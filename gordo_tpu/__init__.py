@@ -1,0 +1,53 @@
+"""
+gordo-tpu — a TPU-native model-fleet framework.
+
+Builds thousands of per-asset anomaly-detection models (feedforward / LSTM
+autoencoders over time-series sensor data) from a single YAML config, trains
+them as vmapped/shard_mapped batches on a TPU mesh (JAX/XLA/Flax), and serves
+anomaly scores over HTTP.
+
+Capability parity target: equinor/gordo (see SURVEY.md). The reference fans
+out one Kubernetes pod per model (argo-workflow.yml.template:1519-1598); this
+framework fans the same fleet out across TPU chips instead.
+
+Version parsing semantics follow the reference (gordo/__init__.py:15-47).
+"""
+
+import re
+from typing import Optional, Tuple
+
+__version__ = "0.1.0"
+
+_VERSION_RE = re.compile(
+    r"^(?P<major>\d+)\.(?P<minor>\d+)\.(?P<patch>\d+)"
+    r"(?:[.+-]?(?P<suffix>[0-9A-Za-z.+-]+))?$"
+)
+
+
+def parse_version(version: str) -> Tuple[int, int, int, Optional[str]]:
+    """
+    Parse a package version string into ``(major, minor, patch, suffix)``.
+
+    A version with any suffix (dev/rc/post segments) is considered
+    "unstable"; the builder's cache key includes the full version for
+    unstable builds (reference: gordo/builder/build_model.py:606-609).
+
+    Examples
+    --------
+    >>> parse_version("1.2.3")
+    (1, 2, 3, None)
+    >>> parse_version("1.2.3.dev4+g12345")
+    (1, 2, 3, 'dev4+g12345')
+    """
+    match = _VERSION_RE.match(version)
+    if match is None:
+        raise ValueError(f"Unparseable package version: {version!r}")
+    major, minor, patch = (int(match.group(g)) for g in ("major", "minor", "patch"))
+    return major, minor, patch, match.group("suffix")
+
+
+def version_is_stable(version: str = __version__) -> bool:
+    return parse_version(version)[3] is None
+
+
+MAJOR_VERSION, MINOR_VERSION = parse_version(__version__)[:2]

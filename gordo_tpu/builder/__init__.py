@@ -1,0 +1,5 @@
+from .build_model import ModelBuilder
+from .local_build import local_build
+from .utils import create_model_builder
+
+__all__ = ["ModelBuilder", "local_build", "create_model_builder"]

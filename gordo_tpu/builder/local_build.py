@@ -1,0 +1,47 @@
+"""
+Dev/test loop: full YAML config → trained models, no orchestration plane.
+
+Reference parity: gordo/builder/local_build.py:14-70 — parse the config
+through NormalizedConfig and yield ``ModelBuilder(machine).build()`` per
+machine. The whole test pyramid stands on this path (SURVEY.md §3.4).
+"""
+
+from io import StringIO
+from typing import Iterable, Tuple, Union
+
+import yaml
+
+from ..machine import Machine
+from .build_model import ModelBuilder
+
+
+def local_build(
+    config_str: str, project_name: str = "local-build"
+) -> Iterable[Tuple[object, Machine]]:
+    """
+    Build every machine in a YAML config locally.
+
+    Example
+    -------
+    >>> import io
+    >>> config = '''
+    ... machines:
+    ...   - name: machine-1
+    ...     dataset:
+    ...       type: RandomDataset
+    ...       train_start_date: "2020-01-01T00:00:00+00:00"
+    ...       train_end_date: "2020-02-01T00:00:00+00:00"
+    ...       tag_list: [tag-1, tag-2]
+    ...     model:
+    ...       gordo_tpu.models.JaxAutoEncoder:
+    ...         kind: feedforward_hourglass
+    ...         epochs: 1
+    ... '''  # doctest: +SKIP
+    >>> model, machine = next(local_build(config))  # doctest: +SKIP
+    """
+    from ..workflow.config_elements.normalized_config import NormalizedConfig
+
+    config = yaml.safe_load(StringIO(config_str))
+    normalized = NormalizedConfig(config, project_name=project_name)
+    for machine in normalized.machines:
+        yield ModelBuilder(machine=machine).build()

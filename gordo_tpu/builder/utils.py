@@ -1,0 +1,19 @@
+"""Builder-class plugin point (reference: gordo/builder/utils.py:8-17)."""
+
+from typing import Optional, Type
+
+from ..serializer.import_utils import import_location
+from .build_model import ModelBuilder
+
+
+def create_model_builder(model_builder_class: Optional[str]) -> Type[ModelBuilder]:
+    """Resolve ``--model-builder-class``; must subclass ModelBuilder."""
+    if not model_builder_class:
+        return ModelBuilder
+    BuilderClass = import_location(model_builder_class)
+    if not (isinstance(BuilderClass, type) and issubclass(BuilderClass, ModelBuilder)):
+        raise ValueError(
+            f"{model_builder_class} is not a subclass of "
+            "gordo_tpu.builder.build_model.ModelBuilder"
+        )
+    return BuilderClass

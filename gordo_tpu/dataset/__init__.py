@@ -1,0 +1,35 @@
+from .data_provider import (
+    GordoBaseDataProvider,
+    ListBackedDataProvider,
+    RandomDataProvider,
+)
+from .datasets import GordoBaseDataset, RandomDataset, TimeSeriesDataset
+from .exceptions import (
+    ConfigException,
+    InsufficientDataError,
+    NoSuitableDataProviderError,
+)
+from .sensor_tag import (
+    SensorTag,
+    normalize_sensor_tag,
+    normalize_sensor_tags,
+    to_list_of_strings,
+    unique_tag_names,
+)
+
+__all__ = [
+    "GordoBaseDataset",
+    "TimeSeriesDataset",
+    "RandomDataset",
+    "GordoBaseDataProvider",
+    "RandomDataProvider",
+    "ListBackedDataProvider",
+    "SensorTag",
+    "normalize_sensor_tag",
+    "normalize_sensor_tags",
+    "to_list_of_strings",
+    "unique_tag_names",
+    "ConfigException",
+    "InsufficientDataError",
+    "NoSuitableDataProviderError",
+]

@@ -1,0 +1,19 @@
+"""
+Dataset-layer exceptions.
+
+Reference parity: gordo-core's exceptions as consumed by gordo's builder exit
+-code map (gordo/cli/cli.py:26-39): ``ConfigException``,
+``InsufficientDataError``, ``NoSuitableDataProviderError``.
+"""
+
+
+class ConfigException(ValueError):
+    """Invalid dataset/machine configuration."""
+
+
+class InsufficientDataError(ValueError):
+    """Raised when the dataset resolves to fewer rows than required."""
+
+
+class NoSuitableDataProviderError(ValueError):
+    """No registered data provider can serve the requested tags."""

@@ -1,0 +1,93 @@
+"""
+Sensor-tag domain type and normalization.
+
+Reference parity: gordo-core's ``SensorTag`` surface as consumed by gordo
+(gordo/utils.py:16-50, machine/machine.py:151-168): a tag has a ``name`` and
+an optional ``asset``; configs may give tags as bare strings, dicts, or
+(name, asset) lists.
+"""
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Union
+
+
+@dataclass(frozen=True)
+class SensorTag:
+    name: str
+    asset: Optional[str] = None
+
+    def to_json(self) -> dict:
+        out = {"name": self.name}
+        if self.asset is not None:
+            out["asset"] = self.asset
+        return out
+
+    @classmethod
+    def from_json(cls, obj: Union[str, dict, Sequence]) -> "SensorTag":
+        return normalize_sensor_tag(obj)
+
+
+class SensorTagNormalizationError(ValueError):
+    pass
+
+
+def normalize_sensor_tag(
+    tag: Union[str, dict, Sequence, SensorTag], asset: Optional[str] = None
+) -> SensorTag:
+    """
+    Coerce any config-level tag representation into a ``SensorTag``.
+
+    >>> normalize_sensor_tag("TAG-1")
+    SensorTag(name='TAG-1', asset=None)
+    >>> normalize_sensor_tag({"name": "TAG-1", "asset": "plant-a"})
+    SensorTag(name='TAG-1', asset='plant-a')
+    >>> normalize_sensor_tag(["TAG-1", "plant-a"])
+    SensorTag(name='TAG-1', asset='plant-a')
+    """
+    if isinstance(tag, SensorTag):
+        return tag
+    if isinstance(tag, str):
+        return SensorTag(name=tag, asset=asset)
+    if isinstance(tag, dict):
+        if "name" not in tag:
+            raise SensorTagNormalizationError(f"Tag dict missing 'name': {tag!r}")
+        return SensorTag(name=tag["name"], asset=tag.get("asset", asset))
+    if isinstance(tag, (list, tuple)):
+        if not 1 <= len(tag) <= 2:
+            raise SensorTagNormalizationError(f"Tag sequence malformed: {tag!r}")
+        return SensorTag(
+            name=tag[0], asset=tag[1] if len(tag) > 1 else asset
+        )
+    raise SensorTagNormalizationError(f"Unrecognized tag form: {tag!r}")
+
+
+def normalize_sensor_tags(
+    tags: Sequence[Union[str, dict, Sequence, SensorTag]],
+    asset: Optional[str] = None,
+) -> List[SensorTag]:
+    """Normalize a config tag list into ``SensorTag`` objects."""
+    return [normalize_sensor_tag(tag, asset=asset) for tag in tags]
+
+
+def to_list_of_strings(tags: Sequence[Union[str, SensorTag]]) -> List[str]:
+    """Tag names as plain strings (column labels, metadata)."""
+    return [tag.name if isinstance(tag, SensorTag) else str(tag) for tag in tags]
+
+
+def unique_tag_names(tags: Sequence[Union[str, SensorTag]]) -> dict:
+    """
+    Map tag name → SensorTag (insertion-ordered union). Repeats of the same
+    tag are fine; the same name bound to two different assets is an error
+    (the join would produce ambiguous columns).
+    """
+    by_name = {}
+    for tag in tags:
+        normalized = normalize_sensor_tag(tag)
+        existing = by_name.get(normalized.name)
+        if existing is not None and existing != normalized:
+            raise SensorTagNormalizationError(
+                f"Tag name {normalized.name!r} bound to conflicting definitions: "
+                f"{existing} vs {normalized}"
+            )
+        by_name[normalized.name] = normalized
+    return by_name

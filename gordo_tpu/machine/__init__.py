@@ -1,0 +1,21 @@
+from .loader import load_globals_config, load_machine_config, load_model_config
+from .machine import Machine
+from .metadata import (
+    BuildMetadata,
+    CrossValidationMetaData,
+    DatasetBuildMetadata,
+    Metadata,
+    ModelBuildMetadata,
+)
+
+__all__ = [
+    "Machine",
+    "Metadata",
+    "BuildMetadata",
+    "ModelBuildMetadata",
+    "DatasetBuildMetadata",
+    "CrossValidationMetaData",
+    "load_globals_config",
+    "load_machine_config",
+    "load_model_config",
+]

@@ -1,0 +1,5 @@
+"""Machine config constants (reference: gordo/machine/constants.py)."""
+
+# Fields of a machine config block that may arrive as YAML embedded in a
+# string and must be parsed at load time.
+MACHINE_YAML_FIELDS = ("model", "dataset", "evaluation", "metadata", "runtime")

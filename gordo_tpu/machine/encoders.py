@@ -1,0 +1,59 @@
+"""
+JSON/YAML encoders for machine configs (reference: gordo/machine/encoders.py).
+"""
+
+import datetime
+import json
+
+import numpy as np
+import yaml
+
+from ..dataset.sensor_tag import SensorTag
+
+
+class MachineJSONEncoder(json.JSONEncoder):
+    """Serializes datetimes (ISO), SensorTags, and numpy scalars/arrays."""
+
+    def default(self, obj):
+        if isinstance(obj, (datetime.datetime, datetime.date)):
+            return obj.isoformat()
+        if isinstance(obj, SensorTag):
+            return obj.to_json()
+        if isinstance(obj, np.integer):
+            return int(obj)
+        if isinstance(obj, np.floating):
+            return float(obj)
+        if isinstance(obj, np.ndarray):
+            return obj.tolist()
+        if hasattr(obj, "to_dict"):
+            return obj.to_dict()
+        return super().default(obj)
+
+
+def multiline_str(dumper: yaml.Dumper, data: str):
+    """Render multi-line strings as YAML literal blocks."""
+    style = "|" if "\n" in data else None
+    return dumper.represent_scalar("tag:yaml.org,2002:str", data, style=style)
+
+
+class MachineSafeDumper(yaml.SafeDumper):
+    pass
+
+
+MachineSafeDumper.add_representer(str, multiline_str)
+MachineSafeDumper.add_representer(
+    SensorTag,
+    lambda dumper, tag: dumper.represent_dict(tag.to_json()),
+)
+MachineSafeDumper.add_representer(
+    datetime.datetime,
+    lambda dumper, dt: dumper.represent_scalar(
+        "tag:yaml.org,2002:str", dt.isoformat()
+    ),
+)
+MachineSafeDumper.add_representer(
+    np.float64, lambda dumper, v: dumper.represent_float(float(v))
+)
+MachineSafeDumper.add_representer(
+    np.int64, lambda dumper, v: dumper.represent_int(int(v))
+)

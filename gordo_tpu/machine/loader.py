@@ -1,0 +1,61 @@
+"""
+Typed machine/global config loading.
+
+Reference parity: gordo/machine/loader.py — config fields in
+``MACHINE_YAML_FIELDS`` may be YAML embedded in strings and are parsed;
+``name`` and ``project_name`` presence is enforced.
+"""
+
+from typing import Any, Dict, Optional
+
+import yaml
+
+from .constants import MACHINE_YAML_FIELDS
+
+GlobalsConfig = Dict[str, Any]
+MachineConfig = Dict[str, Any]
+ModelConfig = Dict[str, Any]
+
+
+def _parse_yaml_fields(config: dict) -> dict:
+    config = dict(config)
+    for field in MACHINE_YAML_FIELDS:
+        value = config.get(field)
+        if isinstance(value, str):
+            config[field] = yaml.safe_load(value)
+    return config
+
+
+def load_globals_config(config: Optional[dict]) -> GlobalsConfig:
+    """
+    Normalize a ``globals`` block, parsing YAML-in-string fields.
+
+    >>> load_globals_config({"model": "{'sklearn.pipeline.Pipeline': {}}"})["model"]
+    {'sklearn.pipeline.Pipeline': {}}
+    """
+    if config is None:
+        return {}
+    if not isinstance(config, dict):
+        raise ValueError(f"globals config must be a mapping, got {type(config)}")
+    return _parse_yaml_fields(config)
+
+
+def load_machine_config(config: dict) -> MachineConfig:
+    """Normalize one machine block; requires ``name``."""
+    if not isinstance(config, dict):
+        raise ValueError(f"machine config must be a mapping, got {type(config)}")
+    config = _parse_yaml_fields(config)
+    if not config.get("name"):
+        raise ValueError("machine config requires a 'name'")
+    return config
+
+
+def load_model_config(config: dict) -> MachineConfig:
+    """
+    Normalize a full model-build config (the ``MACHINE`` env payload of a
+    build pod); requires ``name`` and ``project_name``.
+    """
+    config = load_machine_config(config)
+    if not config.get("project_name"):
+        raise ValueError("model config requires a 'project_name'")
+    return config

@@ -1,0 +1,204 @@
+"""
+The Machine: one model's full configuration (name, model definition,
+dataset, evaluation, runtime, metadata).
+
+Reference parity: gordo/machine/machine.py:30-269 — descriptor-validated
+attributes, ``from_config`` overlaying machine-local config and globals via
+``patch_dict`` (including the reference's merge directions: globals are the
+base for runtime/evaluation, but globals *patch over* the machine's dataset
+block), JSON/YAML round-trips, and ``report()`` running configured
+reporters.
+"""
+
+import copy
+import json
+import logging
+from typing import Any, Dict, Optional
+
+import yaml
+
+from ..dataset import GordoBaseDataset
+from ..dataset.sensor_tag import normalize_sensor_tags
+from ..workflow.helpers import patch_dict
+from .encoders import MachineJSONEncoder, MachineSafeDumper
+from .loader import GlobalsConfig, load_machine_config
+from .metadata import Metadata
+from .validators import (
+    ValidDataset,
+    ValidMachineRuntime,
+    ValidMetadata,
+    ValidModel,
+    ValidUrlString,
+)
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_EVALUATION_CONFIG = {
+    "cv_mode": "full_build",
+    "scoring_scaler": "sklearn.preprocessing.MinMaxScaler",
+    "metrics": [
+        "explained_variance_score",
+        "r2_score",
+        "mean_squared_error",
+        "mean_absolute_error",
+    ],
+}
+
+
+class Machine:
+    name = ValidUrlString()
+    project_name = ValidUrlString()
+    host = ValidUrlString()
+    model = ValidModel()
+    dataset = ValidDataset()
+    metadata = ValidMetadata()
+    runtime = ValidMachineRuntime()
+
+    def __init__(
+        self,
+        name: str,
+        model: dict,
+        dataset: Any,
+        project_name: str,
+        evaluation: Optional[dict] = None,
+        metadata: Optional[Any] = None,
+        runtime: Optional[dict] = None,
+    ):
+        self.name = name
+        self.model = model
+        self.dataset = dataset
+        self.project_name = project_name
+        self.evaluation = (
+            evaluation if evaluation is not None else dict(DEFAULT_EVALUATION_CONFIG)
+        )
+        self.metadata = metadata
+        self.runtime = runtime if runtime is not None else {}
+        self.host = f"gordoserver-{project_name}-{name}"
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_config(
+        cls,
+        config: Dict[str, Any],
+        project_name: Optional[str] = None,
+        config_globals: Optional[GlobalsConfig] = None,
+    ) -> "Machine":
+        """Build a Machine from one machine block + the globals block."""
+        config = load_machine_config(config)
+        config_globals = config_globals or {}
+
+        name = config["name"]
+        model = config.get("model") or config_globals.get("model")
+        if model is None:
+            raise ValueError(f"Machine {name} has no model (locally or in globals)")
+
+        if project_name is None:
+            project_name = config.get("project_name")
+        if project_name is None:
+            raise ValueError("project_name is empty")
+
+        runtime = patch_dict(
+            config_globals.get("runtime", {}), config.get("runtime", {})
+        )
+        # Reference quirk preserved: globals' dataset patches over the
+        # machine's (machine/machine.py:122-124).
+        dataset = patch_dict(
+            config.get("dataset", {}), config_globals.get("dataset", {})
+        )
+        evaluation = patch_dict(
+            config_globals.get("evaluation", DEFAULT_EVALUATION_CONFIG),
+            config.get("evaluation", {}),
+        )
+        metadata = Metadata(
+            user_defined={
+                "global-metadata": config_globals.get("metadata", {}),
+                "machine-metadata": config.get("metadata", {}),
+            }
+        )
+        return cls(
+            name=name,
+            model=model,
+            dataset=dataset,
+            project_name=project_name,
+            evaluation=evaluation,
+            metadata=metadata,
+            runtime=runtime,
+        )
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]) -> "Machine":
+        """Rehydrate from ``to_dict`` output."""
+        config = dict(config)
+        metadata = config.get("metadata")
+        if isinstance(metadata, dict):
+            config["metadata"] = Metadata.from_dict(metadata)
+        return cls(
+            name=config["name"],
+            model=config["model"],
+            dataset=config["dataset"],
+            project_name=config["project_name"],
+            evaluation=config.get("evaluation"),
+            metadata=config.get("metadata"),
+            runtime=config.get("runtime"),
+        )
+
+    # -- serialization ------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "project_name": self.project_name,
+            "model": self.model,
+            "dataset": self.dataset.to_dict()
+            if isinstance(self.dataset, GordoBaseDataset)
+            else self.dataset,
+            "evaluation": self.evaluation,
+            "metadata": self.metadata.to_dict(),
+            "runtime": self.runtime,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), cls=MachineJSONEncoder)
+
+    def to_yaml(self) -> str:
+        return yaml.dump(
+            yaml.safe_load(self.to_json()),
+            Dumper=MachineSafeDumper,
+            default_flow_style=False,
+        )
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Machine) and self.to_dict() == other.to_dict()
+
+    def __repr__(self) -> str:
+        return f"Machine(name={self.name!r}, project_name={self.project_name!r})"
+
+    # -- tags ---------------------------------------------------------------
+
+    def normalize_sensor_tags(self, tag_list) -> list:
+        """Resolve tag names to SensorTags using dataset build metadata
+        (reference: machine/machine.py:151-168)."""
+        build_dataset_metadata = (
+            self.metadata.build_metadata.dataset.dataset_meta or {}
+        )
+        asset = None
+        for tag_meta in build_dataset_metadata.get("tag_list", []):
+            if isinstance(tag_meta, dict) and tag_meta.get("asset"):
+                asset = tag_meta["asset"]
+                break
+        return normalize_sensor_tags(tag_list, asset=asset)
+
+    # -- reporting ----------------------------------------------------------
+
+    def report(self):
+        """
+        Run any reporters configured in ``runtime.reporters``. Deliberate
+        late import to break the layering circle (reference:
+        machine/machine.py:264-265).
+        """
+        from ..reporters.base import create_reporters
+
+        for reporter in create_reporters(self.runtime.get("reporters", [])):
+            logger.debug("Reporting machine %s via %r", self.name, reporter)
+            reporter.report(self)

@@ -1,0 +1,173 @@
+"""
+Descriptor-protocol validators for Machine attributes.
+
+Reference parity: gordo/machine/validators.py — each Machine attribute is a
+class-level descriptor that validates on assignment. Notables kept:
+``ValidUrlString`` enforces k8s DNS-label names (lowercase alnum + dash,
+≤63 chars); ``ValidModel`` eagerly test-builds the model pipeline via the
+serializer (its lines 81-92); ``ValidMachineRuntime.fix_resource_limits``
+bumps limits up to at least the requests.
+"""
+
+import copy
+import datetime
+import re
+from typing import Any
+
+import dateutil.parser
+
+
+class BaseDescriptor:
+    """Validate-on-assign descriptor base."""
+
+    def __set_name__(self, owner, name):
+        self.name = f"_{name}"
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return getattr(instance, self.name, None)
+
+    def __set__(self, instance, value):
+        setattr(instance, self.name, self.validate(value))
+
+    def validate(self, value) -> Any:
+        return value
+
+
+class ValidUrlString(BaseDescriptor):
+    """
+    Value must be usable as a k8s resource name / DNS label.
+
+    >>> ValidUrlString.valid_url_string("a-good-name")
+    True
+    >>> ValidUrlString.valid_url_string("Not_good")
+    False
+    """
+
+    _pattern = re.compile(r"^[a-z0-9]([a-z0-9\-]{0,61}[a-z0-9])?$")
+
+    @classmethod
+    def valid_url_string(cls, value: str) -> bool:
+        return isinstance(value, str) and bool(cls._pattern.match(value))
+
+    def validate(self, value):
+        if not self.valid_url_string(value):
+            raise ValueError(
+                f"{value!r} is not a valid name: must be lowercase alphanumeric "
+                "or '-', at most 63 chars, starting/ending alphanumeric"
+            )
+        return value
+
+
+class ValidModel(BaseDescriptor):
+    """Model definition must be a dict that the serializer can build."""
+
+    def validate(self, value):
+        if not isinstance(value, dict):
+            raise ValueError(f"Model definition must be a dict, got {type(value)}")
+        from ..serializer import from_definition
+
+        try:
+            from_definition(copy.deepcopy(value))
+        except Exception as e:
+            raise ValueError(f"Invalid model definition: {e}") from e
+        return value
+
+
+class ValidDataset(BaseDescriptor):
+    def validate(self, value):
+        from ..dataset import GordoBaseDataset
+
+        if isinstance(value, GordoBaseDataset):
+            return value
+        if isinstance(value, dict):
+            return GordoBaseDataset.from_dict(copy.deepcopy(value))
+        raise ValueError(f"Dataset must be a dict or GordoBaseDataset, got {type(value)}")
+
+
+class ValidMetadata(BaseDescriptor):
+    def validate(self, value):
+        from .metadata import Metadata
+
+        if value is None:
+            return Metadata()
+        if isinstance(value, Metadata):
+            return value
+        if isinstance(value, dict):
+            return Metadata.from_dict(value)
+        raise ValueError(f"Metadata must be a dict or Metadata, got {type(value)}")
+
+
+def fix_resource_limits(resources: dict) -> dict:
+    """
+    Ensure limits >= requests for cpu/memory resource blocks (reference:
+    validators.py:173-231).
+
+    >>> out = fix_resource_limits(
+    ...     {"requests": {"memory": 1000}, "limits": {"memory": 100}})
+    >>> out["limits"]["memory"]
+    1000
+    """
+    resources = copy.deepcopy(resources)
+    requests = resources.get("requests", {})
+    limits = resources.get("limits", {})
+    for key in ("cpu", "memory"):
+        request, limit = requests.get(key), limits.get(key)
+        if request is None or limit is None:
+            continue
+        if not isinstance(request, (int, float)) or not isinstance(
+            limit, (int, float)
+        ):
+            raise ValueError(
+                f"Resource {key} must be numeric, got request={request!r} "
+                f"limit={limit!r}"
+            )
+        if limit < request:
+            limits[key] = request
+    return resources
+
+
+class ValidMachineRuntime(BaseDescriptor):
+    def validate(self, value):
+        if not isinstance(value, dict):
+            raise ValueError(f"Runtime must be a dict, got {type(value)}")
+        value = copy.deepcopy(value)
+        for section in ("builder", "server", "fleet"):
+            if section in value and isinstance(value[section], dict):
+                if "resources" in value[section]:
+                    value[section]["resources"] = fix_resource_limits(
+                        value[section]["resources"]
+                    )
+        return value
+
+
+class ValidDatetime(BaseDescriptor):
+    """Datetimes must be timezone-aware (reference: validators.py:234-253)."""
+
+    def validate(self, value):
+        if isinstance(value, str):
+            value = dateutil.parser.isoparse(value)
+        if not isinstance(value, datetime.datetime) or value.tzinfo is None:
+            raise ValueError(f"{value!r} is not a timezone-aware datetime")
+        return value
+
+
+class ValidTagList(BaseDescriptor):
+    def validate(self, value):
+        if not isinstance(value, (list, tuple)) or not value:
+            raise ValueError("Requires a non-empty list of tags")
+        return list(value)
+
+
+class ValidDataProvider(BaseDescriptor):
+    def validate(self, value):
+        from ..dataset import GordoBaseDataProvider
+
+        if isinstance(value, GordoBaseDataProvider):
+            return value
+        if isinstance(value, dict):
+            return GordoBaseDataProvider.from_dict(value)
+        raise ValueError(
+            f"Data provider must be a dict or GordoBaseDataProvider, got {type(value)}"
+        )

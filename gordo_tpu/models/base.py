@@ -1,0 +1,35 @@
+"""
+The GordoBase contract every model in the framework honors.
+
+Reference parity: gordo/machine/model/base.py:10-35 — the builder, server
+and serializer only rely on this surface plus sklearn's fit/predict.
+"""
+
+import abc
+from typing import Optional, Union
+
+import numpy as np
+import pandas as pd
+
+
+class GordoBase(abc.ABC):
+    @abc.abstractmethod
+    def __init__(self, **kwargs):
+        ...
+
+    @abc.abstractmethod
+    def get_params(self, deep: bool = False) -> dict:
+        """Parameters this model was constructed with."""
+
+    @abc.abstractmethod
+    def score(
+        self,
+        X: Union[np.ndarray, pd.DataFrame],
+        y: Union[np.ndarray, pd.DataFrame],
+        sample_weight: Optional[np.ndarray] = None,
+    ) -> float:
+        """Score the model; channels into builder CV metrics."""
+
+    @abc.abstractmethod
+    def get_metadata(self) -> dict:
+        """Any model-specific metadata (fit history, thresholds, ...)."""

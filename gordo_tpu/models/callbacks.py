@@ -1,0 +1,77 @@
+"""
+Training callbacks.
+
+Reference configs attach Keras callbacks (built via
+gordo/serializer/from_definition.py:352-373); gordo-tpu supports the one that
+matters for these models — EarlyStopping — and compiles it *into* the fused
+training program as a static config (no per-epoch host round trip) whenever
+possible. Unknown/custom callbacks fall back to the per-epoch host loop in
+models/training.py.
+"""
+
+from typing import Optional
+
+
+class Callback:
+    """Base class; host-loop callbacks receive per-epoch logs."""
+
+    def on_train_begin(self, logs: Optional[dict] = None):
+        ...
+
+    def on_epoch_end(self, epoch: int, logs: Optional[dict] = None) -> bool:
+        """Return True to request early stop."""
+        return False
+
+    def get_params(self, deep: bool = False) -> dict:
+        return {}
+
+
+class EarlyStopping(Callback):
+    """
+    Stop training when ``monitor`` stops improving by ``min_delta`` for
+    ``patience`` epochs; optionally restore the best params seen.
+
+    Keras-compatible surface (the subset gordo configs use):
+    monitor/min_delta/patience/restore_best_weights.
+    """
+
+    def __init__(
+        self,
+        monitor: str = "val_loss",
+        min_delta: float = 0.0,
+        patience: int = 0,
+        verbose: int = 0,
+        mode: str = "auto",
+        restore_best_weights: bool = False,
+        **kwargs,
+    ):
+        self.monitor = monitor
+        self.min_delta = float(min_delta)
+        self.patience = int(patience)
+        self.verbose = verbose
+        self.mode = mode
+        self.restore_best_weights = restore_best_weights
+        self._best = None
+        self._wait = 0
+
+    def get_params(self, deep: bool = False) -> dict:
+        return {
+            "monitor": self.monitor,
+            "min_delta": self.min_delta,
+            "patience": self.patience,
+            "restore_best_weights": self.restore_best_weights,
+        }
+
+    def on_train_begin(self, logs: Optional[dict] = None):
+        self._best, self._wait = None, 0
+
+    def on_epoch_end(self, epoch: int, logs: Optional[dict] = None) -> bool:
+        value = (logs or {}).get(self.monitor)
+        if value is None:
+            return False
+        if self._best is None or value < self._best - self.min_delta:
+            self._best, self._wait = value, 0
+            return False
+        self._wait += 1
+        # Keras stops when wait >= patience (patience=0 behaves like 1)
+        return self._wait >= max(self.patience, 1)

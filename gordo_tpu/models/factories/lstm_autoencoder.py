@@ -1,0 +1,120 @@
+"""
+LSTM architecture factories (autoencoder + forecast heads share them).
+
+Same three registered kinds as the reference
+(gordo/machine/model/factories/lstm_autoencoder.py), each registered for
+both LSTM estimator types (its double-decorator at lines 15-16). Returns a
+static :class:`~gordo_tpu.models.spec.LSTMSpec`: stacked LSTM layers (all
+return sequences except the last) feeding a Dense output head.
+"""
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+from ..register import register_model_builder
+from ..spec import LSTMSpec, OptimizerSpec
+from .utils import check_dim_func_len, hourglass_calc_dims
+
+
+@register_model_builder(type="JaxLSTMAutoEncoder")
+@register_model_builder(type="JaxLSTMForecast")
+def lstm_model(
+    n_features: int,
+    n_features_out: Optional[int] = None,
+    lookback_window: int = 1,
+    encoding_dim: Tuple[int, ...] = (256, 128, 64),
+    encoding_func: Tuple[str, ...] = ("tanh", "tanh", "tanh"),
+    decoding_dim: Tuple[int, ...] = (64, 128, 256),
+    decoding_func: Tuple[str, ...] = ("tanh", "tanh", "tanh"),
+    out_func: str = "linear",
+    optimizer: Union[str, OptimizerSpec] = "Adam",
+    optimizer_kwargs: Optional[Dict[str, Any]] = None,
+    compile_kwargs: Optional[Dict[str, Any]] = None,
+    **kwargs,
+) -> LSTMSpec:
+    """Fully-specified stacked-LSTM network over a lookback window."""
+    n_features_out = n_features_out or n_features
+    check_dim_func_len("encoding", encoding_dim, encoding_func)
+    check_dim_func_len("decoding", decoding_dim, decoding_func)
+    compile_kwargs = compile_kwargs or {}
+    return LSTMSpec(
+        n_features=n_features,
+        n_features_out=n_features_out,
+        lookback_window=lookback_window,
+        dims=tuple(encoding_dim) + tuple(decoding_dim),
+        activations=tuple(encoding_func) + tuple(decoding_func),
+        out_activation=out_func,
+        optimizer=OptimizerSpec.from_config(optimizer, optimizer_kwargs),
+        loss=compile_kwargs.get("loss", "mse"),
+    )
+
+
+@register_model_builder(type="JaxLSTMAutoEncoder")
+@register_model_builder(type="JaxLSTMForecast")
+def lstm_symmetric(
+    n_features: int,
+    n_features_out: Optional[int] = None,
+    lookback_window: int = 1,
+    dims: Tuple[int, ...] = (256, 128, 64),
+    funcs: Tuple[str, ...] = ("tanh", "tanh", "tanh"),
+    out_func: str = "linear",
+    optimizer: Union[str, OptimizerSpec] = "Adam",
+    optimizer_kwargs: Optional[Dict[str, Any]] = None,
+    compile_kwargs: Optional[Dict[str, Any]] = None,
+    **kwargs,
+) -> LSTMSpec:
+    """Symmetric stacked LSTM: ``dims`` encoding, reversed decoding."""
+    if len(dims) == 0:
+        raise ValueError("Parameter dims must have len > 0")
+    return lstm_model(
+        n_features,
+        n_features_out,
+        lookback_window=lookback_window,
+        encoding_dim=tuple(dims),
+        decoding_dim=tuple(dims)[::-1],
+        encoding_func=tuple(funcs),
+        decoding_func=tuple(funcs)[::-1],
+        out_func=out_func,
+        optimizer=optimizer,
+        optimizer_kwargs=optimizer_kwargs,
+        compile_kwargs=compile_kwargs,
+        **kwargs,
+    )
+
+
+@register_model_builder(type="JaxLSTMAutoEncoder")
+@register_model_builder(type="JaxLSTMForecast")
+def lstm_hourglass(
+    n_features: int,
+    n_features_out: Optional[int] = None,
+    lookback_window: int = 1,
+    encoding_layers: int = 3,
+    compression_factor: float = 0.5,
+    func: str = "tanh",
+    out_func: str = "linear",
+    optimizer: Union[str, OptimizerSpec] = "Adam",
+    optimizer_kwargs: Optional[Dict[str, Any]] = None,
+    compile_kwargs: Optional[Dict[str, Any]] = None,
+    **kwargs,
+) -> LSTMSpec:
+    """
+    Hourglass stacked LSTM.
+
+    >>> spec = lstm_hourglass(10)
+    >>> spec.dims
+    (8, 7, 5, 5, 7, 8)
+    >>> lstm_hourglass(10, compression_factor=0.2).dims
+    (7, 5, 2, 2, 5, 7)
+    """
+    dims = hourglass_calc_dims(compression_factor, encoding_layers, n_features)
+    return lstm_symmetric(
+        n_features,
+        n_features_out,
+        lookback_window=lookback_window,
+        dims=dims,
+        funcs=tuple([func] * len(dims)),
+        out_func=out_func,
+        optimizer=optimizer,
+        optimizer_kwargs=optimizer_kwargs,
+        compile_kwargs=compile_kwargs,
+        **kwargs,
+    )

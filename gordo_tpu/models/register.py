@@ -1,0 +1,48 @@
+"""
+Model-architecture factory registry.
+
+Reference parity: gordo/machine/model/register.py:10-75 — a class-level dict
+``{model_type: {kind: builder_fn}}`` filled by the ``register_model_builder``
+decorator; builders must accept ``n_features`` as their first argument.
+
+In gordo-tpu a builder returns a static :mod:`gordo_tpu.models.spec`
+ModelSpec (not a live Keras model): specs are hashable, which is what lets
+the fleet trainer bucket thousands of machines into a handful of XLA
+compilations.
+"""
+
+import inspect
+from typing import Callable, Dict
+
+
+class register_model_builder:
+    """
+    Decorator registering an architecture factory for a model type.
+
+    Example
+    -------
+    >>> @register_model_builder(type="DemoModel")
+    ... def my_arch(n_features: int, **kwargs):
+    ...     return None
+    >>> "my_arch" in register_model_builder.factories["DemoModel"]
+    True
+    """
+
+    factories: Dict[str, Dict[str, Callable]] = {}
+
+    def __init__(self, type: str):
+        self.type = type
+
+    def __call__(self, build_fn: Callable) -> Callable:
+        self._validate_func(build_fn)
+        self.factories.setdefault(self.type, {})[build_fn.__name__] = build_fn
+        return build_fn
+
+    @staticmethod
+    def _validate_func(func: Callable):
+        params = list(inspect.signature(func).parameters)
+        if not params or params[0] != "n_features":
+            raise ValueError(
+                f"Model builder function {func.__name__!r} must take "
+                f"'n_features' as its first parameter, got {params[:1]}"
+            )

@@ -1,0 +1,242 @@
+"""
+Static model specifications — the TPU-first replacement for "a compiled Keras
+model object".
+
+Where the reference's factories return a live ``keras.Sequential``
+(gordo/machine/model/factories/*.py), gordo-tpu factories return a frozen
+**ModelSpec**. The spec is:
+
+- *static*: pure data (tuples, floats, strings) → safely closed over by
+  ``jit``; no retracing surprises;
+- *hashable*: the fleet trainer groups thousands of machines by spec so each
+  distinct architecture compiles exactly once (SURVEY.md §7 step 7,
+  "compilation buckets");
+- *declarative*: the training engine (models/training.py) turns a spec into
+  init/forward/loss functions.
+"""
+
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, Optional, Tuple, Union
+
+
+def _freeze_kwargs(kwargs: Optional[Dict[str, Any]]) -> Tuple[Tuple[str, Any], ...]:
+    if not kwargs:
+        return ()
+    return tuple(sorted(kwargs.items()))
+
+
+@dataclass(frozen=True)
+class OptimizerSpec:
+    """
+    Optimizer configuration. Defaults mirror Keras' Adam
+    (learning_rate=1e-3, beta_1=0.9, beta_2=0.999, epsilon=1e-7) so that
+    configs written for the reference train equivalently.
+    """
+
+    name: str = "Adam"
+    learning_rate: float = 0.001
+    kwargs: Tuple[Tuple[str, Any], ...] = ()
+
+    @classmethod
+    def from_config(
+        cls,
+        optimizer: Union[str, "OptimizerSpec", None] = "Adam",
+        optimizer_kwargs: Optional[Dict[str, Any]] = None,
+    ) -> "OptimizerSpec":
+        if isinstance(optimizer, OptimizerSpec):
+            return optimizer
+        optimizer_kwargs = dict(optimizer_kwargs or {})
+        lr = optimizer_kwargs.pop(
+            "learning_rate", optimizer_kwargs.pop("lr", 0.001)
+        )
+        return cls(
+            name=optimizer or "Adam",
+            learning_rate=float(lr),
+            kwargs=_freeze_kwargs(optimizer_kwargs),
+        )
+
+    def to_optax(self):
+        import optax
+
+        kwargs = dict(self.kwargs)
+        name = self.name.lower()
+        if name == "adam":
+            return optax.adam(
+                learning_rate=self.learning_rate,
+                b1=kwargs.get("beta_1", 0.9),
+                b2=kwargs.get("beta_2", 0.999),
+                eps=kwargs.get("epsilon", 1e-7),
+            )
+        if name == "adamw":
+            return optax.adamw(
+                learning_rate=self.learning_rate,
+                b1=kwargs.get("beta_1", 0.9),
+                b2=kwargs.get("beta_2", 0.999),
+                eps=kwargs.get("epsilon", 1e-7),
+                weight_decay=kwargs.get("weight_decay", 1e-4),
+            )
+        if name == "sgd":
+            return optax.sgd(
+                learning_rate=self.learning_rate,
+                momentum=kwargs.get("momentum", 0.0),
+                nesterov=kwargs.get("nesterov", False),
+            )
+        if name == "rmsprop":
+            return optax.rmsprop(
+                learning_rate=self.learning_rate,
+                decay=kwargs.get("rho", 0.9),
+                eps=kwargs.get("epsilon", 1e-7),
+                momentum=kwargs.get("momentum", 0.0),
+            )
+        raise ValueError(f"Unsupported optimizer {self.name!r}")
+
+
+class ModelSpec:
+    """Marker base for architecture specs; concrete specs are frozen dataclasses."""
+
+    def to_dict(self) -> dict:
+        out: Dict[str, Any] = {"spec_type": type(self).__name__}
+        for f in fields(self):  # type: ignore[arg-type]
+            value = getattr(self, f.name)
+            if isinstance(value, OptimizerSpec):
+                value = {
+                    "name": value.name,
+                    "learning_rate": value.learning_rate,
+                    **dict(value.kwargs),
+                }
+            out[f.name] = value
+        return out
+
+
+@dataclass(frozen=True)
+class FeedForwardSpec(ModelSpec):
+    """
+    A feedforward (dense) autoencoder/regressor: ``dims[i]`` hidden units
+    with ``activations[i]``, then an output layer of ``n_features_out`` with
+    ``out_activation``. ``l1_activity[i]`` adds an L1 activity penalty on
+    layer ``i``'s output to the loss (the reference puts l1(1e-4) on all
+    non-first encoder layers — factories/feedforward_autoencoder.py:75-84).
+    """
+
+    n_features: int
+    n_features_out: int
+    dims: Tuple[int, ...]
+    activations: Tuple[str, ...]
+    out_activation: str = "linear"
+    l1_activity: Tuple[float, ...] = ()
+    optimizer: OptimizerSpec = field(default_factory=OptimizerSpec)
+    loss: str = "mse"
+    compute_dtype: str = "float32"
+
+    def __post_init__(self):
+        if len(self.dims) != len(self.activations):
+            raise ValueError(
+                f"dims ({len(self.dims)}) and activations "
+                f"({len(self.activations)}) must have equal length"
+            )
+        if self.l1_activity and len(self.l1_activity) != len(self.dims):
+            raise ValueError("l1_activity must match dims length when given")
+
+
+@dataclass(frozen=True)
+class LSTMSpec(ModelSpec):
+    """
+    A stacked LSTM many-to-one network over a ``lookback_window`` of
+    timesteps: every LSTM layer returns sequences except the last, followed
+    by a Dense output head (reference architecture:
+    factories/lstm_autoencoder.py:78-97).
+    """
+
+    n_features: int
+    n_features_out: int
+    lookback_window: int
+    dims: Tuple[int, ...]
+    activations: Tuple[str, ...]
+    out_activation: str = "linear"
+    optimizer: OptimizerSpec = field(default_factory=OptimizerSpec)
+    loss: str = "mse"
+    compute_dtype: str = "float32"
+
+    def __post_init__(self):
+        if len(self.dims) != len(self.activations):
+            raise ValueError(
+                f"dims ({len(self.dims)}) and activations "
+                f"({len(self.activations)}) must have equal length"
+            )
+        if not self.dims:
+            raise ValueError("LSTM spec needs at least one layer")
+
+
+# ---------------------------------------------------------------------------
+# Raw layer-list definitions (the KerasRawModelRegressor analog): config
+# files can describe a Sequential stack of Dense layers which compiles down
+# to a FeedForwardSpec.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Dense:
+    units: int
+    activation: str = "linear"
+    l1_activity: float = 0.0
+    # Accepted for Keras-config compatibility; the input dim is inferred at
+    # fit time from the data.
+    input_shape: Optional[Tuple[int, ...]] = None
+    input_dim: Optional[int] = None
+
+    def get_params(self, deep: bool = False) -> dict:
+        return {
+            "units": self.units,
+            "activation": self.activation,
+            "l1_activity": self.l1_activity,
+        }
+
+
+class Sequential:
+    """
+    Layer-list container recognized by the serializer (the analog of
+    ``tensorflow.keras.Sequential`` in raw-spec configs —
+    serializer/from_definition.py special-cases it via
+    ``_serializer_layers_container``).
+    """
+
+    _serializer_layers_container = True
+
+    def __init__(self, layers, optimizer="Adam", optimizer_kwargs=None, loss="mse"):
+        self.layers = list(layers)
+        self.optimizer = optimizer
+        self.optimizer_kwargs = optimizer_kwargs or {}
+        self.loss = loss
+
+    def get_params(self, deep: bool = False) -> dict:
+        return {
+            "layers": self.layers,
+            "optimizer": self.optimizer,
+            "optimizer_kwargs": self.optimizer_kwargs,
+            "loss": self.loss,
+        }
+
+    def compile_spec(self, n_features: int) -> FeedForwardSpec:
+        """Compile the layer list into a FeedForwardSpec for ``n_features``
+        inputs; the final Dense layer becomes the output head."""
+        dense_layers = [layer for layer in self.layers if isinstance(layer, Dense)]
+        if len(dense_layers) != len(self.layers):
+            raise ValueError(
+                "Only Dense layers are supported in raw Sequential specs; got "
+                f"{[type(l).__name__ for l in self.layers]}"
+            )
+        if not dense_layers:
+            raise ValueError("Sequential spec needs at least one Dense layer")
+        hidden, head = dense_layers[:-1], dense_layers[-1]
+        return FeedForwardSpec(
+            n_features=n_features,
+            n_features_out=head.units,
+            dims=tuple(layer.units for layer in hidden),
+            activations=tuple(layer.activation for layer in hidden),
+            out_activation=head.activation,
+            l1_activity=tuple(layer.l1_activity for layer in hidden)
+            if any(layer.l1_activity for layer in hidden)
+            else (),
+            optimizer=OptimizerSpec.from_config(self.optimizer, self.optimizer_kwargs),
+            loss=self.loss,
+        )

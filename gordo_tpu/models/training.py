@@ -1,0 +1,389 @@
+"""
+The JAX training engine: spec + arrays → trained params + history.
+
+This replaces the reference's ``keras.Model.fit`` call inside its estimator
+wrapper (gordo/machine/model/models.py:243-287). Design is TPU-first:
+
+- **One device program per fit.** When callbacks can be compiled in (the
+  common case — EarlyStopping becomes masked updates), the entire
+  epochs×batches loop is a nested ``lax.scan`` under one ``jit``; the host
+  dispatches once and reads back final params + per-epoch losses. No
+  per-batch (or even per-epoch) host↔device ping-pong.
+- **Static shapes.** Data is padded host-side to a whole number of batches
+  with a weight mask; shuffling is a device-side ``jax.random.permutation``
+  per epoch, so the compiled program is reused across epochs and across
+  models with the same (spec, shape).
+- **Keras-compatible semantics** where they matter for parity: the
+  validation split is the *last* fraction of the data (taken before
+  shuffling), shuffle applies to the training portion only, epoch "loss" is
+  the sample-weighted mean, Adam defaults match Keras.
+
+The fleet path (gordo_tpu/parallel/fleet.py) vmaps `_train_step`/`_epoch`
+logic over a stacked model axis; both paths share these functions.
+"""
+
+import logging
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..ops.losses import resolve_loss, weighted_mean_loss
+from .callbacks import Callback, EarlyStopping
+from .nn import forward_fn_for, init_fn_for
+from .spec import ModelSpec
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class FitConfig:
+    """Static (hashable) fit configuration — part of the compilation key."""
+
+    epochs: int = 1
+    batch_size: int = 32
+    validation_split: float = 0.0
+    shuffle: bool = True
+    # (monitor, patience, min_delta, restore_best_weights) or None
+    early_stopping: Optional[Tuple[str, int, float, bool]] = None
+
+
+@dataclass
+class History:
+    """Keras-History-shaped fit record (consumed by get_metadata)."""
+
+    history: Dict[str, List[float]]
+    params: Dict[str, Any]
+    epoch: List[int]
+
+
+def split_fit_kwargs(kwargs: dict) -> Tuple[dict, dict]:
+    """Split estimator kwargs into (fit-related, factory-related)."""
+    fit_keys = {
+        "epochs",
+        "batch_size",
+        "validation_split",
+        "shuffle",
+        "callbacks",
+        "verbose",
+        "initial_epoch",
+        "seed",
+    }
+    fit_args = {k: v for k, v in kwargs.items() if k in fit_keys}
+    rest = {k: v for k, v in kwargs.items() if k not in fit_keys}
+    return fit_args, rest
+
+
+def fit_config_from_kwargs(kwargs: dict) -> Tuple[FitConfig, List[Callback]]:
+    """
+    Build a FitConfig from Keras-style fit kwargs. EarlyStopping callbacks
+    compile into the config; any other callbacks are returned for the
+    host-loop path.
+    """
+    callbacks = list(kwargs.get("callbacks") or [])
+    early_stopping = None
+    early_stoppers: List[Callback] = []
+    host_callbacks: List[Callback] = []
+    for cb in callbacks:
+        if isinstance(cb, EarlyStopping):
+            early_stoppers.append(cb)
+            early_stopping = (
+                cb.monitor,
+                cb.patience,
+                cb.min_delta,
+                cb.restore_best_weights,
+            )
+        elif isinstance(cb, Callback):
+            host_callbacks.append(cb)
+        else:
+            raise TypeError(f"Unsupported callback: {cb!r}")
+    if host_callbacks:
+        # The host loop runs all callbacks; EarlyStopping must ride along
+        # rather than being compiled into a program that never runs.
+        host_callbacks = early_stoppers + host_callbacks
+        early_stopping = None
+    config = FitConfig(
+        epochs=int(kwargs.get("epochs", 1)),
+        batch_size=int(kwargs.get("batch_size", 32)),
+        validation_split=float(kwargs.get("validation_split", 0.0)),
+        shuffle=bool(kwargs.get("shuffle", True)),
+        early_stopping=early_stopping,
+    )
+    return config, host_callbacks
+
+
+def _tree_where(flag, a, b):
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.where(flag, x, y), a, b
+    )
+
+
+def _pad_to_batches(
+    X: np.ndarray, y: np.ndarray, batch_size: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Pad to a whole number of batches; returns (X, y, weights, steps)."""
+    n = X.shape[0]
+    steps = max(1, -(-n // batch_size))
+    total = steps * batch_size
+    pad = total - n
+    if pad:
+        X = np.concatenate([X, np.repeat(X[-1:], pad, axis=0)], axis=0)
+        y = np.concatenate([y, np.repeat(y[-1:], pad, axis=0)], axis=0)
+    weights = np.concatenate(
+        [np.ones(n, dtype=X.dtype), np.zeros(pad, dtype=X.dtype)]
+    )
+    return X, y, weights, steps
+
+
+@lru_cache(maxsize=None)
+def _eval_fn(spec: ModelSpec):
+    forward = forward_fn_for(spec)
+    per_sample = resolve_loss(spec.loss)
+
+    @jax.jit
+    def evaluate(params, X, y, w):
+        out, _ = forward(spec, params, X)
+        return weighted_mean_loss(per_sample(out, y), w)
+
+    return evaluate
+
+
+@lru_cache(maxsize=None)
+def predict_fn(spec: ModelSpec):
+    """Jitted forward pass for a spec (used by estimator.predict and server)."""
+    forward = forward_fn_for(spec)
+
+    @jax.jit
+    def predict(params, X):
+        return forward(spec, params, X)[0]
+
+    return predict
+
+
+@lru_cache(maxsize=None)
+def _fit_program(spec: ModelSpec, config: FitConfig):
+    """
+    Compile the fused fit program for (spec, config). Returns a function
+    (params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng) ->
+    (params, losses[epochs], val_losses[epochs], epochs_ran).
+    """
+    forward = forward_fn_for(spec)
+    per_sample = resolve_loss(spec.loss)
+    tx = spec.optimizer.to_optax()
+    es = config.early_stopping
+    monitor_val = es is not None and es[0] == "val_loss"
+
+    def batch_loss(params, xb, yb, wb):
+        out, penalty = forward(spec, params, xb)
+        # Keras adds activity-regularization losses as the raw batch sum, not
+        # averaged; padding rows (duplicates of the last sample) inflate the
+        # final partial batch's penalty slightly — negligible at l1≈1e-4.
+        return weighted_mean_loss(per_sample(out, yb), wb) + penalty
+
+    grad_fn = jax.value_and_grad(batch_loss)
+
+    def train_epoch(params, opt_state, Xtr, ytr, wtr, erng):
+        n_total = Xtr.shape[0]
+        if config.shuffle:
+            perm = jax.random.permutation(erng, n_total)
+        else:
+            perm = jnp.arange(n_total)
+        steps = n_total // config.batch_size
+        idx = perm.reshape(steps, config.batch_size)
+
+        def step(carry, batch_idx):
+            params, opt_state = carry
+            xb = jnp.take(Xtr, batch_idx, axis=0)
+            yb = jnp.take(ytr, batch_idx, axis=0)
+            wb = jnp.take(wtr, batch_idx, axis=0)
+            loss, grads = grad_fn(params, xb, yb, wb)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), loss * jnp.sum(wb)
+
+        (params, opt_state), weighted_losses = jax.lax.scan(step, (params, opt_state), idx)
+        epoch_loss = jnp.sum(weighted_losses) / jnp.maximum(jnp.sum(wtr), 1.0)
+        return params, opt_state, epoch_loss
+
+    def evaluate(params, X, y, w):
+        out, _ = forward(spec, params, X)
+        return weighted_mean_loss(per_sample(out, y), w)
+
+    @jax.jit
+    def fit(params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng):
+        has_val = Xval.shape[0] > 0
+
+        def epoch_body(carry, erng):
+            params, opt_state, best, best_params, wait, stopped = carry
+            stopped_at_start = stopped
+            new_params, new_opt, loss = train_epoch(
+                params, opt_state, Xtr, ytr, wtr, erng
+            )
+            # When already stopped, freeze state (masked update keeps one
+            # compiled program; tiny models make the dead compute negligible).
+            params = _tree_where(stopped, params, new_params)
+            opt_state = _tree_where(stopped, opt_state, new_opt)
+            val_loss = (
+                evaluate(params, Xval, yval, wval)
+                if has_val
+                else jnp.array(jnp.nan, loss.dtype)
+            )
+            if es is not None:
+                monitor = val_loss if (monitor_val and has_val) else loss
+                improved = monitor < best - es[2]
+                best = jnp.where(~stopped & improved, monitor, best)
+                if es[3]:
+                    best_params = _tree_where(
+                        ~stopped & improved, params, best_params
+                    )
+                wait = jnp.where(stopped, wait, jnp.where(improved, 0, wait + 1))
+                stopped = stopped | (wait >= jnp.maximum(es[1], 1))
+            ran = ~stopped_at_start if es is not None else jnp.array(True)
+            return (params, opt_state, best, best_params, wait, stopped), (
+                loss,
+                val_loss,
+                ran,
+            )
+
+        rngs = jax.random.split(rng, config.epochs)
+        init_carry = (
+            params,
+            opt_state,
+            jnp.array(jnp.inf, jnp.float32),
+            params,
+            jnp.array(0, jnp.int32),
+            jnp.array(False),
+        )
+        (params, opt_state, _, best_params, _, _), (losses, val_losses, ran) = (
+            jax.lax.scan(epoch_body, init_carry, rngs)
+        )
+        if es is not None and es[3]:
+            params = best_params
+        return params, opt_state, losses, val_losses, jnp.sum(ran.astype(jnp.int32))
+
+    return fit
+
+
+def fit_single(
+    spec: ModelSpec,
+    X: np.ndarray,
+    y: np.ndarray,
+    config: FitConfig,
+    seed: int = 42,
+    host_callbacks: Optional[List[Callback]] = None,
+    initial_params=None,
+) -> Tuple[Any, History]:
+    """
+    Train one model described by ``spec`` on host arrays ``(X, y)``.
+
+    Returns (params pytree, History). ``host_callbacks`` forces the per-epoch
+    host loop; otherwise the whole fit is a single device program.
+    """
+    n = X.shape[0]
+    n_val = int(n * config.validation_split)
+    Xtr_raw, ytr_raw = X[: n - n_val], y[: n - n_val]
+    Xval_raw, yval_raw = X[n - n_val :], y[n - n_val :]
+
+    batch_size = min(config.batch_size, max(1, len(Xtr_raw)))
+    if batch_size != config.batch_size:
+        config = FitConfig(
+            epochs=config.epochs,
+            batch_size=batch_size,
+            validation_split=config.validation_split,
+            shuffle=config.shuffle,
+            early_stopping=config.early_stopping,
+        )
+
+    Xtr, ytr, wtr, _ = _pad_to_batches(
+        np.asarray(Xtr_raw, np.float32), np.asarray(ytr_raw, np.float32), batch_size
+    )
+    Xval = np.asarray(Xval_raw, np.float32)
+    yval = np.asarray(yval_raw, np.float32)
+    wval = np.ones(len(Xval), np.float32)
+
+    rng = jax.random.PRNGKey(seed)
+    rng, init_rng = jax.random.split(rng)
+    params = (
+        initial_params
+        if initial_params is not None
+        else init_fn_for(spec)(init_rng, spec)
+    )
+    tx = spec.optimizer.to_optax()
+    opt_state = tx.init(params)
+
+    if host_callbacks:
+        return _fit_host_loop(
+            spec, config, params, opt_state, Xtr, ytr, wtr, Xval, yval, wval,
+            rng, host_callbacks,
+        )
+
+    fit = _fit_program(spec, config)
+    params, _, losses, val_losses, epochs_ran = fit(
+        params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng
+    )
+    epochs_ran = int(epochs_ran)
+    history = {"loss": [float(l) for l in losses[:epochs_ran]]}
+    if n_val:
+        history["val_loss"] = [float(l) for l in val_losses[:epochs_ran]]
+    return params, History(
+        history=history,
+        params={
+            "epochs": config.epochs,
+            "steps": len(Xtr) // batch_size,
+            "verbose": 0,
+            "metrics": list(history),
+        },
+        epoch=list(range(epochs_ran)),
+    )
+
+
+def _fit_host_loop(
+    spec, config, params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng, callbacks
+):
+    """Per-epoch host loop for custom callbacks: one jitted epoch at a time."""
+    single_epoch_config = FitConfig(
+        epochs=1,
+        batch_size=config.batch_size,
+        validation_split=0.0,
+        shuffle=config.shuffle,
+        early_stopping=None,
+    )
+    fit_one = _fit_program(spec, single_epoch_config)
+    evaluate = _eval_fn(spec)
+    empty = np.zeros((0,) + Xtr.shape[1:], np.float32)
+    empty_y = np.zeros((0,) + ytr.shape[1:], np.float32)
+    empty_w = np.zeros((0,), np.float32)
+
+    history: Dict[str, List[float]] = {"loss": []}
+    if len(Xval):
+        history["val_loss"] = []
+    for cb in callbacks:
+        cb.on_train_begin()
+    epochs_ran = 0
+    for epoch in range(config.epochs):
+        rng, erng = jax.random.split(rng)
+        params, opt_state, losses, _, _ = fit_one(
+            params, opt_state, Xtr, ytr, wtr, empty, empty_y, empty_w, erng
+        )
+        logs = {"loss": float(losses[0])}
+        if len(Xval):
+            logs["val_loss"] = float(evaluate(params, Xval, yval, wval))
+            history["val_loss"].append(logs["val_loss"])
+        history["loss"].append(logs["loss"])
+        epochs_ran += 1
+        if any(cb.on_epoch_end(epoch, logs) for cb in callbacks):
+            break
+    return params, History(
+        history=history,
+        params={
+            "epochs": config.epochs,
+            "steps": len(Xtr) // config.batch_size,
+            "verbose": 0,
+            "metrics": list(history),
+        },
+        epoch=list(range(epochs_ran)),
+    )

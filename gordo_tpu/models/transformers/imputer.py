@@ -1,0 +1,58 @@
+"""
+±inf imputation transformer.
+
+Reference parity: gordo/machine/model/transformers/imputer.py:12-127 — fill
+positive/negative infinities per feature, either with the train-time
+per-column max/min nudged by ``delta`` ("minmax" strategy) or with the
+dtype's extreme values ("extremes").
+"""
+
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+from sklearn.base import BaseEstimator, TransformerMixin
+
+
+class InfImputer(BaseEstimator, TransformerMixin):
+    def __init__(
+        self,
+        inf_fill_value: Optional[float] = None,
+        neg_inf_fill_value: Optional[float] = None,
+        strategy: str = "minmax",
+        delta: float = 2.0,
+    ):
+        if strategy not in ("minmax", "extremes"):
+            raise ValueError(f"Unknown strategy {strategy!r}")
+        self.inf_fill_value = inf_fill_value
+        self.neg_inf_fill_value = neg_inf_fill_value
+        self.strategy = strategy
+        self.delta = delta
+
+    def fit(self, X, y=None):
+        X = np.asarray(X.values if isinstance(X, pd.DataFrame) else X)
+        if self.strategy == "extremes":
+            info = np.finfo(X.dtype) if np.issubdtype(X.dtype, np.floating) else np.finfo(np.float64)
+            self._fill_values = np.full(X.shape[1], info.max)
+            self._neg_fill_values = np.full(X.shape[1], info.min)
+        else:
+            masked = np.ma.masked_invalid(X)
+            self._fill_values = masked.max(axis=0).filled(0.0) + self.delta
+            self._neg_fill_values = masked.min(axis=0).filled(0.0) - self.delta
+        return self
+
+    def transform(self, X, y=None):
+        values = np.array(X.values if isinstance(X, pd.DataFrame) else X, copy=True)
+        for col in range(values.shape[1]):
+            pos = self.inf_fill_value
+            neg = self.neg_inf_fill_value
+            if pos is None:
+                pos = self._fill_values[col]
+            if neg is None:
+                neg = self._neg_fill_values[col]
+            column = values[:, col]
+            column[np.isposinf(column)] = pos
+            column[np.isneginf(column)] = neg
+        if isinstance(X, pd.DataFrame):
+            return pd.DataFrame(values, columns=X.columns, index=X.index)
+        return values

@@ -1,0 +1,96 @@
+"""
+Response-frame assembly and metric wrapping.
+
+Reference parity: gordo/machine/model/utils.py — ``make_base_dataframe``
+builds the MultiIndex-column response DataFrame (``model-input`` /
+``model-output`` / ``start`` / ``end``) with model-offset alignment, and
+``metric_wrapper`` clips y_true to the (possibly shorter) prediction length
+before scoring.
+"""
+
+import functools
+from datetime import datetime, timedelta
+from typing import List, Optional, Union
+
+import numpy as np
+import pandas as pd
+
+from ..dataset.sensor_tag import SensorTag
+
+
+def metric_wrapper(metric, scaler=None):
+    """
+    Adapt a metric to (a) optionally scale y/y_pred first and (b) tolerate a
+    model whose output is shorter than its input (LSTM offset).
+    """
+
+    @functools.wraps(metric)
+    def _wrapped(y_true, y_pred, *args, **kwargs):
+        if scaler is not None:
+            y_true = scaler.transform(y_true)
+            y_pred = scaler.transform(y_pred)
+        y_true = np.asarray(y_true)
+        y_pred = np.asarray(y_pred)
+        return metric(y_true[-len(y_pred):], y_pred, *args, **kwargs)
+
+    return _wrapped
+
+
+def _tag_names(tags) -> List[str]:
+    return [tag.name if isinstance(tag, SensorTag) else str(tag) for tag in tags]
+
+
+def make_base_dataframe(
+    tags: Union[List[SensorTag], List[str]],
+    model_input: np.ndarray,
+    model_output: np.ndarray,
+    target_tag_list: Optional[Union[List[SensorTag], List[str]]] = None,
+    index: Optional[Union[np.ndarray, pd.Index]] = None,
+    frequency: Optional[timedelta] = None,
+) -> pd.DataFrame:
+    """
+    MultiIndex-column DataFrame with top-level keys ``start``, ``end``,
+    ``model-input``, ``model-output``; everything aligned to the (possibly
+    shorter) model output and timestamps ISO-formatted for JSON.
+    """
+    target_tag_list = target_tag_list if target_tag_list is not None else tags
+    model_output = getattr(model_output, "values", model_output)
+    n_out = len(model_output)
+    model_input = getattr(model_input, "values", model_input)[-n_out:, :]
+
+    if index is not None:
+        normalized_index = pd.Index(index[-n_out:])
+    else:
+        normalized_index = pd.RangeIndex(n_out)
+
+    if isinstance(normalized_index, pd.DatetimeIndex):
+        starts = [ts.isoformat() for ts in normalized_index]
+        if frequency is not None:
+            ends = [(ts + frequency).isoformat() for ts in normalized_index]
+        else:
+            ends = [None] * n_out
+    else:
+        starts = [None] * n_out
+        ends = [None] * n_out
+
+    data = pd.DataFrame(
+        {("start", ""): starts, ("end", ""): ends},
+        columns=pd.MultiIndex.from_product((("start", "end"), ("",))),
+        index=normalized_index,
+    )
+
+    for name, values, name_tags in (
+        ("model-input", model_input, tags),
+        ("model-output", model_output, target_tag_list),
+    ):
+        if values is None:
+            continue
+        if values.shape[1] == len(name_tags):
+            sub_names = _tag_names(name_tags)
+        else:
+            sub_names = [str(i) for i in range(values.shape[1])]
+        columns = pd.MultiIndex.from_tuples((name, sub) for sub in sub_names)
+        data = data.join(
+            pd.DataFrame(values[-n_out:], columns=columns, index=normalized_index)
+        )
+    return data

@@ -1,0 +1,10 @@
+from .activations import resolve_activation
+from .windows import model_offset, num_windows, sliding_windows, window_targets
+
+__all__ = [
+    "resolve_activation",
+    "sliding_windows",
+    "window_targets",
+    "num_windows",
+    "model_offset",
+]

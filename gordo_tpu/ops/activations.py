@@ -1,0 +1,49 @@
+"""Activation-name resolution (Keras-style names → jax.nn functions)."""
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def _linear(x):
+    return x
+
+
+_ACTIVATIONS = {
+    "linear": _linear,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "swish": jax.nn.swish,
+    "silu": jax.nn.silu,
+    "gelu": jax.nn.gelu,
+    "leaky_relu": jax.nn.leaky_relu,
+    "relu6": jax.nn.relu6,
+    "exponential": jnp.exp,
+    "softmax": jax.nn.softmax,
+}
+
+
+def resolve_activation(activation: Union[str, Callable]) -> Callable:
+    """
+    Map a Keras-style activation name to its jax.nn function.
+
+    >>> resolve_activation("tanh") is jnp.tanh
+    True
+    >>> resolve_activation("linear")(2.0)
+    2.0
+    """
+    if callable(activation):
+        return activation
+    try:
+        return _ACTIVATIONS[activation]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation {activation!r}; known: {sorted(_ACTIVATIONS)}"
+        )

@@ -1,0 +1,44 @@
+"""Loss functions over batches with sample weights (pure JAX)."""
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+
+def _per_sample_mse(pred: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(jnp.square(pred - target), axis=-1)
+
+
+def _per_sample_mae(pred: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(jnp.abs(pred - target), axis=-1)
+
+
+_LOSSES = {
+    "mse": _per_sample_mse,
+    "mean_squared_error": _per_sample_mse,
+    "mae": _per_sample_mae,
+    "mean_absolute_error": _per_sample_mae,
+}
+
+
+def resolve_loss(name: str) -> Callable:
+    """
+    Per-sample loss fn for a Keras-style loss name.
+
+    >>> import jax.numpy as jnp
+    >>> fn = resolve_loss("mse")
+    >>> float(fn(jnp.array([[1.0, 1.0]]), jnp.array([[0.0, 0.0]]))[0])
+    1.0
+    """
+    try:
+        return _LOSSES[name]
+    except KeyError:
+        raise ValueError(f"Unknown loss {name!r}; known: {sorted(_LOSSES)}")
+
+
+def weighted_mean_loss(
+    per_sample: jnp.ndarray, weights: jnp.ndarray
+) -> jnp.ndarray:
+    """Weighted mean of per-sample losses; weights zero out padding rows."""
+    total = jnp.sum(weights)
+    return jnp.sum(per_sample * weights) / jnp.maximum(total, 1.0)

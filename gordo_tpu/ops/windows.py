@@ -1,0 +1,98 @@
+"""
+Sliding-window construction for sequence models — the windowing contract the
+whole framework's "model offset" rides on.
+
+Semantics parity with the reference's ``create_keras_timeseriesgenerator``
+(gordo/machine/model/models.py:713-793), which pads/shifts so that for
+lookback L and lookahead ``la``:
+
+- sample ``k`` sees window ``X[k : k+L]`` and targets ``y[k + L + la - 1]``
+- sample count is ``n - L - la + 1``
+- model output is shorter than input by ``L + la - 1`` (the *model offset*
+  threaded through builder metadata, scoring, and server alignment)
+
+These are pure functions over arrays: under ``jit`` the gather lowers to one
+XLA gather; the fleet trainer vmaps them over the model axis.
+"""
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+Array = Union[np.ndarray, jnp.ndarray]
+
+
+def num_windows(n_samples: int, lookback: int, lookahead: int) -> int:
+    """
+    Number of (window, target) samples a series of length ``n_samples``
+    yields.
+
+    >>> num_windows(100, 20, 0)
+    81
+    >>> num_windows(100, 20, 1)
+    80
+    """
+    return n_samples - lookback - lookahead + 1
+
+
+def model_offset(lookback: int, lookahead: int) -> int:
+    """
+    How many rows shorter than its input the model output is.
+
+    >>> model_offset(20, 0), model_offset(20, 1)
+    (19, 20)
+    """
+    return lookback + lookahead - 1
+
+
+def sliding_windows(X: Array, lookback: int, lookahead: int = 0) -> Array:
+    """
+    All length-``lookback`` windows of ``X`` usable with the given lookahead:
+    shape ``[num_windows, lookback, n_features]``.
+
+    >>> import numpy as np
+    >>> X = np.arange(10).reshape(5, 2)
+    >>> w = sliding_windows(X, lookback=2, lookahead=0)
+    >>> w.shape
+    (4, 2, 2)
+    >>> w[0].tolist()
+    [[0, 1], [2, 3]]
+    """
+    n = X.shape[0]
+    count = num_windows(n, lookback, lookahead)
+    if count <= 0:
+        raise ValueError(
+            f"Series of length {n} too short for lookback={lookback}, "
+            f"lookahead={lookahead}"
+        )
+    xp = jnp if isinstance(X, jnp.ndarray) else np
+    idx = xp.arange(count)[:, None] + xp.arange(lookback)[None, :]
+    return X[idx]
+
+
+def window_targets(y: Array, lookback: int, lookahead: int = 0) -> Array:
+    """
+    Targets aligned with :func:`sliding_windows`: ``y[k + lookback +
+    lookahead - 1]`` for each window ``k``.
+
+    >>> import numpy as np
+    >>> y = np.arange(5)
+    >>> window_targets(y, lookback=2, lookahead=0).tolist()
+    [1, 2, 3, 4]
+    >>> window_targets(y, lookback=2, lookahead=1).tolist()
+    [2, 3, 4]
+    """
+    n = y.shape[0]
+    count = num_windows(n, lookback, lookahead)
+    start = lookback + lookahead - 1
+    return y[start : start + count]
+
+
+def windowed_dataset(
+    X: Array, y: Optional[Array], lookback: int, lookahead: int = 0
+) -> Tuple[Array, Optional[Array]]:
+    """Convenience: (windows, aligned targets)."""
+    windows = sliding_windows(X, lookback, lookahead)
+    targets = window_targets(y, lookback, lookahead) if y is not None else None
+    return windows, targets

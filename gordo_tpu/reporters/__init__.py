@@ -1,0 +1,3 @@
+from .base import BaseReporter, LogReporter, ReporterException, create_reporters
+
+__all__ = ["BaseReporter", "LogReporter", "ReporterException", "create_reporters"]

@@ -1,0 +1,71 @@
+"""
+Reporter contract (reference: gordo/reporters/base.py): objects with
+``report(machine)`` built from config definitions via the serializer.
+"""
+
+import abc
+import logging
+from typing import List
+
+from ..utils import capture_args
+
+logger = logging.getLogger(__name__)
+
+
+class ReporterException(Exception):
+    pass
+
+
+class BaseReporter(abc.ABC):
+    @abc.abstractmethod
+    def report(self, machine) -> None:
+        ...
+
+    def get_params(self, deep: bool = False) -> dict:
+        return dict(getattr(self, "_params", {}))
+
+    def to_dict(self) -> dict:
+        from ..serializer import into_definition
+
+        return into_definition(self)
+
+    @classmethod
+    def from_dict(cls, config: dict):
+        from ..serializer import from_definition
+
+        return from_definition(config)
+
+
+class LogReporter(BaseReporter):
+    """Logs machine build results; the zero-dependency default reporter."""
+
+    @capture_args
+    def __init__(self, level: str = "INFO"):
+        self.level = level
+
+    def report(self, machine) -> None:
+        logger.log(
+            logging.getLevelName(self.level),
+            "Built machine %s (project %s)",
+            machine.name,
+            machine.project_name,
+        )
+
+
+def create_reporters(definitions: List[dict]) -> List[BaseReporter]:
+    """Instantiate reporters from their config definitions."""
+    from ..serializer import from_definition
+
+    reporters = []
+    for definition in definitions or []:
+        reporter = (
+            definition
+            if isinstance(definition, BaseReporter)
+            else from_definition(definition)
+        )
+        if not isinstance(reporter, BaseReporter):
+            raise ReporterException(
+                f"{definition!r} did not resolve to a BaseReporter"
+            )
+        reporters.append(reporter)
+    return reporters

@@ -1,0 +1,20 @@
+from .from_definition import (
+    build_callbacks,
+    from_definition,
+    load_params_from_definition,
+)
+from .into_definition import into_definition
+from .serializer import dump, dumps, load, load_info, load_metadata, loads
+
+__all__ = [
+    "from_definition",
+    "into_definition",
+    "load_params_from_definition",
+    "build_callbacks",
+    "dump",
+    "dumps",
+    "load",
+    "loads",
+    "load_metadata",
+    "load_info",
+]

@@ -1,0 +1,103 @@
+"""
+Live object graph → config definition (inverse of ``from_definition``).
+
+Behavior parity with gordo/serializer/into_definition.py:12-190: walk
+``get_params(deep=False)``, honor an object's ``into_definition`` hook,
+unwrap (name, step) tuples from Pipeline/FeatureUnion params, and turn bare
+functions into their dotted import path. The round trip
+``into_definition(from_definition(d))`` freezes an estimator's defaults into
+the definition (used by the CLI before building — cli/cli.py:142-144).
+"""
+
+import logging
+from inspect import isclass, isfunction
+from typing import Any, Dict
+
+logger = logging.getLogger(__name__)
+
+
+def _location_of(obj_type: type) -> str:
+    return f"{obj_type.__module__}.{obj_type.__name__}"
+
+
+def into_definition(pipeline, prune_default_params: bool = False) -> Dict[str, Any]:
+    """
+    Convert an estimator / pipeline into its YAML-able definition.
+
+    Example
+    -------
+    >>> from sklearn.pipeline import Pipeline
+    >>> from sklearn.decomposition import PCA
+    >>> definition = into_definition(Pipeline([("pca", PCA(n_components=2))]))
+    >>> list(definition)
+    ['sklearn.pipeline.Pipeline']
+    """
+    return _decompose_node(pipeline, prune_default_params)
+
+
+def _decompose_node(obj: Any, prune_default_params: bool = False) -> Any:
+    if hasattr(obj, "into_definition"):
+        return {_location_of(type(obj)): obj.into_definition()}
+
+    if isfunction(obj):
+        return f"{obj.__module__}.{obj.__name__}"
+
+    if isclass(obj):
+        return _location_of(obj)
+
+    if isinstance(obj, (list, tuple)):
+        # A (name, step) tuple from Pipeline.steps keeps only the step; plain
+        # sequences decompose element-wise.
+        if (
+            isinstance(obj, tuple)
+            and len(obj) == 2
+            and isinstance(obj[0], str)
+            and hasattr(obj[1], "get_params")
+        ):
+            return _decompose_node(obj[1], prune_default_params)
+        return [_decompose_node(item, prune_default_params) for item in obj]
+
+    if hasattr(obj, "get_params"):
+        params = obj.get_params(deep=False)
+        if prune_default_params:
+            params = _prune_default_params(obj, params)
+        definition = {
+            name: _decompose_node(value, prune_default_params)
+            if _needs_decomposition(value)
+            else value
+            for name, value in params.items()
+        }
+        return {_location_of(type(obj)): definition}
+
+    return obj
+
+
+def _needs_decomposition(value: Any) -> bool:
+    if hasattr(value, "get_params") or hasattr(value, "into_definition"):
+        return True
+    if isfunction(value) or isclass(value):
+        return True
+    if isinstance(value, (list, tuple)):
+        return any(_needs_decomposition(item) for item in value)
+    return False
+
+
+def _prune_default_params(obj: Any, params: Dict[str, Any]) -> Dict[str, Any]:
+    """Drop params whose value equals the constructor default."""
+    import inspect
+
+    try:
+        sig = inspect.signature(type(obj).__init__)
+    except (TypeError, ValueError):
+        return params
+    pruned = {}
+    for name, value in params.items():
+        param = sig.parameters.get(name)
+        if param is not None and param.default is not inspect.Parameter.empty:
+            try:
+                if param.default == value:
+                    continue
+            except Exception:
+                pass
+        pruned[name] = value
+    return pruned

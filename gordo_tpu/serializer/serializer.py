@@ -1,0 +1,106 @@
+"""
+Disk / bytes serialization of trained models.
+
+Artifact layout parity with gordo/serializer/serializer.py:149-196: a model
+directory holds ``model.pkl`` (the pickled estimator/pipeline),
+``metadata.json`` and ``info.json`` (with the model file's checksum). The
+pickle-bytes form (``dumps``/``loads``) is the wire format of the server's
+``/download-model`` route.
+
+JAX estimators make this work by storing their params as host numpy arrays in
+``__getstate__`` (see gordo_tpu/models/estimators.py), so a pickled model is
+device-independent and loads on any backend.
+"""
+
+import hashlib
+import logging
+import os
+import pickle
+from os import path
+from typing import Any, Optional
+
+import simplejson
+
+logger = logging.getLogger(__name__)
+
+MODEL_FILE = "model.pkl"
+METADATA_FILE = "metadata.json"
+INFO_FILE = "info.json"
+
+
+def dumps(model) -> bytes:
+    """
+    Serialize a model into bytes.
+
+    >>> from sklearn.preprocessing import MinMaxScaler
+    >>> restored = loads(dumps(MinMaxScaler(feature_range=(0, 2))))
+    >>> restored.feature_range
+    (0, 2)
+    """
+    return pickle.dumps(model)
+
+
+def loads(bytes_object: bytes):
+    """Restore a model serialized with ``dumps``."""
+    return pickle.loads(bytes_object)
+
+
+def _file_checksum(file_path: str) -> str:
+    digest = hashlib.md5()
+    with open(file_path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def dump(obj, dest_dir: str, metadata: Optional[dict] = None, info: Optional[dict] = None):
+    """
+    Serialize ``obj`` into ``dest_dir`` as ``model.pkl`` (+ optional
+    ``metadata.json`` / ``info.json``; info always records the model
+    checksum).
+    """
+    os.makedirs(dest_dir, exist_ok=True)
+    model_path = path.join(dest_dir, MODEL_FILE)
+    with open(model_path, "wb") as f:
+        pickle.dump(obj, f)
+    if metadata is not None:
+        with open(path.join(dest_dir, METADATA_FILE), "w") as f:
+            simplejson.dump(metadata, f, default=str, ignore_nan=True)
+    full_info = {"checksum": _file_checksum(model_path)}
+    if info:
+        full_info.update(info)
+    with open(path.join(dest_dir, INFO_FILE), "w") as f:
+        simplejson.dump(full_info, f, default=str)
+
+
+def load(source_dir: str) -> Any:
+    """Load the model saved in ``source_dir`` by ``dump``."""
+    model_path = path.join(source_dir, MODEL_FILE)
+    with open(model_path, "rb") as f:
+        return pickle.load(f)
+
+
+def _load_json_file(source_dir: str, filename: str) -> dict:
+    """
+    Load a JSON artifact, falling back to the parent directory — the
+    reference stores metadata either beside or one level above the model dir
+    (gordo/serializer/serializer.py:77-84).
+    """
+    for candidate_dir in (source_dir, path.dirname(path.normpath(source_dir))):
+        candidate = path.join(candidate_dir, filename)
+        if path.isfile(candidate):
+            with open(candidate) as f:
+                return simplejson.load(f)
+    raise FileNotFoundError(
+        f"{filename} not found in {source_dir} or its parent directory"
+    )
+
+
+def load_metadata(source_dir: str) -> dict:
+    """Load ``metadata.json`` for a model directory."""
+    return _load_json_file(source_dir, METADATA_FILE)
+
+
+def load_info(source_dir: str) -> dict:
+    """Load ``info.json`` for a model directory."""
+    return _load_json_file(source_dir, INFO_FILE)

@@ -1,0 +1,37 @@
+"""Typing helpers for the serializer (reference: gordo/serializer/utils.py)."""
+
+import typing
+from typing import Any
+
+
+def _unpack_optional(annotation: Any):
+    origin = typing.get_origin(annotation)
+    if origin is typing.Union:
+        return [arg for arg in typing.get_args(annotation) if arg is not type(None)]
+    return [annotation]
+
+
+def is_tuple_type(annotation: Any) -> bool:
+    """
+    True when ``annotation`` is a tuple type, including ``Optional[Tuple]``
+    and ``Union[..., Tuple, ...]`` forms.
+
+    >>> from typing import Tuple, Optional, Union
+    >>> is_tuple_type(Tuple[int, ...])
+    True
+    >>> is_tuple_type(Optional[Tuple[int, int]])
+    True
+    >>> is_tuple_type(Union[str, tuple])
+    True
+    >>> is_tuple_type(int)
+    False
+    """
+    if annotation is tuple:
+        return True
+    for candidate in _unpack_optional(annotation):
+        if candidate is tuple:
+            return True
+        origin = typing.get_origin(candidate)
+        if origin is tuple:
+            return True
+    return False

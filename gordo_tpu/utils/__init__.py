@@ -1,0 +1,4 @@
+from .args import capture_args
+from .text import replace_all_non_ascii_chars
+
+__all__ = ["capture_args", "replace_all_non_ascii_chars"]

@@ -1,0 +1,47 @@
+"""
+Init-argument capture for config round-tripping.
+
+Reference parity: gordo/util/utils.py:6-48 (``capture_args``) — reporters and
+other serializer-aware objects need ``get_params`` to return exactly the
+arguments they were constructed with.
+"""
+
+import functools
+import inspect
+
+
+def capture_args(method):
+    """
+    Decorator for ``__init__`` which stores the bound call arguments on the
+    instance as ``self._params`` so that ``get_params`` / ``to_dict`` can
+    round-trip the object through the serializer.
+
+    Examples
+    --------
+    >>> class Thing:
+    ...     @capture_args
+    ...     def __init__(self, a, b=2, *args, **kwargs):
+    ...         pass
+    >>> Thing(1, b=3, extra="x")._params
+    {'a': 1, 'b': 3, 'args': [], 'extra': 'x'}
+    """
+
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        bound = inspect.signature(method).bind(self, *args, **kwargs)
+        bound.apply_defaults()
+        params = {}
+        for name, value in bound.arguments.items():
+            if name == "self":
+                continue
+            kind = inspect.signature(method).parameters[name].kind
+            if kind is inspect.Parameter.VAR_POSITIONAL:
+                params["args"] = list(value)
+            elif kind is inspect.Parameter.VAR_KEYWORD:
+                params.update(value)
+            else:
+                params[name] = value
+        self._params = params
+        return method(self, *args, **kwargs)
+
+    return wrapper

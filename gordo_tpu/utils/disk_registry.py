@@ -1,0 +1,54 @@
+"""
+File-per-key registry used as the model build cache index.
+
+Reference parity: gordo/util/disk_registry.py — a minimal KV store where each
+key is a file in a directory. Concurrent writes to the *same* key are not
+atomic (documented there at lines 9-14); concurrent writes to different keys
+are fine, which is all the builder needs.
+"""
+
+import logging
+import os
+import re
+from pathlib import Path
+from typing import Optional, Union
+
+logger = logging.getLogger(__name__)
+
+_VALID_KEY_RE = re.compile(r"^[A-Za-z0-9._\-]+$")
+
+
+def _key_path(registry_dir: Union[os.PathLike, str], key: str) -> Path:
+    if not _VALID_KEY_RE.match(key):
+        raise ValueError(f"Invalid registry key: {key!r}")
+    return Path(registry_dir) / key
+
+
+def write_key(registry_dir: Union[os.PathLike, str], key: str, val: str):
+    """Write ``val`` under ``key``, creating the registry dir if needed."""
+    path = _key_path(registry_dir, key)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    logger.debug("Registry write %s -> %s", key, val)
+    path.write_text(str(val))
+
+
+def get_value(registry_dir: Union[os.PathLike, str], key: str) -> Optional[str]:
+    """Return the value stored under ``key``, or None if absent/unreadable."""
+    path = _key_path(registry_dir, key)
+    try:
+        return path.read_text()
+    except (FileNotFoundError, NotADirectoryError):
+        return None
+    except OSError:
+        logger.warning("Failed reading registry key %s", key, exc_info=True)
+        return None
+
+
+def delete_value(registry_dir: Union[os.PathLike, str], key: str) -> bool:
+    """Delete ``key``; returns True if it existed."""
+    path = _key_path(registry_dir, key)
+    try:
+        path.unlink()
+        return True
+    except FileNotFoundError:
+        return False

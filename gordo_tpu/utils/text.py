@@ -1,0 +1,14 @@
+"""ASCII scrubbing used by the exception reporter (reference: gordo/util/text.py)."""
+
+
+def replace_all_non_ascii_chars(text: str, replacement: str = "?") -> str:
+    """
+    Replace every non-ASCII character in ``text`` with ``replacement``.
+
+    The k8s termination-message path only reliably stores ASCII, so the CLI's
+    exception reports are scrubbed before being written.
+
+    >>> replace_all_non_ascii_chars("øre 100%", "?")
+    '?re 100%'
+    """
+    return "".join(ch if ord(ch) < 128 else replacement for ch in text)

@@ -1,0 +1,106 @@
+"""
+Docker-image-tag grammar used by the workflow generator to pick image sets.
+
+Reference parity: gordo/util/version.py:87-130 — tags are one of: a release
+(``1.2.3`` with optional suffix), a special tag (``latest`` / ``stable``), a
+PR tag (``pr-123``), or a bare git SHA.
+"""
+
+import re
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from enum import Enum
+from typing import Optional
+
+
+class Special(Enum):
+    LATEST = "latest"
+    STABLE = "stable"
+
+
+class Version(ABC):
+    @abstractmethod
+    def get_version(self) -> str:
+        ...
+
+
+@dataclass(frozen=True)
+class GordoRelease(Version):
+    major: int
+    minor: int
+    patch: int
+    suffix: Optional[str] = None
+
+    def get_version(self) -> str:
+        version = f"{self.major}.{self.minor}.{self.patch}"
+        return version + self.suffix if self.suffix else version
+
+    def without_patch(self) -> bool:
+        return False
+
+    def only_major(self) -> bool:
+        return False
+
+    def only_major_minor(self) -> bool:
+        return False
+
+
+@dataclass(frozen=True)
+class GordoSpecial(Version):
+    special: Special
+
+    def get_version(self) -> str:
+        return self.special.value
+
+
+@dataclass(frozen=True)
+class GordoPR(Version):
+    number: int
+
+    def get_version(self) -> str:
+        return f"pr-{self.number}"
+
+
+@dataclass(frozen=True)
+class GordoSHA(Version):
+    sha: str
+
+    def get_version(self) -> str:
+        return self.sha
+
+
+_RELEASE_RE = re.compile(
+    r"^(?P<major>\d+)\.(?P<minor>\d+)\.(?P<patch>\d+)(?P<suffix>[.\-+][0-9A-Za-z.\-+]+)?$"
+)
+_PR_RE = re.compile(r"^pr-(?P<number>\d+)$")
+_SHA_RE = re.compile(r"^[0-9a-f]{7,40}$")
+
+
+def parse_version(tag: str) -> Version:
+    """
+    Parse a docker tag into one of the ``Version`` variants.
+
+    >>> parse_version("1.2.3")
+    GordoRelease(major=1, minor=2, patch=3, suffix=None)
+    >>> parse_version("latest")
+    GordoSpecial(special=<Special.LATEST: 'latest'>)
+    >>> parse_version("pr-42")
+    GordoPR(number=42)
+    """
+    for special in Special:
+        if tag == special.value:
+            return GordoSpecial(special)
+    match = _RELEASE_RE.match(tag)
+    if match:
+        return GordoRelease(
+            int(match.group("major")),
+            int(match.group("minor")),
+            int(match.group("patch")),
+            match.group("suffix"),
+        )
+    match = _PR_RE.match(tag)
+    if match:
+        return GordoPR(int(match.group("number")))
+    if _SHA_RE.match(tag):
+        return GordoSHA(tag)
+    raise ValueError(f"Unparseable docker tag: {tag!r}")

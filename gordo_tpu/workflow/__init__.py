@@ -1,0 +1,13 @@
+from .helpers import patch_dict
+
+__all__ = ["NormalizedConfig", "patch_dict"]
+
+
+def __getattr__(name):
+    # Lazy: NormalizedConfig imports Machine which imports patch_dict from
+    # this package — eager re-export here would close the circle.
+    if name == "NormalizedConfig":
+        from .config_elements.normalized_config import NormalizedConfig
+
+        return NormalizedConfig
+    raise AttributeError(name)

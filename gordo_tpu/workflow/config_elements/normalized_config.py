@@ -1,0 +1,141 @@
+"""
+NormalizedConfig: a full project YAML → validated Machines + effective
+runtime.
+
+Reference parity: gordo/workflow/config_elements/normalized_config.py —
+defaults in ``DEFAULT_CONFIG_GLOBALS`` (pod resources, cv_mode, scoring
+scaler, four default metrics), globals patched by the user's ``globals``
+block, per-machine Machine construction (every machine fully validated,
+including the eager model test-build), and influx resources scaling with
+machine count.
+
+TPU-native addition: a ``fleet`` runtime block (accelerator type, machines
+per slice, num slices) controlling how the training fleet is sharded over
+TPU slices — this replaces the reference's one-builder-pod-per-machine
+scale knobs while keeping them for the serving plane.
+"""
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional
+
+from ...machine import Machine, load_globals_config, load_machine_config
+from ..helpers import patch_dict
+from .schemas import BuilderPodRuntime, PodRuntime, TpuFleetRuntime
+
+
+def _calculate_influx_resources(nr_of_machines: int) -> dict:
+    """Influx sizing scales linearly with fleet size (reference lines 23-34)."""
+    return {
+        "requests": {
+            "memory": min(3000 + (220 * nr_of_machines), 28000),
+            "cpu": min(500 + (10 * nr_of_machines), 4000),
+        },
+        "limits": {
+            "memory": min(3000 + (220 * nr_of_machines), 48000),
+            "cpu": 10000 + (20 * nr_of_machines),
+        },
+    }
+
+
+class NormalizedConfig:
+    """Normalize a project config: globals defaulting + machine validation."""
+
+    DEFAULT_CONFIG_GLOBALS: Dict[str, Any] = {
+        "runtime": {
+            "reporters": [],
+            "server": {
+                "resources": {
+                    "requests": {"memory": 3000, "cpu": 1000},
+                    "limits": {"memory": 6000, "cpu": 2000},
+                }
+            },
+            "prometheus_metrics_server": {
+                "resources": {
+                    "requests": {"memory": 200, "cpu": 100},
+                    "limits": {"memory": 1000, "cpu": 200},
+                }
+            },
+            "builder": {
+                "resources": {
+                    "requests": {"memory": 3900, "cpu": 1001},
+                    "limits": {"memory": 31200, "cpu": 1001},
+                },
+                "remote_logging": {"enable": False},
+            },
+            "client": {
+                "resources": {
+                    "requests": {"memory": 3500, "cpu": 100},
+                    "limits": {"memory": 4000, "cpu": 2000},
+                },
+                "max_instances": 30,
+            },
+            "influx": {"enable": True},
+            # TPU fleet-training plane (no reference analog: replaces
+            # per-machine builder pods with sliced fleet jobs)
+            "fleet": {
+                "accelerator_type": "v5litepod-16",
+                "machines_per_slice": 1024,
+                "num_slices": 1,
+                "compute_dtype": "float32",
+            },
+        },
+        "evaluation": {
+            "cv_mode": "full_build",
+            "scoring_scaler": "sklearn.preprocessing.MinMaxScaler",
+            "metrics": [
+                "explained_variance_score",
+                "r2_score",
+                "mean_squared_error",
+                "mean_absolute_error",
+            ],
+        },
+    }
+
+    def __init__(
+        self,
+        config: Dict[str, Any],
+        project_name: str,
+        model_builder_env: Optional[dict] = None,
+    ):
+        if not isinstance(config, dict):
+            raise ValueError(f"Config must be a mapping, got {type(config)}")
+        default_globals = deepcopy(self.DEFAULT_CONFIG_GLOBALS)
+        user_globals = load_globals_config(config.get("globals", {}))
+        patched_globals = patch_dict(default_globals, user_globals)
+        patched_globals = self._validate_runtime(patched_globals)
+        if model_builder_env is not None:
+            patched_globals.setdefault("runtime", {}).setdefault("builder", {})[
+                "env"
+            ] = model_builder_env
+
+        self.project_name = project_name
+        machine_configs = config.get("machines") or []
+        if not machine_configs:
+            raise ValueError("Config has no machines")
+        self.machines: List[Machine] = [
+            Machine.from_config(
+                load_machine_config(machine_config),
+                project_name=project_name,
+                config_globals=patched_globals,
+            )
+            for machine_config in machine_configs
+        ]
+        self.globals: Dict[str, Any] = patched_globals
+        self.globals["runtime"]["influx"]["resources"] = _calculate_influx_resources(
+            len(self.machines)
+        )
+
+    @staticmethod
+    def _validate_runtime(config: Dict[str, Any]) -> Dict[str, Any]:
+        """Pydantic-validate the known runtime pods (reference lines 171-190)."""
+        runtime = config.get("runtime", {})
+        if "builder" in runtime:
+            BuilderPodRuntime(**runtime["builder"])
+        for pod in ("server", "prometheus_metrics_server", "client"):
+            if pod in runtime:
+                PodRuntime(**runtime[pod])
+        if "fleet" in runtime:
+            runtime["fleet"] = TpuFleetRuntime(**runtime["fleet"]).model_dump(
+                exclude_none=True
+            )
+        return config

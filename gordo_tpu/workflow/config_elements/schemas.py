@@ -1,0 +1,106 @@
+"""
+Pydantic schemas validating the k8s fragments a config may carry.
+
+Reference parity: gordo/workflow/config_elements/schemas.py — EnvVar,
+Volume/VolumeMount, pod runtime and security contexts. Extended with the
+TPU runtime block the fleet plane needs (accelerator topology, machines per
+slice).
+"""
+
+from typing import Any, Dict, List, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+class GordoModel(BaseModel):
+    model_config = ConfigDict(extra="allow", populate_by_name=True)
+
+
+class EnvVar(GordoModel):
+    name: str
+    value: Optional[str] = None
+    valueFrom: Optional[Dict[str, Any]] = None
+
+
+class KeyToPath(GordoModel):
+    key: str
+    path: str
+    mode: Optional[int] = None
+
+
+class ConfigMapVolumeSource(GordoModel):
+    name: Optional[str] = None
+    items: Optional[List[KeyToPath]] = None
+    defaultMode: Optional[int] = None
+    optional: Optional[bool] = None
+
+
+class SecretVolumeSource(GordoModel):
+    secretName: Optional[str] = None
+    items: Optional[List[KeyToPath]] = None
+    defaultMode: Optional[int] = None
+    optional: Optional[bool] = None
+
+
+class PersistentVolumeClaimVolumeSource(GordoModel):
+    claimName: str
+    readOnly: Optional[bool] = None
+
+
+class Volume(GordoModel):
+    name: str
+    configMap: Optional[ConfigMapVolumeSource] = None
+    secret: Optional[SecretVolumeSource] = None
+    persistentVolumeClaim: Optional[PersistentVolumeClaimVolumeSource] = None
+    emptyDir: Optional[Dict[str, Any]] = None
+
+
+class VolumeMount(GordoModel):
+    name: str
+    mountPath: str
+    subPath: Optional[str] = None
+    readOnly: Optional[bool] = None
+
+
+class ResourceRequirements(GordoModel):
+    requests: Optional[Dict[str, Any]] = None
+    limits: Optional[Dict[str, Any]] = None
+
+
+class SecurityContext(GordoModel):
+    runAsUser: Optional[int] = None
+    runAsGroup: Optional[int] = None
+    runAsNonRoot: Optional[bool] = None
+    readOnlyRootFilesystem: Optional[bool] = None
+    allowPrivilegeEscalation: Optional[bool] = None
+
+
+class PodSecurityContext(GordoModel):
+    runAsUser: Optional[int] = None
+    runAsGroup: Optional[int] = None
+    runAsNonRoot: Optional[bool] = None
+    fsGroup: Optional[int] = None
+    supplementalGroups: Optional[List[int]] = None
+
+
+class PodRuntime(GordoModel):
+    image: Optional[str] = None
+    resources: Optional[ResourceRequirements] = None
+    env: Optional[List[EnvVar]] = None
+
+
+class BuilderPodRuntime(PodRuntime):
+    remote_logging: Optional[Dict[str, Any]] = None
+    volumes: Optional[List[Volume]] = None
+    volumeMounts: Optional[List[VolumeMount]] = None
+
+
+class TpuFleetRuntime(GordoModel):
+    """TPU fleet-training runtime: which slice trains how many machines."""
+
+    accelerator_type: str = Field(default="v5litepod-16")
+    topology: Optional[str] = None
+    machines_per_slice: int = Field(default=1024, ge=1)
+    num_slices: int = Field(default=1, ge=1)
+    compute_dtype: str = "float32"
+    resources: Optional[ResourceRequirements] = None

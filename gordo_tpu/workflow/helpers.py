@@ -1,0 +1,37 @@
+"""
+Config-overlay helper (reference:
+gordo/workflow/workflow_generator/helpers.py:16-45, reimplemented without
+dictdiffer): paths in the patch are added or replace existing values;
+nothing is ever removed.
+"""
+
+import copy
+from typing import Any, Dict
+
+
+def patch_dict(original_dict: dict, patch_dictionary: dict) -> dict:
+    """
+    Overlay ``patch_dictionary`` on top of ``original_dict`` recursively.
+
+    >>> patch_dict({"highKey": {"lowkey1": 1, "lowkey2": 2}}, {"highKey": {"lowkey1": 10}})
+    {'highKey': {'lowkey1': 10, 'lowkey2': 2}}
+    >>> patch_dict({"highKey": {"lowkey1": 1, "lowkey2": 2}}, {"highKey": {"lowkey3": 3}})
+    {'highKey': {'lowkey1': 1, 'lowkey2': 2, 'lowkey3': 3}}
+    >>> patch_dict({"highKey": {"lowkey1": 1, "lowkey2": 2}}, {"highKey2": 4})
+    {'highKey': {'lowkey1': 1, 'lowkey2': 2}, 'highKey2': 4}
+    """
+    result: Dict[str, Any] = copy.deepcopy(original_dict)
+
+    def overlay(base: dict, patch: dict):
+        for key, value in patch.items():
+            if (
+                key in base
+                and isinstance(base[key], dict)
+                and isinstance(value, dict)
+            ):
+                overlay(base[key], value)
+            else:
+                base[key] = copy.deepcopy(value)
+
+    overlay(result, patch_dictionary)
+    return result

@@ -1,0 +1,157 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu import serializer
+from gordo_tpu.builder import ModelBuilder, create_model_builder, local_build
+from gordo_tpu.machine import Machine
+
+MODEL_DEF = {
+    "gordo_tpu.models.JaxAutoEncoder": {
+        "kind": "feedforward_model",
+        "encoding_dim": [8, 4],
+        "encoding_func": ["tanh", "tanh"],
+        "decoding_dim": [4, 8],
+        "decoding_func": ["tanh", "tanh"],
+        "epochs": 1,
+    }
+}
+DATASET_DEF = {
+    "type": "RandomDataset",
+    "train_start_date": "2020-01-01T00:00:00+00:00",
+    "train_end_date": "2020-01-05T00:00:00+00:00",
+    "tag_list": ["tag-1", "tag-2"],
+}
+
+
+def make_machine(**evaluation):
+    return Machine.from_config(
+        {
+            "name": "m1",
+            "model": MODEL_DEF,
+            "dataset": dict(DATASET_DEF),
+            **({"evaluation": evaluation} if evaluation else {}),
+        },
+        project_name="proj",
+    )
+
+
+def test_full_build_metadata():
+    model, machine = ModelBuilder(make_machine()).build()
+    bm = machine.metadata.build_metadata
+    assert bm.model.model_offset == 0
+    assert bm.model.model_builder_version
+    assert bm.model.model_training_duration_sec > 0
+    assert bm.dataset.query_duration_sec > 0
+    assert bm.dataset.dataset_meta["row_count"] > 0
+    scores = bm.model.cross_validation.scores
+    # 4 metrics x (2 tags + 1 aggregate)
+    assert len(scores) == 12
+    ev = scores["explained-variance-score"]
+    assert {"fold-mean", "fold-std", "fold-min", "fold-max", "fold-1"} <= set(ev)
+    splits = bm.model.cross_validation.splits
+    assert "fold-1-train-start" in splits
+
+
+def test_cross_val_only_does_not_fit():
+    model, machine = ModelBuilder(make_machine(cv_mode="cross_val_only")).build()
+    assert machine.metadata.build_metadata.model.cross_validation.scores
+    assert machine.metadata.build_metadata.model.model_training_duration_sec is None
+
+
+def test_build_only_skips_cv():
+    model, machine = ModelBuilder(make_machine(cv_mode="build_only")).build()
+    assert not machine.metadata.build_metadata.model.cross_validation.scores
+    assert machine.metadata.build_metadata.model.model_training_duration_sec > 0
+
+
+def test_output_dir_artifacts(tmp_path):
+    out = tmp_path / "out"
+    ModelBuilder(make_machine()).build(output_dir=out)
+    assert (out / "model.pkl").is_file()
+    assert (out / "metadata.json").is_file()
+    assert (out / "info.json").is_file()
+    metadata = serializer.load_metadata(str(out))
+    assert metadata["name"] == "m1"
+    model = serializer.load(str(out))
+    assert hasattr(model, "predict")
+
+
+def test_register_cache_hit(tmp_path):
+    register = tmp_path / "register"
+    builder = ModelBuilder(make_machine())
+    builder.build(model_register_dir=register)
+    assert builder.cached_model_path is not None
+
+    builder2 = ModelBuilder(make_machine())
+    builder2.build(model_register_dir=register)
+    assert builder2.cached_model_path == builder.cached_model_path
+
+    # replace_cache forces a rebuild
+    builder3 = ModelBuilder(make_machine())
+    builder3.build(model_register_dir=register, replace_cache=True)
+    assert builder3.cached_model_path is not None
+
+
+def test_cache_key_sensitivity():
+    key1 = ModelBuilder(make_machine()).cache_key
+    key2 = ModelBuilder(make_machine()).cache_key
+    assert key1 == key2
+    different = Machine.from_config(
+        {
+            "name": "m1",
+            "model": MODEL_DEF,
+            "dataset": {**DATASET_DEF, "tag_list": ["tag-1", "tag-3"]},
+        },
+        project_name="proj",
+    )
+    assert ModelBuilder(different).cache_key != key1
+
+
+def test_metrics_from_list():
+    from sklearn.metrics import r2_score
+
+    out = ModelBuilder.metrics_from_list(None)
+    assert len(out) == 4
+    out = ModelBuilder.metrics_from_list(
+        ["r2_score", "sklearn.metrics.mean_absolute_error"]
+    )
+    assert out[0] is r2_score
+
+
+def test_create_model_builder():
+    assert create_model_builder(None) is ModelBuilder
+    with pytest.raises(ValueError):
+        create_model_builder("sklearn.preprocessing.MinMaxScaler")
+
+
+def test_local_build_end_to_end():
+    config = """
+    machines:
+      - name: machine-a
+        dataset:
+          type: RandomDataset
+          train_start_date: "2020-01-01T00:00:00+00:00"
+          train_end_date: "2020-01-05T00:00:00+00:00"
+          tag_list: [tag-1, tag-2]
+        model:
+          gordo_tpu.models.JaxAutoEncoder:
+            kind: feedforward_hourglass
+            encoding_layers: 1
+            epochs: 1
+    """
+    results = list(local_build(config))
+    assert len(results) == 1
+    model, machine = results[0]
+    assert machine.name == "machine-a"
+    X, _ = machine.dataset.get_data()
+    assert model.predict(X).shape[1] == 2
+
+
+def test_determine_offset():
+    class FakeModel:
+        def predict(self, X):
+            return X[5:]
+
+    X = np.zeros((20, 2))
+    assert ModelBuilder._determine_offset(FakeModel(), X) == 5

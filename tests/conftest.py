@@ -1,0 +1,62 @@
+"""
+Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+The CI/test tier never needs TPU hardware (SURVEY.md §4's implication:
+end-to-end runs on CPU JAX); multi-chip sharding is exercised against
+``--xla_force_host_platform_device_count=8``. The axon TPU plugin registers
+itself via sitecustomize and overrides JAX_PLATFORMS through jax.config, so
+we must reset the config value, not just the env var.
+"""
+
+import os
+
+# Must be in place before the CPU backend initializes.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def sensor_frame() -> pd.DataFrame:
+    """Deterministic 200×4 sensor DataFrame with tz-aware 10min index."""
+    rng = np.random.RandomState(7)
+    index = pd.date_range("2020-01-01", periods=200, freq="10min", tz="UTC")
+    data = np.stack(
+        [
+            50 + 10 * np.sin(np.linspace(0, 6, 200) + phase)
+            + rng.standard_normal(200)
+            for phase in range(4)
+        ],
+        axis=1,
+    ).astype(np.float32)
+    return pd.DataFrame(data, columns=[f"tag-{i}" for i in range(4)], index=index)
+
+
+@pytest.fixture(scope="session")
+def tiny_model_definition() -> dict:
+    """A small, fast AE definition used across builder/server tests."""
+    return {
+        "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "gordo_tpu.models.JaxAutoEncoder": {
+                    "kind": "feedforward_model",
+                    "encoding_dim": [8, 4],
+                    "encoding_func": ["tanh", "tanh"],
+                    "decoding_dim": [4, 8],
+                    "decoding_func": ["tanh", "tanh"],
+                    "epochs": 2,
+                    "batch_size": 32,
+                }
+            }
+        }
+    }

@@ -1,0 +1,110 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.dataset import (
+    ConfigException,
+    GordoBaseDataset,
+    InsufficientDataError,
+    ListBackedDataProvider,
+    RandomDataProvider,
+    RandomDataset,
+    TimeSeriesDataset,
+)
+from gordo_tpu.dataset.datasets import normalize_frequency
+
+START, END = "2020-01-01T00:00:00+00:00", "2020-01-10T00:00:00+00:00"
+
+
+def test_random_dataset_get_data_deterministic():
+    ds1 = RandomDataset(START, END, tag_list=["tag-a", "tag-b"])
+    ds2 = RandomDataset(START, END, tag_list=["tag-a", "tag-b"])
+    X1, y1 = ds1.get_data()
+    X2, y2 = ds2.get_data()
+    pd.testing.assert_frame_equal(X1, X2)
+    assert list(X1.columns) == ["tag-a", "tag-b"]
+    assert X1.index.tz is not None
+    # y defaults to X
+    pd.testing.assert_frame_equal(X1, y1)
+
+
+def test_target_tag_list_splits_y():
+    ds = RandomDataset(START, END, tag_list=["a", "b"], target_tag_list=["c"])
+    X, y = ds.get_data()
+    assert list(X.columns) == ["a", "b"]
+    assert list(y.columns) == ["c"]
+    assert len(X) == len(y)
+
+
+def test_from_dict_round_trip():
+    ds = RandomDataset(START, END, tag_list=["a", "b"], resolution="1h")
+    config = ds.to_dict()
+    assert config["type"].endswith("RandomDataset")
+    rebuilt = GordoBaseDataset.from_dict(config)
+    X1, _ = ds.get_data()
+    X2, _ = rebuilt.get_data()
+    pd.testing.assert_frame_equal(X1, X2)
+
+
+def test_insufficient_data_threshold():
+    ds = RandomDataset(START, END, tag_list=["a"], n_samples_threshold=10**9)
+    with pytest.raises(InsufficientDataError):
+        ds.get_data()
+
+
+def test_tz_naive_dates_rejected():
+    with pytest.raises(ConfigException):
+        RandomDataset("2020-01-01", "2020-01-10", tag_list=["a"])
+
+
+def test_reversed_dates_rejected():
+    with pytest.raises(ConfigException):
+        RandomDataset(END, START, tag_list=["a"])
+
+
+def test_row_filter():
+    index = pd.date_range(START, periods=100, freq="10min", tz="UTC")
+    series = [
+        pd.Series(np.arange(100.0), index=index, name="a"),
+        pd.Series(np.ones(100), index=index, name="b"),
+    ]
+    ds = TimeSeriesDataset(
+        START,
+        END,
+        tag_list=["a", "b"],
+        data_provider=ListBackedDataProvider(series=series),
+        row_filter="`a` < 50",
+    )
+    X, _ = ds.get_data()
+    assert (X["a"] < 50).all()
+    assert ds.get_metadata()["filtered_rows"] > 0
+
+
+def test_trainable_arrays_dtype():
+    ds = RandomDataset(START, END, tag_list=["a", "b"])
+    X, y, index = ds.trainable_arrays()
+    assert X.dtype == np.float32 and y.dtype == np.float32
+    assert len(index) == len(X)
+
+
+def test_metadata_contents():
+    ds = RandomDataset(START, END, tag_list=["a"])
+    ds.get_data()
+    meta = ds.get_metadata()
+    assert meta["row_count"] > 0
+    assert "x_hist" in meta and "a" in meta["x_hist"]
+
+
+@pytest.mark.parametrize(
+    "legacy,modern", [("10T", "10min"), ("1H", "1h"), ("30s", "30s"), ("5min", "5min")]
+)
+def test_normalize_frequency(legacy, modern):
+    assert normalize_frequency(legacy) == modern
+
+
+def test_provider_deterministic_per_tag():
+    provider = RandomDataProvider()
+    t0, t1 = pd.Timestamp(START), pd.Timestamp(END)
+    s1 = list(provider.load_series(t0, t1, ["x"]))[0]
+    s2 = list(provider.load_series(t0, t1, ["x"]))[0]
+    pd.testing.assert_series_equal(s1, s2)

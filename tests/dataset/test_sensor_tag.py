@@ -1,0 +1,44 @@
+import pytest
+
+from gordo_tpu.dataset.sensor_tag import (
+    SensorTag,
+    SensorTagNormalizationError,
+    normalize_sensor_tag,
+    normalize_sensor_tags,
+    to_list_of_strings,
+    unique_tag_names,
+)
+
+
+@pytest.mark.parametrize(
+    "raw,expected",
+    [
+        ("T1", SensorTag("T1")),
+        ({"name": "T1", "asset": "A"}, SensorTag("T1", "A")),
+        (["T1", "A"], SensorTag("T1", "A")),
+        (("T1",), SensorTag("T1")),
+        (SensorTag("T1", "A"), SensorTag("T1", "A")),
+    ],
+)
+def test_normalize_forms(raw, expected):
+    assert normalize_sensor_tag(raw) == expected
+
+
+def test_default_asset_applied():
+    assert normalize_sensor_tags(["T1"], asset="plant")[0].asset == "plant"
+
+
+def test_to_list_of_strings():
+    assert to_list_of_strings([SensorTag("a"), "b"]) == ["a", "b"]
+
+
+def test_unique_tag_names_union_and_conflict():
+    union = unique_tag_names(["a", SensorTag("a"), "b"])
+    assert set(union) == {"a", "b"}
+    with pytest.raises(SensorTagNormalizationError):
+        unique_tag_names([SensorTag("a", "x"), SensorTag("a", "y")])
+
+
+def test_malformed_tag_raises():
+    with pytest.raises(SensorTagNormalizationError):
+        normalize_sensor_tag({"asset": "no-name"})

@@ -1,0 +1,55 @@
+import numpy as np
+import pytest
+
+from gordo_tpu.ops.windows import (
+    model_offset,
+    num_windows,
+    sliding_windows,
+    window_targets,
+    windowed_dataset,
+)
+
+
+def test_window_alignment_lookahead_zero():
+    """lookahead=0: target is the last row of each window (AE semantics)."""
+    X = np.arange(20).reshape(10, 2)
+    windows, targets = windowed_dataset(X, X, lookback=3, lookahead=0)
+    assert windows.shape == (8, 3, 2)
+    for k in range(len(windows)):
+        np.testing.assert_array_equal(windows[k][-1], targets[k])
+
+
+def test_window_alignment_lookahead_one():
+    """lookahead=1: target is one step past the window (forecast semantics)."""
+    X = np.arange(20).reshape(10, 2)
+    windows, targets = windowed_dataset(X, X, lookback=3, lookahead=1)
+    assert windows.shape == (7, 3, 2)
+    for k in range(len(windows)):
+        np.testing.assert_array_equal(windows[k][-1] + 2, targets[k])
+
+
+@pytest.mark.parametrize(
+    "n,lookback,lookahead,expected_count,expected_offset",
+    [
+        (100, 20, 0, 81, 19),
+        (100, 20, 1, 80, 20),
+        (10, 1, 0, 10, 0),
+        (10, 1, 1, 9, 1),
+        (10, 5, 2, 4, 6),
+    ],
+)
+def test_counts_match_reference_semantics(
+    n, lookback, lookahead, expected_count, expected_offset
+):
+    X = np.zeros((n, 3))
+    assert num_windows(n, lookback, lookahead) == expected_count
+    assert model_offset(lookback, lookahead) == expected_offset
+    assert len(sliding_windows(X, lookback, lookahead)) == expected_count
+    assert len(window_targets(X, lookback, lookahead)) == expected_count
+    # count + offset == n
+    assert expected_count + expected_offset == n
+
+
+def test_too_short_series_raises():
+    with pytest.raises(ValueError):
+        sliding_windows(np.zeros((3, 1)), lookback=5, lookahead=0)

@@ -1,0 +1,33 @@
+import pytest
+
+from gordo_tpu.machine.loader import (
+    load_globals_config,
+    load_machine_config,
+    load_model_config,
+)
+
+
+def test_yaml_string_fields_parsed():
+    config = load_machine_config(
+        {"name": "m", "model": "{'a.b.C': {'x': 1}}", "runtime": "{'k': 2}"}
+    )
+    assert config["model"] == {"a.b.C": {"x": 1}}
+    assert config["runtime"] == {"k": 2}
+
+
+def test_name_required():
+    with pytest.raises(ValueError):
+        load_machine_config({"model": {}})
+
+
+def test_project_name_required():
+    with pytest.raises(ValueError):
+        load_model_config({"name": "m"})
+    config = load_model_config({"name": "m", "project_name": "p"})
+    assert config["project_name"] == "p"
+
+
+def test_globals_none_ok():
+    assert load_globals_config(None) == {}
+    with pytest.raises(ValueError):
+        load_globals_config(["not", "a", "dict"])

@@ -1,0 +1,105 @@
+import pytest
+import yaml
+
+from gordo_tpu.machine import Machine
+from gordo_tpu.machine.metadata import Metadata
+
+MODEL_DEF = {
+    "gordo_tpu.models.JaxAutoEncoder": {"kind": "feedforward_hourglass"}
+}
+DATASET_DEF = {
+    "type": "RandomDataset",
+    "train_start_date": "2020-01-01T00:00:00+00:00",
+    "train_end_date": "2020-01-10T00:00:00+00:00",
+    "tag_list": ["tag-1", "tag-2"],
+}
+
+
+def make_machine(**overrides):
+    config = {
+        "name": "my-machine",
+        "model": MODEL_DEF,
+        "dataset": dict(DATASET_DEF),
+        **overrides,
+    }
+    return Machine.from_config(config, project_name="test-project")
+
+
+def test_from_config_basics():
+    machine = make_machine()
+    assert machine.name == "my-machine"
+    assert machine.project_name == "test-project"
+    assert machine.host == "gordoserver-test-project-my-machine"
+    assert machine.evaluation["cv_mode"] == "full_build"
+    assert isinstance(machine.metadata, Metadata)
+
+
+def test_globals_merge_directions():
+    config_globals = {
+        "runtime": {"server": {"replicas": 2}},
+        "evaluation": {"cv_mode": "cross_val_only"},
+        "dataset": {"resolution": "1h"},
+    }
+    machine = Machine.from_config(
+        {
+            "name": "m",
+            "model": MODEL_DEF,
+            "dataset": dict(DATASET_DEF),
+            "runtime": {"server": {"replicas": 5}},
+            "evaluation": {"cv_mode": "full_build"},
+        },
+        project_name="p",
+        config_globals=config_globals,
+    )
+    # machine-local overrides globals for runtime + evaluation
+    assert machine.runtime["server"]["replicas"] == 5
+    assert machine.evaluation["cv_mode"] == "full_build"
+    # reference quirk: globals patch over the machine's dataset block
+    assert machine.dataset.resolution == "1h"
+
+
+def test_invalid_name_rejected():
+    with pytest.raises(ValueError):
+        make_machine(name="Invalid_Name!")
+
+
+def test_invalid_model_rejected():
+    with pytest.raises(ValueError):
+        make_machine(model={"no.such.module.Klass": {}})
+
+
+def test_yaml_in_string_fields_parsed():
+    machine = Machine.from_config(
+        {
+            "name": "m",
+            "model": yaml.dump(MODEL_DEF),
+            "dataset": yaml.dump(DATASET_DEF),
+        },
+        project_name="p",
+    )
+    assert machine.dataset.resolution == "10min"
+
+
+def test_json_round_trip():
+    machine = make_machine()
+    clone = Machine.from_dict(yaml.safe_load(machine.to_json()))
+    assert clone == machine
+    assert clone.dataset.to_dict()["tag_list"] == ["tag-1", "tag-2"]
+
+
+def test_to_yaml_round_trip():
+    machine = make_machine()
+    clone = Machine.from_dict(yaml.safe_load(machine.to_yaml()))
+    assert clone == machine
+
+
+def test_missing_model_raises():
+    with pytest.raises(ValueError):
+        Machine.from_config(
+            {"name": "m", "dataset": dict(DATASET_DEF)}, project_name="p"
+        )
+
+
+def test_missing_project_name_raises():
+    with pytest.raises(ValueError):
+        Machine.from_config({"name": "m", "model": MODEL_DEF, "dataset": dict(DATASET_DEF)})

@@ -1,0 +1,93 @@
+import datetime
+
+import pytest
+
+from gordo_tpu.machine.validators import (
+    BaseDescriptor,
+    ValidDatetime,
+    ValidMachineRuntime,
+    ValidTagList,
+    ValidUrlString,
+    fix_resource_limits,
+)
+
+
+class Holder:
+    url = ValidUrlString()
+    dt = ValidDatetime()
+    tags = ValidTagList()
+    runtime = ValidMachineRuntime()
+
+
+@pytest.mark.parametrize("good", ["valid-name", "a", "abc123", "a-b-c"])
+def test_valid_url_strings(good):
+    h = Holder()
+    h.url = good
+    assert h.url == good
+
+
+@pytest.mark.parametrize(
+    "bad", ["Has_Underscore", "UPPER", "-leading", "trailing-", "a" * 64, "", "dot.ted"]
+)
+def test_invalid_url_strings(bad):
+    with pytest.raises(ValueError):
+        Holder().url = bad
+
+
+def test_datetime_requires_tz():
+    h = Holder()
+    h.dt = "2020-01-01T00:00:00+00:00"
+    assert h.dt.tzinfo is not None
+    with pytest.raises(ValueError):
+        h.dt = datetime.datetime(2020, 1, 1)
+    with pytest.raises(ValueError):
+        h.dt = "2020-01-01T00:00:00"
+
+
+def test_tag_list():
+    h = Holder()
+    h.tags = ["a", "b"]
+    assert h.tags == ["a", "b"]
+    with pytest.raises(ValueError):
+        h.tags = []
+
+
+def test_fix_resource_limits():
+    out = fix_resource_limits(
+        {"requests": {"memory": 1000, "cpu": 100}, "limits": {"memory": 500, "cpu": 200}}
+    )
+    assert out["limits"]["memory"] == 1000
+    assert out["limits"]["cpu"] == 200
+
+
+def test_fix_resource_limits_non_numeric():
+    with pytest.raises(ValueError):
+        fix_resource_limits(
+            {"requests": {"memory": "1G"}, "limits": {"memory": 500}}
+        )
+
+
+def test_runtime_fixes_nested_resources():
+    h = Holder()
+    h.runtime = {
+        "builder": {
+            "resources": {
+                "requests": {"memory": 4000},
+                "limits": {"memory": 1000},
+            }
+        }
+    }
+    assert h.runtime["builder"]["resources"]["limits"]["memory"] == 4000
+
+
+def test_descriptor_base():
+    class D(BaseDescriptor):
+        pass
+
+    class Obj:
+        x = D()
+
+    o = Obj()
+    assert o.x is None
+    o.x = 5
+    assert o.x == 5

@@ -1,0 +1,112 @@
+import pytest
+
+from gordo_tpu.models.factories import (
+    feedforward_hourglass,
+    feedforward_model,
+    feedforward_symmetric,
+    lstm_hourglass,
+    lstm_model,
+    lstm_symmetric,
+)
+from gordo_tpu.models.register import register_model_builder
+from gordo_tpu.models.spec import FeedForwardSpec, LSTMSpec
+
+
+def test_registry_contents():
+    factories = register_model_builder.factories
+    assert set(factories["JaxAutoEncoder"]) == {
+        "feedforward_model",
+        "feedforward_symmetric",
+        "feedforward_hourglass",
+    }
+    for lstm_type in ("JaxLSTMAutoEncoder", "JaxLSTMForecast"):
+        assert set(factories[lstm_type]) == {
+            "lstm_model",
+            "lstm_symmetric",
+            "lstm_hourglass",
+        }
+
+
+def test_feedforward_model_geometry():
+    spec = feedforward_model(
+        5,
+        encoding_dim=(8, 4),
+        encoding_func=("tanh", "relu"),
+        decoding_dim=(4, 8),
+        decoding_func=("relu", "tanh"),
+    )
+    assert isinstance(spec, FeedForwardSpec)
+    assert spec.dims == (8, 4, 4, 8)
+    assert spec.activations == ("tanh", "relu", "relu", "tanh")
+    assert spec.n_features_out == 5
+    # l1 activity on non-first encoder layers only
+    assert spec.l1_activity == (0.0, 1e-4, 0.0, 0.0)
+
+
+def test_feedforward_symmetric_mirrors():
+    spec = feedforward_symmetric(6, dims=(10, 4), funcs=("tanh", "tanh"))
+    assert spec.dims == (10, 4, 4, 10)
+
+
+@pytest.mark.parametrize(
+    "n_features,kwargs,expected_dims",
+    [
+        (10, {}, (8, 7, 5, 5, 7, 8)),
+        (5, {}, (4, 4, 3, 3, 4, 4)),
+        (10, {"compression_factor": 0.2}, (7, 5, 2, 2, 5, 7)),
+        (10, {"encoding_layers": 1}, (5, 5)),
+    ],
+)
+def test_hourglass_geometry_parity(n_features, kwargs, expected_dims):
+    """Geometry matches the reference's doctest examples
+    (factories/feedforward_autoencoder.py:224-236)."""
+    spec = feedforward_hourglass(n_features, **kwargs)
+    assert spec.dims == expected_dims
+    assert spec.n_features_out == n_features
+
+
+def test_hourglass_validation():
+    with pytest.raises(ValueError):
+        feedforward_hourglass(10, compression_factor=2.0)
+    with pytest.raises(ValueError):
+        feedforward_hourglass(10, encoding_layers=0)
+
+
+def test_dim_func_mismatch_raises():
+    with pytest.raises(ValueError):
+        feedforward_model(4, encoding_dim=(8, 4), encoding_func=("tanh",))
+
+
+def test_lstm_factories():
+    spec = lstm_model(4, lookback_window=7, encoding_dim=(8,), encoding_func=("tanh",),
+                      decoding_dim=(8,), decoding_func=("tanh",))
+    assert isinstance(spec, LSTMSpec)
+    assert spec.lookback_window == 7
+    assert spec.dims == (8, 8)
+    sym = lstm_symmetric(4, dims=(6, 3), funcs=("tanh", "tanh"))
+    assert sym.dims == (6, 3, 3, 6)
+    hg = lstm_hourglass(10)
+    assert hg.dims == (8, 7, 5, 5, 7, 8)
+
+
+def test_optimizer_spec_defaults_match_keras():
+    spec = feedforward_hourglass(4)
+    assert spec.optimizer.name == "Adam"
+    assert spec.optimizer.learning_rate == pytest.approx(0.001)
+
+
+def test_specs_are_hashable_bucket_keys():
+    a = feedforward_hourglass(10)
+    b = feedforward_hourglass(10)
+    c = feedforward_hourglass(12)
+    assert hash(a) == hash(b) and a == b
+    assert a != c
+    assert len({a, b, c}) == 2
+
+
+def test_register_validates_n_features_first():
+    with pytest.raises(ValueError):
+
+        @register_model_builder(type="Whatever")
+        def bad_factory(features):
+            ...

@@ -1,0 +1,49 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.models.transformers.imputer import InfImputer
+
+
+@pytest.fixture
+def data():
+    X = np.array(
+        [[1.0, 10.0], [2.0, np.inf], [-np.inf, 30.0], [4.0, 40.0]], dtype=np.float64
+    )
+    return X
+
+
+def test_minmax_strategy(data):
+    imputer = InfImputer(strategy="minmax", delta=2.0)
+    out = imputer.fit_transform(data)
+    assert np.isfinite(out).all()
+    assert out[1, 1] == 40.0 + 2.0
+    assert out[2, 0] == 1.0 - 2.0
+
+
+def test_extremes_strategy(data):
+    imputer = InfImputer(strategy="extremes")
+    out = imputer.fit_transform(data)
+    assert np.isfinite(out).all()
+    assert out[1, 1] == np.finfo(data.dtype).max
+
+
+def test_explicit_fill_values(data):
+    imputer = InfImputer(inf_fill_value=99.0, neg_inf_fill_value=-99.0)
+    out = imputer.fit_transform(data)
+    assert out[1, 1] == 99.0
+    assert out[2, 0] == -99.0
+
+
+def test_dataframe_round_trip(data):
+    df = pd.DataFrame(data, columns=["a", "b"])
+    out = InfImputer().fit_transform(df)
+    assert isinstance(out, pd.DataFrame)
+    assert list(out.columns) == ["a", "b"]
+    # original untouched
+    assert np.isinf(df.values).any()
+
+
+def test_unknown_strategy():
+    with pytest.raises(ValueError):
+        InfImputer(strategy="bogus")

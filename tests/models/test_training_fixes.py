@@ -1,0 +1,46 @@
+import numpy as np
+
+from gordo_tpu.models import EarlyStopping, JaxAutoEncoder
+from gordo_tpu.models.callbacks import Callback
+
+X = np.random.RandomState(3).rand(50, 3).astype(np.float32)
+
+
+class RecordingCallback(Callback):
+    def __init__(self):
+        self.epochs = []
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.epochs.append(dict(logs or {}))
+        return False
+
+
+def test_early_stopping_honored_alongside_host_callbacks():
+    recorder = RecordingCallback()
+    model = JaxAutoEncoder(
+        kind="feedforward_hourglass",
+        encoding_layers=1,
+        epochs=50,
+        validation_split=0.2,
+        callbacks=[
+            EarlyStopping(monitor="val_loss", patience=1, min_delta=10.0),
+            recorder,
+        ],
+    )
+    model.fit(X, X)
+    assert 0 < len(recorder.epochs) < 50
+    assert "val_loss" in recorder.epochs[0]
+
+
+def test_multi_aggregation_dataset():
+    from gordo_tpu.dataset import RandomDataset
+
+    ds = RandomDataset(
+        "2020-01-01T00:00:00+00:00",
+        "2020-01-05T00:00:00+00:00",
+        tag_list=["a", "b"],
+        aggregation_methods=["mean", "max"],
+    )
+    X, y = ds.get_data()
+    assert list(X.columns) == ["a_mean", "a_max", "b_mean", "b_max"]
+    assert len(X) > 0

@@ -1,0 +1,131 @@
+import yaml
+import pytest
+from sklearn.decomposition import PCA
+from sklearn.pipeline import FeatureUnion, Pipeline
+from sklearn.preprocessing import MinMaxScaler
+
+from gordo_tpu import serializer
+from gordo_tpu.models import EarlyStopping, JaxAutoEncoder, Sequential
+from gordo_tpu.models.anomaly import DiffBasedAnomalyDetector
+
+
+def test_nested_pipeline_from_yaml():
+    definition = yaml.safe_load(
+        """
+        sklearn.pipeline.Pipeline:
+            steps:
+                - sklearn.decomposition.PCA:
+                    n_components: 2
+                - sklearn.pipeline.FeatureUnion:
+                    - sklearn.decomposition.PCA:
+                        n_components: 3
+                    - sklearn.pipeline.Pipeline:
+                        - sklearn.preprocessing.MinMaxScaler
+                        - sklearn.decomposition.TruncatedSVD:
+                            n_components: 2
+                - sklearn.preprocessing.MinMaxScaler
+        """
+    )
+    pipe = serializer.from_definition(definition)
+    assert isinstance(pipe, Pipeline)
+    assert isinstance(pipe.steps[0][1], PCA)
+    assert isinstance(pipe.steps[1][1], FeatureUnion)
+    assert isinstance(pipe.steps[2][1], MinMaxScaler)
+    assert pipe.steps[0][0] == "step_0"
+
+
+def test_bare_string_step():
+    scaler = serializer.from_definition("sklearn.preprocessing.MinMaxScaler")
+    assert isinstance(scaler, MinMaxScaler)
+
+
+def test_tuple_coercion():
+    scaler = serializer.from_definition(
+        {"sklearn.preprocessing.MinMaxScaler": {"feature_range": [-1, 1]}}
+    )
+    assert scaler.feature_range == (-1, 1)
+
+
+def test_from_definition_hook():
+    model = serializer.from_definition(
+        {
+            "gordo_tpu.models.JaxAutoEncoder": {
+                "kind": "feedforward_hourglass",
+                "compression_factor": 0.25,
+            }
+        }
+    )
+    assert isinstance(model, JaxAutoEncoder)
+    assert model.kind == "feedforward_hourglass"
+    assert model.kwargs["compression_factor"] == 0.25
+
+
+def test_string_param_resolves_to_estimator_instance():
+    det = serializer.from_definition(
+        {
+            "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+                "base_estimator": "sklearn.linear_model.LinearRegression"
+            }
+        }
+    )
+    assert isinstance(det, DiffBasedAnomalyDetector)
+    assert type(det.base_estimator).__name__ == "LinearRegression"
+
+
+def test_string_param_resolves_to_function():
+    transformer = serializer.from_definition(
+        {
+            "sklearn.preprocessing.FunctionTransformer": {
+                "func": "gordo_tpu.models.transformer_funcs.general.multiply_by",
+                "kw_args": {"factor": 2},
+            }
+        }
+    )
+    import numpy as np
+
+    out = transformer.fit_transform(np.array([[1.0], [2.0]]))
+    assert out.tolist() == [[2.0], [4.0]]
+
+
+def test_reference_compat_paths_rewrite():
+    model = serializer.from_definition(
+        {"gordo.machine.model.models.KerasAutoEncoder": {"kind": "feedforward_model"}}
+    )
+    assert isinstance(model, JaxAutoEncoder)
+
+
+def test_sequential_layers_container():
+    seq = serializer.from_definition(
+        yaml.safe_load(
+            """
+            tensorflow.keras.models.Sequential:
+                layers:
+                    - tensorflow.keras.layers.Dense:
+                        units: 4
+                    - tensorflow.keras.layers.Dense:
+                        units: 2
+            """
+        )
+    )
+    assert isinstance(seq, Sequential)
+    assert [layer.units for layer in seq.layers] == [4, 2]
+
+
+def test_build_callbacks():
+    callbacks = serializer.build_callbacks(
+        [
+            {
+                "tensorflow.keras.callbacks.EarlyStopping": {
+                    "monitor": "val_loss",
+                    "patience": 5,
+                }
+            }
+        ]
+    )
+    assert isinstance(callbacks[0], EarlyStopping)
+    assert callbacks[0].patience == 5
+
+
+def test_unknown_path_raises():
+    with pytest.raises(ImportError):
+        serializer.from_definition({"no.such.module.Klass": {}})

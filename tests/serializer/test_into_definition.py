@@ -1,0 +1,62 @@
+import yaml
+from sklearn.decomposition import PCA
+from sklearn.pipeline import Pipeline
+
+from gordo_tpu import serializer
+from gordo_tpu.models import JaxAutoEncoder
+from gordo_tpu.models.anomaly import DiffBasedAnomalyDetector
+
+
+def test_pipeline_round_trip():
+    definition = yaml.safe_load(
+        """
+        sklearn.pipeline.Pipeline:
+            steps:
+                - sklearn.preprocessing.MinMaxScaler
+                - sklearn.decomposition.PCA:
+                    n_components: 2
+        """
+    )
+    pipe = serializer.from_definition(definition)
+    out = serializer.into_definition(pipe)
+    rebuilt = serializer.from_definition(out)
+    assert isinstance(rebuilt, Pipeline)
+    assert isinstance(rebuilt.steps[1][1], PCA)
+    assert rebuilt.steps[1][1].n_components == 2
+
+
+def test_estimator_hook_round_trip():
+    model = JaxAutoEncoder(kind="feedforward_symmetric", dims=(4, 2), epochs=3)
+    out = serializer.into_definition(model)
+    key = "gordo_tpu.models.estimators.JaxAutoEncoder"
+    assert key in out
+    assert out[key]["kind"] == "feedforward_symmetric"
+    assert out[key]["epochs"] == 3
+    rebuilt = serializer.from_definition(out)
+    assert isinstance(rebuilt, JaxAutoEncoder)
+    assert rebuilt.kwargs["dims"] == (4, 2)
+
+
+def test_anomaly_detector_not_flattened_by_delegation():
+    det = DiffBasedAnomalyDetector(
+        base_estimator=JaxAutoEncoder(kind="feedforward_hourglass")
+    )
+    out = serializer.into_definition(det)
+    key = next(iter(out))
+    assert key.endswith("DiffBasedAnomalyDetector")
+    inner = out[key]["base_estimator"]
+    assert next(iter(inner)).endswith("JaxAutoEncoder")
+    rebuilt = serializer.from_definition(out)
+    assert isinstance(rebuilt, DiffBasedAnomalyDetector)
+    assert isinstance(rebuilt.base_estimator, JaxAutoEncoder)
+
+
+def test_function_reference_decomposes_to_path():
+    from sklearn.preprocessing import FunctionTransformer
+
+    from gordo_tpu.models.transformer_funcs.general import multiply_by
+
+    ft = FunctionTransformer(func=multiply_by, kw_args={"factor": 3})
+    out = serializer.into_definition(ft)
+    params = out["sklearn.preprocessing._function_transformer.FunctionTransformer"]
+    assert params["func"] == "gordo_tpu.models.transformer_funcs.general.multiply_by"

@@ -1,0 +1,46 @@
+import numpy as np
+import pytest
+from sklearn.pipeline import Pipeline
+from sklearn.preprocessing import MinMaxScaler
+
+from gordo_tpu import serializer
+from gordo_tpu.models import JaxAutoEncoder
+
+
+def test_dumps_loads_bytes():
+    scaler = MinMaxScaler(feature_range=(0, 2))
+    restored = serializer.loads(serializer.dumps(scaler))
+    assert restored.feature_range == (0, 2)
+
+
+def test_dump_load_directory(tmp_path):
+    X = np.random.RandomState(0).rand(64, 3).astype(np.float32)
+    pipe = Pipeline(
+        [
+            ("scale", MinMaxScaler()),
+            ("model", JaxAutoEncoder(kind="feedforward_hourglass", epochs=1)),
+        ]
+    )
+    pipe.fit(X, X)
+    expected = pipe.predict(X)
+
+    serializer.dump(pipe, tmp_path, metadata={"machine": "m1"}, info={"extra": 1})
+    restored = serializer.load(tmp_path)
+    np.testing.assert_allclose(restored.predict(X), expected, rtol=1e-5)
+
+    metadata = serializer.load_metadata(tmp_path)
+    assert metadata["machine"] == "m1"
+    info = serializer.load_info(tmp_path)
+    assert "checksum" in info and info["extra"] == 1
+
+
+def test_load_metadata_parent_fallback(tmp_path):
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    serializer.dump(MinMaxScaler(), tmp_path, metadata={"at": "parent"})
+    assert serializer.load_metadata(str(sub))["at"] == "parent"
+
+
+def test_load_metadata_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        serializer.load_metadata(str(tmp_path / "nothing"))

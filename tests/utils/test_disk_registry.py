@@ -1,0 +1,28 @@
+import pytest
+
+from gordo_tpu.utils import disk_registry
+
+
+def test_write_get_delete_roundtrip(tmp_path):
+    registry = tmp_path / "registry"
+    disk_registry.write_key(registry, "abc123", "/some/path")
+    assert disk_registry.get_value(registry, "abc123") == "/some/path"
+    assert disk_registry.delete_value(registry, "abc123") is True
+    assert disk_registry.get_value(registry, "abc123") is None
+    assert disk_registry.delete_value(registry, "abc123") is False
+
+
+def test_get_missing_registry_dir(tmp_path):
+    assert disk_registry.get_value(tmp_path / "nope", "key") is None
+
+
+def test_overwrite_key(tmp_path):
+    disk_registry.write_key(tmp_path, "k", "v1")
+    disk_registry.write_key(tmp_path, "k", "v2")
+    assert disk_registry.get_value(tmp_path, "k") == "v2"
+
+
+@pytest.mark.parametrize("bad_key", ["../escape", "a/b", "", "a b"])
+def test_invalid_keys_rejected(tmp_path, bad_key):
+    with pytest.raises(ValueError):
+        disk_registry.write_key(tmp_path, bad_key, "v")

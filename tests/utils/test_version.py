@@ -1,0 +1,46 @@
+import pytest
+
+import gordo_tpu
+from gordo_tpu.utils.version import (
+    GordoPR,
+    GordoRelease,
+    GordoSHA,
+    GordoSpecial,
+    Special,
+    parse_version,
+)
+
+
+@pytest.mark.parametrize(
+    "tag,expected",
+    [
+        ("1.2.3", GordoRelease(1, 2, 3)),
+        ("10.0.1-rc1", GordoRelease(10, 0, 1, "-rc1")),
+        ("latest", GordoSpecial(Special.LATEST)),
+        ("stable", GordoSpecial(Special.STABLE)),
+        ("pr-123", GordoPR(123)),
+        ("abc1234", GordoSHA("abc1234")),
+    ],
+)
+def test_parse_docker_tag(tag, expected):
+    parsed = parse_version(tag)
+    assert parsed == expected
+    assert parsed.get_version() == tag
+
+
+def test_unparseable_tag():
+    with pytest.raises(ValueError):
+        parse_version("Not A Tag!")
+
+
+def test_package_version_parses():
+    major, minor, patch, suffix = gordo_tpu.parse_version(gordo_tpu.__version__)
+    assert (major, minor) == (
+        gordo_tpu.MAJOR_VERSION,
+        gordo_tpu.MINOR_VERSION,
+    )
+
+
+def test_unstable_version():
+    assert gordo_tpu.parse_version("1.2.3.dev4")[3] == "dev4"
+    assert not gordo_tpu.version_is_stable("1.2.3.dev4")

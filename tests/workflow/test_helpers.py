@@ -1,0 +1,20 @@
+from gordo_tpu.workflow.helpers import patch_dict
+
+
+def test_patch_adds_and_replaces_never_removes():
+    original = {"a": {"x": 1, "y": 2}, "keep": True}
+    patch = {"a": {"x": 10, "z": 3}, "new": 4}
+    out = patch_dict(original, patch)
+    assert out == {"a": {"x": 10, "y": 2, "z": 3}, "keep": True, "new": 4}
+    # inputs untouched
+    assert original == {"a": {"x": 1, "y": 2}, "keep": True}
+    assert patch == {"a": {"x": 10, "z": 3}, "new": 4}
+
+
+def test_patch_replaces_non_dict_with_dict():
+    assert patch_dict({"a": 1}, {"a": {"b": 2}}) == {"a": {"b": 2}}
+
+
+def test_patch_empty():
+    assert patch_dict({}, {"a": 1}) == {"a": 1}
+    assert patch_dict({"a": 1}, {}) == {"a": 1}
