@@ -1,0 +1,157 @@
+"""
+Headline benchmark: autoencoders trained per hour (BASELINE.json metric).
+
+Trains a fleet of hourglass feedforward autoencoders (the reference's
+production architecture — 20 sensor tags, 10 days of 10-minute data, the
+`examples/config.yaml` shape) as ONE fused vmapped program on whatever
+accelerator `jax.devices()` provides, and compares against the reference
+engine's cost measured directly: the same architecture / optimizer / batch
+size / epochs trained with Keras/TF2 on CPU (the reference trains every
+model with CPU Keras inside its per-model k8s pod —
+SURVEY.md §2.9, BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": "autoencoders_trained_per_hour", "value": ..., "unit":
+   "models/hour", "vs_baseline": ...}
+
+Env knobs: BENCH_MODELS (default 256), BENCH_EPOCHS (20), BENCH_SAMPLES
+(1440), BENCH_TAGS (20), BENCH_SKIP_TF_BASELINE=1 to reuse/skip the Keras
+measurement (cached in .bench_baseline.json).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_MODELS = int(os.environ.get("BENCH_MODELS", 256))
+N_EPOCHS = int(os.environ.get("BENCH_EPOCHS", 20))
+N_SAMPLES = int(os.environ.get("BENCH_SAMPLES", 1440))  # 10 days @ 10min
+N_TAGS = int(os.environ.get("BENCH_TAGS", 20))
+BATCH = 64
+BASELINE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_baseline.json")
+
+
+def make_data(n_models: int):
+    rng = np.random.RandomState(42)
+    t = np.linspace(0, 12 * np.pi, N_SAMPLES, dtype=np.float32)
+    data = []
+    for i in range(n_models):
+        phases = rng.uniform(0, 2 * np.pi, N_TAGS).astype(np.float32)
+        amp = rng.uniform(0.5, 2.0, N_TAGS).astype(np.float32)
+        X = amp * np.sin(t[:, None] + phases) + 0.05 * rng.standard_normal(
+            (N_SAMPLES, N_TAGS)
+        ).astype(np.float32)
+        data.append(X)
+    return data
+
+
+def bench_fleet() -> float:
+    """Our throughput: models/hour on the available accelerator."""
+    from gordo_tpu.models.factories import feedforward_hourglass
+    from gordo_tpu.models.training import FitConfig
+    from gordo_tpu.parallel import FleetMember, FleetTrainer
+
+    spec = feedforward_hourglass(N_TAGS)
+    config = FitConfig(epochs=N_EPOCHS, batch_size=BATCH, shuffle=True)
+    data = make_data(N_MODELS)
+    members = [
+        FleetMember(name=f"m{i}", spec=spec, X=X, y=X.copy(), seed=i)
+        for i, X in enumerate(data)
+    ]
+    trainer = FleetTrainer()
+
+    # Warmup: compile the program on a 2-member fleet of the same shapes
+    warm = [
+        FleetMember(name=f"w{i}", spec=spec, X=data[i], y=data[i].copy(), seed=i)
+        for i in range(2)
+    ]
+    trainer.train(warm, config)
+
+    start = time.time()
+    results = trainer.train(members, config)
+    elapsed = time.time() - start
+
+    losses = [r.history.history["loss"][-1] for r in results]
+    assert all(np.isfinite(losses)), "non-finite training losses"
+    print(
+        f"# fleet: {N_MODELS} AEs x {N_EPOCHS} epochs in {elapsed:.2f}s "
+        f"(final loss mean {np.mean(losses):.5f}) on {_device_desc()}",
+        file=sys.stderr,
+    )
+    return N_MODELS / (elapsed / 3600.0)
+
+
+def _device_desc() -> str:
+    import jax
+
+    d = jax.devices()
+    return f"{len(d)}x {d[0].device_kind}"
+
+
+def bench_reference_keras() -> float:
+    """
+    Reference-engine cost: Keras/TF2 CPU fit of the same architecture,
+    measured over a few epochs and scaled to N_EPOCHS. Returns models/hour
+    for one reference builder pod (1 CPU core pod in the reference's spec;
+    we grant it the whole host CPU — a conservative baseline).
+    """
+    if os.environ.get("BENCH_SKIP_TF_BASELINE") and os.path.exists(BASELINE_CACHE):
+        with open(BASELINE_CACHE) as f:
+            return json.load(f)["models_per_hour"]
+
+    import tensorflow as tf
+
+    tf.get_logger().setLevel("ERROR")
+    from gordo_tpu.models.factories.utils import hourglass_calc_dims
+
+    dims = hourglass_calc_dims(0.5, 3, N_TAGS)
+    layers = [tf.keras.layers.Input(shape=(N_TAGS,))]
+    for units in tuple(dims) + tuple(dims[::-1]):
+        layers.append(tf.keras.layers.Dense(units, activation="tanh"))
+    layers.append(tf.keras.layers.Dense(N_TAGS, activation="linear"))
+    model = tf.keras.Sequential(layers)
+    model.compile(optimizer="adam", loss="mse")
+
+    X = make_data(1)[0]
+    measure_epochs = max(2, min(5, N_EPOCHS))
+    model.fit(X, X, epochs=1, batch_size=BATCH, verbose=0)  # warmup
+    start = time.time()
+    model.fit(X, X, epochs=measure_epochs, batch_size=BATCH, verbose=0, shuffle=True)
+    per_epoch = (time.time() - start) / measure_epochs
+    seconds_per_model = per_epoch * N_EPOCHS
+    models_per_hour = 3600.0 / seconds_per_model
+    print(
+        f"# reference: keras CPU {per_epoch:.3f}s/epoch -> "
+        f"{seconds_per_model:.2f}s/model -> {models_per_hour:.1f} models/hour",
+        file=sys.stderr,
+    )
+    with open(BASELINE_CACHE, "w") as f:
+        json.dump({"models_per_hour": models_per_hour}, f)
+    return models_per_hour
+
+
+def main():
+    ours = bench_fleet()
+    try:
+        reference = bench_reference_keras()
+    except Exception as e:  # TF unavailable: fall back to cached/derived
+        print(f"# reference baseline unavailable ({e})", file=sys.stderr)
+        if os.path.exists(BASELINE_CACHE):
+            with open(BASELINE_CACHE) as f:
+                reference = json.load(f)["models_per_hour"]
+        else:
+            reference = None
+    result = {
+        "metric": "autoencoders_trained_per_hour",
+        "value": round(ours, 1),
+        "unit": "models/hour",
+        "vs_baseline": round(ours / reference, 2) if reference else None,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

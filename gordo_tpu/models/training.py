@@ -165,11 +165,16 @@ def predict_fn(spec: ModelSpec):
 
 
 @lru_cache(maxsize=None)
-def _fit_program(spec: ModelSpec, config: FitConfig):
+def build_raw_fit_fn(spec: ModelSpec, config: FitConfig):
     """
-    Compile the fused fit program for (spec, config). Returns a function
+    The *unjitted* fused fit function for (spec, config):
     (params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng) ->
-    (params, losses[epochs], val_losses[epochs], epochs_ran).
+    (params, opt_state, losses[epochs], val_losses[epochs], epochs_ran).
+
+    Everything — ragged lengths, validation split, fold boundaries — is
+    expressed through the weight vectors, so the same function serves the
+    single-model path (jit) and the fleet path (jit∘vmap over a stacked
+    model axis, sharded across the mesh).
     """
     forward = forward_fn_for(spec)
     per_sample = resolve_loss(spec.loss)
@@ -201,9 +206,18 @@ def _fit_program(spec: ModelSpec, config: FitConfig):
             yb = jnp.take(ytr, batch_idx, axis=0)
             wb = jnp.take(wtr, batch_idx, axis=0)
             loss, grads = grad_fn(params, xb, yb, wb)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return (params, opt_state), loss * jnp.sum(wb)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            # An all-padding batch (possible for short members of a padded
+            # fleet bucket) must be a true no-op: zero grads would still
+            # advance Adam momentum and drift the params, and its NaN loss
+            # must not poison the epoch sum.
+            has_data = jnp.sum(wb) > 0
+            params = _tree_where(
+                has_data, optax.apply_updates(params, updates), params
+            )
+            opt_state = _tree_where(has_data, new_opt_state, opt_state)
+            contribution = jnp.where(has_data, loss * jnp.sum(wb), 0.0)
+            return (params, opt_state), contribution
 
         (params, opt_state), weighted_losses = jax.lax.scan(step, (params, opt_state), idx)
         epoch_loss = jnp.sum(weighted_losses) / jnp.maximum(jnp.sum(wtr), 1.0)
@@ -213,7 +227,6 @@ def _fit_program(spec: ModelSpec, config: FitConfig):
         out, _ = forward(spec, params, X)
         return weighted_mean_loss(per_sample(out, y), w)
 
-    @jax.jit
     def fit(params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng):
         has_val = Xval.shape[0] > 0
 
@@ -233,7 +246,12 @@ def _fit_program(spec: ModelSpec, config: FitConfig):
                 else jnp.array(jnp.nan, loss.dtype)
             )
             if es is not None:
-                monitor = val_loss if (monitor_val and has_val) else loss
+                if monitor_val and has_val:
+                    # Per-member fallback: a fleet member with no validation
+                    # rows gets NaN val_loss; monitor train loss instead.
+                    monitor = jnp.where(jnp.isnan(val_loss), loss, val_loss)
+                else:
+                    monitor = loss
                 improved = monitor < best - es[2]
                 best = jnp.where(~stopped & improved, monitor, best)
                 if es[3]:
@@ -266,6 +284,12 @@ def _fit_program(spec: ModelSpec, config: FitConfig):
         return params, opt_state, losses, val_losses, jnp.sum(ran.astype(jnp.int32))
 
     return fit
+
+
+@lru_cache(maxsize=None)
+def _fit_program(spec: ModelSpec, config: FitConfig):
+    """Jitted single-model fused fit program for (spec, config)."""
+    return jax.jit(build_raw_fit_fn(spec, config))
 
 
 def fit_single(

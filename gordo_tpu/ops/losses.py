@@ -39,6 +39,12 @@ def resolve_loss(name: str) -> Callable:
 def weighted_mean_loss(
     per_sample: jnp.ndarray, weights: jnp.ndarray
 ) -> jnp.ndarray:
-    """Weighted mean of per-sample losses; weights zero out padding rows."""
+    """
+    Weighted mean of per-sample losses; weights zero out padding rows.
+    An all-zero weight vector yields NaN — "no data" must be
+    distinguishable from "zero loss" (a fleet member without validation
+    rows would otherwise report a perfect val_loss of 0.0).
+    """
     total = jnp.sum(weights)
-    return jnp.sum(per_sample * weights) / jnp.maximum(total, 1.0)
+    mean = jnp.sum(per_sample * weights) / jnp.maximum(total, 1.0)
+    return jnp.where(total > 0, mean, jnp.nan)

@@ -1,0 +1,24 @@
+from .fleet import FleetMember, FleetResult, FleetTrainer
+from .fleet_build import FleetBuilder, fleet_build
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    initialize_backend,
+    make_mesh,
+    model_data_sharding,
+    model_sharding,
+)
+
+__all__ = [
+    "FleetTrainer",
+    "FleetMember",
+    "FleetResult",
+    "FleetBuilder",
+    "fleet_build",
+    "make_mesh",
+    "model_sharding",
+    "model_data_sharding",
+    "initialize_backend",
+    "MODEL_AXIS",
+    "DATA_AXIS",
+]

@@ -1,0 +1,596 @@
+"""
+FleetBuilder: the whole-project build — every machine in one YAML trained
+as mesh-sharded model batches, producing per-machine artifacts identical
+in contract to ModelBuilder's.
+
+Replaces the reference's per-machine Argo pod DAG
+(argo-workflow.yml.template:1519-1598) with chip fan-out. Per machine it
+reproduces ModelBuilder semantics (gordo/builder/build_model.py):
+
+- data fetch (concurrent across machines, host-side)
+- host-side pipeline transformers (scalers) fitted per machine
+- CV folds → per-tag + aggregate metric scores and DiffBased threshold
+  math, with fold boundaries expressed as weight masks so every fold of
+  every machine in a bucket trains in one device program
+- final fit → params injected back into per-machine estimator objects
+- metadata tree + artifact save (model.pkl / metadata.json / info.json)
+
+Model definitions the fleet path supports: a JaxBaseEstimator, optionally
+inside an sklearn Pipeline (host transformers before it), optionally
+wrapped by DiffBasedAnomalyDetector. Anything else transparently falls
+back to the sequential ModelBuilder so `fleet_build` always builds the
+full config.
+"""
+
+import concurrent.futures
+import datetime
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+from sklearn.base import clone as sklearn_clone
+from sklearn.model_selection import KFold, TimeSeriesSplit
+from sklearn.pipeline import Pipeline
+
+import gordo_tpu
+from .. import serializer
+from ..builder.build_model import ModelBuilder
+from ..dataset import GordoBaseDataset
+from ..machine import Machine
+from ..machine.metadata import (
+    BuildMetadata,
+    CrossValidationMetaData,
+    DatasetBuildMetadata,
+    ModelBuildMetadata,
+)
+from ..models.anomaly.diff import (
+    DiffBasedAnomalyDetector,
+    DiffBasedKFCVAnomalyDetector,
+)
+from ..models.estimators import JaxBaseEstimator, JaxLSTMBaseEstimator
+from ..models.training import FitConfig, fit_config_from_kwargs, split_fit_kwargs
+from ..ops.windows import model_offset as calc_model_offset
+from ..ops.windows import sliding_windows, window_targets
+from .fleet import FleetMember, FleetResult, FleetTrainer, stack_member_params
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Plan:
+    """Everything needed to train + reassemble one machine."""
+
+    machine: Machine
+    dataset: GordoBaseDataset
+    model_obj: Any  # the unfitted object graph from the definition
+    detector: Optional[DiffBasedAnomalyDetector]
+    pipeline: Optional[Pipeline]
+    estimator: JaxBaseEstimator
+    X: pd.DataFrame = None
+    y: pd.DataFrame = None
+    X_arr: np.ndarray = None  # transformed (post host-transformers) inputs
+    y_arr: np.ndarray = None
+    windows: np.ndarray = None  # estimator-space samples ([N,F] or [N,L,F])
+    targets: np.ndarray = None
+    shuffle_perm: Optional[np.ndarray] = None  # detector-level row shuffle
+    offset: int = 0
+    spec: Any = None
+    fit_config: FitConfig = None
+    seed: int = 42
+    query_duration: float = 0.0
+    cv_scores: Dict[str, Any] = field(default_factory=dict)
+    cv_splits: Dict[str, Any] = field(default_factory=dict)
+    cv_duration: float = 0.0
+    train_duration: float = 0.0
+
+
+class FleetBuildError(RuntimeError):
+    pass
+
+
+class FleetBuilder:
+    def __init__(
+        self,
+        machines: Sequence[Machine],
+        trainer: Optional[FleetTrainer] = None,
+        data_workers: int = 16,
+    ):
+        self.machines = list(machines)
+        self.trainer = trainer if trainer is not None else FleetTrainer()
+        self.data_workers = data_workers
+
+    # ------------------------------------------------------------------ API
+
+    def build(
+        self, output_dir: Optional[str] = None
+    ) -> List[Tuple[Any, Machine]]:
+        """Train the whole fleet; optionally dump per-machine artifacts to
+        ``output_dir/<machine-name>/``."""
+        plans, fallbacks = self._plan_all()
+        self._load_all_data(plans)
+
+        # CV folds then final fit, bucketed across all plans at once
+        cv_plans = [
+            p
+            for p in plans
+            if p.machine.evaluation.get("cv_mode", "full_build").lower()
+            in ("full_build", "cross_val_only")
+        ]
+        if cv_plans:
+            self._run_cross_validation(cv_plans)
+        final_plans = [
+            p
+            for p in plans
+            if p.machine.evaluation.get("cv_mode", "full_build").lower()
+            != "cross_val_only"
+        ]
+        self._run_final_fit(final_plans)
+
+        results = [self._assemble(p) for p in plans]
+        for machine in fallbacks:
+            logger.info("Fleet fallback to ModelBuilder for %s", machine.name)
+            results.append(ModelBuilder(machine).build())
+
+        if output_dir is not None:
+            import os
+
+            for model, machine in results:
+                path = os.path.join(output_dir, machine.name)
+                os.makedirs(path, exist_ok=True)
+                serializer.dump(model, path, metadata=machine.to_dict())
+        return results
+
+    # ------------------------------------------------------------- planning
+
+    def _plan_all(self) -> Tuple[List[_Plan], List[Machine]]:
+        plans, fallbacks = [], []
+        for machine in self.machines:
+            plan = self._plan_machine(machine)
+            if plan is None:
+                fallbacks.append(machine)
+            else:
+                plans.append(plan)
+        return plans, fallbacks
+
+    @staticmethod
+    def _plan_machine(machine: Machine) -> Optional[_Plan]:
+        model_obj = serializer.from_definition(machine.model)
+        obj = model_obj
+        detector = None
+        if isinstance(obj, DiffBasedAnomalyDetector):
+            detector = obj
+            obj = obj.base_estimator
+        pipeline = None
+        if isinstance(obj, Pipeline):
+            pipeline = obj
+            obj = obj.steps[-1][1]
+        if not isinstance(obj, JaxBaseEstimator):
+            return None
+        if isinstance(obj, JaxLSTMBaseEstimator) and isinstance(
+            detector, DiffBasedKFCVAnomalyDetector
+        ):
+            # scattered KFold test indices don't map cleanly onto window
+            # semantics; keep exact reference behavior via the fallback
+            return None
+        dataset = (
+            machine.dataset
+            if isinstance(machine.dataset, GordoBaseDataset)
+            else GordoBaseDataset.from_dict(machine.dataset)
+        )
+        return _Plan(
+            machine=machine,
+            dataset=dataset,
+            model_obj=model_obj,
+            detector=detector,
+            pipeline=pipeline,
+            estimator=obj,
+        )
+
+    # ---------------------------------------------------------------- data
+
+    def _load_all_data(self, plans: List[_Plan]):
+        def load(plan: _Plan):
+            start = time.time()
+            X, y = plan.dataset.get_data()
+            plan.query_duration = time.time() - start
+            plan.X, plan.y = X, y
+
+        with concurrent.futures.ThreadPoolExecutor(self.data_workers) as pool:
+            list(pool.map(load, plans))
+
+        for plan in plans:
+            self._stage_arrays(plan)
+
+    @staticmethod
+    def _stage_arrays(plan: _Plan):
+        """Fit host transformers, window if LSTM, resolve spec + fit config."""
+        X_arr = np.asarray(plan.X.to_numpy(), np.float32)
+        y_arr = np.asarray(plan.y.to_numpy(), np.float32)
+        if plan.pipeline is not None and len(plan.pipeline.steps) > 1:
+            transformed = plan.X
+            for _, transformer in plan.pipeline.steps[:-1]:
+                transformed = transformer.fit_transform(transformed, plan.y)
+            X_arr = np.asarray(
+                getattr(transformed, "to_numpy", lambda: transformed)(), np.float32
+            )
+        plan.X_arr, plan.y_arr = X_arr, y_arr
+
+        est = plan.estimator
+        est.kwargs.update(
+            {"n_features": X_arr.shape[1], "n_features_out": y_arr.shape[1]}
+        )
+        fit_kwargs, factory_kwargs = split_fit_kwargs(est.sk_params)
+        if isinstance(est, JaxLSTMBaseEstimator):
+            lookback, lookahead = est.lookback_window, est.lookahead
+            plan.offset = calc_model_offset(lookback, lookahead)
+            plan.windows = sliding_windows(X_arr, lookback, lookahead)
+            plan.targets = window_targets(y_arr, lookback, lookahead)
+            fit_kwargs["shuffle"] = False
+        else:
+            plan.offset = 0
+            plan.windows, plan.targets = X_arr, y_arr
+        if plan.detector is not None and getattr(plan.detector, "shuffle", False):
+            # Sequential DiffBased.fit row-shuffles before training
+            # (diff.py: sklearn_shuffle(..., random_state=0)); mirror it as
+            # a stored permutation applied to training members only —
+            # scoring always runs on chronological windows.
+            from sklearn.utils import shuffle as sklearn_shuffle
+
+            plan.shuffle_perm = sklearn_shuffle(
+                np.arange(len(plan.windows)), random_state=0
+            )
+        plan.spec = est._build_spec(factory_kwargs)
+        config, host_callbacks = fit_config_from_kwargs(fit_kwargs)
+        if host_callbacks:
+            raise FleetBuildError(
+                f"{plan.machine.name}: custom host callbacks are not supported "
+                "in fleet builds"
+            )
+        plan.fit_config = config
+        plan.seed = int(fit_kwargs.get("seed", 42))
+
+    # ------------------------------------------------------------------- CV
+
+    def _run_cross_validation(self, plans: List[_Plan]):
+        """
+        Per-fold fleet training. Fold boundaries become train-weight masks
+        over window indices; every (spec, config) bucket trains all its
+        machines' folds together.
+        """
+        start = time.time()
+        fold_state: Dict[str, Dict[str, Any]] = {p.machine.name: {} for p in plans}
+
+        max_folds = 0
+        per_plan_folds: Dict[str, List[Tuple[np.ndarray, np.ndarray]]] = {}
+        for plan in plans:
+            splits = list(self._cv_for(plan).split(plan.X_arr))
+            per_plan_folds[plan.machine.name] = splits
+            max_folds = max(max_folds, len(splits))
+            plan.cv_splits = self._split_metadata(plan, splits)
+
+        for fold_idx in range(max_folds):
+            grouped: Dict[FitConfig, Tuple[List[FleetMember], List[_Plan]]] = {}
+            for plan in plans:
+                splits = per_plan_folds[plan.machine.name]
+                if fold_idx >= len(splits):
+                    continue
+                train_idx, _ = splits[fold_idx]
+                weights = self._window_train_weights(plan, train_idx)
+                member = self._make_member(
+                    plan, weights, seed=plan.seed + 1000 * (fold_idx + 1)
+                )
+                members, fold_plans = grouped.setdefault(plan.fit_config, ([], []))
+                members.append(member)
+                fold_plans.append(plan)
+            for config, (members, fold_plans) in grouped.items():
+                # One fused program per (config, spec, shape) bucket trains
+                # every machine's fold model together
+                fold_results = self.trainer.train(members, config)
+                self._score_fold(
+                    fold_plans, fold_results, per_plan_folds, fold_idx, fold_state
+                )
+
+        for plan in plans:
+            self._finalize_cv(plan, fold_state[plan.machine.name])
+            plan.cv_duration = time.time() - start
+
+    @staticmethod
+    def _make_member(
+        plan: _Plan, train_weights: Optional[np.ndarray], seed: int
+    ) -> FleetMember:
+        """Training member with the detector-level shuffle applied."""
+        perm = plan.shuffle_perm
+        if perm is None:
+            X, y = plan.windows, plan.targets
+        else:
+            X, y = plan.windows[perm], plan.targets[perm]
+            if train_weights is not None:
+                train_weights = train_weights[perm]
+        return FleetMember(
+            name=plan.machine.name,
+            spec=plan.spec,
+            X=X,
+            y=y,
+            train_weights=train_weights,
+            seed=seed,
+        )
+
+    @staticmethod
+    def _cv_for(plan: _Plan):
+        if isinstance(plan.detector, DiffBasedKFCVAnomalyDetector):
+            return KFold(n_splits=5, shuffle=True, random_state=0)
+        cv_def = plan.machine.evaluation.get("cv")
+        if cv_def:
+            return serializer.from_definition(cv_def)
+        return TimeSeriesSplit(n_splits=3)
+
+    def _window_train_weights(self, plan: _Plan, train_idx: np.ndarray) -> np.ndarray:
+        """Row-index fold → window-index training mask."""
+        n_windows = len(plan.windows)
+        weights = np.zeros(n_windows, np.float32)
+        if plan.offset == 0:
+            weights[train_idx[train_idx < n_windows]] = 1.0
+        else:
+            # windowed models need contiguous [0, b) folds (TimeSeriesSplit);
+            # scattered folds have no clean window mapping
+            if len(train_idx) != int(train_idx[-1]) - int(train_idx[0]) + 1:
+                raise FleetBuildError(
+                    f"{plan.machine.name}: non-contiguous CV folds are not "
+                    "supported for windowed (LSTM) models in fleet builds"
+                )
+            boundary = int(train_idx[-1]) + 1
+            weights[: max(boundary - plan.offset, 0)] = 1.0
+        return weights
+
+    def _predictions_for_rows(
+        self, plan: _Plan, prediction: np.ndarray, rows: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Map row indices to (y_true, y_pred, target_rows) honoring the
+        window offset."""
+        if plan.offset == 0:
+            rows = rows[rows < len(prediction)]
+            return plan.y_arr[rows], prediction[rows], rows
+        # contiguous test [b, c) → window indices [b, c - offset)
+        b, c = int(rows[0]), int(rows[-1]) + 1
+        window_idx = np.arange(b, max(c - plan.offset, b))
+        window_idx = window_idx[window_idx < len(prediction)]
+        target_rows = window_idx + plan.offset
+        return plan.y_arr[target_rows], prediction[window_idx], target_rows
+
+    def _score_fold(self, fold_plans, fold_results, per_plan_folds, fold_idx, fold_state):
+        by_name = {r.name: r for r in fold_results}
+        # One batched forward per (spec, window-rank) group — not one
+        # dispatch per machine.
+        groups: Dict[Tuple, List[_Plan]] = {}
+        for plan in fold_plans:
+            groups.setdefault((plan.spec, plan.windows.shape[1:]), []).append(plan)
+        for (spec, _), group in groups.items():
+            n_max = max(len(p.windows) for p in group)
+            X = np.zeros(
+                (len(group), n_max) + group[0].windows.shape[1:], np.float32
+            )
+            for i, p in enumerate(group):
+                X[i, : len(p.windows)] = p.windows
+            stacked = stack_member_params(
+                [by_name[p.machine.name] for p in group]
+            )
+            predictions = self.trainer.predict_bucket(spec, stacked, X)
+            for i, plan in enumerate(group):
+                prediction = predictions[i, : len(plan.windows)]
+                train_rows, test_rows = per_plan_folds[plan.machine.name][fold_idx]
+                y_true, y_pred, target_rows = self._predictions_for_rows(
+                    plan, prediction, test_rows
+                )
+                state = fold_state[plan.machine.name]
+                state.setdefault("folds", []).append((y_true, y_pred))
+                self._accumulate_metric_scores(plan, y_true, y_pred, fold_idx)
+                if plan.detector is not None:
+                    self._accumulate_thresholds(
+                        plan, y_true, y_pred, fold_idx, state,
+                        y_train=plan.y_arr[train_rows],
+                        test_rows=target_rows,
+                    )
+
+    def _accumulate_metric_scores(self, plan, y_true, y_pred, fold_idx):
+        evaluation = plan.machine.evaluation
+        metrics_list = ModelBuilder.metrics_from_list(evaluation.get("metrics"))
+        scaler_def = evaluation.get("scoring_scaler")
+        scaler = None
+        if scaler_def:
+            scaler = (
+                serializer.from_definition(scaler_def)
+                if isinstance(scaler_def, (str, dict))
+                else scaler_def
+            )
+            scaler = sklearn_clone(scaler).fit(plan.y_arr)
+            y_true_s, y_pred_s = scaler.transform(y_true), scaler.transform(y_pred)
+        else:
+            y_true_s, y_pred_s = y_true, y_pred
+        tags = [str(c) for c in plan.y.columns]
+        for metric in metrics_list:
+            name = metric.__name__.replace("_", "-")
+            for i, tag in enumerate(tags):
+                key = f"{name}-{tag.replace(' ', '-')}"
+                plan.cv_scores.setdefault(key, {})[f"fold-{fold_idx + 1}"] = float(
+                    metric(y_true_s[:, i], y_pred_s[:, i])
+                )
+            plan.cv_scores.setdefault(name, {})[f"fold-{fold_idx + 1}"] = float(
+                metric(y_true_s, y_pred_s)
+            )
+
+    @staticmethod
+    def _accumulate_thresholds(
+        plan, y_true, y_pred, fold_idx, state, y_train=None, test_rows=None
+    ):
+        detector = plan.detector
+        # The fold model's scaler is fit on the fold-TRAIN targets
+        # (reference: DiffBased.fit → scaler.fit(y) on the train split,
+        # then _scaled_mse_per_timestep transforms the test rows with it)
+        scaler = sklearn_clone(detector.scaler).fit(
+            y_train if y_train is not None else y_true
+        )
+        scaled_mse = pd.Series(
+            np.mean(
+                np.square(scaler.transform(y_pred) - scaler.transform(y_true)), axis=1
+            )
+        )
+        mae = pd.DataFrame(np.abs(y_true - y_pred))
+        if isinstance(detector, DiffBasedKFCVAnomalyDetector):
+            # KFold test rows are scattered; keep them with their original
+            # row positions so errors can be re-stitched chronologically
+            # before window smoothing (the sequential path smooths in time
+            # order — diff.py KFCV cross_validate).
+            state.setdefault("kfcv_parts", []).append(
+                (np.asarray(test_rows), scaled_mse.to_numpy(), mae.to_numpy())
+            )
+        else:
+            state["aggregate_threshold"] = float(scaled_mse.rolling(6).min().max())
+            tag_thresholds = mae.rolling(6).min().max()
+            tag_thresholds.name = f"fold-{fold_idx}"
+            state.setdefault("feature_folds", {})[f"fold-{fold_idx}"] = tag_thresholds
+            state.setdefault("agg_folds", {})[f"fold-{fold_idx}"] = state[
+                "aggregate_threshold"
+            ]
+            if detector.window is not None:
+                smooth_agg = float(scaled_mse.rolling(detector.window).min().max())
+                smooth_tags = mae.rolling(detector.window).min().max()
+                smooth_tags.name = f"fold-{fold_idx}"
+                state["smooth_aggregate_threshold"] = smooth_agg
+                state["smooth_feature_thresholds"] = smooth_tags
+                state.setdefault("smooth_feature_folds", {})[
+                    f"fold-{fold_idx}"
+                ] = smooth_tags
+                state.setdefault("smooth_agg_folds", {})[f"fold-{fold_idx}"] = smooth_agg
+
+    def _finalize_cv(self, plan: _Plan, state: Dict[str, Any]):
+        # fold-stat summary rows (fold-mean/std/min/max) like the reference
+        for key, folds in plan.cv_scores.items():
+            values = np.array(
+                [v for k, v in folds.items() if k.startswith("fold-")]
+            )
+            folds.update(
+                {
+                    "fold-mean": float(values.mean()),
+                    "fold-std": float(values.std()),
+                    "fold-max": float(values.max()),
+                    "fold-min": float(values.min()),
+                }
+            )
+        detector = plan.detector
+        if detector is None:
+            return
+        feature_names = [str(c) for c in plan.y.columns]
+        if isinstance(detector, DiffBasedKFCVAnomalyDetector):
+            # Stitch fold errors back into chronological (row) order before
+            # rolling-window smoothing
+            n = len(plan.y_arr)
+            mse_full = np.full(n, np.nan)
+            abs_full = np.full((n, len(feature_names)), np.nan)
+            for rows, mse_part, abs_part in state["kfcv_parts"]:
+                mse_full[rows] = mse_part
+                abs_full[rows] = abs_part
+            detector.aggregate_threshold_ = float(
+                detector._calculate_threshold(pd.Series(mse_full))
+            )
+            thresholds = detector._calculate_threshold(
+                pd.DataFrame(abs_full, columns=feature_names)
+            )
+            detector.feature_thresholds_ = thresholds
+        elif "feature_folds" in state:
+            folds_df = pd.DataFrame(state["feature_folds"]).T
+            folds_df.columns = feature_names
+            detector.feature_thresholds_per_fold_ = folds_df
+            detector.aggregate_thresholds_per_fold_ = state["agg_folds"]
+            last = folds_df.iloc[-1]
+            last.name = folds_df.index[-1]
+            detector.feature_thresholds_ = last
+            detector.aggregate_threshold_ = state["aggregate_threshold"]
+            detector.smooth_aggregate_threshold_ = state.get(
+                "smooth_aggregate_threshold"
+            )
+            smooth = state.get("smooth_feature_thresholds")
+            if smooth is not None:
+                smooth = smooth.copy()
+                smooth.index = feature_names
+            detector.smooth_feature_thresholds_ = smooth
+            if "smooth_feature_folds" in state:
+                smooth_df = pd.DataFrame(state["smooth_feature_folds"]).T
+                smooth_df.columns = feature_names
+                detector.smooth_feature_thresholds_per_fold_ = smooth_df
+                detector.smooth_aggregate_thresholds_per_fold_ = state[
+                    "smooth_agg_folds"
+                ]
+
+    # ------------------------------------------------------------ final fit
+
+    def _run_final_fit(self, plans: List[_Plan]):
+        if not plans:
+            return
+        start = time.time()
+        members = [self._make_member(p, None, seed=p.seed) for p in plans]
+        # group per distinct fit config to keep train() calls homogeneous
+        by_config: Dict[FitConfig, List[int]] = {}
+        for i, plan in enumerate(plans):
+            by_config.setdefault(plan.fit_config, []).append(i)
+        for config, indices in by_config.items():
+            subset = [members[i] for i in indices]
+            results = self.trainer.train(subset, config)
+            for i, result in zip(indices, results):
+                plan = plans[i]
+                plan.estimator.params_ = result.params
+                plan.estimator.spec_ = plan.spec
+                plan.estimator._history = result.history
+                plan.train_duration = time.time() - start
+                if plan.detector is not None:
+                    plan.detector.scaler.fit(plan.y)
+
+    # ------------------------------------------------------------- assembly
+
+    def _assemble(self, plan: _Plan) -> Tuple[Any, Machine]:
+        machine = Machine.from_dict(plan.machine.to_dict())
+        machine.metadata.build_metadata = BuildMetadata(
+            model=ModelBuildMetadata(
+                model_offset=plan.offset,
+                model_creation_date=str(
+                    datetime.datetime.now(datetime.timezone.utc).astimezone()
+                ),
+                model_builder_version=gordo_tpu.__version__,
+                model_training_duration_sec=plan.train_duration,
+                cross_validation=CrossValidationMetaData(
+                    cv_duration_sec=plan.cv_duration,
+                    scores=plan.cv_scores,
+                    splits=plan.cv_splits,
+                ),
+                model_meta=ModelBuilder._extract_metadata_from_model(plan.model_obj),
+            ),
+            dataset=DatasetBuildMetadata(
+                query_duration_sec=plan.query_duration,
+                dataset_meta=plan.dataset.get_metadata(),
+            ),
+        )
+        return plan.model_obj, machine
+
+    @staticmethod
+    def _split_metadata(plan: _Plan, splits) -> Dict[str, Any]:
+        metadata = {}
+        index = plan.X.index
+        for i, (train, test) in enumerate(splits):
+            for label, idx in (("train", train), ("test", test)):
+                for endpoint, pos in (("start", idx[0]), ("end", idx[-1])):
+                    value = index[pos]
+                    metadata[f"fold-{i + 1}-{label}-{endpoint}"] = (
+                        value.isoformat() if hasattr(value, "isoformat") else int(value)
+                    )
+        return metadata
+
+
+def fleet_build(
+    machines: Sequence[Machine],
+    output_dir: Optional[str] = None,
+    trainer: Optional[FleetTrainer] = None,
+) -> List[Tuple[Any, Machine]]:
+    """Convenience wrapper: build the whole fleet."""
+    return FleetBuilder(machines, trainer=trainer).build(output_dir=output_dir)

@@ -1,0 +1,140 @@
+import numpy as np
+import pytest
+
+from gordo_tpu.models.factories import feedforward_symmetric
+from gordo_tpu.models.training import FitConfig, fit_single
+from gordo_tpu.parallel import FleetMember, FleetTrainer, make_mesh
+from gordo_tpu.parallel.fleet import _round_up_pow2
+
+SPEC = feedforward_symmetric(3, dims=(6, 3), funcs=("tanh", "tanh"))
+CONFIG = FitConfig(epochs=3, batch_size=16, shuffle=False)
+
+
+def _member(name, n, seed):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 3).astype(np.float32)
+    return FleetMember(name=name, spec=SPEC, X=X, y=X.copy(), seed=seed)
+
+
+def test_round_up_pow2():
+    assert _round_up_pow2(100, 16) == 128
+    assert _round_up_pow2(5, 16) == 16
+    assert _round_up_pow2(128, 16) == 128
+    assert _round_up_pow2(129, 16) == 256
+
+
+def test_fleet_trains_ragged_members():
+    """Members of different lengths in one bucket, all trained at once."""
+    members = [_member(f"m{i}", n, i) for i, n in enumerate([50, 80, 100, 128])]
+    trainer = FleetTrainer()
+    results = trainer.train(members, CONFIG)
+    assert [r.name for r in results] == ["m0", "m1", "m2", "m3"]
+    for r in results:
+        assert len(r.history.history["loss"]) == 3
+        assert np.isfinite(r.history.history["loss"]).all()
+
+
+def test_fleet_matches_single_model_training():
+    """A fleet member must train to the same params as the single path when
+    shapes align (same seed, same data, no padding difference)."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 3).astype(np.float32)  # 64 = already a pow2 multiple
+    member = FleetMember(name="m", spec=SPEC, X=X, y=X.copy(), seed=7)
+    fleet_result = FleetTrainer().train([member], CONFIG)[0]
+
+    single_params, single_history = fit_single(SPEC, X, X.copy(), CONFIG, seed=7)
+    import jax
+
+    for fleet_leaf, single_leaf in zip(
+        jax.tree_util.tree_leaves(fleet_result.params),
+        jax.tree_util.tree_leaves(jax.device_get(single_params)),
+    ):
+        np.testing.assert_allclose(fleet_leaf, single_leaf, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        fleet_result.history.history["loss"],
+        single_history.history["loss"],
+        rtol=2e-4,
+    )
+
+
+def test_fleet_member_isolation():
+    """A member's result must not depend on which other members share the
+    fleet (same seed => same params)."""
+    alone = FleetTrainer().train([_member("m", 64, 5)], CONFIG)[0]
+    crowded = FleetTrainer().train(
+        [_member("m", 64, 5)] + [_member(f"x{i}", 64, 50 + i) for i in range(3)],
+        CONFIG,
+    )[0]
+    import jax
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(alone.params),
+        jax.tree_util.tree_leaves(crowded.params),
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_fleet_sharded_over_mesh():
+    """8-device CPU mesh: the model axis shards without changing results."""
+    import jax
+
+    assert len(jax.devices()) == 8
+    mesh = make_mesh()
+    assert mesh.devices.shape == (8, 1)
+    members = [_member(f"m{i}", 64, i) for i in range(8)]
+    results = FleetTrainer(mesh=mesh).train(members, CONFIG)
+    baseline = FleetTrainer(mesh=make_mesh(jax.devices()[:1])).train(members, CONFIG)
+    for sharded, single_dev in zip(results, baseline):
+        for a, b in zip(
+            jax.tree_util.tree_leaves(sharded.params),
+            jax.tree_util.tree_leaves(single_dev.params),
+        ):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_fleet_data_axis_mesh():
+    """models × data 2D mesh compiles and runs (GSPMD inserts collectives)."""
+    mesh = make_mesh(data_parallelism=2)
+    assert mesh.devices.shape == (4, 2)
+    members = [_member(f"m{i}", 64, i) for i in range(4)]
+    results = FleetTrainer(mesh=mesh).train(members, CONFIG)
+    assert all(np.isfinite(r.history.history["loss"]).all() for r in results)
+
+
+def test_mismatched_lengths_raise():
+    with pytest.raises(ValueError):
+        FleetMember(name="bad", spec=SPEC, X=np.zeros((10, 3)), y=np.zeros((9, 3)))
+
+
+def test_non_pow2_data_axis_padding():
+    """lcm padding: data axis 3 with batch 32 must not break batch reshape
+    (regression for n_padded bumped to a non-multiple of batch_size)."""
+    import jax
+
+    mesh = make_mesh(jax.devices()[:6], data_parallelism=3)
+    members = [_member(f"m{i}", 20, i) for i in range(2)]
+    results = FleetTrainer(mesh=mesh).train(
+        members, FitConfig(epochs=1, batch_size=32, shuffle=False)
+    )
+    assert all(np.isfinite(r.history.history["loss"]).all() for r in results)
+
+
+def test_val_weights_without_train_weights():
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 3).astype(np.float32)
+    val_mask = np.zeros(64, np.float32)
+    val_mask[-16:] = 1.0
+    member = FleetMember(
+        name="m", spec=SPEC, X=X, y=X.copy(), val_weights=val_mask, seed=1
+    )
+    result = FleetTrainer().train([member], FitConfig(epochs=2, batch_size=16))[0]
+    assert "val_loss" in result.history.history
+    assert np.isfinite(result.history.history["val_loss"]).all()
+
+
+def test_no_val_member_has_no_val_history():
+    member = _member("m", 64, 2)
+    result = FleetTrainer().train(
+        [member], FitConfig(epochs=2, batch_size=16, validation_split=0.0)
+    )[0]
+    assert "val_loss" not in result.history.history

@@ -1,0 +1,186 @@
+import numpy as np
+import pytest
+
+from gordo_tpu import serializer
+from gordo_tpu.machine import Machine
+from gordo_tpu.parallel import FleetBuilder, fleet_build
+
+DATASET = {
+    "type": "RandomDataset",
+    "train_start_date": "2020-01-01T00:00:00+00:00",
+    "train_end_date": "2020-01-05T00:00:00+00:00",
+}
+
+DETECTOR_MODEL = {
+    "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "sklearn.pipeline.Pipeline": {
+                "steps": [
+                    "sklearn.preprocessing.MinMaxScaler",
+                    {
+                        "gordo_tpu.models.JaxAutoEncoder": {
+                            "kind": "feedforward_hourglass",
+                            "encoding_layers": 1,
+                            "epochs": 2,
+                        }
+                    },
+                ]
+            }
+        }
+    }
+}
+
+
+def make_machine(name, tags, model=None):
+    return Machine.from_config(
+        {
+            "name": name,
+            "model": model or DETECTOR_MODEL,
+            "dataset": {**DATASET, "tag_list": tags},
+        },
+        project_name="fleet-test",
+    )
+
+
+def test_fleet_build_detectors(tmp_path):
+    # two machines share an architecture bucket (same tag count), one differs
+    machines = [
+        make_machine("m-a", ["t1", "t2", "t3"]),
+        make_machine("m-b", ["t4", "t5", "t6"]),
+        make_machine("m-c", ["t7", "t8"]),
+    ]
+    results = fleet_build(machines, output_dir=str(tmp_path))
+    assert len(results) == 3
+    for model, machine in results:
+        assert hasattr(model, "anomaly")
+        assert model.aggregate_threshold_ is not None
+        assert len(model.feature_thresholds_) == len(
+            machine.dataset.tag_list
+        )
+        bm = machine.metadata.build_metadata
+        assert bm.model.model_offset == 0
+        scores = bm.model.cross_validation.scores
+        n_tags = len(machine.dataset.tag_list)
+        assert len(scores) == 4 * (n_tags + 1)
+        assert {"fold-mean", "fold-std", "fold-1", "fold-2", "fold-3"} <= set(
+            scores["explained-variance-score"]
+        )
+        # artifacts on disk, loadable, servable
+        loaded = serializer.load(str(tmp_path / machine.name))
+        X, y = machine.dataset.get_data()
+        frame = loaded.anomaly(X, y)
+        assert len(frame) == len(X)
+
+
+def test_fleet_build_matches_model_builder_thresholds():
+    """Fleet CV must produce the same thresholds as the sequential
+    ModelBuilder path for the same machine."""
+    from gordo_tpu.builder import ModelBuilder
+
+    machine = make_machine("parity", ["t1", "t2"])
+    fleet_model, _ = fleet_build([make_machine("parity", ["t1", "t2"])])[0]
+    seq_model, _ = ModelBuilder(machine).build()
+    np.testing.assert_allclose(
+        fleet_model.feature_thresholds_.values.astype(float),
+        seq_model.feature_thresholds_.values.astype(float),
+        rtol=0.2,
+    )
+    np.testing.assert_allclose(
+        fleet_model.aggregate_threshold_, seq_model.aggregate_threshold_, rtol=0.2
+    )
+
+
+def test_fleet_build_lstm():
+    model_def = {
+        "gordo_tpu.models.JaxLSTMAutoEncoder": {
+            "kind": "lstm_symmetric",
+            "dims": [4],
+            "funcs": ["tanh"],
+            "lookback_window": 4,
+            "epochs": 1,
+        }
+    }
+    results = fleet_build([make_machine("lstm-m", ["t1", "t2"], model=model_def)])
+    model, machine = results[0]
+    assert machine.metadata.build_metadata.model.model_offset == 3
+    X, _ = machine.dataset.get_data()
+    assert len(model.predict(X)) == len(X) - 3
+
+
+def test_fleet_build_fallback_for_non_jax_models():
+    model_def = {
+        "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+            "base_estimator": "sklearn.linear_model.LinearRegression"
+        }
+    }
+    results = fleet_build([make_machine("sk-m", ["t1", "t2"], model=model_def)])
+    model, machine = results[0]
+    assert model.aggregate_threshold_ is not None
+    assert machine.metadata.build_metadata.model.model_training_duration_sec > 0
+
+
+def test_cross_val_only_mode():
+    machine = Machine.from_config(
+        {
+            "name": "cv-only",
+            "model": DETECTOR_MODEL,
+            "dataset": {**DATASET, "tag_list": ["t1", "t2"]},
+            "evaluation": {"cv_mode": "cross_val_only"},
+        },
+        project_name="fleet-test",
+    )
+    model, built = fleet_build([machine])[0]
+    assert built.metadata.build_metadata.model.cross_validation.scores
+    assert built.metadata.build_metadata.model.model_training_duration_sec == 0.0
+
+
+def test_fleet_kfcv_matches_sequential():
+    """KFCV thresholds: fleet chronological stitching must track the
+    sequential path (same folds, same smoothing order)."""
+    from gordo_tpu.builder import ModelBuilder
+
+    model_def = {
+        "gordo_tpu.models.anomaly.diff.DiffBasedKFCVAnomalyDetector": {
+            "base_estimator": {
+                "gordo_tpu.models.JaxAutoEncoder": {
+                    "kind": "feedforward_hourglass",
+                    "encoding_layers": 1,
+                    "epochs": 2,
+                }
+            },
+            "window": 12,
+        }
+    }
+    fleet_model, _ = fleet_build(
+        [make_machine("kfcv-m", ["t1", "t2"], model=model_def)]
+    )[0]
+    seq_model, _ = ModelBuilder(
+        make_machine("kfcv-m", ["t1", "t2"], model=model_def)
+    ).build()
+    np.testing.assert_allclose(
+        fleet_model.aggregate_threshold_, seq_model.aggregate_threshold_, rtol=0.35
+    )
+    np.testing.assert_allclose(
+        np.asarray(fleet_model.feature_thresholds_, dtype=float),
+        np.asarray(seq_model.feature_thresholds_, dtype=float),
+        rtol=0.35,
+    )
+
+
+def test_smoothed_threshold_metadata_present():
+    model_def = {
+        "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "gordo_tpu.models.JaxAutoEncoder": {
+                    "kind": "feedforward_hourglass",
+                    "encoding_layers": 1,
+                    "epochs": 1,
+                }
+            },
+            "window": 12,
+        }
+    }
+    model, _ = fleet_build([make_machine("sm-m", ["t1", "t2"], model=model_def)])[0]
+    meta = model.get_metadata()
+    assert "smooth-feature-thresholds-per-fold" in meta
+    assert "smooth-aggregate-thresholds-per-fold" in meta
