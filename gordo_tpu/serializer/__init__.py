@@ -4,9 +4,22 @@ from .from_definition import (
     load_params_from_definition,
 )
 from .into_definition import into_definition
-from .serializer import dump, dumps, load, load_info, load_metadata, loads
+from .serializer import (
+    INFO_FILE,
+    METADATA_FILE,
+    MODEL_FILE,
+    dump,
+    dumps,
+    load,
+    load_info,
+    load_metadata,
+    loads,
+)
 
 __all__ = [
+    "MODEL_FILE",
+    "METADATA_FILE",
+    "INFO_FILE",
     "from_definition",
     "into_definition",
     "load_params_from_definition",

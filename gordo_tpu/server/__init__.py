@@ -1,0 +1,3 @@
+from .app import GordoServerApp, adapt_proxy_deployment, build_app, run_server
+
+__all__ = ["GordoServerApp", "adapt_proxy_deployment", "build_app", "run_server"]

@@ -1,0 +1,410 @@
+"""
+The model server: a werkzeug-native WSGI application.
+
+Reference parity: gordo/server/server.py — same env-driven config
+(``MODEL_COLLECTION_DIR``, ``EXPECTED_MODELS``, ``ENABLE_PROMETHEUS``,
+``PROJECT``), Envoy/Ambassador prefix-rewrite middleware, per-request
+revision resolution from ``?revision=``/header with 410 on a missing
+revision, revision stamped into every JSON body and response header,
+``Server-Timing`` header, ``/healthcheck`` and ``/server-version`` routes,
+plus the base + anomaly route sets.
+
+Engine difference: Flask isn't a dependency here — routing is a werkzeug
+``Map`` and per-request state is an explicit :class:`RequestContext` passed
+to handlers instead of the ``flask.g`` ambient global. The JSON encoder is
+simplejson with ``ignore_nan`` so NaN heads of smoothed anomaly columns
+serialize as null.
+"""
+
+import logging
+import os
+import timeit
+import typing
+from functools import wraps
+from typing import Any, Dict, Optional
+
+import simplejson
+import yaml
+from werkzeug.exceptions import HTTPException
+from werkzeug.routing import Map, Rule
+from werkzeug.wrappers import Request, Response
+
+import gordo_tpu
+
+from . import utils as server_utils
+from .utils import ServerError
+from .views import anomaly, base
+
+logger = logging.getLogger(__name__)
+
+
+def enable_prometheus() -> bool:
+    return os.getenv("ENABLE_PROMETHEUS", "false") != "false"
+
+
+def default_config() -> Dict[str, Any]:
+    """Server config resolved from the environment (reference server.py:36-43)."""
+    return {
+        "MODEL_COLLECTION_DIR_ENV_VAR": "MODEL_COLLECTION_DIR",
+        "EXPECTED_MODELS": yaml.safe_load(os.getenv("EXPECTED_MODELS", "[]")),
+        "ENABLE_PROMETHEUS": enable_prometheus(),
+        "PROJECT": os.getenv("PROJECT"),
+    }
+
+
+class RequestContext:
+    """
+    Per-request state: the request, resolved revision/collection dir, and
+    whatever the handlers load (model, metadata, X, y). The explicit
+    equivalent of the reference's ``flask.g``.
+    """
+
+    __slots__ = (
+        "request",
+        "config",
+        "start_time",
+        "collection_dir",
+        "current_revision",
+        "revision",
+        "model",
+        "metadata",
+        "info",
+        "X",
+        "y",
+    )
+
+    def __init__(self, request: Request, config: Dict[str, Any]):
+        self.request = request
+        self.config = config
+        self.start_time = timeit.default_timer()
+        self.collection_dir: Optional[str] = None
+        self.current_revision: Optional[str] = None
+        self.revision: Optional[str] = None
+        self.model = None
+        self.metadata: Optional[dict] = None
+        self.info: Optional[dict] = None
+        self.X = None
+        self.y = None
+
+    # -- response builders --------------------------------------------------
+
+    def json_response(self, payload: dict, status: int = 200) -> Response:
+        # Revision is stamped here, at serialization time, rather than by
+        # re-parsing the body in an after-request hook: prediction payloads
+        # can be multi-MB and a loads/dumps round-trip would triple the
+        # serialization cost of the hot path.
+        if self.revision is not None and isinstance(payload, dict):
+            payload = {**payload, "revision": self.revision}
+        body = simplejson.dumps(payload, default=str, ignore_nan=True)
+        return Response(body, status=status, mimetype="application/json")
+
+    def file_response(
+        self, data: bytes, download_name: Optional[str] = None
+    ) -> Response:
+        response = Response(data, mimetype="application/octet-stream")
+        if download_name:
+            response.headers["Content-Disposition"] = (
+                f"attachment; filename={download_name}"
+            )
+        return response
+
+
+def adapt_proxy_deployment(wsgi_app: typing.Callable) -> typing.Callable:
+    """
+    WSGI middleware fixing behind-proxy routing on k8s/Envoy: the proxy
+    forwards the full prefixed path (``/gordo/v0/<project>/<name>/metadata``)
+    in ``HTTP_X_ENVOY_ORIGINAL_PATH`` while ``PATH_INFO`` holds the local
+    route; reconstruct ``SCRIPT_NAME``/``PATH_INFO`` accordingly
+    (reference server.py:46-118).
+    """
+
+    @wraps(wsgi_app)
+    def wrapper(environ, start_response):
+        script_name = environ.get("HTTP_X_ENVOY_ORIGINAL_PATH", "")
+        if script_name:
+            path_info = environ.get("PATH_INFO", "")
+            if path_info.rstrip("/"):
+                script_name = script_name.replace(path_info, "")
+            environ["SCRIPT_NAME"] = script_name
+            if path_info.startswith(script_name):
+                environ["PATH_INFO"] = path_info[len(script_name):]
+
+        scheme = environ.get("HTTP_X_FORWARDED_PROTO", "")
+        if scheme:
+            environ["wsgi.url_scheme"] = scheme
+        return wsgi_app(environ, start_response)
+
+    return wrapper
+
+
+PREFIX = "/gordo/v0"
+
+URL_MAP = Map(
+    [
+        Rule("/healthcheck", endpoint="healthcheck", methods=["GET"]),
+        Rule("/server-version", endpoint="server-version", methods=["GET"]),
+        Rule(
+            f"{PREFIX}/<gordo_project>/<gordo_name>/prediction",
+            endpoint="prediction",
+            methods=["POST"],
+        ),
+        Rule(
+            f"{PREFIX}/<gordo_project>/<gordo_name>/anomaly/prediction",
+            endpoint="anomaly-prediction",
+            methods=["POST"],
+        ),
+        Rule(
+            f"{PREFIX}/<gordo_project>/<gordo_name>/metadata",
+            endpoint="metadata",
+            methods=["GET"],
+        ),
+        Rule(
+            f"{PREFIX}/<gordo_project>/<gordo_name>/healthcheck",
+            endpoint="model-healthcheck",
+            methods=["GET"],
+        ),
+        Rule(
+            f"{PREFIX}/<gordo_project>/<gordo_name>/download-model",
+            endpoint="download-model",
+            methods=["GET"],
+        ),
+        Rule(
+            f"{PREFIX}/<gordo_project>/<gordo_name>/revision/<revision>",
+            endpoint="delete-revision",
+            methods=["DELETE"],
+        ),
+        Rule(f"{PREFIX}/<gordo_project>/models", endpoint="models", methods=["GET"]),
+        Rule(
+            f"{PREFIX}/<gordo_project>/revisions",
+            endpoint="revisions",
+            methods=["GET"],
+        ),
+        Rule(
+            f"{PREFIX}/<gordo_project>/expected-models",
+            endpoint="expected-models",
+            methods=["GET"],
+        ),
+    ],
+    strict_slashes=False,
+)
+
+HANDLERS = {
+    "prediction": base.post_prediction,
+    "anomaly-prediction": anomaly.post_anomaly_prediction,
+    "metadata": base.get_metadata,
+    "model-healthcheck": base.get_metadata,
+    "download-model": base.get_download_model,
+    "delete-revision": base.delete_model_revision,
+    "models": base.get_model_list,
+    "revisions": base.get_revision_list,
+    "expected-models": base.get_expected_models,
+}
+
+
+class GordoServerApp:
+    """The WSGI application serving a model-collection directory."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        self.config = default_config()
+        if config is not None:
+            self.config.update(config)
+        self.prometheus_metrics = None
+
+    # -- request lifecycle --------------------------------------------------
+
+    def _resolve_revision(self, ctx: RequestContext) -> Optional[Response]:
+        """
+        Point the context at the served (or requested) revision directory;
+        410 for bad/missing revisions (reference server.py:169-195).
+        """
+        ctx.collection_dir = os.environ[self.config["MODEL_COLLECTION_DIR_ENV_VAR"]]
+        ctx.current_revision = os.path.basename(ctx.collection_dir)
+
+        request = ctx.request
+        revision = request.args.get("revision") or request.headers.get("revision")
+        if revision:
+            ctx.revision = revision
+            if not server_utils.validate_revision(revision):
+                return ctx.json_response(
+                    {"error": "Revision should only contains numbers."}, status=410
+                )
+            ctx.collection_dir = os.path.join(ctx.collection_dir, "..", revision)
+            try:
+                os.listdir(ctx.collection_dir)
+            except FileNotFoundError:
+                return ctx.json_response(
+                    {"error": f"Revision '{revision}' not found."}, status=410
+                )
+        else:
+            ctx.revision = ctx.current_revision
+        return None
+
+    def _finalize(self, ctx: RequestContext, response: Response) -> Response:
+        """Stamp the revision header and add Server-Timing."""
+        if ctx.revision is not None:
+            response.headers["revision"] = ctx.revision
+
+        runtime_s = timeit.default_timer() - ctx.start_time
+        logger.debug("Total runtime for request: %ss", runtime_s)
+        response.headers["Server-Timing"] = f"request_walltime_s;dur={runtime_s}"
+        return response
+
+    def dispatch(self, request: Request) -> Response:
+        ctx = RequestContext(request, self.config)
+        try:
+            endpoint_adapter = URL_MAP.bind_to_environ(request.environ)
+            endpoint, view_args = endpoint_adapter.match()
+
+            if endpoint == "healthcheck":
+                response = Response("", status=200)
+                return self._finalize(ctx, response)
+            if endpoint == "server-version":
+                response = ctx.json_response({"version": gordo_tpu.__version__})
+                return self._finalize(ctx, response)
+
+            error_response = self._resolve_revision(ctx)
+            if error_response is not None:
+                return self._finalize(ctx, error_response)
+
+            response = HANDLERS[endpoint](ctx, **view_args)
+        except ServerError as exc:
+            response = ctx.json_response(exc.payload, status=exc.status)
+        except HTTPException as exc:
+            response = ctx.json_response(
+                {"error": exc.description}, status=exc.code or 500
+            )
+        except Exception:
+            logger.exception("Unhandled server error")
+            response = ctx.json_response({"error": "Internal Server Error"}, status=500)
+        return self._finalize(ctx, response)
+
+    def wsgi_app(self, environ, start_response):
+        request = Request(environ)
+        start = timeit.default_timer()
+        response = self.dispatch(request)
+        if self.prometheus_metrics is not None:
+            self.prometheus_metrics.observe(
+                request, response, timeit.default_timer() - start
+            )
+        return response(environ, start_response)
+
+    def __call__(self, environ, start_response):
+        return self._wsgi_entry(environ, start_response)
+
+    # build_app replaces this per-instance with the proxy-adapted entry.
+    _wsgi_entry = wsgi_app
+
+
+def build_app(
+    config: Optional[Dict[str, Any]] = None,
+    prometheus_registry=None,
+) -> GordoServerApp:
+    """
+    Build the server application with proxy adaptation applied and, when
+    enabled, prometheus request metrics.
+    """
+    app = GordoServerApp(config)
+    app._wsgi_entry = adapt_proxy_deployment(app.wsgi_app)
+
+    if app.config["ENABLE_PROMETHEUS"]:
+        from .prometheus.metrics import create_prometheus_metrics
+
+        app.prometheus_metrics = create_prometheus_metrics(
+            project=app.config.get("PROJECT"), registry=prometheus_registry
+        )
+    elif prometheus_registry is not None:
+        logger.warning("Ignoring non empty prometheus_registry argument")
+    return app
+
+
+# -- process runner ---------------------------------------------------------
+
+
+def build_gunicorn_cmd(
+    host: str,
+    port: int,
+    workers: int,
+    log_level: str,
+    config_module: Optional[str] = None,
+    worker_connections: Optional[int] = None,
+    threads: Optional[int] = None,
+    worker_class: str = "gthread",
+    server_app: str = "gordo_tpu.server.app:build_app()",
+) -> list:
+    """The gunicorn argv the reference would exec (server.py:240-304)."""
+    cmd = [
+        "gunicorn",
+        "--bind",
+        f"{host}:{port}",
+        "--log-level",
+        log_level,
+        "--error-logfile",
+        "-",
+        "--access-logfile",
+        "-",
+        "--worker-class",
+        worker_class,
+        "--worker-tmp-dir",
+        "/dev/shm",
+        "--workers",
+        str(workers),
+    ]
+    if config_module is not None:
+        cmd.extend(("--config", "python:" + config_module))
+    if worker_class == "gthread":
+        if threads is not None:
+            cmd.extend(("--threads", str(threads)))
+    else:
+        if worker_connections is not None:
+            cmd.extend(("--worker-connections", str(worker_connections)))
+    cmd.append(server_app)
+    return cmd
+
+
+def run_cmd(cmd):
+    """Run a shell command, surfacing stderr on stdout."""
+    import subprocess
+
+    subprocess.check_call(cmd, stderr=subprocess.STDOUT)
+
+
+def run_server(
+    host: str,
+    port: int,
+    workers: int,
+    log_level: str,
+    config_module: Optional[str] = None,
+    worker_connections: Optional[int] = None,
+    threads: Optional[int] = None,
+    worker_class: str = "gthread",
+    server_app: str = "gordo_tpu.server.app:build_app()",
+):
+    """
+    Serve via gunicorn when installed (production parity with the
+    reference); otherwise fall back to werkzeug's threaded server — models
+    live on an accelerator, so thread workers sharing the one in-process
+    JAX runtime is the natural single-host deployment anyway.
+    """
+    import shutil as _shutil
+
+    if _shutil.which("gunicorn"):
+        run_cmd(
+            build_gunicorn_cmd(
+                host=host,
+                port=port,
+                workers=workers,
+                log_level=log_level,
+                config_module=config_module,
+                worker_connections=worker_connections,
+                threads=threads,
+                worker_class=worker_class,
+                server_app=server_app,
+            )
+        )
+        return
+
+    logger.warning("gunicorn not found; serving with werkzeug (threaded)")
+    from werkzeug.serving import run_simple
+
+    logging.getLogger().setLevel(log_level.upper())
+    run_simple(host, port, build_app(), threaded=True)

@@ -1,0 +1,24 @@
+"""
+Model invocation for the serving path (reference: gordo/server/model_io.py).
+"""
+
+import logging
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def get_model_output(model, X) -> np.ndarray:
+    """
+    Raw model output for ``X``: try ``predict``, fall back to ``transform``
+    (the model may be a bare transformer pipeline).
+    """
+    try:
+        return model.predict(X)
+    except AttributeError:
+        try:
+            return model.transform(X)
+        except Exception as exc:
+            logger.error("Failed to predict or transform; error: %s", exc)
+            raise

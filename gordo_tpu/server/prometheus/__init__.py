@@ -1,0 +1,3 @@
+from .metrics import GordoServerPrometheusMetrics, create_prometheus_metrics
+
+__all__ = ["GordoServerPrometheusMetrics", "create_prometheus_metrics"]

@@ -1,0 +1,131 @@
+"""
+Prometheus request metrics for the model server.
+
+Reference parity: gordo/server/prometheus/metrics.py — request counter and
+duration histogram labeled (method, path rule, status, gordo model name,
+project, version), with multiprocess-registry support so gunicorn's worker
+fleet aggregates into one scrape target.
+"""
+
+import logging
+import os
+import re
+from typing import List, Optional, Tuple
+
+from prometheus_client import (
+    REGISTRY,
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+)
+
+import gordo_tpu
+
+logger = logging.getLogger(__name__)
+
+# Extract the model name from a request path under the API prefix:
+# /gordo/v0/<project>/<name>/...
+_MODEL_PATH_RE = re.compile(r"^/gordo/v0/(?P<project>[^/]+)/(?P<name>[^/]+)(?:/|$)")
+
+# Routes that would only add scrape noise.
+DEFAULT_IGNORE_PATHS = ("/healthcheck",)
+
+PROJECT_LEVEL_ROUTES = ("models", "revisions", "expected-models")
+
+
+def multiprocess_registry() -> Optional[CollectorRegistry]:
+    """
+    A multiprocess collector registry when ``PROMETHEUS_MULTIPROC_DIR`` is
+    configured (gunicorn worker fan-in), else None.
+    """
+    if os.getenv("PROMETHEUS_MULTIPROC_DIR") or os.getenv("prometheus_multiproc_dir"):
+        from prometheus_client import multiprocess
+
+        registry = CollectorRegistry()
+        multiprocess.MultiProcessCollector(registry)
+        return registry
+    return None
+
+
+class GordoServerPrometheusMetrics:
+    """Request count + latency histogram keyed by route/model/status."""
+
+    def __init__(
+        self,
+        project: Optional[str] = None,
+        ignore_paths: Tuple[str, ...] = DEFAULT_IGNORE_PATHS,
+        registry: Optional[CollectorRegistry] = None,
+    ):
+        self.project = project
+        self.ignore_paths = tuple(ignore_paths)
+        self.registry = registry if registry is not None else REGISTRY
+
+        label_names = ["method", "path", "status_code", "gordo_name", "project"]
+        self.request_count = Counter(
+            "gordo_server_requests_total",
+            "Total number of requests to the gordo model server",
+            labelnames=label_names,
+            registry=self.registry,
+        )
+        self.request_duration = Histogram(
+            "gordo_server_request_duration_seconds",
+            "Request processing wall-time",
+            labelnames=label_names,
+            registry=self.registry,
+        )
+        self.info = Gauge(
+            "gordo_server_info",
+            "Server build information",
+            labelnames=["version", "project"],
+            registry=self.registry,
+            multiprocess_mode="max",
+        )
+        self.info.labels(
+            version=gordo_tpu.__version__, project=project or ""
+        ).set(1)
+
+    def _labels(self, request, response) -> Optional[dict]:
+        path = request.path
+        if path in self.ignore_paths:
+            return None
+        gordo_name = ""
+        project = self.project or ""
+        match = _MODEL_PATH_RE.match(path)
+        if match:
+            project = project or match.group("project")
+            name = match.group("name")
+            if name not in PROJECT_LEVEL_ROUTES:
+                gordo_name = name
+                # Collapse the per-model path to its route shape so label
+                # cardinality stays bounded by route count, not model count;
+                # revision IDs are collapsed for the same reason.
+                path = _MODEL_PATH_RE.sub("/gordo/v0/{project}/{name}/", path, count=1)
+                path = re.sub(r"revision/\d+$", "revision/{revision}", path)
+            else:
+                path = _MODEL_PATH_RE.sub("/gordo/v0/{project}/" + name, path, count=1)
+        elif path not in ("/healthcheck", "/server-version"):
+            # Unmatched paths (scanners, typos) must not mint timeseries.
+            path = "{unmatched}"
+        return {
+            "method": request.method,
+            "path": path,
+            "status_code": str(response.status_code),
+            "gordo_name": gordo_name,
+            "project": project,
+        }
+
+    def observe(self, request, response, duration_s: float):
+        labels = self._labels(request, response)
+        if labels is None:
+            return
+        self.request_count.labels(**labels).inc()
+        self.request_duration.labels(**labels).observe(duration_s)
+
+
+def create_prometheus_metrics(
+    project: Optional[str] = None, registry: Optional[CollectorRegistry] = None
+) -> GordoServerPrometheusMetrics:
+    if registry is None:
+        registry = multiprocess_registry() or REGISTRY
+    return GordoServerPrometheusMetrics(project=project, registry=registry)

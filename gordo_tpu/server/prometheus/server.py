@@ -1,0 +1,28 @@
+"""
+A tiny standalone ``/metrics`` WSGI app, mountable as a second server the
+way the reference mounts its metrics Flask app beside the model server
+(gordo/server/prometheus/server.py).
+"""
+
+from typing import Optional
+
+from prometheus_client import REGISTRY, CollectorRegistry, generate_latest
+from werkzeug.wrappers import Request, Response
+
+from .metrics import multiprocess_registry
+
+
+def build_metrics_app(registry: Optional[CollectorRegistry] = None):
+    """WSGI app answering Prometheus scrapes at ``/metrics`` (and ``/``)."""
+    if registry is None:
+        registry = multiprocess_registry() or REGISTRY
+
+    def app(environ, start_response):
+        request = Request(environ)
+        if request.path.rstrip("/") in ("", "/metrics"):
+            response = Response(generate_latest(registry), mimetype="text/plain")
+        else:
+            response = Response("Not Found", status=404)
+        return response(environ, start_response)
+
+    return app

@@ -1,0 +1,42 @@
+"""
+Pull tag lists and train resolution out of served machine metadata.
+
+Reference parity: gordo/server/properties.py — ``get_tags`` /
+``get_target_tags`` resolve the dataset's configured tag lists (with asset
+defaulting) and ``get_frequency`` the training resolution, all from the
+metadata document saved beside the model.
+"""
+
+from typing import List, Optional
+
+import pandas as pd
+
+from ..dataset.sensor_tag import SensorTag, normalize_sensor_tags
+
+
+def get_frequency(ctx):
+    """The training resolution as a pandas offset (reference :45-49)."""
+    return pd.tseries.frequencies.to_offset(ctx.metadata["dataset"]["resolution"])
+
+
+def _dataset_asset(dataset: dict) -> Optional[str]:
+    """Default asset for bare-string tags (reference :62-69)."""
+    default_tag = dataset.get("default_tag")
+    if isinstance(default_tag, dict) and default_tag.get("asset"):
+        return default_tag["asset"]
+    return dataset.get("asset") or None
+
+
+def get_tags(ctx) -> List[SensorTag]:
+    """The model's input tags."""
+    dataset = ctx.metadata["dataset"]
+    return normalize_sensor_tags(dataset["tag_list"], asset=_dataset_asset(dataset))
+
+
+def get_target_tags(ctx) -> List[SensorTag]:
+    """The model's target tags; defaults to the input tags."""
+    dataset = ctx.metadata["dataset"]
+    target_tag_list = dataset.get("target_tag_list")
+    if target_tag_list:
+        return normalize_sensor_tags(target_tag_list, asset=_dataset_asset(dataset))
+    return get_tags(ctx)

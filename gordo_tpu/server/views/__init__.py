@@ -1,0 +1,3 @@
+from . import anomaly, base
+
+__all__ = ["base", "anomaly"]

@@ -1,0 +1,63 @@
+"""
+Anomaly route: ``POST /gordo/v0/<project>/<name>/anomaly/prediction``.
+
+Reference parity: gordo/server/blueprints/anomaly.py — requires ``y``,
+calls ``model.anomaly(X, y, frequency)``, 422 when the served model is not
+an anomaly detector, drops the ``smooth-*`` columns unless ``?all_columns``,
+answers JSON or parquet.
+"""
+
+import logging
+import timeit
+from typing import Any, Dict
+
+from .. import utils as server_utils
+from ..properties import get_frequency
+
+logger = logging.getLogger(__name__)
+
+DELETED_FROM_RESPONSE_COLUMNS = (
+    "smooth-tag-anomaly-scaled",
+    "smooth-total-anomaly-scaled",
+    "smooth-tag-anomaly-unscaled",
+    "smooth-total-anomaly-unscaled",
+)
+
+
+def post_anomaly_prediction(ctx, gordo_project: str, gordo_name: str):
+    start_time = timeit.default_timer()
+    server_utils.require_model(ctx, gordo_name)
+    server_utils.extract_X_y(ctx)
+
+    if ctx.y is None:
+        return ctx.json_response(
+            {"message": "Cannot perform anomaly without 'y' to compare against."},
+            status=400,
+        )
+
+    try:
+        anomaly_df = ctx.model.anomaly(ctx.X, ctx.y, frequency=get_frequency(ctx))
+    except AttributeError:
+        return ctx.json_response(
+            {
+                "message": "Model is not an AnomalyDetector, it is of type: "
+                f"{type(ctx.model)}"
+            },
+            status=422,
+        )
+
+    if ctx.request.args.get("all_columns") is None:
+        columns_for_delete = [
+            column
+            for column in anomaly_df
+            if column[0] in DELETED_FROM_RESPONSE_COLUMNS
+        ]
+        anomaly_df = anomaly_df.drop(columns=columns_for_delete)
+
+    if ctx.request.args.get("format") == "parquet":
+        return ctx.file_response(server_utils.dataframe_into_parquet_bytes(anomaly_df))
+
+    context: Dict[Any, Any] = dict()
+    context["data"] = server_utils.dataframe_to_dict(anomaly_df)
+    context["time-seconds"] = f"{timeit.default_timer() - start_time:.4f}"
+    return ctx.json_response(context)

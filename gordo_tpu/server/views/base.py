@@ -1,0 +1,140 @@
+"""
+Base model routes under ``/gordo/v0/<project>/...``.
+
+Reference parity: gordo/server/blueprints/base.py — POST prediction, GET
+metadata/healthcheck, GET download-model (pickle stream), GET models, GET
+revisions, GET expected-models, DELETE revision/<revision>.
+"""
+
+import logging
+import os
+import timeit
+import traceback
+from typing import Any, Dict
+
+import pandas as pd
+
+import gordo_tpu
+from ... import serializer
+from ...models import utils as model_utils
+from .. import model_io
+from .. import utils as server_utils
+from ..properties import get_tags, get_target_tags
+
+logger = logging.getLogger(__name__)
+
+
+def post_prediction(ctx, gordo_project: str, gordo_name: str):
+    """
+    Run the model on client-provided ``X`` and answer the
+    start/end/model-input/model-output response frame as JSON (or parquet
+    with ``?format=parquet``).
+    """
+    server_utils.require_model(ctx, gordo_name)
+    server_utils.extract_X_y(ctx)
+
+    context: Dict[Any, Any] = dict()
+    X = ctx.X
+    process_request_start_time_s = timeit.default_timer()
+
+    try:
+        output = model_io.get_model_output(model=ctx.model, X=X)
+    except ValueError as err:
+        logger.error(
+            "Failed to predict or transform; error: %s - \nTraceback: %s",
+            err,
+            traceback.format_exc(),
+        )
+        context["error"] = f"ValueError: {str(err)}"
+        return ctx.json_response(context, status=400)
+    except Exception as exc:
+        logger.error(
+            "Failed to predict or transform; error: %s - \nTraceback: %s",
+            exc,
+            traceback.format_exc(),
+        )
+        context["error"] = "Something unexpected happened; check your input data"
+        return ctx.json_response(context, status=400)
+
+    logger.debug(
+        "Calculating model output took %.4fs",
+        timeit.default_timer() - process_request_start_time_s,
+    )
+    data = model_utils.make_base_dataframe(
+        tags=get_tags(ctx),
+        model_input=X.values if isinstance(X, pd.DataFrame) else X,
+        model_output=output,
+        target_tag_list=get_target_tags(ctx),
+        index=X.index,
+    )
+    if ctx.request.args.get("format") == "parquet":
+        return ctx.file_response(server_utils.dataframe_into_parquet_bytes(data))
+    context["data"] = server_utils.dataframe_to_dict(data)
+    return ctx.json_response(context)
+
+
+def delete_model_revision(ctx, gordo_project: str, gordo_name: str, revision: str):
+    """Delete a (non-current) model revision from disk."""
+    server_utils.validate_gordo_name(gordo_name)
+    if not server_utils.validate_revision(revision):
+        return ctx.json_response(
+            {"error": "Revision should only contains numbers."}, status=422
+        )
+    if revision == ctx.current_revision:
+        return ctx.json_response(
+            {"error": "Unable to delete current revision."}, status=409
+        )
+    revision_dir = os.path.join(ctx.collection_dir, "..", revision)
+    server_utils.delete_revision(revision_dir, gordo_name)
+    return ctx.json_response({"ok": True}, status=200)
+
+
+def get_metadata(ctx, gordo_project: str, gordo_name: str):
+    """Model metadata; doubles as the per-model healthcheck route."""
+    server_utils.require_metadata(ctx, gordo_name)
+    model_collection_env_var = ctx.config["MODEL_COLLECTION_DIR_ENV_VAR"]
+    metadata = dict(ctx.info) if ctx.info else {}
+    metadata.update(
+        {
+            "gordo-server-version": gordo_tpu.__version__,
+            "metadata": ctx.metadata,
+            "env": {model_collection_env_var: os.environ.get(model_collection_env_var)},
+        }
+    )
+    return ctx.json_response(metadata)
+
+
+def get_download_model(ctx, gordo_project: str, gordo_name: str):
+    """The serialized current model (``serializer.dumps`` wire format)."""
+    server_utils.require_model(ctx, gordo_name)
+    return ctx.file_response(serializer.dumps(ctx.model), download_name="model.pickle")
+
+
+def get_model_list(ctx, gordo_project: str):
+    """Names of models currently available from the served revision."""
+    try:
+        available_models = os.listdir(ctx.collection_dir)
+    except FileNotFoundError:
+        available_models = []
+    return ctx.json_response({"models": available_models})
+
+
+def get_revision_list(ctx, gordo_project: str):
+    """All revisions present on disk, plus which one is latest."""
+    try:
+        available_revisions = os.listdir(os.path.join(ctx.collection_dir, ".."))
+    except FileNotFoundError:
+        logger.error(
+            "Attempted to list directories above %s but failed with: %s",
+            ctx.collection_dir,
+            traceback.format_exc(),
+        )
+        available_revisions = [ctx.current_revision]
+    return ctx.json_response(
+        {"latest": ctx.current_revision, "available-revisions": available_revisions}
+    )
+
+
+def get_expected_models(ctx, gordo_project: str):
+    """The project's configured (expected-to-be-built) model names."""
+    return ctx.json_response({"expected-models": ctx.config["EXPECTED_MODELS"]})

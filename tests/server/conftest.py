@@ -1,0 +1,116 @@
+"""
+Server fixtures: a trained model-collection directory (two anomaly models +
+one plain transformer-style model) served by the WSGI app through
+werkzeug's test client — the in-process "deployed system" of SURVEY.md §3.5.
+"""
+
+import contextlib
+import os
+
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu import serializer
+from gordo_tpu.builder import local_build
+from gordo_tpu.server import build_app
+
+PROJECT = "test-project"
+REVISION = "1602324482000"
+OLD_REVISION = "1602324482001"
+
+CONFIG = """
+machines:
+  - name: machine-1
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-05T00:00:00+00:00"
+      tag_list: [tag-1, tag-2, tag-3, tag-4]
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.JaxAutoEncoder:
+            kind: feedforward_model
+            encoding_dim: [8, 4]
+            encoding_func: [tanh, tanh]
+            decoding_dim: [4, 8]
+            decoding_func: [tanh, tanh]
+            epochs: 1
+  - name: machine-2
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-05T00:00:00+00:00"
+      tag_list: [tag-1, tag-2]
+    model:
+      gordo_tpu.models.JaxAutoEncoder:
+        kind: feedforward_hourglass
+        compression_factor: 0.5
+        encoding_layers: 1
+        epochs: 1
+"""
+
+
+@contextlib.contextmanager
+def temp_env_vars(**kwargs):
+    """Set environment variables for the duration of the block."""
+    originals = {key: os.environ.get(key) for key in kwargs}
+    os.environ.update({k: str(v) for k, v in kwargs.items()})
+    try:
+        yield
+    finally:
+        for key, original in originals.items():
+            if original is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = original
+
+
+@pytest.fixture(scope="session")
+def model_collection_root(tmp_path_factory):
+    """
+    ``<root>/<revision>/<machine-name>/{model.pkl,metadata.json,info.json}``
+    for two revisions (the older one only holds machine-1).
+    """
+    root = tmp_path_factory.mktemp("model-collection")
+    builds = list(local_build(CONFIG, project_name=PROJECT))
+    for model, machine in builds:
+        out_dir = root / REVISION / machine.name
+        serializer.dump(model, str(out_dir), metadata=machine.to_dict())
+    # An older revision with just machine-1, for revision routing/deletion.
+    model, machine = builds[0]
+    serializer.dump(
+        model, str(root / OLD_REVISION / machine.name), metadata=machine.to_dict()
+    )
+    return root
+
+
+@pytest.fixture(scope="session")
+def collection_dir(model_collection_root):
+    return str(model_collection_root / REVISION)
+
+
+@pytest.fixture
+def client(collection_dir):
+    with temp_env_vars(MODEL_COLLECTION_DIR=collection_dir):
+        app = build_app(
+            config={"EXPECTED_MODELS": ["machine-1", "machine-2"]}
+        )
+        yield Client(app)
+
+
+@pytest.fixture(scope="session")
+def sensor_payload(model_collection_root):
+    """A valid JSON X/y payload matching machine-1's four tags."""
+    index = [
+        "2020-03-01T00:00:00+00:00",
+        "2020-03-01T00:10:00+00:00",
+        "2020-03-01T00:20:00+00:00",
+        "2020-03-01T00:30:00+00:00",
+        "2020-03-01T00:40:00+00:00",
+    ]
+    values = {
+        f"tag-{i}": {ts: 0.1 * i + 0.01 * j for j, ts in enumerate(index)}
+        for i in range(1, 5)
+    }
+    return {"X": values, "y": values}

@@ -1,0 +1,52 @@
+"""Prometheus request-metrics tests (reference: gordo/server/prometheus/)."""
+
+from prometheus_client import CollectorRegistry
+from werkzeug.test import Client
+
+from gordo_tpu.server import build_app
+from gordo_tpu.server.prometheus.server import build_metrics_app
+
+
+def test_request_metrics_collected(client, collection_dir, monkeypatch):
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", collection_dir)
+    registry = CollectorRegistry()
+    app = build_app(
+        config={"ENABLE_PROMETHEUS": True, "PROJECT": "test-project"},
+        prometheus_registry=registry,
+    )
+    c = Client(app)
+    assert c.get("/gordo/v0/test-project/machine-1/metadata").status_code == 200
+    # healthcheck is in ignore_paths and must not be counted
+    assert c.get("/healthcheck").status_code == 200
+
+    count = registry.get_sample_value(
+        "gordo_server_requests_total",
+        {
+            "method": "GET",
+            "path": "/gordo/v0/{project}/{name}/metadata",
+            "status_code": "200",
+            "gordo_name": "machine-1",
+            "project": "test-project",
+        },
+    )
+    assert count == 1
+    info = registry.get_sample_value(
+        "gordo_server_info",
+        {"version": __import__("gordo_tpu").__version__, "project": "test-project"},
+    )
+    assert info == 1
+    # the /healthcheck hit was ignored: no sample with that path exists
+    assert not any(
+        sample.labels.get("path") == "/healthcheck"
+        for metric in registry.collect()
+        for sample in metric.samples
+    )
+
+
+def test_metrics_app_serves_scrape():
+    registry = CollectorRegistry()
+    app = build_metrics_app(registry=registry)
+    c = Client(app)
+    resp = c.get("/metrics")
+    assert resp.status_code == 200
+    assert c.get("/nope").status_code == 404
