@@ -1,0 +1,6 @@
+"""``python -m gordo_tpu`` — the CLI entry point."""
+
+from gordo_tpu.cli import gordo_tpu_cli
+
+if __name__ == "__main__":
+    gordo_tpu_cli()

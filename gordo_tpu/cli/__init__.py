@@ -1,0 +1,3 @@
+from .cli import gordo_tpu_cli
+
+__all__ = ["gordo_tpu_cli"]

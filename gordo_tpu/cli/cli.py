@@ -1,0 +1,435 @@
+"""
+The ``gordo-tpu`` CLI.
+
+Reference parity: gordo/cli/cli.py — subcommands ``build`` (env-var driven
+the way an orchestrated build pod invokes it: ``MACHINE``, ``OUTPUT_DIR``,
+``MODEL_REGISTER_DIR``), ``run-server``, and ``workflow`` (see
+workflow_generator.py). The build command jinja-expands
+``--model-parameter`` values into string model templates, freezes model
+defaults by round-tripping the config through the serializer, reports the
+built machine, optionally prints CV scores for hyperparameter tuners, and
+maps exceptions to exit codes with a JSON report written for the k8s
+termination-message path.
+
+(The reference's ``if "err" in machine.name`` crash at cli.py:156-157 is
+planted fault code, deliberately not reproduced — SURVEY.md preamble.)
+"""
+
+import logging
+import sys
+import traceback
+from typing import Any, List, Optional, Tuple, cast
+
+import click
+import jinja2
+import yaml
+
+import gordo_tpu
+from ..builder.utils import create_model_builder
+from .. import serializer
+from ..dataset.exceptions import (
+    ConfigException,
+    InsufficientDataError,
+    NoSuitableDataProviderError,
+)
+from ..dataset.sensor_tag import SensorTagNormalizationError
+from ..machine import Machine, load_model_config
+from ..reporters.base import ReporterException
+from ..server import run_server
+from .custom_types import HostIP, key_value_par
+from .exceptions_reporter import ExceptionsReporter, ReportLevel
+from .workflow_generator import workflow_cli
+
+_exceptions_reporter = ExceptionsReporter(
+    (
+        (Exception, 1),
+        (ValueError, 2),
+        (PermissionError, 20),
+        (FileNotFoundError, 30),
+        (SensorTagNormalizationError, 60),
+        (NoSuitableDataProviderError, 70),
+        (InsufficientDataError, 80),
+        (ImportError, 85),
+        (ReporterException, 90),
+        (ConfigException, 100),
+    )
+)
+
+logger = logging.getLogger(__name__)
+
+
+@click.group("gordo-tpu")
+@click.version_option(version=gordo_tpu.__version__, message=gordo_tpu.__version__)
+@click.option(
+    "--log-level",
+    type=str,
+    default="INFO",
+    help="Run with custom log-level.",
+    envvar="GORDO_LOG_LEVEL",
+)
+@click.pass_context
+def gordo_tpu_cli(gordo_ctx: click.Context, **ctx):
+    """The gordo-tpu command line interface."""
+    logging.basicConfig(
+        level=getattr(logging, str(gordo_ctx.params.get("log_level")).upper()),
+        format=(
+            "[%(asctime)s] %(levelname)s "
+            "[%(name)s.%(funcName)s:%(lineno)d] %(message)s"
+        ),
+    )
+    gordo_ctx.obj = gordo_ctx.params
+
+
+@click.command()
+@click.argument("machine-config", envvar="MACHINE", type=yaml.safe_load)
+@click.argument("output-dir", default="/data", envvar="OUTPUT_DIR")
+@click.option(
+    "--model-register-dir",
+    default=None,
+    envvar="MODEL_REGISTER_DIR",
+    type=click.Path(
+        exists=False, file_okay=False, dir_okay=True, writable=True, readable=True
+    ),
+)
+@click.option(
+    "--model-builder-class",
+    help="ModelBuilder class import path; must subclass "
+    "gordo_tpu.builder.build_model.ModelBuilder",
+    envvar="MODEL_BUILDER_CLASS",
+)
+@click.option(
+    "--print-cv-scores", help="Prints CV scores to stdout", is_flag=True, default=False
+)
+@click.option(
+    "--model-parameter",
+    type=key_value_par,
+    multiple=True,
+    default=(),
+    help="Key-value pair for a model parameter, separated by a comma; may be "
+    "given multiple times: --model-parameter key,val",
+)
+@click.option(
+    "--exceptions-reporter-file",
+    envvar="EXCEPTIONS_REPORTER_FILE",
+    help="JSON output file for exception information",
+)
+@click.option(
+    "--exceptions-report-level",
+    type=click.Choice(ReportLevel.get_names(), case_sensitive=False),
+    default=ReportLevel.MESSAGE.name,
+    envvar="EXCEPTIONS_REPORT_LEVEL",
+    help="Detail level for exception reporting",
+)
+def build(
+    machine_config: dict,
+    output_dir: str,
+    model_register_dir: click.Path,
+    model_builder_class: str,
+    print_cv_scores: bool,
+    model_parameter: List[Tuple[str, Any]],
+    exceptions_reporter_file: str,
+    exceptions_report_level: str,
+):
+    """Build a model and deposit it into OUTPUT_DIR."""
+    try:
+        if model_parameter and isinstance(machine_config["model"], str):
+            parameters = dict(model_parameter)
+            machine_config["model"] = expand_model(machine_config["model"], parameters)
+
+        machine: Machine = Machine.from_config(
+            cast(dict, load_model_config(machine_config)),
+            project_name=machine_config["project_name"],
+        )
+
+        logger.info("Building, output will be at: %s", output_dir)
+        logger.info("Register dir: %s", model_register_dir)
+
+        # Round-trip the model config through the serializer so every
+        # default parameter is frozen into the stored definition.
+        logger.debug("Ensuring the passed model config is fully expanded.")
+        machine.model = serializer.into_definition(
+            serializer.from_definition(machine.model)
+        )
+
+        cls = create_model_builder(model_builder_class)
+        builder = cls(machine=machine)
+
+        _, machine_out = builder.build(output_dir, model_register_dir)
+
+        logger.debug("Reporting built machine.")
+        machine_out.report()
+        logger.debug("Finished reporting.")
+
+        if print_cv_scores:
+            for score in get_all_score_strings(machine_out):
+                print(score)
+
+    except Exception:
+        traceback.print_exc()
+        exc_type, exc_value, exc_traceback = sys.exc_info()
+
+        exit_code = _exceptions_reporter.exception_exit_code(exc_type)
+        if exceptions_reporter_file:
+            _exceptions_reporter.safe_report(
+                cast(
+                    ReportLevel,
+                    ReportLevel.get_by_name(
+                        exceptions_report_level, ReportLevel.EXIT_CODE
+                    ),
+                ),
+                exc_type,
+                exc_value,
+                exc_traceback,
+                exceptions_reporter_file,
+                # k8s termination messages cap at 2024 bytes; leave headroom
+                # for the JSON envelope.
+                max_message_len=2024 - 500,
+            )
+        sys.exit(exit_code)
+    else:
+        return 0
+
+
+def expand_model(model_config: str, model_parameters: dict) -> dict:
+    """
+    Expand a jinja-templated model config string with ``model_parameters``;
+    undefined variables are an error.
+    """
+    try:
+        model_template = jinja2.Environment(
+            loader=jinja2.BaseLoader(), undefined=jinja2.StrictUndefined
+        ).from_string(model_config)
+        model_config = model_template.render(**model_parameters)
+    except jinja2.exceptions.UndefinedError as e:
+        raise ValueError("Model parameter missing value!") from e
+    logger.info("Expanded model config: %s", model_config)
+    return yaml.safe_load(model_config)
+
+
+def get_all_score_strings(machine) -> List[str]:
+    """
+    CV scores as ``{metric}_{fold}={value}`` lines — the stdout format
+    hyperparameter tuners (Katib) scrape from the build pod's log.
+    """
+    all_scores = []
+    for (
+        metric_name,
+        scores,
+    ) in machine.metadata.build_metadata.model.cross_validation.scores.items():
+        metric_name = metric_name.replace(" ", "-")
+        for score_name, score_val in scores.items():
+            score_name = score_name.replace(" ", "-")
+            all_scores.append(f"{metric_name}_{score_name}={score_val}")
+    return all_scores
+
+
+@click.command("run-server")
+@click.option(
+    "--host",
+    type=HostIP(),
+    help="The host to run the server on.",
+    default="0.0.0.0",
+    envvar="GORDO_SERVER_HOST",
+    show_default=True,
+)
+@click.option(
+    "--port",
+    type=click.IntRange(1, 65535),
+    help="The port to run the server on.",
+    default=5555,
+    envvar="GORDO_SERVER_PORT",
+    show_default=True,
+)
+@click.option(
+    "--workers",
+    type=click.IntRange(1, 4),
+    help="The number of worker processes for handling requests.",
+    default=2,
+    envvar="GORDO_SERVER_WORKERS",
+    show_default=True,
+)
+@click.option(
+    "--worker-connections",
+    type=click.IntRange(1, 4000),
+    help="The maximum number of simultaneous clients per worker process.",
+    default=50,
+    envvar="GORDO_SERVER_WORKER_CONNECTIONS",
+    show_default=True,
+)
+@click.option(
+    "--threads",
+    type=int,
+    help="The number of worker threads for handling requests "
+    "(only with --worker-class=gthread).",
+    default=8,
+    envvar="GORDO_SERVER_THREADS",
+)
+@click.option(
+    "--worker-class",
+    help="The type of workers to use.",
+    default="gthread",
+    envvar="GORDO_SERVER_WORKER_CLASS",
+    show_default=True,
+)
+@click.option(
+    "--log-level",
+    type=click.Choice(["debug", "info", "warning", "error", "critical"]),
+    help="The log level for the server.",
+    default="debug",
+    envvar="GORDO_SERVER_LOG_LEVEL",
+    show_default=True,
+)
+@click.option(
+    "--server-app",
+    help="The application to run",
+    default="gordo_tpu.server.app:build_app()",
+    envvar="GORDO_SERVER_APP",
+    show_default=True,
+)
+@click.option(
+    "--with-prometheus-config",
+    help="Run with custom config for prometheus",
+    is_flag=True,
+)
+def run_server_cli(
+    host,
+    port,
+    workers,
+    worker_connections,
+    threads,
+    worker_class,
+    log_level,
+    server_app,
+    with_prometheus_config,
+):
+    """Run the model server."""
+    config_module = None
+    if with_prometheus_config:
+        config_module = "gordo_tpu.server.prometheus.gunicorn_config"
+    run_server(
+        host,
+        port,
+        workers,
+        log_level.lower(),
+        config_module=config_module,
+        worker_connections=worker_connections,
+        threads=threads,
+        worker_class=worker_class,
+        server_app=server_app,
+    )
+
+
+@click.command("build-fleet")
+@click.argument("machines-config", envvar="MACHINES_CONFIG")
+@click.argument("output-dir", default="/data", envvar="OUTPUT_DIR")
+@click.option(
+    "--model-register-dir",
+    default=None,
+    envvar="MODEL_REGISTER_DIR",
+    type=click.Path(
+        exists=False, file_okay=False, dir_okay=True, writable=True, readable=True
+    ),
+)
+@click.option(
+    "--exceptions-reporter-file",
+    envvar="EXCEPTIONS_REPORTER_FILE",
+    help="JSON output file for exception information",
+)
+@click.option(
+    "--exceptions-report-level",
+    type=click.Choice(ReportLevel.get_names(), case_sensitive=False),
+    default=ReportLevel.MESSAGE.name,
+    envvar="EXCEPTIONS_REPORT_LEVEL",
+    help="Detail level for exception reporting",
+)
+def build_fleet(
+    machines_config: str,
+    output_dir: str,
+    model_register_dir: Optional[str],
+    exceptions_reporter_file: str,
+    exceptions_report_level: str,
+):
+    """
+    Train a whole machine shard as mesh-sharded model batches on this TPU
+    slice — the entry point each fleet-builder Job pod runs (the TPU-native
+    replacement for the reference's one-`build`-pod-per-machine fan-out).
+
+    MACHINES_CONFIG is a path to (or literal YAML of) a document with a
+    ``machines:`` list of fully-resolved machine dicts, as emitted into the
+    workflow's ConfigMaps by ``workflow generate``.
+    """
+    import os
+
+    try:
+        _maybe_init_distributed()
+
+        if os.path.isfile(machines_config):
+            with open(machines_config) as f:
+                config = yaml.safe_load(f)
+        else:
+            config = yaml.safe_load(machines_config)
+        machines = [Machine.from_dict(m) for m in config["machines"]]
+
+        from ..parallel.fleet_build import FleetBuilder
+
+        logger.info(
+            "Fleet-building %d machines; output at %s", len(machines), output_dir
+        )
+        results = FleetBuilder(machines).build(
+            output_dir, model_register_dir=model_register_dir
+        )
+        for _, machine_out in results:
+            machine_out.report()
+        logger.info("Fleet build of %d machines complete", len(results))
+    except Exception:
+        traceback.print_exc()
+        exc_type, exc_value, exc_traceback = sys.exc_info()
+        exit_code = _exceptions_reporter.exception_exit_code(exc_type)
+        if exceptions_reporter_file:
+            _exceptions_reporter.safe_report(
+                cast(
+                    ReportLevel,
+                    ReportLevel.get_by_name(
+                        exceptions_report_level, ReportLevel.EXIT_CODE
+                    ),
+                ),
+                exc_type,
+                exc_value,
+                exc_traceback,
+                exceptions_reporter_file,
+                max_message_len=2024 - 500,
+            )
+        sys.exit(exit_code)
+
+
+def _maybe_init_distributed():
+    """
+    Join the slice-wide jax.distributed mesh when launched as one pod of a
+    multi-host fleet-builder Job (env injected by the workflow template).
+    """
+    import os
+
+    process_count = int(os.getenv("JAX_PROCESS_COUNT", "1"))
+    if process_count > 1:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+            num_processes=process_count,
+            process_id=int(os.environ["JAX_PROCESS_INDEX"]),
+        )
+        logger.info(
+            "jax.distributed initialized: process %s of %s",
+            os.environ["JAX_PROCESS_INDEX"],
+            process_count,
+        )
+
+
+gordo_tpu_cli.add_command(workflow_cli)
+gordo_tpu_cli.add_command(build)
+gordo_tpu_cli.add_command(build_fleet)
+gordo_tpu_cli.add_command(run_server_cli)
+
+
+if __name__ == "__main__":
+    gordo_tpu_cli()

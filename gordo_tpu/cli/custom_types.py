@@ -1,0 +1,76 @@
+"""
+Click parameter types (reference: gordo/cli/custom_types.py): JSON
+validated against a pydantic schema, regex-validated strings, host IPs,
+and ``key,value`` pairs.
+"""
+
+import ipaddress
+import json
+import re
+from typing import Any, Generic, Optional, Tuple, Type, TypeVar
+
+import click
+from pydantic import TypeAdapter, ValidationError
+
+T = TypeVar("T")
+
+
+class JSONParam(click.ParamType, Generic[T]):
+    """Parse JSON and validate it against a pydantic schema."""
+
+    name = "JSON"
+
+    def __init__(self, schema: Type[T]):
+        self.schema = schema
+        self._adapter = TypeAdapter(schema)
+
+    def convert(
+        self, value: Any, param: Optional[click.Parameter], ctx: Optional[click.Context]
+    ) -> Optional[T]:
+        if value is None:
+            return None
+        try:
+            data = json.loads(value)
+        except json.JSONDecodeError as e:
+            self.fail("Malformed JSON string - %s" % str(e))
+        try:
+            return self._adapter.validate_python(data)
+        except ValidationError as e:
+            self.fail("Schema validation error - %s" % str(e))
+
+
+class REParam(click.ParamType):
+    """Validate an argument against a regular expression."""
+
+    name = "REGEXP"
+
+    def __init__(self, pattern: str):
+        self.pattern = pattern
+        self.re = re.compile(pattern)
+
+    def convert(
+        self, value: Any, param: Optional[click.Parameter], ctx: Optional[click.Context]
+    ):
+        if not self.re.match(value):
+            self.fail("Value '%s' not match '%s'" % (value, self.pattern))
+        return value
+
+
+class HostIP(click.ParamType):
+    """Validate the input is an IP address."""
+
+    name = "host"
+
+    def convert(
+        self, value: Any, param: Optional[click.Parameter], ctx: Optional[click.Context]
+    ):
+        try:
+            ipaddress.ip_address(value)
+            return value
+        except ValueError as e:
+            self.fail(str(e))
+
+
+def key_value_par(val) -> Tuple[str, str]:
+    """Split a CLI ``key,value`` pair."""
+    return val.split(",")

@@ -1,0 +1,568 @@
+"""
+``gordo-tpu workflow generate``: project config → deployable k8s manifests.
+
+Reference parity: gordo/cli/workflow_generator.py — same front-end
+(NormalizedConfig with globals defaulting and per-machine validation),
+same config-surface options (split-workflows, HPA type k8s_cpu/keda with
+prometheus query templating, labels JSON, security contexts, owner
+references, builder exception report level, reporter auto-injection).
+
+Engine difference: the emitter targets the TPU fleet plane — machines are
+grouped into shard-batches, one k8s Job per TPU slice running
+``build-fleet`` — instead of one Argo pod per machine; and there is no
+``argo`` binary dependency at all (the reference shells out to detect the
+argo version; our manifests are plain k8s).
+"""
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple, cast
+
+import click
+import yaml
+from jinja2 import BaseLoader, Environment
+
+import gordo_tpu
+from ..cli.exceptions_reporter import ReportLevel
+from ..machine.encoders import MachineJSONEncoder
+from ..utils.version import parse_version
+from ..workflow.config_elements.normalized_config import NormalizedConfig
+from ..workflow.config_elements.schemas import (
+    EnvVar,
+    PodSecurityContext,
+    SecurityContext,
+)
+from ..workflow.workflow_generator import workflow_generator as wg
+from ..workflow.workflow_generator.tpu import gke_accelerator_label, slice_geometry
+from .custom_types import JSONParam
+
+logger = logging.getLogger(__name__)
+
+PREFIX = "WORKFLOW_GENERATOR"
+DEFAULT_BUILDER_EXCEPTIONS_REPORT_LEVEL = ReportLevel.TRACEBACK
+
+ML_SERVER_HPA_TYPES = ["none", "k8s_cpu", "keda"]
+DEFAULT_ML_SERVER_HPA_TYPE = "k8s_cpu"
+
+DEFAULT_KEDA_PROMETHEUS_METRIC_NAME = "gordo_server_request_duration_seconds_count"
+DEFAULT_KEDA_PROMETHEUS_QUERY = (
+    "sum(rate(gordo_server_request_duration_seconds_count"
+    '{project=~"{{project_name}}",path=~".*prediction"}[30s]))'
+)
+DEFAULT_KEDA_PROMETHEUS_THRESHOLD = "1.0"
+DEFAULT_CUSTOM_MODEL_BUILDER_ENVS = "[]"
+
+KEDA_PROMETHEUS_QUERY_ARGS = ["project_name"]
+
+
+def get_builder_exceptions_report_level(config: NormalizedConfig) -> ReportLevel:
+    orig_report_level = None
+    try:
+        orig_report_level = config.globals["runtime"]["builder"][
+            "exceptions_report_level"
+        ]
+    except KeyError:
+        pass
+    if orig_report_level is not None:
+        report_level = ReportLevel.get_by_name(orig_report_level)
+        if report_level is None:
+            raise ValueError(
+                "Invalid 'runtime.builder.exceptions_report_level' value '%s'"
+                % orig_report_level
+            )
+    else:
+        report_level = DEFAULT_BUILDER_EXCEPTIONS_REPORT_LEVEL
+    return report_level
+
+
+def validate_generate_context(context):
+    if context["ml_server_hpa_type"] == "keda":
+        if not context["with_keda"]:
+            raise click.ClickException(
+                '"--ml-server-hpa-type=keda" is only supported with the '
+                '"--with-keda" flag'
+            )
+        if not context["prometheus_server_address"]:
+            raise click.ClickException(
+                "--prometheus-server-address should be specified for "
+                '"--ml-server-hpa-type=keda"'
+            )
+
+
+def prepare_keda_prometheus_query(context):
+    keda_prometheus_query = context["keda_prometheus_query"]
+    if keda_prometheus_query:
+        template = Environment(loader=BaseLoader()).from_string(keda_prometheus_query)
+        kwargs = {k: context[k] for k in KEDA_PROMETHEUS_QUERY_ARGS}
+        return template.render(**kwargs)
+    return keda_prometheus_query
+
+
+def prepare_resources_labels(
+    value: str, argument: str = "--resources-labels"
+) -> List[Tuple[str, Any]]:
+    resources_labels: List[Tuple[str, Any]] = []
+    if value:
+        try:
+            json_value = json.loads(value)
+        except json.JSONDecodeError as e:
+            raise click.ClickException(
+                '"%s=%s" contains invalid JSON value: %s' % (argument, value, str(e))
+            )
+        if isinstance(json_value, dict):
+            resources_labels = list(json_value.items())
+        else:
+            raise click.ClickException(
+                '"%s=%s" contains value with type %s instead of dict'
+                % (argument, value, type(json_value).__name__)
+            )
+    return resources_labels
+
+
+def _k8s_resources(resources: Dict[str, Dict[str, int]]) -> Dict[str, Dict[str, str]]:
+    """Config resource ints (MB / millicores) → k8s quantity strings."""
+    return {
+        bound: {
+            "memory": f"{values['memory']}M",
+            "cpu": f"{values['cpu']}m",
+        }
+        for bound, values in resources.items()
+        if bound in ("requests", "limits")
+    }
+
+
+def _machines_yaml(machines) -> str:
+    """A machine shard as the YAML document ``build-fleet`` consumes."""
+    dicts = [
+        json.loads(json.dumps(machine.to_dict(), cls=MachineJSONEncoder))
+        for machine in machines
+    ]
+    return yaml.safe_dump({"machines": dicts}, default_flow_style=False)
+
+
+@click.group("workflow")
+@click.pass_context
+def workflow_cli(gordo_ctx):
+    pass
+
+
+@click.command("generate")
+@click.option(
+    "--machine-config",
+    type=str,
+    help="Machine configuration file",
+    envvar=f"{PREFIX}_MACHINE_CONFIG",
+    required=True,
+)
+@click.option("--workflow-template", type=str, help="Template to expand")
+@click.option(
+    "--owner-references",
+    type=wg._valid_owner_ref,
+    default=None,
+    allow_from_autoenv=True,
+    help="Kubernetes owner references to inject into all created resources. "
+    "Should be a nonempty yaml/json list of owner-references, each a dict "
+    "containing at least the keys 'uid', 'name', 'kind', and 'apiVersion'",
+    envvar=f"{PREFIX}_OWNER_REFERENCES",
+)
+@click.option(
+    "--gordo-version",
+    type=str,
+    default=wg._docker_friendly_version(gordo_tpu.__version__),
+    help="Version of gordo-tpu to use, if different than this one",
+    envvar=f"{PREFIX}_GORDO_VERSION",
+)
+@click.option(
+    "--project-name",
+    type=str,
+    help="Name of the project which owns the workflow.",
+    allow_from_autoenv=True,
+    envvar=f"{PREFIX}_PROJECT_NAME",
+    required=True,
+)
+@click.option(
+    "--project-revision",
+    type=str,
+    default=str(int(time.time() * 1000)),  # unix time milliseconds
+    help="Revision of the project which owns the workflow.",
+    envvar=f"{PREFIX}_PROJECT_REVISION",
+)
+@click.option(
+    "--output-file",
+    type=str,
+    required=False,
+    help="Optional file to render to",
+    envvar=f"{PREFIX}_OUTPUT_FILE",
+)
+@click.option(
+    "--namespace",
+    type=str,
+    default="kubeflow",
+    help="Which namespace to deploy services into",
+    envvar=f"{PREFIX}_NAMESPACE",
+)
+@click.option(
+    "--split-workflows",
+    type=int,
+    default=30,
+    help="Split configs containing more than this number of machines into "
+    "several workflow documents, output sequentially with '---' between, "
+    "so kubectl can apply them all at once.",
+    envvar=f"{PREFIX}_SPLIT_WORKFLOWS",
+)
+@click.option(
+    "--n-servers",
+    type=int,
+    default=None,
+    help="Max number of ML Servers to use, defaults to N machines * 10",
+    envvar=f"{PREFIX}_N_SERVERS",
+)
+@click.option(
+    "--docker-repository",
+    type=str,
+    default="equinor",
+    help="The docker repo to use for pulling component images from",
+    envvar=f"{PREFIX}_DOCKER_REPOSITORY",
+)
+@click.option(
+    "--docker-registry",
+    type=str,
+    default="ghcr.io",
+    help="The docker registry to use for pulling component images from",
+    envvar=f"{PREFIX}_DOCKER_REGISTRY",
+)
+@click.option(
+    "--retry-backoff-limit",
+    type=int,
+    default=6,
+    help="backoffLimit for fleet-builder Jobs (k8s-native retry; replaces "
+    "the reference's Argo retryStrategy backoff)",
+    envvar=f"{PREFIX}_RETRY_BACKOFF_LIMIT",
+)
+@click.option(
+    "--gordo-server-workers",
+    type=int,
+    help="The number of worker processes for handling server requests.",
+    envvar=f"{PREFIX}_GORDO_SERVER_WORKERS",
+)
+@click.option(
+    "--gordo-server-threads",
+    type=int,
+    help="The number of worker threads for handling requests.",
+    envvar=f"{PREFIX}_GORDO_SERVER_THREADS",
+)
+@click.option(
+    "--gordo-server-probe-timeout",
+    type=int,
+    help="timeoutSeconds for liveness/readiness probes of the server",
+    envvar=f"{PREFIX}_GORDO_SERVER_PROBE_TIMEOUT",
+)
+@click.option(
+    "--without-prometheus",
+    is_flag=True,
+    help="Do not deploy Prometheus metrics for server monitoring",
+    envvar=f"{PREFIX}_WITHOUT_PROMETHEUS",
+)
+@click.option(
+    "--image-pull-policy",
+    help="Default imagePullPolicy for all images",
+    envvar=f"{PREFIX}_IMAGE_PULL_POLICY",
+)
+@click.option(
+    "--with-keda",
+    is_flag=True,
+    help="Enable support for the KEDA autoscaler",
+    envvar=f"{PREFIX}_WITH_KEDA",
+)
+@click.option(
+    "--ml-server-hpa-type",
+    help="HPA type for the ML server",
+    envvar=f"{PREFIX}_ML_SERVER_HPA_TYPE",
+    type=click.Choice(ML_SERVER_HPA_TYPES),
+    default=DEFAULT_ML_SERVER_HPA_TYPE,
+)
+@click.option(
+    "--custom-model-builder-envs",
+    help="JSON list of custom environment variables for the fleet builder",
+    envvar=f"{PREFIX}_CUSTOM_MODEL_BUILDER_ENVS",
+    default=DEFAULT_CUSTOM_MODEL_BUILDER_ENVS,
+    type=JSONParam(List[EnvVar]),
+)
+@click.option(
+    "--prometheus-server-address",
+    help='Prometheus url. Required for "--ml-server-hpa-type=keda"',
+    envvar=f"{PREFIX}_PROMETHEUS_SERVER_ADDRESS",
+)
+@click.option(
+    "--keda-prometheus-metric-name",
+    help="metricName value for the KEDA prometheus scaler",
+    envvar=f"{PREFIX}_KEDA_PROMETHEUS_METRIC_NAME",
+    default=DEFAULT_KEDA_PROMETHEUS_METRIC_NAME,
+)
+@click.option(
+    "--keda-prometheus-query",
+    help="query value for the KEDA prometheus scaler",
+    envvar=f"{PREFIX}_KEDA_PROMETHEUS_QUERY",
+    default=DEFAULT_KEDA_PROMETHEUS_QUERY,
+)
+@click.option(
+    "--keda-prometheus-threshold",
+    help="threshold value for the KEDA prometheus scaler",
+    envvar=f"{PREFIX}_KEDA_PROMETHEUS_THRESHOLD",
+    default=DEFAULT_KEDA_PROMETHEUS_THRESHOLD,
+)
+@click.option(
+    "--resources-labels",
+    help="Additional labels for resources, as a JSON dict",
+    envvar=f"{PREFIX}_RESOURCE_LABELS",
+    default="",
+)
+@click.option(
+    "--model-builder-labels",
+    help="Additional labels for fleet-builder Jobs, as a JSON dict",
+    envvar=f"{PREFIX}_MODEL_BUILDER_LABELS",
+    default="",
+)
+@click.option(
+    "--server-labels",
+    help="Additional labels for the server, as a JSON dict",
+    envvar=f"{PREFIX}_SERVER_LABELS",
+    default="",
+)
+@click.option(
+    "--server-termination-grace-period",
+    help="terminationGracePeriodSeconds for the server",
+    envvar=f"{PREFIX}_SERVER_TERMINATION_GRACE_PERIOD",
+    type=int,
+    default=60,
+)
+@click.option(
+    "--server-target-cpu-utilization-percentage",
+    help="targetCPUUtilizationPercentage for the server's HPA",
+    envvar=f"{PREFIX}_SERVER_TARGET_CPU_UTILIZATION_PERCENTAGE",
+    type=int,
+    default=50,
+)
+@click.option(
+    "--gordo-server-readiness-initial-delay",
+    help="initialDelaySeconds for the server's readinessProbe",
+    envvar=f"{PREFIX}_GORDO_SERVER_READINESS_INITIAL_DELAY",
+    type=int,
+    default=5,
+)
+@click.option(
+    "--gordo-server-liveness-initial-delay",
+    help="initialDelaySeconds for the server's livenessProbe",
+    envvar=f"{PREFIX}_GORDO_SERVER_LIVENESS_INITIAL_DELAY",
+    type=int,
+    default=600,
+)
+@click.option(
+    "--security-context",
+    help="Containers securityContext in JSON format",
+    envvar=f"{PREFIX}_SECURITY_CONTEXT",
+    type=JSONParam(SecurityContext),
+)
+@click.option(
+    "--pod-security-context",
+    help="Global workload securityContext in JSON format",
+    envvar=f"{PREFIX}_POD_SECURITY_CONTEXT",
+    type=JSONParam(PodSecurityContext),
+)
+@click.option(
+    "--model-builder-class",
+    help="ModelBuilder class",
+    envvar="MODEL_BUILDER_CLASS",
+)
+@click.option(
+    "--models-storage-size",
+    help="Size of the shared model-artifact volume",
+    envvar=f"{PREFIX}_MODELS_STORAGE_SIZE",
+    default="10Gi",
+)
+@click.pass_context
+def workflow_generator_cli(gordo_ctx, **ctx):
+    """Machine configuration to TPU fleet workflow manifests."""
+    context: Dict[Any, Any] = ctx.copy()
+    yaml_content = wg.get_dict_from_yaml(context["machine_config"])
+
+    model_builder_env = None
+    if context["custom_model_builder_envs"]:
+        custom_model_builder_envs = cast(
+            List[EnvVar], context["custom_model_builder_envs"]
+        )
+        model_builder_env = [
+            env_var.model_dump(exclude_none=True)
+            for env_var in custom_model_builder_envs
+        ]
+
+    config = NormalizedConfig(
+        yaml_content,
+        project_name=context["project_name"],
+        model_builder_env=model_builder_env,
+    )
+
+    try:
+        log_level = config.globals["runtime"]["log_level"]
+    except KeyError:
+        log_level = os.getenv(
+            "GORDO_LOG_LEVEL", (gordo_ctx.obj or {}).get("log_level", "INFO")
+        )
+    logging.getLogger("gordo_tpu").setLevel(log_level.upper())
+    context["log_level"] = log_level.upper()
+
+    validate_generate_context(context)
+
+    resources_labels = prepare_resources_labels(context["resources_labels"])
+    model_builder_labels = prepare_resources_labels(
+        context["model_builder_labels"], "--model-builder-labels"
+    )
+    server_labels = prepare_resources_labels(
+        context["server_labels"], "--server-labels"
+    )
+    # Pre-merged label dicts; the template renders them as JSON flow
+    # mappings (valid YAML) to avoid indentation-sensitive templating.
+    context["common_labels"] = {
+        "app.kubernetes.io/component": "gordo-tpu",
+        "app.kubernetes.io/managed-by": "gordo-tpu",
+        "applications.gordo.equinor.com/project-name": context["project_name"],
+        "applications.gordo.equinor.com/project-revision": context["project_revision"],
+        **dict(resources_labels),
+    }
+    context["builder_labels"] = {
+        **context["common_labels"],
+        **dict(model_builder_labels),
+    }
+    context["server_labels_merged"] = {
+        **context["common_labels"],
+        **dict(server_labels),
+    }
+
+    for key in ("pod_security_context", "security_context"):
+        if context[key]:
+            context[key] = context[key].model_dump(exclude_none=True)
+        else:
+            context.pop(key)
+
+    version = parse_version(context["gordo_version"])
+    if not context.get("image_pull_policy"):
+        context["image_pull_policy"] = wg.default_image_pull_policy(version)
+    logger.info(
+        "Generate config with gordo_version=%s and imagePullPolicy=%s",
+        context["gordo_version"],
+        context["image_pull_policy"],
+    )
+
+    context["max_server_replicas"] = (
+        context.pop("n_servers") or len(config.machines) * 10
+    )
+
+    # Fleet-builder pod spec pieces
+    builder_runtime = config.globals["runtime"]["builder"]
+    builder_resources = builder_runtime["resources"]
+    context["model_builder_resources_requests_memory"] = builder_resources["requests"]["memory"]
+    context["model_builder_resources_requests_cpu"] = builder_resources["requests"]["cpu"]
+    context["model_builder_resources_limits_memory"] = builder_resources["limits"]["memory"]
+    context["model_builder_resources_limits_cpu"] = builder_resources["limits"]["cpu"]
+
+    builder_runtime_env = list(builder_runtime.get("env") or [])
+    if context["model_builder_class"]:
+        builder_runtime_env.append(
+            {"name": "MODEL_BUILDER_CLASS", "value": context["model_builder_class"]}
+        )
+    context["builder_runtime_env"] = builder_runtime_env
+    context["builder_volumes"] = builder_runtime.get("volumes") or []
+    context["builder_volume_mounts"] = builder_runtime.get("volumeMounts") or []
+
+    context["server_resources_k8s"] = _k8s_resources(
+        config.globals["runtime"]["server"]["resources"]
+    )
+    context["prometheus_metrics_server_resources_k8s"] = _k8s_resources(
+        config.globals["runtime"]["prometheus_metrics_server"]["resources"]
+    )
+
+    # TPU fleet geometry
+    fleet = config.globals["runtime"]["fleet"]
+    context["slice_geometry"] = slice_geometry(fleet["accelerator_type"])
+    context["tpu_accelerator_label"] = gke_accelerator_label(fleet["accelerator_type"])
+    machines_per_slice = fleet["machines_per_slice"]
+
+    context["keda_prometheus_query"] = prepare_keda_prometheus_query(context)
+
+    # Auto-attach reporters: a Postgres row per machine when influx/grafana
+    # are in play, MLflow opt-in per machine (reference cli lines 538-557).
+    enable_influx = any(
+        machine.runtime.get("influx", {}).get("enable", True)
+        for machine in config.machines
+    )
+    if enable_influx:
+        pg_reporter = {
+            "gordo_tpu.reporters.postgres.PostgresReporter": {
+                "host": f"gordo-postgres-{config.project_name}"
+            }
+        }
+        for machine in config.machines:
+            machine.runtime.setdefault("reporters", []).append(pg_reporter)
+    for machine in config.machines:
+        try:
+            enabled = machine.runtime["builder"]["remote_logging"]["enable"]
+        except KeyError:
+            continue
+        if enabled:
+            machine.runtime.setdefault("reporters", []).append(
+                "gordo_tpu.reporters.mlflow.MlFlowReporter"
+            )
+
+    context["target_names"] = [machine.name for machine in config.machines]
+
+    if context["owner_references"]:
+        context["owner_references"] = json.dumps(context["owner_references"])
+    else:
+        context.pop("owner_references")
+
+    builder_exceptions_report_level = get_builder_exceptions_report_level(config)
+    context["builder_exceptions_report_level"] = builder_exceptions_report_level.name
+    context["builder_exceptions_report_file"] = "/dev/termination-log"
+
+    if context["workflow_template"]:
+        template = wg.load_workflow_template(context["workflow_template"])
+    else:
+        template = wg.load_workflow_template(wg.default_workflow_template())
+
+    if context["output_file"]:
+        open(context["output_file"], "w").close()
+    project_workflow = 0
+    for i in range(0, len(config.machines), context["split_workflows"]):
+        logger.info(
+            "Generating workflow for machines %d to %d",
+            i,
+            i + context["split_workflows"],
+        )
+        chunk = config.machines[i : i + context["split_workflows"]]
+        context["machines"] = chunk
+        context["machine_shards"] = [
+            {"machines_yaml": _machines_yaml(chunk[j : j + machines_per_slice])}
+            for j in range(0, len(chunk), machines_per_slice)
+        ]
+        context["project_workflow"] = str(project_workflow)
+
+        if context["output_file"]:
+            s = template.stream(**context)
+            with open(context["output_file"], "a") as f:
+                if i != 0:
+                    f.write("\n---\n")
+                s.dump(f)
+        else:
+            output = template.render(**context)
+            if i != 0:
+                print("\n---\n")
+            print(output)
+        project_workflow += 1
+
+
+workflow_cli.add_command(workflow_generator_cli)
+
+if __name__ == "__main__":
+    workflow_cli()

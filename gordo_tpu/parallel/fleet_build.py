@@ -105,11 +105,43 @@ class FleetBuilder:
     # ------------------------------------------------------------------ API
 
     def build(
-        self, output_dir: Optional[str] = None
+        self,
+        output_dir: Optional[str] = None,
+        model_register_dir: Optional[str] = None,
+        replace_cache: bool = False,
     ) -> List[Tuple[Any, Machine]]:
-        """Train the whole fleet; optionally dump per-machine artifacts to
-        ``output_dir/<machine-name>/``."""
-        plans, fallbacks = self._plan_all()
+        """
+        Train the whole fleet; optionally dump per-machine artifacts to
+        ``output_dir/<machine-name>/``. With a ``model_register_dir``, the
+        content-addressed build cache applies per machine exactly as in
+        ``ModelBuilder.build`` — cache hits skip training entirely and
+        fresh builds are registered for the next run.
+        """
+        machines = self.machines
+        cached_results: List[Tuple[Any, Machine]] = []
+        if model_register_dir:
+            machines = []
+            for machine in self.machines:
+                model_builder = ModelBuilder(machine)
+                if replace_cache:
+                    model_builder.delete_cached_model(model_register_dir)
+                cached_path = model_builder.check_cache(model_register_dir)
+                if cached_path:
+                    model = serializer.load(cached_path)
+                    metadata = serializer.load_metadata(cached_path)
+                    metadata["metadata"]["user_defined"]["date_of_retrieval"] = str(
+                        datetime.datetime.now(datetime.timezone.utc)
+                    )
+                    cached_results.append((model, Machine.from_dict(metadata)))
+                else:
+                    machines.append(machine)
+            logger.info(
+                "Fleet cache: %d hits, %d to build",
+                len(cached_results),
+                len(machines),
+            )
+
+        plans, fallbacks = self._plan_all(machines)
         self._load_all_data(plans)
 
         # CV folds then final fit, bucketed across all plans at once
@@ -134,6 +166,23 @@ class FleetBuilder:
             logger.info("Fleet fallback to ModelBuilder for %s", machine.name)
             results.append(ModelBuilder(machine).build())
 
+        if model_register_dir:
+            import os
+
+            from ..utils import disk_registry
+
+            for model, machine in results:
+                model_builder = ModelBuilder(machine)
+                path = os.path.join(
+                    str(model_register_dir), "builds", model_builder.cache_key
+                )
+                os.makedirs(path, exist_ok=True)
+                serializer.dump(model, path, metadata=machine.to_dict())
+                disk_registry.write_key(
+                    model_register_dir, model_builder.cache_key, path
+                )
+
+        results = cached_results + results
         if output_dir is not None:
             import os
 
@@ -145,9 +194,11 @@ class FleetBuilder:
 
     # ------------------------------------------------------------- planning
 
-    def _plan_all(self) -> Tuple[List[_Plan], List[Machine]]:
+    def _plan_all(
+        self, machines: Optional[Sequence[Machine]] = None
+    ) -> Tuple[List[_Plan], List[Machine]]:
         plans, fallbacks = [], []
-        for machine in self.machines:
+        for machine in self.machines if machines is None else machines:
             plan = self._plan_machine(machine)
             if plan is None:
                 fallbacks.append(machine)

@@ -223,11 +223,13 @@ class GordoServerApp:
         request = ctx.request
         revision = request.args.get("revision") or request.headers.get("revision")
         if revision:
-            ctx.revision = revision
+            # Validate before adopting: a malformed revision must never be
+            # echoed into response headers (newlines would crash werkzeug).
             if not server_utils.validate_revision(revision):
                 return ctx.json_response(
                     {"error": "Revision should only contains numbers."}, status=410
                 )
+            ctx.revision = revision
             ctx.collection_dir = os.path.join(ctx.collection_dir, "..", revision)
             try:
                 os.listdir(ctx.collection_dir)

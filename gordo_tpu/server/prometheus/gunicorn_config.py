@@ -1,0 +1,13 @@
+"""
+Gunicorn config hooks for multiprocess prometheus metrics (reference:
+gordo/server/prometheus/gunicorn_config.py): dead workers' mmap'd metric
+files must be cleaned up or the multiprocess registry grows forever.
+
+Used via ``gunicorn --config python:gordo_tpu.server.prometheus.gunicorn_config``.
+"""
+
+from prometheus_client import multiprocess
+
+
+def child_exit(server, worker):
+    multiprocess.mark_process_dead(worker.pid)

@@ -39,9 +39,15 @@ def multiprocess_registry() -> Optional[CollectorRegistry]:
     A multiprocess collector registry when ``PROMETHEUS_MULTIPROC_DIR`` is
     configured (gunicorn worker fan-in), else None.
     """
-    if os.getenv("PROMETHEUS_MULTIPROC_DIR") or os.getenv("prometheus_multiproc_dir"):
+    multiproc_dir = os.getenv("PROMETHEUS_MULTIPROC_DIR") or os.getenv(
+        "prometheus_multiproc_dir"
+    )
+    if multiproc_dir:
         from prometheus_client import multiprocess
 
+        # prometheus_client crashes at first metric write if the mmap dir
+        # is missing; creating it here keeps worker startup robust.
+        os.makedirs(multiproc_dir, exist_ok=True)
         registry = CollectorRegistry()
         multiprocess.MultiProcessCollector(registry)
         return registry
@@ -57,6 +63,11 @@ class GordoServerPrometheusMetrics:
         ignore_paths: Tuple[str, ...] = DEFAULT_IGNORE_PATHS,
         registry: Optional[CollectorRegistry] = None,
     ):
+        multiproc_dir = os.getenv("PROMETHEUS_MULTIPROC_DIR") or os.getenv(
+            "prometheus_multiproc_dir"
+        )
+        if multiproc_dir:
+            os.makedirs(multiproc_dir, exist_ok=True)
         self.project = project
         self.ignore_paths = tuple(ignore_paths)
         self.registry = registry if registry is not None else REGISTRY

@@ -1,0 +1,199 @@
+"""CLI tests (reference model: tests/gordo/cli/)."""
+
+import json
+import os
+
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from gordo_tpu import serializer
+from gordo_tpu.cli import gordo_tpu_cli
+from gordo_tpu.cli.cli import expand_model, get_all_score_strings
+
+MACHINE_CONFIG = {
+    "name": "test-machine",
+    "project_name": "test-project",
+    "dataset": {
+        "type": "RandomDataset",
+        "train_start_date": "2020-01-01T00:00:00+00:00",
+        "train_end_date": "2020-01-05T00:00:00+00:00",
+        "tag_list": ["tag-1", "tag-2"],
+    },
+    "model": {
+        "gordo_tpu.models.JaxAutoEncoder": {
+            "kind": "feedforward_model",
+            "encoding_dim": [8, 4],
+            "encoding_func": ["tanh", "tanh"],
+            "decoding_dim": [4, 8],
+            "decoding_func": ["tanh", "tanh"],
+            "epochs": 1,
+        }
+    },
+}
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+def test_version(runner):
+    result = runner.invoke(gordo_tpu_cli, ["--version"])
+    assert result.exit_code == 0
+    assert result.output.strip()
+
+
+def test_build_via_env(runner, tmp_path):
+    out_dir = tmp_path / "out"
+    result = runner.invoke(
+        gordo_tpu_cli,
+        ["build"],
+        env={
+            "MACHINE": json.dumps(MACHINE_CONFIG),
+            "OUTPUT_DIR": str(out_dir),
+        },
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    assert (out_dir / "model.pkl").is_file()
+    assert (out_dir / "metadata.json").is_file()
+    metadata = serializer.load_metadata(str(out_dir))
+    assert metadata["name"] == "test-machine"
+    # Model config was round-tripped through the serializer and re-keyed by
+    # the canonical module path with its construction params preserved
+    model_def = metadata["model"]["gordo_tpu.models.estimators.JaxAutoEncoder"]
+    assert model_def["kind"] == "feedforward_model"
+    assert model_def["epochs"] == 1
+
+
+def test_build_print_cv_scores(runner, tmp_path):
+    result = runner.invoke(
+        gordo_tpu_cli,
+        ["build", "--print-cv-scores"],
+        env={
+            "MACHINE": json.dumps(MACHINE_CONFIG),
+            "OUTPUT_DIR": str(tmp_path / "out"),
+        },
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0
+    assert "explained-variance-score_fold-mean=" in result.output
+
+
+def test_build_model_parameter_expansion(runner, tmp_path):
+    config = dict(MACHINE_CONFIG)
+    config["model"] = (
+        '{"gordo_tpu.models.JaxAutoEncoder": '
+        '{"kind": "feedforward_hourglass", "epochs": {{ n_epochs }}}}'
+    )
+    result = runner.invoke(
+        gordo_tpu_cli,
+        ["build", "--model-parameter", "n_epochs,1"],
+        env={"MACHINE": json.dumps(config), "OUTPUT_DIR": str(tmp_path / "out")},
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+
+
+def test_build_exit_code_and_exception_report(runner, tmp_path):
+    config = dict(MACHINE_CONFIG)
+    # tz-naive dates → ConfigException → exit code 100
+    config["dataset"] = dict(
+        config["dataset"], train_start_date="2020-01-01", train_end_date="2020-01-05"
+    )
+    report_file = tmp_path / "exception.json"
+    result = runner.invoke(
+        gordo_tpu_cli,
+        ["build", "--exceptions-report-level", "MESSAGE"],
+        env={
+            "MACHINE": json.dumps(config),
+            "OUTPUT_DIR": str(tmp_path / "out"),
+            "EXCEPTIONS_REPORTER_FILE": str(report_file),
+        },
+    )
+    assert result.exit_code == 100
+    report = json.loads(report_file.read_text())
+    assert report["type"] == "ConfigException"
+    assert "message" in report
+
+
+def test_build_fleet(runner, tmp_path):
+    machines_yaml = yaml.safe_dump(
+        {
+            "machines": [
+                dict(MACHINE_CONFIG, name=f"fleet-m-{i}") for i in range(2)
+            ]
+        }
+    )
+    config_path = tmp_path / "machines.yaml"
+    config_path.write_text(machines_yaml)
+    out_dir = tmp_path / "out"
+    result = runner.invoke(
+        gordo_tpu_cli,
+        ["build-fleet", str(config_path), str(out_dir)],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    for i in range(2):
+        assert (out_dir / f"fleet-m-{i}" / "model.pkl").is_file()
+        metadata = serializer.load_metadata(str(out_dir / f"fleet-m-{i}"))
+        assert metadata["name"] == f"fleet-m-{i}"
+
+
+def test_build_fleet_register_cache(runner, tmp_path):
+    machines_yaml = yaml.safe_dump(
+        {"machines": [dict(MACHINE_CONFIG, name="cached-m")]}
+    )
+    config_path = tmp_path / "machines.yaml"
+    config_path.write_text(machines_yaml)
+    register = tmp_path / "register"
+
+    def run(out):
+        result = runner.invoke(
+            gordo_tpu_cli,
+            [
+                "build-fleet",
+                str(config_path),
+                str(out),
+                "--model-register-dir",
+                str(register),
+            ],
+            catch_exceptions=False,
+        )
+        assert result.exit_code == 0, result.output
+
+    run(tmp_path / "out1")
+    first = serializer.load_metadata(str(tmp_path / "out1" / "cached-m"))
+    assert (register / "builds").is_dir()
+
+    run(tmp_path / "out2")
+    second = serializer.load_metadata(str(tmp_path / "out2" / "cached-m"))
+    # Second run was a cache hit: same trained artifact, retrieval stamped
+    assert "date_of_retrieval" in second["metadata"]["user_defined"]
+    assert (
+        first["metadata"]["build_metadata"]["model"]["model_creation_date"]
+        == second["metadata"]["build_metadata"]["model"]["model_creation_date"]
+    )
+
+
+def test_expand_model():
+    expanded = expand_model(
+        '{"pkg.Model": {"depth": {{ depth }}}}', {"depth": 3}
+    )
+    assert expanded == {"pkg.Model": {"depth": 3}}
+
+
+def test_expand_model_missing_parameter():
+    with pytest.raises(ValueError, match="Model parameter missing value"):
+        expand_model('{"pkg.Model": {"depth": {{ depth }}}}', {})
+
+
+def test_get_all_score_strings_format(runner, tmp_path):
+    from gordo_tpu.builder import ModelBuilder
+    from gordo_tpu.machine import Machine
+
+    machine = Machine.from_config(MACHINE_CONFIG, project_name="test-project")
+    _, machine_out = ModelBuilder(machine).build()
+    scores = get_all_score_strings(machine_out)
+    assert any(s.startswith("r2-score_fold-1=") for s in scores)

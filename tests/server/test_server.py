@@ -97,6 +97,14 @@ def test_revision_malformed(client):
     assert "error" in resp.json
 
 
+def test_revision_with_newline_is_safe_410(client):
+    # Malformed revisions must not be echoed into headers (werkzeug would
+    # crash on the newline) — just a clean 410.
+    resp = client.get(url("machine-1/metadata"), query_string={"revision": "\nabc"})
+    assert resp.status_code == 410
+    assert "revision" not in resp.headers
+
+
 def test_revision_not_found(client):
     resp = client.get(url("machine-1/metadata"), query_string={"revision": "999999"})
     assert resp.status_code == 410

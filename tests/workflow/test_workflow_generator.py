@@ -1,0 +1,237 @@
+"""
+Workflow-generator tests: render through the real CLI and assert on the
+parsed YAML (reference model:
+tests/gordo/workflow/test_workflow_generator/ — with no fake `argo` binary
+needed, since the TPU workflow has no argo dependency).
+"""
+
+import json
+
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from gordo_tpu.cli import gordo_tpu_cli
+from gordo_tpu.workflow.workflow_generator.tpu import (
+    gke_accelerator_label,
+    slice_geometry,
+)
+
+CONFIG = """
+machines:
+  - name: machine-1
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-05T00:00:00+00:00"
+      tag_list: [tag-1, tag-2]
+    model:
+      gordo_tpu.models.JaxAutoEncoder:
+        kind: feedforward_hourglass
+        epochs: 1
+  - name: machine-2
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-05T00:00:00+00:00"
+      tag_list: [tag-1, tag-2]
+    model:
+      gordo_tpu.models.JaxAutoEncoder:
+        kind: feedforward_hourglass
+        epochs: 1
+"""
+
+
+@pytest.fixture
+def config_file(tmp_path):
+    path = tmp_path / "config.yml"
+    path.write_text(CONFIG)
+    return str(path)
+
+
+def generate(config_file, *extra_args):
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo_tpu_cli,
+        [
+            "workflow",
+            "generate",
+            "--machine-config",
+            config_file,
+            "--project-name",
+            "test-proj",
+            "--project-revision",
+            "1234567890123",
+            *extra_args,
+        ],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    return list(yaml.safe_load_all(result.output))
+
+
+def by_kind(docs, kind):
+    return [d for d in docs if d and d.get("kind") == kind]
+
+
+def test_generates_expected_documents(config_file):
+    docs = generate(config_file)
+    kinds = [d["kind"] for d in docs if d]
+    assert "PersistentVolumeClaim" in kinds
+    assert "ConfigMap" in kinds
+    assert "Job" in kinds
+    assert "Deployment" in kinds
+    assert "Service" in kinds
+    assert "HorizontalPodAutoscaler" in kinds
+
+
+def test_fleet_job_shape(config_file):
+    docs = generate(config_file)
+    (job,) = by_kind(docs, "Job")
+    geometry = slice_geometry("v5litepod-16")
+    spec = job["spec"]
+    assert spec["parallelism"] == geometry.hosts
+    assert spec["completions"] == geometry.hosts
+    assert spec["completionMode"] == "Indexed"
+    pod = spec["template"]["spec"]
+    assert (
+        pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"]
+        == gke_accelerator_label("v5litepod-16")
+    )
+    container = pod["containers"][0]
+    assert container["command"] == ["gordo-tpu"]
+    assert "build-fleet" in container["args"]
+    assert (
+        container["resources"]["limits"]["google.com/tpu"]
+        == geometry.chips_per_host
+    )
+
+
+def test_configmap_embeds_machines(config_file):
+    docs = generate(config_file)
+    (cm,) = by_kind(docs, "ConfigMap")
+    machines = yaml.safe_load(cm["data"]["machines.yaml"])["machines"]
+    assert [m["name"] for m in machines] == ["machine-1", "machine-2"]
+    assert machines[0]["project_name"] == "test-proj"
+    # Fully-validated machine dicts: model + dataset survived normalization
+    assert "gordo_tpu.models.JaxAutoEncoder" in machines[0]["model"]
+
+
+def test_machines_per_slice_sharding(tmp_path, config_file):
+    config = yaml.safe_load(CONFIG)
+    config["globals"] = {"runtime": {"fleet": {"machines_per_slice": 1}}}
+    path = tmp_path / "sharded.yml"
+    path.write_text(yaml.safe_dump(config))
+    docs = generate(str(path))
+    assert len(by_kind(docs, "Job")) == 2  # one slice Job per machine shard
+
+
+def test_split_workflows(config_file):
+    docs = generate(config_file, "--split-workflows", "1")
+    # two chunks → two PVC documents (one per rendered workflow)
+    assert len(by_kind(docs, "PersistentVolumeClaim")) == 2
+
+
+def test_server_plane(config_file):
+    docs = generate(config_file)
+    (deployment,) = by_kind(docs, "Deployment")
+    containers = deployment["spec"]["template"]["spec"]["containers"]
+    assert [c["name"] for c in containers] == ["server", "metrics"]
+    env = {e["name"]: e.get("value") for e in containers[0]["env"]}
+    assert env["PROJECT"] == "test-proj"
+    assert json.loads(env["EXPECTED_MODELS"]) == ["machine-1", "machine-2"]
+    assert "/1234567890123" in env["MODEL_COLLECTION_DIR"]
+    (hpa,) = by_kind(docs, "HorizontalPodAutoscaler")
+    assert hpa["spec"]["maxReplicas"] == 20  # 2 machines * 10
+
+
+def test_without_prometheus(config_file):
+    docs = generate(config_file, "--without-prometheus")
+    (deployment,) = by_kind(docs, "Deployment")
+    containers = deployment["spec"]["template"]["spec"]["containers"]
+    assert [c["name"] for c in containers] == ["server"]
+
+
+def test_hpa_none(config_file):
+    docs = generate(config_file, "--ml-server-hpa-type", "none")
+    assert not by_kind(docs, "HorizontalPodAutoscaler")
+
+
+def test_keda_requires_flags(config_file):
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo_tpu_cli,
+        [
+            "workflow",
+            "generate",
+            "--machine-config",
+            config_file,
+            "--project-name",
+            "test-proj",
+            "--ml-server-hpa-type",
+            "keda",
+        ],
+    )
+    assert result.exit_code != 0
+    assert "--with-keda" in result.output
+
+
+def test_keda_scaled_object(config_file):
+    docs = generate(
+        config_file,
+        "--ml-server-hpa-type",
+        "keda",
+        "--with-keda",
+        "--prometheus-server-address",
+        "http://prometheus:9090",
+    )
+    (scaled,) = by_kind(docs, "ScaledObject")
+    trigger = scaled["spec"]["triggers"][0]
+    assert trigger["type"] == "prometheus"
+    # project_name was templated into the query
+    assert 'project=~"test-proj"' in trigger["metadata"]["query"]
+
+
+def test_resources_labels_and_owner_references(config_file):
+    docs = generate(
+        config_file,
+        "--resources-labels",
+        '{"team": "abc"}',
+        "--owner-references",
+        json.dumps(
+            [{"uid": "1", "name": "n", "kind": "Deployment", "apiVersion": "v1"}]
+        ),
+    )
+    (job,) = by_kind(docs, "Job")
+    assert job["metadata"]["labels"]["team"] == "abc"
+    assert job["metadata"]["ownerReferences"][0]["uid"] == "1"
+
+
+def test_output_file(tmp_path, config_file):
+    out = tmp_path / "workflow.yml"
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo_tpu_cli,
+        [
+            "workflow",
+            "generate",
+            "--machine-config",
+            config_file,
+            "--project-name",
+            "test-proj",
+            "--output-file",
+            str(out),
+        ],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0
+    docs = list(yaml.safe_load_all(out.read_text()))
+    assert by_kind(docs, "Job")
+
+
+def test_postgres_reporter_injected(config_file):
+    docs = generate(config_file)
+    (cm,) = by_kind(docs, "ConfigMap")
+    machines = yaml.safe_load(cm["data"]["machines.yaml"])["machines"]
+    reporters = machines[0]["runtime"]["reporters"]
+    assert any("PostgresReporter" in str(r) for r in reporters)
