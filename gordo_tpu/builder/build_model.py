@@ -77,30 +77,56 @@ class ModelBuilder:
                 model_register_dir,
                 self.cache_key,
             )
-            if replace_cache:
-                self.delete_cached_model(model_register_dir)
-            cached_model_path = self.check_cache(model_register_dir)
-            if cached_model_path:
-                model = serializer.load(cached_model_path)
-                metadata = serializer.load_metadata(cached_model_path)
-                metadata["metadata"]["user_defined"]["date_of_retrieval"] = str(
-                    datetime.datetime.now(datetime.timezone.utc)
-                )
-                machine = Machine.from_dict(metadata)
-                self._cached_model_path = cached_model_path
+            cached = self.load_cached(model_register_dir, replace_cache=replace_cache)
+            if cached is not None:
+                model, machine = cached
             else:
                 model, machine = self._build()
-                self._cached_model_path = self._save_model(
-                    model,
-                    machine,
-                    os.path.join(str(model_register_dir), "builds", self.cache_key),
-                )
-                disk_registry.write_key(
-                    model_register_dir, self.cache_key, self._cached_model_path
-                )
+                self.register(model, machine, model_register_dir)
         if output_dir:
             self._save_model(model, machine, output_dir)
         return model, machine
+
+    def load_cached(
+        self,
+        model_register_dir: Union[os.PathLike, str],
+        replace_cache: bool = False,
+    ) -> Optional[Tuple[Union[BaseEstimator, Pipeline], Machine]]:
+        """
+        Probe the content-addressed cache; on a hit return the loaded model
+        and its machine with the retrieval date stamped into user metadata
+        (reference: build_model.py:135-183).
+        """
+        if replace_cache:
+            self.delete_cached_model(model_register_dir)
+        cached_model_path = self.check_cache(model_register_dir)
+        if not cached_model_path:
+            return None
+        model = serializer.load(cached_model_path)
+        metadata = serializer.load_metadata(cached_model_path)
+        metadata["metadata"]["user_defined"]["date_of_retrieval"] = str(
+            datetime.datetime.now(datetime.timezone.utc)
+        )
+        self._cached_model_path = cached_model_path
+        return model, Machine.from_dict(metadata)
+
+    def register(
+        self,
+        model: Union[BaseEstimator, Pipeline],
+        machine: Machine,
+        model_register_dir: Union[os.PathLike, str],
+    ) -> str:
+        """Save artifacts under ``builds/<cache_key>`` and record the path
+        in the disk registry for future cache hits."""
+        self._cached_model_path = self._save_model(
+            model,
+            machine,
+            os.path.join(str(model_register_dir), "builds", self.cache_key),
+        )
+        disk_registry.write_key(
+            model_register_dir, self.cache_key, self._cached_model_path
+        )
+        return self._cached_model_path
 
     def _build(self) -> Tuple[Union[BaseEstimator, Pipeline], Machine]:
         """Train: fetch data → build model → CV → fit → metadata."""

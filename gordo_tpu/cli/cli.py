@@ -372,14 +372,24 @@ def build_fleet(
 
         from ..parallel.fleet_build import FleetBuilder
 
+        # On a multi-host slice every process runs the same SPMD training
+        # program, but only the coordinator may write artifacts, touch the
+        # shared build cache, or run reporters — otherwise N pods race on
+        # the same files and duplicate every report.
+        is_coordinator = int(os.getenv("JAX_PROCESS_INDEX", "0")) == 0
         logger.info(
-            "Fleet-building %d machines; output at %s", len(machines), output_dir
+            "Fleet-building %d machines; output at %s%s",
+            len(machines),
+            output_dir,
+            "" if is_coordinator else " (non-coordinator: side effects skipped)",
         )
         results = FleetBuilder(machines).build(
-            output_dir, model_register_dir=model_register_dir
+            output_dir if is_coordinator else None,
+            model_register_dir=model_register_dir if is_coordinator else None,
         )
-        for _, machine_out in results:
-            machine_out.report()
+        if is_coordinator:
+            for _, machine_out in results:
+                machine_out.report()
         logger.info("Fleet build of %d machines complete", len(results))
     except Exception:
         traceback.print_exc()

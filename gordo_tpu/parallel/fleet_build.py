@@ -122,17 +122,11 @@ class FleetBuilder:
         if model_register_dir:
             machines = []
             for machine in self.machines:
-                model_builder = ModelBuilder(machine)
-                if replace_cache:
-                    model_builder.delete_cached_model(model_register_dir)
-                cached_path = model_builder.check_cache(model_register_dir)
-                if cached_path:
-                    model = serializer.load(cached_path)
-                    metadata = serializer.load_metadata(cached_path)
-                    metadata["metadata"]["user_defined"]["date_of_retrieval"] = str(
-                        datetime.datetime.now(datetime.timezone.utc)
-                    )
-                    cached_results.append((model, Machine.from_dict(metadata)))
+                cached = ModelBuilder(machine).load_cached(
+                    model_register_dir, replace_cache=replace_cache
+                )
+                if cached is not None:
+                    cached_results.append(cached)
                 else:
                     machines.append(machine)
             logger.info(
@@ -167,20 +161,8 @@ class FleetBuilder:
             results.append(ModelBuilder(machine).build())
 
         if model_register_dir:
-            import os
-
-            from ..utils import disk_registry
-
             for model, machine in results:
-                model_builder = ModelBuilder(machine)
-                path = os.path.join(
-                    str(model_register_dir), "builds", model_builder.cache_key
-                )
-                os.makedirs(path, exist_ok=True)
-                serializer.dump(model, path, metadata=machine.to_dict())
-                disk_registry.write_key(
-                    model_register_dir, model_builder.cache_key, path
-                )
+                ModelBuilder(machine).register(model, machine, model_register_dir)
 
         results = cached_results + results
         if output_dir is not None:

@@ -1,3 +1,14 @@
 from .base import BaseReporter, LogReporter, ReporterException, create_reporters
+from .mlflow import MlflowLoggingError, MlFlowReporter
+from .postgres import PostgresReporter, PostgresReporterException
 
-__all__ = ["BaseReporter", "LogReporter", "ReporterException", "create_reporters"]
+__all__ = [
+    "BaseReporter",
+    "LogReporter",
+    "ReporterException",
+    "create_reporters",
+    "MlFlowReporter",
+    "MlflowLoggingError",
+    "PostgresReporter",
+    "PostgresReporterException",
+]

@@ -1,0 +1,400 @@
+"""
+MLflow reporter: convert build metadata into batched Metric/Param logs.
+
+Reference parity: gordo/reporters/mlflow.py — ``get_machine_log_items``
+flattens the machine's build metadata into MLflow Metric/Param entities
+(reference :194-279), ``batch_log_items`` splits them into batches
+respecting AzureML/MLflow payload limits of 200 metrics / 100 params per
+request (reference :282-340), workspace/service-principal kwargs come from
+colon-separated env-var secrets (reference :343-407), and the reporter
+logs one run per model cache key with the machine's ``metadata.json``
+attached as an artifact (reference :410-505).
+
+The mlflow package (and the AzureML SDK) are optional here: when mlflow is
+importable the real ``MlflowClient`` is used; otherwise a built-in
+:class:`FileTrackingClient` writes the same batches as JSON under a local
+tracking directory — enough for tests and for air-gapped TPU pods, with
+the same reporter-facing client surface (``log_batch``, ``log_artifacts``,
+``set_terminated``).
+"""
+
+import json
+import logging
+import os
+import shutil
+import tempfile
+import uuid
+from collections import namedtuple
+from contextlib import contextmanager
+from datetime import datetime, timezone
+from typing import List, Optional, Tuple
+
+from ..machine.encoders import MachineJSONEncoder
+from ..utils import capture_args
+from .base import BaseReporter, ReporterException
+
+logger = logging.getLogger(__name__)
+
+try:  # pragma: no cover - exercised only where mlflow is installed
+    from mlflow.entities import Metric, Param
+    from mlflow.tracking import MlflowClient
+
+    MLFLOW_AVAILABLE = True
+except ImportError:
+    Metric = namedtuple("Metric", ["key", "value", "timestamp", "step"])
+    Param = namedtuple("Param", ["key", "value"])
+    MlflowClient = None
+    MLFLOW_AVAILABLE = False
+
+
+class MlflowLoggingError(ReporterException):
+    pass
+
+
+# -- time helpers ------------------------------------------------------------
+
+
+def _datetime_to_ms_since_epoch(dt: datetime) -> int:
+    """
+    Milliseconds since the Unix epoch (reference mlflow.py:159-180).
+
+    >>> _datetime_to_ms_since_epoch(datetime(1970, 1, 1, 0, 0))
+    0
+    """
+    epoch = datetime.fromtimestamp(0, tz=timezone.utc).replace(tzinfo=dt.tzinfo)
+    return round((dt - epoch).total_seconds() * 1000.0)
+
+
+def epoch_now() -> int:
+    """Current UTC time as ms since epoch (reference mlflow.py:183-191)."""
+    return _datetime_to_ms_since_epoch(datetime.now(tz=timezone.utc))
+
+
+# -- metadata -> log entities ------------------------------------------------
+
+
+def get_machine_log_items(machine) -> Tuple[List[Metric], List[Param]]:
+    """
+    Flatten a machine's build metadata into Metric/Param lists
+    (reference mlflow.py:194-279): project/name params, dataset time-range
+    params, model build params, CV split params; CV score summary stats and
+    per-fold values as step-indexed metrics (per-tag scores skipped — too
+    many for MLflow); fit-history series as step-indexed metrics with the
+    fit params logged as Params.
+    """
+    build_metadata = machine.metadata.build_metadata
+
+    params = [
+        Param("project_name", machine.project_name),
+        Param("name", machine.name),
+    ]
+
+    dataset = machine.dataset
+    dataset_dict = dataset.to_dict() if hasattr(dataset, "to_dict") else dict(dataset)
+    for key in (
+        "train_start_date",
+        "train_end_date",
+        "resolution",
+        "row_filter",
+        "row_filter_buffer_size",
+    ):
+        if key in dataset_dict:
+            params.append(Param(key, str(dataset_dict[key])))
+
+    model_meta = build_metadata.model
+    for key in ("model_creation_date", "model_builder_version", "model_offset"):
+        params.append(Param(key, str(getattr(model_meta, key))))
+
+    splits = model_meta.cross_validation.splits
+    params.extend(Param(k, str(v)) for k, v in splits.items())
+
+    metrics: List[Metric] = []
+    scores = model_meta.cross_validation.scores
+    if scores:
+        # tag_list entries may be strings, SensorTags, or serialized
+        # {"name": ...} dicts; score keys use spaces replaced with dashes.
+        def tag_name(tag) -> str:
+            if isinstance(tag, dict):
+                tag = tag.get("name", "")
+            elif not isinstance(tag, str):
+                tag = getattr(tag, "name", str(tag))
+            return tag.replace(" ", "-")
+
+        tag_names = [tag_name(t) for t in dataset_dict.get("tag_list", [])]
+        subkeys = ["mean", "max", "min", "std"]
+        keys = sorted(scores.keys())
+        n_folds = len(scores[keys[0]]) - len(subkeys)
+        now = epoch_now()
+        for k in keys:
+            # Per-tag score rows explode the param budget; skip them.
+            if any(tag in k for tag in tag_names):
+                continue
+            for sk in subkeys:
+                metrics.append(Metric(f"{k}-{sk}", scores[k][f"fold-{sk}"], now, 0))
+            metrics.extend(
+                Metric(k, scores[k][f"fold-{i + 1}"], now, i) for i in range(n_folds)
+            )
+
+    history = (model_meta.model_meta or {}).get("history")
+    if history and "params" in history:
+        now = epoch_now()
+        if model_meta.model_training_duration_sec is not None:
+            metrics.append(
+                Metric(
+                    "model_training_duration_sec",
+                    float(model_meta.model_training_duration_sec),
+                    now,
+                    0,
+                )
+            )
+        for series_name, series in history.items():
+            if series_name == "params":
+                continue
+            metrics.extend(
+                Metric(series_name, float(x), now, i) for i, x in enumerate(series)
+            )
+        params.extend(Param(k, str(v)) for k, v in history["params"].items())
+
+    return metrics, params
+
+
+def batch_log_items(
+    metrics: List[Metric],
+    params: List[Param],
+    n_max_metrics: int = 200,
+    n_max_params: int = 100,
+) -> List[dict]:
+    """
+    Split metric/param lists into ``log_batch`` kwargs batches satisfying
+    the AzureML 200-metric and MLflow 100-param per-request limits
+    (reference mlflow.py:282-340).
+    """
+
+    def n_batches(n: int, n_max: int) -> int:
+        return (n // n_max) + int(n % n_max > 0)
+
+    total = max(n_batches(len(metrics), n_max_metrics), n_batches(len(params), n_max_params))
+    return [
+        {
+            "metrics": metrics[i * n_max_metrics : (i + 1) * n_max_metrics],
+            "params": params[i * n_max_params : (i + 1) * n_max_params],
+        }
+        for i in range(total)
+    ]
+
+
+# -- env-secret parsing ------------------------------------------------------
+
+
+def get_kwargs_from_secret(name: str, keys: List[str]) -> dict:
+    """
+    Parse a colon-separated env-var secret into kwargs
+    (reference mlflow.py:343-373). Empty value -> empty dict; missing
+    var -> error; element-count mismatch -> error.
+    """
+    secret_str = os.getenv(name)
+    if secret_str is None:
+        raise MlflowLoggingError(f"The value for env var '{name}' must not be `None`.")
+    if not secret_str:
+        return {}
+    elements = secret_str.split(":")
+    if len(elements) != len(keys):
+        raise MlflowLoggingError(
+            f"keys len {len(keys)} must equal env var {name} elements {len(elements)}."
+        )
+    return dict(zip(keys, elements))
+
+
+def get_workspace_kwargs() -> dict:
+    """AzureML workspace kwargs from ``AZUREML_WORKSPACE_STR``
+    (reference mlflow.py:375-390)."""
+    return get_kwargs_from_secret(
+        "AZUREML_WORKSPACE_STR",
+        ["subscription_id", "resource_group", "workspace_name"],
+    )
+
+
+def get_spauth_kwargs() -> dict:
+    """Service-principal kwargs from ``DL_SERVICE_AUTH_STR``
+    (reference mlflow.py:392-407)."""
+    return get_kwargs_from_secret(
+        "DL_SERVICE_AUTH_STR",
+        ["tenant_id", "service_principal_id", "service_principal_password"],
+    )
+
+
+# -- tracking clients --------------------------------------------------------
+
+
+class FileTrackingClient:
+    """
+    Dependency-free local tracking backend with the client surface the
+    reporter needs: runs live under
+    ``<root>/<experiment>/<run_id>/{batches.jsonl, artifacts/, status}``.
+    """
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or os.environ.get(
+            "GORDO_TPU_MLFLOW_DIR", os.path.join(tempfile.gettempdir(), "gordo-mlruns")
+        )
+
+    def _run_dir(self, run_id: str) -> str:
+        experiment, _, run = run_id.partition("/")
+        return os.path.join(self.root, experiment, run)
+
+    def create_run(self, experiment_name: str, tags: dict) -> str:
+        run_id = f"{experiment_name}/{uuid.uuid4().hex}"
+        run_dir = self._run_dir(run_id)
+        os.makedirs(os.path.join(run_dir, "artifacts"), exist_ok=True)
+        with open(os.path.join(run_dir, "tags.json"), "w") as fh:
+            json.dump(tags, fh)
+        return run_id
+
+    def log_batch(self, run_id: str, metrics=(), params=()):
+        with open(os.path.join(self._run_dir(run_id), "batches.jsonl"), "a") as fh:
+            fh.write(
+                json.dumps(
+                    {
+                        "metrics": [list(m) for m in metrics],
+                        "params": [list(p) for p in params],
+                    }
+                )
+                + "\n"
+            )
+
+    def log_artifacts(self, run_id: str, local_dir: str):
+        dest = os.path.join(self._run_dir(run_id), "artifacts")
+        for name in os.listdir(local_dir):
+            shutil.copy(os.path.join(local_dir, name), os.path.join(dest, name))
+
+    def set_terminated(self, run_id: str):
+        with open(os.path.join(self._run_dir(run_id), "status"), "w") as fh:
+            fh.write("FINISHED")
+
+
+def get_mlflow_client(
+    workspace_kwargs: dict = {}, service_principal_kwargs: dict = {}
+):
+    """
+    Tracking client: AzureML-backed MlflowClient when workspace kwargs are
+    given (reference mlflow.py:60-126), plain MlflowClient for local
+    mlflow tracking, or the built-in file backend when mlflow is absent.
+    """
+    if workspace_kwargs:
+        if not MLFLOW_AVAILABLE:
+            raise MlflowLoggingError(
+                "mlflow (and the AzureML SDK) are required for remote tracking"
+            )
+        required = ["subscription_id", "resource_group", "workspace_name"]
+        missing = [k for k in required if k not in workspace_kwargs]
+        if missing:
+            raise MlflowLoggingError(f"Missing keys {missing} in workspace kwargs")
+        try:  # pragma: no cover - requires azureml
+            from azureml.core import Workspace
+            from azureml.core.authentication import (
+                InteractiveLoginAuthentication,
+                ServicePrincipalAuthentication,
+            )
+        except ImportError as exc:
+            raise MlflowLoggingError(
+                "azureml-core is required for AzureML-backed tracking"
+            ) from exc
+        if service_principal_kwargs:  # pragma: no cover
+            required = [
+                "tenant_id",
+                "service_principal_id",
+                "service_principal_password",
+            ]
+            missing = [k for k in required if k not in service_principal_kwargs]
+            if missing:
+                raise MlflowLoggingError(
+                    f"Missing keys {missing} in service principal kwargs"
+                )
+            workspace_kwargs["auth"] = ServicePrincipalAuthentication(
+                **service_principal_kwargs
+            )
+        else:  # pragma: no cover
+            workspace_kwargs["auth"] = InteractiveLoginAuthentication(force=True)
+        tracking_uri = Workspace(**workspace_kwargs).get_mlflow_tracking_uri()  # pragma: no cover
+        return MlflowClient(tracking_uri)  # pragma: no cover
+    if MLFLOW_AVAILABLE:  # pragma: no cover - requires mlflow
+        return MlflowClient()
+    return FileTrackingClient()
+
+
+def get_run_id(client, experiment_name: str, model_key: str) -> str:
+    """New (or resolved) run tagged with the model cache key
+    (reference mlflow.py:128-156)."""
+    if isinstance(client, FileTrackingClient):
+        return client.create_run(experiment_name, tags={"model_key": model_key})
+    experiment = client.get_experiment_by_name(experiment_name)  # pragma: no cover
+    experiment_id = (  # pragma: no cover
+        getattr(experiment, "experiment_id")
+        if experiment
+        else client.create_experiment(experiment_name)
+    )
+    return client.create_run(  # pragma: no cover
+        experiment_id, tags={"model_key": model_key}
+    ).info.run_id
+
+
+@contextmanager
+def mlflow_context(
+    name: str,
+    model_key: Optional[str] = None,
+    workspace_kwargs: dict = {},
+    service_principal_kwargs: dict = {},
+):
+    """Yield ``(client, run_id)``, terminating the run on exit
+    (reference mlflow.py:410-449)."""
+    client = get_mlflow_client(workspace_kwargs, service_principal_kwargs)
+    run_id = get_run_id(client, name, model_key or uuid.uuid4().hex)
+    try:
+        yield client, run_id
+    finally:
+        client.set_terminated(run_id)
+
+
+def log_machine(client, run_id: str, machine) -> None:
+    """Log batched metrics/params plus the machine dict as a
+    ``metadata.json`` artifact (reference mlflow.py:452-478)."""
+    for batch_kwargs in batch_log_items(*get_machine_log_items(machine)):
+        client.log_batch(run_id, **batch_kwargs)
+    try:
+        with tempfile.TemporaryDirectory() as tmp_dir:
+            path = os.path.join(tmp_dir, "metadata.json")
+            with open(path, "w") as fh:
+                json.dump(machine.to_dict(), fh, cls=MachineJSONEncoder)
+            client.log_artifacts(run_id=run_id, local_dir=tmp_dir)
+    except Exception as exc:
+        raise MlflowLoggingError(exc)
+
+
+class MlFlowReporter(BaseReporter):
+    """One tracked run per build, keyed by the builder's content-addressed
+    cache key (reference mlflow.py:481-505)."""
+
+    @capture_args
+    def __init__(self, *args, model_builder_class=None, **kwargs):
+        from ..builder.utils import create_model_builder
+
+        if isinstance(model_builder_class, str):
+            model_builder_class = create_model_builder(model_builder_class)
+        if model_builder_class is None:
+            from ..builder.build_model import ModelBuilder
+
+            model_builder_class = ModelBuilder
+        self.model_builder_class = model_builder_class
+
+    def report(self, machine) -> None:
+        workspace_kwargs = (
+            get_workspace_kwargs() if os.getenv("AZUREML_WORKSPACE_STR") is not None else {}
+        )
+        service_principal_kwargs = (
+            get_spauth_kwargs() if os.getenv("DL_SERVICE_AUTH_STR") is not None else {}
+        )
+        cache_key = self.model_builder_class.calculate_cache_key(machine)
+        with mlflow_context(
+            machine.name, cache_key, workspace_kwargs, service_principal_kwargs
+        ) as (client, run_id):
+            log_machine(client, run_id, machine)

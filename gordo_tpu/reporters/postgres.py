@@ -1,0 +1,175 @@
+"""
+Postgres reporter: upsert one row per machine into a ``machine`` table.
+
+Reference parity: gordo/reporters/postgres.py:31-109 — a ``machine`` table
+with ``name`` (unique) plus ``dataset``/``model``/``metadata`` JSON columns,
+written once per build via insert-or-update inside a transaction, errors
+wrapped in ``PostgresReporterException``.
+
+The reference reaches Postgres through peewee/psycopg2. Neither is a given
+in this environment, so the SQL layer here is a two-line adapter instead:
+``psycopg2`` when importable (production), stdlib ``sqlite3`` when the host
+is a ``sqlite://`` URI (local runs, tests, CI without a database). The SQL
+itself — one CREATE TABLE and one ON CONFLICT upsert — is identical modulo
+placeholder style and the JSONB/TEXT column type.
+"""
+
+import json
+import logging
+
+from ..machine.encoders import MachineJSONEncoder
+from ..utils import capture_args
+from .base import BaseReporter, ReporterException
+
+logger = logging.getLogger(__name__)
+
+SQLITE_PREFIX = "sqlite://"
+
+
+class PostgresReporterException(ReporterException):
+    pass
+
+
+class PostgresReporter(BaseReporter):
+    """
+    Store a :class:`gordo_tpu.machine.Machine` in a SQL database, one row
+    per machine name (latest build wins).
+
+    Parameters mirror the reference reporter's (host/port/user/password/
+    database). ``host`` may instead be a ``sqlite:///path/to.db`` (or
+    ``sqlite://:memory:``) URI, which selects the stdlib sqlite3 backend —
+    the zero-dependency local equivalent.
+    """
+
+    @capture_args
+    def __init__(
+        self,
+        host: str,
+        port: int = 5432,
+        user: str = "postgres",
+        password: str = "postgres",
+        database: str = "postgres",
+    ):
+        self.host = host
+        self.port = port
+        self.user = user
+        self.password = password
+        self.database = database
+        try:
+            self._connect()
+            self._create_table()
+        except PostgresReporterException:
+            raise
+        except Exception as exc:
+            raise PostgresReporterException(exc)
+
+    # -- backend adapter -----------------------------------------------------
+
+    @property
+    def _is_sqlite(self) -> bool:
+        return self.host.startswith(SQLITE_PREFIX)
+
+    def _connect(self):
+        if self._is_sqlite:
+            import sqlite3
+
+            # sqlite:///abs/path.db -> /abs/path.db; sqlite://:memory: (or
+            # bare sqlite://) -> in-memory database.
+            path = self.host[len(SQLITE_PREFIX) :]
+            if path in ("", ":memory:", "/:memory:"):
+                path = ":memory:"
+            self._conn = sqlite3.connect(path)
+            self._placeholder = "?"
+            self._json_type = "TEXT"
+        else:
+            try:
+                import psycopg2
+            except ImportError as exc:
+                raise PostgresReporterException(
+                    "psycopg2 is required for a Postgres host "
+                    "(use a sqlite:// host for the stdlib backend)"
+                ) from exc
+            self._conn = psycopg2.connect(
+                host=self.host,
+                port=self.port,
+                user=self.user,
+                password=self.password,
+                dbname=self.database,
+            )
+            self._placeholder = "%s"
+            self._json_type = "JSONB"
+
+    def _create_table(self):
+        self._execute(
+            f"CREATE TABLE IF NOT EXISTS machine ("
+            f"name VARCHAR(255) NOT NULL UNIQUE, "
+            f"dataset {self._json_type} NOT NULL, "
+            f"model {self._json_type} NOT NULL, "
+            f"metadata {self._json_type} NOT NULL)"
+        )
+
+    def _pg_execute(self, sql: str, params=()):
+        with self._conn:
+            with self._conn.cursor() as cur:
+                cur.execute(sql, params)
+
+    def _execute(self, sql: str, params=()):
+        if self._is_sqlite:
+            with self._conn:
+                self._conn.execute(sql, params)
+        else:
+            self._pg_execute(sql, params)
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self, machine) -> None:
+        """
+        Upsert the machine: top-level ``name`` plus JSON ``dataset``,
+        ``model``, ``metadata`` columns (reference postgres.py:62-94).
+        """
+        try:
+            record = json.loads(json.dumps(machine.to_dict(), cls=MachineJSONEncoder))
+            p = self._placeholder
+            logger.info("Inserting machine %s in sql", machine.name)
+            self._execute(
+                f"INSERT INTO machine (name, dataset, model, metadata) "
+                f"VALUES ({p}, {p}, {p}, {p}) "
+                f"ON CONFLICT (name) DO UPDATE SET "
+                f"dataset=excluded.dataset, model=excluded.model, "
+                f"metadata=excluded.metadata",
+                (
+                    record["name"],
+                    json.dumps(record["dataset"]),
+                    json.dumps(record["model"]),
+                    json.dumps(record["metadata"]),
+                ),
+            )
+        except Exception as exc:
+            raise PostgresReporterException(exc)
+
+    # -- introspection (tests / debugging) -----------------------------------
+
+    def fetch(self, name: str) -> dict:
+        """Read one machine row back as a dict of parsed JSON columns."""
+        sql = (
+            f"SELECT name, dataset, model, metadata FROM machine "
+            f"WHERE name = {self._placeholder}"
+        )
+        if self._is_sqlite:
+            row = self._conn.execute(sql, (name,)).fetchone()
+        else:
+            with self._conn.cursor() as cur:
+                cur.execute(sql, (name,))
+                row = cur.fetchone()
+        if row is None:
+            raise PostgresReporterException(f"No machine named {name!r}")
+
+        def parse(v):
+            return json.loads(v) if isinstance(v, (str, bytes)) else v
+
+        return {
+            "name": row[0],
+            "dataset": parse(row[1]),
+            "model": parse(row[2]),
+            "metadata": parse(row[3]),
+        }

@@ -34,20 +34,28 @@ DEFAULT_IGNORE_PATHS = ("/healthcheck",)
 PROJECT_LEVEL_ROUTES = ("models", "revisions", "expected-models")
 
 
-def multiprocess_registry() -> Optional[CollectorRegistry]:
+def _ensure_multiproc_dir() -> Optional[str]:
     """
-    A multiprocess collector registry when ``PROMETHEUS_MULTIPROC_DIR`` is
-    configured (gunicorn worker fan-in), else None.
+    The configured ``PROMETHEUS_MULTIPROC_DIR`` (either env spelling),
+    created if missing — prometheus_client crashes at first metric write
+    when the mmap dir doesn't exist.
     """
     multiproc_dir = os.getenv("PROMETHEUS_MULTIPROC_DIR") or os.getenv(
         "prometheus_multiproc_dir"
     )
     if multiproc_dir:
+        os.makedirs(multiproc_dir, exist_ok=True)
+    return multiproc_dir
+
+
+def multiprocess_registry() -> Optional[CollectorRegistry]:
+    """
+    A multiprocess collector registry when ``PROMETHEUS_MULTIPROC_DIR`` is
+    configured (gunicorn worker fan-in), else None.
+    """
+    if _ensure_multiproc_dir():
         from prometheus_client import multiprocess
 
-        # prometheus_client crashes at first metric write if the mmap dir
-        # is missing; creating it here keeps worker startup robust.
-        os.makedirs(multiproc_dir, exist_ok=True)
         registry = CollectorRegistry()
         multiprocess.MultiProcessCollector(registry)
         return registry
@@ -63,11 +71,7 @@ class GordoServerPrometheusMetrics:
         ignore_paths: Tuple[str, ...] = DEFAULT_IGNORE_PATHS,
         registry: Optional[CollectorRegistry] = None,
     ):
-        multiproc_dir = os.getenv("PROMETHEUS_MULTIPROC_DIR") or os.getenv(
-            "prometheus_multiproc_dir"
-        )
-        if multiproc_dir:
-            os.makedirs(multiproc_dir, exist_ok=True)
+        _ensure_multiproc_dir()
         self.project = project
         self.ignore_paths = tuple(ignore_paths)
         self.registry = registry if registry is not None else REGISTRY

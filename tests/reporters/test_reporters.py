@@ -1,0 +1,234 @@
+"""
+Reporter tests, mirroring the reference's strategy
+(tests/gordo/reporters/test_postgres_reporter.py and
+test_mlflow_reporter.py) but with the dependency-free local backends:
+sqlite for Postgres, the file tracking store for MLflow.
+"""
+
+import json
+import os
+
+import pytest
+
+from gordo_tpu.builder import ModelBuilder
+from gordo_tpu.machine import Machine
+from gordo_tpu.reporters import (
+    LogReporter,
+    MlFlowReporter,
+    MlflowLoggingError,
+    PostgresReporter,
+    PostgresReporterException,
+    create_reporters,
+)
+from gordo_tpu.reporters.mlflow import (
+    FileTrackingClient,
+    batch_log_items,
+    get_kwargs_from_secret,
+    get_machine_log_items,
+    get_spauth_kwargs,
+    get_workspace_kwargs,
+    mlflow_context,
+)
+
+MODEL_DEF = {
+    "gordo_tpu.models.JaxAutoEncoder": {
+        "kind": "feedforward_model",
+        "encoding_dim": [8, 4],
+        "encoding_func": ["tanh", "tanh"],
+        "decoding_dim": [4, 8],
+        "decoding_func": ["tanh", "tanh"],
+        "epochs": 2,
+    }
+}
+DATASET_DEF = {
+    "type": "RandomDataset",
+    "train_start_date": "2020-01-01T00:00:00+00:00",
+    "train_end_date": "2020-01-05T00:00:00+00:00",
+    "tag_list": ["tag-1", "tag-2"],
+}
+
+
+@pytest.fixture(scope="module")
+def built_machine():
+    machine = Machine.from_config(
+        {"name": "machine-1", "model": MODEL_DEF, "dataset": dict(DATASET_DEF)},
+        project_name="proj",
+    )
+    _, machine = ModelBuilder(machine).build()
+    return machine
+
+
+# -- postgres ----------------------------------------------------------------
+
+
+def test_postgres_reporter_upserts(tmp_path, built_machine):
+    db = f"sqlite://{tmp_path}/machines.db"
+    reporter = PostgresReporter(host=db)
+    reporter.report(built_machine)
+    row = reporter.fetch("machine-1")
+    assert row["name"] == "machine-1"
+    assert row["dataset"]["tag_list"] == ["tag-1", "tag-2"]
+    assert row["model"] == built_machine.to_dict()["model"]
+    assert "build_metadata" in row["metadata"]
+
+    # Reporting the same machine again updates, not duplicates.
+    reporter.report(built_machine)
+    count_conn = reporter._conn
+    (n,) = count_conn.execute("SELECT COUNT(*) FROM machine").fetchone()
+    assert n == 1
+
+
+def test_postgres_reporter_memory_backend(built_machine):
+    reporter = PostgresReporter(host="sqlite://:memory:")
+    reporter.report(built_machine)
+    assert reporter.fetch("machine-1")["name"] == "machine-1"
+
+
+def test_postgres_reporter_fetch_missing():
+    reporter = PostgresReporter(host="sqlite://:memory:")
+    with pytest.raises(PostgresReporterException):
+        reporter.fetch("nope")
+
+
+def test_postgres_reporter_requires_driver_for_real_host():
+    # No psycopg2 in this environment: a non-sqlite host must fail loudly.
+    with pytest.raises(PostgresReporterException):
+        PostgresReporter(host="postgres.example.com")
+
+
+def test_postgres_reporter_round_trips_serializer(tmp_path):
+    db = f"sqlite://{tmp_path}/machines.db"
+    reporter = PostgresReporter(host=db)
+    definition = reporter.to_dict()
+    assert definition["gordo_tpu.reporters.postgres.PostgresReporter"]["host"] == db
+    clone = PostgresReporter.from_dict(definition)
+    assert isinstance(clone, PostgresReporter)
+    assert clone.host == db
+
+
+# -- mlflow ------------------------------------------------------------------
+
+
+def test_get_machine_log_items(built_machine):
+    metrics, params = get_machine_log_items(built_machine)
+    param_keys = [p.key for p in params]
+    assert "project_name" in param_keys
+    assert "name" in param_keys
+    assert "train_start_date" in param_keys
+    assert "model_offset" in param_keys
+    assert any(k.startswith("fold-1") for k in param_keys)  # CV split bounds
+
+    metric_keys = {m.key for m in metrics}
+    # Aggregate CV metrics present, per-tag ones skipped.
+    assert any(k.startswith("explained-variance-score") for k in metric_keys)
+    assert not any("tag-1" in k for k in metric_keys)
+    # Fit history series logged step-wise.
+    assert "loss" in metric_keys
+    loss_steps = [m.step for m in metrics if m.key == "loss"]
+    assert loss_steps == list(range(len(loss_steps)))
+    assert "model_training_duration_sec" in metric_keys
+
+
+@pytest.mark.parametrize(
+    "n_metrics,n_params,expected_batches",
+    [(0, 0, 0), (1, 1, 1), (200, 100, 1), (201, 100, 2), (10, 250, 3)],
+)
+def test_batch_log_items_limits(n_metrics, n_params, expected_batches):
+    from gordo_tpu.reporters.mlflow import Metric, Param
+
+    metrics = [Metric(f"m{i}", 1.0, 0, 0) for i in range(n_metrics)]
+    params = [Param(f"p{i}", "v") for i in range(n_params)]
+    batches = batch_log_items(metrics, params)
+    assert len(batches) == expected_batches
+    assert all(len(b["metrics"]) <= 200 for b in batches)
+    assert all(len(b["params"]) <= 100 for b in batches)
+    assert sum(len(b["metrics"]) for b in batches) == n_metrics
+    assert sum(len(b["params"]) for b in batches) == n_params
+
+
+def test_get_kwargs_from_secret(monkeypatch):
+    with pytest.raises(MlflowLoggingError):
+        get_kwargs_from_secret("NOT_SET_VAR", ["a"])
+    monkeypatch.setenv("SECRET", "1:2:3")
+    assert get_kwargs_from_secret("SECRET", ["a", "b", "c"]) == {
+        "a": "1",
+        "b": "2",
+        "c": "3",
+    }
+    with pytest.raises(MlflowLoggingError):
+        get_kwargs_from_secret("SECRET", ["a", "b"])
+    monkeypatch.setenv("SECRET", "")
+    assert get_kwargs_from_secret("SECRET", ["a", "b"]) == {}
+
+
+def test_workspace_and_spauth_kwargs(monkeypatch):
+    monkeypatch.setenv("AZUREML_WORKSPACE_STR", "sub:rg:ws")
+    monkeypatch.setenv("DL_SERVICE_AUTH_STR", "tenant:spid:sppw")
+    assert get_workspace_kwargs() == {
+        "subscription_id": "sub",
+        "resource_group": "rg",
+        "workspace_name": "ws",
+    }
+    assert get_spauth_kwargs() == {
+        "tenant_id": "tenant",
+        "service_principal_id": "spid",
+        "service_principal_password": "sppw",
+    }
+
+
+def test_mlflow_context_file_backend(tmp_path, monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_MLFLOW_DIR", str(tmp_path))
+    with mlflow_context("exp", "key123") as (client, run_id):
+        assert isinstance(client, FileTrackingClient)
+        client.log_batch(run_id, metrics=[], params=[])
+    run_dir = os.path.join(str(tmp_path), run_id)
+    assert open(os.path.join(run_dir, "status")).read() == "FINISHED"
+    assert json.load(open(os.path.join(run_dir, "tags.json"))) == {
+        "model_key": "key123"
+    }
+
+
+def test_mlflow_reporter_end_to_end(tmp_path, monkeypatch, built_machine):
+    monkeypatch.setenv("GORDO_TPU_MLFLOW_DIR", str(tmp_path))
+    MlFlowReporter().report(built_machine)
+
+    exp_dir = tmp_path / "machine-1"
+    runs = list(exp_dir.iterdir())
+    assert len(runs) == 1
+    run_dir = runs[0]
+    batches = [
+        json.loads(line)
+        for line in (run_dir / "batches.jsonl").read_text().splitlines()
+    ]
+    assert batches
+    all_params = [p for b in batches for p in b["params"]]
+    assert ["name", "machine-1"] in all_params
+    metadata = json.load(open(run_dir / "artifacts" / "metadata.json"))
+    assert metadata["name"] == "machine-1"
+    assert (run_dir / "status").read_text() == "FINISHED"
+
+
+# -- wiring ------------------------------------------------------------------
+
+
+def test_create_reporters_from_definitions(tmp_path):
+    db = f"sqlite://{tmp_path}/machines.db"
+    reporters = create_reporters(
+        [
+            {"gordo_tpu.reporters.postgres.PostgresReporter": {"host": db}},
+            {"gordo_tpu.reporters.base.LogReporter": {}},
+        ]
+    )
+    assert isinstance(reporters[0], PostgresReporter)
+    assert isinstance(reporters[1], LogReporter)
+
+
+def test_machine_report_runs_configured_reporters(tmp_path, built_machine):
+    db = f"sqlite://{tmp_path}/machines.db"
+    built_machine.runtime = {
+        "reporters": [
+            {"gordo_tpu.reporters.postgres.PostgresReporter": {"host": db}}
+        ]
+    }
+    built_machine.report()
+    assert PostgresReporter(host=db).fetch("machine-1")["name"] == "machine-1"
