@@ -36,6 +36,7 @@ from ..dataset.sensor_tag import SensorTagNormalizationError
 from ..machine import Machine, load_model_config
 from ..reporters.base import ReporterException
 from ..server import run_server
+from ..client.cli import client_cli
 from .custom_types import HostIP, key_value_par
 from .exceptions_reporter import ExceptionsReporter, ReportLevel
 from .workflow_generator import workflow_cli
@@ -436,6 +437,7 @@ def _maybe_init_distributed():
 
 
 gordo_tpu_cli.add_command(workflow_cli)
+gordo_tpu_cli.add_command(client_cli)
 gordo_tpu_cli.add_command(build)
 gordo_tpu_cli.add_command(build_fleet)
 gordo_tpu_cli.add_command(run_server_cli)

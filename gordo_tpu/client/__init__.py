@@ -1,0 +1,15 @@
+from .client import Client
+from .forwarders import (
+    ForwardPredictionsIntoInflux,
+    ForwardPredictionsToDisk,
+    PredictionForwarder,
+)
+from .utils import PredictionResult
+
+__all__ = [
+    "Client",
+    "PredictionResult",
+    "PredictionForwarder",
+    "ForwardPredictionsToDisk",
+    "ForwardPredictionsIntoInflux",
+]

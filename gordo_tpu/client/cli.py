@@ -1,0 +1,96 @@
+"""
+``gordo-tpu-client`` CLI (reference: gordo-client's ``gordo_client.cli.client``
+entry point used by the Argo client replay step).
+"""
+
+import json
+import sys
+
+import click
+
+from .client import Client
+from .forwarders import ForwardPredictionsToDisk
+
+
+def _make_client(ctx_params, **extra) -> Client:
+    return Client(
+        project=ctx_params["project"],
+        host=ctx_params["host"],
+        port=ctx_params["port"],
+        scheme=ctx_params["scheme"],
+        revision=ctx_params.get("revision"),
+        **extra,
+    )
+
+
+@click.group("client")
+@click.option("--project", required=True, help="Project name")
+@click.option("--host", default="localhost", envvar="GORDO_CLIENT_HOST")
+@click.option("--port", default=443, type=int, envvar="GORDO_CLIENT_PORT")
+@click.option("--scheme", default="https", envvar="GORDO_CLIENT_SCHEME")
+@click.option("--revision", default=None, help="Pin to a model revision")
+@click.pass_context
+def client_cli(ctx, project, host, port, scheme, revision):
+    """Interact with a deployed gordo-tpu project."""
+    ctx.ensure_object(dict)
+    ctx.obj.update(
+        project=project, host=host, port=port, scheme=scheme, revision=revision
+    )
+
+
+@client_cli.command("metadata")
+@click.option("--target", multiple=True, help="Limit to these machines")
+@click.option("--output-file", type=click.File("w"), default=None)
+@click.pass_context
+def metadata(ctx, target, output_file):
+    """Fetch metadata for all (or the listed) machines as JSON."""
+    client = _make_client(ctx.obj)
+    payload = client.get_metadata(list(target) or None)
+    stream = output_file if output_file else sys.stdout
+    json.dump(payload, stream, indent=2, default=str)
+
+
+@client_cli.command("download-model")
+@click.argument("output-dir", type=click.Path(exists=True, file_okay=False))
+@click.option("--target", multiple=True)
+@click.pass_context
+def download_model(ctx, output_dir, target):
+    """Download and save serialized models to OUTPUT_DIR/<name>/."""
+    from .. import serializer
+
+    client = _make_client(ctx.obj)
+    for name, model in client.download_model(list(target) or None).items():
+        out = f"{output_dir}/{name}"
+        serializer.dump(model, out)
+        click.echo(f"Saved {name} to {out}")
+
+
+@client_cli.command("predict")
+@click.argument("start")
+@click.argument("end")
+@click.option("--target", multiple=True)
+@click.option("--destination", default=None, help="Forward predictions as parquet here")
+@click.option("--parquet/--no-parquet", default=True, help="Parquet wire format")
+@click.option("--batch-size", default=100000, type=int)
+@click.option("--parallelism", default=10, type=int)
+@click.pass_context
+def predict(ctx, start, end, target, destination, parquet, batch_size, parallelism):
+    """Replay [START, END] through deployed machines (the Argo client
+    step's job)."""
+    forwarder = ForwardPredictionsToDisk(destination) if destination else None
+    client = _make_client(
+        ctx.obj,
+        prediction_forwarder=forwarder,
+        use_parquet=parquet,
+        batch_size=batch_size,
+        parallelism=parallelism,
+    )
+    failed = False
+    for result in client.predict(start, end, list(target) or None):
+        n = len(result.predictions) if result.predictions is not None else 0
+        click.echo(f"{result.name}: {n} rows, {len(result.error_messages)} errors")
+        for msg in result.error_messages:
+            failed = True
+            click.echo(f"  {msg}", err=True)
+    if failed:
+        sys.exit(1)

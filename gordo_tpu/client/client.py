@@ -1,0 +1,281 @@
+"""
+HTTP client for a deployed gordo-tpu project (reference: the external
+``gordo-client`` package, pinned by gordo's full_requirements.txt:139 and
+exercised by tests/gordo/client/test_client.py — SURVEY.md §2 intro).
+
+For each target machine the client pulls the machine's own dataset config
+from served metadata, fetches sensor data for the prediction window via
+that dataset (optionally with an overridden data provider), POSTs it to
+the anomaly-prediction route in row batches (JSON or parquet multipart),
+joins the returned response frames, and optionally forwards them into a
+:class:`~gordo_tpu.client.forwarders.PredictionForwarder` — the Argo
+"client" replay step's behavior.
+"""
+
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Union
+
+import pandas as pd
+import requests
+
+from .. import serializer
+from ..dataset import GordoBaseDataset
+from ..machine import Machine
+from ..server.utils import (
+    dataframe_from_dict,
+    dataframe_from_parquet_bytes,
+    dataframe_into_parquet_bytes,
+    dataframe_to_dict,
+)
+from .forwarders import PredictionForwarder
+from .io import NotFound, _handle_response
+from .utils import PredictionResult
+
+logger = logging.getLogger(__name__)
+
+
+class Client:
+    """
+    Client to a single gordo-tpu project deployment.
+
+    Parameters
+    ----------
+    project
+        Project name (the ``/gordo/v0/<project>`` path element).
+    host / port / scheme
+        Where the ML server lives.
+    revision
+        Pin all requests to a specific model revision (default: server's
+        current).
+    data_provider
+        Override the data provider inside each machine's dataset config
+        when fetching prediction-window data.
+    prediction_forwarder
+        Sink called with each machine's joined predictions.
+    batch_size
+        Max rows per prediction POST.
+    parallelism
+        Machines predicted concurrently (thread pool; requests release
+        the GIL during IO).
+    use_parquet
+        Send/receive parquet instead of JSON payloads.
+    session
+        A ``requests.Session``-compatible object (tests inject an
+        in-process WSGI adapter here).
+    """
+
+    def __init__(
+        self,
+        project: str,
+        host: str = "localhost",
+        port: int = 443,
+        scheme: str = "https",
+        revision: Optional[str] = None,
+        metadata: Optional[dict] = None,
+        data_provider: Optional[dict] = None,
+        prediction_forwarder: Optional[PredictionForwarder] = None,
+        batch_size: int = 100000,
+        parallelism: int = 10,
+        n_retries: int = 5,
+        use_parquet: bool = False,
+        session=None,
+    ):
+        self.project_name = project
+        self.base_url = f"{scheme}://{host}:{port}/gordo/v0/{project}"
+        self.revision = revision
+        self.metadata = metadata if metadata is not None else {}
+        self.data_provider = data_provider
+        self.prediction_forwarder = prediction_forwarder
+        self.batch_size = batch_size
+        self.parallelism = parallelism
+        self.n_retries = n_retries
+        self.use_parquet = use_parquet
+        self.session = session if session is not None else requests.Session()
+
+    # -- discovery -----------------------------------------------------------
+
+    def _query_params(self) -> dict:
+        return {"revision": self.revision} if self.revision else {}
+
+    def get_revisions(self) -> dict:
+        """``{"latest": ..., "available-revisions": [...]}`` from the server."""
+        resp = self.session.get(
+            f"{self.base_url}/revisions", params=self._query_params()
+        )
+        return _handle_response(resp, "revisions")
+
+    def get_machine_names(self) -> List[str]:
+        """Model names available from the (pinned or current) revision."""
+        resp = self.session.get(f"{self.base_url}/models", params=self._query_params())
+        return _handle_response(resp, "model list")["models"]
+
+    def machine_metadata(self, name: str) -> dict:
+        """Full served metadata for one machine."""
+        resp = self.session.get(
+            f"{self.base_url}/{name}/metadata", params=self._query_params()
+        )
+        return _handle_response(resp, f"metadata for {name}")
+
+    def get_metadata(
+        self, targets: Optional[List[str]] = None
+    ) -> Dict[str, dict]:
+        """``{machine-name: machine metadata dict}`` for all (or listed)
+        machines."""
+        return {
+            machine.name: machine.to_dict()
+            for machine in self.get_available_machines(targets)
+        }
+
+    def get_available_machines(
+        self, targets: Optional[List[str]] = None
+    ) -> List[Machine]:
+        """Rehydrated :class:`Machine` objects from served metadata."""
+        names = self.get_machine_names()
+        if targets:
+            missing = set(targets) - set(names)
+            if missing:
+                raise NotFound(f"Machines not deployed: {sorted(missing)}")
+            names = [n for n in names if n in set(targets)]
+        return [
+            Machine.from_dict(self.machine_metadata(name)["metadata"])
+            for name in names
+        ]
+
+    def download_model(
+        self, targets: Optional[List[str]] = None
+    ) -> Dict[str, object]:
+        """``{machine-name: deserialized model}`` via ``/download-model``
+        (the pickle wire format of serializer.dumps/loads)."""
+        names = targets if targets else self.get_machine_names()
+        models = {}
+        for name in names:
+            resp = self.session.get(
+                f"{self.base_url}/{name}/download-model", params=self._query_params()
+            )
+            models[name] = serializer.loads(_handle_response(resp, f"model {name}"))
+        return models
+
+    # -- prediction ----------------------------------------------------------
+
+    def predict(
+        self,
+        start: Union[str, pd.Timestamp],
+        end: Union[str, pd.Timestamp],
+        targets: Optional[List[str]] = None,
+    ) -> List[PredictionResult]:
+        """
+        Replay the ``[start, end]`` window through every (or the listed)
+        machines, in parallel, returning one :class:`PredictionResult`
+        per machine.
+        """
+        machines = self.get_available_machines(targets)
+        with ThreadPoolExecutor(max_workers=max(1, self.parallelism)) as executor:
+            results = list(
+                executor.map(
+                    lambda m: self.predict_single_machine(m, start, end), machines
+                )
+            )
+        if self.prediction_forwarder is not None:
+            for machine, result in zip(machines, results):
+                if result.predictions is not None and len(result.predictions):
+                    self.prediction_forwarder.forward_predictions(
+                        result.predictions, machine=machine, metadata=self.metadata
+                    )
+        return results
+
+    def predict_single_machine(
+        self,
+        machine: Machine,
+        start: Union[str, pd.Timestamp],
+        end: Union[str, pd.Timestamp],
+    ) -> PredictionResult:
+        """Fetch the machine's sensor data for the window and POST it in
+        batches; join the per-batch response frames. Any failure — data
+        fetch included — lands in ``error_messages`` rather than aborting
+        the rest of the fleet's replay."""
+        frames: List[pd.DataFrame] = []
+        errors: List[str] = []
+        try:
+            X, y = self._data_for_window(machine, start, end)
+        except Exception as exc:
+            msg = f"Failed to fetch data for {machine.name}: {exc}"
+            logger.error(msg)
+            return PredictionResult(
+                name=machine.name, predictions=None, error_messages=[msg]
+            )
+        for batch_start in range(0, len(X), self.batch_size):
+            X_batch = X.iloc[batch_start : batch_start + self.batch_size]
+            y_batch = (
+                y.iloc[batch_start : batch_start + self.batch_size]
+                if y is not None
+                else None
+            )
+            try:
+                frames.append(
+                    self._send_prediction_request(machine.name, X_batch, y_batch)
+                )
+            except Exception as exc:
+                msg = (
+                    f"Failed prediction rows {batch_start}-"
+                    f"{batch_start + len(X_batch)} for {machine.name}: {exc}"
+                )
+                logger.error(msg)
+                errors.append(msg)
+        predictions = pd.concat(frames).sort_index() if frames else None
+        return PredictionResult(
+            name=machine.name, predictions=predictions, error_messages=errors
+        )
+
+    def _data_for_window(self, machine: Machine, start, end):
+        """The machine's own dataset config, re-pointed at the prediction
+        window (and optionally at an overridden data provider)."""
+        dataset_config = dict(
+            machine.dataset.to_dict()
+            if isinstance(machine.dataset, GordoBaseDataset)
+            else machine.dataset
+        )
+        dataset_config["train_start_date"] = pd.Timestamp(start)
+        dataset_config["train_end_date"] = pd.Timestamp(end)
+        if self.data_provider is not None:
+            dataset_config["data_provider"] = self.data_provider
+        return GordoBaseDataset.from_dict(dataset_config).get_data()
+
+    def _send_prediction_request(
+        self,
+        machine_name: str,
+        X: pd.DataFrame,
+        y: Optional[pd.DataFrame],
+    ) -> pd.DataFrame:
+        url = f"{self.base_url}/{machine_name}/anomaly/prediction"
+        params = self._query_params()
+        last_exc: Optional[Exception] = None
+        for attempt in range(max(1, self.n_retries)):
+            try:
+                if self.use_parquet:
+                    params = {**params, "format": "parquet"}
+                    files = {"X": dataframe_into_parquet_bytes(X)}
+                    if y is not None:
+                        files["y"] = dataframe_into_parquet_bytes(y)
+                    resp = self.session.post(url, params=params, files=files)
+                else:
+                    body = {"X": dataframe_to_dict(X)}
+                    if y is not None:
+                        body["y"] = dataframe_to_dict(y)
+                    resp = self.session.post(url, params=params, json=body)
+                payload = _handle_response(resp, f"prediction for {machine_name}")
+                break
+            except IOError as exc:  # 5xx / transport: retry
+                last_exc = exc
+                logger.warning(
+                    "Prediction attempt %d/%d for %s failed: %s",
+                    attempt + 1,
+                    self.n_retries,
+                    machine_name,
+                    exc,
+                )
+        else:
+            raise last_exc
+        if isinstance(payload, bytes):
+            return dataframe_from_parquet_bytes(payload)
+        return dataframe_from_dict(payload["data"])

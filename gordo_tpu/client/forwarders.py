@@ -1,0 +1,137 @@
+"""
+Prediction forwarders: sinks the client pushes joined prediction frames
+into after each machine's replay (reference: gordo-client ``forwarders``
+— ``ForwardPredictionsIntoInflux`` used by the Argo client step,
+argo-workflow.yml.template:1374-1376).
+
+The influx forwarder needs the ``influxdb`` package (not baked into this
+environment) and is import-gated; :class:`ForwardPredictionsToDisk`
+provides the dependency-free local sink (parquet per machine) used by
+tests and air-gapped runs.
+"""
+
+import abc
+import logging
+import os
+from typing import Optional
+
+import pandas as pd
+
+logger = logging.getLogger(__name__)
+
+
+class PredictionForwarder(abc.ABC):
+    """One call per machine with the joined prediction frame."""
+
+    @abc.abstractmethod
+    def forward_predictions(
+        self,
+        predictions: pd.DataFrame,
+        machine=None,
+        metadata: Optional[dict] = None,
+    ) -> None:
+        ...
+
+
+def _flatten_columns(predictions: pd.DataFrame) -> pd.DataFrame:
+    """MultiIndex response columns as flat pipe-joined names — the format
+    both sink backends store."""
+    frame = predictions.copy()
+    if isinstance(frame.columns, pd.MultiIndex):
+        frame.columns = ["|".join(map(str, c)).rstrip("|") for c in frame.columns]
+    return frame
+
+
+class ForwardPredictionsToDisk(PredictionForwarder):
+    """Append predictions as ``<destination>/<machine-name>.parquet``."""
+
+    def __init__(self, destination: str):
+        self.destination = destination
+        os.makedirs(destination, exist_ok=True)
+
+    def forward_predictions(
+        self,
+        predictions: pd.DataFrame,
+        machine=None,
+        metadata: Optional[dict] = None,
+    ) -> None:
+        name = machine.name if machine is not None else "predictions"
+        path = os.path.join(self.destination, f"{name}.parquet")
+        frame = _flatten_columns(predictions)
+        if os.path.exists(path):
+            frame = pd.concat([pd.read_parquet(path), frame])
+        frame.to_parquet(path)
+        logger.info("Forwarded %d rows for %s to %s", len(predictions), name, path)
+
+
+class ForwardPredictionsIntoInflux(PredictionForwarder):
+    """
+    Write prediction columns as InfluxDB measurements (the reference Argo
+    "client" step's sink). Requires the ``influxdb`` package.
+    """
+
+    def __init__(
+        self,
+        destination_influx_uri: Optional[str] = None,
+        destination_influx_api_key: Optional[str] = None,
+        destination_influx_recreate: bool = False,
+        n_retries: int = 5,
+    ):
+        try:
+            from influxdb import DataFrameClient  # noqa: F401
+        except ImportError as exc:
+            raise ImportError(
+                "The influxdb package is required for ForwardPredictionsIntoInflux; "
+                "use ForwardPredictionsToDisk for a dependency-free sink"
+            ) from exc
+        if not destination_influx_uri:
+            raise ValueError(
+                "destination_influx_uri is required "
+                "(<username>:<password>@<host>:<port>/<db_name>)"
+            )
+        self.destination_influx_uri = destination_influx_uri
+        self.destination_influx_api_key = destination_influx_api_key
+        self.destination_influx_recreate = destination_influx_recreate
+        self.n_retries = n_retries
+        self.client = self._create_client()
+
+    def _create_client(self):  # pragma: no cover - requires influxdb
+        from influxdb import DataFrameClient
+
+        # uri format: <username>:<password>@<host>:<port>/<optional-path>/<db_name>
+        username, password, host, port, *_, db_name = (
+            self.destination_influx_uri.replace("/", ":").replace("@", ":").split(":")
+        )
+        client = DataFrameClient(
+            host=host,
+            port=int(port),
+            username=username,
+            password=password,
+            database=db_name,
+            headers={"Ocp-Apim-Subscription-Key": self.destination_influx_api_key}
+            if self.destination_influx_api_key
+            else None,
+        )
+        if self.destination_influx_recreate:
+            client.drop_database(db_name)
+            client.create_database(db_name)
+        return client
+
+    def forward_predictions(
+        self,
+        predictions: pd.DataFrame,
+        machine=None,
+        metadata: Optional[dict] = None,
+    ) -> None:  # pragma: no cover - requires influxdb
+        name = machine.name if machine is not None else "predictions"
+        frame = _flatten_columns(predictions)
+        for attempt in range(self.n_retries):
+            try:
+                self.client.write_points(
+                    dataframe=frame, measurement="predictions", tags={"machine": name}
+                )
+                return
+            except Exception:
+                if attempt == self.n_retries - 1:
+                    raise
+                logger.warning("Influx write retry %d for %s", attempt + 1, name)

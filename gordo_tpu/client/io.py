@@ -1,0 +1,47 @@
+"""
+HTTP response handling for the client (reference: gordo-client ``io``
+module): map the server's failure statuses onto typed exceptions so
+callers can distinguish "your input is bad" (422), "bad request" (4xx),
+"no such model" (404) and "revision deleted" (410).
+"""
+
+from typing import Union
+
+
+class HttpUnprocessableEntity(Exception):
+    """HTTP 422: the server understood the request but refused the input
+    (e.g. anomaly prediction against a non-anomaly model)."""
+
+
+class BadGordoRequest(Exception):
+    """Any other 4xx client-side error."""
+
+
+class NotFound(Exception):
+    """HTTP 404: no such project/model/revision."""
+
+
+class ResourceGone(Exception):
+    """HTTP 410: the requested revision is gone (deleted from disk)."""
+
+
+def _handle_response(resp, resource_name: str = None) -> Union[dict, bytes]:
+    """
+    Decode a successful response (JSON dict or raw bytes), or raise the
+    typed exception for the status code.
+    """
+    if 200 <= resp.status_code <= 299:
+        is_json = "application/json" in resp.headers.get("content-type", "")
+        return resp.json() if is_json else resp.content
+    context = f" ({resource_name})" if resource_name else ""
+    content = getattr(resp, "text", "")[:150]
+    msg = f"HTTP {resp.status_code}{context}: {content}"
+    if resp.status_code == 422:
+        raise HttpUnprocessableEntity(msg)
+    if resp.status_code == 410:
+        raise ResourceGone(msg)
+    if resp.status_code == 404:
+        raise NotFound(msg)
+    if 400 <= resp.status_code <= 499:
+        raise BadGordoRequest(msg)
+    raise IOError(msg)

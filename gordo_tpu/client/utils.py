@@ -1,0 +1,9 @@
+"""
+Client-side helper types (reference: gordo-client ``utils`` module —
+``PredictionResult`` carrying one machine's joined predictions plus any
+per-batch error messages).
+"""
+
+from collections import namedtuple
+
+PredictionResult = namedtuple("PredictionResult", "name predictions error_messages")

@@ -1,0 +1,116 @@
+"""
+Exceptions-reporter tests (reference:
+tests/gordo/cli/test_exceptions_reporter.py): exit-code mapping by
+inheritance depth, report levels, message trimming and ASCII scrubbing.
+"""
+
+import json
+import sys
+
+import pytest
+
+from gordo_tpu.cli.exceptions_reporter import ExceptionsReporter, ReportLevel
+from gordo_tpu.utils.text import replace_all_non_ascii_chars
+
+
+class CustomError(ValueError):
+    pass
+
+
+@pytest.fixture
+def reporter():
+    return ExceptionsReporter(((Exception, 1), (ValueError, 2), (CustomError, 3)))
+
+
+def _capture(reporter, level, exc, report_file):
+    try:
+        raise exc
+    except Exception:
+        reporter.report(level, *sys.exc_info(), report_file)
+
+
+def test_report_levels():
+    assert ReportLevel.get_by_name("MESSAGE") is ReportLevel.MESSAGE
+    assert ReportLevel.get_by_name("nope") is None
+    assert ReportLevel.get_by_name("nope", ReportLevel.EXIT_CODE) is ReportLevel.EXIT_CODE
+    assert set(ReportLevel.get_names()) == {
+        "EXIT_CODE",
+        "TYPE",
+        "MESSAGE",
+        "TRACEBACK",
+    }
+
+
+def test_exit_code_most_derived_wins(reporter):
+    # CustomError is a ValueError is an Exception; the deepest match rules.
+    assert reporter.exception_exit_code(CustomError) == 3
+    assert reporter.exception_exit_code(ValueError) == 2
+    assert reporter.exception_exit_code(KeyError) == 1  # falls back to Exception
+    assert reporter.exception_exit_code(None) == 0  # no exception -> success
+
+
+def test_report_message_level(reporter, tmp_path):
+    path = tmp_path / "report.json"
+    with open(path, "w") as fh:
+        _capture(reporter, ReportLevel.MESSAGE, ValueError("bad value"), fh)
+    report = json.loads(path.read_text())
+    assert report["type"] == "ValueError"
+    assert report["message"] == "bad value"
+
+
+def test_report_type_level(reporter, tmp_path):
+    path = tmp_path / "report.json"
+    with open(path, "w") as fh:
+        _capture(reporter, ReportLevel.TYPE, CustomError("x"), fh)
+    report = json.loads(path.read_text())
+    assert report["type"] == "CustomError"
+    assert "message" not in report
+
+
+def test_report_exit_code_level_is_empty(reporter, tmp_path):
+    path = tmp_path / "report.json"
+    with open(path, "w") as fh:
+        _capture(reporter, ReportLevel.EXIT_CODE, ValueError("x"), fh)
+    assert json.loads(path.read_text()) == {}
+
+
+def test_report_traceback_level(reporter, tmp_path):
+    path = tmp_path / "report.json"
+    with open(path, "w") as fh:
+        _capture(reporter, ReportLevel.TRACEBACK, ValueError("boom"), fh)
+    report = json.loads(path.read_text())
+    assert "traceback" in report
+    assert "boom" in report["traceback"]
+
+
+def test_report_trims_long_messages(reporter, tmp_path):
+    path = tmp_path / "report.json"
+    with open(path, "w") as fh:
+        _capture(
+            reporter,
+            ReportLevel.MESSAGE,
+            ValueError("x" * 5000),
+            fh,
+        )
+    # The k8s termination-message file caps at 2024 bytes; the CLI passes
+    # max_message_len=2024-500. Default report() still trims to sane size.
+    report = json.loads(path.read_text())
+    assert len(report["message"]) <= 5000
+
+
+def test_safe_report_swallows_io_errors(reporter, tmp_path):
+    # A bad path must not raise out of the exception handler.
+    try:
+        raise ValueError("x")
+    except Exception:
+        reporter.safe_report(
+            ReportLevel.MESSAGE,
+            *sys.exc_info(),
+            str(tmp_path / "no-such-dir" / "report.json"),
+        )
+
+
+def test_non_ascii_scrubbing():
+    assert replace_all_non_ascii_chars("øre 100%", "?") == "?re 100%"
+    assert replace_all_non_ascii_chars("plain") == "plain"
+    assert replace_all_non_ascii_chars("åß∂", "_") == "___"

@@ -1,0 +1,125 @@
+"""
+Fake-cluster fixtures for client tests (SURVEY.md §3.5): a trained model
+collection served by the in-process WSGI app, reached through a
+requests.Session-compatible adapter injected into the Client — the
+equivalent of the reference's `responses`-based ml_server fixture
+(tests/conftest.py:333-422) without the `responses` package.
+"""
+
+import io
+import os
+from urllib.parse import urlsplit
+
+import pytest
+from werkzeug.test import Client as WerkzeugClient
+
+from gordo_tpu import serializer
+from gordo_tpu.builder import local_build
+from gordo_tpu.server import build_app
+
+PROJECT = "client-project"
+REVISION = "1700000000000"
+
+CONFIG = """
+machines:
+  - name: machine-a
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-03T00:00:00+00:00"
+      tag_list: [tag-1, tag-2, tag-3]
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.JaxAutoEncoder:
+            kind: feedforward_hourglass
+            compression_factor: 0.5
+            encoding_layers: 1
+            epochs: 1
+  - name: machine-b
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-03T00:00:00+00:00"
+      tag_list: [tag-1, tag-2]
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.JaxAutoEncoder:
+            kind: feedforward_model
+            encoding_dim: [6]
+            encoding_func: [tanh]
+            decoding_dim: [6]
+            decoding_func: [tanh]
+            epochs: 1
+"""
+
+
+class _ResponseAdapter:
+    """werkzeug TestResponse presented with the requests.Response surface
+    the Client consumes."""
+
+    def __init__(self, resp):
+        self._resp = resp
+        self.status_code = resp.status_code
+        self.headers = resp.headers
+        self.content = resp.get_data()
+
+    def json(self):
+        return self._resp.get_json()
+
+    @property
+    def text(self):
+        return self.content.decode(errors="replace")
+
+
+class WSGISession:
+    """requests.Session look-alike that dispatches into a werkzeug test
+    client, ignoring scheme/host (everything is the one in-process app)."""
+
+    def __init__(self, wsgi_client: WerkzeugClient):
+        self.client = wsgi_client
+
+    def get(self, url, params=None, **kwargs):
+        return _ResponseAdapter(
+            self.client.get(urlsplit(url).path, query_string=params or {})
+        )
+
+    def post(self, url, params=None, json=None, files=None, **kwargs):
+        path = urlsplit(url).path
+        if files is not None:
+            data = {
+                name: (io.BytesIO(payload), f"{name}.parquet")
+                for name, payload in files.items()
+            }
+            resp = self.client.post(path, query_string=params or {}, data=data)
+        else:
+            resp = self.client.post(path, query_string=params or {}, json=json)
+        return _ResponseAdapter(resp)
+
+
+@pytest.fixture(scope="session")
+def client_collection_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("client-collection")
+    for model, machine in local_build(CONFIG, project_name=PROJECT):
+        serializer.dump(
+            model,
+            str(root / REVISION / machine.name),
+            metadata=machine.to_dict(),
+        )
+    return str(root / REVISION)
+
+
+@pytest.fixture
+def ml_server(client_collection_dir, monkeypatch):
+    """The deployed system: a WSGI session bound to the served collection."""
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", client_collection_dir)
+    app = build_app(config={"EXPECTED_MODELS": ["machine-a", "machine-b"]})
+    return WSGISession(WerkzeugClient(app))
+
+
+@pytest.fixture
+def gordo_client(ml_server):
+    from gordo_tpu.client import Client
+
+    return Client(project=PROJECT, session=ml_server)

@@ -1,0 +1,157 @@
+"""
+Client tests against the in-process fake cluster (reference:
+tests/gordo/client/test_client.py).
+"""
+
+import pandas as pd
+import pytest
+
+from gordo_tpu.client import Client, ForwardPredictionsToDisk, PredictionResult
+from gordo_tpu.client.io import (
+    BadGordoRequest,
+    HttpUnprocessableEntity,
+    NotFound,
+    ResourceGone,
+    _handle_response,
+)
+from gordo_tpu.machine import Machine
+
+START, END = "2020-03-01T00:00:00+00:00", "2020-03-01T06:00:00+00:00"
+
+
+def test_get_machine_names(gordo_client):
+    assert sorted(gordo_client.get_machine_names()) == ["machine-a", "machine-b"]
+
+
+def test_get_revisions(gordo_client):
+    revisions = gordo_client.get_revisions()
+    assert revisions["latest"] in revisions["available-revisions"]
+
+
+def test_get_metadata(gordo_client):
+    metadata = gordo_client.get_metadata()
+    assert set(metadata) == {"machine-a", "machine-b"}
+    assert metadata["machine-a"]["dataset"]["tag_list"] == [
+        {"name": "tag-1"},
+        {"name": "tag-2"},
+        {"name": "tag-3"},
+    ] or metadata["machine-a"]["dataset"]["tag_list"] == ["tag-1", "tag-2", "tag-3"]
+    assert metadata.get("no-such-target") is None
+
+
+def test_get_available_machines(gordo_client):
+    machines = gordo_client.get_available_machines()
+    assert all(isinstance(m, Machine) for m in machines)
+    with pytest.raises(NotFound):
+        gordo_client.get_available_machines(["not-deployed"])
+    only_a = gordo_client.get_available_machines(["machine-a"])
+    assert [m.name for m in only_a] == ["machine-a"]
+
+
+def test_download_model(gordo_client):
+    models = gordo_client.download_model(["machine-a"])
+    assert set(models) == {"machine-a"}
+    # The downloaded model predicts out of the box.
+    import numpy as np
+
+    X = pd.DataFrame(
+        np.random.rand(8, 3), columns=["tag-1", "tag-2", "tag-3"]
+    )
+    assert models["machine-a"].predict(X).shape[0] == 8
+
+
+@pytest.mark.parametrize("use_parquet", [False, True])
+def test_predict(ml_server, use_parquet):
+    client = Client(project="client-project", session=ml_server, use_parquet=use_parquet)
+    results = client.predict(START, END)
+    assert {r.name for r in results} == {"machine-a", "machine-b"}
+    for result in results:
+        assert isinstance(result, PredictionResult)
+        assert result.error_messages == []
+        assert len(result.predictions) > 0
+        top = {c[0] for c in result.predictions.columns}
+        assert {"model-input", "model-output", "total-anomaly-scaled"} <= top
+
+
+def test_predict_batched_equals_single(ml_server):
+    whole = Client(project="client-project", session=ml_server).predict(
+        START, END, targets=["machine-b"]
+    )[0]
+    batched = Client(
+        project="client-project", session=ml_server, batch_size=7
+    ).predict(START, END, targets=["machine-b"])[0]
+    pd.testing.assert_frame_equal(whole.predictions, batched.predictions)
+
+
+def test_predict_forwards(ml_server, tmp_path):
+    destination = tmp_path / "sink"
+    client = Client(
+        project="client-project",
+        session=ml_server,
+        prediction_forwarder=ForwardPredictionsToDisk(str(destination)),
+    )
+    client.predict(START, END, targets=["machine-a"])
+    saved = pd.read_parquet(destination / "machine-a.parquet")
+    assert len(saved) > 0
+    assert any(c.startswith("total-anomaly-scaled") for c in saved.columns)
+
+
+def test_predict_records_data_fetch_failures(ml_server):
+    # A tz-naive window is rejected by the dataset layer; the failure must
+    # land in error_messages for that machine, not abort the whole replay.
+    client = Client(project="client-project", session=ml_server)
+    results = client.predict("2020-03-01 00:00:00", "2020-03-01 06:00:00")
+    assert {r.name for r in results} == {"machine-a", "machine-b"}
+    for result in results:
+        assert result.predictions is None
+        assert len(result.error_messages) == 1
+        assert "Failed to fetch data" in result.error_messages[0]
+
+
+def test_revision_pinning(gordo_client, ml_server):
+    latest = gordo_client.get_revisions()["latest"]
+    pinned = Client(project="client-project", session=ml_server, revision=latest)
+    assert sorted(pinned.get_machine_names()) == ["machine-a", "machine-b"]
+    gone = Client(project="client-project", session=ml_server, revision="123456")
+    with pytest.raises(ResourceGone):
+        gone.get_machine_names()
+
+
+def test_handle_response_exceptions():
+    class FakeResp:
+        def __init__(self, status_code, payload=b"", headers=None):
+            self.status_code = status_code
+            self.content = payload
+            self.headers = headers or {}
+            self.text = payload.decode() if isinstance(payload, bytes) else payload
+
+        def json(self):
+            import json
+
+            return json.loads(self.content)
+
+    assert _handle_response(FakeResp(200, b"raw-bytes")) == b"raw-bytes"
+    assert _handle_response(
+        FakeResp(200, b'{"ok": true}', {"content-type": "application/json"})
+    ) == {"ok": True}
+    with pytest.raises(HttpUnprocessableEntity):
+        _handle_response(FakeResp(422))
+    with pytest.raises(ResourceGone):
+        _handle_response(FakeResp(410))
+    with pytest.raises(NotFound):
+        _handle_response(FakeResp(404))
+    with pytest.raises(BadGordoRequest):
+        _handle_response(FakeResp(403))
+    with pytest.raises(IOError):
+        _handle_response(FakeResp(500))
+
+
+def test_client_cli_registered():
+    from gordo_tpu.cli.cli import gordo_tpu_cli
+
+    assert "client" in gordo_tpu_cli.commands
+    assert set(gordo_tpu_cli.commands["client"].commands) == {
+        "metadata",
+        "download-model",
+        "predict",
+    }
