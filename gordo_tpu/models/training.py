@@ -193,18 +193,25 @@ def build_raw_fit_fn(spec: ModelSpec, config: FitConfig):
 
     def train_epoch(params, opt_state, Xtr, ytr, wtr, erng):
         n_total = Xtr.shape[0]
-        if config.shuffle:
-            perm = jax.random.permutation(erng, n_total)
-        else:
-            perm = jnp.arange(n_total)
         steps = n_total // config.batch_size
-        idx = perm.reshape(steps, config.batch_size)
+        if config.shuffle:
+            # One whole-array permutation per epoch, then contiguous batch
+            # slices via scan-over-xs. Per-batch index gathers were the fleet
+            # hot spot on TPU (measured 2.4× whole-fit slowdown at 256
+            # models): 640 small gather kernels vs 20 large ones.
+            perm = jax.random.permutation(erng, n_total)
+            Xtr = jnp.take(Xtr, perm, axis=0)
+            ytr = jnp.take(ytr, perm, axis=0)
+            wtr = jnp.take(wtr, perm, axis=0)
+        batches = (
+            Xtr.reshape((steps, config.batch_size) + Xtr.shape[1:]),
+            ytr.reshape((steps, config.batch_size) + ytr.shape[1:]),
+            wtr.reshape(steps, config.batch_size),
+        )
 
-        def step(carry, batch_idx):
+        def step(carry, batch):
             params, opt_state = carry
-            xb = jnp.take(Xtr, batch_idx, axis=0)
-            yb = jnp.take(ytr, batch_idx, axis=0)
-            wb = jnp.take(wtr, batch_idx, axis=0)
+            xb, yb, wb = batch
             loss, grads = grad_fn(params, xb, yb, wb)
             updates, new_opt_state = tx.update(grads, opt_state, params)
             # An all-padding batch (possible for short members of a padded
@@ -219,7 +226,9 @@ def build_raw_fit_fn(spec: ModelSpec, config: FitConfig):
             contribution = jnp.where(has_data, loss * jnp.sum(wb), 0.0)
             return (params, opt_state), contribution
 
-        (params, opt_state), weighted_losses = jax.lax.scan(step, (params, opt_state), idx)
+        (params, opt_state), weighted_losses = jax.lax.scan(
+            step, (params, opt_state), batches
+        )
         epoch_loss = jnp.sum(weighted_losses) / jnp.maximum(jnp.sum(wtr), 1.0)
         return params, opt_state, epoch_loss
 

@@ -32,7 +32,6 @@ from functools import lru_cache
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
@@ -74,11 +73,26 @@ class FleetResult:
     history: History
 
 
-def _pad_axis0(arr: np.ndarray, target: int) -> np.ndarray:
-    if len(arr) == target:
-        return arr
-    pad = np.zeros((target - len(arr),) + arr.shape[1:], arr.dtype)
-    return np.concatenate([arr, pad], axis=0)
+def host_prng_keys(seeds: Sequence[int]) -> np.ndarray:
+    """
+    Threefry PRNG keys built host-side, bit-identical to
+    ``jax.random.PRNGKey(seed)`` (the uint32 pair ``(seed >> 32, seed &
+    0xFFFFFFFF)`` in two's complement). ``PRNGKey`` is a tiny device
+    program per call — at fleet scale those round trips dominated staging
+    (measured 3.4s/1024 members over the axon tunnel);
+    tests/parallel/test_fleet.py asserts the bit-equality.
+    """
+    if jax.config.jax_enable_x64:
+        # int64 two's complement for negative seeds, like PRNGKey.
+        raw = np.asarray(seeds, np.int64).view(np.uint64)
+        hi = (raw >> np.uint64(32)).astype(np.uint32)
+        lo = (raw & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    else:
+        # x64 disabled (the default): PRNGKey casts the seed to int32, so
+        # the high word is always zero and the low word wraps modulo 2^32.
+        lo = np.asarray(seeds, np.int64).astype(np.int32).view(np.uint32)
+        hi = np.zeros_like(lo)
+    return np.stack([hi, lo], axis=-1)
 
 
 @lru_cache(maxsize=None)
@@ -182,12 +196,20 @@ class FleetTrainer:
         n_padded = -(-n_padded // step) * step
 
         def stacked(attr_arrays):
-            padded = [_pad_axis0(np.asarray(a, np.float32), n_padded) for a in attr_arrays]
-            dummy = np.zeros_like(padded[0])
-            return np.stack(padded + [dummy] * (m_total - len(padded)))
+            # Fill a preallocated block instead of pad-then-np.stack: one
+            # copy per member, zero rows double as sample padding and
+            # zero-weight dummy models.
+            out = np.zeros(
+                (m_total, n_padded) + np.shape(attr_arrays[0])[1:], np.float32
+            )
+            for i, a in enumerate(attr_arrays):
+                out[i, : len(a)] = a
+            return out
 
         X = stacked([m.X for m in bucket])
-        y = stacked([m.y for m in bucket])
+        # The AE fleet overwhelmingly trains y == X; staging X once and
+        # aliasing saves a second 100s-of-MB host copy + tunnel transfer.
+        y = X if all(m.y is m.X for m in bucket) else stacked([m.y for m in bucket])
 
         wtr = np.zeros((m_total, n_padded), np.float32)
         wval = np.zeros((m_total, n_padded), np.float32)
@@ -202,20 +224,19 @@ class FleetTrainer:
             if member.val_weights is not None:
                 wval[i, : member.n] = member.val_weights
 
-        rngs = jnp.stack(
-            [jax.random.PRNGKey(m.seed) for m in bucket]
-            + [jax.random.PRNGKey(0)] * (m_total - len(bucket))
-        )
-        data_sharding = model_data_sharding(self.mesh, extra_dims=X.ndim - 2)
+        rngs = host_prng_keys([m.seed for m in bucket] + [0] * (m_total - len(bucket)))
         w_sharding = model_data_sharding(self.mesh)
-        X = jax.device_put(X, data_sharding)
-        y = jax.device_put(
-            y, model_data_sharding(self.mesh, extra_dims=y.ndim - 2)
+        X_dev = jax.device_put(X, model_data_sharding(self.mesh, extra_dims=X.ndim - 2))
+        y_dev = (
+            X_dev
+            if y is X
+            else jax.device_put(y, model_data_sharding(self.mesh, extra_dims=y.ndim - 2))
         )
-        wtr = jax.device_put(wtr, w_sharding)
-        wval = jax.device_put(wval, w_sharding)
-        rngs = jax.device_put(rngs, model_sharding(self.mesh, extra_dims=1))
-        return X, y, wtr, wval, rngs
+        wtr, wval, rngs = jax.device_put(
+            (wtr, wval, rngs),
+            (w_sharding, w_sharding, model_sharding(self.mesh, extra_dims=1)),
+        )
+        return X_dev, y_dev, wtr, wval, rngs
 
     def _train_bucket(
         self,

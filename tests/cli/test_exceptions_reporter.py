@@ -84,18 +84,19 @@ def test_report_traceback_level(reporter, tmp_path):
 
 
 def test_report_trims_long_messages(reporter, tmp_path):
+    # The k8s termination-message file caps at 2024 bytes; the CLI passes
+    # max_message_len=2024-500 (reference cli/cli.py:180).
     path = tmp_path / "report.json"
     with open(path, "w") as fh:
-        _capture(
-            reporter,
-            ReportLevel.MESSAGE,
-            ValueError("x" * 5000),
-            fh,
-        )
-    # The k8s termination-message file caps at 2024 bytes; the CLI passes
-    # max_message_len=2024-500. Default report() still trims to sane size.
+        try:
+            raise ValueError("x" * 5000)
+        except Exception:
+            reporter.report(
+                ReportLevel.MESSAGE, *sys.exc_info(), fh, max_message_len=2024 - 500
+            )
     report = json.loads(path.read_text())
-    assert len(report["message"]) <= 5000
+    assert len(report["message"]) <= 2024 - 500
+    assert report["message"].startswith("xxx")
 
 
 def test_safe_report_swallows_io_errors(reporter, tmp_path):

@@ -138,3 +138,18 @@ def test_no_val_member_has_no_val_history():
         [member], FitConfig(epochs=2, batch_size=16, validation_split=0.0)
     )[0]
     assert "val_loss" not in result.history.history
+
+
+def test_host_prng_keys_bit_equal_jax():
+    """host_prng_keys must match jax.random.PRNGKey bit-for-bit (the fleet
+    staging path builds keys host-side to avoid per-member device round
+    trips; any divergence would silently desync fleet vs fit_single RNG)."""
+    import jax
+
+    from gordo_tpu.parallel.fleet import host_prng_keys
+
+    seeds = [0, 1, 7, 42, 2**31 - 1, 2**32 + 5, -1, -1234567]
+    keys = host_prng_keys(seeds)
+    for seed, key in zip(seeds, keys):
+        expected = np.asarray(jax.random.PRNGKey(seed))
+        np.testing.assert_array_equal(key, expected, err_msg=f"seed={seed}")
