@@ -1,0 +1,85 @@
+"""
+In-process ML-server latency benchmark (no network, no pytest-benchmark
+dependency): builds two tiny models via local_build, serves them through
+the WSGI test client, and reports per-route latency percentiles.
+
+Usage: python benchmarks/bench_ml_server.py [rounds]
+"""
+
+import json
+import statistics
+import sys
+import tempfile
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+from werkzeug.test import Client  # noqa: E402
+
+from gordo_tpu import serializer  # noqa: E402
+from gordo_tpu.builder import local_build  # noqa: E402
+from gordo_tpu.server import build_app  # noqa: E402
+
+CONFIG = """
+machines:
+  - name: bench-machine
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-05T00:00:00+00:00"
+      tag_list: [tag-1, tag-2, tag-3, tag-4]
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.JaxAutoEncoder:
+            kind: feedforward_hourglass
+            epochs: 2
+"""
+
+
+def percentile(xs, p):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p / 100 * len(xs)))]
+
+
+def main(rounds: int = 100):
+    import os
+
+    tmp = tempfile.mkdtemp()
+    model, machine = next(local_build(CONFIG, project_name="bench"))
+    out = f"{tmp}/rev1/{machine.name}"
+    serializer.dump(model, out, metadata=machine.to_dict())
+    os.environ["MODEL_COLLECTION_DIR"] = f"{tmp}/rev1"
+    client = Client(build_app())
+
+    index = [f"2020-03-01T{h:02d}:{m:02d}:00+00:00" for h in range(17) for m in range(0, 60, 10)][:100]
+    rng = np.random.RandomState(0)
+    X = {f"tag-{i}": {ts: float(v) for ts, v in zip(index, rng.rand(100))} for i in range(1, 5)}
+    base = f"/gordo/v0/bench/{machine.name}"
+
+    results = {}
+    for route, payload in [
+        (f"{base}/prediction", {"X": X}),
+        (f"{base}/anomaly/prediction", {"X": X, "y": X}),
+    ]:
+        resp = client.post(route, json=payload)  # warmup + compile
+        assert resp.status_code == 200, (route, resp.status_code, resp.text[:300])
+        times = []
+        for _ in range(rounds):
+            start = time.perf_counter()
+            client.post(route, json=payload)
+            times.append(time.perf_counter() - start)
+        results[route.rsplit("/", 2)[-1] if "anomaly" not in route else "anomaly"] = {
+            "mean_ms": round(statistics.mean(times) * 1e3, 2),
+            "p50_ms": round(percentile(times, 50) * 1e3, 2),
+            "p95_ms": round(percentile(times, 95) * 1e3, 2),
+            "rounds": rounds,
+        }
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 100)

@@ -1,0 +1,78 @@
+"""
+Thread-based load generator for a deployed gordo-tpu server — the
+dependency-free analog of the reference's Locust harness
+(benchmarks/load_test/load_test.py there): one task per deployed model,
+POSTing anomaly predictions at the configured concurrency and reporting
+aggregate request rate + error counts.
+
+Usage:
+    python load_test.py --host http://localhost:5555 --project my-project \
+        --targets machine-1 machine-2 --concurrency 8 --duration 30
+"""
+
+import argparse
+import collections
+import threading
+import time
+
+import numpy as np
+import requests
+
+
+def make_payload(tags, rows=100):
+    index = [f"2020-03-01T{i // 6:02d}:{(i % 6) * 10:02d}:00+00:00" for i in range(rows)]
+    rng = np.random.RandomState(0)
+    values = {t: {ts: float(v) for ts, v in zip(index, rng.rand(rows))} for t in tags}
+    return {"X": values, "y": values}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", required=True)
+    ap.add_argument("--project", required=True)
+    ap.add_argument("--targets", nargs="+", required=True)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--rows", type=int, default=100)
+    args = ap.parse_args()
+
+    stats = collections.Counter()
+    lock = threading.Lock()
+    stop = time.time() + args.duration
+
+    def worker(i):
+        session = requests.Session()
+        target = args.targets[i % len(args.targets)]
+        meta = session.get(
+            f"{args.host}/gordo/v0/{args.project}/{target}/metadata"
+        ).json()
+        tags = [
+            t["name"]
+            for t in meta["metadata"]["dataset"]["tag_list"]
+        ] if isinstance(meta.get("metadata", {}).get("dataset", {}), dict) else []
+        payload = make_payload(tags or [f"tag-{j}" for j in range(1, 5)], args.rows)
+        url = f"{args.host}/gordo/v0/{args.project}/{target}/anomaly/prediction"
+        while time.time() < stop:
+            try:
+                resp = session.post(url, json=payload, timeout=30)
+                key = f"http_{resp.status_code}"
+            except Exception as exc:  # noqa: BLE001 - load tool tallies all
+                key = type(exc).__name__
+            with lock:
+                stats[key] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(args.concurrency)]
+    start = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.time() - start
+    total = sum(stats.values())
+    print(f"requests: {total} in {elapsed:.1f}s -> {total / elapsed:.1f} req/s")
+    for key, count in sorted(stats.items()):
+        print(f"  {key}: {count}")
+
+
+if __name__ == "__main__":
+    main()

@@ -68,6 +68,18 @@ logger = logging.getLogger(__name__)
     help="Run with custom log-level.",
     envvar="GORDO_LOG_LEVEL",
 )
+@click.option(
+    "--jax-platform",
+    type=str,
+    default=None,
+    help=(
+        "Force the JAX platform (e.g. 'cpu', 'tpu'). TPU plugins may "
+        "override JAX_PLATFORMS through jax.config, so this sets the config "
+        "value directly — the escape hatch when a builder pod must run "
+        "CPU-only or a TPU runtime is unreachable."
+    ),
+    envvar="GORDO_TPU_PLATFORM",
+)
 @click.pass_context
 def gordo_tpu_cli(gordo_ctx: click.Context, **ctx):
     """The gordo-tpu command line interface."""
@@ -78,6 +90,11 @@ def gordo_tpu_cli(gordo_ctx: click.Context, **ctx):
             "[%(name)s.%(funcName)s:%(lineno)d] %(message)s"
         ),
     )
+    platform = gordo_ctx.params.get("jax_platform")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     gordo_ctx.obj = gordo_ctx.params
 
 

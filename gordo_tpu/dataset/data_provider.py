@@ -52,7 +52,22 @@ class GordoBaseDataProvider(abc.ABC):
         provider_type = config.pop("type", None)
         if provider_type is None:
             return cls(**config)
-        ProviderClass = import_location(provider_type)
+        if "." not in provider_type:
+            # Bare names as the reference example configs use them
+            # (examples/config.yaml: ``type: RandomDataProvider``); resolved
+            # against this module, like gordo-core's provider registry.
+            import sys
+
+            candidate = getattr(sys.modules[__name__], provider_type, None)
+            if candidate is None or not (
+                isinstance(candidate, type) and issubclass(candidate, cls)
+            ):
+                raise ValueError(
+                    f"Unknown data provider short name: {provider_type!r}"
+                )
+            ProviderClass: type = candidate
+        else:
+            ProviderClass = import_location(provider_type)
         return ProviderClass(**config)
 
 

@@ -93,6 +93,11 @@ class GordoBaseDataset(abc.ABC):
         at gordo/machine/machine.py and builder/build_model.py.
         """
         config = dict(config)
+        # gordo-core accepts `tags` / `target_tags` aliases (the reference's
+        # examples/config.yaml uses `tags:`); normalize to the canonical keys.
+        for alias, canonical in (("tags", "tag_list"), ("target_tags", "target_tag_list")):
+            if alias in config and canonical not in config:
+                config[canonical] = config.pop(alias)
         dataset_type = config.pop("type", None)
         if dataset_type is None or dataset_type in (
             "TimeSeriesDataset",

@@ -1,0 +1,125 @@
+"""
+Pallas TPU kernel: the fleet feedforward-AE batch as ONE fused kernel.
+
+The serving hot loop (reference call stack §3.3: ``model.anomaly`` →
+``self.predict(X)``, gordo/machine/model/anomaly/diff.py:310-458) for a
+feedforward AE is a stack of small dense layers. Model dims are tiny
+(hourglass of a ~20-tag asset), so when a fleet of M models scores a batch
+at once, XLA's batched-matmul path emits one kernel per layer and streams
+the [M, B, hidden] activations through HBM between them. This kernel
+instead walks the whole stack for one model per grid step with every
+activation resident in VMEM: grid = (M,), each step loads the model's
+weights + its row block, applies all L layers and the output head, and
+writes only the final reconstruction back to HBM.
+
+The layer walk is unrolled at trace time from the spec (static), so the
+kernel is recompiled per architecture — exactly like the XLA path, which
+is cached per (spec, shape) too.
+
+CPU tests run with ``interpret=True`` (no TPU needed); numerical parity
+with :func:`gordo_tpu.models.nn.forward_feedforward` is asserted in
+tests/ops/test_pallas_dense.py.
+"""
+
+from functools import partial
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU-only installs too, but guard anyway
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    _VMEM = None
+
+from ..models.spec import FeedForwardSpec
+from .activations import resolve_activation
+
+Params = Dict[str, Dict[str, jnp.ndarray]]
+
+
+def _layer_names(spec: FeedForwardSpec) -> List[Tuple[str, str]]:
+    """[(param key, activation name), ...] in forward order."""
+    names = [(f"dense_{i}", spec.activations[i]) for i in range(len(spec.dims))]
+    names.append(("out", spec.out_activation))
+    return names
+
+
+def fleet_feedforward_pallas(
+    spec: FeedForwardSpec,
+    stacked_params: Params,
+    X: jnp.ndarray,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """
+    Fused forward for a stacked fleet: ``X[M, B, F] -> [M, B, F_out]``.
+
+    ``stacked_params`` is the fleet pytree (leading model axis on every
+    leaf), as produced by ``parallel.fleet.stack_member_params``.
+
+    Semantically identical to ``vmap(forward_feedforward)`` without the
+    activity-penalty output (inference only).
+    """
+    names = _layer_names(spec)
+    M, B, F = X.shape
+    f_out = spec.n_features_out
+
+    # Flatten params into the pallas_call argument list, layer order.
+    flat: List[jnp.ndarray] = []
+    for key, _ in names:
+        flat.append(stacked_params[key]["W"])
+        flat.append(stacked_params[key]["b"])
+
+    def kernel(x_ref, *refs):
+        out_ref = refs[-1]
+        param_refs = refs[:-1]
+        h = x_ref[0]  # [B, F] this model's row block, in VMEM
+        for li, (_, act_name) in enumerate(names):
+            w = param_refs[2 * li][0]  # [d_in, d_out]
+            b = param_refs[2 * li + 1][0]  # [d_out]
+            h = jnp.dot(h, w, preferred_element_type=jnp.float32) + b
+            h = resolve_activation(act_name)(h)
+        out_ref[0] = h
+
+    mem = {} if _VMEM is None else {"memory_space": _VMEM}
+    in_specs = [pl.BlockSpec((1, B, F), lambda m: (m, 0, 0), **mem)]
+    for key, _ in names:
+        w = stacked_params[key]["W"]
+        b = stacked_params[key]["b"]
+        d_in, d_out = w.shape[-2], w.shape[-1]
+        in_specs.append(pl.BlockSpec((1, d_in, d_out), lambda m: (m, 0, 0), **mem))
+        in_specs.append(pl.BlockSpec((1, d_out), lambda m: (m, 0), **mem))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(M,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, B, f_out), lambda m: (m, 0, 0), **mem),
+        out_shape=jax.ShapeDtypeStruct((M, B, f_out), jnp.float32),
+        interpret=interpret,
+    )(X.astype(jnp.float32), *flat)
+
+
+@partial(jax.jit, static_argnums=(0,), static_argnames=("interpret",))
+def fleet_anomaly_scores_pallas(
+    spec: FeedForwardSpec,
+    stacked_params: Params,
+    X: jnp.ndarray,
+    y: jnp.ndarray,
+    *,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """
+    Fused fleet scoring: ``(reconstruction[M, B, F_out], mse[M, B])``.
+
+    The per-row mean-squared error is the ``total-anomaly-unscaled``
+    column of the anomaly response (diff.py:387-415 semantics); the
+    reconstruction feeds the ``model-output`` columns.
+    """
+    out = fleet_feedforward_pallas(spec, stacked_params, X, interpret=interpret)
+    err = ((out - y.astype(jnp.float32)) ** 2).mean(axis=-1)
+    return out, err

@@ -1,5 +1,6 @@
 from .fleet import FleetMember, FleetResult, FleetTrainer
 from .fleet_build import FleetBuilder, fleet_build
+from .sequence import ring_windowed_anomaly_scores, ring_windowed_predict
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -21,4 +22,6 @@ __all__ = [
     "initialize_backend",
     "MODEL_AXIS",
     "DATA_AXIS",
+    "ring_windowed_predict",
+    "ring_windowed_anomaly_scores",
 ]

@@ -88,7 +88,10 @@ def dataframe_to_dict(df: pd.DataFrame) -> dict:
     """
     data = df.copy()
     if isinstance(data.index, pd.DatetimeIndex):
-        data.index = data.index.astype(str)
+        # map(str), not astype(str): astype date-formats an all-midnight
+        # index ('2019-01-01'), dropping the time component the reference's
+        # wire format always carries ('2019-01-01 00:00:00').
+        data.index = data.index.map(str)
     if isinstance(df.columns, pd.MultiIndex):
         return {
             col: (

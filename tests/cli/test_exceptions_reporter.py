@@ -22,11 +22,11 @@ def reporter():
     return ExceptionsReporter(((Exception, 1), (ValueError, 2), (CustomError, 3)))
 
 
-def _capture(reporter, level, exc, report_file):
+def _capture(reporter, level, exc, report_file, **report_kwargs):
     try:
         raise exc
     except Exception:
-        reporter.report(level, *sys.exc_info(), report_file)
+        reporter.report(level, *sys.exc_info(), report_file, **report_kwargs)
 
 
 def test_report_levels():
@@ -88,12 +88,13 @@ def test_report_trims_long_messages(reporter, tmp_path):
     # max_message_len=2024-500 (reference cli/cli.py:180).
     path = tmp_path / "report.json"
     with open(path, "w") as fh:
-        try:
-            raise ValueError("x" * 5000)
-        except Exception:
-            reporter.report(
-                ReportLevel.MESSAGE, *sys.exc_info(), fh, max_message_len=2024 - 500
-            )
+        _capture(
+            reporter,
+            ReportLevel.MESSAGE,
+            ValueError("x" * 5000),
+            fh,
+            max_message_len=2024 - 500,
+        )
     report = json.loads(path.read_text())
     assert len(report["message"]) <= 2024 - 500
     assert report["message"].startswith("xxx")

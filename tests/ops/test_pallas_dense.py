@@ -1,0 +1,52 @@
+"""Pallas fused fleet forward vs the reference jnp forward (interpret mode)."""
+
+import jax
+import numpy as np
+import pytest
+
+from gordo_tpu.models.factories import feedforward_hourglass, feedforward_model
+from gordo_tpu.models.nn import forward_feedforward, init_feedforward
+from gordo_tpu.ops.pallas_dense import (
+    fleet_anomaly_scores_pallas,
+    fleet_feedforward_pallas,
+)
+
+
+def _stacked(spec, m, rng):
+    keys = jax.random.split(jax.random.PRNGKey(rng), m)
+    return jax.vmap(lambda k: init_feedforward(k, spec))(keys)
+
+
+@pytest.mark.parametrize("m,b", [(1, 8), (4, 32)])
+def test_pallas_forward_matches_jnp(m, b):
+    spec = feedforward_hourglass(12)
+    params = _stacked(spec, m, 0)
+    X = np.random.RandomState(0).rand(m, b, 12).astype(np.float32)
+
+    expected = jax.vmap(lambda p, x: forward_feedforward(spec, p, x)[0])(params, X)
+    got = fleet_feedforward_pallas(spec, params, X, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_forward_explicit_dims_relu():
+    spec = feedforward_model(6, 6, encoding_dim=(8, 4), decoding_dim=(4, 8),
+                             encoding_func=("relu", "relu"), decoding_func=("relu", "relu"))
+    params = _stacked(spec, 3, 1)
+    X = np.random.RandomState(1).rand(3, 16, 6).astype(np.float32)
+    expected = jax.vmap(lambda p, x: forward_feedforward(spec, p, x)[0])(params, X)
+    got = fleet_feedforward_pallas(spec, params, X, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_anomaly_scores():
+    spec = feedforward_hourglass(5)
+    params = _stacked(spec, 2, 2)
+    X = np.random.RandomState(2).rand(2, 10, 5).astype(np.float32)
+    out, err = fleet_anomaly_scores_pallas(spec, params, X, X, interpret=True)
+    expected_out = jax.vmap(lambda p, x: forward_feedforward(spec, p, x)[0])(params, X)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected_out), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(err),
+        ((np.asarray(expected_out) - X) ** 2).mean(-1),
+        rtol=1e-5, atol=1e-6,
+    )

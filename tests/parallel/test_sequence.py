@@ -1,0 +1,85 @@
+"""Time-axis (sequence) parallelism: halo-exchange windowed scoring."""
+
+import jax
+import numpy as np
+import pytest
+
+from gordo_tpu.models.factories import feedforward_hourglass, lstm_model
+from gordo_tpu.models.nn import init_fn_for
+from gordo_tpu.models.training import predict_fn
+from gordo_tpu.ops.windows import sliding_windows
+from gordo_tpu.parallel.sequence import (
+    ring_windowed_anomaly_scores,
+    ring_windowed_predict,
+)
+from jax.sharding import Mesh
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    dev = jax.devices()
+    return Mesh(np.array(dev).reshape(len(dev)), ("data",))
+
+
+def _lstm_setup(n_features=3, lookback=12, lookahead=0):
+    spec = lstm_model(n_features, lookback_window=lookback)
+    params = init_fn_for(spec)(jax.random.PRNGKey(0), spec)
+    return spec, params
+
+
+@pytest.mark.parametrize("lookahead", [0, 1])
+@pytest.mark.parametrize("n", [200, 203])  # exact and ragged chunking
+def test_ring_predict_matches_single_device(seq_mesh, n, lookahead):
+    lookback = 12
+    spec, params = _lstm_setup(lookback=lookback, lookahead=lookahead)
+    X = np.random.RandomState(0).rand(n, 3).astype(np.float32)
+    fn = predict_fn(spec)
+
+    expected = np.asarray(fn(params, sliding_windows(X, lookback, lookahead)))
+    got = ring_windowed_predict(
+        fn, params, X, lookback, lookahead, mesh=seq_mesh
+    )
+    assert got.shape == expected.shape
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_ring_predict_short_chunks_still_correct(seq_mesh):
+    # chunk < halo forces the chunk-floor path
+    lookback = 40
+    spec, params = _lstm_setup(lookback=lookback)
+    X = np.random.RandomState(1).rand(90, 3).astype(np.float32)
+    fn = predict_fn(spec)
+    expected = np.asarray(fn(params, sliding_windows(X, lookback, 0)))
+    got = ring_windowed_predict(fn, params, X, lookback, 0, mesh=seq_mesh)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_ring_predict_too_short_raises(seq_mesh):
+    spec, params = _lstm_setup(lookback=12)
+    X = np.random.RandomState(2).rand(5, 3).astype(np.float32)
+    with pytest.raises(ValueError, match="too short"):
+        ring_windowed_predict(predict_fn(spec), params, X, 12, 0, mesh=seq_mesh)
+
+
+def test_ring_anomaly_scores_align_targets(seq_mesh):
+    lookback = 8
+    spec, params = _lstm_setup(lookback=lookback)
+    X = np.random.RandomState(3).rand(120, 3).astype(np.float32)
+    fn = predict_fn(spec)
+    scores = ring_windowed_anomaly_scores(
+        fn, params, X, None, lookback, 0, mesh=seq_mesh
+    )
+    pred = np.asarray(fn(params, sliding_windows(X, lookback, 0)))
+    expected = (pred - X[lookback - 1 :]) ** 2
+    np.testing.assert_allclose(scores, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_ring_rejects_multiaxis_mesh():
+    dev = jax.devices()
+    if len(dev) < 4:
+        pytest.skip("needs >=4 devices")
+    mesh = Mesh(np.array(dev[:4]).reshape(2, 2), ("models", "data"))
+    spec, params = _lstm_setup(lookback=4)
+    X = np.random.RandomState(4).rand(64, 3).astype(np.float32)
+    with pytest.raises(ValueError, match="axis 'models' has size 2"):
+        ring_windowed_predict(predict_fn(spec), params, X, 4, 0, mesh=mesh)

@@ -1,0 +1,61 @@
+"""The shipped example configs must load, build, and generate workflows
+(the reference executes its examples as tests: tests/test_examples.py)."""
+
+import os
+
+import pytest
+import yaml
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+@pytest.fixture(scope="module")
+def example_config_path():
+    return os.path.join(EXAMPLES, "config.yaml")
+
+
+def test_example_config_builds_first_machine(example_config_path):
+    from gordo_tpu.builder import local_build
+    from gordo_tpu.workflow.workflow_generator import get_dict_from_yaml
+
+    with open(example_config_path) as fh:
+        config = get_dict_from_yaml(fh)
+    # Trim to one machine + fewer epochs to keep the test fast.
+    config["machines"] = config["machines"][:1]
+    model, machine = next(local_build(yaml.safe_dump(config)))
+    assert machine.name == "ct-23-0001"
+    assert machine.metadata.build_metadata.model.cross_validation.scores
+
+
+def test_example_config_generates_workflow(example_config_path, tmp_path):
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import gordo_tpu_cli
+
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo_tpu_cli,
+        [
+            "workflow",
+            "generate",
+            "--machine-config",
+            example_config_path,
+            "--project-name",
+            "example-project",
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    docs = [d for d in yaml.safe_load_all(result.output) if d]
+    assert docs, "workflow generate emitted no documents"
+
+
+def test_example_model_configurations_all_resolve():
+    from gordo_tpu import serializer
+
+    with open(os.path.join(EXAMPLES, "model-configuration.yaml")) as fh:
+        blocks = yaml.safe_load(fh)
+    for name, definition in blocks.items():
+        model = serializer.from_definition(definition)
+        assert model is not None, name
+        # and they round-trip back into definitions
+        serializer.into_definition(model)
