@@ -46,10 +46,10 @@ def main():
         meta = session.get(
             f"{args.host}/gordo/v0/{args.project}/{target}/metadata"
         ).json()
-        tags = [
-            t["name"]
-            for t in meta["metadata"]["dataset"]["tag_list"]
-        ] if isinstance(meta.get("metadata", {}).get("dataset", {}), dict) else []
+        raw_tags = meta.get("metadata", {}).get("dataset", {}).get("tag_list", [])
+        # tag_list entries are dicts for SensorTags but plain strings for
+        # string-configured tags (dataset.to_dict passes those through).
+        tags = [t["name"] if isinstance(t, dict) else str(t) for t in raw_tags]
         payload = make_payload(tags or [f"tag-{j}" for j in range(1, 5)], args.rows)
         url = f"{args.host}/gordo/v0/{args.project}/{target}/anomaly/prediction"
         while time.time() < stop:

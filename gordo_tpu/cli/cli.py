@@ -386,7 +386,14 @@ def build_fleet(
                 config = yaml.safe_load(f)
         else:
             config = yaml.safe_load(machines_config)
-        machines = [Machine.from_dict(m) for m in config["machines"]]
+        # ConfigMap dicts from `workflow generate` are fully resolved; a
+        # hand-written document may instead carry project_name at the top
+        # level (or omit it entirely for local runs).
+        project = config.get("project_name", "fleet-build")
+        machine_dicts = [dict(m) for m in config["machines"]]
+        for m in machine_dicts:
+            m.setdefault("project_name", project)
+        machines = [Machine.from_dict(m) for m in machine_dicts]
 
         from ..parallel.fleet_build import FleetBuilder
 

@@ -122,6 +122,74 @@ def _tree_where(flag, a, b):
     )
 
 
+def _make_fit_loop(config: FitConfig, train_epoch, evaluate_val):
+    """
+    The shared epochs×early-stopping scaffold of every fused fit program
+    (dense and windowed): scans ``train_epoch`` over per-epoch RNG keys
+    with EarlyStopping compiled in as masked updates.
+
+    ``train_epoch(params, opt_state, erng) -> (params, opt_state, loss)``
+    and ``evaluate_val(params) -> val_loss`` (NaN when there is no
+    validation data — see weighted_mean_loss) close over the training
+    arrays; this function owns everything else.
+
+    Returns ``fit_tail(params, opt_state, rng) -> (params, opt_state,
+    losses[epochs], val_losses[epochs], epochs_ran)``.
+    """
+    es = config.early_stopping
+    monitor_val = es is not None and es[0] == "val_loss"
+
+    def fit_tail(params, opt_state, rng):
+        def epoch_body(carry, erng):
+            params, opt_state, best, best_params, wait, stopped = carry
+            stopped_at_start = stopped
+            new_params, new_opt, loss = train_epoch(params, opt_state, erng)
+            # When already stopped, freeze state (masked update keeps one
+            # compiled program; tiny models make the dead compute negligible).
+            params = _tree_where(stopped, params, new_params)
+            opt_state = _tree_where(stopped, opt_state, new_opt)
+            val_loss = evaluate_val(params)
+            if es is not None:
+                if monitor_val:
+                    # Per-member fallback: a fleet member with no validation
+                    # rows gets NaN val_loss; monitor train loss instead.
+                    monitor = jnp.where(jnp.isnan(val_loss), loss, val_loss)
+                else:
+                    monitor = loss
+                improved = monitor < best - es[2]
+                best = jnp.where(~stopped & improved, monitor, best)
+                if es[3]:
+                    best_params = _tree_where(
+                        ~stopped & improved, params, best_params
+                    )
+                wait = jnp.where(stopped, wait, jnp.where(improved, 0, wait + 1))
+                stopped = stopped | (wait >= jnp.maximum(es[1], 1))
+            ran = ~stopped_at_start if es is not None else jnp.array(True)
+            return (params, opt_state, best, best_params, wait, stopped), (
+                loss,
+                val_loss,
+                ran,
+            )
+
+        rngs = jax.random.split(rng, config.epochs)
+        init_carry = (
+            params,
+            opt_state,
+            jnp.array(jnp.inf, jnp.float32),
+            params,
+            jnp.array(0, jnp.int32),
+            jnp.array(False),
+        )
+        (params, opt_state, _, best_params, _, _), (losses, val_losses, ran) = (
+            jax.lax.scan(epoch_body, init_carry, rngs)
+        )
+        if es is not None and es[3]:
+            params = best_params
+        return params, opt_state, losses, val_losses, jnp.sum(ran.astype(jnp.int32))
+
+    return fit_tail
+
+
 def _pad_to_batches(
     X: np.ndarray, y: np.ndarray, batch_size: int
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
@@ -179,8 +247,6 @@ def build_raw_fit_fn(spec: ModelSpec, config: FitConfig):
     forward = forward_fn_for(spec)
     per_sample = resolve_loss(spec.loss)
     tx = spec.optimizer.to_optax()
-    es = config.early_stopping
-    monitor_val = es is not None and es[0] == "val_loss"
 
     def batch_loss(params, xb, yb, wb):
         out, penalty = forward(spec, params, xb)
@@ -237,60 +303,132 @@ def build_raw_fit_fn(spec: ModelSpec, config: FitConfig):
         return weighted_mean_loss(per_sample(out, y), w)
 
     def fit(params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng):
-        has_val = Xval.shape[0] > 0
+        has_val = Xval.shape[0] > 0  # static branch: no-val fleets skip it
 
-        def epoch_body(carry, erng):
-            params, opt_state, best, best_params, wait, stopped = carry
-            stopped_at_start = stopped
-            new_params, new_opt, loss = train_epoch(
-                params, opt_state, Xtr, ytr, wtr, erng
-            )
-            # When already stopped, freeze state (masked update keeps one
-            # compiled program; tiny models make the dead compute negligible).
-            params = _tree_where(stopped, params, new_params)
-            opt_state = _tree_where(stopped, opt_state, new_opt)
-            val_loss = (
-                evaluate(params, Xval, yval, wval)
+        fit_tail = _make_fit_loop(
+            config,
+            train_epoch=lambda p, o, erng: train_epoch(p, o, Xtr, ytr, wtr, erng),
+            evaluate_val=lambda p: (
+                evaluate(p, Xval, yval, wval)
                 if has_val
-                else jnp.array(jnp.nan, loss.dtype)
-            )
-            if es is not None:
-                if monitor_val and has_val:
-                    # Per-member fallback: a fleet member with no validation
-                    # rows gets NaN val_loss; monitor train loss instead.
-                    monitor = jnp.where(jnp.isnan(val_loss), loss, val_loss)
-                else:
-                    monitor = loss
-                improved = monitor < best - es[2]
-                best = jnp.where(~stopped & improved, monitor, best)
-                if es[3]:
-                    best_params = _tree_where(
-                        ~stopped & improved, params, best_params
-                    )
-                wait = jnp.where(stopped, wait, jnp.where(improved, 0, wait + 1))
-                stopped = stopped | (wait >= jnp.maximum(es[1], 1))
-            ran = ~stopped_at_start if es is not None else jnp.array(True)
-            return (params, opt_state, best, best_params, wait, stopped), (
-                loss,
-                val_loss,
-                ran,
-            )
+                else jnp.array(jnp.nan, jnp.float32)
+            ),
+        )
+        return fit_tail(params, opt_state, rng)
 
-        rngs = jax.random.split(rng, config.epochs)
-        init_carry = (
-            params,
-            opt_state,
-            jnp.array(jnp.inf, jnp.float32),
-            params,
-            jnp.array(0, jnp.int32),
-            jnp.array(False),
+    return fit
+
+
+@lru_cache(maxsize=None)
+def build_raw_windowed_fit_fn(spec: ModelSpec, config: FitConfig):
+    """
+    The fused fit for windowed (LSTM) models with windows gathered ON
+    DEVICE from the raw series, per batch:
+
+    ``(params, opt_state, series[n, F], ytgt[nw, F], order[nv], wtr[nv],
+    wval[nv], rng) -> (params, opt_state, losses, val_losses, epochs_ran)``
+
+    The dense path pre-materializes ``[n_windows, lookback, F]`` windows —
+    a ``lookback×`` HBM blowup that caps LSTM fleet size (1000 machines at
+    lookback 120 ≈ 13 GB for the windows alone, over a v5e chip's HBM).
+    Here only the ``[n, F]`` series and the ``[nw, F]`` aligned targets
+    stay resident; each training step gathers its batch of windows from
+    the series (``starts[:, None] + arange(lookback)``).
+
+    - ``ytgt`` is aligned host-side via ``ops.windows.window_targets`` (so
+      lookahead is already folded in): window ``j`` covers
+      ``series[j : j+lookback]`` with target ``ytgt[j]``.
+    - ``order`` maps virtual training slots to original window starts
+      (the detector-level shuffle of fleet_build, plus padding slots that
+      point at window 0 with zero weight).
+    - ``wtr``/``wval`` are per-VIRTUAL-slot weights, exactly like the
+      dense path's masks.
+
+    Given the same virtual ordering and batch geometry, this trains
+    bit-for-bit like the dense path on pre-materialized windows
+    (tests/parallel/test_fleet_windowed.py asserts it).
+    """
+    forward = forward_fn_for(spec)
+    per_sample = resolve_loss(spec.loss)
+    tx = spec.optimizer.to_optax()
+    lookback = spec.lookback_window
+
+    def gather_windows(series, starts):
+        idx = starts[:, None] + jnp.arange(lookback)[None, :]
+        return series[idx]  # [B, lookback, F]
+
+    def batch_loss(params, series, ytgt, starts, wb):
+        xb = gather_windows(series, starts)
+        yb = jnp.take(ytgt, starts, axis=0)
+        out, penalty = forward(spec, params, xb)
+        return weighted_mean_loss(per_sample(out, yb), wb) + penalty
+
+    grad_fn = jax.value_and_grad(batch_loss)
+
+    def train_epoch(params, opt_state, series, ytgt, order, wtr, erng):
+        nv = order.shape[0]
+        steps = nv // config.batch_size
+        if config.shuffle:
+            perm = jax.random.permutation(erng, nv)
+            order_e = jnp.take(order, perm)
+            wtr_e = jnp.take(wtr, perm)
+        else:
+            order_e, wtr_e = order, wtr
+        starts_b = order_e.reshape(steps, config.batch_size)
+        w_b = wtr_e.reshape(steps, config.batch_size)
+
+        def step(carry, batch):
+            params, opt_state = carry
+            starts, wb = batch
+            loss, grads = grad_fn(params, series, ytgt, starts, wb)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            has_data = jnp.sum(wb) > 0
+            params = _tree_where(
+                has_data, optax.apply_updates(params, updates), params
+            )
+            opt_state = _tree_where(has_data, new_opt_state, opt_state)
+            contribution = jnp.where(has_data, loss * jnp.sum(wb), 0.0)
+            return (params, opt_state), contribution
+
+        (params, opt_state), weighted_losses = jax.lax.scan(
+            step, (params, opt_state), (starts_b, w_b)
         )
-        (params, opt_state, _, best_params, _, _), (losses, val_losses, ran) = (
-            jax.lax.scan(epoch_body, init_carry, rngs)
+        epoch_loss = jnp.sum(weighted_losses) / jnp.maximum(jnp.sum(wtr), 1.0)
+        return params, opt_state, epoch_loss
+
+    def evaluate(params, series, ytgt, order, w):
+        # Batched scan, not one full-window forward: validation memory must
+        # stay bounded for the same reason training's does.
+        nv = order.shape[0]
+        steps = nv // config.batch_size
+
+        def step(acc, batch):
+            starts, wb = batch
+            xb = gather_windows(series, starts)
+            yb = jnp.take(ytgt, starts, axis=0)
+            out, _ = forward(spec, params, xb)
+            losses = per_sample(out, yb)
+            return (acc[0] + jnp.sum(losses * wb), acc[1] + jnp.sum(wb)), None
+
+        (total, wsum), _ = jax.lax.scan(
+            step,
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            (
+                order.reshape(steps, config.batch_size),
+                w.reshape(steps, config.batch_size),
+            ),
         )
-        if es is not None and es[3]:
-            params = best_params
-        return params, opt_state, losses, val_losses, jnp.sum(ran.astype(jnp.int32))
+        return jnp.where(wsum > 0, total / wsum, jnp.nan)
+
+    def fit(params, opt_state, series, ytgt, order, wtr, wval, rng):
+        fit_tail = _make_fit_loop(
+            config,
+            train_epoch=lambda p, o, erng: train_epoch(
+                p, o, series, ytgt, order, wtr, erng
+            ),
+            evaluate_val=lambda p: evaluate(p, series, ytgt, order, wval),
+        )
+        return fit_tail(params, opt_state, rng)
 
     return fit
 

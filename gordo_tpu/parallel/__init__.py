@@ -1,4 +1,4 @@
-from .fleet import FleetMember, FleetResult, FleetTrainer
+from .fleet import FleetMember, FleetResult, FleetTrainer, WindowedFleetMember
 from .fleet_build import FleetBuilder, fleet_build
 from .sequence import ring_windowed_anomaly_scores, ring_windowed_predict
 from .mesh import (
@@ -13,6 +13,7 @@ from .mesh import (
 __all__ = [
     "FleetTrainer",
     "FleetMember",
+    "WindowedFleetMember",
     "FleetResult",
     "FleetBuilder",
     "fleet_build",

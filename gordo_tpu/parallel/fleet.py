@@ -67,10 +67,55 @@ class FleetMember:
 
 
 @dataclass
+class WindowedFleetMember:
+    """
+    One windowed (LSTM) machine's training problem as the RAW series plus
+    window bookkeeping — windows are gathered on device per batch
+    (models/training.py build_raw_windowed_fit_fn), so fleet HBM holds
+    ``[n, F]`` per member instead of the ``lookback×`` window blowup.
+    """
+
+    name: str
+    spec: ModelSpec  # an LSTMSpec (carries lookback_window)
+    series: np.ndarray  # [n, F] raw input series
+    targets: np.ndarray  # [n_windows, F_out] via ops.windows.window_targets
+    order: Optional[np.ndarray] = None  # virtual slot -> window start; None=arange
+    train_weights: Optional[np.ndarray] = None  # per virtual slot
+    val_weights: Optional[np.ndarray] = None
+    seed: int = 42
+
+    def __post_init__(self):
+        lookback = self.spec.lookback_window
+        if len(self.series) < lookback + 1:
+            raise ValueError(
+                f"{self.name}: series of {len(self.series)} rows too short "
+                f"for lookback {lookback}"
+            )
+
+    @property
+    def n_windows(self) -> int:
+        return len(self.targets)
+
+
+@dataclass
 class FleetResult:
     name: str
     params: Any  # host numpy pytree
     history: History
+
+
+def _fill_weight_row(wtr, wval, i, n, member, config: FitConfig):
+    """One member's train/val masks: explicit weights, or the Keras-style
+    tail validation split over its ``n`` (virtual) samples."""
+    if member.train_weights is not None:
+        wtr[i, : len(member.train_weights)] = member.train_weights
+    else:
+        n_val = int(n * config.validation_split)
+        wtr[i, : n - n_val] = 1.0
+        if n_val:
+            wval[i, n - n_val : n] = 1.0
+    if member.val_weights is not None:
+        wval[i, : len(member.val_weights)] = member.val_weights
 
 
 def host_prng_keys(seeds: Sequence[int]) -> np.ndarray:
@@ -100,6 +145,45 @@ def _fleet_fit_program(spec: ModelSpec, config: FitConfig):
     """jit(vmap) of the raw fused fit over a leading model axis."""
     raw_fit = build_raw_fit_fn(spec, config)
     return jax.jit(jax.vmap(raw_fit))
+
+
+@lru_cache(maxsize=None)
+def _fleet_windowed_fit_program(spec: ModelSpec, config: FitConfig):
+    """jit(vmap) of the on-device-windowing fused fit over the model axis."""
+    from ..models.training import build_raw_windowed_fit_fn
+
+    raw_fit = build_raw_windowed_fit_fn(spec, config)
+    return jax.jit(jax.vmap(raw_fit))
+
+
+@lru_cache(maxsize=None)
+def fleet_windowed_predict_program(spec: ModelSpec, batch_size: int):
+    """
+    jit(vmap) forward for windowed members: windows gathered from the raw
+    series per scan step, so prediction memory stays bounded like training.
+
+    ``(stacked params, series[M, n, F], order[M, nv]) -> [M, nv, F_out]``
+    (``nv`` must be a multiple of ``batch_size``).
+    """
+    import jax.numpy as jnp
+
+    forward = forward_fn_for(spec)
+    lookback = spec.lookback_window
+
+    def predict_one(params, series, order):
+        steps = order.shape[0] // batch_size
+
+        def step(_, starts):
+            idx = starts[:, None] + jnp.arange(lookback)[None, :]
+            out, _ = forward(spec, params, series[idx])
+            return None, out
+
+        _, outs = jax.lax.scan(
+            step, None, order.reshape(steps, batch_size)
+        )
+        return outs.reshape(steps * batch_size, -1)
+
+    return jax.jit(jax.vmap(predict_one))
 
 
 @lru_cache(maxsize=None)
@@ -155,18 +239,33 @@ class FleetTrainer:
 
     # -- training -----------------------------------------------------------
 
+    @staticmethod
+    def bucket_windowed(
+        members: Sequence["WindowedFleetMember"], config: FitConfig
+    ) -> Dict[Tuple, List["WindowedFleetMember"]]:
+        """Windowed compilation buckets: (spec, padded series length, offset)."""
+        buckets: Dict[Tuple, List[WindowedFleetMember]] = defaultdict(list)
+        for member in members:
+            n_padded = _round_up_pow2(len(member.series), 1)
+            offset = len(member.series) - member.n_windows
+            buckets[(member.spec, n_padded, offset)].append(member)
+        return dict(buckets)
+
     def train(
         self,
-        members: Sequence[FleetMember],
+        members: Sequence[Any],
         config: FitConfig,
         initial_params: Optional[Any] = None,
     ) -> List[FleetResult]:
         """
         Train all members (auto-bucketed); returns one FleetResult per
-        member in input order.
+        member in input order. Accepts a mix of dense ``FleetMember``s and
+        ``WindowedFleetMember``s (LSTM series with on-device windowing).
         """
         by_name: Dict[str, FleetResult] = {}
-        for (spec, n_padded), bucket in self.bucket(members, config).items():
+        dense = [m for m in members if isinstance(m, FleetMember)]
+        windowed = [m for m in members if isinstance(m, WindowedFleetMember)]
+        for (spec, n_padded), bucket in self.bucket(dense, config).items():
             logger.info(
                 "Fleet bucket: %d models, spec=%s, padded_n=%d",
                 len(bucket),
@@ -174,6 +273,19 @@ class FleetTrainer:
                 n_padded,
             )
             for result in self._train_bucket(spec, n_padded, bucket, config):
+                by_name[result.name] = result
+        for (spec, n_padded, offset), bucket in self.bucket_windowed(
+            windowed, config
+        ).items():
+            logger.info(
+                "Windowed fleet bucket: %d models, spec=%s, padded_n=%d",
+                len(bucket),
+                type(spec).__name__,
+                n_padded,
+            )
+            for result in self._train_windowed_bucket(
+                spec, n_padded, offset, bucket, config
+            ):
                 by_name[result.name] = result
         return [by_name[m.name] for m in members]
 
@@ -214,15 +326,7 @@ class FleetTrainer:
         wtr = np.zeros((m_total, n_padded), np.float32)
         wval = np.zeros((m_total, n_padded), np.float32)
         for i, member in enumerate(bucket):
-            if member.train_weights is not None:
-                wtr[i, : member.n] = member.train_weights
-            else:
-                n_val = int(member.n * config.validation_split)
-                wtr[i, : member.n - n_val] = 1.0
-                if n_val:
-                    wval[i, member.n - n_val : member.n] = 1.0
-            if member.val_weights is not None:
-                wval[i, : member.n] = member.val_weights
+            _fill_weight_row(wtr, wval, i, member.n, member, config)
 
         rngs = host_prng_keys([m.seed for m in bucket] + [0] * (m_total - len(bucket)))
         w_sharding = model_data_sharding(self.mesh)
@@ -246,22 +350,107 @@ class FleetTrainer:
         config: FitConfig,
     ) -> List[FleetResult]:
         X, y, wtr, wval, rngs = self._stack_bucket(spec, n_padded, bucket, config)
-
-        # Mirror fit_single's derivation exactly so a fleet member trains
-        # bit-for-bit like the single-model path: fit rng and init rng are
-        # the two halves of split(PRNGKey(seed)).
-        split_keys = jax.vmap(jax.random.split)(rngs)
-        rngs, init_rngs = split_keys[:, 0], split_keys[:, 1]
-        params = _fleet_init_program(spec)(init_rngs)
-        params = jax.device_put(params, model_sharding(self.mesh, extra_dims=0))
-        tx = spec.optimizer.to_optax()
-        opt_state = jax.jit(jax.vmap(tx.init))(params)
-
+        params, opt_state, rngs = self._init_bucket_params(spec, rngs)
         fit = _fleet_fit_program(spec, config)
         params, _, losses, val_losses, epochs_ran = fit(
             params, opt_state, X, y, wtr, X, y, wval, rngs
         )
+        return self._collect_results(
+            bucket, params, losses, val_losses, epochs_ran, config,
+            steps=n_padded // config.batch_size,
+        )
 
+    def _init_bucket_params(self, spec: ModelSpec, rngs):
+        """Per-member init mirroring fit_single's derivation exactly so a
+        fleet member trains bit-for-bit like the single-model path: fit rng
+        and init rng are the two halves of split(PRNGKey(seed))."""
+        split_keys = jax.vmap(jax.random.split)(rngs)
+        rngs, init_rngs = split_keys[:, 0], split_keys[:, 1]
+        params = _fleet_init_program(spec)(init_rngs)
+        params = jax.device_put(params, model_sharding(self.mesh, extra_dims=0))
+        opt_state = jax.jit(jax.vmap(spec.optimizer.to_optax().init))(params)
+        return params, opt_state, rngs
+
+    # -- windowed training --------------------------------------------------
+
+    def _stack_windowed_bucket(
+        self,
+        spec: ModelSpec,
+        n_padded: int,
+        offset: int,
+        bucket: List[WindowedFleetMember],
+        config: FitConfig,
+    ):
+        """Stack a windowed bucket; series replicated over the data axis.
+
+        The per-batch window gather indexes arbitrary series rows, so the
+        series (and aligned targets) shard over ``models`` only; the
+        virtual window axis (order + weights) shards over ``data``.
+        """
+        model_axis = self.mesh.devices.shape[0]
+        data_axis = self.mesh.devices.shape[1] if self.mesh.devices.ndim > 1 else 1
+        m_total = -(-len(bucket) // model_axis) * model_axis
+        nw_padded = n_padded - offset
+        step = int(np.lcm(config.batch_size, data_axis))
+        nv_padded = -(-nw_padded // step) * step
+
+        f_in = bucket[0].series.shape[1]
+        f_out = bucket[0].targets.shape[1]
+        series = np.zeros((m_total, n_padded, f_in), np.float32)
+        ytgt = np.zeros((m_total, nw_padded, f_out), np.float32)
+        order = np.zeros((m_total, nv_padded), np.int32)
+        wtr = np.zeros((m_total, nv_padded), np.float32)
+        wval = np.zeros((m_total, nv_padded), np.float32)
+        for i, member in enumerate(bucket):
+            series[i, : len(member.series)] = member.series
+            ytgt[i, : member.n_windows] = member.targets
+            nv = member.n_windows
+            order[i, :nv] = (
+                member.order if member.order is not None else np.arange(nv)
+            )
+            _fill_weight_row(wtr, wval, i, nv, member, config)
+
+        rngs = host_prng_keys(
+            [m.seed for m in bucket] + [0] * (m_total - len(bucket))
+        )
+        md = model_data_sharding(self.mesh)
+        series, ytgt, order, wtr, wval, rngs = jax.device_put(
+            (series, ytgt, order, wtr, wval, rngs),
+            (
+                model_sharding(self.mesh, extra_dims=2),
+                model_sharding(self.mesh, extra_dims=2),
+                md,
+                md,
+                md,
+                model_sharding(self.mesh, extra_dims=1),
+            ),
+        )
+        return series, ytgt, order, wtr, wval, rngs
+
+    def _train_windowed_bucket(
+        self,
+        spec: ModelSpec,
+        n_padded: int,
+        offset: int,
+        bucket: List[WindowedFleetMember],
+        config: FitConfig,
+    ) -> List[FleetResult]:
+        series, ytgt, order, wtr, wval, rngs = self._stack_windowed_bucket(
+            spec, n_padded, offset, bucket, config
+        )
+        params, opt_state, rngs = self._init_bucket_params(spec, rngs)
+        fit = _fleet_windowed_fit_program(spec, config)
+        params, _, losses, val_losses, epochs_ran = fit(
+            params, opt_state, series, ytgt, order, wtr, wval, rngs
+        )
+        return self._collect_results(
+            bucket, params, losses, val_losses, epochs_ran, config,
+            steps=order.shape[1] // config.batch_size,
+        )
+
+    def _collect_results(
+        self, bucket, params, losses, val_losses, epochs_ran, config, steps
+    ) -> List[FleetResult]:
         host_params = jax.device_get(params)
         losses = np.asarray(losses)
         val_losses = np.asarray(val_losses)
@@ -288,7 +477,7 @@ class FleetTrainer:
                         history=history,
                         params={
                             "epochs": config.epochs,
-                            "steps": n_padded // config.batch_size,
+                            "steps": steps,
                             "verbose": 0,
                             "metrics": list(history),
                         },
@@ -326,6 +515,52 @@ class FleetTrainer:
         X = jax.device_put(X, model_data_sharding(self.mesh, extra_dims=X.ndim - 2))
         out = np.asarray(fleet_predict_program(spec)(stacked_params, X))
         return out[:m, :n]
+
+    def predict_windowed_bucket(
+        self,
+        spec: ModelSpec,
+        stacked_params,
+        series: np.ndarray,
+        order: np.ndarray,
+        batch_size: int = 256,
+    ) -> np.ndarray:
+        """
+        Forward a windowed bucket with on-device window gathering, sharded
+        over the mesh's model axis like :meth:`predict_bucket`:
+        ``series[M, n, F]`` + ``order[M, nv]`` → ``[M, nv, F_out]``
+        (``nv`` is padded to a whole number of ``batch_size`` batches here).
+        """
+        series = np.asarray(series, np.float32)
+        order = np.asarray(order, np.int32)
+        m = series.shape[0]
+        model_axis = self.mesh.devices.shape[0]
+        m_total = -(-m // model_axis) * model_axis
+        nv = order.shape[1]
+        nv_pad = -(-nv // batch_size) * batch_size
+        if m_total != m or nv_pad != nv:
+            series = np.concatenate(
+                [series, np.repeat(series[:1], m_total - m, axis=0)]
+            ) if m_total != m else series
+            padded_order = np.zeros((m_total, nv_pad), np.int32)
+            padded_order[:m, :nv] = order
+            order = padded_order
+            stacked_params = jax.tree_util.tree_map(
+                lambda a: np.concatenate(
+                    [a, np.repeat(np.asarray(a)[:1], m_total - m, axis=0)]
+                )
+                if m_total != m
+                else np.asarray(a),
+                stacked_params,
+            )
+        ms2 = model_sharding(self.mesh, extra_dims=2)
+        series = jax.device_put(series, ms2)
+        order = jax.device_put(order, model_sharding(self.mesh, extra_dims=1))
+        out = np.asarray(
+            fleet_windowed_predict_program(spec, batch_size)(
+                stacked_params, series, order
+            )
+        )
+        return out[:m, :nv]
 
 
 def _round_up_pow2(n: int, batch_size: int) -> int:

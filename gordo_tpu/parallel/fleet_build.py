@@ -53,8 +53,14 @@ from ..models.anomaly.diff import (
 from ..models.estimators import JaxBaseEstimator, JaxLSTMBaseEstimator
 from ..models.training import FitConfig, fit_config_from_kwargs, split_fit_kwargs
 from ..ops.windows import model_offset as calc_model_offset
-from ..ops.windows import sliding_windows, window_targets
-from .fleet import FleetMember, FleetResult, FleetTrainer, stack_member_params
+from ..ops.windows import window_targets
+from .fleet import (
+    FleetMember,
+    FleetResult,
+    FleetTrainer,
+    WindowedFleetMember,
+    stack_member_params,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -73,8 +79,13 @@ class _Plan:
     y: pd.DataFrame = None
     X_arr: np.ndarray = None  # transformed (post host-transformers) inputs
     y_arr: np.ndarray = None
-    windows: np.ndarray = None  # estimator-space samples ([N,F] or [N,L,F])
+    # Dense models: estimator-space samples [N, F]. Windowed (LSTM) models:
+    # None — the raw series (X_arr) stays resident and windows are gathered
+    # on device (models/training.py build_raw_windowed_fit_fn), avoiding
+    # the lookback× host/HBM blowup of materialized windows.
+    windows: np.ndarray = None
     targets: np.ndarray = None
+    n_windows: int = 0  # virtual sample count (== len(X_arr) for dense)
     shuffle_perm: Optional[np.ndarray] = None  # detector-level row shuffle
     offset: int = 0
     spec: Any = None
@@ -259,8 +270,9 @@ class FleetBuilder:
         if isinstance(est, JaxLSTMBaseEstimator):
             lookback, lookahead = est.lookback_window, est.lookahead
             plan.offset = calc_model_offset(lookback, lookahead)
-            plan.windows = sliding_windows(X_arr, lookback, lookahead)
+            plan.windows = None  # on-device windowing; series stays resident
             plan.targets = window_targets(y_arr, lookback, lookahead)
+            plan.n_windows = len(plan.targets)
             fit_kwargs["shuffle"] = False
         else:
             plan.offset = 0
@@ -275,6 +287,7 @@ class FleetBuilder:
             ):
                 y_arr = X_arr
             plan.windows, plan.targets = X_arr, y_arr
+            plan.n_windows = len(X_arr)
         if plan.detector is not None and getattr(plan.detector, "shuffle", False):
             # Sequential DiffBased.fit row-shuffles before training
             # (diff.py: sklearn_shuffle(..., random_state=0)); mirror it as
@@ -283,7 +296,7 @@ class FleetBuilder:
             from sklearn.utils import shuffle as sklearn_shuffle
 
             plan.shuffle_perm = sklearn_shuffle(
-                np.arange(len(plan.windows)), random_state=0
+                np.arange(plan.n_windows), random_state=0
             )
         plan.spec = est._build_spec(factory_kwargs)
         config, host_callbacks = fit_config_from_kwargs(fit_kwargs)
@@ -341,11 +354,23 @@ class FleetBuilder:
             plan.cv_duration = time.time() - start
 
     @staticmethod
-    def _make_member(
-        plan: _Plan, train_weights: Optional[np.ndarray], seed: int
-    ) -> FleetMember:
+    def _make_member(plan: _Plan, train_weights: Optional[np.ndarray], seed: int):
         """Training member with the detector-level shuffle applied."""
         perm = plan.shuffle_perm
+        if plan.windows is None:
+            # Windowed (LSTM) path: ship the raw series; the shuffle becomes
+            # the order map and weights move into virtual (shuffled) space.
+            if perm is not None and train_weights is not None:
+                train_weights = train_weights[perm]
+            return WindowedFleetMember(
+                name=plan.machine.name,
+                spec=plan.spec,
+                series=plan.X_arr,
+                targets=plan.targets,
+                order=perm,
+                train_weights=train_weights,
+                seed=seed,
+            )
         if perm is None:
             X, y = plan.windows, plan.targets
         else:
@@ -374,7 +399,7 @@ class FleetBuilder:
 
     def _window_train_weights(self, plan: _Plan, train_idx: np.ndarray) -> np.ndarray:
         """Row-index fold → window-index training mask."""
-        n_windows = len(plan.windows)
+        n_windows = plan.n_windows
         weights = np.zeros(n_windows, np.float32)
         if plan.offset == 0:
             weights[train_idx[train_idx < n_windows]] = 1.0
@@ -405,26 +430,35 @@ class FleetBuilder:
         target_rows = window_idx + plan.offset
         return plan.y_arr[target_rows], prediction[window_idx], target_rows
 
+    _SCORING_BATCH = 256  # windowed scoring scan batch (bounds HBM)
+
     def _score_fold(self, fold_plans, fold_results, per_plan_folds, fold_idx, fold_state):
         by_name = {r.name: r for r in fold_results}
-        # One batched forward per (spec, window-rank) group — not one
-        # dispatch per machine.
+        # One batched forward per (spec, geometry) group — not one dispatch
+        # per machine. Windowed (LSTM) plans predict through the on-device
+        # window-gather scan; dense plans through the stacked forward.
         groups: Dict[Tuple, List[_Plan]] = {}
         for plan in fold_plans:
-            groups.setdefault((plan.spec, plan.windows.shape[1:]), []).append(plan)
-        for (spec, _), group in groups.items():
-            n_max = max(len(p.windows) for p in group)
-            X = np.zeros(
-                (len(group), n_max) + group[0].windows.shape[1:], np.float32
+            geometry = (
+                ("windowed",) if plan.windows is None else plan.windows.shape[1:]
             )
-            for i, p in enumerate(group):
-                X[i, : len(p.windows)] = p.windows
+            groups.setdefault((plan.spec, geometry), []).append(plan)
+        for (spec, geometry), group in groups.items():
             stacked = stack_member_params(
                 [by_name[p.machine.name] for p in group]
             )
-            predictions = self.trainer.predict_bucket(spec, stacked, X)
+            if geometry == ("windowed",):
+                predictions = self._predict_windowed_group(spec, stacked, group)
+            else:
+                n_max = max(len(p.windows) for p in group)
+                X = np.zeros(
+                    (len(group), n_max) + group[0].windows.shape[1:], np.float32
+                )
+                for i, p in enumerate(group):
+                    X[i, : len(p.windows)] = p.windows
+                predictions = self.trainer.predict_bucket(spec, stacked, X)
             for i, plan in enumerate(group):
-                prediction = predictions[i, : len(plan.windows)]
+                prediction = predictions[i, : plan.n_windows]
                 train_rows, test_rows = per_plan_folds[plan.machine.name][fold_idx]
                 y_true, y_pred, target_rows = self._predictions_for_rows(
                     plan, prediction, test_rows
@@ -438,6 +472,23 @@ class FleetBuilder:
                         y_train=plan.y_arr[train_rows],
                         test_rows=target_rows,
                     )
+
+    def _predict_windowed_group(self, spec, stacked, group: List[_Plan]) -> np.ndarray:
+        """Chronological predictions for windowed plans, windows gathered on
+        device (scan over _SCORING_BATCH-window batches), model-axis
+        sharded over the trainer's mesh like the dense scoring path."""
+        nv_max = max(p.n_windows for p in group)
+        n_series_max = max(len(p.X_arr) for p in group)
+        series = np.zeros(
+            (len(group), n_series_max, group[0].X_arr.shape[1]), np.float32
+        )
+        order = np.zeros((len(group), nv_max), np.int32)
+        for i, p in enumerate(group):
+            series[i, : len(p.X_arr)] = p.X_arr
+            order[i, : p.n_windows] = np.arange(p.n_windows)
+        return self.trainer.predict_windowed_bucket(
+            spec, stacked, series, order, batch_size=self._SCORING_BATCH
+        )
 
     def _accumulate_metric_scores(self, plan, y_true, y_pred, fold_idx):
         evaluation = plan.machine.evaluation
