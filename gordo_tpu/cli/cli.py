@@ -408,14 +408,24 @@ def build_fleet(
             output_dir,
             "" if is_coordinator else " (non-coordinator: side effects skipped)",
         )
-        results = FleetBuilder(machines).build(
+        builder = FleetBuilder(machines)
+        results = builder.build(
             output_dir if is_coordinator else None,
             model_register_dir=model_register_dir if is_coordinator else None,
         )
         if is_coordinator:
             for _, machine_out in results:
                 machine_out.report()
-        logger.info("Fleet build of %d machines complete", len(results))
+        logger.info(
+            "Fleet build complete: %d built, %d failed",
+            len(results),
+            len(builder.build_errors),
+        )
+        if builder.build_errors:
+            # failFast:false — successes are saved/reported above; exit with
+            # the first failure's mapped code like a reference builder pod.
+            name, exc = next(iter(builder.build_errors.items()))
+            raise exc
     except Exception:
         traceback.print_exc()
         exc_type, exc_value, exc_traceback = sys.exc_info()

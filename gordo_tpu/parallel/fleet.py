@@ -27,7 +27,7 @@ are independent of bucket composition and deterministic per seed.
 
 import logging
 from collections import defaultdict
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from functools import lru_cache
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -86,10 +86,13 @@ class WindowedFleetMember:
 
     def __post_init__(self):
         lookback = self.spec.lookback_window
-        if len(self.series) < lookback + 1:
+        # Validate on the window count (targets length), not raw series
+        # length: lookahead shortens the window set too, and zero windows
+        # would otherwise train nothing yet report a clean 0.0-loss history.
+        if len(self.targets) < 1:
             raise ValueError(
                 f"{self.name}: series of {len(self.series)} rows too short "
-                f"for lookback {lookback}"
+                f"for lookback {lookback} (no complete windows)"
             )
 
     @property
@@ -256,12 +259,48 @@ class FleetTrainer:
         members: Sequence[Any],
         config: FitConfig,
         initial_params: Optional[Any] = None,
+        retry_failed: int = 1,
     ) -> List[FleetResult]:
         """
         Train all members (auto-bucketed); returns one FleetResult per
         member in input order. Accepts a mix of dense ``FleetMember``s and
         ``WindowedFleetMember``s (LSTM series with on-device windowing).
+
+        ``retry_failed``: members whose training diverged (non-finite final
+        loss) are re-vmapped into a retry bucket with a reseeded RNG, up to
+        this many times — the chip-level analog of the reference DAG's
+        per-pod retryStrategy (SURVEY.md §2.9 elasticity row).
         """
+        results = self._train_once(members, config)
+        for attempt in range(1, retry_failed + 1):
+            failed_idx = [
+                i
+                for i, r in enumerate(results)
+                if r.history.history["loss"]
+                and not np.isfinite(r.history.history["loss"][-1])
+            ]
+            if not failed_idx:
+                break
+            logger.warning(
+                "Fleet retry %d: %d member(s) diverged (%s); reseeding",
+                attempt,
+                len(failed_idx),
+                ", ".join(results[i].name for i in failed_idx[:5]),
+            )
+            retry_members = []
+            for i in failed_idx:
+                member = replace(
+                    members[i], seed=members[i].seed + 7919 * attempt
+                )
+                retry_members.append(member)
+            retried = self._train_once(retry_members, config)
+            for i, result in zip(failed_idx, retried):
+                results[i] = result
+        return results
+
+    def _train_once(
+        self, members: Sequence[Any], config: FitConfig
+    ) -> List[FleetResult]:
         by_name: Dict[str, FleetResult] = {}
         dense = [m for m in members if isinstance(m, FleetMember)]
         windowed = [m for m in members if isinstance(m, WindowedFleetMember)]

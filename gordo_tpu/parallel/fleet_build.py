@@ -40,6 +40,7 @@ from .. import serializer
 from ..builder.build_model import ModelBuilder
 from ..dataset import GordoBaseDataset
 from ..machine import Machine
+from ..utils.profiling import maybe_trace
 from ..machine.metadata import (
     BuildMetadata,
     CrossValidationMetaData,
@@ -102,16 +103,39 @@ class FleetBuildError(RuntimeError):
     pass
 
 
+def _try_call(fn, *args):
+    """Run ``fn``; return the exception instead of raising (thread-pool
+    safe capture for failFast:false semantics)."""
+    try:
+        fn(*args)
+        return None
+    except Exception as exc:  # noqa: BLE001 - recorded per machine
+        return exc
+
+
 class FleetBuilder:
     def __init__(
         self,
         machines: Sequence[Machine],
         trainer: Optional[FleetTrainer] = None,
         data_workers: int = 16,
+        fail_fast: bool = False,
     ):
         self.machines = list(machines)
         self.trainer = trainer if trainer is not None else FleetTrainer()
         self.data_workers = data_workers
+        # The reference DAG runs with failFast:false
+        # (argo-workflow.yml.template: one machine's builder pod failing
+        # does not stop the fleet); mirror that — failed machines are
+        # recorded in ``build_errors`` and the rest of the fleet builds.
+        self.fail_fast = fail_fast
+        self.build_errors: Dict[str, BaseException] = {}
+
+    def _fail(self, name: str, exc: BaseException):
+        if self.fail_fast:
+            raise exc
+        logger.error("Fleet build of machine %s failed: %r", name, exc)
+        self.build_errors[name] = exc
 
     # ------------------------------------------------------------------ API
 
@@ -146,44 +170,72 @@ class FleetBuilder:
                 len(machines),
             )
 
+        self.build_errors = {}
         plans, fallbacks = self._plan_all(machines)
-        self._load_all_data(plans)
+        plans = self._load_all_data(plans)
+
+        def alive(ps):
+            return [p for p in ps if p.machine.name not in self.build_errors]
 
         # CV folds then final fit, bucketed across all plans at once
         cv_plans = [
             p
-            for p in plans
+            for p in alive(plans)
             if p.machine.evaluation.get("cv_mode", "full_build").lower()
             in ("full_build", "cross_val_only")
         ]
         if cv_plans:
-            self._run_cross_validation(cv_plans)
+            with maybe_trace("fleet-cross-validation"):
+                self._run_cross_validation(cv_plans)
         final_plans = [
             p
-            for p in plans
+            for p in alive(plans)
             if p.machine.evaluation.get("cv_mode", "full_build").lower()
             != "cross_val_only"
         ]
-        self._run_final_fit(final_plans)
+        with maybe_trace("fleet-final-fit"):
+            self._run_final_fit(final_plans)
 
-        results = [self._assemble(p) for p in plans]
+        results = []
+        for plan in alive(plans):
+            try:
+                results.append(self._assemble(plan))
+            except Exception as exc:
+                self._fail(plan.machine.name, exc)
         for machine in fallbacks:
             logger.info("Fleet fallback to ModelBuilder for %s", machine.name)
-            results.append(ModelBuilder(machine).build())
+            try:
+                results.append(ModelBuilder(machine).build())
+            except Exception as exc:
+                self._fail(machine.name, exc)
 
         if model_register_dir:
             for model, machine in results:
-                ModelBuilder(machine).register(model, machine, model_register_dir)
+                try:
+                    ModelBuilder(machine).register(model, machine, model_register_dir)
+                except Exception as exc:
+                    self._fail(machine.name, exc)
 
         results = cached_results + results
         if output_dir is not None:
             import os
 
+            saved = []
             for model, machine in results:
-                path = os.path.join(output_dir, machine.name)
-                os.makedirs(path, exist_ok=True)
-                serializer.dump(model, path, metadata=machine.to_dict())
-        return results
+                try:
+                    path = os.path.join(output_dir, machine.name)
+                    os.makedirs(path, exist_ok=True)
+                    serializer.dump(model, path, metadata=machine.to_dict())
+                except Exception as exc:
+                    self._fail(machine.name, exc)
+                    continue
+                saved.append((model, machine))
+            results = saved
+        return [
+            (model, machine)
+            for model, machine in results
+            if machine.name not in self.build_errors
+        ]
 
     # ------------------------------------------------------------- planning
 
@@ -235,7 +287,10 @@ class FleetBuilder:
 
     # ---------------------------------------------------------------- data
 
-    def _load_all_data(self, plans: List[_Plan]):
+    def _load_all_data(self, plans: List[_Plan]) -> List[_Plan]:
+        """Fetch + stage every plan; failed machines drop out of the fleet
+        (failFast:false) and are recorded in ``build_errors``."""
+
         def load(plan: _Plan):
             start = time.time()
             X, y = plan.dataset.get_data()
@@ -243,10 +298,21 @@ class FleetBuilder:
             plan.X, plan.y = X, y
 
         with concurrent.futures.ThreadPoolExecutor(self.data_workers) as pool:
-            list(pool.map(load, plans))
-
-        for plan in plans:
-            self._stage_arrays(plan)
+            outcomes = list(
+                pool.map(lambda p: _try_call(load, p), plans)
+            )
+        surviving = []
+        for plan, exc in zip(plans, outcomes):
+            if exc is not None:
+                self._fail(plan.machine.name, exc)
+                continue
+            try:
+                self._stage_arrays(plan)
+            except Exception as stage_exc:
+                self._fail(plan.machine.name, stage_exc)
+                continue
+            surviving.append(plan)
+        return surviving
 
     @staticmethod
     def _stage_arrays(plan: _Plan):
@@ -322,35 +388,56 @@ class FleetBuilder:
         max_folds = 0
         per_plan_folds: Dict[str, List[Tuple[np.ndarray, np.ndarray]]] = {}
         for plan in plans:
-            splits = list(self._cv_for(plan).split(plan.X_arr))
+            try:
+                splits = list(self._cv_for(plan).split(plan.X_arr))
+                plan.cv_splits = self._split_metadata(plan, splits)
+            except Exception as exc:
+                self._fail(plan.machine.name, exc)
+                continue
             per_plan_folds[plan.machine.name] = splits
             max_folds = max(max_folds, len(splits))
-            plan.cv_splits = self._split_metadata(plan, splits)
 
         for fold_idx in range(max_folds):
             grouped: Dict[FitConfig, Tuple[List[FleetMember], List[_Plan]]] = {}
             for plan in plans:
+                if plan.machine.name in self.build_errors:
+                    continue
                 splits = per_plan_folds[plan.machine.name]
                 if fold_idx >= len(splits):
                     continue
                 train_idx, _ = splits[fold_idx]
-                weights = self._window_train_weights(plan, train_idx)
-                member = self._make_member(
-                    plan, weights, seed=plan.seed + 1000 * (fold_idx + 1)
-                )
+                try:
+                    weights = self._window_train_weights(plan, train_idx)
+                    member = self._make_member(
+                        plan, weights, seed=plan.seed + 1000 * (fold_idx + 1)
+                    )
+                except Exception as exc:
+                    self._fail(plan.machine.name, exc)
+                    continue
                 members, fold_plans = grouped.setdefault(plan.fit_config, ([], []))
                 members.append(member)
                 fold_plans.append(plan)
             for config, (members, fold_plans) in grouped.items():
                 # One fused program per (config, spec, shape) bucket trains
-                # every machine's fold model together
-                fold_results = self.trainer.train(members, config)
-                self._score_fold(
-                    fold_plans, fold_results, per_plan_folds, fold_idx, fold_state
-                )
+                # every machine's fold model together. A bucket-level
+                # failure takes its whole bucket down but not the fleet.
+                try:
+                    fold_results = self.trainer.train(members, config)
+                    self._score_fold(
+                        fold_plans, fold_results, per_plan_folds, fold_idx, fold_state
+                    )
+                except Exception as exc:
+                    for plan in fold_plans:
+                        self._fail(plan.machine.name, exc)
 
         for plan in plans:
-            self._finalize_cv(plan, fold_state[plan.machine.name])
+            if plan.machine.name in self.build_errors:
+                continue
+            try:
+                self._finalize_cv(plan, fold_state[plan.machine.name])
+            except Exception as exc:
+                self._fail(plan.machine.name, exc)
+                continue
             plan.cv_duration = time.time() - start
 
     @staticmethod
@@ -626,22 +713,36 @@ class FleetBuilder:
         if not plans:
             return
         start = time.time()
-        members = [self._make_member(p, None, seed=p.seed) for p in plans]
         # group per distinct fit config to keep train() calls homogeneous
-        by_config: Dict[FitConfig, List[int]] = {}
-        for i, plan in enumerate(plans):
-            by_config.setdefault(plan.fit_config, []).append(i)
-        for config, indices in by_config.items():
-            subset = [members[i] for i in indices]
-            results = self.trainer.train(subset, config)
-            for i, result in zip(indices, results):
-                plan = plans[i]
-                plan.estimator.params_ = result.params
-                plan.estimator.spec_ = plan.spec
-                plan.estimator._history = result.history
-                plan.train_duration = time.time() - start
-                if plan.detector is not None:
-                    plan.detector.scaler.fit(plan.y)
+        by_config: Dict[FitConfig, List[_Plan]] = {}
+        for plan in plans:
+            by_config.setdefault(plan.fit_config, []).append(plan)
+        for config, group in by_config.items():
+            members, member_plans = [], []
+            for plan in group:
+                try:
+                    members.append(self._make_member(plan, None, seed=plan.seed))
+                    member_plans.append(plan)
+                except Exception as exc:
+                    self._fail(plan.machine.name, exc)
+            if not members:
+                continue
+            try:
+                results = self.trainer.train(members, config)
+            except Exception as exc:
+                for plan in member_plans:
+                    self._fail(plan.machine.name, exc)
+                continue
+            for plan, result in zip(member_plans, results):
+                try:
+                    plan.estimator.params_ = result.params
+                    plan.estimator.spec_ = plan.spec
+                    plan.estimator._history = result.history
+                    plan.train_duration = time.time() - start
+                    if plan.detector is not None:
+                        plan.detector.scaler.fit(plan.y)
+                except Exception as exc:
+                    self._fail(plan.machine.name, exc)
 
     # ------------------------------------------------------------- assembly
 

@@ -1,0 +1,45 @@
+"""
+Opt-in device profiling (SURVEY.md §5 "Tracing / profiling": the reference
+records only coarse wall-clock durations — query_duration_sec and
+model_training_duration_sec in build metadata, the Server-Timing response
+header. Those fields all exist here too; this module adds the TPU-native
+layer the reference had no analog for: XLA device traces).
+
+Set ``GORDO_TPU_PROFILE_DIR`` and every labeled region writes a
+TensorBoard-loadable trace (``jax.profiler``) under
+``$GORDO_TPU_PROFILE_DIR/<label>/``; unset, the context manager is free.
+"""
+
+import contextlib
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+PROFILE_DIR_ENV = "GORDO_TPU_PROFILE_DIR"
+
+
+@contextlib.contextmanager
+def maybe_trace(label: str):
+    """Trace the enclosed region to ``$GORDO_TPU_PROFILE_DIR/<label>``
+    when profiling is enabled; no-op otherwise."""
+    trace_dir = os.getenv(PROFILE_DIR_ENV)
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    path = os.path.join(trace_dir, label)
+    logger.info("Profiling %s -> %s", label, path)
+    with jax.profiler.trace(path):
+        yield
+
+
+def annotate(label: str):
+    """A ``jax.profiler.TraceAnnotation`` (shows up as a named region in the
+    trace viewer) when profiling is on; a null context otherwise."""
+    if not os.getenv(PROFILE_DIR_ENV):
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.TraceAnnotation(label)

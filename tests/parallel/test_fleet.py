@@ -3,7 +3,7 @@ import pytest
 
 from gordo_tpu.models.factories import feedforward_symmetric
 from gordo_tpu.models.training import FitConfig, fit_single
-from gordo_tpu.parallel import FleetMember, FleetTrainer, make_mesh
+from gordo_tpu.parallel import FleetMember, FleetResult, FleetTrainer, make_mesh
 from gordo_tpu.parallel.fleet import _round_up_pow2
 
 SPEC = feedforward_symmetric(3, dims=(6, 3), funcs=("tanh", "tanh"))
@@ -153,3 +153,49 @@ def test_host_prng_keys_bit_equal_jax():
     for seed, key in zip(seeds, keys):
         expected = np.asarray(jax.random.PRNGKey(seed))
         np.testing.assert_array_equal(key, expected, err_msg=f"seed={seed}")
+
+
+def test_fleet_retries_diverged_members():
+    """Members with non-finite final loss are re-vmapped with a fresh seed
+    (the chip-level analog of the reference DAG's pod retryStrategy)."""
+    from unittest import mock
+
+    from gordo_tpu.models.factories import feedforward_hourglass
+    from gordo_tpu.models.training import FitConfig
+
+    spec = feedforward_hourglass(4)
+    X = np.random.RandomState(0).rand(32, 4).astype(np.float32)
+    members = [
+        FleetMember(name=f"m{i}", spec=spec, X=X, y=X, seed=i) for i in range(3)
+    ]
+    config = FitConfig(epochs=2, batch_size=16, shuffle=False)
+    trainer = FleetTrainer()
+
+    real = trainer._train_once(members, config)
+    poisoned = [
+        FleetResult(
+            name=r.name,
+            params=r.params,
+            history=r.history,
+        )
+        for r in real
+    ]
+    poisoned[1].history.history["loss"] = [float("nan"), float("nan")]
+
+    calls = []
+    original = trainer._train_once
+
+    def fake_train_once(ms, cfg):
+        calls.append([m.name for m in ms])
+        if len(calls) == 1:
+            return poisoned
+        return original(ms, cfg)
+
+    with mock.patch.object(trainer, "_train_once", side_effect=fake_train_once):
+        results = trainer.train(members, config)
+
+    assert calls[0] == ["m0", "m1", "m2"]
+    assert calls[1] == ["m1"]  # only the diverged member retried
+    assert np.isfinite(results[1].history.history["loss"][-1])
+    # retry reseeded: params differ from an identically-seeded fresh train
+    assert results[1].name == "m1"

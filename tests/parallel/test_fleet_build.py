@@ -184,3 +184,51 @@ def test_smoothed_threshold_metadata_present():
     meta = model.get_metadata()
     assert "smooth-feature-thresholds-per-fold" in meta
     assert "smooth-aggregate-thresholds-per-fold" in meta
+
+
+def test_fleet_build_fail_fast_false_continues(tmp_path):
+    """One machine's data failure must not stop the fleet (the reference
+    DAG runs failFast:false — argo-workflow.yml.template)."""
+    good = make_machine("good-machine", ["tag-1", "tag-2"])
+    # n_samples_threshold above the row count forces InsufficientDataError
+    bad = Machine.from_config(
+        {
+            "name": "bad-machine",
+            "model": DETECTOR_MODEL,
+            "dataset": {
+                **DATASET,
+                "tag_list": ["tag-1", "tag-2"],
+                "n_samples_threshold": 10_000_000,
+            },
+        },
+        project_name="fleet-test",
+    )
+    builder = FleetBuilder([good, bad])
+    results = builder.build(output_dir=str(tmp_path))
+    assert [m.name for _, m in results] == ["good-machine"]
+    assert set(builder.build_errors) == {"bad-machine"}
+    from gordo_tpu.dataset.exceptions import InsufficientDataError
+
+    assert isinstance(builder.build_errors["bad-machine"], InsufficientDataError)
+    # good machine's artifacts still landed
+    assert (tmp_path / "good-machine" / "model.pkl").exists()
+    assert not (tmp_path / "bad-machine").exists()
+
+
+def test_fleet_build_fail_fast_true_raises():
+    bad = Machine.from_config(
+        {
+            "name": "bad-machine",
+            "model": DETECTOR_MODEL,
+            "dataset": {
+                **DATASET,
+                "tag_list": ["tag-1"],
+                "n_samples_threshold": 10_000_000,
+            },
+        },
+        project_name="fleet-test",
+    )
+    from gordo_tpu.dataset.exceptions import InsufficientDataError
+
+    with pytest.raises(InsufficientDataError):
+        FleetBuilder([bad], fail_fast=True).build()

@@ -68,7 +68,6 @@ def test_windowed_matches_dense_no_shuffle(lookahead):
 
 def test_windowed_with_order_permutation():
     rng = np.random.RandomState(0)
-    order = rng.permutation(70 - LOOKBACK + 1 - 0).astype(np.int32)
     # lookahead=0 -> n_windows = 70 - 8 + 1 = 63
     order = rng.permutation(63).astype(np.int32)
     spec, dense, windowed = _members(70, 1, order=order)

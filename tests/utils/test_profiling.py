@@ -1,0 +1,23 @@
+import os
+
+from gordo_tpu.utils.profiling import annotate, maybe_trace
+
+
+def test_maybe_trace_noop_without_env(monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_PROFILE_DIR", raising=False)
+    with maybe_trace("x"):
+        pass
+    with annotate("y"):
+        pass
+
+
+def test_maybe_trace_writes_trace(monkeypatch, tmp_path):
+    monkeypatch.setenv("GORDO_TPU_PROFILE_DIR", str(tmp_path))
+    import jax.numpy as jnp
+
+    with maybe_trace("unit"):
+        with annotate("region"):
+            (jnp.ones((4, 4)) @ jnp.ones((4, 4))).block_until_ready()
+    # the profiler writes its plugin dir layout under <dir>/unit
+    assert (tmp_path / "unit").exists()
+    assert any((tmp_path / "unit").rglob("*")), "no trace output written"
