@@ -1,37 +1,177 @@
 """
 Headline benchmark: autoencoders trained per hour (BASELINE.json metric).
 
-Trains a fleet of hourglass feedforward autoencoders (the reference's
-production architecture — 20 sensor tags, 10 days of 10-minute data, the
-`examples/config.yaml` shape) as ONE fused vmapped program on whatever
-accelerator `jax.devices()` provides, and compares against the reference
-engine's cost measured directly: the same architecture / optimizer / batch
-size / epochs trained with Keras/TF2 on CPU (the reference trains every
-model with CPU Keras inside its per-model k8s pod —
-SURVEY.md §2.9, BASELINE.md).
+Three stages, each with its own timeout, transient-error retry, and a
+partial-result artifact written after every stage so an environment flake
+can never zero the whole run:
+
+1. **fleet-train** — the bare fused training program: BENCH_MODELS
+   hourglass feedforward autoencoders (the reference's production
+   architecture — 20 sensor tags, 10 days of 10-minute data, the
+   `examples/config.yaml` shape) trained as ONE vmapped device program.
+   Reports models/hour, seconds per training step, achieved FLOP/s and
+   MFU (with the arithmetic printed to stderr).
+2. **fleet-build-e2e** — the real product path, `FleetBuilder.build` from
+   a NormalizedConfig: machine validation, data staging, CV folds +
+   DiffBased threshold math, final fit, artifact dump
+   (parallel/fleet_build.py). This is the `build-fleet` CLI path the
+   north-star target is defined on (BASELINE.md: 1000 AEs < 10 min).
+3. **reference baseline** — the reference engine's cost measured
+   directly: the same architecture / optimizer / batch size / epochs
+   trained with Keras/TF2 on CPU (the reference trains every model with
+   CPU Keras inside its per-model k8s pod — SURVEY.md §2.9, BASELINE.md).
 
 Prints ONE JSON line:
   {"metric": "autoencoders_trained_per_hour", "value": ..., "unit":
-   "models/hour", "vs_baseline": ...}
+   "models/hour", "vs_baseline": ..., "extra": {...}}
 
-Env knobs: BENCH_MODELS (default 256), BENCH_EPOCHS (20), BENCH_SAMPLES
-(1440), BENCH_TAGS (20), BENCH_SKIP_TF_BASELINE=1 to reuse/skip the Keras
-measurement (cached in .bench_baseline.json).
+Env knobs: BENCH_MODELS (default 256), BENCH_E2E_MODELS (default
+BENCH_MODELS), BENCH_EPOCHS (20), BENCH_SAMPLES (1440), BENCH_TAGS (20),
+BENCH_STAGE_TIMEOUT seconds (default 1500), BENCH_SKIP_TF_BASELINE=1 to
+reuse/skip the Keras measurement (cached in .bench_baseline.json),
+BENCH_SKIP_E2E=1 to skip stage 2.
 """
 
 import json
 import os
+import signal
 import sys
+import tempfile
+import threading
 import time
+import traceback
 
 import numpy as np
 
 N_MODELS = int(os.environ.get("BENCH_MODELS", 256))
+N_E2E_MODELS = int(os.environ.get("BENCH_E2E_MODELS", N_MODELS))
 N_EPOCHS = int(os.environ.get("BENCH_EPOCHS", 20))
 N_SAMPLES = int(os.environ.get("BENCH_SAMPLES", 1440))  # 10 days @ 10min
 N_TAGS = int(os.environ.get("BENCH_TAGS", 20))
 BATCH = 64
-BASELINE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_baseline.json")
+STAGE_TIMEOUT = int(os.environ.get("BENCH_STAGE_TIMEOUT", 1500))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+BASELINE_CACHE = os.path.join(_HERE, ".bench_baseline.json")
+PARTIAL_PATH = os.environ.get(
+    "BENCH_PARTIAL_PATH", os.path.join(_HERE, ".bench_partial.json")
+)
+
+# MXU peak FLOP/s by device kind (dense matmul, bf16 — JAX's default f32
+# matmul precision on TPU lowers to bf16 MXU passes). Used only for the
+# reported MFU; absent kinds report mfu=null.
+PEAK_FLOPS = {
+    "TPU v5 lite": 394e12,  # v5e
+    "TPU v5e": 394e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v3": 123e12,
+    "TPU v2": 46e12,
+}
+
+
+def log(msg: str):
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+# -- stage harness: timeout + transient retry + partial artifacts -----------
+
+
+class StageTimeout(Exception):
+    pass
+
+
+def _alarm_handler(signum, frame):
+    raise StageTimeout()
+
+
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "RESOURCE_EXHAUSTED",
+    "ABORTED",
+    "Socket closed",
+    "Connection reset",
+    "failed to connect",
+)
+
+
+def _is_transient(exc: BaseException) -> bool:
+    text = f"{type(exc).__name__}: {exc}"
+    return any(marker in text for marker in _TRANSIENT_MARKERS)
+
+
+def _arm_watchdog(partial: dict, name: str, seconds: float) -> threading.Timer:
+    """
+    Hard backstop for hangs SIGALRM cannot interrupt: a blocking call
+    inside the JAX/TPU C++ runtime (compile, execute, device_get over a
+    dead tunnel) never returns to the bytecode loop, so the Python alarm
+    handler never runs. This daemon timer flushes the partial artifact,
+    emits whatever final JSON is derivable from completed stages, and
+    hard-exits — bounding wall clock no matter where the hang lives.
+    """
+
+    def expire():
+        partial[f"{name}_error"] = (
+            f"hard timeout after {seconds:.0f}s (uninterruptible backend hang)"
+        )
+        log(f"stage {name}: watchdog fired — backend hang; emitting partials")
+        _flush_partial(partial)
+        rc = _emit_result(partial)
+        os._exit(rc)
+
+    timer = threading.Timer(seconds, expire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
+def run_stage(partial: dict, name: str, fn, timeout: int = STAGE_TIMEOUT, retries: int = 2):
+    """
+    Run one bench stage with a wall-clock alarm and retry on transient
+    backend errors (the axon TPU tunnel can drop mid-run — round 1's bench
+    was zeroed by exactly that). Results and failures are recorded into
+    ``partial`` and flushed to PARTIAL_PATH either way.
+    """
+    for attempt in range(retries + 1):
+        old_handler = signal.signal(signal.SIGALRM, _alarm_handler)
+        signal.alarm(timeout)
+        # The watchdog only fires if SIGALRM could not (hang inside the
+        # C++ runtime), so give the signal path a generous head start.
+        watchdog = _arm_watchdog(partial, name, timeout + 120)
+        try:
+            result = fn()
+            partial[name] = result
+            partial.pop(f"{name}_error", None)  # earlier attempts' failures
+            return result
+        except StageTimeout:
+            partial[f"{name}_error"] = f"timeout after {timeout}s"
+            log(f"stage {name}: timed out after {timeout}s")
+            return None
+        except Exception as exc:  # noqa: BLE001 - bench must survive anything
+            partial[f"{name}_error"] = f"{type(exc).__name__}: {exc}"
+            if _is_transient(exc) and attempt < retries:
+                log(f"stage {name}: transient failure ({exc!r}); retry {attempt + 1}")
+                time.sleep(2 * (attempt + 1))
+                continue
+            log(f"stage {name}: failed: {exc!r}")
+            traceback.print_exc(file=sys.stderr)
+            return None
+        finally:
+            watchdog.cancel()
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old_handler)
+            _flush_partial(partial)
+
+
+def _flush_partial(partial: dict):
+    try:
+        with open(PARTIAL_PATH, "w") as f:
+            json.dump(partial, f, indent=2, default=str)
+    except OSError as exc:
+        log(f"could not write partial artifact: {exc}")
+
+
+# -- data -------------------------------------------------------------------
 
 
 def make_data(n_models: int):
@@ -48,21 +188,35 @@ def make_data(n_models: int):
     return data
 
 
-def bench_fleet() -> float:
-    """Our throughput: models/hour on the available accelerator."""
-    from gordo_tpu.models.factories import feedforward_hourglass
-    from gordo_tpu.models.training import FitConfig
-    from gordo_tpu.parallel import FleetMember, FleetTrainer
+def _device_desc() -> str:
+    import jax
 
+    d = jax.devices()
+    return f"{len(d)}x {d[0].device_kind}"
+
+
+def _setup_jax_cache():
     import jax
 
     # Persistent compilation cache: the fleet program for a (spec, shape)
     # compiles once per machine ever, not once per process.
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-    )
+    jax.config.update("jax_compilation_cache_dir", os.path.join(_HERE, ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+# -- stage 1: bare fleet training ------------------------------------------
+
+
+def bench_fleet() -> dict:
+    """Bare fused-training throughput on the available accelerator."""
+    from gordo_tpu.models.factories import feedforward_hourglass
+    from gordo_tpu.models.training import FitConfig
+    from gordo_tpu.parallel import FleetMember, FleetTrainer
+    from gordo_tpu.parallel.fleet import _round_up_pow2
+
+    import jax
+
+    _setup_jax_cache()
 
     spec = feedforward_hourglass(N_TAGS)
     config = FitConfig(epochs=N_EPOCHS, batch_size=BATCH, shuffle=True)
@@ -84,22 +238,128 @@ def bench_fleet() -> float:
 
     losses = [r.history.history["loss"][-1] for r in results]
     assert all(np.isfinite(losses)), "non-finite training losses"
-    print(
-        f"# fleet: {N_MODELS} AEs x {N_EPOCHS} epochs in {elapsed:.2f}s "
-        f"(final loss mean {np.mean(losses):.5f}) on {_device_desc()}",
-        file=sys.stderr,
+
+    # -- MFU arithmetic (all counted, none assumed; ADVICE.md r2) ----------
+    # Dense-weight parameter count of one model:
+    weight_elems = sum(
+        int(np.asarray(leaf).size)
+        for leaf in jax.tree_util.tree_leaves(results[0].params)
+        if np.asarray(leaf).ndim == 2
     )
-    return N_MODELS / (elapsed / 3600.0)
+    # The compiled program trains the PADDED sample axis (zero-weight rows
+    # still run through the MXU), so executed FLOPs use n_padded:
+    n_padded = _round_up_pow2(N_SAMPLES, BATCH)
+    steps_per_epoch = n_padded // BATCH
+    # fwd = 2*W FLOPs/sample; backward ≈ 2×fwd; + one val forward pass
+    # over the padded set per epoch = 2*W*n_padded.
+    flops_per_model = N_EPOCHS * (6 * weight_elems * n_padded + 2 * weight_elems * n_padded)
+    total_flops = flops_per_model * N_MODELS
+    achieved = total_flops / elapsed
+    device_kind = jax.devices()[0].device_kind
+    peak = PEAK_FLOPS.get(device_kind)
+    mfu = achieved / (peak * len(jax.devices())) if peak else None
+    step_time_s = elapsed / (N_EPOCHS * steps_per_epoch)
+
+    log(
+        f"fleet: {N_MODELS} AEs x {N_EPOCHS} epochs in {elapsed:.2f}s "
+        f"(final loss mean {np.mean(losses):.5f}) on {_device_desc()}"
+    )
+    log(
+        f"mfu arithmetic: W={weight_elems} dense weights/model, "
+        f"n_padded={n_padded} (from {N_SAMPLES}), steps/epoch={steps_per_epoch}, "
+        f"flops/model = {N_EPOCHS}*(6+2)*{weight_elems}*{n_padded} = {flops_per_model:.3e}, "
+        f"achieved {achieved / 1e9:.1f} GFLOP/s vs peak "
+        f"{peak / 1e12 if peak else float('nan'):.0f} TFLOP/s ({device_kind}) "
+        f"-> MFU {mfu * 100 if mfu else float('nan'):.4f}%"
+    )
+    return {
+        "models_per_hour": N_MODELS / (elapsed / 3600.0),
+        "elapsed_s": round(elapsed, 3),
+        "step_time_ms": round(step_time_s * 1e3, 4),
+        "achieved_gflops": round(achieved / 1e9, 2),
+        "mfu": round(mfu, 6) if mfu is not None else None,
+        "device": _device_desc(),
+        "flops_per_model": flops_per_model,
+        "weight_elems": weight_elems,
+        "n_padded": n_padded,
+    }
 
 
-def _device_desc() -> str:
-    import jax
-
-    d = jax.devices()
-    return f"{len(d)}x {d[0].device_kind}"
+# -- stage 2: end-to-end fleet build ---------------------------------------
 
 
-def bench_reference_keras() -> float:
+def bench_fleet_build_e2e() -> dict:
+    """
+    The product path from config to artifacts: NormalizedConfig machine
+    validation -> data staging -> CV folds + thresholds -> final fit ->
+    artifact dump, timed end to end (parallel/fleet_build.py).
+    """
+    from gordo_tpu.machine import Machine
+    from gordo_tpu.parallel import FleetBuilder
+
+    _setup_jax_cache()
+
+    # The reference production shape: DiffBased detector over an hourglass
+    # AE, 3-fold TimeSeriesSplit CV + final fit (SURVEY.md §2.1/§2.3).
+    model_def = {
+        "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "gordo_tpu.models.JaxAutoEncoder": {
+                    "kind": "feedforward_hourglass",
+                    "epochs": N_EPOCHS,
+                    "batch_size": BATCH,
+                }
+            }
+        }
+    }
+    machines = [
+        Machine.from_config(
+            {
+                "name": f"bench-machine-{i:04d}",
+                "model": model_def,
+                "dataset": {
+                    "type": "RandomDataset",
+                    "train_start_date": "2020-01-01T00:00:00+00:00",
+                    "train_end_date": "2020-01-11T00:00:00+00:00",
+                    "tag_list": [f"bench-tag-{i:04d}-{j}" for j in range(N_TAGS)],
+                },
+            },
+            project_name="bench",
+        )
+        for i in range(N_E2E_MODELS)
+    ]
+
+    with tempfile.TemporaryDirectory() as output_dir:
+        start = time.time()
+        builder = FleetBuilder(machines)
+        results = builder.build(output_dir=output_dir)
+        elapsed = time.time() - start
+        n_artifacts = sum(
+            os.path.isfile(os.path.join(output_dir, m.name, "model.pkl"))
+            for _, m in results
+        )
+
+    if builder.build_errors:
+        raise RuntimeError(f"e2e build errors: {builder.build_errors}")
+    if n_artifacts != N_E2E_MODELS:
+        raise RuntimeError(f"expected {N_E2E_MODELS} artifacts, found {n_artifacts}")
+
+    log(
+        f"e2e fleet build: {N_E2E_MODELS} machines (CV 3 folds + final fit "
+        f"+ artifacts) in {elapsed:.2f}s on {_device_desc()}"
+    )
+    return {
+        "models_per_hour": N_E2E_MODELS / (elapsed / 3600.0),
+        "elapsed_s": round(elapsed, 3),
+        "n_machines": N_E2E_MODELS,
+        "device": _device_desc(),
+    }
+
+
+# -- stage 3: reference Keras baseline -------------------------------------
+
+
+def bench_reference_keras() -> dict:
     """
     Reference-engine cost: Keras/TF2 CPU fit of the same architecture,
     measured over a few epochs and scaled to N_EPOCHS. Returns models/hour
@@ -108,7 +368,7 @@ def bench_reference_keras() -> float:
     """
     if os.environ.get("BENCH_SKIP_TF_BASELINE") and os.path.exists(BASELINE_CACHE):
         with open(BASELINE_CACHE) as f:
-            return json.load(f)["models_per_hour"]
+            return json.load(f)
 
     import tensorflow as tf
 
@@ -131,34 +391,71 @@ def bench_reference_keras() -> float:
     per_epoch = (time.time() - start) / measure_epochs
     seconds_per_model = per_epoch * N_EPOCHS
     models_per_hour = 3600.0 / seconds_per_model
-    print(
-        f"# reference: keras CPU {per_epoch:.3f}s/epoch -> "
-        f"{seconds_per_model:.2f}s/model -> {models_per_hour:.1f} models/hour",
-        file=sys.stderr,
+    log(
+        f"reference: keras CPU {per_epoch:.3f}s/epoch -> "
+        f"{seconds_per_model:.2f}s/model -> {models_per_hour:.1f} models/hour"
     )
+    result = {"models_per_hour": models_per_hour}
     with open(BASELINE_CACHE, "w") as f:
-        json.dump({"models_per_hour": models_per_hour}, f)
-    return models_per_hour
+        json.dump(result, f)
+    return result
+
+
+def _emit_result(partial: dict) -> int:
+    """Derive the one-line JSON from whatever stages completed, print it,
+    flush the partial artifact, and return the exit code."""
+    fleet = partial.get("fleet_train")
+    e2e = partial.get("fleet_build_e2e")
+    reference = partial.get("reference_keras")
+
+    # Headline = bare fleet throughput; fall back to the e2e number rather
+    # than zeroing the round if only the bare stage flaked.
+    headline = fleet or e2e
+    ref_mph = reference["models_per_hour"] if reference else None
+    result = {
+        "metric": "autoencoders_trained_per_hour",
+        "value": round(headline["models_per_hour"], 1) if headline else None,
+        "unit": "models/hour",
+        "vs_baseline": (
+            round(headline["models_per_hour"] / ref_mph, 2)
+            if headline and ref_mph
+            else None
+        ),
+        "extra": {
+            "step_time_ms": fleet["step_time_ms"] if fleet else None,
+            "achieved_gflops": fleet["achieved_gflops"] if fleet else None,
+            "mfu": fleet["mfu"] if fleet else None,
+            "e2e_models_per_hour": (
+                round(e2e["models_per_hour"], 1) if e2e else None
+            ),
+            "e2e_elapsed_s": e2e["elapsed_s"] if e2e else None,
+            "e2e_n_machines": e2e["n_machines"] if e2e else None,
+            "device": (fleet or e2e or {}).get("device"),
+            "errors": {
+                k: v for k, v in partial.items() if k.endswith("_error")
+            } or None,
+        },
+    }
+    partial["result"] = result
+    _flush_partial(partial)
+    print(json.dumps(result), flush=True)
+    # rc 0 whenever any stage produced a usable number; a completely dead
+    # environment still leaves the partial artifact behind.
+    return 0 if headline else 1
 
 
 def main():
-    ours = bench_fleet()
-    try:
-        reference = bench_reference_keras()
-    except Exception as e:  # TF unavailable: fall back to cached/derived
-        print(f"# reference baseline unavailable ({e})", file=sys.stderr)
-        if os.path.exists(BASELINE_CACHE):
-            with open(BASELINE_CACHE) as f:
-                reference = json.load(f)["models_per_hour"]
-        else:
-            reference = None
-    result = {
-        "metric": "autoencoders_trained_per_hour",
-        "value": round(ours, 1),
-        "unit": "models/hour",
-        "vs_baseline": round(ours / reference, 2) if reference else None,
-    }
-    print(json.dumps(result))
+    partial: dict = {"n_models": N_MODELS, "epochs": N_EPOCHS}
+
+    run_stage(partial, "fleet_train", bench_fleet)
+    if not os.environ.get("BENCH_SKIP_E2E"):
+        run_stage(partial, "fleet_build_e2e", bench_fleet_build_e2e)
+    reference = run_stage(partial, "reference_keras", bench_reference_keras, retries=0)
+    if reference is None and os.path.exists(BASELINE_CACHE):
+        with open(BASELINE_CACHE) as f:
+            partial["reference_keras"] = {**json.load(f), "from_cache": True}
+
+    sys.exit(_emit_result(partial))
 
 
 if __name__ == "__main__":

@@ -18,7 +18,7 @@ import json
 import logging
 import os
 import time
-from typing import Any, Dict, List, Optional, Tuple, cast
+from typing import Any, Dict, List, cast
 
 import click
 import yaml
@@ -54,71 +54,73 @@ DEFAULT_KEDA_PROMETHEUS_QUERY = (
 DEFAULT_KEDA_PROMETHEUS_THRESHOLD = "1.0"
 DEFAULT_CUSTOM_MODEL_BUILDER_ENVS = "[]"
 
-KEDA_PROMETHEUS_QUERY_ARGS = ["project_name"]
+
+def resolve_exceptions_report_level(config: NormalizedConfig) -> ReportLevel:
+    """
+    The ``ReportLevel`` the fleet builder should emit on failure — from
+    ``runtime.builder.exceptions_report_level`` in the project globals,
+    defaulting to TRACEBACK (config surface parity with reference
+    cli/workflow_generator.py:45-62).
+    """
+    builder_runtime = config.globals.get("runtime", {}).get("builder", {})
+    name = builder_runtime.get("exceptions_report_level")
+    if name is None:
+        return DEFAULT_BUILDER_EXCEPTIONS_REPORT_LEVEL
+    level = ReportLevel.get_by_name(name)
+    if level is None:
+        valid = ", ".join(l.name for l in ReportLevel)
+        raise ValueError(
+            f"runtime.builder.exceptions_report_level={name!r} is not one "
+            f"of: {valid}"
+        )
+    return level
 
 
-def get_builder_exceptions_report_level(config: NormalizedConfig) -> ReportLevel:
-    orig_report_level = None
+def check_keda_flags(context: Dict[str, Any]) -> None:
+    """KEDA autoscaling needs both the feature flag and a Prometheus URL."""
+    if context["ml_server_hpa_type"] != "keda":
+        return
+    missing = None
+    if not context["with_keda"]:
+        missing = "--with-keda"
+    elif not context["prometheus_server_address"]:
+        missing = "--prometheus-server-address"
+    if missing:
+        raise click.ClickException(
+            f"--ml-server-hpa-type=keda requires {missing}"
+        )
+
+
+def render_keda_query(query: str, project_name: str) -> str:
+    """
+    Expand the ``{{project_name}}`` placeholder in a KEDA Prometheus query
+    (queries are user-configurable jinja strings scoped to the project).
+    """
+    if not query:
+        return query
+    return (
+        Environment(loader=BaseLoader())
+        .from_string(query)
+        .render(project_name=project_name)
+    )
+
+
+def parse_label_overrides(value: str, flag: str = "--resources-labels") -> Dict[str, Any]:
+    """
+    A ``--*-labels`` JSON-dict CLI value as a plain dict; empty string means
+    no overrides. Raises a ClickException naming the flag on malformed input.
+    """
+    if not value:
+        return {}
     try:
-        orig_report_level = config.globals["runtime"]["builder"][
-            "exceptions_report_level"
-        ]
-    except KeyError:
-        pass
-    if orig_report_level is not None:
-        report_level = ReportLevel.get_by_name(orig_report_level)
-        if report_level is None:
-            raise ValueError(
-                "Invalid 'runtime.builder.exceptions_report_level' value '%s'"
-                % orig_report_level
-            )
-    else:
-        report_level = DEFAULT_BUILDER_EXCEPTIONS_REPORT_LEVEL
-    return report_level
-
-
-def validate_generate_context(context):
-    if context["ml_server_hpa_type"] == "keda":
-        if not context["with_keda"]:
-            raise click.ClickException(
-                '"--ml-server-hpa-type=keda" is only supported with the '
-                '"--with-keda" flag'
-            )
-        if not context["prometheus_server_address"]:
-            raise click.ClickException(
-                "--prometheus-server-address should be specified for "
-                '"--ml-server-hpa-type=keda"'
-            )
-
-
-def prepare_keda_prometheus_query(context):
-    keda_prometheus_query = context["keda_prometheus_query"]
-    if keda_prometheus_query:
-        template = Environment(loader=BaseLoader()).from_string(keda_prometheus_query)
-        kwargs = {k: context[k] for k in KEDA_PROMETHEUS_QUERY_ARGS}
-        return template.render(**kwargs)
-    return keda_prometheus_query
-
-
-def prepare_resources_labels(
-    value: str, argument: str = "--resources-labels"
-) -> List[Tuple[str, Any]]:
-    resources_labels: List[Tuple[str, Any]] = []
-    if value:
-        try:
-            json_value = json.loads(value)
-        except json.JSONDecodeError as e:
-            raise click.ClickException(
-                '"%s=%s" contains invalid JSON value: %s' % (argument, value, str(e))
-            )
-        if isinstance(json_value, dict):
-            resources_labels = list(json_value.items())
-        else:
-            raise click.ClickException(
-                '"%s=%s" contains value with type %s instead of dict'
-                % (argument, value, type(json_value).__name__)
-            )
-    return resources_labels
+        labels = json.loads(value)
+    except json.JSONDecodeError as exc:
+        raise click.ClickException(f"{flag}: not valid JSON ({exc})")
+    if not isinstance(labels, dict):
+        raise click.ClickException(
+            f"{flag}: expected a JSON object, got {type(labels).__name__}"
+        )
+    return labels
 
 
 def _k8s_resources(resources: Dict[str, Dict[str, int]]) -> Dict[str, Dict[str, str]]:
@@ -413,13 +415,13 @@ def workflow_generator_cli(gordo_ctx, **ctx):
     logging.getLogger("gordo_tpu").setLevel(log_level.upper())
     context["log_level"] = log_level.upper()
 
-    validate_generate_context(context)
+    check_keda_flags(context)
 
-    resources_labels = prepare_resources_labels(context["resources_labels"])
-    model_builder_labels = prepare_resources_labels(
+    resources_labels = parse_label_overrides(context["resources_labels"])
+    model_builder_labels = parse_label_overrides(
         context["model_builder_labels"], "--model-builder-labels"
     )
-    server_labels = prepare_resources_labels(
+    server_labels = parse_label_overrides(
         context["server_labels"], "--server-labels"
     )
     # Pre-merged label dicts; the template renders them as JSON flow
@@ -429,15 +431,15 @@ def workflow_generator_cli(gordo_ctx, **ctx):
         "app.kubernetes.io/managed-by": "gordo-tpu",
         "applications.gordo.equinor.com/project-name": context["project_name"],
         "applications.gordo.equinor.com/project-revision": context["project_revision"],
-        **dict(resources_labels),
+        **resources_labels,
     }
     context["builder_labels"] = {
         **context["common_labels"],
-        **dict(model_builder_labels),
+        **model_builder_labels,
     }
     context["server_labels_merged"] = {
         **context["common_labels"],
-        **dict(server_labels),
+        **server_labels,
     }
 
     for key in ("pod_security_context", "security_context"):
@@ -489,7 +491,9 @@ def workflow_generator_cli(gordo_ctx, **ctx):
     context["tpu_accelerator_label"] = gke_accelerator_label(fleet["accelerator_type"])
     machines_per_slice = fleet["machines_per_slice"]
 
-    context["keda_prometheus_query"] = prepare_keda_prometheus_query(context)
+    context["keda_prometheus_query"] = render_keda_query(
+        context["keda_prometheus_query"], context["project_name"]
+    )
 
     # Auto-attach reporters: a Postgres row per machine when influx/grafana
     # are in play, MLflow opt-in per machine (reference cli lines 538-557).
@@ -522,8 +526,9 @@ def workflow_generator_cli(gordo_ctx, **ctx):
     else:
         context.pop("owner_references")
 
-    builder_exceptions_report_level = get_builder_exceptions_report_level(config)
-    context["builder_exceptions_report_level"] = builder_exceptions_report_level.name
+    context["builder_exceptions_report_level"] = resolve_exceptions_report_level(
+        config
+    ).name
     context["builder_exceptions_report_file"] = "/dev/termination-log"
 
     if context["workflow_template"]:

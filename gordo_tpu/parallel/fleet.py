@@ -105,6 +105,8 @@ class FleetResult:
     name: str
     params: Any  # host numpy pytree
     history: History
+    seed: int = 0  # the RNG seed this member actually trained with
+    retries: int = 0  # diverged-member reseed retries that led to this result
 
 
 def _fill_weight_row(wtr, wval, i, n, member, config: FitConfig):
@@ -295,6 +297,11 @@ class FleetTrainer:
                 retry_members.append(member)
             retried = self._train_once(retry_members, config)
             for i, result in zip(failed_idx, retried):
+                result.retries = attempt
+                result.history.params["fleet_retry"] = {
+                    "retries": attempt,
+                    "seed": result.seed,
+                }
                 results[i] = result
         return results
 
@@ -511,6 +518,7 @@ class FleetTrainer:
             results.append(
                 FleetResult(
                     name=member.name,
+                    seed=member.seed,
                     params=member_params,
                     history=History(
                         history=history,

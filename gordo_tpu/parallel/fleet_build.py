@@ -222,6 +222,10 @@ class FleetBuilder:
 
             saved = []
             for model, machine in results:
+                # A machine can fail *after* assembly (e.g. at register);
+                # never dump artifacts for machines already in build_errors.
+                if machine.name in self.build_errors:
+                    continue
                 try:
                     path = os.path.join(output_dir, machine.name)
                     os.makedirs(path, exist_ok=True)

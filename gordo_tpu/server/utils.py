@@ -84,14 +84,14 @@ def dataframe_to_dict(df: pd.DataFrame) -> dict:
     >>> df = pd.DataFrame(np.arange(8).reshape((2, 4)), columns=columns, index=index)
     >>> serialized = dataframe_to_dict(df)
     >>> serialized["feature0"]["sub-feature-0"]
-    {'2019-01-01 00:00:00': 0, '2019-02-01 00:00:00': 4}
+    {'2019-01-01': 0, '2019-02-01': 4}
     """
     data = df.copy()
     if isinstance(data.index, pd.DatetimeIndex):
-        # map(str), not astype(str): astype date-formats an all-midnight
-        # index ('2019-01-01'), dropping the time component the reference's
-        # wire format always carries ('2019-01-01 00:00:00').
-        data.index = data.index.map(str)
+        # astype(str) matches the reference wire format (utils.py:129-131):
+        # an all-midnight index serializes date-only ('2019-01-01'), and
+        # clients round-trip it through dataframe_from_dict's isoparse.
+        data.index = data.index.astype(str)
     if isinstance(df.columns, pd.MultiIndex):
         return {
             col: (

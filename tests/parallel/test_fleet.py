@@ -177,6 +177,7 @@ def test_fleet_retries_diverged_members():
             name=r.name,
             params=r.params,
             history=r.history,
+            seed=r.seed,
         )
         for r in real
     ]
@@ -199,3 +200,14 @@ def test_fleet_retries_diverged_members():
     assert np.isfinite(results[1].history.history["loss"][-1])
     # retry reseeded: params differ from an identically-seeded fresh train
     assert results[1].name == "m1"
+    # the retry is auditable: FleetResult records the reseed and count,
+    # and the history params carry them into build metadata
+    assert results[1].retries == 1
+    assert results[1].seed == members[1].seed + 7919
+    assert results[1].history.params["fleet_retry"] == {
+        "retries": 1,
+        "seed": members[1].seed + 7919,
+    }
+    # untouched members record their original seed and zero retries
+    assert results[0].retries == 0 and results[0].seed == members[0].seed
+    assert "fleet_retry" not in results[0].history.params

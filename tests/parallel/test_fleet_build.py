@@ -232,3 +232,31 @@ def test_fleet_build_fail_fast_true_raises():
 
     with pytest.raises(InsufficientDataError):
         FleetBuilder([bad], fail_fast=True).build()
+
+
+def test_fleet_build_register_failure_not_dumped(tmp_path, monkeypatch):
+    """A machine that fails at the register step must not leave artifacts
+    in output_dir (its build is an error, not a product)."""
+    from gordo_tpu.builder.build_model import ModelBuilder
+
+    good = make_machine("reg-good", ["t1", "t2"])
+    doomed = make_machine("reg-doomed", ["t3", "t4"])
+    register_dir = tmp_path / "register"
+    output_dir = tmp_path / "out"
+
+    original_register = ModelBuilder.register
+
+    def failing_register(self, model, machine, register_directory):
+        if machine.name == "reg-doomed":
+            raise OSError("disk full")
+        return original_register(self, model, machine, register_directory)
+
+    monkeypatch.setattr(ModelBuilder, "register", failing_register)
+    builder = FleetBuilder([good, doomed])
+    results = builder.build(
+        output_dir=str(output_dir), model_register_dir=str(register_dir)
+    )
+    assert [m.name for _, m in results] == ["reg-good"]
+    assert set(builder.build_errors) == {"reg-doomed"}
+    assert (output_dir / "reg-good" / "model.pkl").exists()
+    assert not (output_dir / "reg-doomed").exists()

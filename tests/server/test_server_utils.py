@@ -1,0 +1,101 @@
+"""Wire-format tests for the server IO helpers (reference gordo/server/utils.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.server.utils import (
+    ServerError,
+    dataframe_from_dict,
+    dataframe_from_parquet_bytes,
+    dataframe_into_parquet_bytes,
+    dataframe_to_dict,
+    verify_dataframe,
+)
+
+
+def _multiindex_frame(index):
+    columns = pd.MultiIndex.from_tuples(
+        (f"feature{i}", f"sub-feature-{ii}") for i in range(2) for ii in range(2)
+    )
+    return pd.DataFrame(np.arange(8).reshape((2, 4)), columns=columns, index=index)
+
+
+def test_dataframe_to_dict_midnight_index_serializes_date_only():
+    """Reference wire-format parity (utils.py:129-131): an all-midnight
+    DatetimeIndex serializes via astype(str) as date-only keys."""
+    df = _multiindex_frame(pd.date_range("2019-01-01", "2019-02-01", periods=2))
+    out = dataframe_to_dict(df)
+    assert out["feature0"]["sub-feature-0"] == {"2019-01-01": 0, "2019-02-01": 4}
+
+
+def test_dataframe_to_dict_intraday_index_keeps_time():
+    df = _multiindex_frame(
+        pd.DatetimeIndex(["2019-01-01 06:30:00", "2019-01-01 12:45:00"])
+    )
+    out = dataframe_to_dict(df)
+    assert list(out["feature1"]["sub-feature-1"]) == [
+        "2019-01-01 06:30:00",
+        "2019-01-01 12:45:00",
+    ]
+
+
+@pytest.mark.parametrize(
+    "index",
+    [
+        pd.date_range("2019-01-01", "2019-02-01", periods=4),
+        pd.DatetimeIndex(["2019-01-01 06:30:00", "2019-01-02 12:00:01"]),
+        pd.RangeIndex(3),
+    ],
+)
+def test_dict_wire_format_roundtrip(index):
+    columns = pd.MultiIndex.from_tuples(
+        (f"f{i}", f"s{ii}") for i in range(2) for ii in range(2)
+    )
+    df = pd.DataFrame(
+        np.arange(4 * len(index)).reshape((len(index), 4)),
+        columns=columns,
+        index=index,
+    )
+    restored = dataframe_from_dict(dataframe_to_dict(df))
+    np.testing.assert_array_equal(restored.to_numpy(), df.to_numpy())
+    if isinstance(index, pd.DatetimeIndex):
+        assert (restored.index == index).all()
+
+
+def test_dataframe_to_dict_does_not_mutate_input():
+    df = _multiindex_frame(pd.date_range("2019-01-01", "2019-02-01", periods=2))
+    dataframe_to_dict(df)
+    assert isinstance(df.index, pd.DatetimeIndex)
+
+
+def test_parquet_roundtrip_preserves_multiindex():
+    df = _multiindex_frame(pd.date_range("2019-01-01", "2019-02-01", periods=2))
+    restored = dataframe_from_parquet_bytes(dataframe_into_parquet_bytes(df))
+    pd.testing.assert_frame_equal(restored, df)
+
+
+def test_verify_dataframe_rejects_multiindex_input():
+    df = _multiindex_frame(pd.RangeIndex(2))
+    with pytest.raises(ServerError) as excinfo:
+        verify_dataframe(df, ["a", "b"])
+    assert excinfo.value.status == 400
+
+
+def test_verify_dataframe_names_unlabeled_columns():
+    df = pd.DataFrame(np.zeros((3, 2)))
+    out = verify_dataframe(df, ["tag-1", "tag-2"])
+    assert list(out.columns) == ["tag-1", "tag-2"]
+
+
+def test_verify_dataframe_selects_and_orders_named_columns():
+    df = pd.DataFrame(np.arange(9).reshape(3, 3), columns=["c", "a", "b"])
+    out = verify_dataframe(df, ["a", "b"])
+    assert list(out.columns) == ["a", "b"]
+
+
+def test_verify_dataframe_wrong_width_is_400():
+    df = pd.DataFrame(np.zeros((3, 3)))
+    with pytest.raises(ServerError) as excinfo:
+        verify_dataframe(df, ["a", "b"])
+    assert excinfo.value.status == 400
