@@ -34,10 +34,9 @@ BENCH_SKIP_E2E=1 to skip stage 2.
 
 import json
 import os
-import signal
+import subprocess
 import sys
 import tempfile
-import threading
 import time
 import traceback
 
@@ -60,8 +59,8 @@ PARTIAL_PATH = os.environ.get(
 # matmul precision on TPU lowers to bf16 MXU passes). Used only for the
 # reported MFU; absent kinds report mfu=null.
 PEAK_FLOPS = {
-    "TPU v5 lite": 394e12,  # v5e
-    "TPU v5e": 394e12,
+    "TPU v5 lite": 197e12,  # v5e bf16 (394e12 is the int8 peak)
+    "TPU v5e": 197e12,
     "TPU v5p": 459e12,
     "TPU v4": 275e12,
     "TPU v3": 123e12,
@@ -73,16 +72,15 @@ def log(msg: str):
     print(f"# {msg}", file=sys.stderr, flush=True)
 
 
-# -- stage harness: timeout + transient retry + partial artifacts -----------
-
-
-class StageTimeout(Exception):
-    pass
-
-
-def _alarm_handler(signum, frame):
-    raise StageTimeout()
-
+# -- stage harness: subprocess isolation + timeout + retry ------------------
+#
+# Each stage runs in its own subprocess (`bench.py --stage <name> <out>`).
+# A hang inside the JAX/TPU C++ runtime (compile or execute over a dead
+# axon tunnel — the exact failure that zeroed round 1) is uninterruptible
+# by Python signals in-process, but a subprocess can simply be killed; the
+# parent never touches JAX, so later stages still run. When a stage times
+# out on the default (TPU) backend, one labeled CPU retry runs so the
+# round still gets a number — `extra.device` shows which backend scored.
 
 _TRANSIENT_MARKERS = (
     "UNAVAILABLE",
@@ -94,73 +92,110 @@ _TRANSIENT_MARKERS = (
     "failed to connect",
 )
 
-
-def _is_transient(exc: BaseException) -> bool:
-    text = f"{type(exc).__name__}: {exc}"
-    return any(marker in text for marker in _TRANSIENT_MARKERS)
+STAGES = {}
 
 
-def _arm_watchdog(partial: dict, name: str, seconds: float) -> threading.Timer:
-    """
-    Hard backstop for hangs SIGALRM cannot interrupt: a blocking call
-    inside the JAX/TPU C++ runtime (compile, execute, device_get over a
-    dead tunnel) never returns to the bytecode loop, so the Python alarm
-    handler never runs. This daemon timer flushes the partial artifact,
-    emits whatever final JSON is derivable from completed stages, and
-    hard-exits — bounding wall clock no matter where the hang lives.
-    """
+def stage(fn):
+    STAGES[fn.__name__] = fn
+    return fn
 
-    def expire():
-        partial[f"{name}_error"] = (
-            f"hard timeout after {seconds:.0f}s (uninterruptible backend hang)"
+
+def _run_stage_subprocess(name: str, timeout: int, force_cpu: bool):
+    """One attempt: returns (result dict | None, error string | None)."""
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        out_path = f.name
+    env = dict(os.environ)
+    if force_cpu:
+        env["BENCH_FORCE_CPU"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--stage", name, out_path],
+            timeout=timeout,
+            env=env,
         )
-        log(f"stage {name}: watchdog fired — backend hang; emitting partials")
-        _flush_partial(partial)
-        rc = _emit_result(partial)
-        os._exit(rc)
-
-    timer = threading.Timer(seconds, expire)
-    timer.daemon = True
-    timer.start()
-    return timer
-
-
-def run_stage(partial: dict, name: str, fn, timeout: int = STAGE_TIMEOUT, retries: int = 2):
-    """
-    Run one bench stage with a wall-clock alarm and retry on transient
-    backend errors (the axon TPU tunnel can drop mid-run — round 1's bench
-    was zeroed by exactly that). Results and failures are recorded into
-    ``partial`` and flushed to PARTIAL_PATH either way.
-    """
-    for attempt in range(retries + 1):
-        old_handler = signal.signal(signal.SIGALRM, _alarm_handler)
-        signal.alarm(timeout)
-        # The watchdog only fires if SIGALRM could not (hang inside the
-        # C++ runtime), so give the signal path a generous head start.
-        watchdog = _arm_watchdog(partial, name, timeout + 120)
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout}s (stage subprocess killed)"
+    finally:
+        payload = None
         try:
-            result = fn()
-            partial[name] = result
-            partial.pop(f"{name}_error", None)  # earlier attempts' failures
-            return result
-        except StageTimeout:
-            partial[f"{name}_error"] = f"timeout after {timeout}s"
-            log(f"stage {name}: timed out after {timeout}s")
-            return None
-        except Exception as exc:  # noqa: BLE001 - bench must survive anything
-            partial[f"{name}_error"] = f"{type(exc).__name__}: {exc}"
-            if _is_transient(exc) and attempt < retries:
-                log(f"stage {name}: transient failure ({exc!r}); retry {attempt + 1}")
-                time.sleep(2 * (attempt + 1))
-                continue
-            log(f"stage {name}: failed: {exc!r}")
-            traceback.print_exc(file=sys.stderr)
-            return None
-        finally:
-            watchdog.cancel()
-            signal.alarm(0)
-            signal.signal(signal.SIGALRM, old_handler)
-            _flush_partial(partial)
+            with open(out_path) as f:
+                content = f.read()
+            os.unlink(out_path)
+            payload = json.loads(content) if content else None
+        except (OSError, ValueError):
+            pass
+    if payload is None:
+        return None, f"stage subprocess died (rc={proc.returncode}) without a result"
+    if "error" in payload:
+        return None, payload["error"]
+    return payload, None
+
+
+def run_stage(partial: dict, name: str, timeout: int = STAGE_TIMEOUT, retries: int = 2):
+    """
+    Run one bench stage with subprocess isolation, transient-error retry,
+    and a final labeled CPU-backend attempt if the accelerator path hung.
+    Results/failures are recorded into ``partial`` and flushed either way.
+    """
+
+    def record(error):
+        partial[f"{name}_error"] = error
+        _flush_partial(partial)
+
+    def accept(result):
+        partial[name] = result
+        partial.pop(f"{name}_error", None)  # earlier attempts' failures
+        _flush_partial(partial)
+        return result
+
+    last_error = None
+    for attempt in range(retries + 1):
+        result, error = _run_stage_subprocess(name, timeout, force_cpu=False)
+        if result is not None:
+            return accept(result)
+        last_error = error
+        record(error)
+        log(f"stage {name}: attempt {attempt + 1} failed: {error}")
+        if "timeout" in error:
+            break  # wedged backend stays wedged — don't burn more timeouts
+        if not any(marker in error for marker in _TRANSIENT_MARKERS):
+            break  # deterministic failure; identical retries won't help
+        time.sleep(2 * (attempt + 1))
+
+    backend_shaped = last_error and (
+        "timeout" in last_error
+        or any(marker in last_error for marker in _TRANSIENT_MARKERS)
+    )
+    if backend_shaped:
+        log(f"stage {name}: accelerator path failed; labeled CPU fallback")
+        result, error = _run_stage_subprocess(name, timeout, force_cpu=True)
+        if result is not None:
+            # keep the accelerator failure visible next to the CPU number
+            partial[f"{name}_note"] = f"cpu fallback after: {last_error}"
+            return accept(result)
+        record(f"{last_error}; cpu fallback: {error}")
+        log(f"stage {name}: cpu fallback failed: {error}")
+    return None
+
+
+def _stage_entry(name: str, out_path: str) -> int:
+    """Subprocess side: run one stage, write its JSON result or error."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+
+        # Env vars are not enough: the axon plugin overrides platform
+        # selection through jax.config, so set it explicitly.
+        jax.config.update("jax_platforms", "cpu")
+        log(f"stage {name}: forced CPU backend")
+    try:
+        result = STAGES[name]()
+        payload = result
+    except Exception as exc:  # noqa: BLE001 - report, don't crash silently
+        traceback.print_exc(file=sys.stderr)
+        payload = {"error": f"{type(exc).__name__}: {exc}"}
+    with open(out_path, "w") as f:
+        json.dump(payload, f, default=str)
+    return 0
 
 
 def _flush_partial(partial: dict):
@@ -207,7 +242,8 @@ def _setup_jax_cache():
 # -- stage 1: bare fleet training ------------------------------------------
 
 
-def bench_fleet() -> dict:
+@stage
+def fleet_train() -> dict:
     """Bare fused-training throughput on the available accelerator."""
     from gordo_tpu.models.factories import feedforward_hourglass
     from gordo_tpu.models.training import FitConfig
@@ -288,7 +324,8 @@ def bench_fleet() -> dict:
 # -- stage 2: end-to-end fleet build ---------------------------------------
 
 
-def bench_fleet_build_e2e() -> dict:
+@stage
+def fleet_build_e2e() -> dict:
     """
     The product path from config to artifacts: NormalizedConfig machine
     validation -> data staging -> CV folds + thresholds -> final fit ->
@@ -359,7 +396,8 @@ def bench_fleet_build_e2e() -> dict:
 # -- stage 3: reference Keras baseline -------------------------------------
 
 
-def bench_reference_keras() -> dict:
+@stage
+def reference_keras() -> dict:
     """
     Reference-engine cost: Keras/TF2 CPU fit of the same architecture,
     measured over a few epochs and scaled to N_EPOCHS. Returns models/hour
@@ -432,8 +470,11 @@ def _emit_result(partial: dict) -> int:
             "e2e_n_machines": e2e["n_machines"] if e2e else None,
             "device": (fleet or e2e or {}).get("device"),
             "errors": {
-                k: v for k, v in partial.items() if k.endswith("_error")
-            } or None,
+                k: v
+                for k, v in partial.items()
+                if k.endswith("_error") or k.endswith("_note")
+            }
+            or None,
         },
     }
     partial["result"] = result
@@ -445,12 +486,15 @@ def _emit_result(partial: dict) -> int:
 
 
 def main():
+    if len(sys.argv) >= 4 and sys.argv[1] == "--stage":
+        sys.exit(_stage_entry(sys.argv[2], sys.argv[3]))
+
     partial: dict = {"n_models": N_MODELS, "epochs": N_EPOCHS}
 
-    run_stage(partial, "fleet_train", bench_fleet)
+    run_stage(partial, "fleet_train")
     if not os.environ.get("BENCH_SKIP_E2E"):
-        run_stage(partial, "fleet_build_e2e", bench_fleet_build_e2e)
-    reference = run_stage(partial, "reference_keras", bench_reference_keras, retries=0)
+        run_stage(partial, "fleet_build_e2e")
+    reference = run_stage(partial, "reference_keras", retries=0)
     if reference is None and os.path.exists(BASELINE_CACHE):
         with open(BASELINE_CACHE) as f:
             partial["reference_keras"] = {**json.load(f), "from_cache": True}

@@ -1,0 +1,123 @@
+"""
+Benchmark fixtures (reference style: benchmarks/test_ml_server.py runs
+against an in-process WSGI client; excluded from default CI like the
+reference's ``--benchmark-skip --ignore benchmarks``).
+
+``benchmark`` resolves to the real pytest-benchmark fixture when that
+plugin is installed; otherwise a lightweight timing shim with the same
+call contract (``benchmark(fn, *args)``) records rounds and prints
+mean/p50/p95 so numbers stay regression-comparable either way.
+"""
+
+import statistics
+import time
+
+import jax
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+
+from werkzeug.test import Client  # noqa: E402
+
+from gordo_tpu import serializer  # noqa: E402
+from gordo_tpu.machine import Machine  # noqa: E402
+from gordo_tpu.parallel import FleetBuilder  # noqa: E402
+from gordo_tpu.server import build_app  # noqa: E402
+
+from tests.server.conftest import temp_env_vars  # noqa: E402
+
+PROJECT = "bench-project"
+REVISION = "1700000000000"
+N_FLEET_MACHINES = 100
+
+try:
+    import pytest_benchmark  # noqa: F401
+
+    HAVE_PYTEST_BENCHMARK = True
+except ImportError:
+    HAVE_PYTEST_BENCHMARK = False
+
+
+if not HAVE_PYTEST_BENCHMARK:
+
+    class _Benchmark:
+        """Minimal stand-in for the pytest-benchmark fixture."""
+
+        rounds = 30
+        warmup_rounds = 3
+
+        def __init__(self, name):
+            self.name = name
+            self.timings = []
+
+        def __call__(self, fn, *args, **kwargs):
+            for _ in range(self.warmup_rounds):
+                result = fn(*args, **kwargs)
+            for _ in range(self.rounds):
+                start = time.perf_counter()
+                result = fn(*args, **kwargs)
+                self.timings.append(time.perf_counter() - start)
+            return result
+
+        def report(self):
+            if not self.timings:
+                return
+            ordered = sorted(self.timings)
+            mean = statistics.mean(ordered)
+            p50 = ordered[len(ordered) // 2]
+            p95 = ordered[int(len(ordered) * 0.95) - 1]
+            print(
+                f"\n[benchmark] {self.name}: mean {mean * 1e3:.2f}ms, "
+                f"p50 {p50 * 1e3:.2f}ms, p95 {p95 * 1e3:.2f}ms "
+                f"({len(ordered)} rounds)"
+            )
+
+    @pytest.fixture
+    def benchmark(request):
+        bench = _Benchmark(request.node.name)
+        yield bench
+        bench.report()
+
+
+def _machine(i: int) -> Machine:
+    return Machine.from_config(
+        {
+            "name": f"bench-m-{i:03d}",
+            "model": {
+                "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+                    "base_estimator": {
+                        "gordo_tpu.models.JaxAutoEncoder": {
+                            "kind": "feedforward_hourglass",
+                            "encoding_layers": 1,
+                            "epochs": 1,
+                        }
+                    }
+                }
+            },
+            "dataset": {
+                "type": "RandomDataset",
+                "train_start_date": "2020-01-01T00:00:00+00:00",
+                "train_end_date": "2020-01-02T00:00:00+00:00",
+                "tag_list": [f"tag-{i:03d}-a", f"tag-{i:03d}-b"],
+            },
+        },
+        project_name=PROJECT,
+    )
+
+
+@pytest.fixture(scope="session")
+def fleet_collection_dir(tmp_path_factory):
+    """A served revision with N_FLEET_MACHINES tiny anomaly models, built
+    as one fleet program (seconds, not minutes)."""
+    root = tmp_path_factory.mktemp("bench-collection") / REVISION
+    machines = [_machine(i) for i in range(N_FLEET_MACHINES)]
+    builder = FleetBuilder(machines)
+    results = builder.build(output_dir=str(root))
+    assert len(results) == N_FLEET_MACHINES, builder.build_errors
+    return str(root)
+
+
+@pytest.fixture
+def bench_client(fleet_collection_dir):
+    with temp_env_vars(MODEL_COLLECTION_DIR=fleet_collection_dir):
+        yield Client(build_app())

@@ -1,0 +1,117 @@
+"""
+ML-server benchmarks (reference harness style: in-process WSGI client,
+pytest-benchmark call contract — /root/reference/benchmarks/test_ml_server.py:21-41).
+
+Run with ``python -m pytest benchmarks/ -q -s``; excluded from the default
+test run like the reference's CI.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gordo_tpu import serializer
+from gordo_tpu.server.fleet_store import FleetModelStore
+
+from .conftest import N_FLEET_MACHINES, PROJECT
+
+ROWS = 100
+
+
+def _payload(machine_idx: int) -> dict:
+    index = [f"2020-03-01T{h:02d}:{m:02d}:00+00:00" for h in range(10) for m in range(0, 60, 6)][:ROWS]
+    rng = np.random.RandomState(machine_idx)
+    return {
+        f"tag-{machine_idx:03d}-{suffix}": {
+            ts: float(v) for ts, v in zip(index, rng.rand(ROWS))
+        }
+        for suffix in ("a", "b")
+    }
+
+
+def test_benchmark_anomaly_prediction(bench_client, benchmark):
+    """Reference parity bench: 100-row anomaly POST (ref :21-30)."""
+    payload = {"X": _payload(0), "y": _payload(0)}
+
+    def post():
+        resp = bench_client.post(
+            f"/gordo/v0/{PROJECT}/bench-m-000/anomaly/prediction", json=payload
+        )
+        assert resp.status_code == 200
+        return resp
+
+    benchmark(post)
+
+
+def test_benchmark_base_prediction(bench_client, benchmark):
+    """Reference parity bench: 100-row base prediction POST (ref :33-41)."""
+    payload = {"X": _payload(1)}
+
+    def post():
+        resp = bench_client.post(
+            f"/gordo/v0/{PROJECT}/bench-m-001/prediction", json=payload
+        )
+        assert resp.status_code == 200
+        return resp
+
+    benchmark(post)
+
+
+def test_benchmark_fleet_prediction_route(bench_client, benchmark):
+    """The batch route: all machines scored in one request."""
+    payload = {"X": {f"bench-m-{i:03d}": _payload(i) for i in range(N_FLEET_MACHINES)}}
+
+    def post():
+        resp = bench_client.post(
+            f"/gordo/v0/{PROJECT}/prediction/fleet", json=payload
+        )
+        assert resp.status_code == 200
+        return resp
+
+    benchmark(post)
+
+
+def test_fleet_store_10x_over_per_model_loading(fleet_collection_dir):
+    """
+    The round-robin serving pattern that broke the reference's LRU(2): at
+    100+ machines every request misses the cache and pays a fresh
+    unpickle. The fleet-resident store must be >=10x faster once warm.
+    """
+    import time
+
+    names = [f"bench-m-{i:03d}" for i in range(N_FLEET_MACHINES)]
+    # The replay workload shape: 10 days of 10-minute rows per machine.
+    n_rows = 1440
+    X = {name: np.random.RandomState(7).rand(n_rows, 2).astype(np.float32) for name in names}
+
+    # Old behavior: load-per-request (what an LRU(2) does on round-robin).
+    start = time.perf_counter()
+    for name in names:
+        model = serializer.load(f"{fleet_collection_dir}/{name}")
+        model.predict(X[name])
+    per_model_s = time.perf_counter() - start
+
+    store = FleetModelStore(max_revisions=1)
+    fleet = store.fleet(fleet_collection_dir)
+    fleet.warm(names)  # one-time residency cost, amortized over serving life
+    fleet.fleet_scores(X)  # XLA compile warmup at the measured shape
+    fleet.model(names[0]).predict(X[names[0]])  # same for the per-model program
+
+    start = time.perf_counter()
+    for name in names:
+        fleet.model(name).predict(X[name])
+    resident_s = time.perf_counter() - start
+
+    # And the fused whole-fleet path, for the batch route.
+    start = time.perf_counter()
+    fleet.fleet_scores(X)
+    fused_s = time.perf_counter() - start
+
+    print(
+        f"\n[benchmark] {N_FLEET_MACHINES} machines round-robin: "
+        f"per-request unpickle {per_model_s:.3f}s, resident {resident_s:.3f}s "
+        f"({per_model_s / resident_s:.1f}x), fused bucket {fused_s:.3f}s "
+        f"({per_model_s / fused_s:.1f}x)"
+    )
+    assert per_model_s / resident_s >= 10 or per_model_s / fused_s >= 10

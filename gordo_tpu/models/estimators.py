@@ -335,6 +335,20 @@ class JaxLSTMBaseEstimator(JaxBaseEstimator, TransformerMixin, metaclass=abc.ABC
             raise NotFittedError(f"This {type(self).__name__} has not been fitted yet.")
         X = X.values if isinstance(X, pd.DataFrame) else np.asarray(X)
         X = self._validate_and_fix_size_of_X(X)
+
+        from ..parallel.sequence import ring_predict_enabled, ring_windowed_predict
+
+        if ring_predict_enabled(len(X)):
+            # Long series: shard the time axis over the devices and exchange
+            # window halos over ICI (parallel/sequence.py) — the host never
+            # materializes the lookback× window blowup.
+            return ring_windowed_predict(
+                predict_fn(self.spec_),
+                self.params_,
+                np.asarray(X, np.float32),
+                self.lookback_window,
+                self.lookahead,
+            )
         windows = sliding_windows(X, self.lookback_window, self.lookahead)
         out = predict_fn(self.spec_)(self.params_, np.asarray(windows, np.float32))
         return np.asarray(out)

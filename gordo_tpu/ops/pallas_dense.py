@@ -48,6 +48,13 @@ def _layer_names(spec: FeedForwardSpec) -> List[Tuple[str, str]]:
     return names
 
 
+# Row-block size of the batch grid axis. Bounds VMEM residency per grid
+# step to ~BLOCK_B × max(width) activations regardless of request size —
+# without it a large B (e.g. a year of 10-min rows ≈ 52k) would try to
+# hold the whole [B, F] block in VMEM and fail to compile.
+BLOCK_B = 512
+
+
 def fleet_feedforward_pallas(
     spec: FeedForwardSpec,
     stacked_params: Params,
@@ -62,46 +69,57 @@ def fleet_feedforward_pallas(
     leaf), as produced by ``parallel.fleet.stack_member_params``.
 
     Semantically identical to ``vmap(forward_feedforward)`` without the
-    activity-penalty output (inference only).
+    activity-penalty output (inference only). The grid is (models,
+    row-blocks): each step walks the whole layer stack for one model's
+    ``BLOCK_B`` rows with activations resident in VMEM.
     """
     names = _layer_names(spec)
     M, B, F = X.shape
     f_out = spec.n_features_out
 
+    block_b = min(B, BLOCK_B)
+    b_pad = -(-B // block_b) * block_b
+    if b_pad != B:
+        X = jnp.pad(X, ((0, 0), (0, b_pad - B), (0, 0)))
+
     # Flatten params into the pallas_call argument list, layer order.
+    # Biases ride as [M, 1, d_out]: a (1, d_out) block of an [M, d_out]
+    # array violates the TPU tiling rule (second-to-last block dim must
+    # divide 8 or equal the array dim); a trailing-(1, d_out) block of an
+    # [M, 1, d_out] array satisfies it exactly.
     flat: List[jnp.ndarray] = []
     for key, _ in names:
         flat.append(stacked_params[key]["W"])
-        flat.append(stacked_params[key]["b"])
+        flat.append(stacked_params[key]["b"][:, None, :])
 
     def kernel(x_ref, *refs):
         out_ref = refs[-1]
         param_refs = refs[:-1]
-        h = x_ref[0]  # [B, F] this model's row block, in VMEM
+        h = x_ref[0]  # [block_b, F] this model's row block, in VMEM
         for li, (_, act_name) in enumerate(names):
             w = param_refs[2 * li][0]  # [d_in, d_out]
-            b = param_refs[2 * li + 1][0]  # [d_out]
+            b = param_refs[2 * li + 1][0, 0]  # [d_out]
             h = jnp.dot(h, w, preferred_element_type=jnp.float32) + b
             h = resolve_activation(act_name)(h)
         out_ref[0] = h
 
     mem = {} if _VMEM is None else {"memory_space": _VMEM}
-    in_specs = [pl.BlockSpec((1, B, F), lambda m: (m, 0, 0), **mem)]
+    in_specs = [pl.BlockSpec((1, block_b, F), lambda m, bi: (m, bi, 0), **mem)]
     for key, _ in names:
         w = stacked_params[key]["W"]
-        b = stacked_params[key]["b"]
         d_in, d_out = w.shape[-2], w.shape[-1]
-        in_specs.append(pl.BlockSpec((1, d_in, d_out), lambda m: (m, 0, 0), **mem))
-        in_specs.append(pl.BlockSpec((1, d_out), lambda m: (m, 0), **mem))
+        in_specs.append(pl.BlockSpec((1, d_in, d_out), lambda m, bi: (m, 0, 0), **mem))
+        in_specs.append(pl.BlockSpec((1, 1, d_out), lambda m, bi: (m, 0, 0), **mem))
 
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
-        grid=(M,),
+        grid=(M, b_pad // block_b),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, B, f_out), lambda m: (m, 0, 0), **mem),
-        out_shape=jax.ShapeDtypeStruct((M, B, f_out), jnp.float32),
+        out_specs=pl.BlockSpec((1, block_b, f_out), lambda m, bi: (m, bi, 0), **mem),
+        out_shape=jax.ShapeDtypeStruct((M, b_pad, f_out), jnp.float32),
         interpret=interpret,
     )(X.astype(jnp.float32), *flat)
+    return out[:, :B]
 
 
 @partial(jax.jit, static_argnums=(0,), static_argnames=("interpret",))

@@ -123,6 +123,23 @@ def _fill_weight_row(wtr, wval, i, n, member, config: FitConfig):
         wval[i, : len(member.val_weights)] = member.val_weights
 
 
+def fetch_to_host(tree):
+    """
+    Device arrays → host numpy, multi-host safe: results of the sharded
+    fleet programs span every process's devices, and ``device_get`` cannot
+    fetch non-addressable shards — each process instead all-gathers the
+    global value (one collective over ICI/DCN, symmetric across the SPMD
+    processes). Single-process runs keep the plain ``device_get`` path.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        # tiled=True is the only mode for global arrays (and for them it
+        # just means "replicate the global value", no reshaping).
+        return multihost_utils.process_allgather(tree, tiled=True)
+    return jax.device_get(tree)
+
+
 def host_prng_keys(seeds: Sequence[int]) -> np.ndarray:
     """
     Threefry PRNG keys built host-side, bit-identical to
@@ -497,10 +514,10 @@ class FleetTrainer:
     def _collect_results(
         self, bucket, params, losses, val_losses, epochs_ran, config, steps
     ) -> List[FleetResult]:
-        host_params = jax.device_get(params)
-        losses = np.asarray(losses)
-        val_losses = np.asarray(val_losses)
-        epochs_ran = np.asarray(epochs_ran)
+        host_params = fetch_to_host(params)
+        losses = np.asarray(fetch_to_host(losses))
+        val_losses = np.asarray(fetch_to_host(val_losses))
+        epochs_ran = np.asarray(fetch_to_host(epochs_ran))
 
         results = []
         for i, member in enumerate(bucket):
@@ -560,7 +577,7 @@ class FleetTrainer:
                 stacked_params,
             )
         X = jax.device_put(X, model_data_sharding(self.mesh, extra_dims=X.ndim - 2))
-        out = np.asarray(fleet_predict_program(spec)(stacked_params, X))
+        out = np.asarray(fetch_to_host(fleet_predict_program(spec)(stacked_params, X)))
         return out[:m, :n]
 
     def predict_windowed_bucket(
@@ -603,8 +620,10 @@ class FleetTrainer:
         series = jax.device_put(series, ms2)
         order = jax.device_put(order, model_sharding(self.mesh, extra_dims=1))
         out = np.asarray(
-            fleet_windowed_predict_program(spec, batch_size)(
-                stacked_params, series, order
+            fetch_to_host(
+                fleet_windowed_predict_program(spec, batch_size)(
+                    stacked_params, series, order
+                )
             )
         )
         return out[:m, :nv]

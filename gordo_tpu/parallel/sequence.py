@@ -53,6 +53,32 @@ from .mesh import DATA_AXIS
 
 logger = logging.getLogger(__name__)
 
+#: Row threshold above which windowed estimators route prediction through
+#: the ring (time-sharded) path instead of host-materializing windows.
+#: Overridable via the env var; <= 0 disables the ring path entirely.
+RING_PREDICT_ROWS_ENV = "GORDO_TPU_RING_PREDICT_ROWS"
+DEFAULT_RING_PREDICT_ROWS = 65_536
+
+
+def ring_predict_enabled(n_rows: int) -> bool:
+    """
+    Whether a windowed predict over ``n_rows`` should take the ring path:
+    the series is long enough that the host-side ``lookback×`` window
+    materialization hurts (threshold rows), and there is more than one
+    device to shard the time axis over.
+    """
+    import os
+
+    try:
+        threshold = int(
+            os.environ.get(RING_PREDICT_ROWS_ENV, DEFAULT_RING_PREDICT_ROWS)
+        )
+    except ValueError:
+        threshold = DEFAULT_RING_PREDICT_ROWS
+    if threshold <= 0:
+        return False
+    return n_rows >= threshold and len(jax.devices()) > 1
+
 
 def _right_halo(local: jnp.ndarray, halo: int, axis_name: str, axis_size: int):
     """

@@ -173,6 +173,11 @@ URL_MAP = Map(
             endpoint="delete-revision",
             methods=["DELETE"],
         ),
+        Rule(
+            f"{PREFIX}/<gordo_project>/prediction/fleet",
+            endpoint="fleet-prediction",
+            methods=["POST"],
+        ),
         Rule(f"{PREFIX}/<gordo_project>/models", endpoint="models", methods=["GET"]),
         Rule(
             f"{PREFIX}/<gordo_project>/revisions",
@@ -191,6 +196,7 @@ URL_MAP = Map(
 HANDLERS = {
     "prediction": base.post_prediction,
     "anomaly-prediction": anomaly.post_anomaly_prediction,
+    "fleet-prediction": base.post_fleet_prediction,
     "metadata": base.get_metadata,
     "model-healthcheck": base.get_metadata,
     "download-model": base.get_download_model,

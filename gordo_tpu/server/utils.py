@@ -3,9 +3,13 @@ Server-side IO and caching helpers.
 
 Reference parity: gordo/server/utils.py — parquet⇄DataFrame (pyarrow),
 MultiIndex-DataFrame⇄nested-dict JSON form, input verification against the
-model's tags, LRU-cached model loading (``N_CACHED_MODELS``, default 2) and
-zlib-compressed metadata caching (``N_CACHED_METADATA``, default 250),
-revision deletion, and name/revision validation regexes.
+model's tags, zlib-compressed metadata caching (``N_CACHED_METADATA``,
+default 250), revision deletion, and name/revision validation regexes.
+
+Engine difference from the reference's model cache: models are served from
+the fleet-resident store (``fleet_store.py`` — load-once per revision,
+device-resident params, ``N_CACHED_REVISIONS`` bounds revision count)
+instead of an LRU(2) of unpickles per request.
 
 Engine difference: no Flask — these helpers are plain functions operating on
 an explicit :class:`gordo_tpu.server.app.RequestContext` instead of
@@ -220,11 +224,17 @@ def extract_X_y(ctx) -> None:
 # -- model / metadata caches -----------------------------------------------
 
 
-@lru_cache(maxsize=int(os.getenv("N_CACHED_MODELS", 2)))
 def load_model(directory: str, name: str):
-    """LRU-cached model load; key is (revision dir, model name)."""
+    """
+    A served model, from the fleet-resident store: loaded once per
+    revision, JAX parameters kept on device, never evicted model-by-model.
+    Replaces the reference's LRU(2)-of-pickles (utils.py:334-353), which
+    reloads from disk on nearly every request once >2 models are in play.
+    """
+    from .fleet_store import STORE
+
     start_time = timeit.default_timer()
-    model = serializer.load(os.path.join(directory, name))
+    model = STORE.get_model(directory, name)
     logger.debug("Time to load model: %.4fs", timeit.default_timer() - start_time)
     return model
 
@@ -277,10 +287,13 @@ def delete_revision(directory: str, name: str):
     Delete one model from a revision directory, and the revision directory
     itself once empty (reference utils.py:404-422).
     """
+    from .fleet_store import STORE
+
     full_path = os.path.join(directory, name)
     if not os.path.isfile(os.path.join(full_path, serializer.METADATA_FILE)):
         raise ServerError("Not found", status=404)
     shutil.rmtree(full_path, ignore_errors=True)
+    STORE.invalidate(directory)
     if os.path.exists(full_path):
         raise ServerError("Unable to delete this model revision folder", status=500)
     if not os.listdir(directory):

@@ -73,6 +73,68 @@ def post_prediction(ctx, gordo_project: str, gordo_name: str):
     return ctx.json_response(context)
 
 
+def post_fleet_prediction(ctx, gordo_project: str):
+    """
+    TPU-native extension route (no reference analog): score MANY models in
+    one request. Body ``{"X": {<model-name>: <dataframe-dict>}}``; models
+    sharing an architecture are stacked and scored as one fused device
+    program (Pallas kernel on TPU, XLA vmap elsewhere) instead of N
+    pickle-load + predict round trips. Response per model: ``model-output``
+    rows and the ``total-anomaly-unscaled`` per-row mse.
+    """
+    from types import SimpleNamespace
+
+    from ..fleet_store import STORE
+
+    request = ctx.request
+    body = request.get_json(silent=True) if request.is_json else None
+    if not body or not isinstance(body.get("X"), dict) or not body["X"]:
+        raise server_utils.ServerError(
+            'Fleet prediction needs a JSON body {"X": {<model-name>: frame}}'
+        )
+
+    frames: Dict[str, pd.DataFrame] = {}
+    errors: Dict[str, Dict[str, Any]] = {}
+    for name, payload in body["X"].items():
+        try:
+            server_utils.validate_gordo_name(name)
+            server_utils.check_metadata_file(ctx.collection_dir, name)
+            metadata = server_utils.load_metadata(ctx.collection_dir, name)
+            frame = server_utils.dataframe_from_dict(payload)
+            tags = get_tags(SimpleNamespace(metadata=metadata))
+            frames[name] = server_utils.verify_dataframe(
+                frame, [t.name for t in tags]
+            )
+        except FileNotFoundError:
+            errors[name] = {"error": f"No such model found: '{name}'", "status": 404}
+        except server_utils.ServerError as exc:
+            errors[name] = {**exc.payload, "status": exc.status}
+        except (ValueError, TypeError, KeyError) as exc:
+            # malformed frame payloads (unparseable index etc.) are that
+            # machine's problem, never the whole batch's
+            errors[name] = {"error": f"Invalid frame payload: {exc}", "status": 400}
+
+    data: Dict[str, Any] = {}
+    if frames:
+        scores = STORE.fleet(ctx.collection_dir).fleet_scores(frames)
+        for name, (reconstruction, mse) in scores.items():
+            index = frames[name].index
+            out_index = index[len(index) - len(reconstruction):]
+            output = pd.DataFrame(reconstruction, index=out_index)
+            output.columns = output.columns.map(str)
+            data[name] = {
+                "model-output": server_utils.dataframe_to_dict(output),
+                "total-anomaly-unscaled": server_utils.dataframe_to_dict(
+                    pd.DataFrame({"mse": mse}, index=out_index)
+                )["mse"],
+            }
+
+    context: Dict[str, Any] = {"data": data}
+    if errors:
+        context["errors"] = errors
+    return ctx.json_response(context, status=200 if data else 400)
+
+
 def delete_model_revision(ctx, gordo_project: str, gordo_name: str, revision: str):
     """Delete a (non-current) model revision from disk."""
     server_utils.validate_gordo_name(gordo_name)

@@ -50,3 +50,19 @@ def test_pallas_anomaly_scores():
         ((np.asarray(expected_out) - X) ** 2).mean(-1),
         rtol=1e-5, atol=1e-6,
     )
+
+
+def test_pallas_forward_large_batch_blocked(monkeypatch):
+    """B larger than the row-block tile: the batch grid axis + padding must
+    keep parity (this bounds VMEM for big serving requests)."""
+    import gordo_tpu.ops.pallas_dense as pallas_dense
+
+    monkeypatch.setattr(pallas_dense, "BLOCK_B", 16)
+    spec = feedforward_hourglass(7)
+    params = _stacked(spec, 2, 3)
+    # 50 rows: 3 full 16-row blocks + a 2-row tail forcing padding
+    X = np.random.RandomState(3).rand(2, 50, 7).astype(np.float32)
+    expected = jax.vmap(lambda p, x: forward_feedforward(spec, p, x)[0])(params, X)
+    got = pallas_dense.fleet_feedforward_pallas(spec, params, X, interpret=True)
+    assert got.shape == (2, 50, 7)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-5, atol=1e-6)

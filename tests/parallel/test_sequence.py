@@ -83,3 +83,55 @@ def test_ring_rejects_multiaxis_mesh():
     X = np.random.RandomState(4).rand(64, 3).astype(np.float32)
     with pytest.raises(ValueError, match="axis 'models' has size 2"):
         ring_windowed_predict(predict_fn(spec), params, X, 4, 0, mesh=mesh)
+
+
+def test_lstm_estimator_routes_long_series_through_ring(monkeypatch):
+    """The product call site: JaxLSTMBaseEstimator.predict takes the ring
+    (time-sharded) path past the row threshold, with identical output."""
+    import gordo_tpu.parallel.sequence as sequence
+    from gordo_tpu.models.estimators import JaxLSTMAutoEncoder
+
+    rng = np.random.RandomState(0)
+    train = rng.rand(64, 3).astype(np.float32)
+    est = JaxLSTMAutoEncoder(
+        kind="lstm_model", lookback_window=4, epochs=1, batch_size=16
+    )
+    est.fit(train, train)
+
+    series = rng.rand(400, 3).astype(np.float32)
+    monkeypatch.setenv(sequence.RING_PREDICT_ROWS_ENV, "0")  # ring disabled
+    direct = est.predict(series)
+    monkeypatch.setenv(sequence.RING_PREDICT_ROWS_ENV, "300")  # 400 > 300: ring on
+    calls = []
+    original = sequence.ring_windowed_predict
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return original(*args, **kwargs)
+
+    monkeypatch.setattr(sequence, "ring_windowed_predict", spy)
+    ringed = est.predict(series)
+
+    assert calls, "long-series predict did not route through the ring path"
+    assert ringed.shape == direct.shape
+    np.testing.assert_allclose(ringed, direct, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_estimator_short_series_stays_on_window_path(monkeypatch):
+    import gordo_tpu.parallel.sequence as sequence
+    from gordo_tpu.models.estimators import JaxLSTMAutoEncoder
+
+    rng = np.random.RandomState(1)
+    train = rng.rand(64, 2).astype(np.float32)
+    est = JaxLSTMAutoEncoder(
+        kind="lstm_model", lookback_window=4, epochs=1, batch_size=16
+    )
+    est.fit(train, train)
+    monkeypatch.setenv(sequence.RING_PREDICT_ROWS_ENV, "1000")
+
+    def boom(*args, **kwargs):
+        raise AssertionError("ring path must not trigger below threshold")
+
+    monkeypatch.setattr(sequence, "ring_windowed_predict", boom)
+    out = est.predict(rng.rand(50, 2).astype(np.float32))
+    assert out.shape[0] == 50 - 3  # lookback offset

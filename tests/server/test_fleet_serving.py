@@ -1,0 +1,156 @@
+"""
+Fleet-resident serving: the revision store (no per-model eviction, device
+params resident) and the batch fleet-prediction route that scores many
+models as one fused device program.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gordo_tpu import serializer
+from gordo_tpu.server.fleet_store import FleetModelStore, RevisionFleet
+
+from .conftest import PROJECT
+
+
+@pytest.fixture
+def fleet_payload(sensor_payload):
+    """Per-machine X frames: machine-1 has 4 tags, machine-2 has 2."""
+    index = sorted(next(iter(sensor_payload["X"].values())))
+    return {
+        "machine-1": sensor_payload["X"],
+        "machine-2": {
+            f"tag-{i}": {ts: 0.05 * i + 0.02 * j for j, ts in enumerate(index)}
+            for i in range(1, 3)
+        },
+    }
+
+
+def test_fleet_prediction_route(client, fleet_payload):
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/prediction/fleet", json={"X": fleet_payload}
+    )
+    assert resp.status_code == 200, resp.text
+    body = json.loads(resp.data)
+    assert set(body["data"]) == {"machine-1", "machine-2"}
+    for name, payload in fleet_payload.items():
+        entry = body["data"][name]
+        n_rows = len(next(iter(payload.values())))
+        assert len(entry["total-anomaly-unscaled"]) == n_rows
+        assert len(entry["model-output"]) == len(payload)  # one col per tag
+    assert "revision" in body
+
+
+def test_fleet_prediction_matches_single_model(client, collection_dir, fleet_payload):
+    """The fused bucket path must agree with each model's own predict."""
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/prediction/fleet", json={"X": fleet_payload}
+    )
+    body = json.loads(resp.data)
+
+    from gordo_tpu.server.utils import dataframe_from_dict
+
+    for name in fleet_payload:
+        model = serializer.load(f"{collection_dir}/{name}")
+        X = dataframe_from_dict(fleet_payload[name])
+        expected = np.asarray(model.predict(X))
+        got_cols = body["data"][name]["model-output"]
+        got = np.column_stack(
+            [
+                [got_cols[str(i)][k] for k in sorted(got_cols[str(i)])]
+                for i in range(expected.shape[1])
+            ]
+        )
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_fleet_prediction_missing_model_reported_per_machine(client, fleet_payload):
+    payload = {**fleet_payload, "no-such-machine": fleet_payload["machine-2"]}
+    resp = client.post(f"/gordo/v0/{PROJECT}/prediction/fleet", json={"X": payload})
+    assert resp.status_code == 200  # good machines still scored
+    body = json.loads(resp.data)
+    assert set(body["data"]) == {"machine-1", "machine-2"}
+    assert body["errors"]["no-such-machine"]["status"] == 404
+
+
+def test_fleet_prediction_requires_body(client):
+    resp = client.post(f"/gordo/v0/{PROJECT}/prediction/fleet", json={})
+    assert resp.status_code == 400
+
+
+def test_fleet_prediction_wrong_columns_is_per_machine_error(client, fleet_payload):
+    # three wrong-named columns into a 2-tag model: neither a name match
+    # nor a width match, so verification must fail for that machine
+    bad = {
+        "machine-2": {
+            name: {"2020-03-01T00:00:00+00:00": 1.0} for name in ("a", "b", "c")
+        }
+    }
+    resp = client.post(f"/gordo/v0/{PROJECT}/prediction/fleet", json={"X": bad})
+    assert resp.status_code == 400
+    body = json.loads(resp.data)
+    assert body["errors"]["machine-2"]["status"] == 400
+
+
+# -- the store itself --------------------------------------------------------
+
+
+def test_store_single_residency(collection_dir):
+    store = FleetModelStore(max_revisions=2)
+    first = store.get_model(collection_dir, "machine-1")
+    again = store.get_model(collection_dir, "machine-1")
+    assert first is again  # loaded once, resident — not re-unpickled
+
+
+def test_store_revision_eviction(collection_dir, tmp_path):
+    store = FleetModelStore(max_revisions=1)
+    fleet_a = store.fleet(collection_dir)
+    fleet_b = store.fleet(str(tmp_path))  # different revision key
+    assert store.fleet(str(tmp_path)) is fleet_b
+    assert store.fleet(collection_dir) is not fleet_a  # evicted by b
+
+
+def test_store_invalidate(collection_dir):
+    store = FleetModelStore(max_revisions=2)
+    fleet = store.fleet(collection_dir)
+    store.invalidate(collection_dir)
+    assert store.fleet(collection_dir) is not fleet
+
+
+def test_fleet_scores_bucket_groups_same_spec(collection_dir):
+    """Models sharing a spec score through ONE stacked bucket program."""
+    fleet = RevisionFleet(collection_dir)
+    fleet.warm()
+    specs = fleet.loaded_specs()
+    assert set(specs) == {"machine-1", "machine-2"}
+
+    rng = np.random.RandomState(0)
+    inputs = {
+        "machine-1": rng.rand(7, 4).astype(np.float32),
+        "machine-2": rng.rand(5, 2).astype(np.float32),
+    }
+    scores = fleet.fleet_scores(inputs)
+    for name, (recon, mse) in scores.items():
+        assert recon.shape[0] == len(inputs[name])
+        assert mse.shape == (len(inputs[name]),)
+        assert np.all(np.isfinite(mse)) and np.all(mse >= 0)
+        # parity with the model's own predict
+        model = fleet.model(name)
+        np.testing.assert_allclose(
+            recon, np.asarray(model.predict(inputs[name])), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_fleet_prediction_malformed_frame_is_per_machine_error(client, fleet_payload):
+    """A bad payload for one machine must not 500 the batch."""
+    payload = {
+        **fleet_payload,
+        "machine-2": {"tag-1": {"not-a-date": 1.0}, "tag-2": {"not-a-date": 2.0}},
+    }
+    resp = client.post(f"/gordo/v0/{PROJECT}/prediction/fleet", json={"X": payload})
+    assert resp.status_code == 200  # machine-1 still scored
+    body = json.loads(resp.data)
+    assert "machine-1" in body["data"]
+    assert body["errors"]["machine-2"]["status"] == 400
