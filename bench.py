@@ -166,7 +166,9 @@ def run_stage(partial: dict, name: str, timeout: int = STAGE_TIMEOUT, retries: i
         "timeout" in last_error
         or any(marker in last_error for marker in _TRANSIENT_MARKERS)
     )
-    if backend_shaped:
+    # Only the JAX stages have an accelerator to fall back FROM; re-running
+    # the pure-TF reference stage with BENCH_FORCE_CPU would change nothing.
+    if backend_shaped and name in ("fleet_train", "fleet_build_e2e"):
         log(f"stage {name}: accelerator path failed; labeled CPU fallback")
         result, error = _run_stage_subprocess(name, timeout, force_cpu=True)
         if result is not None:

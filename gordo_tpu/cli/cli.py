@@ -470,11 +470,113 @@ def _maybe_init_distributed():
         )
 
 
+@click.command("wait-for-models")
+@click.argument("models-dir", envvar="MODELS_DIR")
+@click.option(
+    "--name",
+    "names",
+    multiple=True,
+    help="Model names to wait for; repeatable. Default: EXPECTED_MODELS env",
+)
+@click.option("--timeout", default=3600, type=int, envvar="WAIT_TIMEOUT")
+@click.option("--poll-interval", default=10, type=int)
+def wait_for_models(
+    models_dir: str, names: Tuple[str, ...], timeout: int, poll_interval: int
+):
+    """
+    Block until every named model's artifacts exist under MODELS_DIR.
+
+    The plain-k8s stand-in for the reference DAG's step ordering (its
+    client/cleanup steps depend on builder steps): replay and
+    revision-cleanup Jobs run this in an initContainer so they start only
+    after the fleet builders have written the revision.
+    """
+    import os
+    import time as time_mod
+
+    if not names:
+        names = tuple(yaml.safe_load(os.getenv("EXPECTED_MODELS", "[]")) or ())
+    if not names:
+        raise click.ClickException("No model names given (--name / EXPECTED_MODELS)")
+
+    deadline = time_mod.monotonic() + timeout
+    missing = set(names)
+    while missing:
+        missing = {
+            name
+            for name in missing
+            if not os.path.isfile(os.path.join(models_dir, name, "metadata.json"))
+        }
+        if not missing:
+            break
+        if time_mod.monotonic() > deadline:
+            raise click.ClickException(
+                f"Timed out after {timeout}s waiting for models: "
+                f"{', '.join(sorted(missing)[:10])}"
+            )
+        logger.info("Waiting for %d model(s)...", len(missing))
+        time_mod.sleep(poll_interval)
+    click.echo(f"All {len(names)} models present in {models_dir}")
+
+
+@click.command("cleanup-revisions")
+@click.argument("models-root", envvar="MODELS_ROOT")
+@click.argument("current-revision", envvar="PROJECT_REVISION")
+@click.option(
+    "--keep",
+    default=3,
+    type=int,
+    help="How many newest revisions to retain (the current one always is)",
+)
+@click.option("--dry-run", is_flag=True)
+def cleanup_revisions(models_root: str, current_revision: str, keep: int, dry_run: bool):
+    """
+    Delete old model revisions under MODELS_ROOT, keeping the newest
+    ``--keep`` plus always the current one.
+
+    The reference cleans stale revisions in its workflow's onExit handler
+    by deleting per-revision k8s resources (argo-workflow.yml.template
+    onExit section); here revisions are directories on the shared model
+    volume, so lifecycle is a filesystem sweep — no k8s API, no RBAC.
+    """
+    import os
+    import shutil
+
+    try:
+        entries = sorted(
+            (
+                entry
+                for entry in os.listdir(models_root)
+                if os.path.isdir(os.path.join(models_root, entry)) and entry.isdigit()
+            ),
+            key=int,  # numeric, not lexicographic: '1000' is newer than '999'
+        )
+    except FileNotFoundError:
+        raise click.ClickException(f"No such models root: {models_root}")
+
+    retained = set(entries[-keep:] if keep > 0 else [])
+    retained.add(current_revision)
+    doomed = [entry for entry in entries if entry not in retained]
+    for revision in doomed:
+        path = os.path.join(models_root, revision)
+        if dry_run:
+            click.echo(f"Would delete {path}")
+            continue
+        logger.info("Deleting old revision %s", path)
+        shutil.rmtree(path, ignore_errors=True)
+    click.echo(
+        f"Revisions: {len(entries) - len(doomed)} kept, {len(doomed)} deleted"
+        f"{' (dry run)' if dry_run else ''}"
+    )
+
+
 gordo_tpu_cli.add_command(workflow_cli)
 gordo_tpu_cli.add_command(client_cli)
 gordo_tpu_cli.add_command(build)
 gordo_tpu_cli.add_command(build_fleet)
 gordo_tpu_cli.add_command(run_server_cli)
+gordo_tpu_cli.add_command(wait_for_models)
+gordo_tpu_cli.add_command(cleanup_revisions)
 
 
 if __name__ == "__main__":

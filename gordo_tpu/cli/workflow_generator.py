@@ -384,6 +384,59 @@ def workflow_cli(gordo_ctx):
     envvar=f"{PREFIX}_MODELS_STORAGE_SIZE",
     default="10Gi",
 )
+@click.option(
+    "--with-istio",
+    is_flag=True,
+    help="Emit an Istio VirtualService routing /gordo/v0/<project>/ to the server",
+    envvar=f"{PREFIX}_WITH_ISTIO",
+)
+@click.option(
+    "--istio-gateway",
+    default="istio-system/ingressgateway",
+    help="Gateway the VirtualService binds to",
+    envvar=f"{PREFIX}_ISTIO_GATEWAY",
+)
+@click.option(
+    "--istio-host",
+    default="*",
+    help="Host the VirtualService matches",
+    envvar=f"{PREFIX}_ISTIO_HOST",
+)
+@click.option(
+    "--with-prediction-replay",
+    is_flag=True,
+    help="Emit a replay Job that scores every built model through the "
+    "server and forwards parquet predictions onto the model volume",
+    envvar=f"{PREFIX}_WITH_PREDICTION_REPLAY",
+)
+@click.option(
+    "--replay-start",
+    default=None,
+    help="Replay window start (ISO, tz-aware). Default: 24h before generation",
+    envvar=f"{PREFIX}_REPLAY_START",
+)
+@click.option(
+    "--replay-end",
+    default=None,
+    help="Replay window end (ISO, tz-aware). Default: generation time",
+    envvar=f"{PREFIX}_REPLAY_END",
+)
+@click.option(
+    "--client-max-instances",
+    type=int,
+    default=30,
+    help="Concurrent prediction requests during replay (reference's client "
+    "concurrency cap)",
+    envvar=f"{PREFIX}_CLIENT_MAX_INSTANCES",
+)
+@click.option(
+    "--revisions-to-keep",
+    type=int,
+    default=3,
+    help="Old revisions retained on the model volume by the cleanup Job; "
+    "0 disables cleanup",
+    envvar=f"{PREFIX}_REVISIONS_TO_KEEP",
+)
 @click.pass_context
 def workflow_generator_cli(gordo_ctx, **ctx):
     """Machine configuration to TPU fleet workflow manifests."""
@@ -494,6 +547,19 @@ def workflow_generator_cli(gordo_ctx, **ctx):
     context["keda_prometheus_query"] = render_keda_query(
         context["keda_prometheus_query"], context["project_name"]
     )
+
+    # Replay window defaults: the 24 hours leading up to generation.
+    import datetime as _datetime
+
+    generated_at = _datetime.datetime.now(_datetime.timezone.utc).replace(
+        microsecond=0
+    )
+    if not context["replay_end"]:
+        context["replay_end"] = generated_at.isoformat()
+    if not context["replay_start"]:
+        context["replay_start"] = (
+            generated_at - _datetime.timedelta(hours=24)
+        ).isoformat()
 
     # Auto-attach reporters: a Postgres row per machine when influx/grafana
     # are in play, MLflow opt-in per machine (reference cli lines 538-557).

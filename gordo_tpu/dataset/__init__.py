@@ -1,5 +1,6 @@
 from .data_provider import (
     GordoBaseDataProvider,
+    FileDataProvider,
     ListBackedDataProvider,
     RandomDataProvider,
 )
@@ -24,6 +25,7 @@ __all__ = [
     "GordoBaseDataProvider",
     "RandomDataProvider",
     "ListBackedDataProvider",
+    "FileDataProvider",
     "SensorTag",
     "normalize_sensor_tag",
     "normalize_sensor_tags",

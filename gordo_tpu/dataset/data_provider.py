@@ -13,7 +13,8 @@ provider itself is deliberately device-unaware.
 
 import abc
 import hashlib
-from typing import Iterable, List, Optional, Tuple, Union
+import os
+from typing import Dict, Iterable, List, Optional, Tuple, Union
 
 import numpy as np
 import pandas as pd
@@ -127,6 +128,161 @@ class RandomDataProvider(GordoBaseDataProvider):
                 + 0.1 * amplitude * rng.standard_normal(n_points)
             )
             yield pd.Series(values, index=index, name=tag.name)
+
+
+class FileDataProvider(GordoBaseDataProvider):
+    """
+    Tag series from parquet/CSV files on disk — the provider that makes
+    ``local_build`` / ``build-fleet`` train on real exported data instead
+    of synthetic series (reference surface: gordo-core's provider contract,
+    SURVEY.md §2.9; resolvable from YAML as
+    ``data_provider: {type: FileDataProvider, path: ...}``).
+
+    Two on-disk layouts:
+
+    - **wide file** — ``path`` is one file whose columns are tags and whose
+      index (or ``timestamp_column``) holds timestamps::
+
+          data_provider:
+            type: FileDataProvider
+            path: /data/plant-a.parquet
+            timestamp_column: time       # optional; default: file index
+
+    - **per-tag directory** — ``path`` is a directory of
+      ``<tag-name>.parquet`` / ``<tag-name>.csv`` files, each holding one
+      series (``timestamp_column`` + ``value_column``, defaulting to the
+      first and second columns).
+
+    ``tag_column_map`` renames: ``{config tag name: column or file name}``.
+    Naive timestamps are localized to ``tz`` (default UTC) — gordo's train
+    window bounds are always tz-aware.
+    """
+
+    _FORMATS = {
+        ".parquet": "parquet",
+        ".pq": "parquet",
+        ".csv": "csv",
+    }
+
+    @capture_args
+    def __init__(
+        self,
+        path: str,
+        timestamp_column: Optional[str] = None,
+        value_column: Optional[str] = None,
+        tag_column_map: Optional[Dict[str, str]] = None,
+        tz: str = "UTC",
+        **kwargs,
+    ):
+        self.path = path
+        self.timestamp_column = timestamp_column
+        self.value_column = value_column
+        self.tag_column_map = tag_column_map or {}
+        self.tz = tz
+        self._wide_frame: Optional[pd.DataFrame] = None
+
+    # -- file plumbing -------------------------------------------------------
+
+    def _format_of(self, path: str) -> str:
+        ext = os.path.splitext(path)[1].lower()
+        file_format = self._FORMATS.get(ext)
+        if file_format is None:
+            raise ValueError(
+                f"Unsupported file format {ext!r} for {path!r} "
+                f"(supported: {sorted(self._FORMATS)})"
+            )
+        return file_format
+
+    def _read_frame(self, path: str) -> pd.DataFrame:
+        if self._format_of(path) == "parquet":
+            frame = pd.read_parquet(path)
+        else:
+            frame = pd.read_csv(path)
+        ts_col = self.timestamp_column
+        if ts_col is None and not isinstance(frame.index, pd.DatetimeIndex):
+            ts_col = frame.columns[0]
+        if ts_col is not None:
+            if ts_col not in frame.columns:
+                raise ValueError(
+                    f"Timestamp column {ts_col!r} not present in {path!r} "
+                    f"(columns: {list(frame.columns)})"
+                )
+            frame = frame.set_index(ts_col)
+        frame.index = pd.DatetimeIndex(pd.to_datetime(frame.index))
+        if frame.index.tz is None:
+            frame.index = frame.index.tz_localize(self.tz)
+        return frame.sort_index()
+
+    def _column_for(self, tag: SensorTag) -> str:
+        return self.tag_column_map.get(tag.name, tag.name)
+
+    def _is_directory_layout(self) -> bool:
+        return os.path.isdir(self.path)
+
+    def _tag_file(self, tag: SensorTag) -> Optional[str]:
+        column = self._column_for(tag)
+        for ext in self._FORMATS:
+            candidate = os.path.join(self.path, column + ext)
+            if os.path.isfile(candidate):
+                return candidate
+        return None
+
+    def _wide(self) -> pd.DataFrame:
+        if self._wide_frame is None:
+            self._wide_frame = self._read_frame(self.path)
+        return self._wide_frame
+
+    # -- provider contract ---------------------------------------------------
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        if self._is_directory_layout():
+            return self._tag_file(tag) is not None
+        try:
+            return self._column_for(tag) in self._wide().columns
+        except (OSError, ValueError):
+            return False
+
+    def _series_for(self, tag: SensorTag) -> pd.Series:
+        if self._is_directory_layout():
+            tag_file = self._tag_file(tag)
+            if tag_file is None:
+                raise ValueError(
+                    f"No file for tag {tag.name!r} under {self.path!r}"
+                )
+            frame = self._read_frame(tag_file)
+            column = self.value_column or frame.columns[0]
+            if column not in frame.columns:
+                raise ValueError(
+                    f"Value column {column!r} not present in {tag_file!r}"
+                )
+            return frame[column].rename(tag.name)
+        frame = self._wide()
+        column = self._column_for(tag)
+        if column not in frame.columns:
+            raise ValueError(
+                f"Tag {tag.name!r} (column {column!r}) not present in "
+                f"{self.path!r} (columns: {list(frame.columns)})"
+            )
+        return frame[column].rename(tag.name)
+
+    def load_series(
+        self,
+        train_start_date: pd.Timestamp,
+        train_end_date: pd.Timestamp,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+        **kwargs,
+    ) -> Iterable[pd.Series]:
+        if train_start_date >= train_end_date:
+            raise ValueError(
+                f"train_start_date ({train_start_date}) must be before "
+                f"train_end_date ({train_end_date})"
+            )
+        for tag in normalize_sensor_tags(tag_list):
+            series = self._series_for(tag)
+            yield series[
+                (series.index >= train_start_date) & (series.index < train_end_date)
+            ]
 
 
 class ListBackedDataProvider(GordoBaseDataProvider):

@@ -131,31 +131,37 @@ class RevisionFleet:
         """
         The (names, stacked device params) bucket for one FeedForwardSpec,
         built from every loaded model of that spec. Restacked only when the
-        bucket's membership changed since the last call.
+        bucket's membership changed since the last call. The stacking work
+        (host round-trip of every member's params) runs OUTSIDE the store
+        lock so concurrent single-model serving never stalls behind it.
         """
         from ..parallel.fleet import stack_member_params
 
         with self._lock:
-            cached = self._stacked.get(spec)
-            if cached is not None:
-                return cached
             names = sorted(n for n, s in self._specs.items() if s == spec)
-            if not names:
-                raise KeyError(f"no loaded models with spec {spec}")
+            cached = self._stacked.get(spec)
+            models = {n: self._models[n] for n in names}
+        if cached is not None and cached[0] == names:
+            return cached
+        if not names:
+            raise KeyError(f"no loaded models with spec {spec}")
 
-            class _P:  # stack_member_params wants .params carriers
-                __slots__ = ("params",)
+        class _P:  # stack_member_params wants .params carriers
+            __slots__ = ("params",)
 
-                def __init__(self, params):
-                    self.params = params
+            def __init__(self, params):
+                self.params = params
 
-            host = [
-                _P(jax.device_get(_find_estimator(self._models[n]).params_))
-                for n in names
-            ]
-            stacked = jax.device_put(stack_member_params(host))
+        host = [
+            _P(jax.device_get(_find_estimator(models[n]).params_)) for n in names
+        ]
+        stacked = jax.device_put(stack_member_params(host))
+        with self._lock:
+            # Concurrent stackers of the same membership write identical
+            # content; a membership change since our snapshot just means
+            # the next call restacks (names are re-derived every time).
             self._stacked[spec] = (names, stacked)
-            return names, stacked
+        return names, stacked
 
     def loaded_specs(self) -> Dict[str, Any]:
         with self._lock:
@@ -163,21 +169,30 @@ class RevisionFleet:
 
     def fleet_scores(
         self, inputs: Dict[str, Any]
-    ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+    ) -> Tuple[Dict[str, Tuple[np.ndarray, np.ndarray]], Dict[str, Exception]]:
         """
         Score many models in one device program per spec bucket:
         ``inputs[name] -> X`` (raw model-space frames/arrays; host pipeline
-        transformers are applied here) returns ``name -> (reconstruction,
-        per-row mse)``. Feedforward models take the fused bucket path; any
-        others fall back to their own predict.
+        transformers are applied here) returns ``(scores, errors)`` where
+        ``scores[name] -> (reconstruction, per-row mse)`` and ``errors``
+        records per-machine failures (a broken model never takes the batch
+        down). Feedforward models take the fused bucket path; any others
+        fall back to their own predict.
         """
+        errors: Dict[str, Exception] = {}
+        loadable = []
         for name in inputs:
-            self.model(name)  # ensure loaded + bucketed
+            try:
+                self.model(name)  # ensure loaded + bucketed
+                loadable.append(name)
+            except Exception as exc:  # noqa: BLE001 - per-machine isolation
+                logger.warning("fleet_scores: could not load %s: %r", name, exc)
+                errors[name] = exc
 
         specs = self.loaded_specs()
         by_spec: Dict[Any, List[str]] = {}
         fallback: List[str] = []
-        for name in inputs:
+        for name in loadable:
             spec = specs.get(name)
             if isinstance(spec, FeedForwardSpec):
                 by_spec.setdefault(spec, []).append(name)
@@ -198,9 +213,16 @@ class RevisionFleet:
             names = sorted(names)  # bucket order, so full requests match it
             bucket_names, stacked = self.feedforward_bucket(spec)
             rows = {n: i for i, n in enumerate(bucket_names)}
-            transformed = {
-                n: _host_transform(self._models[n], inputs[n]) for n in names
-            }
+            transformed = {}
+            for n in names:
+                try:
+                    transformed[n] = _host_transform(self._models[n], inputs[n])
+                except Exception as exc:  # noqa: BLE001 - per-machine isolation
+                    logger.warning("fleet_scores: transform failed for %s: %r", n, exc)
+                    errors[n] = exc
+            names = [n for n in names if n in transformed]
+            if not names:
+                continue
             b_max = max(arr.shape[0] for arr in transformed.values())
             if names == bucket_names:
                 # Whole-bucket request (the replay/dashboard pattern):
@@ -219,10 +241,17 @@ class RevisionFleet:
                 r = recon[i, :b]
                 out[n] = (r, mse_vs_raw(r, np.asarray(inputs[n], np.float32)))
         for n in fallback:
-            model = self._models[n]
-            prediction = np.asarray(model.predict(inputs[n]))
-            out[n] = (prediction, mse_vs_raw(prediction, np.asarray(inputs[n], np.float32)))
-        return out
+            try:
+                model = self._models[n]
+                prediction = np.asarray(model.predict(inputs[n]))
+                out[n] = (
+                    prediction,
+                    mse_vs_raw(prediction, np.asarray(inputs[n], np.float32)),
+                )
+            except Exception as exc:  # noqa: BLE001 - per-machine isolation
+                logger.warning("fleet_scores: predict failed for %s: %r", n, exc)
+                errors[n] = exc
+        return out, errors
 
 
 def use_pallas() -> bool:

@@ -116,7 +116,10 @@ def post_fleet_prediction(ctx, gordo_project: str):
 
     data: Dict[str, Any] = {}
     if frames:
-        scores = STORE.fleet(ctx.collection_dir).fleet_scores(frames)
+        scores, score_errors = STORE.fleet(ctx.collection_dir).fleet_scores(frames)
+        for name, exc in score_errors.items():
+            status = 404 if isinstance(exc, FileNotFoundError) else 500
+            errors[name] = {"error": f"Scoring failed: {exc}", "status": status}
         for name, (reconstruction, mse) in scores.items():
             index = frames[name].index
             out_index = index[len(index) - len(reconstruction):]

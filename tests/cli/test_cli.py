@@ -197,3 +197,83 @@ def test_get_all_score_strings_format(runner, tmp_path):
     _, machine_out = ModelBuilder(machine).build()
     scores = get_all_score_strings(machine_out)
     assert any(s.startswith("r2-score_fold-1=") for s in scores)
+
+
+# -- revision lifecycle commands --------------------------------------------
+
+
+def test_wait_for_models_returns_when_present(tmp_path):
+    from gordo_tpu.cli.cli import wait_for_models
+
+    for name in ("w-a", "w-b"):
+        (tmp_path / name).mkdir()
+        (tmp_path / name / "metadata.json").write_text("{}")
+    result = CliRunner().invoke(
+        wait_for_models,
+        [str(tmp_path), "--name", "w-a", "--name", "w-b", "--timeout", "5"],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0
+    assert "All 2 models present" in result.output
+
+
+def test_wait_for_models_times_out_naming_missing(tmp_path):
+    from gordo_tpu.cli.cli import wait_for_models
+
+    (tmp_path / "w-a").mkdir()
+    (tmp_path / "w-a" / "metadata.json").write_text("{}")
+    result = CliRunner().invoke(
+        wait_for_models,
+        [
+            str(tmp_path),
+            "--name", "w-a", "--name", "w-missing",
+            "--timeout", "1", "--poll-interval", "1",
+        ],
+    )
+    assert result.exit_code != 0
+    assert "w-missing" in result.output
+
+
+def test_wait_for_models_reads_expected_models_env(tmp_path, monkeypatch):
+    from gordo_tpu.cli.cli import wait_for_models
+
+    (tmp_path / "env-a").mkdir()
+    (tmp_path / "env-a" / "metadata.json").write_text("{}")
+    monkeypatch.setenv("EXPECTED_MODELS", '["env-a"]')
+    result = CliRunner().invoke(
+        wait_for_models, [str(tmp_path), "--timeout", "5"], catch_exceptions=False
+    )
+    assert result.exit_code == 0
+
+
+def test_cleanup_revisions_keeps_newest_and_current(tmp_path):
+    from gordo_tpu.cli.cli import cleanup_revisions
+
+    # five numeric revision dirs + one non-revision dir that must survive
+    for revision in ("100", "200", "300", "400", "500"):
+        (tmp_path / revision).mkdir()
+    (tmp_path / "register").mkdir()
+    result = CliRunner().invoke(
+        cleanup_revisions,
+        [str(tmp_path), "200", "--keep", "2"],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0
+    kept = sorted(p.name for p in tmp_path.iterdir())
+    # newest two (400, 500) + current (200) + non-revision dir
+    assert kept == ["200", "400", "500", "register"]
+
+
+def test_cleanup_revisions_dry_run(tmp_path):
+    from gordo_tpu.cli.cli import cleanup_revisions
+
+    for revision in ("100", "200"):
+        (tmp_path / revision).mkdir()
+    result = CliRunner().invoke(
+        cleanup_revisions,
+        [str(tmp_path), "200", "--keep", "1", "--dry-run"],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["100", "200"]
+    assert "Would delete" in result.output

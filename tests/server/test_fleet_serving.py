@@ -131,7 +131,8 @@ def test_fleet_scores_bucket_groups_same_spec(collection_dir):
         "machine-1": rng.rand(7, 4).astype(np.float32),
         "machine-2": rng.rand(5, 2).astype(np.float32),
     }
-    scores = fleet.fleet_scores(inputs)
+    scores, errors = fleet.fleet_scores(inputs)
+    assert not errors
     for name, (recon, mse) in scores.items():
         assert recon.shape[0] == len(inputs[name])
         assert mse.shape == (len(inputs[name]),)
@@ -154,3 +155,28 @@ def test_fleet_prediction_malformed_frame_is_per_machine_error(client, fleet_pay
     body = json.loads(resp.data)
     assert "machine-1" in body["data"]
     assert body["errors"]["machine-2"]["status"] == 400
+
+
+def test_fleet_prediction_broken_model_is_per_machine_error(
+    client, collection_dir, fleet_payload, tmp_path
+):
+    """metadata.json present but model.pkl gone: that machine 404s in
+    errors, the rest of the batch still scores (review finding)."""
+    import shutil
+
+    broken_dir = f"{collection_dir}/broken-machine"
+    shutil.copytree(f"{collection_dir}/machine-2", broken_dir)
+    try:
+        import os
+
+        os.remove(f"{broken_dir}/model.pkl")
+        payload = {**fleet_payload, "broken-machine": fleet_payload["machine-2"]}
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/prediction/fleet", json={"X": payload}
+        )
+        assert resp.status_code == 200
+        body = json.loads(resp.data)
+        assert set(body["data"]) == {"machine-1", "machine-2"}
+        assert body["errors"]["broken-machine"]["status"] == 404
+    finally:
+        shutil.rmtree(broken_dir, ignore_errors=True)

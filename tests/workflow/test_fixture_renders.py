@@ -1,0 +1,129 @@
+"""
+Fixture-driven render sweep: every config in ``data/`` goes through the
+real ``workflow generate`` CLI and the emitted documents are checked for
+structural invariants (reference model: the ~20 config fixtures of
+tests/gordo/workflow/test_workflow_generator/data asserted via the CLI).
+"""
+
+import json
+import os
+
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from gordo_tpu.cli import gordo_tpu_cli
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+FIXTURES = sorted(f for f in os.listdir(DATA_DIR) if f.endswith(".yml"))
+
+
+def render(config_path, *extra):
+    result = CliRunner().invoke(
+        gordo_tpu_cli,
+        [
+            "workflow",
+            "generate",
+            "--machine-config",
+            config_path,
+            "--project-name",
+            "fixture-proj",
+            "--project-revision",
+            "1600000000000",
+            *extra,
+        ],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    return list(yaml.safe_load_all(result.output))
+
+
+def expected_machines(config_path):
+    with open(config_path) as f:
+        config = yaml.safe_load(f)
+    if "spec" in config:  # CRD-wrapped
+        config = config["spec"]["config"]
+    return [m["name"] for m in config["machines"]]
+
+
+@pytest.mark.parametrize("fixture", FIXTURES)
+def test_fixture_renders_valid_workflow(fixture):
+    config_path = os.path.join(DATA_DIR, fixture)
+    names = expected_machines(config_path)
+    docs = render(config_path)
+
+    kinds = [d["kind"] for d in docs if d]
+    for kind in ("PersistentVolumeClaim", "ConfigMap", "Job", "Deployment", "Service"):
+        assert kind in kinds, f"{fixture}: no {kind} emitted"
+
+    # every doc labeled with the project
+    for doc in docs:
+        if not doc:
+            continue
+        labels = doc["metadata"]["labels"]
+        assert (
+            labels["applications.gordo.equinor.com/project-name"] == "fixture-proj"
+        ), f"{fixture}: {doc['kind']} missing project label"
+
+    # all machines present across the shard ConfigMaps, fully resolved
+    embedded = []
+    for cm in (d for d in docs if d and d["kind"] == "ConfigMap"):
+        machines = yaml.safe_load(cm["data"]["machines.yaml"])["machines"]
+        for machine in machines:
+            embedded.append(machine["name"])
+            assert machine["project_name"] == "fixture-proj"
+            assert machine["model"], f"{fixture}: machine without model"
+            assert machine["dataset"], f"{fixture}: machine without dataset"
+    assert sorted(embedded) == sorted(names), fixture
+
+    # server knows the full expected-model set
+    (deployment,) = (d for d in docs if d and d["kind"] == "Deployment")
+    env = {
+        e["name"]: e.get("value")
+        for e in deployment["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert sorted(json.loads(env["EXPECTED_MODELS"])) == sorted(names), fixture
+
+
+def test_machines_per_slice_fixture_shards():
+    config_path = os.path.join(DATA_DIR, "machines-per-slice.yml")
+    docs = render(config_path)
+    builder = [
+        d
+        for d in docs
+        if d and d["kind"] == "Job" and "fleet-builder" in d["metadata"]["name"]
+    ]
+    assert len(builder) == 2  # 3 machines / 2 per slice
+
+
+def test_custom_runtime_resources_fixture():
+    config_path = os.path.join(DATA_DIR, "custom-runtime-resources.yml")
+    docs = render(config_path)
+    (job,) = (
+        d
+        for d in docs
+        if d and d["kind"] == "Job" and "fleet-builder" in d["metadata"]["name"]
+    )
+    resources = job["spec"]["template"]["spec"]["containers"][0]["resources"]
+    assert resources["requests"]["memory"] == "1000M"
+    assert resources["limits"]["cpu"] == "1000m"
+    (deployment,) = (d for d in docs if d and d["kind"] == "Deployment")
+    server_resources = deployment["spec"]["template"]["spec"]["containers"][0][
+        "resources"
+    ]
+    assert server_resources["limits"]["memory"] == "2000M"
+
+
+def test_runtime_env_fixture_reaches_builder():
+    config_path = os.path.join(DATA_DIR, "runtime-env-and-reporters.yml")
+    docs = render(config_path)
+    (job,) = (
+        d
+        for d in docs
+        if d and d["kind"] == "Job" and "fleet-builder" in d["metadata"]["name"]
+    )
+    env = {
+        e["name"]: e.get("value")
+        for e in job["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["CUSTOM_FLAG"] == "on"

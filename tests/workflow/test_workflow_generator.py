@@ -74,6 +74,13 @@ def by_kind(docs, kind):
     return [d for d in docs if d and d.get("kind") == kind]
 
 
+def builder_jobs(docs):
+    """The fleet-builder Jobs (the cleanup/replay Jobs are also kind Job)."""
+    return [
+        j for j in by_kind(docs, "Job") if "fleet-builder" in j["metadata"]["name"]
+    ]
+
+
 def test_generates_expected_documents(config_file):
     docs = generate(config_file)
     kinds = [d["kind"] for d in docs if d]
@@ -87,7 +94,7 @@ def test_generates_expected_documents(config_file):
 
 def test_fleet_job_shape(config_file):
     docs = generate(config_file)
-    (job,) = by_kind(docs, "Job")
+    (job,) = builder_jobs(docs)
     geometry = slice_geometry("v5litepod-16")
     spec = job["spec"]
     assert spec["parallelism"] == geometry.hosts
@@ -123,7 +130,7 @@ def test_machines_per_slice_sharding(tmp_path, config_file):
     path = tmp_path / "sharded.yml"
     path.write_text(yaml.safe_dump(config))
     docs = generate(str(path))
-    assert len(by_kind(docs, "Job")) == 2  # one slice Job per machine shard
+    assert len(builder_jobs(docs)) == 2  # one slice Job per machine shard
 
 
 def test_split_workflows(config_file):
@@ -202,7 +209,7 @@ def test_resources_labels_and_owner_references(config_file):
             [{"uid": "1", "name": "n", "kind": "Deployment", "apiVersion": "v1"}]
         ),
     )
-    (job,) = by_kind(docs, "Job")
+    (job,) = builder_jobs(docs)
     assert job["metadata"]["labels"]["team"] == "abc"
     assert job["metadata"]["ownerReferences"][0]["uid"] == "1"
 
@@ -235,3 +242,88 @@ def test_postgres_reporter_injected(config_file):
     machines = yaml.safe_load(cm["data"]["machines.yaml"])["machines"]
     reporters = machines[0]["runtime"]["reporters"]
     assert any("PostgresReporter" in str(r) for r in reporters)
+
+
+# -- deploy plane: ServiceMonitor / Istio / replay / cleanup ----------------
+
+
+def test_service_monitor_emitted_with_prometheus(config_file):
+    docs = generate(config_file)
+    (monitor,) = by_kind(docs, "ServiceMonitor")
+    assert monitor["spec"]["selector"]["matchLabels"]["app"] == (
+        "gordo-tpu-server-test-proj"
+    )
+    assert monitor["spec"]["endpoints"][0]["port"] == "metrics"
+    # the Service actually carries the selected label
+    services = by_kind(docs, "Service")
+    server_service = next(
+        s for s in services if s["metadata"]["name"] == "gordo-tpu-server-test-proj"
+    )
+    assert server_service["metadata"]["labels"]["app"] == "gordo-tpu-server-test-proj"
+
+
+def test_service_monitor_absent_without_prometheus(config_file):
+    docs = generate(config_file, "--without-prometheus")
+    assert not by_kind(docs, "ServiceMonitor")
+
+
+def test_istio_virtual_service_flag_gated(config_file):
+    assert not by_kind(generate(config_file), "VirtualService")
+    docs = generate(
+        config_file, "--with-istio", "--istio-gateway", "my-ns/my-gateway"
+    )
+    (vs,) = by_kind(docs, "VirtualService")
+    assert vs["spec"]["gateways"] == ["my-ns/my-gateway"]
+    match = vs["spec"]["http"][0]["match"][0]["uri"]["prefix"]
+    assert match == "/gordo/v0/test-proj/"
+    route = vs["spec"]["http"][0]["route"][0]["destination"]
+    assert route["host"] == "gordo-tpu-server-test-proj"
+
+
+def test_prediction_replay_job(config_file):
+    assert not [
+        j
+        for j in by_kind(generate(config_file), "Job")
+        if "replay" in j["metadata"]["name"]
+    ]
+    docs = generate(
+        config_file,
+        "--with-prediction-replay",
+        "--replay-start",
+        "2020-01-01T00:00:00+00:00",
+        "--replay-end",
+        "2020-01-02T00:00:00+00:00",
+        "--client-max-instances",
+        "7",
+    )
+    (replay,) = [
+        j for j in by_kind(docs, "Job") if "replay" in j["metadata"]["name"]
+    ]
+    pod = replay["spec"]["template"]["spec"]
+    # gated behind the builders via the wait-for-models initContainer
+    assert pod["initContainers"][0]["command"] == ["gordo-tpu", "wait-for-models"]
+    env = {e["name"]: e.get("value") for e in pod["initContainers"][0]["env"]}
+    assert json.loads(env["EXPECTED_MODELS"]) == ["machine-1", "machine-2"]
+    args = pod["containers"][0]["args"]
+    assert "2020-01-01T00:00:00+00:00" in args
+    assert args[args.index("--parallelism") + 1] == "7"
+    assert any("predictions/1234567890123" in a for a in args)
+
+
+def test_revision_cleanup_job_default_on(config_file):
+    docs = generate(config_file)
+    (cleanup,) = [
+        j for j in by_kind(docs, "Job") if "cleanup" in j["metadata"]["name"]
+    ]
+    pod = cleanup["spec"]["template"]["spec"]
+    assert pod["initContainers"][0]["command"] == ["gordo-tpu", "wait-for-models"]
+    args = pod["containers"][0]["args"]
+    assert args[args.index("--keep") + 1] == "3"
+    assert "1234567890123" in args
+
+
+def test_revision_cleanup_disabled(config_file):
+    docs = generate(config_file, "--revisions-to-keep", "0")
+    assert not [
+        j for j in by_kind(docs, "Job") if "cleanup" in j["metadata"]["name"]
+    ]
