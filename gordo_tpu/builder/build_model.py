@@ -35,7 +35,6 @@ from ..machine.metadata import (
     BuildMetadata,
     CrossValidationMetaData,
     DatasetBuildMetadata,
-    Metadata,
     ModelBuildMetadata,
 )
 from ..models.base import GordoBase

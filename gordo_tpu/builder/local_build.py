@@ -7,7 +7,7 @@ machine. The whole test pyramid stands on this path (SURVEY.md §3.4).
 """
 
 from io import StringIO
-from typing import Iterable, Tuple, Union
+from typing import Iterable, Tuple
 
 import yaml
 

@@ -557,17 +557,29 @@ def cleanup_revisions(models_root: str, current_revision: str, keep: int, dry_ru
     retained = set(entries[-keep:] if keep > 0 else [])
     retained.add(current_revision)
     doomed = [entry for entry in entries if entry not in retained]
+    failed = []
     for revision in doomed:
         path = os.path.join(models_root, revision)
         if dry_run:
             click.echo(f"Would delete {path}")
             continue
         logger.info("Deleting old revision %s", path)
-        shutil.rmtree(path, ignore_errors=True)
+        try:
+            shutil.rmtree(path)
+        except OSError as exc:
+            # Surface it: a cleanup Job that silently leaves revisions
+            # behind lets the shared volume fill — fail so k8s retries/alerts.
+            logger.error("Could not delete %s: %s", path, exc)
+            failed.append(revision)
     click.echo(
-        f"Revisions: {len(entries) - len(doomed)} kept, {len(doomed)} deleted"
+        f"Revisions: {len(entries) - len(doomed)} kept, "
+        f"{len(doomed) - len(failed)} deleted"
         f"{' (dry run)' if dry_run else ''}"
     )
+    if failed:
+        raise click.ClickException(
+            f"Failed to delete {len(failed)} revision(s): {', '.join(failed)}"
+        )
 
 
 gordo_tpu_cli.add_command(workflow_cli)

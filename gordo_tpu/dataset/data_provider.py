@@ -14,7 +14,7 @@ provider itself is deliberately device-unaware.
 import abc
 import hashlib
 import os
-from typing import Dict, Iterable, List, Optional, Tuple, Union
+from typing import Dict, Iterable, List, Optional
 
 import numpy as np
 import pandas as pd

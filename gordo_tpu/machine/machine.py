@@ -10,7 +10,6 @@ block), JSON/YAML round-trips, and ``report()`` running configured
 reporters.
 """
 
-import copy
 import json
 import logging
 from typing import Any, Dict, Optional

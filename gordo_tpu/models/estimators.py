@@ -25,13 +25,11 @@ from sklearn.exceptions import NotFittedError
 from sklearn.metrics import explained_variance_score
 
 from .. import serializer
-from ..ops.windows import model_offset, sliding_windows, window_targets
+from ..ops.windows import sliding_windows, window_targets
 from .base import GordoBase
-from .nn import forward_fn_for, init_fn_for  # noqa: F401  (re-exported)
 from .register import register_model_builder
 from .spec import ModelSpec, Sequential
 from .training import (
-    FitConfig,
     History,
     fit_config_from_kwargs,
     fit_single,

@@ -9,7 +9,7 @@ before scoring.
 """
 
 import functools
-from datetime import datetime, timedelta
+from datetime import timedelta
 from typing import List, Optional, Union
 
 import numpy as np

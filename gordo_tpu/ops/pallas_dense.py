@@ -22,7 +22,7 @@ tests/ops/test_pallas_dense.py.
 """
 
 from functools import partial
-from typing import Any, Dict, List, Tuple
+from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp

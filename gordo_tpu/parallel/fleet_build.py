@@ -57,7 +57,6 @@ from ..ops.windows import model_offset as calc_model_offset
 from ..ops.windows import window_targets
 from .fleet import (
     FleetMember,
-    FleetResult,
     FleetTrainer,
     WindowedFleetMember,
     stack_member_params,

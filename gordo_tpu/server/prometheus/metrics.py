@@ -10,7 +10,7 @@ fleet aggregates into one scrape target.
 import logging
 import os
 import re
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 from prometheus_client import (
     REGISTRY,

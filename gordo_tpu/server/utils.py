@@ -26,7 +26,7 @@ import timeit
 import zlib
 from datetime import datetime
 from functools import lru_cache
-from typing import List, Optional, Union
+from typing import List, Optional
 
 import dateutil.parser
 import pandas as pd

@@ -45,6 +45,11 @@ def post_anomaly_prediction(ctx, gordo_project: str, gordo_name: str):
             },
             status=422,
         )
+    except ValueError as err:
+        # Client-data problem (e.g. fewer rows than a windowed model's
+        # lookback) — same ValueError→400 contract as the base route.
+        logger.error("Failed to compute anomalies: %s", err)
+        return ctx.json_response({"error": f"ValueError: {err}"}, status=400)
 
     if ctx.request.args.get("all_columns") is None:
         columns_for_delete = [

@@ -108,7 +108,7 @@ def post_fleet_prediction(ctx, gordo_project: str):
         except FileNotFoundError:
             errors[name] = {"error": f"No such model found: '{name}'", "status": 404}
         except server_utils.ServerError as exc:
-            errors[name] = {**exc.payload, "status": exc.status}
+            errors[name] = {"error": str(exc), "status": exc.status}
         except (ValueError, TypeError, KeyError) as exc:
             # malformed frame payloads (unparseable index etc.) are that
             # machine's problem, never the whole batch's
@@ -118,8 +118,18 @@ def post_fleet_prediction(ctx, gordo_project: str):
     if frames:
         scores, score_errors = STORE.fleet(ctx.collection_dir).fleet_scores(frames)
         for name, exc in score_errors.items():
-            status = 404 if isinstance(exc, FileNotFoundError) else 500
-            errors[name] = {"error": f"Scoring failed: {exc}", "status": status}
+            # Never echo raw exception text (it can carry server paths);
+            # details are in the server log from fleet_scores' warnings.
+            if isinstance(exc, FileNotFoundError):
+                errors[name] = {
+                    "error": f"No such model found: '{name}'",
+                    "status": 404,
+                }
+            else:
+                errors[name] = {
+                    "error": f"Scoring failed ({type(exc).__name__})",
+                    "status": 500,
+                }
         for name, (reconstruction, mse) in scores.items():
             index = frames[name].index
             out_index = index[len(index) - len(reconstruction):]

@@ -277,3 +277,84 @@ def test_cleanup_revisions_dry_run(tmp_path):
     assert result.exit_code == 0
     assert sorted(p.name for p in tmp_path.iterdir()) == ["100", "200"]
     assert "Would delete" in result.output
+
+
+def test_build_fleet_partial_failure_exit_code_and_artifacts(runner, tmp_path):
+    """failFast:false at the CLI: good machines' artifacts land, the exit
+    code maps the first failure (InsufficientDataError -> 80), and the
+    exception report is written for the k8s termination message."""
+    config = {
+        "machines": [
+            {
+                "name": "ok-machine",
+                "project_name": "p",
+                "model": {
+                    "gordo_tpu.models.JaxAutoEncoder": {
+                        "kind": "feedforward_hourglass",
+                        "epochs": 1,
+                    }
+                },
+                "dataset": {
+                    "type": "RandomDataset",
+                    "train_start_date": "2020-01-01T00:00:00+00:00",
+                    "train_end_date": "2020-01-02T00:00:00+00:00",
+                    "tag_list": ["bf-1", "bf-2"],
+                },
+            },
+            {
+                "name": "starved-machine",
+                "project_name": "p",
+                "model": {
+                    "gordo_tpu.models.JaxAutoEncoder": {
+                        "kind": "feedforward_hourglass",
+                        "epochs": 1,
+                    }
+                },
+                "dataset": {
+                    "type": "RandomDataset",
+                    "train_start_date": "2020-01-01T00:00:00+00:00",
+                    "train_end_date": "2020-01-02T00:00:00+00:00",
+                    "tag_list": ["bf-3", "bf-4"],
+                    "n_samples_threshold": 10_000_000,
+                },
+            },
+        ]
+    }
+    config_path = tmp_path / "machines.yaml"
+    config_path.write_text(yaml.safe_dump(config))
+    out_dir = tmp_path / "out"
+    report_path = tmp_path / "termination-log"
+
+    from gordo_tpu.cli.cli import build_fleet
+
+    result = runner.invoke(
+        build_fleet,
+        [
+            str(config_path),
+            str(out_dir),
+            "--exceptions-reporter-file",
+            str(report_path),
+            "--exceptions-report-level",
+            "MESSAGE",
+        ],
+    )
+    assert result.exit_code == 80  # InsufficientDataError's mapped code
+    assert (out_dir / "ok-machine" / "model.pkl").exists()
+    assert not (out_dir / "starved-machine").exists()
+    report = json.loads(report_path.read_text())
+    assert "InsufficientDataError" in report["type"]
+
+
+def test_cleanup_revisions_orders_numerically(tmp_path):
+    """'1000' is newer than '999' — retention must sort numerically."""
+    from gordo_tpu.cli.cli import cleanup_revisions
+
+    for revision in ("999", "1000"):
+        (tmp_path / revision).mkdir()
+    result = CliRunner().invoke(
+        cleanup_revisions,
+        [str(tmp_path), "1000", "--keep", "1"],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["1000"]

@@ -1,0 +1,66 @@
+"""JSON/YAML encoders for machine documents (reference: gordo/machine/encoders.py)."""
+
+import datetime
+import json
+
+import yaml
+
+from gordo_tpu.dataset.sensor_tag import SensorTag
+from gordo_tpu.machine.encoders import MachineJSONEncoder, MachineSafeDumper
+
+
+def test_json_encoder_datetime():
+    stamp = datetime.datetime(2020, 1, 2, 3, 4, 5, tzinfo=datetime.timezone.utc)
+    out = json.loads(json.dumps({"t": stamp}, cls=MachineJSONEncoder))
+    assert out["t"].startswith("2020-01-02")
+    assert "03:04:05" in out["t"]
+
+
+def test_json_encoder_sensor_tag():
+    tag = SensorTag("tag-a", asset="plant-1")
+    out = json.loads(json.dumps({"tag": tag}, cls=MachineJSONEncoder))
+    assert out["tag"]["name"] == "tag-a"
+    assert out["tag"]["asset"] == "plant-1"
+
+
+def test_json_encoder_rejects_unknown():
+    class Strange:
+        pass
+
+    try:
+        json.dumps({"x": Strange()}, cls=MachineJSONEncoder)
+    except TypeError:
+        return
+    raise AssertionError("unknown types must still raise TypeError")
+
+
+def test_safe_dumper_multiline_literal_block():
+    document = {"model": "line-one\nline-two\n"}
+    text = yaml.dump(document, Dumper=MachineSafeDumper)
+    # multi-line strings render as YAML literal blocks (the config dialect
+    # the reference embeds model/dataset strings with)
+    assert "|" in text
+    assert yaml.safe_load(text) == document
+
+
+def test_safe_dumper_round_trips_machine_to_yaml():
+    from gordo_tpu.machine import Machine
+
+    machine = Machine.from_config(
+        {
+            "name": "enc-machine",
+            "model": {
+                "gordo_tpu.models.JaxAutoEncoder": {"kind": "feedforward_hourglass"}
+            },
+            "dataset": {
+                "type": "RandomDataset",
+                "train_start_date": "2020-01-01T00:00:00+00:00",
+                "train_end_date": "2020-01-02T00:00:00+00:00",
+                "tag_list": ["e-1", "e-2"],
+            },
+        },
+        project_name="enc-proj",
+    )
+    restored = yaml.safe_load(machine.to_yaml())
+    assert restored["name"] == "enc-machine"
+    assert restored["dataset"]["tag_list"] == ["e-1", "e-2"]

@@ -1,0 +1,95 @@
+"""
+Fleet-store behavior under the serving concurrency model (gunicorn gthread
+workers = one shared store, many threads): single residency must survive
+load races, bucket scoring must not deadlock against concurrent
+single-model serving, and restacking must never corrupt results.
+"""
+
+import threading
+
+import numpy as np
+
+from gordo_tpu.server.fleet_store import FleetModelStore, RevisionFleet
+
+
+def test_concurrent_model_loads_single_residency(collection_dir):
+    fleet = RevisionFleet(collection_dir)
+    seen = []
+    errors = []
+
+    def load():
+        try:
+            seen.append(id(fleet.model("machine-1")))
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=load) for _ in range(16)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    assert not errors
+    assert len(set(seen)) == 1  # every thread got the same resident object
+
+
+def test_concurrent_scores_and_loads_no_deadlock(collection_dir):
+    fleet = RevisionFleet(collection_dir)
+    fleet.warm()
+    rng = np.random.RandomState(0)
+    inputs = {
+        "machine-1": rng.rand(6, 4).astype(np.float32),
+        "machine-2": rng.rand(6, 2).astype(np.float32),
+    }
+    # warm compile outside the threads so timing races hit locks, not XLA
+    baseline, errors0 = fleet.fleet_scores(inputs)
+    assert not errors0
+
+    failures = []
+    done = threading.Barrier(9, timeout=120)
+
+    def score():
+        try:
+            scores, errors = fleet.fleet_scores(inputs)
+            assert not errors
+            for name in inputs:
+                np.testing.assert_allclose(
+                    scores[name][0], baseline[name][0], rtol=1e-5, atol=1e-6
+                )
+        except Exception as exc:  # noqa: BLE001
+            failures.append(exc)
+        finally:
+            done.wait()
+
+    def serve_single():
+        try:
+            for _ in range(5):
+                fleet.model("machine-2").predict(inputs["machine-2"])
+        except Exception as exc:  # noqa: BLE001
+            failures.append(exc)
+        finally:
+            done.wait()
+
+    threads = [threading.Thread(target=score) for _ in range(4)] + [
+        threading.Thread(target=serve_single) for _ in range(4)
+    ]
+    for thread in threads:
+        thread.start()
+    done.wait()
+    for thread in threads:
+        thread.join(timeout=60)
+    assert not failures, failures
+
+
+def test_store_concurrent_fleet_creation_one_instance(collection_dir):
+    store = FleetModelStore(max_revisions=4)
+    fleets = []
+
+    def get():
+        fleets.append(id(store.fleet(collection_dir)))
+
+    threads = [threading.Thread(target=get) for _ in range(12)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    assert len(set(fleets)) == 1

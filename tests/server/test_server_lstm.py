@@ -1,0 +1,116 @@
+"""
+The LSTM model-offset contract through the full serving stack: windowed
+models emit ``lookback_window + lookahead - 1`` fewer rows than they are
+fed, and the response frame must align timestamps accordingly (reference:
+model offset threading through model/utils.py make_base_dataframe and the
+anomaly blueprint).
+"""
+
+import json
+
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu import serializer
+from gordo_tpu.builder import local_build
+from gordo_tpu.server import build_app
+
+from .conftest import temp_env_vars
+
+PROJECT = "lstm-proj"
+REVISION = "1700000000001"
+LOOKBACK = 4
+
+CONFIG = f"""
+machines:
+  - name: lstm-served
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-05T00:00:00+00:00"
+      tag_list: [lt-1, lt-2]
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.JaxLSTMAutoEncoder:
+            kind: lstm_model
+            lookback_window: {LOOKBACK}
+            epochs: 1
+"""
+
+
+@pytest.fixture(scope="module")
+def lstm_collection(tmp_path_factory):
+    root = tmp_path_factory.mktemp("lstm-collection") / REVISION
+    for model, machine in local_build(CONFIG, project_name=PROJECT):
+        serializer.dump(
+            model, str(root / machine.name), metadata=machine.to_dict()
+        )
+    return str(root)
+
+
+@pytest.fixture
+def lstm_client(lstm_collection):
+    with temp_env_vars(MODEL_COLLECTION_DIR=lstm_collection):
+        yield Client(build_app())
+
+
+@pytest.fixture
+def lstm_payload():
+    n_rows = 12
+    index = [f"2020-03-01T{h:02d}:00:00+00:00" for h in range(n_rows)]
+    values = {
+        f"lt-{i}": {ts: 0.1 * i + 0.01 * j for j, ts in enumerate(index)}
+        for i in (1, 2)
+    }
+    return {"X": values, "y": values}, index, n_rows
+
+
+def test_lstm_anomaly_rows_shortened_by_offset(lstm_client, lstm_payload):
+    payload, index, n_rows = lstm_payload
+    resp = lstm_client.post(
+        f"/gordo/v0/{PROJECT}/lstm-served/anomaly/prediction", json=payload
+    )
+    assert resp.status_code == 200, resp.text
+    data = json.loads(resp.data)["data"]
+    rows = next(iter(data["total-anomaly-scaled"].values()))
+    assert len(rows) == n_rows - (LOOKBACK - 1)
+    # output is tail-aligned: the first emitted timestamp is index[offset]
+    import dateutil.parser
+
+    first_emitted = dateutil.parser.parse(sorted(rows)[0])
+    assert first_emitted == dateutil.parser.parse(index[LOOKBACK - 1])
+
+
+def test_lstm_metadata_reports_model_offset(lstm_client):
+    resp = lstm_client.get(f"/gordo/v0/{PROJECT}/lstm-served/metadata")
+    metadata = json.loads(resp.data)["metadata"]
+    offset = metadata["metadata"]["build_metadata"]["model"]["model_offset"]
+    assert offset == LOOKBACK - 1
+
+
+def test_lstm_anomaly_too_few_rows_is_client_error(lstm_client):
+    index = [f"2020-03-01T0{h}:00:00+00:00" for h in range(2)]  # < lookback
+    values = {
+        f"lt-{i}": {ts: 0.5 for ts in index} for i in (1, 2)
+    }
+    resp = lstm_client.post(
+        f"/gordo/v0/{PROJECT}/lstm-served/anomaly/prediction",
+        json={"X": values, "y": values},
+    )
+    assert resp.status_code in (400, 422)
+
+
+def test_lstm_anomaly_parquet_response(lstm_client, lstm_payload):
+    payload, _, n_rows = lstm_payload
+    resp = lstm_client.post(
+        f"/gordo/v0/{PROJECT}/lstm-served/anomaly/prediction?format=parquet",
+        json=payload,
+    )
+    assert resp.status_code == 200
+    from gordo_tpu.server.utils import dataframe_from_parquet_bytes
+
+    frame = dataframe_from_parquet_bytes(resp.data)
+    assert len(frame) == n_rows - (LOOKBACK - 1)
+    top_level = {c[0] for c in frame.columns}
+    assert {"model-input", "model-output", "total-anomaly-scaled"} <= top_level

@@ -1,0 +1,154 @@
+"""
+Artifact contract: a dumped model must reload in a FRESH process (new JAX
+runtime, no warm caches) and predict bit-identically — the
+device-independence guarantee the serving plane relies on when builder
+pods write artifacts that server pods (different hosts, possibly no TPU)
+later unpickle.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import numpy as np
+import pytest
+
+from gordo_tpu import serializer
+from gordo_tpu.builder import local_build
+
+CONFIG = """
+machines:
+  - name: contract-ae
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          sklearn.pipeline.Pipeline:
+            steps:
+              - sklearn.preprocessing.MinMaxScaler
+              - gordo_tpu.models.JaxAutoEncoder:
+                  kind: feedforward_hourglass
+                  epochs: 2
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-03T00:00:00+00:00"
+      tag_list: [ca-1, ca-2, ca-3]
+  - name: contract-lstm
+    model:
+      gordo_tpu.models.JaxLSTMAutoEncoder:
+        kind: lstm_model
+        lookback_window: 4
+        epochs: 1
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-03T00:00:00+00:00"
+      tag_list: [ca-1, ca-2]
+"""
+
+RELOADER = textwrap.dedent(
+    """
+    import sys
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from gordo_tpu import serializer
+
+    model_dir, probe_path, out_path = sys.argv[1:4]
+    model = serializer.load(model_dir)
+    probe = np.load(probe_path)
+    np.save(out_path, np.asarray(model.predict(probe)))
+    """
+)
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    root = tmp_path_factory.mktemp("artifact-contract")
+    artifacts = {}
+    for model, machine in local_build(CONFIG):
+        model_dir = root / machine.name
+        serializer.dump(model, str(model_dir), metadata=machine.to_dict())
+        artifacts[machine.name] = (model, str(model_dir))
+    return artifacts
+
+
+@pytest.mark.parametrize(
+    "name,width", [("contract-ae", 3), ("contract-lstm", 2)]
+)
+def test_fresh_process_reload_predicts_identically(built, tmp_path, name, width):
+    model, model_dir = built[name]
+    probe = np.random.RandomState(0).rand(32, width).astype(np.float32)
+    expected = np.asarray(model.predict(probe))
+
+    probe_path = str(tmp_path / f"{name}-probe.npy")
+    out_path = str(tmp_path / f"{name}-out.npy")
+    np.save(probe_path, probe)
+    result = subprocess.run(
+        [sys.executable, "-c", RELOADER, model_dir, probe_path, out_path],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    got = np.load(out_path)
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_info_checksum_present_and_stable(built):
+    for _, model_dir in built.values():
+        info = serializer.load_info(model_dir)
+        assert info["checksum"]
+        assert info["checksum"] == serializer.load_info(model_dir)["checksum"]
+
+
+def test_dumps_loads_bytes_identical_predictions(built):
+    model, _ = built["contract-ae"]
+    probe = np.random.RandomState(1).rand(8, 3).astype(np.float32)
+    clone = serializer.loads(serializer.dumps(model))
+    np.testing.assert_array_equal(
+        np.asarray(clone.predict(probe)), np.asarray(model.predict(probe))
+    )
+
+
+def test_download_model_wire_format_round_trips(built):
+    """The /download-model wire format is serializer.dumps — a client on a
+    CPU-only laptop must be able to unpickle and use it."""
+    model, model_dir = built["contract-ae"]
+    payload = serializer.dumps(model)
+    with tempfile.TemporaryDirectory() as tmp:
+        blob = os.path.join(tmp, "model.pickle")
+        with open(blob, "wb") as f:
+            f.write(payload)
+        loader = textwrap.dedent(
+            """
+            import pickle
+            import sys
+
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+
+            import numpy as np
+
+            with open(sys.argv[1], "rb") as f:
+                model = pickle.load(f)
+            out = model.predict(np.zeros((4, 3), np.float32))
+            assert out.shape == (4, 3), out.shape
+            print("ok")
+            """
+        )
+        result = subprocess.run(
+            [sys.executable, "-c", loader, blob],
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert result.returncode == 0, result.stderr[-2000:]
+        assert "ok" in result.stdout

@@ -1,0 +1,167 @@
+"""
+Static gates as tests — the stand-in for the reference's mypy/pyflakes
+pytest plugins and black-format test (reference pytest.ini and
+tests/test_formatting.py). The heavy tools aren't installed in this
+environment, so the always-on gates are stdlib AST/tokenize checks
+(syntax, unused imports, tab/trailing-whitespace hygiene); the real
+linters run too whenever they are importable.
+"""
+
+import ast
+import io
+import os
+import tokenize
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "gordo_tpu")
+
+
+def _python_files():
+    for root, _, files in os.walk(PACKAGE):
+        for name in sorted(files):
+            if name.endswith(".py"):
+                yield os.path.join(root, name)
+    for extra in ("bench.py", "__graft_entry__.py"):
+        yield os.path.join(REPO_ROOT, extra)
+
+
+FILES = sorted(_python_files())
+IDS = [os.path.relpath(f, REPO_ROOT) for f in FILES]
+
+
+@pytest.mark.parametrize("path", FILES, ids=IDS)
+def test_syntax_and_compile(path):
+    with open(path, "rb") as f:
+        source = f.read()
+    compile(source, path, "exec")
+
+
+class _ImportUsage(ast.NodeVisitor):
+    """Collect imported names and every name/attribute usage."""
+
+    def __init__(self):
+        self.imports = {}  # name -> (lineno, statement repr)
+        self.used = set()
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.imports[name] = node.lineno
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            self.imports[alias.asname or alias.name] = node.lineno
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+@pytest.mark.parametrize("path", FILES, ids=IDS)
+def test_no_unused_imports(path):
+    """pyflakes' highest-signal check, via the stdlib AST."""
+    with open(path) as f:
+        source = f.read()
+    tree = ast.parse(source, path)
+    visitor = _ImportUsage()
+    visitor.visit(tree)
+
+    # __init__.py re-exports and __all__ mentions count as usage.
+    exported = set()
+    if os.path.basename(path) == "__init__.py":
+        pytest.skip("export surfaces re-import by design")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if getattr(target, "id", None) == "__all__" and isinstance(
+                    node.value, (ast.List, ast.Tuple)
+                ):
+                    exported |= {
+                        c.value
+                        for c in node.value.elts
+                        if isinstance(c, ast.Constant)
+                    }
+    # String usages inside docstrings/comments don't count, but names used
+    # only in annotations do appear as Name loads via ast in py3.12.
+    unused = {
+        name: lineno
+        for name, lineno in visitor.imports.items()
+        if name not in visitor.used and name not in exported and name != "_"
+    }
+    assert not unused, f"unused imports in {path}: {unused}"
+
+
+@pytest.mark.parametrize("path", FILES, ids=IDS)
+def test_formatting_hygiene(path):
+    """Black's non-negotiables that don't need black: no tabs in
+    indentation, no trailing whitespace, newline at EOF."""
+    with open(path) as f:
+        lines = f.readlines()
+    if not lines:
+        return
+    offenders = []
+    for i, line in enumerate(lines, 1):
+        stripped = line.rstrip("\n")
+        if stripped != stripped.rstrip():
+            offenders.append(f"{i}: trailing whitespace")
+        indent = stripped[: len(stripped) - len(stripped.lstrip())]
+        if "\t" in indent:
+            offenders.append(f"{i}: tab indentation")
+    if not lines[-1].endswith("\n"):
+        offenders.append("missing newline at EOF")
+    assert not offenders, f"{path}: {offenders}"
+
+
+@pytest.mark.parametrize("path", FILES, ids=IDS)
+def test_tokenizes_cleanly(path):
+    with open(path, "rb") as f:
+        list(tokenize.tokenize(io.BytesIO(f.read()).readline))
+
+
+def test_black_formatting_if_available():
+    black = pytest.importorskip("black")
+    import subprocess
+    import sys
+
+    result = subprocess.run(
+        [sys.executable, "-m", "black", "--check", "--quiet", str(PACKAGE)],
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stderr
+
+
+def test_pyflakes_if_available():
+    pytest.importorskip("pyflakes")
+    import subprocess
+    import sys
+
+    result = subprocess.run(
+        [sys.executable, "-m", "pyflakes", str(PACKAGE)],
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stdout
+
+
+def test_mypy_if_available():
+    pytest.importorskip("mypy")
+    import subprocess
+    import sys
+
+    result = subprocess.run(
+        [sys.executable, "-m", "mypy", "--ignore-missing-imports", str(PACKAGE)],
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stdout

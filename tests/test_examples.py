@@ -59,3 +59,35 @@ def test_example_model_configurations_all_resolve():
         assert model is not None, name
         # and they round-trip back into definitions
         serializer.into_definition(model)
+
+
+def test_example_file_data_config_trains(tmp_path):
+    """examples/config-file-data.yaml works end to end once its path points
+    at a real parquet export (generated here exactly as its header shows)."""
+    import numpy as np
+    import pandas as pd
+
+    from gordo_tpu.builder import local_build
+
+    idx = pd.date_range("2020-01-01", "2020-02-01", freq="10min", tz="UTC")
+    parquet = tmp_path / "plant-a.parquet"
+    pd.DataFrame(
+        {f"plant-tag-{i}": np.random.rand(len(idx)) for i in (1, 2, 3)}, index=idx
+    ).to_parquet(parquet)
+
+    with open(os.path.join(EXAMPLES, "config-file-data.yaml")) as fh:
+        config = yaml.safe_load(fh)
+    provider = config["machines"][0]["dataset"]["data_provider"]
+    assert provider["type"] == "FileDataProvider"
+    provider["path"] = str(parquet)
+    config["globals"]["model"][
+        "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector"
+    ]["base_estimator"]["sklearn.pipeline.Pipeline"]["steps"][1][
+        "gordo_tpu.models.estimators.JaxAutoEncoder"
+    ]["epochs"] = 1
+
+    model, machine = next(local_build(yaml.safe_dump(config)))
+    assert model.aggregate_threshold_ is not None
+    meta = machine.metadata.build_metadata.dataset.dataset_meta
+    # train_end_date is exclusive, so the final 00:00 point drops off
+    assert meta["row_count"] == len(idx) - 1
