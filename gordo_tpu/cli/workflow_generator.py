@@ -437,6 +437,19 @@ def workflow_cli(gordo_ctx):
     "0 disables cleanup",
     envvar=f"{PREFIX}_REVISIONS_TO_KEEP",
 )
+@click.option(
+    "--without-model-crds",
+    is_flag=True,
+    help="Skip the per-machine Model custom resources (they need the "
+    "gordo-controller CRD installed in the cluster)",
+    envvar=f"{PREFIX}_WITHOUT_MODEL_CRDS",
+)
+@click.option(
+    "--infra-storage-size",
+    default="10Gi",
+    help="Volume size for each infra statefulset (InfluxDB, Postgres, Grafana)",
+    envvar=f"{PREFIX}_INFRA_STORAGE_SIZE",
+)
 @click.pass_context
 def workflow_generator_cli(gordo_ctx, **ctx):
     """Machine configuration to TPU fleet workflow manifests."""
@@ -566,6 +579,13 @@ def workflow_generator_cli(gordo_ctx, **ctx):
     enable_influx = any(
         machine.runtime.get("influx", {}).get("enable", True)
         for machine in config.machines
+    )
+    # The infra plane (InfluxDB + Grafana + Postgres statefulsets) rides
+    # the same switch that injects the Postgres reporter: a reporter with
+    # no database to write to would fail every build.
+    context["with_influx"] = enable_influx
+    context["influx_resources_k8s"] = _k8s_resources(
+        config.globals["runtime"]["influx"]["resources"]
     )
     if enable_influx:
         pg_reporter = {

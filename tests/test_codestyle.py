@@ -39,31 +39,31 @@ def test_syntax_and_compile(path):
 
 
 class _ImportUsage(ast.NodeVisitor):
-    """Collect imported names and every name/attribute usage."""
+    """Collect imported names (name -> lineno) and every name usage."""
 
-    def __init__(self):
-        self.imports = {}  # name -> (lineno, statement repr)
+    def __init__(self, noqa_lines=frozenset()):
+        self.imports = {}  # name -> lineno
         self.used = set()
+        self._noqa_lines = noqa_lines
 
     def visit_Import(self, node):
-        for alias in node.names:
-            name = alias.asname or alias.name.split(".")[0]
-            self.imports[name] = node.lineno
+        if node.lineno not in self._noqa_lines:
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                self.imports[name] = node.lineno
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node):
-        for alias in node.names:
-            if alias.name == "*":
-                continue
-            self.imports[alias.asname or alias.name] = node.lineno
+        if node.lineno not in self._noqa_lines:
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                self.imports[alias.asname or alias.name] = node.lineno
         self.generic_visit(node)
 
     def visit_Name(self, node):
         if isinstance(node.ctx, ast.Load):
             self.used.add(node.id)
-        self.generic_visit(node)
-
-    def visit_Attribute(self, node):
         self.generic_visit(node)
 
 
@@ -73,7 +73,12 @@ def test_no_unused_imports(path):
     with open(path) as f:
         source = f.read()
     tree = ast.parse(source, path)
-    visitor = _ImportUsage()
+    # `# noqa` on an import line is the escape hatch for deliberate
+    # re-exports outside __init__.py files.
+    noqa_lines = frozenset(
+        i for i, line in enumerate(source.splitlines(), 1) if "# noqa" in line
+    )
+    visitor = _ImportUsage(noqa_lines)
     visitor.visit(tree)
 
     # __init__.py re-exports and __all__ mentions count as usage.

@@ -67,7 +67,12 @@ def test_fixture_renders_valid_workflow(fixture):
 
     # all machines present across the shard ConfigMaps, fully resolved
     embedded = []
-    for cm in (d for d in docs if d and d["kind"] == "ConfigMap"):
+    shard_cms = (
+        d
+        for d in docs
+        if d and d["kind"] == "ConfigMap" and "machines.yaml" in d.get("data", {})
+    )
+    for cm in shard_cms:
         machines = yaml.safe_load(cm["data"]["machines.yaml"])["machines"]
         for machine in machines:
             embedded.append(machine["name"])

@@ -81,6 +81,15 @@ def builder_jobs(docs):
     ]
 
 
+def fleet_configmaps(docs):
+    """The machine-shard ConfigMaps (Grafana's datasource CM is one too)."""
+    return [
+        c
+        for c in by_kind(docs, "ConfigMap")
+        if "fleet-config" in c["metadata"]["name"]
+    ]
+
+
 def test_generates_expected_documents(config_file):
     docs = generate(config_file)
     kinds = [d["kind"] for d in docs if d]
@@ -116,7 +125,7 @@ def test_fleet_job_shape(config_file):
 
 def test_configmap_embeds_machines(config_file):
     docs = generate(config_file)
-    (cm,) = by_kind(docs, "ConfigMap")
+    (cm,) = fleet_configmaps(docs)
     machines = yaml.safe_load(cm["data"]["machines.yaml"])["machines"]
     assert [m["name"] for m in machines] == ["machine-1", "machine-2"]
     assert machines[0]["project_name"] == "test-proj"
@@ -238,7 +247,7 @@ def test_output_file(tmp_path, config_file):
 
 def test_postgres_reporter_injected(config_file):
     docs = generate(config_file)
-    (cm,) = by_kind(docs, "ConfigMap")
+    (cm,) = fleet_configmaps(docs)
     machines = yaml.safe_load(cm["data"]["machines.yaml"])["machines"]
     reporters = machines[0]["runtime"]["reporters"]
     assert any("PostgresReporter" in str(r) for r in reporters)
@@ -327,3 +336,74 @@ def test_revision_cleanup_disabled(config_file):
     assert not [
         j for j in by_kind(docs, "Job") if "cleanup" in j["metadata"]["name"]
     ]
+
+
+# -- infra plane: Influx / Postgres / Grafana / Model CRDs ------------------
+
+
+def test_infra_statefulsets_emitted_with_influx(config_file):
+    docs = generate(config_file)
+    statefulsets = {d["metadata"]["name"] for d in by_kind(docs, "StatefulSet")}
+    assert statefulsets == {
+        "gordo-influx-test-proj",
+        "gordo-postgres-test-proj",
+        "gordo-grafana-test-proj",
+    }
+    services = {d["metadata"]["name"] for d in by_kind(docs, "Service")}
+    assert {"gordo-influx-test-proj", "gordo-postgres-test-proj",
+            "gordo-grafana-test-proj"} <= services
+    # influx sizing scales with machine count (NormalizedConfig constants)
+    (influx,) = [
+        d for d in by_kind(docs, "StatefulSet")
+        if d["metadata"]["name"] == "gordo-influx-test-proj"
+    ]
+    mem = influx["spec"]["template"]["spec"]["containers"][0]["resources"][
+        "requests"]["memory"]
+    assert mem == f"{3000 + 220 * 2}M"  # 2 machines
+
+
+def test_grafana_datasource_provisioned(config_file):
+    docs = generate(config_file)
+    cm = next(
+        d for d in by_kind(docs, "ConfigMap")
+        if "grafana-datasources" in d["metadata"]["name"]
+    )
+    ds = yaml.safe_load(cm["data"]["datasources.yaml"])["datasources"][0]
+    assert ds["url"] == "http://gordo-influx-test-proj:8086"
+    assert ds["database"] == "test-proj"
+
+
+def test_infra_absent_when_influx_disabled(tmp_path):
+    config = yaml.safe_load(CONFIG)
+    for machine in config["machines"]:
+        machine["runtime"] = {"influx": {"enable": False}}
+    path = tmp_path / "no-influx.yml"
+    path.write_text(yaml.safe_dump(config))
+    docs = generate(str(path))
+    assert not by_kind(docs, "StatefulSet")
+    # and no Postgres reporter got injected either
+    (cm,) = fleet_configmaps(docs)
+    machines = yaml.safe_load(cm["data"]["machines.yaml"])["machines"]
+    assert not any(
+        "PostgresReporter" in str(m.get("runtime", {}).get("reporters", []))
+        for m in machines
+    )
+
+
+def test_model_crds_per_machine(config_file):
+    docs = generate(config_file)
+    models = by_kind(docs, "Model")
+    assert {m["metadata"]["name"] for m in models} == {
+        "test-proj-machine-1",
+        "test-proj-machine-2",
+    }
+    for model in models:
+        assert model["apiVersion"] == "equinor.com/v1"
+        config = model["spec"]["config"]
+        assert config["name"] in ("machine-1", "machine-2")
+        assert "dataset" in config and "model" in config
+
+
+def test_model_crds_disabled(config_file):
+    docs = generate(config_file, "--without-model-crds")
+    assert not by_kind(docs, "Model")
