@@ -638,6 +638,12 @@ def workflow_generator_cli(gordo_ctx, **ctx):
             for j in range(0, len(chunk), machines_per_slice)
         ]
         context["project_workflow"] = str(project_workflow)
+        # Project-level resources (PVC, serving plane, infra statefulsets,
+        # replay/cleanup Jobs) render once, in the first chunk only —
+        # duplicate same-name documents break kustomize/ArgoCD/SSA even
+        # though plain `kubectl apply` tolerates them. Later chunks emit
+        # only their shard ConfigMaps+Jobs and their machines' Model CRs.
+        context["first_workflow"] = project_workflow == 0
 
         if context["output_file"]:
             s = template.stream(**context)

@@ -1,0 +1,321 @@
+"""
+Packed fleet training: G tiny models as ONE block-diagonal supermodel.
+
+The fleet's models are hourglass MLPs a few tens of units wide, but the
+TPU MXU multiplies 128×128 tiles — a vmapped ``[B, 17] @ [17, 13]`` fleet
+spends one systolic pass per model with ~1% of each tile doing work.
+Packing G models into block-diagonal weights turns G passes into one:
+``[B, G·17] @ (G·17, G·13 block-diag)`` fills the tile laterally, so
+throughput scales ~G× until ``G·width`` reaches the 128-lane boundary.
+
+Per-model math is EXACTLY preserved:
+
+- forward multiplies by ``W * mask`` (mask = the block-diagonal pattern),
+  so cross-model terms are exact float zeros and each model's output
+  matches its unpacked forward to within dot-product summation order;
+- gradients through the mask are zero off the diagonal blocks, so Adam's
+  per-element moments never move there;
+- the training loss is the SUM of per-model weighted means (not a mean
+  over the concatenated feature axis), so each model's parameter gradients
+  equal its separate-training gradients;
+- per-model "empty batch" guards become per-model update masks, keeping
+  the no-op contract of the unpacked engine (models/training.py).
+
+The one intentional departure: members of a pack share the per-epoch
+shuffle permutation (one ``jax.random.permutation`` per pack instead of
+per member). With ``shuffle=False`` packed training reproduces unpacked
+training to float summation order; with shuffling it is statistically
+equivalent.
+
+Early stopping is not supported in packed mode — callers fall back to the
+unpacked program when ``config.early_stopping`` is set.
+
+One more ragged-bucket caveat: Adam's step count is shared across a
+pack. A batch that is padding for only SOME members masks their updates
+and moments, but the shared count still advances, so their later
+bias-correction factors differ slightly from separate training (order
+1e-3 over a few epochs). Members of equal length are unaffected.
+"""
+
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..ops.activations import resolve_activation
+from .nn import init_feedforward
+from .spec import FeedForwardSpec, ModelSpec
+
+Params = Dict[str, Dict[str, jnp.ndarray]]
+
+#: MXU lane width — packing beyond this stops helping and starts hurting.
+MXU_LANES = 128
+
+
+@dataclass(frozen=True)
+class PackedFeedForwardSpec(ModelSpec):
+    """G copies of ``base`` fused into block-diagonal layers."""
+
+    base: FeedForwardSpec
+    g: int
+
+    @property
+    def layer_dims(self) -> Tuple[Tuple[int, int], ...]:
+        """Per-layer (d_in, d_out) of the BASE model, output layer last."""
+        dims = []
+        d_in = self.base.n_features
+        for units in self.base.dims:
+            dims.append((d_in, units))
+            d_in = units
+        dims.append((d_in, self.base.n_features_out))
+        return tuple(dims)
+
+    @property
+    def layer_keys(self) -> Tuple[str, ...]:
+        return tuple(f"dense_{i}" for i in range(len(self.base.dims))) + ("out",)
+
+
+def auto_packing(spec: FeedForwardSpec, n_members: int) -> int:
+    """
+    A packing factor that fills (but does not overflow) the MXU lane
+    width: ``G = 128 // widest layer``, capped by the member count.
+    """
+    widest = max((spec.n_features, spec.n_features_out) + tuple(spec.dims))
+    g = max(1, MXU_LANES // max(widest, 1))
+    return max(1, min(g, n_members, 16))
+
+
+@lru_cache(maxsize=None)
+def _block_masks(spec: PackedFeedForwardSpec):
+    """Per layer: (block-diag weight mask, column->member-id vector)."""
+    masks = {}
+    for key, (d_in, d_out) in zip(spec.layer_keys, spec.layer_dims):
+        mask = np.kron(np.eye(spec.g, dtype=np.float32), np.ones((d_in, d_out), np.float32))
+        col_ids = np.repeat(np.arange(spec.g, dtype=np.int32), d_out)
+        masks[key] = (mask, col_ids)
+    return masks
+
+
+def init_packed(member_keys: jnp.ndarray, spec: PackedFeedForwardSpec) -> Params:
+    """
+    Packed params from G per-member PRNG keys: each member initializes
+    through the exact ``init_feedforward`` chain (same glorot draws as
+    unpacked training), then lands on its diagonal block.
+    """
+    per_member = jax.vmap(lambda k: init_feedforward(k, spec.base))(member_keys)
+    packed: Params = {}
+    for key, (d_in, d_out) in zip(spec.layer_keys, spec.layer_dims):
+        W = jnp.zeros((spec.g * d_in, spec.g * d_out), jnp.dtype(spec.base.compute_dtype))
+        for gi in range(spec.g):  # static unroll; G <= 16
+            W = W.at[
+                gi * d_in : (gi + 1) * d_in, gi * d_out : (gi + 1) * d_out
+            ].set(per_member[key]["W"][gi])
+        b = per_member[key]["b"].reshape(spec.g * d_out)
+        packed[key] = {"W": W, "b": b}
+    return packed
+
+
+def unpack_params(packed: Params, spec: PackedFeedForwardSpec, gi: int) -> Params:
+    """Member ``gi``'s standalone param pytree (diagonal block slices)."""
+    out: Params = {}
+    for key, (d_in, d_out) in zip(spec.layer_keys, spec.layer_dims):
+        out[key] = {
+            "W": packed[key]["W"][
+                gi * d_in : (gi + 1) * d_in, gi * d_out : (gi + 1) * d_out
+            ],
+            "b": packed[key]["b"][gi * d_out : (gi + 1) * d_out],
+        }
+    return out
+
+
+def forward_packed(
+    spec: PackedFeedForwardSpec, params: Params, x: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """
+    ``x[B, G*F] -> (out[B, G*F_out], penalties[G])`` — the packed
+    equivalent of ``forward_feedforward`` with per-model activity
+    penalties (L1 over each member's block).
+    """
+    base = spec.base
+    masks = _block_masks(spec)
+    penalties = jnp.zeros((spec.g,), x.dtype)
+    h = x
+    for i in range(len(base.dims)):
+        key = f"dense_{i}"
+        mask, _ = masks[key]
+        layer = params[key]
+        h = resolve_activation(base.activations[i])(h @ (layer["W"] * mask) + layer["b"])
+        if base.l1_activity and base.l1_activity[i]:
+            per_member = jnp.sum(
+                jnp.abs(h).reshape(h.shape[0], spec.g, base.dims[i]), axis=(0, 2)
+            )
+            penalties = penalties + base.l1_activity[i] * per_member
+    mask, _ = masks["out"]
+    out = h @ (params["out"]["W"] * mask) + params["out"]["b"]
+    return resolve_activation(base.out_activation)(out), penalties
+
+
+def _per_model_losses(
+    spec: PackedFeedForwardSpec, out: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """
+    ``(weighted per-model means [G], per-model weight totals [G])`` from
+    packed outputs. ``w[B, G]`` carries each member's sample weights.
+    """
+    base = spec.base
+    err = out - y
+    if base.loss in ("mse", "mean_squared_error"):
+        per = jnp.square(err)
+    elif base.loss in ("mae", "mean_absolute_error"):
+        per = jnp.abs(err)
+    else:
+        raise ValueError(f"Packed training does not support loss {base.loss!r}")
+    per_sample = per.reshape(err.shape[0], spec.g, base.n_features_out).mean(axis=-1)
+    totals = jnp.sum(w, axis=0)
+    means = jnp.sum(per_sample * w, axis=0) / jnp.maximum(totals, 1.0)
+    return means, totals
+
+
+def _mask_updates(spec: PackedFeedForwardSpec, tree, has_data: jnp.ndarray):
+    """Zero every member's entries whose batch had no data ([G] bool)."""
+    masks = _block_masks(spec)
+
+    def mask_leaf_dict(key, leaf_dict):
+        _, col_ids = masks[key]
+        member_mask = has_data[col_ids].astype(leaf_dict["b"].dtype)
+        return {
+            "W": leaf_dict["W"] * member_mask[None, :],
+            "b": leaf_dict["b"] * member_mask,
+        }
+
+    return {key: mask_leaf_dict(key, tree[key]) for key in tree}
+
+
+def _walk_opt_state(spec, new, old, has_data):
+    """Structurally walk an optax state, selecting param-shaped leaves per
+    member and letting scalars (counts) advance."""
+    masks = _block_masks(spec)
+    col_ids_by_shape = {}
+    for key, (d_in, d_out) in zip(spec.layer_keys, spec.layer_dims):
+        _, col_ids = masks[key]
+        col_ids_by_shape[(spec.g * d_in, spec.g * d_out)] = col_ids
+        col_ids_by_shape[(spec.g * d_out,)] = col_ids
+
+    def select(new_leaf, old_leaf):
+        shape = tuple(np.shape(new_leaf))
+        col_ids = col_ids_by_shape.get(shape)
+        if col_ids is None:
+            return new_leaf  # scalar count etc.
+        keep = has_data[col_ids]
+        if len(shape) == 2:
+            return jnp.where(keep[None, :], new_leaf, old_leaf)
+        return jnp.where(keep, new_leaf, old_leaf)
+
+    return jax.tree_util.tree_map(select, new, old)
+
+
+@lru_cache(maxsize=None)
+def build_packed_fit_fn(spec: PackedFeedForwardSpec, config):
+    """
+    The unjitted packed fused fit:
+
+    ``(params, opt_state, Xtr[n, G·F], ytr[n, G·Fo], wtr[n, G],
+    Xval, yval, wval[nv, G], rng) ->
+    (params, opt_state, losses[epochs, G], val_losses[epochs, G])``
+
+    Mirrors ``models.training.build_raw_fit_fn`` with per-model loss
+    vectors and per-model empty-batch update masks. No early stopping.
+    """
+    if config.early_stopping is not None:
+        raise ValueError("Packed training does not support early stopping")
+    tx = spec.base.optimizer.to_optax()
+
+    def batch_loss(params, xb, yb, wb):
+        out, penalties = forward_packed(spec, params, xb)
+        means, totals = _per_model_losses(spec, out, yb, wb)
+        has_data = totals > 0
+        # Penalties for empty members are pure padding artifacts and would
+        # leak gradients into their biases.
+        losses_g = means + jnp.where(has_data, penalties, 0.0)
+        return jnp.sum(losses_g), (losses_g, totals)
+
+    grad_fn = jax.value_and_grad(batch_loss, has_aux=True)
+
+    def train_epoch(params, opt_state, Xtr, ytr, wtr, erng):
+        n_total = Xtr.shape[0]
+        steps = n_total // config.batch_size
+        if config.shuffle:
+            perm = jax.random.permutation(erng, n_total)
+            Xtr = jnp.take(Xtr, perm, axis=0)
+            ytr = jnp.take(ytr, perm, axis=0)
+            wtr = jnp.take(wtr, perm, axis=0)
+        batches = (
+            Xtr.reshape((steps, config.batch_size) + Xtr.shape[1:]),
+            ytr.reshape((steps, config.batch_size) + ytr.shape[1:]),
+            wtr.reshape((steps, config.batch_size) + wtr.shape[1:]),
+        )
+
+        def step(carry, batch):
+            params, opt_state = carry
+            xb, yb, wb = batch
+            (_, (losses_g, totals)), grads = grad_fn(params, xb, yb, wb)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            has_data = totals > 0
+            # A batch that is padding for EVERY member is a true no-op —
+            # Adam's shared step count must not advance (matches the
+            # unpacked engine's has_data skip exactly). A batch that is
+            # padding for only SOME members masks their updates/moments,
+            # but the shared count still advances for them — the one
+            # bias-correction divergence of packed ragged buckets.
+            any_data = jnp.any(has_data)
+            updates = _mask_updates(spec, updates, has_data)
+            new_params = optax.apply_updates(params, updates)
+            new_opt_state = _walk_opt_state(spec, new_opt_state, opt_state, has_data)
+            params = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(any_data, n, o), new_params, params
+            )
+            opt_state = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(any_data, n, o), new_opt_state, opt_state
+            )
+            contribution = jnp.where(has_data, losses_g * totals, 0.0)
+            return (params, opt_state), (contribution, totals)
+
+        (params, opt_state), (weighted, batch_totals) = jax.lax.scan(
+            step, (params, opt_state), batches
+        )
+        member_totals = jnp.sum(batch_totals, axis=0)
+        epoch_losses = jnp.sum(weighted, axis=0) / jnp.maximum(member_totals, 1.0)
+        epoch_losses = jnp.where(member_totals > 0, epoch_losses, jnp.nan)
+        return params, opt_state, epoch_losses
+
+    def evaluate(params, X, y, w):
+        out, _ = forward_packed(spec, params, X)
+        means, totals = _per_model_losses(spec, out, y, w)
+        return jnp.where(totals > 0, means, jnp.nan)
+
+    def fit(params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng):
+        has_val = Xval.shape[0] > 0
+
+        def epoch_body(carry, erng):
+            params, opt_state = carry
+            params, opt_state, losses_g = train_epoch(
+                params, opt_state, Xtr, ytr, wtr, erng
+            )
+            val_g = (
+                evaluate(params, Xval, yval, wval)
+                if has_val
+                else jnp.full((spec.g,), jnp.nan, jnp.float32)
+            )
+            return (params, opt_state), (losses_g, val_g)
+
+        rngs = jax.random.split(rng, config.epochs)
+        (params, opt_state), (losses, val_losses) = jax.lax.scan(
+            epoch_body, (params, opt_state), rngs
+        )
+        return params, opt_state, losses, val_losses
+
+    return fit

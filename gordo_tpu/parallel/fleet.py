@@ -220,6 +220,21 @@ def fleet_predict_program(spec: ModelSpec):
 
 
 @lru_cache(maxsize=None)
+def _packed_fit_program(pspec, config: FitConfig):
+    """jit(vmap) of the packed block-diagonal fit over the pack axis."""
+    from ..models.packing import build_packed_fit_fn
+
+    return jax.jit(jax.vmap(build_packed_fit_fn(pspec, config)))
+
+
+@lru_cache(maxsize=None)
+def _packed_init_program(pspec):
+    from ..models.packing import init_packed
+
+    return jax.jit(jax.vmap(lambda keys: init_packed(keys, pspec)))
+
+
+@lru_cache(maxsize=None)
 def _fleet_init_program(spec: ModelSpec):
     init = init_fn_for(spec)
 
@@ -237,10 +252,36 @@ class FleetTrainer:
     ----------
     mesh
         Fleet mesh (default: all local devices on the model axis).
+    packing
+        Block-diagonal model packing (models/packing.py): ``None``/1 off,
+        an int for a fixed factor, or ``"auto"`` to fill the 128-lane MXU
+        tile (``128 // widest layer``). Packing G models turns G tiny
+        matmuls into one tile-filling matmul — per-model math is
+        preserved exactly (masked block-diagonal weights; see the module
+        docstring for the shared-shuffle caveat). Applies to feedforward
+        buckets without early stopping; everything else falls back to the
+        unpacked program.
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None):
+    def __init__(self, mesh: Optional[Mesh] = None, packing=None):
         self.mesh = mesh if mesh is not None else make_mesh()
+        self.packing = packing
+
+    def _packing_factor(self, spec, n_members: int, config: FitConfig) -> int:
+        from ..models.packing import auto_packing
+        from ..models.spec import FeedForwardSpec
+
+        if not self.packing or self.packing == 1:
+            return 1
+        if not isinstance(spec, FeedForwardSpec):
+            return 1
+        if config.early_stopping is not None:
+            return 1
+        if spec.loss not in ("mse", "mean_squared_error", "mae", "mean_absolute_error"):
+            return 1
+        if self.packing == "auto":
+            return auto_packing(spec, n_members)
+        return max(1, min(int(self.packing), n_members))
 
     # -- bucketing ----------------------------------------------------------
 
@@ -329,13 +370,20 @@ class FleetTrainer:
         dense = [m for m in members if isinstance(m, FleetMember)]
         windowed = [m for m in members if isinstance(m, WindowedFleetMember)]
         for (spec, n_padded), bucket in self.bucket(dense, config).items():
+            g = self._packing_factor(spec, len(bucket), config)
             logger.info(
-                "Fleet bucket: %d models, spec=%s, padded_n=%d",
+                "Fleet bucket: %d models, spec=%s, padded_n=%d%s",
                 len(bucket),
                 type(spec).__name__,
                 n_padded,
+                f", packed x{g}" if g > 1 else "",
             )
-            for result in self._train_bucket(spec, n_padded, bucket, config):
+            train_bucket = (
+                (lambda s, n, b, c: self._train_bucket_packed(s, n, b, c, g))
+                if g > 1
+                else self._train_bucket
+            )
+            for result in train_bucket(spec, n_padded, bucket, config):
                 by_name[result.name] = result
         for (spec, n_padded, offset), bucket in self.bucket_windowed(
             windowed, config
@@ -422,6 +470,122 @@ class FleetTrainer:
             bucket, params, losses, val_losses, epochs_ran, config,
             steps=n_padded // config.batch_size,
         )
+
+    # -- packed training ----------------------------------------------------
+
+    def _train_bucket_packed(
+        self,
+        spec: ModelSpec,
+        n_padded: int,
+        bucket: List[FleetMember],
+        config: FitConfig,
+        g: int,
+    ) -> List[FleetResult]:
+        """
+        Train the bucket as ceil(M/G) block-diagonal supermodels
+        (models/packing.py): G members share each device matmul, filling
+        the MXU tile that a single tiny model would leave ~99% idle.
+        Downstream (scoring, serving, artifacts) sees ordinary per-member
+        params — unpacking happens right here.
+        """
+        from ..models.packing import (
+            PackedFeedForwardSpec,
+            init_packed,
+            unpack_params,
+        )
+
+        pspec = PackedFeedForwardSpec(base=spec, g=g)
+        model_axis = self.mesh.devices.shape[0]
+        data_axis = self.mesh.devices.shape[1] if self.mesh.devices.ndim > 1 else 1
+        packs = -(-len(bucket) // g)
+        packs_total = -(-packs // model_axis) * model_axis
+        m_total = packs_total * g
+        step = int(np.lcm(config.batch_size, data_axis))
+        n_padded = -(-n_padded // step) * step
+
+        f_in, f_out = spec.n_features, spec.n_features_out
+        # AE fleets overwhelmingly train y == X; aliasing skips the second
+        # [P, n, G·F] host block and its device transfer (same optimization
+        # as _stack_bucket's).
+        aliased = f_in == f_out and all(m.y is m.X for m in bucket)
+        X = np.zeros((packs_total, n_padded, g * f_in), np.float32)
+        y = X if aliased else np.zeros((packs_total, n_padded, g * f_out), np.float32)
+        wtr = np.zeros((packs_total, n_padded, g), np.float32)
+        wval = np.zeros((packs_total, n_padded, g), np.float32)
+        for i, member in enumerate(bucket):
+            p, gi = divmod(i, g)
+            X[p, : member.n, gi * f_in : (gi + 1) * f_in] = member.X
+            if not aliased:
+                y[p, : member.n, gi * f_out : (gi + 1) * f_out] = member.y
+            row_tr = np.zeros((1, n_padded), np.float32)
+            row_val = np.zeros((1, n_padded), np.float32)
+            _fill_weight_row(row_tr, row_val, 0, member.n, member, config)
+            wtr[p, :, gi] = row_tr[0]
+            wval[p, :, gi] = row_val[0]
+
+        # Per-member RNG parity with the unpacked path: each member's key
+        # splits into (fit, init) halves; the pack trains with its first
+        # member's fit key (one shared shuffle stream per pack).
+        seeds = [m.seed for m in bucket] + [0] * (m_total - len(bucket))
+        member_keys = host_prng_keys(seeds)
+        split_keys = jax.vmap(jax.random.split)(member_keys)
+        fit_keys = np.asarray(split_keys[:, 0]).reshape(packs_total, g, 2)[:, 0]
+        init_keys = np.asarray(split_keys[:, 1]).reshape(packs_total, g, 2)
+
+        md1 = model_data_sharding(self.mesh, extra_dims=1)
+        X_dev, wtr_dev, wval_dev = jax.device_put((X, wtr, wval), (md1, md1, md1))
+        y_dev = X_dev if aliased else jax.device_put(y, md1)
+        fit_rngs, init_rngs = jax.device_put(
+            (fit_keys, init_keys),
+            (
+                model_sharding(self.mesh, extra_dims=1),
+                model_sharding(self.mesh, extra_dims=2),
+            ),
+        )
+
+        params = _packed_init_program(pspec)(init_rngs)
+        params = jax.device_put(params, model_sharding(self.mesh, extra_dims=0))
+        opt_state = jax.jit(jax.vmap(spec.optimizer.to_optax().init))(params)
+        fit = _packed_fit_program(pspec, config)
+        params, _, losses, val_losses = fit(
+            params, opt_state, X_dev, y_dev, wtr_dev, X_dev, y_dev, wval_dev, fit_rngs
+        )
+
+        host_params = fetch_to_host(params)
+        losses = np.asarray(fetch_to_host(losses))
+        val_losses = np.asarray(fetch_to_host(val_losses))
+
+        results = []
+        steps = n_padded // config.batch_size
+        for i, member in enumerate(bucket):
+            p, gi = divmod(i, g)
+            pack_params = jax.tree_util.tree_map(lambda a: a[p], host_params)
+            member_params = jax.tree_util.tree_map(
+                np.asarray, unpack_params(pack_params, pspec, gi)
+            )
+            history = {"loss": [float(l) for l in losses[p][:, gi]]}
+            member_val = val_losses[p][:, gi]
+            if not np.all(np.isnan(member_val)):
+                history["val_loss"] = [float(l) for l in member_val]
+            results.append(
+                FleetResult(
+                    name=member.name,
+                    seed=member.seed,
+                    params=member_params,
+                    history=History(
+                        history=history,
+                        params={
+                            "epochs": config.epochs,
+                            "steps": steps,
+                            "verbose": 0,
+                            "metrics": list(history),
+                            "packed": g,
+                        },
+                        epoch=list(range(config.epochs)),
+                    ),
+                )
+            )
+        return results
 
     def _init_bucket_params(self, spec: ModelSpec, rngs):
         """Per-member init mirroring fit_single's derivation exactly so a

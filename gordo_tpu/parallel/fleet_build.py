@@ -121,7 +121,17 @@ class FleetBuilder:
         fail_fast: bool = False,
     ):
         self.machines = list(machines)
-        self.trainer = trainer if trainer is not None else FleetTrainer()
+        if trainer is None:
+            # GORDO_TPU_PACKING=auto|<int> turns on block-diagonal model
+            # packing (models/packing.py) for the whole build path —
+            # including the `build-fleet` CLI — without new flags.
+            import os
+
+            packing: Any = os.environ.get("GORDO_TPU_PACKING") or None
+            if packing and packing != "auto":
+                packing = int(packing)
+            trainer = FleetTrainer(packing=packing)
+        self.trainer = trainer
         self.data_workers = data_workers
         # The reference DAG runs with failFast:false
         # (argo-workflow.yml.template: one machine's builder pod failing

@@ -1,0 +1,222 @@
+"""
+Packed (block-diagonal) fleet training: per-model math preserved exactly,
+G× fewer device matmuls (models/packing.py + FleetTrainer(packing=...)).
+"""
+
+import numpy as np
+import pytest
+
+from gordo_tpu.models.factories import feedforward_hourglass, feedforward_model
+from gordo_tpu.models.packing import (
+    PackedFeedForwardSpec,
+    auto_packing,
+    forward_packed,
+    init_packed,
+    unpack_params,
+)
+from gordo_tpu.models.training import FitConfig
+from gordo_tpu.parallel import FleetMember, FleetTrainer
+
+
+def _members(spec, m, n=48, seed0=0):
+    rng = np.random.RandomState(7)
+    return [
+        FleetMember(
+            name=f"pk-{i}",
+            spec=spec,
+            X=(X := rng.rand(n, spec.n_features).astype(np.float32)),
+            y=X,
+            seed=seed0 + i,
+        )
+        for i in range(m)
+    ]
+
+
+def test_auto_packing_fills_mxu_lanes():
+    spec = feedforward_hourglass(20)  # widest layer = 20
+    assert auto_packing(spec, 100) == 6  # 128 // 20
+    assert auto_packing(spec, 3) == 3  # capped by member count
+    wide = feedforward_hourglass(200)
+    assert auto_packing(wide, 100) == 1  # already tile-wide
+
+
+def test_packed_forward_matches_unpacked():
+    """Per-member outputs must match: off-block contributions are exact
+    zeros, so the only difference is dot-product summation order (a
+    G·F-wide reduction rounds differently than an F-wide one)."""
+    import jax
+
+    from gordo_tpu.models.nn import forward_feedforward, init_feedforward
+
+    base = feedforward_hourglass(6, encoding_layers=2)
+    g = 4
+    pspec = PackedFeedForwardSpec(base=base, g=g)
+    keys = jax.random.split(jax.random.PRNGKey(0), g)
+    packed = init_packed(keys, pspec)
+
+    rng = np.random.RandomState(0)
+    xs = [rng.rand(16, 6).astype(np.float32) for _ in range(g)]
+    x_packed = np.concatenate(xs, axis=1)
+    out_packed, penalties = forward_packed(pspec, packed, x_packed)
+    out_packed = np.asarray(out_packed)
+
+    for gi in range(g):
+        params_gi = init_feedforward(keys[gi], base)
+        expected, expected_pen = forward_feedforward(base, params_gi, xs[gi])
+        np.testing.assert_allclose(
+            out_packed[:, gi * 6 : (gi + 1) * 6],
+            np.asarray(expected),
+            rtol=1e-5,
+            atol=5e-7,
+        )
+        # init parity too: the unpacked block equals a fresh per-member init
+        member = unpack_params(packed, pspec, gi)
+        for key in params_gi:
+            np.testing.assert_array_equal(
+                np.asarray(member[key]["W"]), np.asarray(params_gi[key]["W"])
+            )
+
+
+def test_packed_training_matches_unpacked_no_shuffle():
+    """With shuffle=False the packed engine must train each member like
+    the unpacked fleet (same batches, same gradients, same Adam
+    trajectory — differing only in float summation order)."""
+    spec = feedforward_hourglass(5, encoding_layers=1)
+    members = _members(spec, 6)
+    config = FitConfig(epochs=3, batch_size=16, shuffle=False, validation_split=0.25)
+
+    plain = FleetTrainer().train([m for m in members], config)
+    packed = FleetTrainer(packing=3).train([m for m in members], config)
+
+    for a, b in zip(plain, packed):
+        assert a.name == b.name
+        np.testing.assert_allclose(
+            a.history.history["loss"], b.history.history["loss"], rtol=1e-4, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            a.history.history["val_loss"],
+            b.history.history["val_loss"],
+            rtol=1e-4,
+            atol=1e-6,
+        )
+        for key in a.params:
+            np.testing.assert_allclose(
+                a.params[key]["W"], b.params[key]["W"], rtol=1e-4, atol=1e-6
+            )
+    assert packed[0].history.params["packed"] == 3
+
+
+def test_packed_training_ragged_members():
+    """Members with different real lengths (zero-weight padding rows) must
+    not bleed into each other."""
+    spec = feedforward_hourglass(4, encoding_layers=1)
+    rng = np.random.RandomState(3)
+    members = [
+        FleetMember(
+            name=f"rg-{i}",
+            spec=spec,
+            X=(X := rng.rand(n, 4).astype(np.float32)),
+            y=X,
+            seed=i,
+        )
+        for i, n in enumerate((40, 24, 33))
+    ]
+    config = FitConfig(epochs=2, batch_size=16, shuffle=False)
+    plain = FleetTrainer().train(list(members), config)
+    packed = FleetTrainer(packing=3).train(list(members), config)
+    for a, b in zip(plain, packed):
+        # ragged packs share Adam's step count, so members whose padding
+        # batches are real data for pack-mates drift by bias-correction
+        # factors (documented in models/packing.py) — tolerance reflects it
+        np.testing.assert_allclose(
+            a.history.history["loss"], b.history.history["loss"], rtol=2e-2, atol=1e-5
+        )
+
+
+def test_packed_training_with_l1_activity():
+    """The reference's l1 activity penalty must stay per-member."""
+    spec = feedforward_model(
+        4, 4,
+        encoding_dim=(6, 3), decoding_dim=(3, 6),
+        encoding_func=("tanh", "tanh"), decoding_func=("tanh", "tanh"),
+    )
+    assert spec.l1_activity and any(spec.l1_activity)
+    members = _members(spec, 4, n=32)
+    config = FitConfig(epochs=2, batch_size=16, shuffle=False)
+    plain = FleetTrainer().train(list(members), config)
+    packed = FleetTrainer(packing=2).train(list(members), config)
+    for a, b in zip(plain, packed):
+        np.testing.assert_allclose(
+            a.history.history["loss"], b.history.history["loss"], rtol=1e-4, atol=1e-6
+        )
+
+
+def test_packing_falls_back_for_early_stopping():
+    spec = feedforward_hourglass(4, encoding_layers=1)
+    members = _members(spec, 4, n=32)
+    config = FitConfig(
+        epochs=3, batch_size=16, shuffle=False,
+        early_stopping=("loss", 1, 0.0, False), validation_split=0.25,
+    )
+    trainer = FleetTrainer(packing="auto")
+    assert trainer._packing_factor(spec, len(members), config) == 1
+    results = trainer.train(list(members), config)  # unpacked path works
+    assert len(results) == 4
+
+
+def test_packed_auto_mode_trains():
+    spec = feedforward_hourglass(8)
+    members = _members(spec, 10, n=40)
+    config = FitConfig(epochs=2, batch_size=16, shuffle=True)
+    results = FleetTrainer(packing="auto").train(list(members), config)
+    assert len(results) == 10
+    for result in results:
+        assert np.isfinite(result.history.history["loss"][-1])
+        assert result.params["out"]["W"].shape == (
+            results[0].params["out"]["W"].shape
+        )
+
+
+def test_packed_respects_retry_on_divergence():
+    """The diverged-member retry loop reads packed histories fine."""
+    spec = feedforward_hourglass(4, encoding_layers=1)
+    members = _members(spec, 4, n=32)
+    config = FitConfig(epochs=2, batch_size=16, shuffle=False)
+    results = FleetTrainer(packing=2).train(list(members), config, retry_failed=1)
+    assert all(np.isfinite(r.history.history["loss"][-1]) for r in results)
+
+
+def test_fleet_builder_packs_via_env(monkeypatch, tmp_path):
+    """GORDO_TPU_PACKING wires packing into the whole build path."""
+    from gordo_tpu.machine import Machine
+    from gordo_tpu.parallel import FleetBuilder
+
+    monkeypatch.setenv("GORDO_TPU_PACKING", "2")
+    machines = [
+        Machine.from_config(
+            {
+                "name": f"pk-env-{i}",
+                "model": {
+                    "gordo_tpu.models.JaxAutoEncoder": {
+                        "kind": "feedforward_hourglass",
+                        "encoding_layers": 1,
+                        "epochs": 1,
+                    }
+                },
+                "dataset": {
+                    "type": "RandomDataset",
+                    "train_start_date": "2020-01-01T00:00:00+00:00",
+                    "train_end_date": "2020-01-02T00:00:00+00:00",
+                    "tag_list": [f"pk-{i}-a", f"pk-{i}-b"],
+                },
+            },
+            project_name="pk-proj",
+        )
+        for i in range(4)
+    ]
+    builder = FleetBuilder(machines)
+    assert builder.trainer.packing == 2
+    results = builder.build(output_dir=str(tmp_path))
+    assert len(results) == 4
+    for model, machine in results:
+        assert (tmp_path / machine.name / "model.pkl").exists()

@@ -144,8 +144,22 @@ def test_machines_per_slice_sharding(tmp_path, config_file):
 
 def test_split_workflows(config_file):
     docs = generate(config_file, "--split-workflows", "1")
-    # two chunks → two PVC documents (one per rendered workflow)
-    assert len(by_kind(docs, "PersistentVolumeClaim")) == 2
+    # two chunks, but project-level resources render exactly once — a
+    # duplicated PVC/Deployment would break kustomize/ArgoCD/SSA
+    assert len(by_kind(docs, "PersistentVolumeClaim")) == 1
+    assert len(by_kind(docs, "Deployment")) == 1
+    assert len({d["metadata"]["name"] for d in by_kind(docs, "StatefulSet")}) == len(
+        by_kind(docs, "StatefulSet")
+    )
+    # while per-chunk resources cover every machine
+    assert len(builder_jobs(docs)) == 2
+    assert {m["metadata"]["name"] for m in by_kind(docs, "Model")} == {
+        "test-proj-machine-1",
+        "test-proj-machine-2",
+    }
+    # no duplicate (kind, name) identities anywhere in the stream
+    identities = [(d["kind"], d["metadata"]["name"]) for d in docs if d]
+    assert len(identities) == len(set(identities))
 
 
 def test_server_plane(config_file):
