@@ -282,7 +282,9 @@ def fleet_train() -> dict:
     # baseline so the headroom is visible, per-seat.
     packed_elapsed = None
     packing = os.environ.get("BENCH_PACKING", "auto")
-    if packing != "0":
+    # "0"/"1" both mean "no packing" — a factor of 1 IS the unpacked
+    # program, and timing it twice would just report jitter as speedup.
+    if packing not in ("0", "1"):
         packed_trainer = FleetTrainer(
             packing=packing if packing == "auto" else int(packing)
         )

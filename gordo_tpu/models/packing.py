@@ -47,6 +47,7 @@ import numpy as np
 import optax
 
 from ..ops.activations import resolve_activation
+from ..ops.losses import resolve_loss
 from .nn import init_feedforward
 from .spec import FeedForwardSpec, ModelSpec
 
@@ -167,14 +168,12 @@ def _per_model_losses(
     packed outputs. ``w[B, G]`` carries each member's sample weights.
     """
     base = spec.base
-    err = out - y
-    if base.loss in ("mse", "mean_squared_error"):
-        per = jnp.square(err)
-    elif base.loss in ("mae", "mean_absolute_error"):
-        per = jnp.abs(err)
-    else:
-        raise ValueError(f"Packed training does not support loss {base.loss!r}")
-    per_sample = per.reshape(err.shape[0], spec.g, base.n_features_out).mean(axis=-1)
+    # resolve_loss gives the per-sample loss (mean over the trailing
+    # feature axis); reshaping to [B, G, F_out] yields the [B, G]
+    # per-member matrix with the same registry as the unpacked engine.
+    per_sample_fn = resolve_loss(base.loss)
+    shape = (out.shape[0], spec.g, base.n_features_out)
+    per_sample = per_sample_fn(out.reshape(shape), y.reshape(shape))
     totals = jnp.sum(w, axis=0)
     means = jnp.sum(per_sample * w, axis=0) / jnp.maximum(totals, 1.0)
     return means, totals

@@ -277,7 +277,11 @@ class FleetTrainer:
             return 1
         if config.early_stopping is not None:
             return 1
-        if spec.loss not in ("mse", "mean_squared_error", "mae", "mean_absolute_error"):
+        from ..ops.losses import resolve_loss
+
+        try:
+            resolve_loss(spec.loss)
+        except ValueError:
             return 1
         if self.packing == "auto":
             return auto_packing(spec, n_members)

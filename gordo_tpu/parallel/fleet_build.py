@@ -96,6 +96,7 @@ class _Plan:
     cv_splits: Dict[str, Any] = field(default_factory=dict)
     cv_duration: float = 0.0
     train_duration: float = 0.0
+    _scoring_setup_cache: Any = None  # (metrics, fitted scoring scaler)
 
 
 class FleetBuildError(RuntimeError):
@@ -590,7 +591,14 @@ class FleetBuilder:
             spec, stacked, series, order, batch_size=self._SCORING_BATCH
         )
 
-    def _accumulate_metric_scores(self, plan, y_true, y_pred, fold_idx):
+    @staticmethod
+    def _scoring_setup(plan: _Plan):
+        """Resolved metrics + the fitted scoring scaler, cached per plan —
+        re-deriving them per fold was a measured CV hot spot (63ms per
+        machine-fold at 20 tags on CPU)."""
+        cached = getattr(plan, "_scoring_setup_cache", None)
+        if cached is not None:
+            return cached
         evaluation = plan.machine.evaluation
         metrics_list = ModelBuilder.metrics_from_list(evaluation.get("metrics"))
         scaler_def = evaluation.get("scoring_scaler")
@@ -601,19 +609,46 @@ class FleetBuilder:
                 if isinstance(scaler_def, (str, dict))
                 else scaler_def
             )
+            # The scoring scaler always fits the FULL target frame (not
+            # the fold), so one fit serves every fold.
             scaler = sklearn_clone(scaler).fit(plan.y_arr)
+        plan._scoring_setup_cache = (metrics_list, scaler)
+        return plan._scoring_setup_cache
+
+    def _accumulate_metric_scores(self, plan, y_true, y_pred, fold_idx):
+        metrics_list, scaler = self._scoring_setup(plan)
+        if scaler is not None:
             y_true_s, y_pred_s = scaler.transform(y_true), scaler.transform(y_pred)
         else:
             y_true_s, y_pred_s = y_true, y_pred
         tags = [str(c) for c in plan.y.columns]
+        fold_key = f"fold-{fold_idx + 1}"
         for metric in metrics_list:
             name = metric.__name__.replace("_", "-")
+            per_tag = None
+            try:
+                # One vectorized call for all tags (sklearn regression
+                # metrics support multioutput) instead of a Python loop of
+                # per-column calls — ~20× fewer sklearn invocations.
+                per_tag = np.asarray(
+                    metric(y_true_s, y_pred_s, multioutput="raw_values")
+                )
+            except TypeError:
+                pass
+            if per_tag is None or per_tag.shape != (len(tags),):
+                # Custom metrics may lack multioutput support — or swallow
+                # the kwarg and return something else entirely; only trust
+                # a correctly-shaped per-tag vector.
+                per_tag = np.asarray(
+                    [
+                        metric(y_true_s[:, i], y_pred_s[:, i])
+                        for i in range(len(tags))
+                    ]
+                )
             for i, tag in enumerate(tags):
                 key = f"{name}-{tag.replace(' ', '-')}"
-                plan.cv_scores.setdefault(key, {})[f"fold-{fold_idx + 1}"] = float(
-                    metric(y_true_s[:, i], y_pred_s[:, i])
-                )
-            plan.cv_scores.setdefault(name, {})[f"fold-{fold_idx + 1}"] = float(
+                plan.cv_scores.setdefault(key, {})[fold_key] = float(per_tag[i])
+            plan.cv_scores.setdefault(name, {})[fold_key] = float(
                 metric(y_true_s, y_pred_s)
             )
 
