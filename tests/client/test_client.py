@@ -155,3 +155,28 @@ def test_client_cli_registered():
         "download-model",
         "predict",
     }
+
+
+def test_fleet_anomaly_scores(ml_server):
+    """One batch request scores every machine through the fused route."""
+    client = Client(project="client-project", session=ml_server)
+    results = client.fleet_anomaly_scores(START, END)
+    assert set(results) == {"machine-a", "machine-b"}
+    for name, result in results.items():
+        assert not result.error_messages
+        frame = result.predictions
+        assert frame is not None and len(frame) > 0
+        assert "total-anomaly-unscaled" in frame.columns
+        assert (frame["total-anomaly-unscaled"] >= 0).all()
+
+
+def test_fleet_anomaly_scores_all_failures_still_per_machine(ml_server):
+    """A batch whose every machine fails server-side (HTTP 400 + errors
+    body) must return per-machine error results, not raise."""
+    client = Client(project="client-project", session=ml_server)
+    machines = client.get_available_machines(["machine-a"])
+
+    bad_payload = {"machine-a": {"not-a-tag": {"also-not-a-date": 1.0}}}
+    # drive through the internal POST path the public method uses
+    body = client._post_fleet_request(bad_payload)
+    assert body.get("errors", {}).get("machine-a", {}).get("status") in (400, 422)

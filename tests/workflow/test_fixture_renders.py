@@ -96,7 +96,7 @@ def test_machines_per_slice_fixture_shards():
     builder = [
         d
         for d in docs
-        if d and d["kind"] == "Job" and "fleet-builder" in d["metadata"]["name"]
+        if d and d["kind"] == "Job" and d["metadata"]["name"].startswith("gordo-fleet-")
     ]
     assert len(builder) == 2  # 3 machines / 2 per slice
 
@@ -107,7 +107,7 @@ def test_custom_runtime_resources_fixture():
     (job,) = (
         d
         for d in docs
-        if d and d["kind"] == "Job" and "fleet-builder" in d["metadata"]["name"]
+        if d and d["kind"] == "Job" and d["metadata"]["name"].startswith("gordo-fleet-")
     )
     resources = job["spec"]["template"]["spec"]["containers"][0]["resources"]
     assert resources["requests"]["memory"] == "1000M"
@@ -125,7 +125,7 @@ def test_runtime_env_fixture_reaches_builder():
     (job,) = (
         d
         for d in docs
-        if d and d["kind"] == "Job" and "fleet-builder" in d["metadata"]["name"]
+        if d and d["kind"] == "Job" and d["metadata"]["name"].startswith("gordo-fleet-")
     )
     env = {
         e["name"]: e.get("value")

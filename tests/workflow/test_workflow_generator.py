@@ -77,7 +77,7 @@ def by_kind(docs, kind):
 def builder_jobs(docs):
     """The fleet-builder Jobs (the cleanup/replay Jobs are also kind Job)."""
     return [
-        j for j in by_kind(docs, "Job") if "fleet-builder" in j["metadata"]["name"]
+        j for j in by_kind(docs, "Job") if j["metadata"]["name"].startswith("gordo-fleet-")
     ]
 
 
@@ -421,3 +421,28 @@ def test_model_crds_per_machine(config_file):
 def test_model_crds_disabled(config_file):
     docs = generate(config_file, "--without-model-crds")
     assert not by_kind(docs, "Model")
+
+
+def test_per_revision_resources_get_fresh_names(config_file):
+    """k8s Jobs are immutable: redeploying a new revision must create NEW
+    Jobs/ConfigMaps, so their names carry the revision."""
+    docs_a = generate(config_file)  # revision 1234567890123 (the default)
+    # click takes the LAST occurrence of a non-multiple option, so the
+    # helper's default revision is overridden here
+    docs_b = generate(config_file, "--project-revision", "9999999999999")
+
+    def job_and_cm_names(docs):
+        return {
+            d["metadata"]["name"]
+            for d in docs
+            if d and (
+                d["kind"] == "Job"
+                or (d["kind"] == "ConfigMap" and "fleet-config" in d["metadata"]["name"])
+            )
+        }
+
+    assert job_and_cm_names(docs_a).isdisjoint(job_and_cm_names(docs_b))
+    # ...and the builder pod hostname (job name + "-<index>") stays a
+    # valid DNS label for the jax.distributed coordinator address
+    for job in builder_jobs(docs_a):
+        assert len(job["metadata"]["name"]) + len("-0") <= 63
