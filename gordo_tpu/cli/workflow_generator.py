@@ -76,6 +76,23 @@ def resolve_exceptions_report_level(config: NormalizedConfig) -> ReportLevel:
     return level
 
 
+#: Longest fixed prefix among per-revision resource names
+#: ("gordo-tpu-fleet-config-"), plus "-r12345678-<workflow>-<shard>" and the
+#: "-<pod index>" a builder pod hostname appends — everything must stay a
+#: valid 63-char DNS label or kubectl rejects the deploy.
+_NAME_OVERHEAD = len("gordo-tpu-fleet-config-") + len("-r12345678-999-999-99")
+
+
+def check_project_name_fits(project_name: str) -> None:
+    budget = 63 - _NAME_OVERHEAD
+    if len(project_name) > budget:
+        raise click.ClickException(
+            f"--project-name {project_name!r} is {len(project_name)} chars; "
+            f"at most {budget} fit within k8s' 63-char resource-name labels "
+            "once revision/workflow/shard suffixes are added"
+        )
+
+
 def check_keda_flags(context: Dict[str, Any]) -> None:
     """KEDA autoscaling needs both the feature flag and a Prometheus URL."""
     if context["ml_server_hpa_type"] != "keda":
@@ -450,6 +467,14 @@ def workflow_cli(gordo_ctx):
     help="Volume size for each infra statefulset (InfluxDB, Postgres, Grafana)",
     envvar=f"{PREFIX}_INFRA_STORAGE_SIZE",
 )
+@click.option(
+    "--job-ttl-seconds",
+    type=int,
+    default=7 * 24 * 3600,
+    help="ttlSecondsAfterFinished for builder/replay/cleanup Jobs — "
+    "per-revision Jobs would otherwise accumulate forever",
+    envvar=f"{PREFIX}_JOB_TTL_SECONDS",
+)
 @click.pass_context
 def workflow_generator_cli(gordo_ctx, **ctx):
     """Machine configuration to TPU fleet workflow manifests."""
@@ -482,6 +507,7 @@ def workflow_generator_cli(gordo_ctx, **ctx):
     context["log_level"] = log_level.upper()
 
     check_keda_flags(context)
+    check_project_name_fits(context["project_name"])
 
     resources_labels = parse_label_overrides(context["resources_labels"])
     model_builder_labels = parse_label_overrides(

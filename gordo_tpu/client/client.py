@@ -223,20 +223,46 @@ class Client:
                     payload[name] = frame_dict
 
         if payload:
-            body = self._post_fleet_request(payload)
-            for name, entry in body.get("data", {}).items():
-                frame = dataframe_from_dict(entry["model-output"])
-                frame["total-anomaly-unscaled"] = dataframe_from_dict(
-                    {"mse": entry["total-anomaly-unscaled"]}
-                )["mse"]
-                results[name] = PredictionResult(
-                    name=name, predictions=frame, error_messages=[]
-                )
-            for name, error in (body.get("errors") or {}).items():
+            # Chunk by rows like predict_single_machine does: one giant
+            # body for a long window would blow past proxy limits where
+            # the chunked per-machine path succeeds.
+            frames_by_name: Dict[str, List[pd.DataFrame]] = {}
+            errors_by_name: Dict[str, List[str]] = {}
+            max_rows = max(len(frame_dict[next(iter(frame_dict))]) for frame_dict in payload.values())
+            for chunk_start in range(0, max_rows, self.batch_size):
+                chunk_payload = {}
+                for name, frame_dict in payload.items():
+                    chunk = {
+                        col: dict(
+                            list(series.items())[
+                                chunk_start : chunk_start + self.batch_size
+                            ]
+                        )
+                        for col, series in frame_dict.items()
+                    }
+                    if next(iter(chunk.values()), None):
+                        chunk_payload[name] = chunk
+                if not chunk_payload:
+                    continue
+                body = self._post_fleet_request(chunk_payload)
+                for name, entry in body.get("data", {}).items():
+                    frame = dataframe_from_dict(entry["model-output"])
+                    frame["total-anomaly-unscaled"] = dataframe_from_dict(
+                        {"mse": entry["total-anomaly-unscaled"]}
+                    )["mse"]
+                    frames_by_name.setdefault(name, []).append(frame)
+                for name, error in (body.get("errors") or {}).items():
+                    errors_by_name.setdefault(name, []).append(
+                        str(error.get("error"))
+                    )
+            for name in payload:
+                frames = frames_by_name.get(name)
                 results[name] = PredictionResult(
                     name=name,
-                    predictions=None,
-                    error_messages=[str(error.get("error"))],
+                    predictions=(
+                        pd.concat(frames).sort_index() if frames else None
+                    ),
+                    error_messages=errors_by_name.get(name, []),
                 )
         return results
 
@@ -253,7 +279,13 @@ class Client:
                     url, json={"X": payload}, params=self._query_params()
                 )
                 if resp.status_code == 400:
-                    body = resp.json()
+                    try:
+                        body = resp.json()
+                    except ValueError:
+                        # non-JSON 400 (a proxy error page): not the
+                        # server's errors contract — let _handle_response
+                        # raise the typed, non-retryable exception
+                        body = None
                     if isinstance(body, dict) and body.get("errors"):
                         return body
                 return _handle_response(resp, "fleet prediction")

@@ -3,6 +3,8 @@ Client tests against the in-process fake cluster (reference:
 tests/gordo/client/test_client.py).
 """
 
+import json
+
 import pandas as pd
 import pytest
 
@@ -180,3 +182,46 @@ def test_fleet_anomaly_scores_all_failures_still_per_machine(ml_server):
     # drive through the internal POST path the public method uses
     body = client._post_fleet_request(bad_payload)
     assert body.get("errors", {}).get("machine-a", {}).get("status") in (400, 422)
+
+
+def test_fleet_anomaly_scores_maps_error_body_per_machine(ml_server):
+    """The PUBLIC method must turn a 400-with-errors body into per-machine
+    PredictionResults (not raise, not drop entries)."""
+
+    class AllErrorsSession:
+        """Delegates everything but fleet POSTs, which fail per-machine."""
+
+        def __init__(self, inner):
+            self.inner = inner
+
+        def get(self, *args, **kwargs):
+            return self.inner.get(*args, **kwargs)
+
+        def post(self, url, **kwargs):
+            if url.endswith("/prediction/fleet"):
+                names = list(kwargs["json"]["X"])
+                import requests
+
+                resp = requests.models.Response()
+                resp.status_code = 400
+                resp.headers["content-type"] = "application/json"
+                resp._content = json.dumps(
+                    {
+                        "data": {},
+                        "errors": {
+                            name: {"error": f"boom {name}", "status": 500}
+                            for name in names
+                        },
+                    }
+                ).encode()
+                return resp
+            return self.inner.post(url, **kwargs)
+
+    client = Client(
+        project="client-project", session=AllErrorsSession(ml_server)
+    )
+    results = client.fleet_anomaly_scores(START, END)
+    assert set(results) == {"machine-a", "machine-b"}
+    for name, result in results.items():
+        assert result.predictions is None
+        assert any(f"boom {name}" in msg for msg in result.error_messages)

@@ -446,3 +446,27 @@ def test_per_revision_resources_get_fresh_names(config_file):
     # valid DNS label for the jax.distributed coordinator address
     for job in builder_jobs(docs_a):
         assert len(job["metadata"]["name"]) + len("-0") <= 63
+
+
+def test_jobs_have_ttl(config_file):
+    docs = generate(config_file, "--with-prediction-replay")
+    jobs = by_kind(docs, "Job")
+    assert len(jobs) == 3  # builder + replay + cleanup
+    for job in jobs:
+        assert job["spec"]["ttlSecondsAfterFinished"] == 7 * 24 * 3600
+    (job,) = builder_jobs(generate(config_file, "--job-ttl-seconds", "60"))
+    assert job["spec"]["ttlSecondsAfterFinished"] == 60
+
+
+def test_project_name_length_guard(config_file):
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo_tpu_cli,
+        [
+            "workflow", "generate",
+            "--machine-config", config_file,
+            "--project-name", "x" * 40,
+        ],
+    )
+    assert result.exit_code != 0
+    assert "63-char" in result.output
