@@ -241,6 +241,22 @@ def _setup_jax_cache():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
+# -- stage 0: backend pre-flight -------------------------------------------
+
+
+@stage
+def backend_probe() -> dict:
+    """A ~second of real device work. If even this hangs, the accelerator
+    tunnel is wedged and every later stage should go straight to CPU
+    instead of burning a full stage timeout each first."""
+    import jax
+
+    _setup_jax_cache()
+    x = jax.numpy.ones((256, 256))
+    value = float((x @ x).sum())
+    return {"device": _device_desc(), "checksum": value}
+
+
 # -- stage 1: bare fleet training ------------------------------------------
 
 
@@ -532,6 +548,16 @@ def main():
         sys.exit(_stage_entry(sys.argv[2], sys.argv[3]))
 
     partial: dict = {"n_models": N_MODELS, "epochs": N_EPOCHS}
+
+    # Pre-flight: a wedged accelerator tunnel hangs even trivial device
+    # work. Detect it once (short timeout) and pin the whole run to CPU
+    # rather than paying a full stage timeout per stage.
+    if not os.environ.get("BENCH_FORCE_CPU"):
+        probe = run_stage(partial, "backend_probe", timeout=240, retries=0)
+        if probe is None:
+            log("backend probe failed; forcing CPU for all stages")
+            os.environ["BENCH_FORCE_CPU"] = "1"
+            partial["backend_note"] = "accelerator unresponsive; ran on CPU"
 
     run_stage(partial, "fleet_train")
     if not os.environ.get("BENCH_SKIP_E2E"):

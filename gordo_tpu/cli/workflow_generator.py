@@ -76,11 +76,15 @@ def resolve_exceptions_report_level(config: NormalizedConfig) -> ReportLevel:
     return level
 
 
-#: Longest fixed prefix among per-revision resource names
-#: ("gordo-tpu-fleet-config-"), plus "-r12345678-<workflow>-<shard>" and the
-#: "-<pod index>" a builder pod hostname appends — everything must stay a
-#: valid 63-char DNS label or kubectl rejects the deploy.
-_NAME_OVERHEAD = len("gordo-tpu-fleet-config-") + len("-r12345678-999-999-99")
+#: The worst non-project chars any generated name carries. Candidates:
+#: ConfigMap "gordo-tpu-fleet-config-<P>-r<8>-<wf:3>-<shard:2>" = 40, and
+#: builder pod hostname "gordo-fleet-<P>-r<8>-<wf:3>-<shard:2>-<idx:2>" =
+#: 33 — everything must stay within k8s' 63-char name/DNS labels or
+#: kubectl rejects the deploy.
+_NAME_OVERHEAD = max(
+    len("gordo-tpu-fleet-config-") + len("-r12345678-999-99"),
+    len("gordo-fleet-") + len("-r12345678-999-99-99"),
+)
 
 
 def check_project_name_fits(project_name: str) -> None:

@@ -204,47 +204,55 @@ class Client:
         def fetch(machine):
             try:
                 X, _ = self._data_for_window(machine, start, end)
-                # the server parses frames with dataframe_from_dict, so the
-                # body is exactly dataframe_to_dict's wire format
-                return machine.name, dataframe_to_dict(X), None
+                return machine.name, X, None
             except Exception as exc:  # noqa: BLE001 - per-machine isolation
                 msg = f"Failed to fetch data for {machine.name}: {exc}"
                 logger.error(msg)
                 return machine.name, None, msg
 
-        payload: Dict[str, dict] = {}
+        inputs: Dict[str, pd.DataFrame] = {}
         with ThreadPoolExecutor(max_workers=max(1, self.parallelism)) as executor:
-            for name, frame_dict, error in executor.map(fetch, machines):
+            for name, X, error in executor.map(fetch, machines):
                 if error is not None:
                     results[name] = PredictionResult(
                         name=name, predictions=None, error_messages=[error]
                     )
                 else:
-                    payload[name] = frame_dict
+                    inputs[name] = X
 
-        if payload:
+        if inputs:
             # Chunk by rows like predict_single_machine does: one giant
             # body for a long window would blow past proxy limits where
-            # the chunked per-machine path succeeds.
+            # the chunked per-machine path succeeds. Frames are sliced
+            # with .iloc per chunk and serialized to the wire format
+            # (dataframe_to_dict) only for the rows being sent; a machine
+            # that failed server-side drops out of later chunks; a chunk
+            # whose POST exhausts retries records a per-machine error and
+            # the already-scored chunks survive.
             frames_by_name: Dict[str, List[pd.DataFrame]] = {}
             errors_by_name: Dict[str, List[str]] = {}
-            max_rows = max(len(frame_dict[next(iter(frame_dict))]) for frame_dict in payload.values())
+            max_rows = max(len(X) for X in inputs.values())
             for chunk_start in range(0, max_rows, self.batch_size):
-                chunk_payload = {}
-                for name, frame_dict in payload.items():
-                    chunk = {
-                        col: dict(
-                            list(series.items())[
-                                chunk_start : chunk_start + self.batch_size
-                            ]
-                        )
-                        for col, series in frame_dict.items()
-                    }
-                    if next(iter(chunk.values()), None):
-                        chunk_payload[name] = chunk
+                chunk_payload = {
+                    name: dataframe_to_dict(
+                        X.iloc[chunk_start : chunk_start + self.batch_size]
+                    )
+                    for name, X in inputs.items()
+                    if name not in errors_by_name and len(X) > chunk_start
+                }
                 if not chunk_payload:
                     continue
-                body = self._post_fleet_request(chunk_payload)
+                try:
+                    body = self._post_fleet_request(chunk_payload)
+                except Exception as exc:  # noqa: BLE001 - keep partials
+                    msg = (
+                        f"Fleet request for rows {chunk_start}-"
+                        f"{chunk_start + self.batch_size} failed: {exc}"
+                    )
+                    logger.error(msg)
+                    for name in chunk_payload:
+                        errors_by_name.setdefault(name, []).append(msg)
+                    continue
                 for name, entry in body.get("data", {}).items():
                     frame = dataframe_from_dict(entry["model-output"])
                     frame["total-anomaly-unscaled"] = dataframe_from_dict(
@@ -255,7 +263,7 @@ class Client:
                     errors_by_name.setdefault(name, []).append(
                         str(error.get("error"))
                     )
-            for name in payload:
+            for name in inputs:
                 frames = frames_by_name.get(name)
                 results[name] = PredictionResult(
                     name=name,
