@@ -156,7 +156,10 @@ def run_stage(partial: dict, name: str, timeout: int = STAGE_TIMEOUT, retries: i
         last_error = error
         record(error)
         log(f"stage {name}: attempt {attempt + 1} failed: {error}")
-        if "timeout" in error:
+        # Only the harness's OWN kill sentinel means "wedged backend, stop
+        # retrying" — a backend error that merely mentions a timeout (e.g.
+        # 'UNAVAILABLE: connection timeout') is still transient-retryable.
+        if "(stage subprocess killed)" in error:
             break  # wedged backend stays wedged — don't burn more timeouts
         if not any(marker in error for marker in _TRANSIENT_MARKERS):
             break  # deterministic failure; identical retries won't help

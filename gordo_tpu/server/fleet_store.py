@@ -121,8 +121,12 @@ class RevisionFleet:
             try:
                 self.model(name)
                 loaded.append(name)
-            except FileNotFoundError:
-                logger.warning("warm: no model at %s/%s", self.collection_dir, name)
+            except Exception as exc:  # noqa: BLE001 - one bad artifact must
+                # not abort warming the other 99 (same per-machine
+                # isolation as fleet_scores)
+                logger.warning(
+                    "warm: could not load %s/%s: %r", self.collection_dir, name, exc
+                )
         return loaded
 
     # -- fused fleet scoring -------------------------------------------------

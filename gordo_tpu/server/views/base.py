@@ -118,12 +118,22 @@ def post_fleet_prediction(ctx, gordo_project: str):
     if frames:
         scores, score_errors = STORE.fleet(ctx.collection_dir).fleet_scores(frames)
         for name, exc in score_errors.items():
-            # Never echo raw exception text (it can carry server paths);
-            # details are in the server log from fleet_scores' warnings.
+            # Filesystem/internal errors never echo raw text (it can carry
+            # server paths; details live in the server log); client-data
+            # ValueErrors are user-facing messages and do echo, matching
+            # the single-model routes.
             if isinstance(exc, FileNotFoundError):
                 errors[name] = {
                     "error": f"No such model found: '{name}'",
                     "status": 404,
+                }
+            elif isinstance(exc, (ValueError, TypeError)):
+                # client-data problem (e.g. too few rows for a windowed
+                # model) — same ValueError→400 contract as the single-model
+                # prediction and anomaly routes
+                errors[name] = {
+                    "error": f"Scoring failed ({type(exc).__name__}: {exc})",
+                    "status": 400,
                 }
             else:
                 errors[name] = {

@@ -180,3 +180,57 @@ def test_fleet_prediction_broken_model_is_per_machine_error(
         assert body["errors"]["broken-machine"]["status"] == 404
     finally:
         shutil.rmtree(broken_dir, ignore_errors=True)
+
+
+def test_fleet_prediction_value_error_is_400(client, collection_dir, fleet_payload):
+    """A client-data ValueError in scoring (e.g. too few rows for a
+    windowed model) is a per-machine 400, matching the single-model
+    routes' ValueError contract."""
+    import shutil
+
+    from gordo_tpu.builder import local_build
+    from gordo_tpu import serializer
+
+    lstm_dir = f"{collection_dir}/lstm-short"
+    config = """
+    machines:
+      - name: lstm-short
+        model:
+          gordo_tpu.models.JaxLSTMAutoEncoder: {kind: lstm_model, lookback_window: 8, epochs: 1}
+        dataset:
+          type: RandomDataset
+          train_start_date: "2020-01-01T00:00:00+00:00"
+          train_end_date: "2020-01-03T00:00:00+00:00"
+          tag_list: [tag-1, tag-2]
+    """
+    model, machine = next(local_build(config, project_name="test-project"))
+    serializer.dump(model, lstm_dir, metadata=machine.to_dict())
+    try:
+        # 5 rows < lookback 8 → the LSTM's predict raises ValueError
+        index = sorted(next(iter(fleet_payload["machine-2"].values())))[:5]
+        payload = {
+            "lstm-short": {
+                t: {ts: 0.5 for ts in index} for t in ("tag-1", "tag-2")
+            }
+        }
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/prediction/fleet", json={"X": payload}
+        )
+        body = json.loads(resp.data)
+        assert body["errors"]["lstm-short"]["status"] == 400
+        assert "lookback" in body["errors"]["lstm-short"]["error"]
+    finally:
+        shutil.rmtree(lstm_dir, ignore_errors=True)
+
+
+def test_warm_survives_corrupt_artifact(collection_dir, tmp_path):
+    """One truncated pickle must not abort warming the rest."""
+    import shutil
+
+    work = tmp_path / "rev"
+    shutil.copytree(collection_dir, work)
+    (work / "machine-1" / "model.pkl").write_bytes(b"truncated garbage")
+    fleet = RevisionFleet(str(work))
+    loaded = fleet.warm()
+    assert "machine-2" in loaded
+    assert "machine-1" not in loaded
