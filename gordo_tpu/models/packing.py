@@ -8,18 +8,31 @@ Packing G models into block-diagonal weights turns G passes into one:
 ``[B, G·17] @ (G·17, G·13 block-diag)`` fills the tile laterally, so
 throughput scales ~G× until ``G·width`` reaches the 128-lane boundary.
 
+Parameters stay COMPACT: each layer's weights live as ``[G, d_in, d_out]``
+stacks (exactly a vmapped ``init_feedforward``), and the block-diagonal
+``[G·d_in, G·d_out]`` matrix is materialized *inside* the step, only for
+the matmul. This keeps the matmul win without a G× optimizer tax — Adam's
+moments, the gradients it consumes, and every elementwise update touch
+``G·d_in·d_out`` elements, not the ``G²·d_in·d_out`` of a dense packed
+weight. (An earlier dense-parameter formulation lost on real TPUs for
+exactly that reason: these models are so small that training is
+elementwise/HBM-bound, not matmul-bound.)
+
 Per-model math is EXACTLY preserved:
 
-- forward multiplies by ``W * mask`` (mask = the block-diagonal pattern),
-  so cross-model terms are exact float zeros and each model's output
-  matches its unpacked forward to within dot-product summation order;
-- gradients through the mask are zero off the diagonal blocks, so Adam's
-  per-element moments never move there;
+- off-diagonal blocks are structural zeros (built by construction, not
+  masked), so cross-model terms are exact float zeros and each model's
+  output matches its unpacked forward to within dot-product summation
+  order;
+- autodiff through the block-diagonal construction returns gradients in
+  the compact ``[G, d_in, d_out]`` layout — each member's block, nothing
+  else — so per-member gradients equal separate-training gradients;
 - the training loss is the SUM of per-model weighted means (not a mean
   over the concatenated feature axis), so each model's parameter gradients
   equal its separate-training gradients;
-- per-model "empty batch" guards become per-model update masks, keeping
-  the no-op contract of the unpacked engine (models/training.py).
+- per-model "empty batch" guards become per-member update masks over the
+  leading G axis, keeping the no-op contract of the unpacked engine
+  (models/training.py).
 
 The one intentional departure: members of a pack share the per-epoch
 shuffle permutation (one ``jax.random.permutation`` per pack instead of
@@ -90,47 +103,32 @@ def auto_packing(spec: FeedForwardSpec, n_members: int) -> int:
     return max(1, min(g, n_members, 16))
 
 
-@lru_cache(maxsize=None)
-def _block_masks(spec: PackedFeedForwardSpec):
-    """Per layer: (block-diag weight mask, column->member-id vector)."""
-    masks = {}
-    for key, (d_in, d_out) in zip(spec.layer_keys, spec.layer_dims):
-        mask = np.kron(np.eye(spec.g, dtype=np.float32), np.ones((d_in, d_out), np.float32))
-        col_ids = np.repeat(np.arange(spec.g, dtype=np.int32), d_out)
-        masks[key] = (mask, col_ids)
-    return masks
+def _block_diag(W: jnp.ndarray) -> jnp.ndarray:
+    """
+    ``W[G, d_in, d_out] -> [G·d_in, G·d_out]`` with member ``gi``'s matrix
+    on diagonal block ``gi`` and structural zeros elsewhere. Differentiable:
+    the backward pass is the block-extraction, so gradients arrive compact.
+    """
+    g, d_in, d_out = W.shape
+    eye = jnp.eye(g, dtype=W.dtype)
+    # [G(row-block), d_in, G(col-block), d_out] -> flatten pairwise
+    blocks = W[:, :, None, :] * eye[:, None, :, None]
+    return blocks.reshape(g * d_in, g * d_out)
 
 
 def init_packed(member_keys: jnp.ndarray, spec: PackedFeedForwardSpec) -> Params:
     """
-    Packed params from G per-member PRNG keys: each member initializes
-    through the exact ``init_feedforward`` chain (same glorot draws as
-    unpacked training), then lands on its diagonal block.
+    Compact packed params from G per-member PRNG keys: each member
+    initializes through the exact ``init_feedforward`` chain (same glorot
+    draws as unpacked training); leaves carry a leading member axis
+    (``W[G, d_in, d_out]``, ``b[G, d_out]``).
     """
-    per_member = jax.vmap(lambda k: init_feedforward(k, spec.base))(member_keys)
-    packed: Params = {}
-    for key, (d_in, d_out) in zip(spec.layer_keys, spec.layer_dims):
-        W = jnp.zeros((spec.g * d_in, spec.g * d_out), jnp.dtype(spec.base.compute_dtype))
-        for gi in range(spec.g):  # static unroll; G <= 16
-            W = W.at[
-                gi * d_in : (gi + 1) * d_in, gi * d_out : (gi + 1) * d_out
-            ].set(per_member[key]["W"][gi])
-        b = per_member[key]["b"].reshape(spec.g * d_out)
-        packed[key] = {"W": W, "b": b}
-    return packed
+    return jax.vmap(lambda k: init_feedforward(k, spec.base))(member_keys)
 
 
 def unpack_params(packed: Params, spec: PackedFeedForwardSpec, gi: int) -> Params:
-    """Member ``gi``'s standalone param pytree (diagonal block slices)."""
-    out: Params = {}
-    for key, (d_in, d_out) in zip(spec.layer_keys, spec.layer_dims):
-        out[key] = {
-            "W": packed[key]["W"][
-                gi * d_in : (gi + 1) * d_in, gi * d_out : (gi + 1) * d_out
-            ],
-            "b": packed[key]["b"][gi * d_out : (gi + 1) * d_out],
-        }
-    return out
+    """Member ``gi``'s standalone param pytree (leading-axis slice)."""
+    return jax.tree_util.tree_map(lambda leaf: leaf[gi], packed)
 
 
 def forward_packed(
@@ -142,21 +140,18 @@ def forward_packed(
     penalties (L1 over each member's block).
     """
     base = spec.base
-    masks = _block_masks(spec)
     penalties = jnp.zeros((spec.g,), x.dtype)
     h = x
     for i in range(len(base.dims)):
-        key = f"dense_{i}"
-        mask, _ = masks[key]
-        layer = params[key]
-        h = resolve_activation(base.activations[i])(h @ (layer["W"] * mask) + layer["b"])
+        layer = params[f"dense_{i}"]
+        pre = h @ _block_diag(layer["W"]) + layer["b"].reshape(-1)
+        h = resolve_activation(base.activations[i])(pre)
         if base.l1_activity and base.l1_activity[i]:
             per_member = jnp.sum(
                 jnp.abs(h).reshape(h.shape[0], spec.g, base.dims[i]), axis=(0, 2)
             )
             penalties = penalties + base.l1_activity[i] * per_member
-    mask, _ = masks["out"]
-    out = h @ (params["out"]["W"] * mask) + params["out"]["b"]
+    out = h @ _block_diag(params["out"]["W"]) + params["out"]["b"].reshape(-1)
     return resolve_activation(base.out_activation)(out), penalties
 
 
@@ -179,40 +174,19 @@ def _per_model_losses(
     return means, totals
 
 
-def _mask_updates(spec: PackedFeedForwardSpec, tree, has_data: jnp.ndarray):
-    """Zero every member's entries whose batch had no data ([G] bool)."""
-    masks = _block_masks(spec)
-
-    def mask_leaf_dict(key, leaf_dict):
-        _, col_ids = masks[key]
-        member_mask = has_data[col_ids].astype(leaf_dict["b"].dtype)
-        return {
-            "W": leaf_dict["W"] * member_mask[None, :],
-            "b": leaf_dict["b"] * member_mask,
-        }
-
-    return {key: mask_leaf_dict(key, tree[key]) for key in tree}
-
-
-def _walk_opt_state(spec, new, old, has_data):
-    """Structurally walk an optax state, selecting param-shaped leaves per
-    member and letting scalars (counts) advance."""
-    masks = _block_masks(spec)
-    col_ids_by_shape = {}
-    for key, (d_in, d_out) in zip(spec.layer_keys, spec.layer_dims):
-        _, col_ids = masks[key]
-        col_ids_by_shape[(spec.g * d_in, spec.g * d_out)] = col_ids
-        col_ids_by_shape[(spec.g * d_out,)] = col_ids
+def _per_member_select(g: int, new, old, keep: jnp.ndarray):
+    """
+    ``where(keep[member], new, old)`` over every leaf whose leading axis is
+    the member axis (compact params and optimizer moments all carry it);
+    scalar leaves (Adam's shared step count) advance unconditionally.
+    """
 
     def select(new_leaf, old_leaf):
         shape = tuple(np.shape(new_leaf))
-        col_ids = col_ids_by_shape.get(shape)
-        if col_ids is None:
-            return new_leaf  # scalar count etc.
-        keep = has_data[col_ids]
-        if len(shape) == 2:
-            return jnp.where(keep[None, :], new_leaf, old_leaf)
-        return jnp.where(keep, new_leaf, old_leaf)
+        if len(shape) >= 2 and shape[0] == g:
+            cond = keep.reshape((g,) + (1,) * (len(shape) - 1))
+            return jnp.where(cond, new_leaf, old_leaf)
+        return new_leaf
 
     return jax.tree_util.tree_map(select, new, old)
 
@@ -271,9 +245,11 @@ def build_packed_fit_fn(spec: PackedFeedForwardSpec, config):
             # but the shared count still advances for them — the one
             # bias-correction divergence of packed ragged buckets.
             any_data = jnp.any(has_data)
-            updates = _mask_updates(spec, updates, has_data)
             new_params = optax.apply_updates(params, updates)
-            new_opt_state = _walk_opt_state(spec, new_opt_state, opt_state, has_data)
+            new_params = _per_member_select(spec.g, new_params, params, has_data)
+            new_opt_state = _per_member_select(
+                spec.g, new_opt_state, opt_state, has_data
+            )
             params = jax.tree_util.tree_map(
                 lambda n, o: jnp.where(any_data, n, o), new_params, params
             )

@@ -32,6 +32,7 @@ from functools import lru_cache
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
@@ -123,6 +124,11 @@ def _fill_weight_row(wtr, wval, i, n, member, config: FitConfig):
         wval[i, : len(member.val_weights)] = member.val_weights
 
 
+#: jit'd ravel+concat of same-dtype leaves: turns a many-leaf pytree fetch
+#: into one contiguous device buffer, so the host sees ONE transfer.
+_flat_concat = jax.jit(lambda *leaves: jnp.concatenate([l.ravel() for l in leaves]))
+
+
 def fetch_to_host(tree):
     """
     Device arrays → host numpy, multi-host safe: results of the sharded
@@ -130,6 +136,15 @@ def fetch_to_host(tree):
     fetch non-addressable shards — each process instead all-gathers the
     global value (one collective over ICI/DCN, symmetric across the SPMD
     processes). Single-process runs keep the plain ``device_get`` path.
+
+    Single-process fetches of multi-leaf pytrees are COALESCED: every
+    same-dtype leaf is raveled and concatenated on-device (one fused XLA
+    program), fetched as one contiguous buffer, and sliced back on the
+    host. Device→host readback pays a fixed per-transfer latency (PCIe
+    round trip; ~70ms through a remote-accelerator tunnel), so fetching a
+    fleet's params/losses/epoch-counters as 11+ separate arrays costs 11
+    round trips where one or two suffice — this was 90% of measured fleet
+    training wall-clock on a tunneled TPU v5e.
     """
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
@@ -137,7 +152,27 @@ def fetch_to_host(tree):
         # tiled=True is the only mode for global arrays (and for them it
         # just means "replicate the global value", no reshaping).
         return multihost_utils.process_allgather(tree, tiled=True)
-    return jax.device_get(tree)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if len(leaves) <= 1 or not all(isinstance(l, jax.Array) for l in leaves):
+        return jax.device_get(tree)
+    by_dtype: Dict[Any, List[int]] = {}
+    for idx, leaf in enumerate(leaves):
+        by_dtype.setdefault(leaf.dtype, []).append(idx)
+    host_leaves: List[Any] = [None] * len(leaves)
+    for idxs in by_dtype.values():
+        group = [leaves[i] for i in idxs]
+        flat = np.asarray(_flat_concat(*group))
+        offset = 0
+        for i, leaf in zip(idxs, group):
+            size = leaf.size
+            # copy: a view would pin the whole coalesced buffer for as
+            # long as any one leaf lives (e.g. one member's params kept in
+            # a FleetResult would retain every pack's)
+            host_leaves[i] = (
+                flat[offset : offset + size].reshape(leaf.shape).copy()
+            )
+            offset += size
+    return jax.tree_util.tree_unflatten(treedef, host_leaves)
 
 
 def host_prng_keys(seeds: Sequence[int]) -> np.ndarray:
@@ -555,9 +590,9 @@ class FleetTrainer:
             params, opt_state, X_dev, y_dev, wtr_dev, X_dev, y_dev, wval_dev, fit_rngs
         )
 
-        host_params = fetch_to_host(params)
-        losses = np.asarray(fetch_to_host(losses))
-        val_losses = np.asarray(fetch_to_host(val_losses))
+        host_params, losses, val_losses = fetch_to_host((params, losses, val_losses))
+        losses = np.asarray(losses)
+        val_losses = np.asarray(val_losses)
 
         results = []
         steps = n_padded // config.batch_size
@@ -682,10 +717,12 @@ class FleetTrainer:
     def _collect_results(
         self, bucket, params, losses, val_losses, epochs_ran, config, steps
     ) -> List[FleetResult]:
-        host_params = fetch_to_host(params)
-        losses = np.asarray(fetch_to_host(losses))
-        val_losses = np.asarray(fetch_to_host(val_losses))
-        epochs_ran = np.asarray(fetch_to_host(epochs_ran))
+        host_params, losses, val_losses, epochs_ran = fetch_to_host(
+            (params, losses, val_losses, epochs_ran)
+        )
+        losses = np.asarray(losses)
+        val_losses = np.asarray(val_losses)
+        epochs_ran = np.asarray(epochs_ran)
 
         results = []
         for i, member in enumerate(bucket):

@@ -63,6 +63,15 @@ def _host_transform(model: Any, X):
     return np.asarray(X, np.float32)
 
 
+class ModelLoadError(Exception):
+    """
+    A model artifact failed to LOAD (as opposed to failing to score a
+    request). Routes must not echo the underlying cause — load errors are
+    server-side and their text can carry filesystem paths; the cause is
+    chained for the server log only.
+    """
+
+
 class RevisionFleet:
     """
     All models of one revision directory, loaded lazily but retained for
@@ -189,9 +198,13 @@ class RevisionFleet:
             try:
                 self.model(name)  # ensure loaded + bucketed
                 loadable.append(name)
+            except FileNotFoundError as exc:
+                errors[name] = exc
             except Exception as exc:  # noqa: BLE001 - per-machine isolation
                 logger.warning("fleet_scores: could not load %s: %r", name, exc)
-                errors[name] = exc
+                load_error = ModelLoadError(name)
+                load_error.__cause__ = exc
+                errors[name] = load_error
 
         specs = self.loaded_specs()
         by_spec: Dict[Any, List[str]] = {}

@@ -84,7 +84,7 @@ def post_fleet_prediction(ctx, gordo_project: str):
     """
     from types import SimpleNamespace
 
-    from ..fleet_store import STORE
+    from ..fleet_store import STORE, ModelLoadError
 
     request = ctx.request
     body = request.get_json(silent=True) if request.is_json else None
@@ -127,12 +127,25 @@ def post_fleet_prediction(ctx, gordo_project: str):
                     "error": f"No such model found: '{name}'",
                     "status": 404,
                 }
-            elif isinstance(exc, (ValueError, TypeError)):
-                # client-data problem (e.g. too few rows for a windowed
-                # model) — same ValueError→400 contract as the single-model
-                # prediction and anomaly routes
+            elif isinstance(exc, ModelLoadError):
                 errors[name] = {
-                    "error": f"Scoring failed ({type(exc).__name__}: {exc})",
+                    "error": "Model could not be loaded",
+                    "status": 500,
+                }
+            elif isinstance(exc, ValueError):
+                # client-data problem (e.g. too few rows for a windowed
+                # model) — same ValueError→400 echo contract as the
+                # single-model prediction and anomaly routes
+                errors[name] = {
+                    "error": f"Scoring failed (ValueError: {exc})",
+                    "status": 400,
+                }
+            elif isinstance(exc, TypeError):
+                # likely client data, but the text may describe server
+                # internals — generic message, like the single-model routes
+                errors[name] = {
+                    "error": "Something unexpected happened; "
+                    "check your input data",
                     "status": 400,
                 }
             else:

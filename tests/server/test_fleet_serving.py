@@ -182,6 +182,34 @@ def test_fleet_prediction_broken_model_is_per_machine_error(
         shutil.rmtree(broken_dir, ignore_errors=True)
 
 
+def test_fleet_prediction_corrupt_artifact_is_generic_500(
+    client, collection_dir, fleet_payload
+):
+    """A model.pkl that fails to DESERIALIZE is a server-side problem: the
+    per-machine error must be generic (load-error text can carry server
+    paths) with status 500, while the rest of the batch still scores."""
+    import shutil
+
+    corrupt_dir = f"{collection_dir}/corrupt-machine"
+    shutil.copytree(f"{collection_dir}/machine-2", corrupt_dir)
+    try:
+        with open(f"{corrupt_dir}/model.pkl", "wb") as f:
+            f.write(b"not a pickle at all")
+        payload = {**fleet_payload, "corrupt-machine": fleet_payload["machine-2"]}
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/prediction/fleet", json={"X": payload}
+        )
+        assert resp.status_code == 200
+        body = json.loads(resp.data)
+        assert set(body["data"]) == {"machine-1", "machine-2"}
+        err = body["errors"]["corrupt-machine"]
+        assert err["status"] == 500
+        assert err["error"] == "Model could not be loaded"
+        assert "corrupt-machine" not in err["error"]  # no paths, no details
+    finally:
+        shutil.rmtree(corrupt_dir, ignore_errors=True)
+
+
 def test_fleet_prediction_value_error_is_400(client, collection_dir, fleet_payload):
     """A client-data ValueError in scoring (e.g. too few rows for a
     windowed model) is a per-machine 400, matching the single-model

@@ -98,3 +98,5 @@ def test_failing_stage_yields_partial_artifact(tmp_path):
     # parseable record either way
     lines = [line for line in proc.stdout.splitlines() if line.strip()]
     assert json.loads(lines[-1])["metric"] == "autoencoders_trained_per_hour"
+    # rc is non-zero: nothing produced a usable number
+    assert proc.returncode != 0
