@@ -516,20 +516,21 @@ class FleetBuilder:
             weights[: max(boundary - plan.offset, 0)] = 1.0
         return weights
 
-    def _predictions_for_rows(
-        self, plan: _Plan, prediction: np.ndarray, rows: np.ndarray
-    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Map row indices to (y_true, y_pred, target_rows) honoring the
-        window offset."""
+    def _test_window_rows(
+        self, plan: _Plan, rows: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Fold-test row indices → (window indices to predict, target rows)
+        honoring the window offset. Only these windows are staged and
+        forwarded — a fold's test split is ~1/(n_folds+1) of the series,
+        so predicting all windows would move ~4× the data both ways."""
         if plan.offset == 0:
-            rows = rows[rows < len(prediction)]
-            return plan.y_arr[rows], prediction[rows], rows
+            rows = rows[rows < plan.n_windows]
+            return rows, rows
         # contiguous test [b, c) → window indices [b, c - offset)
         b, c = int(rows[0]), int(rows[-1]) + 1
         window_idx = np.arange(b, max(c - plan.offset, b))
-        window_idx = window_idx[window_idx < len(prediction)]
-        target_rows = window_idx + plan.offset
-        return plan.y_arr[target_rows], prediction[window_idx], target_rows
+        window_idx = window_idx[window_idx < plan.n_windows]
+        return window_idx, window_idx + plan.offset
 
     _SCORING_BATCH = 256  # windowed scoring scan batch (bounds HBM)
 
@@ -548,22 +549,27 @@ class FleetBuilder:
             stacked = stack_member_params(
                 [by_name[p.machine.name] for p in group]
             )
+            fold_rows = []  # per plan: (train_rows, window_idx, target_rows)
+            for plan in group:
+                train_rows, test_rows = per_plan_folds[plan.machine.name][fold_idx]
+                window_idx, target_rows = self._test_window_rows(plan, test_rows)
+                fold_rows.append((train_rows, window_idx, target_rows))
             if geometry == ("windowed",):
-                predictions = self._predict_windowed_group(spec, stacked, group)
+                predictions = self._predict_windowed_group(
+                    spec, stacked, group, [wi for _, wi, _ in fold_rows]
+                )
             else:
-                n_max = max(len(p.windows) for p in group)
+                n_max = max(len(wi) for _, wi, _ in fold_rows)
                 X = np.zeros(
                     (len(group), n_max) + group[0].windows.shape[1:], np.float32
                 )
                 for i, p in enumerate(group):
-                    X[i, : len(p.windows)] = p.windows
+                    X[i, : len(fold_rows[i][1])] = p.windows[fold_rows[i][1]]
                 predictions = self.trainer.predict_bucket(spec, stacked, X)
             for i, plan in enumerate(group):
-                prediction = predictions[i, : plan.n_windows]
-                train_rows, test_rows = per_plan_folds[plan.machine.name][fold_idx]
-                y_true, y_pred, target_rows = self._predictions_for_rows(
-                    plan, prediction, test_rows
-                )
+                train_rows, window_idx, target_rows = fold_rows[i]
+                y_true = plan.y_arr[target_rows]
+                y_pred = predictions[i, : len(window_idx)]
                 state = fold_state[plan.machine.name]
                 state.setdefault("folds", []).append((y_true, y_pred))
                 self._accumulate_metric_scores(plan, y_true, y_pred, fold_idx)
@@ -574,11 +580,19 @@ class FleetBuilder:
                         test_rows=target_rows,
                     )
 
-    def _predict_windowed_group(self, spec, stacked, group: List[_Plan]) -> np.ndarray:
-        """Chronological predictions for windowed plans, windows gathered on
-        device (scan over _SCORING_BATCH-window batches), model-axis
-        sharded over the trainer's mesh like the dense scoring path."""
-        nv_max = max(p.n_windows for p in group)
+    def _predict_windowed_group(
+        self,
+        spec,
+        stacked,
+        group: List[_Plan],
+        window_idx: List[np.ndarray],
+    ) -> np.ndarray:
+        """Predictions for windowed plans, windows gathered on device (scan
+        over _SCORING_BATCH-window batches), model-axis sharded over the
+        trainer's mesh like the dense scoring path. ``window_idx`` gives
+        each plan's window positions to predict (the fold-test windows)."""
+        orders = window_idx
+        nv_max = max(len(o) for o in orders)
         n_series_max = max(len(p.X_arr) for p in group)
         series = np.zeros(
             (len(group), n_series_max, group[0].X_arr.shape[1]), np.float32
@@ -586,7 +600,7 @@ class FleetBuilder:
         order = np.zeros((len(group), nv_max), np.int32)
         for i, p in enumerate(group):
             series[i, : len(p.X_arr)] = p.X_arr
-            order[i, : p.n_windows] = np.arange(p.n_windows)
+            order[i, : len(orders[i])] = orders[i]
         return self.trainer.predict_windowed_bucket(
             spec, stacked, series, order, batch_size=self._SCORING_BATCH
         )

@@ -199,6 +199,7 @@ class RevisionFleet:
                 self.model(name)  # ensure loaded + bucketed
                 loadable.append(name)
             except FileNotFoundError as exc:
+                logger.warning("fleet_scores: could not load %s: %r", name, exc)
                 errors[name] = exc
             except Exception as exc:  # noqa: BLE001 - per-machine isolation
                 logger.warning("fleet_scores: could not load %s: %r", name, exc)

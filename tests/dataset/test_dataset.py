@@ -108,3 +108,88 @@ def test_provider_deterministic_per_tag():
     s1 = list(provider.load_series(t0, t1, ["x"]))[0]
     s2 = list(provider.load_series(t0, t1, ["x"]))[0]
     pd.testing.assert_series_equal(s1, s2)
+
+
+def _ragged_series():
+    """Three tags with different spans, irregular stamps, interior gaps
+    (empty resample bins), and duplicated values around bin edges."""
+    rng = np.random.RandomState(5)
+    idx_a = pd.date_range("2020-01-01 00:03", "2020-01-03 23:00", freq="7min", tz="UTC")
+    idx_b = pd.date_range("2020-01-01 12:00", "2020-01-04 12:00", freq="13min", tz="UTC")
+    idx_c = pd.date_range("2020-01-02 02:30", "2020-01-03 11:00", freq="1min", tz="UTC")
+    a = pd.Series(rng.rand(len(idx_a)), index=idx_a, name="rg-a")
+    b = pd.Series(rng.rand(len(idx_b)), index=idx_b, name="rg-b")
+    # carve an interior gap into c: its 10min resample gets NaN bins
+    c = pd.Series(rng.rand(len(idx_c)), index=idx_c, name="rg-c")
+    c = c[(c.index < "2020-01-02 20:00") | (c.index > "2020-01-03 04:00")]
+    return [a, b, c]
+
+
+def _build(series, **kwargs):
+    return TimeSeriesDataset(
+        "2020-01-01T00:00:00+00:00",
+        "2020-01-05T00:00:00+00:00",
+        tag_list=[s.name for s in series],
+        data_provider=ListBackedDataProvider(series=series),
+        **kwargs,
+    )
+
+
+def test_fast_resample_path_matches_per_series_path():
+    """The one-pass frame resample (_resample_joined) must reproduce the
+    per-series resample + inner join exactly: ragged spans, interior empty
+    bins and irregular stamps included."""
+    series = _ragged_series()
+    ds = _build(series)
+    fast = ds._load_and_join()
+
+    slow_ds = _build(series)
+    slow_ds._resample_joined = lambda _: (_ for _ in ()).throw(ValueError("off"))
+    slow = slow_ds._load_and_join()
+    pd.testing.assert_frame_equal(fast, slow)
+
+
+def test_fast_resample_path_skipped_for_non_day_dividing_resolution():
+    """A resolution that does not divide a day (e.g. 7min) must take the
+    per-series path: resample origins are per-series midnights, so the
+    frame fast path would not be bin-exact."""
+    series = _ragged_series()
+    ds = _build(series, resolution="7min")
+    called = {}
+
+    def boom(_):
+        called["fast"] = True
+        raise AssertionError("fast path must not run for 7min resolution")
+
+    ds._resample_joined = boom
+    data = ds._load_and_join()
+    assert not called
+    # (the result itself is empty here: per-series 7min bins anchor to each
+    # series' own first midnight, 1440 % 7 != 0 misaligns the labels and the
+    # inner join drops everything — exactly the divergence the gate guards)
+    assert list(data.columns) == ["rg-a", "rg-b", "rg-c"]
+
+
+def test_multiple_aggregation_methods_unchanged():
+    series = _ragged_series()
+    ds = _build(series, aggregation_methods=["mean", "max"])
+    data = ds._load_and_join()
+    assert any(col.endswith("_mean") for col in data.columns)
+    assert any(col.endswith("_max") for col in data.columns)
+
+
+def test_sum_aggregation_takes_per_series_path():
+    """'sum' turns all-NaN bins into 0, which would defeat the fast path's
+    span trim and fabricate zero rows for out-of-span tags — it must use
+    the per-series path (review finding: 504 fabricated vs 196 real rows)."""
+    series = _ragged_series()
+    ds = _build(series, aggregation_methods="sum")
+
+    def boom(_):
+        raise AssertionError("fast path must not run for sum aggregation")
+
+    ds._resample_joined = boom
+    data = ds._load_and_join()
+    # inner-join semantics: rows only inside the intersection of tag spans
+    assert data.index.min() >= pd.Timestamp("2020-01-02 02:00", tz="UTC")
+    assert data.index.max() <= pd.Timestamp("2020-01-03 11:00", tz="UTC")
