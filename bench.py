@@ -289,9 +289,19 @@ def fleet_train() -> dict:
     # would leave XLA compilation inside the measured section.
     trainer.train(members, config)
 
-    start = time.time()
-    results = trainer.train(members, config)
-    elapsed = time.time() - start
+    def timed_best(t, n=3):
+        """Best of n timed runs: tunneled-accelerator transfer latency
+        varies ±50% run to run, so a single sample misreports the engine."""
+        best, results = None, None
+        for _ in range(n):
+            start = time.time()
+            r = t.train(members, config)
+            dt = time.time() - start
+            if best is None or dt < best:
+                best, results = dt, r
+        return best, results
+
+    elapsed, results = timed_best(trainer)
 
     losses = [r.history.history["loss"][-1] for r in results]
     assert all(np.isfinite(losses)), "non-finite training losses"
@@ -308,9 +318,7 @@ def fleet_train() -> dict:
             packing=packing if packing == "auto" else int(packing)
         )
         packed_trainer.train(members, config)  # warmup/compile
-        start = time.time()
-        packed_results = packed_trainer.train(members, config)
-        packed_elapsed = time.time() - start
+        packed_elapsed, packed_results = timed_best(packed_trainer)
         packed_losses = [r.history.history["loss"][-1] for r in packed_results]
         assert all(np.isfinite(packed_losses)), "non-finite packed losses"
 

@@ -131,7 +131,7 @@ class ModelBuilder:
         """Train: fetch data → build model → CV → fit → metadata."""
         self.set_seed(seed=1337)
 
-        machine = Machine.from_dict(self.machine.to_dict())
+        machine = self.machine.copy()
 
         # Fetch data (the IO hot spot; duration recorded as
         # query_duration_sec — reference build_model.py:208-215)

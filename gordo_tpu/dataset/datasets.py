@@ -263,8 +263,14 @@ class TimeSeriesDataset(GordoBaseDataset):
         raw = pd.concat(series_list, axis=1, sort=True)
         raw.columns = [s.name for s in series_list]
         data = raw.resample(self.resolution).agg(self.aggregation_methods)
-        start = max(data[c].first_valid_index() for c in data.columns)
-        end = min(data[c].last_valid_index() for c in data.columns)
+        # Trim by bin LABELS of each series' observed span (floor is
+        # midnight-anchored like resample's origin for day-dividing
+        # resolutions) — not by first/last valid aggregated values: a
+        # boundary bin can legitimately aggregate to NaN (std of a single
+        # observation, NaN-valued raw samples) and must still be kept,
+        # exactly as the per-series inner join keeps it.
+        start = max(s.index.min().floor(self.resolution) for s in series_list)
+        end = min(s.index.max().floor(self.resolution) for s in series_list)
         return data.loc[start:end]
 
     def _apply_filters(self, data: pd.DataFrame) -> pd.DataFrame:

@@ -10,6 +10,7 @@ block), JSON/YAML round-trips, and ``report()`` running configured
 reporters.
 """
 
+import copy
 import json
 import logging
 from typing import Any, Dict, Optional
@@ -140,6 +141,29 @@ class Machine:
             evaluation=config.get("evaluation"),
             metadata=config.get("metadata"),
             runtime=config.get("runtime"),
+        )
+
+    def copy(self) -> "Machine":
+        """
+        Independent Machine for attaching build results without touching
+        the caller's object. The dataset is rebuilt from its config dict —
+        a live dataset's data provider can hold loaded source frames
+        (e.g. ``FileDataProvider``'s wide-frame cache), which must not be
+        duplicated into every build result — while metadata and the plain
+        config dicts are deep-copied directly, skipping the ~20ms-per-
+        machine dataclasses_json serialize/parse round trip of
+        ``from_dict(to_dict())``.
+        """
+        return Machine(
+            name=self.name,
+            model=copy.deepcopy(self.model),
+            dataset=self.dataset.to_dict()
+            if isinstance(self.dataset, GordoBaseDataset)
+            else copy.deepcopy(self.dataset),
+            project_name=self.project_name,
+            evaluation=copy.deepcopy(self.evaluation),
+            metadata=copy.deepcopy(self.metadata),
+            runtime=copy.deepcopy(self.runtime),
         )
 
     # -- serialization ------------------------------------------------------

@@ -809,7 +809,7 @@ class FleetBuilder:
     # ------------------------------------------------------------- assembly
 
     def _assemble(self, plan: _Plan) -> Tuple[Any, Machine]:
-        machine = Machine.from_dict(plan.machine.to_dict())
+        machine = plan.machine.copy()
         machine.metadata.build_metadata = BuildMetadata(
             model=ModelBuildMetadata(
                 model_offset=plan.offset,

@@ -198,14 +198,14 @@ class RevisionFleet:
             try:
                 self.model(name)  # ensure loaded + bucketed
                 loadable.append(name)
-            except FileNotFoundError as exc:
-                logger.warning("fleet_scores: could not load %s: %r", name, exc)
-                errors[name] = exc
             except Exception as exc:  # noqa: BLE001 - per-machine isolation
                 logger.warning("fleet_scores: could not load %s: %r", name, exc)
-                load_error = ModelLoadError(name)
-                load_error.__cause__ = exc
-                errors[name] = load_error
+                if isinstance(exc, FileNotFoundError):
+                    errors[name] = exc  # routes map it to a plain 404
+                else:
+                    load_error = ModelLoadError(name)
+                    load_error.__cause__ = exc
+                    errors[name] = load_error
 
         specs = self.loaded_specs()
         by_spec: Dict[Any, List[str]] = {}

@@ -193,3 +193,30 @@ def test_sum_aggregation_takes_per_series_path():
     # inner-join semantics: rows only inside the intersection of tag spans
     assert data.index.min() >= pd.Timestamp("2020-01-02 02:00", tz="UTC")
     assert data.index.max() <= pd.Timestamp("2020-01-03 11:00", tz="UTC")
+
+
+@pytest.mark.parametrize("agg", ["mean", "std", "max"])
+def test_fast_resample_path_matches_with_nan_boundary_bins(agg):
+    """Boundary bins that aggregate to NaN (std of a single observation,
+    NaN-valued raw samples at a span edge) must still be trimmed by span
+    LABELS, exactly like the per-series inner join (review finding: a
+    value-based trim dropped such bins and shifted interpolation)."""
+    rng = np.random.RandomState(9)
+    # tag with exactly ONE observation in its first bin -> std ddof=1 = NaN
+    idx_a = pd.DatetimeIndex(
+        [pd.Timestamp("2020-01-01 00:09", tz="UTC")]
+    ).append(pd.date_range("2020-01-01 00:10", "2020-01-02 12:00", freq="3min", tz="UTC"))
+    a = pd.Series(rng.rand(len(idx_a)), index=idx_a, name="nb-a")
+    # tag with NaN raw values covering its entire first in-span bin
+    idx_b = pd.date_range("2020-01-01 00:00", "2020-01-02 18:00", freq="4min", tz="UTC")
+    vals_b = rng.rand(len(idx_b))
+    vals_b[:3] = np.nan
+    b = pd.Series(vals_b, index=idx_b, name="nb-b")
+    series = [a, b]
+
+    ds = _build(series, aggregation_methods=agg)
+    fast = ds._load_and_join()
+    slow_ds = _build(series, aggregation_methods=agg)
+    slow_ds._resample_joined = lambda _: (_ for _ in ()).throw(ValueError("off"))
+    slow = slow_ds._load_and_join()
+    pd.testing.assert_frame_equal(fast, slow)

@@ -103,3 +103,66 @@ def test_missing_model_raises():
 def test_missing_project_name_raises():
     with pytest.raises(ValueError):
         Machine.from_config({"name": "m", "model": MODEL_DEF, "dataset": dict(DATASET_DEF)})
+
+
+def test_copy_is_independent_and_cache_free():
+    """Machine.copy(): build results must not share mutable state with the
+    caller's Machine, and a live dataset's provider caches (e.g.
+    FileDataProvider's loaded wide frame) must not be duplicated into the
+    copy — the dataset is rebuilt from config."""
+    machine = Machine.from_config(
+        {
+            "name": "copy-src",
+            "model": {"gordo_tpu.models.JaxAutoEncoder": {"kind": "feedforward_model"}},
+            "dataset": {
+                "type": "RandomDataset",
+                "train_start_date": "2020-01-01T00:00:00+00:00",
+                "train_end_date": "2020-01-02T00:00:00+00:00",
+                "tag_list": ["cp-a", "cp-b"],
+            },
+        },
+        project_name="copy-proj",
+    )
+    machine.dataset.get_data()  # populate any lazy per-dataset state
+    clone = machine.copy()
+    assert clone is not machine
+    assert clone.dataset is not machine.dataset
+    assert clone.metadata is not machine.metadata
+    # dataset was rebuilt from config, not carried over as the live object
+    assert clone.dataset.to_dict() == machine.dataset.to_dict()
+    # mutating the clone's metadata must not leak back
+    clone.metadata.user_defined["machine-metadata"] = {"x": 1}
+    assert machine.metadata.user_defined.get("machine-metadata") != {"x": 1}
+
+
+def test_copy_strips_file_provider_frame_cache(tmp_path):
+    """A FileDataProvider that has loaded its source must copy WITHOUT the
+    cached frame (review finding: deepcopy duplicated multi-MB frames into
+    every build result)."""
+    import numpy as np
+    import pandas as pd
+
+    idx = pd.date_range("2020-01-01", periods=200, freq="10min", tz="UTC")
+    frame = pd.DataFrame(
+        {"fp-a": np.arange(200.0), "fp-b": np.ones(200)}, index=idx
+    )
+    path = tmp_path / "data.parquet"
+    frame.to_parquet(path)
+    machine = Machine.from_config(
+        {
+            "name": "copy-file",
+            "model": {"gordo_tpu.models.JaxAutoEncoder": {"kind": "feedforward_model"}},
+            "dataset": {
+                "type": "TimeSeriesDataset",
+                "train_start_date": "2020-01-01T00:00:00+00:00",
+                "train_end_date": "2020-01-03T00:00:00+00:00",
+                "tag_list": ["fp-a", "fp-b"],
+                "data_provider": {"type": "FileDataProvider", "path": str(path)},
+            },
+        },
+        project_name="copy-proj",
+    )
+    machine.dataset.get_data()  # loads + caches the wide frame
+    assert machine.dataset.data_provider._wide_frame is not None
+    clone = machine.copy()
+    assert clone.dataset.data_provider._wide_frame is None
