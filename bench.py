@@ -1,7 +1,7 @@
 """
 Headline benchmark: autoencoders trained per hour (BASELINE.json metric).
 
-Three stages, each with its own timeout, transient-error retry, and a
+Four stages, each with its own timeout, transient-error retry, and a
 partial-result artifact written after every stage so an environment flake
 can never zero the whole run:
 
@@ -16,7 +16,10 @@ can never zero the whole run:
    DiffBased threshold math, final fit, artifact dump
    (parallel/fleet_build.py). This is the `build-fleet` CLI path the
    north-star target is defined on (BASELINE.md: 1000 AEs < 10 min).
-3. **reference baseline** — the reference engine's cost measured
+3. **lstm-fleet-train** — BASELINE.json parity configs #3/#4: 50-tag
+   sliding-window LSTM autoencoder and forecast fleets with on-device
+   window gathering. Rates land in the final line's extras.
+4. **reference baseline** — the reference engine's cost measured
    directly: the same architecture / optimizer / batch size / epochs
    trained with Keras/TF2 on CPU (the reference trains every model with
    CPU Keras inside its per-model k8s pod — SURVEY.md §2.9, BASELINE.md).
@@ -27,9 +30,11 @@ Prints ONE JSON line:
 
 Env knobs: BENCH_MODELS (default 256), BENCH_E2E_MODELS (default
 BENCH_MODELS), BENCH_EPOCHS (20), BENCH_SAMPLES (1440), BENCH_TAGS (20),
-BENCH_STAGE_TIMEOUT seconds (default 1500), BENCH_SKIP_TF_BASELINE=1 to
-reuse/skip the Keras measurement (cached in .bench_baseline.json),
-BENCH_SKIP_E2E=1 to skip stage 2.
+BENCH_LSTM_MODELS (64), BENCH_LSTM_TAGS (50), BENCH_LSTM_LOOKBACK (60),
+BENCH_LSTM_EPOCHS (5), BENCH_STAGE_TIMEOUT seconds (default 1500),
+BENCH_SKIP_TF_BASELINE=1 to reuse/skip the Keras measurement (cached in
+.bench_baseline.json), BENCH_SKIP_E2E=1 to skip stage 2,
+BENCH_SKIP_LSTM=1 to skip stage 3.
 """
 
 import json
@@ -48,6 +53,11 @@ N_EPOCHS = int(os.environ.get("BENCH_EPOCHS", 20))
 N_SAMPLES = int(os.environ.get("BENCH_SAMPLES", 1440))  # 10 days @ 10min
 N_TAGS = int(os.environ.get("BENCH_TAGS", 20))
 BATCH = 64
+# LSTM stage (BASELINE.json parity configs #3/#4: 50-tag sliding window)
+N_LSTM_MODELS = int(os.environ.get("BENCH_LSTM_MODELS", 64))
+LSTM_TAGS = int(os.environ.get("BENCH_LSTM_TAGS", 50))
+LSTM_LOOKBACK = int(os.environ.get("BENCH_LSTM_LOOKBACK", 60))
+LSTM_EPOCHS = int(os.environ.get("BENCH_LSTM_EPOCHS", 5))
 STAGE_TIMEOUT = int(os.environ.get("BENCH_STAGE_TIMEOUT", 1500))
 _HERE = os.path.dirname(os.path.abspath(__file__))
 BASELINE_CACHE = os.path.join(_HERE, ".bench_baseline.json")
@@ -171,7 +181,11 @@ def run_stage(partial: dict, name: str, timeout: int = STAGE_TIMEOUT, retries: i
     )
     # Only the JAX stages have an accelerator to fall back FROM; re-running
     # the pure-TF reference stage with BENCH_FORCE_CPU would change nothing.
-    if backend_shaped and name in ("fleet_train", "fleet_build_e2e"):
+    if backend_shaped and name in (
+        "fleet_train",
+        "fleet_build_e2e",
+        "lstm_fleet_train",
+    ):
         log(f"stage {name}: accelerator path failed; labeled CPU fallback")
         result, error = _run_stage_subprocess(name, timeout, force_cpu=True)
         if result is not None:
@@ -461,6 +475,76 @@ def fleet_build_e2e() -> dict:
     }
 
 
+# -- stage 2b: LSTM fleet (parity configs #3/#4) ----------------------------
+
+
+@stage
+def lstm_fleet_train() -> dict:
+    """
+    BASELINE.json parity configs #3 (LSTM AE) and #4 (LSTM forecast):
+    50-tag sliding-window fleets trained with on-device window gathering
+    (WindowedFleetMember — the raw series stays device-resident; windows
+    are gathered per batch inside the fused program).
+    """
+    from gordo_tpu.models.factories import lstm_model
+    from gordo_tpu.models.training import FitConfig
+    from gordo_tpu.ops.windows import window_targets
+    from gordo_tpu.parallel import FleetTrainer, WindowedFleetMember
+
+    _setup_jax_cache()
+
+    # shuffle=False: the product LSTM path pins it (estimators.py — the
+    # reference fits its timeseries generator unshuffled), so the bench
+    # must time the same compiled program the product runs.
+    config = FitConfig(epochs=LSTM_EPOCHS, batch_size=BATCH, shuffle=False)
+    rng = np.random.RandomState(0)
+    series = [
+        rng.rand(N_SAMPLES, LSTM_TAGS).astype(np.float32)
+        for _ in range(N_LSTM_MODELS)
+    ]
+
+    def members(lookahead: int):
+        # the spec carries lookback only; lookahead lives in the targets
+        # alignment (ops.windows.window_targets)
+        spec = lstm_model(LSTM_TAGS, lookback_window=LSTM_LOOKBACK)
+        return [
+            WindowedFleetMember(
+                name=f"lstm{i}",
+                spec=spec,
+                series=X,
+                targets=window_targets(X, LSTM_LOOKBACK, lookahead),
+                seed=i,
+            )
+            for i, X in enumerate(series)
+        ]
+
+    trainer = FleetTrainer()
+    rates = {}
+    for key, lookahead in (("lstm_ae", 0), ("lstm_forecast", 1)):
+        fleet = members(lookahead)
+        trainer.train(fleet, config)  # warmup/compile
+        start = time.time()
+        results = trainer.train(fleet, config)
+        elapsed = time.time() - start
+        losses = [r.history.history["loss"][-1] for r in results]
+        assert all(np.isfinite(losses)), f"non-finite {key} losses"
+        rates[key] = N_LSTM_MODELS / (elapsed / 3600.0)
+        log(
+            f"{key}: {N_LSTM_MODELS} x {LSTM_TAGS}-tag lookback-"
+            f"{LSTM_LOOKBACK} models, {LSTM_EPOCHS} epochs in {elapsed:.2f}s "
+            f"-> {rates[key]:.0f} models/hour"
+        )
+    return {
+        "lstm_ae_models_per_hour": round(rates["lstm_ae"], 1),
+        "lstm_forecast_models_per_hour": round(rates["lstm_forecast"], 1),
+        "n_models": N_LSTM_MODELS,
+        "tags": LSTM_TAGS,
+        "lookback": LSTM_LOOKBACK,
+        "epochs": LSTM_EPOCHS,
+        "device": _device_desc(),
+    }
+
+
 # -- stage 3: reference Keras baseline -------------------------------------
 
 
@@ -512,6 +596,7 @@ def _emit_result(partial: dict) -> int:
     flush the partial artifact, and return the exit code."""
     fleet = partial.get("fleet_train")
     e2e = partial.get("fleet_build_e2e")
+    lstm = partial.get("lstm_fleet_train")
     reference = partial.get("reference_keras")
 
     # Headline = bare fleet throughput; fall back to the e2e number rather
@@ -537,7 +622,13 @@ def _emit_result(partial: dict) -> int:
             ),
             "e2e_elapsed_s": e2e["elapsed_s"] if e2e else None,
             "e2e_n_machines": e2e["n_machines"] if e2e else None,
-            "device": (fleet or e2e or {}).get("device"),
+            "lstm_ae_models_per_hour": (
+                lstm["lstm_ae_models_per_hour"] if lstm else None
+            ),
+            "lstm_forecast_models_per_hour": (
+                lstm["lstm_forecast_models_per_hour"] if lstm else None
+            ),
+            "device": (fleet or e2e or lstm or {}).get("device"),
             "errors": {
                 k: v
                 for k, v in partial.items()
@@ -573,6 +664,8 @@ def main():
     run_stage(partial, "fleet_train")
     if not os.environ.get("BENCH_SKIP_E2E"):
         run_stage(partial, "fleet_build_e2e")
+    if not os.environ.get("BENCH_SKIP_LSTM"):
+        run_stage(partial, "lstm_fleet_train", retries=1)
     reference = run_stage(partial, "reference_keras", retries=0)
     if reference is None and os.path.exists(BASELINE_CACHE):
         with open(BASELINE_CACHE) as f:

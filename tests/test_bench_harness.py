@@ -22,6 +22,10 @@ TINY_ENV = {
     "BENCH_EPOCHS": "2",
     "BENCH_SAMPLES": "128",
     "BENCH_TAGS": "4",
+    "BENCH_LSTM_MODELS": "2",
+    "BENCH_LSTM_TAGS": "4",
+    "BENCH_LSTM_LOOKBACK": "8",
+    "BENCH_LSTM_EPOCHS": "1",
     "BENCH_FORCE_CPU": "1",
     "BENCH_STAGE_TIMEOUT": "300",
 }
