@@ -228,6 +228,19 @@ def _flush_partial(partial: dict):
 # -- data -------------------------------------------------------------------
 
 
+def _timed_best(trainer, members, config, n=3):
+    """Best of n timed training runs: tunneled-accelerator transfer latency
+    varies ±50% run to run, so a single sample misreports the engine."""
+    best, results = None, None
+    for _ in range(n):
+        start = time.time()
+        r = trainer.train(members, config)
+        dt = time.time() - start
+        if best is None or dt < best:
+            best, results = dt, r
+    return best, results
+
+
 def make_data(n_models: int):
     rng = np.random.RandomState(42)
     t = np.linspace(0, 12 * np.pi, N_SAMPLES, dtype=np.float32)
@@ -303,19 +316,7 @@ def fleet_train() -> dict:
     # would leave XLA compilation inside the measured section.
     trainer.train(members, config)
 
-    def timed_best(t, n=3):
-        """Best of n timed runs: tunneled-accelerator transfer latency
-        varies ±50% run to run, so a single sample misreports the engine."""
-        best, results = None, None
-        for _ in range(n):
-            start = time.time()
-            r = t.train(members, config)
-            dt = time.time() - start
-            if best is None or dt < best:
-                best, results = dt, r
-        return best, results
-
-    elapsed, results = timed_best(trainer)
+    elapsed, results = _timed_best(trainer, members, config)
 
     losses = [r.history.history["loss"][-1] for r in results]
     assert all(np.isfinite(losses)), "non-finite training losses"
@@ -332,7 +333,7 @@ def fleet_train() -> dict:
             packing=packing if packing == "auto" else int(packing)
         )
         packed_trainer.train(members, config)  # warmup/compile
-        packed_elapsed, packed_results = timed_best(packed_trainer)
+        packed_elapsed, packed_results = _timed_best(packed_trainer, members, config)
         packed_losses = [r.history.history["loss"][-1] for r in packed_results]
         assert all(np.isfinite(packed_losses)), "non-finite packed losses"
 
@@ -523,9 +524,7 @@ def lstm_fleet_train() -> dict:
     for key, lookahead in (("lstm_ae", 0), ("lstm_forecast", 1)):
         fleet = members(lookahead)
         trainer.train(fleet, config)  # warmup/compile
-        start = time.time()
-        results = trainer.train(fleet, config)
-        elapsed = time.time() - start
+        elapsed, results = _timed_best(trainer, fleet, config)
         losses = [r.history.history["loss"][-1] for r in results]
         assert all(np.isfinite(losses)), f"non-finite {key} losses"
         rates[key] = N_LSTM_MODELS / (elapsed / 3600.0)

@@ -25,6 +25,7 @@ full config.
 import concurrent.futures
 import datetime
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -103,6 +104,45 @@ class FleetBuildError(RuntimeError):
     pass
 
 
+def _cv_chunk_bytes() -> int:
+    """Per-program staging budget for CV fold members (raw member data;
+    the device program's true footprint is a few × this for gradients and
+    optimizer moments). Override with GORDO_TPU_CV_CHUNK_BYTES."""
+    return int(os.environ.get("GORDO_TPU_CV_CHUNK_BYTES", 1 << 30))
+
+
+def _member_nbytes(member) -> int:
+    """Raw staged bytes of one fold member (X + non-aliased y, or series)."""
+    if isinstance(member, WindowedFleetMember):
+        return member.series.nbytes + member.targets.nbytes
+    n = member.X.nbytes
+    if member.y is not member.X:
+        n += member.y.nbytes
+    return n
+
+
+def _chunk_by_bytes(members, items, budget: int):
+    """Split (members, items) into order-preserving chunks whose summed
+    member bytes stay under ``budget`` (every chunk holds ≥1 member)."""
+    chunks = []
+    start, used = 0, 0
+    for i, member in enumerate(members):
+        size = _member_nbytes(member)
+        if i > start and used + size > budget:
+            chunks.append((members[start:i], items[start:i]))
+            start, used = i, 0
+        used += size
+    if start < len(members):
+        chunks.append((members[start:], items[start:]))
+    return chunks
+
+
+def _fold_member_name(machine_name: str, fold_idx: int) -> str:
+    """Unique member name for one machine's fold model. '::' cannot occur
+    in machine names (k8s-name validated), so no collision is possible."""
+    return f"{machine_name}::fold{fold_idx}"
+
+
 def _try_call(fn, *args):
     """Run ``fn``; return the exception instead of raising (thread-pool
     safe capture for failFast:false semantics)."""
@@ -126,7 +166,6 @@ class FleetBuilder:
             # GORDO_TPU_PACKING=auto|<int> turns on block-diagonal model
             # packing (models/packing.py) for the whole build path —
             # including the `build-fleet` CLI — without new flags.
-            import os
 
             packing: Any = os.environ.get("GORDO_TPU_PACKING") or None
             if packing and packing != "auto":
@@ -411,8 +450,18 @@ class FleetBuilder:
             per_plan_folds[plan.machine.name] = splits
             max_folds = max(max_folds, len(splits))
 
+        # Every machine's EVERY fold goes into one member list per fit
+        # config: fold models of the same (spec, shape) differ only in
+        # their train-weight masks, so they join a single vmapped bucket
+        # and the whole CV trains as ONE device program per architecture
+        # group — one dispatch and one result fetch where a fold-major
+        # loop paid max_folds of each (SURVEY §7: "fold = extra batch
+        # axis"). Fold-major append order keeps per-machine fold order for
+        # the threshold accumulators downstream.
+        grouped: Dict[
+            FitConfig, Tuple[List[Any], List[Tuple[_Plan, int]]]
+        ] = {}
         for fold_idx in range(max_folds):
-            grouped: Dict[FitConfig, Tuple[List[FleetMember], List[_Plan]]] = {}
             for plan in plans:
                 if plan.machine.name in self.build_errors:
                     continue
@@ -423,26 +472,39 @@ class FleetBuilder:
                 try:
                     weights = self._window_train_weights(plan, train_idx)
                     member = self._make_member(
-                        plan, weights, seed=plan.seed + 1000 * (fold_idx + 1)
+                        plan,
+                        weights,
+                        seed=plan.seed + 1000 * (fold_idx + 1),
+                        name=_fold_member_name(plan.machine.name, fold_idx),
                     )
                 except Exception as exc:
                     self._fail(plan.machine.name, exc)
                     continue
-                members, fold_plans = grouped.setdefault(plan.fit_config, ([], []))
+                members, fold_items = grouped.setdefault(plan.fit_config, ([], []))
                 members.append(member)
-                fold_plans.append(plan)
-            for config, (members, fold_plans) in grouped.items():
-                # One fused program per (config, spec, shape) bucket trains
-                # every machine's fold model together. A bucket-level
-                # failure takes its whole bucket down but not the fleet.
-                try:
-                    fold_results = self.trainer.train(members, config)
-                    self._score_fold(
-                        fold_plans, fold_results, per_plan_folds, fold_idx, fold_state
-                    )
-                except Exception as exc:
-                    for plan in fold_plans:
-                        self._fail(plan.machine.name, exc)
+                fold_items.append((plan, fold_idx))
+        for config, (members, fold_items) in grouped.items():
+            live_items = [
+                (plan, fold_idx)
+                for plan, fold_idx in fold_items
+                if plan.machine.name not in self.build_errors
+            ]
+            live_members = [
+                m
+                for m, (plan, _) in zip(members, fold_items)
+                if plan.machine.name not in self.build_errors
+            ]
+            # Chunk by staged bytes: n_machines × n_folds members in ONE
+            # program is the fast path, but an unbounded super-bucket
+            # could out-size HBM on big fleets. Chunks preserve the
+            # fold-major order (threshold accumulators are last-fold-wins
+            # per machine).
+            for chunk_members, chunk_items in _chunk_by_bytes(
+                live_members, live_items, _cv_chunk_bytes()
+            ):
+                self._train_and_score_folds(
+                    chunk_members, chunk_items, config, per_plan_folds, fold_state
+                )
 
         for plan in plans:
             if plan.machine.name in self.build_errors:
@@ -455,16 +517,24 @@ class FleetBuilder:
             plan.cv_duration = time.time() - start
 
     @staticmethod
-    def _make_member(plan: _Plan, train_weights: Optional[np.ndarray], seed: int):
-        """Training member with the detector-level shuffle applied."""
+    def _make_member(
+        plan: _Plan,
+        train_weights: Optional[np.ndarray],
+        seed: int,
+        name: Optional[str] = None,
+    ):
+        """Training member with the detector-level shuffle applied.
+        ``name`` overrides the member name (CV submits every fold of a
+        machine into one bucket, so fold members need distinct names)."""
         perm = plan.shuffle_perm
+        name = name or plan.machine.name
         if plan.windows is None:
             # Windowed (LSTM) path: ship the raw series; the shuffle becomes
             # the order map and weights move into virtual (shuffled) space.
             if perm is not None and train_weights is not None:
                 train_weights = train_weights[perm]
             return WindowedFleetMember(
-                name=plan.machine.name,
+                name=name,
                 spec=plan.spec,
                 series=plan.X_arr,
                 targets=plan.targets,
@@ -475,13 +545,18 @@ class FleetBuilder:
         if perm is None:
             X, y = plan.windows, plan.targets
         else:
-            X = plan.windows[perm]
-            # Preserve y-is-X aliasing through the permutation gather.
-            y = X if plan.targets is plan.windows else plan.targets[perm]
+            cached = getattr(plan, "_shuffled_windows_cache", None)
+            if cached is None:
+                X = plan.windows[perm]
+                # Preserve y-is-X aliasing through the permutation gather.
+                y = X if plan.targets is plan.windows else plan.targets[perm]
+                plan._shuffled_windows_cache = (X, y)
+            else:
+                X, y = cached
             if train_weights is not None:
                 train_weights = train_weights[perm]
         return FleetMember(
-            name=plan.machine.name,
+            name=name,
             spec=plan.spec,
             X=X,
             y=y,
@@ -534,39 +609,90 @@ class FleetBuilder:
 
     _SCORING_BATCH = 256  # windowed scoring scan batch (bounds HBM)
 
-    def _score_fold(self, fold_plans, fold_results, per_plan_folds, fold_idx, fold_state):
+    def _train_and_score_folds(
+        self, members, fold_items, config, per_plan_folds, fold_state
+    ):
+        """
+        Train one chunk of fold members and score it. A failing chunk is
+        split in half and retried (down to single members), so a bad
+        machine — or a chunk that out-sizes device memory despite the
+        byte budget — degrades to per-member isolation instead of taking
+        every machine of the fit config down.
+        """
+        if not members:
+            return
+        try:
+            fold_results = self.trainer.train(members, config)
+        except Exception as exc:
+            if len(members) > 1:
+                logger.warning(
+                    "CV chunk of %d fold-members failed (%s); splitting",
+                    len(members),
+                    exc,
+                )
+                mid = len(members) // 2
+                self._train_and_score_folds(
+                    members[:mid], fold_items[:mid], config,
+                    per_plan_folds, fold_state,
+                )
+                self._train_and_score_folds(
+                    members[mid:], fold_items[mid:], config,
+                    per_plan_folds, fold_state,
+                )
+                return
+            self._fail(fold_items[0][0].machine.name, exc)
+            return
+        try:
+            self._score_folds(fold_items, fold_results, per_plan_folds, fold_state)
+        except Exception as exc:
+            for plan, _ in fold_items:
+                self._fail(plan.machine.name, exc)
+
+    def _score_folds(self, fold_items, fold_results, per_plan_folds, fold_state):
+        """
+        Score trained fold models: ``fold_items`` is ``[(plan, fold_idx)]``
+        in fold-major order (every fold of every machine of one fit
+        config). One batched forward per (spec, geometry) group — all
+        folds of all machines of an architecture predict in one dispatch.
+        Windowed (LSTM) plans predict through the on-device window-gather
+        scan; dense plans through the stacked forward.
+        """
         by_name = {r.name: r for r in fold_results}
-        # One batched forward per (spec, geometry) group — not one dispatch
-        # per machine. Windowed (LSTM) plans predict through the on-device
-        # window-gather scan; dense plans through the stacked forward.
-        groups: Dict[Tuple, List[_Plan]] = {}
-        for plan in fold_plans:
+        groups: Dict[Tuple, List[Tuple[_Plan, int]]] = {}
+        for plan, fold_idx in fold_items:
             geometry = (
                 ("windowed",) if plan.windows is None else plan.windows.shape[1:]
             )
-            groups.setdefault((plan.spec, geometry), []).append(plan)
+            groups.setdefault((plan.spec, geometry), []).append((plan, fold_idx))
         for (spec, geometry), group in groups.items():
             stacked = stack_member_params(
-                [by_name[p.machine.name] for p in group]
+                [
+                    by_name[_fold_member_name(p.machine.name, k)]
+                    for p, k in group
+                ]
             )
-            fold_rows = []  # per plan: (train_rows, window_idx, target_rows)
-            for plan in group:
+            fold_rows = []  # per item: (train_rows, window_idx, target_rows)
+            for plan, fold_idx in group:
                 train_rows, test_rows = per_plan_folds[plan.machine.name][fold_idx]
                 window_idx, target_rows = self._test_window_rows(plan, test_rows)
                 fold_rows.append((train_rows, window_idx, target_rows))
             if geometry == ("windowed",):
                 predictions = self._predict_windowed_group(
-                    spec, stacked, group, [wi for _, wi, _ in fold_rows]
+                    spec,
+                    stacked,
+                    [p for p, _ in group],
+                    [wi for _, wi, _ in fold_rows],
                 )
             else:
                 n_max = max(len(wi) for _, wi, _ in fold_rows)
                 X = np.zeros(
-                    (len(group), n_max) + group[0].windows.shape[1:], np.float32
+                    (len(group), n_max) + group[0][0].windows.shape[1:],
+                    np.float32,
                 )
-                for i, p in enumerate(group):
+                for i, (p, _) in enumerate(group):
                     X[i, : len(fold_rows[i][1])] = p.windows[fold_rows[i][1]]
                 predictions = self.trainer.predict_bucket(spec, stacked, X)
-            for i, plan in enumerate(group):
+            for i, (plan, fold_idx) in enumerate(group):
                 train_rows, window_idx, target_rows = fold_rows[i]
                 y_true = plan.y_arr[target_rows]
                 y_pred = predictions[i, : len(window_idx)]

@@ -260,3 +260,55 @@ def test_fleet_build_register_failure_not_dumped(tmp_path, monkeypatch):
     assert set(builder.build_errors) == {"reg-doomed"}
     assert (output_dir / "reg-good" / "model.pkl").exists()
     assert not (output_dir / "reg-doomed").exists()
+
+
+def test_cv_chunking_by_bytes_preserves_order():
+    from gordo_tpu.parallel.fleet_build import _chunk_by_bytes
+    from gordo_tpu.parallel import FleetMember
+    from gordo_tpu.models.factories import feedforward_hourglass
+
+    spec = feedforward_hourglass(4)
+    members = [
+        FleetMember(name=f"c{i}", spec=spec,
+                    X=(X := np.zeros((50, 4), np.float32)), y=X, seed=i)
+        for i in range(7)
+    ]
+    items = [(f"plan{i}", i % 3) for i in range(7)]
+    per_member = members[0].X.nbytes  # y aliased -> not double-counted
+    chunks = _chunk_by_bytes(members, items, budget=per_member * 3)
+    assert [len(ms) for ms, _ in chunks] == [3, 3, 1]
+    flat_items = [it for _, its in chunks for it in its]
+    assert flat_items == items  # order preserved across chunk boundaries
+    # a budget smaller than one member still yields 1-member chunks
+    tiny = _chunk_by_bytes(members, items, budget=1)
+    assert [len(ms) for ms, _ in tiny] == [1] * 7
+
+
+def test_cv_chunk_split_retry_isolates_bad_machine(monkeypatch):
+    """A fold bucket that fails as a whole must split-retry down to the
+    bad machine: the healthy machines' CV still completes."""
+    from gordo_tpu.parallel import FleetBuilder, FleetTrainer
+
+    machines = [make_machine(f"split-{i}", ["t1", "t2"]) for i in range(3)]
+    builder = FleetBuilder(machines)
+    real_train = builder.trainer.train
+    calls = {"n": 0}
+
+    def flaky_train(members, config, **kwargs):
+        calls["n"] += 1
+        # fail any chunk containing the bad machine AND another member —
+        # forcing the halving retry to isolate it
+        names = [m.name for m in members]
+        bad = [n for n in names if n.startswith("split-1")]
+        if bad and len(names) > 1:
+            raise RuntimeError("chunk-level failure")
+        if bad:
+            raise RuntimeError("bad machine alone")
+        return real_train(members, config, **kwargs)
+
+    monkeypatch.setattr(builder.trainer, "train", flaky_train)
+    results = builder.build()
+    names = {m.name for _, m in results}
+    assert names == {"split-0", "split-2"}
+    assert set(builder.build_errors) == {"split-1"}
+    assert calls["n"] > 3  # the halving retry actually recursed
