@@ -99,3 +99,24 @@ def test_verify_dataframe_wrong_width_is_400():
     with pytest.raises(ServerError) as excinfo:
         verify_dataframe(df, ["a", "b"])
     assert excinfo.value.status == 400
+
+
+def test_dataframe_to_dict_object_dtype_boxes_numpy_scalars():
+    """Object-dtype columns must yield python natives (np.int64 would
+    break stdlib-json clients; review finding)."""
+    df = pd.DataFrame({"a": pd.Series([np.int64(5), "x"], dtype=object)})
+    out = dataframe_to_dict(df)
+    v = out["a"][0]
+    assert type(v) is int and v == 5
+
+
+def test_dataframe_to_dict_duplicate_columns_degrade_not_crash():
+    """Duplicate column labels keep pandas' warn-and-omit semantics (the
+    old behavior) instead of raising."""
+    import warnings
+
+    df = pd.DataFrame([[1, 2], [3, 4]], columns=["a", "a"])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = dataframe_to_dict(df)
+    assert "a" in out
