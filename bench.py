@@ -524,7 +524,10 @@ def lstm_fleet_train() -> dict:
     for key, lookahead in (("lstm_ae", 0), ("lstm_forecast", 1)):
         fleet = members(lookahead)
         trainer.train(fleet, config)  # warmup/compile
-        elapsed, results = _timed_best(trainer, fleet, config)
+        # n=2: a ~30s program amortizes per-transfer jitter far better
+        # than the millisecond feedforward runs, and best-of-3 here would
+        # push the whole bench past a 10-minute budget
+        elapsed, results = _timed_best(trainer, fleet, config, n=2)
         losses = [r.history.history["loss"][-1] for r in results]
         assert all(np.isfinite(losses)), f"non-finite {key} losses"
         rates[key] = N_LSTM_MODELS / (elapsed / 3600.0)

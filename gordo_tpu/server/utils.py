@@ -125,11 +125,18 @@ def dataframe_to_dict(df: pd.DataFrame) -> dict:
     # numpy scalars like to_dict's maybe_box_native did, and the key list
     # is built once instead of once per column — this serializer is half
     # the anomaly route's host time at reference payload sizes
+    def box_native(v):
+        # .item() on ns-precision datetime64/timedelta64 yields raw
+        # nanosecond ints — box those like pandas' maybe_box_native does
+        if isinstance(v, np.datetime64):
+            return pd.Timestamp(v)
+        if isinstance(v, np.timedelta64):
+            return pd.Timedelta(v)
+        return v.item() if isinstance(v, np.generic) else v
+
     def column_values(series: pd.Series) -> list:
         if series.dtype == object:
-            return [
-                v.item() if isinstance(v, np.generic) else v for v in series
-            ]
+            return [box_native(v) for v in series]
         return series.tolist()
 
     keys = (

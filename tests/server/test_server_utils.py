@@ -120,3 +120,18 @@ def test_dataframe_to_dict_duplicate_columns_degrade_not_crash():
         warnings.simplefilter("ignore")
         out = dataframe_to_dict(df)
     assert "a" in out
+
+
+def test_dataframe_to_dict_object_dtype_boxes_numpy_datetimes():
+    """np.datetime64/timedelta64 in object columns must box to
+    Timestamp/Timedelta, not raw nanosecond ints (review finding)."""
+    df = pd.DataFrame(
+        {
+            "t": pd.Series([np.datetime64("2020-01-01", "ns")], dtype=object),
+            "d": pd.Series([np.timedelta64(1, "h")], dtype=object),
+        }
+    )
+    out = dataframe_to_dict(df)
+    assert isinstance(out["t"][0], pd.Timestamp)
+    assert out["t"][0] == pd.Timestamp("2020-01-01")
+    assert isinstance(out["d"][0], pd.Timedelta)
