@@ -262,3 +262,28 @@ def test_warm_survives_corrupt_artifact(collection_dir, tmp_path):
     loaded = fleet.warm()
     assert "machine-2" in loaded
     assert "machine-1" not in loaded
+
+
+def test_fleet_and_single_routes_share_wire_key_format(client, fleet_payload):
+    """The fleet route and the single-model routes must emit identical
+    index keys for identical input (index_wire_keys is the single shared
+    definition — this pins the cross-route consistency clients rely on)."""
+    resp_fleet = client.post(
+        f"/gordo/v0/{PROJECT}/prediction/fleet",
+        json={"X": {"machine-1": fleet_payload["machine-1"]}},
+    )
+    assert resp_fleet.status_code == 200
+    fleet_keys = sorted(
+        json.loads(resp_fleet.data)["data"]["machine-1"]["model-output"]["0"]
+    )
+    resp_single = client.post(
+        f"/gordo/v0/{PROJECT}/machine-1/prediction",
+        json={"X": fleet_payload["machine-1"]},
+    )
+    assert resp_single.status_code == 200
+    body = json.loads(resp_single.data)["data"]
+    # single-model responses name sub-columns by tag; the INDEX keys are
+    # the shared wire format under test
+    first_tag = next(iter(body["model-output"]))
+    single_keys = sorted(body["model-output"][first_tag])
+    assert fleet_keys == single_keys
