@@ -1,0 +1,23 @@
+"""
+Compatibility and migration tooling.
+
+``tf_parity`` is the TF2/Keras ↔ JAX parity harness: it trains the same
+architecture with the reference's Keras engine and with gordo-tpu's JAX
+engine on identical data and quantifies the anomaly-score agreement. It
+backs the bench's ``parity`` stage and the migration-validation test
+(tests/models/test_parity_tf.py).
+"""
+
+from .tf_parity import (
+    KerasReferenceAutoEncoder,
+    make_parity_data,
+    parity_passes,
+    run_parity,
+)
+
+__all__ = [
+    "KerasReferenceAutoEncoder",
+    "make_parity_data",
+    "parity_passes",
+    "run_parity",
+]

@@ -1,0 +1,83 @@
+"""
+The north-star correctness gate: anomaly-score MAE parity vs TF2/Keras
+(BASELINE.md: "anomaly-score MAE parity vs the TF2 CPU baseline").
+
+Trains the same hourglass AE on the same data with the reference's Keras
+engine and with the JAX engine, runs the same CV + threshold math through
+:class:`DiffBasedAnomalyDetector`, and gates the anomaly surfaces against
+the tolerances stated in gordo_tpu/compat/tf_parity.py (calibrated
+against the reference engine's own seed-to-seed envelope).
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("tensorflow")
+
+from gordo_tpu.compat import tf_parity  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def parity_record() -> dict:
+    # The calibrated configuration from the module header: small enough
+    # for CI, converged enough that residuals are noise-dominated.
+    return tf_parity.run_parity(
+        n_train=720, n_eval=240, n_tags=8, epochs=150, batch_size=64
+    )
+
+
+@pytest.mark.slow
+def test_anomaly_score_mae_parity(parity_record):
+    assert parity_record["score_rel_mae"] <= tf_parity.DEFAULT_REL_MAE_TOL, (
+        "anomaly-score MAE vs TF2 out of tolerance: "
+        f"{parity_record['score_rel_mae']:.3f} > {tf_parity.DEFAULT_REL_MAE_TOL}"
+    )
+    assert parity_record["score_corr"] >= tf_parity.DEFAULT_CORR_MIN
+
+
+@pytest.mark.slow
+def test_threshold_parity(parity_record):
+    assert (
+        parity_record["agg_threshold_rel_delta"]
+        <= tf_parity.DEFAULT_AGG_THRESHOLD_REL_TOL
+    )
+    assert (
+        parity_record["tag_threshold_mean_rel_delta"]
+        <= tf_parity.DEFAULT_TAG_THRESHOLD_REL_TOL
+    )
+
+
+@pytest.mark.slow
+def test_parity_gate(parity_record):
+    assert parity_record["passes"] is True
+    # Both engines must actually have converged — a parity of two underfit
+    # models would be vacuous.
+    assert parity_record["explained_variance_tf"] > 0.95
+    assert parity_record["explained_variance_jax"] > 0.95
+
+
+def test_make_parity_data_shapes():
+    train, evaluation = tf_parity.make_parity_data(
+        n_train=100, n_eval=40, n_tags=5, anomaly_tags=2, anomaly_offset=2.0
+    )
+    assert train.shape == (100, 5)
+    assert evaluation.shape == (40, 5)
+    # the injected anomaly lives in the last quarter of the eval window
+    clean, anomalous = evaluation.iloc[:-10], evaluation.iloc[-10:]
+    assert (
+        anomalous.iloc[:, 0].mean() - clean.iloc[:, 0].mean() > 1.0
+    ), "anomaly offset missing from eval tail"
+    assert train.index.tz is not None
+
+
+def test_parity_passes_gate_logic():
+    good = {
+        "score_rel_mae": 0.1,
+        "score_corr": 0.999,
+        "agg_threshold_rel_delta": 0.1,
+        "tag_threshold_mean_rel_delta": 0.1,
+    }
+    assert tf_parity.parity_passes(good)
+    assert not tf_parity.parity_passes({**good, "score_rel_mae": 0.9})
+    assert not tf_parity.parity_passes({**good, "score_corr": 0.5})
+    assert not tf_parity.parity_passes({**good, "agg_threshold_rel_delta": 0.9})
