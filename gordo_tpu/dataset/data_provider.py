@@ -109,16 +109,16 @@ class RandomDataProvider(GordoBaseDataProvider):
         for tag in normalize_sensor_tags(tag_list):
             rng = self._rng_for(tag)
             n_points = rng.randint(self.min_size, self.max_size + 1)
-            index = pd.DatetimeIndex(
-                pd.to_datetime(
-                    np.linspace(
-                        pd.Timestamp(train_start_date).value,
-                        pd.Timestamp(train_end_date).value,
-                        n_points,
-                    ).astype("int64")
-                ),
-                tz=getattr(train_start_date, "tz", None),
-            )
+            stamps = np.linspace(
+                pd.Timestamp(train_start_date).value,
+                pd.Timestamp(train_end_date).value,
+                n_points,
+            ).astype("int64")
+            index = pd.DatetimeIndex(stamps.view("M8[ns]"))
+            tz = getattr(train_start_date, "tz", None)
+            if tz is not None:
+                # .value above is UTC ns; localize back to the input tz
+                index = index.tz_localize("UTC").tz_convert(tz)
             t = np.linspace(0.0, 2 * np.pi * rng.uniform(1.0, 6.0), n_points)
             base = rng.uniform(-50.0, 50.0)
             amplitude = rng.uniform(0.5, 10.0)

@@ -43,6 +43,47 @@ _NAN_PRESERVING_AGGS = frozenset(
 )
 
 
+def _interpolate_linear_limited(data: pd.DataFrame, limit: int) -> pd.DataFrame:
+    """
+    ``DataFrame.interpolate(method="linear", limit=limit)`` in vectorized
+    numpy — bit-identical to pandas (parity-tested against it in
+    tests/dataset/test_datasets.py) but ~100× cheaper: pandas routes the
+    limit logic through ``apply_along_axis`` per column, which measured
+    ~0.25s per machine on the build path (minutes at 1000-machine scale).
+
+    Pandas "linear" semantics (positional, ignores index spacing):
+    leading NaNs stay NaN; interior gaps fill linearly between anchors but
+    only the first ``limit`` positions of each gap; trailing NaNs repeat
+    the last valid value, also up to ``limit``.
+    """
+    try:
+        values = data.to_numpy(dtype=np.float64, copy=True)
+    except (TypeError, ValueError):
+        # non-numeric columns (never produced by resample, but a custom
+        # provider could) — keep pandas' own path for them
+        return data.interpolate(method="linear", limit=limit)
+    n = len(values)
+    if n == 0:
+        return data
+    positions = np.arange(n)
+    for col in range(values.shape[1]):
+        column = values[:, col]
+        nan_mask = np.isnan(column)
+        if not nan_mask.any():
+            continue
+        valid = ~nan_mask
+        if not valid.any():
+            continue
+        valid_idx = np.flatnonzero(valid)
+        filled = np.interp(positions, valid_idx, column[valid_idx])
+        # distance to the previous valid observation gates the fill
+        prev_valid = np.maximum.accumulate(np.where(valid, positions, -1))
+        gap_run = positions - prev_valid
+        fill = nan_mask & (prev_valid >= 0) & (gap_run <= limit)
+        column[fill] = filled[fill]
+    return pd.DataFrame(values, index=data.index, columns=data.columns)
+
+
 def normalize_frequency(resolution: str) -> str:
     """
     Accept legacy pandas offset aliases ('10T', '1H') alongside the modern
@@ -238,7 +279,7 @@ class TimeSeriesDataset(GordoBaseDataset):
             1,
         )
         if self.interpolation_method == "linear_interpolation":
-            data = data.interpolate(method="linear", limit=interp_limit)
+            data = _interpolate_linear_limited(data, interp_limit)
         elif self.interpolation_method == "ffill":
             data = data.ffill(limit=interp_limit)
         return data.dropna()
@@ -259,9 +300,13 @@ class TimeSeriesDataset(GordoBaseDataset):
         its first/last observations, exactly where its own resample would
         start and end. Raises for ragged/duplicate indexes the aligner
         can't handle; the caller falls back to the per-series path.
+
+        The outer alignment itself is a numpy int64-ns union +
+        searchsorted scatter — ``pd.concat(axis=1, sort=True)`` does a
+        k-way index union through per-series reindex machinery that
+        measured ~20ms per machine on the build path (20 tags).
         """
-        raw = pd.concat(series_list, axis=1, sort=True)
-        raw.columns = [s.name for s in series_list]
+        raw = self._outer_align(series_list)
         data = raw.resample(self.resolution).agg(self.aggregation_methods)
         # Trim by bin LABELS of each series' observed span (floor is
         # midnight-anchored like resample's origin for day-dividing
@@ -272,6 +317,46 @@ class TimeSeriesDataset(GordoBaseDataset):
         start = max(s.index.min().floor(self.resolution) for s in series_list)
         end = min(s.index.max().floor(self.resolution) for s in series_list)
         return data.loc[start:end]
+
+    @staticmethod
+    def _outer_align(series_list: List[pd.Series]) -> pd.DataFrame:
+        """NaN-padded outer join of the raw tag series, equivalent to
+        ``pd.concat(series_list, axis=1, sort=True)`` for unique sorted
+        tz-homogeneous indexes; raises InvalidIndexError otherwise (the
+        resample-path caller falls back to per-series resampling, exactly
+        as it does when pandas' own concat raises)."""
+        def index_unit(index) -> str:
+            dtype = index.dtype
+            if hasattr(dtype, "unit"):  # tz-aware DatetimeTZDtype
+                return dtype.unit
+            return np.datetime_data(dtype)[0]
+
+        tzs = {getattr(s.index, "tz", None) for s in series_list}
+        int_indexes = []
+        units = set()
+        for s in series_list:
+            if not isinstance(s.index, pd.DatetimeIndex) or not s.index.is_unique:
+                raise pd.errors.InvalidIndexError(f"index of {s.name!r}")
+            units.add(index_unit(s.index))
+            int_indexes.append(s.index.asi8)
+        # asi8 is in the index's own resolution (pandas ≥2 indexes can be
+        # s/ms/us/ns), so the epoch ints only union across a single unit
+        if len(tzs) > 1 or len(units) > 1:
+            raise pd.errors.InvalidIndexError("mixed index timezones or units")
+        unit = units.pop()
+        union = np.unique(np.concatenate(int_indexes))
+        values = np.full((len(union), len(series_list)), np.nan)
+        for j, s in enumerate(series_list):
+            values[np.searchsorted(union, int_indexes[j]), j] = s.to_numpy(
+                dtype=np.float64, na_value=np.nan
+            )
+        index = pd.DatetimeIndex(union.view(f"M8[{unit}]"))
+        tz = tzs.pop()
+        if tz is not None:
+            index = index.tz_localize("UTC").tz_convert(tz)
+        return pd.DataFrame(
+            values, index=index, columns=[s.name for s in series_list]
+        )
 
     def _apply_filters(self, data: pd.DataFrame) -> pd.DataFrame:
         n_before = len(data)
@@ -320,18 +405,35 @@ class TimeSeriesDataset(GordoBaseDataset):
                 "row_count": len(X),
                 "tag_list": [t.to_json() for t in self.tag_list],
                 "target_tag_list": [t.to_json() for t in self.target_tag_list],
-                "x_hist": {
-                    name: {
-                        "min": float(X[name].min()),
-                        "max": float(X[name].max()),
-                        "mean": float(X[name].mean()),
-                        "std": float(X[name].std()),
-                    }
-                    for name in x_names
-                },
+                "x_hist": self._column_histograms(X),
             }
         )
         return X, y
+
+    @staticmethod
+    def _column_histograms(X: pd.DataFrame) -> Dict[str, Dict[str, float]]:
+        """Per-tag summary stats in four vectorized reductions (pandas'
+        per-column Series reductions measured ~10ms/machine at 20 tags).
+        ``ddof=1`` matches ``Series.std``; NaN-aware to keep parity on
+        frames that skipped interpolation."""
+        values = X.to_numpy(dtype=np.float64)
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)  # all-NaN columns
+            mins = np.nanmin(values, axis=0)
+            maxs = np.nanmax(values, axis=0)
+            means = np.nanmean(values, axis=0)
+            stds = np.nanstd(values, axis=0, ddof=1)
+        return {
+            str(name): {
+                "min": float(mins[i]),
+                "max": float(maxs[i]),
+                "mean": float(means[i]),
+                "std": float(stds[i]),
+            }
+            for i, name in enumerate(X.columns)
+        }
 
     def trainable_arrays(self) -> Tuple[np.ndarray, np.ndarray, pd.Index]:
         """(X, y) as float32 numpy plus the shared index — one device_put away

@@ -128,6 +128,12 @@ def _fill_weight_row(wtr, wval, i, n, member, config: FitConfig):
 #: into one contiguous device buffer, so the host sees ONE transfer.
 _flat_concat = jax.jit(lambda *leaves: jnp.concatenate([l.ravel() for l in leaves]))
 
+#: _flat_concat compiles one XLA program per distinct (leaf count, shapes,
+#: dtypes) signature for the process lifetime; past this many leaves the
+#: coalescing falls back to plain device_get so a long-lived process with
+#: many heterogeneous buckets can't grow the jit cache unboundedly.
+_FLAT_CONCAT_MAX_LEAVES = 256
+
 
 def fetch_to_host(tree):
     """
@@ -153,7 +159,11 @@ def fetch_to_host(tree):
         # just means "replicate the global value", no reshaping).
         return multihost_utils.process_allgather(tree, tiled=True)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    if len(leaves) <= 1 or not all(isinstance(l, jax.Array) for l in leaves):
+    if (
+        len(leaves) <= 1
+        or len(leaves) > _FLAT_CONCAT_MAX_LEAVES
+        or not all(isinstance(l, jax.Array) for l in leaves)
+    ):
         return jax.device_get(tree)
     by_dtype: Dict[Any, List[int]] = {}
     for idx, leaf in enumerate(leaves):

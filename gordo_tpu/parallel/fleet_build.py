@@ -23,10 +23,12 @@ full config.
 """
 
 import concurrent.futures
+import contextlib
 import datetime
 import logging
 import os
 import time
+from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -108,7 +110,15 @@ def _cv_chunk_bytes() -> int:
     """Per-program staging budget for CV fold members (raw member data;
     the device program's true footprint is a few × this for gradients and
     optimizer moments). Override with GORDO_TPU_CV_CHUNK_BYTES."""
-    return int(os.environ.get("GORDO_TPU_CV_CHUNK_BYTES", 1 << 30))
+    raw = os.environ.get("GORDO_TPU_CV_CHUNK_BYTES")
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            logger.warning(
+                "Invalid GORDO_TPU_CV_CHUNK_BYTES=%r; using 1 GiB default", raw
+            )
+    return 1 << 30
 
 
 def _member_nbytes(member) -> int:
@@ -179,6 +189,19 @@ class FleetBuilder:
         # recorded in ``build_errors`` and the rest of the fleet builds.
         self.fail_fast = fail_fast
         self.build_errors: Dict[str, BaseException] = {}
+        # Wall-clock per build phase (seconds), for the bench's host/device
+        # breakdown: plan, data_fetch, stage, cv_train (device programs),
+        # cv_score (host threshold/metric math), cv_finalize, final_fit,
+        # assemble, dump.
+        self.phase_seconds: Dict[str, float] = defaultdict(float)
+
+    @contextlib.contextmanager
+    def _phase(self, name: str):
+        start = time.time()
+        try:
+            yield
+        finally:
+            self.phase_seconds[name] += time.time() - start
 
     def _fail(self, name: str, exc: BaseException):
         if self.fail_fast:
@@ -220,7 +243,9 @@ class FleetBuilder:
             )
 
         self.build_errors = {}
-        plans, fallbacks = self._plan_all(machines)
+        self.phase_seconds = defaultdict(float)
+        with self._phase("plan"):
+            plans, fallbacks = self._plan_all(machines)
         plans = self._load_all_data(plans)
 
         def alive(ps):
@@ -246,11 +271,12 @@ class FleetBuilder:
             self._run_final_fit(final_plans)
 
         results = []
-        for plan in alive(plans):
-            try:
-                results.append(self._assemble(plan))
-            except Exception as exc:
-                self._fail(plan.machine.name, exc)
+        with self._phase("assemble"):
+            for plan in alive(plans):
+                try:
+                    results.append(self._assemble(plan))
+                except Exception as exc:
+                    self._fail(plan.machine.name, exc)
         for machine in fallbacks:
             logger.info("Fleet fallback to ModelBuilder for %s", machine.name)
             try:
@@ -267,28 +293,44 @@ class FleetBuilder:
 
         results = cached_results + results
         if output_dir is not None:
-            import os
-
-            saved = []
-            for model, machine in results:
-                # A machine can fail *after* assembly (e.g. at register);
-                # never dump artifacts for machines already in build_errors.
-                if machine.name in self.build_errors:
-                    continue
-                try:
-                    path = os.path.join(output_dir, machine.name)
-                    os.makedirs(path, exist_ok=True)
-                    serializer.dump(model, path, metadata=machine.to_dict())
-                except Exception as exc:
-                    self._fail(machine.name, exc)
-                    continue
-                saved.append((model, machine))
-            results = saved
+            with self._phase("dump"):
+                results = self._dump_all(results, output_dir)
         return [
             (model, machine)
             for model, machine in results
             if machine.name not in self.build_errors
         ]
+
+    def _dump_all(self, results, output_dir: str):
+        """Per-machine artifact dump, thread-pooled: pickling releases the
+        GIL for the array copies and the file writes overlap, so the dump
+        phase scales with cores instead of machine count. Per-machine
+        error capture keeps failFast:false semantics."""
+
+        def dump_one(item):
+            model, machine = item
+            path = os.path.join(output_dir, machine.name)
+            os.makedirs(path, exist_ok=True)
+            serializer.dump(model, path, metadata=machine.to_dict())
+
+        to_dump = [
+            (model, machine)
+            for model, machine in results
+            # A machine can fail *after* assembly (e.g. at register);
+            # never dump artifacts for machines already in build_errors.
+            if machine.name not in self.build_errors
+        ]
+        with concurrent.futures.ThreadPoolExecutor(
+            min(8, max(1, len(to_dump)))
+        ) as pool:
+            outcomes = list(pool.map(lambda it: _try_call(dump_one, it), to_dump))
+        saved = []
+        for (model, machine), exc in zip(to_dump, outcomes):
+            if exc is not None:
+                self._fail(machine.name, exc)
+                continue
+            saved.append((model, machine))
+        return saved
 
     # ------------------------------------------------------------- planning
 
@@ -350,21 +392,23 @@ class FleetBuilder:
             plan.query_duration = time.time() - start
             plan.X, plan.y = X, y
 
-        with concurrent.futures.ThreadPoolExecutor(self.data_workers) as pool:
-            outcomes = list(
-                pool.map(lambda p: _try_call(load, p), plans)
-            )
+        with self._phase("data_fetch"):
+            with concurrent.futures.ThreadPoolExecutor(self.data_workers) as pool:
+                outcomes = list(
+                    pool.map(lambda p: _try_call(load, p), plans)
+                )
         surviving = []
-        for plan, exc in zip(plans, outcomes):
-            if exc is not None:
-                self._fail(plan.machine.name, exc)
-                continue
-            try:
-                self._stage_arrays(plan)
-            except Exception as stage_exc:
-                self._fail(plan.machine.name, stage_exc)
-                continue
-            surviving.append(plan)
+        with self._phase("stage"):
+            for plan, exc in zip(plans, outcomes):
+                if exc is not None:
+                    self._fail(plan.machine.name, exc)
+                    continue
+                try:
+                    self._stage_arrays(plan)
+                except Exception as stage_exc:
+                    self._fail(plan.machine.name, stage_exc)
+                    continue
+                surviving.append(plan)
         return surviving
 
     @staticmethod
@@ -506,15 +550,16 @@ class FleetBuilder:
                     chunk_members, chunk_items, config, per_plan_folds, fold_state
                 )
 
-        for plan in plans:
-            if plan.machine.name in self.build_errors:
-                continue
-            try:
-                self._finalize_cv(plan, fold_state[plan.machine.name])
-            except Exception as exc:
-                self._fail(plan.machine.name, exc)
-                continue
-            plan.cv_duration = time.time() - start
+        with self._phase("cv_finalize"):
+            for plan in plans:
+                if plan.machine.name in self.build_errors:
+                    continue
+                try:
+                    self._finalize_cv(plan, fold_state[plan.machine.name])
+                except Exception as exc:
+                    self._fail(plan.machine.name, exc)
+                    continue
+                plan.cv_duration = time.time() - start
 
     @staticmethod
     def _make_member(
@@ -619,10 +664,22 @@ class FleetBuilder:
         byte budget — degrades to per-member isolation instead of taking
         every machine of the fit config down.
         """
+        # A machine that failed in an earlier chunk of this config must not
+        # waste device time training its remaining folds here (its
+        # accumulators are dead — _finalize_cv skips failed machines).
+        live = [
+            i
+            for i, (plan, _) in enumerate(fold_items)
+            if plan.machine.name not in self.build_errors
+        ]
+        if len(live) != len(fold_items):
+            members = [members[i] for i in live]
+            fold_items = [fold_items[i] for i in live]
         if not members:
             return
         try:
-            fold_results = self.trainer.train(members, config)
+            with self._phase("cv_train"):
+                fold_results = self.trainer.train(members, config)
         except Exception as exc:
             if len(members) > 1:
                 logger.warning(
@@ -676,35 +733,36 @@ class FleetBuilder:
                 train_rows, test_rows = per_plan_folds[plan.machine.name][fold_idx]
                 window_idx, target_rows = self._test_window_rows(plan, test_rows)
                 fold_rows.append((train_rows, window_idx, target_rows))
-            if geometry == ("windowed",):
-                predictions = self._predict_windowed_group(
-                    spec,
-                    stacked,
-                    [p for p, _ in group],
-                    [wi for _, wi, _ in fold_rows],
-                )
-            else:
-                n_max = max(len(wi) for _, wi, _ in fold_rows)
-                X = np.zeros(
-                    (len(group), n_max) + group[0][0].windows.shape[1:],
-                    np.float32,
-                )
-                for i, (p, _) in enumerate(group):
-                    X[i, : len(fold_rows[i][1])] = p.windows[fold_rows[i][1]]
-                predictions = self.trainer.predict_bucket(spec, stacked, X)
-            for i, (plan, fold_idx) in enumerate(group):
-                train_rows, window_idx, target_rows = fold_rows[i]
-                y_true = plan.y_arr[target_rows]
-                y_pred = predictions[i, : len(window_idx)]
-                state = fold_state[plan.machine.name]
-                state.setdefault("folds", []).append((y_true, y_pred))
-                self._accumulate_metric_scores(plan, y_true, y_pred, fold_idx)
-                if plan.detector is not None:
-                    self._accumulate_thresholds(
-                        plan, y_true, y_pred, fold_idx, state,
-                        y_train=plan.y_arr[train_rows],
-                        test_rows=target_rows,
+            with self._phase("cv_predict"):
+                if geometry == ("windowed",):
+                    predictions = self._predict_windowed_group(
+                        spec,
+                        stacked,
+                        [p for p, _ in group],
+                        [wi for _, wi, _ in fold_rows],
                     )
+                else:
+                    n_max = max(len(wi) for _, wi, _ in fold_rows)
+                    X = np.zeros(
+                        (len(group), n_max) + group[0][0].windows.shape[1:],
+                        np.float32,
+                    )
+                    for i, (p, _) in enumerate(group):
+                        X[i, : len(fold_rows[i][1])] = p.windows[fold_rows[i][1]]
+                    predictions = self.trainer.predict_bucket(spec, stacked, X)
+            with self._phase("cv_score"):
+                for i, (plan, fold_idx) in enumerate(group):
+                    train_rows, window_idx, target_rows = fold_rows[i]
+                    y_true = plan.y_arr[target_rows]
+                    y_pred = predictions[i, : len(window_idx)]
+                    state = fold_state[plan.machine.name]
+                    self._accumulate_metric_scores(plan, y_true, y_pred, fold_idx)
+                    if plan.detector is not None:
+                        self._accumulate_thresholds(
+                            plan, y_true, y_pred, fold_idx, state,
+                            y_train=plan.y_arr[train_rows],
+                            test_rows=target_rows,
+                        )
 
     def _predict_windowed_group(
         self,
@@ -766,6 +824,7 @@ class FleetBuilder:
         for metric in metrics_list:
             name = metric.__name__.replace("_", "-")
             per_tag = None
+            vectorized = False
             try:
                 # One vectorized call for all tags (sklearn regression
                 # metrics support multioutput) instead of a Python loop of
@@ -773,9 +832,10 @@ class FleetBuilder:
                 per_tag = np.asarray(
                     metric(y_true_s, y_pred_s, multioutput="raw_values")
                 )
+                vectorized = per_tag.shape == (len(tags),)
             except TypeError:
                 pass
-            if per_tag is None or per_tag.shape != (len(tags),):
+            if not vectorized:
                 # Custom metrics may lack multioutput support — or swallow
                 # the kwarg and return something else entirely; only trust
                 # a correctly-shaped per-tag vector.
@@ -788,13 +848,45 @@ class FleetBuilder:
             for i, tag in enumerate(tags):
                 key = f"{name}-{tag.replace(' ', '-')}"
                 plan.cv_scores.setdefault(key, {})[fold_key] = float(per_tag[i])
+            # sklearn regression metrics aggregate with multioutput=
+            # "uniform_average" — the plain mean of the raw_values vector —
+            # so when the vectorized call succeeded the aggregate is free.
             plan.cv_scores.setdefault(name, {})[fold_key] = float(
-                metric(y_true_s, y_pred_s)
+                np.mean(per_tag) if vectorized else metric(y_true_s, y_pred_s)
             )
 
     @staticmethod
+    def _rolling_min_max(values: np.ndarray, window: int):
+        """
+        ``pd.rolling(window).min().max()`` in vectorized numpy — the
+        reference's threshold statistic (diff.py: max over time of the
+        min over each ``window``-long run), ~20× cheaper than building a
+        pandas object per (machine, fold). Matches pandas NaN semantics:
+        windows containing NaN (min_periods=window counts valid values)
+        are skipped by the NaN-aware max; no complete window → NaN.
+        Works on ``[n]`` (returns float) and ``[n, k]`` (returns ``[k]``).
+        """
+        values = np.asarray(values, np.float64)
+        if len(values) < window:
+            return (
+                np.nan if values.ndim == 1 else np.full(values.shape[1], np.nan)
+            )
+        mins = np.lib.stride_tricks.sliding_window_view(
+            values, window, axis=0
+        ).min(axis=-1)
+        if np.isnan(mins).any():
+            import warnings
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)  # all-NaN slice
+                out = np.nanmax(mins, axis=0)
+        else:
+            out = mins.max(axis=0)
+        return float(out) if values.ndim == 1 else out
+
+    @classmethod
     def _accumulate_thresholds(
-        plan, y_true, y_pred, fold_idx, state, y_train=None, test_rows=None
+        cls, plan, y_true, y_pred, fold_idx, state, y_train=None, test_rows=None
     ):
         detector = plan.detector
         # The fold model's scaler is fit on the fold-TRAIN targets
@@ -803,32 +895,33 @@ class FleetBuilder:
         scaler = sklearn_clone(detector.scaler).fit(
             y_train if y_train is not None else y_true
         )
-        scaled_mse = pd.Series(
-            np.mean(
-                np.square(scaler.transform(y_pred) - scaler.transform(y_true)), axis=1
-            )
+        scaled_mse = np.mean(
+            np.square(scaler.transform(y_pred) - scaler.transform(y_true)), axis=1
         )
-        mae = pd.DataFrame(np.abs(y_true - y_pred))
+        abs_err = np.abs(y_true - y_pred)
         if isinstance(detector, DiffBasedKFCVAnomalyDetector):
             # KFold test rows are scattered; keep them with their original
             # row positions so errors can be re-stitched chronologically
             # before window smoothing (the sequential path smooths in time
             # order — diff.py KFCV cross_validate).
             state.setdefault("kfcv_parts", []).append(
-                (np.asarray(test_rows), scaled_mse.to_numpy(), mae.to_numpy())
+                (np.asarray(test_rows), scaled_mse, abs_err)
             )
         else:
-            state["aggregate_threshold"] = float(scaled_mse.rolling(6).min().max())
-            tag_thresholds = mae.rolling(6).min().max()
-            tag_thresholds.name = f"fold-{fold_idx}"
+            state["aggregate_threshold"] = cls._rolling_min_max(scaled_mse, 6)
+            tag_thresholds = pd.Series(
+                cls._rolling_min_max(abs_err, 6), name=f"fold-{fold_idx}"
+            )
             state.setdefault("feature_folds", {})[f"fold-{fold_idx}"] = tag_thresholds
             state.setdefault("agg_folds", {})[f"fold-{fold_idx}"] = state[
                 "aggregate_threshold"
             ]
             if detector.window is not None:
-                smooth_agg = float(scaled_mse.rolling(detector.window).min().max())
-                smooth_tags = mae.rolling(detector.window).min().max()
-                smooth_tags.name = f"fold-{fold_idx}"
+                smooth_agg = cls._rolling_min_max(scaled_mse, detector.window)
+                smooth_tags = pd.Series(
+                    cls._rolling_min_max(abs_err, detector.window),
+                    name=f"fold-{fold_idx}",
+                )
                 state["smooth_aggregate_threshold"] = smooth_agg
                 state["smooth_feature_thresholds"] = smooth_tags
                 state.setdefault("smooth_feature_folds", {})[
@@ -916,7 +1009,8 @@ class FleetBuilder:
             if not members:
                 continue
             try:
-                results = self.trainer.train(members, config)
+                with self._phase("final_fit"):
+                    results = self.trainer.train(members, config)
             except Exception as exc:
                 for plan in member_plans:
                     self._fail(plan.machine.name, exc)

@@ -220,3 +220,41 @@ def test_fast_resample_path_matches_with_nan_boundary_bins(agg):
     slow_ds._resample_joined = lambda _: (_ for _ in ()).throw(ValueError("off"))
     slow = slow_ds._load_and_join()
     pd.testing.assert_frame_equal(fast, slow)
+
+
+class TestInterpolationParity:
+    """_interpolate_linear_limited must be bit-identical to pandas
+    DataFrame.interpolate(method='linear', limit=N) — it replaced the
+    pandas call on the product build path purely for speed."""
+
+    @pytest.mark.parametrize("limit", [1, 2, 8, 48])
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_pandas_on_random_nan_patterns(self, limit, seed):
+        from gordo_tpu.dataset.datasets import _interpolate_linear_limited
+
+        rng = np.random.RandomState(seed)
+        n, k = 300, 5
+        values = rng.standard_normal((n, k))
+        # random NaN runs incl. leading/trailing gaps and a full-NaN column
+        mask = rng.rand(n, k) < 0.4
+        mask[:7, 0] = True
+        mask[-9:, 1] = True
+        mask[:, 4] = True
+        values[mask] = np.nan
+        index = pd.date_range("2020-01-01", periods=n, freq="10min", tz="UTC")
+        frame = pd.DataFrame(values, index=index, columns=list("abcde"))
+
+        expected = frame.interpolate(method="linear", limit=limit)
+        actual = _interpolate_linear_limited(frame, limit)
+        pd.testing.assert_frame_equal(actual, expected)
+
+    def test_no_nan_frame_is_returned_unchanged(self):
+        from gordo_tpu.dataset.datasets import _interpolate_linear_limited
+
+        frame = pd.DataFrame(
+            np.arange(12.0).reshape(4, 3), columns=list("xyz")
+        )
+        pd.testing.assert_frame_equal(
+            _interpolate_linear_limited(frame, 3),
+            frame.interpolate(method="linear", limit=3),
+        )

@@ -1,4 +1,5 @@
 import numpy as np
+import pandas as pd
 import pytest
 
 from gordo_tpu import serializer
@@ -312,3 +313,38 @@ def test_cv_chunk_split_retry_isolates_bad_machine(monkeypatch):
     assert names == {"split-0", "split-2"}
     assert set(builder.build_errors) == {"split-1"}
     assert calls["n"] > 3  # the halving retry actually recursed
+
+
+class TestRollingMinMax:
+    """FleetBuilder._rolling_min_max replaced the per-(machine, fold)
+    pandas rolling(w).min().max() threshold statistic; parity with the
+    pandas expression is the contract (reference diff.py:196-212)."""
+
+    @pytest.mark.parametrize("window", [1, 6, 144])
+    @pytest.mark.parametrize("n", [4, 6, 150, 400])
+    def test_series_parity(self, window, n):
+        rng = np.random.RandomState(window * 1000 + n)
+        values = rng.rand(n)
+        expected = pd.Series(values).rolling(window).min().max()
+        actual = FleetBuilder._rolling_min_max(values, window)
+        if np.isnan(expected):
+            assert np.isnan(actual)
+        else:
+            assert actual == pytest.approx(expected, rel=1e-12)
+
+    @pytest.mark.parametrize("window", [6, 30])
+    def test_frame_parity(self, window):
+        rng = np.random.RandomState(7)
+        values = rng.rand(200, 4)
+        expected = pd.DataFrame(values).rolling(window).min().max().to_numpy()
+        actual = FleetBuilder._rolling_min_max(values, window)
+        np.testing.assert_allclose(actual, expected, rtol=1e-12)
+
+    def test_nan_windows_skipped_like_pandas(self):
+        values = np.array([1.0, 2.0, np.nan, 4.0, 5.0, 6.0, 7.0, 8.0])
+        expected = pd.Series(values).rolling(3).min().max()
+        actual = FleetBuilder._rolling_min_max(values, 3)
+        assert actual == pytest.approx(expected)
+
+    def test_all_nan_returns_nan(self):
+        assert np.isnan(FleetBuilder._rolling_min_max(np.full(10, np.nan), 3))
