@@ -73,8 +73,19 @@ def download_model(ctx, output_dir, target):
 @click.option("--parquet/--no-parquet", default=True, help="Parquet wire format")
 @click.option("--batch-size", default=100000, type=int)
 @click.option("--parallelism", default=10, type=int)
+@click.option(
+    "--fleet/--per-machine",
+    default=False,
+    help=(
+        "Score through the batch prediction/fleet route (one fused device "
+        "program per architecture, full anomaly frames) instead of one "
+        "anomaly POST per machine"
+    ),
+)
 @click.pass_context
-def predict(ctx, start, end, target, destination, parquet, batch_size, parallelism):
+def predict(
+    ctx, start, end, target, destination, parquet, batch_size, parallelism, fleet
+):
     """Replay [START, END] through deployed machines (the Argo client
     step's job)."""
     forwarder = ForwardPredictionsToDisk(destination) if destination else None
@@ -85,8 +96,16 @@ def predict(ctx, start, end, target, destination, parquet, batch_size, paralleli
         batch_size=batch_size,
         parallelism=parallelism,
     )
+    if fleet:
+        results = list(
+            client.fleet_anomaly_scores(
+                start, end, list(target) or None, full=True
+            ).values()
+        )
+    else:
+        results = client.predict(start, end, list(target) or None)
     failed = False
-    for result in client.predict(start, end, list(target) or None):
+    for result in results:
         n = len(result.predictions) if result.predictions is not None else 0
         click.echo(f"{result.name}: {n} rows, {len(result.error_messages)} errors")
         for msg in result.error_messages:

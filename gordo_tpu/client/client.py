@@ -189,6 +189,7 @@ class Client:
         start: Union[str, pd.Timestamp],
         end: Union[str, pd.Timestamp],
         targets: Optional[List[str]] = None,
+        full: bool = False,
     ) -> Dict[str, "PredictionResult"]:
         """
         Score many machines with ONE request via the server's batch
@@ -196,7 +197,10 @@ class Client:
         machine as a single fused device program (Pallas on TPU), instead
         of this client fanning one anomaly POST per machine. The lean wire
         format carries each machine's ``model-output`` columns plus the
-        ``total-anomaly-unscaled`` per-row mse.
+        ``total-anomaly-unscaled`` per-row mse; ``full=True`` requests the
+        complete anomaly frame per detector machine (tag/total anomalies,
+        confidence — the series set the reference's replay client writes
+        to Influx), still scored through the fused bucket.
         """
         machines = self.get_available_machines(targets)
         results: Dict[str, PredictionResult] = {}
@@ -243,7 +247,7 @@ class Client:
                 if not chunk_payload:
                     continue
                 try:
-                    body = self._post_fleet_request(chunk_payload)
+                    body = self._post_fleet_request(chunk_payload, full=full)
                 except Exception as exc:  # noqa: BLE001 - keep partials
                     msg = (
                         f"Fleet request for rows {chunk_start}-"
@@ -254,10 +258,19 @@ class Client:
                         errors_by_name.setdefault(name, []).append(msg)
                     continue
                 for name, entry in body.get("data", {}).items():
-                    frame = dataframe_from_dict(entry["model-output"])
-                    frame["total-anomaly-unscaled"] = dataframe_from_dict(
-                        {"mse": entry["total-anomaly-unscaled"]}
-                    )["mse"]
+                    if "total-anomaly-unscaled" in entry and not isinstance(
+                        next(iter(entry["total-anomaly-unscaled"].values()), None),
+                        dict,
+                    ):
+                        # lean entry: flat {ts: mse} + model-output columns
+                        frame = dataframe_from_dict(entry["model-output"])
+                        frame["total-anomaly-unscaled"] = dataframe_from_dict(
+                            {"mse": entry["total-anomaly-unscaled"]}
+                        )["mse"]
+                    else:
+                        # full anomaly frame (two-level column groups) —
+                        # same wire shape as the single anomaly route
+                        frame = dataframe_from_dict(entry)
                     frames_by_name.setdefault(name, []).append(frame)
                 for name, error in (body.get("errors") or {}).items():
                     errors_by_name.setdefault(name, []).append(
@@ -272,19 +285,39 @@ class Client:
                     ),
                     error_messages=errors_by_name.get(name, []),
                 )
+        if self.prediction_forwarder is not None:
+            # same forwarding contract as predict(): one call per machine
+            # with scored rows (the replay Job's Influx/parquet sink)
+            for machine in machines:
+                result = results.get(machine.name)
+                if (
+                    result is not None
+                    and result.predictions is not None
+                    and len(result.predictions)
+                ):
+                    self.prediction_forwarder.forward_predictions(
+                        result.predictions,
+                        machine=machine,
+                        metadata=self.metadata,
+                    )
         return results
 
-    def _post_fleet_request(self, payload: Dict[str, dict]) -> dict:
+    def _post_fleet_request(
+        self, payload: Dict[str, dict], full: bool = False
+    ) -> dict:
         """POST the batch body with the same transient-retry policy as the
         per-machine path; a 400 whose body carries the per-machine errors
         dict is a VALID outcome (every machine failed server-side), not an
         exception — the per-machine contract holds either way."""
         url = f"{self.base_url}/prediction/fleet"
+        request_body: Dict[str, object] = {"X": payload}
+        if full:
+            request_body["full"] = True
         last_exc: Optional[Exception] = None
         for attempt in range(max(1, self.n_retries)):
             try:
                 resp = self.session.post(
-                    url, json={"X": payload}, params=self._query_params()
+                    url, json=request_body, params=self._query_params()
                 )
                 if resp.status_code == 400:
                     try:

@@ -256,16 +256,25 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         X: pd.DataFrame,
         y: pd.DataFrame,
         frequency: Optional[timedelta] = None,
+        model_output: Optional[np.ndarray] = None,
     ) -> pd.DataFrame:
-        """Build the anomaly response DataFrame for ``X``/``y``."""
+        """
+        Build the anomaly response DataFrame for ``X``/``y``.
+
+        ``model_output`` short-circuits the base estimator's predict with
+        an already-computed reconstruction — the fleet serving route
+        scores whole spec buckets as one fused device program and then
+        assembles each machine's full anomaly frame from its slice.
+        """
         if not hasattr(X, "values"):
             raise ValueError("Unable to find X.values property")
 
-        model_output = (
-            self.predict(X)
-            if hasattr(self.base_estimator, "predict")
-            else self.transform(X)
-        )
+        if model_output is None:
+            model_output = (
+                self.predict(X)
+                if hasattr(self.base_estimator, "predict")
+                else self.transform(X)
+            )
 
         data = model_utils.make_base_dataframe(
             tags=X.columns,

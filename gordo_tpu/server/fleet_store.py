@@ -31,7 +31,7 @@ import numpy as np
 
 from .. import serializer
 from ..models.estimators import JaxBaseEstimator
-from ..models.spec import FeedForwardSpec
+from ..models.spec import FeedForwardSpec, LSTMSpec
 
 logger = logging.getLogger(__name__)
 
@@ -76,8 +76,8 @@ class RevisionFleet:
     """
     All models of one revision directory, loaded lazily but retained for
     the life of the revision (no per-request eviction thrash). Feedforward
-    estimators additionally join per-spec stacked buckets for fused
-    whole-fleet scoring.
+    and LSTM estimators additionally join per-spec stacked buckets for
+    fused whole-fleet scoring.
     """
 
     def __init__(self, collection_dir: str):
@@ -140,13 +140,14 @@ class RevisionFleet:
 
     # -- fused fleet scoring -------------------------------------------------
 
-    def feedforward_bucket(self, spec) -> Tuple[List[str], Any]:
+    def spec_bucket(self, spec) -> Tuple[List[str], Any]:
         """
-        The (names, stacked device params) bucket for one FeedForwardSpec,
-        built from every loaded model of that spec. Restacked only when the
-        bucket's membership changed since the last call. The stacking work
-        (host round-trip of every member's params) runs OUTSIDE the store
-        lock so concurrent single-model serving never stalls behind it.
+        The (names, stacked device params) bucket for one spec (feedforward
+        or LSTM), built from every loaded model of that spec. Restacked
+        only when the bucket's membership changed since the last call. The
+        stacking work (host round-trip of every member's params) runs
+        OUTSIDE the store lock so concurrent single-model serving never
+        stalls behind it.
         """
         from ..parallel.fleet import stack_member_params
 
@@ -176,6 +177,9 @@ class RevisionFleet:
             self._stacked[spec] = (names, stacked)
         return names, stacked
 
+    #: retained name from before LSTM buckets existed (r3 API)
+    feedforward_bucket = spec_bucket
+
     def loaded_specs(self) -> Dict[str, Any]:
         with self._lock:
             return dict(self._specs)
@@ -189,8 +193,8 @@ class RevisionFleet:
         transformers are applied here) returns ``(scores, errors)`` where
         ``scores[name] -> (reconstruction, per-row mse)`` and ``errors``
         records per-machine failures (a broken model never takes the batch
-        down). Feedforward models take the fused bucket path; any others
-        fall back to their own predict.
+        down). Feedforward AND windowed LSTM models take fused per-spec
+        bucket paths; any others fall back to their own predict.
         """
         errors: Dict[str, Exception] = {}
         loadable = []
@@ -209,11 +213,14 @@ class RevisionFleet:
 
         specs = self.loaded_specs()
         by_spec: Dict[Any, List[str]] = {}
+        by_lstm_spec: Dict[Any, List[str]] = {}
         fallback: List[str] = []
         for name in loadable:
             spec = specs.get(name)
             if isinstance(spec, FeedForwardSpec):
                 by_spec.setdefault(spec, []).append(name)
+            elif isinstance(spec, LSTMSpec):
+                by_lstm_spec.setdefault(spec, []).append(name)
             else:
                 fallback.append(name)
 
@@ -228,28 +235,12 @@ class RevisionFleet:
             return ((prediction[:, :width] - aligned[:, :width]) ** 2).mean(axis=-1)
 
         for spec, names in by_spec.items():
-            names = sorted(names)  # bucket order, so full requests match it
-            bucket_names, stacked = self.feedforward_bucket(spec)
-            rows = {n: i for i, n in enumerate(bucket_names)}
-            transformed = {}
-            for n in names:
-                try:
-                    transformed[n] = _host_transform(self._models[n], inputs[n])
-                except Exception as exc:  # noqa: BLE001 - per-machine isolation
-                    logger.warning("fleet_scores: transform failed for %s: %r", n, exc)
-                    errors[n] = exc
-            names = [n for n in names if n in transformed]
+            names, member_params, transformed = self._bucket_request(
+                spec, names, inputs, errors
+            )
             if not names:
                 continue
             b_max = max(arr.shape[0] for arr in transformed.values())
-            if names == bucket_names:
-                # Whole-bucket request (the replay/dashboard pattern):
-                # serve straight off the resident stack, no gather.
-                member_params = stacked
-            else:
-                member_params = jax.tree_util.tree_map(
-                    lambda a: a[np.asarray([rows[n] for n in names])], stacked
-                )
             X = np.zeros((len(names), b_max, spec.n_features), np.float32)
             for i, n in enumerate(names):
                 X[i, : transformed[n].shape[0]] = transformed[n]
@@ -258,6 +249,10 @@ class RevisionFleet:
                 b = transformed[n].shape[0]
                 r = recon[i, :b]
                 out[n] = (r, mse_vs_raw(r, np.asarray(inputs[n], np.float32)))
+        for spec, names in by_lstm_spec.items():
+            self._score_lstm_bucket(
+                spec, names, inputs, out, errors, mse_vs_raw
+            )
         for n in fallback:
             try:
                 model = self._models[n]
@@ -270,6 +265,95 @@ class RevisionFleet:
                 logger.warning("fleet_scores: predict failed for %s: %r", n, exc)
                 errors[n] = exc
         return out, errors
+
+    def _bucket_request(self, spec, names, inputs, errors):
+        """Shared bucket-request staging: sort into bucket order, apply
+        host transformers with per-machine error isolation, and gather the
+        requested members' stacked params (whole-bucket requests — the
+        replay/dashboard pattern — serve straight off the resident stack)."""
+        names = sorted(names)
+        bucket_names, stacked = self.spec_bucket(spec)
+        rows = {n: i for i, n in enumerate(bucket_names)}
+        transformed = {}
+        for n in names:
+            try:
+                transformed[n] = _host_transform(self._models[n], inputs[n])
+            except Exception as exc:  # noqa: BLE001 - per-machine isolation
+                logger.warning("fleet_scores: transform failed for %s: %r", n, exc)
+                errors[n] = exc
+        names = [n for n in names if n in transformed]
+        if not names:
+            return [], None, {}
+        if names == bucket_names:
+            member_params = stacked
+        else:
+            member_params = jax.tree_util.tree_map(
+                lambda a: a[np.asarray([rows[n] for n in names])], stacked
+            )
+        return names, member_params, transformed
+
+    _LSTM_SERVING_BATCH = 256  # window batch of the on-device gather scan
+
+    def _score_lstm_bucket(self, spec, names, inputs, out, errors, mse_vs_raw):
+        """
+        Fused LSTM scoring: every member's raw series stays ``[b, F]`` and
+        windows are gathered on device per scan batch
+        (parallel.fleet.fleet_windowed_predict_program) — one device
+        program for the whole bucket, same as the feedforward path.
+        Window counts honor each estimator's lookahead (the model-offset
+        contract), which is per-member data, not part of the compiled
+        shape.
+        """
+        from ..parallel.fleet import fleet_windowed_predict_program
+
+        names, member_params, transformed = self._bucket_request(
+            spec, names, inputs, errors
+        )
+        if not names:
+            return
+        lookback = spec.lookback_window
+        counts = {}
+        for n in names:
+            estimator = _find_estimator(self._models[n])
+            lookahead = getattr(estimator, "lookahead", 0)
+            count = transformed[n].shape[0] - lookback - lookahead + 1
+            if count <= 0:
+                errors[n] = ValueError(
+                    f"series of {transformed[n].shape[0]} rows too short for "
+                    f"lookback {lookback} (lookahead {lookahead})"
+                )
+            else:
+                counts[n] = count
+        kept = [n for n in names if n in counts]
+        if not kept:
+            return
+        if kept != names:
+            keep_rows = np.asarray([names.index(n) for n in kept])
+            member_params = jax.tree_util.tree_map(
+                lambda a: a[keep_rows], member_params
+            )
+        b_max = max(transformed[n].shape[0] for n in kept)
+        # series shorter than one window would make even the zero-padded
+        # gather read out of bounds
+        b_max = max(b_max, lookback)
+        batch = self._LSTM_SERVING_BATCH
+        nv_max = -(-max(counts.values()) // batch) * batch
+        series = np.zeros((len(kept), b_max, spec.n_features), np.float32)
+        order = np.zeros((len(kept), nv_max), np.int32)
+        for i, n in enumerate(kept):
+            series[i, : transformed[n].shape[0]] = transformed[n]
+            order[i, : counts[n]] = np.arange(counts[n])
+        predictions = np.asarray(
+            fleet_windowed_predict_program(spec, batch)(
+                member_params, series, order
+            )
+        )
+        for i, n in enumerate(kept):
+            prediction = predictions[i, : counts[n]]
+            out[n] = (
+                prediction,
+                mse_vs_raw(prediction, np.asarray(inputs[n], np.float32)),
+            )
 
 
 def use_pallas() -> bool:

@@ -80,8 +80,19 @@ def post_fleet_prediction(ctx, gordo_project: str):
     one request. Body ``{"X": {<model-name>: <dataframe-dict>}}``; models
     sharing an architecture are stacked and scored as one fused device
     program (Pallas kernel on TPU, XLA vmap elsewhere) instead of N
-    pickle-load + predict round trips. Response per model: ``model-output``
-    rows and the ``total-anomaly-unscaled`` per-row mse.
+    pickle-load + predict round trips.
+
+    Response per model, lean mode (default): ``model-output`` rows and the
+    ``total-anomaly-unscaled`` per-row mse. With ``?full`` (or body
+    ``{"full": true}``), anomaly-detector machines instead answer the FULL
+    anomaly frame — the same column groups the single-model
+    ``anomaly/prediction`` route emits (tag-anomaly scaled/unscaled,
+    totals, confidence; ``smooth-*`` kept only with ``?all_columns``) —
+    assembled from the fused reconstruction, so the Influx replay can
+    carry the reference client's complete series set
+    (reference argo-workflow.yml.template:1296-1410). ``y`` defaults to
+    ``X`` per machine (autoencoder replay); a body ``"y"`` dict overrides
+    per machine.
     """
     from types import SimpleNamespace
 
@@ -93,8 +104,13 @@ def post_fleet_prediction(ctx, gordo_project: str):
         raise server_utils.ServerError(
             'Fleet prediction needs a JSON body {"X": {<model-name>: frame}}'
         )
+    full = request.args.get("full") is not None or bool(body.get("full"))
+    keep_smooth = request.args.get("all_columns") is not None
+    y_payloads = body.get("y") if isinstance(body.get("y"), dict) else {}
 
     frames: Dict[str, pd.DataFrame] = {}
+    y_frames: Dict[str, pd.DataFrame] = {}
+    metadatas: Dict[str, Any] = {}
     errors: Dict[str, Dict[str, Any]] = {}
     for name, payload in body["X"].items():
         try:
@@ -106,6 +122,9 @@ def post_fleet_prediction(ctx, gordo_project: str):
             frames[name] = server_utils.verify_dataframe(
                 frame, [t.name for t in tags]
             )
+            metadatas[name] = metadata
+            if name in y_payloads:
+                y_frames[name] = server_utils.dataframe_from_dict(y_payloads[name])
         except FileNotFoundError:
             errors[name] = {"error": f"No such model found: '{name}'", "status": 404}
         except server_utils.ServerError as exc:
@@ -169,6 +188,7 @@ def post_fleet_prediction(ctx, gordo_project: str):
             formatted.append((index, keys))
             return keys
 
+        fleet = STORE.fleet(ctx.collection_dir) if full else None
         for name, (reconstruction, mse) in scores.items():
             index = frames[name].index
             recon = np.asarray(reconstruction)
@@ -180,6 +200,32 @@ def post_fleet_prediction(ctx, gordo_project: str):
                     "status": 500,
                 }
                 continue
+            if full:
+                try:
+                    entry, error = _full_anomaly_entry(
+                        fleet,
+                        name,
+                        frames[name],
+                        y_frames.get(name, frames[name]),
+                        metadatas[name],
+                        recon,
+                        keep_smooth,
+                    )
+                except Exception:  # noqa: BLE001 - per-machine isolation:
+                    # custom detectors run arbitrary code; one broken
+                    # machine must never 500 the batch (route contract)
+                    logger.exception("full anomaly assembly failed for %s", name)
+                    entry, error = None, {
+                        "error": "Anomaly assembly failed",
+                        "status": 500,
+                    }
+                if error is not None:
+                    errors[name] = error
+                    continue
+                if entry is not None:
+                    data[name] = entry
+                    continue
+                # not an anomaly detector: lean entry below
             keys = index_keys(index[len(index) - len(recon):])
             # direct dict assembly — same wire shape as
             # dataframe_to_dict(DataFrame(reconstruction)) with stringified
@@ -198,6 +244,64 @@ def post_fleet_prediction(ctx, gordo_project: str):
     if errors:
         context["errors"] = errors
     return ctx.json_response(context, status=200 if data else 400)
+
+
+def _full_anomaly_entry(
+    fleet, name, X, y, metadata, reconstruction, keep_smooth
+):
+    """
+    One machine's FULL anomaly response assembled from the fused-bucket
+    reconstruction: ``(entry, error)`` where ``entry`` is the wire dict
+    (None for non-detector models → caller falls back to the lean shape)
+    and ``error`` a per-machine error dict. The detector's threshold/
+    confidence math runs host-side exactly as in the single-model route;
+    only the predict was fused.
+    """
+    import inspect
+    from types import SimpleNamespace
+
+    from ...models.anomaly.base import AnomalyDetectorBase
+    from ..properties import get_frequency
+    from .anomaly import DELETED_FROM_RESPONSE_COLUMNS
+
+    model = fleet.model(name)
+    if not isinstance(model, AnomalyDetectorBase):
+        return None, None
+    try:
+        frequency = get_frequency(SimpleNamespace(metadata=metadata))
+    except (KeyError, TypeError, ValueError):
+        frequency = None
+    # signature inspection, not a TypeError probe: a custom detector whose
+    # anomaly() raises TypeError internally must surface it, not silently
+    # re-run unfused
+    kwargs = {"frequency": frequency}
+    try:
+        accepts_output = (
+            "model_output" in inspect.signature(model.anomaly).parameters
+        )
+    except (TypeError, ValueError):
+        accepts_output = False
+    if accepts_output:
+        kwargs["model_output"] = reconstruction
+    try:
+        anomaly_df = model.anomaly(X, y, **kwargs)
+    except AttributeError:
+        return None, {
+            "error": "Model has no thresholds (require_thresholds unmet)",
+            "status": 422,
+        }
+    except ValueError as exc:
+        return None, {"error": f"ValueError: {exc}", "status": 400}
+    if not keep_smooth:
+        # same drop set as the single-model anomaly route, by construction
+        anomaly_df = anomaly_df.drop(
+            columns=[
+                column
+                for column in anomaly_df
+                if column[0] in DELETED_FROM_RESPONSE_COLUMNS
+            ]
+        )
+    return server_utils.dataframe_to_dict(anomaly_df), None
 
 
 def delete_model_revision(ctx, gordo_project: str, gordo_name: str, revision: str):

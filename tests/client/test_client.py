@@ -225,3 +225,55 @@ def test_fleet_anomaly_scores_maps_error_body_per_machine(ml_server):
     for name, result in results.items():
         assert result.predictions is None
         assert any(f"boom {name}" in msg for msg in result.error_messages)
+
+
+def test_fleet_anomaly_scores_full_frames(ml_server):
+    """full=True answers complete anomaly frames for detector machines —
+    the series set the replay Job forwards (template: `predict --fleet`)."""
+    client = Client(project="client-project", session=ml_server)
+    results = client.fleet_anomaly_scores(START, END, full=True)
+    assert set(results) == {"machine-a", "machine-b"}
+    for result in results.values():
+        assert not result.error_messages
+        frame = result.predictions
+        assert frame is not None and len(frame) > 0
+        groups = (
+            set(frame.columns.get_level_values(0))
+            if hasattr(frame.columns, "get_level_values")
+            else set(frame.columns)
+        )
+        # detector machines carry the full column groups; a plain model
+        # would fall back to the lean pair
+        if "tag-anomaly-unscaled" in groups:
+            for needed in (
+                "model-output",
+                "tag-anomaly-scaled",
+                "total-anomaly-scaled",
+                "total-anomaly-unscaled",
+                "anomaly-confidence",
+            ):
+                assert needed in groups, f"missing {needed}: {groups}"
+        else:
+            assert "total-anomaly-unscaled" in groups
+
+
+def test_fleet_full_forwards_predictions(ml_server, tmp_path):
+    """fleet_anomaly_scores honors prediction_forwarder like predict()
+    does — the Influx/parquet sink of the `--fleet` replay path."""
+    from gordo_tpu.client.forwarders import ForwardPredictionsToDisk
+
+    client = Client(
+        project="client-project",
+        session=ml_server,
+        prediction_forwarder=ForwardPredictionsToDisk(str(tmp_path)),
+    )
+    results = client.fleet_anomaly_scores(START, END, full=True)
+    import os
+
+    written = sorted(os.listdir(tmp_path))
+    assert written == ["machine-a.parquet", "machine-b.parquet"]
+    import pandas as pd
+
+    frame = pd.read_parquet(tmp_path / "machine-a.parquet")
+    assert len(frame) == len(results["machine-a"].predictions)
+    assert any("total-anomaly-unscaled" in c for c in frame.columns)

@@ -287,3 +287,67 @@ def test_fleet_and_single_routes_share_wire_key_format(client, fleet_payload):
     first_tag = next(iter(body["model-output"]))
     single_keys = sorted(body["model-output"][first_tag])
     assert fleet_keys == single_keys
+
+
+def test_fleet_full_mode_matches_single_anomaly_route(client, sensor_payload):
+    """?full: detector machines answer the single anomaly route's column
+    groups, assembled from the fused reconstruction."""
+    single = client.post(
+        f"/gordo/v0/{PROJECT}/machine-1/anomaly/prediction", json=sensor_payload
+    )
+    assert single.status_code == 200, single.text
+    single_data = json.loads(single.data)["data"]
+
+    fleet = client.post(
+        f"/gordo/v0/{PROJECT}/prediction/fleet?full=1",
+        json={"X": {"machine-1": sensor_payload["X"]}},
+    )
+    assert fleet.status_code == 200, fleet.text
+    entry = json.loads(fleet.data)["data"]["machine-1"]
+
+    assert set(entry) == set(single_data)  # same column groups incl.
+    # tag-anomaly-*, total-anomaly-*, anomaly-confidence
+    for group in (
+        "model-input",
+        "model-output",
+        "tag-anomaly-scaled",
+        "tag-anomaly-unscaled",
+        "total-anomaly-scaled",
+        "total-anomaly-unscaled",
+        "anomaly-confidence",
+        "total-anomaly-confidence",
+    ):
+        assert group in entry, f"missing column group {group}"
+    # numeric parity with the single-model route (nested {col: {ts: v}}
+    # or flat {ts: v} — compare whatever shape the wire uses, recursively)
+    def assert_close(got, expected, path):
+        if isinstance(expected, dict):
+            assert set(got) == set(expected), path
+            for key in expected:
+                assert_close(got[key], expected[key], f"{path}/{key}")
+        else:
+            assert got == pytest.approx(expected, rel=1e-5, abs=1e-7), path
+
+    for group in ("total-anomaly-unscaled", "total-anomaly-scaled"):
+        assert_close(entry[group], single_data[group], group)
+
+
+def test_fleet_full_mode_non_detector_stays_lean(client, fleet_payload):
+    """machine-2 is a plain AE (no detector): full mode falls back to the
+    lean {model-output, total-anomaly-unscaled} shape for it."""
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/prediction/fleet?full=1",
+        json={"X": {"machine-2": fleet_payload["machine-2"]}},
+    )
+    assert resp.status_code == 200, resp.text
+    entry = json.loads(resp.data)["data"]["machine-2"]
+    assert set(entry) == {"model-output", "total-anomaly-unscaled"}
+
+
+def test_fleet_full_mode_drops_smooth_without_all_columns(client, sensor_payload):
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/prediction/fleet?full=1",
+        json={"X": {"machine-1": sensor_payload["X"]}},
+    )
+    entry = json.loads(resp.data)["data"]["machine-1"]
+    assert not any(key.startswith("smooth-") for key in entry)

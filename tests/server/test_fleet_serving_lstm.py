@@ -1,0 +1,162 @@
+"""
+Fused LSTM fleet serving: LSTMSpec models join per-spec stacked buckets
+(on-device window gathering) instead of falling back to sequential
+per-model predicts, and mixed FF/LSTM fleets score in one request.
+"""
+
+import json
+
+import numpy as np
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu import serializer
+from gordo_tpu.builder import local_build
+from gordo_tpu.server import build_app
+from gordo_tpu.server.fleet_store import RevisionFleet
+
+from .conftest import temp_env_vars
+
+PROJECT = "lstm-fleet-project"
+
+MIXED_CONFIG = """
+machines:
+  - name: lstm-ae-1
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-03T00:00:00+00:00"
+      tag_list: [tag-1, tag-2, tag-3]
+    model:
+      gordo_tpu.models.JaxLSTMAutoEncoder:
+        kind: lstm_model
+        lookback_window: 4
+        epochs: 1
+  - name: lstm-ae-2
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-03T00:00:00+00:00"
+      tag_list: [tag-4, tag-5, tag-6]
+    model:
+      gordo_tpu.models.JaxLSTMAutoEncoder:
+        kind: lstm_model
+        lookback_window: 4
+        epochs: 1
+  - name: lstm-forecast
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-03T00:00:00+00:00"
+      tag_list: [tag-7, tag-8, tag-9]
+    model:
+      gordo_tpu.models.JaxLSTMForecast:
+        kind: lstm_model
+        lookback_window: 4
+        epochs: 1
+  - name: dense-ae
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-03T00:00:00+00:00"
+      tag_list: [tag-1, tag-2, tag-3]
+    model:
+      gordo_tpu.models.JaxAutoEncoder:
+        kind: feedforward_hourglass
+        encoding_layers: 1
+        epochs: 1
+"""
+
+NAMES = ["lstm-ae-1", "lstm-ae-2", "lstm-forecast", "dense-ae"]
+
+
+@pytest.fixture(scope="module")
+def mixed_collection_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("lstm-fleet") / "1700000000000"
+    for model, machine in local_build(MIXED_CONFIG, project_name=PROJECT):
+        serializer.dump(
+            model, str(root / machine.name), metadata=machine.to_dict()
+        )
+    return str(root)
+
+
+@pytest.fixture(scope="module")
+def warmed_fleet(mixed_collection_dir):
+    fleet = RevisionFleet(mixed_collection_dir)
+    assert sorted(fleet.warm()) == sorted(NAMES)
+    return fleet
+
+
+def test_lstm_models_join_spec_buckets(warmed_fleet):
+    from gordo_tpu.models.spec import LSTMSpec
+
+    specs = warmed_fleet.loaded_specs()
+    lstm_specs = {n: s for n, s in specs.items() if isinstance(s, LSTMSpec)}
+    assert set(lstm_specs) == {"lstm-ae-1", "lstm-ae-2", "lstm-forecast"}
+    # identical architecture ⇒ ONE bucket regardless of lookahead
+    assert len(set(lstm_specs.values())) == 1
+    names, stacked = warmed_fleet.spec_bucket(next(iter(lstm_specs.values())))
+    assert names == ["lstm-ae-1", "lstm-ae-2", "lstm-forecast"]
+
+
+def test_fused_lstm_scores_match_sequential_predict(warmed_fleet):
+    rng = np.random.RandomState(3)
+    inputs = {
+        "lstm-ae-1": rng.rand(12, 3).astype(np.float32),
+        "lstm-ae-2": rng.rand(17, 3).astype(np.float32),  # ragged lengths
+        "lstm-forecast": rng.rand(12, 3).astype(np.float32),
+        "dense-ae": rng.rand(9, 3).astype(np.float32),
+    }
+    scores, errors = warmed_fleet.fleet_scores(inputs)
+    assert not errors
+    assert set(scores) == set(inputs)
+    for name in inputs:
+        model = warmed_fleet.model(name)
+        expected = np.asarray(model.predict(inputs[name]))
+        recon, mse = scores[name]
+        np.testing.assert_allclose(recon, expected, rtol=1e-4, atol=1e-5)
+        assert mse.shape == (len(expected),)
+    # the offset contract: AE output shorter by lookback-1, forecast by lookback
+    assert scores["lstm-ae-1"][0].shape[0] == 12 - 3
+    assert scores["lstm-forecast"][0].shape[0] == 12 - 4
+
+
+def test_too_short_series_is_per_machine_error(warmed_fleet):
+    rng = np.random.RandomState(4)
+    inputs = {
+        "lstm-ae-1": rng.rand(3, 3).astype(np.float32),  # < lookback rows
+        "lstm-ae-2": rng.rand(12, 3).astype(np.float32),
+    }
+    scores, errors = warmed_fleet.fleet_scores(inputs)
+    assert "lstm-ae-1" in errors and "lstm-ae-1" not in scores
+    assert "lstm-ae-2" in scores and "lstm-ae-2" not in errors
+
+
+def test_mixed_fleet_route(mixed_collection_dir):
+    with temp_env_vars(MODEL_COLLECTION_DIR=mixed_collection_dir):
+        client = Client(build_app(config={"EXPECTED_MODELS": NAMES}))
+        index = [
+            f"2020-03-01T00:{10 * j:02d}:00+00:00" for j in range(6)
+        ]
+        tag_groups = {
+            "lstm-ae-1": ["tag-1", "tag-2", "tag-3"],
+            "lstm-forecast": ["tag-7", "tag-8", "tag-9"],
+            "dense-ae": ["tag-1", "tag-2", "tag-3"],
+        }
+        payload = {
+            name: {
+                tag: {ts: 0.1 * i + 0.01 * j for j, ts in enumerate(index)}
+                for i, tag in enumerate(tags)
+            }
+            for name, tags in tag_groups.items()
+        }
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/prediction/fleet", json={"X": payload}
+        )
+        assert resp.status_code == 200, resp.text
+        body = json.loads(resp.data)
+        assert set(body["data"]) == set(tag_groups)
+        # model offsets survive the wire: 6 rows in, lookback 4
+        assert len(body["data"]["dense-ae"]["total-anomaly-unscaled"]) == 6
+        assert len(body["data"]["lstm-ae-1"]["total-anomaly-unscaled"]) == 6 - 3
+        assert len(body["data"]["lstm-forecast"]["total-anomaly-unscaled"]) == 6 - 4
