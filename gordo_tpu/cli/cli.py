@@ -15,6 +15,7 @@ termination-message path.
 planted fault code, deliberately not reproduced — SURVEY.md preamble.)
 """
 
+import json
 import logging
 import sys
 import traceback
@@ -519,6 +520,117 @@ def wait_for_models(
     click.echo(f"All {len(names)} models present in {models_dir}")
 
 
+@click.command("ensure-single-workflow")
+@click.argument("models-root", envvar="MODELS_ROOT")
+@click.argument("revision", envvar="PROJECT_REVISION")
+@click.option(
+    "--check-only", is_flag=True, help="Verify the lock without acquiring it"
+)
+def ensure_single_workflow(models_root: str, revision: str, check_only: bool):
+    """
+    Single-deployer guard on the shared model volume.
+
+    The reference's ensure-single-workflow Argo step kills OLDER concurrent
+    workflows of the same project before deploying
+    (argo-workflow.yml.template:47-104). This plane has no k8s API access
+    (by design — no kubectl, no RBAC), so the semantics invert: the STALE
+    deploy aborts itself. The lock file ``MODELS_ROOT/deploy.lock`` records
+    the newest deploying revision (atomic rename); any Job belonging to an
+    older revision fails this guard fast instead of interleaving its
+    writes with the newer deploy's. Same-revision acquires are idempotent,
+    so every shard Job of one deploy guards independently with no
+    ordering requirement between them.
+    """
+    import datetime as datetime_mod
+    import os
+    import tempfile
+    import time as time_mod
+
+    if not str(revision).isdigit():
+        raise click.ClickException(f"Revision must be numeric, got {revision!r}")
+    os.makedirs(models_root, exist_ok=True)
+    lock_path = os.path.join(models_root, "deploy.lock")
+
+    def read_lock():
+        try:
+            with open(lock_path) as f:
+                lock = json.load(f)
+        except FileNotFoundError:
+            return ""
+        except ValueError:
+            logger.warning("Corrupt deploy.lock at %s; overwriting", lock_path)
+            return ""
+        return str(lock.get("revision", "")) if isinstance(lock, dict) else ""
+
+    def fail_stale(held):
+        raise click.ClickException(
+            f"A newer deploy (revision {held}) owns {models_root}; "
+            f"this deploy (revision {revision}) is stale and must not write"
+        )
+
+    if check_only:
+        held = read_lock()
+        if held.isdigit() and int(held) > int(revision):
+            fail_stale(held)
+        click.echo(f"Lock check ok for revision {revision} (held: {held or 'none'})")
+        return
+
+    # The read-check-replace must not race a concurrent deploy (both could
+    # pass the check, then the OLDER one could land its lock last). mkdir
+    # is atomic on POSIX shares, so a guard directory serializes the
+    # critical section; a crashed holder's stale mutex is broken after
+    # a timeout (the section below is milliseconds long).
+    mutex = os.path.join(models_root, ".deploy.guard")
+    deadline = time_mod.monotonic() + 60
+    while True:
+        try:
+            os.mkdir(mutex)
+            break
+        except FileExistsError:
+            if time_mod.monotonic() > deadline:
+                raise click.ClickException(
+                    f"Could not acquire {mutex} within 60s; if no other "
+                    "deploy is running, remove the stale directory"
+                )
+            try:
+                age = time_mod.time() - os.stat(mutex).st_mtime
+                if age > 300:
+                    logger.warning("Breaking stale deploy mutex %s", mutex)
+                    os.rmdir(mutex)
+                    continue
+            except OSError:
+                pass
+            time_mod.sleep(0.5)
+    try:
+        held = read_lock()
+        if held.isdigit() and int(held) > int(revision):
+            fail_stale(held)
+        fd, tmp = tempfile.mkstemp(dir=models_root, prefix=".deploy.lock.")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(
+                    {
+                        "revision": str(revision),
+                        "acquired_at": datetime_mod.datetime.now(
+                            datetime_mod.timezone.utc
+                        ).isoformat(),
+                    },
+                    f,
+                )
+            os.replace(tmp, lock_path)  # atomic on the shared volume
+        except OSError:
+            try:
+                os.unlink(tmp)
+            finally:
+                raise
+    finally:
+        try:
+            os.rmdir(mutex)
+        except OSError:
+            pass
+    click.echo(f"Acquired deploy lock for revision {revision}")
+
+
 @click.command("cleanup-revisions")
 @click.argument("models-root", envvar="MODELS_ROOT")
 @click.argument("current-revision", envvar="PROJECT_REVISION")
@@ -588,6 +700,7 @@ gordo_tpu_cli.add_command(build)
 gordo_tpu_cli.add_command(build_fleet)
 gordo_tpu_cli.add_command(run_server_cli)
 gordo_tpu_cli.add_command(wait_for_models)
+gordo_tpu_cli.add_command(ensure_single_workflow)
 gordo_tpu_cli.add_command(cleanup_revisions)
 
 

@@ -358,3 +358,64 @@ def test_cleanup_revisions_orders_numerically(tmp_path):
     )
     assert result.exit_code == 0
     assert sorted(p.name for p in tmp_path.iterdir()) == ["1000"]
+
+
+class TestEnsureSingleWorkflow:
+    """The deploy-lock guard (reference ensure-single-workflow semantics,
+    inverted: the stale deploy aborts itself)."""
+
+    def _run(self, runner, root, revision, *extra):
+        return runner.invoke(
+            gordo_tpu_cli,
+            ["ensure-single-workflow", str(root), revision, *extra],
+        )
+
+    def test_fresh_acquire_writes_lock(self, runner, tmp_path):
+        result = self._run(runner, tmp_path, "1600000000000")
+        assert result.exit_code == 0, result.output
+        import json as json_mod
+
+        lock = json_mod.load(open(tmp_path / "deploy.lock"))
+        assert lock["revision"] == "1600000000000"
+
+    def test_same_revision_is_idempotent(self, runner, tmp_path):
+        assert self._run(runner, tmp_path, "1600000000000").exit_code == 0
+        assert self._run(runner, tmp_path, "1600000000000").exit_code == 0
+
+    def test_newer_revision_takes_over(self, runner, tmp_path):
+        assert self._run(runner, tmp_path, "1600000000000").exit_code == 0
+        assert self._run(runner, tmp_path, "1600000000001").exit_code == 0
+        import json as json_mod
+
+        lock = json_mod.load(open(tmp_path / "deploy.lock"))
+        assert lock["revision"] == "1600000000001"
+
+    def test_stale_revision_fails(self, runner, tmp_path):
+        assert self._run(runner, tmp_path, "1600000000001").exit_code == 0
+        result = self._run(runner, tmp_path, "1600000000000")
+        assert result.exit_code != 0
+        assert "stale" in result.output
+        # and the newer lock is untouched
+        import json as json_mod
+
+        lock = json_mod.load(open(tmp_path / "deploy.lock"))
+        assert lock["revision"] == "1600000000001"
+
+    def test_check_only_does_not_write(self, runner, tmp_path):
+        result = self._run(runner, tmp_path, "1600000000000", "--check-only")
+        assert result.exit_code == 0, result.output
+        assert not (tmp_path / "deploy.lock").exists()
+
+    def test_check_only_stale_fails(self, runner, tmp_path):
+        assert self._run(runner, tmp_path, "1600000000005").exit_code == 0
+        result = self._run(runner, tmp_path, "1600000000004", "--check-only")
+        assert result.exit_code != 0
+
+    def test_corrupt_lock_is_overwritten(self, runner, tmp_path):
+        (tmp_path / "deploy.lock").write_text("{not json")
+        result = self._run(runner, tmp_path, "1600000000000")
+        assert result.exit_code == 0, result.output
+
+    def test_non_numeric_revision_rejected(self, runner, tmp_path):
+        result = self._run(runner, tmp_path, "not-a-revision")
+        assert result.exit_code != 0

@@ -132,3 +132,73 @@ def test_runtime_env_fixture_reaches_builder():
         for e in job["spec"]["template"]["spec"]["containers"][0]["env"]
     }
     assert env["CUSTOM_FLAG"] == "on"
+
+
+def test_deploy_guard_and_builder_lock_check():
+    """The single-writer guard (reference ensure-single-workflow
+    semantics): a guard Job acquires the revision lock and every builder
+    pod re-checks it via an initContainer."""
+    config_path = os.path.join(DATA_DIR, FIXTURES[0])
+    docs = render(config_path)
+    guard_jobs = [
+        d
+        for d in docs
+        if d and d["kind"] == "Job" and d["metadata"]["name"].startswith("gordo-tpu-guard-")
+    ]
+    assert len(guard_jobs) == 1
+    (container,) = guard_jobs[0]["spec"]["template"]["spec"]["containers"]
+    assert container["command"] == ["gordo-tpu", "ensure-single-workflow"]
+    assert container["args"][1] == "1600000000000"
+
+    builders = [
+        d
+        for d in docs
+        if d and d["kind"] == "Job" and d["metadata"]["name"].startswith("gordo-fleet-")
+    ]
+    assert builders
+    for job in builders:
+        inits = job["spec"]["template"]["spec"]["initContainers"]
+        assert any(
+            c["command"] == ["gordo-tpu", "ensure-single-workflow"] for c in inits
+        ), "builder Job missing the revision-lock initContainer"
+
+
+def test_grafana_dashboards_provisioned():
+    """Grafana ships a provisioned per-project anomaly dashboard, not just
+    the datasource (reference: resources/grafana/dashboards)."""
+    config_path = os.path.join(DATA_DIR, FIXTURES[0])
+    docs = render(config_path)
+    (cm,) = [
+        d
+        for d in docs
+        if d
+        and d["kind"] == "ConfigMap"
+        and d["metadata"]["name"].startswith("gordo-grafana-dashboards-")
+    ]
+    provider = yaml.safe_load(cm["data"]["provider.yaml"])
+    assert provider["providers"][0]["type"] == "file"
+    dashboard = json.loads(cm["data"]["anomaly.json"])
+    assert dashboard["title"].startswith("fixture-proj")
+    queries = [
+        target["query"]
+        for panel in dashboard["panels"]
+        for target in panel.get("targets", [])
+    ]
+    assert any("total-anomaly-unscaled" in q for q in queries)
+    assert any("total-anomaly-confidence" in q for q in queries)
+
+    # and the statefulset mounts both the provider and the dashboards
+    grafana = [
+        d
+        for d in docs
+        if d
+        and d["kind"] == "StatefulSet"
+        and d["metadata"]["name"].startswith("gordo-grafana-")
+    ]
+    (sts,) = grafana
+    mounts = {
+        m["mountPath"]
+        for m in sts["spec"]["template"]["spec"]["containers"][0]["volumeMounts"]
+    }
+    assert "/etc/grafana/provisioning/dashboards" in mounts
+    assert "/var/lib/grafana/provisioned-dashboards" in mounts

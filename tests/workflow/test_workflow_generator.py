@@ -451,7 +451,7 @@ def test_per_revision_resources_get_fresh_names(config_file):
 def test_jobs_have_ttl(config_file):
     docs = generate(config_file, "--with-prediction-replay")
     jobs = by_kind(docs, "Job")
-    assert len(jobs) == 3  # builder + replay + cleanup
+    assert len(jobs) == 4  # deploy-guard + builder + replay + cleanup
     for job in jobs:
         assert job["spec"]["ttlSecondsAfterFinished"] == 7 * 24 * 3600
     (job,) = builder_jobs(generate(config_file, "--job-ttl-seconds", "60"))
