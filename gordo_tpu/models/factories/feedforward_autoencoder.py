@@ -30,11 +30,14 @@ def feedforward_model(
     optimizer: Union[str, OptimizerSpec] = "Adam",
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     compile_kwargs: Optional[Dict[str, Any]] = None,
+    compute_dtype: str = "float32",
     **kwargs,
 ) -> FeedForwardSpec:
     """
     Fully-specified feedforward AE: encoder layers then decoder layers, with
     an L1 activity penalty on every encoder layer except the first.
+    ``compute_dtype="bfloat16"`` runs params + activations in bf16 (losses
+    and outputs stay float32 — models/nn.py dtype contract).
     """
     n_features_out = n_features_out or n_features
     check_dim_func_len("encoding", encoding_dim, encoding_func)
@@ -56,6 +59,7 @@ def feedforward_model(
         l1_activity=l1 if any(l1) else (),
         optimizer=OptimizerSpec.from_config(optimizer, optimizer_kwargs),
         loss=compile_kwargs.get("loss", "mse"),
+        compute_dtype=compute_dtype,
     )
 
 

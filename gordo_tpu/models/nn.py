@@ -11,8 +11,17 @@ Initialization parity with Keras (so reference configs converge the same
 way): Dense kernels glorot_uniform + zero bias; LSTM input kernels
 glorot_uniform, recurrent kernels orthogonal, zero bias with unit forget
 gate bias.
+
+Dtype contract (``spec.compute_dtype``): mixed precision in the standard
+sense — parameters and optimizer moments always live in float32 (Adam
+updates are ~1e-4 of the param magnitude, far below bf16's 8-bit
+mantissa ULP; storing params in bf16 silently drops most updates and
+stalls training — measured: EV −0.02 vs 0.70 on the bf16 test fixture),
+while matmuls/activations cast to the compute dtype per use and the
+OUTPUT, losses and thresholds are always float32.
 """
 
+import os
 from typing import Dict, Tuple
 
 import jax
@@ -27,9 +36,22 @@ _glorot = jax.nn.initializers.glorot_uniform()
 _orthogonal = jax.nn.initializers.orthogonal()
 
 
+def _lstm_unroll() -> int:
+    """Unroll factor for the recurrent scan (GORDO_TPU_LSTM_UNROLL,
+    default 4): the LSTM fleet is per-scan-step overhead-bound (see the
+    roofline in docs/architecture.md), so fusing several timesteps into
+    one scan iteration amortizes the per-step cost without changing the
+    math."""
+    try:
+        return max(1, int(os.environ.get("GORDO_TPU_LSTM_UNROLL", 4)))
+    except ValueError:
+        return 4
+
+
 def init_feedforward(rng: jax.Array, spec: FeedForwardSpec) -> Params:
-    """Initialize params for a FeedForwardSpec."""
-    dtype = jnp.dtype(spec.compute_dtype)
+    """Initialize params for a FeedForwardSpec (always float32 — see the
+    module docstring's dtype contract)."""
+    dtype = jnp.float32
     params: Params = {}
     in_dim = spec.n_features
     for i, units in enumerate(spec.dims):
@@ -57,21 +79,37 @@ def forward_feedforward(
     activity regularization (zero when the spec has none) to be added to the
     training loss. XLA fuses the elementwise activations into the matmuls, so
     the whole stack is a handful of MXU ops.
+
+    Dtype contract: compute runs in ``spec.compute_dtype`` (bf16 halves
+    the HBM traffic the tiny-model regime is bound by — see
+    docs/architecture.md roofline); the OUTPUT and the penalty are always
+    float32, so losses, thresholds and the sklearn-facing predict keep
+    full precision regardless of compute dtype.
     """
-    penalty = jnp.zeros((), x.dtype)
-    h = x
+    dtype = jnp.dtype(spec.compute_dtype)
+
+    def cast(leaf):
+        return leaf.astype(dtype) if leaf.dtype != dtype else leaf
+
+    penalty = jnp.zeros((), jnp.float32)
+    h = cast(x)
     for i in range(len(spec.dims)):
         layer = params[f"dense_{i}"]
-        h = resolve_activation(spec.activations[i])(h @ layer["W"] + layer["b"])
+        h = resolve_activation(spec.activations[i])(
+            h @ cast(layer["W"]) + cast(layer["b"])
+        )
         if spec.l1_activity and spec.l1_activity[i]:
-            penalty = penalty + spec.l1_activity[i] * jnp.sum(jnp.abs(h))
-    out = h @ params["out"]["W"] + params["out"]["b"]
-    return resolve_activation(spec.out_activation)(out), penalty
+            penalty = penalty + spec.l1_activity[i] * jnp.sum(
+                jnp.abs(h), dtype=jnp.float32
+            )
+    out = h @ cast(params["out"]["W"]) + cast(params["out"]["b"])
+    return resolve_activation(spec.out_activation)(out).astype(jnp.float32), penalty
 
 
 def init_lstm(rng: jax.Array, spec: LSTMSpec) -> Params:
-    """Initialize params for an LSTMSpec (stacked LSTM + Dense head)."""
-    dtype = jnp.dtype(spec.compute_dtype)
+    """Initialize params for an LSTMSpec (stacked LSTM + Dense head);
+    always float32 like init_feedforward."""
+    dtype = jnp.float32
     params: Params = {}
     in_dim = spec.n_features
     for i, units in enumerate(spec.dims):
@@ -103,8 +141,13 @@ def _lstm_layer(
 
     The configured ``activation`` applies to both the candidate cell update
     and the output transform (Keras LSTM semantics); gates use sigmoid.
+    Compute dtype follows ``x_seq`` (the caller casts); f32 master params
+    are cast at use.
     """
     act = resolve_activation(activation)
+    dtype = x_seq.dtype
+    Wx, Wh = layer["Wx"].astype(dtype), layer["Wh"].astype(dtype)
+    b = layer["b"].astype(dtype)
     units = layer["Wh"].shape[0]
     batch = x_seq.shape[1]
     h0 = jnp.zeros((batch, units), x_seq.dtype)
@@ -112,18 +155,18 @@ def _lstm_layer(
 
     # Hoist the input projection out of the scan: one big [T*B, F] @ [F, 4H]
     # matmul keeps the MXU busy instead of T small ones.
-    x_proj = x_seq @ layer["Wx"] + layer["b"]
+    x_proj = x_seq @ Wx + b
 
     def step(carry, xp_t):
         h, c = carry
-        gates = xp_t + h @ layer["Wh"]
+        gates = xp_t + h @ Wh
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
         c_new = f * c + i * act(g)
         h_new = o * act(c_new)
         return (h_new, c_new), h_new
 
-    _, h_seq = jax.lax.scan(step, (h0, c0), x_proj)
+    _, h_seq = jax.lax.scan(step, (h0, c0), x_proj, unroll=_lstm_unroll())
     return h_seq
 
 
@@ -134,13 +177,23 @@ def forward_lstm(
     Forward pass on windows ``x`` of shape ``[batch, lookback, n_features]``
     → ``[batch, n_features_out]`` (many-to-one: last timestep's hidden state
     feeds the Dense head). Returns ``(output, activity_penalty=0)``.
+    Same dtype contract as :func:`forward_feedforward`: compute in
+    ``spec.compute_dtype``, float32 out.
     """
+    dtype = jnp.dtype(spec.compute_dtype)
+    if x.dtype != dtype:
+        x = x.astype(dtype)
     h_seq = jnp.transpose(x, (1, 0, 2))  # [time, batch, features] for scan
     for i in range(len(spec.dims)):
         h_seq = _lstm_layer(params[f"lstm_{i}"], h_seq, spec.activations[i])
     last_h = h_seq[-1]
-    out = last_h @ params["out"]["W"] + params["out"]["b"]
-    return resolve_activation(spec.out_activation)(out), jnp.zeros((), x.dtype)
+    out = last_h @ params["out"]["W"].astype(dtype) + params["out"]["b"].astype(
+        dtype
+    )
+    return (
+        resolve_activation(spec.out_activation)(out).astype(jnp.float32),
+        jnp.zeros((), jnp.float32),
+    )
 
 
 def init_fn_for(spec):

@@ -5,8 +5,18 @@ The fleet's models are hourglass MLPs a few tens of units wide, but the
 TPU MXU multiplies 128×128 tiles — a vmapped ``[B, 17] @ [17, 13]`` fleet
 spends one systolic pass per model with ~1% of each tile doing work.
 Packing G models into block-diagonal weights turns G passes into one:
-``[B, G·17] @ (G·17, G·13 block-diag)`` fills the tile laterally, so
-throughput scales ~G× until ``G·width`` reaches the 128-lane boundary.
+``[B, G·17] @ (G·17, G·13 block-diag)`` fills the tile laterally.
+
+What that buys in practice: the MXU-pass count drops ~G×, but the fleet
+regime is NOT matmul-bound — per training step the chip moves the f32
+params + Adam moments + gradients and the batch through HBM, and that
+elementwise/optimizer traffic is identical packed or unpacked (compact
+``[G, d_in, d_out]`` parameters, by design). Measured on a v5e, packing
+is worth ~1.1× end to end, consistent with the roofline arithmetic in
+docs/architecture.md — it is the matmul share of the step, not the whole
+step, that scales with G. The block-diagonal trick would approach its
+ideal ~G× only for compute-bound workloads (wider layers, bigger
+batches), which these fleet models deliberately are not.
 
 Parameters stay COMPACT: each layer's weights live as ``[G, d_in, d_out]``
 stacks (exactly a vmapped ``init_feedforward``), and the block-diagonal
@@ -140,19 +150,29 @@ def forward_packed(
     penalties (L1 over each member's block).
     """
     base = spec.base
-    penalties = jnp.zeros((spec.g,), x.dtype)
-    h = x
+    dtype = jnp.dtype(base.compute_dtype)
+
+    def cast(leaf):
+        return leaf.astype(dtype) if leaf.dtype != dtype else leaf
+
+    penalties = jnp.zeros((spec.g,), jnp.float32)
+    h = cast(x)
     for i in range(len(base.dims)):
         layer = params[f"dense_{i}"]
-        pre = h @ _block_diag(layer["W"]) + layer["b"].reshape(-1)
+        pre = h @ _block_diag(cast(layer["W"])) + cast(layer["b"]).reshape(-1)
         h = resolve_activation(base.activations[i])(pre)
         if base.l1_activity and base.l1_activity[i]:
             per_member = jnp.sum(
-                jnp.abs(h).reshape(h.shape[0], spec.g, base.dims[i]), axis=(0, 2)
+                jnp.abs(h).reshape(h.shape[0], spec.g, base.dims[i]),
+                axis=(0, 2),
+                dtype=jnp.float32,
             )
             penalties = penalties + base.l1_activity[i] * per_member
-    out = h @ _block_diag(params["out"]["W"]) + params["out"]["b"].reshape(-1)
-    return resolve_activation(base.out_activation)(out), penalties
+    out = h @ _block_diag(cast(params["out"]["W"])) + cast(
+        params["out"]["b"]
+    ).reshape(-1)
+    # float32 out regardless of compute dtype (models/nn.py dtype contract)
+    return resolve_activation(base.out_activation)(out).astype(jnp.float32), penalties
 
 
 def _per_model_losses(
@@ -272,7 +292,12 @@ def build_packed_fit_fn(spec: PackedFeedForwardSpec, config):
         means, totals = _per_model_losses(spec, out, y, w)
         return jnp.where(totals > 0, means, jnp.nan)
 
+    compute_dtype = jnp.dtype(spec.base.compute_dtype)
+
     def fit(params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng):
+        if compute_dtype != jnp.float32:
+            Xtr, ytr = Xtr.astype(compute_dtype), ytr.astype(compute_dtype)
+            Xval, yval = Xval.astype(compute_dtype), yval.astype(compute_dtype)
         has_val = Xval.shape[0] > 0
 
         def epoch_body(carry, erng):

@@ -302,7 +302,15 @@ def build_raw_fit_fn(spec: ModelSpec, config: FitConfig):
         out, _ = forward(spec, params, X)
         return weighted_mean_loss(per_sample(out, y), w)
 
+    compute_dtype = jnp.dtype(spec.compute_dtype)
+
     def fit(params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng):
+        if compute_dtype != jnp.float32:
+            # one on-device cast up front: the epoch scan then re-reads the
+            # half-width copy from HBM every step (the bandwidth the tiny-
+            # model regime is bound by), not the f32 staging buffer
+            Xtr, ytr = Xtr.astype(compute_dtype), ytr.astype(compute_dtype)
+            Xval, yval = Xval.astype(compute_dtype), yval.astype(compute_dtype)
         has_val = Xval.shape[0] > 0  # static branch: no-val fleets skip it
 
         fit_tail = _make_fit_loop(
@@ -420,7 +428,11 @@ def build_raw_windowed_fit_fn(spec: ModelSpec, config: FitConfig):
         )
         return jnp.where(wsum > 0, total / wsum, jnp.nan)
 
+    compute_dtype = jnp.dtype(spec.compute_dtype)
+
     def fit(params, opt_state, series, ytgt, order, wtr, wval, rng):
+        if compute_dtype != jnp.float32:
+            series, ytgt = series.astype(compute_dtype), ytgt.astype(compute_dtype)
         fit_tail = _make_fit_loop(
             config,
             train_epoch=lambda p, o, erng: train_epoch(
