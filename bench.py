@@ -19,7 +19,13 @@ can never zero the whole run:
 3. **lstm-fleet-train** — BASELINE.json parity configs #3/#4: 50-tag
    sliding-window LSTM autoencoder and forecast fleets with on-device
    window gathering. Rates land in the final line's extras.
-4. **reference baseline** — the reference engine's cost measured
+4. **parity** — the north star's correctness half: the same hourglass AE
+   trained on identical data by the reference's Keras/TF2 engine and by
+   the JAX engine, both wrapped in DiffBasedAnomalyDetector with the same
+   CV + threshold math; reports the anomaly-score MAE / correlation /
+   threshold deltas against the reference AND the reference's own
+   seed-to-seed envelope (gordo_tpu/compat/tf_parity.py).
+5. **reference baseline** — the reference engine's cost measured
    directly: the same architecture / optimizer / batch size / epochs
    trained with Keras/TF2 on CPU (the reference trains every model with
    CPU Keras inside its per-model k8s pod — SURVEY.md §2.9, BASELINE.md).
@@ -34,7 +40,8 @@ BENCH_LSTM_MODELS (64), BENCH_LSTM_TAGS (50), BENCH_LSTM_LOOKBACK (60),
 BENCH_LSTM_EPOCHS (5), BENCH_STAGE_TIMEOUT seconds (default 1500),
 BENCH_SKIP_TF_BASELINE=1 to reuse/skip the Keras measurement (cached in
 .bench_baseline.json), BENCH_SKIP_E2E=1 to skip stage 2,
-BENCH_SKIP_LSTM=1 to skip stage 3.
+BENCH_SKIP_LSTM=1 to skip stage 3, BENCH_SKIP_PARITY=1 to skip the
+parity stage, BENCH_PARITY_EPOCHS (150) / BENCH_PARITY_ENVELOPE (1).
 """
 
 import json
@@ -47,8 +54,14 @@ import traceback
 
 import numpy as np
 
-N_MODELS = int(os.environ.get("BENCH_MODELS", 256))
-N_E2E_MODELS = int(os.environ.get("BENCH_E2E_MODELS", N_MODELS))
+# 1024 models per fused program: the fleet regime is per-scan-step
+# overhead-bound (docs/architecture.md roofline), so per-step cost is
+# amortized over the model axis and models/hour scales ~linearly with
+# fleet size — the bench measures the design at its intended scale.
+N_MODELS = int(os.environ.get("BENCH_MODELS", 1024))
+# The north-star scale (BASELINE.md: 1000 AEs from one YAML in <10 min) is
+# the DEFAULT e2e demonstration, not an extrapolation from 256.
+N_E2E_MODELS = int(os.environ.get("BENCH_E2E_MODELS", 1000))
 N_EPOCHS = int(os.environ.get("BENCH_EPOCHS", 20))
 N_SAMPLES = int(os.environ.get("BENCH_SAMPLES", 1440))  # 10 days @ 10min
 N_TAGS = int(os.environ.get("BENCH_TAGS", 20))
@@ -337,6 +350,22 @@ def fleet_train() -> dict:
         packed_losses = [r.history.history["loss"][-1] for r in packed_results]
         assert all(np.isfinite(packed_losses)), "non-finite packed losses"
 
+    # Mixed-precision (bf16 compute, f32 master params): same fleet with
+    # compute_dtype=bfloat16 — in the HBM-bound regime the win is bounded
+    # by how much of the per-step traffic is activations/data vs the f32
+    # param+moment state (docs/architecture.md roofline).
+    bf16_elapsed = None
+    if os.environ.get("BENCH_BF16", "1") == "1":
+        bf16_spec = feedforward_hourglass(N_TAGS, compute_dtype="bfloat16")
+        bf16_members = [
+            FleetMember(name=f"m{i}", spec=bf16_spec, X=X, y=X, seed=i)
+            for i, X in enumerate(data)
+        ]
+        trainer.train(bf16_members, config)  # warmup/compile
+        bf16_elapsed, bf16_results = _timed_best(trainer, bf16_members, config)
+        bf16_losses = [r.history.history["loss"][-1] for r in bf16_results]
+        assert all(np.isfinite(bf16_losses)), "non-finite bf16 losses"
+
     # -- MFU arithmetic (all counted, none assumed; ADVICE.md r2) ----------
     # Dense-weight parameter count of one model:
     weight_elems = sum(
@@ -374,6 +403,11 @@ def fleet_train() -> dict:
             f"packed fleet: same workload in {packed_elapsed:.2f}s "
             f"({elapsed / packed_elapsed:.2f}x vs unpacked)"
         )
+    if bf16_elapsed is not None:
+        log(
+            f"bf16 fleet: same workload in {bf16_elapsed:.2f}s "
+            f"({elapsed / bf16_elapsed:.2f}x vs f32)"
+        )
     log(
         f"mfu arithmetic ({mode} run): W={weight_elems} dense weights/model, "
         f"n_padded={n_padded} (from {N_SAMPLES}), steps/epoch={steps_per_epoch}, "
@@ -393,6 +427,12 @@ def fleet_train() -> dict:
         ),
         "packed_speedup": (
             round(elapsed / packed_elapsed, 3) if packed_elapsed else None
+        ),
+        "bf16_elapsed_s": (
+            round(bf16_elapsed, 3) if bf16_elapsed is not None else None
+        ),
+        "bf16_speedup": (
+            round(elapsed / bf16_elapsed, 3) if bf16_elapsed else None
         ),
         "step_time_ms": round(step_time_s * 1e3, 4),
         "achieved_gflops": round(achieved / 1e9, 2),
@@ -464,14 +504,26 @@ def fleet_build_e2e() -> dict:
     if n_artifacts != N_E2E_MODELS:
         raise RuntimeError(f"expected {N_E2E_MODELS} artifacts, found {n_artifacts}")
 
+    phases = {k: round(v, 3) for k, v in sorted(builder.phase_seconds.items())}
+    device_s = sum(
+        phases.get(k, 0.0) for k in ("cv_train", "cv_predict", "final_fit")
+    )
+    host_s = max(elapsed - device_s, 0.0)
     log(
         f"e2e fleet build: {N_E2E_MODELS} machines (CV 3 folds + final fit "
         f"+ artifacts) in {elapsed:.2f}s on {_device_desc()}"
+    )
+    log(
+        f"e2e phases: {phases} -> device-program {device_s:.1f}s, "
+        f"host {host_s:.1f}s ({100 * host_s / elapsed:.0f}%)"
     )
     return {
         "models_per_hour": N_E2E_MODELS / (elapsed / 3600.0),
         "elapsed_s": round(elapsed, 3),
         "n_machines": N_E2E_MODELS,
+        "phases": phases,
+        "device_program_s": round(device_s, 3),
+        "host_s": round(host_s, 3),
         "device": _device_desc(),
     }
 
@@ -547,6 +599,50 @@ def lstm_fleet_train() -> dict:
     }
 
 
+# -- stage 2c: anomaly-score parity vs TF2 ---------------------------------
+
+
+@stage
+def parity() -> dict:
+    """
+    North-star correctness: train the same architecture with the
+    reference Keras engine and the JAX engine on identical data, same CV
+    and threshold math, and quantify anomaly-surface agreement. The
+    ``tf_envelope`` sub-record is the reference engine's own seed-to-seed
+    delta — the yardstick the tolerances were calibrated against
+    (gordo_tpu/compat/tf_parity.py).
+    """
+    from gordo_tpu.compat import tf_parity
+
+    _setup_jax_cache()
+    record = tf_parity.run_parity(
+        epochs=int(os.environ.get("BENCH_PARITY_EPOCHS", 150)),
+        measure_envelope=os.environ.get("BENCH_PARITY_ENVELOPE", "1") == "1",
+    )
+    log(
+        "parity: score rel MAE {:.3f} (corr {:.4f}), agg-threshold delta "
+        "{:.3f}, tag-threshold delta {:.3f} -> {}".format(
+            record["score_rel_mae"],
+            record["score_corr"],
+            record["agg_threshold_rel_delta"],
+            record["tag_threshold_mean_rel_delta"],
+            "PASS" if record["passes"] else "FAIL",
+        )
+    )
+    envelope = record.get("tf_envelope")
+    if envelope:
+        log(
+            "parity envelope (TF seed-to-seed): rel MAE {:.3f}, corr {:.4f}, "
+            "agg delta {:.3f}, tag delta {:.3f}".format(
+                envelope["score_rel_mae"],
+                envelope["score_corr"],
+                envelope["agg_threshold_rel_delta"],
+                envelope["tag_threshold_mean_rel_delta"],
+            )
+        )
+    return record
+
+
 # -- stage 3: reference Keras baseline -------------------------------------
 
 
@@ -600,6 +696,7 @@ def _emit_result(partial: dict) -> int:
     e2e = partial.get("fleet_build_e2e")
     lstm = partial.get("lstm_fleet_train")
     reference = partial.get("reference_keras")
+    parity_rec = partial.get("parity")
 
     # Headline = bare fleet throughput; fall back to the e2e number rather
     # than zeroing the round if only the bare stage flaked.
@@ -619,6 +716,7 @@ def _emit_result(partial: dict) -> int:
             "achieved_gflops": fleet["achieved_gflops"] if fleet else None,
             "mfu": fleet["mfu"] if fleet else None,
             "packed_speedup": fleet.get("packed_speedup") if fleet else None,
+            "bf16_speedup": fleet.get("bf16_speedup") if fleet else None,
             "e2e_models_per_hour": (
                 round(e2e["models_per_hour"], 1) if e2e else None
             ),
@@ -629,6 +727,29 @@ def _emit_result(partial: dict) -> int:
             ),
             "lstm_forecast_models_per_hour": (
                 lstm["lstm_forecast_models_per_hour"] if lstm else None
+            ),
+            "parity": (
+                {
+                    "score_rel_mae": round(parity_rec["score_rel_mae"], 4),
+                    "score_corr": round(parity_rec["score_corr"], 4),
+                    "agg_threshold_rel_delta": round(
+                        parity_rec["agg_threshold_rel_delta"], 4
+                    ),
+                    "tag_threshold_mean_rel_delta": round(
+                        parity_rec["tag_threshold_mean_rel_delta"], 4
+                    ),
+                    "passes": parity_rec["passes"],
+                    "tf_envelope": (
+                        {
+                            k: round(v, 4)
+                            for k, v in parity_rec["tf_envelope"].items()
+                        }
+                        if parity_rec.get("tf_envelope")
+                        else None
+                    ),
+                }
+                if parity_rec
+                else None
             ),
             "device": (fleet or e2e or lstm or {}).get("device"),
             "errors": {
@@ -668,6 +789,8 @@ def main():
         run_stage(partial, "fleet_build_e2e")
     if not os.environ.get("BENCH_SKIP_LSTM"):
         run_stage(partial, "lstm_fleet_train", retries=1)
+    if not os.environ.get("BENCH_SKIP_PARITY"):
+        run_stage(partial, "parity", retries=1)
     reference = run_stage(partial, "reference_keras", retries=0)
     if reference is None and os.path.exists(BASELINE_CACHE):
         with open(BASELINE_CACHE) as f:

@@ -28,6 +28,10 @@ TINY_ENV = {
     "BENCH_LSTM_EPOCHS": "1",
     "BENCH_FORCE_CPU": "1",
     "BENCH_STAGE_TIMEOUT": "300",
+    # the TF-vs-JAX parity stage has its own dedicated test
+    # (tests/models/test_parity_tf.py); at harness-test sizes it would
+    # just burn minutes of TF training
+    "BENCH_SKIP_PARITY": "1",
 }
 
 
