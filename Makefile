@@ -1,0 +1,15 @@
+# Convenience targets (CI runs the same commands directly)
+
+.PHONY: test docs bench lint
+
+test:
+	python -m pytest tests/ -q
+
+docs:
+	python docs/generate_api.py docs/api
+
+bench:
+	python bench.py
+
+lint:
+	python -m pytest tests/test_codestyle.py -q
