@@ -520,6 +520,84 @@ def wait_for_models(
     click.echo(f"All {len(names)} models present in {models_dir}")
 
 
+@click.command("score")
+@click.argument("model-dir", type=click.Path(exists=True, file_okay=False))
+@click.argument("output", type=click.Path(dir_okay=False, writable=True))
+@click.option("--input", "input_path", default=None, type=click.Path(exists=True),
+              help="Parquet/CSV of sensor columns to score (overrides --start/--end)")
+@click.option("--start", default=None, help="Score window start (ISO timestamp)")
+@click.option("--end", default=None, help="Score window end (ISO timestamp)")
+@click.option(
+    "--anomaly/--predict-only",
+    "with_anomaly",
+    default=True,
+    help="Emit the full anomaly frame (detector models) or raw predictions",
+)
+def score(
+    model_dir: str,
+    output: str,
+    input_path: Optional[str],
+    start: Optional[str],
+    end: Optional[str],
+    with_anomaly: bool,
+):
+    """
+    Batch-score a data window against a built model, no server needed —
+    backfills, migrations, ad-hoc investigations. Data comes from a
+    parquet/CSV file (``--input``) or from the machine's own dataset
+    config re-pointed at ``--start``/``--end`` (as the replay client
+    does). Output is one parquet of the anomaly frame (or raw
+    predictions) with pipe-flattened columns, the replay sink's format.
+
+    Long series on a multi-device host score through the ring
+    (time-sharded) path automatically: windowed models shard the time
+    axis over the mesh past ``GORDO_TPU_RING_PREDICT_ROWS`` rows
+    (parallel/sequence.py) — the host never materializes the lookback×
+    window blowup of a year-scale backfill.
+    """
+    import jax
+    import pandas as pd
+
+    from .. import serializer
+    from ..client.forwarders import flatten_columns
+    from ..dataset import GordoBaseDataset
+
+    model = serializer.load(model_dir)
+    metadata = serializer.load_metadata(model_dir)
+
+    if input_path:
+        if input_path.endswith(".csv"):
+            X = pd.read_csv(input_path, index_col=0, parse_dates=True)
+        else:
+            X = pd.read_parquet(input_path)
+        y = X  # file mode carries inputs only; autoencoder semantics
+    else:
+        if not (start and end):
+            raise click.ClickException("Provide --input or both --start/--end")
+        dataset_config = dict(metadata.get("dataset") or {})
+        if not dataset_config:
+            raise click.ClickException(
+                "Model metadata carries no dataset config; use --input"
+            )
+        dataset_config["train_start_date"] = start
+        dataset_config["train_end_date"] = end
+        # the dataset yields the machine's own targets, so machines with a
+        # distinct target_tag_list score against the right columns
+        X, y = GordoBaseDataset.from_dict(dataset_config).get_data()
+
+    logger.info("Scoring %d rows on %d device(s)", len(X), len(jax.devices()))
+    if with_anomaly and hasattr(model, "anomaly"):
+        frame = model.anomaly(X, y)
+    else:
+        values = model.predict(X)
+        index = X.index[len(X) - len(values):]
+        frame = pd.DataFrame(
+            values, index=index, columns=[str(i) for i in range(values.shape[1])]
+        )
+    flatten_columns(frame).to_parquet(output)
+    click.echo(f"Scored {len(frame)} rows -> {output}")
+
+
 @click.command("ensure-single-workflow")
 @click.argument("models-root", envvar="MODELS_ROOT")
 @click.argument("revision", envvar="PROJECT_REVISION")
@@ -551,7 +629,7 @@ def ensure_single_workflow(models_root: str, revision: str, check_only: bool):
     os.makedirs(models_root, exist_ok=True)
     lock_path = os.path.join(models_root, "deploy.lock")
 
-    def read_lock():
+    def read_lock() -> str:
         try:
             with open(lock_path) as f:
                 lock = json.load(f)
@@ -562,7 +640,7 @@ def ensure_single_workflow(models_root: str, revision: str, check_only: bool):
             return ""
         return str(lock.get("revision", "")) if isinstance(lock, dict) else ""
 
-    def fail_stale(held):
+    def fail_stale(held: str) -> None:
         raise click.ClickException(
             f"A newer deploy (revision {held}) owns {models_root}; "
             f"this deploy (revision {revision}) is stale and must not write"
@@ -700,6 +778,7 @@ gordo_tpu_cli.add_command(build)
 gordo_tpu_cli.add_command(build_fleet)
 gordo_tpu_cli.add_command(run_server_cli)
 gordo_tpu_cli.add_command(wait_for_models)
+gordo_tpu_cli.add_command(score)
 gordo_tpu_cli.add_command(ensure_single_workflow)
 gordo_tpu_cli.add_command(cleanup_revisions)
 

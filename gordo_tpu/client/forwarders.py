@@ -33,13 +33,19 @@ class PredictionForwarder(abc.ABC):
         ...
 
 
-def _flatten_columns(predictions: pd.DataFrame) -> pd.DataFrame:
-    """MultiIndex response columns as flat pipe-joined names — the format
-    both sink backends store."""
+def flatten_columns(predictions: pd.DataFrame) -> pd.DataFrame:
+    """MultiIndex response columns as flat pipe-joined names — THE sink
+    column format (disk/Influx forwarders and the `score` CLI all write
+    it; one definition so backfills always match the live sink schema).
+    Frames with flat columns pass through as a copy."""
     frame = predictions.copy()
     if isinstance(frame.columns, pd.MultiIndex):
         frame.columns = ["|".join(map(str, c)).rstrip("|") for c in frame.columns]
     return frame
+
+
+#: retained pre-r4 private name
+_flatten_columns = flatten_columns
 
 
 class ForwardPredictionsToDisk(PredictionForwarder):
