@@ -20,11 +20,6 @@ from typing import List, Optional
 # runnable from anywhere: the package lives next to docs/
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-#: modules whose import needs optional heavyweight deps; documented from
-#: source docstring only if import fails
-_OPTIONAL_HINTS = ("reporters.postgres", "reporters.mlflow", "compat")
-
-
 def public_modules(package_name: str = "gordo_tpu") -> List[str]:
     package = importlib.import_module(package_name)
     names = [package_name]
@@ -140,6 +135,12 @@ def generate(output_dir: str) -> List[str]:
     out = Path(output_dir)
     out.mkdir(parents=True, exist_ok=True)
     modules = public_modules()
+    # prune pages of deleted/renamed modules so the committed reference
+    # never documents modules that no longer exist
+    expected = {f"{name}.md" for name in modules} | {"index.md"}
+    for stale in out.glob("*.md"):
+        if stale.name not in expected:
+            stale.unlink()
     index = [
         "# gordo-tpu API reference",
         "",

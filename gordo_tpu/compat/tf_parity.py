@@ -111,7 +111,7 @@ class KerasReferenceAutoEncoder(BaseEstimator):
         model.compile(optimizer="adam", loss="mse")
         return model
 
-    def fit(self, X, y):
+    def fit(self, X, y) -> "KerasReferenceAutoEncoder":
         import tensorflow as tf
 
         X = np.asarray(getattr(X, "values", X), np.float32)
@@ -128,11 +128,11 @@ class KerasReferenceAutoEncoder(BaseEstimator):
         )
         return self
 
-    def predict(self, X):
+    def predict(self, X) -> np.ndarray:
         X = np.asarray(getattr(X, "values", X), np.float32)
         return np.asarray(self.model_.predict(X, verbose=0, batch_size=2048))
 
-    def score(self, X, y, sample_weight=None):
+    def score(self, X, y, sample_weight=None) -> float:
         out = self.predict(X)
         y = np.asarray(getattr(y, "values", y))
         return explained_variance_score(y, out)

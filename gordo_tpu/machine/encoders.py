@@ -14,7 +14,7 @@ from ..dataset.sensor_tag import SensorTag
 class MachineJSONEncoder(json.JSONEncoder):
     """Serializes datetimes (ISO), SensorTags, and numpy scalars/arrays."""
 
-    def default(self, obj):
+    def default(self, obj) -> object:
         if isinstance(obj, (datetime.datetime, datetime.date)):
             return obj.isoformat()
         if isinstance(obj, SensorTag):

@@ -214,7 +214,7 @@ class Machine:
 
     # -- reporting ----------------------------------------------------------
 
-    def report(self):
+    def report(self) -> None:
         """
         Run any reporters configured in ``runtime.reporters``. Deliberate
         late import to break the layering circle (reference:

@@ -51,7 +51,7 @@ class ValidUrlString(BaseDescriptor):
     def valid_url_string(cls, value: str) -> bool:
         return isinstance(value, str) and bool(cls._pattern.match(value))
 
-    def validate(self, value):
+    def validate(self, value) -> object:
         if not self.valid_url_string(value):
             raise ValueError(
                 f"{value!r} is not a valid name: must be lowercase alphanumeric "
@@ -63,7 +63,7 @@ class ValidUrlString(BaseDescriptor):
 class ValidModel(BaseDescriptor):
     """Model definition must be a dict that the serializer can build."""
 
-    def validate(self, value):
+    def validate(self, value) -> object:
         if not isinstance(value, dict):
             raise ValueError(f"Model definition must be a dict, got {type(value)}")
         from ..serializer import from_definition
@@ -76,7 +76,7 @@ class ValidModel(BaseDescriptor):
 
 
 class ValidDataset(BaseDescriptor):
-    def validate(self, value):
+    def validate(self, value) -> object:
         from ..dataset import GordoBaseDataset
 
         if isinstance(value, GordoBaseDataset):
@@ -87,7 +87,7 @@ class ValidDataset(BaseDescriptor):
 
 
 class ValidMetadata(BaseDescriptor):
-    def validate(self, value):
+    def validate(self, value) -> object:
         from .metadata import Metadata
 
         if value is None:
@@ -129,7 +129,7 @@ def fix_resource_limits(resources: dict) -> dict:
 
 
 class ValidMachineRuntime(BaseDescriptor):
-    def validate(self, value):
+    def validate(self, value) -> object:
         if not isinstance(value, dict):
             raise ValueError(f"Runtime must be a dict, got {type(value)}")
         value = copy.deepcopy(value)
@@ -145,7 +145,7 @@ class ValidMachineRuntime(BaseDescriptor):
 class ValidDatetime(BaseDescriptor):
     """Datetimes must be timezone-aware (reference: validators.py:234-253)."""
 
-    def validate(self, value):
+    def validate(self, value) -> object:
         if isinstance(value, str):
             value = dateutil.parser.isoparse(value)
         if not isinstance(value, datetime.datetime) or value.tzinfo is None:
@@ -154,14 +154,14 @@ class ValidDatetime(BaseDescriptor):
 
 
 class ValidTagList(BaseDescriptor):
-    def validate(self, value):
+    def validate(self, value) -> object:
         if not isinstance(value, (list, tuple)) or not value:
             raise ValueError("Requires a non-empty list of tags")
         return list(value)
 
 
 class ValidDataProvider(BaseDescriptor):
-    def validate(self, value):
+    def validate(self, value) -> object:
         from ..dataset import GordoBaseDataProvider
 
         if isinstance(value, GordoBaseDataProvider):

@@ -146,7 +146,7 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         y = np.asarray(getattr(y, "values", y))
         return explained_variance_score(y[-len(out):], out)
 
-    def fit(self, X, y):
+    def fit(self, X, y) -> "DiffBasedAnomalyDetector":
         if self.shuffle:
             X_s, y_s = sklearn_shuffle(X, y, random_state=0)
             self.base_estimator.fit(X_s, y_s)

@@ -88,7 +88,7 @@ def forward_feedforward(
     """
     dtype = jnp.dtype(spec.compute_dtype)
 
-    def cast(leaf):
+    def cast(leaf) -> jnp.ndarray:
         return leaf.astype(dtype) if leaf.dtype != dtype else leaf
 
     penalty = jnp.zeros((), jnp.float32)
@@ -196,7 +196,7 @@ def forward_lstm(
     )
 
 
-def init_fn_for(spec):
+def init_fn_for(spec) -> "object":
     if isinstance(spec, FeedForwardSpec):
         return init_feedforward
     if isinstance(spec, LSTMSpec):
@@ -204,7 +204,7 @@ def init_fn_for(spec):
     raise TypeError(f"No init function for spec type {type(spec).__name__}")
 
 
-def forward_fn_for(spec):
+def forward_fn_for(spec) -> "object":
     if isinstance(spec, FeedForwardSpec):
         return forward_feedforward
     if isinstance(spec, LSTMSpec):

@@ -2,14 +2,20 @@
 Static gates as tests — the stand-in for the reference's mypy/pyflakes
 pytest plugins and black-format test (reference pytest.ini and
 tests/test_formatting.py). The heavy tools aren't installed in this
-environment, so the always-on gates are stdlib AST/tokenize checks
-(syntax, unused imports, tab/trailing-whitespace hygiene); the real
-linters run too whenever they are importable.
+environment (and cannot be: no package installs), so the always-on
+gates are stdlib checks: syntax, unused imports, scope-aware
+undefined-name detection via ``symtable`` (the other high-signal
+pyflakes check), an annotation-coverage ratchet, and tab/trailing-
+whitespace hygiene. The real linters are pinned as the ``dev`` extra in
+pyproject.toml and their gates run whenever they are importable, so a
+normally-provisioned CI runs them for real.
 """
 
 import ast
+import builtins
 import io
 import os
+import symtable
 import tokenize
 
 import pytest
@@ -104,6 +110,108 @@ def test_no_unused_imports(path):
         if name not in visitor.used and name not in exported and name != "_"
     }
     assert not unused, f"unused imports in {path}: {unused}"
+
+
+#: names the interpreter injects at module scope
+_MODULE_DUNDERS = {
+    "__file__",
+    "__name__",
+    "__doc__",
+    "__package__",
+    "__spec__",
+    "__loader__",
+    "__path__",
+    "__builtins__",
+    "__debug__",
+    "__annotations__",
+    "__dict__",
+    "__class__",
+    "__module__",
+    "__qualname__",
+}
+_BUILTIN_NAMES = set(dir(builtins)) | _MODULE_DUNDERS
+
+
+def _undefined_names(path):
+    """Scope-aware undefined-name detection via the stdlib ``symtable``:
+    a referenced symbol that is neither assigned/imported/parameter in
+    its scope, nor a closure variable, nor defined at module scope, nor
+    a builtin, is a typo waiting for a rare code path."""
+    with open(path) as f:
+        source = f.read()
+    top = symtable.symtable(source, path, "exec")
+    module_defined = {
+        s.get_name()
+        for s in top.get_symbols()
+        if s.is_assigned() or s.is_imported() or s.is_namespace()
+    }
+    problems = []
+
+    def walk(table):
+        for sym in table.get_symbols():
+            name = sym.get_name()
+            if not sym.is_referenced():
+                continue
+            if (
+                sym.is_assigned()
+                or sym.is_imported()
+                or sym.is_parameter()
+                or sym.is_namespace()
+            ):
+                continue
+            if sym.is_free():
+                continue  # closure variable: defined in an enclosing scope
+            if name in module_defined or name in _BUILTIN_NAMES:
+                continue
+            problems.append((table.get_name(), table.get_lineno(), name))
+        for child in table.get_children():
+            walk(child)
+
+    walk(top)
+    return problems
+
+
+@pytest.mark.parametrize("path", FILES, ids=IDS)
+def test_no_undefined_names(path):
+    problems = _undefined_names(path)
+    assert not problems, f"undefined names in {path}: {problems}"
+
+
+def _public_function_annotation_coverage():
+    total, annotated = 0, 0
+    for path in FILES:
+        with open(path) as f:
+            tree = ast.parse(f.read(), path)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name.startswith("_"):
+                continue
+            total += 1
+            args = node.args
+            params = args.posonlyargs + args.args + args.kwonlyargs
+            params = [a for a in params if a.arg not in ("self", "cls")]
+            # zero-parameter functions count only via a return annotation
+            # (all([]) is vacuously true and would let them ratchet-dodge)
+            if node.returns is not None or (
+                params and all(a.annotation is not None for a in params)
+            ):
+                annotated += 1
+    return annotated, total
+
+
+def test_annotation_coverage_ratchet():
+    """Typing gate without mypy in the image: public functions must keep
+    at least the current level of annotation coverage (a return
+    annotation, or fully annotated parameters). Raise the floor as
+    coverage improves; never lower it."""
+    annotated, total = _public_function_annotation_coverage()
+    coverage = annotated / max(total, 1)
+    floor = 0.75
+    assert coverage >= floor, (
+        f"public-function annotation coverage fell to {coverage:.1%} "
+        f"({annotated}/{total}); the ratchet floor is {floor:.0%}"
+    )
 
 
 @pytest.mark.parametrize("path", FILES, ids=IDS)

@@ -25,9 +25,10 @@ def test_committed_api_reference_covers_every_public_module(tmp_path):
     generated = {p for p in os.listdir(tmp_path) if p.endswith(".md")}
     committed = {p for p in os.listdir(API_DIR) if p.endswith(".md")}
     missing = generated - committed
-    assert not missing, (
+    stale = committed - generated
+    assert not missing and not stale, (
         f"API reference out of date — run `make docs`. Missing pages: "
-        f"{sorted(missing)[:10]}"
+        f"{sorted(missing)[:10]}; stale pages: {sorted(stale)[:10]}"
     )
 
 
