@@ -66,8 +66,10 @@ N_EPOCHS = int(os.environ.get("BENCH_EPOCHS", 20))
 N_SAMPLES = int(os.environ.get("BENCH_SAMPLES", 1440))  # 10 days @ 10min
 N_TAGS = int(os.environ.get("BENCH_TAGS", 20))
 BATCH = 64
-# LSTM stage (BASELINE.json parity configs #3/#4: 50-tag sliding window)
-N_LSTM_MODELS = int(os.environ.get("BENCH_LSTM_MODELS", 64))
+# LSTM stage (BASELINE.json parity configs #3/#4: 50-tag sliding window).
+# 256 members: the recurrence is per-scan-step overhead-bound like the
+# dense fleet, so per-step cost amortizes across the vmapped member axis.
+N_LSTM_MODELS = int(os.environ.get("BENCH_LSTM_MODELS", 256))
 LSTM_TAGS = int(os.environ.get("BENCH_LSTM_TAGS", 50))
 LSTM_LOOKBACK = int(os.environ.get("BENCH_LSTM_LOOKBACK", 60))
 LSTM_EPOCHS = int(os.environ.get("BENCH_LSTM_EPOCHS", 5))
