@@ -1,0 +1,62 @@
+# gordo-tpu-base — the image every manifest in
+# gordo_tpu/workflow/workflow_generator/resources/tpu-workflow.yml.template
+# pins as {{ docker_registry }}/{{ docker_repository }}/gordo-tpu-base:
+# {{ gordo_version }} (fleet-shard Jobs, server Deployment, client replay,
+# cleanup Job). Reference analog: /root/reference/Dockerfile (python-slim
+# two-stage sdist build, non-root user, build.sh default command); the
+# TPU specifics — libtpu wheel, no CUDA, no argo binary — are this
+# image's own.
+#
+#   docker build -t gordo-tpu-base:$(python -c 'import gordo_tpu; print(gordo_tpu.__version__)') .
+
+# -- stage 1: pack the sdist ------------------------------------------------
+FROM python:3.12-slim-bookworm AS builder
+
+COPY . /code
+WORKDIR /code
+
+RUN pip install --no-cache-dir build \
+    && rm -rf /code/dist \
+    && python -m build --sdist \
+    && mv /code/dist/$(ls /code/dist | head -1) /code/dist/gordo-tpu-packed.tar.gz
+
+# -- stage 2: runtime -------------------------------------------------------
+FROM python:3.12-slim-bookworm
+
+# Non-root runtime user (pods run with runAsNonRoot; uid is what the
+# manifests' securityContext expects).
+RUN groupadd -g 999 gordo && useradd -r -u 999 -g gordo -m gordo
+ENV HOME=/home/gordo
+ENV PATH="${HOME}/.local/bin:${PATH}"
+
+# The heavy, slow-moving dependencies install in their own layer so a
+# source-only change rebuilds in seconds. jax[tpu] pulls libtpu from the
+# Google releases index — this is the only TPU-specific install step; the
+# same image runs CPU-only (tests, workflow generation, server) when no
+# TPU is attached, because JAX falls back to the CPU backend at runtime.
+RUN pip install --no-cache-dir \
+    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir \
+    numpy pandas scikit-learn optax pyarrow gunicorn prometheus_client
+
+COPY --from=builder /code/dist/gordo-tpu-packed.tar.gz .
+RUN pip install --no-cache-dir "gordo-tpu-packed.tar.gz[server,reporters]" \
+    && rm gordo-tpu-packed.tar.gz
+
+# Example configs ride along for smoke tests (reference bakes its
+# examples/ and resources/ the same way).
+COPY ./examples ${HOME}/examples
+
+# `build` as the default command: the fleet-shard Jobs in the rendered
+# workflow run the image with no args and expect a model build, exactly
+# like the reference's build.sh. Every other entrypoint (run-server,
+# workflow generate, client) is an explicit `gordo-tpu <subcommand>`
+# in its manifest.
+RUN printf '#!/bin/sh\nexec gordo-tpu build "$@"\n' > /usr/bin/build \
+    && chmod a+x /usr/bin/build
+
+WORKDIR ${HOME}
+RUN chown -R gordo:gordo ${HOME}
+USER 999
+
+CMD ["build"]

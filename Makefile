@@ -1,9 +1,17 @@
-# Convenience targets (CI runs the same commands directly)
+# Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test docs bench lint
+.PHONY: test test-fast docs bench lint image
 
 test:
 	python -m pytest tests/ -q
+
+# The sub-5-minute tier: everything except the compile-heavy JAX suites
+# (tests/parallel, tests/models) and slow-marked tests.
+test-fast:
+	bash scripts/tests.sh fast
+
+image:
+	docker build -t gordo-tpu-base:latest .
 
 docs:
 	python docs/generate_api.py docs/api

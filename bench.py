@@ -38,14 +38,18 @@ Env knobs: BENCH_MODELS (default 1024), BENCH_E2E_MODELS (default 1000),
 BENCH_EPOCHS (20), BENCH_SAMPLES (1440), BENCH_TAGS (20),
 BENCH_LSTM_MODELS (256), BENCH_LSTM_TAGS (50), BENCH_LSTM_LOOKBACK (60),
 BENCH_LSTM_EPOCHS (5), BENCH_STAGE_TIMEOUT seconds (default 1500),
-BENCH_SKIP_TF_BASELINE=1 to reuse/skip the Keras measurement (cached in
-.bench_baseline.json), BENCH_SKIP_E2E=1 to skip stage 2,
-BENCH_SKIP_LSTM=1 to skip stage 3, BENCH_SKIP_PARITY=1 to skip the
-parity stage, BENCH_PARITY_EPOCHS (150) / BENCH_PARITY_ENVELOPE (1).
+BENCH_BUDGET total wall-clock seconds (default 460 — stages are clamped
+to it and skipped once it runs out), BENCH_TIMED_RUNS best-of-n count,
+BENCH_REFRESH_BASELINE=1 to re-measure the Keras baseline instead of
+using .bench_baseline.json, BENCH_SKIP_E2E=1 / BENCH_SKIP_LSTM=1 /
+BENCH_SKIP_PARITY=1 to skip those stages, BENCH_PARITY_EPOCHS (150) /
+BENCH_PARITY_ENVELOPE (1; the TF-vs-TF envelope is cached in
+.bench_envelope.json).
 """
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -53,6 +57,23 @@ import time
 import traceback
 
 import numpy as np
+
+# -- global wall-clock budget ----------------------------------------------
+#
+# The driver runs `python bench.py` under its own hard timeout (round 4
+# died at rc=124 with no JSON line). The bench therefore keeps its OWN
+# deadline, strictly inside the driver's: every stage timeout is clamped
+# to the time remaining, stages that no longer fit are skipped with a
+# recorded reason, and SIGTERM/SIGINT emit the final JSON line from
+# whatever completed before exiting. The bench must be constitutionally
+# unable to end a round without an artifact.
+_T0 = time.time()
+BUDGET = int(os.environ.get("BENCH_BUDGET", 460))
+_EMIT_RESERVE = 10  # seconds kept back for writing the final JSON line
+
+
+def _remaining() -> float:
+    return BUDGET - (time.time() - _T0)
 
 # 1024 models per fused program: the fleet regime is per-scan-step
 # overhead-bound (docs/architecture.md roofline), so per-step cost is
@@ -76,6 +97,7 @@ LSTM_EPOCHS = int(os.environ.get("BENCH_LSTM_EPOCHS", 5))
 STAGE_TIMEOUT = int(os.environ.get("BENCH_STAGE_TIMEOUT", 1500))
 _HERE = os.path.dirname(os.path.abspath(__file__))
 BASELINE_CACHE = os.path.join(_HERE, ".bench_baseline.json")
+ENVELOPE_CACHE = os.path.join(_HERE, ".bench_envelope.json")
 PARTIAL_PATH = os.environ.get(
     "BENCH_PARTIAL_PATH", os.path.join(_HERE, ".bench_partial.json")
 )
@@ -125,6 +147,25 @@ def stage(fn):
     return fn
 
 
+# Stage sizes for the CPU-fallback regime (dead/wedged accelerator).
+# Full-size CPU runs blew round 4's driver budget (1000-machine e2e alone
+# was 357s on this 1-core host); these sizes keep the WHOLE bench under
+# ~6 minutes worst-case while still exercising every stage. setdefault
+# semantics: an explicit BENCH_* env from the operator wins.
+_CPU_SHRINK = {
+    "BENCH_MODELS": "128",
+    "BENCH_E2E_MODELS": "128",
+    "BENCH_LSTM_MODELS": "8",
+    "BENCH_TIMED_RUNS": "1",  # no tunnel jitter on CPU; one timed run
+}
+
+
+def _apply_cpu_shrink(env: dict) -> dict:
+    for key, value in _CPU_SHRINK.items():
+        env.setdefault(key, value)
+    return env
+
+
 def _run_stage_subprocess(name: str, timeout: int, force_cpu: bool):
     """One attempt: returns (result dict | None, error string | None)."""
     with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
@@ -132,6 +173,7 @@ def _run_stage_subprocess(name: str, timeout: int, force_cpu: bool):
     env = dict(os.environ)
     if force_cpu:
         env["BENCH_FORCE_CPU"] = "1"
+        _apply_cpu_shrink(env)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--stage", name, out_path],
@@ -156,11 +198,19 @@ def _run_stage_subprocess(name: str, timeout: int, force_cpu: bool):
     return payload, None
 
 
-def run_stage(partial: dict, name: str, timeout: int = STAGE_TIMEOUT, retries: int = 2):
+def _stage_budget(timeout: int) -> int:
+    """Clamp a stage timeout to the global deadline; <=0 means skip."""
+    return int(min(timeout, _remaining() - _EMIT_RESERVE))
+
+
+def run_stage(partial: dict, name: str, timeout: int = STAGE_TIMEOUT, retries: int = 1):
     """
     Run one bench stage with subprocess isolation, transient-error retry,
     and a final labeled CPU-backend attempt if the accelerator path hung.
-    Results/failures are recorded into ``partial`` and flushed either way.
+    Every attempt's timeout is clamped to the global deadline; a stage
+    that no longer fits is skipped with a recorded reason instead of
+    running past the driver's budget. Results/failures are recorded into
+    ``partial`` and flushed either way.
     """
 
     def record(error):
@@ -175,7 +225,19 @@ def run_stage(partial: dict, name: str, timeout: int = STAGE_TIMEOUT, retries: i
 
     last_error = None
     for attempt in range(retries + 1):
-        result, error = _run_stage_subprocess(name, timeout, force_cpu=False)
+        if _remaining() - _EMIT_RESERVE < 20:
+            # Budget exhausted — distinct from a small configured stage
+            # timeout, and never allowed to mask a real first-attempt
+            # error with a "skipped" message.
+            if last_error is None:
+                record(f"skipped: {_remaining():.0f}s left of {BUDGET}s budget")
+            else:
+                record(f"{last_error}; no budget left for a retry")
+            log(f"stage {name}: stopping (budget exhausted)")
+            return None
+        result, error = _run_stage_subprocess(
+            name, _stage_budget(timeout), force_cpu=False
+        )
         if result is not None:
             return accept(result)
         last_error = error
@@ -201,8 +263,12 @@ def run_stage(partial: dict, name: str, timeout: int = STAGE_TIMEOUT, retries: i
         "fleet_build_e2e",
         "lstm_fleet_train",
     ):
+        if _remaining() - _EMIT_RESERVE < 20:
+            record(f"{last_error}; cpu fallback skipped (budget exhausted)")
+            return None
+        fallback_timeout = _stage_budget(timeout)
         log(f"stage {name}: accelerator path failed; labeled CPU fallback")
-        result, error = _run_stage_subprocess(name, timeout, force_cpu=True)
+        result, error = _run_stage_subprocess(name, fallback_timeout, force_cpu=True)
         if result is not None:
             # keep the accelerator failure visible next to the CPU number
             partial[f"{name}_note"] = f"cpu fallback after: {last_error}"
@@ -243,9 +309,13 @@ def _flush_partial(partial: dict):
 # -- data -------------------------------------------------------------------
 
 
-def _timed_best(trainer, members, config, n=3):
+def _timed_best(trainer, members, config, n=None):
     """Best of n timed training runs: tunneled-accelerator transfer latency
-    varies ±50% run to run, so a single sample misreports the engine."""
+    varies ±50% run to run, so a single sample misreports the engine.
+    (The CPU-fallback regime sets BENCH_TIMED_RUNS=1 — no tunnel, no
+    jitter, and repeat runs there only burn the driver's budget.)"""
+    if n is None:
+        n = int(os.environ.get("BENCH_TIMED_RUNS", 3))
     best, results = None, None
     for _ in range(n):
         start = time.time()
@@ -278,6 +348,12 @@ def _device_desc() -> str:
 
 
 def _setup_jax_cache():
+    # CPU runs skip the persistent cache: XLA:CPU AOT entries embed the
+    # compile host's machine features, and loading them on a different
+    # host spams feature-mismatch errors (and risks SIGILL) — exactly the
+    # noise in round 4's rc=124 tail. TPU programs have no such coupling.
+    if os.environ.get("BENCH_FORCE_CPU"):
+        return
     import jax
 
     # Persistent compilation cache: the fleet program for a (spec, shape)
@@ -291,14 +367,16 @@ def _setup_jax_cache():
 
 @stage
 def backend_probe() -> dict:
-    """A ~second of real device work. If even this hangs, the accelerator
-    tunnel is wedged and every later stage should go straight to CPU
-    instead of burning a full stage timeout each first."""
+    """A pure host↔device transfer round trip — deliberately NO XLA
+    compile, so a live-but-cold accelerator answers in well under a
+    second (~2×67ms on the axon tunnel) and the probe timeout can be
+    short. If even this hangs, the tunnel is wedged and every later
+    stage should go straight to CPU instead of burning a full stage
+    timeout each first."""
     import jax
 
-    _setup_jax_cache()
-    x = jax.numpy.ones((256, 256))
-    value = float((x @ x).sum())
+    x = jax.device_put(np.arange(8, dtype=np.float32))
+    value = float(np.asarray(x).sum())
     return {"device": _device_desc(), "checksum": value}
 
 
@@ -592,7 +670,7 @@ def lstm_fleet_train() -> dict:
     # lands inside the stage timeout instead of zeroing the stage.
     n_lstm = N_LSTM_MODELS
     if jax.default_backend() != "tpu":
-        n_lstm = min(n_lstm, 32)
+        n_lstm = min(n_lstm, 8)
         log(f"lstm stage: CPU backend, capping fleet at {n_lstm} members")
 
     # shuffle=False: the product LSTM path pins it (estimators.py — the
@@ -628,7 +706,8 @@ def lstm_fleet_train() -> dict:
         # n=2: a ~30s program amortizes per-transfer jitter far better
         # than the millisecond feedforward runs, and best-of-3 here would
         # push the whole bench past a 10-minute budget
-        elapsed, results = _timed_best(trainer, fleet, config, n=2)
+        n_runs = min(2, int(os.environ.get("BENCH_TIMED_RUNS", 2)))
+        elapsed, results = _timed_best(trainer, fleet, config, n=n_runs)
         losses = [r.history.history["loss"][-1] for r in results]
         assert all(np.isfinite(losses)), f"non-finite {key} losses"
         rates[key] = n_lstm / (elapsed / 3600.0)
@@ -664,10 +743,35 @@ def parity() -> dict:
     from gordo_tpu.compat import tf_parity
 
     _setup_jax_cache()
+    epochs = int(os.environ.get("BENCH_PARITY_EPOCHS", 150))
+    # The envelope (TF-seed1-vs-TF-seed0) involves no JAX at all — it is a
+    # deterministic property of the reference engine, so measuring it once
+    # per parameter set and caching saves ~half the stage's TF training
+    # time on every later run.
+    want_envelope = os.environ.get("BENCH_PARITY_ENVELOPE", "1") == "1"
+    cached_envelope = None
+    if want_envelope:
+        try:
+            with open(ENVELOPE_CACHE) as f:
+                cached = json.load(f)
+            if cached.get("epochs") == epochs:
+                cached_envelope = cached["tf_envelope"]
+        except (OSError, ValueError, KeyError):
+            pass
     record = tf_parity.run_parity(
-        epochs=int(os.environ.get("BENCH_PARITY_EPOCHS", 150)),
-        measure_envelope=os.environ.get("BENCH_PARITY_ENVELOPE", "1") == "1",
+        epochs=epochs,
+        measure_envelope=want_envelope and cached_envelope is None,
     )
+    if cached_envelope is not None:
+        record["tf_envelope"] = {**cached_envelope, "from_cache": True}
+    elif want_envelope and record.get("tf_envelope"):
+        try:
+            with open(ENVELOPE_CACHE, "w") as f:
+                json.dump(
+                    {"epochs": epochs, "tf_envelope": record["tf_envelope"]}, f
+                )
+        except OSError:
+            pass
     log(
         "parity: score rel MAE {:.3f} (corr {:.4f}), agg-threshold delta "
         "{:.3f}, tag-threshold delta {:.3f} -> {}".format(
@@ -703,9 +807,16 @@ def reference_keras() -> dict:
     for one reference builder pod (1 CPU core pod in the reference's spec;
     we grant it the whole host CPU — a conservative baseline).
     """
-    if os.environ.get("BENCH_SKIP_TF_BASELINE") and os.path.exists(BASELINE_CACHE):
+    # The baseline is the reference engine's CPU cost — independent of the
+    # accelerator under test, so a cached measurement from an earlier run
+    # on this host is as good as a fresh one and costs zero budget.
+    # BENCH_REFRESH_BASELINE=1 forces a re-measure.
+    if not os.environ.get("BENCH_REFRESH_BASELINE") and os.path.exists(
+        BASELINE_CACHE
+    ):
         with open(BASELINE_CACHE) as f:
-            return json.load(f)
+            cached = json.load(f)
+        return {**cached, "from_cache": True}
 
     import tensorflow as tf
 
@@ -790,7 +901,7 @@ def _emit_result(partial: dict) -> int:
                     "passes": parity_rec["passes"],
                     "tf_envelope": (
                         {
-                            k: round(v, 4)
+                            k: round(v, 4) if isinstance(v, float) else v
                             for k, v in parity_rec["tf_envelope"].items()
                         }
                         if parity_rec.get("tf_envelope")
@@ -821,29 +932,61 @@ def main():
     if len(sys.argv) >= 4 and sys.argv[1] == "--stage":
         sys.exit(_stage_entry(sys.argv[2], sys.argv[3]))
 
-    partial: dict = {"n_models": N_MODELS, "epochs": N_EPOCHS}
+    partial: dict = {"n_models": N_MODELS, "epochs": N_EPOCHS, "budget_s": BUDGET}
+
+    # Backstop: if the driver's own timeout fires anyway (SIGTERM, or ^C
+    # interactively), emit the final JSON line from whatever stages
+    # completed instead of dying silently — round 4 ended rc=124 with no
+    # artifact precisely because nothing caught the kill.
+    def _on_signal(signum, frame):
+        log(f"signal {signum}: emitting result from completed stages")
+        partial["interrupted"] = f"signal {signum} at {time.time() - _T0:.0f}s"
+        _emit_result(partial)
+        os._exit(0)  # noqa: SLF001 - skip atexit; the JSON line is out
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
 
     # Pre-flight: a wedged accelerator tunnel hangs even trivial device
-    # work. Detect it once (short timeout) and pin the whole run to CPU
-    # rather than paying a full stage timeout per stage.
-    if not os.environ.get("BENCH_FORCE_CPU"):
-        probe = run_stage(partial, "backend_probe", timeout=240, retries=0)
+    # work. The probe is a pure transfer round trip (no XLA compile), so
+    # 30s is generous for a live tunnel; on failure the whole run pins to
+    # CPU with every stage auto-shrunk to fallback sizes.
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # Operator-pinned CPU run: same budget math as the fallback path.
+        _apply_cpu_shrink(os.environ)
+    else:
+        probe = run_stage(partial, "backend_probe", timeout=30, retries=0)
         if probe is None:
-            log("backend probe failed; forcing CPU for all stages")
+            log("backend probe failed; forcing CPU + shrunk stages")
             os.environ["BENCH_FORCE_CPU"] = "1"
+            _apply_cpu_shrink(os.environ)
             partial["backend_note"] = "accelerator unresponsive; ran on CPU"
+        elif "tpu" not in probe.get("device", "").lower():
+            # A healthy host with no accelerator (CI, laptops): the JAX
+            # CPU backend answers the probe fine, but full-size stages
+            # can no more fit the budget here than on the fallback path.
+            log(f"no accelerator ({probe.get('device')}); shrunk CPU stages")
+            _apply_cpu_shrink(os.environ)
+            os.environ["BENCH_FORCE_CPU"] = "1"
+            partial["backend_note"] = f"no accelerator; ran on {probe.get('device')}"
+    # Sizes may have been shrunk above — the artifact must describe the
+    # run that actually happened, not the import-time defaults.
+    partial["n_models"] = int(os.environ.get("BENCH_MODELS", N_MODELS))
 
+    # Stage order = audit priority: the headline number and the parity
+    # record land first so a budget squeeze costs the auxiliary rates,
+    # never the round's primary evidence.
     run_stage(partial, "fleet_train")
-    if not os.environ.get("BENCH_SKIP_E2E"):
-        run_stage(partial, "fleet_build_e2e")
-    if not os.environ.get("BENCH_SKIP_LSTM"):
-        run_stage(partial, "lstm_fleet_train", retries=1)
     if not os.environ.get("BENCH_SKIP_PARITY"):
-        run_stage(partial, "parity", retries=1)
+        run_stage(partial, "parity", retries=0)
     reference = run_stage(partial, "reference_keras", retries=0)
     if reference is None and os.path.exists(BASELINE_CACHE):
         with open(BASELINE_CACHE) as f:
             partial["reference_keras"] = {**json.load(f), "from_cache": True}
+    if not os.environ.get("BENCH_SKIP_E2E"):
+        run_stage(partial, "fleet_build_e2e")
+    if not os.environ.get("BENCH_SKIP_LSTM"):
+        run_stage(partial, "lstm_fleet_train", retries=1)
 
     sys.exit(_emit_result(partial))
 

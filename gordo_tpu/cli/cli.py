@@ -673,8 +673,43 @@ def ensure_single_workflow(models_root: str, revision: str, check_only: bool):
             try:
                 age = time_mod.time() - os.stat(mutex).st_mtime
                 if age > 300:
-                    logger.warning("Breaking stale deploy mutex %s", mutex)
-                    os.rmdir(mutex)
+                    # Break the stale guard via an atomic rename to a
+                    # unique name: exactly one waiter's rename succeeds
+                    # and only that winner removes the condemned dir.
+                    # stat-then-rmdir would let two waiters both pass the
+                    # age check, and the second rmdir could delete the
+                    # NEW holder's live mutex — the very
+                    # older-lock-lands-last race this guard exists to
+                    # prevent.
+                    condemned = (
+                        f"{mutex}.stale-{os.getpid()}-{time_mod.monotonic_ns()}"
+                    )
+                    try:
+                        os.rename(mutex, condemned)
+                    except OSError:
+                        pass  # another waiter already broke it
+                    else:
+                        # Between our stat and our rename another waiter
+                        # may have broken the stale guard AND a new deploy
+                        # acquired a fresh one — which our rename then
+                        # condemned. Re-check the age of what we actually
+                        # renamed and hand a young guard straight back.
+                        try:
+                            renamed_age = (
+                                time_mod.time() - os.stat(condemned).st_mtime
+                            )
+                        except OSError:
+                            renamed_age = None
+                        if renamed_age is not None and renamed_age <= 300:
+                            try:
+                                os.rename(condemned, mutex)
+                            except OSError:
+                                # the holder (or a waiter) already made a
+                                # new guard; release ours quietly
+                                os.rmdir(condemned)
+                        else:
+                            logger.warning("Broke stale deploy mutex %s", mutex)
+                            os.rmdir(condemned)
                     continue
             except OSError:
                 pass

@@ -1,49 +1,89 @@
 """
-Exception → exit-code mapping and JSON exception reports.
+Failure contracts for containerized CLI runs: exception → exit code, and
+a bounded JSON post-mortem for the k8s termination-message file.
 
-Reference parity: gordo/cli/exceptions_reporter.py — exception types are
-matched most-derived-first (sorted by inheritance depth), reports are
-trimmed to fit the 2024-byte k8s termination-message limit and scrubbed to
-ASCII.
+Contract parity with the reference (gordo/cli/exceptions_reporter.py):
+the most-derived registered exception type decides the exit code, report
+verbosity is one of EXIT_CODE/TYPE/MESSAGE/TRACEBACK, payloads are
+scrubbed to ASCII and trimmed to fit k8s's 2024-byte termination-message
+limit. The mechanism here is original: exit codes resolve by walking the
+raised type's own ``__mro__`` against a flat registry (no issubclass
+scans over a depth-sorted list), and reports are assembled by per-level
+field builders.
 """
 
 import json
 import traceback
-from collections import Counter
 from enum import Enum
 from types import TracebackType
 from typing import IO, Dict, Iterable, List, Optional, Tuple, Type
 
 from ..utils.text import replace_all_non_ascii_chars
 
+DEFAULT_EXIT_CODE = 1
+
+#: Room left in the termination message for the JSON syntax and keys
+#: around the payload strings.
+_ELLIPSIS = "..."
+
 
 class ReportLevel(Enum):
-    EXIT_CODE = 0
-    TYPE = 1
-    MESSAGE = 2
-    TRACEBACK = 3
+    """How much of a failure the termination report spells out."""
+
+    EXIT_CODE = 0  # empty report: the exit code itself is the message
+    TYPE = 1  # exception class name only
+    MESSAGE = 2  # class name + str(exception)
+    TRACEBACK = 3  # class name + formatted traceback tail
 
     @classmethod
     def get_by_name(
         cls, name: str, default: Optional["ReportLevel"] = None
     ) -> Optional["ReportLevel"]:
-        for level in cls:
-            if name == level.name:
-                return level
-        return default
+        return cls.__members__.get(name, default)
 
     @classmethod
     def get_names(cls) -> List[str]:
-        return [level.name for level in cls]
+        return list(cls.__members__)
 
 
-DEFAULT_EXIT_CODE = 1
+def _ascii(text: str) -> str:
+    return replace_all_non_ascii_chars(text, "?")
+
+
+def _clip(text: str, budget: int) -> str:
+    """``text`` within ``budget`` characters, ellipsized when cut; a
+    budget too small to hold anything beyond the ellipsis yields ''."""
+    if len(text) <= budget:
+        return text
+    kept = text[: budget - len(_ELLIPSIS)]
+    return kept + _ELLIPSIS if kept else ""
+
+
+def _traceback_tail(lines: List[str], budget: int) -> List[str]:
+    """The innermost traceback lines that fit ``budget``, with a leading
+    '...\\n' marker whenever outer frames were dropped."""
+    marker = "...\n"
+    if sum(map(len, lines)) <= budget:
+        return lines
+    tail: List[str] = []
+    used = len(marker)
+    for line in reversed(lines):
+        if used + len(line) > budget:
+            break
+        tail.append(line)
+        used += len(line)
+    return [marker] + tail[::-1]
 
 
 class ExceptionsReporter:
     """
-    Maps exception types to exit codes and writes a JSON report of a failure
-    — the payload a k8s pod leaves in its termination-message file.
+    Flat ``{exception type: exit code}`` registry with MRO-based
+    resolution, plus the JSON report writer for pod post-mortems.
+
+    Resolution walks the *raised* type's method resolution order and
+    takes the first registered class it meets — the most-derived
+    registered ancestor by construction, with no ordering requirements
+    on the registry itself.
     """
 
     def __init__(
@@ -52,67 +92,43 @@ class ExceptionsReporter:
         default_exit_code: int = DEFAULT_EXIT_CODE,
         traceback_limit: Optional[int] = None,
     ):
-        self.exceptions_items = self.sort_exceptions(exceptions)
+        self._registry: Dict[Type[BaseException], int] = dict(exceptions)
         self.default_exit_code = default_exit_code
         self.traceback_limit = traceback_limit
 
-    @staticmethod
-    def sort_exceptions(
-        exceptions: Iterable[Tuple[Type[Exception], int]]
-    ) -> List[Tuple[Type[Exception], int]]:
-        """
-        Order so the most-derived exception wins the ``issubclass`` scan: a
-        type that is a base of N other registered types sorts after them.
-        """
-        exceptions = list(exceptions)
-        inheritance_levels: Dict[Type[BaseException], int] = Counter()
-        for exc, _ in exceptions:
-            for other, _ in exceptions:
-                if other is not exc and issubclass(exc, other):
-                    inheritance_levels[other] += 1
-
-        def key(item):
-            exc, exit_code = item
-            return (inheritance_levels[exc], exit_code)
-
-        return sorted(exceptions, key=key)
-
-    @staticmethod
-    def trim_message(message: str, max_length: int) -> str:
-        if len(message) > max_length:
-            message = message[: max_length - 3]
-            return "" if len(message) <= 3 else message + "..."
-        return message
-
-    @staticmethod
-    def trim_formatted_traceback(
-        formatted_traceback: List[str], max_length: int
-    ) -> List[str]:
-        """Keep the tail of the traceback (innermost frames) within budget."""
-        if sum(len(line) for line in formatted_traceback) <= max_length:
-            return formatted_traceback
-        length = 4
-        result: List[str] = []
-        for line in reversed(formatted_traceback):
-            length += len(line)
-            if length > max_length:
-                result.append("...\n")
-                break
-            result.append(line)
-        return list(reversed(result))
-
-    def found_exception_item(self, exc_type: Type[BaseException]):
-        for item in self.exceptions_items:
-            if issubclass(exc_type, item[0]):
-                return item
+    def _resolve(
+        self, exc_type: Type[BaseException]
+    ) -> Optional[Type[BaseException]]:
+        for klass in exc_type.__mro__:
+            if klass in self._registry:
+                return klass
         return None
 
     def exception_exit_code(self, exc_type: Optional[Type[BaseException]]) -> int:
         """The ``sys.exit`` code for an exception type (0 for None)."""
         if exc_type is None:
             return 0
-        item = self.found_exception_item(exc_type)
-        return item[1] if item is not None else self.default_exit_code
+        match = self._resolve(exc_type)
+        return self._registry[match] if match else self.default_exit_code
+
+    # -- report assembly ----------------------------------------------------
+
+    def _message_field(self, exc_value, budget: Optional[int]) -> str:
+        text = _ascii(str(exc_value))
+        return _clip(text, budget) if budget is not None else text
+
+    def _traceback_field(
+        self, exc_type, exc_value, exc_traceback, budget: Optional[int]
+    ) -> str:
+        lines = [
+            _ascii(line)
+            for line in traceback.format_exception(
+                exc_type, exc_value, exc_traceback, limit=self.traceback_limit
+            )
+        ]
+        if budget is not None:
+            lines = _traceback_tail(lines, budget)
+        return "".join(lines)
 
     def report(
         self,
@@ -123,35 +139,27 @@ class ExceptionsReporter:
         report_file: IO[str],
         max_message_len: Optional[int] = None,
     ):
-        """Write the JSON report at the requested verbosity."""
-        report: Dict[str, str] = {}
-        if exc_type is not None and exc_value is not None and exc_traceback is not None:
-            if self.found_exception_item(exc_type) is not None:
-                if level in (
-                    ReportLevel.MESSAGE,
-                    ReportLevel.TYPE,
-                    ReportLevel.TRACEBACK,
-                ):
-                    report["type"] = replace_all_non_ascii_chars(exc_type.__name__, "?")
-                if level == ReportLevel.MESSAGE:
-                    report["message"] = replace_all_non_ascii_chars(str(exc_value), "?")
-                    if max_message_len is not None:
-                        report["message"] = self.trim_message(
-                            report["message"], max_message_len
-                        )
-                elif level == ReportLevel.TRACEBACK:
-                    formatted_traceback = traceback.format_exception(
-                        exc_type, exc_value, exc_traceback, limit=self.traceback_limit
+        """Write the JSON report at the requested verbosity. Exceptions
+        outside the registry (and the EXIT_CODE level) report ``{}`` —
+        the exit code already tells the orchestrator everything."""
+        payload: Dict[str, str] = {}
+        have_failure = (
+            exc_type is not None
+            and exc_value is not None
+            and exc_traceback is not None
+        )
+        if have_failure and level is not ReportLevel.EXIT_CODE:
+            if self._resolve(exc_type) is not None:
+                payload["type"] = _ascii(exc_type.__name__)
+                if level is ReportLevel.MESSAGE:
+                    payload["message"] = self._message_field(
+                        exc_value, max_message_len
                     )
-                    formatted_traceback = [
-                        replace_all_non_ascii_chars(v, "?") for v in formatted_traceback
-                    ]
-                    if max_message_len is not None:
-                        formatted_traceback = self.trim_formatted_traceback(
-                            formatted_traceback, max_message_len
-                        )
-                    report["traceback"] = "".join(formatted_traceback)
-        json.dump(report, report_file)
+                elif level is ReportLevel.TRACEBACK:
+                    payload["traceback"] = self._traceback_field(
+                        exc_type, exc_value, exc_traceback, max_message_len
+                    )
+        json.dump(payload, report_file)
 
     def safe_report(
         self,

@@ -258,10 +258,20 @@ class Client:
                         errors_by_name.setdefault(name, []).append(msg)
                     continue
                 for name, entry in body.get("data", {}).items():
-                    if "total-anomaly-unscaled" in entry and not isinstance(
-                        next(iter(entry["total-anomaly-unscaled"].values()), None),
-                        dict,
-                    ):
+                    # Lean vs full is decided by what the client ASKED for
+                    # plus the entry's column groups — never by sniffing
+                    # value nesting, which misreads a zero-row full frame
+                    # (empty series) as lean. Even under full=True the
+                    # server answers the lean shape for non-detector
+                    # machines, and those entries carry exactly the two
+                    # lean keys while a detector's anomaly frame always
+                    # includes further groups (total-anomaly-scaled,
+                    # anomaly-confidence, ...).
+                    lean = not full or set(entry) <= {
+                        "model-output",
+                        "total-anomaly-unscaled",
+                    }
+                    if lean:
                         # lean entry: flat {ts: mse} + model-output columns
                         frame = dataframe_from_dict(entry["model-output"])
                         frame["total-anomaly-unscaled"] = dataframe_from_dict(

@@ -81,7 +81,15 @@ def _interpolate_linear_limited(data: pd.DataFrame, limit: int) -> pd.DataFrame:
         gap_run = positions - prev_valid
         fill = nan_mask & (prev_valid >= 0) & (gap_run <= limit)
         column[fill] = filled[fill]
-    return pd.DataFrame(values, index=data.index, columns=data.columns)
+    result = pd.DataFrame(values, index=data.index, columns=data.columns)
+    # pandas.interpolate preserves per-column dtypes; the f64 work buffer
+    # must not leak into the result for e.g. float32 input frames, or the
+    # drop-in-replacement claim only holds for f64 callers. (Duplicate
+    # column labels keep the f64 frame — astype-by-dict can't address
+    # them, and the resample product path never produces duplicates.)
+    if data.columns.is_unique and any(dt != np.float64 for dt in data.dtypes):
+        result = result.astype(dict(zip(data.columns, data.dtypes)))
+    return result
 
 
 def normalize_frequency(resolution: str) -> str:

@@ -129,9 +129,11 @@ def _fill_weight_row(wtr, wval, i, n, member, config: FitConfig):
 _flat_concat = jax.jit(lambda *leaves: jnp.concatenate([l.ravel() for l in leaves]))
 
 #: _flat_concat compiles one XLA program per distinct (leaf count, shapes,
-#: dtypes) signature for the process lifetime; past this many leaves the
-#: coalescing falls back to plain device_get so a long-lived process with
-#: many heterogeneous buckets can't grow the jit cache unboundedly.
+#: dtypes) signature for the process lifetime; trees with more leaves than
+#: this are coalesced in chunks of this size rather than per-leaf — the
+#: largest fleets are exactly where per-leaf round trips (~70ms each over
+#: a tunneled accelerator) hurt most, while chunking keeps each program's
+#: signature bounded so the jit cache can't grow without limit.
 _FLAT_CONCAT_MAX_LEAVES = 256
 
 
@@ -159,29 +161,27 @@ def fetch_to_host(tree):
         # just means "replicate the global value", no reshaping).
         return multihost_utils.process_allgather(tree, tiled=True)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
-    if (
-        len(leaves) <= 1
-        or len(leaves) > _FLAT_CONCAT_MAX_LEAVES
-        or not all(isinstance(l, jax.Array) for l in leaves)
-    ):
+    if len(leaves) <= 1 or not all(isinstance(l, jax.Array) for l in leaves):
         return jax.device_get(tree)
     by_dtype: Dict[Any, List[int]] = {}
     for idx, leaf in enumerate(leaves):
         by_dtype.setdefault(leaf.dtype, []).append(idx)
     host_leaves: List[Any] = [None] * len(leaves)
     for idxs in by_dtype.values():
-        group = [leaves[i] for i in idxs]
-        flat = np.asarray(_flat_concat(*group))
-        offset = 0
-        for i, leaf in zip(idxs, group):
-            size = leaf.size
-            # copy: a view would pin the whole coalesced buffer for as
-            # long as any one leaf lives (e.g. one member's params kept in
-            # a FleetResult would retain every pack's)
-            host_leaves[i] = (
-                flat[offset : offset + size].reshape(leaf.shape).copy()
-            )
-            offset += size
+        for start in range(0, len(idxs), _FLAT_CONCAT_MAX_LEAVES):
+            chunk = idxs[start : start + _FLAT_CONCAT_MAX_LEAVES]
+            group = [leaves[i] for i in chunk]
+            flat = np.asarray(_flat_concat(*group))
+            offset = 0
+            for i, leaf in zip(chunk, group):
+                size = leaf.size
+                # copy: a view would pin the whole coalesced buffer for as
+                # long as any one leaf lives (e.g. one member's params kept
+                # in a FleetResult would retain every pack's)
+                host_leaves[i] = (
+                    flat[offset : offset + size].reshape(leaf.shape).copy()
+                )
+                offset += size
     return jax.tree_util.tree_unflatten(treedef, host_leaves)
 
 

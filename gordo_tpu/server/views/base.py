@@ -124,7 +124,15 @@ def post_fleet_prediction(ctx, gordo_project: str):
             )
             metadatas[name] = metadata
             if name in y_payloads:
-                y_frames[name] = server_utils.dataframe_from_dict(y_payloads[name])
+                # verify/reorder y exactly like the single-model route
+                # (extract_X_y): an unverified y dict with shuffled or
+                # wrong columns would silently misalign the detector's
+                # scaler.transform(y) instead of answering 400
+                target_tags = get_target_tags(SimpleNamespace(metadata=metadata))
+                y_frames[name] = server_utils.verify_dataframe(
+                    server_utils.dataframe_from_dict(y_payloads[name]),
+                    [t.name for t in target_tags],
+                )
         except FileNotFoundError:
             errors[name] = {"error": f"No such model found: '{name}'", "status": 404}
         except server_utils.ServerError as exc:

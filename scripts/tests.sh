@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+#
+# Per-layer test runner — the CI matrix entry point (reference analog:
+# /root/reference/scripts/tests.sh, which splits the suite into per-layer
+# jobs precisely so no single job pays the whole suite's wall time).
+#
+#   scripts/tests.sh <component>
+#
+# Components mirror the package layers, plus:
+#   fast     — the sub-5-minute tier: every layer EXCEPT the
+#              compile-heavy JAX suites (tests/parallel, tests/models)
+#              and everything marked slow. Tiering is by path, like the
+#              reference's, because compile cost tracks the directory
+#              (parallel/models jit real fleet programs; the rest is
+#              host-side logic).
+#   parallel — the compile-heavy fleet/mesh/distributed suite in its own
+#              job (~7 min single-core).
+#   models   — estimator/training/anomaly suites (JAX compiles, TF
+#              parity tests auto-skip without tensorflow).
+#   allelse  — anything not covered by a named component, so a new test
+#              directory can never silently fall out of CI.
+#   all      — the whole non-slow suite (what `make test` runs).
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# Tests force the CPU backend themselves (tests/conftest.py); the env
+# vars here only make that explicit for CI logs and virtualize an
+# 8-device mesh for the sharding suites.
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+
+run() { python -m pytest -q "$@"; }
+
+component="${1:-all}"
+case "$component" in
+    all)      run -m "not slow" tests/ ;;
+    fast)     run -m "not slow" tests/ --ignore=tests/parallel --ignore=tests/models ;;
+    parallel) run -m "not slow" tests/parallel ;;
+    models)   run -m "not slow" tests/models ;;
+    builder)  run -m "not slow" tests/builder ;;
+    cli)      run -m "not slow" tests/cli ;;
+    client)   run -m "not slow" tests/client ;;
+    dataset)  run -m "not slow" tests/dataset ;;
+    machine)  run -m "not slow" tests/machine ;;
+    ops)      run -m "not slow" tests/ops ;;
+    reporters) run -m "not slow" tests/reporters ;;
+    serializer) run -m "not slow" tests/serializer ;;
+    server)   run -m "not slow" tests/server ;;
+    utils)    run -m "not slow" tests/utils ;;
+    workflow) run -m "not slow" tests/workflow ;;
+    formatting) run tests/test_codestyle.py ;;
+    docs)     run tests/test_docs.py ;;
+    slow)     run -m "slow" tests/ ;;
+    allelse)
+        run -m "not slow" tests/ \
+            --ignore=tests/builder --ignore=tests/cli --ignore=tests/client \
+            --ignore=tests/dataset --ignore=tests/machine --ignore=tests/models \
+            --ignore=tests/ops --ignore=tests/parallel --ignore=tests/reporters \
+            --ignore=tests/serializer --ignore=tests/server --ignore=tests/utils \
+            --ignore=tests/workflow
+        ;;
+    *)
+        echo "unknown component: $component" >&2
+        exit 2
+        ;;
+esac

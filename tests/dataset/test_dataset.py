@@ -227,14 +227,15 @@ class TestInterpolationParity:
     DataFrame.interpolate(method='linear', limit=N) — it replaced the
     pandas call on the product build path purely for speed."""
 
+    @pytest.mark.parametrize("dtype", [np.float64, np.float32])
     @pytest.mark.parametrize("limit", [1, 2, 8, 48])
     @pytest.mark.parametrize("seed", [0, 1, 2])
-    def test_matches_pandas_on_random_nan_patterns(self, limit, seed):
+    def test_matches_pandas_on_random_nan_patterns(self, limit, seed, dtype):
         from gordo_tpu.dataset.datasets import _interpolate_linear_limited
 
         rng = np.random.RandomState(seed)
         n, k = 300, 5
-        values = rng.standard_normal((n, k))
+        values = rng.standard_normal((n, k)).astype(dtype)
         # random NaN runs incl. leading/trailing gaps and a full-NaN column
         mask = rng.rand(n, k) < 0.4
         mask[:7, 0] = True
@@ -246,6 +247,8 @@ class TestInterpolationParity:
 
         expected = frame.interpolate(method="linear", limit=limit)
         actual = _interpolate_linear_limited(frame, limit)
+        # dtype parity too: pandas preserves float32 frames; the f64 work
+        # buffer must not widen the result (check_dtype defaults to True)
         pd.testing.assert_frame_equal(actual, expected)
 
     def test_no_nan_frame_is_returned_unchanged(self):

@@ -48,7 +48,7 @@ def test_stage_subprocess_writes_json(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     payload = json.loads(out.read_text())
     assert "cpu" in payload["device"]
-    assert payload["checksum"] == 256.0**3  # (ones @ ones).sum()
+    assert payload["checksum"] == 28.0  # arange(8).sum() — transfer-only probe
 
 
 def test_full_run_emits_one_json_line_rc0(tmp_path):

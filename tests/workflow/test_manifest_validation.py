@@ -1,0 +1,88 @@
+"""
+Offline manifest-schema gate (the analog of the reference's `argo lint`
+dockertest — reference gordo/workflow/workflow_generator/helpers.py:66-99,
+tests/conftest.py:258-330): every fixture render must validate against
+the vendored k8s schemas, and a deliberately broken template must FAIL,
+proving the gate actually bites.
+"""
+
+import os
+
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from gordo_tpu.cli import gordo_tpu_cli
+from gordo_tpu.workflow.manifest_validation import validate_manifests
+from gordo_tpu.workflow.workflow_generator.workflow_generator import (
+    default_workflow_template,
+)
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+FIXTURES = sorted(f for f in os.listdir(DATA_DIR) if f.endswith(".yml"))
+
+
+def render(config_path, *extra):
+    result = CliRunner().invoke(
+        gordo_tpu_cli,
+        [
+            "workflow",
+            "generate",
+            "--machine-config",
+            config_path,
+            "--project-name",
+            "fixture-proj",
+            "--project-revision",
+            "1600000000000",
+            *extra,
+        ],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    return list(yaml.safe_load_all(result.output))
+
+
+@pytest.mark.parametrize("fixture", FIXTURES)
+def test_fixture_renders_validate_against_schemas(fixture):
+    docs = render(os.path.join(DATA_DIR, fixture))
+    errors = validate_manifests(docs)
+    assert not errors, "\n".join(errors)
+
+
+# Each case mutates the pristine template the way a real editing slip
+# would, and names the class of error the gate must catch.
+BREAKAGES = {
+    "misspelled-containers-key": ("containers:", "continers:"),
+    "wrong-deployment-apiversion": ("apiVersion: apps/v1\nkind: Deployment", "apiVersion: apps/v1beta1\nkind: Deployment"),
+    "dangling-volume-mount": ("- name: fleet-config", "- name: fleet-cfg"),
+    "bad-restart-policy": ("restartPolicy: Never", "restartPolicy: never"),
+}
+
+
+@pytest.mark.parametrize("breakage", sorted(BREAKAGES))
+def test_broken_template_fails_validation(breakage, tmp_path):
+    source = open(default_workflow_template()).read()
+    needle, replacement = BREAKAGES[breakage]
+    assert needle in source, f"breakage {breakage}: needle not in template"
+    broken = tmp_path / "broken.yml.template"
+    broken.write_text(source.replace(needle, replacement, 1))
+
+    docs = render(
+        os.path.join(DATA_DIR, FIXTURES[0]),
+        "--workflow-template",
+        str(broken),
+    )
+    errors = validate_manifests(docs)
+    assert errors, f"{breakage}: validation passed on a broken template"
+
+
+def test_unknown_kind_is_an_error():
+    docs = [
+        {
+            "apiVersion": "v1",
+            "kind": "Gadget",
+            "metadata": {"name": "x"},
+        }
+    ]
+    errors = validate_manifests(docs)
+    assert errors and "unknown kind" in errors[0]
