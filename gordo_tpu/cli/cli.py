@@ -654,66 +654,84 @@ def ensure_single_workflow(models_root: str, revision: str, check_only: bool):
         return
 
     # The read-check-replace must not race a concurrent deploy (both could
-    # pass the check, then the OLDER one could land its lock last). mkdir
-    # is atomic on POSIX shares, so a guard directory serializes the
-    # critical section; a crashed holder's stale mutex is broken after
-    # a timeout (the section below is milliseconds long).
+    # pass the check, then the OLDER one could land its lock last). The
+    # guard is a directory that is NEVER empty — acquirers stage
+    # ``<unique>/held`` and atomically rename it onto the mutex path —
+    # because POSIX rename replaces an EMPTY directory target silently
+    # but fails (ENOTEMPTY) on a non-empty one. That one property makes
+    # both acquisition (can't steal a live guard) and stale-break
+    # restoration (can't clobber a successor's guard) atomic; a crashed
+    # holder's stale guard is broken after a timeout (the critical
+    # section below is milliseconds long).
     mutex = os.path.join(models_root, ".deploy.guard")
-    deadline = time_mod.monotonic() + 60
-    while True:
-        try:
-            os.mkdir(mutex)
-            break
-        except FileExistsError:
-            if time_mod.monotonic() > deadline:
-                raise click.ClickException(
-                    f"Could not acquire {mutex} within 60s; if no other "
-                    "deploy is running, remove the stale directory"
-                )
+
+    def _unique(suffix: str) -> str:
+        return f"{mutex}.{suffix}-{os.getpid()}-{time_mod.monotonic_ns()}"
+
+    def _remove_guard(path: str) -> None:
+        for entry in ("held", ""):
             try:
-                age = time_mod.time() - os.stat(mutex).st_mtime
-                if age > 300:
-                    # Break the stale guard via an atomic rename to a
-                    # unique name: exactly one waiter's rename succeeds
-                    # and only that winner removes the condemned dir.
-                    # stat-then-rmdir would let two waiters both pass the
-                    # age check, and the second rmdir could delete the
-                    # NEW holder's live mutex — the very
-                    # older-lock-lands-last race this guard exists to
-                    # prevent.
-                    condemned = (
-                        f"{mutex}.stale-{os.getpid()}-{time_mod.monotonic_ns()}"
-                    )
-                    try:
-                        os.rename(mutex, condemned)
-                    except OSError:
-                        pass  # another waiter already broke it
-                    else:
-                        # Between our stat and our rename another waiter
-                        # may have broken the stale guard AND a new deploy
-                        # acquired a fresh one — which our rename then
-                        # condemned. Re-check the age of what we actually
-                        # renamed and hand a young guard straight back.
-                        try:
-                            renamed_age = (
-                                time_mod.time() - os.stat(condemned).st_mtime
-                            )
-                        except OSError:
-                            renamed_age = None
-                        if renamed_age is not None and renamed_age <= 300:
-                            try:
-                                os.rename(condemned, mutex)
-                            except OSError:
-                                # the holder (or a waiter) already made a
-                                # new guard; release ours quietly
-                                os.rmdir(condemned)
-                        else:
-                            logger.warning("Broke stale deploy mutex %s", mutex)
-                            os.rmdir(condemned)
-                    continue
+                os.rmdir(os.path.join(path, entry) if entry else path)
             except OSError:
                 pass
-            time_mod.sleep(0.5)
+
+    def _try_acquire() -> bool:
+        staging = _unique("acquire")
+        os.mkdir(staging)
+        os.mkdir(os.path.join(staging, "held"))
+        try:
+            # Fails while ANY guard (always non-empty) sits at the path.
+            os.rename(staging, mutex)
+            return True
+        except OSError:
+            _remove_guard(staging)
+            return False
+
+    deadline = time_mod.monotonic() + 60
+    while not _try_acquire():
+        if time_mod.monotonic() > deadline:
+            raise click.ClickException(
+                f"Could not acquire {mutex} within 60s; if no other "
+                "deploy is running, remove the stale directory"
+            )
+        try:
+            age = time_mod.time() - os.stat(mutex).st_mtime
+            if age > 300:
+                # Break the stale guard via an atomic rename to a unique
+                # name: exactly one waiter's rename succeeds, and only
+                # that winner may dispose of the condemned dir. The
+                # rename may still have caught a guard that was
+                # broken-and-reacquired between our stat and our rename
+                # (a sub-millisecond window), so the winner re-checks the
+                # age of what it actually took: a young guard is handed
+                # straight back — and because guards are non-empty, that
+                # restore can never overwrite a successor's live guard
+                # (rename fails ENOTEMPTY and we release ours instead;
+                # a guard stands at the path either way).
+                condemned = _unique("stale")
+                try:
+                    os.rename(mutex, condemned)
+                except OSError:
+                    pass  # another waiter already broke it
+                else:
+                    try:
+                        renamed_age = (
+                            time_mod.time() - os.stat(condemned).st_mtime
+                        )
+                    except OSError:
+                        renamed_age = None
+                    if renamed_age is not None and renamed_age <= 300:
+                        try:
+                            os.rename(condemned, mutex)
+                        except OSError:
+                            _remove_guard(condemned)
+                    else:
+                        logger.warning("Broke stale deploy mutex %s", mutex)
+                        _remove_guard(condemned)
+                continue
+        except OSError:
+            pass
+        time_mod.sleep(0.5)
     try:
         held = read_lock()
         if held.isdigit() and int(held) > int(revision):
@@ -737,10 +755,7 @@ def ensure_single_workflow(models_root: str, revision: str, check_only: bool):
             finally:
                 raise
     finally:
-        try:
-            os.rmdir(mutex)
-        except OSError:
-            pass
+        _remove_guard(mutex)
     click.echo(f"Acquired deploy lock for revision {revision}")
 
 

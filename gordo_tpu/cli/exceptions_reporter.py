@@ -55,8 +55,9 @@ def _clip(text: str, budget: int) -> str:
     budget too small to hold anything beyond the ellipsis yields ''."""
     if len(text) <= budget:
         return text
-    kept = text[: budget - len(_ELLIPSIS)]
-    return kept + _ELLIPSIS if kept else ""
+    if budget <= len(_ELLIPSIS):
+        return ""
+    return text[: budget - len(_ELLIPSIS)] + _ELLIPSIS
 
 
 def _traceback_tail(lines: List[str], budget: int) -> List[str]:

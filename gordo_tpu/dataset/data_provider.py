@@ -307,3 +307,162 @@ class ListBackedDataProvider(GordoBaseDataProvider):
         for tag in normalize_sensor_tags(tag_list):
             series = by_name[tag.name]
             yield series[(series.index >= train_start_date) & (series.index < train_end_date)]
+
+
+class InfluxDataProvider(GordoBaseDataProvider):
+    """
+    Tag series from an InfluxDB (1.x line) time-series database — the
+    production reader that closes the data loop the Influx *forwarder*
+    opens (client/forwarders.py ForwardPredictionsIntoInflux; the
+    reference ecosystem reads sensor data through gordo-core's influx
+    provider, pinned at
+    /root/reference/requirements/full_requirements.txt:139-142, and its
+    Argo client step replays predictions into the same Influx the
+    dashboards read — argo-workflow.yml.template:1374-1376).
+
+    Two on-wire layouts:
+
+    - **sensor layout** (default): one shared ``measurement`` whose rows
+      are distinguished by an Influx tag (``tag_key``, default ``tag``)
+      holding the sensor name, values in field ``value_name``::
+
+          data_provider:
+            type: InfluxDataProvider
+            measurement: sensors
+            uri: user:pass@influx:8086/dbname
+
+    - **field layout** (``fields_are_tags: true``): sensor names are the
+      measurement's *fields* — exactly what
+      ``ForwardPredictionsIntoInflux`` writes (pipe-joined prediction
+      columns as fields, one ``machine`` Influx tag), so a dataset can
+      train on replayed predictions::
+
+          data_provider:
+            type: InfluxDataProvider
+            measurement: predictions
+            fields_are_tags: true
+            where_tags: {machine: my-machine}
+
+    ``client`` injects a ready ``influxdb.DataFrameClient``-compatible
+    object (tests use an in-memory fake); otherwise ``uri`` is parsed
+    exactly like the forwarder's
+    (``<username>:<password>@<host>:<port>/<db_name>``).
+    """
+
+    @capture_args
+    def __init__(
+        self,
+        measurement: str,
+        value_name: str = "Value",
+        tag_key: str = "tag",
+        fields_are_tags: bool = False,
+        where_tags: Optional[Dict[str, str]] = None,
+        uri: Optional[str] = None,
+        api_key: Optional[str] = None,
+        api_key_header: str = "Ocp-Apim-Subscription-Key",
+        client=None,
+        **kwargs,
+    ):
+        self.measurement = measurement
+        self.value_name = value_name
+        self.tag_key = tag_key
+        self.fields_are_tags = fields_are_tags
+        self.where_tags = where_tags or {}
+        self.uri = uri
+        self.api_key = api_key
+        self.api_key_header = api_key_header
+        self.influx_client = client
+        if self.influx_client is None and uri:
+            self.influx_client = self._client_from_uri(uri)
+
+    def _client_from_uri(self, uri: str):  # pragma: no cover - needs influxdb
+        try:
+            from influxdb import DataFrameClient
+        except ImportError as exc:
+            raise ImportError(
+                "The influxdb package is required for InfluxDataProvider "
+                "(or pass client=...)"
+            ) from exc
+
+        username, password, host, port, *_, db_name = (
+            uri.replace("/", ":").replace("@", ":").split(":")
+        )
+        return DataFrameClient(
+            host=host,
+            port=int(port),
+            username=username,
+            password=password,
+            database=db_name,
+            headers={self.api_key_header: self.api_key} if self.api_key else None,
+        )
+
+    def _require_client(self):
+        if self.influx_client is None:
+            raise ValueError(
+                "InfluxDataProvider has no client; pass uri=... or client=..."
+            )
+        return self.influx_client
+
+    @staticmethod
+    def _escape(identifier: str) -> str:
+        # InfluxQL string literals escape single quotes by doubling
+        return identifier.replace("'", "\\'")
+
+    def _query_series(
+        self,
+        tag: SensorTag,
+        train_start_date: pd.Timestamp,
+        train_end_date: pd.Timestamp,
+    ) -> pd.Series:
+        client = self._require_client()
+        start_ns = int(pd.Timestamp(train_start_date).value)
+        end_ns = int(pd.Timestamp(train_end_date).value)
+        conditions = [f"time >= {start_ns} AND time < {end_ns}"]
+        if self.fields_are_tags:
+            field = tag.name
+        else:
+            field = self.value_name
+            conditions.append(
+                f"\"{self.tag_key}\" = '{self._escape(tag.name)}'"
+            )
+        for key, value in self.where_tags.items():
+            conditions.append(f"\"{key}\" = '{self._escape(str(value))}'")
+        query = (
+            f'SELECT "{field}" FROM "{self.measurement}" '
+            f"WHERE {' AND '.join(conditions)}"
+        )
+        result = client.query(query)
+        frame = result.get(self.measurement) if hasattr(result, "get") else None
+        if frame is None or len(frame) == 0:
+            raise ValueError(
+                f"No data for tag {tag.name!r} in measurement "
+                f"{self.measurement!r} over [{train_start_date}, "
+                f"{train_end_date})"
+            )
+        series = frame[field].rename(tag.name)
+        index = pd.DatetimeIndex(pd.to_datetime(series.index))
+        if index.tz is None:
+            index = index.tz_localize("UTC")
+        series.index = index
+        return series.sort_index()
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        # Availability is a per-window property in a TSDB; existence is
+        # checked by the read itself (ValueError names the tag/window).
+        return self.influx_client is not None or bool(self.uri)
+
+    def load_series(
+        self,
+        train_start_date: pd.Timestamp,
+        train_end_date: pd.Timestamp,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+        **kwargs,
+    ) -> Iterable[pd.Series]:
+        if train_start_date >= train_end_date:
+            raise ValueError(
+                f"train_start_date ({train_start_date}) must be before "
+                f"train_end_date ({train_end_date})"
+            )
+        for tag in normalize_sensor_tags(tag_list):
+            yield self._query_series(tag, train_start_date, train_end_date)

@@ -2,13 +2,17 @@
 Training callbacks.
 
 Reference configs attach Keras callbacks (built via
-gordo/serializer/from_definition.py:352-373); gordo-tpu supports the one that
-matters for these models — EarlyStopping — and compiles it *into* the fused
-training program as a static config (no per-epoch host round trip) whenever
-possible. Unknown/custom callbacks fall back to the per-epoch host loop in
-models/training.py.
+gordo/serializer/from_definition.py:352-373); gordo-tpu compiles the one
+that matters for these models — EarlyStopping — *into* the fused training
+program as a static config (no per-epoch host round trip) whenever
+possible. Everything else — the built-ins below and any custom
+dotted-path callback from YAML (serializer build_callbacks) — rides the
+per-epoch host loop in models/training.py, which re-dispatches one
+compiled epoch at a time and honors stop requests and learning-rate
+changes between epochs.
 """
 
+import math
 from typing import Optional
 
 
@@ -75,3 +79,96 @@ class EarlyStopping(Callback):
         self._wait += 1
         # Keras stops when wait >= patience (patience=0 behaves like 1)
         return self._wait >= max(self.patience, 1)
+
+
+class TerminateOnNaN(Callback):
+    """Stop training the moment the epoch loss goes non-finite (Keras
+    ``TerminateOnNaN``; the fleet path's analog is the diverged-member
+    reseed retry in parallel/fleet.py)."""
+
+    def on_epoch_end(self, epoch: int, logs: Optional[dict] = None) -> bool:
+        loss = (logs or {}).get("loss")
+        return loss is not None and not math.isfinite(loss)
+
+
+class ReduceLROnPlateau(Callback):
+    """
+    Multiply the learning rate by ``factor`` when ``monitor`` stops
+    improving for ``patience`` epochs (Keras-compatible surface:
+    monitor/factor/patience/min_delta/cooldown/min_lr).
+
+    The host loop applies the request between epochs by recompiling the
+    one-epoch program with the new rate (models/training.py
+    ``_fit_host_loop``; Adam's moment state carries over unchanged — the
+    learning rate only scales the update).
+    """
+
+    def __init__(
+        self,
+        monitor: str = "val_loss",
+        factor: float = 0.1,
+        patience: int = 10,
+        min_delta: float = 1e-4,
+        cooldown: int = 0,
+        min_lr: float = 0.0,
+        verbose: int = 0,
+        mode: str = "auto",
+        **kwargs,
+    ):
+        if factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau factor must be < 1.0")
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = int(patience)
+        self.min_delta = float(min_delta)
+        self.cooldown = int(cooldown)
+        self.min_lr = float(min_lr)
+        self.verbose = verbose
+        self.mode = mode
+        self._best: Optional[float] = None
+        self._wait = 0
+        self._cooldown_left = 0
+        self._requested_lr: Optional[float] = None
+
+    def get_params(self, deep: bool = False) -> dict:
+        return {
+            "monitor": self.monitor,
+            "factor": self.factor,
+            "patience": self.patience,
+            "min_delta": self.min_delta,
+            "cooldown": self.cooldown,
+            "min_lr": self.min_lr,
+        }
+
+    def on_train_begin(self, logs: Optional[dict] = None):
+        self._best, self._wait, self._cooldown_left = None, 0, 0
+        self._requested_lr = None
+
+    def consume_lr_request(self) -> Optional[float]:
+        """The new learning rate this callback wants (one-shot), or None.
+        Called by the host loop after each epoch's callbacks ran."""
+        requested, self._requested_lr = self._requested_lr, None
+        return requested
+
+    def on_epoch_end(self, epoch: int, logs: Optional[dict] = None) -> bool:
+        logs = logs or {}
+        # monitor falls back to train loss when val_loss is absent, like
+        # the compiled EarlyStopping's per-member fallback
+        value = logs.get(self.monitor, logs.get("loss"))
+        current_lr = logs.get("lr")
+        if value is None or not math.isfinite(value):
+            return False
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            self._wait = 0
+        if self._best is None or value < self._best - self.min_delta:
+            self._best, self._wait = value, 0
+        elif self._cooldown_left <= 0:
+            self._wait += 1
+            if self._wait >= max(self.patience, 1) and current_lr is not None:
+                new_lr = max(current_lr * self.factor, self.min_lr)
+                if new_lr < current_lr:
+                    self._requested_lr = new_lr
+                self._wait = 0
+                self._cooldown_left = self.cooldown
+        return False

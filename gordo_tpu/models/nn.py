@@ -196,6 +196,36 @@ def forward_lstm(
     )
 
 
+def forward_lstm_sequence(
+    spec: LSTMSpec, params: Params, x_seq: jnp.ndarray
+) -> jnp.ndarray:
+    """
+    Run the stacked LSTM over ``x_seq`` of shape ``[time, batch,
+    n_features]`` and emit the Dense-head output at EVERY timestep:
+    ``[time, batch, n_features_out]``.
+
+    This is the segmented-training forward (training.py
+    build_raw_segmented_fit_fn): one recurrence pass over a span of the
+    series yields the many-to-one output of every window ending inside
+    the span, instead of re-running the first ``lookback-1`` steps of
+    each stride-1 window from scratch. The output at time ``t`` equals
+    :func:`forward_lstm` on a window ending at ``t`` whose hidden state
+    was warmed by the span's earlier steps (identical when the span
+    starts exactly ``lookback`` steps before ``t``). Same dtype
+    contract: compute in ``spec.compute_dtype``, float32 out.
+    """
+    dtype = jnp.dtype(spec.compute_dtype)
+    if x_seq.dtype != dtype:
+        x_seq = x_seq.astype(dtype)
+    h_seq = x_seq
+    for i in range(len(spec.dims)):
+        h_seq = _lstm_layer(params[f"lstm_{i}"], h_seq, spec.activations[i])
+    out = h_seq @ params["out"]["W"].astype(dtype) + params["out"]["b"].astype(
+        dtype
+    )
+    return resolve_activation(spec.out_activation)(out).astype(jnp.float32)
+
+
 def init_fn_for(spec) -> "object":
     if isinstance(spec, FeedForwardSpec):
         return init_feedforward

@@ -446,6 +446,146 @@ def build_raw_windowed_fit_fn(spec: ModelSpec, config: FitConfig):
 
 
 @lru_cache(maxsize=None)
+def build_raw_segmented_fit_fn(
+    spec: ModelSpec, config: FitConfig, segments_per_update: int
+):
+    """
+    Segmented (stateful-scan) fit for windowed LSTM models:
+
+    ``(params, opt_state, series[n, F], ytgt[nw, F], wtr[nv], wval[nv],
+    rng) -> (params, opt_state, losses, val_losses, epochs_ran)``
+
+    The window-restart path (build_raw_windowed_fit_fn) re-runs the
+    recurrence from zero state for every stride-1 window: a batch of B
+    windows costs ``B×lookback`` cell applications for ``B+lookback-1``
+    distinct timesteps — a ~``lookback×`` FLOP/HBM redundancy (reference
+    semantics: Keras stateless LSTM over materialized windows,
+    gordo/machine/model/models.py:713-793).
+
+    Here each Adam update still covers the SAME B consecutive windows as
+    the unshuffled windowed path, but computes them as
+    ``segments_per_update`` (G) parallel segments of ``L = B/G``
+    consecutive windows: one recurrence pass of ``L+lookback-1`` steps
+    per segment yields every window output in the segment via
+    :func:`nn.forward_lstm_sequence`. Cell applications per update drop
+    from ``B×lookback`` to ``B + G×(lookback-1)``; sequential depth
+    rises from ``lookback`` to ``L+lookback-1``. ``G=B`` (L=1) is
+    bit-equivalent to the windowed path (tests assert it); small ``G``
+    trades depth for a ~``lookback×`` FLOP cut.
+
+    Semantics difference (the reason this is opt-in): within a segment,
+    window ``j`` at position ``p`` sees hidden state warmed by the
+    ``p-lookback+1`` preceding segment steps instead of starting cold —
+    the first window of each segment is exactly cold, later ones
+    approximate it (LSTM state forgets geometrically). Training is
+    therefore TBPTT-like; serving still scores cold windows. Parity is
+    gated at the anomaly-surface level like TF parity
+    (compat/tf_parity.py), not bit-level.
+
+    Requires ``config.shuffle == False`` (the product LSTM path pins
+    this, matching the reference's unshuffled timeseries generator) and
+    identity window order — segments must be consecutive windows.
+    """
+    if config.shuffle:
+        raise ValueError("segmented LSTM training requires shuffle=False")
+    from .nn import forward_lstm_sequence
+
+    per_sample = resolve_loss(spec.loss)
+    tx = spec.optimizer.to_optax()
+    lookback = spec.lookback_window
+    G = segments_per_update
+    B = config.batch_size
+    if B % G:
+        raise ValueError(f"batch_size {B} not divisible by segments {G}")
+    L = B // G
+    span = L + lookback - 1  # timesteps one segment must read
+
+    def update_loss(params, series, ytgt, starts, w):
+        # starts: [G] window-start heads of this update's segments;
+        # w: [G, L] per-window weights (0 for padding)
+        n = series.shape[0]
+        t_idx = jnp.minimum(starts[:, None] + jnp.arange(span)[None, :], n - 1)
+        segs = series[t_idx]  # [G, span, F]
+        out_seq = forward_lstm_sequence(
+            spec, params, jnp.transpose(segs, (1, 0, 2))
+        )  # [span, G, F_out]
+        outs = jnp.transpose(out_seq[lookback - 1 :], (1, 0, 2))  # [G, L, Fo]
+        w_idx = jnp.minimum(
+            starts[:, None] + jnp.arange(L)[None, :], ytgt.shape[0] - 1
+        )
+        targets = ytgt[w_idx]  # [G, L, F_out]
+        losses = per_sample(
+            outs.reshape(B, -1), targets.reshape(B, -1)
+        )
+        return weighted_mean_loss(losses, w.reshape(B))
+
+    grad_fn = jax.value_and_grad(update_loss)
+
+    def train_epoch(params, opt_state, series, ytgt, wtr, erng):
+        del erng  # shuffle=False: epoch order is the window order
+        nv = wtr.shape[0]
+        K = nv // B  # updates per epoch, same count as the windowed path
+        heads = (
+            jnp.arange(K)[:, None] * B + jnp.arange(G)[None, :] * L
+        )  # [K, G]
+        w_b = wtr.reshape(K, G, L)
+
+        def step(carry, batch):
+            params, opt_state = carry
+            starts, wb = batch
+            loss, grads = grad_fn(params, series, ytgt, starts, wb)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            has_data = jnp.sum(wb) > 0
+            params = _tree_where(
+                has_data, optax.apply_updates(params, updates), params
+            )
+            opt_state = _tree_where(has_data, new_opt_state, opt_state)
+            contribution = jnp.where(has_data, loss * jnp.sum(wb), 0.0)
+            return (params, opt_state), contribution
+
+        (params, opt_state), weighted_losses = jax.lax.scan(
+            step, (params, opt_state), (heads, w_b)
+        )
+        epoch_loss = jnp.sum(weighted_losses) / jnp.maximum(jnp.sum(wtr), 1.0)
+        return params, opt_state, epoch_loss
+
+    def evaluate(params, series, ytgt, wval):
+        nv = wval.shape[0]
+        K = nv // B
+        heads = jnp.arange(K)[:, None] * B + jnp.arange(G)[None, :] * L
+        w_b = wval.reshape(K, G, L)
+
+        def step(acc, batch):
+            starts, wb = batch
+            loss = update_loss(params, series, ytgt, starts, wb)
+            wsum = jnp.sum(wb)
+            return (acc[0] + loss * wsum, acc[1] + wsum), None
+
+        (total, wsum), _ = jax.lax.scan(
+            step,
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            (heads, w_b),
+        )
+        return jnp.where(wsum > 0, total / wsum, jnp.nan)
+
+    compute_dtype = jnp.dtype(spec.compute_dtype)
+
+    def fit(params, opt_state, series, ytgt, wtr, wval, rng):
+        if compute_dtype != jnp.float32:
+            series, ytgt = series.astype(compute_dtype), ytgt.astype(compute_dtype)
+        fit_tail = _make_fit_loop(
+            config,
+            train_epoch=lambda p, o, erng: train_epoch(
+                p, o, series, ytgt, wtr, erng
+            ),
+            evaluate_val=lambda p: evaluate(p, series, ytgt, wval),
+        )
+        return fit_tail(params, opt_state, rng)
+
+    return fit
+
+
+@lru_cache(maxsize=None)
 def _fit_program(spec: ModelSpec, config: FitConfig):
     """Jitted single-model fused fit program for (spec, config)."""
     return jax.jit(build_raw_fit_fn(spec, config))
@@ -527,7 +667,14 @@ def fit_single(
 def _fit_host_loop(
     spec, config, params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng, callbacks
 ):
-    """Per-epoch host loop for custom callbacks: one jitted epoch at a time."""
+    """Per-epoch host loop for custom callbacks: one jitted epoch at a
+    time. Callbacks may stop training (on_epoch_end -> True) or request a
+    learning-rate change (``consume_lr_request`` protocol —
+    ReduceLROnPlateau); an LR change swaps in the one-epoch program
+    compiled for the new rate (lru-cached per rate) while Adam's moment
+    state carries over unchanged."""
+    from dataclasses import replace as dc_replace
+
     single_epoch_config = FitConfig(
         epochs=1,
         batch_size=config.batch_size,
@@ -535,7 +682,6 @@ def _fit_host_loop(
         shuffle=config.shuffle,
         early_stopping=None,
     )
-    fit_one = _fit_program(spec, single_epoch_config)
     evaluate = _eval_fn(spec)
     empty = np.zeros((0,) + Xtr.shape[1:], np.float32)
     empty_y = np.zeros((0,) + ytr.shape[1:], np.float32)
@@ -547,18 +693,40 @@ def _fit_host_loop(
     for cb in callbacks:
         cb.on_train_begin()
     epochs_ran = 0
+    current_spec = spec
     for epoch in range(config.epochs):
+        fit_one = _fit_program(current_spec, single_epoch_config)
         rng, erng = jax.random.split(rng)
         params, opt_state, losses, _, _ = fit_one(
             params, opt_state, Xtr, ytr, wtr, empty, empty_y, empty_w, erng
         )
-        logs = {"loss": float(losses[0])}
+        logs = {
+            "loss": float(losses[0]),
+            "lr": current_spec.optimizer.learning_rate,
+        }
         if len(Xval):
             logs["val_loss"] = float(evaluate(params, Xval, yval, wval))
             history["val_loss"].append(logs["val_loss"])
         history["loss"].append(logs["loss"])
         epochs_ran += 1
-        if any(cb.on_epoch_end(epoch, logs) for cb in callbacks):
+        # run every callback (Keras semantics), then stop/LR decisions
+        stop_requests = [cb.on_epoch_end(epoch, logs) for cb in callbacks]
+        new_lr = None
+        for cb in callbacks:
+            request = getattr(cb, "consume_lr_request", None)
+            if callable(request):
+                requested = request()
+                if requested is not None:
+                    new_lr = requested
+        if new_lr is not None and new_lr != current_spec.optimizer.learning_rate:
+            logger.info("Host loop: learning rate -> %g (epoch %d)", new_lr, epoch)
+            current_spec = dc_replace(
+                current_spec,
+                optimizer=dc_replace(
+                    current_spec.optimizer, learning_rate=float(new_lr)
+                ),
+            )
+        if any(stop_requests):
             break
     return params, History(
         history=history,

@@ -26,6 +26,7 @@ are independent of bucket composition and deterministic per seed.
 """
 
 import logging
+import os
 from collections import defaultdict
 from dataclasses import dataclass, replace
 from functools import lru_cache
@@ -221,6 +222,29 @@ def _fleet_windowed_fit_program(spec: ModelSpec, config: FitConfig):
 
     raw_fit = build_raw_windowed_fit_fn(spec, config)
     return jax.jit(jax.vmap(raw_fit))
+
+
+@lru_cache(maxsize=None)
+def _fleet_segmented_fit_program(
+    spec: ModelSpec, config: FitConfig, segments_per_update: int
+):
+    """jit(vmap) of the segmented (stateful-scan) LSTM fit over the model
+    axis (models/training.py build_raw_segmented_fit_fn)."""
+    from ..models.training import build_raw_segmented_fit_fn
+
+    raw_fit = build_raw_segmented_fit_fn(spec, config, segments_per_update)
+    return jax.jit(jax.vmap(raw_fit))
+
+
+def _segmented_config() -> Optional[int]:
+    """The opt-in segments-per-update for segmented LSTM fleet training
+    (env GORDO_TPU_LSTM_SEGMENTED: 0/unset = off, N = segments per
+    update; see build_raw_segmented_fit_fn for the trade)."""
+    try:
+        value = int(os.environ.get("GORDO_TPU_LSTM_SEGMENTED", "0"))
+    except ValueError:
+        return None
+    return value if value > 0 else None
 
 
 @lru_cache(maxsize=None)
@@ -703,6 +727,27 @@ class FleetTrainer:
         )
         return series, ytgt, order, wtr, wval, rngs
 
+    def _segmented_eligible(
+        self, bucket: List[WindowedFleetMember], config: FitConfig
+    ) -> Optional[int]:
+        """Segments-per-update when the opt-in segmented path applies to
+        this bucket, else None. Segments need consecutive windows, so any
+        shuffle or explicit member ordering/weighting keeps the
+        window-restart path."""
+        segments = _segmented_config()
+        if not segments or config.shuffle:
+            return None
+        if config.batch_size % segments:
+            return None
+        if any(
+            m.order is not None
+            or m.train_weights is not None
+            or m.val_weights is not None
+            for m in bucket
+        ):
+            return None
+        return segments
+
     def _train_windowed_bucket(
         self,
         spec: ModelSpec,
@@ -715,10 +760,22 @@ class FleetTrainer:
             spec, n_padded, offset, bucket, config
         )
         params, opt_state, rngs = self._init_bucket_params(spec, rngs)
-        fit = _fleet_windowed_fit_program(spec, config)
-        params, _, losses, val_losses, epochs_ran = fit(
-            params, opt_state, series, ytgt, order, wtr, wval, rngs
-        )
+        segments = self._segmented_eligible(bucket, config)
+        if segments is not None:
+            logger.info(
+                "Segmented LSTM training: %d segments/update (L=%d)",
+                segments,
+                config.batch_size // segments,
+            )
+            fit = _fleet_segmented_fit_program(spec, config, segments)
+            params, _, losses, val_losses, epochs_ran = fit(
+                params, opt_state, series, ytgt, wtr, wval, rngs
+            )
+        else:
+            fit = _fleet_windowed_fit_program(spec, config)
+            params, _, losses, val_losses, epochs_ran = fit(
+                params, opt_state, series, ytgt, order, wtr, wval, rngs
+            )
         return self._collect_results(
             bucket, params, losses, val_losses, epochs_ran, config,
             steps=order.shape[1] // config.batch_size,

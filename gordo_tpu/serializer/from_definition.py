@@ -58,6 +58,18 @@ COMPAT_LOCATIONS: Dict[str, str] = {
         "gordo_tpu.models.callbacks.EarlyStopping"
     ),
     "keras.callbacks.EarlyStopping": "gordo_tpu.models.callbacks.EarlyStopping",
+    "tensorflow.keras.callbacks.ReduceLROnPlateau": (
+        "gordo_tpu.models.callbacks.ReduceLROnPlateau"
+    ),
+    "keras.callbacks.ReduceLROnPlateau": (
+        "gordo_tpu.models.callbacks.ReduceLROnPlateau"
+    ),
+    "tensorflow.keras.callbacks.TerminateOnNaN": (
+        "gordo_tpu.models.callbacks.TerminateOnNaN"
+    ),
+    "keras.callbacks.TerminateOnNaN": (
+        "gordo_tpu.models.callbacks.TerminateOnNaN"
+    ),
     "tensorflow.keras.models.Sequential": "gordo_tpu.models.spec.Sequential",
     "keras.models.Sequential": "gordo_tpu.models.spec.Sequential",
     "tensorflow.keras.layers.Dense": "gordo_tpu.models.spec.Dense",

@@ -1,0 +1,195 @@
+"""
+InfluxDataProvider — the TSDB reader that closes the data loop the Influx
+forwarder opens. An in-memory DataFrameClient fake stands in for influxdb
+(the reference dockertests run a real Influx container; same contract,
+no container): sensor-layout reads, window filtering, and the full
+forwarder→provider replay round trip, including from a YAML config
+through local_build.
+"""
+
+import re
+import sys
+import types
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.dataset.data_provider import GordoBaseDataProvider, InfluxDataProvider
+from gordo_tpu.dataset.sensor_tag import SensorTag
+
+UTC = "UTC"
+
+_QUERY_RE = re.compile(
+    r'SELECT "(?P<field>[^"]+)" FROM "(?P<measurement>[^"]+)" WHERE '
+    r"time >= (?P<start>\d+) AND time < (?P<end>\d+)(?P<conds>.*)$"
+)
+_COND_RE = re.compile(r'"(?P<key>[^"]+)" = \'(?P<value>[^\']*)\'')
+
+
+class FakeDataFrameClient:
+    """In-memory influxdb.DataFrameClient: write_points stores frames per
+    (measurement, influx-tags); query parses the provider's InfluxQL."""
+
+    def __init__(self, *args, **kwargs):
+        self.writes = []  # (measurement, tags dict, frame)
+
+    def write_points(self, dataframe, measurement, tags=None, **kwargs):
+        self.writes.append((measurement, dict(tags or {}), dataframe.copy()))
+
+    def query(self, q):
+        match = _QUERY_RE.match(q)
+        assert match, f"fake client cannot parse: {q}"
+        field = match.group("field")
+        conds = dict(
+            (m.group("key"), m.group("value"))
+            for m in _COND_RE.finditer(match.group("conds"))
+        )
+        start = pd.Timestamp(int(match.group("start")), tz=UTC)
+        end = pd.Timestamp(int(match.group("end")), tz=UTC)
+        parts = []
+        for measurement, tags, frame in self.writes:
+            if measurement != match.group("measurement"):
+                continue
+            if any(tags.get(k) != v for k, v in conds.items() if k in tags):
+                continue
+            # conditions on keys the write didn't tag with must also
+            # match (sensor layout stores the sensor name as a tag)
+            if any(k not in tags for k in conds if k not in frame.columns):
+                continue
+            if field not in frame.columns:
+                continue
+            index = frame.index
+            if index.tz is None:
+                index = index.tz_localize(UTC)
+            mask = (index >= start) & (index < end)
+            if mask.any():
+                sub = frame.loc[mask, [field]]
+                sub.index = index[mask]
+                parts.append(sub)
+        if not parts:
+            return {}
+        return {match.group("measurement"): pd.concat(parts).sort_index()}
+
+
+@pytest.fixture
+def fake_influx(monkeypatch):
+    """A fake `influxdb` module whose DataFrameClient is one shared
+    in-memory instance, so forwarder and provider see the same store."""
+    client = FakeDataFrameClient()
+    module = types.ModuleType("influxdb")
+    module.DataFrameClient = lambda *a, **k: client
+    monkeypatch.setitem(sys.modules, "influxdb", module)
+    return client
+
+
+def _seed_sensors(client, tags, n=200):
+    index = pd.date_range("2020-01-01", periods=n, freq="10min", tz=UTC)
+    for i, tag in enumerate(tags):
+        frame = pd.DataFrame(
+            {"Value": np.sin(np.linspace(0, 8, n)) + 0.1 * i}, index=index
+        )
+        client.write_points(frame, measurement="sensors", tags={"tag": tag})
+    return index
+
+
+def test_sensor_layout_reads_window(fake_influx):
+    index = _seed_sensors(fake_influx, ["t1", "t2"])
+    provider = InfluxDataProvider(measurement="sensors", client=fake_influx)
+    series = list(
+        provider.load_series(
+            index[10], index[50], [SensorTag("t1"), SensorTag("t2")]
+        )
+    )
+    assert [s.name for s in series] == ["t1", "t2"]
+    for s in series:
+        assert s.index.min() >= index[10] and s.index.max() < index[50]
+        assert len(s) == 40
+
+
+def test_missing_tag_raises_value_error(fake_influx):
+    index = _seed_sensors(fake_influx, ["t1"])
+    provider = InfluxDataProvider(measurement="sensors", client=fake_influx)
+    with pytest.raises(ValueError, match="no-such-tag"):
+        list(provider.load_series(index[0], index[50], [SensorTag("no-such-tag")]))
+
+
+def test_roundtrip_through_serializer_dict(fake_influx):
+    provider = InfluxDataProvider(
+        measurement="sensors", uri="u:p@host:8086/db", value_name="V"
+    )
+    config = provider.to_dict()
+    assert config["measurement"] == "sensors"
+    restored = GordoBaseDataProvider.from_dict(config)
+    assert isinstance(restored, InfluxDataProvider)
+    assert restored.value_name == "V"
+
+
+def test_forwarder_replay_loop(fake_influx):
+    """What ForwardPredictionsIntoInflux writes, the provider reads back
+    (field layout) — the reference client's Influx replay, closed."""
+    from gordo_tpu.client.forwarders import ForwardPredictionsIntoInflux
+
+    index = pd.date_range("2020-02-01", periods=60, freq="10min", tz=UTC)
+    predictions = pd.DataFrame(
+        {
+            ("model-output", "t1"): np.linspace(0, 1, 60),
+            ("total-anomaly-unscaled", "total-anomaly-unscaled"): np.linspace(
+                1, 2, 60
+            ),
+        },
+        index=index,
+    )
+    predictions.columns = pd.MultiIndex.from_tuples(predictions.columns)
+
+    class Machine:
+        name = "machine-a"
+
+    forwarder = ForwardPredictionsIntoInflux(
+        destination_influx_uri="u:p@host:8086/db"
+    )
+    forwarder.forward_predictions(predictions, machine=Machine())
+
+    provider = InfluxDataProvider(
+        measurement="predictions",
+        fields_are_tags=True,
+        where_tags={"machine": "machine-a"},
+        client=fake_influx,
+    )
+    (series,) = list(
+        provider.load_series(
+            index[0],
+            index[30],
+            [SensorTag("total-anomaly-unscaled|total-anomaly-unscaled")],
+        )
+    )
+    np.testing.assert_allclose(series.to_numpy(), np.linspace(1, 2, 60)[:30])
+
+
+def test_config_builds_end_to_end(fake_influx):
+    """A YAML config whose dataset reads from InfluxDataProvider trains a
+    model through local_build — the provider in the real product path."""
+    from gordo_tpu.builder import local_build
+
+    _seed_sensors(fake_influx, ["tag-1", "tag-2"], n=400)
+    config = """
+machines:
+  - name: influx-machine
+    dataset:
+      type: TimeSeriesDataset
+      data_provider:
+        type: InfluxDataProvider
+        measurement: sensors
+        uri: user:pass@influx-host:8086/sensordb
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-03T00:00:00+00:00"
+      tag_list: [tag-1, tag-2]
+    model:
+      gordo_tpu.models.JaxAutoEncoder:
+        kind: feedforward_hourglass
+        epochs: 1
+"""
+    model, machine = next(local_build(config, project_name="p"))
+    assert machine.metadata.build_metadata.model.model_offset is not None
+    out = model.predict(np.zeros((4, 2), np.float32))
+    assert np.asarray(out).shape == (4, 2)
