@@ -858,7 +858,12 @@ def lstm_fleet_train() -> dict:
     # fallback it would only burn budget).
     segmented_rate = None
     seg = os.environ.get("BENCH_LSTM_SEGMENTED", "4")
-    if jax.default_backend() == "tpu" and seg not in ("", "0"):
+    seg_usable = seg.isdigit() and int(seg) > 0 and BATCH % int(seg) == 0
+    if not seg_usable and seg not in ("", "0"):
+        # fleet._segmented_eligible would silently fall back to the
+        # window-restart path — never label a restart timing "segmented"
+        log(f"segmented measurement skipped: G={seg!r} invalid for batch {BATCH}")
+    if jax.default_backend() == "tpu" and seg_usable:
         os.environ["GORDO_TPU_LSTM_SEGMENTED"] = seg
         try:
             fleet = members(0)

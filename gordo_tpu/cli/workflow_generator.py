@@ -181,6 +181,15 @@ def workflow_cli(gordo_ctx):
 )
 @click.option("--workflow-template", type=str, help="Template to expand")
 @click.option(
+    "--validate/--no-validate",
+    "validate_manifests_flag",
+    default=True,
+    help="Validate every rendered document against the vendored k8s "
+    "schemas and cross-document invariants before emitting (the offline "
+    "analog of the reference's `argo lint` step); --no-validate skips it.",
+    envvar=f"{PREFIX}_VALIDATE",
+)
+@click.option(
     "--owner-references",
     type=wg._valid_owner_ref,
     default=None,
@@ -654,6 +663,8 @@ def workflow_generator_cli(gordo_ctx, **ctx):
 
     if context["output_file"]:
         open(context["output_file"], "w").close()
+    validate = bool(context.get("validate_manifests_flag", True))
+    rendered_chunks: List[str] = []
     project_workflow = 0
     for i in range(0, len(config.machines), context["split_workflows"]):
         logger.info(
@@ -683,10 +694,48 @@ def workflow_generator_cli(gordo_ctx, **ctx):
                 s.dump(f)
         else:
             output = template.render(**context)
-            if i != 0:
-                print("\n---\n")
-            print(output)
+            rendered_chunks.append(output)
+            if not validate:
+                # With the gate off, stream chunks as they render; with
+                # it on, printing waits until validation passes so that
+                # `generate | kubectl apply -f -` can never feed invalid
+                # documents to the consumer before the command fails.
+                if i != 0:
+                    print("\n---\n")
+                print(output)
         project_workflow += 1
+
+    if validate:
+        # Offline schema gate before anything ships (the analog of the
+        # reference's `argo lint` dockertest — see
+        # workflow/manifest_validation.py): a template or config slip
+        # fails THIS command, not the cluster apply.
+        from ..workflow.manifest_validation import validate_manifests
+
+        if context["output_file"]:
+            with open(context["output_file"]) as f:
+                text = f.read()
+        else:
+            text = "\n---\n".join(rendered_chunks)
+        try:
+            documents = list(yaml.safe_load_all(text))
+        except yaml.YAMLError as exc:
+            raise click.ClickException(
+                "Rendered manifests are not parseable YAML "
+                f"(--no-validate to bypass): {exc}"
+            )
+        errors = validate_manifests(documents)
+        if errors:
+            shown = "\n  ".join(errors[:20])
+            more = f"\n  ... and {len(errors) - 20} more" if len(errors) > 20 else ""
+            raise click.ClickException(
+                f"Rendered manifests failed schema validation "
+                f"({len(errors)} error(s); --no-validate to bypass):\n  "
+                f"{shown}{more}"
+            )
+        logger.info("Rendered manifests validated against vendored schemas")
+        if not context["output_file"]:
+            print("\n---\n".join(rendered_chunks))
 
 
 workflow_cli.add_command(workflow_generator_cli)

@@ -405,8 +405,10 @@ class InfluxDataProvider(GordoBaseDataProvider):
 
     @staticmethod
     def _escape(identifier: str) -> str:
-        # InfluxQL string literals escape single quotes by doubling
-        return identifier.replace("'", "\\'")
+        # InfluxQL string literals backslash-escape; backslashes first so
+        # a trailing backslash can't swallow the closing quote (or a
+        # crafted value extend the WHERE clause)
+        return identifier.replace("\\", "\\\\").replace("'", "\\'")
 
     def _query_series(
         self,

@@ -23,6 +23,9 @@ FIXTURES = sorted(f for f in os.listdir(DATA_DIR) if f.endswith(".yml"))
 
 
 def render(config_path, *extra):
+    # --no-validate: these tests call validate_manifests directly as the
+    # assertion; the CLI's own inline gate (tested separately below)
+    # would otherwise refuse the deliberately-broken renders up front.
     result = CliRunner().invoke(
         gordo_tpu_cli,
         [
@@ -34,6 +37,7 @@ def render(config_path, *extra):
             "fixture-proj",
             "--project-revision",
             "1600000000000",
+            "--no-validate",
             *extra,
         ],
         catch_exceptions=False,
@@ -74,6 +78,32 @@ def test_broken_template_fails_validation(breakage, tmp_path):
     )
     errors = validate_manifests(docs)
     assert errors, f"{breakage}: validation passed on a broken template"
+
+
+def test_cli_validate_gate_blocks_broken_render(tmp_path):
+    """`workflow generate` validates by default and fails the command on
+    a broken template; --no-validate is the explicit escape hatch."""
+    source = open(default_workflow_template()).read()
+    needle, replacement = BREAKAGES["misspelled-containers-key"]
+    broken = tmp_path / "broken.yml.template"
+    broken.write_text(source.replace(needle, replacement, 1))
+    args = [
+        "workflow",
+        "generate",
+        "--machine-config",
+        os.path.join(DATA_DIR, FIXTURES[0]),
+        "--project-name",
+        "fixture-proj",
+        "--workflow-template",
+        str(broken),
+    ]
+
+    result = CliRunner().invoke(gordo_tpu_cli, args)
+    assert result.exit_code != 0
+    assert "failed schema validation" in result.output
+
+    bypassed = CliRunner().invoke(gordo_tpu_cli, args + ["--no-validate"])
+    assert bypassed.exit_code == 0, bypassed.output
 
 
 def test_unknown_kind_is_an_error():
