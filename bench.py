@@ -18,7 +18,10 @@ can never zero the whole run:
    north-star target is defined on (BASELINE.md: 1000 AEs < 10 min).
 3. **lstm-fleet-train** — BASELINE.json parity configs #3/#4: 50-tag
    sliding-window LSTM autoencoder and forecast fleets with on-device
-   window gathering. Rates land in the final line's extras.
+   window gathering. Rates land in the final line's extras. A separate
+   last-priority **lstm-experiments** stage (TPU only) measures the
+   segmented stateful-scan path and a recurrence unroll sweep against
+   the window-restart baseline.
 4. **parity** — the north star's correctness half: the same hourglass AE
    trained on identical data by the reference's Keras/TF2 engine and by
    the JAX engine, both wrapped in DiffBasedAnomalyDetector with the same
@@ -56,6 +59,8 @@ import tempfile
 import time
 import traceback
 
+from typing import Optional
+
 import numpy as np
 
 # -- global wall-clock budget ----------------------------------------------
@@ -68,7 +73,13 @@ import numpy as np
 # whatever completed before exiting. The bench must be constitutionally
 # unable to end a round without an artifact.
 _T0 = time.time()
-BUDGET = int(os.environ.get("BENCH_BUDGET", 460))
+# 780s: round 4's driver kill landed only after ~675s of stages had run,
+# so the external budget is comfortably larger; a too-small internal
+# budget would skip stages a live TPU had time for. Overshoot is safe —
+# the SIGTERM handler emits the final JSON from completed stages if the
+# driver's own timeout fires first. The worst-case CPU-fallback run
+# (every stage shrunk) finishes well under this regardless.
+BUDGET = int(os.environ.get("BENCH_BUDGET", 780))
 _EMIT_RESERVE = 10  # seconds kept back for writing the final JSON line
 
 
@@ -195,6 +206,8 @@ def _run_stage_subprocess(name: str, timeout: int, force_cpu: bool):
     if force_cpu:
         env["BENCH_FORCE_CPU"] = "1"
         _apply_cpu_shrink(env)
+    timed_out = False
+    proc = None
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--stage", name, out_path],
@@ -202,16 +215,23 @@ def _run_stage_subprocess(name: str, timeout: int, force_cpu: bool):
             env=env,
         )
     except subprocess.TimeoutExpired:
+        timed_out = True
+    payload = None
+    try:
+        with open(out_path) as f:
+            content = f.read()
+        os.unlink(out_path)
+        payload = json.loads(content) if content else None
+    except (OSError, ValueError):
+        pass
+    if timed_out:
+        # a long multi-measurement stage flushes interim results as it
+        # goes (_flush_stage); a timeout salvages those instead of
+        # discarding completed measurements
+        if payload is not None and "error" not in payload:
+            payload["timeout_note"] = f"killed at {timeout}s; interim results"
+            return payload, None
         return None, f"timeout after {timeout}s (stage subprocess killed)"
-    finally:
-        payload = None
-        try:
-            with open(out_path) as f:
-                content = f.read()
-            os.unlink(out_path)
-            payload = json.loads(content) if content else None
-        except (OSError, ValueError):
-            pass
     if payload is None:
         return None, f"stage subprocess died (rc={proc.returncode}) without a result"
     if "error" in payload:
@@ -299,8 +319,24 @@ def run_stage(partial: dict, name: str, timeout: int = STAGE_TIMEOUT, retries: i
     return None
 
 
+#: Set by _stage_entry: long multi-measurement stages flush interim
+#: results here (via _flush_stage) so a timeout kill salvages completed
+#: measurements — the parent reads whatever was last written.
+_STAGE_OUT_PATH: Optional[str] = None
+
+
+def _flush_stage(payload: dict):
+    """Write a stage's in-progress results; marked interim until the
+    stage returns normally (the final write overwrites)."""
+    if _STAGE_OUT_PATH:
+        with open(_STAGE_OUT_PATH, "w") as f:
+            json.dump({**payload, "interim": True}, f, default=str)
+
+
 def _stage_entry(name: str, out_path: str) -> int:
     """Subprocess side: run one stage, write its JSON result or error."""
+    global _STAGE_OUT_PATH
+    _STAGE_OUT_PATH = out_path
     if os.environ.get("BENCH_FORCE_CPU"):
         import jax
 
@@ -724,20 +760,19 @@ def fleet_build_e2e() -> dict:
 # -- stage 2b: LSTM fleet (parity configs #3/#4) ----------------------------
 
 
-@stage
-def lstm_fleet_train() -> dict:
+def _lstm_fleet_setup():
     """
-    BASELINE.json parity configs #3 (LSTM AE) and #4 (LSTM forecast):
-    50-tag sliding-window fleets trained with on-device window gathering
-    (WindowedFleetMember — the raw series stays device-resident; windows
-    are gathered per batch inside the fused program).
+    The ONE LSTM fleet definition both LSTM stages measure — the
+    experiments stage's restart baseline is only comparable to the core
+    `lstm_ae` rate because they share this geometry verbatim.
+
+    Returns ``(members, config, n_lstm, lstm_kwargs)`` where ``members``
+    is a ``members(lookahead)`` factory.
     """
     from gordo_tpu.models.factories import lstm_model
     from gordo_tpu.models.training import FitConfig
     from gordo_tpu.ops.windows import window_targets
-    from gordo_tpu.parallel import FleetTrainer, WindowedFleetMember
-
-    _setup_jax_cache()
+    from gordo_tpu.parallel import WindowedFleetMember
 
     import jax
 
@@ -747,7 +782,7 @@ def lstm_fleet_train() -> dict:
     n_lstm = N_LSTM_MODELS
     if jax.default_backend() != "tpu":
         n_lstm = min(n_lstm, 8)
-        log(f"lstm stage: CPU backend, capping fleet at {n_lstm} members")
+        log(f"lstm setup: CPU backend, capping fleet at {n_lstm} members")
 
     # shuffle=False: the product LSTM path pins it (estimators.py — the
     # reference fits its timeseries generator unshuffled), so the bench
@@ -788,6 +823,23 @@ def lstm_fleet_train() -> dict:
             )
             for i, X in enumerate(series)
         ]
+
+    return members, config, n_lstm, lstm_kwargs
+
+
+@stage
+def lstm_fleet_train() -> dict:
+    """
+    BASELINE.json parity configs #3 (LSTM AE) and #4 (LSTM forecast):
+    50-tag sliding-window fleets trained with on-device window gathering
+    (WindowedFleetMember — the raw series stays device-resident; windows
+    are gathered per batch inside the fused program).
+    """
+    from gordo_tpu.models.factories import lstm_model
+    from gordo_tpu.parallel import FleetTrainer
+
+    _setup_jax_cache()
+    members, config, n_lstm, lstm_kwargs = _lstm_fleet_setup()
 
     trainer = FleetTrainer()
     rates = {}
@@ -853,46 +905,9 @@ def lstm_fleet_train() -> dict:
         )
     )
 
-    # -- segmented (stateful-scan) path: the measured answer to the
-    # window-restart redundancy. TPU-gated like packing/bf16 (on the CPU
-    # fallback it would only burn budget).
-    segmented_rate = None
-    seg = os.environ.get("BENCH_LSTM_SEGMENTED", "4")
-    seg_usable = seg.isdigit() and int(seg) > 0 and BATCH % int(seg) == 0
-    if not seg_usable and seg not in ("", "0"):
-        # fleet._segmented_eligible would silently fall back to the
-        # window-restart path — never label a restart timing "segmented"
-        log(f"segmented measurement skipped: G={seg!r} invalid for batch {BATCH}")
-    if jax.default_backend() == "tpu" and seg_usable:
-        os.environ["GORDO_TPU_LSTM_SEGMENTED"] = seg
-        try:
-            fleet = members(0)
-            trainer.train(fleet, config)  # warmup/compile
-            seg_elapsed, seg_results = _timed_best(
-                trainer, fleet, config, n=min(2, int(os.environ.get("BENCH_TIMED_RUNS", 2)))
-            )
-            seg_losses = [r.history.history["loss"][-1] for r in seg_results]
-            assert all(np.isfinite(seg_losses)), "non-finite segmented losses"
-            segmented_rate = n_lstm / (seg_elapsed / 3600.0)
-            log(
-                f"lstm_ae segmented (G={seg}): {seg_elapsed:.2f}s -> "
-                f"{segmented_rate:.0f} models/hour "
-                f"({elapsed_by_key['lstm_ae'] / seg_elapsed:.2f}x vs restart)"
-            )
-        finally:
-            os.environ.pop("GORDO_TPU_LSTM_SEGMENTED", None)
-
     return {
         "lstm_ae_models_per_hour": round(rates["lstm_ae"], 1),
         "lstm_forecast_models_per_hour": round(rates["lstm_forecast"], 1),
-        "lstm_segmented_models_per_hour": (
-            round(segmented_rate, 1) if segmented_rate is not None else None
-        ),
-        "lstm_segmented_speedup": (
-            round(segmented_rate / rates["lstm_ae"], 3)
-            if segmented_rate
-            else None
-        ),
         "roofline": {
             "loop_iters_per_epoch": loop_iters_per_epoch,
             "unroll": unroll,
@@ -910,6 +925,113 @@ def lstm_fleet_train() -> dict:
         "epochs": LSTM_EPOCHS,
         "device": _device_desc(),
     }
+
+
+# -- stage 2b': LSTM experiments (segmented path, unroll sweep) -------------
+
+
+@stage
+def lstm_experiments() -> dict:
+    """
+    The measured answers to the LSTM 100× question, isolated in their own
+    stage so a budget clamp can never take the core LSTM rates down with
+    them (they run LAST):
+
+    - **segmented (stateful-scan) training** at BENCH_LSTM_SEGMENTED
+      segments/update — the ~lookback× FLOP/HBM cut vs window-restart;
+    - **scan-unroll sweep** — the per-scan-iteration-overhead killer:
+      the same window-restart fleet at GORDO_TPU_LSTM_UNROLL 4 (the
+      default), 15, and 60 (= fully unrolled recurrence, no inner loop).
+      The unroll knob is read at trace time, so each sweep point clears
+      the (spec, config)-keyed program caches to force a rebuild.
+
+    TPU-only: on the CPU fallback these would only burn budget.
+    """
+    from gordo_tpu.models import training as training_mod
+    from gordo_tpu.parallel import FleetTrainer
+    from gordo_tpu.parallel import fleet as fleet_mod
+
+    _setup_jax_cache()
+
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": "accelerator-only experiments (CPU backend)"}
+
+    members, config, n_lstm, _ = _lstm_fleet_setup()
+
+    def clear_program_caches():
+        # the unroll env var is read at trace time; cached programs for
+        # the same (spec, config) must be rebuilt to pick it up
+        fleet_mod._fleet_windowed_fit_program.cache_clear()
+        fleet_mod._fleet_segmented_fit_program.cache_clear()
+        training_mod.build_raw_windowed_fit_fn.cache_clear()
+        training_mod.build_raw_segmented_fit_fn.cache_clear()
+
+    trainer = FleetTrainer()
+    n_runs = min(2, int(os.environ.get("BENCH_TIMED_RUNS", 2)))
+
+    def measure(label: str) -> float:
+        fleet = members(0)
+        trainer.train(fleet, config)  # warmup/compile
+        # best-of-2 like the core LSTM stage: tunneled-transfer latency
+        # varies ±50% run to run, and these speedup ratios are the
+        # round's headline experiment evidence
+        elapsed, results = _timed_best(trainer, fleet, config, n=n_runs)
+        losses = [r.history.history["loss"][-1] for r in results]
+        assert all(np.isfinite(losses)), f"non-finite {label} losses"
+        rate = n_lstm / (elapsed / 3600.0)
+        log(f"lstm experiment {label}: {elapsed:.2f}s -> {rate:.0f} models/hour")
+        return rate
+
+    result: dict = {"n_models": n_lstm, "device": _device_desc()}
+
+    # Baseline PINNED to unroll=4 (the shipped default) regardless of any
+    # operator GORDO_TPU_LSTM_UNROLL in the environment — every speedup
+    # ratio below is "vs the default configuration", so the baseline must
+    # actually run it.
+    prior_unroll = os.environ.get("GORDO_TPU_LSTM_UNROLL")
+    try:
+        os.environ["GORDO_TPU_LSTM_UNROLL"] = "4"
+        clear_program_caches()
+        base_rate = measure("restart@unroll=4 (baseline)")
+        result["restart_models_per_hour"] = round(base_rate, 1)
+        result["baseline_unroll"] = 4
+        _flush_stage(result)
+
+        seg = os.environ.get("BENCH_LSTM_SEGMENTED", "4")
+        if seg.isdigit() and int(seg) > 0 and BATCH % int(seg) == 0:
+            os.environ["GORDO_TPU_LSTM_SEGMENTED"] = seg
+            try:
+                seg_rate = measure(f"segmented G={seg}")
+            finally:
+                os.environ.pop("GORDO_TPU_LSTM_SEGMENTED", None)
+            result["segmented_models_per_hour"] = round(seg_rate, 1)
+            result["segmented_speedup"] = round(seg_rate / base_rate, 3)
+            _flush_stage(result)
+        elif seg not in ("", "0"):
+            log(f"segmented skipped: G={seg!r} invalid for batch {BATCH}")
+
+        for unroll_raw in os.environ.get("BENCH_LSTM_UNROLL_SWEEP", "15,60").split(","):
+            unroll = unroll_raw.strip()
+            if not unroll:
+                continue
+            if not unroll.isdigit():
+                log(f"unroll sweep: skipping non-numeric entry {unroll_raw!r}")
+                continue
+            os.environ["GORDO_TPU_LSTM_UNROLL"] = unroll
+            clear_program_caches()
+            rate = measure(f"restart@unroll={unroll}")
+            result[f"unroll_{unroll}_models_per_hour"] = round(rate, 1)
+            result[f"unroll_{unroll}_speedup"] = round(rate / base_rate, 3)
+            _flush_stage(result)
+    finally:
+        if prior_unroll is None:
+            os.environ.pop("GORDO_TPU_LSTM_UNROLL", None)
+        else:
+            os.environ["GORDO_TPU_LSTM_UNROLL"] = prior_unroll
+        clear_program_caches()
+    return result
 
 
 # -- stage 2c: anomaly-score parity vs TF2 ---------------------------------
@@ -1040,6 +1162,7 @@ def _emit_result(partial: dict) -> int:
     fleet = partial.get("fleet_train")
     e2e = partial.get("fleet_build_e2e")
     lstm = partial.get("lstm_fleet_train")
+    experiments = partial.get("lstm_experiments")
     reference = partial.get("reference_keras")
     parity_rec = partial.get("parity")
 
@@ -1073,11 +1196,8 @@ def _emit_result(partial: dict) -> int:
             "lstm_forecast_models_per_hour": (
                 lstm["lstm_forecast_models_per_hour"] if lstm else None
             ),
-            "lstm_segmented_models_per_hour": (
-                lstm.get("lstm_segmented_models_per_hour") if lstm else None
-            ),
-            "lstm_segmented_speedup": (
-                lstm.get("lstm_segmented_speedup") if lstm else None
+            "lstm_experiments": (
+                experiments if experiments and "skipped" not in experiments else None
             ),
             "roofline": fleet.get("roofline") if fleet else None,
             "lstm_roofline": lstm.get("roofline") if lstm else None,
@@ -1180,6 +1300,9 @@ def main():
         run_stage(partial, "fleet_build_e2e")
     if not os.environ.get("BENCH_SKIP_LSTM"):
         run_stage(partial, "lstm_fleet_train", retries=1)
+        # experiments (segmented path, unroll sweep) run LAST: if the
+        # budget clamps anything, it is these, never the core rates
+        run_stage(partial, "lstm_experiments", retries=0)
 
     sys.exit(_emit_result(partial))
 
