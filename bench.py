@@ -232,7 +232,12 @@ def _run_stage_subprocess(name: str, timeout: int, force_cpu: bool):
             payload["timeout_note"] = f"killed at {timeout}s; interim results"
             return payload, None
         return None, f"timeout after {timeout}s (stage subprocess killed)"
-    if payload is None:
+    if payload is None or payload.get("interim"):
+        # no result — or only an interim flush left behind by a CRASHED
+        # process (OOM kill, segfault). Unlike a timeout, a crash is
+        # worth the normal retry/CPU-fallback path, which can still
+        # produce complete results; accepting the partial here would
+        # silently skip both.
         return None, f"stage subprocess died (rc={proc.returncode}) without a result"
     if "error" in payload:
         return None, payload["error"]
@@ -325,12 +330,20 @@ def run_stage(partial: dict, name: str, timeout: int = STAGE_TIMEOUT, retries: i
 _STAGE_OUT_PATH: Optional[str] = None
 
 
+def _write_json_atomic(path: str, payload: dict):
+    """tmp + os.replace so a kill mid-write can never leave truncated
+    JSON — every observable file state is a complete document."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, default=str)
+    os.replace(tmp, path)
+
+
 def _flush_stage(payload: dict):
     """Write a stage's in-progress results; marked interim until the
     stage returns normally (the final write overwrites)."""
     if _STAGE_OUT_PATH:
-        with open(_STAGE_OUT_PATH, "w") as f:
-            json.dump({**payload, "interim": True}, f, default=str)
+        _write_json_atomic(_STAGE_OUT_PATH, {**payload, "interim": True})
 
 
 def _stage_entry(name: str, out_path: str) -> int:
@@ -349,9 +362,22 @@ def _stage_entry(name: str, out_path: str) -> int:
         payload = result
     except Exception as exc:  # noqa: BLE001 - report, don't crash silently
         traceback.print_exc(file=sys.stderr)
-        payload = {"error": f"{type(exc).__name__}: {exc}"}
-    with open(out_path, "w") as f:
-        json.dump(payload, f, default=str)
+        error = f"{type(exc).__name__}: {exc}"
+        # A late failure must not clobber measurements already flushed:
+        # keep them and note the error under a non-"error" key so the
+        # parent accepts the partials (the error key would discard them).
+        prior = None
+        try:
+            with open(out_path) as f:
+                prior = json.loads(f.read() or "null")
+        except (OSError, ValueError):
+            pass
+        if isinstance(prior, dict) and prior.get("interim"):
+            payload = {**prior, "stage_error": error}
+            payload.pop("interim", None)
+        else:
+            payload = {"error": error}
+    _write_json_atomic(out_path, payload)
     return 0
 
 
@@ -1001,13 +1027,18 @@ def lstm_experiments() -> dict:
 
         seg = os.environ.get("BENCH_LSTM_SEGMENTED", "4")
         if seg.isdigit() and int(seg) > 0 and BATCH % int(seg) == 0:
+            # per-point isolation: one failed experiment records its
+            # error and the remaining points still run
             os.environ["GORDO_TPU_LSTM_SEGMENTED"] = seg
             try:
                 seg_rate = measure(f"segmented G={seg}")
+                result["segmented_models_per_hour"] = round(seg_rate, 1)
+                result["segmented_speedup"] = round(seg_rate / base_rate, 3)
+            except Exception as exc:  # noqa: BLE001 - isolate the point
+                log(f"segmented measurement failed: {exc}")
+                result["segmented_error"] = f"{type(exc).__name__}: {exc}"
             finally:
                 os.environ.pop("GORDO_TPU_LSTM_SEGMENTED", None)
-            result["segmented_models_per_hour"] = round(seg_rate, 1)
-            result["segmented_speedup"] = round(seg_rate / base_rate, 3)
             _flush_stage(result)
         elif seg not in ("", "0"):
             log(f"segmented skipped: G={seg!r} invalid for batch {BATCH}")
@@ -1021,9 +1052,13 @@ def lstm_experiments() -> dict:
                 continue
             os.environ["GORDO_TPU_LSTM_UNROLL"] = unroll
             clear_program_caches()
-            rate = measure(f"restart@unroll={unroll}")
-            result[f"unroll_{unroll}_models_per_hour"] = round(rate, 1)
-            result[f"unroll_{unroll}_speedup"] = round(rate / base_rate, 3)
+            try:
+                rate = measure(f"restart@unroll={unroll}")
+                result[f"unroll_{unroll}_models_per_hour"] = round(rate, 1)
+                result[f"unroll_{unroll}_speedup"] = round(rate / base_rate, 3)
+            except Exception as exc:  # noqa: BLE001 - isolate the point
+                log(f"unroll={unroll} measurement failed: {exc}")
+                result[f"unroll_{unroll}_error"] = f"{type(exc).__name__}: {exc}"
             _flush_stage(result)
     finally:
         if prior_unroll is None:

@@ -91,3 +91,52 @@ def test_example_file_data_config_trains(tmp_path):
     meta = machine.metadata.build_metadata.dataset.dataset_meta
     # train_end_date is exclusive, so the final 00:00 point drops off
     assert meta["row_count"] == len(idx) - 1
+
+
+def test_example_influx_callbacks_config_trains(monkeypatch):
+    """examples/config-influx-callbacks.yaml works end to end against an
+    in-memory Influx fake (the same series layout the example's header
+    describes), with its callback stack riding the host loop."""
+    import re
+    import sys
+    import types
+
+    import numpy as np
+    import pandas as pd
+
+    from gordo_tpu.builder import local_build
+
+    idx = pd.date_range("2020-01-01", "2020-02-01", freq="10min", tz="UTC")
+
+    class FakeDataFrameClient:
+        store = {
+            f"plant-tag-{i}": pd.DataFrame(
+                {"Value": np.sin(np.arange(len(idx)) / (40.0 + i))}, index=idx
+            )
+            for i in (1, 2, 3)
+        }
+
+        def __init__(self, *args, **kwargs):
+            pass
+
+        def query(self, q):
+            tag = re.search(r'"tag" = \'([^\']+)\'', q).group(1)
+            return {"sensors": self.store[tag]}
+
+    module = types.ModuleType("influxdb")
+    module.DataFrameClient = FakeDataFrameClient
+    monkeypatch.setitem(sys.modules, "influxdb", module)
+
+    with open(os.path.join(EXAMPLES, "config-influx-callbacks.yaml")) as fh:
+        config = yaml.safe_load(fh)
+    estimator = config["globals"]["model"][
+        "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector"
+    ]["base_estimator"]["sklearn.pipeline.Pipeline"]["steps"][1][
+        "gordo_tpu.models.estimators.JaxAutoEncoder"
+    ]
+    assert len(estimator["callbacks"]) == 3
+    estimator["epochs"] = 2
+
+    model, machine = next(local_build(yaml.safe_dump(config)))
+    assert machine.name == "plant-b-compressor"
+    assert model.aggregate_threshold_ is not None
