@@ -33,7 +33,9 @@ from .training import (
     History,
     fit_config_from_kwargs,
     fit_single,
+    fit_single_segmented,
     predict_fn,
+    segmented_config,
     split_fit_kwargs,
 )
 
@@ -305,7 +307,6 @@ class JaxLSTMBaseEstimator(JaxBaseEstimator, TransformerMixin, metaclass=abc.ABC
             y = y.reshape(-1, 1)
         X = self._validate_and_fix_size_of_X(X)
 
-        windows = sliding_windows(X, self.lookback_window, self.lookahead)
         targets = window_targets(y, self.lookback_window, self.lookahead)
 
         self.kwargs.update(
@@ -318,12 +319,37 @@ class JaxLSTMBaseEstimator(JaxBaseEstimator, TransformerMixin, metaclass=abc.ABC
         fit_kwargs, factory_kwargs = split_fit_kwargs(all_kwargs)
         self.spec_ = self._build_spec(factory_kwargs)
         config, host_callbacks = fit_config_from_kwargs(fit_kwargs)
+        seed = int(fit_kwargs.get("seed", 42))
+
+        # Opt-in segmented (stateful-scan) training — same env knob as the
+        # fleet path: the raw series goes to the device and the host never
+        # materializes the lookback× window blowup. Host callbacks need
+        # the per-epoch loop, which only the dense program provides;
+        # ineligible fits fall through silently.
+        segments = segmented_config()
+        if (
+            segments
+            and not host_callbacks
+            and config.batch_size % segments == 0
+            and len(targets) >= config.batch_size
+        ):
+            self.params_, self._history = fit_single_segmented(
+                self.spec_,
+                X,
+                targets,
+                config,
+                seed=seed,
+                segments=segments,
+            )
+            return self
+
+        windows = sliding_windows(X, self.lookback_window, self.lookahead)
         self.params_, self._history = fit_single(
             self.spec_,
             np.asarray(windows, np.float32),
             np.asarray(targets, np.float32),
             config,
-            seed=int(fit_kwargs.get("seed", 42)),
+            seed=seed,
             host_callbacks=host_callbacks,
         )
         return self

@@ -40,6 +40,20 @@ from .spec import ModelSpec
 logger = logging.getLogger(__name__)
 
 
+def segmented_config() -> Optional[int]:
+    """The opt-in segments-per-update for segmented LSTM training (env
+    GORDO_TPU_LSTM_SEGMENTED: 0/unset = off, N = segments per update;
+    see build_raw_segmented_fit_fn for the trade). Shared by the fleet
+    trainer and the single-model estimator path."""
+    import os
+
+    try:
+        value = int(os.environ.get("GORDO_TPU_LSTM_SEGMENTED", "0"))
+    except ValueError:
+        return None
+    return value if value > 0 else None
+
+
 @dataclass(frozen=True)
 class FitConfig:
     """Static (hashable) fit configuration — part of the compilation key."""
@@ -559,7 +573,10 @@ def build_raw_segmented_fit_fn(
             starts, wb = batch
             loss = update_loss(params, series, ytgt, starts, wb)
             wsum = jnp.sum(wb)
-            return (acc[0] + loss * wsum, acc[1] + wsum), None
+            # an all-padding batch yields NaN mean loss; NaN*0 is NaN,
+            # so the guard (not the weight) must zero its contribution
+            contribution = jnp.where(wsum > 0, loss * wsum, 0.0)
+            return (acc[0] + contribution, acc[1] + wsum), None
 
         (total, wsum), _ = jax.lax.scan(
             step,
@@ -589,6 +606,81 @@ def build_raw_segmented_fit_fn(
 def _fit_program(spec: ModelSpec, config: FitConfig):
     """Jitted single-model fused fit program for (spec, config)."""
     return jax.jit(build_raw_fit_fn(spec, config))
+
+
+@lru_cache(maxsize=None)
+def _segmented_fit_program(spec: ModelSpec, config: FitConfig, segments: int):
+    """Jitted single-model segmented fit program."""
+    return jax.jit(build_raw_segmented_fit_fn(spec, config, segments))
+
+
+def fit_single_segmented(
+    spec: ModelSpec,
+    series: np.ndarray,
+    targets: np.ndarray,
+    config: FitConfig,
+    seed: int = 42,
+    segments: int = 4,
+) -> Tuple[Any, History]:
+    """
+    Single-model segmented (stateful-scan) LSTM fit: the estimator-path
+    twin of the fleet's segmented program. Takes the RAW ``series[n, F]``
+    and aligned ``targets[nw, F]`` (ops.windows.window_targets) — the
+    host never materializes the ``lookback×`` window blowup the dense
+    single-model path pays. Validation split is the Keras-style tail
+    fraction over the window axis, exactly like :func:`fit_single` over
+    materialized windows. See :func:`build_raw_segmented_fit_fn` for the
+    semantics trade vs window-restart training.
+    """
+    if config.shuffle:
+        raise ValueError("segmented LSTM training requires shuffle=False")
+    series = np.asarray(series, np.float32)
+    targets = np.asarray(targets, np.float32)
+    nw = len(targets)
+    batch_size = config.batch_size
+    if batch_size % segments or nw < batch_size:
+        raise ValueError(
+            f"segments={segments} needs batch_size divisible by it and at "
+            f"least one full batch of windows (nw={nw}, batch={batch_size})"
+        )
+    nv = -(-nw // batch_size) * batch_size
+    n_val = int(nw * config.validation_split)
+    wtr = np.zeros(nv, np.float32)
+    wtr[: nw - n_val] = 1.0
+    wval = np.zeros(nv, np.float32)
+    if n_val:
+        wval[nw - n_val : nw] = 1.0
+
+    rng = jax.random.PRNGKey(seed)
+    rng, init_rng = jax.random.split(rng)
+    params = init_fn_for(spec)(init_rng, spec)
+    opt_state = spec.optimizer.to_optax().init(params)
+
+    fit = _segmented_fit_program(spec, config, segments)
+    params, _, losses, val_losses, epochs_ran = fit(
+        params, opt_state, series, targets, wtr, wval, rng
+    )
+    # one coalesced d2h readback — per-element float() would pay the
+    # fixed per-transfer latency once PER EPOCH on tunneled accelerators
+    losses, val_losses, epochs_ran = jax.device_get(
+        (losses, val_losses, epochs_ran)
+    )
+    epochs_ran = int(epochs_ran)
+    history = {"loss": [float(l) for l in losses[:epochs_ran]]}
+    if n_val:
+        history["val_loss"] = [float(l) for l in val_losses[:epochs_ran]]
+    return params, History(
+        history=history,
+        params={
+            "epochs": config.epochs,
+            # train-only, like fit_single over materialized windows
+            "steps": (nw - n_val + batch_size - 1) // batch_size,
+            "verbose": 0,
+            "metrics": list(history),
+            "segmented": segments,
+        },
+        epoch=list(range(epochs_ran)),
+    )
 
 
 def fit_single(
@@ -647,6 +739,11 @@ def fit_single(
     fit = _fit_program(spec, config)
     params, _, losses, val_losses, epochs_ran = fit(
         params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng
+    )
+    # one coalesced d2h readback — per-element float() would pay the
+    # fixed per-transfer latency once PER EPOCH on tunneled accelerators
+    losses, val_losses, epochs_ran = jax.device_get(
+        (losses, val_losses, epochs_ran)
     )
     epochs_ran = int(epochs_ran)
     history = {"loss": [float(l) for l in losses[:epochs_ran]]}

@@ -39,7 +39,12 @@ from jax.sharding import Mesh
 
 from ..models.nn import forward_fn_for, init_fn_for
 from ..models.spec import ModelSpec
-from ..models.training import FitConfig, History, build_raw_fit_fn
+from ..models.training import (
+    FitConfig,
+    History,
+    build_raw_fit_fn,
+    segmented_config,
+)
 from .mesh import make_mesh, model_data_sharding, model_sharding
 
 logger = logging.getLogger(__name__)
@@ -236,15 +241,10 @@ def _fleet_segmented_fit_program(
     return jax.jit(jax.vmap(raw_fit))
 
 
-def _segmented_config() -> Optional[int]:
-    """The opt-in segments-per-update for segmented LSTM fleet training
-    (env GORDO_TPU_LSTM_SEGMENTED: 0/unset = off, N = segments per
-    update; see build_raw_segmented_fit_fn for the trade)."""
-    try:
-        value = int(os.environ.get("GORDO_TPU_LSTM_SEGMENTED", "0"))
-    except ValueError:
-        return None
-    return value if value > 0 else None
+#: the shared GORDO_TPU_LSTM_SEGMENTED knob parser lives beside the
+#: segmented program builder (models/training.py) — both the fleet and
+#: the single-model estimator path read it from there
+_segmented_config = segmented_config
 
 
 @lru_cache(maxsize=None)

@@ -1,0 +1,116 @@
+"""
+Estimator-level segmented (stateful-scan) LSTM training — the single-
+model twin of the fleet opt-in (GORDO_TPU_LSTM_SEGMENTED): the raw
+series trains without host-side window materialization, matching the
+window-restart path exactly at segment length 1 and staying in the same
+quality regime at real segment counts.
+"""
+
+import numpy as np
+import pytest
+
+from gordo_tpu.models.estimators import JaxLSTMAutoEncoder
+from gordo_tpu.models.factories import lstm_model
+from gordo_tpu.models.training import FitConfig, fit_single_segmented
+from gordo_tpu.ops.windows import window_targets
+
+LOOKBACK = 8
+TAGS = 3
+
+
+def _series(n=90, seed=0):
+    return np.random.RandomState(seed).rand(n, TAGS).astype(np.float32)
+
+
+def _fit_estimator(monkeypatch, segments, **kwargs):
+    if segments:
+        monkeypatch.setenv("GORDO_TPU_LSTM_SEGMENTED", str(segments))
+    else:
+        monkeypatch.delenv("GORDO_TPU_LSTM_SEGMENTED", raising=False)
+    model = JaxLSTMAutoEncoder(
+        kind="lstm_model",
+        lookback_window=LOOKBACK,
+        encoding_dim=[8],
+        encoding_func=["tanh"],
+        decoding_dim=[8],
+        decoding_func=["tanh"],
+        epochs=3,
+        batch_size=16,
+        seed=1,
+        **kwargs,
+    )
+    X = _series()
+    model.fit(X, X)
+    return model, X
+
+
+def test_estimator_segmented_single_window_matches_dense(monkeypatch):
+    """L=1 segments (G=batch) are cold windows in batch order: losses and
+    predictions must match the materialized-window path."""
+    dense, X = _fit_estimator(monkeypatch, None)
+    segmented, _ = _fit_estimator(monkeypatch, 16)
+    np.testing.assert_allclose(
+        segmented._history.history["loss"],
+        dense._history.history["loss"],
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        segmented.predict(X), dense.predict(X), rtol=1e-4, atol=1e-6
+    )
+    assert segmented._history.params.get("segmented") == 16
+
+
+def test_estimator_segmented_real_segments_trains(monkeypatch):
+    model, X = _fit_estimator(monkeypatch, 4)
+    losses = model._history.history["loss"]
+    assert len(losses) == 3 and all(np.isfinite(losses))
+    out = model.predict(X)
+    # model-offset contract unchanged: lookback-1 rows shorter
+    assert out.shape == (len(X) - LOOKBACK + 1, TAGS)
+
+
+def test_estimator_falls_back_with_host_callbacks(monkeypatch):
+    """Custom callbacks need the per-epoch host loop — segmented must
+    quietly defer to the dense path rather than dropping them."""
+    from gordo_tpu.models.callbacks import Callback
+
+    class Recorder(Callback):
+        epochs = []
+
+        def on_epoch_end(self, epoch, logs=None):
+            Recorder.epochs.append(epoch)
+            return False
+
+    model, _ = _fit_estimator(monkeypatch, 4, callbacks=[Recorder()])
+    assert Recorder.epochs  # the callback actually ran
+    assert "segmented" not in model._history.params
+
+
+def test_fit_single_segmented_validation_split():
+    spec = lstm_model(
+        TAGS, lookback_window=LOOKBACK,
+        encoding_dim=(8,), encoding_func=("tanh",),
+        decoding_dim=(8,), decoding_func=("tanh",),
+    )
+    X = _series(120)
+    targets = window_targets(X, LOOKBACK, 0)
+    config = FitConfig(
+        epochs=2, batch_size=16, shuffle=False, validation_split=0.25
+    )
+    _, history = fit_single_segmented(spec, X, targets, config, segments=4)
+    assert "val_loss" in history.history
+    assert all(np.isfinite(history.history["val_loss"]))
+
+
+def test_fit_single_segmented_rejects_shuffle():
+    spec = lstm_model(
+        TAGS, lookback_window=LOOKBACK,
+        encoding_dim=(8,), encoding_func=("tanh",),
+        decoding_dim=(8,), decoding_func=("tanh",),
+    )
+    X = _series()
+    with pytest.raises(ValueError, match="shuffle"):
+        fit_single_segmented(
+            spec, X, window_targets(X, LOOKBACK, 0),
+            FitConfig(epochs=1, batch_size=16, shuffle=True),
+        )

@@ -211,3 +211,58 @@ def test_fleet_retries_diverged_members():
     # untouched members record their original seed and zero retries
     assert results[0].retries == 0 and results[0].seed == members[0].seed
     assert "fleet_retry" not in results[0].history.params
+
+
+class TestFetchToHost:
+    """Coalesced device→host fetch: values must round-trip exactly for
+    any leaf count — including past _FLAT_CONCAT_MAX_LEAVES, where the
+    coalescing proceeds in chunks rather than reverting to per-leaf
+    transfers (the largest fleets are exactly where per-leaf round trips
+    hurt most)."""
+
+    def _tree(self, n_leaves, dtype=np.float32):
+        import jax
+
+        rng = np.random.RandomState(0)
+        return {
+            f"leaf_{i}": jax.device_put(
+                rng.standard_normal((3, i % 5 + 1)).astype(dtype)
+            )
+            for i in range(n_leaves)
+        }
+
+    @pytest.mark.parametrize("n_leaves", [2, 7, 300])
+    def test_round_trips_exactly(self, n_leaves):
+        from gordo_tpu.parallel.fleet import fetch_to_host
+
+        tree = self._tree(n_leaves)
+        host = fetch_to_host(tree)
+        assert set(host) == set(tree)
+        for key, device_leaf in tree.items():
+            np.testing.assert_array_equal(host[key], np.asarray(device_leaf))
+            assert isinstance(host[key], np.ndarray)
+
+    def test_mixed_dtypes_past_chunk_cap(self):
+        import jax
+
+        from gordo_tpu.parallel.fleet import _FLAT_CONCAT_MAX_LEAVES, fetch_to_host
+
+        n = _FLAT_CONCAT_MAX_LEAVES + 20
+        tree = {
+            **{f"f{i}": jax.device_put(np.full((2,), i, np.float32)) for i in range(n)},
+            **{f"i{i}": jax.device_put(np.full((3,), -i, np.int32)) for i in range(40)},
+        }
+        host = fetch_to_host(tree)
+        for i in range(n):
+            np.testing.assert_array_equal(host[f"f{i}"], np.full((2,), i, np.float32))
+        for i in range(40):
+            np.testing.assert_array_equal(host[f"i{i}"], np.full((3,), -i, np.int32))
+
+    def test_leaves_are_independent_copies(self):
+        """Slicing out of the coalesced buffer must copy — a view would
+        pin the whole transfer buffer for the life of any one leaf."""
+        from gordo_tpu.parallel.fleet import fetch_to_host
+
+        host = fetch_to_host(self._tree(6))
+        leaf = host["leaf_0"]
+        assert leaf.base is None, "leaf is a view into the coalesced buffer"
