@@ -26,7 +26,6 @@ are independent of bucket composition and deterministic per seed.
 """
 
 import logging
-import os
 from collections import defaultdict
 from dataclasses import dataclass, replace
 from functools import lru_cache
