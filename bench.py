@@ -208,6 +208,11 @@ def _run_stage_subprocess(name: str, timeout: int, force_cpu: bool):
         _apply_cpu_shrink(env)
     timed_out = False
     proc = None
+    # stages that run optional second passes (e2e steady-state) read this
+    # wall-clock deadline to decide whether the extra pass still fits —
+    # a duration would ignore the stage's own setup time before the
+    # check (imports, machine construction)
+    env["BENCH_STAGE_DEADLINE"] = str(time.time() + timeout)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--stage", name, out_path],
@@ -735,7 +740,35 @@ def fleet_build_e2e() -> dict:
     import jax
 
     steady_elapsed = None
-    if jax.default_backend() == "tpu" and not os.environ.get("BENCH_E2E_COLD_ONLY"):
+    # cold-result record, shared between the interim flush and the final
+    # return so a salvaged artifact can never disagree with a normal one
+    cold_result = {
+        "models_per_hour": N_E2E_MODELS / (elapsed / 3600.0),
+        "elapsed_s": round(elapsed, 3),
+        "cold_elapsed_s": round(elapsed, 3),
+        "steady_elapsed_s": None,
+        "n_machines": N_E2E_MODELS,
+        "device": _device_desc(),
+    }
+    # the cold number is salvageable from here on even if the steady
+    # pass is killed mid-run (interim flush; see _flush_stage)
+    _flush_stage(cold_result)
+    steady_wanted = jax.default_backend() == "tpu" and not os.environ.get(
+        "BENCH_E2E_COLD_ONLY"
+    )
+    # the steady pass re-runs the whole build; skip it when it no longer
+    # fits the wall-clock deadline — a half-finished steady run would be
+    # killed and lose its number (the cold one survives via the flush)
+    stage_remaining = (
+        float(os.environ.get("BENCH_STAGE_DEADLINE", "inf")) - time.time()
+    )
+    steady_fits = elapsed < 0.7 * stage_remaining
+    if steady_wanted and not steady_fits:
+        log(
+            f"e2e steady-state skipped: cold took {elapsed:.0f}s with only "
+            f"{stage_remaining:.0f}s of the stage window left"
+        )
+    if steady_wanted and steady_fits:
         machines = [machine.copy() for machine in machines]
         with tempfile.TemporaryDirectory() as output_dir:
             start = time.time()
@@ -769,17 +802,15 @@ def fleet_build_e2e() -> dict:
     )
     best_elapsed = min(elapsed, steady_elapsed or elapsed)
     return {
+        **cold_result,
         "models_per_hour": N_E2E_MODELS / (best_elapsed / 3600.0),
         "elapsed_s": round(best_elapsed, 3),
-        "cold_elapsed_s": round(elapsed, 3),
         "steady_elapsed_s": (
             round(steady_elapsed, 3) if steady_elapsed is not None else None
         ),
-        "n_machines": N_E2E_MODELS,
         "phases": phases,
         "device_program_s": round(device_s, 3),
         "host_s": round(host_s, 3),
-        "device": _device_desc(),
     }
 
 
