@@ -1,9 +1,15 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast docs bench lint image
+.PHONY: test test-fast test-faults docs bench lint image
 
 test:
 	python -m pytest tests/ -q
+
+# The deterministic fault-injection robustness suite (crash+resume,
+# bucket bisection, data-fetch retry) — CPU-only and not slow-marked,
+# so the same tests also run inside the tier-1 `-m 'not slow'` budget.
+test-faults:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults
 
 # The sub-5-minute tier: everything except the compile-heavy JAX suites
 # (tests/parallel, tests/models) and slow-marked tests.

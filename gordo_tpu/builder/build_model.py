@@ -388,14 +388,36 @@ class ModelBuilder:
         )
         return hashlib.sha3_256(payload.encode()).hexdigest()
 
+    @staticmethod
+    def _cache_entry_valid(path: str) -> bool:
+        """The ONE definition of a loadable cache entry — shared by the
+        coordinator's check_cache and the read-only probe_cache mirror so
+        multi-host processes can never disagree on cache hits."""
+        return os.path.isdir(path) and os.path.isfile(
+            os.path.join(path, "model.pkl")
+        )
+
+    @classmethod
+    def probe_cache(
+        cls, machine: Machine, model_register_dir: Union[os.PathLike, str]
+    ) -> Optional[str]:
+        """Read-only cache probe: like :meth:`check_cache` but with NO
+        stale-key cleanup, so non-coordinator SPMD processes can mirror
+        the coordinator's cache-hit machine filter without writing to the
+        shared registry."""
+        path = disk_registry.get_value(
+            model_register_dir, cls.calculate_cache_key(machine)
+        )
+        if path is None or not cls._cache_entry_valid(path):
+            return None
+        return path
+
     def check_cache(self, model_register_dir: Union[os.PathLike, str]) -> Optional[str]:
         """Return the cached model path for this machine, if valid."""
         path = disk_registry.get_value(model_register_dir, self.cache_key)
         if path is None:
             return None
-        if not os.path.isdir(path) or not os.path.isfile(
-            os.path.join(path, "model.pkl")
-        ):
+        if not self._cache_entry_valid(path):
             logger.warning("Registry key %s points at missing dir %s", self.cache_key, path)
             disk_registry.delete_value(model_register_dir, self.cache_key)
             return None
@@ -411,9 +433,11 @@ class ModelBuilder:
         output_dir: Union[os.PathLike, str],
     ) -> str:
         output_dir = str(output_dir)
-        os.makedirs(output_dir, exist_ok=True)
         metadata = machine.to_dict() if isinstance(machine, Machine) else machine
-        serializer.dump(model, output_dir, metadata=metadata)
+        # Atomic (staging dir + rename): a crash mid-save can never leave
+        # a half-written model.pkl where the registry or a resume pass
+        # would find it — same contract as the fleet builder's dumps.
+        serializer.dump_atomic(model, output_dir, metadata=metadata)
         return output_dir
 
 

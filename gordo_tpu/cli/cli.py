@@ -361,12 +361,21 @@ def run_server_cli(
     envvar="EXCEPTIONS_REPORT_LEVEL",
     help="Detail level for exception reporting",
 )
+@click.option(
+    "--resume",
+    is_flag=True,
+    envvar="FLEET_RESUME",
+    help="Resume a crashed build from OUTPUT_DIR's build journal: machines "
+    "journaled complete (config-hash matched, artifact checksum-verified) "
+    "are skipped; only the remainder is replanned and trained.",
+)
 def build_fleet(
     machines_config: str,
     output_dir: str,
     model_register_dir: Optional[str],
     exceptions_reporter_file: str,
     exceptions_report_level: str,
+    resume: bool,
 ):
     """
     Train a whole machine shard as mesh-sharded model batches on this TPU
@@ -403,6 +412,28 @@ def build_fleet(
         # shared build cache, or run reporters — otherwise N pods race on
         # the same files and duplicate every report.
         is_coordinator = int(os.getenv("JAX_PROCESS_INDEX", "0")) == 0
+        if not is_coordinator:
+            # The coordinator's machine filters must be mirrored here: all
+            # processes run ONE SPMD program, so every process has to
+            # train the same surviving machine set — a divergent list
+            # desynchronizes the collective device programs. Both mirrors
+            # read the shared volume without writing anything.
+            if resume:
+                from ..parallel.journal import resumable_names
+
+                skip = set(resumable_names(output_dir, machines))
+                machines = [m for m in machines if m.name not in skip]
+            if model_register_dir:
+                # read-only shadow of FleetBuilder.build's cache-hit
+                # filter (load_cached runs on the coordinator only);
+                # probe_cache shares check_cache's validity definition
+                from ..builder.build_model import ModelBuilder
+
+                machines = [
+                    m
+                    for m in machines
+                    if ModelBuilder.probe_cache(m, model_register_dir) is None
+                ]
         logger.info(
             "Fleet-building %d machines; output at %s%s",
             len(machines),
@@ -413,13 +444,15 @@ def build_fleet(
         results = builder.build(
             output_dir if is_coordinator else None,
             model_register_dir=model_register_dir if is_coordinator else None,
+            resume=resume,
         )
         if is_coordinator:
             for _, machine_out in results:
                 machine_out.report()
         logger.info(
-            "Fleet build complete: %d built, %d failed",
+            "Fleet build complete: %d built, %d resumed (skipped), %d failed",
             len(results),
+            len(builder.resumed),
             len(builder.build_errors),
         )
         if builder.build_errors:

@@ -10,7 +10,10 @@ import copy
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
-from dataclasses_json import dataclass_json
+try:
+    from dataclasses_json import dataclass_json
+except ImportError:  # container without dataclasses_json
+    from ..utils.dataclasses_compat import dataclass_json
 
 
 @dataclass_json
@@ -43,9 +46,22 @@ class DatasetBuildMetadata:
 
 @dataclass_json
 @dataclass
+class RobustnessMetadata:
+    """Per-machine fleet-build robustness counters: diverged-member
+    reseed retries, bucket bisection (split-retry) events the machine's
+    members rode through, and data-fetch retry total."""
+
+    fleet_retries: int = 0
+    bucket_bisects: int = 0
+    data_fetch_retries: int = 0
+
+
+@dataclass_json
+@dataclass
 class BuildMetadata:
     model: ModelBuildMetadata = field(default_factory=ModelBuildMetadata)
     dataset: DatasetBuildMetadata = field(default_factory=DatasetBuildMetadata)
+    robustness: RobustnessMetadata = field(default_factory=RobustnessMetadata)
 
 
 @dataclass_json
@@ -66,6 +82,7 @@ def _metadata_to_dict(self: Metadata, **_kwargs) -> Dict[str, Any]:
     """
     model = self.build_metadata.model
     dataset = self.build_metadata.dataset
+    robustness = self.build_metadata.robustness
     return {
         "user_defined": copy.deepcopy(self.user_defined),
         "build_metadata": {
@@ -84,6 +101,11 @@ def _metadata_to_dict(self: Metadata, **_kwargs) -> Dict[str, Any]:
             "dataset": {
                 "query_duration_sec": dataset.query_duration_sec,
                 "dataset_meta": copy.deepcopy(dataset.dataset_meta),
+            },
+            "robustness": {
+                "fleet_retries": robustness.fleet_retries,
+                "bucket_bisects": robustness.bucket_bisects,
+                "data_fetch_retries": robustness.data_fetch_retries,
             },
         },
     }

@@ -1,5 +1,12 @@
-from .fleet import FleetMember, FleetResult, FleetTrainer, WindowedFleetMember
+from .fleet import (
+    FleetMember,
+    FleetResult,
+    FleetTrainer,
+    WindowedFleetMember,
+    is_device_error,
+)
 from .fleet_build import FleetBuilder, fleet_build
+from .journal import BuildJournal, artifact_complete, clean_staging_dirs
 from .sequence import ring_windowed_anomaly_scores, ring_windowed_predict
 from .mesh import (
     DATA_AXIS,
@@ -17,6 +24,10 @@ __all__ = [
     "FleetResult",
     "FleetBuilder",
     "fleet_build",
+    "is_device_error",
+    "BuildJournal",
+    "artifact_complete",
+    "clean_staging_dirs",
     "make_mesh",
     "model_sharding",
     "model_data_sharding",

@@ -44,9 +44,28 @@ from ..models.training import (
     build_raw_fit_fn,
     segmented_config,
 )
+from ..utils.faults import InjectedDeviceError, fault_point
 from .mesh import make_mesh, model_data_sharding, model_sharding
 
 logger = logging.getLogger(__name__)
+
+try:  # the canonical runtime-error alias moved between jax versions
+    from jax.errors import JaxRuntimeError as _XlaRuntimeError
+except ImportError:  # pragma: no cover - older jaxlib spelling
+    from jaxlib.xla_extension import XlaRuntimeError as _XlaRuntimeError
+
+
+def is_device_error(exc: BaseException) -> bool:
+    """True for failures raised BY a device program — XLA runtime errors
+    (``RESOURCE_EXHAUSTED`` OOMs, preempted/poisoned device programs) and
+    their injected test stand-ins. These are the failures worth bucket
+    bisection: the bucket may simply be over-packed, or one member's
+    geometry may be poisonous, and retrying halves isolates which.
+    Host-side errors (bad config, data bugs) are deterministic and are
+    NOT classified as device errors."""
+    if isinstance(exc, (InjectedDeviceError, _XlaRuntimeError)):
+        return True
+    return "RESOURCE_EXHAUSTED" in str(exc)
 
 
 @dataclass
@@ -109,10 +128,15 @@ class WindowedFleetMember:
 @dataclass
 class FleetResult:
     name: str
-    params: Any  # host numpy pytree
+    params: Any  # host numpy pytree (None when ``error`` is set)
     history: History
     seed: int = 0  # the RNG seed this member actually trained with
     retries: int = 0  # diverged-member reseed retries that led to this result
+    #: set when this member's device program failed in ISOLATION after
+    #: bucket bisection — the member trained nothing; callers decide the
+    #: degradation policy (FleetBuilder falls back to the sequential
+    #: ModelBuilder path)
+    error: Optional[BaseException] = None
 
 
 def _fill_weight_row(wtr, wval, i, n, member, config: FitConfig):
@@ -334,6 +358,14 @@ class FleetTrainer:
     def __init__(self, mesh: Optional[Mesh] = None, packing=None):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.packing = packing
+        #: lifetime count of device-error bucket bisection events (the
+        #: FleetBuilder folds the per-build delta into its robustness
+        #: counters / Prometheus export)
+        self.bucket_bisects = 0
+        #: lifetime per-member split-event counts (member name -> events
+        #: its bucket rode through); lets the builder attribute trainer-
+        #: internal bisections to machines in BuildMetadata.robustness
+        self.bisect_counts: Dict[str, int] = {}
 
     def _packing_factor(self, spec, n_members: int, config: FitConfig) -> int:
         from ..models.packing import auto_packing
@@ -402,6 +434,14 @@ class FleetTrainer:
         loss) are re-vmapped into a retry bucket with a reseeded RNG, up to
         this many times — the chip-level analog of the reference DAG's
         per-pod retryStrategy (SURVEY.md §2.9 elasticity row).
+
+        CONTRACT: a member whose device program fails in ISOLATION (after
+        bucket bisection of an ``XlaRuntimeError``/``RESOURCE_EXHAUSTED``)
+        does NOT raise — it returns a ``FleetResult`` with ``params=None``
+        and the exception in ``error``. Callers must check
+        ``result.error`` before using ``result.params`` (FleetBuilder
+        degrades such machines to the sequential builder). Host-side
+        exceptions still raise for the whole call, as before.
         """
         results = self._train_once(members, config)
         for attempt in range(1, retry_failed + 1):
@@ -439,6 +479,7 @@ class FleetTrainer:
         self, members: Sequence[Any], config: FitConfig
     ) -> List[FleetResult]:
         by_name: Dict[str, FleetResult] = {}
+        failures: Dict[str, BaseException] = {}
         dense = [m for m in members if isinstance(m, FleetMember)]
         windowed = [m for m in members if isinstance(m, WindowedFleetMember)]
         for (spec, n_padded), bucket in self.bucket(dense, config).items():
@@ -455,8 +496,14 @@ class FleetTrainer:
                 if g > 1
                 else self._train_bucket
             )
-            for result in train_bucket(spec, n_padded, bucket, config):
-                by_name[result.name] = result
+            self._run_bucket_degraded(
+                lambda b, _fit=train_bucket, _s=spec, _n=n_padded: _fit(
+                    _s, _n, b, config
+                ),
+                bucket,
+                by_name,
+                failures,
+            )
         for (spec, n_padded, offset), bucket in self.bucket_windowed(
             windowed, config
         ).items():
@@ -466,11 +513,70 @@ class FleetTrainer:
                 type(spec).__name__,
                 n_padded,
             )
-            for result in self._train_windowed_bucket(
-                spec, n_padded, offset, bucket, config
-            ):
-                by_name[result.name] = result
+            self._run_bucket_degraded(
+                lambda b, _s=spec, _n=n_padded, _o=offset: (
+                    self._train_windowed_bucket(_s, _n, _o, b, config)
+                ),
+                bucket,
+                by_name,
+                failures,
+            )
+        for member in members:
+            if member.name in failures:
+                by_name[member.name] = FleetResult(
+                    name=member.name,
+                    params=None,
+                    history=History(history={"loss": []}, params={}, epoch=[]),
+                    seed=member.seed,
+                    error=failures[member.name],
+                )
         return [by_name[m.name] for m in members]
+
+    def _run_bucket_degraded(self, run, bucket, by_name, failures) -> None:
+        """
+        Run one bucket's device program with degradation: an
+        ``XlaRuntimeError``/``RESOURCE_EXHAUSTED`` failure bisects the
+        bucket and retries each half recursively — an over-packed bucket
+        resolves by splitting, a poisonous member is isolated down to a
+        single-member program whose failure lands in ``failures`` (the
+        member's FleetResult carries it as ``error``) instead of taking
+        the whole fleet down. Host-side exceptions propagate unchanged:
+        they are deterministic and would fail every half identically.
+        """
+        try:
+            for member in bucket:
+                fault_point("device_program", member.name)
+            results = run(bucket)
+        except Exception as exc:
+            if not is_device_error(exc):
+                raise
+            if len(bucket) == 1:
+                logger.error(
+                    "Device program failed for member %s in isolation: %r",
+                    bucket[0].name,
+                    exc,
+                )
+                failures[bucket[0].name] = exc
+                return
+            mid = len(bucket) // 2
+            self.bucket_bisects += 1
+            for member in bucket:
+                self.bisect_counts[member.name] = (
+                    self.bisect_counts.get(member.name, 0) + 1
+                )
+            logger.warning(
+                "Device program failed for bucket of %d members (%s); "
+                "bisecting into %d + %d",
+                len(bucket),
+                exc,
+                mid,
+                len(bucket) - mid,
+            )
+            self._run_bucket_degraded(run, bucket[:mid], by_name, failures)
+            self._run_bucket_degraded(run, bucket[mid:], by_name, failures)
+            return
+        for result in results:
+            by_name[result.name] = result
 
     def _stack_bucket(
         self, spec: ModelSpec, n_padded: int, bucket: List[FleetMember], config: FitConfig

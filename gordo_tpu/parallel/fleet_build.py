@@ -49,6 +49,7 @@ from ..machine.metadata import (
     CrossValidationMetaData,
     DatasetBuildMetadata,
     ModelBuildMetadata,
+    RobustnessMetadata,
 )
 from ..models.anomaly.diff import (
     DiffBasedAnomalyDetector,
@@ -58,12 +59,17 @@ from ..models.estimators import JaxBaseEstimator, JaxLSTMBaseEstimator
 from ..models.training import FitConfig, fit_config_from_kwargs, split_fit_kwargs
 from ..ops.windows import model_offset as calc_model_offset
 from ..ops.windows import window_targets
+from ..utils.env import env_float, env_int
+from ..utils.faults import fault_point
+from ..utils.retry import retry_call
 from .fleet import (
     FleetMember,
     FleetTrainer,
     WindowedFleetMember,
+    is_device_error,
     stack_member_params,
 )
+from .journal import BuildJournal, clean_staging_dirs
 
 logger = logging.getLogger(__name__)
 
@@ -99,6 +105,10 @@ class _Plan:
     cv_splits: Dict[str, Any] = field(default_factory=dict)
     cv_duration: float = 0.0
     train_duration: float = 0.0
+    # Robustness counters surfaced in BuildMetadata.robustness:
+    data_retries: int = 0  # data-fetch attempts beyond the first
+    fleet_retries: int = 0  # diverged-member reseed retries (CV + final)
+    bucket_bisects: int = 0  # split-retry events this machine rode through
     _scoring_setup_cache: Any = None  # (metrics, fitted scoring scaler)
 
 
@@ -110,15 +120,7 @@ def _cv_chunk_bytes() -> int:
     """Per-program staging budget for CV fold members (raw member data;
     the device program's true footprint is a few × this for gradients and
     optimizer moments). Override with GORDO_TPU_CV_CHUNK_BYTES."""
-    raw = os.environ.get("GORDO_TPU_CV_CHUNK_BYTES")
-    if raw:
-        try:
-            return int(raw)
-        except ValueError:
-            logger.warning(
-                "Invalid GORDO_TPU_CV_CHUNK_BYTES=%r; using 1 GiB default", raw
-            )
-    return 1 << 30
+    return env_int("GORDO_TPU_CV_CHUNK_BYTES", 1 << 30)
 
 
 def _member_nbytes(member) -> int:
@@ -155,11 +157,17 @@ def _fold_member_name(machine_name: str, fold_idx: int) -> str:
 
 def _try_call(fn, *args):
     """Run ``fn``; return the exception instead of raising (thread-pool
-    safe capture for failFast:false semantics)."""
+    safe capture for failFast:false semantics). Interpreter-shutdown
+    signals are explicitly NOT captured: ``failFast:false`` means one
+    machine's failure spares the rest, not that a Ctrl-C or SystemExit
+    (e.g. an injected process kill) gets silently journaled as a
+    per-machine build error and the build marches on."""
     try:
         fn(*args)
         return None
-    except Exception as exc:  # noqa: BLE001 - recorded per machine
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException as exc:  # noqa: BLE001 - recorded per machine
         return exc
 
 
@@ -170,6 +178,9 @@ class FleetBuilder:
         trainer: Optional[FleetTrainer] = None,
         data_workers: int = 16,
         fail_fast: bool = False,
+        data_retries: Optional[int] = None,
+        data_backoff: Optional[float] = None,
+        data_deadline: Optional[float] = None,
     ):
         self.machines = list(machines)
         if trainer is None:
@@ -194,6 +205,33 @@ class FleetBuilder:
         # cv_score (host threshold/metric math), cv_finalize, final_fit,
         # assemble, dump.
         self.phase_seconds: Dict[str, float] = defaultdict(float)
+        # Data-plane retry knobs (reference analog: the builder pod's
+        # retryStrategy with backoff); env-overridable for operators.
+        self.data_retries = (
+            env_int("GORDO_TPU_DATA_RETRIES", 2)
+            if data_retries is None
+            else data_retries
+        )
+        self.data_backoff = (
+            env_float("GORDO_TPU_DATA_BACKOFF", 0.5)
+            if data_backoff is None
+            else data_backoff
+        )
+        self.data_deadline = (
+            env_float("GORDO_TPU_DATA_DEADLINE", None)
+            if data_deadline is None
+            else data_deadline
+        )
+        # Fleet-wide robustness counters (surfaced in BuildMetadata per
+        # machine and as Prometheus counters at build end).
+        self.robustness: Dict[str, int] = defaultdict(int)
+        # Machines degraded out of the fleet path to the sequential
+        # ModelBuilder after an isolated device failure: name -> cause.
+        self.degraded: Dict[str, BaseException] = {}
+        # Machine names skipped by --resume (journaled complete).
+        self.resumed: List[str] = []
+        self._journal: Optional[BuildJournal] = None
+        self._config_hashes: Dict[str, str] = {}
 
     @contextlib.contextmanager
     def _phase(self, name: str):
@@ -204,10 +242,33 @@ class FleetBuilder:
             self.phase_seconds[name] += time.time() - start
 
     def _fail(self, name: str, exc: BaseException):
+        if self._journal is not None:
+            self._journal.record(name, "failed", error=repr(exc))
         if self.fail_fast:
             raise exc
         logger.error("Fleet build of machine %s failed: %r", name, exc)
         self.build_errors[name] = exc
+
+    def _skipped(self, name: str) -> bool:
+        """A machine out of the fleet path: failed, or degraded to the
+        sequential builder (it finishes there, not here)."""
+        return name in self.build_errors or name in self.degraded
+
+    def _degrade(self, plan: "_Plan", exc: BaseException):
+        """Pull one machine out of the fleet path after its device
+        program failed in isolation; it rebuilds on the sequential
+        ModelBuilder path (the same escape hatch unsupported definitions
+        take), so a poisonous member costs one sequential build instead
+        of the fleet."""
+        name = plan.machine.name
+        logger.warning(
+            "Fleet degrade: %s falls back to the sequential builder after "
+            "an isolated device failure: %r",
+            name,
+            exc,
+        )
+        self.robustness["sequential_degraded"] += 1
+        self.degraded[name] = exc
 
     # ------------------------------------------------------------------ API
 
@@ -216,6 +277,7 @@ class FleetBuilder:
         output_dir: Optional[str] = None,
         model_register_dir: Optional[str] = None,
         replace_cache: bool = False,
+        resume: bool = False,
     ) -> List[Tuple[Any, Machine]]:
         """
         Train the whole fleet; optionally dump per-machine artifacts to
@@ -223,12 +285,61 @@ class FleetBuilder:
         content-addressed build cache applies per machine exactly as in
         ``ModelBuilder.build`` — cache hits skip training entirely and
         fresh builds are registered for the next run.
+
+        With an ``output_dir`` the build keeps a journal
+        (``build_state.json``, written with atomic replaces) of every
+        machine's status; ``resume=True`` replays it after a crash —
+        machines journaled ``built`` under an unchanged config hash with
+        a complete artifact on disk are skipped entirely (recorded in
+        ``self.resumed``), and only the remainder is replanned. Resumed
+        machines are not re-loaded, so they do not appear in the return
+        value; their artifacts are already in place.
         """
         machines = self.machines
+        self.build_errors = {}
+        self.phase_seconds = defaultdict(float)
+        self.robustness = defaultdict(int)
+        self.degraded = {}
+        self.resumed = []
+        self._journal = None
+        trainer_bisects_start = getattr(self.trainer, "bucket_bisects", 0)
+        trainer_counts_start = dict(getattr(self.trainer, "bisect_counts", {}))
+        config_hashes: Dict[str, str] = {}
+        if output_dir is not None:
+            config_hashes = {
+                m.name: ModelBuilder.calculate_cache_key(m) for m in machines
+            }
+            self._config_hashes = config_hashes
+            # Orphaned `.<name>.tmp-*` staging dirs from a killed run are
+            # dead weight either way; sweep them before anything else.
+            clean_staging_dirs(output_dir)
+            self._journal = (
+                BuildJournal.load(output_dir) if resume else BuildJournal(output_dir)
+            )
+            if resume:
+                remaining = []
+                for machine in machines:
+                    if self._journal.resumable(
+                        machine.name, config_hashes[machine.name]
+                    ):
+                        self.resumed.append(machine.name)
+                    else:
+                        remaining.append(machine)
+                machines = remaining
+                logger.info(
+                    "Resume: %d machine(s) already built and verified, "
+                    "%d to build",
+                    len(self.resumed),
+                    len(machines),
+                )
+
         cached_results: List[Tuple[Any, Machine]] = []
         if model_register_dir:
-            machines = []
-            for machine in self.machines:
+            # register() dumps atomically under builds/ too — sweep any
+            # staging orphans a killed build left in the shared registry.
+            clean_staging_dirs(os.path.join(str(model_register_dir), "builds"))
+            to_probe, machines = machines, []
+            for machine in to_probe:
                 cached = ModelBuilder(machine).load_cached(
                     model_register_dir, replace_cache=replace_cache
                 )
@@ -242,14 +353,21 @@ class FleetBuilder:
                 len(machines),
             )
 
-        self.build_errors = {}
-        self.phase_seconds = defaultdict(float)
         with self._phase("plan"):
             plans, fallbacks = self._plan_all(machines)
+        if self._journal is not None:
+            for machine in machines:
+                self._journal.record(
+                    machine.name,
+                    "planned",
+                    config_hash=config_hashes.get(machine.name),
+                    flush=False,
+                )
+            self._journal.flush()
         plans = self._load_all_data(plans)
 
         def alive(ps):
-            return [p for p in ps if p.machine.name not in self.build_errors]
+            return [p for p in ps if not self._skipped(p.machine.name)]
 
         # CV folds then final fit, bucketed across all plans at once
         cv_plans = [
@@ -261,6 +379,12 @@ class FleetBuilder:
         if cv_plans:
             with maybe_trace("fleet-cross-validation"):
                 self._run_cross_validation(cv_plans)
+            if self._journal is not None:
+                for plan in alive(cv_plans):
+                    self._journal.record(
+                        plan.machine.name, "cv_done", flush=False
+                    )
+                self._journal.flush()
         final_plans = [
             p
             for p in alive(plans)
@@ -269,6 +393,20 @@ class FleetBuilder:
         ]
         with maybe_trace("fleet-final-fit"):
             self._run_final_fit(final_plans)
+
+        # Attribute trainer-INTERNAL bisections (resolved inside
+        # FleetTrainer without surfacing here) to their machines before
+        # assembly bakes the per-machine robustness metadata: member
+        # names are `machine` or `machine::foldN`.
+        trainer_counts = getattr(self.trainer, "bisect_counts", {})
+        if trainer_counts:
+            per_machine: Dict[str, int] = defaultdict(int)
+            for member_name, count in trainer_counts.items():
+                delta = count - trainer_counts_start.get(member_name, 0)
+                if delta > 0:
+                    per_machine[member_name.split("::", 1)[0]] += delta
+            for plan in plans:
+                plan.bucket_bisects += per_machine.get(plan.machine.name, 0)
 
         results = []
         with self._phase("assemble"):
@@ -283,6 +421,24 @@ class FleetBuilder:
                 results.append(ModelBuilder(machine).build())
             except Exception as exc:
                 self._fail(machine.name, exc)
+        # Machines degraded out of the fleet after isolated device
+        # failures rebuild sequentially, exactly like unsupported
+        # definitions; a machine that fails here too is a real failure
+        # (recorded with the sequential cause, the device cause logged).
+        degraded_machines = {m.name: m for m in machines}
+        for name, cause in self.degraded.items():
+            machine = degraded_machines.get(name)
+            if machine is None:
+                continue
+            logger.info(
+                "Sequential rebuild of degraded machine %s (device cause: %r)",
+                name,
+                cause,
+            )
+            try:
+                results.append(ModelBuilder(machine).build())
+            except Exception as exc:
+                self._fail(name, exc)
 
         if model_register_dir:
             for model, machine in results:
@@ -295,23 +451,61 @@ class FleetBuilder:
         if output_dir is not None:
             with self._phase("dump"):
                 results = self._dump_all(results, output_dir)
+            # compact the per-machine event overlay into the base journal
+            # so a finished build leaves one clean state file
+            self._journal.flush()
+        # Fold in bisections the trainer resolved internally (they never
+        # surfaced as exceptions here, but they are still split-retry
+        # events an operator wants on a dashboard).
+        self.robustness["bucket_bisects"] += max(
+            0, getattr(self.trainer, "bucket_bisects", 0) - trainer_bisects_start
+        )
+        self._record_prometheus(machines)
         return [
             (model, machine)
             for model, machine in results
             if machine.name not in self.build_errors
         ]
 
+    def _record_prometheus(self, machines: Sequence[Machine]):
+        """Best-effort robustness counter export; the build must not care
+        whether a Prometheus stack is configured."""
+        if not any(self.robustness.values()):
+            return
+        try:
+            from ..server.prometheus.metrics import record_fleet_build_robustness
+
+            project = machines[0].project_name if machines else ""
+            record_fleet_build_robustness(project, dict(self.robustness))
+        except Exception as exc:  # noqa: BLE001 - metrics are advisory
+            logger.debug("Robustness counters not exported: %r", exc)
+
     def _dump_all(self, results, output_dir: str):
         """Per-machine artifact dump, thread-pooled: pickling releases the
         GIL for the array copies and the file writes overlap, so the dump
         phase scales with cores instead of machine count. Per-machine
-        error capture keeps failFast:false semantics."""
+        error capture keeps failFast:false semantics.
+
+        Each artifact is written atomically (staging dir + rename), so a
+        crash at any instant leaves either a complete artifact or none —
+        never a half-written ``model.pkl`` a later resume or the serving
+        store could load. Completion is journaled per machine before the
+        kill-injection site, so a death right after machine N leaves N
+        resumable machines."""
 
         def dump_one(item):
             model, machine = item
             path = os.path.join(output_dir, machine.name)
-            os.makedirs(path, exist_ok=True)
-            serializer.dump(model, path, metadata=machine.to_dict())
+            serializer.dump_atomic(model, path, metadata=machine.to_dict())
+            if self._journal is not None:
+                # Record the hash too: cache-hit machines skip the planning
+                # pass (where it is normally journaled), and resume needs it.
+                self._journal.record(
+                    machine.name,
+                    "built",
+                    config_hash=self._config_hashes.get(machine.name),
+                )
+            fault_point("process_kill_after_n_machines", machine.name)
 
         to_dump = [
             (model, machine)
@@ -320,10 +514,19 @@ class FleetBuilder:
             # never dump artifacts for machines already in build_errors.
             if machine.name not in self.build_errors
         ]
-        with concurrent.futures.ThreadPoolExecutor(
-            min(8, max(1, len(to_dump)))
-        ) as pool:
+        pool = concurrent.futures.ThreadPoolExecutor(min(8, max(1, len(to_dump))))
+        try:
             outcomes = list(pool.map(lambda it: _try_call(dump_one, it), to_dump))
+        except (KeyboardInterrupt, SystemExit):
+            # Interpreter shutdown mid-dump: stop scheduling new dumps.
+            # In-flight atomic writes either land whole (and are
+            # journaled) or vanish with their staging dirs; queued
+            # machines stay journaled un-built, exactly what a later
+            # ``--resume`` expects.
+            pool.shutdown(wait=True, cancel_futures=True)
+            raise
+        finally:
+            pool.shutdown(wait=True)
         saved = []
         for (model, machine), exc in zip(to_dump, outcomes):
             if exc is not None:
@@ -384,19 +587,63 @@ class FleetBuilder:
 
     def _load_all_data(self, plans: List[_Plan]) -> List[_Plan]:
         """Fetch + stage every plan; failed machines drop out of the fleet
-        (failFast:false) and are recorded in ``build_errors``."""
+        (failFast:false) and are recorded in ``build_errors``.
+
+        Fetches retry with exponential backoff (``GORDO_TPU_DATA_RETRIES``
+        extra attempts, ``GORDO_TPU_DATA_BACKOFF`` base seconds, optional
+        per-machine ``GORDO_TPU_DATA_DEADLINE``) — the in-process analog
+        of the reference builder pod's retryStrategy. Deterministic
+        config errors (insufficient data, bad tags) are not retried."""
+        from ..dataset.exceptions import ConfigException, InsufficientDataError
 
         def load(plan: _Plan):
             start = time.time()
-            X, y = plan.dataset.get_data()
+
+            def fetch():
+                fault_point("data_fetch", plan.machine.name)
+                return plan.dataset.get_data()
+
+            def note_retry(attempt: int, exc: BaseException):
+                # Per-plan counter only: each plan's retries run in ONE
+                # pool thread, so this is race-free; the fleet total is
+                # summed on the main thread below (incrementing the shared
+                # dict from 16 fetch threads would drop updates).
+                plan.data_retries += 1
+                logger.warning(
+                    "Data fetch retry %d for %s after %r",
+                    attempt,
+                    plan.machine.name,
+                    exc,
+                )
+
+            X, y = retry_call(
+                fetch,
+                attempts=1 + max(0, self.data_retries),
+                backoff=self.data_backoff,
+                deadline=self.data_deadline,
+                no_retry=(ConfigException, InsufficientDataError),
+                on_retry=note_retry,
+            )
             plan.query_duration = time.time() - start
             plan.X, plan.y = X, y
 
         with self._phase("data_fetch"):
-            with concurrent.futures.ThreadPoolExecutor(self.data_workers) as pool:
+            pool = concurrent.futures.ThreadPoolExecutor(self.data_workers)
+            try:
                 outcomes = list(
                     pool.map(lambda p: _try_call(load, p), plans)
                 )
+            except (KeyboardInterrupt, SystemExit):
+                # Same contract as _dump_all: a shutdown signal must not
+                # wait on thousands of queued fetches (and their backoff
+                # ladders) before the process dies.
+                pool.shutdown(wait=True, cancel_futures=True)
+                raise
+            finally:
+                pool.shutdown(wait=True)
+        self.robustness["data_fetch_retries"] += sum(
+            p.data_retries for p in plans
+        )
         surviving = []
         with self._phase("stage"):
             for plan, exc in zip(plans, outcomes):
@@ -409,6 +656,10 @@ class FleetBuilder:
                     self._fail(plan.machine.name, stage_exc)
                     continue
                 surviving.append(plan)
+        if self._journal is not None:
+            for plan in surviving:
+                self._journal.record(plan.machine.name, "data_loaded", flush=False)
+            self._journal.flush()
         return surviving
 
     @staticmethod
@@ -507,7 +758,7 @@ class FleetBuilder:
         ] = {}
         for fold_idx in range(max_folds):
             for plan in plans:
-                if plan.machine.name in self.build_errors:
+                if self._skipped(plan.machine.name):
                     continue
                 splits = per_plan_folds[plan.machine.name]
                 if fold_idx >= len(splits):
@@ -531,12 +782,12 @@ class FleetBuilder:
             live_items = [
                 (plan, fold_idx)
                 for plan, fold_idx in fold_items
-                if plan.machine.name not in self.build_errors
+                if not self._skipped(plan.machine.name)
             ]
             live_members = [
                 m
                 for m, (plan, _) in zip(members, fold_items)
-                if plan.machine.name not in self.build_errors
+                if not self._skipped(plan.machine.name)
             ]
             # Chunk by staged bytes: n_machines × n_folds members in ONE
             # program is the fast path, but an unbounded super-bucket
@@ -552,7 +803,7 @@ class FleetBuilder:
 
         with self._phase("cv_finalize"):
             for plan in plans:
-                if plan.machine.name in self.build_errors:
+                if self._skipped(plan.machine.name):
                     continue
                 try:
                     self._finalize_cv(plan, fold_state[plan.machine.name])
@@ -670,7 +921,7 @@ class FleetBuilder:
         live = [
             i
             for i, (plan, _) in enumerate(fold_items)
-            if plan.machine.name not in self.build_errors
+            if not self._skipped(plan.machine.name)
         ]
         if len(live) != len(fold_items):
             members = [members[i] for i in live]
@@ -681,12 +932,23 @@ class FleetBuilder:
             with self._phase("cv_train"):
                 fold_results = self.trainer.train(members, config)
         except Exception as exc:
+            # CV chunks split on ANY exception — unlike _train_final_group,
+            # which gates on device errors. The asymmetry is deliberate:
+            # CV's any-exception halving is the pinned bad-machine
+            # isolation contract (a member-specific host error — bad
+            # shapes, poisoned data — fails only its machine, at
+            # O(N log N) retrain cost in the worst chunk-wide case),
+            # while the final fit keeps its original fail-the-group
+            # semantics for deterministic host errors.
             if len(members) > 1:
                 logger.warning(
                     "CV chunk of %d fold-members failed (%s); splitting",
                     len(members),
                     exc,
                 )
+                self.robustness["bucket_bisects"] += 1
+                for plan, _ in fold_items:
+                    plan.bucket_bisects += 1
                 mid = len(members) // 2
                 self._train_and_score_folds(
                     members[:mid], fold_items[:mid], config,
@@ -697,12 +959,40 @@ class FleetBuilder:
                     per_plan_folds, fold_state,
                 )
                 return
-            self._fail(fold_items[0][0].machine.name, exc)
+            plan = fold_items[0][0]
+            if is_device_error(exc):
+                self._degrade(plan, exc)
+            else:
+                self._fail(plan.machine.name, exc)
+            return
+        # The trainer's own bucket bisection reports members that failed
+        # in ISOLATION as error-results instead of raising: degrade those
+        # machines to the sequential path first, then score only fold
+        # results of machines still on the fleet path (a degraded
+        # machine's OTHER folds in this chunk are dead too).
+        for (plan, _), result in zip(fold_items, fold_results):
+            if result.error is None or self._skipped(plan.machine.name):
+                continue
+            if is_device_error(result.error):
+                self._degrade(plan, result.error)
+            else:
+                self._fail(plan.machine.name, result.error)
+        scorable_items, scorable_results = [], []
+        for (plan, fold_idx), result in zip(fold_items, fold_results):
+            if result.error is not None or self._skipped(plan.machine.name):
+                continue
+            plan.fleet_retries += result.retries
+            self.robustness["fleet_retries"] += result.retries
+            scorable_items.append((plan, fold_idx))
+            scorable_results.append(result)
+        if not scorable_items:
             return
         try:
-            self._score_folds(fold_items, fold_results, per_plan_folds, fold_state)
+            self._score_folds(
+                scorable_items, scorable_results, per_plan_folds, fold_state
+            )
         except Exception as exc:
-            for plan, _ in fold_items:
+            for plan, _ in scorable_items:
                 self._fail(plan.machine.name, exc)
 
     def _score_folds(self, fold_items, fold_results, per_plan_folds, fold_state):
@@ -1008,23 +1298,78 @@ class FleetBuilder:
                     self._fail(plan.machine.name, exc)
             if not members:
                 continue
-            try:
-                with self._phase("final_fit"):
-                    results = self.trainer.train(members, config)
-            except Exception as exc:
+            self._train_final_group(members, member_plans, config, start)
+
+    def _train_final_group(self, members, member_plans, config, start):
+        """
+        Final-fit one config group with the same degradation ladder as
+        the CV chunks: a failing group splits in half and retries (down
+        to single members), an isolated device failure degrades that one
+        machine to the sequential builder, anything else fails just that
+        machine — one poisonous machine or an over-packed group never
+        takes the fleet's final fit down.
+        """
+        live = [
+            i
+            for i, plan in enumerate(member_plans)
+            if not self._skipped(plan.machine.name)
+        ]
+        if len(live) != len(member_plans):
+            members = [members[i] for i in live]
+            member_plans = [member_plans[i] for i in live]
+        if not members:
+            return
+        try:
+            with self._phase("final_fit"):
+                results = self.trainer.train(members, config)
+        except Exception as exc:
+            # Split-retry DEVICE errors only (the trainer's own rule): a
+            # host-side exception is deterministic and would fail every
+            # half identically — 2N-1 futile retrains of a 100-machine
+            # group, each paying staging + compile. The trainer already
+            # converts in-bucket device errors to error-results, so this
+            # is the net for failures outside its per-bucket scope.
+            if is_device_error(exc) and len(members) > 1:
+                logger.warning(
+                    "Final-fit group of %d members failed (%s); splitting",
+                    len(members),
+                    exc,
+                )
+                self.robustness["bucket_bisects"] += 1
                 for plan in member_plans:
-                    self._fail(plan.machine.name, exc)
+                    plan.bucket_bisects += 1
+                mid = len(members) // 2
+                self._train_final_group(
+                    members[:mid], member_plans[:mid], config, start
+                )
+                self._train_final_group(
+                    members[mid:], member_plans[mid:], config, start
+                )
+                return
+            if is_device_error(exc):
+                self._degrade(member_plans[0], exc)
+                return
+            for plan in member_plans:
+                self._fail(plan.machine.name, exc)
+            return
+        for plan, result in zip(member_plans, results):
+            if result.error is not None:
+                if is_device_error(result.error):
+                    self._degrade(plan, result.error)
+                else:
+                    self._fail(plan.machine.name, result.error)
                 continue
-            for plan, result in zip(member_plans, results):
-                try:
-                    plan.estimator.params_ = result.params
-                    plan.estimator.spec_ = plan.spec
-                    plan.estimator._history = result.history
-                    plan.train_duration = time.time() - start
-                    if plan.detector is not None:
-                        plan.detector.scaler.fit(plan.y)
-                except Exception as exc:
-                    self._fail(plan.machine.name, exc)
+            try:
+                plan.fleet_retries += result.retries
+                self.robustness["fleet_retries"] += result.retries
+                plan.estimator.params_ = result.params
+                plan.estimator.spec_ = plan.spec
+                plan.estimator._history = result.history
+                plan.train_duration = time.time() - start
+                if plan.detector is not None:
+                    plan.detector.scaler.fit(plan.y)
+            except Exception as exc:
+                self._fail(plan.machine.name, exc)
 
     # ------------------------------------------------------------- assembly
 
@@ -1049,6 +1394,11 @@ class FleetBuilder:
                 query_duration_sec=plan.query_duration,
                 dataset_meta=plan.dataset.get_metadata(),
             ),
+            robustness=RobustnessMetadata(
+                fleet_retries=plan.fleet_retries,
+                bucket_bisects=plan.bucket_bisects,
+                data_fetch_retries=plan.data_retries,
+            ),
         )
         return plan.model_obj, machine
 
@@ -1070,6 +1420,9 @@ def fleet_build(
     machines: Sequence[Machine],
     output_dir: Optional[str] = None,
     trainer: Optional[FleetTrainer] = None,
+    resume: bool = False,
 ) -> List[Tuple[Any, Machine]]:
     """Convenience wrapper: build the whole fleet."""
-    return FleetBuilder(machines, trainer=trainer).build(output_dir=output_dir)
+    return FleetBuilder(machines, trainer=trainer).build(
+        output_dir=output_dir, resume=resume
+    )

@@ -1,0 +1,279 @@
+"""
+Crash-safe fleet build journal: ``<output_dir>/build_state.json``.
+
+The reference's resumability is Argo's: each machine is a pod, and a
+re-submitted workflow skips Succeeded nodes. The chip-fan-out build is
+one process, so resumability has to be data: the journal records every
+machine's build status (``planned → data_loaded → cv_done → built``,
+or ``failed``) plus the machine's config hash, each update written with
+an atomic tempfile-then-``os.replace`` so a crash at ANY instant leaves
+a parseable journal. ``fleet_build --resume`` replays it: machines
+whose journal entry says ``built``, whose config hash still matches,
+and whose on-disk artifact is complete are skipped; everything else —
+including machines that crashed mid-status — is rebuilt.
+
+The journal lives beside the artifacts on purpose: whatever volume
+survives the crash carries both, and the server's fleet store ignores
+the file (it only loads artifact *directories*).
+"""
+
+import contextlib
+import json
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from .. import serializer
+from ..serializer.serializer import (
+    BUILD_JOURNAL_EVENTS_FILE,
+    BUILD_JOURNAL_FILE,
+    is_staging_dir,
+)
+
+logger = logging.getLogger(__name__)
+
+#: canonical names live in serializer (the artifact-layout module) so
+#: every discovery path shares them; re-exported here for journal users
+JOURNAL_FILE = BUILD_JOURNAL_FILE
+EVENTS_FILE = BUILD_JOURNAL_EVENTS_FILE
+
+#: machine statuses in build order (``failed`` is terminal at any phase)
+STATUSES = ("planned", "data_loaded", "cv_done", "built", "failed")
+
+
+class BuildJournal:
+    """Per-machine build state with incremental atomic persistence.
+
+    Thread-safe: the dump pool records ``built`` entries concurrently.
+
+    Durability comes in two tiers so a 5000-machine dump phase is not
+    O(N²) in journal bytes: phase-boundary batches rewrite the base file
+    atomically (:meth:`flush`, which also compacts), while per-machine
+    events from the dump pool append ONE JSON line to an event overlay
+    (``.build_state.json.events``) — O(1) per machine, still durable the
+    instant the line lands. :meth:`load` applies the overlay on top of
+    the base and tolerates a torn final line (a kill mid-append).
+    """
+
+    def __init__(self, output_dir: str):
+        self.output_dir = output_dir
+        self.path = os.path.join(output_dir, JOURNAL_FILE)
+        self.events_path = os.path.join(output_dir, EVENTS_FILE)
+        self._lock = threading.Lock()
+        self._machines: Dict[str, Dict[str, Any]] = {}
+
+    @classmethod
+    def load(cls, output_dir: str) -> "BuildJournal":
+        """Read an existing journal (base + event overlay); missing or
+        corrupt files yield an empty journal (resume then just rebuilds
+        everything)."""
+        journal = cls(output_dir)
+        try:
+            with open(journal.path) as f:
+                state = json.load(f)
+            machines = state.get("machines", {})
+            if isinstance(machines, dict):
+                journal._machines = {
+                    name: dict(entry)
+                    for name, entry in machines.items()
+                    if isinstance(entry, dict)
+                }
+        except FileNotFoundError:
+            pass
+        except (OSError, ValueError) as exc:
+            logger.warning(
+                "Unreadable build journal %s (%r); starting fresh",
+                journal.path,
+                exc,
+            )
+        try:
+            with open(journal.events_path) as f:
+                for line in f:
+                    try:
+                        event = json.loads(line)
+                        name = event.pop("name")
+                    except (ValueError, KeyError):
+                        # torn tail from a kill mid-append; later lines
+                        # of a healthy file are never affected
+                        continue
+                    journal._machines.setdefault(name, {}).update(event)
+        except FileNotFoundError:
+            pass
+        except OSError as exc:
+            logger.warning(
+                "Unreadable journal events %s (%r); ignored",
+                journal.events_path,
+                exc,
+            )
+        return journal
+
+    def get(self, name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            entry = self._machines.get(name)
+            return dict(entry) if entry else None
+
+    def machines(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {name: dict(e) for name, e in self._machines.items()}
+
+    def record(
+        self,
+        name: str,
+        status: str,
+        config_hash: Optional[str] = None,
+        error: Optional[str] = None,
+        flush: bool = True,
+    ) -> None:
+        """Record one machine's status. ``flush=True`` makes it durable
+        immediately via an O(1) event-line append; ``flush=False`` defers
+        to the caller's next :meth:`flush` (phase-boundary batching)."""
+        if status not in STATUSES:
+            raise ValueError(f"unknown journal status {status!r}")
+        with self._lock:
+            entry = self._machines.setdefault(name, {})
+            entry["status"] = status
+            if config_hash is not None:
+                entry["config_hash"] = config_hash
+            if error is not None:
+                entry["error"] = error
+            elif status != "failed":
+                entry.pop("error", None)
+            if flush:
+                os.makedirs(self.output_dir, exist_ok=True)
+                with open(self.events_path, "a") as f:
+                    f.write(json.dumps({"name": name, **entry}, default=str) + "\n")
+
+    def flush(self) -> None:
+        """Atomically persist the full state and compact the event
+        overlay into it: a crash mid-flush leaves the previous complete
+        journal (plus its overlay), never a torn file."""
+        with self._lock:
+            state = {"version": 1, "machines": self._machines}
+            payload = json.dumps(state, indent=1, sort_keys=True, default=str)
+            os.makedirs(self.output_dir, exist_ok=True)
+            # Dotted staging-convention name (`.build_state.json.tmp-*`):
+            # a flush interrupted mid-write leaves a file every discovery
+            # path already classifies as a staging leftover, and the next
+            # build's clean_staging_dirs sweep removes it.
+            tmp = os.path.join(
+                self.output_dir, f".{JOURNAL_FILE}.tmp-{os.getpid()}"
+            )
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self.path)
+            # the overlay's events are now in the base; remove AFTER the
+            # replace so no window exists where neither holds them
+            with contextlib.suppress(FileNotFoundError, OSError):
+                os.remove(self.events_path)
+
+    # -- resume helpers ------------------------------------------------------
+
+    def resumable(self, name: str, config_hash: str) -> bool:
+        """True when ``name`` can be skipped on resume: journaled
+        ``built`` under the same config hash AND the artifact on disk is
+        complete (checksum-verified) — the journal alone is never
+        trusted over the artifact."""
+        entry = self.get(name)
+        return bool(
+            entry
+            and entry.get("status") == "built"
+            and entry.get("config_hash") == config_hash
+            and artifact_complete(os.path.join(self.output_dir, name))
+        )
+
+
+def resumable_names(output_dir: str, machines) -> List[str]:
+    """Machine names a ``--resume`` will skip, computed purely from the
+    (shared) output volume. Multi-host fleet builds run one SPMD program
+    across processes, so EVERY process must derive the same surviving
+    machine list — non-coordinators (which never write artifacts) call
+    this read-only helper to mirror the coordinator's resume filter; a
+    divergent list would desynchronize the collective device programs."""
+    from ..builder.build_model import ModelBuilder
+
+    journal = BuildJournal.load(output_dir)
+    return [
+        machine.name
+        for machine in machines
+        if journal.resumable(
+            machine.name, ModelBuilder.calculate_cache_key(machine)
+        )
+    ]
+
+
+def artifact_complete(model_dir: str) -> bool:
+    """A complete, uncorrupted artifact dir: all three files present and
+    ``info.json``'s recorded checksum matching ``model.pkl``'s bytes.
+    (Atomic dumps make partial dirs impossible, but a resume must also
+    survive artifacts written by older non-atomic builders or tampering
+    between runs.)"""
+    from ..serializer.serializer import _file_checksum
+
+    model_path = os.path.join(model_dir, serializer.MODEL_FILE)
+    if not all(
+        os.path.isfile(os.path.join(model_dir, f))
+        for f in (serializer.MODEL_FILE, serializer.METADATA_FILE, serializer.INFO_FILE)
+    ):
+        return False
+    try:
+        info = serializer.load_info(model_dir)
+        return info.get("checksum") == _file_checksum(model_path)
+    except (OSError, ValueError):
+        return False
+
+
+#: a staging entry younger than this is assumed to belong to a LIVE
+#: builder (shared register/output volumes host several pods by design);
+#: an in-flight dump takes seconds, so an hour marks a true orphan
+STAGING_ORPHAN_AGE_SECONDS = 3600.0
+
+
+def clean_staging_dirs(
+    output_dir: str, min_age_seconds: float = STAGING_ORPHAN_AGE_SECONDS
+) -> List[str]:
+    """Remove orphaned atomic-write staging leftovers — ``.<name>.tmp-*``
+    artifact dirs and ``.build_state.json.tmp-*`` journal flush files —
+    that a killed process can leave behind; returns the removed names.
+    Entries younger than ``min_age_seconds`` are spared: on a shared
+    volume they may be another live builder's in-flight dump, and
+    sweeping one out from under it would fail a healthy machine. Never
+    touches completed artifacts or the journal itself."""
+    import shutil
+    import time
+
+    removed = []
+    try:
+        entries = os.listdir(output_dir)
+    except FileNotFoundError:
+        return removed
+    now = time.time()
+    for entry in entries:
+        if not is_staging_dir(entry):
+            continue
+        full = os.path.join(output_dir, entry)
+        try:
+            age = now - os.stat(full).st_mtime
+        except OSError:
+            continue  # vanished: its owner just renamed/cleaned it
+        if age < min_age_seconds:
+            logger.info(
+                "Sparing staging entry %s (%.0fs old — possibly a live "
+                "builder's in-flight dump)",
+                full,
+                age,
+            )
+            continue
+        if os.path.isdir(full):
+            shutil.rmtree(full, ignore_errors=True)
+        else:
+            with contextlib.suppress(OSError):
+                os.remove(full)
+        removed.append(entry)
+    if removed:
+        logger.info(
+            "Removed %d orphaned staging entr(ies) from %s",
+            len(removed),
+            output_dir,
+        )
+    return removed

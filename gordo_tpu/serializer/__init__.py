@@ -5,11 +5,16 @@ from .from_definition import (
 )
 from .into_definition import into_definition
 from .serializer import (
+    BUILD_JOURNAL_FILE,
     INFO_FILE,
     METADATA_FILE,
     MODEL_FILE,
     dump,
+    dump_atomic,
     dumps,
+    is_builder_dropping,
+    is_staging_dir,
+    list_model_dirs,
     load,
     load_info,
     load_metadata,
@@ -20,12 +25,17 @@ __all__ = [
     "MODEL_FILE",
     "METADATA_FILE",
     "INFO_FILE",
+    "BUILD_JOURNAL_FILE",
+    "is_builder_dropping",
+    "list_model_dirs",
     "from_definition",
     "into_definition",
     "load_params_from_definition",
     "build_callbacks",
     "dump",
+    "dump_atomic",
     "dumps",
+    "is_staging_dir",
     "load",
     "loads",
     "load_metadata",

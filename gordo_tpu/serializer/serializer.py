@@ -16,10 +16,13 @@ import hashlib
 import logging
 import os
 import pickle
+import shutil
+import uuid
 from os import path
 from typing import Any, Optional
 
-import simplejson
+from ..utils import json_compat as simplejson
+from ..utils.faults import fault_point
 
 logger = logging.getLogger(__name__)
 
@@ -71,6 +74,118 @@ def dump(obj, dest_dir: str, metadata: Optional[dict] = None, info: Optional[dic
         full_info.update(info)
     with open(path.join(dest_dir, INFO_FILE), "w") as f:
         simplejson.dump(full_info, f, default=str)
+
+
+TMP_DIR_MARKER = ".tmp-"
+
+#: the fleet builder's crash-safe journal, written beside the artifacts
+#: (parallel/journal.py owns its format; the names live here so every
+#: artifact-discovery path shares one notion of "not a model")
+BUILD_JOURNAL_FILE = "build_state.json"
+#: append-only per-machine event overlay (one JSON line per status
+#: event), compacted into the base journal at phase boundaries
+BUILD_JOURNAL_EVENTS_FILE = "." + BUILD_JOURNAL_FILE + ".events"
+
+
+def is_staging_dir(name: str) -> bool:
+    """True for atomic-write staging entries (``.<name>.tmp-*`` dirs and
+    the journal's ``.build_state.json.tmp-*`` flush files): every
+    artifact-discovery path (serving store, model listings, resume) must
+    skip them — they are by construction possibly half-written."""
+    return name.startswith(".") and TMP_DIR_MARKER in name
+
+
+def is_builder_dropping(name: str) -> bool:
+    """True for any non-model entry the fleet builder may leave in an
+    artifact directory: the build journal, its event overlay, and
+    atomic-write staging leftovers. Revision cleanup treats a directory
+    holding only these as empty; model listings never surface them."""
+    return (
+        name == BUILD_JOURNAL_FILE
+        or name == BUILD_JOURNAL_EVENTS_FILE
+        or is_staging_dir(name)
+    )
+
+
+def list_model_dirs(directory: str) -> list:
+    """Names of the artifact (model) directories under ``directory`` —
+    the one shared definition of "what counts as a model entry" for the
+    serving store, the model-list route, and resume: directories only,
+    builder droppings and dot-entries excluded. Missing directory → []."""
+    try:
+        entries = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    return sorted(
+        entry
+        for entry in entries
+        if not entry.startswith(".")
+        and not is_builder_dropping(entry)
+        and path.isdir(path.join(directory, entry))
+    )
+
+
+#: files an artifact dir may contain; a dest dir holding ONLY these (or
+#: nothing) is a prior artifact and safe to swap wholesale
+_ARTIFACT_FILES = frozenset({MODEL_FILE, METADATA_FILE, INFO_FILE})
+
+
+def dump_atomic(
+    obj,
+    dest_dir: str,
+    metadata: Optional[dict] = None,
+    info: Optional[dict] = None,
+):
+    """
+    Crash-safe :func:`dump`: artifacts are written into a
+    ``.<name>.tmp-*`` sibling staging dir and ``os.replace``-renamed
+    into place, so ``dest_dir`` either holds a complete artifact set or
+    does not exist — a crash mid-write can never leave a half-written
+    ``model.pkl`` where the server's fleet store (or a ``--resume``
+    pass) would load it.
+
+    A pre-existing ``dest_dir`` that is empty or a prior artifact is
+    replaced whole. A dest dir holding OTHER content (e.g. ``gordo
+    build config.yaml .`` — the legacy dump merged into it) is never
+    deleted: the three artifact files are moved in individually, each
+    with its own atomic ``os.replace``.
+    """
+    dest_dir = path.normpath(dest_dir)
+    parent, name = path.dirname(dest_dir), path.basename(dest_dir)
+    os.makedirs(parent or ".", exist_ok=True)
+    # Plain os.mkdir (NOT tempfile.mkdtemp): mkdtemp forces mode 0700,
+    # which the rename would carry onto the artifact dir and lock out a
+    # model server running as a different UID; mkdir honors the umask
+    # like os.makedirs always did, with no process-global umask probing
+    # (os.umask() round trips race across the dump thread pool).
+    while True:
+        staging = path.join(
+            parent or ".", f".{name}{TMP_DIR_MARKER}{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        )
+        try:
+            os.mkdir(staging)
+            break
+        except FileExistsError:  # pragma: no cover - 2^32 collision
+            continue
+    try:
+        dump(obj, staging, metadata=metadata, info=info)
+        fault_point("dump_artifact", name)
+        if path.isdir(dest_dir) and not set(os.listdir(dest_dir)) <= _ARTIFACT_FILES:
+            # Mixed-content dest: move each artifact file in (file-level
+            # atomic), leave everything else untouched.
+            for entry in os.listdir(staging):
+                os.replace(path.join(staging, entry), path.join(dest_dir, entry))
+            os.rmdir(staging)
+            return
+        if path.isdir(dest_dir):
+            # rename(2) cannot replace a non-empty dir; a complete prior
+            # artifact (e.g. a re-build into the same output dir) is
+            # swapped out the pre-rename instant before the new one lands.
+            shutil.rmtree(dest_dir)
+        os.replace(staging, dest_dir)
+    except BaseException:
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
 
 
 def load(source_dir: str) -> Any:

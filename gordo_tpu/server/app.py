@@ -23,7 +23,7 @@ import typing
 from functools import wraps
 from typing import Any, Dict, Optional
 
-import simplejson
+from ..utils import json_compat as simplejson
 import yaml
 from werkzeug.exceptions import HTTPException
 from werkzeug.routing import Map, Rule

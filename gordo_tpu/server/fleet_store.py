@@ -117,14 +117,10 @@ class RevisionFleet:
         """Load every model in the revision dir (or ``names``); returns the
         names that loaded successfully."""
         if names is None:
-            try:
-                names = sorted(
-                    entry
-                    for entry in os.listdir(self.collection_dir)
-                    if os.path.isdir(os.path.join(self.collection_dir, entry))
-                )
-            except FileNotFoundError:
-                return []
+            # list_model_dirs skips the builder's crash-safety droppings:
+            # atomic-dump staging dirs (possibly half-written by a killed
+            # build) and the build journal are never models.
+            names = serializer.list_model_dirs(self.collection_dir)
         loaded = []
         for name in names:
             try:

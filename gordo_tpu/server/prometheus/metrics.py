@@ -144,3 +144,67 @@ def create_prometheus_metrics(
     if registry is None:
         registry = multiprocess_registry() or REGISTRY
     return GordoServerPrometheusMetrics(project=project, registry=registry)
+
+
+#: (metric suffix, help) per fleet-build robustness counter — the
+#: chip-fan-out analogs of the reference DAG's per-pod retry visibility
+#: (a retried/failed pod shows in `argo get`; an in-process retry must
+#: show in /metrics instead).
+_BUILD_ROBUSTNESS_COUNTERS = (
+    (
+        "fleet_retries",
+        "gordo_fleet_build_member_retries_total",
+        "Diverged fleet members retrained with a reseeded RNG",
+    ),
+    (
+        "bucket_bisects",
+        "gordo_fleet_build_bucket_bisects_total",
+        "Device-program bucket bisection (split-retry) events",
+    ),
+    (
+        "data_fetch_retries",
+        "gordo_fleet_build_data_fetch_retries_total",
+        "Per-machine data fetch retry attempts",
+    ),
+    (
+        "sequential_degraded",
+        "gordo_fleet_build_sequential_degraded_total",
+        "Machines degraded to the sequential builder after isolated "
+        "device failures",
+    ),
+)
+
+#: one Counter set per CollectorRegistry (a Counter name can only
+#: register once per registry; a process typically only ever uses one)
+_build_counters: dict = {}
+
+
+def fleet_build_robustness_counters(
+    registry: Optional[CollectorRegistry] = None,
+) -> dict:
+    """The build-robustness Counter set for ``registry`` (default: the
+    global REGISTRY), created once per registry."""
+    target = registry if registry is not None else REGISTRY
+    key = id(target)
+    if key not in _build_counters:
+        _ensure_multiproc_dir()
+        _build_counters[key] = {
+            counter_key: Counter(
+                name,
+                help_text,
+                labelnames=["project"],
+                registry=target,
+            )
+            for counter_key, name, help_text in _BUILD_ROBUSTNESS_COUNTERS
+        }
+    return _build_counters[key]
+
+
+def record_fleet_build_robustness(project: Optional[str], counters: dict):
+    """Export a finished build's robustness counters (FleetBuilder calls
+    this best-effort at the end of ``build``)."""
+    built = fleet_build_robustness_counters()
+    for key, counter in built.items():
+        value = int(counters.get(key, 0) or 0)
+        if value:
+            counter.labels(project=project or "").inc(value)

@@ -350,7 +350,16 @@ def delete_revision(directory: str, name: str):
     STORE.invalidate(directory)
     if os.path.exists(full_path):
         raise ServerError("Unable to delete this model revision folder", status=500)
-    if not os.listdir(directory):
+    # The builder's crash-safety droppings — the build journal, its
+    # flush temp files, and orphaned `.<name>.tmp-*` staging dirs — are
+    # not models: a revision holding only those is empty and must still
+    # be reclaimed (journal and all).
+    from ..serializer.serializer import is_builder_dropping
+
+    leftovers = [
+        entry for entry in os.listdir(directory) if not is_builder_dropping(entry)
+    ]
+    if not leftovers:
         shutil.rmtree(directory, ignore_errors=True)
         if os.path.exists(directory):
             raise ServerError("Unable to delete this revision folder", status=500)

@@ -350,12 +350,13 @@ def get_download_model(ctx, gordo_project: str, gordo_name: str):
 
 
 def get_model_list(ctx, gordo_project: str):
-    """Names of models currently available from the served revision."""
-    try:
-        available_models = os.listdir(ctx.collection_dir)
-    except FileNotFoundError:
-        available_models = []
-    return ctx.json_response({"models": available_models})
+    """Names of models currently available from the served revision.
+    Only artifact directories count (serializer.list_model_dirs): the
+    fleet builder's journal file and atomic-dump staging dirs (possibly
+    half-written by a killed build) are never models."""
+    return ctx.json_response(
+        {"models": serializer.list_model_dirs(ctx.collection_dir)}
+    )
 
 
 def get_revision_list(ctx, gordo_project: str):

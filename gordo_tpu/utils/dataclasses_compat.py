@@ -1,0 +1,82 @@
+"""
+Minimal ``dataclasses_json.dataclass_json`` stand-in.
+
+The metadata tree (machine/metadata.py) only uses the decorator's
+``to_dict``/``from_dict`` pair; this fallback implements exactly that
+subset — a recursive encode of dataclass fields and a type-hint-driven
+decode that rebuilds nested dataclasses and ignores unknown keys (the
+same tolerance the real library shows for artifacts written by newer
+schema versions). Used only when ``dataclasses_json`` is not installed.
+
+>>> from dataclasses import dataclass, field
+>>> @dataclass_json
+... @dataclass
+... class Inner:
+...     n: int = 0
+>>> @dataclass_json
+... @dataclass
+... class Outer:
+...     inner: Inner = field(default_factory=Inner)
+>>> Outer.from_dict({"inner": {"n": 3}, "unknown": 1}).inner.n
+3
+>>> Outer(inner=Inner(n=2)).to_dict()
+{'inner': {'n': 2}}
+"""
+
+import dataclasses
+import typing
+
+
+def _encode(value):
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return {
+            f.name: _encode(getattr(value, f.name))
+            for f in dataclasses.fields(value)
+        }
+    if isinstance(value, dict):
+        return {k: _encode(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_encode(v) for v in value]
+    return value
+
+
+def _unwrap_optional(hint):
+    """``Optional[T]`` → ``T`` (the only generic the metadata tree uses
+    around dataclass fields)."""
+    if typing.get_origin(hint) is typing.Union:
+        args = [a for a in typing.get_args(hint) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return hint
+
+
+def _decode(cls, data):
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        value = data[f.name]
+        ftype = _unwrap_optional(hints.get(f.name))
+        if dataclasses.is_dataclass(ftype) and isinstance(value, dict):
+            from_dict = getattr(ftype, "from_dict", None)
+            value = from_dict(value) if from_dict else _decode(ftype, value)
+        kwargs[f.name] = value
+    return cls(**kwargs)
+
+
+def dataclass_json(cls):
+    """Attach ``to_dict``/``from_dict``, clobbering any body-defined ones
+    — mirroring the real decorator's (documented-in-metadata.py)
+    unconditional assignment, so the post-decoration override pattern
+    behaves identically under both implementations."""
+
+    def to_dict(self, **_kwargs):
+        return _encode(self)
+
+    def from_dict(klass, data, **_kwargs):
+        return _decode(klass, data)
+
+    cls.to_dict = to_dict
+    cls.from_dict = classmethod(from_dict)
+    return cls

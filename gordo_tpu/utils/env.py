@@ -1,0 +1,36 @@
+"""
+Environment-knob parsing: one warn-and-fall-back implementation for every
+``GORDO_TPU_*`` numeric knob instead of a per-call-site copy.
+
+>>> import os
+>>> os.environ["GORDO_TPU_DOCTEST_KNOB"] = "not-a-number"
+>>> env_int("GORDO_TPU_DOCTEST_KNOB", 7)
+7
+>>> del os.environ["GORDO_TPU_DOCTEST_KNOB"]
+"""
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+def env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            logger.warning("Invalid %s=%r; using %r", name, raw, default)
+    return default
+
+
+def env_float(name: str, default: Optional[float]) -> Optional[float]:
+    raw = os.environ.get(name)
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            logger.warning("Invalid %s=%r; using %r", name, raw, default)
+    return default

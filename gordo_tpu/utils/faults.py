@@ -1,0 +1,258 @@
+"""
+Deterministic fault injection for the fleet build path.
+
+The reference gets fault tolerance for free from Argo/Kubernetes (one
+pod per machine, ``failFast:false``, per-pod ``retryStrategy``); the
+chip-fan-out build collapses thousands of machines into one process, so
+its crash-safety paths — atomic artifact renames, the build journal,
+bucket bisection, data-fetch retry — need an in-process way to be
+*exercised on demand*. This registry provides that: production code
+calls :func:`fault_point` at named sites, which is a no-op unless a
+matching :class:`FaultRule` is active; tests (and the bench) install
+rules via the :func:`inject` context manager or the ``GORDO_TPU_FAULTS``
+environment variable and get byte-reproducible failures on CPU.
+
+Sites instrumented today:
+
+- ``data_fetch`` — before each machine's ``dataset.get_data()`` attempt
+  (key: machine name); exercises the retry/backoff path.
+- ``device_program`` — before each fleet bucket's device program runs,
+  once per member (key: member name); exercises bucket bisection and
+  the sequential-builder degradation.
+- ``dump_artifact`` — inside the atomic artifact dump, after the files
+  are written into the ``.<name>.tmp-*`` staging dir but before the
+  rename (key: artifact dir name); simulates a crash mid-write.
+- ``process_kill_after_n_machines`` — after each machine's artifact
+  lands and is journaled (key: machine name); with ``after=N`` the
+  first N machines complete and the next one dies — the in-process
+  analog of a host preemption at machine N of the fleet.
+
+Rules fire deterministically: each rule counts the calls matching its
+(site, key-glob) and fires on calls ``after < i <= after + times``.
+
+>>> with inject(FaultRule("data_fetch", match="m-*", times=1)):
+...     try:
+...         fault_point("data_fetch", "m-1")
+...     except FaultInjected:
+...         print("fired")
+...     fault_point("data_fetch", "m-1")  # times exhausted: passes
+fired
+
+Env form (``;``-separated rules, fields ``site[:key-glob][:opt...]``)::
+
+    GORDO_TPU_FAULTS="device_program:poison-*:times=inf"
+    GORDO_TPU_FAULTS="process_kill_after_n_machines:*:after=500:kill"
+
+``kill`` makes the rule ``os._exit(137)`` instead of raising — a true
+mid-build death for end-to-end resume drills; tests prefer the default
+raising form (``process_kill_after_n_machines`` raises ``SystemExit``,
+which the build never swallows into per-machine errors).
+"""
+
+import fnmatch
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "GORDO_TPU_FAULTS"
+
+SITES = (
+    "data_fetch",
+    "device_program",
+    "dump_artifact",
+    "process_kill_after_n_machines",
+)
+
+
+class FaultInjected(RuntimeError):
+    """An injected fault (default exception for most sites)."""
+
+
+class InjectedDeviceError(FaultInjected):
+    """Injected stand-in for a device-program ``XlaRuntimeError`` — the
+    message carries ``RESOURCE_EXHAUSTED`` so every detection path (type
+    or message) classifies it as a device error."""
+
+
+#: exception names accepted by the env form's ``exc=`` option
+_EXC_TYPES = {
+    "FaultInjected": FaultInjected,
+    "InjectedDeviceError": InjectedDeviceError,
+    "RuntimeError": RuntimeError,
+    "OSError": OSError,
+    "MemoryError": MemoryError,
+    "SystemExit": SystemExit,
+    "KeyboardInterrupt": KeyboardInterrupt,
+}
+
+
+@dataclass
+class FaultRule:
+    """One deterministic failure: fire on matching calls
+    ``after < i <= after + times`` of ``site`` whose key globs ``match``."""
+
+    site: str
+    match: str = "*"
+    times: Optional[int] = 1  # None = every matching call past ``after``
+    after: int = 0
+    exc: Optional[Any] = None  # exception class/instance/factory(site, key)
+    kill: bool = False  # os._exit(137) instead of raising
+    seen: int = field(default=0, compare=False)
+    fired: int = field(default=0, compare=False)
+
+    def make_exc(self, key: str) -> BaseException:
+        exc = self.exc
+        if exc is not None:
+            if isinstance(exc, BaseException):
+                return exc
+            return exc(f"injected fault at {self.site}:{key}")
+        if self.site == "device_program":
+            return InjectedDeviceError(
+                f"RESOURCE_EXHAUSTED: injected device fault ({key})"
+            )
+        if self.site == "process_kill_after_n_machines":
+            return SystemExit(137)
+        return FaultInjected(f"injected fault at {self.site}:{key}")
+
+
+_lock = threading.Lock()
+_installed: List[FaultRule] = []
+#: (raw env string, parsed rules) — parsed once per distinct value so rule
+#: counters persist across fault_point calls within a process
+_env_cache: Tuple[Optional[str], List[FaultRule]] = (None, [])
+
+
+def parse_rules(spec: str) -> List[FaultRule]:
+    """Parse the ``GORDO_TPU_FAULTS`` string form.
+
+    >>> [r.after for r in parse_rules("dump_artifact:*:after=2:exc=SystemExit")]
+    [2]
+    """
+    rules = []
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        site = parts[0]
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r} (known: {SITES})")
+        rule = FaultRule(site=site)
+        opts = parts[1:]
+        if opts and "=" not in opts[0] and opts[0] != "kill":
+            rule.match = opts[0]
+            opts = opts[1:]
+        for opt in opts:
+            if opt == "kill":
+                rule.kill = True
+            elif opt.startswith("times="):
+                value = opt.split("=", 1)[1]
+                rule.times = None if value in ("inf", "all") else int(value)
+            elif opt.startswith("after="):
+                rule.after = int(opt.split("=", 1)[1])
+            elif opt.startswith("exc="):
+                name = opt.split("=", 1)[1]
+                if name not in _EXC_TYPES:
+                    raise ValueError(
+                        f"unknown exc {name!r} (known: {sorted(_EXC_TYPES)})"
+                    )
+                rule.exc = _EXC_TYPES[name]
+            else:
+                raise ValueError(f"unknown fault option {opt!r}")
+        rules.append(rule)
+    return rules
+
+
+def _env_rules() -> List[FaultRule]:
+    global _env_cache
+    raw = os.environ.get(ENV_VAR)
+    if not raw:
+        if _env_cache[0] is not None:
+            _env_cache = (None, [])
+        return []
+    if raw != _env_cache[0]:
+        _env_cache = (raw, parse_rules(raw))
+    return _env_cache[1]
+
+
+def install(*rules: FaultRule) -> None:
+    """Activate rules for the rest of the process (tests prefer
+    :func:`inject`, which scopes them)."""
+    with _lock:
+        _installed.extend(rules)
+
+
+def clear() -> None:
+    """Deactivate every installed rule and forget the env cache."""
+    global _env_cache
+    with _lock:
+        _installed.clear()
+        _env_cache = (None, [])
+
+
+class inject:
+    """Context manager scoping a set of :class:`FaultRule`\\ s.
+
+    Re-entrant and nestable; rules installed by an inner scope are
+    removed on exit without disturbing outer scopes.
+    """
+
+    def __init__(self, *rules: FaultRule):
+        self.rules = rules
+
+    def __enter__(self) -> "inject":
+        install(*self.rules)
+        return self
+
+    def __exit__(self, *_exc_info) -> None:
+        with _lock:
+            for rule in self.rules:
+                # identity, not equality: dataclass __eq__ ignores the
+                # counters, so list.remove(rule) could pop an EQUAL rule
+                # an outer scope installed and leave this one active
+                for i, installed in enumerate(_installed):
+                    if installed is rule:
+                        del _installed[i]
+                        break
+
+
+def fault_point(site: str, key: str = "") -> None:
+    """Fire any active rule matching ``(site, key)``; no-op otherwise.
+
+    Instrumentation sites call this with a stable per-unit key (machine
+    or member name) so rules can target one poisonous unit out of a
+    fleet. Threads share rule counters under a lock, so ``after``/
+    ``times`` stay exact even from the dump/data thread pools.
+    """
+    with _lock:
+        rules = _installed + _env_rules()
+        to_fire = None
+        for rule in rules:
+            if rule.site != site or not fnmatch.fnmatchcase(key, rule.match):
+                continue
+            rule.seen += 1
+            i = rule.seen
+            if i <= rule.after:
+                continue
+            if rule.times is not None and i > rule.after + rule.times:
+                continue
+            rule.fired += 1
+            to_fire = rule
+            break
+    if to_fire is None:
+        return
+    logger.warning(
+        "Fault injection: firing %s at %s:%s (match %r, fired %d)",
+        "os._exit(137)" if to_fire.kill else "exception",
+        site,
+        key,
+        to_fire.match,
+        to_fire.fired,
+    )
+    if to_fire.kill:
+        os._exit(137)
+    raise to_fire.make_exc(key)

@@ -1,0 +1,50 @@
+"""
+``simplejson`` pass-through with a stdlib fallback.
+
+The artifact writers (serializer, server JSON responses) want
+``simplejson``'s ``ignore_nan=True`` — NaN/Inf floats become ``null``
+instead of the invalid-JSON ``NaN`` literal the stdlib emits. Containers
+without ``simplejson`` (it is a pyproject dependency, but the baked
+image may predate it) fall back to ``json`` plus an explicit
+NaN-sanitizing walk, so artifacts stay valid JSON either way.
+
+>>> loads(dumps({"a": float("nan"), "b": 1.5}, ignore_nan=True))
+{'a': None, 'b': 1.5}
+"""
+
+import math
+
+try:  # pragma: no cover - exercised only where simplejson is installed
+    from simplejson import dump, dumps, load, loads  # noqa: F401
+
+    HAVE_SIMPLEJSON = True
+except ImportError:
+    import json as _json
+
+    HAVE_SIMPLEJSON = False
+
+    def _sanitize(value):
+        """Replace non-finite floats with None, recursively (the
+        ``ignore_nan`` contract). numpy float scalars subclass ``float``,
+        so fleet metadata's np.float64 NaNs are covered too."""
+        if isinstance(value, float):
+            return value if math.isfinite(value) else None
+        if isinstance(value, dict):
+            return {k: _sanitize(v) for k, v in value.items()}
+        if isinstance(value, (list, tuple)):
+            return [_sanitize(v) for v in value]
+        return value
+
+    def dumps(obj, default=None, ignore_nan=False, **kwargs):
+        if ignore_nan:
+            obj = _sanitize(obj)
+        return _json.dumps(obj, default=default, **kwargs)
+
+    def dump(obj, fp, default=None, ignore_nan=False, **kwargs):
+        fp.write(dumps(obj, default=default, ignore_nan=ignore_nan, **kwargs))
+
+    def load(fp, **kwargs):
+        return _json.load(fp, **kwargs)
+
+    def loads(s, **kwargs):
+        return _json.loads(s, **kwargs)

@@ -1,0 +1,76 @@
+"""
+Exponential-backoff retry for the fleet's host-side data plane.
+
+The reference DAG retried a failed builder pod wholesale (per-pod
+``retryStrategy`` with backoff in argo-workflow.yml.template); in the
+chip-fan-out build the only genuinely flaky host-side phase is the
+per-machine data fetch, so retry lives there as a plain function wrap —
+bounded attempts, exponential backoff, and an optional per-call
+deadline bounding how long the retry ladder keeps going. The deadline
+cannot interrupt a call already in flight (no safe cross-thread cancel
+in Python): a provider that can block forever must carry its own socket
+timeout, which every bundled provider does.
+
+>>> calls = []
+>>> def flaky():
+...     calls.append(1)
+...     if len(calls) < 3:
+...         raise OSError("transient")
+...     return "ok"
+>>> retry_call(flaky, attempts=3, backoff=0)
+'ok'
+>>> len(calls)
+3
+"""
+
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    attempts: int = 3,
+    backoff: float = 0.5,
+    factor: float = 2.0,
+    max_backoff: float = 30.0,
+    deadline: Optional[float] = None,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    no_retry: Tuple[Type[BaseException], ...] = (),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+) -> Any:
+    """
+    Call ``fn`` up to ``attempts`` times; sleep
+    ``min(backoff * factor**(attempt-1), max_backoff)`` between tries.
+
+    ``no_retry`` exceptions re-raise immediately (deterministic config
+    errors — retrying an InsufficientDataError just burns the backoff).
+    ``deadline`` caps total elapsed-plus-next-sleep seconds; when the
+    next sleep would cross it, the last error re-raises instead. It is
+    checked BETWEEN attempts only — it does not (cannot) interrupt an
+    ``fn()`` call that blocks; timeouts inside ``fn`` are its own job.
+    ``on_retry(attempt, exc)`` fires before each sleep (retry counters).
+    ``KeyboardInterrupt``/``SystemExit`` always propagate.
+    """
+    start = time.monotonic()
+    attempt = 1
+    while True:
+        try:
+            return fn()
+        except no_retry:
+            raise
+        except retry_on as exc:
+            if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                raise
+            if attempt >= attempts:
+                raise
+            delay = min(backoff * factor ** (attempt - 1), max_backoff)
+            if (
+                deadline is not None
+                and time.monotonic() - start + delay > deadline
+            ):
+                raise
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            if delay > 0:
+                time.sleep(delay)
+            attempt += 1

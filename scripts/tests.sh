@@ -38,7 +38,12 @@ component="${1:-all}"
 case "$component" in
     all)      run -m "not slow" tests/ ;;
     fast)     run -m "not slow" tests/ --ignore=tests/parallel --ignore=tests/models --ignore=tests/server ;;
-    parallel) run -m "not slow" tests/parallel ;;
+    # The parallel job runs its compile-heavy suites INCLUDING the
+    # slow-marked LSTM/packing/sequence fleet modules — that is exactly
+    # why it has its own matrix job; only the multi-process distributed
+    # tests (their own `slow` cost class, run by the `slow` component)
+    # are excluded here.
+    parallel) run tests/parallel --ignore=tests/parallel/test_distributed.py ;;
     models)   run -m "not slow" tests/models ;;
     builder)  run -m "not slow" tests/builder ;;
     cli)      run -m "not slow" tests/cli ;;

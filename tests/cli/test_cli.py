@@ -141,6 +141,45 @@ def test_build_fleet(runner, tmp_path):
         assert metadata["name"] == f"fleet-m-{i}"
 
 
+def test_build_fleet_resume_skips_journaled_machines(runner, tmp_path):
+    """`build-fleet --resume` must skip machines journaled complete (no
+    rebuild: artifact bytes/mtime untouched) and rebuild any machine
+    whose artifact is missing — the post-crash recovery contract."""
+    import shutil
+
+    machines_yaml = yaml.safe_dump(
+        {
+            "machines": [
+                dict(MACHINE_CONFIG, name=f"resume-m-{i}") for i in range(2)
+            ]
+        }
+    )
+    config_path = tmp_path / "machines.yaml"
+    config_path.write_text(machines_yaml)
+    out_dir = tmp_path / "out"
+    result = runner.invoke(
+        gordo_tpu_cli,
+        ["build-fleet", str(config_path), str(out_dir)],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    assert (out_dir / "build_state.json").is_file()
+    kept = out_dir / "resume-m-0" / "model.pkl"
+    kept_stat = (kept.read_bytes(), kept.stat().st_mtime_ns)
+    # simulate a crash that lost one machine's artifact
+    shutil.rmtree(out_dir / "resume-m-1")
+
+    result = runner.invoke(
+        gordo_tpu_cli,
+        ["build-fleet", str(config_path), str(out_dir), "--resume"],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    assert (out_dir / "resume-m-1" / "model.pkl").is_file()
+    # the journaled-complete machine was not rebuilt
+    assert (kept.read_bytes(), kept.stat().st_mtime_ns) == kept_stat
+
+
 def test_build_fleet_register_cache(runner, tmp_path):
     machines_yaml = yaml.safe_dump(
         {"machines": [dict(MACHINE_CONFIG, name="cached-m")]}

@@ -216,6 +216,95 @@ def test_fleet_build_fail_fast_false_continues(tmp_path):
     assert not (tmp_path / "bad-machine").exists()
 
 
+def test_try_call_propagates_shutdown_signals():
+    """_try_call's broad capture exists for failFast:false semantics
+    only — interpreter shutdown (Ctrl-C, SystemExit/injected kill) must
+    propagate, never become a per-machine build error."""
+    from gordo_tpu.parallel.fleet_build import _try_call
+
+    def raise_(exc):
+        raise exc
+
+    with pytest.raises(KeyboardInterrupt):
+        _try_call(raise_, KeyboardInterrupt())
+    with pytest.raises(SystemExit):
+        _try_call(raise_, SystemExit(137))
+    captured = _try_call(raise_, RuntimeError("per-machine"))
+    assert isinstance(captured, RuntimeError)
+    assert _try_call(lambda: None) is None
+
+
+def test_fleet_build_fail_fast_true_raises_fleet_build_error():
+    """fail_fast=True surfaces the first FleetBuildError instead of
+    recording it: here a windowed (LSTM) model with scattered KFold CV
+    folds, which have no clean window mapping."""
+    from gordo_tpu.parallel.fleet_build import FleetBuildError
+
+    machine = Machine.from_config(
+        {
+            "name": "ff-lstm",
+            "model": {
+                "gordo_tpu.models.JaxLSTMAutoEncoder": {
+                    "kind": "lstm_symmetric",
+                    "dims": [4],
+                    "funcs": ["tanh"],
+                    "lookback_window": 4,
+                    "epochs": 1,
+                }
+            },
+            "dataset": {**DATASET, "tag_list": ["t1", "t2"]},
+            "evaluation": {
+                "cv": {
+                    "sklearn.model_selection.KFold": {
+                        "n_splits": 3,
+                        "shuffle": True,
+                        "random_state": 0,
+                    }
+                }
+            },
+        },
+        project_name="fleet-test",
+    )
+    with pytest.raises(FleetBuildError):
+        FleetBuilder([machine], fail_fast=True).build()
+    # failFast:false records the same failure instead of raising
+    builder = FleetBuilder([machine])
+    assert builder.build() == []
+    assert isinstance(builder.build_errors["ff-lstm"], FleetBuildError)
+
+
+def test_final_fit_divergence_retry_counts_into_metadata(monkeypatch):
+    """FleetTrainer.train's diverged-member reseed retry must surface in
+    the built machine's BuildMetadata robustness counters."""
+    from gordo_tpu.parallel import FleetTrainer
+
+    machine = make_machine("retry-meta", ["t1", "t2"])
+    builder = FleetBuilder([machine])
+    real = FleetTrainer._train_once
+    state = {"poisoned": False}
+
+    def poison_first_final_fit(self, members, config):
+        results = real(self, members, config)
+        # poison exactly one result once: the final-fit members carry the
+        # machine name itself (CV fold members are name::foldN)
+        if not state["poisoned"] and any(r.name == "retry-meta" for r in results):
+            state["poisoned"] = True
+            for r in results:
+                if r.name == "retry-meta":
+                    r.history.history["loss"] = [float("nan")]
+        return results
+
+    monkeypatch.setattr(FleetTrainer, "_train_once", poison_first_final_fit)
+    results = builder.build()
+    assert len(results) == 1
+    _, built = results[0]
+    robustness = built.metadata.build_metadata.robustness
+    assert robustness.fleet_retries == 1
+    assert builder.robustness["fleet_retries"] == 1
+    estimator = results[0][0].base_estimator.steps[-1][1]
+    assert np.isfinite(estimator._history.history["loss"][-1])
+
+
 def test_fleet_build_fail_fast_true_raises():
     bad = Machine.from_config(
         {

@@ -18,6 +18,10 @@ from gordo_tpu.models.training import FitConfig
 from gordo_tpu.ops.windows import window_targets
 from gordo_tpu.parallel import FleetTrainer, WindowedFleetMember
 
+#: segmented-scan LSTM fleet compiles are multi-minute on CPU hosts:
+#: runs in the dedicated `parallel` CI job, outside the tier-1 budget.
+pytestmark = pytest.mark.slow
+
 LOOKBACK = 8
 TAGS = 3
 

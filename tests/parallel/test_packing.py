@@ -17,6 +17,10 @@ from gordo_tpu.models.packing import (
 from gordo_tpu.models.training import FitConfig
 from gordo_tpu.parallel import FleetMember, FleetTrainer
 
+#: packed-supermodel compiles are minute-scale on CPU hosts: runs in the
+#: dedicated `parallel` CI job, outside the tier-1 `-m 'not slow'` budget.
+pytestmark = pytest.mark.slow
+
 
 def _members(spec, m, n=48, seed0=0):
     rng = np.random.RandomState(7)

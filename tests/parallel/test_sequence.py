@@ -14,6 +14,10 @@ from gordo_tpu.parallel.sequence import (
 )
 from jax.sharding import Mesh
 
+#: ring-sequence LSTM compiles are minute-scale on CPU hosts: runs in
+#: the dedicated `parallel` CI job, outside the tier-1 budget.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def seq_mesh():

@@ -44,3 +44,40 @@ def test_load_metadata_parent_fallback(tmp_path):
 def test_load_metadata_missing_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         serializer.load_metadata(str(tmp_path / "nothing"))
+
+
+def test_dump_atomic_replaces_prior_artifact(tmp_path):
+    dest = tmp_path / "model-dir"
+    serializer.dump_atomic(MinMaxScaler(feature_range=(0, 2)), str(dest))
+    serializer.dump_atomic(MinMaxScaler(feature_range=(0, 5)), str(dest))
+    assert serializer.load(str(dest)).feature_range == (0, 5)
+    # no staging dirs left behind
+    assert [e for e in tmp_path.iterdir() if e.name.startswith(".")] == []
+
+
+def test_dump_atomic_preserves_unrelated_files_in_mixed_dir(tmp_path):
+    """The legacy dump merged into an existing dir; dump_atomic must never
+    rmtree a dest holding other content (`gordo build config.yaml .` would
+    otherwise delete the user's working directory)."""
+    dest = tmp_path / "workdir"
+    dest.mkdir()
+    (dest / "notes.txt").write_text("keep me")
+    serializer.dump_atomic(MinMaxScaler(), str(dest), metadata={"m": 1})
+    assert (dest / "notes.txt").read_text() == "keep me"
+    assert serializer.load_metadata(str(dest))["m"] == 1
+    assert isinstance(serializer.load(str(dest)), MinMaxScaler)
+    assert [e for e in tmp_path.iterdir() if e.name.startswith(".")] == []
+
+
+def test_dump_atomic_dir_mode_honors_umask(tmp_path):
+    """mkdtemp's private 0700 must not leak onto artifact dirs — the model
+    server often runs as a different UID on the shared volume."""
+    import os
+    import stat
+
+    dest = tmp_path / "served-model"
+    serializer.dump_atomic(MinMaxScaler(), str(dest))
+    umask = os.umask(0)
+    os.umask(umask)
+    expected = 0o777 & ~umask
+    assert stat.S_IMODE(os.stat(dest).st_mode) == expected

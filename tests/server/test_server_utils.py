@@ -135,3 +135,21 @@ def test_dataframe_to_dict_object_dtype_boxes_numpy_datetimes():
     assert isinstance(out["t"][0], pd.Timestamp)
     assert out["t"][0] == pd.Timestamp("2020-01-01")
     assert isinstance(out["d"][0], pd.Timedelta)
+
+
+def test_delete_revision_reclaims_dir_despite_journal_and_staging(tmp_path):
+    """build_state.json and orphaned `.tmp-*` staging dirs are builder
+    droppings, not models: deleting the last model must still reclaim the
+    revision directory."""
+    from gordo_tpu import serializer
+    from gordo_tpu.server.utils import delete_revision
+    from sklearn.preprocessing import MinMaxScaler
+
+    revision = tmp_path / "1602324482000"
+    revision.mkdir()
+    serializer.dump(MinMaxScaler(), str(revision / "only-model"), metadata={})
+    (revision / "build_state.json").write_text("{}")
+    (revision / ".dead.tmp-1").mkdir()
+
+    delete_revision(str(revision), "only-model")
+    assert not revision.exists()

@@ -1,0 +1,193 @@
+"""Unit coverage for the deterministic fault-injection registry and the
+retry helper it exercises."""
+
+import pytest
+
+from gordo_tpu.utils import faults
+from gordo_tpu.utils.faults import FaultInjected, FaultRule, fault_point, inject
+from gordo_tpu.utils.retry import retry_call
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def test_no_rules_is_a_noop():
+    fault_point("data_fetch", "anything")  # must not raise
+
+
+def test_rule_fires_once_then_passes():
+    with inject(FaultRule("data_fetch", times=1)):
+        with pytest.raises(FaultInjected):
+            fault_point("data_fetch", "m-1")
+        fault_point("data_fetch", "m-1")  # times exhausted
+
+
+def test_rule_scoped_to_site_and_key_glob():
+    with inject(FaultRule("data_fetch", match="poison-*", times=None)):
+        fault_point("data_fetch", "healthy-1")
+        fault_point("device_program", "poison-1")  # wrong site
+        with pytest.raises(FaultInjected):
+            fault_point("data_fetch", "poison-1")
+        with pytest.raises(FaultInjected):
+            fault_point("data_fetch", "poison-2")  # unlimited times
+
+
+def test_after_skips_first_n_matching_calls():
+    rule = FaultRule("dump_artifact", after=2, times=1)
+    with inject(rule):
+        fault_point("dump_artifact", "m-1")
+        fault_point("dump_artifact", "m-2")
+        with pytest.raises(FaultInjected):
+            fault_point("dump_artifact", "m-3")
+        fault_point("dump_artifact", "m-4")
+    assert rule.seen == 4 and rule.fired == 1
+
+
+def test_device_program_default_exc_is_resource_exhausted():
+    from gordo_tpu.parallel.fleet import is_device_error
+
+    with inject(FaultRule("device_program")):
+        with pytest.raises(faults.InjectedDeviceError) as exc_info:
+            fault_point("device_program", "m-1")
+    assert "RESOURCE_EXHAUSTED" in str(exc_info.value)
+    assert is_device_error(exc_info.value)
+
+
+def test_process_kill_site_raises_system_exit_by_default():
+    with inject(FaultRule("process_kill_after_n_machines", after=1)):
+        fault_point("process_kill_after_n_machines", "m-1")
+        with pytest.raises(SystemExit):
+            fault_point("process_kill_after_n_machines", "m-2")
+
+
+def test_nested_scopes_unwind_independently():
+    outer = FaultRule("data_fetch", match="outer-*", times=None)
+    inner = FaultRule("data_fetch", match="inner-*", times=None)
+    with inject(outer):
+        with inject(inner):
+            with pytest.raises(FaultInjected):
+                fault_point("data_fetch", "inner-1")
+        fault_point("data_fetch", "inner-1")  # inner scope gone
+        with pytest.raises(FaultInjected):
+            fault_point("data_fetch", "outer-1")
+    fault_point("data_fetch", "outer-1")
+
+
+def test_nested_equal_rules_unwind_by_identity():
+    """Exiting an inner scope must remove ITS rule object, not an equal
+    outer-scope rule (dataclass __eq__ ignores the counters)."""
+    outer = FaultRule("data_fetch", times=1)
+    inner = FaultRule("data_fetch", times=1)
+    assert outer == inner
+    with inject(outer):
+        with inject(inner):
+            with pytest.raises(FaultInjected):
+                fault_point("data_fetch", "m")  # consumes the OUTER budget
+        # outer scope still governed by its own (now spent) rule; the
+        # inner rule's untouched budget must be gone with its scope
+        assert inner.fired == 0 and outer.fired == 1
+        fault_point("data_fetch", "m")  # outer budget spent: passes
+    fault_point("data_fetch", "m")
+
+
+def test_env_rules_parse_and_fire(monkeypatch):
+    monkeypatch.setenv(
+        faults.ENV_VAR, "dump_artifact:m-*:after=1:exc=SystemExit"
+    )
+    fault_point("dump_artifact", "m-a")
+    with pytest.raises(SystemExit):
+        fault_point("dump_artifact", "m-b")
+
+
+def test_env_parse_rejects_unknown_site_and_option():
+    with pytest.raises(ValueError):
+        faults.parse_rules("not_a_site")
+    with pytest.raises(ValueError):
+        faults.parse_rules("data_fetch:*:bogus=1")
+    with pytest.raises(ValueError):
+        faults.parse_rules("data_fetch:*:exc=NotAnError")
+
+
+def test_parse_multiple_rules():
+    rules = faults.parse_rules(
+        "data_fetch:m-*:times=2; device_program:*:times=inf:kill"
+    )
+    assert [r.site for r in rules] == ["data_fetch", "device_program"]
+    assert rules[0].times == 2 and rules[0].match == "m-*"
+    assert rules[1].times is None and rules[1].kill
+
+
+# -- retry_call ----------------------------------------------------------
+
+
+def test_retry_call_retries_then_succeeds():
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    retried = []
+    assert (
+        retry_call(
+            flaky,
+            attempts=3,
+            backoff=0,
+            on_retry=lambda a, e: retried.append(a),
+        )
+        == "ok"
+    )
+    assert retried == [1, 2]
+
+
+def test_retry_call_exhausts_and_reraises():
+    def always_fails():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_call(always_fails, attempts=2, backoff=0)
+
+
+def test_retry_call_no_retry_types_raise_immediately():
+    calls = []
+
+    def config_error():
+        calls.append(1)
+        raise ValueError("bad config")
+
+    with pytest.raises(ValueError):
+        retry_call(
+            config_error, attempts=5, backoff=0, no_retry=(ValueError,)
+        )
+    assert len(calls) == 1
+
+
+def test_retry_call_never_swallows_shutdown_signals():
+    def interrupted():
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        retry_call(
+            interrupted, attempts=5, backoff=0, retry_on=(BaseException,)
+        )
+
+
+def test_retry_call_deadline_stops_retrying():
+    calls = []
+
+    def slow_failure():
+        calls.append(1)
+        raise OSError("still down")
+
+    with pytest.raises(OSError):
+        # next sleep (10s) would cross the 0.01s deadline → immediate raise
+        retry_call(slow_failure, attempts=10, backoff=10, deadline=0.01)
+    assert len(calls) == 1
