@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults docs bench lint image
+.PHONY: test test-fast test-faults test-observability docs bench bench-telemetry lint image
 
 test:
 	python -m pytest tests/ -q
@@ -10,6 +10,17 @@ test:
 # so the same tests also run inside the tier-1 `-m 'not slow'` budget.
 test-faults:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults
+
+# The build-telemetry suite: span recorder, live progress surface,
+# compile/run attribution, Prometheus build metrics — CPU-only and not
+# slow-marked, so the same tests also run inside the tier-1 budget.
+test-observability:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m observability
+
+# Telemetry-overhead microbench: a small CPU fleet build with telemetry
+# off vs on; writes BENCH_TELEMETRY.json for the bench trajectory.
+bench-telemetry:
+	JAX_PLATFORMS=cpu python benchmarks/bench_telemetry.py
 
 # The sub-5-minute tier: everything except the compile-heavy JAX suites
 # (tests/parallel, tests/models) and slow-marked tests.

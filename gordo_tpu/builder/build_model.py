@@ -36,6 +36,7 @@ from ..machine.metadata import (
     CrossValidationMetaData,
     DatasetBuildMetadata,
     ModelBuildMetadata,
+    TrainingSummaryMetadata,
 )
 from ..models.base import GordoBase
 from ..models.utils import metric_wrapper
@@ -230,6 +231,7 @@ class ModelBuilder:
                     splits=split_metadata,
                 ),
                 model_meta=self._extract_metadata_from_model(model),
+                training=self._extract_training_summary(model),
             ),
             dataset=DatasetBuildMetadata(
                 query_duration_sec=time_elapsed_data,
@@ -237,6 +239,34 @@ class ModelBuilder:
             ),
         )
         return model, machine
+
+    @staticmethod
+    def _extract_training_summary(model) -> TrainingSummaryMetadata:
+        """Training-history summary (final/best loss, epochs, early
+        stop) dug out of the fitted estimator's ``History`` carry, so
+        sequential builds record the same ``training`` block as fleet
+        builds (machines degraded out of the fleet path included)."""
+
+        def find_history(obj, depth=0):
+            if obj is None or depth > 4:
+                return None
+            if isinstance(obj, Pipeline):
+                return find_history(obj.steps[-1][1], depth + 1)
+            history = getattr(obj, "_history", None)
+            if history is not None and hasattr(history, "history"):
+                return history
+            base = getattr(obj, "base_estimator", None)
+            if base is not None and base is not obj:
+                return find_history(base, depth + 1)
+            return None
+
+        history = find_history(model)
+        if history is None:
+            return TrainingSummaryMetadata()
+        try:
+            return TrainingSummaryMetadata.from_history(history)
+        except (TypeError, ValueError, AttributeError):
+            return TrainingSummaryMetadata()
 
     @staticmethod
     def set_seed(seed: int):

@@ -504,6 +504,54 @@ def _maybe_init_distributed():
         )
 
 
+@click.command("build-status")
+@click.argument("output-dir", envvar="OUTPUT_DIR")
+@click.option(
+    "--as-json",
+    "as_json",
+    is_flag=True,
+    help="Print the raw build_status.json document instead of the table",
+)
+@click.option(
+    "--watch",
+    default=None,
+    type=float,
+    help="Re-render every N seconds until the build leaves 'running'",
+)
+def build_status(output_dir: str, as_json: bool, watch: Optional[float]):
+    """
+    Render the live progress of a fleet build from OUTPUT_DIR's
+    ``build_status.json`` heartbeat — the chip-fan-out analog of
+    ``argo get``: state, current phase, machine counts with an ETA from
+    the completed-machine rate, and the per-phase wall-clock table.
+
+    Works mid-build (the builder atomically replaces the document on
+    every phase transition and machine completion), after a crash (the
+    last heartbeat survives beside the journal for post-mortems), and
+    on finished builds. The model server exposes the same document at
+    ``/gordo/v0/<project>/build-status``.
+    """
+    import time as time_mod
+
+    from ..telemetry import load_status, render_status
+
+    while True:
+        doc = load_status(output_dir)
+        if doc is None:
+            raise click.ClickException(
+                f"No build status found in {output_dir} (no fleet build "
+                "has written a heartbeat there, or telemetry is disabled)"
+            )
+        if as_json:
+            click.echo(json.dumps(doc, indent=1, sort_keys=True))
+        else:
+            click.echo(render_status(doc))
+        if watch is None or doc.get("state") != "running":
+            break
+        time_mod.sleep(max(0.1, watch))
+        click.echo("")
+
+
 @click.command("wait-for-models")
 @click.argument("models-dir", envvar="MODELS_DIR")
 @click.option(
@@ -859,6 +907,7 @@ gordo_tpu_cli.add_command(workflow_cli)
 gordo_tpu_cli.add_command(client_cli)
 gordo_tpu_cli.add_command(build)
 gordo_tpu_cli.add_command(build_fleet)
+gordo_tpu_cli.add_command(build_status)
 gordo_tpu_cli.add_command(run_server_cli)
 gordo_tpu_cli.add_command(wait_for_models)
 gordo_tpu_cli.add_command(score)

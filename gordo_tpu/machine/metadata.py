@@ -26,6 +26,46 @@ class CrossValidationMetaData:
 
 @dataclass_json
 @dataclass
+class TrainingSummaryMetadata:
+    """Per-member training-history summary, captured from the fit's
+    ``History`` carry: final/best losses, how many epochs actually ran
+    vs were configured, and where early stopping cut in (``None`` when
+    the fit ran to its configured epoch count)."""
+
+    final_loss: Optional[float] = None
+    best_loss: Optional[float] = None
+    final_val_loss: Optional[float] = None
+    best_val_loss: Optional[float] = None
+    epochs_run: int = 0
+    epochs_configured: int = 0
+    early_stop_epoch: Optional[int] = None
+
+    @classmethod
+    def from_history(cls, history) -> "TrainingSummaryMetadata":
+        """Summarize a Keras-History-shaped fit record (duck-typed:
+        ``.history`` dict of loss lists, ``.params`` dict, ``.epoch``
+        list) — shared by the fleet builder and the sequential
+        ModelBuilder so both artifact paths carry the same fields."""
+        losses = [float(l) for l in history.history.get("loss") or []]
+        val = [float(l) for l in history.history.get("val_loss") or []]
+        epochs_run = len(history.epoch)
+        configured = int(
+            history.params.get("epochs", epochs_run) or epochs_run
+        )
+        early = epochs_run < configured
+        return cls(
+            final_loss=losses[-1] if losses else None,
+            best_loss=min(losses) if losses else None,
+            final_val_loss=val[-1] if val else None,
+            best_val_loss=min(val) if val else None,
+            epochs_run=epochs_run,
+            epochs_configured=configured,
+            early_stop_epoch=epochs_run if early else None,
+        )
+
+
+@dataclass_json
+@dataclass
 class ModelBuildMetadata:
     model_offset: int = 0
     model_creation_date: Optional[str] = None
@@ -35,6 +75,9 @@ class ModelBuildMetadata:
     )
     model_training_duration_sec: Optional[float] = None
     model_meta: Dict[str, Any] = field(default_factory=dict)
+    training: TrainingSummaryMetadata = field(
+        default_factory=TrainingSummaryMetadata
+    )
 
 
 @dataclass_json
@@ -83,6 +126,7 @@ def _metadata_to_dict(self: Metadata, **_kwargs) -> Dict[str, Any]:
     model = self.build_metadata.model
     dataset = self.build_metadata.dataset
     robustness = self.build_metadata.robustness
+    training = model.training
     return {
         "user_defined": copy.deepcopy(self.user_defined),
         "build_metadata": {
@@ -97,6 +141,15 @@ def _metadata_to_dict(self: Metadata, **_kwargs) -> Dict[str, Any]:
                 },
                 "model_training_duration_sec": model.model_training_duration_sec,
                 "model_meta": copy.deepcopy(model.model_meta),
+                "training": {
+                    "final_loss": training.final_loss,
+                    "best_loss": training.best_loss,
+                    "final_val_loss": training.final_val_loss,
+                    "best_val_loss": training.best_val_loss,
+                    "epochs_run": training.epochs_run,
+                    "epochs_configured": training.epochs_configured,
+                    "early_stop_epoch": training.early_stop_epoch,
+                },
             },
             "dataset": {
                 "query_duration_sec": dataset.query_duration_sec,

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from .. import telemetry
 from ..ops.losses import resolve_loss, weighted_mean_loss
 from .callbacks import Callback, EarlyStopping
 from .nn import forward_fn_for, init_fn_for
@@ -657,14 +658,22 @@ def fit_single_segmented(
     opt_state = spec.optimizer.to_optax().init(params)
 
     fit = _segmented_fit_program(spec, config, segments)
-    params, _, losses, val_losses, epochs_ran = fit(
-        params, opt_state, series, targets, wtr, wval, rng
-    )
-    # one coalesced d2h readback — per-element float() would pay the
-    # fixed per-transfer latency once PER EPOCH on tunneled accelerators
-    losses, val_losses, epochs_ran = jax.device_get(
-        (losses, val_losses, epochs_ran)
-    )
+    with telemetry.program_span(
+        "fit_single_segmented",
+        (spec, config, segments, series.shape, targets.shape),
+        shape=str(tuple(series.shape)),
+        spec=type(spec).__name__,
+    ):
+        params, _, losses, val_losses, epochs_ran = fit(
+            params, opt_state, series, targets, wtr, wval, rng
+        )
+        # one coalesced d2h readback — per-element float() would pay the
+        # fixed per-transfer latency once PER EPOCH on tunneled
+        # accelerators. Inside the span: the readback waits on the
+        # program, so the span times real device work, not dispatch.
+        losses, val_losses, epochs_ran = jax.device_get(
+            (losses, val_losses, epochs_ran)
+        )
     epochs_ran = int(epochs_ran)
     history = {"loss": [float(l) for l in losses[:epochs_ran]]}
     if n_val:
@@ -737,14 +746,22 @@ def fit_single(
         )
 
     fit = _fit_program(spec, config)
-    params, _, losses, val_losses, epochs_ran = fit(
-        params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng
-    )
-    # one coalesced d2h readback — per-element float() would pay the
-    # fixed per-transfer latency once PER EPOCH on tunneled accelerators
-    losses, val_losses, epochs_ran = jax.device_get(
-        (losses, val_losses, epochs_ran)
-    )
+    with telemetry.program_span(
+        "fit_single",
+        (spec, config, Xtr.shape, Xval.shape),
+        shape=str(tuple(Xtr.shape)),
+        spec=type(spec).__name__,
+    ):
+        params, _, losses, val_losses, epochs_ran = fit(
+            params, opt_state, Xtr, ytr, wtr, Xval, yval, wval, rng
+        )
+        # one coalesced d2h readback — per-element float() would pay the
+        # fixed per-transfer latency once PER EPOCH on tunneled
+        # accelerators. Inside the span: the readback waits on the
+        # program, so the span times real device work, not dispatch.
+        losses, val_losses, epochs_ran = jax.device_get(
+            (losses, val_losses, epochs_ran)
+        )
     epochs_ran = int(epochs_ran)
     history = {"loss": [float(l) for l in losses[:epochs_ran]]}
     if n_val:

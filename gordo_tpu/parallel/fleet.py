@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from .. import telemetry
 from ..models.nn import forward_fn_for, init_fn_for
 from ..models.spec import ModelSpec
 from ..models.training import (
@@ -137,6 +138,30 @@ class FleetResult:
     #: degradation policy (FleetBuilder falls back to the sequential
     #: ModelBuilder path)
     error: Optional[BaseException] = None
+
+
+def _bucket_nbytes(bucket) -> int:
+    """Raw staged bytes of a bucket's members (span attribution)."""
+    total = 0
+    for member in bucket:
+        if isinstance(member, WindowedFleetMember):
+            total += member.series.nbytes + member.targets.nbytes
+        else:
+            total += member.X.nbytes
+            if member.y is not member.X:
+                total += member.y.nbytes
+    return total
+
+
+def _traced_outputs(outputs):
+    """Block on a device program's outputs when a telemetry recorder is
+    active, so the enclosing program span times real device work — jit
+    dispatch is async and would otherwise measure ~0 for cache hits. The
+    fetch right after waits on the same buffers, so the extra sync is
+    free; with telemetry off this is a pass-through."""
+    if telemetry.get_recorder().enabled:
+        return jax.block_until_ready(outputs)
+    return outputs
 
 
 def _fill_weight_row(wtr, wval, i, n, member, config: FitConfig):
@@ -556,10 +581,16 @@ class FleetTrainer:
                     bucket[0].name,
                     exc,
                 )
+                telemetry.get_recorder().event(
+                    "member_isolated", member=bucket[0].name, error=repr(exc)
+                )
                 failures[bucket[0].name] = exc
                 return
             mid = len(bucket) // 2
             self.bucket_bisects += 1
+            telemetry.get_recorder().event(
+                "bucket_bisect", members=len(bucket), error=repr(exc)
+            )
             for member in bucket:
                 self.bisect_counts[member.name] = (
                     self.bisect_counts.get(member.name, 0) + 1
@@ -641,9 +672,17 @@ class FleetTrainer:
         X, y, wtr, wval, rngs = self._stack_bucket(spec, n_padded, bucket, config)
         params, opt_state, rngs = self._init_bucket_params(spec, rngs)
         fit = _fleet_fit_program(spec, config)
-        params, _, losses, val_losses, epochs_ran = fit(
-            params, opt_state, X, y, wtr, X, y, wval, rngs
-        )
+        with telemetry.program_span(
+            "fleet_fit",
+            (spec, config, X.shape),
+            members=len(bucket),
+            shape=str(tuple(X.shape)),
+            spec=type(spec).__name__,
+            bytes=_bucket_nbytes(bucket),
+        ):
+            params, _, losses, val_losses, epochs_ran = _traced_outputs(
+                fit(params, opt_state, X, y, wtr, X, y, wval, rngs)
+            )
         return self._collect_results(
             bucket, params, losses, val_losses, epochs_ran, config,
             steps=n_padded // config.batch_size,
@@ -725,9 +764,21 @@ class FleetTrainer:
         params = jax.device_put(params, model_sharding(self.mesh, extra_dims=0))
         opt_state = jax.jit(jax.vmap(spec.optimizer.to_optax().init))(params)
         fit = _packed_fit_program(pspec, config)
-        params, _, losses, val_losses = fit(
-            params, opt_state, X_dev, y_dev, wtr_dev, X_dev, y_dev, wval_dev, fit_rngs
-        )
+        with telemetry.program_span(
+            "fleet_packed_fit",
+            (pspec, config, X.shape),
+            members=len(bucket),
+            packed=g,
+            shape=str(tuple(X.shape)),
+            spec=type(spec).__name__,
+            bytes=_bucket_nbytes(bucket),
+        ):
+            params, _, losses, val_losses = _traced_outputs(
+                fit(
+                    params, opt_state, X_dev, y_dev, wtr_dev,
+                    X_dev, y_dev, wval_dev, fit_rngs,
+                )
+            )
 
         host_params, losses, val_losses = fetch_to_host((params, losses, val_losses))
         losses = np.asarray(losses)
@@ -866,6 +917,12 @@ class FleetTrainer:
         )
         params, opt_state, rngs = self._init_bucket_params(spec, rngs)
         segments = self._segmented_eligible(bucket, config)
+        span_attrs = dict(
+            members=len(bucket),
+            shape=str(tuple(series.shape)),
+            spec=type(spec).__name__,
+            bytes=_bucket_nbytes(bucket),
+        )
         if segments is not None:
             logger.info(
                 "Segmented LSTM training: %d segments/update (L=%d)",
@@ -873,14 +930,24 @@ class FleetTrainer:
                 config.batch_size // segments,
             )
             fit = _fleet_segmented_fit_program(spec, config, segments)
-            params, _, losses, val_losses, epochs_ran = fit(
-                params, opt_state, series, ytgt, wtr, wval, rngs
-            )
+            with telemetry.program_span(
+                "fleet_segmented_fit",
+                (spec, config, segments, series.shape),
+                **span_attrs,
+            ):
+                params, _, losses, val_losses, epochs_ran = _traced_outputs(
+                    fit(params, opt_state, series, ytgt, wtr, wval, rngs)
+                )
         else:
             fit = _fleet_windowed_fit_program(spec, config)
-            params, _, losses, val_losses, epochs_ran = fit(
-                params, opt_state, series, ytgt, order, wtr, wval, rngs
-            )
+            with telemetry.program_span(
+                "fleet_windowed_fit",
+                (spec, config, series.shape, order.shape),
+                **span_attrs,
+            ):
+                params, _, losses, val_losses, epochs_ran = _traced_outputs(
+                    fit(params, opt_state, series, ytgt, order, wtr, wval, rngs)
+                )
         return self._collect_results(
             bucket, params, losses, val_losses, epochs_ran, config,
             steps=order.shape[1] // config.batch_size,
@@ -954,7 +1021,16 @@ class FleetTrainer:
                 stacked_params,
             )
         X = jax.device_put(X, model_data_sharding(self.mesh, extra_dims=X.ndim - 2))
-        out = np.asarray(fetch_to_host(fleet_predict_program(spec)(stacked_params, X)))
+        with telemetry.program_span(
+            "fleet_predict",
+            (spec, X.shape),
+            members=m,
+            shape=str(tuple(X.shape)),
+            spec=type(spec).__name__,
+        ):
+            out = np.asarray(
+                fetch_to_host(fleet_predict_program(spec)(stacked_params, X))
+            )
         return out[:m, :n]
 
     def predict_windowed_bucket(
@@ -996,13 +1072,20 @@ class FleetTrainer:
         ms2 = model_sharding(self.mesh, extra_dims=2)
         series = jax.device_put(series, ms2)
         order = jax.device_put(order, model_sharding(self.mesh, extra_dims=1))
-        out = np.asarray(
-            fetch_to_host(
-                fleet_windowed_predict_program(spec, batch_size)(
-                    stacked_params, series, order
+        with telemetry.program_span(
+            "fleet_windowed_predict",
+            (spec, batch_size, series.shape, order.shape),
+            members=m,
+            shape=str(tuple(series.shape)),
+            spec=type(spec).__name__,
+        ):
+            out = np.asarray(
+                fetch_to_host(
+                    fleet_windowed_predict_program(spec, batch_size)(
+                        stacked_params, series, order
+                    )
                 )
             )
-        )
         return out[:m, :nv]
 
 

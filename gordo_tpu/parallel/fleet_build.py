@@ -39,10 +39,11 @@ from sklearn.model_selection import KFold, TimeSeriesSplit
 from sklearn.pipeline import Pipeline
 
 import gordo_tpu
-from .. import serializer
+from .. import serializer, telemetry
 from ..builder.build_model import ModelBuilder
 from ..dataset import GordoBaseDataset
 from ..machine import Machine
+from ..telemetry.progress import BUILD_TRACE_FILE
 from ..utils.profiling import maybe_trace
 from ..machine.metadata import (
     BuildMetadata,
@@ -50,6 +51,7 @@ from ..machine.metadata import (
     DatasetBuildMetadata,
     ModelBuildMetadata,
     RobustnessMetadata,
+    TrainingSummaryMetadata,
 )
 from ..models.anomaly.diff import (
     DiffBasedAnomalyDetector,
@@ -109,6 +111,9 @@ class _Plan:
     data_retries: int = 0  # data-fetch attempts beyond the first
     fleet_retries: int = 0  # diverged-member reseed retries (CV + final)
     bucket_bisects: int = 0  # split-retry events this machine rode through
+    # Final-fit History summary (final/best loss, epochs, early stop),
+    # baked into BuildMetadata.model.training at assembly.
+    training_summary: Optional[TrainingSummaryMetadata] = None
     _scoring_setup_cache: Any = None  # (metrics, fitted scoring scaler)
 
 
@@ -232,12 +237,23 @@ class FleetBuilder:
         self.resumed: List[str] = []
         self._journal: Optional[BuildJournal] = None
         self._config_hashes: Dict[str, str] = {}
+        # Telemetry: the per-build span recorder + live progress surface
+        # (installed by build(); NULL/None outside one, so every
+        # instrumentation site stays unconditional).
+        self.recorder: Any = telemetry.NULL_RECORDER
+        self.progress: Optional[telemetry.BuildProgress] = None
+        self._project = ""
 
     @contextlib.contextmanager
     def _phase(self, name: str):
+        if self.progress is not None:
+            self.progress.phase(name)
         start = time.time()
         try:
-            yield
+            with self.recorder.span(
+                "build_phase", phase=name, machines=len(self.machines)
+            ):
+                yield
         finally:
             self.phase_seconds[name] += time.time() - start
 
@@ -247,7 +263,13 @@ class FleetBuilder:
         if self.fail_fast:
             raise exc
         logger.error("Fleet build of machine %s failed: %r", name, exc)
+        first_failure = name not in self.build_errors
         self.build_errors[name] = exc
+        if first_failure:
+            self.recorder.event("machine_failed", machine=name, error=repr(exc))
+            if self.progress is not None:
+                self.progress.machine_failed(name)
+                self._update_progress_gauges()
 
     def _skipped(self, name: str) -> bool:
         """A machine out of the fleet path: failed, or degraded to the
@@ -269,6 +291,12 @@ class FleetBuilder:
         )
         self.robustness["sequential_degraded"] += 1
         self.degraded[name] = exc
+        self.recorder.event(
+            "machine_degraded", machine=name, error=repr(exc)
+        )
+        if self.progress is not None:
+            self.progress.degraded = len(self.degraded)
+            self.progress.write()
 
     # ------------------------------------------------------------------ API
 
@@ -294,14 +322,79 @@ class FleetBuilder:
         ``self.resumed``), and only the remainder is replanned. Resumed
         machines are not re-loaded, so they do not appear in the return
         value; their artifacts are already in place.
+
+        Telemetry (on unless ``GORDO_TPU_TELEMETRY`` is falsy): the
+        build records a span per phase and device program into
+        ``self.recorder`` (JSONL-sunk to ``<output_dir>/build_trace.jsonl``
+        or ``$GORDO_TPU_TELEMETRY_DIR``), heartbeats a live
+        ``build_status.json`` beside the journal, and exports phase/
+        compile durations, member final losses and machine-progress
+        gauges to Prometheus as they happen.
         """
-        machines = self.machines
         self.build_errors = {}
         self.phase_seconds = defaultdict(float)
         self.robustness = defaultdict(int)
         self.degraded = {}
         self.resumed = []
         self._journal = None
+        self._project = self.machines[0].project_name if self.machines else ""
+
+        recorder: Any = telemetry.NULL_RECORDER
+        self.progress = None
+        if telemetry.enabled():
+            trace_path = None
+            if output_dir is not None:
+                trace_dir = os.getenv(telemetry.TRACE_DIR_ENV) or output_dir
+                try:
+                    os.makedirs(trace_dir, exist_ok=True)
+                    trace_path = os.path.join(trace_dir, BUILD_TRACE_FILE)
+                except OSError as exc:
+                    logger.debug("No span trace sink: %r", exc)
+            recorder = telemetry.SpanRecorder(
+                sink_path=trace_path, service="gordo-tpu-fleet-build"
+            )
+            recorder.add_listener(self._export_span)
+            self.progress = telemetry.BuildProgress(
+                output_dir,
+                project=self._project,
+                total=len(self.machines),
+                phase_seconds=self.phase_seconds,
+            )
+            self._update_progress_gauges()
+        self.recorder = recorder
+        try:
+            with telemetry.activate(recorder):
+                with recorder.span(
+                    "fleet_build",
+                    project=self._project,
+                    machines=len(self.machines),
+                ):
+                    results = self._run_build(
+                        output_dir, model_register_dir, replace_cache, resume
+                    )
+        except Exception:
+            # a build-level failure (per-machine failures do NOT raise);
+            # SystemExit/KeyboardInterrupt skip this on purpose — a
+            # killed build leaves the status "running", like a real kill
+            if self.progress is not None:
+                self.progress.finish("failed")
+                self._update_progress_gauges()
+            raise
+        finally:
+            recorder.close()
+        if self.progress is not None:
+            self.progress.finish("complete")
+            self._update_progress_gauges()
+        return results
+
+    def _run_build(
+        self,
+        output_dir: Optional[str],
+        model_register_dir: Optional[str],
+        replace_cache: bool,
+        resume: bool,
+    ) -> List[Tuple[Any, Machine]]:
+        machines = self.machines
         trainer_bisects_start = getattr(self.trainer, "bucket_bisects", 0)
         trainer_counts_start = dict(getattr(self.trainer, "bisect_counts", {}))
         config_hashes: Dict[str, str] = {}
@@ -332,6 +425,9 @@ class FleetBuilder:
                     len(self.resumed),
                     len(machines),
                 )
+                if self.progress is not None:
+                    self.progress.resumed = len(self.resumed)
+                    self.progress.write(force=True)
 
         cached_results: List[Tuple[Any, Machine]] = []
         if model_register_dir:
@@ -352,6 +448,9 @@ class FleetBuilder:
                 len(cached_results),
                 len(machines),
             )
+            if self.progress is not None:
+                self.progress.cached = len(cached_results)
+                self.progress.write(force=True)
 
         with self._phase("plan"):
             plans, fallbacks = self._plan_all(machines)
@@ -467,6 +566,54 @@ class FleetBuilder:
             if machine.name not in self.build_errors
         ]
 
+    def _export_span(self, span: dict) -> None:
+        """Live Prometheus export of finished telemetry spans — phase
+        durations, first-call (compile) program durations, and member
+        final losses land in /metrics as they happen, not at build end.
+        Best-effort like every metrics path: the build must not care
+        whether a Prometheus stack is configured."""
+        try:
+            from ..server.prometheus import metrics as prom
+
+            name = span["name"]
+            attrs = span.get("attributes") or {}
+            seconds = float(span.get("duration_ms") or 0.0) / 1000.0
+            if name == "build_phase":
+                prom.record_fleet_build_phase(
+                    self._project, str(attrs.get("phase", "")), seconds
+                )
+            elif name == "device_program" and attrs.get("compile"):
+                prom.record_fleet_compile(
+                    self._project,
+                    str(attrs.get("program", "")),
+                    str(attrs.get("shape", "")),
+                    seconds,
+                )
+            elif name == "member_trained":
+                loss = attrs.get("final_loss")
+                if loss is not None and np.isfinite(loss):
+                    prom.record_member_final_loss(self._project, float(loss))
+        except Exception as exc:  # noqa: BLE001 - metrics are advisory
+            logger.debug("Telemetry span not exported: %r", exc)
+
+    def _update_progress_gauges(self) -> None:
+        """Push the live machine-progress counters to the Prometheus
+        gauges (best-effort; called from the dump pool too — Gauge.set
+        is thread-safe)."""
+        if self.progress is None:
+            return
+        try:
+            from ..server.prometheus.metrics import set_fleet_build_progress
+
+            set_fleet_build_progress(
+                self._project,
+                self.progress.total,
+                self.progress.completed,
+                self.progress.failed,
+            )
+        except Exception as exc:  # noqa: BLE001 - metrics are advisory
+            logger.debug("Progress gauges not exported: %r", exc)
+
     def _record_prometheus(self, machines: Sequence[Machine]):
         """Best-effort robustness counter export; the build must not care
         whether a Prometheus stack is configured."""
@@ -505,6 +652,15 @@ class FleetBuilder:
                     "built",
                     config_hash=self._config_hashes.get(machine.name),
                 )
+            # Progress lands BEFORE the kill-injection site, mirroring
+            # the journal: a death right after machine N leaves a status
+            # document (and gauges) that already show N completed —
+            # exactly, with GORDO_TPU_TELEMETRY_HEARTBEAT=0 (the fault
+            # drills); within one heartbeat interval otherwise.
+            self.recorder.event("machine_built", machine=machine.name)
+            if self.progress is not None:
+                self.progress.machine_completed(machine.name)
+                self._update_progress_gauges()
             fault_point("process_kill_after_n_machines", machine.name)
 
         to_dump = [
@@ -1366,6 +1522,18 @@ class FleetBuilder:
                 plan.estimator.spec_ = plan.spec
                 plan.estimator._history = result.history
                 plan.train_duration = time.time() - start
+                plan.training_summary = TrainingSummaryMetadata.from_history(
+                    result.history
+                )
+                self.recorder.event(
+                    "member_trained",
+                    machine=plan.machine.name,
+                    final_loss=plan.training_summary.final_loss,
+                    best_loss=plan.training_summary.best_loss,
+                    epochs_run=plan.training_summary.epochs_run,
+                    early_stop_epoch=plan.training_summary.early_stop_epoch,
+                    retries=result.retries,
+                )
                 if plan.detector is not None:
                     plan.detector.scaler.fit(plan.y)
             except Exception as exc:
@@ -1389,6 +1557,7 @@ class FleetBuilder:
                     splits=plan.cv_splits,
                 ),
                 model_meta=ModelBuilder._extract_metadata_from_model(plan.model_obj),
+                training=plan.training_summary or TrainingSummaryMetadata(),
             ),
             dataset=DatasetBuildMetadata(
                 query_duration_sec=plan.query_duration,

@@ -21,6 +21,7 @@ import uuid
 from os import path
 from typing import Any, Optional
 
+from ..telemetry.progress import BUILD_STATUS_FILE, BUILD_TRACE_FILE
 from ..utils import json_compat as simplejson
 from ..utils.faults import fault_point
 
@@ -85,6 +86,11 @@ BUILD_JOURNAL_FILE = "build_state.json"
 #: append-only per-machine event overlay (one JSON line per status
 #: event), compacted into the base journal at phase boundaries
 BUILD_JOURNAL_EVENTS_FILE = "." + BUILD_JOURNAL_FILE + ".events"
+#: BUILD_STATUS_FILE / BUILD_TRACE_FILE — the build-progress heartbeat
+#: and JSONL span trace written beside the artifacts — are re-exported
+#: in the imports above: telemetry/progress.py owns the names and
+#: formats (that package must stay stdlib-only importable from the
+#: training hot path, so the dependency arrow points this way)
 
 
 def is_staging_dir(name: str) -> bool:
@@ -97,12 +103,15 @@ def is_staging_dir(name: str) -> bool:
 
 def is_builder_dropping(name: str) -> bool:
     """True for any non-model entry the fleet builder may leave in an
-    artifact directory: the build journal, its event overlay, and
-    atomic-write staging leftovers. Revision cleanup treats a directory
-    holding only these as empty; model listings never surface them."""
+    artifact directory: the build journal, its event overlay, the
+    telemetry heartbeat/trace files, and atomic-write staging leftovers.
+    Revision cleanup treats a directory holding only these as empty;
+    model listings never surface them."""
     return (
         name == BUILD_JOURNAL_FILE
         or name == BUILD_JOURNAL_EVENTS_FILE
+        or name == BUILD_STATUS_FILE
+        or name == BUILD_TRACE_FILE
         or is_staging_dir(name)
     )
 

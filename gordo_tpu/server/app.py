@@ -31,6 +31,7 @@ from werkzeug.wrappers import Request, Response
 
 import gordo_tpu
 
+from ..telemetry import SpanRecorder
 from . import utils as server_utils
 from .utils import ServerError
 from .views import anomaly, base
@@ -63,6 +64,7 @@ class RequestContext:
         "request",
         "config",
         "start_time",
+        "timing",
         "collection_dir",
         "current_revision",
         "revision",
@@ -77,6 +79,11 @@ class RequestContext:
         self.request = request
         self.config = config
         self.start_time = timeit.default_timer()
+        # Per-request span recorder (telemetry/recorder.py, in-memory
+        # only): handlers wrap their stages in ``ctx.stage(...)`` and
+        # _finalize turns the recorded durations into Server-Timing
+        # entries, so every response carries its own stage breakdown.
+        self.timing = SpanRecorder(service="gordo-tpu-server")
         self.collection_dir: Optional[str] = None
         self.current_revision: Optional[str] = None
         self.revision: Optional[str] = None
@@ -85,6 +92,11 @@ class RequestContext:
         self.info: Optional[dict] = None
         self.X = None
         self.y = None
+
+    def stage(self, name: str):
+        """Span over one request stage (``model_resolve``, ``data_decode``,
+        ``inference``, ``serialize``); surfaces in Server-Timing."""
+        return self.timing.span(name)
 
     # -- response builders --------------------------------------------------
 
@@ -95,7 +107,8 @@ class RequestContext:
         # serialization cost of the hot path.
         if self.revision is not None and isinstance(payload, dict):
             payload = {**payload, "revision": self.revision}
-        body = simplejson.dumps(payload, default=str, ignore_nan=True)
+        with self.stage("serialize"):
+            body = simplejson.dumps(payload, default=str, ignore_nan=True)
         return Response(body, status=status, mimetype="application/json")
 
     def file_response(
@@ -178,6 +191,11 @@ URL_MAP = Map(
             endpoint="fleet-prediction",
             methods=["POST"],
         ),
+        Rule(
+            f"{PREFIX}/<gordo_project>/build-status",
+            endpoint="build-status",
+            methods=["GET"],
+        ),
         Rule(f"{PREFIX}/<gordo_project>/models", endpoint="models", methods=["GET"]),
         Rule(
             f"{PREFIX}/<gordo_project>/revisions",
@@ -204,6 +222,7 @@ HANDLERS = {
     "models": base.get_model_list,
     "revisions": base.get_revision_list,
     "expected-models": base.get_expected_models,
+    "build-status": base.get_build_status,
 }
 
 
@@ -248,13 +267,22 @@ class GordoServerApp:
         return None
 
     def _finalize(self, ctx: RequestContext, response: Response) -> Response:
-        """Stamp the revision header and add Server-Timing."""
+        """Stamp the revision header and add Server-Timing — one entry
+        per recorded request stage (milliseconds, per the Server-Timing
+        spec) plus the reference-parity ``request_walltime_s`` total
+        (seconds, kept last under its original name/unit for existing
+        dashboards)."""
         if ctx.revision is not None:
             response.headers["revision"] = ctx.revision
 
         runtime_s = timeit.default_timer() - ctx.start_time
         logger.debug("Total runtime for request: %ss", runtime_s)
-        response.headers["Server-Timing"] = f"request_walltime_s;dur={runtime_s}"
+        entries = [
+            f"{name};dur={round(seconds * 1000.0, 2)}"
+            for name, seconds in ctx.timing.durations().items()
+        ]
+        entries.append(f"request_walltime_s;dur={runtime_s}")
+        response.headers["Server-Timing"] = ", ".join(entries)
         return response
 
     def dispatch(self, request: Request) -> Response:

@@ -10,6 +10,7 @@ fleet aggregates into one scrape target.
 import logging
 import os
 import re
+import weakref
 from typing import Optional, Tuple
 
 from prometheus_client import (
@@ -31,7 +32,7 @@ _MODEL_PATH_RE = re.compile(r"^/gordo/v0/(?P<project>[^/]+)/(?P<name>[^/]+)(?:/|
 # Routes that would only add scrape noise.
 DEFAULT_IGNORE_PATHS = ("/healthcheck",)
 
-PROJECT_LEVEL_ROUTES = ("models", "revisions", "expected-models")
+PROJECT_LEVEL_ROUTES = ("models", "revisions", "expected-models", "build-status")
 
 
 def _ensure_multiproc_dir() -> Optional[str]:
@@ -174,21 +175,44 @@ _BUILD_ROBUSTNESS_COUNTERS = (
     ),
 )
 
-#: one Counter set per CollectorRegistry (a Counter name can only
-#: register once per registry; a process typically only ever uses one)
-_build_counters: dict = {}
+#: duration buckets for build phases — builds span sub-second host
+#: phases to multi-minute device training, so the default request
+#: buckets (capped at 10s) would flatten everything interesting
+_PHASE_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+    120.0, 300.0, 600.0, 1800.0, 3600.0,
+)
+#: first-call durations span quick XLA compiles to compile+first-run of
+#: multi-minute training programs — the tail must stay resolvable
+_COMPILE_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0, 120.0, 300.0, 600.0, 1800.0, 3600.0,
+)
+#: final training losses of normalized autoencoder fleets
+_LOSS_BUCKETS = (
+    1e-4, 1e-3, 1e-2, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 100.0,
+)
+
+#: one metric set per LIVE CollectorRegistry. A WeakKeyDictionary, not a
+#: dict keyed by ``id(registry)``: a garbage-collected registry can hand
+#: its id to a NEW registry, which would then silently receive the old
+#: (unregistered-with-it) metric objects — increments that no scrape of
+#: the new registry ever sees. Weak keys die with their registry, so a
+#: fresh registry always builds (and owns) fresh metrics.
+_build_metrics: "weakref.WeakKeyDictionary[CollectorRegistry, dict]" = (
+    weakref.WeakKeyDictionary()
+)
 
 
-def fleet_build_robustness_counters(
-    registry: Optional[CollectorRegistry] = None,
-) -> dict:
-    """The build-robustness Counter set for ``registry`` (default: the
-    global REGISTRY), created once per registry."""
+def fleet_build_metrics(registry: Optional[CollectorRegistry] = None) -> dict:
+    """The full fleet-build metric set for ``registry`` (default: the
+    global REGISTRY), created once per live registry: the robustness
+    Counters, the phase/compile duration and member-final-loss
+    Histograms, and the live machine-progress Gauges."""
     target = registry if registry is not None else REGISTRY
-    key = id(target)
-    if key not in _build_counters:
+    if target not in _build_metrics:
         _ensure_multiproc_dir()
-        _build_counters[key] = {
+        metrics = {
             counter_key: Counter(
                 name,
                 help_text,
@@ -197,7 +221,70 @@ def fleet_build_robustness_counters(
             )
             for counter_key, name, help_text in _BUILD_ROBUSTNESS_COUNTERS
         }
-    return _build_counters[key]
+        metrics["phase_duration"] = Histogram(
+            "gordo_fleet_build_phase_duration_seconds",
+            "Wall-clock of fleet build phases (per occurrence; phases "
+            "like cv_train recur once per bucket chunk)",
+            labelnames=["project", "phase"],
+            buckets=_PHASE_BUCKETS,
+            registry=target,
+        )
+        metrics["compile_duration"] = Histogram(
+            "gordo_fleet_compile_duration_seconds",
+            "FIRST-CALL wall-clock of fleet device programs per program "
+            "and bucket shape: XLA trace+compile plus the first "
+            "execution (they are not separable without an AOT split). "
+            "The cache-miss signal is the DELTA vs later calls of the "
+            "same signature in gordo_fleet_build_phase_duration_seconds "
+            "/ the device_program run spans, not this value alone",
+            labelnames=["project", "program", "shape"],
+            buckets=_COMPILE_BUCKETS,
+            registry=target,
+        )
+        metrics["member_final_loss"] = Histogram(
+            "gordo_fleet_member_final_loss",
+            "Final training loss of fleet members at the end of their "
+            "final fit",
+            labelnames=["project"],
+            buckets=_LOSS_BUCKETS,
+            registry=target,
+        )
+        for gauge_key, name, help_text in (
+            (
+                "machines_total",
+                "gordo_fleet_build_machines_total",
+                "Machines in the currently running fleet build",
+            ),
+            (
+                "machines_completed",
+                "gordo_fleet_build_machines_completed",
+                "Machines whose artifacts have landed in the current "
+                "fleet build (updated live, not only at build end)",
+            ),
+            (
+                "machines_failed",
+                "gordo_fleet_build_machines_failed",
+                "Machines failed so far in the current fleet build",
+            ),
+        ):
+            metrics[gauge_key] = Gauge(
+                name,
+                help_text,
+                labelnames=["project"],
+                registry=target,
+                multiprocess_mode="max",
+            )
+        _build_metrics[target] = metrics
+    return _build_metrics[target]
+
+
+def fleet_build_robustness_counters(
+    registry: Optional[CollectorRegistry] = None,
+) -> dict:
+    """The build-robustness Counter subset for ``registry`` (kept for
+    callers that predate :func:`fleet_build_metrics`)."""
+    metrics = fleet_build_metrics(registry)
+    return {key: metrics[key] for key, _, _ in _BUILD_ROBUSTNESS_COUNTERS}
 
 
 def record_fleet_build_robustness(project: Optional[str], counters: dict):
@@ -208,3 +295,43 @@ def record_fleet_build_robustness(project: Optional[str], counters: dict):
         value = int(counters.get(key, 0) or 0)
         if value:
             counter.labels(project=project or "").inc(value)
+
+
+def record_fleet_build_phase(
+    project: Optional[str], phase: str, seconds: float
+):
+    """One build-phase occurrence's wall-clock (live, per span)."""
+    fleet_build_metrics()["phase_duration"].labels(
+        project=project or "", phase=phase
+    ).observe(seconds)
+
+
+def record_fleet_compile(
+    project: Optional[str], program: str, shape: str, seconds: float
+):
+    """One device program's first-call (compile) wall-clock. ``shape``
+    is the bucket's stacked-array shape string — bounded by the fleet's
+    distinct (architecture, padded-size) buckets, so label cardinality
+    stays at bucket count, not machine count."""
+    fleet_build_metrics()["compile_duration"].labels(
+        project=project or "", program=program, shape=shape
+    ).observe(seconds)
+
+
+def record_member_final_loss(project: Optional[str], loss: float):
+    """One fleet member's final training loss, at final-fit completion."""
+    fleet_build_metrics()["member_final_loss"].labels(
+        project=project or ""
+    ).observe(loss)
+
+
+def set_fleet_build_progress(
+    project: Optional[str], total: int, completed: int, failed: int
+):
+    """The live machine-progress gauges (the in-process analog of
+    counting Succeeded/Failed pods in ``argo get``)."""
+    metrics = fleet_build_metrics()
+    labels = {"project": project or ""}
+    metrics["machines_total"].labels(**labels).set(total)
+    metrics["machines_completed"].labels(**labels).set(completed)
+    metrics["machines_failed"].labels(**labels).set(failed)

@@ -26,8 +26,10 @@ DELETED_FROM_RESPONSE_COLUMNS = (
 
 def post_anomaly_prediction(ctx, gordo_project: str, gordo_name: str):
     start_time = timeit.default_timer()
-    server_utils.require_model(ctx, gordo_name)
-    server_utils.extract_X_y(ctx)
+    with ctx.stage("model_resolve"):
+        server_utils.require_model(ctx, gordo_name)
+    with ctx.stage("data_decode"):
+        server_utils.extract_X_y(ctx)
 
     if ctx.y is None:
         return ctx.json_response(
@@ -36,7 +38,10 @@ def post_anomaly_prediction(ctx, gordo_project: str, gordo_name: str):
         )
 
     try:
-        anomaly_df = ctx.model.anomaly(ctx.X, ctx.y, frequency=get_frequency(ctx))
+        with ctx.stage("inference"):
+            anomaly_df = ctx.model.anomaly(
+                ctx.X, ctx.y, frequency=get_frequency(ctx)
+            )
     except AttributeError:
         return ctx.json_response(
             {

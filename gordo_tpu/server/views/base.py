@@ -18,6 +18,7 @@ import pandas as pd
 import gordo_tpu
 from ... import serializer
 from ...models import utils as model_utils
+from ...telemetry import load_status as load_build_status
 from .. import model_io
 from .. import utils as server_utils
 from ..properties import get_tags, get_target_tags
@@ -31,15 +32,18 @@ def post_prediction(ctx, gordo_project: str, gordo_name: str):
     start/end/model-input/model-output response frame as JSON (or parquet
     with ``?format=parquet``).
     """
-    server_utils.require_model(ctx, gordo_name)
-    server_utils.extract_X_y(ctx)
+    with ctx.stage("model_resolve"):
+        server_utils.require_model(ctx, gordo_name)
+    with ctx.stage("data_decode"):
+        server_utils.extract_X_y(ctx)
 
     context: Dict[Any, Any] = dict()
     X = ctx.X
     process_request_start_time_s = timeit.default_timer()
 
     try:
-        output = model_io.get_model_output(model=ctx.model, X=X)
+        with ctx.stage("inference"):
+            output = model_io.get_model_output(model=ctx.model, X=X)
     except ValueError as err:
         logger.error(
             "Failed to predict or transform; error: %s - \nTraceback: %s",
@@ -144,7 +148,10 @@ def post_fleet_prediction(ctx, gordo_project: str):
 
     data: Dict[str, Any] = {}
     if frames:
-        scores, score_errors = STORE.fleet(ctx.collection_dir).fleet_scores(frames)
+        with ctx.stage("inference"):
+            scores, score_errors = STORE.fleet(ctx.collection_dir).fleet_scores(
+                frames
+            )
         for name, exc in score_errors.items():
             # Filesystem/internal errors never echo raw text (it can carry
             # server paths; details live in the server log); client-data
@@ -326,6 +333,22 @@ def delete_model_revision(ctx, gordo_project: str, gordo_name: str, revision: st
     revision_dir = os.path.join(ctx.collection_dir, "..", revision)
     server_utils.delete_revision(revision_dir, gordo_name)
     return ctx.json_response({"ok": True}, status=200)
+
+
+def get_build_status(ctx, gordo_project: str):
+    """
+    The live fleet-build progress document (``build_status.json``) the
+    builder heartbeats beside this revision's artifacts — phase, machine
+    counts and per-phase durations, served verbatim so operators (and
+    the ``gordo-tpu build-status`` CLI pointed at the server) can watch
+    a build without volume access. 404 when no build has written one.
+    """
+    doc = load_build_status(ctx.collection_dir)
+    if doc is None:
+        return ctx.json_response(
+            {"error": "No build status for this revision."}, status=404
+        )
+    return ctx.json_response(doc)
 
 
 def get_metadata(ctx, gordo_project: str, gordo_name: str):

@@ -1,0 +1,48 @@
+"""
+Build telemetry: span recording, compile/run attribution, and the live
+build-progress surface (see recorder.py and progress.py module docs).
+
+Import surface is intentionally small and stdlib-only — the training hot
+path imports this package, so it must never pull in server or metrics
+dependencies.
+"""
+
+from .progress import (
+    HEARTBEAT_ENV,
+    BuildProgress,
+    eta_seconds,
+    load_status,
+    render_status,
+)
+from .recorder import (
+    NULL_RECORDER,
+    TELEMETRY_ENV,
+    TRACE_DIR_ENV,
+    NullRecorder,
+    SpanRecorder,
+    activate,
+    enabled,
+    get_recorder,
+    program_span,
+    reset_seen_programs,
+    seen_program,
+)
+
+__all__ = [
+    "BuildProgress",
+    "HEARTBEAT_ENV",
+    "NULL_RECORDER",
+    "NullRecorder",
+    "SpanRecorder",
+    "TELEMETRY_ENV",
+    "TRACE_DIR_ENV",
+    "activate",
+    "enabled",
+    "eta_seconds",
+    "get_recorder",
+    "load_status",
+    "program_span",
+    "render_status",
+    "reset_seen_programs",
+    "seen_program",
+]

@@ -1,0 +1,289 @@
+"""
+Live fleet-build progress: the ``build_status.json`` heartbeat.
+
+The reference operator watched a build with ``argo get`` — per-machine
+phase, counts and durations straight from the pod DAG. The chip-fan-out
+build's equivalent is this compact document, atomically rewritten beside
+the build journal on every phase transition and machine completion, so
+*any* moment of the build has a current, parseable status on disk:
+
+- the ``gordo-tpu build-status <output-dir>`` CLI renders it (per-phase
+  table, progress bar, ETA from the completed-machine rate),
+- the model server serves it verbatim from
+  ``/gordo/v0/<project>/build-status``,
+- dashboards can poll the file over whatever volume carries the
+  artifacts.
+
+Writes are throttled by ``GORDO_TPU_TELEMETRY_HEARTBEAT`` (seconds
+between machine-completion writes; default 0.5). The throttle is what
+makes the surface free at any scale: an atomic replace costs ~1ms, so
+per-completion writes would tax a toy build measurably while a real
+heartbeat is at most ~2 writes/second no matter how many thousand
+machines are landing. ``0`` opts into exact per-completion durability
+(the fault-injection drills use it so the status is never behind the
+journal). First entry of each phase and the final state always write.
+"""
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .recorder import _iso
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_ENV = "GORDO_TPU_TELEMETRY_HEARTBEAT"
+DEFAULT_HEARTBEAT_SECONDS = 0.5
+
+#: canonical names of the telemetry files written beside the artifacts.
+#: They live HERE (not serializer.py) because this package must stay
+#: stdlib-only importable from the training hot path; the serializer's
+#: artifact-discovery predicates re-export them.
+BUILD_STATUS_FILE = "build_status.json"
+BUILD_TRACE_FILE = "build_trace.jsonl"
+
+
+class BuildProgress:
+    """
+    Counter/phase tracker that heartbeats ``<output_dir>/build_status.json``.
+
+    Thread-safe: the dump pool reports completions concurrently. With
+    ``output_dir=None`` the counters still track (and feed the Prometheus
+    gauges via the builder) but nothing is written.
+    """
+
+    def __init__(
+        self,
+        output_dir: Optional[str],
+        project: str = "",
+        total: int = 0,
+        phase_seconds: Optional[Dict[str, float]] = None,
+        heartbeat_seconds: Optional[float] = None,
+    ):
+        self.path = (
+            os.path.join(output_dir, BUILD_STATUS_FILE)
+            if output_dir is not None
+            else None
+        )
+        if output_dir is not None:
+            try:
+                os.makedirs(output_dir, exist_ok=True)
+            except OSError:
+                self.path = None  # advisory: never fail the build
+        self.project = project
+        self.total = total
+        self.completed = 0
+        self.failed = 0
+        self.resumed = 0
+        self.cached = 0
+        self.degraded = 0
+        self.state = "running"
+        self.started_at = time.time()
+        #: reference to the builder's live phase_seconds dict — snapshot
+        #: at every write so the doc carries the fine-grained breakdown
+        self.phase_seconds = phase_seconds if phase_seconds is not None else {}
+        if heartbeat_seconds is None:
+            try:
+                heartbeat_seconds = float(
+                    os.getenv(HEARTBEAT_ENV, "") or DEFAULT_HEARTBEAT_SECONDS
+                )
+            except ValueError:
+                heartbeat_seconds = DEFAULT_HEARTBEAT_SECONDS
+        self.heartbeat_seconds = max(0.0, heartbeat_seconds)
+        self._phase: Optional[str] = None
+        self._phase_order: List[str] = []
+        self._lock = threading.Lock()
+        self._write_lock = threading.Lock()  # serializes write+rename
+        self._last_write = 0.0
+
+    # -- build lifecycle ----------------------------------------------------
+
+    def phase(self, name: str) -> None:
+        """Enter a build phase. Phases re-enter freely (the CV loop
+        interleaves train/predict/score once per bucket chunk); only the
+        FIRST entry of each phase forces a write — re-entries ride the
+        heartbeat throttle, so a thousand-chunk CV costs one forced
+        write, not a thousand ~ms atomic replaces."""
+        with self._lock:
+            changed = self._phase != name
+            self._phase = name
+            first_entry = name not in self._phase_order
+            if first_entry:
+                self._phase_order.append(name)
+        if first_entry:
+            self.write(force=True)
+        elif changed:
+            self.write(min_interval=self.PHASE_REENTRY_INTERVAL)
+
+    def machine_completed(self, name: str = "") -> None:
+        with self._lock:
+            self.completed += 1
+        self.write()
+
+    def machine_failed(self, name: str = "") -> None:
+        with self._lock:
+            self.failed += 1
+        self.write()
+
+    def finish(self, state: str = "complete") -> None:
+        with self._lock:
+            self.state = state
+            self._phase = None
+        self.write(force=True)
+
+    # -- the document -------------------------------------------------------
+
+    def document(self) -> Dict[str, Any]:
+        with self._lock:
+            now = time.time()
+            phases = {
+                name: {
+                    "seconds": round(
+                        float(self.phase_seconds.get(name, 0.0)), 6
+                    ),
+                    "status": "running" if name == self._phase else "done",
+                }
+                for name in self._phase_order
+            }
+            return {
+                "version": 1,
+                "project": self.project,
+                "state": self.state,
+                "phase": self._phase,
+                "started_at": _iso(self.started_at),
+                "updated_at": _iso(now),
+                "elapsed_sec": round(now - self.started_at, 3),
+                "machines": {
+                    "total": self.total,
+                    "completed": self.completed,
+                    "failed": self.failed,
+                    "resumed": self.resumed,
+                    "cached": self.cached,
+                    "degraded": self.degraded,
+                },
+                "phases": phases,
+            }
+
+    #: floor on how often phase RE-entries rewrite the doc — the CV loop
+    #: cycles train/predict/score once per bucket chunk, and each atomic
+    #: replace costs ~1ms; machine completions are not floored (their
+    #: durability mirrors the journal's per-machine event append)
+    PHASE_REENTRY_INTERVAL = 0.2
+
+    def write(
+        self, force: bool = False, min_interval: Optional[float] = None
+    ) -> None:
+        """Atomically replace the status file (best-effort: the build
+        must never fail because its progress doc could not land).
+        ``min_interval`` raises the throttle floor for this call only."""
+        if self.path is None:
+            return
+        interval = self.heartbeat_seconds
+        if min_interval is not None:
+            interval = max(interval, min_interval)
+        now = time.time()
+        with self._write_lock:
+            with self._lock:
+                if not force and now - self._last_write < interval:
+                    return
+                self._last_write = now
+            doc = self.document()
+            # Dotted staging-convention name, like the journal's flush:
+            # an interrupted write leaves a file every discovery path
+            # already classifies as a staging leftover. The write+rename
+            # happens under _write_lock (a dedicated lock so document()
+            # can take _lock): the dump pool reports completions from 8
+            # threads sharing this one pid-named tmp path, and an
+            # unlocked open(tmp, "w") would truncate a sibling's
+            # in-flight write — renaming torn JSON into the status file.
+            tmp = f"{os.path.join(os.path.dirname(self.path), '.' + BUILD_STATUS_FILE)}.tmp-{os.getpid()}"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(doc, f, default=str)
+                os.replace(tmp, self.path)
+            except OSError as exc:
+                logger.debug("build_status heartbeat not written: %r", exc)
+                with contextlib.suppress(OSError):
+                    os.remove(tmp)
+
+
+def load_status(output_dir: str) -> Optional[Dict[str, Any]]:
+    """The build-status document from ``output_dir``, or None when no
+    build has written one (or it is unreadable)."""
+    try:
+        with open(os.path.join(output_dir, BUILD_STATUS_FILE)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def eta_seconds(doc: Dict[str, Any]) -> Optional[float]:
+    """ETA from the completed-machine rate, or None while no machine has
+    completed (training phases finish machines in bulk at dump time, so
+    the estimate firms up as artifacts start landing)."""
+    machines = doc.get("machines") or {}
+    completed = int(machines.get("completed") or 0)
+    elapsed = float(doc.get("elapsed_sec") or 0.0)
+    if doc.get("state") != "running" or completed <= 0 or elapsed <= 0:
+        return None
+    remaining = (
+        int(machines.get("total") or 0)
+        - completed
+        - int(machines.get("resumed") or 0)
+        - int(machines.get("failed") or 0)
+    )
+    if remaining <= 0:
+        return 0.0
+    return remaining * elapsed / completed
+
+
+def render_status(doc: Dict[str, Any]) -> str:
+    """Human rendering of a build-status document (the ``build-status``
+    CLI's output): header, progress bar + ETA, per-phase table."""
+    machines = doc.get("machines") or {}
+    total = int(machines.get("total") or 0)
+    completed = int(machines.get("completed") or 0)
+    resumed = int(machines.get("resumed") or 0)
+    failed = int(machines.get("failed") or 0)
+    done = completed + resumed
+    state = doc.get("state", "unknown")
+    phase = doc.get("phase")
+    lines = [
+        f"Project:  {doc.get('project') or '-'}",
+        f"State:    {state}" + (f" (phase: {phase})" if phase else ""),
+        f"Started:  {doc.get('started_at', '-')}  "
+        f"(elapsed {doc.get('elapsed_sec', 0):.0f}s)",
+        f"Machines: {done}/{total} done"
+        + (f" ({resumed} resumed)" if resumed else "")
+        + (f", {failed} failed" if failed else "")
+        + (
+            f", {machines.get('degraded')} degraded"
+            if machines.get("degraded")
+            else ""
+        ),
+    ]
+    if total:
+        frac = min(1.0, (done + failed) / total)
+        width = 30
+        fill = int(round(frac * width))
+        bar = "#" * fill + "." * (width - fill)
+        eta = eta_seconds(doc)
+        eta_text = f"   ETA ~{eta:.0f}s" if eta is not None else ""
+        lines.append(f"Progress: [{bar}] {frac * 100:3.0f}%{eta_text}")
+    phases = doc.get("phases") or {}
+    if phases:
+        lines.append("Phases:")
+        name_width = max(len(name) for name in phases)
+        lines.append(f"  {'phase'.ljust(name_width)}  {'seconds':>9}  status")
+        for name, entry in phases.items():
+            lines.append(
+                f"  {name.ljust(name_width)}  "
+                f"{float(entry.get('seconds', 0.0)):9.2f}  "
+                f"{entry.get('status', '')}"
+            )
+    return "\n".join(lines)

@@ -1,0 +1,356 @@
+"""
+The build-telemetry span recorder.
+
+The reference system's observability was Kubernetes': one pod per model
+build means ``argo get`` shows per-machine phase, duration and retries
+for free. The chip-fan-out build collapses thousands of machines into
+one process, so the same visibility has to be *data* the process emits:
+this module records named spans (wall-clock intervals with attributes)
+and point events into an in-memory list and an optional JSONL sink,
+shaped like OpenTelemetry span dicts so a real OTLP exporter can be
+bolted on later without touching the instrumentation sites.
+
+Stdlib-only by design — the recorder is imported by the training hot
+path (models/training.py, parallel/fleet.py) and must never drag server
+or metrics dependencies into it. Prometheus export happens via
+listeners the *builder* registers (parallel/fleet_build.py), keeping
+the dependency arrow pointing outward.
+
+Two activation models coexist:
+
+- a process-global recorder (:func:`activate` / :func:`get_recorder`)
+  used by the fleet build, so deep call sites (the trainer's device
+  programs) record without threading a recorder argument through every
+  layer. The default is :data:`NULL_RECORDER`, whose spans cost a few
+  hundred nanoseconds and record nothing.
+- explicit per-object recorders (the model server builds one per
+  request for its ``Server-Timing`` stages).
+
+Compile-vs-run attribution: :func:`program_span` wraps jit entry
+points. The first call per ``(program, key)`` — key includes the spec,
+fit config and array shapes, i.e. the XLA compilation signature — is
+attributed ``compile=True`` (jax traces+compiles synchronously inside
+that first call); later calls with the same signature are steady-state
+``compile=False`` runs. This is the cache-hit/miss signal future
+compile-cache work needs.
+"""
+
+import contextlib
+import datetime
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, Hashable, List, Optional
+
+TELEMETRY_ENV = "GORDO_TPU_TELEMETRY"
+TRACE_DIR_ENV = "GORDO_TPU_TELEMETRY_DIR"
+
+
+def enabled() -> bool:
+    """Telemetry master switch: on unless ``GORDO_TPU_TELEMETRY`` is a
+    falsy string (``0``/``false``/``off``/``no``)."""
+    return os.getenv(TELEMETRY_ENV, "1").strip().lower() not in (
+        "0",
+        "false",
+        "off",
+        "no",
+    )
+
+
+def _iso(ts: float) -> str:
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc
+    ).isoformat()
+
+
+class SpanHandle:
+    """The object a ``with recorder.span(...)`` block receives; lets the
+    body attach attributes discovered mid-span (e.g. result counts)."""
+
+    __slots__ = ("attributes",)
+
+    def __init__(self, attributes: Dict[str, Any]):
+        self.attributes = attributes
+
+    def set(self, **attributes) -> "SpanHandle":
+        self.attributes.update(attributes)
+        return self
+
+
+class NullRecorder:
+    """The do-nothing recorder: spans yield a throwaway handle and
+    record nothing. Shared process-wide default."""
+
+    enabled = False
+    trace_id = ""
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes):
+        yield SpanHandle({})
+
+    def event(self, name: str, **attributes) -> None:
+        pass
+
+    def add_listener(self, listener: Callable[[dict], None]) -> None:
+        pass
+
+    def finished(self, name: Optional[str] = None) -> List[dict]:
+        return []
+
+    def durations(self) -> Dict[str, float]:
+        return {}
+
+    def close(self) -> None:
+        pass
+
+
+NULL_RECORDER = NullRecorder()
+
+
+class SpanRecorder:
+    """
+    Span/event recorder: in-memory tree + optional JSONL sink.
+
+    Thread-safe — the dump/data thread pools record spans concurrently;
+    parent/child nesting is tracked per thread (a pool thread's spans
+    are roots of their own subtree, which is the truth: they do not run
+    inside the main thread's current span).
+
+    Every finished span is appended to ``sink_path`` as one JSON line
+    the instant it closes, so a killed build leaves a complete trace of
+    everything that actually happened.
+    """
+
+    enabled = True
+
+    def __init__(
+        self,
+        sink_path: Optional[str] = None,
+        service: str = "gordo-tpu",
+        retain_spans: Optional[bool] = None,
+    ):
+        self.trace_id = uuid.uuid4().hex
+        self.service = service
+        self.sink_path = sink_path
+        self._sink = None
+        self._lock = threading.Lock()
+        # In-memory retention serves short-lived recorders (the server's
+        # per-request Server-Timing, in-process tests). A sink-backed
+        # BUILD recorder must not retain: a many-hour fleet build emits
+        # an unbounded span stream that nothing in the build path reads
+        # back — the JSONL sink and the listeners are its consumers.
+        self.retain_spans = (
+            retain_spans if retain_spans is not None else sink_path is None
+        )
+        self._spans: List[dict] = []
+        self._listeners: List[Callable[[dict], None]] = []
+        self._local = threading.local()
+
+    # -- recording ----------------------------------------------------------
+
+    def _stack(self) -> List[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes):
+        """Record the enclosed block as one span; exceptions mark the
+        span ``ERROR`` (with the exception repr) and propagate."""
+        handle = SpanHandle(dict(attributes))
+        span_id = uuid.uuid4().hex[:16]
+        stack = self._stack()
+        parent_id = stack[-1] if stack else None
+        stack.append(span_id)
+        start = time.time()
+        error: Optional[BaseException] = None
+        try:
+            yield handle
+        except BaseException as exc:
+            error = exc
+            raise
+        finally:
+            stack.pop()
+            end = time.time()
+            self._record(
+                self._span_dict(
+                    name,
+                    span_id,
+                    parent_id,
+                    start,
+                    end,
+                    handle.attributes,
+                    error,
+                )
+            )
+
+    def event(self, name: str, **attributes) -> None:
+        """A point-in-time (zero-duration) record."""
+        now = time.time()
+        stack = self._stack()
+        self._record(
+            self._span_dict(
+                name,
+                uuid.uuid4().hex[:16],
+                stack[-1] if stack else None,
+                now,
+                now,
+                dict(attributes),
+                None,
+                kind="event",
+            )
+        )
+
+    def _span_dict(
+        self,
+        name,
+        span_id,
+        parent_id,
+        start,
+        end,
+        attributes,
+        error,
+        kind="internal",
+    ) -> dict:
+        return {
+            "name": name,
+            "context": {"trace_id": self.trace_id, "span_id": span_id},
+            "parent_id": parent_id,
+            "kind": kind,
+            "start_time": _iso(start),
+            "end_time": _iso(end),
+            "duration_ms": round((end - start) * 1000.0, 3),
+            "status": {
+                "status_code": "ERROR" if error is not None else "OK",
+                **({"description": repr(error)} if error is not None else {}),
+            },
+            "attributes": attributes,
+            "resource": {"service.name": self.service},
+        }
+
+    def _record(self, span: dict) -> None:
+        with self._lock:
+            if self.retain_spans:
+                self._spans.append(span)
+            if self.sink_path is not None:
+                try:
+                    if self._sink is None:
+                        self._sink = open(self.sink_path, "a")
+                    self._sink.write(json.dumps(span, default=str) + "\n")
+                    self._sink.flush()
+                except OSError:
+                    # telemetry is advisory: a full/readonly volume must
+                    # never fail the build it is describing
+                    self.sink_path = None
+                    self._sink = None
+            listeners = list(self._listeners)
+        for listener in listeners:
+            try:
+                listener(span)
+            except Exception:  # noqa: BLE001 - listeners are advisory too
+                pass
+
+    # -- introspection ------------------------------------------------------
+
+    def add_listener(self, listener: Callable[[dict], None]) -> None:
+        """Call ``listener(span_dict)`` for every span/event as it
+        finishes (the builder uses this for live Prometheus export)."""
+        with self._lock:
+            self._listeners.append(listener)
+
+    def finished(self, name: Optional[str] = None) -> List[dict]:
+        """Finished spans (optionally filtered by name), oldest first.
+        Empty when ``retain_spans`` is off (the default for sink-backed
+        recorders — read the JSONL sink instead)."""
+        with self._lock:
+            spans = list(self._spans)
+        if name is not None:
+            spans = [s for s in spans if s["name"] == name]
+        return spans
+
+    def durations(self) -> Dict[str, float]:
+        """Total seconds per span name, in first-seen order."""
+        totals: Dict[str, float] = {}
+        for span in self.finished():
+            if span["kind"] == "event":
+                continue
+            totals[span["name"]] = (
+                totals.get(span["name"], 0.0) + span["duration_ms"] / 1000.0
+            )
+        return totals
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                try:
+                    self._sink.close()
+                except OSError:
+                    pass
+                self._sink = None
+
+
+# -- the process-global recorder --------------------------------------------
+
+_active: Any = NULL_RECORDER
+_active_lock = threading.Lock()
+
+
+def get_recorder():
+    """The currently active recorder (:data:`NULL_RECORDER` when no
+    build is being traced)."""
+    return _active
+
+
+@contextlib.contextmanager
+def activate(recorder):
+    """Install ``recorder`` as the process-global recorder for the
+    enclosed block (the fleet build wraps itself in this)."""
+    global _active
+    with _active_lock:
+        previous, _active = _active, recorder
+    try:
+        yield recorder
+    finally:
+        with _active_lock:
+            _active = previous
+
+
+# -- compile-vs-run attribution ---------------------------------------------
+
+_seen_lock = threading.Lock()
+_seen_programs: set = set()
+
+
+def seen_program(key: Hashable) -> bool:
+    """Register a program signature; True when it was already seen this
+    process (→ the jit cache will hit and the call is a steady-state
+    run, not a compile)."""
+    with _seen_lock:
+        if key in _seen_programs:
+            return True
+        _seen_programs.add(key)
+        return False
+
+
+def reset_seen_programs() -> None:
+    """Forget all program signatures (tests only — real processes keep
+    the set for the jit caches' lifetime, which is the process)."""
+    with _seen_lock:
+        _seen_programs.clear()
+
+
+def program_span(program: str, key: Hashable, **attributes):
+    """
+    Span around one jit-program invocation, attributed ``compile=True``
+    on the first call per signature and ``compile=False`` after.
+
+    ``key`` must capture the full compilation signature — spec, fit
+    config, and array shapes — exactly as the jit cache would.
+    """
+    compile_flag = not seen_program((program, key))
+    return get_recorder().span(
+        "device_program", program=program, compile=compile_flag, **attributes
+    )

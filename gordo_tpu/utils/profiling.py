@@ -8,6 +8,12 @@ layer the reference had no analog for: XLA device traces).
 Set ``GORDO_TPU_PROFILE_DIR`` and every labeled region writes a
 TensorBoard-loadable trace (``jax.profiler``) under
 ``$GORDO_TPU_PROFILE_DIR/<label>/``; unset, the context manager is free.
+
+This is the heavyweight, opt-in layer: raw XLA device traces for deep
+kernel work. The always-on, aggregated layer — phase spans, compile/run
+attribution, the live build-status surface — is ``gordo_tpu.telemetry``
+(docs/observability.md); the two compose (a ``maybe_trace`` region can
+enclose spans and vice versa).
 """
 
 import contextlib

@@ -1,0 +1,101 @@
+"""
+The server-side observability surface: the ``build-status`` route
+serving the builder's heartbeat document, and the per-stage
+``Server-Timing`` entries the request recorder produces.
+"""
+
+import json
+import os
+
+import pytest
+
+from gordo_tpu.telemetry.progress import BUILD_STATUS_FILE
+
+# Must match tests/server/conftest.py
+PROJECT = "test-project"
+REVISION = "1602324482000"
+
+pytestmark = pytest.mark.observability
+
+
+def url(rest: str) -> str:
+    return f"/gordo/v0/{PROJECT}/{rest}"
+
+
+@pytest.fixture
+def status_doc(collection_dir):
+    doc = {
+        "version": 1,
+        "project": PROJECT,
+        "state": "running",
+        "phase": "dump",
+        "elapsed_sec": 12.0,
+        "machines": {
+            "total": 5,
+            "completed": 2,
+            "failed": 0,
+            "resumed": 0,
+            "cached": 0,
+            "degraded": 0,
+        },
+        "phases": {"plan": {"seconds": 0.2, "status": "done"}},
+    }
+    path = os.path.join(collection_dir, BUILD_STATUS_FILE)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    yield doc
+    os.remove(path)
+
+
+def test_build_status_route_serves_heartbeat(client, status_doc):
+    resp = client.get(url("build-status"))
+    assert resp.status_code == 200
+    body = resp.json
+    assert body["state"] == "running"
+    assert body["phase"] == "dump"
+    assert body["machines"]["completed"] == 2
+    # served like every document of this revision
+    assert body["revision"] == REVISION
+    assert resp.headers["revision"] == REVISION
+
+
+def test_build_status_404_when_no_build_wrote_one(client):
+    resp = client.get(url("build-status"))
+    assert resp.status_code == 404
+    assert "error" in resp.json
+
+
+def test_build_status_ignored_by_model_listing(client, status_doc):
+    resp = client.get(url("models"))
+    assert sorted(resp.json["models"]) == ["machine-1", "machine-2"]
+
+
+def test_server_timing_carries_stage_breakdown(client, sensor_payload):
+    resp = client.post(
+        url("machine-1/prediction"), json={"X": sensor_payload["X"]}
+    )
+    assert resp.status_code == 200
+    timing = resp.headers["Server-Timing"]
+    for stage in ("model_resolve", "data_decode", "inference", "serialize"):
+        assert f"{stage};dur=" in timing
+    # reference-parity total stays last, in seconds, under its old name
+    assert timing.rstrip().rpartition(",")[2].strip().startswith(
+        "request_walltime_s;dur="
+    )
+
+
+def test_server_timing_anomaly_route_stages(client, sensor_payload):
+    resp = client.post(
+        url("machine-1/anomaly/prediction"),
+        json={"X": sensor_payload["X"], "y": sensor_payload["y"]},
+    )
+    assert resp.status_code == 200
+    timing = resp.headers["Server-Timing"]
+    for stage in ("model_resolve", "data_decode", "inference", "serialize"):
+        assert f"{stage};dur=" in timing
+
+
+def test_server_timing_on_non_handler_routes_still_present(client):
+    resp = client.get("/healthcheck")
+    assert "Server-Timing" in resp.headers
+    assert "request_walltime_s;dur=" in resp.headers["Server-Timing"]

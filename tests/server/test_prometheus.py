@@ -1,9 +1,18 @@
 """Prometheus request-metrics tests (reference: gordo/server/prometheus/)."""
 
+import gc
+import weakref
+
 from prometheus_client import CollectorRegistry
 from werkzeug.test import Client
 
 from gordo_tpu.server import build_app
+from gordo_tpu.server.prometheus import metrics as prom_metrics
+from gordo_tpu.server.prometheus.metrics import (
+    GordoServerPrometheusMetrics,
+    fleet_build_metrics,
+    fleet_build_robustness_counters,
+)
 from gordo_tpu.server.prometheus.server import build_metrics_app
 
 
@@ -50,3 +59,199 @@ def test_metrics_app_serves_scrape():
     resp = c.get("/metrics")
     assert resp.status_code == 200
     assert c.get("/nope").status_code == 404
+
+
+# -- label-cardinality guards ----------------------------------------------
+
+
+def test_unmatched_scanner_paths_collapse_to_one_label(
+    client, collection_dir, monkeypatch
+):
+    """Paths outside the API shape (scanners, typos) must not mint
+    timeseries: every such request lands on the single ``{unmatched}``
+    path label."""
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", collection_dir)
+    registry = CollectorRegistry()
+    app = build_app(
+        config={"ENABLE_PROMETHEUS": True, "PROJECT": "test-project"},
+        prometheus_registry=registry,
+    )
+    c = Client(app)
+    for path in ("/wp-admin/setup.php", "/.env", "/gordo/nope", "/x" * 50):
+        assert c.get(path).status_code == 404
+    paths = {
+        sample.labels["path"]
+        for metric in registry.collect()
+        for sample in metric.samples
+        if "path" in sample.labels
+    }
+    assert "{unmatched}" in paths
+    # no scanner path ever became a label value
+    assert all(p == "{unmatched}" or p.startswith("/gordo") for p in paths)
+    count = registry.get_sample_value(
+        "gordo_server_requests_total",
+        {
+            "method": "GET",
+            "path": "{unmatched}",
+            "status_code": "404",
+            "gordo_name": "",
+            "project": "test-project",
+        },
+    )
+    assert count == 4
+
+
+def test_revision_ids_collapse_in_path_label(client, collection_dir, monkeypatch):
+    """DELETE revision/<id> paths collapse the numeric id to
+    ``{revision}`` — revisions are unbounded (one per deploy) and must
+    not become label values."""
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", collection_dir)
+    registry = CollectorRegistry()
+    app = build_app(
+        config={"ENABLE_PROMETHEUS": True, "PROJECT": "test-project"},
+        prometheus_registry=registry,
+    )
+    c = Client(app)
+    # the current revision can't be deleted (409) — perfect: the request
+    # is observed without touching the collection
+    resp = c.delete("/gordo/v0/test-project/machine-1/revision/1602324482000")
+    assert resp.status_code == 409
+    count = registry.get_sample_value(
+        "gordo_server_requests_total",
+        {
+            "method": "DELETE",
+            "path": "/gordo/v0/{project}/{name}/revision/{revision}",
+            "status_code": "409",
+            "gordo_name": "machine-1",
+            "project": "test-project",
+        },
+    )
+    assert count == 1
+    assert not any(
+        "1602324482000" in sample.labels.get("path", "")
+        for metric in registry.collect()
+        for sample in metric.samples
+    )
+
+
+def test_multiproc_dir_auto_created_before_first_metric_write(
+    tmp_path, monkeypatch
+):
+    """prometheus_client crashes at first metric write when the mmap dir
+    is missing; both env spellings must be created up front."""
+    for env_name in ("PROMETHEUS_MULTIPROC_DIR", "prometheus_multiproc_dir"):
+        target = tmp_path / env_name / "mp"
+        assert not target.exists()
+        for other in ("PROMETHEUS_MULTIPROC_DIR", "prometheus_multiproc_dir"):
+            monkeypatch.delenv(other, raising=False)
+        monkeypatch.setenv(env_name, str(target))
+        GordoServerPrometheusMetrics(
+            project="p", registry=CollectorRegistry()
+        )
+        assert target.is_dir()
+
+
+# -- build-metric registry bookkeeping -------------------------------------
+
+
+def test_build_metrics_keyed_by_live_registry_not_id():
+    """The per-registry metric cache must hold the registry itself (weak
+    key), not ``id(registry)``: a GC'd registry can hand its id to a new
+    one, which would then silently receive stale Counter objects that
+    its scrapes never see."""
+    r1 = CollectorRegistry()
+    c1 = fleet_build_robustness_counters(r1)
+    c1["fleet_retries"].labels(project="p").inc()
+    assert (
+        r1.get_sample_value(
+            "gordo_fleet_build_member_retries_total", {"project": "p"}
+        )
+        == 1
+    )
+    # stable per live registry (the subset dict is rebuilt per call but
+    # the metric objects are the cached ones)
+    assert (
+        fleet_build_robustness_counters(r1)["fleet_retries"]
+        is c1["fleet_retries"]
+    )
+    # the cache must not keep dead registries (or their metrics) alive
+    ref = weakref.ref(r1)
+    del r1, c1
+    gc.collect()
+    assert ref() is None
+    # a fresh registry always gets fresh metrics registered to IT: its
+    # scrape sees the increments (the id-reuse bug left them invisible)
+    r2 = CollectorRegistry()
+    c2 = fleet_build_robustness_counters(r2)
+    c2["fleet_retries"].labels(project="p").inc(3)
+    assert (
+        r2.get_sample_value(
+            "gordo_fleet_build_member_retries_total", {"project": "p"}
+        )
+        == 3
+    )
+
+
+def test_fleet_build_metric_set_complete():
+    registry = CollectorRegistry()
+    metrics = fleet_build_metrics(registry)
+    metrics["phase_duration"].labels(project="p", phase="dump").observe(0.5)
+    metrics["compile_duration"].labels(
+        project="p", program="fleet_fit", shape="(2, 128, 4)"
+    ).observe(1.5)
+    metrics["member_final_loss"].labels(project="p").observe(0.01)
+    metrics["machines_total"].labels(project="p").set(10)
+    metrics["machines_completed"].labels(project="p").set(4)
+    metrics["machines_failed"].labels(project="p").set(1)
+    assert (
+        registry.get_sample_value(
+            "gordo_fleet_build_phase_duration_seconds_count",
+            {"project": "p", "phase": "dump"},
+        )
+        == 1
+    )
+    assert (
+        registry.get_sample_value(
+            "gordo_fleet_compile_duration_seconds_count",
+            {"project": "p", "program": "fleet_fit", "shape": "(2, 128, 4)"},
+        )
+        == 1
+    )
+    assert (
+        registry.get_sample_value(
+            "gordo_fleet_member_final_loss_count", {"project": "p"}
+        )
+        == 1
+    )
+    assert (
+        registry.get_sample_value(
+            "gordo_fleet_build_machines_completed", {"project": "p"}
+        )
+        == 4
+    )
+
+
+def test_record_helpers_hit_default_registry():
+    """The record_* helpers FleetBuilder's telemetry listener calls
+    land in the default REGISTRY under the caller's project label."""
+    from prometheus_client import REGISTRY
+
+    prom_metrics.record_fleet_build_phase("helper-proj", "cv_train", 2.0)
+    prom_metrics.record_fleet_compile(
+        "helper-proj", "fleet_fit", "(1, 64, 2)", 0.2
+    )
+    prom_metrics.record_member_final_loss("helper-proj", 0.5)
+    prom_metrics.set_fleet_build_progress("helper-proj", 5, 2, 1)
+    assert (
+        REGISTRY.get_sample_value(
+            "gordo_fleet_build_phase_duration_seconds_count",
+            {"project": "helper-proj", "phase": "cv_train"},
+        )
+        >= 1
+    )
+    assert (
+        REGISTRY.get_sample_value(
+            "gordo_fleet_build_machines_total", {"project": "helper-proj"}
+        )
+        == 5
+    )

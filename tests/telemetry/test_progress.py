@@ -1,0 +1,161 @@
+"""BuildProgress heartbeat + build-status rendering coverage."""
+
+import json
+import os
+
+import pytest
+
+from gordo_tpu.telemetry import (
+    BuildProgress,
+    eta_seconds,
+    load_status,
+    render_status,
+)
+from gordo_tpu.telemetry.progress import BUILD_STATUS_FILE
+
+pytestmark = pytest.mark.observability
+
+
+def test_heartbeat_writes_atomic_document(tmp_path):
+    progress = BuildProgress(
+        str(tmp_path), project="p", total=4, heartbeat_seconds=0
+    )
+    progress.phase("plan")
+    progress.machine_completed("m-1")
+    doc = load_status(str(tmp_path))
+    assert doc["project"] == "p"
+    assert doc["state"] == "running"
+    assert doc["phase"] == "plan"
+    assert doc["machines"]["total"] == 4
+    assert doc["machines"]["completed"] == 1
+    # no staging leftovers from the atomic replace
+    assert sorted(os.listdir(tmp_path)) == [BUILD_STATUS_FILE]
+
+
+def test_default_heartbeat_is_throttled(tmp_path, monkeypatch):
+    """The env-default throttle bounds status writes to ~2/s at ANY
+    fleet size — per-completion writes would tax small builds for
+    durability the journal already provides exactly."""
+    monkeypatch.delenv("GORDO_TPU_TELEMETRY_HEARTBEAT", raising=False)
+    progress = BuildProgress(str(tmp_path), project="p", total=100)
+    assert progress.heartbeat_seconds == 0.5
+    progress.phase("dump")  # forced
+    for i in range(50):
+        progress.machine_completed(f"m-{i}")  # throttled away
+    assert load_status(str(tmp_path))["machines"]["completed"] == 0
+    monkeypatch.setenv("GORDO_TPU_TELEMETRY_HEARTBEAT", "0")
+    assert BuildProgress(str(tmp_path), total=1).heartbeat_seconds == 0.0
+
+
+def test_phase_table_tracks_running_and_done(tmp_path):
+    seconds = {}
+    progress = BuildProgress(
+        str(tmp_path), project="p", total=2, phase_seconds=seconds
+    )
+    progress.phase("plan")
+    seconds["plan"] = 0.5
+    progress.phase("dump")
+    doc = load_status(str(tmp_path))
+    assert doc["phases"]["plan"] == {"seconds": 0.5, "status": "done"}
+    assert doc["phases"]["dump"]["status"] == "running"
+
+
+def test_finish_states(tmp_path):
+    progress = BuildProgress(str(tmp_path), project="p", total=1)
+    progress.machine_completed("m")
+    progress.finish("complete")
+    doc = load_status(str(tmp_path))
+    assert doc["state"] == "complete" and doc["phase"] is None
+
+    progress2 = BuildProgress(str(tmp_path), project="p", total=1)
+    progress2.machine_failed("m")
+    progress2.finish("failed")
+    assert load_status(str(tmp_path))["state"] == "failed"
+
+
+def test_heartbeat_throttle_skips_midstream_writes(tmp_path):
+    progress = BuildProgress(
+        str(tmp_path), project="p", total=10, heartbeat_seconds=3600.0
+    )
+    progress.phase("dump")  # forced write
+    first = (tmp_path / BUILD_STATUS_FILE).read_text()
+    progress.machine_completed("m-1")  # throttled away
+    assert (tmp_path / BUILD_STATUS_FILE).read_text() == first
+    progress.finish("complete")  # forced
+    assert load_status(str(tmp_path))["machines"]["completed"] == 1
+
+
+def test_concurrent_completions_never_tear_the_document(tmp_path):
+    """The dump pool reports completions from 8 threads with the
+    fault-drill heartbeat (0 = write every completion); the shared
+    pid-named tmp path must be serialized or a sibling's open() truncates
+    an in-flight write and renames torn JSON into the status file."""
+    import concurrent.futures
+
+    progress = BuildProgress(
+        str(tmp_path), project="p", total=64, heartbeat_seconds=0
+    )
+    pool = concurrent.futures.ThreadPoolExecutor(8)
+    try:
+        list(pool.map(progress.machine_completed, [f"m-{i}" for i in range(64)]))
+    finally:
+        pool.shutdown(wait=True)
+    doc = load_status(str(tmp_path))
+    assert doc is not None, "torn/unparseable build_status.json"
+    assert doc["machines"]["completed"] == 64
+
+
+def test_no_output_dir_counts_without_writing():
+    progress = BuildProgress(None, project="p", total=3)
+    progress.phase("plan")
+    progress.machine_completed("m")
+    assert progress.completed == 1
+    assert progress.document()["machines"]["completed"] == 1
+
+
+def test_unreadable_or_missing_status_is_none(tmp_path):
+    assert load_status(str(tmp_path)) is None
+    (tmp_path / BUILD_STATUS_FILE).write_text("{torn")
+    assert load_status(str(tmp_path)) is None
+    (tmp_path / BUILD_STATUS_FILE).write_text(json.dumps([1, 2]))
+    assert load_status(str(tmp_path)) is None
+
+
+def test_eta_from_completed_machine_rate():
+    doc = {
+        "state": "running",
+        "elapsed_sec": 100.0,
+        "machines": {"total": 10, "completed": 4, "resumed": 1, "failed": 1},
+    }
+    # 4 remaining at 25s/machine
+    assert eta_seconds(doc) == pytest.approx(100.0)
+    doc["machines"]["completed"] = 0
+    assert eta_seconds(doc) is None
+    doc["machines"].update(completed=8, resumed=1, failed=1)
+    assert eta_seconds(doc) == 0.0
+    assert eta_seconds({**doc, "state": "complete"}) is None
+
+
+def test_render_status_covers_counts_phases_and_eta(tmp_path):
+    seconds = {"plan": 0.25, "dump": 1.5}
+    progress = BuildProgress(
+        str(tmp_path),
+        project="render-p",
+        total=8,
+        phase_seconds=seconds,
+        heartbeat_seconds=0,
+    )
+    progress.phase("plan")
+    progress.phase("dump")
+    for i in range(3):
+        progress.machine_completed(f"m-{i}")
+    progress.machine_failed("m-x")
+    text = render_status(load_status(str(tmp_path)))
+    assert "render-p" in text
+    assert "running (phase: dump)" in text
+    assert "3/8 done" in text and "1 failed" in text
+    assert "ETA" in text
+    assert "plan" in text and "1.50" in text
+    # finished builds render without an ETA
+    progress.finish("complete")
+    assert "ETA" not in render_status(load_status(str(tmp_path)))

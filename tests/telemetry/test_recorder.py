@@ -1,0 +1,200 @@
+"""Span recorder unit coverage: nesting, thread safety, the JSONL sink,
+listeners, compile/run program attribution, and env gating."""
+
+import json
+import threading
+
+import pytest
+
+from gordo_tpu import telemetry
+from gordo_tpu.telemetry import (
+    NULL_RECORDER,
+    SpanRecorder,
+    activate,
+    enabled,
+    get_recorder,
+    program_span,
+)
+
+pytestmark = pytest.mark.observability
+
+
+def test_span_records_duration_attributes_and_status():
+    rec = SpanRecorder()
+    with rec.span("work", machines=3) as handle:
+        handle.set(found=7)
+    (span,) = rec.finished("work")
+    assert span["attributes"] == {"machines": 3, "found": 7}
+    assert span["status"]["status_code"] == "OK"
+    assert span["duration_ms"] >= 0
+    assert span["context"]["trace_id"] == rec.trace_id
+    assert span["parent_id"] is None
+    assert span["kind"] == "internal"
+
+
+def test_nested_spans_carry_parent_ids():
+    rec = SpanRecorder()
+    with rec.span("outer"):
+        with rec.span("inner"):
+            rec.event("marker", n=1)
+    marker, inner, outer = rec.finished()
+    assert outer["name"] == "outer" and outer["parent_id"] is None
+    assert inner["parent_id"] == outer["context"]["span_id"]
+    assert marker["parent_id"] == inner["context"]["span_id"]
+    assert marker["kind"] == "event" and marker["duration_ms"] == 0
+
+
+def test_exception_marks_span_error_and_propagates():
+    rec = SpanRecorder()
+    with pytest.raises(ValueError):
+        with rec.span("doomed"):
+            raise ValueError("boom")
+    (span,) = rec.finished("doomed")
+    assert span["status"]["status_code"] == "ERROR"
+    assert "boom" in span["status"]["description"]
+
+
+def test_jsonl_sink_is_line_per_span_and_durable(tmp_path):
+    sink = tmp_path / "trace.jsonl"
+    rec = SpanRecorder(sink_path=str(sink))
+    with rec.span("a"):
+        pass
+    # durable the instant the span closes, before close()
+    lines = sink.read_text().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["name"] == "a"
+    rec.event("b")
+    rec.close()
+    assert [json.loads(l)["name"] for l in sink.read_text().splitlines()] == [
+        "a",
+        "b",
+    ]
+
+
+def test_sink_failure_never_raises(tmp_path):
+    rec = SpanRecorder(
+        sink_path=str(tmp_path / "nodir" / "x.jsonl"), retain_spans=True
+    )
+    with rec.span("still-works"):
+        pass
+    assert rec.finished("still-works")
+
+
+def test_sink_backed_recorders_do_not_retain_by_default(tmp_path):
+    """A build recorder's span stream is unbounded (hours of chunked CV
+    phases and per-machine events); with a sink configured the JSONL
+    file is the record and memory must stay flat."""
+    sink = tmp_path / "t.jsonl"
+    rec = SpanRecorder(sink_path=str(sink))
+    assert not rec.retain_spans
+    with rec.span("a"):
+        pass
+    assert rec.finished() == []
+    assert json.loads(sink.read_text())["name"] == "a"
+    # in-memory recorders (the server's per-request timing) retain
+    assert SpanRecorder().retain_spans
+
+
+def test_thread_spans_are_independent_roots():
+    rec = SpanRecorder()
+    results = []
+
+    def worker(i):
+        with rec.span("threaded", worker=i):
+            results.append(i)
+
+    with rec.span("main"):
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    threaded = rec.finished("threaded")
+    assert len(threaded) == 4
+    # pool threads do not run inside the main thread's span
+    assert all(s["parent_id"] is None for s in threaded)
+
+
+def test_listeners_called_per_span_and_never_fail_recording():
+    rec = SpanRecorder()
+    seen = []
+    rec.add_listener(lambda s: seen.append(s["name"]))
+    rec.add_listener(lambda s: 1 / 0)  # a broken listener is swallowed
+    with rec.span("x"):
+        pass
+    rec.event("y")
+    assert seen == ["x", "y"]
+    assert len(rec.finished()) == 2
+
+
+def test_durations_sum_per_name_in_first_seen_order():
+    rec = SpanRecorder()
+    for _ in range(2):
+        with rec.span("alpha"):
+            pass
+    with rec.span("beta"):
+        pass
+    durations = rec.durations()
+    assert list(durations) == ["alpha", "beta"]
+    assert durations["alpha"] >= 0
+
+
+def test_activate_scopes_the_global_recorder():
+    rec = SpanRecorder()
+    assert get_recorder() is NULL_RECORDER
+    with activate(rec):
+        assert get_recorder() is rec
+        with get_recorder().span("inside"):
+            pass
+    assert get_recorder() is NULL_RECORDER
+    assert rec.finished("inside")
+
+
+def test_null_recorder_is_inert():
+    with NULL_RECORDER.span("nope", a=1) as handle:
+        handle.set(b=2)
+    NULL_RECORDER.event("nope")
+    assert NULL_RECORDER.finished() == []
+    assert NULL_RECORDER.durations() == {}
+    assert not NULL_RECORDER.enabled
+
+
+def test_program_span_first_call_is_compile_then_run():
+    telemetry.reset_seen_programs()
+    rec = SpanRecorder()
+    with activate(rec):
+        with program_span("prog", ("spec", (8, 4)), members=2):
+            pass
+        with program_span("prog", ("spec", (8, 4)), members=2):
+            pass
+        with program_span("prog", ("spec", (16, 4))):  # new shape → compile
+            pass
+    flags = [
+        (s["attributes"]["program"], s["attributes"]["compile"])
+        for s in rec.finished("device_program")
+    ]
+    assert flags == [("prog", True), ("prog", False), ("prog", True)]
+
+
+def test_program_registration_survives_inactive_recorder():
+    """A program compiled while no recorder is active must still count
+    as seen — a later traced call with the same signature is a cache
+    hit, not a compile."""
+    telemetry.reset_seen_programs()
+    with program_span("p2", "sig"):
+        pass  # NULL recorder active: nothing recorded, but registered
+    rec = SpanRecorder()
+    with activate(rec):
+        with program_span("p2", "sig"):
+            pass
+    (span,) = rec.finished("device_program")
+    assert span["attributes"]["compile"] is False
+
+
+def test_enabled_env_gate(monkeypatch):
+    monkeypatch.delenv(telemetry.TELEMETRY_ENV, raising=False)
+    assert enabled()
+    for value in ("0", "false", "off", "no", "False", " OFF "):
+        monkeypatch.setenv(telemetry.TELEMETRY_ENV, value)
+        assert not enabled()
+    monkeypatch.setenv(telemetry.TELEMETRY_ENV, "1")
+    assert enabled()
