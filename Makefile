@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability docs bench bench-telemetry lint image
+.PHONY: test test-fast test-faults test-observability test-serve docs bench bench-telemetry bench-serve lint image
 
 test:
 	python -m pytest tests/ -q
@@ -16,6 +16,17 @@ test-faults:
 # slow-marked, so the same tests also run inside the tier-1 budget.
 test-observability:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m observability
+
+# The micro-batching serving suite: flush policy, shape ladder, warmup,
+# admission control, batched-vs-unbatched equivalence — CPU-only and not
+# slow-marked, so the same tests also run inside the tier-1 budget.
+test-serve:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serve
+
+# Serving micro-batching benchmark: concurrent single-model requests
+# with batching off vs on; writes BENCH_SERVE.json.
+bench-serve:
+	JAX_PLATFORMS=cpu python benchmarks/bench_serve.py
 
 # Telemetry-overhead microbench: a small CPU fleet build with telemetry
 # off vs on; writes BENCH_TELEMETRY.json for the bench trajectory.

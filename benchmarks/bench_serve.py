@@ -1,0 +1,320 @@
+"""
+Serving micro-batching benchmark: concurrent single-model requests,
+batching off vs on.
+
+Two layers are measured:
+
+- **scoring** (the headline): concurrent threads scoring single-model
+  requests — batching off calls each model's own ``predict`` (one device
+  program per request, the pre-batching serving path); batching on goes
+  through ``ServeEngine.batched_predict`` (requests coalesce into fused
+  ``fleet_forward_gather`` programs). This is the layer the micro-batcher
+  operates on, where its effect is visible: the same traffic answered
+  with ~``max_size``x fewer device programs. The regime is OVERLOAD
+  (client threads >> host cores — the regime batching exists for): the
+  per-request fixed cost (python glue + jit dispatch + transfers,
+  ~0.8ms/request on this host) is paid once per fused batch instead of
+  once per request, and parked batch waiters don't fight the scoring
+  path for the GIL the way actively-dispatching unbatched threads do.
+- **route** (context): the same comparison through the full WSGI
+  ``prediction`` route. Each request pays identical JSON/pandas host work
+  in BOTH modes (GIL-bound, per-request, unamortizable in one process),
+  which on CPU swamps the device-side difference — reported for honesty,
+  not gated. Production deployments parallelize that host work across
+  gunicorn workers while the device stays shared, which is exactly the
+  regime batching exists for.
+
+Shared CI hosts show multi-x wall-clock noise, so per-mode reps are
+interleaved and the headline compares QUIET-WINDOW FLOORS (best rep per
+mode) — the estimator whose noise is one-sided; medians ride along (same
+methodology as bench_telemetry.py).
+
+Writes ``BENCH_SERVE.json`` at the repo root (the committed bench
+convention). Run: ``JAX_PLATFORMS=cpu python benchmarks/bench_serve.py``
+(or ``make bench-serve``). Not run in CI, like the rest of benchmarks/ —
+``tests/serve`` asserts the mechanism (numerical equivalence, program
+bound, backpressure) and this script's harness stays importable.
+"""
+
+import datetime
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import threading
+import time
+import warnings
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+warnings.filterwarnings("ignore", category=UserWarning)
+
+#: enough same-spec models that concurrent traffic actually co-batches,
+#: small enough that the one-time build stays tens of seconds
+N_MODELS = 8
+N_TAGS = 12
+ROWS = 256  # rows per request — an exact row-ladder rung (no padding)
+THREADS = 64  # >> host cores: the overload regime batching exists for
+REQUESTS_PER_THREAD = 20
+BATCH_MAX_SIZE = 32
+BATCH_MAX_DELAY_MS = 20.0
+#: interleaved reps; the headline is per-mode best (quiet-window floor)
+REPS = 7
+ROUTE_THREADS = 16  # the route layer is ~10x slower/request
+ROUTE_REQUESTS_PER_THREAD = 8
+
+REVISION = "1700000000000"
+
+MACHINE_YAML = """  - name: bench-{i}
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-02T00:00:00+00:00"
+      tag_list: [{tags}]
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.JaxAutoEncoder:
+            kind: feedforward_model
+            encoding_dim: [256, 128]
+            encoding_func: [tanh, tanh]
+            decoding_dim: [128, 256]
+            decoding_func: [tanh, tanh]
+            epochs: 1
+"""
+
+
+def build_collection(root: str) -> str:
+    from gordo_tpu import serializer
+    from gordo_tpu.builder import local_build
+
+    tags = ", ".join(f"tag-{j}" for j in range(1, N_TAGS + 1))
+    config = "machines:\n" + "".join(
+        MACHINE_YAML.format(i=i, tags=tags) for i in range(N_MODELS)
+    )
+    collection_dir = os.path.join(root, REVISION)
+    for model, machine in local_build(config, project_name="bench-serve"):
+        serializer.dump(
+            model,
+            os.path.join(collection_dir, machine.name),
+            metadata=machine.to_dict(),
+        )
+    return collection_dir
+
+
+def traffic(score_one, threads: int, per_thread: int) -> dict:
+    """One concurrent burst: ``threads`` clients, round-robin over the
+    models, timing every request."""
+    latencies = []
+    lock = threading.Lock()
+
+    def worker(worker_id: int):
+        mine = []
+        for r in range(per_thread):
+            name = f"bench-{(worker_id + r) % N_MODELS}"
+            begin = time.perf_counter()
+            score_one(name)
+            mine.append(time.perf_counter() - begin)
+        with lock:
+            latencies.extend(mine)
+
+    pool = [threading.Thread(target=worker, args=(i,)) for i in range(threads)]
+    wall_start = time.perf_counter()
+    for thread in pool:
+        thread.start()
+    for thread in pool:
+        thread.join()
+    wall = time.perf_counter() - wall_start
+
+    total = threads * per_thread
+    latencies.sort()
+    return {
+        "requests": total,
+        "wall_sec": round(wall, 4),
+        "throughput_rps": round(total / wall, 2),
+        "p50_ms": round(statistics.median(latencies) * 1000.0, 3),
+        "p99_ms": round(latencies[int(len(latencies) * 0.99) - 1] * 1000.0, 3),
+    }
+
+
+def interleaved_floors(run_off, run_on, reps: int) -> dict:
+    """Alternate the modes, keep each mode's best rep (quiet-window
+    floor) and rep medians for context."""
+    runs = {"batching_off": [], "batching_on": []}
+    for rep in range(reps):
+        order = (
+            [("batching_off", run_off), ("batching_on", run_on)]
+            if rep % 2 == 0
+            else [("batching_on", run_on), ("batching_off", run_off)]
+        )
+        for mode, run in order:
+            runs[mode].append(run())
+    out = {}
+    for mode, results in runs.items():
+        best = max(results, key=lambda r: r["throughput_rps"])
+        out[mode] = dict(
+            best,
+            median_throughput_rps=round(
+                statistics.median(r["throughput_rps"] for r in results), 2
+            ),
+            throughput_rps_runs=[r["throughput_rps"] for r in results],
+        )
+    return out
+
+
+def main() -> dict:
+    import numpy as np
+
+    from gordo_tpu import serve
+    from gordo_tpu.serve import ServeConfig, ServeEngine
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server.fleet_store import STORE
+
+    root = tempfile.mkdtemp(prefix="bench-serve-")
+    try:
+        collection_dir = build_collection(root)
+        fleet = STORE.fleet(collection_dir)
+        fleet.warm()
+        models = {
+            f"bench-{i}": fleet.model(f"bench-{i}") for i in range(N_MODELS)
+        }
+        X = np.random.RandomState(0).rand(ROWS, N_TAGS).astype(np.float32)
+
+        config = ServeConfig(
+            max_size=BATCH_MAX_SIZE,
+            max_delay_ms=BATCH_MAX_DELAY_MS,
+            queue_depth=4096,
+            deadline_ms=60000.0,
+            row_ladder=(ROWS, ROWS * 4),
+            # on a CPU host the dispatcher thread serializing the fused
+            # programs beats inline leader-flush (concurrent leaders'
+            # programs thrash the small core count); TPU serving keeps
+            # the default
+            inline_flush=False,
+        )
+        ladder_bound = len(serve.member_ladder(config.max_size)) * len(
+            config.row_ladder
+        )
+        engine = ServeEngine(config)
+        serve.install_engine(engine)
+        warmup = engine.warmup_fleet(fleet)
+
+        def score_unbatched(name: str):
+            np.asarray(models[name].predict(X))
+
+        def score_batched(name: str):
+            engine.batched_predict(collection_dir, name, models[name], X)
+
+        # warm both paths out of the timed region (compiles, lazy loads)
+        traffic(score_unbatched, THREADS, 4)
+        traffic(score_batched, THREADS, 4)
+
+        batches_before = engine.stats()["batches"]
+        scoring = interleaved_floors(
+            lambda: traffic(score_unbatched, THREADS, REQUESTS_PER_THREAD),
+            lambda: traffic(score_batched, THREADS, REQUESTS_PER_THREAD),
+            REPS,
+        )
+        on_requests = scoring["batching_on"]["requests"] * REPS
+        on_batches = engine.stats()["batches"] - batches_before
+        scoring["batching_off"]["device_programs_launched"] = scoring[
+            "batching_off"
+        ]["requests"]  # one program per request, by construction
+        scoring["batching_on"]["device_programs_launched_all_reps"] = on_batches
+        scoring["batching_on"]["coalesce_ratio"] = round(
+            on_requests / max(1, on_batches), 2
+        )
+
+        # context: the same traffic through the full WSGI route (both
+        # modes pay identical per-request JSON/pandas host work)
+        from werkzeug.test import Client
+
+        os.environ["MODEL_COLLECTION_DIR"] = collection_dir
+        os.environ["GORDO_TPU_SERVE_WARMUP"] = "0"
+        app = build_app(config={})
+        index = [
+            f"2020-03-{d:02d}T{h:02d}:{m:02d}:00+00:00"
+            for d in range(1, 3)
+            for h in range(24)
+            for m in range(60)
+        ][:ROWS]
+        payload = {
+            "X": {
+                f"tag-{i}": {ts: 0.1 * i + 0.001 * j for j, ts in enumerate(index)}
+                for i in range(1, N_TAGS + 1)
+            }
+        }
+
+        def route_request(name: str):
+            resp = Client(app).post(
+                f"/gordo/v0/bench-serve/{name}/prediction", json=payload
+            )
+            assert resp.status_code == 200, (name, resp.status_code)
+
+        def route_off():
+            serve.install_engine(None)
+            try:
+                return traffic(
+                    route_request, ROUTE_THREADS, ROUTE_REQUESTS_PER_THREAD
+                )
+            finally:
+                serve.install_engine(engine)
+
+        traffic(route_request, ROUTE_THREADS, 2)  # warm the route path
+        route = interleaved_floors(
+            route_off,
+            lambda: traffic(
+                route_request, ROUTE_THREADS, ROUTE_REQUESTS_PER_THREAD
+            ),
+            3,
+        )
+
+        stats = engine.stats()
+        serve.install_engine(None)
+        engine.shutdown(drain=True)
+        STORE.clear()
+
+        off, on = scoring["batching_off"], scoring["batching_on"]
+        doc = {
+            "bench": "serve-micro-batching",
+            "timestamp": datetime.datetime.now(
+                datetime.timezone.utc
+            ).isoformat(),
+            "models": N_MODELS,
+            "tags": N_TAGS,
+            "rows_per_request": ROWS,
+            "threads": THREADS,
+            "requests_per_rep": THREADS * REQUESTS_PER_THREAD,
+            "reps": REPS,
+            "batch_max_size": config.max_size,
+            "batch_max_delay_ms": config.max_delay_s * 1000.0,
+            "scoring": scoring,
+            "throughput_gain": round(
+                on["throughput_rps"] / off["throughput_rps"], 3
+            ),
+            "batching_on_beats_off": on["throughput_rps"]
+            > off["throughput_rps"],
+            "full_route_context": route,
+            "warmup": warmup,
+            "compiled_programs": stats["programs"],
+            "ladder_bound_per_spec": ladder_bound,
+            "programs_bounded": stats["programs"] <= ladder_bound,
+        }
+        out_path = REPO_ROOT / "BENCH_SERVE.json"
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        print(f"\nwrote {out_path}")
+        return doc
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

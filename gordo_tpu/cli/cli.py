@@ -17,6 +17,7 @@ planted fault code, deliberately not reproduced — SURVEY.md preamble.)
 
 import json
 import logging
+import os
 import sys
 import traceback
 from typing import Any, List, Optional, Tuple, cast
@@ -310,6 +311,53 @@ def get_all_score_strings(machine) -> List[str]:
     help="Run with custom config for prometheus",
     is_flag=True,
 )
+@click.option(
+    "--batching/--no-batching",
+    default=None,
+    help="Coalesce concurrent single-model requests into fused fleet "
+    "programs (gordo_tpu.serve). Overrides GORDO_TPU_BATCHING; the "
+    "default leaves the env switch (default: off) in charge.",
+)
+@click.option(
+    "--batch-max-size",
+    type=click.IntRange(1, 4096),
+    default=None,
+    help="Requests per fused batch before an immediate flush "
+    "[GORDO_TPU_BATCH_MAX_SIZE, default 32].",
+)
+@click.option(
+    "--batch-max-delay-ms",
+    type=click.FloatRange(0.0, 60000.0),
+    default=None,
+    help="Longest a request waits for co-batchable traffic "
+    "[GORDO_TPU_BATCH_MAX_DELAY_MS, default 5].",
+)
+@click.option(
+    "--batch-queue-depth",
+    type=click.IntRange(1, 1 << 20),
+    default=None,
+    help="Queued requests before admission control answers 429 "
+    "[GORDO_TPU_BATCH_QUEUE_DEPTH, default 512].",
+)
+@click.option(
+    "--batch-deadline-ms",
+    type=click.FloatRange(1.0, 600000.0),
+    default=None,
+    help="Per-request batching deadline before a 504 "
+    "[GORDO_TPU_BATCH_DEADLINE_MS, default 2000].",
+)
+@click.option(
+    "--batch-row-ladder",
+    default=None,
+    help="Comma-separated row-padding rungs bounding the jit cache "
+    "[GORDO_TPU_BATCH_ROW_LADDER, default 32,128,512,2048,8192].",
+)
+@click.option(
+    "--serve-warmup/--no-serve-warmup",
+    default=None,
+    help="Precompile each served bucket's ladder programs at startup "
+    "[GORDO_TPU_SERVE_WARMUP, default on when batching is on].",
+)
 def run_server_cli(
     host,
     port,
@@ -320,8 +368,28 @@ def run_server_cli(
     log_level,
     server_app,
     with_prometheus_config,
+    batching,
+    batch_max_size,
+    batch_max_delay_ms,
+    batch_queue_depth,
+    batch_deadline_ms,
+    batch_row_ladder,
+    serve_warmup,
 ):
     """Run the model server."""
+    # Batching knobs travel as env vars — that is how they reach the
+    # gunicorn worker processes (and the werkzeug fallback alike).
+    for env_name, value in (
+        ("GORDO_TPU_BATCHING", None if batching is None else int(batching)),
+        ("GORDO_TPU_BATCH_MAX_SIZE", batch_max_size),
+        ("GORDO_TPU_BATCH_MAX_DELAY_MS", batch_max_delay_ms),
+        ("GORDO_TPU_BATCH_QUEUE_DEPTH", batch_queue_depth),
+        ("GORDO_TPU_BATCH_DEADLINE_MS", batch_deadline_ms),
+        ("GORDO_TPU_BATCH_ROW_LADDER", batch_row_ladder),
+        ("GORDO_TPU_SERVE_WARMUP", None if serve_warmup is None else int(serve_warmup)),
+    ):
+        if value is not None:
+            os.environ[env_name] = str(value)
     config_module = None
     if with_prometheus_config:
         config_module = "gordo_tpu.server.prometheus.gunicorn_config"

@@ -1,0 +1,54 @@
+"""
+Cross-request micro-batching for the model server.
+
+Thousands of concurrent single-model predict/anomaly requests each
+launching their own tiny device program leave the accelerator idle
+between launches. This package coalesces them: requests enqueue keyed by
+``(revision, spec bucket)``, a dispatcher drains the queue under an
+adaptive flush policy, and every drained batch runs as ONE fused
+``fleet_forward`` program — with shape-ladder padding (bounded jit
+cache), startup warmup, and admission control (429/504 backpressure).
+
+Master switch: ``GORDO_TPU_BATCHING`` (default off — the unbatched
+per-request path is the fallback and the default). See
+``docs/serving.md`` for the full knob catalog.
+"""
+
+from .batcher import (
+    BatcherStopped,
+    BatchItem,
+    BatchShedError,
+    DeadlineExceeded,
+    MicroBatcher,
+    QueueFullError,
+)
+from .engine import (
+    ServeConfig,
+    ServeEngine,
+    batching_enabled,
+    ensure_engine,
+    get_engine,
+    install_engine,
+    reset_engine,
+)
+from .ladder import member_ladder, pad_to, parse_ladder, row_ladder
+
+__all__ = [
+    "BatchItem",
+    "BatchShedError",
+    "BatcherStopped",
+    "DeadlineExceeded",
+    "MicroBatcher",
+    "QueueFullError",
+    "ServeConfig",
+    "ServeEngine",
+    "batching_enabled",
+    "ensure_engine",
+    "get_engine",
+    "install_engine",
+    "member_ladder",
+    "pad_to",
+    "parse_ladder",
+    "reset_engine",
+    "row_ladder",
+]

@@ -1,0 +1,523 @@
+"""
+The serving engine: fused scoring of coalesced single-model requests.
+
+:class:`ServeEngine` owns a :class:`~gordo_tpu.serve.batcher.MicroBatcher`
+keyed by ``(revision fleet, spec)`` and turns each drained batch into ONE
+fused ``fleet_forward`` device program (the same program the fleet route
+and the Pallas kernel serve):
+
+- **stack**: gather the batch members' rows from the revision's resident
+  stacked parameter bucket (``RevisionFleet.spec_bucket``) and pad the
+  member axis up a power-of-two ladder (``ladder.py``) so the jit cache
+  stays bounded. The ROW axis is padded on the *request* thread (each
+  payload lands in the queue already at its row-ladder rung, and the
+  batch key includes the rung): request threads are idle waiters anyway,
+  while every Python-level op on the dispatcher thread is a GIL handoff
+  opportunity against hundreds of active clients — under overload a
+  per-item dispatcher padding loop measures tens of ms per batch, a
+  single ``np.stack`` does not;
+- **device**: run the fused program once for the whole batch;
+- **scatter**: slice each member's rows back out and resolve its future.
+
+Requests the engine cannot batch (non-feedforward models, row counts
+above the ladder, a draining batcher) return ``None`` from
+:func:`ServeEngine.batched_predict` and the caller falls back to the
+unbatched path — batching is an optimization, never a gate.
+
+A process-global engine (:func:`ensure_engine` / :func:`get_engine`)
+mirrors the fleet store's module-global pattern: gunicorn gthread workers
+share one engine per process. The master switch is ``GORDO_TPU_BATCHING``
+(default OFF — existing single-program-per-request behavior is the
+fallback and the default).
+"""
+
+import atexit
+import logging
+import os
+import threading
+import time
+from concurrent.futures import CancelledError
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.spec import FeedForwardSpec
+from ..telemetry import NULL_RECORDER, SpanRecorder
+from ..telemetry import enabled as telemetry_enabled
+from ..telemetry.recorder import TRACE_DIR_ENV
+from ..utils.env import env_float, env_int
+from . import ladder
+from .batcher import BatcherStopped, BatchItem, DeadlineExceeded, MicroBatcher
+
+logger = logging.getLogger(__name__)
+
+BATCHING_ENV = "GORDO_TPU_BATCHING"
+
+#: the JSONL the engine's batch spans append to (build_trace-style),
+#: under ``GORDO_TPU_TELEMETRY_DIR`` when telemetry is enabled
+SERVE_TRACE_FILE = "serve_trace.jsonl"
+
+
+def batching_enabled() -> bool:
+    """Master switch: batching is opt-in (``GORDO_TPU_BATCHING=1``)."""
+    return os.getenv(BATCHING_ENV, "0").strip().lower() in ("1", "true", "on", "yes")
+
+
+class ServeConfig:
+    """Engine knobs, resolved once from the environment at creation."""
+
+    __slots__ = (
+        "max_size",
+        "max_delay_s",
+        "queue_depth",
+        "pressure_depth",
+        "deadline_s",
+        "dispatchers",
+        "row_ladder",
+        "warmup_max_rows",
+        "inline_flush",
+    )
+
+    def __init__(
+        self,
+        max_size: int = 32,
+        max_delay_ms: float = 5.0,
+        queue_depth: int = 512,
+        pressure_depth: Optional[int] = None,
+        deadline_ms: float = 2000.0,
+        dispatchers: int = 1,
+        row_ladder: Optional[Tuple[int, ...]] = None,
+        warmup_max_rows: int = 512,
+        inline_flush: bool = True,
+    ):
+        self.max_size = max(1, int(max_size))
+        self.max_delay_s = max(0.0, float(max_delay_ms) / 1000.0)
+        self.queue_depth = max(1, int(queue_depth))
+        self.pressure_depth = pressure_depth
+        self.deadline_s = max(0.001, float(deadline_ms) / 1000.0)
+        self.dispatchers = max(1, int(dispatchers))
+        self.row_ladder = (
+            tuple(row_ladder) if row_ladder is not None else ladder.row_ladder()
+        )
+        self.warmup_max_rows = int(warmup_max_rows)
+        self.inline_flush = bool(inline_flush)
+
+    @classmethod
+    def from_env(cls) -> "ServeConfig":
+        return cls(
+            max_size=env_int("GORDO_TPU_BATCH_MAX_SIZE", 32),
+            max_delay_ms=env_float("GORDO_TPU_BATCH_MAX_DELAY_MS", 5.0),
+            queue_depth=env_int("GORDO_TPU_BATCH_QUEUE_DEPTH", 512),
+            deadline_ms=env_float("GORDO_TPU_BATCH_DEADLINE_MS", 2000.0),
+            dispatchers=env_int("GORDO_TPU_BATCH_DISPATCHERS", 1),
+            warmup_max_rows=env_int("GORDO_TPU_SERVE_WARMUP_ROWS", 512),
+            inline_flush=env_int("GORDO_TPU_BATCH_INLINE_FLUSH", 1) != 0,
+        )
+
+
+class ServeEngine:
+    """Process-wide micro-batching scheduler over the fleet store."""
+
+    def __init__(self, config: Optional[ServeConfig] = None, metrics: Any = None):
+        self.config = config or ServeConfig.from_env()
+        #: duck-typed metric sink (server.prometheus.metrics.ServeMetrics);
+        #: late-bound so build_app can attach it after creation
+        self.metrics = metrics
+        self.member_ladder = ladder.member_ladder(self.config.max_size)
+        self._recorder = self._build_recorder()
+        self._lock = threading.Lock()
+        self._programs: set = set()
+        self._counters: Dict[str, int] = {
+            "requests": 0,  # batched_predict calls that enqueued
+            "fallback": 0,  # ineligible calls answered None
+            "batches": 0,  # fused device programs launched
+            "coalesced": 0,  # requests scored through fused programs
+            "shed_queue_full": 0,
+            "shed_deadline": 0,
+            "warmup_programs": 0,
+        }
+        self._batcher = MicroBatcher(
+            self._run_batch,
+            max_size=self.config.max_size,
+            max_delay_s=self.config.max_delay_s,
+            queue_depth=self.config.queue_depth,
+            pressure_depth=self.config.pressure_depth,
+            dispatchers=self.config.dispatchers,
+            inline_flush=self.config.inline_flush,
+            retry_after_s=max(1.0, self.config.max_delay_s * 4),
+            on_shed=self._on_shed,
+            on_depth=self._on_depth,
+        )
+
+    def _build_recorder(self):
+        trace_dir = os.getenv(TRACE_DIR_ENV)
+        if telemetry_enabled() and trace_dir:
+            os.makedirs(trace_dir, exist_ok=True)
+            return SpanRecorder(
+                sink_path=os.path.join(trace_dir, SERVE_TRACE_FILE),
+                service="gordo-tpu-serve",
+            )
+        return NULL_RECORDER
+
+    # -- request path -------------------------------------------------------
+
+    def eligible_spec(self, fleet, name: str) -> Optional[FeedForwardSpec]:
+        """The spec this request batches under, or None: only feedforward
+        architectures take the fused path today (windowed LSTMs need the
+        order-array program and stay on the unbatched path)."""
+        spec = fleet.loaded_specs().get(name)
+        return spec if isinstance(spec, FeedForwardSpec) else None
+
+    def batched_predict(
+        self,
+        collection_dir: str,
+        name: str,
+        model: Any,
+        X,
+        timing: Any = None,
+    ) -> Optional[np.ndarray]:
+        """
+        Score one request through the micro-batcher: returns the
+        reconstruction rows, or None when the request is not batchable
+        (the caller runs the model's own predict instead).
+
+        Raises :class:`QueueFullError` (→ 429) when admission control
+        rejects the request and :class:`DeadlineExceeded` (→ 504) when
+        its batch misses the per-request deadline.
+        """
+        from ..server.fleet_store import STORE, _find_estimator, _host_transform
+
+        fleet = STORE.fleet(collection_dir)
+        spec = self.eligible_spec(fleet, name)
+        if spec is None or _find_estimator(model) is None:
+            self._count("fallback")
+            return None
+        # row count is decided before the (potentially expensive) host
+        # transform: a fallback request must not pay the pipeline twice
+        rows = int(len(X))
+        padded_rows = ladder.pad_to(rows, self.config.row_ladder)
+        if rows == 0 or padded_rows is None:
+            # taller than the ladder's top rung: an unbounded shape —
+            # serve it unbatched rather than minting a program
+            self._count("fallback")
+            return None
+        transformed = _host_transform(model, X)
+        if int(transformed.shape[0]) != rows:
+            # a row-count-changing transformer: re-derive from the
+            # shape the fused program will actually see
+            rows = int(transformed.shape[0])
+            padded_rows = ladder.pad_to(rows, self.config.row_ladder)
+            if rows == 0 or padded_rows is None:
+                self._count("fallback")
+                return None
+
+        # row padding happens HERE, on the (otherwise waiting) request
+        # thread — the dispatcher then stacks same-rung payloads in one
+        # numpy call (see the module docstring for why that matters)
+        if rows == padded_rows:
+            payload = np.ascontiguousarray(transformed, dtype=np.float32)
+        else:
+            payload = np.zeros((padded_rows,) + transformed.shape[1:], np.float32)
+            payload[:rows] = transformed
+
+        deadline = time.monotonic() + self.config.deadline_s
+        item = BatchItem(name, payload, rows=rows, deadline=deadline)
+        try:
+            future = self._batcher.submit((fleet, spec, padded_rows), item)
+        except BatcherStopped:
+            self._count("fallback")
+            return None
+        self._count("requests")
+        try:
+            recon, meta = future.result(timeout=self.config.deadline_s)
+        except FutureTimeoutError:
+            future.cancel()
+            self._count("shed_deadline")
+            raise DeadlineExceeded(
+                f"request missed the {self.config.deadline_s * 1000:.0f}ms "
+                "batching deadline"
+            ) from None
+        except CancelledError:
+            # already counted: the batcher's claim path shed it
+            raise DeadlineExceeded("request expired while queued") from None
+        if timing is not None:
+            for stage, seconds in meta.items():
+                timing.record(stage, seconds)
+        return recon
+
+    # -- batch execution (dispatcher thread) --------------------------------
+
+    def _run_batch(self, key, items: List[BatchItem]) -> None:
+        from ..server.fleet_store import fleet_forward_gather, use_pallas
+
+        fleet, spec, padded_rows = key
+        flush_start = time.monotonic()
+        queue_waits = [flush_start - item.enqueued_at for item in items]
+        with self._recorder.span(
+            "serve_batch",
+            spec=type(spec).__name__,
+            n_features=spec.n_features,
+            size=len(items),
+        ) as batch_span:
+            with self._recorder.span("stack"):
+                stack_start = time.monotonic()
+                bucket_names, stacked = fleet.spec_bucket(spec)
+                bucket_rows = {n: i for i, n in enumerate(bucket_names)}
+                live: List[BatchItem] = []
+                for item in items:
+                    if item.name in bucket_rows:
+                        live.append(item)
+                    else:
+                        # invalidated/evicted between submit and flush
+                        try:
+                            item.future.set_exception(
+                                KeyError(f"{item.name} left the serving bucket")
+                            )
+                        except Exception:  # noqa: BLE001 - already resolved
+                            pass
+                if not live:
+                    return
+                members = len(live)
+                padded_members = ladder.pad_to(members, self.member_ladder)
+                indices = [bucket_rows[item.name] for item in live]
+                indices += [indices[0]] * (padded_members - members)
+                # payloads arrive pre-padded to this key's row rung: the
+                # whole batch stacks in ONE numpy call (per-item python
+                # work here gets GIL-starved under request load)
+                X = np.stack([item.payload for item in live])
+                if padded_members > members:
+                    padded = np.zeros(
+                        (padded_members, padded_rows, spec.n_features),
+                        np.float32,
+                    )
+                    padded[:members] = X
+                    X = padded
+                stack_s = time.monotonic() - stack_start
+
+            with self._recorder.span(
+                "device", padded_members=padded_members, padded_rows=padded_rows
+            ):
+                device_start = time.monotonic()
+                # member gather happens INSIDE the program — one device
+                # dispatch per batch, not one per parameter leaf
+                recon = np.asarray(
+                    fleet_forward_gather(
+                        spec, stacked, np.asarray(indices, np.int32), X
+                    )
+                )
+                device_s = time.monotonic() - device_start
+
+            backend = "pallas" if use_pallas() else "xla"
+            program = (spec, backend, padded_members, padded_rows)
+            with self._lock:
+                self._programs.add(program)
+                self._counters["batches"] += 1
+                self._counters["coalesced"] += members
+
+            scatter_start = time.monotonic()
+            with self._recorder.span("scatter"):
+                for i, item in enumerate(live):
+                    meta = {
+                        "queue_wait": flush_start - item.enqueued_at,
+                        "batch_stack": stack_s,
+                        "batch_device": device_s,
+                        "batch_scatter": time.monotonic() - scatter_start,
+                    }
+                    try:
+                        item.future.set_result((recon[i, : item.rows], meta))
+                    except Exception:  # noqa: BLE001 - waiter gave up (504'd)
+                        pass
+
+            useful = sum(item.rows for item in live)
+            waste = 1.0 - useful / float(padded_members * padded_rows)
+            batch_span.set(
+                coalesced=members,
+                padded_members=padded_members,
+                padded_rows=padded_rows,
+                padding_waste=round(waste, 4),
+                queue_wait_max_ms=round(max(queue_waits) * 1000.0, 3),
+            )
+        if self.metrics is not None:
+            try:
+                self.metrics.observe_batch(
+                    size=members,
+                    occupancy=members / float(padded_members),
+                    padding_waste=waste,
+                )
+                self.metrics.set_program_cache()
+            except Exception:  # noqa: BLE001 - metrics are advisory
+                pass
+
+    # -- warmup -------------------------------------------------------------
+
+    def warmup_collection(
+        self, collection_dir: str, names: Optional[List[str]] = None
+    ) -> Dict[str, Any]:
+        """Load the revision's models and precompile its fused programs
+        at every ladder shape a request could hit (rows capped at
+        ``warmup_max_rows`` — taller rungs compile on first use)."""
+        from ..server.fleet_store import STORE
+
+        fleet = STORE.fleet(collection_dir)
+        fleet.warm(names)
+        return self.warmup_fleet(fleet)
+
+    def warmup_fleet(self, fleet) -> Dict[str, Any]:
+        from ..server.fleet_store import fleet_forward_gather, use_pallas
+
+        start = time.monotonic()
+        backend = "pallas" if use_pallas() else "xla"
+        warm_rows = [
+            rung
+            for rung in self.config.row_ladder
+            if rung <= self.config.warmup_max_rows
+        ] or [self.config.row_ladder[0]]
+        specs = {
+            spec
+            for spec in fleet.loaded_specs().values()
+            if isinstance(spec, FeedForwardSpec)
+        }
+        compiled = 0
+        for spec in sorted(specs, key=repr):
+            try:
+                bucket_names, stacked = fleet.spec_bucket(spec)
+            except KeyError:
+                continue
+            n_bucket = len(bucket_names)
+            for padded_members in self.member_ladder:
+                indices = np.arange(padded_members, dtype=np.int32) % n_bucket
+                for padded_rows in warm_rows:
+                    program = (spec, backend, padded_members, padded_rows)
+                    with self._lock:
+                        new = program not in self._programs
+                        if new:
+                            self._programs.add(program)
+                    if not new:
+                        continue
+                    X = np.zeros(
+                        (padded_members, padded_rows, spec.n_features), np.float32
+                    )
+                    with self._recorder.span(
+                        "warmup_program",
+                        padded_members=padded_members,
+                        padded_rows=padded_rows,
+                    ):
+                        np.asarray(fleet_forward_gather(spec, stacked, indices, X))
+                    compiled += 1
+        self._count("warmup_programs", compiled)
+        if self.metrics is not None:
+            try:
+                self.metrics.set_program_cache()
+            except Exception:  # noqa: BLE001 - metrics are advisory
+                pass
+        seconds = time.monotonic() - start
+        logger.info(
+            "serve warmup: %d program(s) over %d spec bucket(s) in %.2fs",
+            compiled,
+            len(specs),
+            seconds,
+        )
+        return {"programs": compiled, "specs": len(specs), "seconds": seconds}
+
+    # -- introspection / lifecycle ------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            stats = dict(self._counters)
+            stats["programs"] = len(self._programs)
+        stats["pending"] = self._batcher.pending()
+        return stats
+
+    def program_shapes(self) -> List[Tuple]:
+        with self._lock:
+            return sorted(
+                (repr(s), b, m, r) for (s, b, m, r) in self._programs
+            )
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop the dispatcher(s); with ``drain`` everything already
+        queued still scores before the threads exit."""
+        self._batcher.shutdown(drain=drain)
+        self._recorder.close()
+
+    # -- internal hooks -----------------------------------------------------
+
+    def _count(self, key: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + by
+
+    def _on_shed(self, reason: str, n: int) -> None:
+        if reason == "queue_full":
+            self._count("shed_queue_full", n)
+        elif reason == "deadline":
+            self._count("shed_deadline", n)
+        if self.metrics is not None:
+            try:
+                self.metrics.observe_shed(reason, n)
+            except Exception:  # noqa: BLE001 - metrics are advisory
+                pass
+
+    def _on_depth(self, depth: int) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.set_queue_depth(depth)
+            except Exception:  # noqa: BLE001 - metrics are advisory
+                pass
+
+
+# -- the process-global engine ----------------------------------------------
+
+_engine: Optional[ServeEngine] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> Optional[ServeEngine]:
+    """The installed engine, or None (batching off / not configured)."""
+    return _engine
+
+
+def ensure_engine() -> Optional[ServeEngine]:
+    """Create-and-install the process engine when ``GORDO_TPU_BATCHING``
+    is on (idempotent); None when batching is off."""
+    global _engine
+    if not batching_enabled():
+        return None
+    with _engine_lock:
+        if _engine is None:
+            _engine = ServeEngine()
+            atexit.register(_shutdown_at_exit)
+            logger.info(
+                "micro-batching engine on: max_size=%d max_delay=%.1fms "
+                "queue_depth=%d row_ladder=%s",
+                _engine.config.max_size,
+                _engine.config.max_delay_s * 1000.0,
+                _engine.config.queue_depth,
+                _engine.config.row_ladder,
+            )
+        return _engine
+
+
+def install_engine(engine: Optional[ServeEngine]) -> None:
+    """Install a specific engine (tests; pass None to uninstall)."""
+    global _engine
+    with _engine_lock:
+        _engine = engine
+
+
+def reset_engine(drain: bool = True) -> None:
+    """Shut down and uninstall the process engine (tests, reload)."""
+    global _engine
+    with _engine_lock:
+        engine, _engine = _engine, None
+    if engine is not None:
+        engine.shutdown(drain=drain)
+
+
+def _shutdown_at_exit() -> None:
+    engine = _engine
+    if engine is not None:
+        try:
+            engine.shutdown(drain=True)
+        except Exception:  # noqa: BLE001 - interpreter is going down anyway
+            pass

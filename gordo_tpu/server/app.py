@@ -337,7 +337,9 @@ def build_app(
 ) -> GordoServerApp:
     """
     Build the server application with proxy adaptation applied and, when
-    enabled, prometheus request metrics.
+    enabled, prometheus request metrics and the cross-request
+    micro-batching engine (``GORDO_TPU_BATCHING`` — see
+    ``gordo_tpu.serve``), including its startup warmup pass.
     """
     app = GordoServerApp(config)
     app._wsgi_entry = adapt_proxy_deployment(app.wsgi_app)
@@ -350,7 +352,60 @@ def build_app(
         )
     elif prometheus_registry is not None:
         logger.warning("Ignoring non empty prometheus_registry argument")
+
+    # Micro-batching engine: process-global (gthread workers share it,
+    # like STORE); created here so the server lifecycle owns warmup and
+    # the atexit drain. Default-off — without the env switch this is a
+    # no-op and serving behaves exactly as before.
+    from .. import serve
+
+    engine = serve.ensure_engine()
+    if engine is not None:
+        if app.prometheus_metrics is not None and engine.metrics is None:
+            from .prometheus.metrics import serve_metrics
+
+            engine.metrics = serve_metrics(
+                project=app.config.get("PROJECT"),
+                registry=app.prometheus_metrics.registry,
+            )
+        _start_serve_warmup(app, engine)
     return app
+
+
+def serve_warmup_enabled() -> bool:
+    """Startup precompile of the served buckets' ladder programs: on by
+    default whenever batching is on (``GORDO_TPU_SERVE_WARMUP=0`` skips)."""
+    return os.getenv("GORDO_TPU_SERVE_WARMUP", "1").strip().lower() not in (
+        "0",
+        "false",
+        "off",
+        "no",
+    )
+
+
+def _start_serve_warmup(app: GordoServerApp, engine) -> Optional[object]:
+    """Kick off the engine's warmup for the served collection dir in the
+    background, so the first request after boot hits compiled programs
+    without the boot itself blocking on XLA."""
+    import threading
+
+    if not serve_warmup_enabled():
+        return None
+    collection_dir = os.environ.get(app.config["MODEL_COLLECTION_DIR_ENV_VAR"])
+    if not collection_dir or not os.path.isdir(collection_dir):
+        return None
+
+    def warm():
+        try:
+            engine.warmup_collection(collection_dir)
+        except Exception:  # noqa: BLE001 - warmup is an optimization; a bad
+            # artifact must not take the server down (requests would just
+            # pay first-call compiles, as without warmup)
+            logger.exception("serve warmup failed for %s", collection_dir)
+
+    thread = threading.Thread(target=warm, name="gordo-serve-warmup", daemon=True)
+    thread.start()
+    return thread
 
 
 # -- process runner ---------------------------------------------------------

@@ -32,6 +32,7 @@ import numpy as np
 from .. import serializer
 from ..models.estimators import JaxBaseEstimator
 from ..models.spec import FeedForwardSpec, LSTMSpec
+from ..utils.env import env_int
 
 logger = logging.getLogger(__name__)
 
@@ -83,16 +84,23 @@ class RevisionFleet:
     def __init__(self, collection_dir: str):
         self.collection_dir = collection_dir
         self._lock = threading.Lock()
+        # _models and _specs are COPY-ON-WRITE: loads replace the whole
+        # dict under the lock, readers just dereference the attribute
+        # (an atomic ref read) — the per-request serving path never
+        # touches the lock, so a thousand concurrent requests can't
+        # convoy behind it (nor behind the micro-batcher's per-batch
+        # bucket lookup). Never mutate these dicts in place.
         self._models: Dict[str, Any] = {}
         self._specs: Dict[str, Any] = {}  # name -> spec (JAX models only)
-        self._stacked: Dict[Any, Tuple[List[str], Any]] = {}  # spec -> (names, params)
+        #: spec -> (names, stacked params, epoch stamped at build)
+        self._stacked: Dict[Any, Tuple[List[str], Any, int]] = {}
+        self._bucket_epoch = 0  # bumped on every membership change
 
     # -- single-model serving ------------------------------------------------
 
     def model(self, name: str) -> Any:
         """The loaded model for ``name`` (load-once, then resident)."""
-        with self._lock:
-            cached = self._models.get(name)
+        cached = self._models.get(name)  # lock-free: _models is COW
         if cached is not None:
             return cached
 
@@ -107,10 +115,15 @@ class RevisionFleet:
             existing = self._models.get(name)
             if existing is not None:
                 return existing
-            self._models[name] = model
+            models = dict(self._models)
+            models[name] = model
+            self._models = models
             if estimator is not None and estimator.spec_ is not None:
-                self._specs[name] = estimator.spec_
+                specs = dict(self._specs)
+                specs[name] = estimator.spec_
+                self._specs = specs
                 self._stacked.pop(estimator.spec_, None)  # bucket grew; restack
+                self._bucket_epoch += 1
         return model
 
     def warm(self, names: Optional[List[str]] = None) -> List[str]:
@@ -148,11 +161,22 @@ class RevisionFleet:
         from ..parallel.fleet import stack_member_params
 
         with self._lock:
-            names = sorted(n for n, s in self._specs.items() if s == spec)
             cached = self._stacked.get(spec)
-            models = {n: self._models[n] for n in names}
+            epoch = self._bucket_epoch
+            if cached is not None and cached[2] == epoch:
+                # Hot path — one dict probe + an int compare. The
+                # micro-batcher hits this once per fused batch while the
+                # request threads churn; re-deriving membership here
+                # (sort + dict build) measurably starves the dispatcher
+                # of the GIL under load.
+                return cached[0], cached[1]
+            specs, models = self._specs, self._models  # COW snapshots
+        names = sorted(n for n, s in specs.items() if s == spec)
         if cached is not None and cached[0] == names:
-            return cached
+            with self._lock:
+                if self._bucket_epoch == epoch:
+                    self._stacked[spec] = (cached[0], cached[1], epoch)
+            return cached[0], cached[1]
         if not names:
             raise KeyError(f"no loaded models with spec {spec}")
 
@@ -169,16 +193,19 @@ class RevisionFleet:
         with self._lock:
             # Concurrent stackers of the same membership write identical
             # content; a membership change since our snapshot just means
-            # the next call restacks (names are re-derived every time).
-            self._stacked[spec] = (names, stacked)
+            # the next call restacks (membership is re-derived then).
+            if self._bucket_epoch == epoch:
+                self._stacked[spec] = (names, stacked, epoch)
         return names, stacked
 
     #: retained name from before LSTM buckets existed (r3 API)
     feedforward_bucket = spec_bucket
 
     def loaded_specs(self) -> Dict[str, Any]:
-        with self._lock:
-            return dict(self._specs)
+        """The name -> spec map of the loaded JAX models. The returned
+        dict is a COW snapshot — treat it as read-only (no per-call copy:
+        this sits on the per-request serving path)."""
+        return self._specs
 
     def fleet_scores(
         self, inputs: Dict[str, Any]
@@ -364,27 +391,92 @@ def fleet_forward(spec: FeedForwardSpec, stacked_params, X: np.ndarray):
     """
     The fused fleet forward ``X[M, B, F] -> [M, B, F_out]``: Pallas kernel
     on TPU (whole layer stack per grid step, activations in VMEM —
-    ops/pallas_dense.py), XLA vmap elsewhere. Both paths are jitted and
-    cached per spec so serving requests hit a compiled program.
+    ops/pallas_dense.py), XLA vmap elsewhere. Both paths share ONE cached
+    program table keyed by (spec, backend) so serving requests hit a
+    compiled program and cache growth is observable in one place
+    (``program_cache_stats`` / the ``gordo_server_program_cache_size``
+    Prometheus gauge).
     """
-    if use_pallas():
-        return _pallas_fleet_forward(spec)(stacked_params, X)
-    return _xla_fleet_forward(spec)(stacked_params, X)
+    backend = "pallas" if use_pallas() else "xla"
+    return _fleet_forward_program(spec, backend, gather=False)(stacked_params, X)
+
+
+def fleet_forward_gather(
+    spec: FeedForwardSpec, stacked_params, indices: np.ndarray, X: np.ndarray
+):
+    """
+    The fused gather+forward the micro-batcher runs:
+    ``(bucket[N, ...], indices[M], X[M, B, F]) -> [M, B, F_out]``, where
+    ``indices`` picks each batch member's row out of the revision's FULL
+    resident bucket INSIDE the jitted program. One device dispatch per
+    batch — gathering on the host instead (a ``tree_map`` of fancy
+    indexing) costs one tiny device program per parameter leaf, which at
+    micro-batch rates dominates the fused forward itself. The jit
+    signature includes the bucket's member count, which is fixed per
+    revision, so the executable count per spec stays bounded by the serve
+    shape ladder.
+    """
+    backend = "pallas" if use_pallas() else "xla"
+    return _fleet_forward_program(spec, backend, gather=True)(
+        stacked_params, indices, X
+    )
+
+
+#: keys ever handed to ``_fleet_forward_program`` — lru_cache has no key
+#: iteration API, and ``program_cache_stats`` needs the live entries to
+#: sum their per-shape executable counts
+_program_cache_keys: set = set()
+
+
+def _fleet_forward_program(spec: FeedForwardSpec, backend: str, gather: bool):
+    _program_cache_keys.add((spec, backend, gather))
+    return _build_fleet_forward_program(spec, backend, gather)
 
 
 @lru_cache(maxsize=None)
-def _pallas_fleet_forward(spec: FeedForwardSpec):
-    from ..ops.pallas_dense import fleet_feedforward_pallas
+def _build_fleet_forward_program(
+    spec: FeedForwardSpec, backend: str, gather: bool = False
+):
+    """The jitted fused-forward entry for one (spec, backend[, gather]).
+    The lru entry holds the jit wrapper; XLA compiles one executable per
+    input shape INSIDE it (counted by ``program_cache_stats``)."""
+    if backend == "pallas":
+        from ..ops.pallas_dense import fleet_feedforward_pallas
 
-    return jax.jit(lambda params, X: fleet_feedforward_pallas(spec, params, X))
+        fused = lambda params, X: fleet_feedforward_pallas(spec, params, X)  # noqa: E731
+    else:
+        from ..models.nn import forward_fn_for
+
+        forward = forward_fn_for(spec)
+        fused = jax.vmap(lambda p, x: forward(spec, p, x)[0])
+    if gather:
+
+        def run(params, indices, X):
+            member = jax.tree_util.tree_map(lambda a: a[indices], params)
+            return fused(member, X)
+
+        return jax.jit(run)
+    return jax.jit(fused)
 
 
-@lru_cache(maxsize=None)
-def _xla_fleet_forward(spec: FeedForwardSpec):
-    from ..models.nn import forward_fn_for
-
-    forward = forward_fn_for(spec)
-    return jax.jit(jax.vmap(lambda p, x: forward(spec, p, x)[0]))
+def program_cache_stats() -> Dict[str, int]:
+    """Serving program-cache sizes: ``programs`` is the number of cached
+    (spec, backend) jit entries, ``signatures`` the number of XLA
+    executables compiled inside them (distinct argument shapes) — the
+    number that must stay bounded by the serve shape ladder. A
+    ``signatures`` of -1 means this jax version hides the jit cache."""
+    signatures = 0
+    for (spec, backend, gather) in list(_program_cache_keys):
+        program = _build_fleet_forward_program(spec, backend, gather)
+        try:
+            signatures += program._cache_size()
+        except AttributeError:  # jit cache introspection is version-bound
+            signatures = -1
+            break
+    return {
+        "programs": _build_fleet_forward_program.cache_info().currsize,
+        "signatures": signatures,
+    }
 
 
 class FleetModelStore:
@@ -398,16 +490,46 @@ class FleetModelStore:
 
     def __init__(self, max_revisions: Optional[int] = None):
         if max_revisions is None:
-            max_revisions = int(os.getenv("N_CACHED_REVISIONS", 2))
+            # Validated, never trusted: this constructor runs at module
+            # import (the process-wide STORE below), so a malformed env
+            # var must degrade to the default, not kill every worker at
+            # boot.
+            max_revisions = env_int("N_CACHED_REVISIONS", 2)
+            if max_revisions < 1:
+                logger.warning(
+                    "N_CACHED_REVISIONS=%d is not a positive revision "
+                    "count; using 2",
+                    max_revisions,
+                )
+                max_revisions = 2
         self.max_revisions = max_revisions
         self._lock = threading.Lock()
         self._revisions: "OrderedDict[str, RevisionFleet]" = OrderedDict()
+        #: lock-free fast path for the overwhelmingly common case of every
+        #: request hitting the same revision: one atomic tuple read
+        #: instead of realpath() syscalls + the store lock + an
+        #: OrderedDict reorder PER REQUEST (all three are GIL-handoff
+        #: points that convoy under concurrent serving load)
+        self._mru: Optional[Tuple[str, RevisionFleet]] = None
 
     def fleet(self, collection_dir: str) -> RevisionFleet:
+        mru = self._mru
+        if mru is not None and mru[0] == collection_dir:
+            return mru[1]
         key = os.path.realpath(collection_dir)
         with self._lock:
             fleet = self._revisions.get(key)
             if fleet is None:
+                # Requests served through the lock-free fast path never
+                # refresh their LRU slot, so the hottest revision can
+                # look least-recently-used — re-rank it before deciding
+                # evictions (the dict is at most max_revisions entries).
+                mru = self._mru
+                if mru is not None:
+                    for mru_key, mru_fleet in self._revisions.items():
+                        if mru_fleet is mru[1]:
+                            self._revisions.move_to_end(mru_key)
+                            break
                 fleet = RevisionFleet(key)
                 self._revisions[key] = fleet
                 while len(self._revisions) > self.max_revisions:
@@ -415,6 +537,7 @@ class FleetModelStore:
                     logger.info("Evicting served revision %s", evicted_key)
             else:
                 self._revisions.move_to_end(key)
+            self._mru = (collection_dir, fleet)
             return fleet
 
     def get_model(self, collection_dir: str, name: str) -> Any:
@@ -423,10 +546,12 @@ class FleetModelStore:
     def invalidate(self, collection_dir: str):
         key = os.path.realpath(collection_dir)
         with self._lock:
+            self._mru = None  # conservatively, whatever alias it holds
             self._revisions.pop(key, None)
 
     def clear(self):
         with self._lock:
+            self._mru = None
             self._revisions.clear()
 
 

@@ -1,8 +1,12 @@
 """
-Model invocation for the serving path (reference: gordo/server/model_io.py).
+Model invocation for the serving path (reference: gordo/server/model_io.py),
+plus the glue between the request handlers and the cross-request
+micro-batching engine (``gordo_tpu.serve``).
 """
 
+import inspect
 import logging
+from typing import Any, Optional
 
 import numpy as np
 
@@ -22,3 +26,56 @@ def get_model_output(model, X) -> np.ndarray:
         except Exception as exc:
             logger.error("Failed to predict or transform; error: %s", exc)
             raise
+
+
+def accepts_model_output(model: Any) -> bool:
+    """Whether ``model.anomaly`` takes a precomputed ``model_output`` —
+    signature inspection, not a TypeError probe: a custom detector whose
+    ``anomaly()`` raises TypeError internally must surface it, not
+    silently re-run unfused."""
+    anomaly = getattr(model, "anomaly", None)
+    if anomaly is None:
+        return False
+    try:
+        return "model_output" in inspect.signature(anomaly).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def batched_model_output(ctx, gordo_name: str, X) -> Optional[np.ndarray]:
+    """
+    The micro-batched reconstruction for one single-model request, or
+    None when batching is off or this request is not batchable (caller
+    falls back to the model's own predict). The engine's admission
+    errors (:class:`gordo_tpu.serve.QueueFullError` → 429,
+    :class:`gordo_tpu.serve.DeadlineExceeded` → 504) propagate to the
+    route, which maps them via :func:`shed_response`.
+    """
+    from ..serve import get_engine
+
+    engine = get_engine()
+    if engine is None:
+        return None
+    return engine.batched_predict(
+        ctx.collection_dir, gordo_name, ctx.model, X, timing=ctx.timing
+    )
+
+
+def shed_response(ctx, exc):
+    """The backpressure response for an admission-control rejection:
+    429 + ``Retry-After`` for a full queue, 504 for a missed deadline —
+    overload degrades into flow control instead of OOMing the host."""
+    from ..serve import QueueFullError
+
+    if isinstance(exc, QueueFullError):
+        response = ctx.json_response(
+            {"error": "Server overloaded: batch queue full, retry later."},
+            status=429,
+        )
+        response.headers["Retry-After"] = str(
+            max(1, int(round(exc.retry_after_s)))
+        )
+        return response
+    return ctx.json_response(
+        {"error": "Request timed out waiting for its batch."}, status=504
+    )

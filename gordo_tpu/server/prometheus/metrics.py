@@ -59,6 +59,10 @@ def multiprocess_registry() -> Optional[CollectorRegistry]:
 
         registry = CollectorRegistry()
         multiprocess.MultiProcessCollector(registry)
+        # Scrape-time collectors have no mmap backing, so the worker
+        # fan-in alone would silently drop them: they must ride every
+        # registry that answers scrapes.
+        register_program_cache_collector(registry)
         return registry
     return None
 
@@ -323,6 +327,150 @@ def record_member_final_loss(project: Optional[str], loss: float):
     fleet_build_metrics()["member_final_loss"].labels(
         project=project or ""
     ).observe(loss)
+
+
+# -- serving micro-batcher metrics ------------------------------------------
+
+#: batch sizes are bounded by GORDO_TPU_BATCH_MAX_SIZE (default 32);
+#: powers of two mirror the member shape ladder
+_BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+#: ratios in [0, 1] (program occupancy / padding waste)
+_RATIO_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 0.95, 1.0)
+
+
+class ProgramCacheCollector:
+    """Scrape-time reader of the serving program cache
+    (``fleet_store.program_cache_stats``): ``cache="programs"`` counts
+    cached (spec, backend) jit entries, ``cache="signatures"`` the XLA
+    executables compiled inside them — the number the serve shape
+    ladder exists to bound."""
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        from ..fleet_store import program_cache_stats
+
+        stats = program_cache_stats()
+        family = GaugeMetricFamily(
+            "gordo_server_program_cache_size",
+            "Compiled serving-program cache size (programs = cached jit "
+            "entries per (spec, backend); signatures = XLA executables "
+            "compiled inside them, -1 when the jax version hides the "
+            "jit cache)",
+            labels=["cache"],
+        )
+        family.add_metric(["programs"], stats["programs"])
+        family.add_metric(["signatures"], stats["signatures"])
+        yield family
+
+
+#: registries already carrying a ProgramCacheCollector — re-registering
+#: would raise on the duplicated metric name
+_program_cache_registries: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_program_cache_collector(registry: CollectorRegistry) -> None:
+    """Attach the scrape-time program-cache gauge to ``registry``, once.
+
+    Unlike Counter/Histogram, a custom collector is not mmap-backed, so
+    it must be registered on every registry that answers scrapes — the
+    in-process one AND the fresh multiprocess fan-in registry (where the
+    reported values are the answering worker's own cache)."""
+    if registry in _program_cache_registries:
+        return
+    _program_cache_registries.add(registry)
+    registry.register(ProgramCacheCollector())
+
+
+class ServeMetrics:
+    """The micro-batching engine's metric set: queue depth, batch size /
+    coalesce-ratio / padding-waste histograms, and the shed counter.
+    Attached to a :class:`gordo_tpu.serve.ServeEngine` by ``build_app``;
+    every method is safe to call from dispatcher threads."""
+
+    def __init__(
+        self,
+        project: Optional[str] = None,
+        registry: Optional[CollectorRegistry] = None,
+    ):
+        _ensure_multiproc_dir()
+        self.project = project or ""
+        self.registry = registry if registry is not None else REGISTRY
+        labels = ["project"]
+        self.queue_depth = Gauge(
+            "gordo_server_batch_queue_depth",
+            "Requests currently waiting in the micro-batch queue",
+            labelnames=labels,
+            registry=self.registry,
+            multiprocess_mode="max",
+        )
+        self.batch_size = Histogram(
+            "gordo_server_batch_size",
+            "Requests coalesced into each fused device program",
+            labelnames=labels,
+            buckets=_BATCH_SIZE_BUCKETS,
+            registry=self.registry,
+        )
+        self.coalesce_ratio = Histogram(
+            "gordo_server_batch_coalesce_ratio",
+            "Program occupancy: coalesced requests / padded member slots "
+            "of the fused program (1.0 = a perfectly full batch)",
+            labelnames=labels,
+            buckets=_RATIO_BUCKETS,
+            registry=self.registry,
+        )
+        self.padding_waste = Histogram(
+            "gordo_server_batch_padding_waste",
+            "Fraction of the fused program's padded (member x row) cells "
+            "holding no request data",
+            labelnames=labels,
+            buckets=_RATIO_BUCKETS,
+            registry=self.registry,
+        )
+        self.shed = Counter(
+            "gordo_server_batch_shed_total",
+            "Requests shed by serving admission control, by reason "
+            "(queue_full -> 429, deadline -> 504, cancelled = waiter "
+            "gave up before its batch ran)",
+            labelnames=labels + ["reason"],
+            registry=self.registry,
+        )
+        register_program_cache_collector(self.registry)
+
+    def observe_batch(self, size: int, occupancy: float, padding_waste: float):
+        self.batch_size.labels(project=self.project).observe(size)
+        self.coalesce_ratio.labels(project=self.project).observe(occupancy)
+        self.padding_waste.labels(project=self.project).observe(padding_waste)
+
+    def observe_shed(self, reason: str, n: int = 1):
+        self.shed.labels(project=self.project, reason=reason).inc(n)
+
+    def set_queue_depth(self, depth: int):
+        self.queue_depth.labels(project=self.project).set(depth)
+
+    def set_program_cache(self):
+        # the gauge is a scrape-time collector; nothing to push
+        pass
+
+
+#: one ServeMetrics per LIVE registry (same WeakKey rationale as
+#: ``_build_metrics`` above: a dead registry's id must never alias a new
+#: registry into receiving unregistered metric objects)
+_serve_metrics: "weakref.WeakKeyDictionary[CollectorRegistry, ServeMetrics]" = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def serve_metrics(
+    project: Optional[str] = None,
+    registry: Optional[CollectorRegistry] = None,
+) -> ServeMetrics:
+    """The serve metric set for ``registry`` (default: the global
+    REGISTRY), created once per live registry."""
+    target = registry if registry is not None else REGISTRY
+    if target not in _serve_metrics:
+        _serve_metrics[target] = ServeMetrics(project=project, registry=target)
+    return _serve_metrics[target]
 
 
 def set_fleet_build_progress(

@@ -9,13 +9,15 @@ from typing import Optional
 from prometheus_client import REGISTRY, CollectorRegistry, generate_latest
 from werkzeug.wrappers import Request, Response
 
-from .metrics import multiprocess_registry
+from .metrics import multiprocess_registry, register_program_cache_collector
 
 
 def build_metrics_app(registry: Optional[CollectorRegistry] = None):
     """WSGI app answering Prometheus scrapes at ``/metrics`` (and ``/``)."""
     if registry is None:
         registry = multiprocess_registry() or REGISTRY
+    # scrape-time collector: not mmap-backed, must ride THIS registry
+    register_program_cache_collector(registry)
 
     def app(environ, start_response):
         request = Request(environ)

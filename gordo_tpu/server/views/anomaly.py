@@ -25,6 +25,9 @@ DELETED_FROM_RESPONSE_COLUMNS = (
 
 
 def post_anomaly_prediction(ctx, gordo_project: str, gordo_name: str):
+    from ...serve import BatchShedError
+    from .. import model_io
+
     start_time = timeit.default_timer()
     with ctx.stage("model_resolve"):
         server_utils.require_model(ctx, gordo_name)
@@ -39,9 +42,20 @@ def post_anomaly_prediction(ctx, gordo_project: str, gordo_name: str):
 
     try:
         with ctx.stage("inference"):
-            anomaly_df = ctx.model.anomaly(
-                ctx.X, ctx.y, frequency=get_frequency(ctx)
-            )
+            # Micro-batching: when the detector accepts a precomputed
+            # model_output, the reconstruction can coalesce with
+            # concurrent requests into one fused program; the detector's
+            # threshold/confidence math still runs per request.
+            kwargs = {"frequency": get_frequency(ctx)}
+            if model_io.accepts_model_output(ctx.model):
+                model_output = model_io.batched_model_output(
+                    ctx, gordo_name, ctx.X
+                )
+                if model_output is not None:
+                    kwargs["model_output"] = model_output
+            anomaly_df = ctx.model.anomaly(ctx.X, ctx.y, **kwargs)
+    except BatchShedError as exc:
+        return model_io.shed_response(ctx, exc)
     except AttributeError:
         return ctx.json_response(
             {

@@ -31,7 +31,14 @@ def post_prediction(ctx, gordo_project: str, gordo_name: str):
     Run the model on client-provided ``X`` and answer the
     start/end/model-input/model-output response frame as JSON (or parquet
     with ``?format=parquet``).
+
+    With micro-batching on (``GORDO_TPU_BATCHING``), concurrent requests
+    for same-architecture models coalesce into one fused fleet program
+    (``gordo_tpu.serve``); admission control maps to 429/504 and
+    everything unbatchable falls back to the model's own predict.
     """
+    from ...serve import BatchShedError
+
     with ctx.stage("model_resolve"):
         server_utils.require_model(ctx, gordo_name)
     with ctx.stage("data_decode"):
@@ -43,7 +50,11 @@ def post_prediction(ctx, gordo_project: str, gordo_name: str):
 
     try:
         with ctx.stage("inference"):
-            output = model_io.get_model_output(model=ctx.model, X=X)
+            output = model_io.batched_model_output(ctx, gordo_name, X)
+            if output is None:
+                output = model_io.get_model_output(model=ctx.model, X=X)
+    except BatchShedError as exc:
+        return model_io.shed_response(ctx, exc)
     except ValueError as err:
         logger.error(
             "Failed to predict or transform; error: %s - \nTraceback: %s",
@@ -272,7 +283,6 @@ def _full_anomaly_entry(
     confidence math runs host-side exactly as in the single-model route;
     only the predict was fused.
     """
-    import inspect
     from types import SimpleNamespace
 
     from ...models.anomaly.base import AnomalyDetectorBase
@@ -286,17 +296,8 @@ def _full_anomaly_entry(
         frequency = get_frequency(SimpleNamespace(metadata=metadata))
     except (KeyError, TypeError, ValueError):
         frequency = None
-    # signature inspection, not a TypeError probe: a custom detector whose
-    # anomaly() raises TypeError internally must surface it, not silently
-    # re-run unfused
     kwargs = {"frequency": frequency}
-    try:
-        accepts_output = (
-            "model_output" in inspect.signature(model.anomaly).parameters
-        )
-    except (TypeError, ValueError):
-        accepts_output = False
-    if accepts_output:
+    if model_io.accepts_model_output(model):
         kwargs["model_output"] = reconstruction
     try:
         anomaly_df = model.anomaly(X, y, **kwargs)

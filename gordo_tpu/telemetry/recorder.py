@@ -93,6 +93,9 @@ class NullRecorder:
     def event(self, name: str, **attributes) -> None:
         pass
 
+    def record(self, name: str, seconds: float, **attributes) -> None:
+        pass
+
     def add_listener(self, listener: Callable[[dict], None]) -> None:
         pass
 
@@ -201,6 +204,27 @@ class SpanRecorder:
                 dict(attributes),
                 None,
                 kind="event",
+            )
+        )
+
+    def record(self, name: str, seconds: float, **attributes) -> None:
+        """An externally-timed interval as a finished span (ends now).
+
+        For durations measured on ANOTHER thread's clock — e.g. a
+        request handler folding the micro-batcher's shared stack/device
+        stage times into its own Server-Timing — where a ``with span``
+        block on this recorder would double-count the wait."""
+        end = time.time()
+        stack = self._stack()
+        self._record(
+            self._span_dict(
+                name,
+                uuid.uuid4().hex[:16],
+                stack[-1] if stack else None,
+                end - max(0.0, seconds),
+                end,
+                dict(attributes),
+                None,
             )
         )
 

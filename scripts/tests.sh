@@ -37,7 +37,7 @@ run() { python -m pytest -q "$@"; }
 component="${1:-all}"
 case "$component" in
     all)      run -m "not slow" tests/ ;;
-    fast)     run -m "not slow" tests/ --ignore=tests/parallel --ignore=tests/models --ignore=tests/server ;;
+    fast)     run -m "not slow" tests/ --ignore=tests/parallel --ignore=tests/models --ignore=tests/server --ignore=tests/serve ;;
     # The parallel job runs its compile-heavy suites INCLUDING the
     # slow-marked LSTM/packing/sequence fleet modules — that is exactly
     # why it has its own matrix job; only the multi-process distributed
@@ -54,6 +54,7 @@ case "$component" in
     reporters) run -m "not slow" tests/reporters ;;
     serializer) run -m "not slow" tests/serializer ;;
     server)   run -m "not slow" tests/server ;;
+    serve)    run -m "not slow" tests/serve ;;
     utils)    run -m "not slow" tests/utils ;;
     workflow) run -m "not slow" tests/workflow ;;
     formatting) run tests/test_codestyle.py ;;
@@ -64,8 +65,8 @@ case "$component" in
             --ignore=tests/builder --ignore=tests/cli --ignore=tests/client \
             --ignore=tests/dataset --ignore=tests/machine --ignore=tests/models \
             --ignore=tests/ops --ignore=tests/parallel --ignore=tests/reporters \
-            --ignore=tests/serializer --ignore=tests/server --ignore=tests/utils \
-            --ignore=tests/workflow
+            --ignore=tests/serializer --ignore=tests/serve --ignore=tests/server \
+            --ignore=tests/utils --ignore=tests/workflow
         ;;
     *)
         echo "unknown component: $component" >&2

@@ -1,0 +1,157 @@
+"""
+Serve-suite fixtures: a model collection where three machines SHARE one
+feedforward architecture (the micro-batcher's coalescing unit) plus one
+odd-spec machine, an engine factory that installs/uninstalls the
+process-global engine around each test, and a batched WSGI client.
+"""
+
+import contextlib
+import os
+import threading
+
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu import serializer, serve
+from gordo_tpu.builder import local_build
+from gordo_tpu.serve import ServeConfig, ServeEngine
+from gordo_tpu.server import build_app
+from gordo_tpu.server.fleet_store import STORE
+
+from tests.server.conftest import temp_env_vars  # noqa: F401 (re-export)
+
+PROJECT = "serve-project"
+REVISION = "1700000000000"
+
+#: three same-architecture detector machines (one spec bucket) + one
+#: two-tag machine (its own bucket) — 1 epoch keeps the build cheap
+SERVE_CONFIG = """
+machines:
+  - name: batch-a
+    dataset: &ds
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-05T00:00:00+00:00"
+      tag_list: [tag-1, tag-2, tag-3, tag-4]
+    model: &detector
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.JaxAutoEncoder:
+            kind: feedforward_model
+            encoding_dim: [8, 4]
+            encoding_func: [tanh, tanh]
+            decoding_dim: [4, 8]
+            decoding_func: [tanh, tanh]
+            epochs: 1
+  - name: batch-b
+    dataset: *ds
+    model: *detector
+  - name: batch-c
+    dataset: *ds
+    model: *detector
+  - name: odd-one
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-05T00:00:00+00:00"
+      tag_list: [tag-1, tag-2]
+    model:
+      gordo_tpu.models.JaxAutoEncoder:
+        kind: feedforward_hourglass
+        compression_factor: 0.5
+        encoding_layers: 1
+        epochs: 1
+"""
+
+BATCH_NAMES = ["batch-a", "batch-b", "batch-c"]
+
+
+@pytest.fixture(scope="session")
+def serve_collection_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("serve-collection")
+    for model, machine in local_build(SERVE_CONFIG, project_name=PROJECT):
+        serializer.dump(
+            model, str(root / REVISION / machine.name), metadata=machine.to_dict()
+        )
+    return str(root / REVISION)
+
+
+#: a tiny, test-friendly engine: small ladders (fast compiles), a long
+#: flush delay relative to thread-spawn jitter, generous deadline
+def tiny_config(**overrides) -> ServeConfig:
+    defaults = dict(
+        max_size=8,
+        max_delay_ms=60.0,
+        queue_depth=64,
+        deadline_ms=10000.0,
+        dispatchers=1,
+        row_ladder=(8, 32),
+        warmup_max_rows=32,
+    )
+    defaults.update(overrides)
+    return ServeConfig(**defaults)
+
+
+@contextlib.contextmanager
+def installed_engine(config=None):
+    engine = ServeEngine(config or tiny_config())
+    serve.install_engine(engine)
+    try:
+        yield engine
+    finally:
+        serve.install_engine(None)
+        engine.shutdown(drain=True)
+
+
+@pytest.fixture
+def engine():
+    with installed_engine() as installed:
+        yield installed
+
+
+@pytest.fixture
+def client(serve_collection_dir):
+    """A WSGI client over the serve collection; whether requests batch is
+    decided by which engine fixture the test also pulls in."""
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=serve_collection_dir, GORDO_TPU_SERVE_WARMUP="0"
+    ):
+        yield Client(build_app(config={"EXPECTED_MODELS": BATCH_NAMES}))
+
+
+@pytest.fixture(scope="session")
+def batch_payload():
+    """A 6-row JSON X payload matching the shared four-tag spec."""
+    index = [f"2020-03-01T00:{m:02d}:00+00:00" for m in range(0, 60, 10)]
+    return {
+        "X": {
+            f"tag-{i}": {ts: 0.1 * i + 0.01 * j for j, ts in enumerate(index)}
+            for i in range(1, 5)
+        }
+    }
+
+
+def warm_store(collection_dir, names=None):
+    """Load the collection's models into the process STORE (what
+    require_model does per request) so engine paths see a live bucket."""
+    fleet = STORE.fleet(collection_dir)
+    fleet.warm(names)
+    return fleet
+
+
+def run_threads(n, target):
+    """Run ``target(i)`` on n threads; returns per-thread exceptions."""
+    errors = [None] * n
+
+    def wrap(i):
+        try:
+            target(i)
+        except Exception as exc:  # noqa: BLE001 - surfaced by the test
+            errors[i] = exc
+
+    threads = [threading.Thread(target=wrap, args=(i,)) for i in range(n)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=60)
+    return [e for e in errors if e is not None]

@@ -1,0 +1,215 @@
+"""
+MicroBatcher scheduling semantics, device-free: flush triggers (size,
+age, pressure), admission control (queue depth, deadlines, cancels),
+key isolation, and shutdown draining.
+"""
+
+import threading
+import time
+
+import pytest
+
+from gordo_tpu.serve.batcher import (
+    BatcherStopped,
+    BatchItem,
+    DeadlineExceeded,
+    MicroBatcher,
+    QueueFullError,
+)
+
+pytestmark = pytest.mark.serve
+
+
+class Collector:
+    """Runner stub: records batches and resolves futures with the key."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.batches = []
+        self.delay_s = delay_s
+        self._lock = threading.Lock()
+
+    def __call__(self, key, items):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        with self._lock:
+            self.batches.append((key, [item.name for item in items]))
+        for item in items:
+            item.future.set_result(item.name)
+
+
+def make(runner, **kwargs):
+    defaults = dict(max_size=4, max_delay_s=0.02, queue_depth=32, dispatchers=1)
+    defaults.update(kwargs)
+    return MicroBatcher(runner, **defaults)
+
+
+def test_flush_on_size_before_delay():
+    runner = Collector()
+    batcher = make(runner, max_size=3, max_delay_s=5.0)
+    try:
+        futures = [batcher.submit("k", BatchItem(f"r{i}", None)) for i in range(3)]
+        assert [f.result(timeout=5) for f in futures] == ["r0", "r1", "r2"]
+        assert runner.batches == [("k", ["r0", "r1", "r2"])]
+    finally:
+        batcher.shutdown()
+
+
+def test_flush_on_age_when_batch_never_fills():
+    runner = Collector()
+    batcher = make(runner, max_size=100, max_delay_s=0.02)
+    try:
+        future = batcher.submit("k", BatchItem("lonely", None))
+        assert future.result(timeout=5) == "lonely"
+    finally:
+        batcher.shutdown()
+
+
+def test_flush_on_pressure_across_keys():
+    runner = Collector()
+    # neither key fills max_size, but total pressure forces a flush well
+    # before the (deliberately huge) age trigger
+    batcher = make(
+        runner, max_size=100, max_delay_s=60.0, queue_depth=32, pressure_depth=4
+    )
+    try:
+        futures = [
+            batcher.submit(f"k{i % 2}", BatchItem(f"r{i}", None)) for i in range(4)
+        ]
+        for future in futures:
+            future.result(timeout=5)
+        assert len(runner.batches) >= 1
+    finally:
+        batcher.shutdown()
+
+
+def test_keys_never_share_a_batch():
+    runner = Collector()
+    batcher = make(runner, max_size=8, max_delay_s=0.02)
+    try:
+        futures = [
+            batcher.submit(f"spec-{i % 2}", BatchItem(f"r{i}", None))
+            for i in range(6)
+        ]
+        for future in futures:
+            future.result(timeout=5)
+        for key, names in runner.batches:
+            assert {n for n in names} <= {f"r{i}" for i in range(6) if f"spec-{i % 2}" == key}
+    finally:
+        batcher.shutdown()
+
+
+def test_queue_full_rejects_with_retry_after():
+    block = threading.Event()
+
+    def stuck(key, items):
+        block.wait(timeout=10)
+        for item in items:
+            item.future.set_result(None)
+
+    batcher = MicroBatcher(
+        stuck, max_size=1, max_delay_s=0.0, queue_depth=2, dispatchers=1,
+        retry_after_s=3.0,
+    )
+    try:
+        batcher.submit("k", BatchItem("r0", None))  # occupies the dispatcher
+        time.sleep(0.05)
+        batcher.submit("k", BatchItem("r1", None))
+        batcher.submit("k", BatchItem("r2", None))
+        with pytest.raises(QueueFullError) as excinfo:
+            batcher.submit("k", BatchItem("r3", None))
+        assert excinfo.value.retry_after_s == 3.0
+    finally:
+        block.set()
+        batcher.shutdown()
+
+
+def test_expired_item_is_shed_not_scored():
+    runner = Collector()
+    shed = []
+    batcher = MicroBatcher(
+        runner, max_size=4, max_delay_s=0.05, queue_depth=8,
+        on_shed=lambda reason, n: shed.append(reason),
+    )
+    try:
+        expired = BatchItem("late", None, deadline=time.monotonic() - 1.0)
+        future = batcher.submit("k", expired)
+        with pytest.raises((DeadlineExceeded, Exception)):
+            future.result(timeout=5)
+        assert all("late" not in names for _, names in runner.batches)
+        assert "deadline" in shed
+    finally:
+        batcher.shutdown()
+
+
+def test_cancelled_future_skips_execution():
+    runner = Collector()
+    batcher = make(runner, max_size=4, max_delay_s=0.05)
+    try:
+        item = BatchItem("gone", None)
+        future = batcher.submit("k", item)
+        assert future.cancel()  # waiter gave up before the flush
+        time.sleep(0.15)
+        assert all("gone" not in names for _, names in runner.batches)
+    finally:
+        batcher.shutdown()
+
+
+def test_shutdown_drains_queued_work():
+    runner = Collector(delay_s=0.01)
+    # age/size triggers deliberately unreachable: only the drain flushes
+    batcher = make(runner, max_size=100, max_delay_s=60.0)
+    futures = [batcher.submit("k", BatchItem(f"r{i}", None)) for i in range(5)]
+    batcher.shutdown(drain=True)
+    assert [f.result(timeout=1) for f in futures] == [f"r{i}" for i in range(5)]
+
+
+def test_shutdown_without_drain_resolves_waiters():
+    runner = Collector()
+    batcher = make(runner, max_size=100, max_delay_s=60.0)
+    future = batcher.submit("k", BatchItem("r0", None))
+    batcher.shutdown(drain=False)
+    with pytest.raises(Exception):  # cancelled or BatcherStopped
+        future.result(timeout=1)
+    with pytest.raises(BatcherStopped):
+        batcher.submit("k", BatchItem("r1", None))
+
+
+def test_inline_flush_runs_size_batch_on_submitting_thread():
+    ran_on = []
+
+    def runner(key, items):
+        ran_on.append(threading.current_thread())
+        for item in items:
+            item.future.set_result(item.name)
+
+    batcher = make(runner, max_size=3, max_delay_s=60.0, inline_flush=True)
+    try:
+        futures = [batcher.submit("k", BatchItem(f"r{i}", None)) for i in range(3)]
+        # the third submit filled the batch and ran it inline — no
+        # dispatcher handoff, so results exist before any wait
+        assert [f.result(timeout=0) for f in futures] == ["r0", "r1", "r2"]
+        assert ran_on == [threading.current_thread()]
+    finally:
+        batcher.shutdown()
+
+
+def test_inline_flush_partial_batches_still_age_out():
+    runner = Collector()
+    batcher = make(runner, max_size=100, max_delay_s=0.02, inline_flush=True)
+    try:
+        future = batcher.submit("k", BatchItem("lonely", None))
+        assert future.result(timeout=5) == "lonely"  # dispatcher age flush
+    finally:
+        batcher.shutdown()
+
+
+def test_oversize_queue_splits_into_max_size_batches():
+    runner = Collector()
+    batcher = make(runner, max_size=2, max_delay_s=5.0)
+    try:
+        futures = [batcher.submit("k", BatchItem(f"r{i}", None)) for i in range(6)]
+        for future in futures:
+            future.result(timeout=5)
+        assert sorted(len(names) for _, names in runner.batches) == [2, 2, 2]
+    finally:
+        batcher.shutdown()

@@ -1,0 +1,261 @@
+"""
+ServeEngine end-to-end over the WSGI routes: batched and unbatched
+scoring are numerically equivalent under concurrent clients, coalescing
+actually happens, the compiled-program count stays inside the shape
+ladder, warmup precompiles it, and admission control maps to 429/504.
+"""
+
+import json
+
+import numpy as np
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu import serve
+from gordo_tpu.serve import DeadlineExceeded, QueueFullError
+from gordo_tpu.server import build_app
+from gordo_tpu.server.fleet_store import STORE
+
+from tests.serve.conftest import (
+    BATCH_NAMES,
+    PROJECT,
+    installed_engine,
+    run_threads,
+    temp_env_vars,
+    tiny_config,
+    warm_store,
+)
+
+pytestmark = pytest.mark.serve
+
+
+def _frames_close(got, want, rtol=1e-4, atol=1e-5, path=""):
+    """dataframe_to_dict payloads (nested {column: {row: value}}, one
+    level deeper for MultiIndex anomaly frames) numerically equal within
+    float32 tolerance; non-numeric leaves (timestamps) exactly equal."""
+    if isinstance(want, dict):
+        assert isinstance(got, dict) and got.keys() == want.keys(), path
+        for key in want:
+            _frames_close(got[key], want[key], rtol, atol, f"{path}/{key}")
+    elif isinstance(want, (int, float)) and not isinstance(want, bool):
+        np.testing.assert_allclose(got, want, rtol=rtol, atol=atol, err_msg=path)
+    else:
+        assert got == want, path
+
+
+def test_concurrent_clients_batched_matches_unbatched(
+    serve_collection_dir, batch_payload
+):
+    """The acceptance-criteria test: N concurrent single-model requests
+    with batching on answer exactly what the unbatched path answers, and
+    they coalesce into fewer fused programs than requests."""
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=serve_collection_dir, GORDO_TPU_SERVE_WARMUP="0"
+    ):
+        app = build_app(config={"EXPECTED_MODELS": BATCH_NAMES})
+        reference = {}
+        client = Client(app)
+        assert serve.get_engine() is None  # the reference runs unbatched
+        for name in BATCH_NAMES:
+            resp = client.post(
+                f"/gordo/v0/{PROJECT}/{name}/prediction", json=batch_payload
+            )
+            assert resp.status_code == 200
+            reference[name] = json.loads(resp.data)["data"]["model-output"]
+
+        # a longer flush window than thread-spawn jitter so the burst
+        # lands in one or two fused programs, never nine
+        with installed_engine(tiny_config(max_delay_ms=250.0)) as engine:
+            engine.warmup_collection(serve_collection_dir)
+            results = {}
+
+            def hit(i):
+                name = BATCH_NAMES[i % len(BATCH_NAMES)]
+                resp = Client(app).post(
+                    f"/gordo/v0/{PROJECT}/{name}/prediction", json=batch_payload
+                )
+                assert resp.status_code == 200, resp.data
+                results[i] = (name, json.loads(resp.data)["data"]["model-output"])
+
+            errors = run_threads(9, hit)
+            assert not errors
+            stats = engine.stats()
+            assert stats["coalesced"] == 9
+            assert stats["batches"] < 9  # requests actually fused
+
+        assert len(results) == 9
+        for name, frame in results.values():
+            _frames_close(frame, reference[name])
+
+
+def test_anomaly_route_batched_matches_unbatched(
+    serve_collection_dir, batch_payload
+):
+    """The detector's threshold/confidence math over a micro-batched
+    reconstruction answers the same anomaly frame as the unbatched
+    route (the detector accepts model_output, so only predict fused)."""
+    payload = dict(batch_payload, y=batch_payload["X"])
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=serve_collection_dir, GORDO_TPU_SERVE_WARMUP="0"
+    ):
+        app = build_app(config={"EXPECTED_MODELS": BATCH_NAMES})
+        url = f"/gordo/v0/{PROJECT}/batch-a/anomaly/prediction"
+        resp = Client(app).post(url, json=payload)
+        assert resp.status_code == 200, resp.data
+        reference = json.loads(resp.data)["data"]
+
+        with installed_engine() as engine:
+            resp = Client(app).post(url, json=payload)
+            assert resp.status_code == 200, resp.data
+            batched = json.loads(resp.data)["data"]
+            assert engine.stats()["coalesced"] == 1
+
+    _frames_close(batched, reference)
+
+
+def test_program_count_bounded_by_ladder(serve_collection_dir):
+    """Arbitrary client row counts mint at most |member ladder| x
+    |row ladder| fused programs per spec bucket."""
+    fleet = warm_store(serve_collection_dir, BATCH_NAMES)
+    config = tiny_config(max_size=8, row_ladder=(8, 32), max_delay_ms=20.0)
+    bound = len(serve.member_ladder(8)) * 2
+    with installed_engine(config) as engine:
+        model = STORE.get_model(serve_collection_dir, "batch-a")
+
+        def hit(i):
+            rows = 1 + (i * 7) % 30  # 1..29: spans both rungs
+            X = np.random.RandomState(i).rand(rows, 4).astype(np.float32)
+            recon = engine.batched_predict(
+                serve_collection_dir, "batch-a", model, X
+            )
+            assert recon is not None and recon.shape == (rows, 4)
+
+        errors = run_threads(12, hit)
+        assert not errors
+        stats = engine.stats()
+        assert stats["requests"] == 12
+        assert 0 < stats["programs"] <= bound
+        for _, _, members, rows in engine.program_shapes():
+            assert members in serve.member_ladder(8)
+            assert rows in (8, 32)
+    del fleet
+
+
+def test_oversized_and_empty_requests_fall_back(serve_collection_dir):
+    """Rows above the top rung (an unbounded shape) and empty inputs
+    answer None — the caller's cue to run the model's own predict."""
+    warm_store(serve_collection_dir, ["batch-a"])
+    model = STORE.get_model(serve_collection_dir, "batch-a")
+    with installed_engine(tiny_config(row_ladder=(8, 32))) as engine:
+        tall = np.zeros((64, 4), np.float32)
+        assert (
+            engine.batched_predict(serve_collection_dir, "batch-a", model, tall)
+            is None
+        )
+        empty = np.zeros((0, 4), np.float32)
+        assert (
+            engine.batched_predict(serve_collection_dir, "batch-a", model, empty)
+            is None
+        )
+        assert engine.stats()["fallback"] == 2
+
+
+def test_unknown_model_falls_back(serve_collection_dir):
+    warm_store(serve_collection_dir, ["batch-a"])
+    model = STORE.get_model(serve_collection_dir, "batch-a")
+    with installed_engine() as engine:
+        assert (
+            engine.batched_predict(
+                serve_collection_dir, "never-loaded", model, np.zeros((4, 4))
+            )
+            is None
+        )
+        assert engine.stats()["fallback"] == 1
+
+
+def test_warmup_precompiles_every_ladder_shape(serve_collection_dir):
+    """Warmup mints exactly |specs| x |member ladder| x |warm rows|
+    programs, and is idempotent — the first real request after boot
+    hits a compiled program."""
+    with installed_engine(tiny_config()) as engine:
+        report = engine.warmup_collection(serve_collection_dir)
+        # two spec buckets: the shared 4-feature detector spec + odd-one
+        assert report["specs"] == 2
+        member_rungs = len(serve.member_ladder(engine.config.max_size))
+        assert report["programs"] == 2 * member_rungs * 2  # warm rows (8, 32)
+        assert engine.stats()["programs"] == report["programs"]
+
+        again = engine.warmup_fleet(STORE.fleet(serve_collection_dir))
+        assert again["programs"] == 0
+
+        # a ladder-shaped request adds no new program
+        model = STORE.get_model(serve_collection_dir, "batch-a")
+        recon = engine.batched_predict(
+            serve_collection_dir, "batch-a", model, np.zeros((6, 4), np.float32)
+        )
+        assert recon is not None
+        assert engine.stats()["programs"] == report["programs"]
+
+
+def test_request_deadline_maps_to_504(client, batch_payload):
+    """A request whose batch never flushes inside its deadline answers
+    504, not a hang: deadline 50ms versus a 400ms flush window."""
+    with installed_engine(tiny_config(max_delay_ms=400.0, deadline_ms=50.0)):
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/batch-a/prediction", json=batch_payload
+        )
+        assert resp.status_code == 504
+        assert "error" in json.loads(resp.data)
+
+
+class _ShedStub:
+    """An engine stand-in whose batched_predict always sheds."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+    def batched_predict(self, *args, **kwargs):
+        raise self.exc
+
+
+@pytest.fixture
+def stub_engine():
+    def install(exc):
+        serve.install_engine(_ShedStub(exc))
+
+    yield install
+    serve.install_engine(None)
+
+
+def test_queue_full_maps_to_429_with_retry_after(
+    client, batch_payload, stub_engine
+):
+    stub_engine(QueueFullError(7, 1.6))
+    for url in (
+        f"/gordo/v0/{PROJECT}/batch-a/prediction",
+        f"/gordo/v0/{PROJECT}/batch-a/anomaly/prediction",
+    ):
+        payload = dict(batch_payload, y=batch_payload["X"])
+        resp = client.post(url, json=payload)
+        assert resp.status_code == 429
+        assert resp.headers["Retry-After"] == "2"
+        assert "retry" in json.loads(resp.data)["error"].lower()
+
+
+def test_deadline_exceeded_maps_to_504(client, batch_payload, stub_engine):
+    stub_engine(DeadlineExceeded("missed"))
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/batch-a/prediction", json=batch_payload
+    )
+    assert resp.status_code == 504
+
+
+def test_batching_disabled_is_the_default(client, batch_payload):
+    """Without the master switch nothing is installed and the routes
+    serve exactly as before (the fallback IS the default)."""
+    assert serve.get_engine() is None
+    assert not serve.batching_enabled()
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/batch-a/prediction", json=batch_payload
+    )
+    assert resp.status_code == 200

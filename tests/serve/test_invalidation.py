@@ -1,0 +1,154 @@
+"""
+DELETE-revision racing in-flight scoring: ``STORE.invalidate()`` while a
+fleet request is mid-batch must neither 500 later requests nor serve
+parameters from the deleted revision afterwards.
+
+The consistency contract under the race: requests already queued when the
+delete lands score against the revision snapshot they were admitted under
+(the engine's batch key pins the RevisionFleet OBJECT, whose params are
+device-resident independent of the directory) — while every request
+arriving AFTER the invalidation re-resolves through the store and either
+loads fresh artifacts or answers the route's 404/410, never a 500 and
+never stale params.
+"""
+
+import json
+import shutil
+
+import numpy as np
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu.server import build_app
+from gordo_tpu.server.fleet_store import STORE
+
+from tests.serve.conftest import (
+    BATCH_NAMES,
+    PROJECT,
+    REVISION,
+    installed_engine,
+    run_threads,
+    temp_env_vars,
+    tiny_config,
+)
+
+pytestmark = pytest.mark.serve
+
+OLD_REVISION = str(int(REVISION) + 1)
+
+
+@pytest.fixture
+def disposable_revision(serve_collection_dir, tmp_path):
+    """A throwaway copy of the serve collection the test may delete."""
+    root = tmp_path / "collection"
+    live = root / REVISION
+    doomed = root / OLD_REVISION
+    shutil.copytree(serve_collection_dir, live)
+    shutil.copytree(serve_collection_dir, doomed)
+    yield str(live), str(doomed)
+    STORE.invalidate(str(live))
+    STORE.invalidate(str(doomed))
+
+
+def test_invalidate_mid_batch_keeps_inflight_and_later_requests_sane(
+    disposable_revision,
+):
+    """Engine-level race: items queued when invalidate-and-delete lands
+    still score (their key pins the old fleet's resident params); calls
+    after the delete fall back cleanly instead of raising or answering
+    from the deleted revision."""
+    _, doomed = disposable_revision
+    fleet = STORE.fleet(doomed)
+    fleet.warm(BATCH_NAMES)
+    model = fleet.model("batch-a")
+    X = np.random.RandomState(0).rand(6, 4).astype(np.float32)
+    reference = np.asarray(model.predict(X))
+
+    # flush window long enough that every submit (and the delete) lands
+    # while the batch is still queued — the "mid-batch" of the contract
+    with installed_engine(tiny_config(max_delay_ms=1000.0)) as engine:
+        results = [None] * 4
+
+        def hit(i):
+            results[i] = engine.batched_predict(doomed, "batch-a", model, X)
+
+        import threading
+        import time
+
+        threads = [threading.Thread(target=hit, args=(i,)) for i in range(4)]
+        for thread in threads:
+            thread.start()
+        # all four admitted and queued (none flushed yet) ...
+        deadline = time.monotonic() + 5.0
+        while engine._batcher.pending() < 4:
+            assert time.monotonic() < deadline, engine.stats()
+            time.sleep(0.005)
+        # ... THEN the race: revision deleted from disk + store mid-batch
+        STORE.invalidate(doomed)
+        shutil.rmtree(doomed)
+        for thread in threads:
+            thread.join(timeout=30)
+
+        for recon in results:
+            assert recon is not None
+            np.testing.assert_allclose(recon, reference, rtol=1e-4, atol=1e-5)
+
+        # later calls resolve a FRESH (empty) fleet for the gone dir:
+        # nothing loadable -> unbatched fallback (None), never stale rows
+        later_fleet = STORE.fleet(doomed)
+        assert later_fleet is not fleet
+        assert later_fleet.loaded_specs() == {}
+        assert engine.batched_predict(doomed, "batch-a", model, X) is None
+
+
+def test_delete_revision_route_mid_batch_never_500s_later_requests(
+    disposable_revision, batch_payload
+):
+    """Route-level race: concurrent batched requests pinned to an old
+    revision while DELETE removes that revision model-by-model. Every
+    response is a defined status (200 for admitted work, 404/410 once
+    the revision is gone) and the live revision keeps serving 200s."""
+    live, doomed = disposable_revision
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=live, GORDO_TPU_SERVE_WARMUP="0"
+    ):
+        app = build_app(config={"EXPECTED_MODELS": BATCH_NAMES})
+        with installed_engine(tiny_config(max_delay_ms=150.0)) as engine:
+            statuses = [None] * 6
+
+            def hit(i):
+                name = BATCH_NAMES[i % len(BATCH_NAMES)]
+                resp = Client(app).post(
+                    f"/gordo/v0/{PROJECT}/{name}/prediction",
+                    json=batch_payload,
+                    query_string={"revision": OLD_REVISION},
+                )
+                statuses[i] = resp.status_code
+
+            import threading
+
+            threads = [threading.Thread(target=hit, args=(i,)) for i in range(6)]
+            for thread in threads:
+                thread.start()
+            deleter = Client(app)
+            for name in BATCH_NAMES + ["odd-one"]:
+                resp = deleter.delete(
+                    f"/gordo/v0/{PROJECT}/{name}/revision/{OLD_REVISION}"
+                )
+                assert resp.status_code in (200, 404), resp.data
+            for thread in threads:
+                thread.join(timeout=30)
+
+            # defined outcomes only: scored, or a clean revision/model
+            # miss for arrivals after their model's deletion — never 500
+            assert all(code in (200, 404, 410) for code in statuses), statuses
+
+            # the engine never errored a batch, and the live revision is
+            # untouched by the old one's deletion
+            assert engine.stats().get("shed_queue_full", 0) == 0
+            resp = Client(app).post(
+                f"/gordo/v0/{PROJECT}/batch-a/prediction", json=batch_payload
+            )
+            assert resp.status_code == 200, resp.data
+            body = json.loads(resp.data)
+            assert "model-output" in body["data"]

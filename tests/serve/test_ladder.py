@@ -1,0 +1,42 @@
+"""Shape-ladder semantics: rung selection, env override, overflow."""
+
+import pytest
+
+from gordo_tpu.serve import ladder
+
+from tests.server.conftest import temp_env_vars
+
+pytestmark = pytest.mark.serve
+
+
+def test_parse_ladder_sorts_and_dedups():
+    assert ladder.parse_ladder("128, 32,32,512") == (32, 128, 512)
+
+
+@pytest.mark.parametrize("bad", ["", "0,32", "-4", "a,b"])
+def test_parse_ladder_rejects(bad):
+    with pytest.raises(ValueError):
+        ladder.parse_ladder(bad)
+
+
+def test_row_ladder_env_override_and_fallback():
+    with temp_env_vars(GORDO_TPU_BATCH_ROW_LADDER="16,64"):
+        assert ladder.row_ladder() == (16, 64)
+    with temp_env_vars(GORDO_TPU_BATCH_ROW_LADDER="not-a-ladder"):
+        # malformed env degrades to the default, never crashes serving
+        assert ladder.row_ladder() == ladder.DEFAULT_ROW_LADDER
+    assert ladder.row_ladder() == ladder.DEFAULT_ROW_LADDER
+
+
+def test_member_ladder_covers_max_size():
+    assert ladder.member_ladder(1) == (1,)
+    assert ladder.member_ladder(8) == (1, 2, 4, 8)
+    # non-power max still gets a covering top rung
+    assert ladder.member_ladder(6) == (1, 2, 4, 8)
+
+
+@pytest.mark.parametrize(
+    "n,expected", [(1, 8), (8, 8), (9, 32), (32, 32), (33, None)]
+)
+def test_pad_to_first_covering_rung(n, expected):
+    assert ladder.pad_to(n, (8, 32)) == expected
