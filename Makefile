@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve docs bench bench-telemetry bench-serve lint image
+.PHONY: test test-fast test-faults test-observability test-serve test-planner docs bench bench-telemetry bench-serve bench-planner lint image
 
 test:
 	python -m pytest tests/ -q
@@ -23,10 +23,21 @@ test-observability:
 test-serve:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serve
 
+# The build-planner suite: cost model + calibration, bucket packing,
+# FleetPlan determinism/replay, plan-aware resume — CPU-only and not
+# slow-marked, so the same tests also run inside the tier-1 budget.
+test-planner:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m planner
+
 # Serving micro-batching benchmark: concurrent single-model requests
 # with batching off vs on; writes BENCH_SERVE.json.
 bench-serve:
 	JAX_PLATFORMS=cpu python benchmarks/bench_serve.py
+
+# Bucket-planner benchmark: a heterogeneous synthetic fleet built with
+# the naive vs packed strategies; writes BENCH_PLAN.json.
+bench-planner:
+	JAX_PLATFORMS=cpu python benchmarks/bench_planner.py
 
 # Telemetry-overhead microbench: a small CPU fleet build with telemetry
 # off vs on; writes BENCH_TELEMETRY.json for the bench trajectory.

@@ -406,6 +406,142 @@ def run_server_cli(
     )
 
 
+def _load_fleet_machines(machines_config: str) -> List[Machine]:
+    """Machines from a path to (or literal YAML of) a ``machines:``
+    document, project_name defaulted per machine — shared by
+    ``build-fleet`` and ``plan``."""
+    if os.path.isfile(machines_config):
+        with open(machines_config) as f:
+            config = yaml.safe_load(f)
+    else:
+        config = yaml.safe_load(machines_config)
+    project = config.get("project_name", "fleet-build")
+    machine_dicts = [dict(m) for m in config["machines"]]
+    for m in machine_dicts:
+        m.setdefault("project_name", project)
+    return [Machine.from_dict(m) for m in machine_dicts]
+
+
+def _load_planner_inputs(
+    plan_from: Optional[str], cost_table_path: Optional[str]
+):
+    """(FleetPlan, CostTable) from their CLI paths (None where absent);
+    unusable documents (stale version, torn JSON) become clean CLI
+    errors, not tracebacks."""
+    from ..planner import CostTable, FleetPlan
+
+    try:
+        fleet_plan = FleetPlan.load(plan_from) if plan_from else None
+    except ValueError as exc:
+        raise click.ClickException(f"--plan-from: {exc}") from exc
+    try:
+        cost_table = (
+            CostTable.load(cost_table_path) if cost_table_path else None
+        )
+    except ValueError as exc:
+        raise click.ClickException(f"--cost-table: {exc}") from exc
+    return fleet_plan, cost_table
+
+
+@click.command("plan")
+@click.argument("machines-config", envvar="MACHINES_CONFIG")
+@click.option(
+    "--strategy",
+    type=click.Choice(["naive", "packed"]),
+    default=None,
+    help="Bucket-construction strategy (default: GORDO_TPU_PLAN_STRATEGY "
+    "or naive). `packed` is the cost-model bin packer: geometric shape "
+    "ladders, per-bucket HBM caps, compile-budget rung merging.",
+)
+@click.option(
+    "--output",
+    "-o",
+    "output_path",
+    default=None,
+    type=click.Path(dir_okay=False, writable=True),
+    help="Write the FleetPlan JSON here (feed it to "
+    "`build-fleet --plan-from`).",
+)
+@click.option(
+    "--cost-table",
+    "cost_table_path",
+    default=None,
+    type=click.Path(exists=True, dir_okay=False),
+    help="Calibrated cost_table.json to cost buckets with "
+    "(default: the analytic table).",
+)
+@click.option(
+    "--calibrate-from",
+    default=None,
+    type=click.Path(exists=True, dir_okay=False),
+    help="Fit a cost table from this build_trace.jsonl first (the "
+    "telemetry trace of any previous build on the same backend) and "
+    "plan with it; persisted as cost_table.json beside the trace "
+    "unless --cost-table-out is given.",
+)
+@click.option(
+    "--cost-table-out",
+    default=None,
+    type=click.Path(dir_okay=False, writable=True),
+    help="Where --calibrate-from persists the fitted table.",
+)
+@click.option(
+    "--as-json",
+    "as_json",
+    is_flag=True,
+    help="Print the raw plan document instead of the table",
+)
+def plan_fleet(
+    machines_config: str,
+    strategy: Optional[str],
+    output_path: Optional[str],
+    cost_table_path: Optional[str],
+    calibrate_from: Optional[str],
+    cost_table_out: Optional[str],
+    as_json: bool,
+):
+    """
+    Emit and explain the FleetPlan a ``build-fleet`` of MACHINES_CONFIG
+    would run: every bucket with its member roster, padded shape,
+    predicted compile/run seconds, HBM footprint and padding waste —
+    deterministic (same config + cost table → byte-identical JSON, so
+    the plan hash is a stable identity the build journal records).
+
+    Data IS fetched and staged (bucket shapes depend on per-machine
+    sample counts), but nothing trains and no artifacts are written.
+    """
+    from ..parallel.fleet_build import FleetBuilder
+    from ..planner import COST_TABLE_FILE, calibrate, render_plan
+
+    _, cost_table = _load_planner_inputs(None, cost_table_path)
+    if calibrate_from:
+        cost_table = calibrate(calibrate_from, cost_table)
+        table_path = cost_table_out or os.path.join(
+            os.path.dirname(os.path.abspath(calibrate_from)), COST_TABLE_FILE
+        )
+        cost_table.save(table_path)
+        logger.info("Calibrated cost table written to %s", table_path)
+
+    machines = _load_fleet_machines(machines_config)
+    builder = FleetBuilder(
+        machines, plan_strategy=strategy, cost_table=cost_table
+    )
+    plan = builder.plan_only()
+    if builder.build_errors:
+        name, exc = next(iter(builder.build_errors.items()))
+        raise click.ClickException(
+            f"{len(builder.build_errors)} machine(s) could not be planned "
+            f"(first: {name}: {exc!r})"
+        )
+    if output_path:
+        plan.save(output_path)
+        logger.info("FleetPlan written to %s", output_path)
+    if as_json:
+        click.echo(plan.to_json(), nl=False)
+    else:
+        click.echo(render_plan(plan))
+
+
 @click.command("build-fleet")
 @click.argument("machines-config", envvar="MACHINES_CONFIG")
 @click.argument("output-dir", default="/data", envvar="OUTPUT_DIR")
@@ -437,6 +573,31 @@ def run_server_cli(
     "journaled complete (config-hash matched, artifact checksum-verified) "
     "are skipped; only the remainder is replanned and trained.",
 )
+@click.option(
+    "--plan-strategy",
+    type=click.Choice(["naive", "packed"]),
+    default=None,
+    help="Bucket-construction strategy (gordo_tpu.planner): naive = the "
+    "historical exact-key grouping (default, also via "
+    "GORDO_TPU_PLAN_STRATEGY), packed = cost-model bin packing with "
+    "geometric shape ladders, HBM caps and a compile budget.",
+)
+@click.option(
+    "--plan-from",
+    default=None,
+    type=click.Path(exists=True, dir_okay=False),
+    help="Replay a FleetPlan emitted by `gordo-tpu plan`: covered "
+    "members train in their planned buckets with their planned pad "
+    "targets (stable across --resume); uncovered members pack live.",
+)
+@click.option(
+    "--cost-table",
+    "cost_table_path",
+    default=None,
+    type=click.Path(exists=True, dir_okay=False),
+    help="Calibrated cost_table.json for the packed strategy's cost "
+    "model.",
+)
 def build_fleet(
     machines_config: str,
     output_dir: str,
@@ -444,6 +605,9 @@ def build_fleet(
     exceptions_reporter_file: str,
     exceptions_report_level: str,
     resume: bool,
+    plan_strategy: Optional[str],
+    plan_from: Optional[str],
+    cost_table_path: Optional[str],
 ):
     """
     Train a whole machine shard as mesh-sharded model batches on this TPU
@@ -459,19 +623,13 @@ def build_fleet(
     try:
         _maybe_init_distributed()
 
-        if os.path.isfile(machines_config):
-            with open(machines_config) as f:
-                config = yaml.safe_load(f)
-        else:
-            config = yaml.safe_load(machines_config)
         # ConfigMap dicts from `workflow generate` are fully resolved; a
         # hand-written document may instead carry project_name at the top
         # level (or omit it entirely for local runs).
-        project = config.get("project_name", "fleet-build")
-        machine_dicts = [dict(m) for m in config["machines"]]
-        for m in machine_dicts:
-            m.setdefault("project_name", project)
-        machines = [Machine.from_dict(m) for m in machine_dicts]
+        machines = _load_fleet_machines(machines_config)
+        fleet_plan, cost_table = _load_planner_inputs(
+            plan_from, cost_table_path
+        )
 
         from ..parallel.fleet_build import FleetBuilder
 
@@ -508,7 +666,12 @@ def build_fleet(
             output_dir,
             "" if is_coordinator else " (non-coordinator: side effects skipped)",
         )
-        builder = FleetBuilder(machines)
+        builder = FleetBuilder(
+            machines,
+            plan_strategy=plan_strategy,
+            fleet_plan=fleet_plan,
+            cost_table=cost_table,
+        )
         results = builder.build(
             output_dir if is_coordinator else None,
             model_register_dir=model_register_dir if is_coordinator else None,
@@ -975,6 +1138,7 @@ gordo_tpu_cli.add_command(workflow_cli)
 gordo_tpu_cli.add_command(client_cli)
 gordo_tpu_cli.add_command(build)
 gordo_tpu_cli.add_command(build_fleet)
+gordo_tpu_cli.add_command(plan_fleet)
 gordo_tpu_cli.add_command(build_status)
 gordo_tpu_cli.add_command(run_server_cli)
 gordo_tpu_cli.add_command(wait_for_models)

@@ -26,10 +26,9 @@ are independent of bucket composition and deterministic per seed.
 """
 
 import logging
-from collections import defaultdict
 from dataclasses import dataclass, replace
 from functools import lru_cache
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +43,19 @@ from ..models.training import (
     History,
     build_raw_fit_fn,
     segmented_config,
+)
+from ..planner.costmodel import (
+    CostModel,
+    spec_flops_per_sample,
+    spec_param_count,
+)
+# _round_up_pow2 (the historical dense pad target) now lives in the
+# planner — the naive strategy is its one implementation; re-exported
+# here for the long-standing import path.
+from ..planner.packing import (  # noqa: F401
+    _round_up_pow2,
+    naive_pad_target,
+    plan_train_buckets,
 )
 from ..utils.faults import InjectedDeviceError, fault_point
 from .mesh import make_mesh, model_data_sharding, model_sharding
@@ -151,6 +163,21 @@ def _bucket_nbytes(bucket) -> int:
             if member.y is not member.X:
                 total += member.y.nbytes
     return total
+
+
+def _calibration_attrs(
+    spec: ModelSpec, config: FitConfig, stacked_members: int, stacked_samples: int
+):
+    """The cost model's static features on a ``device_program`` span —
+    exactly what :func:`gordo_tpu.planner.costmodel.calibrate` reads back
+    from ``build_trace.jsonl`` to fit per-program correction factors."""
+    return dict(
+        params=spec_param_count(spec),
+        flops_per_sample=spec_flops_per_sample(spec),
+        stacked_members=int(stacked_members),
+        stacked_samples=int(stacked_samples),
+        epochs=config.epochs,
+    )
 
 
 def _traced_outputs(outputs):
@@ -378,11 +405,34 @@ class FleetTrainer:
         docstring for the shared-shuffle caveat). Applies to feedforward
         buckets without early stopping; everything else falls back to the
         unpacked program.
+    plan_strategy
+        Bucket-construction strategy (``gordo_tpu.planner``): ``naive``
+        (the historical exact-key grouping; the default, also via
+        ``GORDO_TPU_PLAN_STRATEGY``) or ``packed`` (cost-model bin
+        packing: geometric shape ladders, HBM caps, compile budget).
+    fleet_plan
+        An optional :class:`gordo_tpu.planner.FleetPlan`: members the
+        plan covers train in their planned buckets with their planned
+        pad targets; uncovered members (CV folds) pack live with
+        ``plan_strategy``.
+    cost_table
+        A calibrated :class:`gordo_tpu.planner.CostTable` for the packed
+        strategy's cost model (default: the analytic table).
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None, packing=None):
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        packing=None,
+        plan_strategy: Optional[str] = None,
+        fleet_plan: Optional[Any] = None,
+        cost_table: Optional[Any] = None,
+    ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.packing = packing
+        self.plan_strategy = plan_strategy
+        self.fleet_plan = fleet_plan
+        self.cost_table = cost_table
         #: lifetime count of device-error bucket bisection events (the
         #: FleetBuilder folds the per-build delta into its robustness
         #: counters / Prometheus export)
@@ -413,35 +463,15 @@ class FleetTrainer:
         return max(1, min(int(self.packing), n_members))
 
     # -- bucketing ----------------------------------------------------------
+    # Bucket construction lives in gordo_tpu.planner.packing
+    # (plan_train_buckets); the ``naive`` strategy there reproduces the
+    # grouping that used to be FleetTrainer.bucket/bucket_windowed.
 
-    @staticmethod
-    def bucket(
-        members: Sequence[FleetMember], config: FitConfig
-    ) -> Dict[Tuple, List[FleetMember]]:
-        """
-        Group members into compilation buckets. The padded sample count is
-        rounded up to the next power of two (≥ one batch) so ragged fleets
-        land in few distinct shapes.
-        """
-        buckets: Dict[Tuple, List[FleetMember]] = defaultdict(list)
-        for member in members:
-            n_padded = _round_up_pow2(member.n, config.batch_size)
-            buckets[(member.spec, n_padded)].append(member)
-        return dict(buckets)
-
-    # -- training -----------------------------------------------------------
-
-    @staticmethod
-    def bucket_windowed(
-        members: Sequence["WindowedFleetMember"], config: FitConfig
-    ) -> Dict[Tuple, List["WindowedFleetMember"]]:
-        """Windowed compilation buckets: (spec, padded series length, offset)."""
-        buckets: Dict[Tuple, List[WindowedFleetMember]] = defaultdict(list)
-        for member in members:
-            n_padded = _round_up_pow2(len(member.series), 1)
-            offset = len(member.series) - member.n_windows
-            buckets[(member.spec, n_padded, offset)].append(member)
-        return dict(buckets)
+    def cost_model(self) -> CostModel:
+        """The planner cost model bound to this trainer's mesh shape."""
+        shape = self.mesh.devices.shape
+        mesh_shape = (shape[0], shape[1] if len(shape) > 1 else 1)
+        return CostModel(self.cost_table, mesh_shape=mesh_shape)
 
     def train(
         self,
@@ -505,42 +535,64 @@ class FleetTrainer:
     ) -> List[FleetResult]:
         by_name: Dict[str, FleetResult] = {}
         failures: Dict[str, BaseException] = {}
-        dense = [m for m in members if isinstance(m, FleetMember)]
-        windowed = [m for m in members if isinstance(m, WindowedFleetMember)]
-        for (spec, n_padded), bucket in self.bucket(dense, config).items():
-            g = self._packing_factor(spec, len(bucket), config)
+        planned = plan_train_buckets(
+            members,
+            config,
+            strategy=self.plan_strategy,
+            cost_model=self.cost_model(),
+            plan=self.fleet_plan,
+        )
+        def bucket_m_padded(pb, b):
+            """The planned member-axis floor — only while the bucket is
+            intact. A bisected half (the OOM recovery ladder) must NOT
+            pad back up to the planned rung, or every half re-OOMs at
+            the original shape and bisection can never converge."""
+            return pb.m_padded if len(b) == len(pb.members) else None
+
+        for pb in planned:
+            bucket = pb.members
+            if pb.windowed:
+                logger.info(
+                    "Windowed fleet bucket %s: %d models, spec=%s, padded_n=%d",
+                    pb.bucket_id,
+                    len(bucket),
+                    type(pb.spec).__name__,
+                    pb.n_padded,
+                )
+                self._run_bucket_degraded(
+                    lambda b, _p=pb: self._train_windowed_bucket(
+                        _p.spec, _p.n_padded, _p.offset, b, config,
+                        m_padded=bucket_m_padded(_p, b),
+                    ),
+                    bucket,
+                    by_name,
+                    failures,
+                )
+                continue
+            # Sibling HBM-split buckets rely on the shared m_padded rung
+            # for their one-compile contract; the block-diagonal packed
+            # program has no member-axis floor, so those buckets skip it.
+            g = (
+                self._packing_factor(pb.spec, len(bucket), config)
+                if pb.m_padded is None
+                else 1
+            )
             logger.info(
-                "Fleet bucket: %d models, spec=%s, padded_n=%d%s",
+                "Fleet bucket %s: %d models, spec=%s, padded_n=%d%s",
+                pb.bucket_id,
                 len(bucket),
-                type(spec).__name__,
-                n_padded,
+                type(pb.spec).__name__,
+                pb.n_padded,
                 f", packed x{g}" if g > 1 else "",
             )
-            train_bucket = (
-                (lambda s, n, b, c: self._train_bucket_packed(s, n, b, c, g))
-                if g > 1
-                else self._train_bucket
-            )
             self._run_bucket_degraded(
-                lambda b, _fit=train_bucket, _s=spec, _n=n_padded: _fit(
-                    _s, _n, b, config
-                ),
-                bucket,
-                by_name,
-                failures,
-            )
-        for (spec, n_padded, offset), bucket in self.bucket_windowed(
-            windowed, config
-        ).items():
-            logger.info(
-                "Windowed fleet bucket: %d models, spec=%s, padded_n=%d",
-                len(bucket),
-                type(spec).__name__,
-                n_padded,
-            )
-            self._run_bucket_degraded(
-                lambda b, _s=spec, _n=n_padded, _o=offset: (
-                    self._train_windowed_bucket(_s, _n, _o, b, config)
+                lambda b, _p=pb, _g=g: (
+                    self._train_bucket_packed(_p.spec, _p.n_padded, b, config, _g)
+                    if _g > 1
+                    else self._train_bucket(
+                        _p.spec, _p.n_padded, b, config,
+                        m_padded=bucket_m_padded(_p, b),
+                    )
                 ),
                 bucket,
                 by_name,
@@ -610,7 +662,12 @@ class FleetTrainer:
             by_name[result.name] = result
 
     def _stack_bucket(
-        self, spec: ModelSpec, n_padded: int, bucket: List[FleetMember], config: FitConfig
+        self,
+        spec: ModelSpec,
+        n_padded: int,
+        bucket: List[FleetMember],
+        config: FitConfig,
+        m_padded: Optional[int] = None,
     ):
         """Stack + mask a bucket; returns device-sharded arrays.
 
@@ -618,10 +675,14 @@ class FleetTrainer:
         of the mesh's model-axis size (sharding requires divisibility);
         dummy results are dropped by the caller. The sample axis is padded
         to a multiple of the data-axis size for the same reason.
+        ``m_padded`` raises the member-axis floor further (the packed
+        planner pads sibling HBM-split buckets to one shared rung so they
+        reuse a single compiled program).
         """
         model_axis = self.mesh.devices.shape[0]
         data_axis = self.mesh.devices.shape[1] if self.mesh.devices.ndim > 1 else 1
-        m_total = -(-len(bucket) // model_axis) * model_axis
+        m_floor = max(len(bucket), m_padded or 0)
+        m_total = -(-m_floor // model_axis) * model_axis
         # The sample axis must stay a whole number of batches (the fit
         # program reshapes [steps, batch]) AND divide across the data axis.
         step = int(np.lcm(config.batch_size, data_axis))
@@ -668,8 +729,11 @@ class FleetTrainer:
         n_padded: int,
         bucket: List[FleetMember],
         config: FitConfig,
+        m_padded: Optional[int] = None,
     ) -> List[FleetResult]:
-        X, y, wtr, wval, rngs = self._stack_bucket(spec, n_padded, bucket, config)
+        X, y, wtr, wval, rngs = self._stack_bucket(
+            spec, n_padded, bucket, config, m_padded=m_padded
+        )
         params, opt_state, rngs = self._init_bucket_params(spec, rngs)
         fit = _fleet_fit_program(spec, config)
         with telemetry.program_span(
@@ -679,6 +743,7 @@ class FleetTrainer:
             shape=str(tuple(X.shape)),
             spec=type(spec).__name__,
             bytes=_bucket_nbytes(bucket),
+            **_calibration_attrs(spec, config, X.shape[0], X.shape[1]),
         ):
             params, _, losses, val_losses, epochs_ran = _traced_outputs(
                 fit(params, opt_state, X, y, wtr, X, y, wval, rngs)
@@ -772,6 +837,7 @@ class FleetTrainer:
             shape=str(tuple(X.shape)),
             spec=type(spec).__name__,
             bytes=_bucket_nbytes(bucket),
+            **_calibration_attrs(spec, config, m_total, n_padded),
         ):
             params, _, losses, val_losses = _traced_outputs(
                 fit(
@@ -836,6 +902,7 @@ class FleetTrainer:
         offset: int,
         bucket: List[WindowedFleetMember],
         config: FitConfig,
+        m_padded: Optional[int] = None,
     ):
         """Stack a windowed bucket; series replicated over the data axis.
 
@@ -845,7 +912,8 @@ class FleetTrainer:
         """
         model_axis = self.mesh.devices.shape[0]
         data_axis = self.mesh.devices.shape[1] if self.mesh.devices.ndim > 1 else 1
-        m_total = -(-len(bucket) // model_axis) * model_axis
+        m_floor = max(len(bucket), m_padded or 0)
+        m_total = -(-m_floor // model_axis) * model_axis
         nw_padded = n_padded - offset
         step = int(np.lcm(config.batch_size, data_axis))
         nv_padded = -(-nw_padded // step) * step
@@ -911,9 +979,10 @@ class FleetTrainer:
         offset: int,
         bucket: List[WindowedFleetMember],
         config: FitConfig,
+        m_padded: Optional[int] = None,
     ) -> List[FleetResult]:
         series, ytgt, order, wtr, wval, rngs = self._stack_windowed_bucket(
-            spec, n_padded, offset, bucket, config
+            spec, n_padded, offset, bucket, config, m_padded=m_padded
         )
         params, opt_state, rngs = self._init_bucket_params(spec, rngs)
         segments = self._segmented_eligible(bucket, config)
@@ -922,6 +991,9 @@ class FleetTrainer:
             shape=str(tuple(series.shape)),
             spec=type(spec).__name__,
             bytes=_bucket_nbytes(bucket),
+            **_calibration_attrs(
+                spec, config, series.shape[0], order.shape[1]
+            ),
         )
         if segments is not None:
             logger.info(
@@ -1087,15 +1159,6 @@ class FleetTrainer:
                 )
             )
         return out[:m, :nv]
-
-
-def _round_up_pow2(n: int, batch_size: int) -> int:
-    """Pad target: next power of two, at least one full batch."""
-    target = max(n, batch_size)
-    power = 1
-    while power < target:
-        power <<= 1
-    return ((power + batch_size - 1) // batch_size) * batch_size
 
 
 def stack_member_params(results: Sequence[FleetResult]):

@@ -28,6 +28,7 @@ import datetime
 import logging
 import os
 import time
+import types
 from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -186,6 +187,9 @@ class FleetBuilder:
         data_retries: Optional[int] = None,
         data_backoff: Optional[float] = None,
         data_deadline: Optional[float] = None,
+        plan_strategy: Optional[str] = None,
+        fleet_plan: Optional[Any] = None,
+        cost_table: Optional[Any] = None,
     ):
         self.machines = list(machines)
         if trainer is None:
@@ -197,7 +201,23 @@ class FleetBuilder:
             if packing and packing != "auto":
                 packing = int(packing)
             trainer = FleetTrainer(packing=packing)
+        # Bucket planning (gordo_tpu.planner): strategy / pre-computed
+        # FleetPlan / calibrated cost table ride on the trainer — it is
+        # the component that materializes buckets. Explicit arguments win
+        # over whatever the (possibly caller-provided) trainer carries.
+        if plan_strategy is not None:
+            trainer.plan_strategy = plan_strategy
+        if fleet_plan is not None:
+            trainer.fleet_plan = fleet_plan
+        if cost_table is not None:
+            trainer.cost_table = cost_table
         self.trainer = trainer
+        # A plan handed in (directly or already on the trainer) is
+        # REPLAYED; otherwise each build computes a fresh one — a trainer
+        # reused across builds must not leak the previous fleet's plan
+        # (or the strategy a replayed plan switched it to).
+        self._external_plan = getattr(trainer, "fleet_plan", None)
+        self._external_strategy = getattr(trainer, "plan_strategy", None)
         self.data_workers = data_workers
         # The reference DAG runs with failFast:false
         # (argo-workflow.yml.template: one machine's builder pod failing
@@ -243,18 +263,25 @@ class FleetBuilder:
         self.recorder: Any = telemetry.NULL_RECORDER
         self.progress: Optional[telemetry.BuildProgress] = None
         self._project = ""
+        # Predicted-vs-actual bookkeeping for the FleetPlan: the span
+        # listener attributes final-fit device programs here so the
+        # cost model's error is observable (event + gauges at build end).
+        self._current_phase = ""
+        self._plan_actuals: Dict[str, float] = defaultdict(float)
 
     @contextlib.contextmanager
     def _phase(self, name: str):
         if self.progress is not None:
             self.progress.phase(name)
         start = time.time()
+        previous_phase, self._current_phase = self._current_phase, name
         try:
             with self.recorder.span(
                 "build_phase", phase=name, machines=len(self.machines)
             ):
                 yield
         finally:
+            self._current_phase = previous_phase
             self.phase_seconds[name] += time.time() - start
 
     def _fail(self, name: str, exc: BaseException):
@@ -337,6 +364,7 @@ class FleetBuilder:
         self.degraded = {}
         self.resumed = []
         self._journal = None
+        self._plan_actuals = defaultdict(float)
         self._project = self.machines[0].project_name if self.machines else ""
 
         recorder: Any = telemetry.NULL_RECORDER
@@ -369,9 +397,19 @@ class FleetBuilder:
                     project=self._project,
                     machines=len(self.machines),
                 ):
-                    results = self._run_build(
-                        output_dir, model_register_dir, replace_cache, resume
-                    )
+                    try:
+                        results = self._run_build(
+                            output_dir, model_register_dir, replace_cache, resume
+                        )
+                    finally:
+                        # The build-computed plan (and any strategy a
+                        # replayed plan switched the trainer to) must not
+                        # outlive the build on a shared trainer: a later
+                        # FleetBuilder reusing this trainer would
+                        # otherwise replay THIS fleet's plan as if the
+                        # caller had passed it.
+                        self.trainer.fleet_plan = self._external_plan
+                        self.trainer.plan_strategy = self._external_strategy
         except Exception:
             # a build-level failure (per-machine failures do NOT raise);
             # SystemExit/KeyboardInterrupt skip this on purpose — a
@@ -464,6 +502,7 @@ class FleetBuilder:
                 )
             self._journal.flush()
         plans = self._load_all_data(plans)
+        self._prepare_fleet_plan(plans, output_dir)
 
         def alive(ps):
             return [p for p in ps if not self._skipped(p.machine.name)]
@@ -560,6 +599,7 @@ class FleetBuilder:
             0, getattr(self.trainer, "bucket_bisects", 0) - trainer_bisects_start
         )
         self._record_prometheus(machines)
+        self._export_plan_accuracy()
         return [
             (model, machine)
             for model, machine in results
@@ -572,12 +612,22 @@ class FleetBuilder:
         final losses land in /metrics as they happen, not at build end.
         Best-effort like every metrics path: the build must not care
         whether a Prometheus stack is configured."""
+        name = span["name"]
+        attrs = span.get("attributes") or {}
+        seconds = float(span.get("duration_ms") or 0.0) / 1000.0
+        if (
+            name == "device_program"
+            and self._current_phase == "final_fit"
+            and str(attrs.get("program", "")).endswith("_fit")
+        ):
+            # The plan covers exactly the final-fit fit programs; their
+            # observed cost is the plan's predicted-vs-actual 'actual'.
+            self._plan_actuals["seconds"] += seconds
+            if attrs.get("compile"):
+                self._plan_actuals["compiles"] += 1
         try:
             from ..server.prometheus import metrics as prom
 
-            name = span["name"]
-            attrs = span.get("attributes") or {}
-            seconds = float(span.get("duration_ms") or 0.0) / 1000.0
             if name == "build_phase":
                 prom.record_fleet_build_phase(
                     self._project, str(attrs.get("phase", "")), seconds
@@ -738,6 +788,221 @@ class FleetBuilder:
             pipeline=pipeline,
             estimator=obj,
         )
+
+    # ------------------------------------------------------- bucket planning
+
+    def _final_fit_plans(self, plans: List[_Plan]) -> List[_Plan]:
+        """The plans whose machines will take the final fit (the member
+        set a FleetPlan covers; ``cross_val_only`` machines never final-
+        fit, and CV fold members pack live by design — fold models are
+        shape-twins of their machine, differing only in weight masks)."""
+        return [
+            p
+            for p in plans
+            if not self._skipped(p.machine.name)
+            and p.machine.evaluation.get("cv_mode", "full_build").lower()
+            != "cross_val_only"
+        ]
+
+    def _plan_strategy_name(self) -> str:
+        from ..planner import default_strategy
+
+        return self.trainer.plan_strategy or default_strategy()
+
+    @staticmethod
+    def _plan_member_proxy(plan: _Plan):
+        """A shape-only stand-in for the member ``plan`` will train: the
+        packer reads name/spec/sample-count/aliasing, and building REAL
+        members here would materialize every machine's shuffled window
+        copies during the bucket_plan phase — resident through all of CV
+        instead of appearing one final-fit bucket at a time."""
+        if plan.windows is None:
+            return types.SimpleNamespace(
+                name=plan.machine.name,
+                spec=plan.spec,
+                series=range(len(plan.X_arr)),
+                n_windows=len(plan.targets),
+            )
+        x_token = object()
+        return types.SimpleNamespace(
+            name=plan.machine.name,
+            spec=plan.spec,
+            n=len(plan.windows),
+            X=x_token,
+            y=x_token if plan.windows is plan.targets else object(),
+        )
+
+    def _compute_fleet_plan(self, final_plans: List[_Plan], strategy: str):
+        """Pack the final-fit members into buckets and assemble the
+        deterministic :class:`~gordo_tpu.planner.FleetPlan` artifact."""
+        from .. import planner
+
+        by_config: Dict[FitConfig, List[Any]] = {}
+        for plan in final_plans:
+            by_config.setdefault(plan.fit_config, []).append(
+                self._plan_member_proxy(plan)
+            )
+        cost_model = self.trainer.cost_model()
+        buckets_by_config = [
+            (
+                config,
+                planner.plan_train_buckets(
+                    members, config, strategy=strategy, cost_model=cost_model
+                ),
+            )
+            for config, members in by_config.items()
+        ]
+        fingerprint = planner.config_fingerprint(
+            [
+                self._config_hashes.get(p.machine.name)
+                or ModelBuilder.calculate_cache_key(p.machine)
+                for p in final_plans
+            ]
+        )
+        return planner.build_plan_doc(
+            buckets_by_config,
+            strategy,
+            cost_model.mesh_shape,
+            cost_model.table,
+            fingerprint,
+        )
+
+    def _prepare_fleet_plan(self, plans: List[_Plan], output_dir: Optional[str]):
+        """Fix the final-fit bucket composition BEFORE training: replay
+        an externally provided plan (``build-fleet --plan-from``) or
+        compute a fresh one, hand it to the trainer, persist it beside
+        the artifacts, journal its hash, and export its predictions."""
+        from .. import planner
+
+        final_plans = self._final_fit_plans(plans)
+        strategy = self._plan_strategy_name()
+        if not final_plans:
+            return
+        with self._phase("bucket_plan"):
+            plan = self._external_plan
+            if plan is not None:
+                expected = planner.config_fingerprint(
+                    [
+                        self._config_hashes.get(p.machine.name)
+                        or ModelBuilder.calculate_cache_key(p.machine)
+                        for p in final_plans
+                    ]
+                )
+                recorded = str(plan.doc.get("config_fingerprint", ""))
+                if recorded and recorded != expected:
+                    # Stale plans stay usable: members it does not know
+                    # (or whose data outgrew their pad target) repack
+                    # live; warn so the operator re-plans eventually.
+                    logger.warning(
+                        "FleetPlan %s was computed for a different config "
+                        "set (fingerprint %s != %s); unknown members will "
+                        "be packed live",
+                        plan.plan_hash,
+                        recorded,
+                        expected,
+                    )
+                strategy = plan.strategy or strategy
+            else:
+                plan = self._compute_fleet_plan(final_plans, strategy)
+            self.trainer.fleet_plan = plan
+            # The strategy must ride with the plan: members the plan
+            # does not cover — every CV fold member, late additions —
+            # pack live with trainer.plan_strategy, and a packed plan
+            # replayed onto a default trainer would otherwise run its
+            # whole CV phase naive while journal and gauges say packed.
+            self.trainer.plan_strategy = strategy
+            totals = plan.totals
+            self.recorder.event(
+                "fleet_plan",
+                plan_hash=plan.plan_hash,
+                strategy=strategy,
+                replayed=self._external_plan is not None,
+                buckets=totals.get("buckets", 0),
+                members=totals.get("members", 0),
+                compiles=totals.get("compiles", 0),
+                predicted_wall_s=totals.get("predicted_wall_s", 0.0),
+                padding_waste=totals.get("padding_waste", 0.0),
+            )
+            if output_dir is not None:
+                try:
+                    plan.save(os.path.join(output_dir, planner.PLAN_FILE))
+                except OSError as exc:
+                    logger.warning("FleetPlan not persisted: %r", exc)
+            if self._journal is not None:
+                # The replay-vs-replan signal --resume acts on: a resumed
+                # build whose plan hash changed is REPLANNING the
+                # remaining members (config or strategy drift), not
+                # replaying the journaled build's shapes.
+                previous = self._journal.plan()
+                if previous and previous.get("plan_hash") != plan.plan_hash:
+                    logger.info(
+                        "FleetPlan %s differs from the journaled %s: "
+                        "remaining members are replanned%s",
+                        plan.plan_hash,
+                        previous.get("plan_hash"),
+                        ""
+                        if self._external_plan is None
+                        else " (a different --plan-from was supplied)",
+                    )
+                self._journal.set_plan(plan.plan_hash, strategy)
+            try:
+                from ..server.prometheus.metrics import set_fleet_plan_prediction
+
+                set_fleet_plan_prediction(
+                    self._project,
+                    strategy,
+                    float(totals.get("predicted_wall_s", 0.0)),
+                    float(totals.get("padding_waste", 0.0)),
+                    int(totals.get("compiles", 0)),
+                )
+            except Exception as exc:  # noqa: BLE001 - metrics are advisory
+                logger.debug("Plan prediction gauges not exported: %r", exc)
+
+    def plan_only(self):
+        """Plan without training: machine planning + data fetch/stage +
+        bucket packing, returning the :class:`~gordo_tpu.planner.FleetPlan`
+        the `gordo-tpu plan` CLI renders and ``build-fleet --plan-from``
+        replays. Machines that would fall back to the sequential builder
+        (unsupported definitions) are not part of a fleet plan."""
+        plans, fallbacks = self._plan_all()
+        if fallbacks:
+            logger.info(
+                "%d machine(s) use the sequential builder and are not "
+                "fleet-planned: %s",
+                len(fallbacks),
+                ", ".join(m.name for m in fallbacks[:5]),
+            )
+        plans = self._load_all_data(plans)
+        return self._compute_fleet_plan(
+            self._final_fit_plans(plans), self._plan_strategy_name()
+        )
+
+    def _export_plan_accuracy(self):
+        """Predicted-vs-actual at build end: what the FleetPlan promised
+        against the final-fit fit-programs the span listener observed."""
+        plan = getattr(self.trainer, "fleet_plan", None)
+        if plan is None:
+            return
+        totals = plan.totals
+        actual_seconds = round(float(self._plan_actuals.get("seconds", 0.0)), 3)
+        actual_compiles = int(self._plan_actuals.get("compiles", 0))
+        self.recorder.event(
+            "fleet_plan_accuracy",
+            plan_hash=plan.plan_hash,
+            strategy=plan.strategy,
+            predicted_compiles=totals.get("compiles", 0),
+            actual_compiles=actual_compiles,
+            predicted_wall_s=totals.get("predicted_wall_s", 0.0),
+            actual_fit_s=actual_seconds,
+        )
+        try:
+            from ..server.prometheus.metrics import set_fleet_plan_actuals
+
+            set_fleet_plan_actuals(
+                self._project, plan.strategy, actual_seconds, actual_compiles
+            )
+        except Exception as exc:  # noqa: BLE001 - metrics are advisory
+            logger.debug("Plan actuals not exported: %r", exc)
 
     # ---------------------------------------------------------------- data
 

@@ -62,6 +62,11 @@ class BuildJournal:
         self.events_path = os.path.join(output_dir, EVENTS_FILE)
         self._lock = threading.Lock()
         self._machines: Dict[str, Dict[str, Any]] = {}
+        # Build-level FleetPlan identity (gordo_tpu.planner): which plan
+        # hash / strategy produced this build's buckets. A resume reads
+        # it to tell a replay (same plan) from a replan (hash changed —
+        # only non-resumed members get new bucket compositions).
+        self._plan: Dict[str, Any] = {}
 
     @classmethod
     def load(cls, output_dir: str) -> "BuildJournal":
@@ -79,6 +84,9 @@ class BuildJournal:
                     for name, entry in machines.items()
                     if isinstance(entry, dict)
                 }
+            plan = state.get("plan")
+            if isinstance(plan, dict):
+                journal._plan = dict(plan)
         except FileNotFoundError:
             pass
         except (OSError, ValueError) as exc:
@@ -144,12 +152,30 @@ class BuildJournal:
                 with open(self.events_path, "a") as f:
                     f.write(json.dumps({"name": name, **entry}, default=str) + "\n")
 
+    def plan(self) -> Dict[str, Any]:
+        """The recorded FleetPlan identity (``{}`` when the build ran
+        without a planner plan — e.g. the pure naive path pre-plan)."""
+        with self._lock:
+            return dict(self._plan)
+
+    def set_plan(
+        self, plan_hash: str, strategy: str, flush: bool = True
+    ) -> None:
+        """Record the build's FleetPlan identity (hash + strategy); a
+        later ``--resume`` compares hashes to tell replay from replan."""
+        with self._lock:
+            self._plan = {"plan_hash": str(plan_hash), "strategy": str(strategy)}
+        if flush:
+            self.flush()
+
     def flush(self) -> None:
         """Atomically persist the full state and compact the event
         overlay into it: a crash mid-flush leaves the previous complete
         journal (plus its overlay), never a torn file."""
         with self._lock:
             state = {"version": 1, "machines": self._machines}
+            if self._plan:
+                state["plan"] = self._plan
             payload = json.dumps(state, indent=1, sort_keys=True, default=str)
             os.makedirs(self.output_dir, exist_ok=True)
             # Dotted staging-convention name (`.build_state.json.tmp-*`):

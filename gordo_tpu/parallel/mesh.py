@@ -18,6 +18,7 @@ ICI/DCN without change.
 """
 
 import logging
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -28,6 +29,48 @@ logger = logging.getLogger(__name__)
 
 MODEL_AXIS = "models"
 DATA_AXIS = "data"
+
+#: directory for JAX's persistent compilation cache — repeated fleet
+#: builds and server restarts reuse compiled programs instead of paying
+#: the XLA compile again (the FleetPlan's compile-count predictions
+#: count *cold* compiles; a warm cache turns them into disk loads)
+COMPILE_CACHE_ENV = "GORDO_TPU_COMPILE_CACHE"
+
+_compile_cache_configured = False
+
+
+def configure_compile_cache() -> Optional[str]:
+    """
+    Point JAX's persistent compilation cache at ``$GORDO_TPU_COMPILE_CACHE``
+    (no-op when unset). Idempotent — called from every mesh/backend init
+    path so any entrypoint (build, plan, serve) gets the same cache.
+
+    The min-compile-time threshold is zeroed: fleet programs are many
+    small autoencoders, and JAX's 1s default would skip exactly the
+    programs a heterogeneous fleet recompiles most often.
+    """
+    global _compile_cache_configured
+    cache_dir = os.getenv(COMPILE_CACHE_ENV)
+    if not cache_dir:
+        return None
+    if _compile_cache_configured:
+        return cache_dir
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except (OSError, AttributeError, ValueError) as exc:
+        logger.warning(
+            "Persistent compile cache not enabled (%s=%r): %r",
+            COMPILE_CACHE_ENV,
+            cache_dir,
+            exc,
+        )
+        return None
+    _compile_cache_configured = True
+    logger.info("JAX persistent compilation cache at %s", cache_dir)
+    return cache_dir
 
 
 def initialize_backend(
@@ -41,6 +84,7 @@ def initialize_backend(
     backend" row (which was k8s pod fan-out, SURVEY.md §2.9) with XLA
     collectives over ICI/DCN.
     """
+    configure_compile_cache()
     if coordinator_address is None:
         return
     jax.distributed.initialize(
@@ -61,6 +105,7 @@ def make_mesh(
     ``data_parallelism`` chips cooperate per model shard; the rest of the
     device count spreads the model axis.
     """
+    configure_compile_cache()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if n % data_parallelism != 0:

@@ -1,0 +1,98 @@
+"""
+Cost-model-driven fleet build planning.
+
+The fleet trainer's original bucketing is purely syntactic: members
+group by exact ``(spec, round_up_pow2(n))`` keys, so heterogeneous
+fleets fragment into many compiles, pow2 padding wastes up to ~2x FLOPs
+per axis, and over-packed buckets are only discovered reactively by the
+device-error bisection ladder. This package turns bucket construction
+into explicit, explainable, cost-optimized scheduling:
+
+- :mod:`~gordo_tpu.planner.ladder` — the shared shape ladders; build and
+  serve quantize with the same code, so a fleet planned here warms the
+  same programs the serving engine batches into.
+- :mod:`~gordo_tpu.planner.costmodel` — an analytic compile + step-time
+  + HBM estimator per bucket shape, with :func:`calibrate` fitting
+  correction factors from the telemetry trace (``build_trace.jsonl``)
+  and persisting them as a versioned ``cost_table.json`` — the "static
+  features plus a small calibration set" recipe of the learned-TPU-
+  cost-model line of work (PAPERS.md).
+- :mod:`~gordo_tpu.planner.packing` — bucket construction as bin
+  packing: geometric shape ladders, best-fit-decreasing over members
+  with per-bucket HBM caps (split *before* the OOM, not bisect after),
+  and a compile-budget knob trading padding waste against program count.
+- :mod:`~gordo_tpu.planner.plan` — the deterministic, JSON-serializable
+  :class:`FleetPlan` artifact (buckets, predicted wall-clock / compiles
+  / padding waste / HBM, config hash for journal compatibility).
+- :mod:`~gordo_tpu.planner.report` — the human-readable plan table.
+
+Dependency direction: this package imports model specs and stdlib only —
+never ``parallel``/``serializer``/``server`` — so the trainer can import
+it without cycles.
+"""
+
+from .costmodel import (
+    COST_TABLE_FILE,
+    CostModel,
+    CostTable,
+    calibrate,
+    spec_flops_per_sample,
+    spec_param_count,
+)
+from .ladder import (
+    DEFAULT_ROW_LADDER,
+    geometric_rungs,
+    member_ladder,
+    pad_to,
+    parse_ladder,
+    round_up_ladder,
+    row_ladder,
+    sample_pad_ratio,
+    series_pad_ratio,
+)
+from .packing import (
+    NAIVE,
+    PACKED,
+    STRATEGIES,
+    PlannedBucket,
+    default_strategy,
+    plan_train_buckets,
+)
+from .plan import (
+    PLAN_FILE,
+    FleetPlan,
+    PlanError,
+    build_plan_doc,
+    config_fingerprint,
+)
+from .report import render_plan
+
+__all__ = [
+    "COST_TABLE_FILE",
+    "CostModel",
+    "CostTable",
+    "DEFAULT_ROW_LADDER",
+    "FleetPlan",
+    "NAIVE",
+    "PACKED",
+    "PLAN_FILE",
+    "PlanError",
+    "PlannedBucket",
+    "STRATEGIES",
+    "build_plan_doc",
+    "calibrate",
+    "config_fingerprint",
+    "default_strategy",
+    "geometric_rungs",
+    "member_ladder",
+    "pad_to",
+    "parse_ladder",
+    "plan_train_buckets",
+    "render_plan",
+    "round_up_ladder",
+    "row_ladder",
+    "sample_pad_ratio",
+    "series_pad_ratio",
+    "spec_flops_per_sample",
+    "spec_param_count",
+]

@@ -1,0 +1,382 @@
+"""
+Analytic bucket cost model with trace-fitted correction factors.
+
+Per-program TPU cost is predictable from static features plus a small
+calibration set (the learned-performance-model line of work, PAPERS.md).
+This module is the smallest useful instance of that recipe:
+
+- **static features**: parameter count and padded training FLOPs derived
+  from the spec geometry alone (:func:`spec_param_count`,
+  :func:`spec_flops_per_sample`) — the planner never traces or compiles
+  anything to cost a candidate bucket;
+- **calibration**: :func:`calibrate` fits per-program correction factors
+  from the ``device_program`` spans PR 3's telemetry already records in
+  ``build_trace.jsonl`` (first-call-per-signature spans are compiles,
+  the rest steady-state runs), and persists them as a versioned
+  ``cost_table.json``.
+
+Absolute accuracy is NOT the point — bucket *ranking* is. The packer
+only ever compares candidate buckets of the same fleet against each
+other, so a constant-factor error cancels; the calibration exists to
+keep the compile-vs-run trade (the compile-budget knob) honest on the
+actual backend.
+"""
+
+import json
+import logging
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Optional, Tuple
+
+from ..models.spec import FeedForwardSpec, LSTMSpec, ModelSpec
+
+logger = logging.getLogger(__name__)
+
+#: canonical calibrated-table filename (beside the trace it was fit from)
+COST_TABLE_FILE = "cost_table.json"
+
+#: cost_table.json schema version — bump on shape changes so stale
+#: tables are rejected instead of silently misread
+COST_TABLE_VERSION = 1
+
+#: Adam keeps params + grads + two moment vectors resident per member
+_OPTIMIZER_COPIES = 4
+
+#: backward pass ≈ 2x the forward FLOPs (grad wrt inputs + weights)
+_TRAIN_FLOP_FACTOR = 3.0
+
+
+def spec_param_count(spec: ModelSpec) -> int:
+    """Trainable parameter count from the spec geometry alone."""
+    if isinstance(spec, FeedForwardSpec):
+        dims = (spec.n_features,) + tuple(spec.dims) + (spec.n_features_out,)
+        return sum(
+            d_in * d_out + d_out for d_in, d_out in zip(dims[:-1], dims[1:])
+        )
+    if isinstance(spec, LSTMSpec):
+        total = 0
+        d_in = spec.n_features
+        for d_h in spec.dims:
+            # 4 gates, each [d_in + d_h, d_h] + bias
+            total += 4 * (d_in * d_h + d_h * d_h + d_h)
+            d_in = d_h
+        total += d_in * spec.n_features_out + spec.n_features_out
+        return total
+    # Unknown spec types (future architectures): no geometry knowledge —
+    # callers treat 0 as "cost unknown, keep the member in its own group".
+    return 0
+
+
+def spec_flops_per_sample(spec: ModelSpec) -> float:
+    """Forward-pass FLOPs for ONE sample (one window for LSTM specs —
+    the recurrence runs ``lookback_window`` steps per window)."""
+    if isinstance(spec, FeedForwardSpec):
+        dims = (spec.n_features,) + tuple(spec.dims) + (spec.n_features_out,)
+        return float(
+            sum(2 * d_in * d_out for d_in, d_out in zip(dims[:-1], dims[1:]))
+        )
+    if isinstance(spec, LSTMSpec):
+        per_step = 0.0
+        d_in = spec.n_features
+        for d_h in spec.dims:
+            per_step += 2.0 * 4 * (d_in + d_h) * d_h
+            d_in = d_h
+        head = 2.0 * d_in * spec.n_features_out
+        return per_step * spec.lookback_window + head
+    # ~2 FLOPs per parameter per sample is the dense-layer identity;
+    # use it as the generic fallback.
+    return 2.0 * spec_param_count(spec)
+
+
+@dataclass
+class CostTable:
+    """Versioned correction factors fit by :func:`calibrate`.
+
+    ``run_factors``/``compile_factors`` map program name (``fleet_fit``,
+    ``fleet_windowed_fit``, ...) to a multiplicative correction on the
+    analytic estimate; unseen programs fall back to 1.0. ``throughput``
+    and ``compile_per_flop`` are the analytic baseline constants the
+    factors correct — persisted so a table is self-contained.
+    """
+
+    #: sustained training throughput (FLOP/s) the analytic model divides
+    #: by; deliberately conservative-CPU-ish so an UNcalibrated model
+    #: still ranks buckets sanely on the test backend
+    throughput: float = 2.0e9
+    #: seconds of XLA compile per traced FLOP-per-sample unit, plus a
+    #: fixed per-program floor — compiles scale with program complexity
+    #: (op count ~ layer count ~ flops/sample), not with data volume
+    compile_per_flop: float = 2.0e-7
+    compile_floor_s: float = 0.35
+    #: per-program-dispatch fixed overhead (host dispatch + fetch)
+    dispatch_s: float = 0.01
+    run_factors: Dict[str, float] = field(default_factory=dict)
+    compile_factors: Dict[str, float] = field(default_factory=dict)
+    #: calibration provenance: sample counts per program
+    samples: Dict[str, int] = field(default_factory=dict)
+    version: int = COST_TABLE_VERSION
+
+    def to_dict(self) -> dict:
+        return {
+            "version": self.version,
+            "throughput": self.throughput,
+            "compile_per_flop": self.compile_per_flop,
+            "compile_floor_s": self.compile_floor_s,
+            "dispatch_s": self.dispatch_s,
+            "run_factors": dict(sorted(self.run_factors.items())),
+            "compile_factors": dict(sorted(self.compile_factors.items())),
+            "samples": dict(sorted(self.samples.items())),
+        }
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "CostTable":
+        version = int(doc.get("version", 0))
+        if version != COST_TABLE_VERSION:
+            raise ValueError(
+                f"cost table version {version} != supported "
+                f"{COST_TABLE_VERSION}; re-run calibration"
+            )
+        return cls(
+            throughput=float(doc.get("throughput", cls.throughput)),
+            compile_per_flop=float(
+                doc.get("compile_per_flop", cls.compile_per_flop)
+            ),
+            compile_floor_s=float(doc.get("compile_floor_s", cls.compile_floor_s)),
+            dispatch_s=float(doc.get("dispatch_s", cls.dispatch_s)),
+            run_factors={
+                str(k): float(v) for k, v in (doc.get("run_factors") or {}).items()
+            },
+            compile_factors={
+                str(k): float(v)
+                for k, v in (doc.get("compile_factors") or {}).items()
+            },
+            samples={
+                str(k): int(v) for k, v in (doc.get("samples") or {}).items()
+            },
+            version=version,
+        )
+
+    def save(self, path: str) -> None:
+        payload = json.dumps(self.to_dict(), indent=1, sort_keys=True) + "\n"
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "CostTable":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    @property
+    def calibrated(self) -> bool:
+        return bool(self.run_factors or self.compile_factors)
+
+
+class CostModel:
+    """Bucket-shape cost estimates against a :class:`CostTable`.
+
+    ``mesh_shape`` is the trainer mesh's ``(model_axis, data_axis)`` —
+    the estimator replicates the trainer's shape rounding so predicted
+    program signatures (and therefore compile counts) match what XLA
+    will actually see.
+    """
+
+    def __init__(
+        self,
+        table: Optional[CostTable] = None,
+        mesh_shape: Tuple[int, int] = (1, 1),
+    ):
+        self.table = table or CostTable()
+        self.mesh_shape = (int(mesh_shape[0]), int(mesh_shape[1] or 1))
+
+    # -- shape replication --------------------------------------------------
+
+    def stacked_shape(
+        self, m: int, n_padded: int, batch_size: int
+    ) -> Tuple[int, int]:
+        """``(m_total, n_total)`` after the trainer's mesh rounding
+        (mirrors ``FleetTrainer._stack_bucket``): the model axis pads to
+        a multiple of the mesh's model axis, the sample axis to a whole
+        number of batches that also divides across the data axis."""
+        model_axis, data_axis = self.mesh_shape
+        m_total = -(-m // model_axis) * model_axis
+        step = abs(batch_size * data_axis) // math.gcd(batch_size, data_axis)
+        n_total = -(-n_padded // step) * step
+        return m_total, n_total
+
+    def stacked_windowed_shape(
+        self, m: int, n_padded: int, offset: int, batch_size: int
+    ) -> Tuple[int, int, int]:
+        """``(m_total, series_rows, windows_total)`` after the trainer's
+        windowed-stacker rounding (mirrors
+        ``FleetTrainer._stack_windowed_bucket``): the series axis stays
+        at ``n_padded`` exactly; only the virtual window axis mesh-rounds."""
+        model_axis, data_axis = self.mesh_shape
+        m_total = -(-m // model_axis) * model_axis
+        step = abs(batch_size * data_axis) // math.gcd(batch_size, data_axis)
+        nv_total = -(-(n_padded - offset) // step) * step
+        return m_total, n_padded, nv_total
+
+    # -- analytic estimates -------------------------------------------------
+
+    def train_flops(
+        self, spec: ModelSpec, m: int, n: int, epochs: int
+    ) -> float:
+        """Training FLOPs for ``m`` members × ``n`` (virtual) samples ×
+        ``epochs`` epochs at this spec."""
+        return (
+            _TRAIN_FLOP_FACTOR
+            * spec_flops_per_sample(spec)
+            * float(m)
+            * float(n)
+            * float(max(epochs, 1))
+        )
+
+    def predict_run_s(
+        self, program: str, spec: ModelSpec, m_total: int, n_total: int, epochs: int
+    ) -> float:
+        flops = self.train_flops(spec, m_total, n_total, epochs)
+        factor = self.table.run_factors.get(program, 1.0)
+        return factor * (flops / self.table.throughput) + self.table.dispatch_s
+
+    def predict_compile_s(self, program: str, spec: ModelSpec) -> float:
+        factor = self.table.compile_factors.get(program, 1.0)
+        return factor * (
+            self.table.compile_floor_s
+            + self.table.compile_per_flop * spec_flops_per_sample(spec)
+        )
+
+    def predict_hbm_bytes(
+        self,
+        spec: ModelSpec,
+        m_total: int,
+        n_total: int,
+        batch_size: int,
+        y_aliased: bool = True,
+        series_rows: Optional[int] = None,
+    ) -> int:
+        """Resident device bytes of one bucket's training program:
+        staged data + per-member params × optimizer copies + one batch
+        of activations. ``series_rows`` switches to the windowed layout
+        (series resident instead of materialized windows)."""
+        f_in = getattr(spec, "n_features", 1)
+        f_out = getattr(spec, "n_features_out", f_in)
+        if series_rows is not None:
+            data = m_total * series_rows * f_in + m_total * n_total * f_out
+        else:
+            data = m_total * n_total * f_in
+            if not y_aliased:
+                data += m_total * n_total * f_out
+        data += 3 * m_total * n_total  # train/val weights + epoch bookkeeping
+        params = spec_param_count(spec) * m_total * _OPTIMIZER_COPIES
+        width = max(
+            [f_in, f_out, *getattr(spec, "dims", ())] or [1]
+        )
+        lookback = getattr(spec, "lookback_window", 1)
+        activations = m_total * batch_size * width * (
+            len(getattr(spec, "dims", ())) + 2
+        ) * lookback
+        return 4 * int(data + params + activations)  # float32
+
+
+def calibrate(
+    trace_path: str, table: Optional[CostTable] = None
+) -> CostTable:
+    """
+    Fit per-program correction factors from a ``build_trace.jsonl``.
+
+    Reads every ``device_program`` span carrying the planner's static
+    features (``params``/``flops_per_sample``/``members``/``epochs``,
+    recorded by the trainer's program spans), splits them into compile
+    (first call per signature) and run samples, and sets each program's
+    factor to the MEDIAN of actual/analytic ratios — median, not mean,
+    because a shared host's neighbor stalls put multi-second one-sided
+    outliers into any wall-clock sample set.
+
+    Returns a new :class:`CostTable`; the input ``table`` (default: the
+    analytic defaults) provides the baseline constants the factors
+    correct. Spans missing the static features (older traces) are
+    skipped.
+    """
+    base = table or CostTable()
+    model = CostModel(CostTable(  # factor-free baseline for the ratios
+        throughput=base.throughput,
+        compile_per_flop=base.compile_per_flop,
+        compile_floor_s=base.compile_floor_s,
+        dispatch_s=base.dispatch_s,
+    ))
+    run_ratios: Dict[str, list] = {}
+    compile_ratios: Dict[str, list] = {}
+    counts: Dict[str, int] = {}
+    for span in _iter_spans(trace_path):
+        if span.get("name") != "device_program":
+            continue
+        attrs = span.get("attributes") or {}
+        program = str(attrs.get("program", ""))
+        flops_per_sample = attrs.get("flops_per_sample")
+        if not program or flops_per_sample is None:
+            continue
+        try:
+            m = int(attrs.get("stacked_members") or attrs.get("members") or 0)
+            n = int(attrs.get("stacked_samples") or 0)
+            epochs = int(attrs.get("epochs") or 1)
+            seconds = float(span.get("duration_ms") or 0.0) / 1000.0
+            flops_per_sample = float(flops_per_sample)
+        except (TypeError, ValueError):
+            continue
+        if m <= 0 or n <= 0 or seconds <= 0.0:
+            continue
+        counts[program] = counts.get(program, 0) + 1
+        flops = _TRAIN_FLOP_FACTOR * flops_per_sample * m * n * max(epochs, 1)
+        analytic_run = flops / base.throughput + base.dispatch_s
+        if attrs.get("compile"):
+            analytic_compile = (
+                base.compile_floor_s + base.compile_per_flop * flops_per_sample
+            )
+            # the first call is trace+compile+first run; subtract the
+            # analytic run share so the factor corrects the compile part
+            compile_ratios.setdefault(program, []).append(
+                max(seconds - analytic_run, 1e-3) / analytic_compile
+            )
+        else:
+            run_ratios.setdefault(program, []).append(seconds / analytic_run)
+
+    def medians(ratios: Dict[str, list]) -> Dict[str, float]:
+        out = {}
+        for program, values in ratios.items():
+            values = sorted(values)
+            out[program] = round(values[len(values) // 2], 6)
+        return out
+
+    calibrated = CostTable(
+        throughput=base.throughput,
+        compile_per_flop=base.compile_per_flop,
+        compile_floor_s=base.compile_floor_s,
+        dispatch_s=base.dispatch_s,
+        run_factors=medians(run_ratios),
+        compile_factors=medians(compile_ratios),
+        samples=counts,
+    )
+    logger.info(
+        "Calibrated cost table from %s: %d program kind(s), %d span(s)",
+        trace_path,
+        len(counts),
+        sum(counts.values()),
+    )
+    return calibrated
+
+
+def _iter_spans(trace_path: str) -> Iterable[dict]:
+    with open(trace_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue  # torn tail from a killed build
+            if isinstance(doc, dict):
+                yield doc

@@ -1,0 +1,145 @@
+"""
+Shared shape ladders: the one quantization vocabulary for build AND serve.
+
+Every distinct array shape handed to a jitted fleet program mints one
+XLA compilation, so both planes quantize their ragged axes up a small
+ladder of allowed sizes. This module used to live in ``serve/ladder.py``
+(the micro-batcher's member/row ladders); the build planner needs the
+same machinery for its sample/series axes, so the implementation moved
+here and ``gordo_tpu.serve.ladder`` re-exports it — a fleet planned with
+these rungs warms exactly the shapes the serving engine will batch into.
+
+Two ladder families:
+
+- **explicit rung lists** (:func:`parse_ladder`, :data:`DEFAULT_ROW_LADDER`,
+  :func:`member_ladder`): serve-side, where the rung count itself is the
+  contract (programs per spec ≤ ``|member ladder| × |row ladder|``).
+- **geometric rounding** (:func:`round_up_ladder`, :func:`geometric_rungs`):
+  build-side, where the axis is open-ended (sample counts, series
+  lengths) and what matters is the growth *ratio* — pow2 (ratio 2) can
+  nearly double padded work per axis; a 1.25 ladder caps waste at 25%
+  for ~3x the distinct shapes, and the planner's compile-budget knob
+  then merges rungs back down where the trade is wrong.
+"""
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from ..utils.env import env_float
+
+#: default row-count rungs: factor-4 geometric — 5 programs per member
+#: rung, worst-case 4x row padding, typical sensor payloads (tens to a
+#: few thousand rows) land in the first three rungs
+DEFAULT_ROW_LADDER: Tuple[int, ...] = (32, 128, 512, 2048, 8192)
+
+ROW_LADDER_ENV = "GORDO_TPU_BATCH_ROW_LADDER"
+
+#: growth ratio for the windowed (LSTM) series axis — pow2 padding on
+#: the time axis nearly doubled padded work for long series; 1.25 caps
+#: the waste at 25% per member
+SERIES_PAD_RATIO_ENV = "GORDO_TPU_SERIES_PAD_RATIO"
+DEFAULT_SERIES_PAD_RATIO = 1.25
+
+#: growth ratio for the packed strategy's dense sample axis
+SAMPLE_PAD_RATIO_ENV = "GORDO_TPU_PLAN_PAD_RATIO"
+DEFAULT_SAMPLE_PAD_RATIO = 1.25
+
+
+def parse_ladder(text: str) -> Tuple[int, ...]:
+    """A comma-separated rung list as a sorted, deduplicated tuple of
+    positive ints; raises ``ValueError`` on anything else."""
+    rungs = sorted({int(part) for part in text.split(",") if part.strip()})
+    if not rungs or rungs[0] <= 0:
+        raise ValueError(f"ladder needs positive rungs, got {text!r}")
+    return tuple(rungs)
+
+
+def row_ladder() -> Tuple[int, ...]:
+    """The configured row ladder (``GORDO_TPU_BATCH_ROW_LADDER``, falling
+    back to :data:`DEFAULT_ROW_LADDER` on absent or malformed values)."""
+    raw = os.getenv(ROW_LADDER_ENV)
+    if raw:
+        try:
+            return parse_ladder(raw)
+        except ValueError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "Invalid %s=%r; using %r", ROW_LADDER_ENV, raw, DEFAULT_ROW_LADDER
+            )
+    return DEFAULT_ROW_LADDER
+
+
+def member_ladder(max_size: int) -> Tuple[int, ...]:
+    """Powers of two up to (and including) the padded ``max_size``:
+    the allowed member-axis shapes of one fused batch."""
+    rungs = []
+    rung = 1
+    while rung < max_size:
+        rungs.append(rung)
+        rung <<= 1
+    rungs.append(rung)
+    return tuple(rungs)
+
+
+def pad_to(n: int, ladder: Sequence[int]) -> Optional[int]:
+    """The first rung >= ``n``, or None when ``n`` overflows the ladder
+    (the caller's cue to fall back to an unbatched path)."""
+    for rung in ladder:
+        if n <= rung:
+            return rung
+    return None
+
+
+# -- geometric rounding (build-side open-ended axes) -------------------------
+
+
+def round_up_ladder(n: int, ratio: float, multiple: int = 1) -> int:
+    """
+    The smallest geometric-ladder rung >= ``n``. Rung ``k`` is
+    ``multiple * ratio**k`` rounded UP to a multiple of ``multiple`` (so
+    every rung is directly usable as a whole number of batches); with
+    ratio 2 this reproduces pow2 rounding exactly.
+
+    >>> round_up_ladder(100, 2.0, 16)
+    128
+    >>> round_up_ladder(1100, 2.0)
+    2048
+    >>> round_up_ladder(1100, 1.25)
+    1263
+    """
+    import math
+
+    if multiple < 1:
+        raise ValueError(f"multiple must be >= 1, got {multiple}")
+    ratio = max(float(ratio), 1.0001)
+    rung, k = multiple, 0
+    while rung < n:
+        k += 1
+        raw = math.ceil(multiple * ratio**k)
+        nxt = -(-raw // multiple) * multiple
+        rung = max(nxt, rung + multiple)  # always strictly increasing
+    return rung
+
+
+def geometric_rungs(lo: int, hi: int, ratio: float, multiple: int = 1) -> List[int]:
+    """All geometric-ladder rungs covering ``[lo, hi]`` (both rounded up
+    onto the ladder) — the candidate shape set a packer chooses from."""
+    rungs = [round_up_ladder(max(lo, 1), ratio, multiple)]
+    while rungs[-1] < hi:
+        rungs.append(round_up_ladder(rungs[-1] + 1, ratio, multiple))
+    return rungs
+
+
+def series_pad_ratio() -> float:
+    """Growth ratio for the windowed series axis
+    (``GORDO_TPU_SERIES_PAD_RATIO``, default 1.25)."""
+    value = env_float(SERIES_PAD_RATIO_ENV, DEFAULT_SERIES_PAD_RATIO)
+    return value if value and value > 1.0 else DEFAULT_SERIES_PAD_RATIO
+
+
+def sample_pad_ratio() -> float:
+    """Growth ratio for the packed strategy's dense sample axis
+    (``GORDO_TPU_PLAN_PAD_RATIO``, default 1.25)."""
+    value = env_float(SAMPLE_PAD_RATIO_ENV, DEFAULT_SAMPLE_PAD_RATIO)
+    return value if value and value > 1.0 else DEFAULT_SAMPLE_PAD_RATIO

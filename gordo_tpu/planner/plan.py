@@ -1,0 +1,275 @@
+"""
+The ``FleetPlan`` artifact: explainable, deterministic, replayable.
+
+A plan is the full answer to "what will this build run, and why": every
+bucket with its member roster, pad targets, predicted wall-clock /
+compile / HBM / padding-waste numbers, plus the knobs that produced it.
+Properties the rest of the system leans on:
+
+- **deterministic**: the same machine configs and cost table always
+  serialize to byte-identical JSON (sorted keys, rounded floats, no
+  timestamps) — so ``plan_hash`` is a stable identity the build journal
+  records and ``--resume`` can trust;
+- **self-describing**: specs serialize via ``ModelSpec.to_dict`` and the
+  fit config inline, so a plan explains itself without the machine YAML
+  in hand;
+- **replayable**: ``build-fleet --plan-from plan.json`` re-binds bucket
+  rosters to live members by NAME (:meth:`FleetPlan.materialize_buckets`).
+  A member keeps its planned pad targets even when neighbors were
+  resumed away, so its padded shape — and therefore its shuffle stream
+  and trained parameters — never depends on which other members are
+  still building.
+"""
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Sequence, Tuple
+
+from .packing import PlannedBucket, member_is_windowed, member_samples
+
+PLAN_VERSION = 1
+
+#: canonical plan filename a build drops beside its artifacts
+PLAN_FILE = "fleet_plan.json"
+
+
+class PlanError(ValueError):
+    """A plan document that cannot be used (version/shape mismatch)."""
+
+
+class FleetPlan:
+    """In-memory plan: the serialized document plus name→bucket maps."""
+
+    def __init__(self, doc: Dict[str, Any]):
+        if int(doc.get("version", 0)) != PLAN_VERSION:
+            raise PlanError(
+                f"fleet plan version {doc.get('version')!r} != supported "
+                f"{PLAN_VERSION}; re-run `gordo-tpu plan`"
+            )
+        self.doc = doc
+        self._assignment: Dict[str, dict] = {}
+        for bucket in self.buckets:
+            for name in bucket["members"]:
+                self._assignment[name] = bucket
+
+    # -- document accessors -------------------------------------------------
+
+    @property
+    def strategy(self) -> str:
+        return str(self.doc.get("strategy", ""))
+
+    @property
+    def buckets(self) -> List[dict]:
+        return list(self.doc.get("buckets") or [])
+
+    @property
+    def totals(self) -> Dict[str, Any]:
+        return dict(self.doc.get("totals") or {})
+
+    @property
+    def member_names(self) -> List[str]:
+        return sorted(self._assignment)
+
+    def covers(self, names: Sequence[str]) -> bool:
+        return all(name in self._assignment for name in names)
+
+    # -- identity -----------------------------------------------------------
+
+    def to_json(self) -> str:
+        """The canonical byte form: sorted keys, indent 1, trailing
+        newline. Everything (including :attr:`plan_hash`) derives from
+        this, so two plans are the same iff their files are."""
+        return json.dumps(self.doc, indent=1, sort_keys=True) + "\n"
+
+    @property
+    def plan_hash(self) -> str:
+        return hashlib.sha256(self.to_json().encode()).hexdigest()[:16]
+
+    def save(self, path: str) -> None:
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(self.to_json())
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "FleetPlan":
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except ValueError as exc:
+            raise PlanError(f"unreadable fleet plan {path}: {exc}") from exc
+        if not isinstance(doc, dict):
+            raise PlanError(f"fleet plan {path} is not a JSON object")
+        return cls(doc)
+
+    # -- replay -------------------------------------------------------------
+
+    def materialize_buckets(
+        self, members: Sequence[Any]
+    ) -> Tuple[List[PlannedBucket], List[Any]]:
+        """
+        Re-bind this plan's bucket rosters to live ``members`` by name.
+
+        Returns ``(buckets, uncovered)``: one :class:`PlannedBucket` per
+        plan bucket that has at least one live member (keeping the
+        planned pad targets — composition may be a subset after
+        ``--resume``), plus the members the plan does not know (CV fold
+        members, machines added since planning) for live packing.
+        """
+        by_bucket: Dict[str, List[Any]] = {}
+        uncovered: List[Any] = []
+        for member in members:
+            entry = self._assignment.get(member.name)
+            # A member the plan is stale for cannot use the planned
+            # bucket: data that outgrew the pad target would be
+            # truncated by stacking, and a spec that drifted since
+            # planning (the machine's architecture was edited) would
+            # train under the wrong program — both repack live instead.
+            if (
+                entry is None
+                or member_samples(member) > int(entry["n_padded"])
+                or _jsonable(member.spec.to_dict()) != entry.get("spec")
+            ):
+                uncovered.append(member)
+                continue
+            by_bucket.setdefault(entry["id"], []).append(member)
+        buckets: List[PlannedBucket] = []
+        for entry in self.buckets:
+            live = by_bucket.get(entry["id"])
+            if not live:
+                continue
+            windowed = bool(entry.get("windowed"))
+            if any(member_is_windowed(m) != windowed for m in live):
+                raise PlanError(
+                    f"plan bucket {entry['id']} mixes windowed and dense "
+                    "members with the live fleet — the plan does not match "
+                    "this config; re-run `gordo-tpu plan`"
+                )
+            buckets.append(
+                PlannedBucket(
+                    bucket_id=str(entry["id"]),
+                    program=str(entry["program"]),
+                    spec=live[0].spec,
+                    members=live,
+                    n_padded=int(entry["n_padded"]),
+                    m_padded=(
+                        int(entry["m_padded"])
+                        if entry.get("m_padded") is not None
+                        else None
+                    ),
+                    offset=int(entry.get("offset", 0)),
+                    windowed=windowed,
+                )
+            )
+        return buckets, uncovered
+
+
+def build_plan_doc(
+    buckets_by_config: Sequence[Tuple[Any, Sequence[PlannedBucket]]],
+    strategy: str,
+    mesh_shape: Tuple[int, int],
+    cost_table: Any,
+    config_fingerprint: str,
+) -> FleetPlan:
+    """
+    Assemble the serializable plan document from per-fit-config bucket
+    lists (``annotate_predictions`` must already have run on them).
+
+    ``config_fingerprint`` ties the plan to the machine configs it was
+    computed from (the builder hashes the per-machine cache keys); the
+    journal records :attr:`FleetPlan.plan_hash` so a resume can tell a
+    replan from a replay.
+    """
+    bucket_docs: List[dict] = []
+    totals = {
+        "buckets": 0,
+        "members": 0,
+        "compiles": 0,
+        "predicted_compile_s": 0.0,
+        "predicted_run_s": 0.0,
+        "flops_true": 0.0,
+        "flops_padded": 0.0,
+        "hbm_peak_bytes": 0,
+    }
+    for config, buckets in buckets_by_config:
+        config_doc = {
+            "epochs": config.epochs,
+            "batch_size": config.batch_size,
+            "validation_split": config.validation_split,
+            "shuffle": config.shuffle,
+            "early_stopping": list(config.early_stopping)
+            if config.early_stopping
+            else None,
+        }
+        for bucket in buckets:
+            predicted = dict(bucket.predicted)
+            bucket_docs.append(
+                {
+                    "id": bucket.bucket_id,
+                    "program": bucket.program,
+                    "windowed": bucket.windowed,
+                    "spec": _jsonable(bucket.spec.to_dict()),
+                    "fit_config": config_doc,
+                    "members": list(bucket.member_names),
+                    "n_padded": bucket.n_padded,
+                    "m_padded": bucket.m_padded,
+                    "offset": bucket.offset,
+                    "predicted": predicted,
+                }
+            )
+            totals["buckets"] += 1
+            totals["members"] += len(bucket.members)
+            totals["compiles"] += int(predicted.get("compiles", 1))
+            totals["predicted_compile_s"] += float(predicted.get("compile_s", 0.0))
+            totals["predicted_run_s"] += float(predicted.get("run_s", 0.0))
+            totals["flops_true"] += float(predicted.get("flops_true", 0.0))
+            totals["flops_padded"] += float(predicted.get("flops_padded", 0.0))
+            totals["hbm_peak_bytes"] = max(
+                totals["hbm_peak_bytes"], int(predicted.get("hbm_bytes", 0))
+            )
+    bucket_docs.sort(key=lambda b: b["id"])
+    totals["predicted_wall_s"] = round(
+        totals["predicted_compile_s"] + totals["predicted_run_s"], 6
+    )
+    totals["predicted_compile_s"] = round(totals["predicted_compile_s"], 6)
+    totals["predicted_run_s"] = round(totals["predicted_run_s"], 6)
+    totals["padding_waste"] = round(
+        1.0 - totals["flops_true"] / totals["flops_padded"]
+        if totals["flops_padded"]
+        else 0.0,
+        6,
+    )
+    totals["flops_true"] = float(f"{totals['flops_true']:.6g}")
+    totals["flops_padded"] = float(f"{totals['flops_padded']:.6g}")
+    doc = {
+        "version": PLAN_VERSION,
+        "strategy": strategy,
+        "mesh_shape": [int(mesh_shape[0]), int(mesh_shape[1] or 1)],
+        "config_fingerprint": config_fingerprint,
+        "cost_table": {
+            "version": getattr(cost_table, "version", None),
+            "calibrated": bool(getattr(cost_table, "calibrated", False)),
+        },
+        "buckets": bucket_docs,
+        "totals": totals,
+    }
+    return FleetPlan(doc)
+
+
+def _jsonable(value: Any) -> Any:
+    """Tuples → lists (json round-trip stability for spec dicts)."""
+    if isinstance(value, dict):
+        return {k: _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    return value
+
+
+def config_fingerprint(cache_keys: Sequence[str]) -> str:
+    """One stable hash over the fleet's per-machine config hashes."""
+    digest = hashlib.sha256()
+    for key in sorted(cache_keys):
+        digest.update(str(key).encode())
+        digest.update(b"\0")
+    return digest.hexdigest()[:16]

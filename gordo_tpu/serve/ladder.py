@@ -2,76 +2,31 @@
 Shape buckets for the micro-batching engine.
 
 Every distinct ``(members, rows)`` shape handed to the fused fleet
-program mints one XLA compilation. Arbitrary client batch sizes would
-therefore grow the jit cache without bound — the standard fix in TPU
-serving stacks is to pad each axis up a small geometric *ladder* of
-allowed sizes, so the compiled-program count per architecture is capped
-at ``len(member_ladder) x len(row_ladder)`` while padding waste stays
-bounded by the ladder's growth factor.
+program mints one XLA compilation, so both serving axes pad up a small
+ladder of allowed sizes — the member axis up powers of two bounded by
+``GORDO_TPU_BATCH_MAX_SIZE``, the row axis up
+``GORDO_TPU_BATCH_ROW_LADDER`` (taller requests fall back unbatched).
 
-Two ladders exist because the two axes grow differently:
-
-- the **member axis** (how many coalesced requests share one program)
-  is bounded by ``GORDO_TPU_BATCH_MAX_SIZE`` and padded up powers of
-  two (worst-case 2x waste, ~log2(max_size) rungs);
-- the **row axis** (rows per request) is open-ended client data and
-  pads up ``GORDO_TPU_BATCH_ROW_LADDER`` (default geometric, factor 4).
-  Requests taller than the top rung fall back to the unbatched path
-  rather than minting an unbounded shape.
+The implementation lives in :mod:`gordo_tpu.planner.ladder` — the build
+planner quantizes its bucket shapes with the SAME ladder code, so a
+planned fleet warms exactly the shapes this engine batches into. This
+module re-exports the serve-facing names for compatibility.
 """
 
-import os
-from typing import Optional, Sequence, Tuple
+from ..planner.ladder import (  # noqa: F401
+    DEFAULT_ROW_LADDER,
+    ROW_LADDER_ENV,
+    member_ladder,
+    pad_to,
+    parse_ladder,
+    row_ladder,
+)
 
-#: default row-count rungs: factor-4 geometric — 5 programs per member
-#: rung, worst-case 4x row padding, typical sensor payloads (tens to a
-#: few thousand rows) land in the first three rungs
-DEFAULT_ROW_LADDER: Tuple[int, ...] = (32, 128, 512, 2048, 8192)
-
-ROW_LADDER_ENV = "GORDO_TPU_BATCH_ROW_LADDER"
-
-
-def parse_ladder(text: str) -> Tuple[int, ...]:
-    """A comma-separated rung list as a sorted, deduplicated tuple of
-    positive ints; raises ``ValueError`` on anything else."""
-    rungs = sorted({int(part) for part in text.split(",") if part.strip()})
-    if not rungs or rungs[0] <= 0:
-        raise ValueError(f"ladder needs positive rungs, got {text!r}")
-    return tuple(rungs)
-
-
-def row_ladder() -> Tuple[int, ...]:
-    """The configured row ladder (``GORDO_TPU_BATCH_ROW_LADDER``, falling
-    back to :data:`DEFAULT_ROW_LADDER` on absent or malformed values)."""
-    raw = os.getenv(ROW_LADDER_ENV)
-    if raw:
-        try:
-            return parse_ladder(raw)
-        except ValueError:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "Invalid %s=%r; using %r", ROW_LADDER_ENV, raw, DEFAULT_ROW_LADDER
-            )
-    return DEFAULT_ROW_LADDER
-
-
-def member_ladder(max_size: int) -> Tuple[int, ...]:
-    """Powers of two up to (and including) the padded ``max_size``:
-    the allowed member-axis shapes of one fused batch."""
-    rungs = []
-    rung = 1
-    while rung < max_size:
-        rungs.append(rung)
-        rung <<= 1
-    rungs.append(rung)
-    return tuple(rungs)
-
-
-def pad_to(n: int, ladder: Sequence[int]) -> Optional[int]:
-    """The first rung >= ``n``, or None when ``n`` overflows the ladder
-    (the caller's cue to fall back to an unbatched path)."""
-    for rung in ladder:
-        if n <= rung:
-            return rung
-    return None
+__all__ = [
+    "DEFAULT_ROW_LADDER",
+    "ROW_LADDER_ENV",
+    "member_ladder",
+    "pad_to",
+    "parse_ladder",
+    "row_ladder",
+]

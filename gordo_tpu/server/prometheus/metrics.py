@@ -278,6 +278,49 @@ def fleet_build_metrics(registry: Optional[CollectorRegistry] = None) -> dict:
                 registry=target,
                 multiprocess_mode="max",
             )
+        # FleetPlan (gordo_tpu.planner) gauges: what the cost model
+        # promised for this build, and what the final fit actually cost —
+        # the pair an operator (or a recalibration job) diffs to see the
+        # model's error. `strategy` is bounded (naive|packed).
+        for gauge_key, name, help_text in (
+            (
+                "plan_predicted_seconds",
+                "gordo_fleet_plan_predicted_seconds",
+                "FleetPlan predicted build wall-clock (compile + run) for "
+                "the planned final-fit buckets",
+            ),
+            (
+                "plan_padding_waste",
+                "gordo_fleet_plan_padding_waste_ratio",
+                "FleetPlan predicted padded-FLOP waste ratio (padding "
+                "FLOPs / total padded FLOPs) across the planned buckets",
+            ),
+            (
+                "plan_compiles",
+                "gordo_fleet_plan_compiles",
+                "Distinct XLA programs the FleetPlan predicts the planned "
+                "buckets will compile",
+            ),
+            (
+                "plan_actual_compiles",
+                "gordo_fleet_plan_actual_compiles",
+                "First-call (compile) fit programs actually observed "
+                "during the final-fit phase of the build",
+            ),
+            (
+                "plan_actual_seconds",
+                "gordo_fleet_plan_actual_seconds",
+                "Wall-clock of fit device programs actually observed "
+                "during the final-fit phase of the build",
+            ),
+        ):
+            metrics[gauge_key] = Gauge(
+                name,
+                help_text,
+                labelnames=["project", "strategy"],
+                registry=target,
+                multiprocess_mode="max",
+            )
         _build_metrics[target] = metrics
     return _build_metrics[target]
 
@@ -471,6 +514,32 @@ def serve_metrics(
     if target not in _serve_metrics:
         _serve_metrics[target] = ServeMetrics(project=project, registry=target)
     return _serve_metrics[target]
+
+
+def set_fleet_plan_prediction(
+    project: Optional[str],
+    strategy: str,
+    predicted_seconds: float,
+    padding_waste: float,
+    compiles: int,
+):
+    """Export a FleetPlan's headline predictions (at bucket-plan time)."""
+    metrics = fleet_build_metrics()
+    labels = {"project": project or "", "strategy": strategy}
+    metrics["plan_predicted_seconds"].labels(**labels).set(predicted_seconds)
+    metrics["plan_padding_waste"].labels(**labels).set(padding_waste)
+    metrics["plan_compiles"].labels(**labels).set(compiles)
+
+
+def set_fleet_plan_actuals(
+    project: Optional[str], strategy: str, seconds: float, compiles: int
+):
+    """Export what the planned (final-fit) programs actually cost, so
+    predicted-vs-actual is one PromQL subtraction."""
+    metrics = fleet_build_metrics()
+    labels = {"project": project or "", "strategy": strategy}
+    metrics["plan_actual_seconds"].labels(**labels).set(seconds)
+    metrics["plan_actual_compiles"].labels(**labels).set(compiles)
 
 
 def set_fleet_build_progress(

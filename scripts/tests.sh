@@ -55,6 +55,7 @@ case "$component" in
     serializer) run -m "not slow" tests/serializer ;;
     server)   run -m "not slow" tests/server ;;
     serve)    run -m "not slow" tests/serve ;;
+    planner)  run -m "not slow" tests/planner ;;
     utils)    run -m "not slow" tests/utils ;;
     workflow) run -m "not slow" tests/workflow ;;
     formatting) run tests/test_codestyle.py ;;
@@ -64,8 +65,9 @@ case "$component" in
         run -m "not slow" tests/ \
             --ignore=tests/builder --ignore=tests/cli --ignore=tests/client \
             --ignore=tests/dataset --ignore=tests/machine --ignore=tests/models \
-            --ignore=tests/ops --ignore=tests/parallel --ignore=tests/reporters \
-            --ignore=tests/serializer --ignore=tests/serve --ignore=tests/server \
+            --ignore=tests/ops --ignore=tests/parallel --ignore=tests/planner \
+            --ignore=tests/reporters --ignore=tests/serializer \
+            --ignore=tests/serve --ignore=tests/server \
             --ignore=tests/utils --ignore=tests/workflow
         ;;
     *)

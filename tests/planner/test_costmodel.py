@@ -1,0 +1,161 @@
+import json
+
+import pytest
+
+from gordo_tpu.models.spec import FeedForwardSpec, LSTMSpec
+from gordo_tpu.planner import costmodel
+from gordo_tpu.planner.costmodel import (
+    CostModel,
+    CostTable,
+    calibrate,
+    spec_flops_per_sample,
+    spec_param_count,
+)
+
+pytestmark = pytest.mark.planner
+
+FF = FeedForwardSpec(
+    n_features=3, n_features_out=3, dims=(6, 3), activations=("tanh", "tanh")
+)
+LSTM = LSTMSpec(
+    n_features=2,
+    n_features_out=2,
+    lookback_window=4,
+    dims=(4,),
+    activations=("tanh",),
+)
+
+
+def test_spec_param_count_feedforward():
+    # 3->6->3->3 dense chain: (3*6+6) + (6*3+3) + (3*3+3)
+    assert spec_param_count(FF) == 24 + 21 + 12
+
+
+def test_spec_param_count_lstm():
+    # one LSTM layer (4 gates of [2+4, 4] + bias) + dense head 4->2
+    assert spec_param_count(LSTM) == 4 * (2 * 4 + 4 * 4 + 4) + (4 * 2 + 2)
+
+
+def test_spec_flops_scale_with_lookback():
+    longer = LSTMSpec(
+        n_features=2,
+        n_features_out=2,
+        lookback_window=8,
+        dims=(4,),
+        activations=("tanh",),
+    )
+    assert spec_flops_per_sample(longer) > 1.9 * spec_flops_per_sample(LSTM)
+
+
+def test_cost_table_round_trip(tmp_path):
+    table = CostTable(
+        run_factors={"fleet_fit": 1.5}, compile_factors={"fleet_fit": 0.8},
+        samples={"fleet_fit": 12},
+    )
+    path = str(tmp_path / "cost_table.json")
+    table.save(path)
+    loaded = CostTable.load(path)
+    assert loaded.to_dict() == table.to_dict()
+    assert loaded.calibrated
+
+
+def test_cost_table_rejects_wrong_version(tmp_path):
+    path = tmp_path / "cost_table.json"
+    path.write_text(json.dumps({"version": 99}))
+    with pytest.raises(ValueError, match="version"):
+        CostTable.load(str(path))
+
+
+def test_stacked_shape_mesh_rounding():
+    model = CostModel(mesh_shape=(4, 2))
+    m_total, n_total = model.stacked_shape(m=5, n_padded=100, batch_size=16)
+    assert m_total == 8  # multiple of the model axis
+    assert n_total % 16 == 0 and n_total % 2 == 0 and n_total >= 100
+
+
+def test_predict_hbm_monotonic():
+    model = CostModel()
+    small = model.predict_hbm_bytes(FF, 4, 128, 16)
+    bigger_fleet = model.predict_hbm_bytes(FF, 8, 128, 16)
+    more_samples = model.predict_hbm_bytes(FF, 4, 512, 16)
+    assert bigger_fleet > small
+    assert more_samples > small
+
+
+def test_predict_run_scales_with_work():
+    model = CostModel()
+    base = model.predict_run_s("fleet_fit", FF, 4, 128, epochs=2)
+    doubled = model.predict_run_s("fleet_fit", FF, 8, 128, epochs=2)
+    assert doubled > base
+
+
+def _span(program, seconds, m, n, compile=False, **extra):
+    attrs = {
+        "program": program,
+        "flops_per_sample": spec_flops_per_sample(FF),
+        "stacked_members": m,
+        "stacked_samples": n,
+        "epochs": 2,
+    }
+    if compile:
+        attrs["compile"] = True
+    attrs.update(extra)
+    return {
+        "name": "device_program",
+        "duration_ms": seconds * 1000.0,
+        "attributes": attrs,
+    }
+
+
+def test_calibrate_fits_median_run_factors(tmp_path):
+    """The factor is the MEDIAN actual/analytic ratio, robust to one
+    neighbor-stall outlier."""
+    base = CostTable()
+    m, n = 4, 128
+    flops = costmodel._TRAIN_FLOP_FACTOR * spec_flops_per_sample(FF) * m * n * 2
+    analytic = flops / base.throughput + base.dispatch_s
+    spans = [
+        _span("fleet_fit", 2.0 * analytic, m, n),
+        _span("fleet_fit", 2.0 * analytic, m, n),
+        _span("fleet_fit", 50.0 * analytic, m, n),  # host-noise outlier
+    ]
+    trace = tmp_path / "build_trace.jsonl"
+    trace.write_text("\n".join(json.dumps(s) for s in spans) + "\n")
+    table = calibrate(str(trace))
+    assert table.run_factors["fleet_fit"] == pytest.approx(2.0, rel=1e-3)
+    assert table.samples["fleet_fit"] == 3
+    assert table.calibrated
+
+
+def test_calibrate_separates_compile_spans(tmp_path):
+    spans = [
+        _span("fleet_fit", 5.0, 4, 128, compile=True),
+        _span("fleet_fit", 0.1, 4, 128),
+    ]
+    trace = tmp_path / "build_trace.jsonl"
+    trace.write_text("\n".join(json.dumps(s) for s in spans) + "\n")
+    table = calibrate(str(trace))
+    assert "fleet_fit" in table.compile_factors
+    assert "fleet_fit" in table.run_factors
+    assert table.compile_factors["fleet_fit"] > 0
+
+
+def test_calibrate_skips_unusable_lines(tmp_path):
+    """Old traces (no static features), foreign spans and torn tails
+    must not break calibration."""
+    trace = tmp_path / "build_trace.jsonl"
+    lines = [
+        json.dumps({"name": "build_phase", "duration_ms": 5.0}),
+        json.dumps(
+            {
+                "name": "device_program",
+                "duration_ms": 100.0,
+                "attributes": {"program": "fleet_fit"},  # pre-planner span
+            }
+        ),
+        json.dumps(_span("fleet_fit", 0.5, 4, 128)),
+        '{"torn": tail',  # killed build's partial line
+    ]
+    trace.write_text("\n".join(lines) + "\n")
+    table = calibrate(str(trace))
+    assert table.samples == {"fleet_fit": 1}

@@ -1,0 +1,74 @@
+import pytest
+
+from gordo_tpu.planner import ladder
+
+pytestmark = pytest.mark.planner
+
+
+def test_round_up_ladder_pow2_parity():
+    """ratio 2.0 reproduces the trainer's historical pow2 rounding."""
+    from gordo_tpu.planner.packing import _round_up_pow2
+
+    for n in (1, 5, 16, 100, 128, 129, 1000, 4096):
+        for batch in (1, 16, 32):
+            assert ladder.round_up_ladder(
+                max(n, batch), 2.0, multiple=batch
+            ) == _round_up_pow2(n, batch)
+
+
+def test_round_up_ladder_examples():
+    assert ladder.round_up_ladder(100, 2.0, 16) == 128
+    assert ladder.round_up_ladder(1100, 2.0) == 2048
+    assert ladder.round_up_ladder(1100, 1.25) == 1263
+    # already on a rung stays put
+    assert ladder.round_up_ladder(128, 2.0, 16) == 128
+
+
+def test_round_up_ladder_respects_multiple():
+    for n in (7, 33, 100, 999):
+        rung = ladder.round_up_ladder(n, 1.25, multiple=16)
+        assert rung >= n
+        assert rung % 16 == 0
+
+
+def test_round_up_ladder_strictly_increasing_rungs():
+    """Small ratios never stall: successive rungs strictly increase even
+    when ceil(ratio**k) rounds to the same multiple."""
+    rungs = ladder.geometric_rungs(1, 200, 1.01, multiple=8)
+    assert rungs == sorted(set(rungs))
+    assert rungs[-1] >= 200
+
+
+def test_geometric_rungs_cover_range():
+    rungs = ladder.geometric_rungs(50, 1000, 1.25)
+    assert rungs[0] >= 50
+    assert rungs[-1] >= 1000
+    for lo, hi in zip(rungs[:-1], rungs[1:]):
+        assert hi > lo
+
+
+def test_pad_ratio_env_overrides(monkeypatch):
+    monkeypatch.setenv(ladder.SERIES_PAD_RATIO_ENV, "1.5")
+    monkeypatch.setenv(ladder.SAMPLE_PAD_RATIO_ENV, "2.0")
+    assert ladder.series_pad_ratio() == 1.5
+    assert ladder.sample_pad_ratio() == 2.0
+
+
+def test_pad_ratio_rejects_degenerate_values(monkeypatch):
+    """Ratios <= 1 would loop forever in round_up_ladder — fall back."""
+    for bad in ("0.5", "1.0", "-3", "nonsense"):
+        monkeypatch.setenv(ladder.SERIES_PAD_RATIO_ENV, bad)
+        monkeypatch.setenv(ladder.SAMPLE_PAD_RATIO_ENV, bad)
+        assert ladder.series_pad_ratio() == ladder.DEFAULT_SERIES_PAD_RATIO
+        assert ladder.sample_pad_ratio() == ladder.DEFAULT_SAMPLE_PAD_RATIO
+
+
+def test_serve_ladder_reexports_planner_implementation():
+    """Build and serve must quantize with the SAME code: the serve module
+    is a facade over the planner's (the PR that moved it)."""
+    from gordo_tpu.serve import ladder as serve_ladder
+
+    assert serve_ladder.pad_to is ladder.pad_to
+    assert serve_ladder.member_ladder is ladder.member_ladder
+    assert serve_ladder.row_ladder is ladder.row_ladder
+    assert serve_ladder.DEFAULT_ROW_LADDER == ladder.DEFAULT_ROW_LADDER

@@ -1,0 +1,240 @@
+from types import SimpleNamespace
+
+import pytest
+
+from gordo_tpu.models.spec import FeedForwardSpec, LSTMSpec
+from gordo_tpu.planner.costmodel import CostModel
+from gordo_tpu.planner.packing import (
+    NAIVE,
+    PACKED,
+    _round_up_pow2,
+    annotate_predictions,
+    naive_pad_target,
+    plan_train_buckets,
+)
+
+pytestmark = pytest.mark.planner
+
+SPEC = FeedForwardSpec(
+    n_features=3, n_features_out=3, dims=(6, 3), activations=("tanh", "tanh")
+)
+OTHER_SPEC = FeedForwardSpec(
+    n_features=5, n_features_out=5, dims=(8, 4), activations=("tanh", "tanh")
+)
+LSTM = LSTMSpec(
+    n_features=2,
+    n_features_out=2,
+    lookback_window=4,
+    dims=(4,),
+    activations=("tanh",),
+)
+
+CONFIG = SimpleNamespace(epochs=2, batch_size=16)
+
+
+def dense(name, n, spec=SPEC):
+    return SimpleNamespace(name=name, spec=spec, n=n)
+
+
+def windowed(name, length, spec=LSTM):
+    return SimpleNamespace(
+        name=name,
+        spec=spec,
+        series=[0.0] * length,
+        n_windows=length - spec.lookback_window + 1,
+    )
+
+
+def test_naive_matches_historical_grouping():
+    """The naive strategy is the trainer's exact-key grouping: one
+    bucket per (spec, pow2 pad), members in input order."""
+    members = [
+        dense("a", 70),
+        dense("b", 100),  # 70 and 100 both pad to 128
+        dense("c", 100, OTHER_SPEC),
+        dense("d", 300),  # pads to 512
+    ]
+    buckets = plan_train_buckets(members, CONFIG, strategy=NAIVE)
+    rosters = {tuple(b.member_names): b for b in buckets}
+    assert set(rosters) == {("a", "b"), ("c",), ("d",)}
+    assert rosters[("a", "b")].n_padded == _round_up_pow2(100, 16)
+    assert rosters[("d",)].n_padded == _round_up_pow2(300, 16)
+
+
+def test_naive_windowed_uses_geometric_series_ladder():
+    """The pow2 time-axis fix (satellite): naive windowed members pad up
+    the shared geometric ladder, not to the next power of two."""
+    from gordo_tpu.planner.ladder import round_up_ladder, series_pad_ratio
+
+    member = windowed("w", 1100)
+    assert naive_pad_target(member, CONFIG.batch_size) == round_up_ladder(
+        1100, series_pad_ratio()
+    )
+    assert naive_pad_target(member, CONFIG.batch_size) < 2048  # the old pow2
+
+
+def test_packed_merges_rungs_under_break_even():
+    """Small same-spec members with scattered sample counts are one
+    bucket under packed (padding a few rows is cheaper than a compile),
+    where naive mints one bucket per pow2 key."""
+    members = [dense(f"m{i}", 40 + 17 * i) for i in range(6)]  # 40..125
+    naive = plan_train_buckets(members, CONFIG, strategy=NAIVE)
+    packed = plan_train_buckets(members, CONFIG, strategy=PACKED)
+    assert len(packed) < len(naive) or len(naive) == 1
+    assert sorted(n for b in packed for n in b.member_names) == sorted(
+        m.name for m in members
+    )
+
+
+def test_packed_never_mixes_specs():
+    members = [dense("a", 64), dense("b", 64, OTHER_SPEC)]
+    packed = plan_train_buckets(members, CONFIG, strategy=PACKED)
+    assert len(packed) == 2
+    for bucket in packed:
+        specs = {m.spec for m in bucket.members}
+        assert len(specs) == 1
+
+
+def test_packed_compile_budget_forces_merges():
+    """An explicit budget keeps merging past break-even until the
+    program count fits."""
+    members = [dense(f"m{i}", 100 * (i + 1)) for i in range(8)]  # 100..800
+    unbudgeted = plan_train_buckets(
+        members, CONFIG, strategy=PACKED, budget=0, hbm_cap=1 << 40
+    )
+    capped = plan_train_buckets(
+        members, CONFIG, strategy=PACKED, budget=1, hbm_cap=1 << 40
+    )
+    assert len(capped) == 1
+    assert len(capped) <= len(unbudgeted)
+
+
+def test_packed_hbm_cap_splits_before_oom():
+    """A tiny cap splits a rung group into several bins, each under the
+    cap, padded to one shared member rung so they share a compile."""
+    cost_model = CostModel()
+    members = [dense(f"m{i}", 128) for i in range(9)]
+    per_member = cost_model.predict_hbm_bytes(SPEC, 1, 128, CONFIG.batch_size)
+    cap = int(3.5 * per_member)  # 3 members per bin
+    buckets = plan_train_buckets(
+        members, CONFIG, strategy=PACKED, cost_model=cost_model, hbm_cap=cap
+    )
+    assert len(buckets) == 3
+    for bucket in buckets:
+        assert bucket.predicted["hbm_bytes"] <= cap * 2  # padded members
+        assert bucket.m_padded == 4  # shared pow2 rung over max bin size
+    # sibling bins share ONE compile: identical padded signature
+    assert sum(b.predicted["compiles"] for b in buckets) == 1
+
+
+def test_packed_deterministic_and_order_stable():
+    members = [dense(f"m{i}", 40 + 13 * i) for i in range(10)]
+    first = plan_train_buckets(members, CONFIG, strategy=PACKED)
+    second = plan_train_buckets(members, CONFIG, strategy=PACKED)
+    assert [(b.bucket_id, b.member_names) for b in first] == [
+        (b.bucket_id, b.member_names) for b in second
+    ]
+    # members inside a bucket stay in fleet input order
+    order = {f"m{i}": i for i in range(10)}
+    for bucket in first:
+        positions = [order[n] for n in bucket.member_names]
+        assert positions == sorted(positions)
+
+
+def test_annotate_predictions_attributes_compiles_once():
+    """Two buckets with the same padded signature cost one compile —
+    mirroring the telemetry's first-call-per-signature attribution."""
+    buckets = plan_train_buckets(
+        [dense("a", 100), dense("b", 700)], CONFIG, strategy=NAIVE
+    )
+    for b in buckets:
+        b.n_padded = 1024  # force an identical signature
+    annotate_predictions(buckets, CONFIG, CostModel())
+    assert sorted(b.predicted["compiles"] for b in buckets) == [0, 1]
+
+
+def test_predictions_account_padding_waste():
+    buckets = plan_train_buckets([dense("a", 65)], CONFIG, strategy=NAIVE)
+    predicted = buckets[0].predicted
+    assert predicted["flops_padded"] > predicted["flops_true"]
+    assert 0.0 < predicted["padding_waste"] < 1.0
+    assert predicted["stacked_shape"][1] == 128
+
+
+def test_profitable_merge_not_masked_by_cheap_unprofitable_one():
+    """The greedy must pick the largest NET win across all families: a
+    family whose cheapest-padding merge is unprofitable (tiny compile
+    save) must not stop a big-save merge in another family."""
+    from gordo_tpu.planner.costmodel import CostTable
+
+    # dense merges save almost nothing; windowed compiles are precious
+    table = CostTable(
+        compile_factors={"fleet_fit": 1e-6, "fleet_windowed_fit": 100.0}
+    )
+    members = [
+        dense("a1", 100),
+        dense("a2", 200),
+        windowed("w1", 100),
+        windowed("w2", 200),
+    ]
+    buckets = plan_train_buckets(
+        members,
+        CONFIG,
+        strategy=PACKED,
+        cost_model=CostModel(table),
+        hbm_cap=1 << 40,
+    )
+    rosters = {tuple(b.member_names) for b in buckets}
+    # the windowed family merged (its compile save dwarfs the padding),
+    # the dense family did not (its compile save is ~free to re-pay)
+    assert ("w1", "w2") in rosters
+    assert ("a1",) in rosters and ("a2",) in rosters
+
+
+def test_bucket_ids_distinct_across_fit_configs():
+    """Two fit-config groups sharing a spec and rung must NOT collide on
+    bucket id — materialize_buckets keys rosters by id, and a collision
+    would train the pooled members twice."""
+    from gordo_tpu.planner.plan import build_plan_doc, config_fingerprint
+
+    other_config = SimpleNamespace(
+        epochs=9,
+        batch_size=16,
+        validation_split=None,
+        shuffle=False,
+        early_stopping=None,
+    )
+    base_config = SimpleNamespace(
+        epochs=2,
+        batch_size=16,
+        validation_split=None,
+        shuffle=False,
+        early_stopping=None,
+    )
+    member_a, member_b = dense("a", 128), dense("b", 128)
+    plan = build_plan_doc(
+        [
+            (base_config, plan_train_buckets([member_a], base_config, strategy=NAIVE)),
+            (other_config, plan_train_buckets([member_b], other_config, strategy=NAIVE)),
+        ],
+        NAIVE,
+        (1, 1),
+        None,
+        config_fingerprint(["a", "b"]),
+    )
+    ids = [b["id"] for b in plan.buckets]
+    assert len(ids) == len(set(ids)) == 2
+    buckets, uncovered = plan.materialize_buckets([member_a, member_b])
+    assert uncovered == []
+    rosters = sorted(tuple(b.member_names) for b in buckets)
+    assert rosters == [("a",), ("b",)]  # each member exactly once
+
+
+def test_mixed_windowed_and_dense_partition():
+    members = [dense("d1", 64), windowed("w1", 40), windowed("w2", 40)]
+    buckets = plan_train_buckets(members, CONFIG, strategy=PACKED)
+    by_kind = {b.windowed: b for b in buckets}
+    assert by_kind[False].member_names == ["d1"]
+    assert by_kind[True].member_names == ["w1", "w2"]
+    assert by_kind[True].program == "fleet_windowed_fit"
+    assert by_kind[True].offset == LSTM.lookback_window - 1

@@ -1,0 +1,138 @@
+from types import SimpleNamespace
+
+import pytest
+
+from gordo_tpu.models.spec import FeedForwardSpec
+from gordo_tpu.planner.costmodel import CostModel, CostTable
+from gordo_tpu.planner.packing import PACKED, plan_train_buckets
+from gordo_tpu.planner.plan import (
+    FleetPlan,
+    PlanError,
+    build_plan_doc,
+    config_fingerprint,
+)
+from gordo_tpu.planner.report import render_plan
+
+pytestmark = pytest.mark.planner
+
+SPEC = FeedForwardSpec(
+    n_features=3, n_features_out=3, dims=(6, 3), activations=("tanh", "tanh")
+)
+CONFIG = SimpleNamespace(
+    epochs=2,
+    batch_size=16,
+    validation_split=0.1,
+    shuffle=False,
+    early_stopping=None,
+)
+
+
+def dense(name, n):
+    return SimpleNamespace(name=name, spec=SPEC, n=n)
+
+
+def make_plan(members=None, table=None):
+    members = members or [dense("a", 50), dense("b", 120), dense("c", 700)]
+    cost_model = CostModel(table)
+    buckets = plan_train_buckets(
+        members, CONFIG, strategy=PACKED, cost_model=cost_model
+    )
+    return build_plan_doc(
+        [(CONFIG, buckets)],
+        PACKED,
+        cost_model.mesh_shape,
+        cost_model.table,
+        config_fingerprint(["k1", "k2", "k3"]),
+    )
+
+
+def test_plan_is_byte_deterministic():
+    """Same configs + cost table => byte-identical JSON and equal hash —
+    the identity the journal records and --resume trusts."""
+    assert make_plan().to_json() == make_plan().to_json()
+    assert make_plan().plan_hash == make_plan().plan_hash
+
+
+def test_plan_hash_tracks_cost_table():
+    calibrated = CostTable(run_factors={"fleet_fit": 3.0})
+    assert make_plan().to_json() != make_plan(table=calibrated).to_json()
+
+
+def test_plan_save_load_round_trip(tmp_path):
+    plan = make_plan()
+    path = str(tmp_path / "fleet_plan.json")
+    plan.save(path)
+    loaded = FleetPlan.load(path)
+    assert loaded.to_json() == plan.to_json()
+    assert loaded.plan_hash == plan.plan_hash
+    assert loaded.strategy == PACKED
+
+
+def test_plan_rejects_bad_documents(tmp_path):
+    bad_version = tmp_path / "v.json"
+    bad_version.write_text('{"version": 99, "buckets": []}')
+    with pytest.raises(PlanError, match="version"):
+        FleetPlan.load(str(bad_version))
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"version": 1')
+    with pytest.raises(PlanError, match="unreadable"):
+        FleetPlan.load(str(torn))
+
+
+def test_materialize_keeps_pad_targets_for_subsets():
+    """After --resume removed neighbors, a member keeps its planned
+    bucket and pad target — its padded shape (and numerics) never depend
+    on which other members still build."""
+    plan = make_plan()
+    full, uncovered = plan.materialize_buckets(
+        [dense("a", 50), dense("b", 120), dense("c", 700)]
+    )
+    assert uncovered == []
+    subset, uncovered = plan.materialize_buckets([dense("b", 120)])
+    assert uncovered == []
+    assert len(subset) == 1
+    original = next(
+        b for b in full if "b" in b.member_names
+    )
+    assert subset[0].n_padded == original.n_padded
+    assert subset[0].bucket_id == original.bucket_id
+
+
+def test_materialize_routes_unknown_and_outgrown_members_live():
+    plan = make_plan()
+    unknown = dense("new-machine", 64)
+    outgrown = dense("a", 10_000)  # data grew past the planned pad target
+    buckets, uncovered = plan.materialize_buckets([unknown, outgrown])
+    assert buckets == []
+    assert {m.name for m in uncovered} == {"new-machine", "a"}
+
+
+def test_materialize_routes_spec_drifted_members_live():
+    """A machine whose architecture was edited since planning keeps its
+    name but must NOT land in its old bucket — it would train under the
+    wrong program (or drag its unchanged neighbors onto the new one)."""
+    plan = make_plan()
+    drifted_spec = FeedForwardSpec(
+        n_features=3, n_features_out=3, dims=(9, 4), activations=("tanh", "tanh")
+    )
+    drifted = SimpleNamespace(name="a", spec=drifted_spec, n=50)
+    buckets, uncovered = plan.materialize_buckets(
+        [drifted, dense("b", 120), dense("c", 700)]
+    )
+    assert [m.name for m in uncovered] == ["a"]
+    assert all("a" not in b.member_names for b in buckets)
+    assert {n for b in buckets for n in b.member_names} == {"b", "c"}
+
+
+def test_config_fingerprint_is_order_insensitive():
+    assert config_fingerprint(["x", "y"]) == config_fingerprint(["y", "x"])
+    assert config_fingerprint(["x"]) != config_fingerprint(["y"])
+
+
+def test_render_plan_mentions_every_bucket():
+    plan = make_plan()
+    text = render_plan(plan)
+    for bucket in plan.buckets:
+        assert bucket["id"] in text
+    assert plan.plan_hash in text
+    assert "padding_waste" in text
