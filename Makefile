@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve test-planner docs bench bench-telemetry bench-serve bench-planner lint image
+.PHONY: test test-fast test-faults test-observability test-serve test-planner test-lifecycle test-lifecycle-faults docs bench bench-telemetry bench-serve bench-planner bench-lifecycle lint image
 
 test:
 	python -m pytest tests/ -q
@@ -28,6 +28,25 @@ test-serve:
 # slow-marked, so the same tests also run inside the tier-1 budget.
 test-planner:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m planner
+
+# The self-healing lifecycle suite: drift statistics, canary
+# publish/gates, promotion hot-swap, rollback + quarantine — CPU-only
+# and not slow-marked, so the same tests also run inside tier-1.
+test-lifecycle:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m lifecycle
+
+# The deterministic lifecycle chaos drill: a crash injected at each
+# lifecycle/serve fault site (drift_eval, canary_build, promote_swap,
+# rollback) must leave serving on the last-good revision and the loop
+# resumable.
+test-lifecycle-faults:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "lifecycle and faults"
+
+# Lifecycle hot-swap benchmark: concurrent clients through N canary
+# promote/rollback swaps; writes BENCH_LIFECYCLE.json (swap latency,
+# dropped requests — target: zero).
+bench-lifecycle:
+	JAX_PLATFORMS=cpu python benchmarks/bench_lifecycle.py
 
 # Serving micro-batching benchmark: concurrent single-model requests
 # with batching off vs on; writes BENCH_SERVE.json.

@@ -1,0 +1,204 @@
+"""
+Lifecycle hot-swap benchmark: serving continuity through promotions.
+
+The contract under test is the PR's headline robustness claim: a
+promotion hot-swap (``FleetModelStore.swap``) moves serving onto a new
+revision with ZERO dropped requests — in-flight and queued work scores
+against the fleet object it was admitted under (the pinned-snapshot
+contract), while post-swap requests route to the pre-warmed new fleet.
+
+The drill: build a small fleet once, clone it into a second revision
+the way the lifecycle does (``publish_canary`` with an empty rebuilt
+set — pure hardlink assembly, also timed), then hammer the full WSGI
+``prediction`` route from concurrent client threads while the main
+thread alternates serving between the two revisions with warm hot
+swaps. Reported: per-swap latency percentiles, publish latency, total
+requests, and the dropped/5xx count — the acceptance target is ZERO
+dropped across every swap.
+
+Writes ``BENCH_LIFECYCLE.json`` at the repo root (the committed bench
+convention). Run: ``JAX_PLATFORMS=cpu python benchmarks/bench_lifecycle.py``
+(or ``make bench-lifecycle``). Not run in CI — tests/lifecycle asserts
+the mechanism; this script records the numbers.
+"""
+
+import datetime
+import json
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+import warnings
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+warnings.filterwarnings("ignore", category=UserWarning)
+
+N_MODELS = 6
+N_TAGS = 8
+N_SWAPS = 20
+N_CLIENTS = 8
+SWAP_INTERVAL_S = 0.25
+
+PROJECT = "bench-lifecycle"
+BASE_REVISION = "100"
+ALT_REVISION = "101"
+
+
+def build_collection(root: str):
+    from gordo_tpu.machine import Machine
+    from gordo_tpu.parallel import FleetBuilder
+
+    tags = [f"tag-{i}" for i in range(1, N_TAGS + 1)]
+    dataset = {
+        "type": "RandomDataset",
+        "train_start_date": "2020-01-01T00:00:00+00:00",
+        "train_end_date": "2020-01-04T00:00:00+00:00",
+        "tag_list": tags,
+    }
+    model = {
+        "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "gordo_tpu.models.JaxAutoEncoder": {
+                    "kind": "feedforward_hourglass",
+                    "encoding_layers": 1,
+                    "epochs": 1,
+                }
+            }
+        }
+    }
+    machines = [
+        Machine.from_config(
+            {"name": f"swap-{i}", "model": model, "dataset": dict(dataset)},
+            project_name=PROJECT,
+        )
+        for i in range(N_MODELS)
+    ]
+    base_dir = os.path.join(root, BASE_REVISION)
+    FleetBuilder(machines, plan_strategy="packed").build(output_dir=base_dir)
+    return base_dir, tags
+
+
+def payload_for(tags):
+    index = [
+        f"2020-03-01T00:{m:02d}:00+00:00" for m in range(0, 60, 10)
+    ]
+    return {
+        "X": {
+            tag: {ts: 0.01 * i + 0.1 * j for j, ts in enumerate(index)}
+            for i, tag in enumerate(tags)
+        }
+    }
+
+
+def main() -> dict:
+    from werkzeug.test import Client
+
+    from gordo_tpu import serve
+    from gordo_tpu.lifecycle import publish_canary
+    from gordo_tpu.serve import ServeConfig, ServeEngine
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server.fleet_store import STORE
+
+    tmp = tempfile.mkdtemp(prefix="bench-lifecycle-")
+    base_dir, tags = build_collection(tmp)
+
+    publish_start = time.monotonic()
+    alt_dir = publish_canary(tmp, BASE_REVISION, base_dir, [], ALT_REVISION)
+    publish_seconds = time.monotonic() - publish_start
+
+    os.environ["MODEL_COLLECTION_DIR"] = base_dir
+    os.environ["GORDO_TPU_SERVE_WARMUP"] = "0"
+    app = build_app(config={"EXPECTED_MODELS": []})
+    engine = ServeEngine(
+        ServeConfig(max_size=16, max_delay_ms=5.0, row_ladder=(8, 32))
+    )
+    serve.install_engine(engine)
+
+    payload = payload_for(tags)
+    statuses: dict = {}
+    revisions_seen = set()
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def hammer(i: int) -> None:
+        client = Client(app)
+        while not stop.is_set():
+            name = f"swap-{i % N_MODELS}"
+            resp = client.post(
+                f"/gordo/v0/{PROJECT}/{name}/prediction", json=payload
+            )
+            with lock:
+                statuses[resp.status_code] = (
+                    statuses.get(resp.status_code, 0) + 1
+                )
+                revisions_seen.add(resp.headers.get("revision"))
+
+    # warm both revisions before the clock starts (boot warmup's job)
+    STORE.fleet(base_dir).warm()
+    STORE.fleet(alt_dir).warm()
+
+    threads = [
+        threading.Thread(target=hammer, args=(i,), daemon=True)
+        for i in range(N_CLIENTS)
+    ]
+    bench_start = time.monotonic()
+    for thread in threads:
+        thread.start()
+
+    swap_seconds = []
+    targets = [alt_dir, base_dir]
+    for swap in range(N_SWAPS):
+        time.sleep(SWAP_INTERVAL_S)
+        target = targets[swap % 2]
+        start = time.monotonic()
+        STORE.swap(base_dir, target, warm=True)
+        swap_seconds.append(time.monotonic() - start)
+    time.sleep(SWAP_INTERVAL_S)
+    stop.set()
+    for thread in threads:
+        thread.join(timeout=30)
+    wall = time.monotonic() - bench_start
+    serve.install_engine(None)
+    engine.shutdown(drain=True)
+
+    total = sum(statuses.values())
+    dropped = sum(n for code, n in statuses.items() if code != 200)
+    quantiles = sorted(swap_seconds)
+    result = {
+        "bench": "lifecycle-hot-swap",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "models": N_MODELS,
+        "clients": N_CLIENTS,
+        "swaps": N_SWAPS,
+        "wall_sec": round(wall, 3),
+        "requests_total": total,
+        "requests_dropped": dropped,
+        "statuses": {str(code): n for code, n in sorted(statuses.items())},
+        "revisions_served": sorted(r for r in revisions_seen if r),
+        "publish_canary_sec": round(publish_seconds, 4),
+        "swap_p50_ms": round(
+            statistics.median(quantiles) * 1000.0, 3
+        ),
+        "swap_p95_ms": round(
+            quantiles[max(0, int(0.95 * len(quantiles)) - 1)] * 1000.0, 3
+        ),
+        "swap_max_ms": round(quantiles[-1] * 1000.0, 3),
+        "zero_dropped": dropped == 0,
+    }
+    return result
+
+
+if __name__ == "__main__":
+    outcome = main()
+    out_path = REPO_ROOT / "BENCH_LIFECYCLE.json"
+    with open(out_path, "w") as f:
+        json.dump(outcome, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(outcome, indent=1, sort_keys=True))
+    print(f"\nwrote {out_path}")

@@ -35,6 +35,7 @@ from ..machine.metadata import (
     BuildMetadata,
     CrossValidationMetaData,
     DatasetBuildMetadata,
+    DriftBaselineMetadata,
     ModelBuildMetadata,
     TrainingSummaryMetadata,
 )
@@ -237,8 +238,21 @@ class ModelBuilder:
                 query_duration_sec=time_elapsed_data,
                 dataset_meta=dataset.get_metadata(),
             ),
+            drift_baseline=self._drift_baseline(X),
         )
         return model, machine
+
+    @staticmethod
+    def _drift_baseline(X) -> DriftBaselineMetadata:
+        """The lifecycle drift monitor's training baseline (raw-input
+        feature stats); a frame it cannot summarize — exotic dtypes from
+        a custom provider — degrades to an empty baseline (the monitor
+        then self-calibrates) rather than failing the build."""
+        try:
+            return DriftBaselineMetadata.from_frame(X)
+        except Exception as exc:  # noqa: BLE001 - baseline is advisory
+            logger.debug("No drift baseline for this frame: %r", exc)
+            return DriftBaselineMetadata()
 
     @staticmethod
     def _extract_training_summary(model) -> TrainingSummaryMetadata:

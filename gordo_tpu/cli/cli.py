@@ -1134,6 +1134,255 @@ def cleanup_revisions(models_root: str, current_revision: str, keep: int, dry_ru
         )
 
 
+@click.group("lifecycle")
+def lifecycle_cli():
+    """Self-healing fleet lifecycle: drift-triggered incremental
+    rebuilds, canary promotion with auto-rollback, zero-downtime
+    hot-swap (gordo_tpu.lifecycle; docs/lifecycle.md)."""
+
+
+def _lifecycle_supervisor(
+    collection_dir: str,
+    machines_config: Optional[str],
+    canary_fraction: Optional[float],
+    auto_promote: Optional[bool] = None,
+):
+    from ..lifecycle import LifecycleConfig, LifecycleSupervisor
+
+    machines = (
+        _load_fleet_machines(machines_config) if machines_config else []
+    )
+    config = LifecycleConfig.from_env()
+    if canary_fraction is not None:
+        config.canary_fraction = canary_fraction
+    if auto_promote is not None:
+        config.auto_promote = auto_promote
+    return LifecycleSupervisor(machines, collection_dir, config=config)
+
+
+def _lifecycle_frames(machines) -> dict:
+    """One probe window per machine: the machine's own dataset fetch
+    (the scoring loop's data plane). Per-machine isolation — a machine
+    whose provider is down simply contributes no probe rows this
+    cycle."""
+    from ..dataset import GordoBaseDataset
+
+    frames = {}
+    for machine in machines:
+        try:
+            dataset = (
+                machine.dataset
+                if isinstance(machine.dataset, GordoBaseDataset)
+                else GordoBaseDataset.from_dict(machine.dataset)
+            )
+            X, _y = dataset.get_data()
+            frames[machine.name] = X
+        except Exception as exc:  # noqa: BLE001 - per-machine isolation
+            logger.warning("lifecycle probe fetch failed for %s: %r",
+                           machine.name, exc)
+    return frames
+
+
+def _echo_cycle(report) -> None:
+    click.echo(f"phase: {report.phase}")
+    if report.drifted:
+        for name, reasons in sorted(report.drifted.items()):
+            click.echo(f"  drifted {name}: {'; '.join(reasons)}")
+    if report.canary_revision:
+        click.echo(f"  canary revision: {report.canary_revision}")
+    if report.gate is not None:
+        verdict = "PASSED" if report.gate["passed"] else "FAILED"
+        click.echo(f"  gates: {verdict}")
+        for failure in report.gate["failures"]:
+            click.echo(f"    {failure}")
+    if report.promoted:
+        click.echo(
+            f"  promoted (swap {report.details.get('swap_seconds', 0)}s)"
+        )
+    if report.rolled_back:
+        click.echo("  rolled back; serving stays on the last-good revision")
+
+
+@lifecycle_cli.command("run")
+@click.argument("machines-config", envvar="MACHINES_CONFIG")
+@click.argument("collection-dir", envvar="MODEL_COLLECTION_DIR")
+@click.option(
+    "--once", is_flag=True, help="Run a single cycle and exit (cron mode)."
+)
+@click.option(
+    "--interval",
+    default=300.0,
+    type=click.FloatRange(min=0.0),
+    show_default=True,
+    help="Seconds between cycles in loop mode.",
+)
+@click.option(
+    "--cycles",
+    default=None,
+    type=click.IntRange(min=1),
+    help="Stop after this many cycles (default: run forever).",
+)
+@click.option(
+    "--canary-fraction",
+    default=None,
+    type=click.FloatRange(0.0, 1.0, min_open=True),
+    help="Traffic slice routed to a canary under evaluation "
+    "[GORDO_TPU_CANARY_FRACTION, default 0.25].",
+)
+@click.option(
+    "--auto-promote/--no-auto-promote",
+    default=True,
+    show_default=True,
+    help="Promote automatically when the gates pass; off leaves the "
+    "canary serving its slice until `lifecycle promote`.",
+)
+@click.option(
+    "--dry-run",
+    is_flag=True,
+    help="Observe and report drift only; never rebuild or route.",
+)
+def lifecycle_run(
+    machines_config: str,
+    collection_dir: str,
+    once: bool,
+    interval: float,
+    cycles: Optional[int],
+    canary_fraction: Optional[float],
+    auto_promote: bool,
+    dry_run: bool,
+):
+    """
+    Supervise COLLECTION_DIR (a served revision directory): each cycle
+    scores every machine's current data through the serving fleet,
+    updates per-machine drift statistics, incrementally rebuilds
+    members that tripped, canaries the result and promotes (or rolls
+    back) through the gates. Crash-safe: state and build journals
+    under ``<models root>/.lifecycle`` make every phase resumable.
+
+    Canary/hot-swap ROUTING is per-process (the store is process
+    memory): embed the supervisor in the serving process for live
+    traffic splitting; a separately-running server picks promotions
+    up at its next boot. See docs/lifecycle.md "Deployment model".
+    """
+    import time as time_mod
+
+    supervisor = _lifecycle_supervisor(
+        collection_dir, machines_config, canary_fraction, auto_promote
+    )
+    try:
+        ran = 0
+        while True:
+            frames = _lifecycle_frames(supervisor.machines)
+            if dry_run:
+                supervisor.observe(frames)
+                verdicts = supervisor.evaluate_drift()
+                for name, verdict in sorted(verdicts.items()):
+                    status = "DRIFTED" if verdict.drifted else "ok"
+                    click.echo(
+                        f"{name}: {status} {'; '.join(verdict.reasons)}"
+                    )
+            else:
+                _echo_cycle(supervisor.run_cycle(frames))
+            ran += 1
+            if once or (cycles is not None and ran >= cycles):
+                break
+            time_mod.sleep(interval)
+    finally:
+        supervisor.close()
+
+
+@lifecycle_cli.command("status")
+@click.argument("models-root", envvar="MODELS_ROOT")
+@click.option("--as-json", is_flag=True, help="Machine-readable output.")
+def lifecycle_status(models_root: str, as_json: bool):
+    """The lifecycle state and quarantine record for MODELS_ROOT (the
+    directory holding the numbered revision dirs)."""
+    from ..lifecycle import LifecycleState
+
+    state = LifecycleState.load(models_root)
+    quarantined = state.quarantined()
+    if as_json:
+        click.echo(
+            json.dumps(
+                {"state": state.doc, "quarantined": quarantined},
+                indent=1,
+                sort_keys=True,
+                default=str,
+            )
+        )
+        return
+    click.echo(f"phase:    {state.phase}")
+    click.echo(f"anchor:   {state.anchor_revision}")
+    click.echo(f"serving:  {state.serving_revision}")
+    click.echo(f"canary:   {state.canary_revision or '-'}")
+    if state.stale:
+        click.echo(f"stale:    {', '.join(state.stale)}")
+    for entry in (state.doc.get("history") or [])[-5:]:
+        click.echo(
+            f"  {entry.get('event')}: serving={entry.get('serving_revision')}"
+            f" canary={entry.get('canary_revision')}"
+        )
+    click.echo(f"quarantined canaries: {len(quarantined)}")
+    for record in quarantined[-3:]:
+        click.echo(
+            f"  revision {record.get('canary_revision')}: "
+            f"{'; '.join(record.get('reasons', [])[:2])}"
+        )
+
+
+@lifecycle_cli.command("promote")
+@click.argument("collection-dir", envvar="MODEL_COLLECTION_DIR")
+@click.option(
+    "--machines-config",
+    envvar="MACHINES_CONFIG",
+    default=None,
+    help="Machine YAML for fetching a probe window (gates need scored "
+    "data; without it only --force can promote).",
+)
+@click.option(
+    "--force",
+    is_flag=True,
+    help="Skip the gates (operator has verified the canary externally).",
+)
+def lifecycle_promote(
+    collection_dir: str, machines_config: Optional[str], force: bool
+):
+    """Promote the current canary revision into serving."""
+    supervisor = _lifecycle_supervisor(collection_dir, machines_config, None)
+    try:
+        if machines_config and not force:
+            supervisor.observe(_lifecycle_frames(supervisor.machines))
+        report = supervisor.promote(force=force)
+    except RuntimeError as exc:
+        raise click.ClickException(str(exc)) from exc
+    finally:
+        supervisor.close()
+    _echo_cycle(report)
+    if report.rolled_back:
+        raise click.ClickException("gates failed; canary rolled back")
+
+
+@lifecycle_cli.command("rollback")
+@click.argument("collection-dir", envvar="MODEL_COLLECTION_DIR")
+@click.option(
+    "--reason",
+    default="operator rollback",
+    show_default=True,
+    help="Recorded in the quarantine entry.",
+)
+def lifecycle_rollback(collection_dir: str, reason: str):
+    """Roll back the current canary: drop its traffic slice, quarantine
+    it, and keep serving the last-good revision."""
+    supervisor = _lifecycle_supervisor(collection_dir, None, None)
+    try:
+        report = supervisor.rollback(reason)
+    except RuntimeError as exc:
+        raise click.ClickException(str(exc)) from exc
+    finally:
+        supervisor.close()
+    _echo_cycle(report)
+
+
 gordo_tpu_cli.add_command(workflow_cli)
 gordo_tpu_cli.add_command(client_cli)
 gordo_tpu_cli.add_command(build)
@@ -1145,6 +1394,7 @@ gordo_tpu_cli.add_command(wait_for_models)
 gordo_tpu_cli.add_command(score)
 gordo_tpu_cli.add_command(ensure_single_workflow)
 gordo_tpu_cli.add_command(cleanup_revisions)
+gordo_tpu_cli.add_command(lifecycle_cli)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,58 @@
+"""
+Self-healing fleet lifecycle: drift-triggered incremental rebuilds,
+canary promotion with auto-rollback, and zero-downtime hot-swap.
+
+The production scenario is not a one-shot build: thousands of
+per-machine anomaly models must stay calibrated for months under
+continuously arriving sensor data. This package turns the one-shot
+subsystems into that loop — drift statistics over scored data
+(``drift.py``), partial rebuilds of only the stale members (via
+``gordo_tpu.parallel.rebuild_stale`` + FleetPlan replay), hardlinked
+canary revisions (``revision.py``), promotion gates (``gates.py``),
+crash-safe supervision state (``state.py``), and the supervisor itself
+(``loop.py``). Serving integration lives in
+``gordo_tpu.server.fleet_store`` (canary routing + hot swap). See
+``docs/lifecycle.md``.
+"""
+
+from .drift import DriftConfig, DriftMonitor, DriftVerdict, MachineDrift
+from .gates import GateConfig, GateReport, evaluate_canary
+from .loop import (
+    LIFECYCLE_TRACE_FILE,
+    CycleReport,
+    LifecycleConfig,
+    LifecycleSupervisor,
+    restore_serving_state,
+)
+from .revision import (
+    delete_revision_dir,
+    list_revisions,
+    next_revision,
+    publish_canary,
+    revision_complete,
+)
+from .state import LIFECYCLE_DIR, QUARANTINE_FILE, STATE_FILE, LifecycleState
+
+__all__ = [
+    "CycleReport",
+    "DriftConfig",
+    "DriftMonitor",
+    "DriftVerdict",
+    "GateConfig",
+    "GateReport",
+    "LIFECYCLE_DIR",
+    "LIFECYCLE_TRACE_FILE",
+    "LifecycleConfig",
+    "LifecycleState",
+    "LifecycleSupervisor",
+    "MachineDrift",
+    "QUARANTINE_FILE",
+    "STATE_FILE",
+    "delete_revision_dir",
+    "evaluate_canary",
+    "list_revisions",
+    "next_revision",
+    "publish_canary",
+    "restore_serving_state",
+    "revision_complete",
+]

@@ -1,0 +1,421 @@
+"""
+Per-machine drift statistics: the trigger of the self-healing loop.
+
+A fleet that lives for months under continuously arriving sensor data
+goes stale machine by machine, not all at once — the lifecycle loop
+therefore tracks TWO per-machine signals over the data it scores:
+
+- **feature drift** — the running mean of each raw input tag, compared
+  against the training baseline persisted in
+  ``BuildMetadata.drift_baseline`` (``machine/metadata.py``). A tag
+  whose serving-window mean has moved more than
+  ``GORDO_TPU_DRIFT_SIGMA`` training standard deviations counts as
+  shifted; a machine whose shifted-tag fraction reaches
+  ``GORDO_TPU_DRIFT_FEATURE_QUORUM`` is feature-drifted.
+- **residual drift** — the running mean of the per-row reconstruction
+  error (the raw-target-space mse ``fleet_scores`` already computes).
+  Training loss lives in the estimator's scaled space, so the serving
+  baseline is calibrated online from the machine's first
+  ``GORDO_TPU_DRIFT_CALIBRATION`` scored batches; once calibrated, a
+  window whose mean residual exceeds ``GORDO_TPU_DRIFT_RESIDUAL_RATIO``
+  × baseline is residual-drifted (the model no longer reconstructs what
+  it is seeing).
+
+Either signal trips the machine (``DriftVerdict.drifted``) once at
+least ``GORDO_TPU_DRIFT_MIN_SAMPLES`` rows are in the window — a drift
+verdict triggers a rebuild, so it must never fire off a handful of
+rows. All accumulators are plain Welford-style sums, snapshot/restore
+round-trip through JSON (the supervisor persists them in its state
+file), and evaluation is deterministic given the observed data.
+
+>>> config = DriftConfig(min_samples=4, sigma=1.0, calibration_batches=1)
+>>> machine = MachineDrift(
+...     "m-1",
+...     baseline={"feature_means": [0.0], "feature_stds": [1.0],
+...               "tags": ["t"], "n_samples": 100},
+...     config=config,
+... )
+>>> machine.observe([[5.0], [5.1], [4.9], [5.0]])
+>>> verdict = machine.evaluate()
+>>> verdict.drifted, verdict.reasons[0].startswith("feature-shift")
+(True, True)
+"""
+
+import logging
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.env import env_float, env_int
+from ..utils.faults import fault_point
+
+logger = logging.getLogger(__name__)
+
+#: guard against degenerate (constant-tag) baselines: a zero training
+#: std would make any noise look like infinite drift
+_STD_FLOOR = 1e-9
+
+
+@dataclass
+class DriftConfig:
+    """Drift-detection knobs, all env-overridable (``from_env``)."""
+
+    #: mean shift, in training-stds, for one tag to count as shifted.
+    #: 2.0 by default: sensor series are autocorrelated, so a short
+    #: window's mean routinely wanders ~1σ from the training mean
+    #: without the distribution having moved — a 1σ trigger would
+    #: rebuild-storm on healthy random walks
+    sigma: float = 2.0
+    #: fraction of tags that must shift for feature drift (≥1 tag always)
+    feature_quorum: float = 0.25
+    #: window residual mean / calibrated baseline ratio for residual drift
+    residual_ratio: float = 2.0
+    #: rows required in the window before any verdict can fire
+    min_samples: int = 64
+    #: scored batches that form the online residual baseline
+    calibration_batches: int = 3
+
+    @classmethod
+    def from_env(cls) -> "DriftConfig":
+        return cls(
+            sigma=env_float("GORDO_TPU_DRIFT_SIGMA", 2.0),
+            feature_quorum=env_float("GORDO_TPU_DRIFT_FEATURE_QUORUM", 0.25),
+            residual_ratio=env_float("GORDO_TPU_DRIFT_RESIDUAL_RATIO", 2.0),
+            min_samples=env_int("GORDO_TPU_DRIFT_MIN_SAMPLES", 64),
+            calibration_batches=env_int("GORDO_TPU_DRIFT_CALIBRATION", 3),
+        )
+
+
+@dataclass
+class DriftVerdict:
+    """One machine's evaluation: drifted or not, with the why."""
+
+    machine: str
+    drifted: bool = False
+    reasons: List[str] = field(default_factory=list)
+    stats: Dict[str, Any] = field(default_factory=dict)
+
+
+class MachineDrift:
+    """Welford-style window accumulators + drift tests for one machine.
+
+    ``baseline`` is the ``drift_baseline`` dict out of the machine's
+    build metadata (missing/empty baselines disable the feature test —
+    the machine can still residual-drift)."""
+
+    def __init__(
+        self,
+        name: str,
+        baseline: Optional[Dict[str, Any]] = None,
+        config: Optional[DriftConfig] = None,
+    ):
+        self.name = name
+        self.config = config or DriftConfig()
+        self.baseline = baseline if baseline and baseline.get("tags") else None
+        # current window (cleared on every verdict); sums and counts
+        # are per-feature and NaN-aware — raw sensor frames routinely
+        # carry NaN rows, and one NaN must not poison (and thereby
+        # silently disable) the whole feature test
+        self._n = 0
+        self._sum: Optional[np.ndarray] = None
+        self._counts: Optional[np.ndarray] = None
+        self._res_n = 0
+        self._res_sum = 0.0
+        # online residual baseline (first calibration_batches batches)
+        self._cal_batches = 0
+        self._cal_n = 0
+        self._cal_sum = 0.0
+
+    # -- observation --------------------------------------------------------
+
+    def observe(self, X: Any, residuals: Any = None) -> None:
+        """Fold one scored batch into the window: ``X`` the raw input
+        rows (array/DataFrame), ``residuals`` the per-row mse the
+        scoring path computed (optional — metadata-only probes)."""
+        values = np.asarray(
+            X.to_numpy() if hasattr(X, "to_numpy") else X, dtype=float
+        )
+        if values.ndim == 1:
+            values = values[:, None]
+        if len(values):
+            finite = np.isfinite(values)
+            batch_sum = np.where(finite, values, 0.0).sum(axis=0)
+            if self._sum is None or self._sum.shape != batch_sum.shape:
+                self._sum = np.zeros_like(batch_sum)
+                self._counts = np.zeros(batch_sum.shape, dtype=np.int64)
+                self._n = 0
+            self._sum += batch_sum
+            self._counts += finite.sum(axis=0)
+            self._n += len(values)
+        if residuals is None:
+            return
+        res = np.asarray(residuals, dtype=float).ravel()
+        res = res[np.isfinite(res)]
+        if not len(res):
+            return
+        if self._cal_batches < self.config.calibration_batches:
+            self._cal_batches += 1
+            self._cal_n += len(res)
+            self._cal_sum += float(res.sum())
+        else:
+            self._res_n += len(res)
+            self._res_sum += float(res.sum())
+
+    # -- evaluation ---------------------------------------------------------
+
+    @property
+    def residual_baseline(self) -> Optional[float]:
+        """The calibrated per-row residual baseline (None until the
+        calibration window completes)."""
+        if self._cal_batches < self.config.calibration_batches or not self._cal_n:
+            return None
+        return self._cal_sum / self._cal_n
+
+    def evaluate(self, reset: bool = True) -> DriftVerdict:
+        """The machine's drift verdict over the current window. Each
+        signal's accumulator resets only once that signal was actually
+        TESTABLE (its window reached ``min_samples``): a machine fed
+        small per-cycle batches keeps accumulating evidence across
+        cycles instead of having every sub-threshold window discarded
+        — which would make drift permanently undetectable for it."""
+        fault_point("drift_eval", self.name)
+        verdict = DriftVerdict(machine=self.name)
+        config = self.config
+        verdict.stats["window_rows"] = self._n
+        features_tested = residuals_tested = False
+        try:
+            if self._n >= config.min_samples and self.baseline is not None:
+                features_tested = True
+                self._feature_test(verdict)
+            if self._res_n >= config.min_samples:
+                residuals_tested = True
+                self._residual_test(verdict)
+        finally:
+            if reset:
+                if features_tested:
+                    self._reset_features()
+                if residuals_tested:
+                    self._reset_residuals()
+        verdict.drifted = bool(verdict.reasons)
+        return verdict
+
+    def _feature_test(self, verdict: DriftVerdict) -> None:
+        means = np.asarray(
+            [
+                v if v is not None else np.nan
+                for v in (self.baseline.get("feature_means") or [])
+            ],
+            float,
+        )
+        stds = np.asarray(
+            [
+                v if v is not None else np.nan
+                for v in (self.baseline.get("feature_stds") or [])
+            ],
+            float,
+        )
+        # a column with ZERO finite rows in the window (offline sensor)
+        # is NaN — not 0.0, which would read as a giant shift from any
+        # nonzero baseline and trip drift off a dead sensor
+        window_mean = np.where(
+            self._counts > 0,
+            self._sum / np.maximum(self._counts, 1),
+            np.nan,
+        )
+        if means.shape != window_mean.shape or stds.shape != means.shape:
+            # tag set changed since the baseline was built — the NEXT
+            # rebuild records a fresh one; no feature verdict until then
+            verdict.stats["feature_baseline"] = "shape-mismatch"
+            return
+        shift = np.abs(window_mean - means) / np.maximum(stds, _STD_FLOOR)
+        # a tag whose baseline stat or window mean is non-finite (NaN
+        # training column, all-NaN window) cannot vote either way —
+        # NaN comparisons being always-False must never read as "no
+        # drift" for the tags that ARE measurable
+        shift = np.where(np.isfinite(shift), shift, 0.0)
+        measurable = int(
+            np.isfinite(means).sum()
+        )  # quorum over tags that can actually be tested
+        if not measurable:
+            verdict.stats["feature_baseline"] = "no-finite-baseline"
+            return
+        tags = list(self.baseline.get("tags") or [])
+        needed = max(1, int(math.ceil(self.config.feature_quorum * measurable)))
+        shifted = [i for i in range(len(shift)) if shift[i] > self.config.sigma]
+        verdict.stats["feature_shift_max"] = round(float(shift.max()), 4)
+        verdict.stats["feature_shifted"] = len(shifted)
+        if len(shifted) >= needed:
+            worst = max(shifted, key=lambda i: shift[i])
+            tag = tags[worst] if worst < len(tags) else str(worst)
+            verdict.reasons.append(
+                f"feature-shift {tag} ({shift[worst]:.2f}σ, "
+                f"{len(shifted)}/{len(shift)} tags)"
+            )
+
+    def _residual_test(self, verdict: DriftVerdict) -> None:
+        baseline = self.residual_baseline
+        if baseline is None or baseline <= 0:
+            verdict.stats["residual_baseline"] = "uncalibrated"
+            return
+        window = self._res_sum / self._res_n
+        ratio = window / baseline
+        verdict.stats["residual_ratio"] = round(float(ratio), 4)
+        if ratio > self.config.residual_ratio:
+            verdict.reasons.append(
+                f"residual-ratio {ratio:.2f}x over the calibrated baseline"
+            )
+
+    def _reset_features(self) -> None:
+        self._n = 0
+        self._sum = None
+        self._counts = None
+
+    def _reset_residuals(self) -> None:
+        self._res_n = 0
+        self._res_sum = 0.0
+
+    def reset_window(self) -> None:
+        self._reset_features()
+        self._reset_residuals()
+
+    # -- persistence --------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-roundtrippable accumulator state (supervisor state file)."""
+        return {
+            "n": self._n,
+            "sum": list(self._sum) if self._sum is not None else None,
+            "counts": (
+                [int(c) for c in self._counts]
+                if self._counts is not None
+                else None
+            ),
+            "res_n": self._res_n,
+            "res_sum": self._res_sum,
+            "cal_batches": self._cal_batches,
+            "cal_n": self._cal_n,
+            "cal_sum": self._cal_sum,
+        }
+
+    def restore(self, snapshot: Dict[str, Any]) -> None:
+        self._n = int(snapshot.get("n") or 0)
+        raw = snapshot.get("sum")
+        self._sum = np.asarray(raw, float) if raw is not None else None
+        raw_counts = snapshot.get("counts")
+        if raw_counts is not None:
+            self._counts = np.asarray(raw_counts, np.int64)
+        elif self._sum is not None:
+            # snapshot from before per-feature counts: every row finite
+            self._counts = np.full(self._sum.shape, self._n, np.int64)
+        else:
+            self._counts = None
+        self._res_n = int(snapshot.get("res_n") or 0)
+        self._res_sum = float(snapshot.get("res_sum") or 0.0)
+        self._cal_batches = int(snapshot.get("cal_batches") or 0)
+        self._cal_n = int(snapshot.get("cal_n") or 0)
+        self._cal_sum = float(snapshot.get("cal_sum") or 0.0)
+
+
+class DriftMonitor:
+    """The fleet's per-machine :class:`MachineDrift` set, loadable from
+    a served revision's artifact metadata."""
+
+    def __init__(self, config: Optional[DriftConfig] = None):
+        self.config = config or DriftConfig.from_env()
+        self._machines: Dict[str, MachineDrift] = {}
+
+    @classmethod
+    def from_revision(
+        cls, collection_dir: str, config: Optional[DriftConfig] = None
+    ) -> "DriftMonitor":
+        """A monitor seeded with every artifact's persisted
+        ``drift_baseline`` (machines without one — older artifacts,
+        exotic providers — still join, feature test disabled)."""
+        from .. import serializer
+
+        monitor = cls(config)
+        for name in serializer.list_model_dirs(collection_dir):
+            monitor.ensure(name, baseline=_load_baseline(collection_dir, name))
+        return monitor
+
+    def ensure(
+        self, name: str, baseline: Optional[Dict[str, Any]] = None
+    ) -> MachineDrift:
+        machine = self._machines.get(name)
+        if machine is None:
+            machine = MachineDrift(name, baseline=baseline, config=self.config)
+            self._machines[name] = machine
+        return machine
+
+    def machines(self) -> List[str]:
+        return sorted(self._machines)
+
+    def observe_scores(
+        self,
+        frames: Dict[str, Any],
+        scores: Dict[str, Any],
+    ) -> None:
+        """Feed one scored request window: ``frames[name] -> X`` raw
+        input rows, ``scores[name] -> (reconstruction, per-row mse)``
+        as returned by ``RevisionFleet.fleet_scores``. Machines whose
+        scoring failed contribute no residuals (their errors are the
+        serving path's concern, not a drift signal)."""
+        for name, X in frames.items():
+            entry = scores.get(name)
+            residuals = entry[1] if entry is not None else None
+            try:
+                self.ensure(name).observe(X, residuals)
+            except Exception as exc:  # noqa: BLE001 - one machine's bad
+                # frame must not poison the whole window's statistics
+                logger.warning("drift observe failed for %s: %r", name, exc)
+
+    def evaluate(self, reset: bool = True) -> Dict[str, DriftVerdict]:
+        """Every machine's verdict. Per-machine isolation: an evaluation
+        error marks that machine not-drifted (logged) instead of taking
+        the loop down — process-fatal signals still propagate."""
+        verdicts: Dict[str, DriftVerdict] = {}
+        for name, machine in sorted(self._machines.items()):
+            try:
+                verdicts[name] = machine.evaluate(reset=reset)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as exc:  # noqa: BLE001 - per-machine isolation
+                logger.warning("drift evaluation failed for %s: %r", name, exc)
+                verdicts[name] = DriftVerdict(
+                    machine=name, stats={"error": repr(exc)}
+                )
+        return verdicts
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            name: machine.snapshot() for name, machine in self._machines.items()
+        }
+
+    def restore(self, snapshot: Dict[str, Any]) -> None:
+        for name, machine_snapshot in (snapshot or {}).items():
+            try:
+                self.ensure(name).restore(machine_snapshot)
+            except (TypeError, ValueError) as exc:
+                logger.warning("drift snapshot for %s ignored: %r", name, exc)
+
+
+def _load_baseline(collection_dir: str, name: str) -> Optional[Dict[str, Any]]:
+    """The persisted drift baseline out of one artifact's metadata.json
+    (None for artifacts predating the baseline, or torn metadata)."""
+    import json
+    import os
+
+    path = os.path.join(collection_dir, name, "metadata.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return (
+            doc.get("metadata", {})
+            .get("build_metadata", {})
+            .get("drift_baseline")
+        )
+    except (OSError, ValueError, AttributeError) as exc:
+        logger.debug("no drift baseline for %s/%s: %r", collection_dir, name, exc)
+        return None

@@ -1,0 +1,178 @@
+"""
+Canary promotion gates: a rebuilt fleet slice earns traffic, it is
+never granted it.
+
+Before a canary revision is hot-swapped into serving, every REBUILT
+member must pass, on the same probe window scored against both the
+base and the canary fleets:
+
+- **load/score gate** — the canary artifact loads and scores the probe
+  rows without error and with finite outputs; the per-canary error
+  rate must stay at ``GORDO_TPU_GATE_MAX_ERROR_RATE`` (default 0: one
+  broken rebuild blocks promotion);
+- **threshold-parity gate** — a rebuilt anomaly detector's aggregate
+  threshold must stay within ``GORDO_TPU_GATE_THRESHOLD_RATIO`` × of
+  the base model's (either direction). Retraining on drifted data
+  legitimately moves thresholds; a threshold orders of magnitude away
+  means the rebuild trained on garbage and would flag everything (or
+  nothing) the moment it took traffic;
+- **residual-parity gate** — the canary's mean reconstruction error on
+  the probe window must not exceed ``GORDO_TPU_GATE_RESIDUAL_RATIO`` ×
+  the base model's on the same rows. The base is the STALE model, so a
+  healthy rebuild usually scores far below it — a canary that scores
+  materially WORSE than a model already flagged as drifted is broken,
+  whatever its training loss claimed.
+
+Gate failures are collected (not short-circuited) so the quarantine
+record explains every reason at once.
+"""
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.env import env_float
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class GateConfig:
+    """Promotion-gate knobs, env-overridable (``from_env``)."""
+
+    max_error_rate: float = 0.0
+    threshold_ratio: float = 4.0
+    residual_ratio: float = 2.0
+
+    @classmethod
+    def from_env(cls) -> "GateConfig":
+        return cls(
+            max_error_rate=env_float("GORDO_TPU_GATE_MAX_ERROR_RATE", 0.0),
+            threshold_ratio=env_float("GORDO_TPU_GATE_THRESHOLD_RATIO", 4.0),
+            residual_ratio=env_float("GORDO_TPU_GATE_RESIDUAL_RATIO", 2.0),
+        )
+
+
+@dataclass
+class GateReport:
+    """The full gate evaluation: pass/fail plus per-check evidence."""
+
+    passed: bool = True
+    failures: List[str] = field(default_factory=list)
+    checks: Dict[str, Any] = field(default_factory=dict)
+
+    def fail(self, reason: str) -> None:
+        self.passed = False
+        self.failures.append(reason)
+
+
+def _aggregate_threshold(model: Any) -> Optional[float]:
+    value = getattr(model, "aggregate_threshold_", None)
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return None
+    return value if np.isfinite(value) and value > 0 else None
+
+
+def evaluate_canary(
+    base_fleet: Any,
+    canary_fleet: Any,
+    frames: Dict[str, Any],
+    rebuilt_names: Sequence[str],
+    config: Optional[GateConfig] = None,
+) -> GateReport:
+    """
+    Gate ``rebuilt_names`` for promotion: score the probe ``frames``
+    (``name -> X``) on both fleets and apply the three gates above.
+    Members without probe data still pass the load/threshold gates
+    (their artifacts are checked) but skip residual parity — promotion
+    with zero probe coverage of a rebuilt member is reported in
+    ``checks`` so operators can see what the gate could not test.
+    """
+    config = config or GateConfig.from_env()
+    report = GateReport()
+    rebuilt = sorted(set(rebuilt_names))
+    probe = {name: frames[name] for name in rebuilt if name in frames}
+    report.checks["rebuilt"] = rebuilt
+    report.checks["probed"] = sorted(probe)
+    unprobed = sorted(set(rebuilt) - set(probe))
+    if unprobed:
+        report.checks["unprobed"] = unprobed
+
+    base_scores, base_errors = (
+        base_fleet.fleet_scores(probe) if probe else ({}, {})
+    )
+    canary_scores, canary_errors = (
+        canary_fleet.fleet_scores(probe) if probe else ({}, {})
+    )
+
+    # -- load/score gate ----------------------------------------------------
+    errored = sorted(canary_errors)
+    nonfinite = sorted(
+        name
+        for name, (recon, mse) in canary_scores.items()
+        if not (np.all(np.isfinite(recon)) and np.all(np.isfinite(mse)))
+    )
+    bad = sorted(set(errored) | set(nonfinite))
+    error_rate = len(bad) / len(probe) if probe else 0.0
+    report.checks["error_rate"] = round(error_rate, 4)
+    if error_rate > config.max_error_rate:
+        report.fail(
+            f"canary error rate {error_rate:.2%} over "
+            f"{config.max_error_rate:.2%} ({', '.join(bad[:5])})"
+        )
+
+    # -- threshold-parity gate ----------------------------------------------
+    parity: Dict[str, Any] = {}
+    for name in rebuilt:
+        try:
+            base_thr = _aggregate_threshold(base_fleet.model(name))
+            canary_thr = _aggregate_threshold(canary_fleet.model(name))
+        except Exception as exc:  # noqa: BLE001 - a load failure here is
+            # the load gate's finding when probed; unprobed members must
+            # still surface it
+            if name not in bad:
+                report.fail(f"{name}: canary model unloadable ({exc!r})")
+            continue
+        if base_thr is None:
+            continue  # base is not a fitted detector: nothing to compare
+        if canary_thr is None:
+            report.fail(f"{name}: canary lost its anomaly threshold")
+            continue
+        ratio = max(base_thr, canary_thr) / min(base_thr, canary_thr)
+        parity[name] = round(ratio, 4)
+        if ratio > config.threshold_ratio:
+            report.fail(
+                f"{name}: threshold parity {ratio:.2f}x over "
+                f"{config.threshold_ratio:.2f}x "
+                f"(base {base_thr:.4g}, canary {canary_thr:.4g})"
+            )
+    report.checks["threshold_parity"] = parity
+
+    # -- residual-parity gate -----------------------------------------------
+    residual: Dict[str, Any] = {}
+    for name in sorted(probe):
+        base_entry = base_scores.get(name)
+        canary_entry = canary_scores.get(name)
+        if base_entry is None or canary_entry is None:
+            continue
+        base_mse = float(np.mean(base_entry[1]))
+        canary_mse = float(np.mean(canary_entry[1]))
+        if not np.isfinite(base_mse) or base_mse <= 0:
+            continue
+        ratio = canary_mse / base_mse
+        residual[name] = round(ratio, 4)
+        if ratio > config.residual_ratio:
+            report.fail(
+                f"{name}: canary residual {ratio:.2f}x the (already stale) "
+                f"base on the probe window"
+            )
+    report.checks["residual_parity"] = residual
+    if base_errors:
+        # informational: the stale base failing to score the probe does
+        # not block the canary (it is what the rebuild is fixing)
+        report.checks["base_errors"] = sorted(base_errors)
+    return report

@@ -1,0 +1,640 @@
+"""
+The self-healing fleet supervisor: drift → incremental rebuild → canary
+→ gated promotion (or rollback), with serving never interrupted.
+
+One :class:`LifecycleSupervisor` owns one served collection directory
+(the "anchor" — what the server's ``MODEL_COLLECTION_DIR`` points at)
+and runs cycles over scored data:
+
+1. **observe** — score incoming frames through the serving fleet and
+   fold them into the per-machine drift statistics (``drift.py``);
+2. **detect** — machines whose drift verdict trips become the *stale
+   set*; everything else is left alone;
+3. **rebuild** — ONLY the stale members retrain
+   (:func:`gordo_tpu.parallel.rebuild_stale`), journaled and resumable,
+   replaying the base build's FleetPlan so pad targets — and therefore
+   trained parameters — stay stable across crashes and restarts;
+4. **canary** — the rebuilt members are assembled into a full canary
+   revision (hardlinks for the untouched majority, ``revision.py``) and
+   a configurable slice of traffic routes to it
+   (``FleetModelStore.set_canary``);
+5. **gate** — threshold-parity / error-rate / residual-parity gates
+   (``gates.py``) on a probe window scored against BOTH fleets;
+6. **promote** — a passing canary hot-swaps into serving
+   (``FleetModelStore.swap``): in-flight requests finish against the
+   fleet object they resolved, new requests route to the pre-warmed
+   canary — nothing drops, nothing 500s;
+7. **rollback** — a failing canary loses its traffic slice immediately,
+   lands in the quarantine record with every gate failure, and serving
+   stays on the last-good revision.
+
+Every phase boundary persists to ``state.json`` (``state.py``) BEFORE
+its side effects, and every failure path carries a fault-injection site
+(``drift_eval``, ``canary_build``, ``promote_swap``, ``rollback``), so
+a crash at any instant is a drill, not an incident: a restarted
+supervisor resumes the interrupted phase and converges.
+"""
+
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import telemetry
+from ..utils.env import env_float
+from ..utils.faults import fault_point
+from .drift import DriftConfig, DriftMonitor, DriftVerdict
+from .gates import GateConfig, GateReport, evaluate_canary
+from .revision import list_revisions, next_revision, publish_canary
+from .state import LIFECYCLE_DIR, LifecycleState
+
+logger = logging.getLogger(__name__)
+
+#: the JSONL the supervisor's spans append to (build_trace-style)
+LIFECYCLE_TRACE_FILE = "lifecycle_trace.jsonl"
+
+
+@dataclass
+class LifecycleConfig:
+    """Supervisor knobs; drift and gate sub-configs ride along."""
+
+    #: slice of traffic the canary takes while under evaluation
+    canary_fraction: float = 0.25
+    #: promote automatically when the gates pass (False = operators run
+    #: ``gordo-tpu lifecycle promote`` after their own checks)
+    auto_promote: bool = True
+    #: warm the canary/promoted fleet (artifact loads + fused-program
+    #: precompile when the serve engine is on) before it takes traffic
+    warm_swaps: bool = True
+    #: a machine whose canary was quarantined this recently is NOT
+    #: re-tripped by drift — without a cooldown a persistent drift with
+    #: a broken rebuild path would canary-storm (rebuild, fail gates,
+    #: roll back, repeat) every cycle
+    quarantine_cooldown_s: float = 3600.0
+    drift: DriftConfig = field(default_factory=DriftConfig)
+    gates: GateConfig = field(default_factory=GateConfig)
+
+    @classmethod
+    def from_env(cls) -> "LifecycleConfig":
+        return cls(
+            canary_fraction=env_float("GORDO_TPU_CANARY_FRACTION", 0.25),
+            quarantine_cooldown_s=env_float(
+                "GORDO_TPU_QUARANTINE_COOLDOWN", 3600.0
+            ),
+            drift=DriftConfig.from_env(),
+            gates=GateConfig.from_env(),
+        )
+
+
+@dataclass
+class CycleReport:
+    """What one :meth:`LifecycleSupervisor.run_cycle` did."""
+
+    phase: str = "idle"
+    drifted: Dict[str, List[str]] = field(default_factory=dict)
+    stale: List[str] = field(default_factory=list)
+    canary_revision: Optional[str] = None
+    promoted: bool = False
+    rolled_back: bool = False
+    gate: Optional[Dict[str, Any]] = None
+    details: Dict[str, Any] = field(default_factory=dict)
+
+
+class LifecycleSupervisor:
+    """The drift-triggered rebuild/canary/promote loop for one served
+    collection directory."""
+
+    def __init__(
+        self,
+        machines: Sequence[Any],
+        collection_dir: str,
+        store: Any = None,
+        config: Optional[LifecycleConfig] = None,
+    ):
+        from ..server.fleet_store import STORE
+
+        self.machines = list(machines)
+        self.collection_dir = os.path.normpath(collection_dir)
+        self.models_root = os.path.dirname(self.collection_dir)
+        self.anchor_revision = os.path.basename(self.collection_dir)
+        self.store = store if store is not None else STORE
+        self.config = config or LifecycleConfig.from_env()
+        self.state = LifecycleState.load(self.models_root)
+        if self.state.anchor_revision not in (None, self.anchor_revision):
+            # a NEW deploy moved the served revision out from under the
+            # recorded lifecycle history: disk truth wins, start fresh
+            # (quarantine records are append-only and survive)
+            logger.warning(
+                "lifecycle state anchored to revision %s but serving %s; "
+                "starting a fresh lifecycle",
+                self.state.anchor_revision,
+                self.anchor_revision,
+            )
+            self.state = LifecycleState(self.models_root)
+        if self.state.anchor_revision is None:
+            self.state.update(
+                anchor_revision=self.anchor_revision,
+                serving_revision=self.anchor_revision,
+            )
+        self.recorder: Any = telemetry.NULL_RECORDER
+        if telemetry.enabled():
+            trace_dir = os.getenv(telemetry.TRACE_DIR_ENV) or os.path.join(
+                self.models_root, LIFECYCLE_DIR
+            )
+            try:
+                os.makedirs(trace_dir, exist_ok=True)
+                self.recorder = telemetry.SpanRecorder(
+                    sink_path=os.path.join(trace_dir, LIFECYCLE_TRACE_FILE),
+                    service="gordo-tpu-lifecycle",
+                )
+            except OSError as exc:
+                logger.debug("no lifecycle trace sink: %r", exc)
+        self.monitor = DriftMonitor.from_revision(
+            self.serving_dir, self.config.drift
+        )
+        self.monitor.restore(self.state.doc.get("drift") or {})
+        self._probe_frames: Optional[Dict[str, Any]] = None
+        self._project = (
+            getattr(self.machines[0], "project_name", "") if self.machines else ""
+        )
+
+    # -- identity -----------------------------------------------------------
+
+    @property
+    def serving_revision(self) -> str:
+        return self.state.serving_revision or self.anchor_revision
+
+    @property
+    def serving_dir(self) -> str:
+        return os.path.join(self.models_root, self.serving_revision)
+
+    def canary_dir(self, revision: Optional[str] = None) -> Optional[str]:
+        revision = revision or self.state.canary_revision
+        return (
+            os.path.join(self.models_root, revision) if revision else None
+        )
+
+    def _build_dir(self, revision: str) -> str:
+        return os.path.join(self.models_root, LIFECYCLE_DIR, f"build-{revision}")
+
+    def close(self) -> None:
+        self.recorder.close()
+
+    # -- observation --------------------------------------------------------
+
+    def observe(self, frames: Dict[str, Any]) -> Tuple[Dict, Dict]:
+        """Score ``frames`` through the SERVING fleet and fold the
+        results into the drift statistics; returns ``(scores, errors)``
+        exactly like ``RevisionFleet.fleet_scores`` (callers may serve
+        them — observation never double-scores traffic)."""
+        fleet = self.store.fleet(self.serving_dir)
+        with self.recorder.span(
+            "lifecycle_observe", machines=len(frames)
+        ):
+            scores, errors = fleet.fleet_scores(frames)
+        self.monitor.observe_scores(frames, scores)
+        self._probe_frames = dict(frames)
+        return scores, errors
+
+    def evaluate_drift(self) -> Dict[str, DriftVerdict]:
+        """Every machine's drift verdict (windows reset)."""
+        with self.recorder.span(
+            "drift_eval", machines=len(self.monitor.machines())
+        ):
+            verdicts = self.monitor.evaluate()
+        for name, verdict in verdicts.items():
+            if verdict.drifted:
+                self.recorder.event(
+                    "machine_drifted",
+                    machine=name,
+                    reasons=verdict.reasons,
+                    **{
+                        k: v
+                        for k, v in verdict.stats.items()
+                        if isinstance(v, (int, float))
+                    },
+                )
+        return verdicts
+
+    # -- the cycle ----------------------------------------------------------
+
+    def run_cycle(self, frames: Optional[Dict[str, Any]] = None) -> CycleReport:
+        """One supervision cycle: observe (when ``frames`` given), then
+        advance the state machine as far as it can go — a fresh drift
+        verdict can ride all the way to a promoted (or rolled-back)
+        canary in one call; an interrupted prior cycle resumes its
+        phase first."""
+        report = CycleReport(phase=self.state.phase)
+        with self.recorder.span("lifecycle_cycle", phase=self.state.phase):
+            if frames:
+                self.observe(frames)
+            if self.state.phase == "rolling_back":
+                self._finish_rollback(report)
+            if self.state.phase == "idle":
+                self._detect(report)
+            if self.state.phase == "canary_building":
+                self._build_and_publish(report)
+            if self.state.phase == "canary_serving":
+                self._gate_and_settle(report)
+            # drift accumulators survive restarts (windows in progress
+            # when the process dies are evidence, not noise)
+            self.state.update(drift=self.monitor.snapshot())
+        report.phase = self.state.phase
+        self._export_status(report)
+        return report
+
+    # -- phase steps --------------------------------------------------------
+
+    def _detect(self, report: CycleReport) -> None:
+        verdicts = self.evaluate_drift()
+        report.drifted = {
+            name: verdict.reasons
+            for name, verdict in verdicts.items()
+            if verdict.drifted
+        }
+        buildable = {m.name for m in self.machines}
+        stale = sorted(set(report.drifted) & buildable)
+        unbuildable = sorted(set(report.drifted) - buildable)
+        if unbuildable:
+            logger.warning(
+                "drifted machines with no machine config (cannot rebuild): %s",
+                ", ".join(unbuildable),
+            )
+            report.details["unbuildable"] = unbuildable
+        cooling = self._quarantine_cooldown() & set(stale)
+        if cooling:
+            logger.warning(
+                "drifted machines in quarantine cooldown (a recent canary "
+                "for them was rolled back): %s",
+                ", ".join(sorted(cooling)),
+            )
+            report.details["cooldown"] = sorted(cooling)
+            stale = sorted(set(stale) - cooling)
+        if not stale:
+            return
+        report.stale = stale
+        revision = next_revision(self.models_root)
+        logger.info(
+            "drift tripped %d machine(s) (%s); canary revision %s",
+            len(stale),
+            ", ".join(stale[:5]),
+            revision,
+        )
+        self.state.transition(
+            "canary_building",
+            event="drift_detected",
+            stale=stale,
+            canary_revision=revision,
+            drift=self.monitor.snapshot(),
+        )
+        self.recorder.event(
+            "canary_started", canary_revision=revision, stale=stale
+        )
+
+    def _build_and_publish(self, report: CycleReport) -> None:
+        from ..parallel.fleet_build import rebuild_stale
+        from ..planner import PLAN_FILE
+
+        stale = self.state.stale
+        revision = self.state.canary_revision
+        report.stale = stale
+        report.canary_revision = revision
+        fault_point("canary_build", revision or "")
+        build_dir = self._build_dir(revision)
+        with self.recorder.span(
+            "canary_build", canary_revision=revision, stale=len(stale)
+        ):
+            builder = rebuild_stale(
+                self.machines,
+                stale,
+                build_dir,
+                base_plan_path=os.path.join(self.serving_dir, PLAN_FILE),
+                resume=True,
+            )
+        failed = sorted(builder.build_errors)
+        rebuilt = sorted(set(stale) - set(failed))
+        report.details["rebuilt"] = rebuilt
+        report.details["resumed"] = sorted(builder.resumed)
+        if failed:
+            report.details["rebuild_failed"] = failed
+        if not rebuilt:
+            logger.error(
+                "canary %s: every stale member failed to rebuild; "
+                "serving stays on %s",
+                revision,
+                self.serving_revision,
+            )
+            self.state.quarantine(
+                {
+                    "canary_revision": revision,
+                    "machines": stale,
+                    "reasons": [
+                        f"{name}: rebuild failed ({exc!r})"
+                        for name, exc in sorted(builder.build_errors.items())
+                    ],
+                }
+            )
+            self.state.transition(
+                "idle", event="canary_build_failed", canary_revision=None,
+                stale=[], rebuilt=[],
+            )
+            self._count_event("rollbacks")
+            report.rolled_back = True
+            return
+        canary_path = publish_canary(
+            self.models_root,
+            self.serving_revision,
+            build_dir,
+            rebuilt,
+            revision,
+        )
+        self.recorder.event(
+            "canary_published",
+            canary_revision=revision,
+            rebuilt=rebuilt,
+            failed=failed,
+        )
+        fleet = self.store.set_canary(
+            self.collection_dir,
+            canary_path,
+            self.config.canary_fraction,
+            warm=self.config.warm_swaps,
+        )
+        self._warm_programs(fleet)
+        self.state.transition(
+            "canary_serving", event="canary_serving", rebuilt=rebuilt
+        )
+        self._count_event("rebuilds", len(rebuilt))
+
+    def _gate_and_settle(self, report: CycleReport) -> None:
+        revision = self.state.canary_revision
+        report.canary_revision = revision
+        canary_path = self.canary_dir(revision)
+        # routing is process memory: a restarted supervisor re-installs
+        # the canary slice before gating (idempotent when already set)
+        if self.store.canary_status() is None and canary_path:
+            self.store.set_canary(
+                self.collection_dir,
+                canary_path,
+                self.config.canary_fraction,
+                warm=self.config.warm_swaps,
+            )
+        probe = self._probe_frames
+        if not probe:
+            report.details["gate"] = "awaiting probe data"
+            return
+        rebuilt = list(self.state.doc.get("rebuilt") or self.state.stale)
+        try:
+            with self.recorder.span(
+                "canary_gate", canary_revision=revision, rebuilt=len(rebuilt)
+            ):
+                gate = evaluate_canary(
+                    self.store.fleet(self.serving_dir),
+                    self.store.fleet(canary_path),
+                    probe,
+                    rebuilt,
+                    self.config.gates,
+                )
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as exc:  # noqa: BLE001 - an unevaluable canary
+            # is a failed canary, never a crashed loop
+            gate = GateReport()
+            gate.fail(f"gate evaluation crashed: {exc!r}")
+        report.gate = {
+            "passed": gate.passed,
+            "failures": gate.failures,
+            "checks": gate.checks,
+        }
+        self.recorder.event(
+            "canary_gate",
+            canary_revision=revision,
+            passed=gate.passed,
+            failures=gate.failures,
+        )
+        if not gate.passed:
+            self._rollback(report, gate.failures)
+        elif self.config.auto_promote:
+            self._promote(report)
+        else:
+            report.details["gate"] = "passed; awaiting manual promote"
+
+    def _promote(self, report: CycleReport) -> None:
+        revision = self.state.canary_revision
+        canary_path = self.canary_dir(revision)
+        fault_point("promote_swap", revision or "")
+        start = time.monotonic()
+        with self.recorder.span("promote_swap", canary_revision=revision):
+            self.store.swap(
+                self.collection_dir, canary_path, warm=self.config.warm_swaps
+            )
+        swap_seconds = time.monotonic() - start
+        self.state.transition(
+            "idle",
+            event="promoted",
+            serving_revision=revision,
+            canary_revision=None,
+            stale=[],
+            rebuilt=[],
+        )
+        logger.info(
+            "promoted canary %s into serving (swap %.3fs)",
+            revision,
+            swap_seconds,
+        )
+        self.recorder.event(
+            "promoted", revision=revision, swap_seconds=round(swap_seconds, 4)
+        )
+        # fresh baselines: rebuilt members' artifacts carry new training
+        # stats, and every window restarts against the promoted fleet
+        self.monitor = DriftMonitor.from_revision(
+            self.serving_dir, self.config.drift
+        )
+        report.promoted = True
+        report.details["swap_seconds"] = round(swap_seconds, 4)
+        self._count_event("promotions")
+        self._observe_swap(swap_seconds)
+
+    def _rollback(self, report: CycleReport, reasons: List[str]) -> None:
+        self.state.transition(
+            "rolling_back", event="canary_rejected", reasons=reasons
+        )
+        self._finish_rollback(report, reasons=reasons)
+
+    def _finish_rollback(
+        self, report: CycleReport, reasons: Optional[List[str]] = None
+    ) -> None:
+        revision = self.state.canary_revision
+        reasons = reasons or list(self.state.doc.get("reasons") or [])
+        fault_point("rollback", revision or "")
+        with self.recorder.span("rollback", canary_revision=revision):
+            self.store.clear_canary(self.collection_dir)
+            # serving never left the last-good revision for non-canary
+            # traffic; re-assert the redirect in case a crashed promote
+            # landed its swap without its state transition
+            self.store.swap(
+                self.collection_dir, self.serving_dir, warm=False
+            )
+            self.state.quarantine(
+                {
+                    "canary_revision": revision,
+                    "machines": self.state.stale,
+                    "reasons": reasons,
+                }
+            )
+            self.state.transition(
+                "idle",
+                event="rolled_back",
+                canary_revision=None,
+                stale=[],
+                rebuilt=[],
+                reasons=[],
+            )
+        logger.warning(
+            "canary %s rolled back (%s); serving stays on %s",
+            revision,
+            "; ".join(reasons[:3]) or "no reasons recorded",
+            self.serving_revision,
+        )
+        self.recorder.event(
+            "rolled_back", canary_revision=revision, reasons=reasons
+        )
+        report.rolled_back = True
+        report.details["quarantined"] = revision
+        self._count_event("rollbacks")
+
+    def _quarantine_cooldown(self) -> set:
+        """Machines whose canaries were quarantined within the cooldown
+        window — excluded from new stale sets so a persistent drift
+        with a broken rebuild path cannot canary-storm."""
+        cooldown = self.config.quarantine_cooldown_s
+        if cooldown <= 0:
+            return set()
+        cutoff = time.time() - cooldown
+        cooling: set = set()
+        for record in self.state.quarantined():
+            if float(record.get("time") or 0.0) >= cutoff:
+                cooling.update(record.get("machines") or [])
+        return cooling
+
+    # -- manual controls (CLI) ----------------------------------------------
+
+    def promote(self, force: bool = False) -> CycleReport:
+        """Operator promote: gate the current canary with the last probe
+        window (unless ``force``) and swap it in."""
+        report = CycleReport(phase=self.state.phase)
+        if self.state.phase != "canary_serving":
+            raise RuntimeError(
+                f"no canary to promote (phase {self.state.phase})"
+            )
+        if force:
+            report.canary_revision = self.state.canary_revision
+            self._promote(report)
+        else:
+            previous, self.config.auto_promote = self.config.auto_promote, True
+            try:
+                self._gate_and_settle(report)
+            finally:
+                self.config.auto_promote = previous
+            if not (report.promoted or report.rolled_back):
+                raise RuntimeError(
+                    "gates could not run (no probe data scored yet); "
+                    "re-run after traffic or use --force"
+                )
+        report.phase = self.state.phase
+        return report
+
+    def rollback(self, reason: str = "operator rollback") -> CycleReport:
+        """Operator rollback of the current canary (or a re-run of an
+        interrupted one)."""
+        report = CycleReport(phase=self.state.phase)
+        if self.state.phase not in ("canary_serving", "rolling_back"):
+            raise RuntimeError(
+                f"no canary to roll back (phase {self.state.phase})"
+            )
+        report.canary_revision = self.state.canary_revision
+        if self.state.phase == "canary_serving":
+            self._rollback(report, [reason])
+        else:
+            self._finish_rollback(report, reasons=[reason])
+        report.phase = self.state.phase
+        return report
+
+    # -- best-effort exports ------------------------------------------------
+
+    def _warm_programs(self, fleet: Any) -> None:
+        """Precompile the fused serving programs for a fleet about to
+        take traffic (only when the micro-batching engine is on)."""
+        try:
+            from ..serve import get_engine
+
+            engine = get_engine()
+            if engine is not None:
+                engine.warmup_fleet(fleet)
+        except Exception as exc:  # noqa: BLE001 - warmup is an optimization
+            logger.debug("canary program warmup skipped: %r", exc)
+
+    def _count_event(self, event: str, n: int = 1) -> None:
+        try:
+            from ..server.prometheus.metrics import record_fleet_lifecycle_event
+
+            record_fleet_lifecycle_event(self._project, event, n)
+        except Exception as exc:  # noqa: BLE001 - metrics are advisory
+            logger.debug("lifecycle event not exported: %r", exc)
+
+    def _observe_swap(self, seconds: float) -> None:
+        try:
+            from ..server.prometheus.metrics import observe_lifecycle_swap
+
+            observe_lifecycle_swap(self._project, seconds)
+        except Exception as exc:  # noqa: BLE001 - metrics are advisory
+            logger.debug("swap duration not exported: %r", exc)
+
+    def _export_status(self, report: CycleReport) -> None:
+        try:
+            from ..server.prometheus.metrics import set_fleet_lifecycle_status
+
+            canary = self.store.canary_status()
+            set_fleet_lifecycle_status(
+                self._project,
+                drifted=len(report.drifted),
+                stale=len(self.state.stale),
+                canary_fraction=float(canary["fraction"]) if canary else 0.0,
+            )
+        except Exception as exc:  # noqa: BLE001 - metrics are advisory
+            logger.debug("lifecycle status not exported: %r", exc)
+
+
+def restore_serving_state(collection_dir: str) -> Optional[str]:
+    """Re-install a promoted revision's hot-swap redirect at server
+    boot: when the lifecycle state anchored to ``collection_dir``
+    records a different serving revision that still exists on disk, the
+    store routes requests there (lazily loaded — the boot warmup pass
+    handles residency). Returns the restored revision or None."""
+    from ..server.fleet_store import STORE
+
+    normalized = os.path.normpath(collection_dir)
+    root = os.path.dirname(normalized)
+    anchor = os.path.basename(normalized)
+    state = LifecycleState.load(root)
+    if state.anchor_revision != anchor:
+        return None
+    serving = state.serving_revision
+    if not serving or serving == anchor:
+        return None
+    target = os.path.join(root, serving)
+    if serving not in list_revisions(root) or not os.path.isdir(target):
+        logger.warning(
+            "lifecycle state serves revision %s but it is gone; serving %s",
+            serving,
+            anchor,
+        )
+        return None
+    STORE.swap(normalized, target, warm=False)
+    logger.info(
+        "restored lifecycle serving state: %s routes to revision %s",
+        normalized,
+        serving,
+    )
+    return serving

@@ -1,0 +1,162 @@
+"""
+Canary revision assembly: an incremental rebuild becomes a FULL
+revision directory without retraining (or even copying) the untouched
+majority.
+
+Revisions are numeric directories under the models root (the layout
+``run-server``/``cleanup-revisions``/the DELETE route already share).
+:func:`publish_canary` assembles ``<root>/<revision>`` from the base
+revision plus the rebuilt artifacts: untouched members are HARDLINKED
+file-by-file (same volume, O(files) metadata ops, zero bytes copied —
+with a copy fallback for cross-device layouts), rebuilt members come
+from the lifecycle build directory. Assembly happens in a dotted
+``.<revision>.tmp-<pid>`` staging dir — the same atomic-publish
+convention as artifact dumps, so every discovery path already
+classifies a crashed half-assembled canary as a staging leftover
+(swept by ``clean_staging_dirs``) and a revision directory, once
+visible, is always complete.
+"""
+
+import logging
+import os
+import shutil
+from typing import List, Optional, Sequence
+
+from .. import serializer
+from ..parallel.journal import artifact_complete
+from ..planner import PLAN_FILE
+
+logger = logging.getLogger(__name__)
+
+
+def list_revisions(models_root: str) -> List[str]:
+    """Numeric revision directories under ``models_root``, oldest
+    first (numeric order: '1000' is newer than '999')."""
+    try:
+        entries = os.listdir(models_root)
+    except FileNotFoundError:
+        return []
+    return sorted(
+        (
+            entry
+            for entry in entries
+            if entry.isdigit() and os.path.isdir(os.path.join(models_root, entry))
+        ),
+        key=int,
+    )
+
+
+def next_revision(models_root: str) -> str:
+    """The next free numeric revision name (max + 1; '1' for an empty
+    root). Deterministic on purpose: the lifecycle state file records
+    the chosen name BEFORE the build starts, so a crashed canary
+    resumes into the same revision id."""
+    revisions = list_revisions(models_root)
+    return str(int(revisions[-1]) + 1) if revisions else "1"
+
+
+def revision_complete(revision_dir: str) -> bool:
+    """Every artifact in ``revision_dir`` checksum-complete (and at
+    least one present) — the idempotence check a resumed publish uses
+    before trusting an already-visible revision."""
+    names = serializer.list_model_dirs(revision_dir)
+    return bool(names) and all(
+        artifact_complete(os.path.join(revision_dir, name)) for name in names
+    )
+
+
+def publish_canary(
+    models_root: str,
+    base_revision: str,
+    rebuilt_dir: str,
+    rebuilt_names: Sequence[str],
+    revision: str,
+) -> str:
+    """
+    Assemble and atomically publish ``<models_root>/<revision>`` from
+    the base revision's artifacts with ``rebuilt_names`` taken from
+    ``rebuilt_dir`` instead. Returns the revision directory path.
+
+    Idempotent: a complete already-published revision (a crash landed
+    between rename and state update, or a resumed supervisor re-runs
+    the step) is returned as-is. A crash mid-assembly leaves only a
+    dotted staging dir — never a torn revision.
+    """
+    target = os.path.join(models_root, revision)
+    if os.path.isdir(target):
+        if revision_complete(target):
+            logger.info("canary revision %s already published", revision)
+            return target
+        raise RuntimeError(
+            f"revision {revision} exists but is incomplete — refusing to "
+            "overwrite a directory this process did not stage"
+        )
+    base_dir = os.path.join(models_root, base_revision)
+    base_names = serializer.list_model_dirs(base_dir)
+    rebuilt = set(rebuilt_names)
+    missing = [
+        name
+        for name in rebuilt
+        if not artifact_complete(os.path.join(rebuilt_dir, name))
+    ]
+    if missing:
+        raise RuntimeError(
+            f"rebuilt artifacts incomplete for {sorted(missing)}; canary "
+            "cannot publish"
+        )
+    staging = os.path.join(models_root, f".{revision}.tmp-{os.getpid()}")
+    if os.path.isdir(staging):
+        shutil.rmtree(staging)
+    os.makedirs(staging)
+    try:
+        for name in sorted(set(base_names) | rebuilt):
+            source = os.path.join(
+                rebuilt_dir if name in rebuilt else base_dir, name
+            )
+            _link_tree(source, os.path.join(staging, name))
+        # the base build's full-fleet plan rides along: the NEXT
+        # incremental rebuild replays it so pad targets stay stable
+        plan_path = os.path.join(base_dir, PLAN_FILE)
+        if os.path.isfile(plan_path):
+            _link_file(plan_path, os.path.join(staging, PLAN_FILE))
+        os.rename(staging, target)
+    except BaseException:
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
+    logger.info(
+        "published canary revision %s (%d rebuilt, %d inherited from %s)",
+        revision,
+        len(rebuilt),
+        len(set(base_names) - rebuilt),
+        base_revision,
+    )
+    return target
+
+
+def _link_file(source: str, target: str) -> None:
+    try:
+        os.link(source, target)
+    except OSError:  # cross-device / FS without hardlinks
+        shutil.copy2(source, target)
+
+
+def _link_tree(source: str, target: str) -> None:
+    """Hardlink-or-copy one artifact directory tree."""
+    os.makedirs(target, exist_ok=True)
+    for entry in os.listdir(source):
+        src = os.path.join(source, entry)
+        dst = os.path.join(target, entry)
+        if os.path.isdir(src):
+            _link_tree(src, dst)
+        else:
+            _link_file(src, dst)
+
+
+def delete_revision_dir(models_root: str, revision: str) -> Optional[str]:
+    """Remove one revision directory (quarantined canary cleanup);
+    returns the removed path or None when absent."""
+    target = os.path.join(models_root, revision)
+    if not os.path.isdir(target):
+        return None
+    shutil.rmtree(target, ignore_errors=True)
+    return target

@@ -1,0 +1,166 @@
+"""
+Crash-safe lifecycle state: ``<models_root>/.lifecycle/state.json``.
+
+The supervisor is a long-running loop that may die at ANY point of a
+cycle — the state file is what makes every phase resumable. It records
+the phase machine (``idle → canary_building → canary_serving →
+[promoted | rolling_back] → idle``), the identities the phases need
+(anchor/serving/canary revisions, the stale member set), the drift
+monitor's accumulator snapshot, and a bounded event history. Every
+write is an atomic tempfile-then-``os.replace`` (the journal's
+convention), so a kill mid-write leaves the previous complete state.
+
+The quarantine record (``quarantine.json``, same directory) is
+append-only evidence: every rolled-back canary lands there with its
+revision, members and gate failures, so "why did this rebuild never
+take traffic" has a durable answer.
+"""
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+#: supervisor working directory under the models root (dotted: never a
+#: revision, and the serving store ignores non-numeric entries anyway)
+LIFECYCLE_DIR = ".lifecycle"
+STATE_FILE = "state.json"
+QUARANTINE_FILE = "quarantine.json"
+
+#: phases of the lifecycle state machine (``promoted``/``rolled_back``
+#: are history events, not phases — the machine rests in ``idle``)
+PHASES = ("idle", "canary_building", "canary_serving", "rolling_back")
+
+#: bounded history length (state.json must stay a small document)
+MAX_HISTORY = 50
+
+
+class LifecycleState:
+    """The persisted document plus its accessors; one per models root."""
+
+    def __init__(self, models_root: str):
+        self.models_root = models_root
+        self.directory = os.path.join(models_root, LIFECYCLE_DIR)
+        self.path = os.path.join(self.directory, STATE_FILE)
+        self.quarantine_path = os.path.join(self.directory, QUARANTINE_FILE)
+        self.doc: Dict[str, Any] = {
+            "version": 1,
+            "phase": "idle",
+            "anchor_revision": None,
+            "serving_revision": None,
+            "canary_revision": None,
+            "stale": [],
+            "drift": {},
+            "history": [],
+        }
+
+    @classmethod
+    def load(cls, models_root: str) -> "LifecycleState":
+        """Read the persisted state; missing or torn files yield a fresh
+        idle state (the supervisor then re-derives from disk truth)."""
+        state = cls(models_root)
+        try:
+            with open(state.path) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict) and doc.get("version") == 1:
+                state.doc.update(doc)
+                if state.doc.get("phase") not in PHASES:
+                    logger.warning(
+                        "unknown lifecycle phase %r; resetting to idle",
+                        state.doc.get("phase"),
+                    )
+                    state.doc["phase"] = "idle"
+        except FileNotFoundError:
+            pass
+        except (OSError, ValueError) as exc:
+            logger.warning(
+                "unreadable lifecycle state %s (%r); starting idle",
+                state.path,
+                exc,
+            )
+        return state
+
+    # -- accessors ----------------------------------------------------------
+
+    @property
+    def phase(self) -> str:
+        return str(self.doc.get("phase") or "idle")
+
+    @property
+    def anchor_revision(self) -> Optional[str]:
+        return self.doc.get("anchor_revision")
+
+    @property
+    def serving_revision(self) -> Optional[str]:
+        return self.doc.get("serving_revision")
+
+    @property
+    def canary_revision(self) -> Optional[str]:
+        return self.doc.get("canary_revision")
+
+    @property
+    def stale(self) -> List[str]:
+        return list(self.doc.get("stale") or [])
+
+    # -- mutation -----------------------------------------------------------
+
+    def update(self, **fields: Any) -> None:
+        """Merge fields and persist — no history entry (drift snapshot
+        refreshes etc.)."""
+        self.doc.update(fields)
+        self.save()
+
+    def transition(
+        self, phase: str, event: Optional[str] = None, **fields: Any
+    ) -> None:
+        """Move the state machine and persist atomically; ``event``
+        (default: the phase name) lands in the bounded history with a
+        timestamp and the fields' identity keys."""
+        if phase not in PHASES:
+            raise ValueError(f"unknown lifecycle phase {phase!r}")
+        self.doc.update(fields)
+        self.doc["phase"] = phase
+        entry = {
+            "time": time.time(),
+            "event": event or phase,
+            "serving_revision": self.doc.get("serving_revision"),
+            "canary_revision": self.doc.get("canary_revision"),
+        }
+        history = list(self.doc.get("history") or [])
+        history.append(entry)
+        self.doc["history"] = history[-MAX_HISTORY:]
+        self.save()
+
+    def save(self) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        payload = json.dumps(self.doc, indent=1, sort_keys=True, default=str)
+        tmp = os.path.join(self.directory, f".{STATE_FILE}.tmp-{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, self.path)
+
+    # -- quarantine ---------------------------------------------------------
+
+    def quarantine(self, record: Dict[str, Any]) -> None:
+        """Append one rolled-back canary's evidence (atomic rewrite of
+        the whole — small — document)."""
+        records = self.quarantined()
+        records.append({"time": time.time(), **record})
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = os.path.join(
+            self.directory, f".{QUARANTINE_FILE}.tmp-{os.getpid()}"
+        )
+        with open(tmp, "w") as f:
+            json.dump(records, f, indent=1, sort_keys=True, default=str)
+        os.replace(tmp, self.quarantine_path)
+
+    def quarantined(self) -> List[Dict[str, Any]]:
+        try:
+            with open(self.quarantine_path) as f:
+                records = json.load(f)
+            return records if isinstance(records, list) else []
+        except (OSError, ValueError):
+            return []

@@ -8,7 +8,7 @@ tree recorded per build and served from ``/metadata``:
 
 import copy
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 try:
     from dataclasses_json import dataclass_json
@@ -89,6 +89,47 @@ class DatasetBuildMetadata:
 
 @dataclass_json
 @dataclass
+class DriftBaselineMetadata:
+    """Training-data distribution baseline the lifecycle drift monitor
+    (``gordo_tpu.lifecycle.drift``) tests scored serving data against:
+    per-tag means/stds of the RAW input frame (the same space serving
+    requests arrive in — host transformers run after this point) plus
+    the sample count behind them. Residual (reconstruction-error)
+    baselines are calibrated online by the monitor from the first scored
+    window, because training loss lives in the estimator's scaled space
+    while serving residuals are raw-target-space mse."""
+
+    tags: List[str] = field(default_factory=list)
+    feature_means: List[float] = field(default_factory=list)
+    feature_stds: List[float] = field(default_factory=list)
+    n_samples: int = 0
+
+    @classmethod
+    def from_frame(cls, X) -> "DriftBaselineMetadata":
+        """Baseline from a training DataFrame (raw, pre-transform).
+        NaN-aware: sensor frames carry NaN rows, and a NaN mean/std
+        would silently disable the monitor's feature test for that
+        tag (an all-NaN column stays NaN → serialized null → the
+        monitor treats the tag as unmeasurable)."""
+        import warnings
+
+        import numpy as np
+
+        values = np.asarray(X.to_numpy(), dtype=float)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)  # all-NaN cols
+            means = np.nanmean(values, axis=0)
+            stds = np.nanstd(values, axis=0)
+        return cls(
+            tags=[str(c) for c in X.columns],
+            feature_means=[round(float(v), 8) for v in means],
+            feature_stds=[round(float(v), 8) for v in stds],
+            n_samples=int(len(values)),
+        )
+
+
+@dataclass_json
+@dataclass
 class RobustnessMetadata:
     """Per-machine fleet-build robustness counters: diverged-member
     reseed retries, bucket bisection (split-retry) events the machine's
@@ -105,6 +146,9 @@ class BuildMetadata:
     model: ModelBuildMetadata = field(default_factory=ModelBuildMetadata)
     dataset: DatasetBuildMetadata = field(default_factory=DatasetBuildMetadata)
     robustness: RobustnessMetadata = field(default_factory=RobustnessMetadata)
+    drift_baseline: DriftBaselineMetadata = field(
+        default_factory=DriftBaselineMetadata
+    )
 
 
 @dataclass_json
@@ -126,6 +170,7 @@ def _metadata_to_dict(self: Metadata, **_kwargs) -> Dict[str, Any]:
     model = self.build_metadata.model
     dataset = self.build_metadata.dataset
     robustness = self.build_metadata.robustness
+    baseline = self.build_metadata.drift_baseline
     training = model.training
     return {
         "user_defined": copy.deepcopy(self.user_defined),
@@ -159,6 +204,12 @@ def _metadata_to_dict(self: Metadata, **_kwargs) -> Dict[str, Any]:
                 "fleet_retries": robustness.fleet_retries,
                 "bucket_bisects": robustness.bucket_bisects,
                 "data_fetch_retries": robustness.data_fetch_retries,
+            },
+            "drift_baseline": {
+                "tags": list(baseline.tags),
+                "feature_means": list(baseline.feature_means),
+                "feature_stds": list(baseline.feature_stds),
+                "n_samples": baseline.n_samples,
             },
         },
     }

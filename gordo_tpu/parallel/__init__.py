@@ -5,7 +5,7 @@ from .fleet import (
     WindowedFleetMember,
     is_device_error,
 )
-from .fleet_build import FleetBuilder, fleet_build
+from .fleet_build import FleetBuilder, fleet_build, rebuild_stale
 from .journal import BuildJournal, artifact_complete, clean_staging_dirs
 from .sequence import ring_windowed_anomaly_scores, ring_windowed_predict
 from .mesh import (
@@ -24,6 +24,7 @@ __all__ = [
     "FleetResult",
     "FleetBuilder",
     "fleet_build",
+    "rebuild_stale",
     "is_device_error",
     "BuildJournal",
     "artifact_complete",

@@ -1833,6 +1833,7 @@ class FleetBuilder:
                 bucket_bisects=plan.bucket_bisects,
                 data_fetch_retries=plan.data_retries,
             ),
+            drift_baseline=ModelBuilder._drift_baseline(plan.X),
         )
         return plan.model_obj, machine
 
@@ -1860,3 +1861,60 @@ def fleet_build(
     return FleetBuilder(machines, trainer=trainer).build(
         output_dir=output_dir, resume=resume
     )
+
+
+def rebuild_stale(
+    machines: Sequence[Machine],
+    stale_names: Sequence[str],
+    output_dir: str,
+    base_plan: Optional[Any] = None,
+    base_plan_path: Optional[str] = None,
+    resume: bool = True,
+    trainer: Optional[FleetTrainer] = None,
+) -> FleetBuilder:
+    """
+    Partial-fleet rebuild: train ONLY ``stale_names`` (the drift-tripped
+    subset the lifecycle loop hands in) into ``output_dir``, leaving
+    every other member untouched — the incremental half of the
+    self-healing loop (``gordo_tpu.lifecycle``).
+
+    Reuses the full crash-safety stack: the rebuild keeps its own
+    journal in ``output_dir`` and ``resume=True`` (the default — a
+    lifecycle restart must converge on the same canary, not restart it)
+    skips members already rebuilt. When the base build's FleetPlan is
+    available (``base_plan`` in memory or ``base_plan_path`` on disk,
+    typically ``<base revision>/fleet_plan.json``) it is REPLAYED:
+    :meth:`~gordo_tpu.planner.FleetPlan.materialize_buckets` re-binds
+    bucket rosters by name, so a stale member keeps its planned pad
+    targets and trains under the exact program shape of its original
+    build — members the plan does not cover (or whose data outgrew the
+    pad target) repack live, and the untouched majority is simply never
+    in the member list.
+
+    Returns the builder (artifacts + journal are in ``output_dir``;
+    callers read ``build_errors``/``resumed`` off it).
+    """
+    stale = set(stale_names)
+    unknown = stale - {m.name for m in machines}
+    if unknown:
+        raise FleetBuildError(
+            f"stale members not in the machine set: {sorted(unknown)}"
+        )
+    if base_plan is None and base_plan_path and os.path.isfile(base_plan_path):
+        from ..planner import FleetPlan
+
+        try:
+            base_plan = FleetPlan.load(base_plan_path)
+        except ValueError as exc:
+            logger.warning(
+                "Base FleetPlan %s unusable (%s); stale members pack live",
+                base_plan_path,
+                exc,
+            )
+    builder = FleetBuilder(
+        [m for m in machines if m.name in stale],
+        trainer=trainer,
+        fleet_plan=base_plan,
+    )
+    builder.build(output_dir=output_dir, resume=resume)
+    return builder

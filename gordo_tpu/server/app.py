@@ -234,6 +234,20 @@ class GordoServerApp:
         if config is not None:
             self.config.update(config)
         self.prometheus_metrics = None
+        # Graceful-shutdown flag: once draining, /healthcheck answers 503
+        # (load balancers stop sending) while every already-accepted
+        # request — including everything queued in the micro-batcher —
+        # still gets a real response (drain_and_stop).
+        import threading
+
+        self._draining = threading.Event()
+
+    def begin_drain(self) -> None:
+        self._draining.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
 
     # -- request lifecycle --------------------------------------------------
 
@@ -241,6 +255,12 @@ class GordoServerApp:
         """
         Point the context at the served (or requested) revision directory;
         410 for bad/missing revisions (reference server.py:169-195).
+
+        Requests that do NOT pin a revision route through the fleet
+        store's lifecycle routing (``STORE.route``): a hot-swapped
+        (promoted) revision or the canary traffic slice resolves HERE,
+        once per request, so every artifact the request touches comes
+        from one revision — explicitly pinned revisions bypass routing.
         """
         ctx.collection_dir = os.environ[self.config["MODEL_COLLECTION_DIR_ENV_VAR"]]
         ctx.current_revision = os.path.basename(ctx.collection_dir)
@@ -263,6 +283,15 @@ class GordoServerApp:
                     {"error": f"Revision '{revision}' not found."}, status=410
                 )
         else:
+            from .fleet_store import STORE
+
+            routed = STORE.route(ctx.collection_dir)
+            if routed != ctx.collection_dir:
+                ctx.collection_dir = routed
+                # the response honestly stamps the revision that SERVED it
+                ctx.current_revision = os.path.basename(
+                    os.path.normpath(routed)
+                )
             ctx.revision = ctx.current_revision
         return None
 
@@ -292,7 +321,10 @@ class GordoServerApp:
             endpoint, view_args = endpoint_adapter.match()
 
             if endpoint == "healthcheck":
-                response = Response("", status=200)
+                if self.draining:
+                    response = Response("draining", status=503)
+                else:
+                    response = Response("", status=200)
                 return self._finalize(ctx, response)
             if endpoint == "server-version":
                 response = ctx.json_response({"version": gordo_tpu.__version__})
@@ -353,6 +385,22 @@ def build_app(
     elif prometheus_registry is not None:
         logger.warning("Ignoring non empty prometheus_registry argument")
 
+    # Lifecycle continuity: a promotion the supervisor recorded before
+    # this process booted (state.json beside the revisions) is
+    # re-installed as a hot-swap redirect, so a restarted server keeps
+    # serving the promoted revision even when its env var still points
+    # at the original one. BEFORE engine warmup, which warms whatever
+    # the store routes to.
+    collection_dir = os.environ.get(app.config["MODEL_COLLECTION_DIR_ENV_VAR"])
+    if collection_dir and os.path.isdir(collection_dir):
+        try:
+            from ..lifecycle import restore_serving_state
+
+            restore_serving_state(collection_dir)
+        except Exception:  # noqa: BLE001 - serving state restore is
+            # advisory; a torn state file must not take the server down
+            logger.exception("lifecycle serving-state restore failed")
+
     # Micro-batching engine: process-global (gthread workers share it,
     # like STORE); created here so the server lifecycle owns warmup and
     # the atexit drain. Default-off — without the env switch this is a
@@ -370,6 +418,47 @@ def build_app(
             )
         _start_serve_warmup(app, engine)
     return app
+
+
+def drain_and_stop(app: GordoServerApp, server=None, engine=None) -> None:
+    """Graceful shutdown: flip the app to draining (healthcheck 503 so
+    load balancers stop routing here), drain the micro-batching engine —
+    every queued and in-flight batch resolves its futures, new batched
+    work falls back to the still-running unbatched path — then stop the
+    HTTP server's accept loop. Queued requests never die unanswered with
+    the process."""
+    from .. import serve
+
+    app.begin_drain()
+    engine = engine if engine is not None else serve.get_engine()
+    if engine is not None:
+        logger.info("draining micro-batcher before shutdown")
+        engine.shutdown(drain=True)
+    if server is not None:
+        server.shutdown()
+
+
+def install_graceful_shutdown(app: GordoServerApp, server=None):
+    """SIGTERM/SIGINT → :func:`drain_and_stop` on a background thread
+    (signal handlers must return fast). No-op outside the main thread
+    (embedded/test servers manage their own lifecycle)."""
+    import signal
+    import threading
+
+    def handler(_signum, _frame):
+        threading.Thread(
+            target=drain_and_stop,
+            args=(app, server),
+            name="gordo-drain",
+            daemon=True,
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, handler)
+        signal.signal(signal.SIGINT, handler)
+    except ValueError:  # not the main thread
+        return None
+    return handler
 
 
 def serve_warmup_enabled() -> bool:
@@ -397,7 +486,11 @@ def _start_serve_warmup(app: GordoServerApp, engine) -> Optional[object]:
 
     def warm():
         try:
-            engine.warmup_collection(collection_dir)
+            from .fleet_store import STORE
+
+            # warm what requests will actually resolve: the lifecycle
+            # routing may point this dir at a promoted revision
+            engine.warmup_collection(STORE.route(collection_dir))
         except Exception:  # noqa: BLE001 - warmup is an optimization; a bad
             # artifact must not take the server down (requests would just
             # pay first-call compiles, as without warmup)
@@ -495,7 +588,15 @@ def run_server(
         return
 
     logger.warning("gunicorn not found; serving with werkzeug (threaded)")
-    from werkzeug.serving import run_simple
+    from werkzeug.serving import make_server
 
     logging.getLogger().setLevel(log_level.upper())
-    run_simple(host, port, build_app(), threaded=True)
+    # make_server (not run_simple): the graceful-shutdown path needs the
+    # server handle so SIGTERM can drain the micro-batcher queues and
+    # in-flight batches BEFORE the accept loop stops — queued request
+    # futures must resolve, not die with the process.
+    app = build_app()
+    server = make_server(host, port, app, threaded=True)
+    install_graceful_shutdown(app, server)
+    logger.info("serving on %s:%d (werkzeug threaded)", host, port)
+    server.serve_forever()

@@ -486,6 +486,17 @@ class FleetModelStore:
     stay resident — the model axis within a revision is never evicted,
     which is the point: the reference's pressure point was per-model
     eviction, not revision count.
+
+    Lifecycle extensions (``gordo_tpu.lifecycle``): :meth:`route`
+    resolves a requested collection dir through the hot-swap redirect a
+    promotion installed (:meth:`swap`) and the canary traffic slice
+    (:meth:`set_canary`) — requests route ONCE, at revision-resolution
+    time, so one request never mixes base and canary artifacts (model
+    from one, params from the other). A swap never touches an existing
+    :class:`RevisionFleet`: in-flight work pinned to the old fleet
+    object keeps scoring its device-resident snapshot (the same
+    contract the DELETE-revision race relies on), while requests routed
+    after the swap resolve the new — pre-warmed — fleet.
     """
 
     def __init__(self, max_revisions: Optional[int] = None):
@@ -511,25 +522,133 @@ class FleetModelStore:
         #: OrderedDict reorder PER REQUEST (all three are GIL-handoff
         #: points that convoy under concurrent serving load)
         self._mru: Optional[Tuple[str, RevisionFleet]] = None
+        #: hot-swap redirects: requested dir -> served dir. Mutated only
+        #: under the lock; read lock-free (dict.get is atomic under the
+        #: GIL) on the per-request routing path.
+        self._redirects: Dict[str, str] = {}
+        #: canary slice: (source dir, canary dir, every-nth period) —
+        #: one atomic tuple read per routed request; None in steady
+        #: state. The tick is intentionally unlocked: under concurrent
+        #: load the slice is approximate (lost increments skew it a
+        #: request or two), which is fine for traffic splitting and
+        #: keeps the hot path lock-free.
+        self._canary: Optional[Tuple[str, str, int]] = None
+        self._canary_tick = 0
 
-    def fleet(self, collection_dir: str) -> RevisionFleet:
+    # -- lifecycle routing --------------------------------------------------
+
+    @staticmethod
+    def _route_key(collection_dir: str) -> str:
+        """Routing keys are normpath'd strings: the env var may carry a
+        trailing slash while the supervisor/restore path installs
+        normalized sources — a cosmetic difference must not silently
+        disable a recorded promotion or a canary slice. (normpath, not
+        realpath: no syscalls on the per-request path.)"""
+        return os.path.normpath(collection_dir)
+
+    def route(self, collection_dir: str) -> str:
+        """The directory a request for ``collection_dir`` should serve
+        from, after the hot-swap redirect and the canary slice. Resolved
+        once per request (at revision resolution) so every artifact the
+        request touches — model, metadata, params — comes from ONE
+        revision."""
+        key = self._route_key(collection_dir)
+        canary = self._canary
+        if canary is not None and canary[0] == key:
+            self._canary_tick += 1
+            if self._canary_tick % canary[2] == 0:
+                return canary[1]
+        return self._redirects.get(key, collection_dir)
+
+    def swap(
+        self, collection_dir: str, new_dir: str, warm: bool = True
+    ) -> RevisionFleet:
+        """Zero-downtime hot swap: requests for ``collection_dir`` serve
+        ``new_dir`` from now on. The new fleet is loaded (and by default
+        warmed) BEFORE the redirect lands, so no request ever waits on
+        cold artifact loads; requests already in flight keep the fleet
+        object they resolved — nothing is dropped or torn. Swapping a
+        dir onto itself removes the redirect (rollback to disk truth)."""
+        fleet = self._ensure_fleet(new_dir, warm=warm)
+        key = self._route_key(collection_dir)
+        with self._lock:
+            if os.path.realpath(new_dir) == os.path.realpath(collection_dir):
+                self._redirects.pop(key, None)
+            else:
+                self._redirects[key] = new_dir
+            canary = self._canary
+            if canary is not None and canary[0] == key:
+                self._canary = None
+            # the swapped-in dir is about to be the hottest key
+            self._mru = (new_dir, fleet)
+        return fleet
+
+    def set_canary(
+        self,
+        collection_dir: str,
+        canary_dir: str,
+        fraction: float,
+        warm: bool = True,
+    ) -> RevisionFleet:
+        """Route ``~fraction`` of the traffic for ``collection_dir`` to
+        ``canary_dir`` (every Nth routed request, N = round(1/fraction)
+        — deterministic, no per-request RNG). The canary fleet is
+        pre-warmed before any traffic lands on it."""
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"canary fraction must be in (0, 1]: {fraction}")
+        fleet = self._ensure_fleet(canary_dir, warm=warm)
+        period = max(1, int(round(1.0 / fraction)))
+        with self._lock:
+            self._canary = (self._route_key(collection_dir), canary_dir, period)
+        return fleet
+
+    def clear_canary(self, collection_dir: Optional[str] = None) -> None:
+        """Stop canary routing (for ``collection_dir``, or whatever is
+        canarying); in-flight canary-routed requests finish against the
+        still-resident canary fleet."""
+        with self._lock:
+            canary = self._canary
+            if canary is not None and (
+                collection_dir is None
+                or canary[0] == self._route_key(collection_dir)
+            ):
+                self._canary = None
+
+    def canary_status(self) -> Optional[Dict[str, Any]]:
+        canary = self._canary
+        if canary is None:
+            return None
+        return {
+            "source": canary[0],
+            "canary": canary[1],
+            "fraction": 1.0 / canary[2],
+        }
+
+    def _rerank_mru_locked(self) -> None:
+        """Re-rank the lock-free fast path's fleet before any eviction
+        decision (caller holds the lock): requests served through
+        ``_mru`` never refresh their LRU slot, so the hottest revision
+        can look least-recently-used — evicting it would force every
+        fast-path request onto a cold reload."""
         mru = self._mru
-        if mru is not None and mru[0] == collection_dir:
-            return mru[1]
+        if mru is None:
+            return
+        for mru_key, mru_fleet in self._revisions.items():
+            if mru_fleet is mru[1]:
+                self._revisions.move_to_end(mru_key)
+                break
+
+    def _ensure_fleet(self, collection_dir: str, warm: bool) -> RevisionFleet:
+        """The ONE get-or-create path for resident fleets — request
+        path (:meth:`fleet`) and lifecycle path (swap/set_canary) share
+        it, so eviction and MRU re-rank behavior cannot drift apart.
+        Model loads (``warm``) run OUTSIDE the store lock, like every
+        other load path. The re-rank walk is O(max_revisions)."""
         key = os.path.realpath(collection_dir)
         with self._lock:
             fleet = self._revisions.get(key)
             if fleet is None:
-                # Requests served through the lock-free fast path never
-                # refresh their LRU slot, so the hottest revision can
-                # look least-recently-used — re-rank it before deciding
-                # evictions (the dict is at most max_revisions entries).
-                mru = self._mru
-                if mru is not None:
-                    for mru_key, mru_fleet in self._revisions.items():
-                        if mru_fleet is mru[1]:
-                            self._revisions.move_to_end(mru_key)
-                            break
+                self._rerank_mru_locked()
                 fleet = RevisionFleet(key)
                 self._revisions[key] = fleet
                 while len(self._revisions) > self.max_revisions:
@@ -537,8 +656,18 @@ class FleetModelStore:
                     logger.info("Evicting served revision %s", evicted_key)
             else:
                 self._revisions.move_to_end(key)
+        if warm:
+            fleet.warm()
+        return fleet
+
+    def fleet(self, collection_dir: str) -> RevisionFleet:
+        mru = self._mru
+        if mru is not None and mru[0] == collection_dir:
+            return mru[1]
+        fleet = self._ensure_fleet(collection_dir, warm=False)
+        with self._lock:
             self._mru = (collection_dir, fleet)
-            return fleet
+        return fleet
 
     def get_model(self, collection_dir: str, name: str) -> Any:
         return self.fleet(collection_dir).model(name)
@@ -548,11 +677,24 @@ class FleetModelStore:
         with self._lock:
             self._mru = None  # conservatively, whatever alias it holds
             self._revisions.pop(key, None)
+            # Routing that TARGETS the invalidated dir is stale too: a
+            # deleted canary must stop taking traffic, and a redirect
+            # onto a deleted revision must fall back to disk truth.
+            # Routing FROM it survives — a redirect is serving state,
+            # not a cache of the source dir's content.
+            canary = self._canary
+            if canary is not None and os.path.realpath(canary[1]) == key:
+                self._canary = None
+            for source, target in list(self._redirects.items()):
+                if os.path.realpath(target) == key:
+                    del self._redirects[source]
 
     def clear(self):
         with self._lock:
             self._mru = None
             self._revisions.clear()
+            self._redirects.clear()
+            self._canary = None
 
 
 #: Process-wide store (gunicorn gthread workers share it per process, like

@@ -552,3 +552,123 @@ def set_fleet_build_progress(
     metrics["machines_total"].labels(**labels).set(total)
     metrics["machines_completed"].labels(**labels).set(completed)
     metrics["machines_failed"].labels(**labels).set(failed)
+
+
+# -- fleet lifecycle metrics --------------------------------------------------
+
+#: one lifecycle metric set per LIVE registry (same WeakKey rationale as
+#: ``_build_metrics``: id() reuse after GC must never resurrect stale
+#: collector handles)
+_lifecycle_metrics: "weakref.WeakKeyDictionary[CollectorRegistry, dict]" = (
+    weakref.WeakKeyDictionary()
+)
+
+_LIFECYCLE_EVENT_COUNTERS = (
+    (
+        "rebuilds",
+        "gordo_fleet_lifecycle_rebuilds_total",
+        "Members rebuilt by the drift-triggered lifecycle loop",
+    ),
+    (
+        "promotions",
+        "gordo_fleet_lifecycle_promotions_total",
+        "Canary revisions promoted into serving by the lifecycle loop",
+    ),
+    (
+        "rollbacks",
+        "gordo_fleet_lifecycle_rollbacks_total",
+        "Canary revisions rolled back and quarantined (gate failures, "
+        "failed rebuilds, operator rollbacks)",
+    ),
+)
+
+#: hot swaps are sub-second by design; the tail buckets catch cold loads
+_SWAP_BUCKETS = (0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0)
+
+
+def fleet_lifecycle_metrics(
+    registry: Optional[CollectorRegistry] = None,
+) -> dict:
+    """The ``gordo_fleet_lifecycle_*`` metric set for ``registry``
+    (default: the global REGISTRY), created once per live registry:
+    event Counters, the drift/canary status Gauges, and the hot-swap
+    duration Histogram."""
+    target = registry if registry is not None else REGISTRY
+    if target not in _lifecycle_metrics:
+        _ensure_multiproc_dir()
+        metrics = {
+            counter_key: Counter(
+                name,
+                help_text,
+                labelnames=["project"],
+                registry=target,
+            )
+            for counter_key, name, help_text in _LIFECYCLE_EVENT_COUNTERS
+        }
+        metrics["drifted"] = Gauge(
+            "gordo_fleet_lifecycle_drifted_machines",
+            "Machines whose latest drift evaluation tripped",
+            labelnames=["project"],
+            registry=target,
+            multiprocess_mode="max",
+        )
+        metrics["stale"] = Gauge(
+            "gordo_fleet_lifecycle_stale_machines",
+            "Machines in the current stale set (being rebuilt/canaried)",
+            labelnames=["project"],
+            registry=target,
+            multiprocess_mode="max",
+        )
+        metrics["canary_fraction"] = Gauge(
+            "gordo_fleet_lifecycle_canary_fraction",
+            "Traffic fraction currently routed to the canary revision "
+            "(0 when no canary is serving)",
+            labelnames=["project"],
+            registry=target,
+            multiprocess_mode="max",
+        )
+        metrics["swap_seconds"] = Histogram(
+            "gordo_fleet_lifecycle_swap_seconds",
+            "Wall-clock of promoting a canary into serving (the hot "
+            "swap itself, warm included; requests are never paused)",
+            labelnames=["project"],
+            buckets=_SWAP_BUCKETS,
+            registry=target,
+        )
+        _lifecycle_metrics[target] = metrics
+    return _lifecycle_metrics[target]
+
+
+def record_fleet_lifecycle_event(
+    project: Optional[str], event: str, n: int = 1
+):
+    """Count one lifecycle event (``rebuilds``/``promotions``/
+    ``rollbacks``); unknown event names are ignored (forward
+    compatibility over crashes). The lookup is restricted to the
+    counter keys — the metric dict also holds Gauges/Histograms, which
+    must be neither inc'd nor crashed into."""
+    if event not in {key for key, _, _ in _LIFECYCLE_EVENT_COUNTERS}:
+        return
+    if n:
+        fleet_lifecycle_metrics()[event].labels(project=project or "").inc(n)
+
+
+def set_fleet_lifecycle_status(
+    project: Optional[str],
+    drifted: int,
+    stale: int,
+    canary_fraction: float,
+):
+    """The lifecycle loop's live status gauges (per cycle)."""
+    metrics = fleet_lifecycle_metrics()
+    labels = {"project": project or ""}
+    metrics["drifted"].labels(**labels).set(drifted)
+    metrics["stale"].labels(**labels).set(stale)
+    metrics["canary_fraction"].labels(**labels).set(canary_fraction)
+
+
+def observe_lifecycle_swap(project: Optional[str], seconds: float):
+    """One promotion hot-swap's wall-clock."""
+    fleet_lifecycle_metrics()["swap_seconds"].labels(
+        project=project or ""
+    ).observe(seconds)

@@ -26,6 +26,20 @@ Sites instrumented today:
   lands and is journaled (key: machine name); with ``after=N`` the
   first N machines complete and the next one dies — the in-process
   analog of a host preemption at machine N of the fleet.
+- ``drift_eval`` — before each machine's drift verdict is computed
+  (key: machine name); exercises the lifecycle loop's contract that a
+  broken drift evaluation never takes scoring (or the loop) down.
+- ``canary_build`` — before the lifecycle loop launches the partial
+  rebuild of the stale members (key: canary revision); a crash here
+  must leave serving on the last-good revision with the canary
+  resumable from its journal.
+- ``promote_swap`` — after the canary passed its gates, immediately
+  before the hot-swap installs it as the served revision (key: canary
+  revision); a crash here must leave serving on the last-good revision
+  and the promotion re-runnable.
+- ``rollback`` — before a failed canary's rollback actions run (key:
+  canary revision); a crash here must leave the rollback resumable so
+  a restart still converges on the last-good revision.
 
 Rules fire deterministically: each rule counts the calls matching its
 (site, key-glob) and fires on calls ``after < i <= after + times``.
@@ -65,6 +79,10 @@ SITES = (
     "device_program",
     "dump_artifact",
     "process_kill_after_n_machines",
+    "drift_eval",
+    "canary_build",
+    "promote_swap",
+    "rollback",
 )
 
 

@@ -37,7 +37,7 @@ run() { python -m pytest -q "$@"; }
 component="${1:-all}"
 case "$component" in
     all)      run -m "not slow" tests/ ;;
-    fast)     run -m "not slow" tests/ --ignore=tests/parallel --ignore=tests/models --ignore=tests/server --ignore=tests/serve ;;
+    fast)     run -m "not slow" tests/ --ignore=tests/parallel --ignore=tests/models --ignore=tests/server --ignore=tests/serve --ignore=tests/lifecycle ;;
     # The parallel job runs its compile-heavy suites INCLUDING the
     # slow-marked LSTM/packing/sequence fleet modules — that is exactly
     # why it has its own matrix job; only the multi-process distributed
@@ -56,6 +56,7 @@ case "$component" in
     server)   run -m "not slow" tests/server ;;
     serve)    run -m "not slow" tests/serve ;;
     planner)  run -m "not slow" tests/planner ;;
+    lifecycle) run -m "not slow" tests/lifecycle ;;
     utils)    run -m "not slow" tests/utils ;;
     workflow) run -m "not slow" tests/workflow ;;
     formatting) run tests/test_codestyle.py ;;
@@ -64,7 +65,8 @@ case "$component" in
     allelse)
         run -m "not slow" tests/ \
             --ignore=tests/builder --ignore=tests/cli --ignore=tests/client \
-            --ignore=tests/dataset --ignore=tests/machine --ignore=tests/models \
+            --ignore=tests/dataset --ignore=tests/lifecycle \
+            --ignore=tests/machine --ignore=tests/models \
             --ignore=tests/ops --ignore=tests/parallel --ignore=tests/planner \
             --ignore=tests/reporters --ignore=tests/serializer \
             --ignore=tests/serve --ignore=tests/server \

@@ -1,0 +1,127 @@
+"""
+Lifecycle-suite fixtures: one tiny three-machine fleet built ONCE per
+session into a base revision, copied per test into a throwaway models
+root; probe windows drawn from the machines' own (deterministic)
+RandomDataset so "healthy" traffic matches the training distribution
+and "drifted" traffic is shifted by 10 training-stds.
+"""
+
+import shutil
+
+import pytest
+
+from gordo_tpu.dataset.datasets import RandomDataset
+from gordo_tpu.lifecycle import LifecycleConfig, LifecycleSupervisor
+from gordo_tpu.lifecycle.drift import DriftConfig
+from gordo_tpu.lifecycle.gates import GateConfig
+from gordo_tpu.machine import Machine
+from gordo_tpu.parallel import FleetBuilder
+from gordo_tpu.server.fleet_store import FleetModelStore
+from gordo_tpu.utils import faults
+
+PROJECT = "lifecycle-project"
+BASE_REVISION = "100"
+TAGS = ["tag-1", "tag-2", "tag-3"]
+NAMES = ["lc-0", "lc-1", "lc-2"]
+
+DATASET = {
+    "type": "RandomDataset",
+    "train_start_date": "2020-01-01T00:00:00+00:00",
+    "train_end_date": "2020-01-05T00:00:00+00:00",
+    "tag_list": TAGS,
+}
+
+MODEL = {
+    "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "gordo_tpu.models.JaxAutoEncoder": {
+                "kind": "feedforward_hourglass",
+                "encoding_layers": 1,
+                "epochs": 1,
+            }
+        }
+    }
+}
+
+
+def make_machines(names=NAMES):
+    return [
+        Machine.from_config(
+            {"name": name, "model": MODEL, "dataset": dict(DATASET)},
+            project_name=PROJECT,
+        )
+        for name in names
+    ]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="session")
+def base_build(tmp_path_factory):
+    """The base revision, built once per session (plan + journal +
+    artifacts, exactly what a real build leaves on the volume)."""
+    root = tmp_path_factory.mktemp("lifecycle-base")
+    base_dir = root / BASE_REVISION
+    FleetBuilder(make_machines(), plan_strategy="packed").build(
+        output_dir=str(base_dir)
+    )
+    return str(base_dir)
+
+
+@pytest.fixture
+def models_root(base_build, tmp_path):
+    """A throwaway models root holding a copy of the base revision."""
+    root = tmp_path / "collection"
+    root.mkdir()
+    shutil.copytree(base_build, root / BASE_REVISION)
+    return str(root)
+
+
+@pytest.fixture(scope="session")
+def probe_windows():
+    """(healthy, drifted) probe DataFrames: a stride sample of the
+    training series (window mean ≈ training mean) and the same rows
+    shifted by 10 training-stds."""
+    dataset = RandomDataset(
+        **{k: v for k, v in DATASET.items() if k != "type"}
+    )
+    X, _ = dataset.get_data()
+    healthy = X.iloc[::24]
+    drifted = healthy + 10.0 * X.std()
+    return healthy, drifted
+
+
+def lifecycle_config(**overrides) -> LifecycleConfig:
+    """Test-friendly config: small windows, instant calibration, no
+    cooldown (tests re-canary on purpose), half the traffic to the
+    canary (deterministic alternation)."""
+    defaults = dict(
+        canary_fraction=0.5,
+        quarantine_cooldown_s=0.0,
+        drift=DriftConfig(min_samples=8, calibration_batches=1),
+        gates=GateConfig(),
+    )
+    defaults.update(overrides)
+    return LifecycleConfig(**defaults)
+
+
+def make_supervisor(
+    models_root, store=None, machines=None, **config_overrides
+) -> LifecycleSupervisor:
+    import os
+
+    return LifecycleSupervisor(
+        machines if machines is not None else make_machines(),
+        os.path.join(models_root, BASE_REVISION),
+        store=store if store is not None else FleetModelStore(max_revisions=4),
+        config=lifecycle_config(**config_overrides),
+    )
+
+
+def frames_for(names, window):
+    return {name: window for name in names}

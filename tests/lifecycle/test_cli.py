@@ -1,0 +1,106 @@
+"""
+The ``gordo-tpu lifecycle`` command group: dry-run observation, status
+rendering, and the no-canary guard rails.
+"""
+
+import json
+import os
+
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from gordo_tpu.cli.cli import gordo_tpu_cli
+from gordo_tpu.server.fleet_store import STORE
+
+from tests.lifecycle.conftest import (
+    BASE_REVISION,
+    DATASET,
+    MODEL,
+    NAMES,
+    PROJECT,
+)
+
+pytestmark = pytest.mark.lifecycle
+
+
+@pytest.fixture
+def machines_config(tmp_path):
+    path = tmp_path / "machines.yaml"
+    path.write_text(
+        yaml.safe_dump(
+            {
+                "project_name": PROJECT,
+                "machines": [
+                    {"name": name, "model": MODEL, "dataset": dict(DATASET)}
+                    for name in NAMES
+                ],
+            }
+        )
+    )
+    return str(path)
+
+
+@pytest.fixture(autouse=True)
+def _clean_store():
+    yield
+    STORE.clear()
+
+
+def test_lifecycle_run_dry_run_reports_every_machine(
+    models_root, machines_config
+):
+    collection = os.path.join(models_root, BASE_REVISION)
+    result = CliRunner().invoke(
+        gordo_tpu_cli,
+        [
+            "lifecycle",
+            "run",
+            machines_config,
+            collection,
+            "--once",
+            "--dry-run",
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    for name in NAMES:
+        assert name in result.output
+    # dry run never creates revisions
+    assert [e for e in os.listdir(models_root) if e.isdigit()] == [
+        BASE_REVISION
+    ]
+
+
+def test_lifecycle_status_renders_state_and_json(models_root, machines_config):
+    collection = os.path.join(models_root, BASE_REVISION)
+    CliRunner().invoke(
+        gordo_tpu_cli,
+        ["lifecycle", "run", machines_config, collection, "--once"],
+    )
+    result = CliRunner().invoke(
+        gordo_tpu_cli, ["lifecycle", "status", models_root]
+    )
+    assert result.exit_code == 0, result.output
+    assert "phase:    idle" in result.output
+    assert BASE_REVISION in result.output
+
+    as_json = CliRunner().invoke(
+        gordo_tpu_cli, ["lifecycle", "status", models_root, "--as-json"]
+    )
+    assert as_json.exit_code == 0
+    doc = json.loads(as_json.output)
+    assert doc["state"]["anchor_revision"] == BASE_REVISION
+
+
+def test_promote_and_rollback_require_a_canary(models_root):
+    collection = os.path.join(models_root, BASE_REVISION)
+    promote = CliRunner().invoke(
+        gordo_tpu_cli, ["lifecycle", "promote", collection, "--force"]
+    )
+    assert promote.exit_code != 0
+    assert "no canary to promote" in promote.output
+    rollback = CliRunner().invoke(
+        gordo_tpu_cli, ["lifecycle", "rollback", collection]
+    )
+    assert rollback.exit_code != 0
+    assert "no canary to roll back" in rollback.output

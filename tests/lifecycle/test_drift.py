@@ -1,0 +1,204 @@
+"""
+Drift statistics: baselines out of build metadata, feature/residual
+tests, calibration, quorum, and snapshot round-trips.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gordo_tpu.lifecycle.drift import (
+    DriftConfig,
+    DriftMonitor,
+    MachineDrift,
+)
+
+from tests.lifecycle.conftest import BASE_REVISION, NAMES, TAGS
+
+pytestmark = pytest.mark.lifecycle
+
+BASELINE = {
+    "tags": ["a", "b"],
+    "feature_means": [0.0, 10.0],
+    "feature_stds": [1.0, 2.0],
+    "n_samples": 500,
+}
+
+
+def config(**kw):
+    defaults = dict(min_samples=4, sigma=2.0, calibration_batches=1)
+    defaults.update(kw)
+    return DriftConfig(**defaults)
+
+
+def test_no_drift_on_baseline_distribution():
+    machine = MachineDrift("m", baseline=BASELINE, config=config())
+    rng = np.random.RandomState(0)
+    X = np.stack([rng.normal(0.0, 1.0, 200), rng.normal(10.0, 2.0, 200)], 1)
+    machine.observe(X)
+    verdict = machine.evaluate()
+    assert not verdict.drifted, verdict
+
+
+def test_feature_shift_trips_with_quorum():
+    # quorum 0.25 of 2 tags -> 1 shifted tag suffices
+    machine = MachineDrift("m", baseline=BASELINE, config=config())
+    X = np.stack([np.full(50, 8.0), np.full(50, 10.0)], 1)  # tag a: +8σ
+    machine.observe(X)
+    verdict = machine.evaluate()
+    assert verdict.drifted
+    assert verdict.reasons[0].startswith("feature-shift a")
+
+    # quorum 1.0 -> one shifted tag of two is NOT enough
+    machine = MachineDrift(
+        "m", baseline=BASELINE, config=config(feature_quorum=1.0)
+    )
+    machine.observe(X)
+    assert not machine.evaluate().drifted
+
+
+def test_residual_drift_after_calibration():
+    machine = MachineDrift("m", baseline=None, config=config())
+    machine.observe(np.zeros((4, 1)), residuals=[0.5] * 4)  # calibrates
+    machine.observe(np.zeros((4, 1)), residuals=[2.0] * 4)  # 4x baseline
+    verdict = machine.evaluate()
+    assert verdict.drifted
+    assert "residual-ratio" in verdict.reasons[0]
+    assert verdict.stats["residual_ratio"] == pytest.approx(4.0)
+
+
+def test_residual_calibration_window_never_flags():
+    machine = MachineDrift(
+        "m", baseline=None, config=config(calibration_batches=3)
+    )
+    for _ in range(3):  # all calibration, whatever the values
+        machine.observe(np.zeros((4, 1)), residuals=[5.0] * 4)
+    assert not machine.evaluate().drifted
+
+
+def test_min_samples_gate():
+    machine = MachineDrift(
+        "m", baseline=BASELINE, config=config(min_samples=100)
+    )
+    machine.observe(np.full((10, 2), 100.0))
+    assert not machine.evaluate().drifted  # huge shift, tiny window
+
+
+def test_window_resets_after_evaluation():
+    machine = MachineDrift("m", baseline=BASELINE, config=config())
+    machine.observe(np.full((10, 2), 100.0))
+    assert machine.evaluate().drifted
+    assert not machine.evaluate().drifted  # fresh (empty) window
+
+
+def test_nan_rows_do_not_poison_the_feature_test():
+    """One NaN in a window (routine in raw sensor frames) must neither
+    disable drift detection (NaN > sigma is always False) nor trip it."""
+    machine = MachineDrift("m", baseline=BASELINE, config=config())
+    X = np.stack([np.full(50, 8.0), np.full(50, 10.0)], 1)
+    X[3, 0] = np.nan
+    X[7, 1] = np.nan
+    machine.observe(X)
+    verdict = machine.evaluate()
+    assert verdict.drifted, verdict  # tag a is still +8σ over baseline
+
+    healthy = MachineDrift("m", baseline=BASELINE, config=config())
+    H = np.stack([np.zeros(50), np.full(50, 10.0)], 1)
+    H[0, 0] = np.nan
+    healthy.observe(H)
+    assert not healthy.evaluate().drifted
+
+
+def test_nan_baseline_tag_is_unmeasurable_not_undrifted():
+    """A tag with a NaN/null training stat (all-NaN column at build
+    time) drops out of the quorum; the measurable tags still vote."""
+    baseline = dict(BASELINE, feature_means=[None, 10.0])
+    machine = MachineDrift(
+        "m", baseline=baseline, config=config(feature_quorum=1.0)
+    )
+    X = np.stack([np.zeros(50), np.full(50, 30.0)], 1)  # tag b: +10σ
+    machine.observe(X)
+    verdict = machine.evaluate()
+    assert verdict.drifted, verdict  # quorum = 1 measurable tag, shifted
+
+    nothing = MachineDrift(
+        "m", baseline=dict(BASELINE, feature_means=[None, None])
+    )
+    nothing.observe(X)
+    assert not nothing.evaluate().drifted
+
+
+def test_offline_sensor_is_unmeasurable_not_a_giant_shift():
+    """An all-NaN window column (dead sensor) must not read as a huge
+    shift from a nonzero baseline — zero finite rows means the tag
+    cannot vote, period."""
+    baseline = dict(
+        BASELINE, feature_means=[500.0, 10.0], feature_stds=[10.0, 2.0]
+    )
+    machine = MachineDrift(
+        "m", baseline=baseline, config=config(feature_quorum=0.25)
+    )
+    X = np.stack([np.full(50, np.nan), np.full(50, 10.0)], 1)
+    machine.observe(X)
+    assert not machine.evaluate().drifted
+
+
+def test_sub_threshold_windows_accumulate_across_evaluations():
+    """Evidence from windows too small to test must survive the cycle
+    boundary — otherwise small per-cycle batches make drift permanently
+    undetectable."""
+    machine = MachineDrift(
+        "m", baseline=BASELINE, config=config(min_samples=20)
+    )
+    verdicts = []
+    for _ in range(3):  # 3 × 8 rows; testable once 24 ≥ 20 accumulate
+        machine.observe(np.full((8, 2), 100.0))
+        verdicts.append(machine.evaluate())
+    assert [v.drifted for v in verdicts] == [False, False, True], verdicts
+    # ... and the tested window DID reset
+    machine.observe(np.full((8, 2), 100.0))
+    assert not machine.evaluate().drifted
+
+
+def test_baseline_shape_mismatch_disables_feature_test():
+    machine = MachineDrift("m", baseline=BASELINE, config=config())
+    machine.observe(np.full((10, 3), 100.0))  # 3 cols vs 2-tag baseline
+    verdict = machine.evaluate()
+    assert not verdict.drifted
+    assert verdict.stats["feature_baseline"] == "shape-mismatch"
+
+
+def test_snapshot_restore_roundtrip_through_json():
+    machine = MachineDrift("m", baseline=BASELINE, config=config())
+    machine.observe(np.full((10, 2), 3.0), residuals=[1.0] * 10)
+    snapshot = json.loads(json.dumps(machine.snapshot()))
+    clone = MachineDrift("m", baseline=BASELINE, config=config())
+    clone.restore(snapshot)
+    assert clone.snapshot() == machine.snapshot()
+    machine.observe(np.full((10, 2), 3.0))
+    clone.observe(np.full((10, 2), 3.0))
+    assert machine.evaluate().drifted == clone.evaluate().drifted
+
+
+def test_monitor_from_revision_reads_persisted_baselines(models_root):
+    collection = os.path.join(models_root, BASE_REVISION)
+    monitor = DriftMonitor.from_revision(collection, config())
+    assert monitor.machines() == sorted(NAMES)
+    machine = monitor.ensure(NAMES[0])
+    assert machine.baseline is not None
+    assert machine.baseline["tags"] == TAGS
+    assert len(machine.baseline["feature_means"]) == len(TAGS)
+    assert machine.baseline["n_samples"] > 0
+
+
+def test_monitor_per_machine_isolation_on_bad_frames():
+    monitor = DriftMonitor(config())
+    monitor.observe_scores(
+        {"good": np.zeros((5, 2)), "bad": object()},
+        {"good": (np.zeros((5, 2)), np.zeros(5))},
+    )
+    verdicts = monitor.evaluate()
+    assert set(verdicts) == {"good", "bad"}
+    assert not verdicts["bad"].drifted

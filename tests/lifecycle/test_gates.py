@@ -1,0 +1,103 @@
+"""
+Promotion gates: identical fleets pass, broken/worse canaries fail with
+the reason recorded.
+"""
+
+import os
+import shutil
+
+import pytest
+
+from gordo_tpu.lifecycle.gates import GateConfig, evaluate_canary
+from gordo_tpu.server.fleet_store import RevisionFleet
+
+from tests.lifecycle.conftest import BASE_REVISION, NAMES
+
+pytestmark = pytest.mark.lifecycle
+
+
+@pytest.fixture
+def twin_fleets(models_root, probe_windows):
+    """Base + an identical 'canary' copy of the same revision."""
+    base_dir = os.path.join(models_root, BASE_REVISION)
+    canary_dir = os.path.join(models_root, "101")
+    shutil.copytree(base_dir, canary_dir)
+    return RevisionFleet(base_dir), RevisionFleet(canary_dir), canary_dir
+
+
+def _frames(probe_windows):
+    healthy, _ = probe_windows
+    return {name: healthy for name in NAMES}
+
+
+def test_identical_canary_passes_all_gates(twin_fleets, probe_windows):
+    base, canary, _ = twin_fleets
+    report = evaluate_canary(
+        base, canary, _frames(probe_windows), NAMES, GateConfig()
+    )
+    assert report.passed, report.failures
+    assert report.checks["error_rate"] == 0.0
+    assert set(report.checks["threshold_parity"]) == set(NAMES)
+    for ratio in report.checks["residual_parity"].values():
+        assert ratio == pytest.approx(1.0, abs=1e-3)
+
+
+def test_residual_gate_rejects_worse_canary(twin_fleets, probe_windows):
+    base, canary, _ = twin_fleets
+    report = evaluate_canary(
+        base,
+        canary,
+        _frames(probe_windows),
+        NAMES,
+        GateConfig(residual_ratio=0.5),  # identical (1.0x) now "worse"
+    )
+    assert not report.passed
+    assert any("residual" in failure for failure in report.failures)
+
+
+def test_threshold_gate_rejects_runaway_threshold(twin_fleets, probe_windows):
+    base, canary, _ = twin_fleets
+    poisoned = canary.model(NAMES[1])
+    poisoned.aggregate_threshold_ = poisoned.aggregate_threshold_ * 1000.0
+    report = evaluate_canary(
+        base, canary, _frames(probe_windows), NAMES, GateConfig()
+    )
+    assert not report.passed
+    assert any(
+        failure.startswith(f"{NAMES[1]}: threshold parity")
+        for failure in report.failures
+    )
+
+
+def test_lost_threshold_fails(twin_fleets, probe_windows):
+    base, canary, _ = twin_fleets
+    delattr_target = canary.model(NAMES[0])
+    delattr_target.aggregate_threshold_ = None
+    report = evaluate_canary(
+        base, canary, _frames(probe_windows), NAMES, GateConfig()
+    )
+    assert not report.passed
+    assert any("lost its anomaly threshold" in f for f in report.failures)
+
+
+def test_unloadable_canary_artifact_fails_error_rate(
+    twin_fleets, probe_windows
+):
+    base, canary, canary_dir = twin_fleets
+    with open(os.path.join(canary_dir, NAMES[2], "model.pkl"), "wb") as f:
+        f.write(b"not a pickle")
+    report = evaluate_canary(
+        base, canary, _frames(probe_windows), NAMES, GateConfig()
+    )
+    assert not report.passed
+    assert report.checks["error_rate"] > 0
+
+
+def test_unprobed_members_are_reported(twin_fleets, probe_windows):
+    base, canary, _ = twin_fleets
+    healthy, _ = probe_windows
+    report = evaluate_canary(
+        base, canary, {NAMES[0]: healthy}, NAMES, GateConfig()
+    )
+    assert report.passed
+    assert report.checks["unprobed"] == sorted(NAMES[1:])

@@ -1,0 +1,157 @@
+"""
+The lifecycle chaos drill: a deterministic crash injected at every
+lifecycle fault site (``drift_eval``, ``canary_build``,
+``promote_swap``, ``rollback``) must leave serving on the last-good
+revision and the loop resumable — a restarted supervisor converges.
+"""
+
+import os
+
+import pytest
+
+from gordo_tpu.lifecycle import LifecycleState
+from gordo_tpu.lifecycle.gates import GateConfig
+from gordo_tpu.utils.faults import FaultRule, inject
+
+from tests.lifecycle.conftest import (
+    BASE_REVISION,
+    NAMES,
+    frames_for,
+    make_supervisor,
+)
+
+pytestmark = [pytest.mark.lifecycle, pytest.mark.faults]
+
+
+def _drifted_frames(probe_windows, name=None):
+    healthy, drifted = probe_windows
+    frames = frames_for(NAMES, healthy)
+    frames[name or NAMES[1]] = drifted
+    return frames
+
+
+def _calibrated_supervisor(models_root, probe_windows, **overrides):
+    healthy, _ = probe_windows
+    supervisor = make_supervisor(models_root, **overrides)
+    supervisor.run_cycle(frames_for(NAMES, healthy))
+    return supervisor
+
+
+def _assert_serving_last_good(supervisor, models_root):
+    base_dir = os.path.join(models_root, BASE_REVISION)
+    # no hot-swap redirect landed: steady traffic still resolves base
+    assert supervisor.store._redirects == {}
+    assert supervisor.store.route(base_dir) in (
+        base_dir,
+        supervisor.store.canary_status() and supervisor.store.canary_status()["canary"],
+    )
+    assert LifecycleState.load(models_root).serving_revision == BASE_REVISION
+
+
+def test_crash_at_drift_eval_leaves_serving_and_loop_intact(
+    models_root, probe_windows
+):
+    supervisor = _calibrated_supervisor(models_root, probe_windows)
+    frames = _drifted_frames(probe_windows)
+    with inject(FaultRule("drift_eval", match=NAMES[1], exc=SystemExit)):
+        with pytest.raises(SystemExit):
+            supervisor.run_cycle(frames)
+    _assert_serving_last_good(supervisor, models_root)
+    assert LifecycleState.load(models_root).phase == "idle"
+    supervisor.close()
+
+    # restart converges: drift detected, canary built, promoted
+    resumed = make_supervisor(models_root, store=supervisor.store)
+    resumed.run_cycle(frames_for(NAMES, probe_windows[0]))
+    report = resumed.run_cycle(frames)
+    assert report.promoted
+    resumed.close()
+
+
+def test_nonfatal_drift_eval_fault_is_isolated_per_machine(
+    models_root, probe_windows
+):
+    """A drift evaluation ERROR (not a crash) must neither take the
+    loop down nor trip the machine."""
+    supervisor = _calibrated_supervisor(models_root, probe_windows)
+    with inject(FaultRule("drift_eval", match=NAMES[0], times=None)):
+        report = supervisor.run_cycle(_drifted_frames(probe_windows))
+    # the faulted machine is skipped; the genuinely drifted one rebuilt
+    assert NAMES[0] not in report.drifted
+    assert report.details.get("rebuilt") == [NAMES[1]]
+    assert report.promoted
+    supervisor.close()
+
+
+def test_crash_at_canary_build_resumes_same_canary(models_root, probe_windows):
+    supervisor = _calibrated_supervisor(models_root, probe_windows)
+    frames = _drifted_frames(probe_windows)
+    with inject(FaultRule("canary_build", exc=SystemExit)):
+        with pytest.raises(SystemExit):
+            supervisor.run_cycle(frames)
+    _assert_serving_last_good(supervisor, models_root)
+    state = LifecycleState.load(models_root)
+    assert state.phase == "canary_building"
+    planned_revision = state.canary_revision
+    assert planned_revision
+    # the crash happened BEFORE any training: nothing half-published
+    assert planned_revision not in os.listdir(models_root)
+    supervisor.close()
+
+    resumed = make_supervisor(models_root, store=supervisor.store)
+    report = resumed.run_cycle(frames)
+    assert report.canary_revision == planned_revision
+    assert report.promoted
+    resumed.close()
+
+
+def test_crash_at_promote_swap_leaves_canary_serving_and_resumes(
+    models_root, probe_windows
+):
+    supervisor = _calibrated_supervisor(models_root, probe_windows)
+    frames = _drifted_frames(probe_windows)
+    with inject(FaultRule("promote_swap", exc=SystemExit)):
+        with pytest.raises(SystemExit):
+            supervisor.run_cycle(frames)
+    _assert_serving_last_good(supervisor, models_root)
+    state = LifecycleState.load(models_root)
+    assert state.phase == "canary_serving"
+    supervisor.close()
+
+    resumed = make_supervisor(models_root, store=supervisor.store)
+    report = resumed.run_cycle(frames_for(NAMES, probe_windows[0]))
+    assert report.promoted
+    assert (
+        LifecycleState.load(models_root).serving_revision
+        == state.canary_revision
+    )
+    resumed.close()
+
+
+def test_crash_at_rollback_finishes_rollback_on_restart(
+    models_root, probe_windows
+):
+    supervisor = _calibrated_supervisor(
+        models_root, probe_windows, gates=GateConfig(residual_ratio=1e-6)
+    )
+    frames = _drifted_frames(probe_windows, NAMES[2])
+    with inject(FaultRule("rollback", exc=SystemExit)):
+        with pytest.raises(SystemExit):
+            supervisor.run_cycle(frames)
+    _assert_serving_last_good(supervisor, models_root)
+    state = LifecycleState.load(models_root)
+    assert state.phase == "rolling_back"
+    supervisor.close()
+
+    resumed = make_supervisor(
+        models_root,
+        store=supervisor.store,
+        gates=GateConfig(residual_ratio=1e-6),
+    )
+    report = resumed.run_cycle()
+    assert report.rolled_back
+    after = LifecycleState.load(models_root)
+    assert after.phase == "idle"
+    assert after.serving_revision == BASE_REVISION
+    assert after.quarantined(), "rollback must leave the quarantine record"
+    resumed.close()

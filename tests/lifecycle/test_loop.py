@@ -1,0 +1,289 @@
+"""
+The supervisor end to end: steady state, drift → incremental rebuild →
+canary → gated promotion with hot-swap, gate failure → rollback with
+quarantine, cooldown, and zero-5xx serving through a full cycle.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu.lifecycle import LifecycleState, restore_serving_state
+from gordo_tpu.lifecycle.gates import GateConfig
+from gordo_tpu.parallel.journal import BuildJournal
+from gordo_tpu.server import build_app
+from gordo_tpu.server.fleet_store import STORE
+
+from tests.lifecycle.conftest import (
+    BASE_REVISION,
+    NAMES,
+    PROJECT,
+    TAGS,
+    frames_for,
+    make_supervisor,
+)
+from tests.server.conftest import temp_env_vars
+
+pytestmark = pytest.mark.lifecycle
+
+
+def test_steady_state_never_canaries(models_root, probe_windows):
+    healthy, _ = probe_windows
+    supervisor = make_supervisor(models_root)
+    for _ in range(3):
+        report = supervisor.run_cycle(frames_for(NAMES, healthy))
+        assert report.phase == "idle"
+        assert not report.stale and report.canary_revision is None
+    assert sorted(os.listdir(models_root))[-1] == BASE_REVISION
+    supervisor.close()
+
+
+def test_drift_rebuilds_only_stale_and_promotes(models_root, probe_windows):
+    healthy, drifted = probe_windows
+    supervisor = make_supervisor(models_root)
+    store = supervisor.store
+    base_dir = os.path.join(models_root, BASE_REVISION)
+    supervisor.run_cycle(frames_for(NAMES, healthy))  # calibration
+
+    frames = frames_for(NAMES, healthy)
+    frames[NAMES[1]] = drifted
+    report = supervisor.run_cycle(frames)
+
+    assert report.stale == [NAMES[1]]
+    assert report.details["rebuilt"] == [NAMES[1]]
+    assert report.promoted and not report.rolled_back
+    canary = report.canary_revision
+    assert canary == "101"
+
+    # ONLY the stale member went through the build (journal evidence)
+    journal = BuildJournal.load(
+        os.path.join(models_root, ".lifecycle", f"build-{canary}")
+    )
+    assert sorted(journal.machines()) == [NAMES[1]]
+
+    # untouched members were hardlinked, the stale one replaced
+    canary_dir = os.path.join(models_root, canary)
+    same = os.stat(os.path.join(base_dir, NAMES[0], "model.pkl")).st_ino
+    assert same == os.stat(os.path.join(canary_dir, NAMES[0], "model.pkl")).st_ino
+    assert os.stat(os.path.join(base_dir, NAMES[1], "model.pkl")).st_ino != (
+        os.stat(os.path.join(canary_dir, NAMES[1], "model.pkl")).st_ino
+    )
+
+    # the hot swap landed: requests for the base dir route to the canary
+    assert store.route(base_dir) == canary_dir
+    assert store.canary_status() is None  # promotion cleared the slice
+    assert supervisor.serving_revision == canary
+
+    # state survived and the next cycle is steady again
+    state = LifecycleState.load(models_root)
+    assert state.phase == "idle" and state.serving_revision == canary
+    follow_up = supervisor.run_cycle(frames_for(NAMES, healthy))
+    assert follow_up.phase == "idle" and not follow_up.stale
+    supervisor.close()
+
+
+def test_gate_failure_rolls_back_and_quarantines(models_root, probe_windows):
+    healthy, drifted = probe_windows
+    # an impossible residual gate: every canary fails it
+    supervisor = make_supervisor(
+        models_root, gates=GateConfig(residual_ratio=1e-6)
+    )
+    store = supervisor.store
+    base_dir = os.path.join(models_root, BASE_REVISION)
+    supervisor.run_cycle(frames_for(NAMES, healthy))
+    frames = frames_for(NAMES, healthy)
+    frames[NAMES[2]] = drifted
+    report = supervisor.run_cycle(frames)
+
+    assert report.rolled_back and not report.promoted
+    assert not report.gate["passed"]
+    # serving never moved
+    assert store.route(base_dir) == base_dir
+    assert store.canary_status() is None
+    assert supervisor.serving_revision == BASE_REVISION
+    # the quarantine record explains it
+    state = LifecycleState.load(models_root)
+    records = state.quarantined()
+    assert len(records) == 1
+    assert records[0]["canary_revision"] == report.canary_revision
+    assert NAMES[2] in records[0]["machines"]
+    assert any("residual" in reason for reason in records[0]["reasons"])
+    supervisor.close()
+
+
+def test_quarantine_cooldown_suppresses_canary_storm(
+    models_root, probe_windows
+):
+    healthy, drifted = probe_windows
+    supervisor = make_supervisor(
+        models_root,
+        gates=GateConfig(residual_ratio=1e-6),
+        quarantine_cooldown_s=3600.0,
+    )
+    supervisor.run_cycle(frames_for(NAMES, healthy))
+    frames = frames_for(NAMES, healthy)
+    frames[NAMES[1]] = drifted
+    first = supervisor.run_cycle(frames)
+    assert first.rolled_back
+    # the same drift again: cooldown suppresses a second canary
+    second = supervisor.run_cycle(frames)
+    assert not second.canary_revision
+    assert second.details.get("cooldown") == [NAMES[1]]
+    supervisor.close()
+
+
+def test_no_auto_promote_leaves_canary_serving_then_manual_promote(
+    models_root, probe_windows
+):
+    healthy, drifted = probe_windows
+    supervisor = make_supervisor(models_root, auto_promote=False)
+    supervisor.run_cycle(frames_for(NAMES, healthy))
+    frames = frames_for(NAMES, healthy)
+    frames[NAMES[0]] = drifted
+    report = supervisor.run_cycle(frames)
+    assert report.phase == "canary_serving"
+    assert not report.promoted and not report.rolled_back
+    assert supervisor.store.canary_status() is not None
+
+    manual = supervisor.promote()
+    assert manual.promoted
+    assert supervisor.serving_revision == report.canary_revision
+    supervisor.close()
+
+
+def test_manual_rollback(models_root, probe_windows):
+    healthy, drifted = probe_windows
+    supervisor = make_supervisor(models_root, auto_promote=False)
+    supervisor.run_cycle(frames_for(NAMES, healthy))
+    frames = frames_for(NAMES, healthy)
+    frames[NAMES[0]] = drifted
+    report = supervisor.run_cycle(frames)
+    assert report.phase == "canary_serving"
+    manual = supervisor.rollback("operator says no")
+    assert manual.rolled_back
+    assert supervisor.serving_revision == BASE_REVISION
+    records = LifecycleState.load(models_root).quarantined()
+    assert records and records[-1]["reasons"] == ["operator says no"]
+    supervisor.close()
+
+
+def _payload(window):
+    rows = window.iloc[:8]
+    index = [ts.isoformat() for ts in rows.index]
+    return {
+        "X": {
+            tag: {ts: float(v) for ts, v in zip(index, rows[tag])}
+            for tag in TAGS
+        }
+    }
+
+
+def test_full_cycle_route_level_zero_5xx(models_root, probe_windows):
+    """The acceptance drill: concurrent clients through drift → canary
+    → rollback AND drift → canary → promote; every response is 200 and
+    stamps exactly one known revision (never torn, never 5xx)."""
+    healthy, drifted = probe_windows
+    base_dir = os.path.join(models_root, BASE_REVISION)
+    payload = _payload(healthy)
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=base_dir, GORDO_TPU_SERVE_WARMUP="0"
+    ):
+        app = build_app(config={"EXPECTED_MODELS": NAMES})
+        supervisor = make_supervisor(
+            models_root, store=STORE, gates=GateConfig(residual_ratio=1e-6)
+        )
+        try:
+            stop = threading.Event()
+            outcomes = []
+
+            def hammer(i):
+                client = Client(app)
+                while not stop.is_set():
+                    name = NAMES[i % len(NAMES)]
+                    resp = client.post(
+                        f"/gordo/v0/{PROJECT}/{name}/prediction", json=payload
+                    )
+                    outcomes.append(
+                        (resp.status_code, resp.headers.get("revision"))
+                    )
+
+            threads = [
+                threading.Thread(target=hammer, args=(i,)) for i in range(4)
+            ]
+            for thread in threads:
+                thread.start()
+            try:
+                supervisor.run_cycle(frames_for(NAMES, healthy))
+                bad_frames = frames_for(NAMES, healthy)
+                bad_frames[NAMES[1]] = drifted
+                rolled = supervisor.run_cycle(bad_frames)  # gates fail
+                assert rolled.rolled_back
+                # now a healthy promotion path
+                supervisor.config.gates = GateConfig()
+                supervisor.config.quarantine_cooldown_s = 0.0
+                supervisor.run_cycle(bad_frames)
+                promoted = supervisor.run_cycle(bad_frames)
+                promoted_any = rolled.canary_revision and (
+                    promoted.promoted or promoted.canary_revision
+                )
+                assert promoted_any
+            finally:
+                stop.set()
+                for thread in threads:
+                    thread.join(timeout=30)
+
+            assert outcomes
+            statuses = {code for code, _ in outcomes}
+            assert statuses == {200}, statuses
+            revisions = {rev for _, rev in outcomes}
+            known = set(
+                entry
+                for entry in os.listdir(models_root)
+                if entry.isdigit()
+            )
+            assert revisions <= known, (revisions, known)
+        finally:
+            supervisor.close()
+            STORE.clear()
+
+
+def test_restore_serving_state_reinstalls_promotion(
+    models_root, probe_windows
+):
+    healthy, drifted = probe_windows
+    supervisor = make_supervisor(models_root, store=STORE)
+    base_dir = os.path.join(models_root, BASE_REVISION)
+    try:
+        supervisor.run_cycle(frames_for(NAMES, healthy))
+        frames = frames_for(NAMES, healthy)
+        frames[NAMES[1]] = drifted
+        report = supervisor.run_cycle(frames)
+        assert report.promoted
+        promoted_dir = os.path.join(models_root, report.canary_revision)
+
+        # simulate a server restart: routing state is process memory
+        STORE.clear()
+        assert STORE.route(base_dir) == base_dir
+        assert restore_serving_state(base_dir) == report.canary_revision
+        assert STORE.route(base_dir) == promoted_dir
+
+        # build_app applies it too (and /prediction serves the new rev)
+        STORE.clear()
+        with temp_env_vars(
+            MODEL_COLLECTION_DIR=base_dir, GORDO_TPU_SERVE_WARMUP="0"
+        ):
+            app = build_app(config={"EXPECTED_MODELS": NAMES})
+            resp = Client(app).post(
+                f"/gordo/v0/{PROJECT}/{NAMES[0]}/prediction",
+                json=_payload(healthy),
+            )
+            assert resp.status_code == 200, resp.data
+            assert resp.headers["revision"] == report.canary_revision
+            body = json.loads(resp.data)
+            assert body["revision"] == report.canary_revision
+    finally:
+        supervisor.close()
+        STORE.clear()

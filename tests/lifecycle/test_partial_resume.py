@@ -1,0 +1,77 @@
+"""
+Journal-driven partial rebuilds: kill the lifecycle loop mid-canary-
+build, restart, and assert only unbuilt stale members replan/rebuild —
+and the canary resumes to the SAME revision id.
+"""
+
+import os
+
+import pytest
+
+from gordo_tpu.lifecycle import LifecycleState
+from gordo_tpu.parallel.journal import BuildJournal
+from gordo_tpu.utils.faults import FaultRule, inject
+
+from tests.lifecycle.conftest import NAMES, frames_for, make_supervisor
+
+pytestmark = [pytest.mark.lifecycle, pytest.mark.faults]
+
+
+def test_kill_mid_canary_build_resumes_only_unbuilt_members(
+    models_root, probe_windows
+):
+    healthy, drifted = probe_windows
+    supervisor = make_supervisor(models_root)
+    supervisor.run_cycle(frames_for(NAMES, healthy))
+    # TWO machines drift; the process dies while dumping the second
+    # canary artifact (mid-write, inside the atomic dump — the dump
+    # pool is concurrent, so the process_kill-after-N site can land
+    # after BOTH dumps; dying inside the Nth dump is deterministic)
+    frames = frames_for(NAMES, healthy)
+    frames[NAMES[1]] = drifted
+    frames[NAMES[2]] = drifted
+    with inject(
+        FaultRule("dump_artifact", after=1, times=None, exc=SystemExit)
+    ):
+        with pytest.raises(SystemExit):
+            supervisor.run_cycle(frames)
+    supervisor.close()
+
+    state = LifecycleState.load(models_root)
+    assert state.phase == "canary_building"
+    revision = state.canary_revision
+    assert sorted(state.stale) == sorted(NAMES[1:])
+    build_dir = os.path.join(models_root, ".lifecycle", f"build-{revision}")
+    journal = BuildJournal.load(build_dir)
+    built = sorted(
+        name
+        for name, entry in journal.machines().items()
+        if entry.get("status") == "built"
+    )
+    assert len(built) == 1  # exactly one artifact landed before the kill
+    survivor = built[0]
+    other = next(n for n in NAMES[1:] if n != survivor)
+    before = os.stat(os.path.join(build_dir, survivor, "model.pkl")).st_mtime_ns
+
+    # restart: the canary resumes — same revision id, and ONLY the
+    # unbuilt member trains (the survivor's artifact is untouched)
+    resumed = make_supervisor(models_root, store=supervisor.store)
+    report = resumed.run_cycle(frames)
+    assert report.canary_revision == revision
+    assert report.details["resumed"] == [survivor]
+    assert report.details["rebuilt"] == sorted(NAMES[1:])
+    assert (
+        os.stat(os.path.join(build_dir, survivor, "model.pkl")).st_mtime_ns
+        == before
+    )
+    # journal evidence: both stale members built, nothing else planned
+    journal = BuildJournal.load(build_dir)
+    assert sorted(journal.machines()) == sorted([survivor, other])
+    assert all(
+        entry.get("status") == "built"
+        for entry in journal.machines().values()
+    )
+    # the resumed canary promoted and serves
+    assert report.promoted
+    assert resumed.serving_revision == revision
+    resumed.close()

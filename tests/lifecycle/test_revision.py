@@ -1,0 +1,102 @@
+"""
+Canary revision assembly: numeric revision allocation, hardlinked
+publish, idempotence, and refusal to ship incomplete artifacts.
+"""
+
+import os
+import shutil
+
+import pytest
+
+from gordo_tpu import serializer
+from gordo_tpu.lifecycle.revision import (
+    delete_revision_dir,
+    list_revisions,
+    next_revision,
+    publish_canary,
+    revision_complete,
+)
+from gordo_tpu.serializer.serializer import is_staging_dir
+
+from tests.lifecycle.conftest import BASE_REVISION, NAMES
+
+pytestmark = pytest.mark.lifecycle
+
+
+def test_revision_allocation(tmp_path):
+    assert list_revisions(str(tmp_path)) == []
+    assert next_revision(str(tmp_path)) == "1"
+    for revision in ("8", "9", "10"):
+        (tmp_path / revision).mkdir()
+    (tmp_path / "not-a-revision").mkdir()
+    assert list_revisions(str(tmp_path)) == ["8", "9", "10"]
+    assert next_revision(str(tmp_path)) == "11"
+
+
+@pytest.fixture
+def rebuilt_dir(models_root, tmp_path):
+    """A 'rebuild output' holding fresh copies of one member."""
+    build = tmp_path / "build"
+    build.mkdir()
+    source = os.path.join(models_root, BASE_REVISION, NAMES[1])
+    shutil.copytree(source, build / NAMES[1])
+    return str(build)
+
+
+def test_publish_links_untouched_and_takes_rebuilt(models_root, rebuilt_dir):
+    target = publish_canary(
+        models_root, BASE_REVISION, rebuilt_dir, [NAMES[1]], "101"
+    )
+    assert sorted(serializer.list_model_dirs(target)) == sorted(NAMES)
+    assert revision_complete(target)
+    # untouched members share inodes with the base (no bytes copied)
+    base = os.path.join(models_root, BASE_REVISION)
+    for name in (NAMES[0], NAMES[2]):
+        assert os.stat(os.path.join(base, name, "model.pkl")).st_ino == (
+            os.stat(os.path.join(target, name, "model.pkl")).st_ino
+        )
+    # the rebuilt member came from the build dir, not the base
+    assert os.stat(os.path.join(rebuilt_dir, NAMES[1], "model.pkl")).st_ino == (
+        os.stat(os.path.join(target, NAMES[1], "model.pkl")).st_ino
+    )
+    # the base build's plan rides along for the next incremental replay
+    assert os.path.isfile(os.path.join(target, "fleet_plan.json"))
+    # no staging leftovers
+    assert not [e for e in os.listdir(models_root) if is_staging_dir(e)]
+
+
+def test_publish_is_idempotent(models_root, rebuilt_dir):
+    first = publish_canary(
+        models_root, BASE_REVISION, rebuilt_dir, [NAMES[1]], "101"
+    )
+    again = publish_canary(
+        models_root, BASE_REVISION, rebuilt_dir, [NAMES[1]], "101"
+    )
+    assert first == again
+    assert revision_complete(again)
+
+
+def test_publish_refuses_incomplete_rebuilt_artifacts(models_root, tmp_path):
+    build = tmp_path / "torn-build"
+    (build / NAMES[1]).mkdir(parents=True)
+    (build / NAMES[1] / "model.pkl").write_bytes(b"torn")
+    with pytest.raises(RuntimeError, match="incomplete"):
+        publish_canary(
+            models_root, BASE_REVISION, str(build), [NAMES[1]], "101"
+        )
+    assert "101" not in list_revisions(models_root)
+
+
+def test_publish_refuses_foreign_incomplete_target(models_root, rebuilt_dir):
+    os.makedirs(os.path.join(models_root, "101", "junk"))
+    with pytest.raises(RuntimeError, match="refusing"):
+        publish_canary(
+            models_root, BASE_REVISION, rebuilt_dir, [NAMES[1]], "101"
+        )
+
+
+def test_delete_revision_dir(models_root, rebuilt_dir):
+    publish_canary(models_root, BASE_REVISION, rebuilt_dir, [NAMES[1]], "101")
+    assert delete_revision_dir(models_root, "101") is not None
+    assert "101" not in list_revisions(models_root)
+    assert delete_revision_dir(models_root, "101") is None

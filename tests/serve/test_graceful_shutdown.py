@@ -1,0 +1,108 @@
+"""
+Graceful shutdown: queued micro-batcher futures must RESOLVE on
+SIGTERM-driven drains — concurrent clients get real responses, never a
+dead future — while the healthcheck flips to 503 so load balancers
+stop routing here.
+"""
+
+import json
+import signal
+import threading
+import time
+
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu.server import build_app
+from gordo_tpu.server.app import drain_and_stop, install_graceful_shutdown
+
+from tests.serve.conftest import (
+    BATCH_NAMES,
+    PROJECT,
+    installed_engine,
+    temp_env_vars,
+    tiny_config,
+)
+
+pytestmark = pytest.mark.serve
+
+
+def test_drain_resolves_queued_batches_with_concurrent_clients(
+    serve_collection_dir, batch_payload
+):
+    """Clients whose requests are QUEUED in the batcher when the drain
+    starts still get 200s (today's failure mode: their futures die with
+    the process); post-drain requests serve unbatched."""
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=serve_collection_dir, GORDO_TPU_SERVE_WARMUP="0"
+    ):
+        app = build_app(config={"EXPECTED_MODELS": BATCH_NAMES})
+        # a flush window long enough that the drain lands mid-queue
+        with installed_engine(tiny_config(max_delay_ms=5000.0)) as engine:
+            statuses = [None] * 4
+
+            def hit(i):
+                resp = Client(app).post(
+                    f"/gordo/v0/{PROJECT}/{BATCH_NAMES[i % 3]}/prediction",
+                    json=batch_payload,
+                )
+                statuses[i] = resp.status_code
+
+            threads = [
+                threading.Thread(target=hit, args=(i,)) for i in range(4)
+            ]
+            for thread in threads:
+                thread.start()
+            deadline = time.monotonic() + 10.0
+            while engine._batcher.pending() < 4:
+                assert time.monotonic() < deadline, engine.stats()
+                time.sleep(0.005)
+
+            # SIGTERM path: drain flushes everything queued
+            drain_and_stop(app, server=None, engine=engine)
+            for thread in threads:
+                thread.join(timeout=30)
+            assert statuses == [200, 200, 200, 200], statuses
+            assert engine._batcher.pending() == 0
+
+            # draining server: healthcheck 503 (LBs stop sending) but
+            # already-connected clients still get served, unbatched
+            assert Client(app).get("/healthcheck").status_code == 503
+            resp = Client(app).post(
+                f"/gordo/v0/{PROJECT}/batch-a/prediction", json=batch_payload
+            )
+            assert resp.status_code == 200, resp.data
+            assert "model-output" in json.loads(resp.data)["data"]
+
+
+def test_drain_without_engine_still_flips_healthcheck(serve_collection_dir):
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=serve_collection_dir, GORDO_TPU_SERVE_WARMUP="0"
+    ):
+        app = build_app(config={"EXPECTED_MODELS": BATCH_NAMES})
+        assert Client(app).get("/healthcheck").status_code == 200
+        drain_and_stop(app, server=None, engine=None)
+        assert Client(app).get("/healthcheck").status_code == 503
+
+
+def test_install_graceful_shutdown_registers_sigterm(serve_collection_dir):
+    """The werkzeug fallback path wires SIGTERM/SIGINT to the drain
+    (restored afterwards so the test process keeps its handlers)."""
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=serve_collection_dir, GORDO_TPU_SERVE_WARMUP="0"
+    ):
+        app = build_app(config={"EXPECTED_MODELS": BATCH_NAMES})
+        previous_term = signal.getsignal(signal.SIGTERM)
+        previous_int = signal.getsignal(signal.SIGINT)
+        try:
+            handler = install_graceful_shutdown(app, server=None)
+            assert handler is not None
+            assert signal.getsignal(signal.SIGTERM) is handler
+            handler(signal.SIGTERM, None)
+            deadline = time.monotonic() + 10.0
+            while not app.draining:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+        finally:
+            signal.signal(signal.SIGTERM, previous_term)
+            signal.signal(signal.SIGINT, previous_int)

@@ -101,6 +101,61 @@ def test_invalidate_mid_batch_keeps_inflight_and_later_requests_sane(
         assert engine.batched_predict(doomed, "batch-a", model, X) is None
 
 
+def test_swap_and_invalidate_mid_batch_never_serves_torn_fleet(
+    disposable_revision,
+):
+    """Hot-swap racing in-flight batches (the lifecycle promotion
+    race): items queued when a swap+invalidate lands must score
+    exactly against the fleet object they were admitted under (never a
+    mix of old and new revisions, never an error), and requests routed
+    AFTER the swap resolve the swapped-in fleet."""
+    live, doomed = disposable_revision
+    fleet = STORE.fleet(doomed)
+    fleet.warm(BATCH_NAMES)
+    model = fleet.model("batch-a")
+    X = np.random.RandomState(0).rand(6, 4).astype(np.float32)
+    reference = np.asarray(model.predict(X))
+
+    with installed_engine(tiny_config(max_delay_ms=1000.0)) as engine:
+        results = [None] * 4
+
+        def hit(i):
+            results[i] = engine.batched_predict(doomed, "batch-a", model, X)
+
+        import threading
+        import time
+
+        threads = [threading.Thread(target=hit, args=(i,)) for i in range(4)]
+        for thread in threads:
+            thread.start()
+        deadline = time.monotonic() + 5.0
+        while engine._batcher.pending() < 4:
+            assert time.monotonic() < deadline, engine.stats()
+            time.sleep(0.005)
+        # the race: a promotion swap + invalidation of the old revision
+        # lands between the MRU fast-path read and the batch flush
+        swapped = STORE.swap(doomed, live, warm=True)
+        STORE.invalidate(doomed)
+        for thread in threads:
+            thread.join(timeout=30)
+
+        # every queued request scored against its pinned snapshot —
+        # bit-equal to the pre-swap reference, no errors, no tearing
+        for recon in results:
+            assert recon is not None
+            np.testing.assert_allclose(recon, reference, rtol=1e-4, atol=1e-5)
+
+        # post-swap traffic routes to the swapped-in revision's fleet
+        routed = STORE.route(doomed)
+        assert routed == live
+        assert STORE.fleet(routed) is swapped
+        later = engine.batched_predict(
+            routed, "batch-a", swapped.model("batch-a"), X
+        )
+        assert later is not None
+        np.testing.assert_allclose(later, reference, rtol=1e-4, atol=1e-5)
+
+
 def test_delete_revision_route_mid_batch_never_500s_later_requests(
     disposable_revision, batch_payload
 ):

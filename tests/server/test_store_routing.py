@@ -1,0 +1,147 @@
+"""
+FleetModelStore lifecycle routing: hot-swap redirects, canary traffic
+slices, and their interplay with invalidation.
+"""
+
+import os
+
+import pytest
+
+from gordo_tpu.server.fleet_store import FleetModelStore
+
+pytestmark = pytest.mark.lifecycle
+
+
+@pytest.fixture
+def roots(tmp_path):
+    base = tmp_path / "100"
+    canary = tmp_path / "101"
+    base.mkdir()
+    canary.mkdir()
+    return str(base), str(canary)
+
+
+def test_route_is_identity_without_lifecycle_state(roots):
+    base, _ = roots
+    store = FleetModelStore(max_revisions=2)
+    assert store.route(base) == base
+
+
+def test_swap_redirects_and_swap_back_restores(roots):
+    base, canary = roots
+    store = FleetModelStore(max_revisions=2)
+    store.swap(base, canary, warm=False)
+    assert store.route(base) == canary
+    # requests already routed keep their fleet; the base fleet object
+    # is untouched by the swap (pinned-snapshot contract)
+    store.swap(base, base, warm=False)
+    assert store.route(base) == base
+
+
+def test_canary_slice_alternates_deterministically(roots):
+    base, canary = roots
+    store = FleetModelStore(max_revisions=2)
+    store.set_canary(base, canary, fraction=0.5, warm=False)
+    routed = [store.route(base) for _ in range(6)]
+    assert routed.count(canary) == 3
+    assert routed.count(base) == 3
+    status = store.canary_status()
+    assert status["fraction"] == pytest.approx(0.5)
+    store.clear_canary(base)
+    assert store.canary_status() is None
+    assert {store.route(base) for _ in range(4)} == {base}
+
+
+def test_canary_fraction_validation(roots):
+    base, canary = roots
+    store = FleetModelStore(max_revisions=2)
+    with pytest.raises(ValueError):
+        store.set_canary(base, canary, fraction=0.0)
+    with pytest.raises(ValueError):
+        store.set_canary(base, canary, fraction=1.5)
+
+
+def test_swap_clears_canary_slice(roots):
+    base, canary = roots
+    store = FleetModelStore(max_revisions=2)
+    store.set_canary(base, canary, fraction=1.0, warm=False)
+    assert store.route(base) == canary
+    store.swap(base, canary, warm=False)
+    assert store.canary_status() is None
+    assert store.route(base) == canary  # via the redirect now
+
+
+def test_invalidating_the_target_drops_routing_to_it(roots):
+    base, canary = roots
+    store = FleetModelStore(max_revisions=2)
+    store.swap(base, canary, warm=False)
+    store.invalidate(canary)
+    assert store.route(base) == base
+
+    store.set_canary(base, canary, fraction=1.0, warm=False)
+    store.invalidate(canary)
+    assert store.canary_status() is None
+
+
+def test_invalidating_the_source_keeps_the_redirect(roots):
+    """A redirect is serving state, not a cache of the source dir: the
+    DELETE route invalidating the (stale) source must not un-promote."""
+    base, canary = roots
+    store = FleetModelStore(max_revisions=2)
+    store.swap(base, canary, warm=False)
+    store.invalidate(base)
+    assert store.route(base) == canary
+
+
+def test_clear_resets_all_routing(roots):
+    base, canary = roots
+    store = FleetModelStore(max_revisions=2)
+    store.swap(base, canary, warm=False)
+    store.set_canary(base, canary, fraction=1.0, warm=False)
+    store.clear()
+    assert store.route(base) == base
+    assert store.canary_status() is None
+
+
+def test_routing_tolerates_cosmetic_path_differences(roots):
+    """MODEL_COLLECTION_DIR often carries a trailing slash; a recorded
+    promotion/canary must still route for it."""
+    base, canary = roots
+    store = FleetModelStore(max_revisions=2)
+    store.swap(base, canary, warm=False)  # installed with the clean path
+    assert store.route(base + "/") == canary
+    assert store.route(base + "//") == canary
+    store.swap(base + "/", base, warm=False)  # swap-back via slashed form
+    assert store.route(base) == base
+
+    store.set_canary(base + "/", canary, fraction=1.0, warm=False)
+    assert store.route(base) == canary
+    store.clear_canary(base)
+    assert store.canary_status() is None
+
+
+def test_ensure_fleet_never_evicts_the_mru_served_revision(roots, tmp_path):
+    """Installing a canary must not evict the actively-serving fleet:
+    the MRU fast path never refreshes its LRU slot, so without the
+    re-rank the hottest revision looks least-recently-used."""
+    base, canary = roots
+    cold = tmp_path / "99"
+    cold.mkdir()
+    store = FleetModelStore(max_revisions=2)
+    serving = store.fleet(base)
+    store.fleet(str(cold))  # cold revision now looks newer than base
+    assert store.fleet(base) is serving  # served via the MRU fast path
+    store.set_canary(base, canary, fraction=0.5, warm=False)
+    # the canary displaced the COLD revision, not the serving one
+    assert store.fleet(base) is serving
+    assert os.path.realpath(base) in store._revisions
+
+
+def test_swap_preinstalls_mru_for_the_new_dir(roots):
+    base, canary = roots
+    store = FleetModelStore(max_revisions=2)
+    fleet = store.swap(base, canary, warm=False)
+    # the swapped-in fleet is already the lock-free fast path
+    assert store._mru == (canary, fleet)
+    assert store.fleet(canary) is fleet
+    assert os.path.realpath(canary) == fleet.collection_dir
