@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve test-planner test-lifecycle test-lifecycle-faults docs bench bench-telemetry bench-serve bench-planner bench-lifecycle lint image
+.PHONY: test test-fast test-faults test-observability test-serve test-planner test-lifecycle test-lifecycle-faults docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-check lint image
 
 test:
 	python -m pytest tests/ -q
@@ -62,6 +62,22 @@ bench-planner:
 # off vs on; writes BENCH_TELEMETRY.json for the bench trajectory.
 bench-telemetry:
 	JAX_PLATFORMS=cpu python benchmarks/bench_telemetry.py
+
+# Full-route serving benchmark + observability acceptance surface:
+# per-stage attribution from serve_trace.jsonl (coverage >= 90% of p50
+# walltime) and the tracing/histogram overhead floor; writes
+# BENCH_ROUTE.json (override the path with BENCH_ROUTE_OUT).
+bench-route:
+	JAX_PLATFORMS=cpu python benchmarks/bench_route.py
+
+# The perf-regression gate: re-run the route bench into a scratch file
+# and compare it against the committed BENCH_ROUTE.json. Exits non-zero
+# on regression; CI runs the same comparison with --report-only.
+bench-check:
+	JAX_PLATFORMS=cpu BENCH_ROUTE_OUT=/tmp/bench_route_fresh.json \
+		python benchmarks/bench_route.py
+	python -m gordo_tpu bench-check /tmp/bench_route_fresh.json \
+		--baseline BENCH_ROUTE.json
 
 # The sub-5-minute tier: everything except the compile-heavy JAX suites
 # (tests/parallel, tests/models) and slow-marked tests.

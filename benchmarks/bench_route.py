@@ -1,0 +1,456 @@
+"""
+Full-route serving benchmark + the observability acceptance surface.
+
+Measures the thing ROADMAP's top open item says nobody could measure:
+where a full-route request's time goes. Three layers land in
+``BENCH_ROUTE.json``:
+
+- **route**: concurrent clients through the real WSGI ``prediction``
+  route with the serving trace ON — full-route throughput/latency plus
+  the per-stage breakdown (``model_resolve`` / ``data_decode`` /
+  ``inference`` / ``response_assemble`` / ``serialize``, and
+  ``queue_wait`` when batching) **reproduced from serve_trace.jsonl by
+  the same analysis ``gordo-tpu trace`` runs** — the bench asserts the
+  instrumented stages explain ≥90% of median request walltime
+  (``attribution_coverage``), i.e. the route is now explainable, not
+  just slow;
+- **scoring_overhead**: what flipping ``GORDO_TPU_TELEMETRY`` changes
+  on the scoring hot path, where the cost is proportionally largest.
+  Both modes run the invariant per-request machinery (Server-Timing
+  recorder + stage span + RED observation — ``ENABLE_PROMETHEUS`` is a
+  separate switch and stays on); telemetry-on adds trace identity, log
+  binding, and head-sampled serve-trace export. Interleaved reps; the
+  headline compares the two modes' MEDIAN throughput (per-rep noise on
+  throttled shared hosts is independent between adjacent runs, so the
+  mode-median is the lowest-variance estimator; per-pair medians and
+  quiet-window floors ride along for context). Acceptance bar: ≤2%;
+- **profile**: one profiled request's top self-time frames, as a
+  sanity surface for the sampling profiler.
+
+Writes ``BENCH_ROUTE.json`` at the repo root (override with
+``BENCH_ROUTE_OUT``); ``gordo-tpu bench-check`` gates fresh runs
+against the committed copy. Run: ``make bench-route``.
+"""
+
+import datetime
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import threading
+import time
+import warnings
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+warnings.filterwarnings("ignore", category=UserWarning)
+
+N_MODELS = 4
+N_TAGS = 12
+ROWS = 256
+ROUTE_THREADS = int(os.getenv("BENCH_ROUTE_THREADS", "16"))
+ROUTE_REQUESTS_PER_THREAD = int(os.getenv("BENCH_ROUTE_REQUESTS", "6"))
+ROUTE_REPS = int(os.getenv("BENCH_ROUTE_REPS", "3"))
+SCORE_THREADS = int(os.getenv("BENCH_ROUTE_SCORE_THREADS", "32"))
+SCORE_REQUESTS_PER_THREAD = int(os.getenv("BENCH_ROUTE_SCORE_REQUESTS", "20"))
+SCORE_REPS = int(os.getenv("BENCH_ROUTE_SCORE_REPS", "9"))
+
+REVISION = "1700000000000"
+
+MACHINE_YAML = """  - name: route-{i}
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-02T00:00:00+00:00"
+      tag_list: [{tags}]
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.JaxAutoEncoder:
+            kind: feedforward_model
+            encoding_dim: [128, 64]
+            encoding_func: [tanh, tanh]
+            decoding_dim: [64, 128]
+            decoding_func: [tanh, tanh]
+            epochs: 1
+"""
+
+
+def build_collection(root: str) -> str:
+    from gordo_tpu import serializer
+    from gordo_tpu.builder import local_build
+
+    tags = ", ".join(f"tag-{j}" for j in range(1, N_TAGS + 1))
+    config = "machines:\n" + "".join(
+        MACHINE_YAML.format(i=i, tags=tags) for i in range(N_MODELS)
+    )
+    collection_dir = os.path.join(root, REVISION)
+    for model, machine in local_build(config, project_name="bench-route"):
+        serializer.dump(
+            model,
+            os.path.join(collection_dir, machine.name),
+            metadata=machine.to_dict(),
+        )
+    return collection_dir
+
+
+def traffic(score_one, threads: int, per_thread: int) -> dict:
+    latencies = []
+    lock = threading.Lock()
+
+    def worker(worker_id: int):
+        mine = []
+        for r in range(per_thread):
+            name = f"route-{(worker_id + r) % N_MODELS}"
+            begin = time.perf_counter()
+            score_one(name)
+            mine.append(time.perf_counter() - begin)
+        with lock:
+            latencies.extend(mine)
+
+    pool = [threading.Thread(target=worker, args=(i,)) for i in range(threads)]
+    wall_start = time.perf_counter()
+    for thread in pool:
+        thread.start()
+    for thread in pool:
+        thread.join()
+    wall = time.perf_counter() - wall_start
+
+    total = threads * per_thread
+    latencies.sort()
+    return {
+        "requests": total,
+        "wall_sec": round(wall, 4),
+        "throughput_rps": round(total / wall, 2),
+        "p50_ms": round(statistics.median(latencies) * 1000.0, 3),
+        "p99_ms": round(latencies[int(len(latencies) * 0.99) - 1] * 1000.0, 3),
+    }
+
+
+def interleaved_floors(run_a, run_b, reps: int, names=("a", "b")) -> dict:
+    runs = {names[0]: [], names[1]: []}
+    for rep in range(reps):
+        order = (
+            [(names[0], run_a), (names[1], run_b)]
+            if rep % 2 == 0
+            else [(names[1], run_b), (names[0], run_a)]
+        )
+        for mode, run in order:
+            runs[mode].append(run())
+    out = {}
+    for mode, results in runs.items():
+        best = max(results, key=lambda r: r["throughput_rps"])
+        out[mode] = dict(
+            best,
+            median_throughput_rps=round(
+                statistics.median(r["throughput_rps"] for r in results), 2
+            ),
+            throughput_rps_runs=[r["throughput_rps"] for r in results],
+        )
+    return out
+
+
+def main() -> dict:
+    import numpy as np
+    from werkzeug.test import Client
+
+    from gordo_tpu import telemetry
+    from gordo_tpu.server.fleet_store import STORE
+    from gordo_tpu.telemetry import trace_analysis
+
+    root = tempfile.mkdtemp(prefix="bench-route-")
+    trace_dir = os.path.join(root, "telemetry")
+    try:
+        collection_dir = build_collection(root)
+
+        # ---- route layer: full WSGI path, serving trace ON --------------
+        os.environ["MODEL_COLLECTION_DIR"] = collection_dir
+        os.environ["GORDO_TPU_SERVE_WARMUP"] = "0"
+        os.environ["GORDO_TPU_TELEMETRY"] = "1"
+        os.environ["GORDO_TPU_TELEMETRY_DIR"] = trace_dir
+        # full-fidelity export for the attribution phase: every request's
+        # stage spans land in the trace (production default head-samples)
+        os.environ["GORDO_TPU_TRACE_SAMPLE_RATE"] = "1.0"
+        telemetry.reset_serve_recorder()
+
+        from gordo_tpu.server import build_app
+
+        app = build_app(config={})
+        index = [
+            f"2020-03-{d:02d}T{h:02d}:{m:02d}:00+00:00"
+            for d in range(1, 3)
+            for h in range(24)
+            for m in range(60)
+        ][:ROWS]
+        payload = {
+            "X": {
+                f"tag-{i}": {ts: 0.1 * i + 0.001 * j for j, ts in enumerate(index)}
+                for i in range(1, N_TAGS + 1)
+            }
+        }
+
+        def route_request(name: str):
+            resp = Client(app).post(
+                f"/gordo/v0/bench-route/{name}/prediction", json=payload
+            )
+            assert resp.status_code == 200, (name, resp.status_code)
+
+        traffic(route_request, ROUTE_THREADS, 2)  # warm compiles/caches
+        route_reps = [
+            traffic(route_request, ROUTE_THREADS, ROUTE_REQUESTS_PER_THREAD)
+            for _ in range(ROUTE_REPS)
+        ]
+        route = dict(
+            max(route_reps, key=lambda r: r["throughput_rps"]),
+            median_throughput_rps=round(
+                statistics.median(r["throughput_rps"] for r in route_reps), 2
+            ),
+            throughput_rps_runs=[r["throughput_rps"] for r in route_reps],
+        )
+
+        # one explicitly profiled request exercises the sampling profiler
+        resp = Client(app).post(
+            f"/gordo/v0/bench-route/route-0/prediction?profile=1",
+            json=payload,
+        )
+        assert resp.status_code == 200
+
+        # ---- the breakdown, REPRODUCED the way `gordo-tpu trace` does ---
+        telemetry.serve_recorder().flush()  # async sink -> disk
+        trace_path = os.path.join(trace_dir, telemetry.SERVE_TRACE_FILE)
+        analysis = trace_analysis.analyze_trace(trace_path)
+        breakdown = analysis["request_breakdown"] or {}
+        route["stages"] = breakdown.get("stages", {})
+        route["attribution_coverage"] = breakdown.get(
+            "attribution_coverage", 0.0
+        )
+        route["trace_walltime_p50_ms"] = breakdown.get("walltime_p50_ms", 0.0)
+        route["critical_path"] = breakdown.get("critical_path", [])
+
+        # ---- batched route: queue-wait attribution ----------------------
+        # the same traffic through the micro-batching engine, so the
+        # trace carries queue_wait / batch_* stages and serve_batch
+        # spans with links — the full attribution set (decode /
+        # transform / score / serialize + queue-wait) in one trace
+        from gordo_tpu import serve as serve_pkg
+        from gordo_tpu.serve import ServeConfig, ServeEngine
+
+        bengine = ServeEngine(
+            ServeConfig(
+                max_size=8,
+                max_delay_ms=10.0,
+                queue_depth=4096,
+                deadline_ms=60000.0,
+                row_ladder=(ROWS, ROWS * 4),
+                inline_flush=False,
+            )
+        )
+        serve_pkg.install_engine(bengine)
+        try:
+            traffic(route_request, ROUTE_THREADS, 2)  # warm fused programs
+            batched = traffic(
+                route_request, ROUTE_THREADS, ROUTE_REQUESTS_PER_THREAD
+            )
+        finally:
+            serve_pkg.install_engine(None)
+            bengine.shutdown(drain=True)
+        telemetry.serve_recorder().flush()
+        full_analysis = trace_analysis.analyze_trace(trace_path)
+        all_stages = (full_analysis["request_breakdown"] or {}).get(
+            "stages", {}
+        )
+        route_batched = dict(
+            batched,
+            queue_wait_p50_ms=all_stages.get("queue_wait", {}).get("p50_ms"),
+            batch_stage_p50_ms={
+                name: dist["p50_ms"]
+                for name, dist in all_stages.items()
+                if name == "queue_wait" or name.startswith("batch_")
+            },
+            serve_batch_spans=full_analysis["span_summary"]
+            .get("serve_batch", {})
+            .get("count", 0),
+        )
+
+        # ---- scoring-only overhead: observability stack on vs hard off --
+        # marginal cost at the PRODUCTION default sampling rate
+        os.environ.pop("GORDO_TPU_TRACE_SAMPLE_RATE", None)
+        fleet = STORE.fleet(collection_dir)
+        fleet.warm()
+        models = {
+            f"route-{i}": fleet.model(f"route-{i}") for i in range(N_MODELS)
+        }
+        X = np.random.RandomState(0).rand(ROWS, N_TAGS).astype(np.float32)
+        from gordo_tpu.server.prometheus.metrics import (
+            create_prometheus_metrics,
+        )
+        from prometheus_client import CollectorRegistry
+
+        registry = CollectorRegistry()
+        red = create_prometheus_metrics(project="bench", registry=registry)
+
+        class _FakeRequest:
+            method = "POST"
+            path = "/gordo/v0/bench/route-0/prediction"
+
+        class _FakeResponse:
+            status_code = 200
+
+            def __init__(self, stages, endpoint):
+                self.gordo_stage_durations = stages
+                self.gordo_endpoint = endpoint
+
+        from gordo_tpu.telemetry import SpanRecorder, serving, tracing
+
+        def score_traced(name: str):
+            # GORDO_TPU_TELEMETRY=1 + ENABLE_PROMETHEUS=true: trace
+            # identity + log binding + head-sampled serve-trace export
+            # ON TOP of the invariant per-request machinery (recorder,
+            # stage span, Server-Timing durations, RED observation).
+            begin = time.perf_counter()
+            trace_id, span_id, _ = tracing.new_trace_context()
+            timing = SpanRecorder(service="gordo-tpu-server", trace_id=trace_id)
+            timing.default_parent_id = span_id
+            token = tracing.bind(trace_id)
+            try:
+                with timing.span("inference"):
+                    np.asarray(models[name].predict(X))
+            finally:
+                tracing.unbind(token)
+            durations = timing.durations()
+            duration = time.perf_counter() - begin
+            if serving.sample_trace():
+                serving.export_request_trace(
+                    timing,
+                    span_id=span_id,
+                    parent_id=None,
+                    start=time.time() - duration,
+                    duration_s=duration,
+                    attributes={
+                        "http.method": "POST",
+                        "http.route": "prediction",
+                        "http.status_code": 200,
+                        "gordo_name": name,
+                        "revision": REVISION,
+                    },
+                )
+            red.observe(
+                _FakeRequest(),
+                _FakeResponse(durations, "prediction"),
+                duration,
+            )
+
+        def score_plain(name: str):
+            # GORDO_TPU_TELEMETRY=0 + ENABLE_PROMETHEUS=true: the
+            # Server-Timing recorder, stage span, and full RED
+            # observation still run — the master switches are
+            # independent in the real server (ENABLE_PROMETHEUS governs
+            # metrics, GORDO_TPU_TELEMETRY governs tracing), so the
+            # marginal being measured is exactly what flipping the
+            # telemetry switch changes on a production deployment
+            begin = time.perf_counter()
+            timing = SpanRecorder(service="gordo-tpu-server")
+            with timing.span("inference"):
+                np.asarray(models[name].predict(X))
+            durations = timing.durations()
+            red.observe(
+                _FakeRequest(),
+                _FakeResponse(durations, "prediction"),
+                time.perf_counter() - begin,
+            )
+
+        def run_off():
+            # score_plain IS the telemetry-off request path (no env
+            # reads on it — the master-switch tests in
+            # tests/server/test_request_tracing.py pin that contract),
+            # so the env is deliberately NOT toggled per rep: resetting
+            # the shared recorder/writer between interleaved reps
+            # measurably perturbs the comparison (~4% on a 2-core
+            # host) without changing what either mode executes.
+            return traffic(
+                score_plain, SCORE_THREADS, SCORE_REQUESTS_PER_THREAD
+            )
+
+        def run_on():
+            return traffic(
+                score_traced, SCORE_THREADS, SCORE_REQUESTS_PER_THREAD
+            )
+
+        traffic(score_plain, SCORE_THREADS, 4)
+        traffic(score_traced, SCORE_THREADS, 4)
+        overhead_runs = interleaved_floors(
+            run_off, run_on, SCORE_REPS, names=("telemetry_off", "telemetry_on")
+        )
+        # overhead estimator: MEDIAN THROUGHPUT per mode, compared —
+        # the interleaved reps give both modes the same mix of quiet
+        # and noisy windows, and per-rep noise here is INDEPENDENT
+        # between adjacent runs (cgroup throttling), so a pair
+        # difference carries the noise of two runs while the
+        # mode-median carries ~1/sqrt(n) of one. Pair medians and the
+        # quiet-window floors ride along for context.
+        off_runs = overhead_runs["telemetry_off"]["throughput_rps_runs"]
+        on_runs = overhead_runs["telemetry_on"]["throughput_rps_runs"]
+        median_off = statistics.median(off_runs)
+        median_on = statistics.median(on_runs)
+        overhead_pct = round((median_off - median_on) / median_off * 100.0, 3)
+        pair_overheads = [
+            round((off_i - on_i) / off_i * 100.0, 3)
+            for off_i, on_i in zip(off_runs, on_runs)
+            if off_i > 0
+        ]
+        floor_off = overhead_runs["telemetry_off"]["throughput_rps"]
+        floor_on = overhead_runs["telemetry_on"]["throughput_rps"]
+
+        STORE.clear()
+        telemetry.reset_serve_recorder()
+
+        doc = {
+            "bench": "route-observability",
+            "timestamp": datetime.datetime.now(
+                datetime.timezone.utc
+            ).isoformat(),
+            "models": N_MODELS,
+            "tags": N_TAGS,
+            "rows_per_request": ROWS,
+            "route_threads": ROUTE_THREADS,
+            "route_reps": ROUTE_REPS,
+            "route": route,
+            "route_batched": route_batched,
+            "attribution_target_met": route["attribution_coverage"] >= 0.9,
+            "scoring_overhead": {
+                "threads": SCORE_THREADS,
+                "reps": SCORE_REPS,
+                "trace_sample_rate": serving.trace_sample_rate(),
+                "telemetry_off": overhead_runs["telemetry_off"],
+                "telemetry_on": overhead_runs["telemetry_on"],
+                "pair_overhead_pcts": pair_overheads,
+                "pair_median_overhead_pct": round(
+                    statistics.median(pair_overheads), 3
+                ),
+                "overhead_pct": overhead_pct,
+                "floor_overhead_pct": round(
+                    (floor_off - floor_on) / floor_off * 100.0, 3
+                ),
+                "within_2pct": overhead_pct <= 2.0,
+            },
+            "profile_frames": analysis["profile_frames"][:10],
+            "trace_spans_read": analysis["spans_read"],
+        }
+        out_path = Path(os.getenv("BENCH_ROUTE_OUT", REPO_ROOT / "BENCH_ROUTE.json"))
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        print(f"\nwrote {out_path}")
+        return doc
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -783,6 +783,156 @@ def build_status(output_dir: str, as_json: bool, watch: Optional[float]):
         click.echo("")
 
 
+@click.command("trace")
+@click.argument("target", envvar="OUTPUT_DIR")
+@click.option(
+    "--as-json",
+    "as_json",
+    is_flag=True,
+    help="Print the raw analysis document instead of the report",
+)
+def trace(target: str, as_json: bool):
+    """
+    Analyze a span trace: per-span latency percentiles, the request
+    per-stage breakdown with attribution coverage and the median
+    request's critical path, and the top self-time frames the sampling
+    profiler collected.
+
+    TARGET is a trace file (``serve_trace.jsonl`` / ``build_trace.jsonl``,
+    rotated generations are read automatically) or a directory holding
+    one — a serving telemetry dir or a build output dir. With both
+    traces present in a directory, each is analyzed in turn.
+    """
+    from ..telemetry import SERVE_TRACE_FILE
+    from ..telemetry.progress import BUILD_TRACE_FILE
+    from ..telemetry.trace_analysis import analyze_trace, render_analysis
+
+    if os.path.isdir(target):
+        paths = [
+            os.path.join(target, name)
+            for name in (SERVE_TRACE_FILE, BUILD_TRACE_FILE)
+            if os.path.exists(os.path.join(target, name))
+        ]
+        if not paths:
+            raise click.ClickException(
+                f"No {SERVE_TRACE_FILE} or {BUILD_TRACE_FILE} in {target} "
+                "(is GORDO_TPU_TELEMETRY_DIR pointed elsewhere, or "
+                "telemetry disabled?)"
+            )
+    elif os.path.exists(target):
+        paths = [target]
+    else:
+        raise click.ClickException(f"No such trace file or directory: {target}")
+
+    docs = [analyze_trace(path) for path in paths]
+    if as_json:
+        click.echo(
+            json.dumps(docs[0] if len(docs) == 1 else docs, indent=1)
+        )
+        return
+    for i, doc in enumerate(docs):
+        if i:
+            click.echo("")
+        click.echo(render_analysis(doc))
+
+
+@click.command("bench-check")
+@click.argument("candidate", type=click.Path(exists=True, dir_okay=False))
+@click.option(
+    "--baseline",
+    "baseline_path",
+    default=None,
+    type=click.Path(exists=True, dir_okay=False),
+    help="Baseline bench JSON (default: the committed BENCH_*.json for "
+    "the candidate's bench kind, looked up beside the candidate and "
+    "then in the current directory).",
+)
+@click.option(
+    "--tolerance",
+    "tolerance_scale",
+    default=1.0,
+    type=float,
+    help="Scale every gate tolerance by this factor (2.0 = twice as "
+    "lenient; noisy hosts).",
+)
+@click.option(
+    "--report-only",
+    is_flag=True,
+    help="Always exit 0: print the comparison, never gate (CI visibility "
+    "mode).",
+)
+@click.option(
+    "--as-json",
+    "as_json",
+    is_flag=True,
+    help="Print the raw comparison document instead of the report",
+)
+def bench_check(
+    candidate: str,
+    baseline_path: Optional[str],
+    tolerance_scale: float,
+    report_only: bool,
+    as_json: bool,
+):
+    """
+    The performance-regression gate: compare a fresh bench run
+    (CANDIDATE, a ``BENCH_*.json``-shaped document) against the
+    committed baseline for the same bench kind, metric by metric under
+    each metric's direction and tolerance, and exit non-zero on any
+    regression (unless --report-only).
+
+    Example: ``make bench-route BENCH_ROUTE_OUT=/tmp/fresh.json &&
+    gordo-tpu bench-check /tmp/fresh.json``.
+    """
+    from ..telemetry.benchgate import (
+        BASELINE_FILES,
+        compare_files,
+        render_report,
+    )
+
+    if baseline_path is None:
+        try:
+            with open(candidate) as handle:
+                bench = json.load(handle).get("bench")
+        except (OSError, ValueError) as exc:
+            raise click.ClickException(f"Unreadable candidate: {exc}")
+        default_name = BASELINE_FILES.get(str(bench))
+        if default_name is None:
+            raise click.ClickException(
+                f"No default baseline known for bench {bench!r}; "
+                "pass --baseline"
+            )
+        for directory in (
+            os.path.dirname(os.path.abspath(candidate)),
+            os.getcwd(),
+        ):
+            probe = os.path.join(directory, default_name)
+            if os.path.exists(probe) and os.path.abspath(
+                probe
+            ) != os.path.abspath(candidate):
+                baseline_path = probe
+                break
+        if baseline_path is None:
+            raise click.ClickException(
+                f"Committed baseline {default_name} not found beside the "
+                "candidate or in the current directory; pass --baseline"
+            )
+
+    try:
+        report = compare_files(
+            baseline_path, candidate, tolerance_scale=tolerance_scale
+        )
+    except (OSError, ValueError) as exc:
+        raise click.ClickException(str(exc))
+
+    if as_json:
+        click.echo(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        click.echo(render_report(report))
+    if not report["ok"] and not report_only:
+        raise SystemExit(1)
+
+
 @click.command("wait-for-models")
 @click.argument("models-dir", envvar="MODELS_DIR")
 @click.option(
@@ -1389,6 +1539,8 @@ gordo_tpu_cli.add_command(build)
 gordo_tpu_cli.add_command(build_fleet)
 gordo_tpu_cli.add_command(plan_fleet)
 gordo_tpu_cli.add_command(build_status)
+gordo_tpu_cli.add_command(trace)
+gordo_tpu_cli.add_command(bench_check)
 gordo_tpu_cli.add_command(run_server_cli)
 gordo_tpu_cli.add_command(wait_for_models)
 gordo_tpu_cli.add_command(score)

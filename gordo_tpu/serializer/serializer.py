@@ -22,6 +22,7 @@ from os import path
 from typing import Any, Optional
 
 from ..telemetry.progress import BUILD_STATUS_FILE, BUILD_TRACE_FILE
+from ..telemetry.serving import SERVE_TRACE_FILE
 from ..utils import json_compat as simplejson
 from ..utils.faults import fault_point
 
@@ -104,14 +105,20 @@ def is_staging_dir(name: str) -> bool:
 def is_builder_dropping(name: str) -> bool:
     """True for any non-model entry the fleet builder may leave in an
     artifact directory: the build journal, its event overlay, the
-    telemetry heartbeat/trace files, and atomic-write staging leftovers.
-    Revision cleanup treats a directory holding only these as empty;
-    model listings never surface them."""
+    telemetry heartbeat/trace files — including their size-rotated
+    generations (``build_trace.jsonl.1`` ...) and the serving-side
+    ``serve_trace.jsonl`` when ``GORDO_TPU_TELEMETRY_DIR`` points at
+    the artifact volume — and atomic-write staging leftovers. Revision
+    cleanup treats a directory holding only these as empty; model
+    listings never surface them."""
     return (
         name == BUILD_JOURNAL_FILE
         or name == BUILD_JOURNAL_EVENTS_FILE
         or name == BUILD_STATUS_FILE
         or name == BUILD_TRACE_FILE
+        or name == SERVE_TRACE_FILE
+        or name.startswith(BUILD_TRACE_FILE + ".")
+        or name.startswith(SERVE_TRACE_FILE + ".")
         or is_staging_dir(name)
     )
 

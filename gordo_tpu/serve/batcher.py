@@ -60,9 +60,20 @@ class BatcherStopped(BatchShedError):
 
 class BatchItem:
     """One enqueued request: the payload the runner scores, the future
-    the waiting request thread holds, and the admission bookkeeping."""
+    the waiting request thread holds, and the admission bookkeeping.
+    ``trace`` optionally carries the submitting request's W3C trace
+    context as ``(trace_id, span_id)`` so the fused batch span can link
+    back to the request spans it coalesced."""
 
-    __slots__ = ("name", "payload", "future", "enqueued_at", "deadline", "rows")
+    __slots__ = (
+        "name",
+        "payload",
+        "future",
+        "enqueued_at",
+        "deadline",
+        "rows",
+        "trace",
+    )
 
     def __init__(
         self,
@@ -70,6 +81,7 @@ class BatchItem:
         payload: Any,
         rows: int = 1,
         deadline: Optional[float] = None,
+        trace: Optional[tuple] = None,
     ):
         self.name = name
         self.payload = payload
@@ -77,6 +89,7 @@ class BatchItem:
         self.enqueued_at = time.monotonic()
         self.deadline = deadline
         self.rows = rows
+        self.trace = trace
 
 
 class MicroBatcher:

@@ -43,9 +43,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..models.spec import FeedForwardSpec
-from ..telemetry import NULL_RECORDER, SpanRecorder
-from ..telemetry import enabled as telemetry_enabled
-from ..telemetry.recorder import TRACE_DIR_ENV
+from ..telemetry.serving import SERVE_TRACE_FILE, serve_recorder
 from ..utils.env import env_float, env_int
 from . import ladder
 from .batcher import BatcherStopped, BatchItem, DeadlineExceeded, MicroBatcher
@@ -54,9 +52,9 @@ logger = logging.getLogger(__name__)
 
 BATCHING_ENV = "GORDO_TPU_BATCHING"
 
-#: the JSONL the engine's batch spans append to (build_trace-style),
-#: under ``GORDO_TPU_TELEMETRY_DIR`` when telemetry is enabled
-SERVE_TRACE_FILE = "serve_trace.jsonl"
+# SERVE_TRACE_FILE is re-exported for callers that predate the shared
+# serving recorder; telemetry/serving.py owns the name and sink now.
+assert SERVE_TRACE_FILE  # imported for re-export
 
 
 def batching_enabled() -> bool:
@@ -125,7 +123,6 @@ class ServeEngine:
         #: late-bound so build_app can attach it after creation
         self.metrics = metrics
         self.member_ladder = ladder.member_ladder(self.config.max_size)
-        self._recorder = self._build_recorder()
         self._lock = threading.Lock()
         self._programs: set = set()
         self._counters: Dict[str, int] = {
@@ -150,15 +147,15 @@ class ServeEngine:
             on_depth=self._on_depth,
         )
 
-    def _build_recorder(self):
-        trace_dir = os.getenv(TRACE_DIR_ENV)
-        if telemetry_enabled() and trace_dir:
-            os.makedirs(trace_dir, exist_ok=True)
-            return SpanRecorder(
-                sink_path=os.path.join(trace_dir, SERVE_TRACE_FILE),
-                service="gordo-tpu-serve",
-            )
-        return NULL_RECORDER
+    @property
+    def _recorder(self):
+        # the process-shared serving recorder (telemetry/serving.py) —
+        # the same sink the server's request-span export writes to, so
+        # batch spans and the request spans they link to land in ONE
+        # serve_trace.jsonl; resolved per use, so telemetry env changes
+        # (tests, late configuration) take effect without an engine
+        # rebuild
+        return serve_recorder()
 
     # -- request path -------------------------------------------------------
 
@@ -222,7 +219,20 @@ class ServeEngine:
             payload[:rows] = transformed
 
         deadline = time.monotonic() + self.config.deadline_s
-        item = BatchItem(name, payload, rows=rows, deadline=deadline)
+        # carry the request's trace identity into the queue ONLY when
+        # the serving trace is on: with telemetry off nothing span- or
+        # link-shaped is constructed anywhere on this path
+        trace = None
+        if (
+            self._recorder.enabled
+            and timing is not None
+            and getattr(timing, "trace_id", None)
+            and getattr(timing, "sampled", True)
+        ):
+            # only sampled requests' spans exist in the trace — linking
+            # an unexported request span would dangle
+            trace = (timing.trace_id, getattr(timing, "default_parent_id", None))
+        item = BatchItem(name, payload, rows=rows, deadline=deadline, trace=trace)
         try:
             future = self._batcher.submit((fleet, spec, padded_rows), item)
         except BatcherStopped:
@@ -338,6 +348,20 @@ class ServeEngine:
                 padding_waste=round(waste, 4),
                 queue_wait_max_ms=round(max(queue_waits) * 1000.0, 3),
             )
+            # link back to every request span this batch coalesced, with
+            # the per-request queue wait — the causal edge that makes a
+            # batch span attributable request by request in the trace
+            for item in live:
+                if item.trace is not None:
+                    trace_id, span_id = item.trace
+                    batch_span.link(
+                        trace_id,
+                        span_id or "",
+                        name=item.name,
+                        queue_wait_ms=round(
+                            (flush_start - item.enqueued_at) * 1000.0, 3
+                        ),
+                    )
         if self.metrics is not None:
             try:
                 self.metrics.observe_batch(
@@ -437,9 +461,10 @@ class ServeEngine:
 
     def shutdown(self, drain: bool = True) -> None:
         """Stop the dispatcher(s); with ``drain`` everything already
-        queued still scores before the threads exit."""
+        queued still scores before the threads exit. The trace recorder
+        is process-shared (the server's request export writes there
+        too), so the engine does not close it."""
         self._batcher.shutdown(drain=drain)
-        self._recorder.close()
 
     # -- internal hooks -----------------------------------------------------
 

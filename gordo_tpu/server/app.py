@@ -16,8 +16,10 @@ simplejson with ``ignore_nan`` so NaN heads of smoothed anomaly columns
 serialize as null.
 """
 
+import contextlib
 import logging
 import os
+import time
 import timeit
 import typing
 from functools import wraps
@@ -31,7 +33,9 @@ from werkzeug.wrappers import Request, Response
 
 import gordo_tpu
 
-from ..telemetry import SpanRecorder
+from ..telemetry import SpanRecorder, tracing
+from ..telemetry import serving as serve_trace
+from ..telemetry.profiler import SamplingProfiler, should_profile
 from . import utils as server_utils
 from .utils import ServerError
 from .views import anomaly, base
@@ -58,13 +62,30 @@ class RequestContext:
     Per-request state: the request, resolved revision/collection dir, and
     whatever the handlers load (model, metadata, X, y). The explicit
     equivalent of the reference's ``flask.g``.
+
+    Every request owns a W3C trace identity: ``trace_id`` continues an
+    incoming ``traceparent`` header (so a gateway's trace flows through
+    the model server) or starts fresh; ``span_id`` names the request's
+    root span. The per-request ``timing`` recorder adopts that identity,
+    so the stage spans it collects nest under the request span — and at
+    finalization the whole set exports into the process-shared
+    ``serve_trace.jsonl`` (telemetry/serving.py).
     """
 
     __slots__ = (
         "request",
         "config",
         "start_time",
+        "start_wall",
         "timing",
+        "trace_id",
+        "span_id",
+        "remote_parent_id",
+        "sampled",
+        "current_stage",
+        "profiler",
+        "endpoint",
+        "gordo_name",
         "collection_dir",
         "current_revision",
         "revision",
@@ -79,11 +100,36 @@ class RequestContext:
         self.request = request
         self.config = config
         self.start_time = timeit.default_timer()
+        self.start_wall = time.time()
+        incoming = tracing.parse_traceparent(
+            request.headers.get(tracing.TRACEPARENT_HEADER)
+        )
+        if incoming is not None:
+            self.trace_id = incoming.trace_id
+            self.remote_parent_id = incoming.span_id
+            # export sampling: the upstream decision is honored; locally
+            # originated traces decide in _dispatch_bound (None =
+            # undecided)
+            self.sampled: Optional[bool] = incoming.sampled
+            self.span_id = tracing.new_span_id()
+        else:
+            fresh = tracing.new_trace_context()
+            self.trace_id = fresh.trace_id
+            self.span_id = fresh.span_id
+            self.remote_parent_id = None
+            self.sampled = None
         # Per-request span recorder (telemetry/recorder.py, in-memory
         # only): handlers wrap their stages in ``ctx.stage(...)`` and
         # _finalize turns the recorded durations into Server-Timing
         # entries, so every response carries its own stage breakdown.
-        self.timing = SpanRecorder(service="gordo-tpu-server")
+        self.timing = SpanRecorder(
+            service="gordo-tpu-server", trace_id=self.trace_id
+        )
+        self.timing.default_parent_id = self.span_id
+        self.current_stage: Optional[str] = None
+        self.profiler: Optional[SamplingProfiler] = None
+        self.endpoint: Optional[str] = None
+        self.gordo_name: Optional[str] = None
         self.collection_dir: Optional[str] = None
         self.current_revision: Optional[str] = None
         self.revision: Optional[str] = None
@@ -93,10 +139,20 @@ class RequestContext:
         self.X = None
         self.y = None
 
+    @contextlib.contextmanager
     def stage(self, name: str):
         """Span over one request stage (``model_resolve``, ``data_decode``,
-        ``inference``, ``serialize``); surfaces in Server-Timing."""
-        return self.timing.span(name)
+        ``inference``, ``response_assemble``, ``serialize``); surfaces in
+        Server-Timing, the exported request trace, and — while a sampling
+        profiler is attached — as the stage axis of its self-time
+        aggregation (``current_stage`` is read from the sampling thread)."""
+        previous = self.current_stage
+        self.current_stage = name
+        try:
+            with self.timing.span(name) as handle:
+                yield handle
+        finally:
+            self.current_stage = previous
 
     # -- response builders --------------------------------------------------
 
@@ -295,30 +351,83 @@ class GordoServerApp:
             ctx.revision = ctx.current_revision
         return None
 
+    #: endpoints whose request traces would only add noise and volume
+    #: (load balancers hit /healthcheck every few seconds)
+    UNTRACED_ENDPOINTS = (None, "healthcheck", "server-version")
+
     def _finalize(self, ctx: RequestContext, response: Response) -> Response:
-        """Stamp the revision header and add Server-Timing — one entry
-        per recorded request stage (milliseconds, per the Server-Timing
-        spec) plus the reference-parity ``request_walltime_s`` total
-        (seconds, kept last under its original name/unit for existing
-        dashboards)."""
+        """Stamp the revision + ``traceparent`` headers, add
+        Server-Timing — one entry per recorded request stage
+        (milliseconds, per the Server-Timing spec) plus the
+        reference-parity ``request_walltime_s`` total (seconds, kept
+        last under its original name/unit for existing dashboards) —
+        then export the finished request into the shared serving trace
+        and hand the stage durations to the Prometheus observer."""
         if ctx.revision is not None:
             response.headers["revision"] = ctx.revision
+        response.headers[tracing.TRACEPARENT_HEADER] = tracing.format_traceparent(
+            ctx.trace_id, ctx.span_id, sampled=bool(ctx.sampled)
+        )
 
         runtime_s = timeit.default_timer() - ctx.start_time
         logger.debug("Total runtime for request: %ss", runtime_s)
+        durations = ctx.timing.durations()
         entries = [
             f"{name};dur={round(seconds * 1000.0, 2)}"
-            for name, seconds in ctx.timing.durations().items()
+            for name, seconds in durations.items()
         ]
         entries.append(f"request_walltime_s;dur={runtime_s}")
         response.headers["Server-Timing"] = ", ".join(entries)
+
+        # RED attribution for wsgi_app's Prometheus observer: the stage
+        # breakdown and route identity ride the response object (the
+        # observer sees only (request, response, duration)).
+        response.gordo_stage_durations = durations
+        response.gordo_endpoint = ctx.endpoint
+        response.gordo_model_name = ctx.gordo_name
+
+        profile_report = None
+        if ctx.profiler is not None:
+            profile_report = ctx.profiler.stop()
+            ctx.profiler = None
+        if ctx.sampled and ctx.endpoint not in self.UNTRACED_ENDPOINTS:
+            serve_trace.export_request_trace(
+                ctx.timing,
+                span_id=ctx.span_id,
+                parent_id=ctx.remote_parent_id,
+                start=ctx.start_wall,
+                duration_s=runtime_s,
+                attributes={
+                    "http.method": ctx.request.method,
+                    "http.route": ctx.endpoint,
+                    "http.status_code": response.status_code,
+                    "gordo_name": ctx.gordo_name or "",
+                    "revision": ctx.revision or "",
+                },
+                error=(
+                    f"HTTP {response.status_code}"
+                    if response.status_code >= 500
+                    else None
+                ),
+                profile=profile_report,
+            )
         return response
 
     def dispatch(self, request: Request) -> Response:
         ctx = RequestContext(request, self.config)
+        token = tracing.bind(ctx.trace_id)
+        try:
+            return self._dispatch_bound(ctx, request)
+        finally:
+            tracing.unbind(token)
+
+    def _dispatch_bound(self, ctx: RequestContext, request: Request) -> Response:
+        profile_arg = request.args.get("profile")
         try:
             endpoint_adapter = URL_MAP.bind_to_environ(request.environ)
             endpoint, view_args = endpoint_adapter.match()
+            ctx.endpoint = endpoint
+            ctx.gordo_name = view_args.get("gordo_name")
 
             if endpoint == "healthcheck":
                 if self.draining:
@@ -330,11 +439,43 @@ class GordoServerApp:
                 response = ctx.json_response({"version": gordo_tpu.__version__})
                 return self._finalize(ctx, response)
 
+            # trace-export sampling: with the serving sink on, honor an
+            # upstream traceparent decision, else head-sample locally
+            # (GORDO_TPU_TRACE_SAMPLE_RATE) — every request still gets a
+            # trace id; sampling gates only span export
+            if serve_trace.serve_recorder().enabled:
+                if ctx.sampled is None:
+                    ctx.sampled = serve_trace.sample_trace()
+                # host-pipeline sampling profiler: per-request
+                # (?profile=1) or a random slice
+                # (GORDO_TPU_PROFILE_SAMPLE_RATE); a profiled request is
+                # always exported — the report's destination is a
+                # `profile` span in serve_trace.jsonl
+                if should_profile(profile_arg):
+                    ctx.sampled = True
+                    ctx.profiler = SamplingProfiler().start(
+                        stage_getter=lambda: ctx.current_stage
+                    )
+            else:
+                ctx.sampled = False
+            # the engine reads this to decide whether batch spans should
+            # link back to this request's (exported) spans
+            ctx.timing.sampled = ctx.sampled
+
             error_response = self._resolve_revision(ctx)
             if error_response is not None:
                 return self._finalize(ctx, error_response)
 
-            response = HANDLERS[endpoint](ctx, **view_args)
+            if profile_arg == "device":
+                # the heavyweight opt-in layer: a TensorBoard-loadable
+                # XLA device trace for this one request (no-op unless
+                # GORDO_TPU_PROFILE_DIR is set)
+                from ..utils.profiling import maybe_trace
+
+                with maybe_trace(f"request-{ctx.trace_id[:16]}"):
+                    response = HANDLERS[endpoint](ctx, **view_args)
+            else:
+                response = HANDLERS[endpoint](ctx, **view_args)
         except ServerError as exc:
             response = ctx.json_response(exc.payload, status=exc.status)
         except HTTPException as exc:
@@ -375,6 +516,8 @@ def build_app(
     """
     app = GordoServerApp(config)
     app._wsgi_entry = adapt_proxy_deployment(app.wsgi_app)
+    # every in-request log record carries its trace_id from here on
+    tracing.install_trace_log_stamping()
 
     if app.config["ENABLE_PROMETHEUS"]:
         from .prometheus.metrics import create_prometheus_metrics
@@ -434,6 +577,9 @@ def drain_and_stop(app: GordoServerApp, server=None, engine=None) -> None:
     if engine is not None:
         logger.info("draining micro-batcher before shutdown")
         engine.shutdown(drain=True)
+    # the serving trace is write-buffered; the drained batches' spans
+    # and the final requests' traces must reach disk before exit
+    serve_trace.serve_recorder().flush()
     if server is not None:
         server.shutdown()
 

@@ -34,6 +34,14 @@ DEFAULT_IGNORE_PATHS = ("/healthcheck",)
 
 PROJECT_LEVEL_ROUTES = ("models", "revisions", "expected-models", "build-status")
 
+#: request-stage latency buckets: stages span sub-millisecond metadata
+#: lookups to second-scale inference+serialize on fat payloads — the
+#: default request buckets start at 5ms and would flatten the fast half
+_STAGE_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
 
 def _ensure_multiproc_dir() -> Optional[str]:
     """
@@ -68,7 +76,17 @@ def multiprocess_registry() -> Optional[CollectorRegistry]:
 
 
 class GordoServerPrometheusMetrics:
-    """Request count + latency histogram keyed by route/model/status."""
+    """The serving RED metric set, keyed by route/model/status:
+
+    - **Rate** — ``gordo_server_requests_total`` (as before);
+    - **Errors** — ``gordo_server_request_errors_total``, the explicit
+      error counter (4xx = ``kind="client"``, 5xx = ``kind="server"``)
+      so an error-rate panel is one PromQL ratio, no status-code regex;
+    - **Duration** — the full-route latency histogram plus
+      ``gordo_server_stage_duration_seconds{endpoint,stage}``: the same
+      per-stage breakdown Server-Timing carries per response, as
+      aggregable histograms — where the route's time goes, fleet-wide.
+    """
 
     def __init__(
         self,
@@ -94,6 +112,27 @@ class GordoServerPrometheusMetrics:
             labelnames=label_names,
             registry=self.registry,
         )
+        self.error_count = Counter(
+            "gordo_server_request_errors_total",
+            "Requests answered with an error status (kind=client for "
+            "4xx — including 429/504 batching backpressure — and "
+            "kind=server for 5xx)",
+            labelnames=label_names + ["kind"],
+            registry=self.registry,
+        )
+        # stage labels are bounded: endpoint is the route map's endpoint
+        # name, stage the handler-instrumented pipeline stage set
+        # (model_resolve/data_decode/inference/response_assemble/
+        # serialize + the micro-batcher's queue_wait/batch_* intervals)
+        self.stage_duration = Histogram(
+            "gordo_server_stage_duration_seconds",
+            "Per-request pipeline-stage wall-time (one observation per "
+            "stage per request — the aggregable form of the "
+            "Server-Timing response header)",
+            labelnames=["project", "endpoint", "stage"],
+            buckets=_STAGE_BUCKETS,
+            registry=self.registry,
+        )
         self.info = Gauge(
             "gordo_server_info",
             "Server build information",
@@ -104,8 +143,29 @@ class GordoServerPrometheusMetrics:
         self.info.labels(
             version=gordo_tpu.__version__, project=project or ""
         ).set(1)
+        # label-child caches: prometheus_client's .labels() rebuilds a
+        # key tuple and takes the metric lock per call (~10us); on the
+        # request hot path that is paid 2-7 times per request. Children
+        # are stable objects — cache them per label tuple (bounded by
+        # the same cardinality guards as the metrics themselves).
+        self._request_children: dict = {}
+        self._stage_children: dict = {}
+        #: raw (method, path, status) -> computed labels dict; the two
+        #: regex passes in _labels_uncached are ~6us per request and
+        #: the distinct raw paths are bounded by models x routes
+        self._labels_cache: dict = {}
 
     def _labels(self, request, response) -> Optional[dict]:
+        key = (request.method, request.path, response.status_code)
+        try:
+            return self._labels_cache[key]
+        except KeyError:
+            labels = self._labels_uncached(request, response)
+            if len(self._labels_cache) < 4096:
+                self._labels_cache[key] = labels
+            return labels
+
+    def _labels_uncached(self, request, response) -> Optional[dict]:
         path = request.path
         if path in self.ignore_paths:
             return None
@@ -139,8 +199,44 @@ class GordoServerPrometheusMetrics:
         labels = self._labels(request, response)
         if labels is None:
             return
-        self.request_count.labels(**labels).inc()
-        self.request_duration.labels(**labels).observe(duration_s)
+        key = (
+            labels["method"],
+            labels["path"],
+            labels["status_code"],
+            labels["gordo_name"],
+            labels["project"],
+        )
+        children = self._request_children.get(key)
+        if children is None:
+            children = self._request_children[key] = (
+                self.request_count.labels(**labels),
+                self.request_duration.labels(**labels),
+            )
+        count_child, duration_child = children
+        count_child.inc()
+        duration_child.observe(duration_s)
+        status = response.status_code
+        if status >= 400:
+            self.error_count.labels(
+                **labels, kind="server" if status >= 500 else "client"
+            ).inc()
+        # per-stage durations ride the response object (_finalize stashes
+        # them — the WSGI observer never sees the request context)
+        stages = getattr(response, "gordo_stage_durations", None)
+        if stages:
+            endpoint = getattr(response, "gordo_endpoint", None) or "{unmatched}"
+            for stage, seconds in stages.items():
+                stage_key = (endpoint, stage)
+                child = self._stage_children.get(stage_key)
+                if child is None:
+                    child = self._stage_children[stage_key] = (
+                        self.stage_duration.labels(
+                            project=labels["project"],
+                            endpoint=endpoint,
+                            stage=stage,
+                        )
+                    )
+                child.observe(seconds)
 
 
 def create_prometheus_metrics(

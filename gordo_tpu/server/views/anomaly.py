@@ -70,18 +70,25 @@ def post_anomaly_prediction(ctx, gordo_project: str, gordo_name: str):
         logger.error("Failed to compute anomalies: %s", err)
         return ctx.json_response({"error": f"ValueError: {err}"}, status=400)
 
-    if ctx.request.args.get("all_columns") is None:
-        columns_for_delete = [
-            column
-            for column in anomaly_df
-            if column[0] in DELETED_FROM_RESPONSE_COLUMNS
-        ]
-        anomaly_df = anomaly_df.drop(columns=columns_for_delete)
+    # same response_assemble stage as the base route: column filtering +
+    # frame→wire-dict conversion is host-pipeline time the per-stage
+    # attribution must cover
+    with ctx.stage("response_assemble"):
+        if ctx.request.args.get("all_columns") is None:
+            columns_for_delete = [
+                column
+                for column in anomaly_df
+                if column[0] in DELETED_FROM_RESPONSE_COLUMNS
+            ]
+            anomaly_df = anomaly_df.drop(columns=columns_for_delete)
 
-    if ctx.request.args.get("format") == "parquet":
-        return ctx.file_response(server_utils.dataframe_into_parquet_bytes(anomaly_df))
-
-    context: Dict[Any, Any] = dict()
-    context["data"] = server_utils.dataframe_to_dict(anomaly_df)
+        if ctx.request.args.get("format") == "parquet":
+            payload = server_utils.dataframe_into_parquet_bytes(anomaly_df)
+        else:
+            payload = None
+            context: Dict[Any, Any] = dict()
+            context["data"] = server_utils.dataframe_to_dict(anomaly_df)
+    if payload is not None:
+        return ctx.file_response(payload)
     context["time-seconds"] = f"{timeit.default_timer() - start_time:.4f}"
     return ctx.json_response(context)

@@ -76,16 +76,25 @@ def post_prediction(ctx, gordo_project: str, gordo_name: str):
         "Calculating model output took %.4fs",
         timeit.default_timer() - process_request_start_time_s,
     )
-    data = model_utils.make_base_dataframe(
-        tags=get_tags(ctx),
-        model_input=X.values if isinstance(X, pd.DataFrame) else X,
-        model_output=output,
-        target_tag_list=get_target_tags(ctx),
-        index=X.index,
-    )
-    if ctx.request.args.get("format") == "parquet":
-        return ctx.file_response(server_utils.dataframe_into_parquet_bytes(data))
-    context["data"] = server_utils.dataframe_to_dict(data)
+    # response_assemble is its own stage (distinct from `serialize`, the
+    # JSON encode): frame construction + wire-dict conversion is a big
+    # slice of full-route walltime, and the per-stage attribution the
+    # trace/bench surfaces report must cover it to explain the route
+    with ctx.stage("response_assemble"):
+        data = model_utils.make_base_dataframe(
+            tags=get_tags(ctx),
+            model_input=X.values if isinstance(X, pd.DataFrame) else X,
+            model_output=output,
+            target_tag_list=get_target_tags(ctx),
+            index=X.index,
+        )
+        if ctx.request.args.get("format") == "parquet":
+            payload = server_utils.dataframe_into_parquet_bytes(data)
+        else:
+            payload = None
+            context["data"] = server_utils.dataframe_to_dict(data)
+    if payload is not None:
+        return ctx.file_response(payload)
     return ctx.json_response(context)
 
 
@@ -215,56 +224,61 @@ def post_fleet_prediction(ctx, gordo_project: str):
             return keys
 
         fleet = STORE.fleet(ctx.collection_dir) if full else None
-        for name, (reconstruction, mse) in scores.items():
-            index = frames[name].index
-            recon = np.asarray(reconstruction)
-            if len(recon) > len(index):
-                # more output rows than input rows can only be a broken
-                # model/transformer; zip would silently misalign
-                errors[name] = {
-                    "error": "Scoring failed (output longer than input)",
-                    "status": 500,
-                }
-                continue
-            if full:
-                try:
-                    entry, error = _full_anomaly_entry(
-                        fleet,
-                        name,
-                        frames[name],
-                        y_frames.get(name, frames[name]),
-                        metadatas[name],
-                        recon,
-                        keep_smooth,
-                    )
-                except Exception:  # noqa: BLE001 - per-machine isolation:
-                    # custom detectors run arbitrary code; one broken
-                    # machine must never 500 the batch (route contract)
-                    logger.exception("full anomaly assembly failed for %s", name)
-                    entry, error = None, {
-                        "error": "Anomaly assembly failed",
+        # per-machine wire assembly is the fleet route's host-pipeline
+        # tail — staged like the single-model routes' response_assemble
+        with ctx.stage("response_assemble"):
+            for name, (reconstruction, mse) in scores.items():
+                index = frames[name].index
+                recon = np.asarray(reconstruction)
+                if len(recon) > len(index):
+                    # more output rows than input rows can only be a broken
+                    # model/transformer; zip would silently misalign
+                    errors[name] = {
+                        "error": "Scoring failed (output longer than input)",
                         "status": 500,
                     }
-                if error is not None:
-                    errors[name] = error
                     continue
-                if entry is not None:
-                    data[name] = entry
-                    continue
-                # not an anomaly detector: lean entry below
-            keys = index_keys(index[len(index) - len(recon):])
-            # direct dict assembly — same wire shape as
-            # dataframe_to_dict(DataFrame(reconstruction)) with stringified
-            # columns, without re-building frames per machine
-            data[name] = {
-                "model-output": {
-                    str(col): dict(zip(keys, recon[:, col].tolist()))
-                    for col in range(recon.shape[1])
-                },
-                "total-anomaly-unscaled": dict(
-                    zip(keys, np.asarray(mse).tolist())
-                ),
-            }
+                if full:
+                    try:
+                        entry, error = _full_anomaly_entry(
+                            fleet,
+                            name,
+                            frames[name],
+                            y_frames.get(name, frames[name]),
+                            metadatas[name],
+                            recon,
+                            keep_smooth,
+                        )
+                    except Exception:  # noqa: BLE001 - per-machine isolation:
+                        # custom detectors run arbitrary code; one broken
+                        # machine must never 500 the batch (route contract)
+                        logger.exception(
+                            "full anomaly assembly failed for %s", name
+                        )
+                        entry, error = None, {
+                            "error": "Anomaly assembly failed",
+                            "status": 500,
+                        }
+                    if error is not None:
+                        errors[name] = error
+                        continue
+                    if entry is not None:
+                        data[name] = entry
+                        continue
+                    # not an anomaly detector: lean entry below
+                keys = index_keys(index[len(index) - len(recon):])
+                # direct dict assembly — same wire shape as
+                # dataframe_to_dict(DataFrame(reconstruction)) with
+                # stringified columns, without re-building frames per machine
+                data[name] = {
+                    "model-output": {
+                        str(col): dict(zip(keys, recon[:, col].tolist()))
+                        for col in range(recon.shape[1])
+                    },
+                    "total-anomaly-unscaled": dict(
+                        zip(keys, np.asarray(mse).tolist())
+                    ),
+                }
 
     context: Dict[str, Any] = {"data": data}
     if errors:

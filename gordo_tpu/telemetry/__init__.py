@@ -15,6 +15,8 @@ from .progress import (
     render_status,
 )
 from .recorder import (
+    KEEP_ENV,
+    MAX_BYTES_ENV,
     NULL_RECORDER,
     TELEMETRY_ENV,
     TRACE_DIR_ENV,
@@ -27,22 +29,56 @@ from .recorder import (
     reset_seen_programs,
     seen_program,
 )
+from .serving import (
+    SERVE_TRACE_FILE,
+    export_request_trace,
+    reset_serve_recorder,
+    serve_recorder,
+    serve_trace_path,
+)
+from .tracing import (
+    TRACEPARENT_HEADER,
+    TraceContext,
+    bind_trace,
+    current_trace_id,
+    format_traceparent,
+    install_trace_log_stamping,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
 
 __all__ = [
     "BuildProgress",
     "HEARTBEAT_ENV",
+    "KEEP_ENV",
+    "MAX_BYTES_ENV",
     "NULL_RECORDER",
     "NullRecorder",
+    "SERVE_TRACE_FILE",
     "SpanRecorder",
     "TELEMETRY_ENV",
+    "TRACEPARENT_HEADER",
     "TRACE_DIR_ENV",
+    "TraceContext",
     "activate",
+    "bind_trace",
+    "current_trace_id",
     "enabled",
     "eta_seconds",
+    "export_request_trace",
+    "format_traceparent",
     "get_recorder",
+    "install_trace_log_stamping",
     "load_status",
+    "new_span_id",
+    "new_trace_id",
+    "parse_traceparent",
     "program_span",
     "render_status",
     "reset_seen_programs",
+    "reset_serve_recorder",
     "seen_program",
+    "serve_recorder",
+    "serve_trace_path",
 ]

@@ -1,0 +1,271 @@
+"""
+The performance-regression gate: ``gordo-tpu bench-check``.
+
+The repo's committed ``BENCH_*.json`` files are its performance
+trajectory — every PR that touched a hot path re-ran a bench and
+committed the result. Until now nothing *compared* them: a serving
+regression had to be noticed by a human reading JSON diffs. This module
+makes the comparison executable: each known bench kind declares which
+of its numbers are load-bearing (direction + relative tolerance, or an
+absolute budget), :func:`compare` evaluates a fresh candidate run
+against the committed baseline, and the CLI exits non-zero on any
+regression — the gate the ROADMAP's full-route optimization work needs
+before it can claim wins (and keep them).
+
+Tolerances are deliberately loose by default (shared CI hosts show
+multi-x wall-clock noise; the benches fight it with interleaved
+quiet-window floors, but a gate that cries wolf gets deleted) and scale
+with ``--tolerance``. CI runs the gate in ``--report-only`` mode —
+visibility without flakiness — while release branches can enforce.
+"""
+
+import json
+from typing import Any, Dict, List, NamedTuple, Optional
+
+
+class MetricSpec(NamedTuple):
+    """One gated number inside a bench document.
+
+    ``kind``: ``higher`` / ``lower`` (relative to baseline, within
+    ``tolerance``), ``max_bound`` (candidate must stay ≤ ``bound``,
+    baseline-independent), or ``truthy`` (candidate must be true).
+    ``path`` is dotted (``scoring.batching_on.throughput_rps``).
+    """
+
+    label: str
+    path: str
+    kind: str
+    tolerance: float = 0.0
+    bound: Optional[float] = None
+
+
+#: the load-bearing numbers per bench kind, keyed by the document's
+#: ``bench`` field — adding a bench to the trajectory means adding its
+#: gate row here (the golden-schema tests pin the paths)
+GATES: Dict[str, List[MetricSpec]] = {
+    "route-observability": [
+        MetricSpec(
+            "full-route throughput (floor rps)",
+            "route.throughput_rps",
+            "higher",
+            0.25,
+        ),
+        MetricSpec("full-route p50 latency", "route.p50_ms", "lower", 0.25),
+        MetricSpec(
+            "stage attribution coverage",
+            "route.attribution_coverage",
+            "higher",
+            0.05,
+        ),
+        MetricSpec(
+            "telemetry overhead on scoring floor (%)",
+            "scoring_overhead.overhead_pct",
+            "max_bound",
+            bound=2.0,
+        ),
+    ],
+    "serve-micro-batching": [
+        MetricSpec(
+            "batched scoring throughput (floor rps)",
+            "scoring.batching_on.throughput_rps",
+            "higher",
+            0.25,
+        ),
+        MetricSpec(
+            "unbatched scoring throughput (floor rps)",
+            "scoring.batching_off.throughput_rps",
+            "higher",
+            0.25,
+        ),
+        MetricSpec("batching gain", "throughput_gain", "higher", 0.2),
+        MetricSpec("program-cache bounded", "programs_bounded", "truthy"),
+    ],
+    "telemetry-overhead": [
+        MetricSpec(
+            "build telemetry overhead (%)",
+            "overhead_pct",
+            "max_bound",
+            bound=3.0,
+        ),
+    ],
+    "planner-strategies": [
+        MetricSpec("packed beats naive", "packed_wins", "truthy"),
+    ],
+    "lifecycle-hot-swap": [
+        MetricSpec("hot-swap p50 (ms)", "swap_p50_ms", "lower", 0.5),
+        MetricSpec(
+            "dropped requests during swaps",
+            "requests_dropped",
+            "max_bound",
+            bound=0.0,
+        ),
+    ],
+}
+
+#: where each bench kind's committed baseline lives (repo root)
+BASELINE_FILES: Dict[str, str] = {
+    "route-observability": "BENCH_ROUTE.json",
+    "serve-micro-batching": "BENCH_SERVE.json",
+    "telemetry-overhead": "BENCH_TELEMETRY.json",
+    "planner-strategies": "BENCH_PLAN.json",
+    "lifecycle-hot-swap": "BENCH_LIFECYCLE.json",
+}
+
+
+def get_path(doc: Any, path: str) -> Any:
+    """Walk a dotted path through nested dicts; None when absent."""
+    node = doc
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def _evaluate(
+    spec: MetricSpec,
+    baseline: Optional[float],
+    candidate: Any,
+    tolerance_scale: float,
+) -> Dict[str, Any]:
+    result: Dict[str, Any] = {
+        "metric": spec.label,
+        "path": spec.path,
+        "kind": spec.kind,
+        "baseline": baseline,
+        "candidate": candidate,
+        "status": "ok",
+    }
+    if candidate is None:
+        result["status"] = "regression"
+        result["detail"] = "metric missing from candidate run"
+        return result
+    if spec.kind == "truthy":
+        if not candidate:
+            result["status"] = "regression"
+            result["detail"] = "expected truthy"
+        return result
+    if spec.kind == "max_bound":
+        # --tolerance scales budgets too ("2.0 = twice as lenient"
+        # must mean every gate, or the loosening a noisy host needs
+        # is vetoed by whichever metric is noisiest)
+        bound = float(spec.bound) * tolerance_scale
+        result["bound"] = round(bound, 6)
+        if float(candidate) > bound:
+            result["status"] = "regression"
+            result["detail"] = f"exceeds budget {bound:g}"
+        return result
+    if baseline is None:
+        # a schema-evolving candidate gains metrics the old baseline
+        # lacks: report, don't fail — the next committed baseline picks
+        # it up
+        result["status"] = "skipped"
+        result["detail"] = "metric missing from baseline"
+        return result
+    baseline_f, candidate_f = float(baseline), float(candidate)
+    tolerance = spec.tolerance * tolerance_scale
+    result["tolerance"] = round(tolerance, 4)
+    if baseline_f != 0:
+        result["ratio"] = round(candidate_f / baseline_f, 4)
+    if spec.kind == "higher":
+        limit = baseline_f * (1.0 - tolerance)
+        if candidate_f < limit:
+            result["status"] = "regression"
+            result["detail"] = (
+                f"below baseline {baseline_f:g} by more than "
+                f"{tolerance * 100:.0f}%"
+            )
+    elif spec.kind == "lower":
+        limit = baseline_f * (1.0 + tolerance)
+        if candidate_f > limit:
+            result["status"] = "regression"
+            result["detail"] = (
+                f"above baseline {baseline_f:g} by more than "
+                f"{tolerance * 100:.0f}%"
+            )
+    return result
+
+
+def compare(
+    baseline_doc: Dict[str, Any],
+    candidate_doc: Dict[str, Any],
+    specs: Optional[List[MetricSpec]] = None,
+    tolerance_scale: float = 1.0,
+) -> Dict[str, Any]:
+    """Evaluate ``candidate_doc`` against ``baseline_doc`` under the
+    bench kind's gate specs. The two documents must describe the same
+    bench (``bench`` field) unless explicit ``specs`` are supplied."""
+    bench = candidate_doc.get("bench")
+    if specs is None:
+        if baseline_doc.get("bench") != bench:
+            raise ValueError(
+                f"bench mismatch: baseline is "
+                f"{baseline_doc.get('bench')!r}, candidate {bench!r}"
+            )
+        specs = GATES.get(str(bench))
+        if specs is None:
+            raise ValueError(
+                f"no gate specs for bench {bench!r} "
+                f"(known: {sorted(GATES)})"
+            )
+    results = [
+        _evaluate(
+            spec,
+            get_path(baseline_doc, spec.path),
+            get_path(candidate_doc, spec.path),
+            tolerance_scale,
+        )
+        for spec in specs
+    ]
+    regressions = sum(1 for r in results if r["status"] == "regression")
+    return {
+        "bench": bench,
+        "tolerance_scale": tolerance_scale,
+        "results": results,
+        "regressions": regressions,
+        "ok": regressions == 0,
+    }
+
+
+def compare_files(
+    baseline_path: str,
+    candidate_path: str,
+    tolerance_scale: float = 1.0,
+) -> Dict[str, Any]:
+    with open(baseline_path) as handle:
+        baseline_doc = json.load(handle)
+    with open(candidate_path) as handle:
+        candidate_doc = json.load(handle)
+    report = compare(
+        baseline_doc, candidate_doc, tolerance_scale=tolerance_scale
+    )
+    report["baseline"] = baseline_path
+    report["candidate"] = candidate_path
+    return report
+
+
+def render_report(report: Dict[str, Any]) -> str:
+    """Human-readable gate report."""
+    lines = [
+        f"bench-check: {report['bench']}  "
+        f"(baseline {report.get('baseline', '?')} vs "
+        f"candidate {report.get('candidate', '?')})"
+    ]
+    for result in report["results"]:
+        mark = {"ok": "PASS", "regression": "FAIL", "skipped": "SKIP"}[
+            result["status"]
+        ]
+        value = result["candidate"]
+        baseline = result["baseline"]
+        detail = result.get("detail", "")
+        extra = f"  [{detail}]" if detail else ""
+        lines.append(
+            f"  {mark}  {result['metric']}: {value!r}"
+            + (f" (baseline {baseline!r})" if baseline is not None else "")
+            + extra
+        )
+    verdict = "OK" if report["ok"] else (
+        f"{report['regressions']} regression(s)"
+    )
+    lines.append(f"result: {verdict}")
+    return "\n".join(lines)

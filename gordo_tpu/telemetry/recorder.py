@@ -35,17 +35,27 @@ that first call); later calls with the same signature are steady-state
 compile-cache work needs.
 """
 
+import collections
 import contextlib
 import datetime
 import json
 import os
+import random
 import threading
 import time
-import uuid
 from typing import Any, Callable, Dict, Hashable, List, Optional
 
 TELEMETRY_ENV = "GORDO_TPU_TELEMETRY"
 TRACE_DIR_ENV = "GORDO_TPU_TELEMETRY_DIR"
+#: size-based trace-sink rotation: when a JSONL sink crosses this many
+#: bytes it is rotated (``trace.jsonl`` -> ``trace.jsonl.1`` -> ...), so
+#: a months-lived serving or lifecycle process can never fill the disk.
+#: 0 disables rotation.
+MAX_BYTES_ENV = "GORDO_TPU_TELEMETRY_MAX_BYTES"
+#: rotated generations kept per sink (older ones are deleted)
+KEEP_ENV = "GORDO_TPU_TELEMETRY_KEEP"
+DEFAULT_MAX_BYTES = 256 * 1024 * 1024
+DEFAULT_KEEP = 3
 
 
 def enabled() -> bool:
@@ -65,17 +75,54 @@ def _iso(ts: float) -> str:
     ).isoformat()
 
 
+def _env_size(name: str, default: int) -> int:
+    # utils.env is the one shared GORDO_TPU_* numeric-knob parser (it
+    # warns on invalid values); stdlib-only, so the telemetry package's
+    # no-heavy-deps contract holds
+    from ..utils.env import env_int
+
+    return max(0, env_int(name, default))
+
+
+#: id generator for trace/span ids — a PRNG seeded once from the OS,
+#: NOT uuid4: ids only need uniqueness, and uuid4's per-call urandom
+#: syscall costs ~20x more, which matters at one span id per request
+#: stage on the serving hot path (GIL makes getrandbits effectively
+#: atomic; ids are not security tokens)
+_id_source = random.Random(int.from_bytes(os.urandom(16), "big"))
+
+
+def rand_hex(chars: int = 32) -> str:
+    """``chars`` lowercase hex characters of PRNG randomness (32 = a
+    W3C trace id, 16 = a span id)."""
+    return f"{_id_source.getrandbits(chars * 4):0{chars}x}"
+
+
 class SpanHandle:
     """The object a ``with recorder.span(...)`` block receives; lets the
-    body attach attributes discovered mid-span (e.g. result counts)."""
+    body attach attributes discovered mid-span (e.g. result counts) and
+    OTel-shaped links to spans in OTHER traces (the serving engine links
+    each fused batch span to the request spans it coalesced)."""
 
-    __slots__ = ("attributes",)
+    __slots__ = ("attributes", "links")
 
     def __init__(self, attributes: Dict[str, Any]):
         self.attributes = attributes
+        self.links: List[dict] = []
 
     def set(self, **attributes) -> "SpanHandle":
         self.attributes.update(attributes)
+        return self
+
+    def link(self, trace_id: str, span_id: str, **attributes) -> "SpanHandle":
+        """Attach a link to a span in another trace (OTel link shape:
+        a span context plus link attributes)."""
+        self.links.append(
+            {
+                "context": {"trace_id": trace_id, "span_id": span_id},
+                **({"attributes": attributes} if attributes else {}),
+            }
+        )
         return self
 
 
@@ -85,6 +132,7 @@ class NullRecorder:
 
     enabled = False
     trace_id = ""
+    default_parent_id = None
 
     @contextlib.contextmanager
     def span(self, name: str, **attributes):
@@ -94,6 +142,12 @@ class NullRecorder:
         pass
 
     def record(self, name: str, seconds: float, **attributes) -> None:
+        pass
+
+    def emit(self, span: dict) -> None:
+        pass
+
+    def flush(self) -> None:
         pass
 
     def add_listener(self, listener: Callable[[dict], None]) -> None:
@@ -133,10 +187,49 @@ class SpanRecorder:
         sink_path: Optional[str] = None,
         service: str = "gordo-tpu",
         retain_spans: Optional[bool] = None,
+        trace_id: Optional[str] = None,
+        max_bytes: Optional[int] = None,
+        keep: Optional[int] = None,
+        async_sink: bool = False,
     ):
-        self.trace_id = uuid.uuid4().hex
+        #: explicit ``trace_id`` joins an existing trace (the server's
+        #: per-request recorders adopt the request's W3C trace id so its
+        #: stage spans land in the caller's trace); default is a fresh one
+        self.trace_id = trace_id or rand_hex(32)
+        #: parent for spans opened on a thread with no enclosing span —
+        #: the per-request recorder points this at the request's root
+        #: span id, so stage spans (and the batcher's externally-timed
+        #: ``record()`` intervals) nest under the request span
+        self.default_parent_id: Optional[str] = None
         self.service = service
         self.sink_path = sink_path
+        #: async sink: spans queue to a background writer thread that
+        #: batch-writes them — the mode the process-shared SERVING
+        #: recorder runs in, where the recording threads are request
+        #: threads and the ~50us of json+write+flush per span would be
+        #: paid at request rate. Builds keep the synchronous default
+        #: (every span durable the instant it closes, crash-complete).
+        self.async_sink = bool(async_sink) and sink_path is not None
+        if sink_path is not None:
+            # rotation knobs and writer plumbing only matter with a
+            # sink; the per-REQUEST in-memory recorders skip all of it
+            # (two env reads + deque/event allocation per request add up)
+            self.max_bytes = (
+                max_bytes
+                if max_bytes is not None
+                else _env_size(MAX_BYTES_ENV, DEFAULT_MAX_BYTES)
+            )
+            self.keep = (
+                keep if keep is not None else _env_size(KEEP_ENV, DEFAULT_KEEP)
+            )
+            self._queue: "collections.deque" = collections.deque(maxlen=20000)
+            self._wakeup = threading.Event()
+            self._write_lock = threading.Lock()
+        else:
+            self.max_bytes = max_bytes or 0
+            self.keep = keep or 0
+        self._writer: Optional[threading.Thread] = None
+        self._closed = False
         self._sink = None
         self._lock = threading.Lock()
         # In-memory retention serves short-lived recorders (the server's
@@ -164,9 +257,9 @@ class SpanRecorder:
         """Record the enclosed block as one span; exceptions mark the
         span ``ERROR`` (with the exception repr) and propagate."""
         handle = SpanHandle(dict(attributes))
-        span_id = uuid.uuid4().hex[:16]
+        span_id = rand_hex(16)
         stack = self._stack()
-        parent_id = stack[-1] if stack else None
+        parent_id = stack[-1] if stack else self.default_parent_id
         stack.append(span_id)
         start = time.time()
         error: Optional[BaseException] = None
@@ -187,6 +280,7 @@ class SpanRecorder:
                     end,
                     handle.attributes,
                     error,
+                    links=handle.links or None,
                 )
             )
 
@@ -197,8 +291,8 @@ class SpanRecorder:
         self._record(
             self._span_dict(
                 name,
-                uuid.uuid4().hex[:16],
-                stack[-1] if stack else None,
+                rand_hex(16),
+                stack[-1] if stack else self.default_parent_id,
                 now,
                 now,
                 dict(attributes),
@@ -219,14 +313,43 @@ class SpanRecorder:
         self._record(
             self._span_dict(
                 name,
-                uuid.uuid4().hex[:16],
-                stack[-1] if stack else None,
+                rand_hex(16),
+                stack[-1] if stack else self.default_parent_id,
                 end - max(0.0, seconds),
                 end,
                 dict(attributes),
                 None,
             )
         )
+
+    def emit(self, span: dict) -> None:
+        """Record a pre-built span dict as-is (sink + listeners + retain).
+
+        The request-trace export path uses this: per-request recorders
+        are in-memory (cheap, no file handle per request); at response
+        finalization their finished spans — already carrying the
+        request's own trace id — are emitted into the process-shared
+        serving sink in one pass."""
+        self._record(span)
+
+    def emit_deferred(self, build: Callable[[], List[dict]]) -> None:
+        """Queue a zero-arg callable whose returned span dicts are
+        materialized ON THE WRITER THREAD (async sinks only; falls back
+        to immediate emission otherwise).
+
+        The request-export hot path uses this so a request thread pays
+        one deque append while dict assembly + json + IO happen off the
+        request's GIL time — the difference between the serving trace
+        costing ~100us and ~10us per request."""
+        if self.async_sink and self.sink_path is not None:
+            self._queue.append(build)
+            if self._writer is None:
+                self._ensure_writer()
+            elif len(self._queue) >= 2048:
+                self._wakeup.set()
+            return
+        for span in build():
+            self._record(span)
 
     def _span_dict(
         self,
@@ -238,6 +361,7 @@ class SpanRecorder:
         attributes,
         error,
         kind="internal",
+        links=None,
     ) -> dict:
         return {
             "name": name,
@@ -252,19 +376,37 @@ class SpanRecorder:
                 **({"description": repr(error)} if error is not None else {}),
             },
             "attributes": attributes,
+            **({"links": links} if links else {}),
             "resource": {"service.name": self.service},
         }
 
     def _record(self, span: dict) -> None:
+        if self.async_sink and self.sink_path is not None:
+            # the serving hot path: request threads pay one deque append
+            # (~0.1us); the writer thread does the json encode + IO.
+            # A bounded deque sheds oldest-first if the disk ever stalls
+            # — advisory telemetry must never become backpressure.
+            self._queue.append(span)
+            if self._writer is None:
+                self._ensure_writer()
+            elif len(self._queue) >= 2048:
+                # deep backlog: wake the writer early rather than risk
+                # the bounded deque shedding (the only signaling the
+                # recording threads ever do — see _writer_loop)
+                self._wakeup.set()
+            if not self.retain_spans and not self._listeners:
+                return
         with self._lock:
             if self.retain_spans:
                 self._spans.append(span)
-            if self.sink_path is not None:
+            if self.sink_path is not None and not self.async_sink:
                 try:
                     if self._sink is None:
                         self._sink = open(self.sink_path, "a")
                     self._sink.write(json.dumps(span, default=str) + "\n")
                     self._sink.flush()
+                    if self.max_bytes and self._sink.tell() >= self.max_bytes:
+                        self._rotate_locked()
                 except OSError:
                     # telemetry is advisory: a full/readonly volume must
                     # never fail the build it is describing
@@ -276,6 +418,104 @@ class SpanRecorder:
                 listener(span)
             except Exception:  # noqa: BLE001 - listeners are advisory too
                 pass
+
+    # -- async sink (serving) -----------------------------------------------
+
+    def _ensure_writer(self) -> None:
+        with self._lock:
+            if self._writer is None and not self._closed:
+                self._writer = threading.Thread(
+                    target=self._writer_loop,
+                    name="gordo-trace-writer",
+                    daemon=True,
+                )
+                self._writer.start()
+
+    def _writer_loop(self) -> None:
+        # Self-polling instead of per-span signaling: an Event.set()
+        # from the recording thread is a futex syscall that wakes the
+        # writer mid-request — measured ~4% of scoring throughput at a
+        # 10% export rate. While spans flow the poll is 50ms (bounds
+        # trace latency); an idle writer backs off exponentially to 1s
+        # so a quiet server doesn't pay 20 scheduler wakes/second for
+        # nothing (under cgroup CPU quota even idle wakes bill the
+        # throttle budget). close()/flush() still signal for prompt
+        # shutdown.
+        timeout = 0.05
+        while True:
+            self._wakeup.wait(timeout=timeout)
+            self._wakeup.clear()
+            if self._queue:
+                timeout = 0.05
+                self._drain()
+            else:
+                timeout = min(1.0, timeout * 2)
+            if self._closed and not self._queue:
+                return
+
+    def _drain(self) -> None:
+        """Write everything queued, as one batched write+flush. Queue
+        items are span dicts or deferred builders (zero-arg callables
+        returning span lists — see :meth:`emit_deferred`)."""
+        with self._write_lock:
+            batch: List[dict] = []
+            while True:
+                try:
+                    item = self._queue.popleft()
+                except IndexError:
+                    break
+                if callable(item):
+                    try:
+                        batch.extend(item())
+                    except Exception:  # noqa: BLE001 - a broken deferred
+                        # builder loses ITS spans, never the writer
+                        pass
+                else:
+                    batch.append(item)
+            if not batch or self.sink_path is None:
+                return
+            try:
+                if self._sink is None:
+                    self._sink = open(self.sink_path, "a")
+                self._sink.write(
+                    "".join(
+                        json.dumps(span, default=str) + "\n" for span in batch
+                    )
+                )
+                self._sink.flush()
+                if self.max_bytes and self._sink.tell() >= self.max_bytes:
+                    self._rotate_locked()
+            except OSError:
+                self.sink_path = None
+                self._sink = None
+
+    def flush(self) -> None:
+        """Block until everything recorded so far is on disk (async
+        sinks; a synchronous sink is always flushed per span). Tests
+        and the route bench call this before reading the trace back."""
+        if self.async_sink:
+            self._drain()
+
+    def _rotate_locked(self) -> None:
+        """Rotate the sink: ``p`` -> ``p.1`` -> ... -> ``p.<keep>``
+        (older generations deleted), then reopen a fresh ``p``. Called
+        with the lock held, right after a write crossed ``max_bytes`` —
+        so a months-lived serving/lifecycle process caps its telemetry
+        footprint at ~``(keep + 1) * max_bytes`` per sink instead of
+        growing without bound."""
+        self._sink.close()
+        self._sink = None
+        if self.keep < 1:
+            os.remove(self.sink_path)
+            return
+        for generation in range(self.keep, 0, -1):
+            src = (
+                self.sink_path
+                if generation == 1
+                else f"{self.sink_path}.{generation - 1}"
+            )
+            if os.path.exists(src):
+                os.replace(src, f"{self.sink_path}.{generation}")
 
     # -- introspection ------------------------------------------------------
 
@@ -307,6 +547,22 @@ class SpanRecorder:
         return totals
 
     def close(self) -> None:
+        if self.async_sink:
+            self._closed = True
+            self._wakeup.set()
+            writer = self._writer
+            if writer is not None:
+                writer.join(timeout=2.0)
+                self._writer = None
+            self._drain()  # anything the writer left behind
+            with self._write_lock:
+                if self._sink is not None:
+                    try:
+                        self._sink.close()
+                    except OSError:
+                        pass
+                    self._sink = None
+            return
         with self._lock:
             if self._sink is not None:
                 try:

@@ -1,0 +1,226 @@
+"""
+The serving-side trace surface: one process-shared ``serve_trace.jsonl``.
+
+The build side has had a span trace since PR 3 (``build_trace.jsonl``);
+this module gives the *serving* side its equivalent, with one crucial
+difference: a server handles thousands of concurrent requests, so there
+is no single recorder wrapping "the work" — instead
+
+- every request gets a cheap **in-memory** recorder on its context
+  (``RequestContext.timing``, no file handle per request) carrying the
+  request's own W3C trace id;
+- at response finalization the request's finished stage spans plus one
+  synthesized ``request`` root span are emitted into the process-shared
+  sink recorder this module owns (:func:`serve_recorder`) in one pass;
+- the micro-batching engine records its batch spans into the same sink,
+  each carrying OTel ``links`` back to the request spans it coalesced —
+  so queue-wait/stack/device/scatter are attributable per request.
+
+The sink lives at ``$GORDO_TPU_TELEMETRY_DIR/serve_trace.jsonl`` and
+rotates by size (``GORDO_TPU_TELEMETRY_MAX_BYTES``); with telemetry off
+(``GORDO_TPU_TELEMETRY=0``) or no trace dir configured, everything here
+short-circuits to the :data:`~gordo_tpu.telemetry.NULL_RECORDER` and no
+file is ever created — the master-switch contract the serve hot path is
+tested against.
+"""
+
+import atexit
+import os
+import random
+import threading
+from typing import Any, Dict, Optional
+
+from .recorder import (
+    NULL_RECORDER,
+    TRACE_DIR_ENV,
+    SpanRecorder,
+    enabled,
+    rand_hex,
+)
+
+#: the serving-side JSONL trace beside ``build_trace.jsonl`` — batch
+#: spans (the engine), request spans and stage spans (the server)
+SERVE_TRACE_FILE = "serve_trace.jsonl"
+
+#: head-sampling rate for request-trace export, in [0, 1]. Every request
+#: still GETS a trace id (headers, logs, RED metrics see all traffic);
+#: this gates only which requests' spans are written to
+#: ``serve_trace.jsonl``. Sampling is how the trace stays affordable at
+#: production request rates — the RED histograms carry the full
+#: population statistics, the trace carries attributable exemplars.
+#: Overridden per request by an incoming ``traceparent`` sampled flag
+#: (a sampled upstream trace always exports) and by ``?profile=1``.
+TRACE_SAMPLE_RATE_ENV = "GORDO_TPU_TRACE_SAMPLE_RATE"
+DEFAULT_TRACE_SAMPLE_RATE = 0.05
+
+_lock = threading.Lock()
+_recorder: Optional[SpanRecorder] = None
+_atexit_registered = False
+
+
+#: (raw env string, parsed rate) — the parse is cached per distinct env
+#: value so the hot path pays one getenv + one string compare
+_rate_cache: tuple = (None, DEFAULT_TRACE_SAMPLE_RATE)
+
+
+def trace_sample_rate() -> float:
+    global _rate_cache
+    raw = os.getenv(TRACE_SAMPLE_RATE_ENV)
+    cached_raw, cached_rate = _rate_cache
+    if raw == cached_raw:
+        return cached_rate
+    # slow path only when the env value changed: the shared warn-and-
+    # fall-back parser, clamped to a fraction
+    from ..utils.env import env_float
+
+    rate = min(
+        1.0,
+        max(0.0, env_float(TRACE_SAMPLE_RATE_ENV, DEFAULT_TRACE_SAMPLE_RATE)),
+    )
+    _rate_cache = (raw, rate)
+    return rate
+
+
+def sample_trace() -> bool:
+    """The head-sampling coin flip for a locally-originated trace."""
+    rate = trace_sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return random.random() < rate
+
+
+def serve_trace_path() -> Optional[str]:
+    """Where the serving trace would land, or None when telemetry is off
+    or no ``GORDO_TPU_TELEMETRY_DIR`` is configured (the serving path,
+    unlike a build, has no natural output directory to default to)."""
+    trace_dir = os.getenv(TRACE_DIR_ENV)
+    if not enabled() or not trace_dir:
+        return None
+    return os.path.join(trace_dir, SERVE_TRACE_FILE)
+
+
+def serve_recorder() -> Any:
+    """The process-shared serving trace recorder (created on first use,
+    one per sink path), or :data:`NULL_RECORDER` when tracing is off —
+    callers can branch on ``.enabled`` to skip span construction
+    entirely on the request hot path."""
+    global _recorder
+    path = serve_trace_path()
+    if path is None:
+        return NULL_RECORDER
+    # lock-free steady-state path: the recorder only changes when the
+    # telemetry env does, and this runs several times per request/batch
+    # — serializing every request thread on the module lock is exactly
+    # the class of hot-path cost this PR budgets away
+    recorder = _recorder
+    if recorder is not None and recorder.sink_path == path:
+        return recorder
+    global _atexit_registered
+    with _lock:
+        if _recorder is None or _recorder.sink_path != path:
+            try:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+            except OSError:
+                return NULL_RECORDER
+            if _recorder is not None:
+                _recorder.close()
+            # async sink: request threads enqueue, a writer thread does
+            # the json+IO — the ≤2% scoring-overhead budget does not fit
+            # a synchronous write+flush per span at request rate
+            _recorder = SpanRecorder(
+                sink_path=path, service="gordo-tpu-serve", async_sink=True
+            )
+            if not _atexit_registered:
+                # the daemon writer dies with the interpreter; without
+                # this, the last ~50ms of queued spans (including the
+                # final requests before a SIGTERM) never reach disk
+                _atexit_registered = True
+                atexit.register(_close_at_exit)
+        return _recorder
+
+
+def _close_at_exit() -> None:
+    with _lock:
+        recorder = _recorder
+    if recorder is not None:
+        try:
+            recorder.close()
+        except Exception:  # noqa: BLE001 - interpreter is going down
+            pass
+
+
+def reset_serve_recorder() -> None:
+    """Close and drop the shared recorder (tests, reload)."""
+    global _recorder
+    with _lock:
+        if _recorder is not None:
+            _recorder.close()
+        _recorder = None
+
+
+def export_request_trace(
+    timing: SpanRecorder,
+    *,
+    span_id: str,
+    parent_id: Optional[str],
+    start: float,
+    duration_s: float,
+    attributes: Dict[str, Any],
+    error: Optional[str] = None,
+    profile: Optional[dict] = None,
+) -> None:
+    """
+    Flush one finished request into the shared serving trace: the
+    request's stage spans (recorded in-memory on ``timing`` with the
+    request's trace id and ``default_parent_id = span_id``, so they
+    already nest correctly), one ``request`` root span synthesized from
+    the supplied interval, and — when the request was profiled — one
+    ``profile`` span carrying the sampling profiler's aggregated
+    self-time frames.
+
+    No-ops (without constructing anything) when the serving sink is off.
+    The request thread pays one list copy and one queue append; the
+    ``request``/``profile`` span dicts are materialized on the sink's
+    writer thread (:meth:`SpanRecorder.emit_deferred`) — dict assembly
+    and ISO timestamp formatting are off the request's GIL time.
+    """
+    sink = serve_recorder()
+    if not sink.enabled:
+        return
+    stage_spans = timing.finished()
+
+    def build() -> list:
+        end = start + max(0.0, duration_s)
+        request_span = timing._span_dict(
+            "request",
+            span_id,
+            parent_id,
+            start,
+            end,
+            attributes,
+            None,
+            kind="server",
+        )
+        if error:
+            request_span["status"] = {
+                "status_code": "ERROR",
+                "description": error,
+            }
+        spans = stage_spans
+        if profile:
+            spans = spans + [
+                timing._span_dict(
+                    "profile",
+                    rand_hex(16),
+                    span_id,
+                    end - profile.get("duration_ms", 0.0) / 1000.0,
+                    end,
+                    profile,
+                    None,
+                )
+            ]
+        return spans + [request_span]
+
+    sink.emit_deferred(build)

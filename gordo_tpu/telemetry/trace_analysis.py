@@ -1,0 +1,337 @@
+"""
+Offline analysis of the JSONL span traces (``serve_trace.jsonl`` /
+``build_trace.jsonl``): the library behind ``gordo-tpu trace``.
+
+The traces answer "where does the time go" only if something aggregates
+them; this module turns a span stream into:
+
+- **per-span-name latency distributions** (count, p50/p95/p99, total) —
+  the serve trace's ``request``/``serve_batch``/stage spans, the build
+  trace's ``build_phase``/``device_program`` spans;
+- **the request breakdown**: for every ``request`` span, its child
+  stage spans are joined back by ``(trace_id, parent_id)`` and the
+  aggregate reports per-stage percentiles, each stage's share of median
+  request walltime, and the **attribution coverage** — the fraction of
+  request walltime the instrumented stages explain (the serving
+  observability acceptance bar is ≥0.9; anything below means the
+  pipeline has un-instrumented host work);
+- **the critical path** of the median-ish request: its own stages,
+  longest first;
+- **top self-time frames** aggregated across ``profile`` spans (the
+  sampling profiler's output), by (stage, function).
+
+Everything is computed from span dicts alone — the analyses run on any
+trace the :class:`~gordo_tpu.telemetry.SpanRecorder` wrote, rotated
+generations included. Stdlib-only, like the whole telemetry package.
+"""
+
+import json
+import os
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
+
+#: serving stage spans whose parent is the request span; anything else
+#: under a request (events, nested helper spans) is excluded from the
+#: stage breakdown so shares stay a partition of walltime
+_NON_STAGE_NAMES = ("request", "profile")
+
+
+def read_trace(
+    path: str, include_rotated: bool = True
+) -> Iterator[dict]:
+    """Yield span dicts from a JSONL trace file, oldest first across
+    rotated generations (``p.N`` ... ``p.1``, then ``p``). Unparseable
+    lines (a crash mid-write leaves at most one) are skipped."""
+    paths: List[str] = []
+    if include_rotated:
+        generation = 1
+        rotated = []
+        while os.path.exists(f"{path}.{generation}"):
+            rotated.append(f"{path}.{generation}")
+            generation += 1
+        paths.extend(reversed(rotated))
+    if os.path.exists(path):
+        paths.append(path)
+    for trace_path in paths:
+        with open(trace_path) as handle:
+            for line in handle:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    span = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(span, dict) and "name" in span:
+                    yield span
+
+
+def percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile of ``values`` (must be sorted)."""
+    if not values:
+        return 0.0
+    rank = max(0, min(len(values) - 1, int(round(q * (len(values) - 1)))))
+    return values[rank]
+
+
+def _distribution(durations: List[float]) -> Dict[str, float]:
+    durations = sorted(durations)
+    return {
+        "count": len(durations),
+        "p50_ms": round(percentile(durations, 0.50), 3),
+        "p95_ms": round(percentile(durations, 0.95), 3),
+        "p99_ms": round(percentile(durations, 0.99), 3),
+        "total_ms": round(sum(durations), 3),
+    }
+
+
+def summarize_spans(spans: Iterable[dict]) -> Dict[str, Dict[str, float]]:
+    """Per-span-name duration distributions, skipping point events."""
+    by_name: Dict[str, List[float]] = {}
+    for span in spans:
+        if span.get("kind") == "event":
+            continue
+        by_name.setdefault(span["name"], []).append(
+            float(span.get("duration_ms", 0.0))
+        )
+    return {
+        name: _distribution(durations)
+        for name, durations in sorted(by_name.items())
+    }
+
+
+def request_breakdown(spans: Iterable[dict]) -> Optional[Dict[str, Any]]:
+    """
+    The per-stage attribution of the trace's ``request`` spans:
+
+    ``stages`` maps stage name → distribution + ``share_of_p50`` (the
+    stage's median as a fraction of the median request walltime);
+    ``attribution_coverage`` is the summed share — how much of a median
+    request the instrumented stages explain; ``critical_path`` lists the
+    median request's own stages, longest first. None when the trace
+    holds no request spans.
+    """
+    requests: List[dict] = []
+    children: Dict[Tuple[str, str], List[dict]] = {}
+    for span in spans:
+        if span.get("kind") == "event":
+            continue
+        context = span.get("context") or {}
+        if span["name"] == "request":
+            requests.append(span)
+        elif (
+            span["name"] not in _NON_STAGE_NAMES
+            and span.get("parent_id")
+        ):
+            children.setdefault(
+                (context.get("trace_id", ""), span["parent_id"]), []
+            ).append(span)
+    if not requests:
+        return None
+
+    walltimes = sorted(float(r.get("duration_ms", 0.0)) for r in requests)
+    p50_wall = percentile(walltimes, 0.50)
+    stage_durations: Dict[str, List[float]] = {}
+    # attribution coverage is computed PER REQUEST (own stages summed
+    # over own walltime, then the median ratio) — aggregating means
+    # against a median walltime overstates coverage whenever the
+    # latency distribution is skewed, which under concurrency it
+    # always is
+    coverage_ratios: List[float] = []
+    for request in requests:
+        context = request.get("context") or {}
+        trace_id = context.get("trace_id", "")
+        own = children.get((trace_id, context.get("span_id", "")), [])
+        for stage in own:
+            stage_durations.setdefault(stage["name"], []).append(
+                float(stage.get("duration_ms", 0.0))
+            )
+            # one level of nesting: spans recorded while a stage was
+            # open (the micro-batcher's queue_wait / batch_* intervals
+            # land inside `inference`) surface as stages of their own —
+            # informational sub-segments, excluded from coverage below
+            # (their time is already inside their parent stage's)
+            stage_context = stage.get("context") or {}
+            for nested in children.get(
+                (trace_id, stage_context.get("span_id", "")), []
+            ):
+                stage_durations.setdefault(nested["name"], []).append(
+                    float(nested.get("duration_ms", 0.0))
+                )
+        wall = float(request.get("duration_ms", 0.0))
+        if wall > 0:
+            explained = sum(
+                float(stage.get("duration_ms", 0.0)) for stage in own
+            )
+            coverage_ratios.append(min(1.0, explained / wall))
+    coverage = percentile(sorted(coverage_ratios), 0.50)
+
+    stages: Dict[str, Dict[str, float]] = {}
+    for name, durations in sorted(stage_durations.items()):
+        dist = _distribution(durations)
+        # the stage's conditional median over the median request
+        # walltime — how much of a typical request this stage explains
+        # when it occurs (queue_wait occurs only for batched requests)
+        dist["share_of_p50"] = round(
+            dist["p50_ms"] / p50_wall if p50_wall > 0 else 0.0, 4
+        )
+        stages[name] = dist
+
+    # the critical path of the median request: the request whose
+    # walltime sits at p50, its own stages longest-first
+    median_request = min(
+        requests,
+        key=lambda r: abs(float(r.get("duration_ms", 0.0)) - p50_wall),
+    )
+    context = median_request.get("context") or {}
+    own = children.get(
+        (context.get("trace_id", ""), context.get("span_id", "")), []
+    )
+    critical_path = [
+        {
+            "stage": stage["name"],
+            "duration_ms": round(float(stage.get("duration_ms", 0.0)), 3),
+        }
+        for stage in sorted(
+            own, key=lambda s: float(s.get("duration_ms", 0.0)), reverse=True
+        )
+    ]
+
+    return {
+        "requests": len(requests),
+        "walltime_p50_ms": round(p50_wall, 3),
+        "walltime_p95_ms": round(percentile(walltimes, 0.95), 3),
+        "walltime_p99_ms": round(percentile(walltimes, 0.99), 3),
+        "stages": stages,
+        "attribution_coverage": round(coverage, 4),
+        "critical_path": critical_path,
+    }
+
+
+def top_profile_frames(
+    spans: Iterable[dict], max_frames: int = 25
+) -> List[Dict[str, Any]]:
+    """Self-time frames aggregated across every ``profile`` span in the
+    trace, by (stage, function), heaviest first."""
+    totals: Dict[Tuple[str, str], Dict[str, float]] = {}
+    for span in spans:
+        if span["name"] != "profile":
+            continue
+        for frame in (span.get("attributes") or {}).get("frames", []):
+            key = (frame.get("stage", "-"), frame.get("function", "?"))
+            entry = totals.setdefault(key, {"self_ms": 0.0, "samples": 0})
+            entry["self_ms"] += float(frame.get("self_ms", 0.0))
+            entry["samples"] += int(frame.get("samples", 0))
+    ranked = sorted(
+        totals.items(), key=lambda kv: kv[1]["self_ms"], reverse=True
+    )
+    return [
+        {
+            "stage": stage,
+            "function": function,
+            "self_ms": round(entry["self_ms"], 3),
+            "samples": entry["samples"],
+        }
+        for (stage, function), entry in ranked[:max_frames]
+    ]
+
+
+def analyze_trace(path: str) -> Dict[str, Any]:
+    """The full analysis document for one trace file: span summaries,
+    the request breakdown, and the aggregated profile — the JSON shape
+    ``gordo-tpu trace --as-json`` prints and the tests golden-check."""
+    spans = list(read_trace(path))
+    return {
+        "trace": path,
+        "spans_read": len(spans),
+        "span_summary": summarize_spans(spans),
+        "request_breakdown": request_breakdown(spans),
+        "profile_frames": top_profile_frames(spans),
+    }
+
+
+# -- rendering ---------------------------------------------------------------
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [
+        max(len(str(row[i])) for row in [header] + rows)
+        for i in range(len(header))
+    ]
+    lines = [
+        "  ".join(str(cell).ljust(widths[i]) for i, cell in enumerate(row))
+        for row in [header, ["-" * w for w in widths]] + rows
+    ]
+    return "\n".join(line.rstrip() for line in lines)
+
+
+def render_analysis(doc: Dict[str, Any]) -> str:
+    """Human-readable rendering of :func:`analyze_trace`'s document."""
+    out: List[str] = [f"trace: {doc['trace']}  ({doc['spans_read']} spans)"]
+
+    summary = doc.get("span_summary") or {}
+    if summary:
+        out.append("\nSpan latency (ms):")
+        out.append(
+            _table(
+                [
+                    [
+                        name,
+                        dist["count"],
+                        dist["p50_ms"],
+                        dist["p95_ms"],
+                        dist["p99_ms"],
+                    ]
+                    for name, dist in summary.items()
+                ],
+                ["span", "count", "p50", "p95", "p99"],
+            )
+        )
+
+    breakdown = doc.get("request_breakdown")
+    if breakdown:
+        out.append(
+            f"\nRequests: {breakdown['requests']}  "
+            f"walltime p50={breakdown['walltime_p50_ms']}ms "
+            f"p95={breakdown['walltime_p95_ms']}ms "
+            f"p99={breakdown['walltime_p99_ms']}ms"
+        )
+        out.append("\nPer-stage breakdown:")
+        out.append(
+            _table(
+                [
+                    [
+                        name,
+                        dist["p50_ms"],
+                        dist["p95_ms"],
+                        f"{dist['share_of_p50'] * 100:.1f}%",
+                    ]
+                    for name, dist in breakdown["stages"].items()
+                ],
+                ["stage", "p50", "p95", "share of p50"],
+            )
+        )
+        coverage = breakdown["attribution_coverage"]
+        out.append(
+            f"\nattribution coverage: {coverage * 100:.1f}% of median "
+            "request walltime explained by instrumented stages"
+        )
+        if breakdown["critical_path"]:
+            path_text = "  >  ".join(
+                f"{step['stage']} {step['duration_ms']}ms"
+                for step in breakdown["critical_path"]
+            )
+            out.append(f"critical path (median request): {path_text}")
+
+    frames = doc.get("profile_frames") or []
+    if frames:
+        out.append("\nTop self-time frames (sampling profiler):")
+        out.append(
+            _table(
+                [
+                    [f["stage"], f["function"], f["self_ms"], f["samples"]]
+                    for f in frames[:15]
+                ],
+                ["stage", "function", "self ms", "samples"],
+            )
+        )
+    return "\n".join(out)

@@ -1,0 +1,127 @@
+"""Batch-span trace links: fused ``serve_batch`` spans in
+serve_trace.jsonl carry OTel links back to the (sampled) request spans
+they coalesced, with per-request queue-wait — the causal edge that
+makes a shared batch attributable request by request."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from gordo_tpu import telemetry
+from gordo_tpu.serve import ServeEngine
+from gordo_tpu.server.fleet_store import STORE
+from gordo_tpu.telemetry import SpanRecorder
+from gordo_tpu.telemetry import serving as serve_trace
+
+from .conftest import BATCH_NAMES, temp_env_vars, tiny_config
+
+pytestmark = [pytest.mark.serve, pytest.mark.observability]
+
+
+def _request_timing(sampled=True):
+    trace_id = telemetry.new_trace_id()
+    span_id = telemetry.new_span_id()
+    timing = SpanRecorder(service="gordo-tpu-server", trace_id=trace_id)
+    timing.default_parent_id = span_id
+    timing.sampled = sampled
+    return timing, trace_id, span_id
+
+
+def test_batch_spans_link_back_to_request_spans(
+    serve_collection_dir, tmp_path
+):
+    trace_dir = str(tmp_path / "telemetry")
+    with temp_env_vars(
+        GORDO_TPU_TELEMETRY="1",
+        GORDO_TPU_TELEMETRY_DIR=trace_dir,
+        GORDO_TPU_TRACE_SAMPLE_RATE="1.0",
+    ):
+        serve_trace.reset_serve_recorder()
+        engine = ServeEngine(tiny_config(max_delay_ms=100.0))
+        try:
+            fleet = STORE.fleet(serve_collection_dir)
+            fleet.warm(BATCH_NAMES)
+            timings = {}
+            results = {}
+
+            def hit(name):
+                timing, trace_id, span_id = _request_timing()
+                timings[name] = (trace_id, span_id)
+                model = fleet.model(name)
+                X = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+                results[name] = engine.batched_predict(
+                    serve_collection_dir, name, model, X, timing=timing
+                )
+
+            threads = [
+                threading.Thread(target=hit, args=(name,))
+                for name in BATCH_NAMES
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert all(results[n] is not None for n in BATCH_NAMES)
+
+            serve_trace.serve_recorder().flush()
+            path = os.path.join(trace_dir, telemetry.SERVE_TRACE_FILE)
+            spans = [json.loads(line) for line in open(path)]
+            batch_spans = [s for s in spans if s["name"] == "serve_batch"]
+            assert batch_spans, "no serve_batch spans recorded"
+            links = [
+                link for s in batch_spans for link in s.get("links", [])
+            ]
+            linked = {
+                (
+                    link["context"]["trace_id"],
+                    link["context"]["span_id"],
+                ): link
+                for link in links
+            }
+            # every coalesced request's trace context is linked, with
+            # its queue wait attributed
+            for name in BATCH_NAMES:
+                assert timings[name] in linked, name
+                attrs = linked[timings[name]]["attributes"]
+                assert attrs["name"] == name
+                assert attrs["queue_wait_ms"] >= 0
+            # the request's own Server-Timing got the batch intervals
+            # (queue_wait / batch_* recorded onto the request recorder)
+        finally:
+            engine.shutdown(drain=True)
+            STORE.clear()
+            serve_trace.reset_serve_recorder()
+
+
+def test_unsampled_requests_are_not_linked(serve_collection_dir, tmp_path):
+    trace_dir = str(tmp_path / "telemetry")
+    with temp_env_vars(
+        GORDO_TPU_TELEMETRY="1",
+        GORDO_TPU_TELEMETRY_DIR=trace_dir,
+        GORDO_TPU_TRACE_SAMPLE_RATE="1.0",
+    ):
+        serve_trace.reset_serve_recorder()
+        engine = ServeEngine(tiny_config(max_delay_ms=30.0))
+        try:
+            fleet = STORE.fleet(serve_collection_dir)
+            fleet.warm(BATCH_NAMES[:1])
+            timing, trace_id, _ = _request_timing(sampled=False)
+            model = fleet.model("batch-a")
+            X = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+            recon = engine.batched_predict(
+                serve_collection_dir, "batch-a", model, X, timing=timing
+            )
+            assert recon is not None
+            serve_trace.serve_recorder().flush()
+            path = os.path.join(trace_dir, telemetry.SERVE_TRACE_FILE)
+            spans = [json.loads(line) for line in open(path)]
+            for span in spans:
+                for link in span.get("links", []):
+                    assert link["context"]["trace_id"] != trace_id
+        finally:
+            engine.shutdown(drain=True)
+            STORE.clear()
+            serve_trace.reset_serve_recorder()

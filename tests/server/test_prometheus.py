@@ -255,3 +255,151 @@ def test_record_helpers_hit_default_registry():
         )
         == 5
     )
+
+
+# -- serving RED metrics (stage histograms + explicit error counter) ---------
+
+
+def test_stage_duration_histograms_per_request(
+    client, collection_dir, sensor_payload, monkeypatch
+):
+    """Every instrumented request stage lands one observation in
+    gordo_server_stage_duration_seconds{endpoint,stage} — the aggregable
+    form of the Server-Timing header."""
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", collection_dir)
+    registry = CollectorRegistry()
+    app = build_app(
+        config={"ENABLE_PROMETHEUS": True, "PROJECT": "test-project"},
+        prometheus_registry=registry,
+    )
+    c = Client(app)
+    resp = c.post(
+        "/gordo/v0/test-project/machine-1/prediction", json=sensor_payload
+    )
+    assert resp.status_code == 200
+    for stage in (
+        "model_resolve",
+        "data_decode",
+        "inference",
+        "response_assemble",
+        "serialize",
+    ):
+        count = registry.get_sample_value(
+            "gordo_server_stage_duration_seconds_count",
+            {
+                "project": "test-project",
+                "endpoint": "prediction",
+                "stage": stage,
+            },
+        )
+        assert count == 1, f"stage {stage} not observed"
+    # stage sums roughly partition the request duration
+    total = registry.get_sample_value(
+        "gordo_server_request_duration_seconds_sum",
+        {
+            "method": "POST",
+            "path": "/gordo/v0/{project}/{name}/prediction",
+            "status_code": "200",
+            "gordo_name": "machine-1",
+            "project": "test-project",
+        },
+    )
+    stage_sum = sum(
+        registry.get_sample_value(
+            "gordo_server_stage_duration_seconds_sum",
+            {
+                "project": "test-project",
+                "endpoint": "prediction",
+                "stage": stage,
+            },
+        )
+        for stage in (
+            "model_resolve",
+            "data_decode",
+            "inference",
+            "response_assemble",
+            "serialize",
+        )
+    )
+    assert 0 < stage_sum <= total
+
+
+def test_error_counter_classifies_client_and_server_errors(
+    client, collection_dir, monkeypatch
+):
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", collection_dir)
+    registry = CollectorRegistry()
+    app = build_app(
+        config={"ENABLE_PROMETHEUS": True, "PROJECT": "test-project"},
+        prometheus_registry=registry,
+    )
+    c = Client(app)
+    # a 404: client-kind error
+    assert c.get("/gordo/v0/test-project/no-such/metadata").status_code == 404
+    # a 200: no error counted
+    assert c.get("/gordo/v0/test-project/machine-1/metadata").status_code == 200
+    client_errors = registry.get_sample_value(
+        "gordo_server_request_errors_total",
+        {
+            "method": "GET",
+            "path": "/gordo/v0/{project}/{name}/metadata",
+            "status_code": "404",
+            "gordo_name": "no-such",
+            "project": "test-project",
+            "kind": "client",
+        },
+    )
+    assert client_errors == 1
+    # no error sample exists for the 200
+    assert not any(
+        sample.labels.get("status_code") == "200"
+        for metric in registry.collect()
+        for sample in metric.samples
+        if sample.name == "gordo_server_request_errors_total"
+    )
+
+
+def test_label_child_cache_matches_uncached_observe(collection_dir, monkeypatch):
+    """The hot-path label caches must be pure speedups: repeated
+    observations accumulate exactly like uncached .labels() calls."""
+    from gordo_tpu.server.prometheus.metrics import (
+        GordoServerPrometheusMetrics,
+    )
+
+    registry = CollectorRegistry()
+    red = GordoServerPrometheusMetrics(project="p", registry=registry)
+
+    class Req:
+        method = "POST"
+        path = "/gordo/v0/p/m-1/prediction"
+
+    class Resp:
+        status_code = 200
+        gordo_stage_durations = {"inference": 0.25}
+        gordo_endpoint = "prediction"
+
+    for _ in range(3):
+        red.observe(Req(), Resp(), 0.5)
+    labels = {
+        "method": "POST",
+        "path": "/gordo/v0/{project}/{name}/prediction",
+        "status_code": "200",
+        "gordo_name": "m-1",
+        "project": "p",
+    }
+    assert (
+        registry.get_sample_value("gordo_server_requests_total", labels) == 3
+    )
+    assert (
+        registry.get_sample_value(
+            "gordo_server_request_duration_seconds_sum", labels
+        )
+        == 1.5
+    )
+    assert (
+        registry.get_sample_value(
+            "gordo_server_stage_duration_seconds_count",
+            {"project": "p", "endpoint": "prediction", "stage": "inference"},
+        )
+        == 3
+    )

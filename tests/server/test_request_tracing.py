@@ -1,0 +1,257 @@
+"""End-to-end serving observability through the WSGI app: traceparent
+propagation, the serve_trace.jsonl request export, per-request
+profiling, RED metrics, and the telemetry master-switch contract on the
+request hot path."""
+
+import json
+import os
+
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu import telemetry
+from gordo_tpu.server import build_app
+from gordo_tpu.telemetry import serving as serve_trace
+
+from .conftest import temp_env_vars
+
+pytestmark = pytest.mark.observability
+
+TRACE = "0af7651916cd43dd8448eb211c80319c"
+SPAN = "b7ad6b7169203331"
+
+
+@pytest.fixture
+def traced_client(collection_dir, tmp_path):
+    """A client whose app exports every request to serve_trace.jsonl."""
+    trace_dir = str(tmp_path / "telemetry")
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=collection_dir,
+        GORDO_TPU_TELEMETRY="1",
+        GORDO_TPU_TELEMETRY_DIR=trace_dir,
+        GORDO_TPU_TRACE_SAMPLE_RATE="1.0",
+    ):
+        serve_trace.reset_serve_recorder()
+        app = build_app(config={"EXPECTED_MODELS": ["machine-1", "machine-2"]})
+        yield Client(app), trace_dir
+    serve_trace.reset_serve_recorder()
+
+
+def _read_trace(trace_dir):
+    serve_trace.serve_recorder().flush()
+    path = os.path.join(trace_dir, telemetry.SERVE_TRACE_FILE)
+    with open(path) as handle:
+        return [json.loads(line) for line in handle]
+
+
+def url(rest):
+    return f"/gordo/v0/test-project/{rest}"
+
+
+def test_every_response_carries_a_traceparent(traced_client):
+    client, _ = traced_client
+    resp = client.get(url("machine-1/metadata"))
+    assert resp.status_code == 200
+    header = resp.headers["traceparent"]
+    ctx = telemetry.parse_traceparent(header)
+    assert ctx is not None and ctx.sampled
+
+
+def test_incoming_traceparent_continues_the_trace(traced_client):
+    client, trace_dir = traced_client
+    incoming = f"00-{TRACE}-{SPAN}-01"
+    resp = client.get(
+        url("machine-1/metadata"), headers={"traceparent": incoming}
+    )
+    echoed = telemetry.parse_traceparent(resp.headers["traceparent"])
+    assert echoed.trace_id == TRACE
+    assert echoed.span_id != SPAN  # the server's own span, same trace
+    spans = _read_trace(trace_dir)
+    request_span = next(
+        s
+        for s in spans
+        if s["name"] == "request" and s["context"]["trace_id"] == TRACE
+    )
+    # the request span is a child of the caller's span
+    assert request_span["parent_id"] == SPAN
+    assert request_span["context"]["span_id"] == echoed.span_id
+
+
+def test_unsampled_upstream_trace_is_not_exported(traced_client):
+    client, trace_dir = traced_client
+    other = "c" * 32
+    resp = client.get(
+        url("machine-1/metadata"),
+        headers={"traceparent": f"00-{other}-{SPAN}-00"},
+    )
+    echoed = telemetry.parse_traceparent(resp.headers["traceparent"])
+    assert echoed.trace_id == other and not echoed.sampled
+    serve_trace.serve_recorder().flush()
+    path = os.path.join(trace_dir, telemetry.SERVE_TRACE_FILE)
+    if os.path.exists(path):
+        spans = [json.loads(line) for line in open(path)]
+        assert all(s["context"]["trace_id"] != other for s in spans)
+
+
+def test_prediction_exports_stage_spans_under_the_request(
+    traced_client, sensor_payload
+):
+    client, trace_dir = traced_client
+    resp = client.post(url("machine-1/prediction"), json=sensor_payload)
+    assert resp.status_code == 200
+    trace_id = telemetry.parse_traceparent(
+        resp.headers["traceparent"]
+    ).trace_id
+    spans = [
+        s for s in _read_trace(trace_dir)
+        if s["context"]["trace_id"] == trace_id
+    ]
+    by_name = {s["name"]: s for s in spans}
+    request_span = by_name["request"]
+    assert request_span["kind"] == "server"
+    assert request_span["attributes"]["http.route"] == "prediction"
+    assert request_span["attributes"]["http.status_code"] == 200
+    assert request_span["attributes"]["gordo_name"] == "machine-1"
+    for stage in (
+        "model_resolve",
+        "data_decode",
+        "inference",
+        "response_assemble",
+        "serialize",
+    ):
+        assert stage in by_name, f"stage {stage} not exported"
+        assert by_name[stage]["parent_id"] == request_span["context"]["span_id"]
+    # stages explain the request: the trace analysis reproduces it
+    from gordo_tpu.telemetry.trace_analysis import request_breakdown
+
+    breakdown = request_breakdown(spans)
+    assert breakdown["requests"] == 1
+    assert breakdown["attribution_coverage"] > 0.5
+
+
+def test_server_errors_mark_the_request_span(traced_client):
+    client, trace_dir = traced_client
+    resp = client.post(
+        url("machine-1/prediction"), json={"X": "not-a-frame"}
+    )
+    assert resp.status_code >= 400
+    spans = _read_trace(trace_dir)
+    trace_id = telemetry.parse_traceparent(
+        resp.headers["traceparent"]
+    ).trace_id
+    request_span = next(
+        s
+        for s in spans
+        if s["name"] == "request" and s["context"]["trace_id"] == trace_id
+    )
+    assert request_span["attributes"]["http.status_code"] == resp.status_code
+
+
+def test_profile_param_attaches_a_profile_span(traced_client, sensor_payload):
+    client, trace_dir = traced_client
+    resp = client.post(
+        url("machine-1/prediction") + "?profile=1", json=sensor_payload
+    )
+    assert resp.status_code == 200
+    trace_id = telemetry.parse_traceparent(
+        resp.headers["traceparent"]
+    ).trace_id
+    spans = [
+        s for s in _read_trace(trace_dir)
+        if s["context"]["trace_id"] == trace_id
+    ]
+    profile = next(s for s in spans if s["name"] == "profile")
+    assert profile["attributes"]["interval_ms"] > 0
+    assert isinstance(profile["attributes"]["frames"], list)
+    request_span = next(s for s in spans if s["name"] == "request")
+    assert profile["parent_id"] == request_span["context"]["span_id"]
+
+
+def test_healthcheck_is_never_exported(traced_client):
+    client, trace_dir = traced_client
+    client.get("/healthcheck")
+    client.get("/server-version")
+    serve_trace.serve_recorder().flush()
+    path = os.path.join(trace_dir, telemetry.SERVE_TRACE_FILE)
+    if os.path.exists(path):
+        for line in open(path):
+            span = json.loads(line)
+            assert span["attributes"].get("http.route") not in (
+                "healthcheck",
+                "server-version",
+            )
+
+
+def test_sampling_rate_zero_exports_nothing(collection_dir, tmp_path):
+    trace_dir = str(tmp_path / "t0")
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=collection_dir,
+        GORDO_TPU_TELEMETRY="1",
+        GORDO_TPU_TELEMETRY_DIR=trace_dir,
+        GORDO_TPU_TRACE_SAMPLE_RATE="0",
+    ):
+        serve_trace.reset_serve_recorder()
+        app = build_app(config={})
+        client = Client(app)
+        resp = client.get(url("machine-1/metadata"))
+        # trace ids still flow (headers, logs) — only export is gated
+        assert telemetry.parse_traceparent(resp.headers["traceparent"])
+        serve_trace.serve_recorder().flush()
+        path = os.path.join(trace_dir, telemetry.SERVE_TRACE_FILE)
+        assert not os.path.exists(path) or not open(path).read()
+    serve_trace.reset_serve_recorder()
+
+
+# -- the master switch: GORDO_TPU_TELEMETRY=0 on the request hot path --------
+
+
+def test_telemetry_off_writes_zero_files_and_skips_span_export(
+    collection_dir, tmp_path, sensor_payload
+):
+    """The regression test the satellite asks for: with the master
+    switch off the serve path must write NO telemetry files and skip
+    span-export construction entirely — while Server-Timing (reference
+    parity, in-memory only) keeps working."""
+    trace_dir = str(tmp_path / "off-telemetry")
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=collection_dir,
+        GORDO_TPU_TELEMETRY="0",
+        GORDO_TPU_TELEMETRY_DIR=trace_dir,
+        GORDO_TPU_TRACE_SAMPLE_RATE="1.0",
+    ):
+        serve_trace.reset_serve_recorder()
+        # the shared recorder short-circuits to the null recorder —
+        # request handling never constructs an export
+        assert serve_trace.serve_recorder() is telemetry.NULL_RECORDER
+        app = build_app(config={})
+        client = Client(app)
+        resp = client.post(
+            url("machine-1/prediction") + "?profile=1", json=sensor_payload
+        )
+        assert resp.status_code == 200
+        # Server-Timing survives (it predates telemetry and is in-memory)
+        assert "inference" in resp.headers["Server-Timing"]
+        # no telemetry file anywhere under the configured dir
+        assert not os.path.exists(trace_dir)
+    serve_trace.reset_serve_recorder()
+
+
+def test_telemetry_off_engine_skips_trace_construction(
+    collection_dir, tmp_path
+):
+    """The micro-batching engine side of the master switch: no recorder,
+    no BatchItem trace context, no batch spans."""
+    from gordo_tpu.serve import ServeConfig, ServeEngine
+
+    trace_dir = str(tmp_path / "off-engine")
+    with temp_env_vars(
+        GORDO_TPU_TELEMETRY="0", GORDO_TPU_TELEMETRY_DIR=trace_dir
+    ):
+        serve_trace.reset_serve_recorder()
+        engine = ServeEngine(ServeConfig(max_size=4))
+        try:
+            assert engine._recorder is telemetry.NULL_RECORDER
+            assert not os.path.exists(trace_dir)
+        finally:
+            engine.shutdown(drain=False)
+    serve_trace.reset_serve_recorder()

@@ -1,0 +1,195 @@
+"""bench-check regression-gate coverage: metric evaluation semantics,
+tolerance scaling, and the CLI exiting non-zero on an injected
+synthetic regression (the acceptance drill)."""
+
+import json
+
+import pytest
+
+from gordo_tpu.telemetry.benchgate import (
+    GATES,
+    MetricSpec,
+    compare,
+    compare_files,
+    get_path,
+    render_report,
+)
+
+pytestmark = pytest.mark.observability
+
+
+BASELINE = {
+    "bench": "route-observability",
+    "route": {
+        "throughput_rps": 20.0,
+        "p50_ms": 700.0,
+        "attribution_coverage": 0.95,
+    },
+    "scoring_overhead": {"overhead_pct": 1.0},
+}
+
+
+def _candidate(**overrides):
+    doc = json.loads(json.dumps(BASELINE))
+    for path, value in overrides.items():
+        node = doc
+        parts = path.split(".")
+        for part in parts[:-1]:
+            node = node[part]
+        node[parts[-1]] = value
+    return doc
+
+
+def test_get_path():
+    assert get_path(BASELINE, "route.p50_ms") == 700.0
+    assert get_path(BASELINE, "route.missing") is None
+    assert get_path(BASELINE, "nope.deeper") is None
+
+
+def test_identical_run_passes():
+    report = compare(BASELINE, _candidate())
+    assert report["ok"] and report["regressions"] == 0
+
+
+def test_within_tolerance_passes():
+    report = compare(BASELINE, _candidate(**{"route.throughput_rps": 17.0}))
+    assert report["ok"]  # -15% vs 25% tolerance
+
+
+def test_throughput_regression_fails():
+    report = compare(BASELINE, _candidate(**{"route.throughput_rps": 10.0}))
+    assert not report["ok"]
+    (failure,) = [r for r in report["results"] if r["status"] == "regression"]
+    assert failure["path"] == "route.throughput_rps"
+
+
+def test_latency_regression_fails():
+    report = compare(BASELINE, _candidate(**{"route.p50_ms": 1500.0}))
+    assert not report["ok"]
+
+
+def test_budget_bound_is_baseline_independent():
+    report = compare(
+        BASELINE, _candidate(**{"scoring_overhead.overhead_pct": 5.0})
+    )
+    assert not report["ok"]
+    failure = next(r for r in report["results"] if r["status"] == "regression")
+    assert "budget" in failure["detail"]
+
+
+def test_tolerance_scale_loosens_the_gate():
+    candidate = _candidate(**{"route.throughput_rps": 12.0})  # -40%
+    assert not compare(BASELINE, candidate)["ok"]
+    assert compare(BASELINE, candidate, tolerance_scale=2.0)["ok"]
+
+
+def test_tolerance_scale_applies_to_budget_bounds_too():
+    """--tolerance promises 'twice as lenient' for EVERY gate; a budget
+    metric (the noisiest kind — wall-clock overhead deltas) must not
+    veto the loosening."""
+    candidate = _candidate(**{"scoring_overhead.overhead_pct": 3.0})
+    assert not compare(BASELINE, candidate)["ok"]  # budget is 2.0
+    assert compare(BASELINE, candidate, tolerance_scale=2.0)["ok"]  # 4.0
+
+
+def test_missing_candidate_metric_is_a_regression():
+    candidate = _candidate()
+    del candidate["route"]["p50_ms"]
+    report = compare(BASELINE, candidate)
+    assert not report["ok"]
+
+
+def test_missing_baseline_metric_is_skipped_not_failed():
+    baseline = json.loads(json.dumps(BASELINE))
+    del baseline["route"]["attribution_coverage"]
+    report = compare(baseline, _candidate())
+    assert report["ok"]
+    assert any(r["status"] == "skipped" for r in report["results"])
+
+
+def test_bench_mismatch_is_an_error():
+    with pytest.raises(ValueError, match="bench mismatch"):
+        compare(BASELINE, {"bench": "lifecycle-hot-swap"})
+
+
+def test_unknown_bench_is_an_error():
+    with pytest.raises(ValueError, match="no gate specs"):
+        compare({"bench": "x"}, {"bench": "x"})
+
+
+def test_truthy_spec():
+    specs = [MetricSpec("flag", "ok", "truthy")]
+    assert compare({"ok": True}, {"ok": True}, specs=specs)["ok"]
+    assert not compare({"ok": True}, {"ok": False}, specs=specs)["ok"]
+
+
+def test_render_report_names_the_failure():
+    report = compare(BASELINE, _candidate(**{"route.throughput_rps": 1.0}))
+    report["baseline"], report["candidate"] = "b.json", "c.json"
+    text = render_report(report)
+    assert "FAIL" in text and "throughput" in text
+    assert "regression" in text
+
+
+def test_every_gate_has_a_baseline_file():
+    from gordo_tpu.telemetry.benchgate import BASELINE_FILES
+
+    assert set(GATES) == set(BASELINE_FILES)
+
+
+# -- the CLI drill: injected synthetic regression → non-zero exit ------------
+
+
+def _write(path, doc):
+    with open(path, "w") as handle:
+        json.dump(doc, handle)
+
+
+def test_bench_check_cli_gates_synthetic_regression(tmp_path):
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import bench_check
+
+    runner = CliRunner()
+    baseline = tmp_path / "BENCH_ROUTE.json"
+    _write(baseline, BASELINE)
+
+    good = tmp_path / "fresh_good.json"
+    _write(good, _candidate(**{"route.throughput_rps": 21.0}))
+    result = runner.invoke(bench_check, [str(good), "--baseline", str(baseline)])
+    assert result.exit_code == 0, result.output
+
+    # injected regression: throughput halves -> the gate must trip
+    bad = tmp_path / "fresh_bad.json"
+    _write(bad, _candidate(**{"route.throughput_rps": 8.0}))
+    result = runner.invoke(bench_check, [str(bad), "--baseline", str(baseline)])
+    assert result.exit_code != 0
+    assert "FAIL" in result.output
+
+    # --report-only always exits 0 (the CI visibility mode)
+    result = runner.invoke(
+        bench_check, [str(bad), "--baseline", str(baseline), "--report-only"]
+    )
+    assert result.exit_code == 0, result.output
+    assert "FAIL" in result.output
+
+    # --as-json emits the machine-readable report
+    result = runner.invoke(
+        bench_check,
+        [str(bad), "--baseline", str(baseline), "--as-json", "--report-only"],
+    )
+    doc = json.loads(result.output)
+    assert doc["regressions"] >= 1
+
+
+def test_bench_check_cli_finds_committed_baseline_beside_candidate(tmp_path):
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import bench_check
+
+    _write(tmp_path / "BENCH_ROUTE.json", BASELINE)
+    fresh = tmp_path / "fresh.json"
+    _write(fresh, _candidate())
+    result = CliRunner().invoke(bench_check, [str(fresh)])
+    assert result.exit_code == 0, result.output
+    assert "BENCH_ROUTE.json" in result.output

@@ -1,0 +1,141 @@
+"""Sampling-profiler coverage: it observes a busy thread's frames,
+attributes self time to the active stage, costs the target thread no
+instrumentation, and honors the per-request/env toggles."""
+
+import threading
+import time
+
+import pytest
+
+from gordo_tpu.telemetry.profiler import (
+    SAMPLE_RATE_ENV,
+    SamplingProfiler,
+    sample_rate,
+    should_profile,
+)
+
+pytestmark = pytest.mark.observability
+
+
+def _busy_work(duration_s: float):
+    """Spin in THIS frame so samples attribute here."""
+    deadline = time.perf_counter() + duration_s
+    total = 0
+    while time.perf_counter() < deadline:
+        total += sum(range(100))
+    return total
+
+
+def test_profiler_samples_the_target_thread():
+    stage = {"name": "inference"}
+    profiler = SamplingProfiler(interval_s=0.002)
+    profiler.start(stage_getter=lambda: stage["name"])
+    # busy-spin until enough samples landed (the sampling thread can be
+    # starved on a loaded CI host — wall-clock alone is not a bound)
+    deadline = time.perf_counter() + 10.0
+    while profiler._samples < 12 and time.perf_counter() < deadline:
+        _busy_work(0.1)
+    report = profiler.stop()
+    assert report["samples"] > 10
+    assert report["interval_ms"] == 2.0
+    assert report["duration_ms"] >= 100
+    frames = report["frames"]
+    assert frames, "no frames aggregated"
+    # the busy loop dominates self time, attributed to the active stage
+    top = frames[0]
+    assert top["stage"] == "inference"
+    assert "_busy_work" in top["function"]
+    assert top["self_ms"] == pytest.approx(top["samples"] * 2.0)
+
+
+def test_profiler_tracks_stage_transitions():
+    stage = {"name": "a"}
+    profiler = SamplingProfiler(interval_s=0.002)
+    profiler.start(stage_getter=lambda: stage["name"])
+    for name in ("a", "b"):
+        stage["name"] = name
+        deadline = time.perf_counter() + 10.0
+        while (
+            not any(key[0] == name for key in profiler._counts)
+            and time.perf_counter() < deadline
+        ):
+            _busy_work(0.05)
+    report = profiler.stop()
+    stages = {frame["stage"] for frame in report["frames"]}
+    assert {"a", "b"} <= stages
+
+
+def test_profiler_profiles_another_thread_and_misses_after_exit():
+    release = threading.Event()
+
+    def target():
+        release.wait(2.0)
+
+    thread = threading.Thread(target=target)
+    thread.start()
+    profiler = SamplingProfiler(interval_s=0.002)
+    profiler.start(thread_id=thread.ident)
+    deadline = time.perf_counter() + 10.0
+    while profiler._samples < 2 and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    release.set()
+    thread.join()
+    # samples after thread death are "missed", not a crash
+    while profiler._missed < 2 and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    report = profiler.stop()
+    assert report["samples"] > 0
+    assert report["missed"] > 0
+
+
+def test_profiler_stage_getter_failure_is_one_mislabeled_sample():
+    calls = {"n": 0}
+
+    def flaky_stage():
+        calls["n"] += 1
+        if calls["n"] % 2:
+            raise RuntimeError("mid-mutation read")
+        return "ok"
+
+    profiler = SamplingProfiler(interval_s=0.002)
+    profiler.start(stage_getter=flaky_stage)
+    deadline = time.perf_counter() + 10.0
+    while profiler._samples < 6 and time.perf_counter() < deadline:
+        _busy_work(0.05)
+    report = profiler.stop()
+    assert report["samples"] > 5  # the profiler survived the raises
+    assert {"-", "ok"} >= {f["stage"] for f in report["frames"]} or any(
+        f["stage"] in ("-", "ok") for f in report["frames"]
+    )
+
+
+def test_report_truncates_to_max_frames():
+    profiler = SamplingProfiler(interval_s=0.002)
+    profiler._counts = {(f"s{i}", f"f{i}"): i + 1 for i in range(40)}
+    profiler._samples = sum(range(1, 41))
+    report = profiler.report(max_frames=5)
+    assert len(report["frames"]) == 5
+    assert report["truncated_frames"] == 35
+    # heaviest first
+    assert report["frames"][0]["samples"] == 40
+
+
+def test_should_profile_explicit_param_wins(monkeypatch):
+    monkeypatch.delenv(SAMPLE_RATE_ENV, raising=False)
+    assert should_profile("1")
+    assert should_profile("true")
+    assert should_profile("device")
+    assert not should_profile("0")
+    assert not should_profile("off")
+    assert not should_profile(None)  # no rate configured
+
+
+def test_sample_rate_env(monkeypatch):
+    monkeypatch.setenv(SAMPLE_RATE_ENV, "0.25")
+    assert sample_rate() == 0.25
+    monkeypatch.setenv(SAMPLE_RATE_ENV, "7")  # clamped
+    assert sample_rate() == 1.0
+    monkeypatch.setenv(SAMPLE_RATE_ENV, "not-a-number")
+    assert sample_rate() == 0.0
+    monkeypatch.setenv(SAMPLE_RATE_ENV, "1")
+    assert should_profile(None)  # every request sampled at rate 1

@@ -1,0 +1,107 @@
+"""Trace-sink rotation: a months-lived process's telemetry footprint is
+capped at ~(keep+1) * max_bytes per sink, and everything downstream
+(readers, artifact discovery) understands rotated generations."""
+
+import json
+import os
+
+import pytest
+
+from gordo_tpu.telemetry import KEEP_ENV, MAX_BYTES_ENV, SpanRecorder
+from gordo_tpu.telemetry.trace_analysis import read_trace
+
+pytestmark = pytest.mark.observability
+
+
+def _fill(rec, n, name="s"):
+    for i in range(n):
+        with rec.span(name, i=i, pad="x" * 200):
+            pass
+
+
+def test_sink_rotates_at_max_bytes(tmp_path):
+    sink = str(tmp_path / "trace.jsonl")
+    rec = SpanRecorder(sink_path=sink, max_bytes=4096, keep=2)
+    _fill(rec, 60)
+    rec.close()
+    files = sorted(os.listdir(tmp_path))
+    assert "trace.jsonl" in files or "trace.jsonl.1" in files
+    assert "trace.jsonl.1" in files
+    # never more than keep rotated generations
+    rotated = [f for f in files if f.startswith("trace.jsonl.")]
+    assert len(rotated) <= 2
+    for name in rotated:
+        assert json.loads((tmp_path / name).read_text().splitlines()[0])
+
+
+def test_rotation_bounds_total_footprint(tmp_path):
+    sink = str(tmp_path / "trace.jsonl")
+    rec = SpanRecorder(sink_path=sink, max_bytes=2048, keep=1)
+    _fill(rec, 300)
+    rec.close()
+    total = sum(
+        (tmp_path / f).stat().st_size for f in os.listdir(tmp_path)
+    )
+    # keep+1 generations, each at most max_bytes plus one span of slop
+    assert total < 3 * 2048
+
+
+def test_keep_zero_truncates_instead_of_rotating(tmp_path):
+    sink = str(tmp_path / "trace.jsonl")
+    rec = SpanRecorder(sink_path=sink, max_bytes=2048, keep=0)
+    _fill(rec, 100)
+    rec.close()
+    files = os.listdir(tmp_path)
+    assert all(not f.startswith("trace.jsonl.") for f in files)
+
+
+def test_zero_max_bytes_disables_rotation(tmp_path):
+    sink = str(tmp_path / "trace.jsonl")
+    rec = SpanRecorder(sink_path=sink, max_bytes=0, keep=3)
+    _fill(rec, 100)
+    rec.close()
+    assert os.listdir(tmp_path) == ["trace.jsonl"]
+
+
+def test_env_knobs_configure_rotation(tmp_path, monkeypatch):
+    monkeypatch.setenv(MAX_BYTES_ENV, "4096")
+    monkeypatch.setenv(KEEP_ENV, "1")
+    rec = SpanRecorder(sink_path=str(tmp_path / "t.jsonl"))
+    assert rec.max_bytes == 4096 and rec.keep == 1
+    monkeypatch.setenv(MAX_BYTES_ENV, "garbage")
+    rec2 = SpanRecorder(sink_path=str(tmp_path / "t2.jsonl"))
+    assert rec2.max_bytes > 4096  # fell back to the default
+
+
+def test_read_trace_spans_rotated_generations_oldest_first(tmp_path):
+    sink = str(tmp_path / "trace.jsonl")
+    # sized so 120 spans span ~3 generations, all inside keep=3
+    rec = SpanRecorder(sink_path=sink, max_bytes=16384, keep=3)
+    _fill(rec, 120)
+    rec.close()
+    spans = list(read_trace(sink))
+    indices = [s["attributes"]["i"] for s in spans]
+    assert indices == sorted(indices), "rotated files must read in order"
+    assert len(indices) > 60  # rotation kept more than one file's worth
+
+
+def test_rotated_trace_files_are_builder_droppings():
+    from gordo_tpu.serializer import is_builder_dropping
+
+    assert is_builder_dropping("build_trace.jsonl")
+    assert is_builder_dropping("build_trace.jsonl.1")
+    assert is_builder_dropping("serve_trace.jsonl")
+    assert is_builder_dropping("serve_trace.jsonl.3")
+    assert not is_builder_dropping("my-model")
+
+
+def test_async_sink_rotates_and_flushes(tmp_path):
+    sink = str(tmp_path / "trace.jsonl")
+    rec = SpanRecorder(
+        sink_path=sink, max_bytes=4096, keep=2, async_sink=True
+    )
+    _fill(rec, 80)
+    rec.flush()
+    files = sorted(os.listdir(tmp_path))
+    assert any(f.startswith("trace.jsonl.") for f in files)
+    rec.close()

@@ -1,0 +1,177 @@
+"""Trace-analysis coverage: span summaries, the request breakdown with
+attribution coverage, profile-frame aggregation, and the ``gordo-tpu
+trace`` CLI over a synthetic serve trace."""
+
+import json
+
+import pytest
+
+from gordo_tpu.telemetry.trace_analysis import (
+    analyze_trace,
+    percentile,
+    read_trace,
+    render_analysis,
+    request_breakdown,
+    summarize_spans,
+    top_profile_frames,
+)
+
+pytestmark = pytest.mark.observability
+
+
+def _span(name, duration_ms, trace_id, span_id, parent_id=None, kind="internal",
+          attributes=None, **extra):
+    return {
+        "name": name,
+        "context": {"trace_id": trace_id, "span_id": span_id},
+        "parent_id": parent_id,
+        "kind": kind,
+        "start_time": "2026-01-01T00:00:00+00:00",
+        "end_time": "2026-01-01T00:00:01+00:00",
+        "duration_ms": duration_ms,
+        "status": {"status_code": "OK"},
+        "attributes": attributes or {},
+        "resource": {"service.name": "test"},
+        **extra,
+    }
+
+
+def _request(i, wall_ms, stages):
+    trace_id = f"{i:032x}"
+    span_id = f"{i:016x}"
+    spans = [
+        _span("request", wall_ms, trace_id, span_id, kind="server")
+    ]
+    for j, (stage, ms) in enumerate(stages.items()):
+        spans.append(
+            _span(stage, ms, trace_id, f"{i}{j:015x}", parent_id=span_id)
+        )
+    return spans
+
+
+@pytest.fixture
+def synthetic_trace(tmp_path):
+    spans = []
+    # 9 well-instrumented requests + 1 with a big unattributed gap
+    for i in range(1, 10):
+        wall = 100.0 + i
+        spans.extend(
+            _request(
+                i,
+                wall,
+                {
+                    "data_decode": 30.0,
+                    "inference": 40.0 + i,
+                    "serialize": 25.0,
+                },
+            )
+        )
+    spans.extend(_request(10, 500.0, {"inference": 50.0}))
+    # a profile span and a batch span (neither is a request stage)
+    spans.append(
+        _span(
+            "profile",
+            50.0,
+            f"{1:032x}",
+            "f" * 16,
+            parent_id=f"{1:016x}",
+            attributes={
+                "frames": [
+                    {"stage": "inference", "function": "a.py:f", "samples": 8,
+                     "self_ms": 40.0},
+                    {"stage": "serialize", "function": "b.py:g", "samples": 2,
+                     "self_ms": 10.0},
+                ]
+            },
+        )
+    )
+    spans.append(_span("serve_batch", 12.0, "e" * 32, "e" * 16))
+    path = tmp_path / "serve_trace.jsonl"
+    with open(path, "w") as f:
+        for span in spans:
+            f.write(json.dumps(span) + "\n")
+        f.write("not json\n")  # torn tail line must be skipped
+    return str(path)
+
+
+def test_percentile_nearest_rank():
+    values = sorted(float(v) for v in range(1, 101))
+    assert percentile(values, 0.5) == pytest.approx(51.0, abs=1.0)
+    assert percentile(values, 0.99) == pytest.approx(99.0, abs=1.0)
+    assert percentile([], 0.5) == 0.0
+
+
+def test_read_trace_skips_torn_lines(synthetic_trace):
+    spans = list(read_trace(synthetic_trace))
+    assert all(isinstance(s, dict) for s in spans)
+    assert any(s["name"] == "request" for s in spans)
+
+
+def test_summarize_spans(synthetic_trace):
+    summary = summarize_spans(read_trace(synthetic_trace))
+    assert summary["request"]["count"] == 10
+    assert summary["inference"]["count"] == 10
+    assert summary["serve_batch"]["p50_ms"] == 12.0
+
+
+def test_request_breakdown_attribution(synthetic_trace):
+    breakdown = request_breakdown(read_trace(synthetic_trace))
+    assert breakdown["requests"] == 10
+    # median request is one of the ~105ms well-instrumented ones
+    assert 100 <= breakdown["walltime_p50_ms"] <= 110
+    stages = breakdown["stages"]
+    assert set(stages) == {"data_decode", "inference", "serialize"}
+    # ~95ms attributed out of ~105ms walltime for 9 of 10 requests
+    assert 0.85 <= breakdown["attribution_coverage"] <= 1.0
+    # the profile span is NOT a stage
+    assert "profile" not in stages
+    # critical path is the median request's stages, longest first
+    path_stages = [step["stage"] for step in breakdown["critical_path"]]
+    assert path_stages[0] == "inference"
+    assert set(path_stages) == set(stages)
+
+
+def test_request_breakdown_none_without_requests(tmp_path):
+    path = tmp_path / "t.jsonl"
+    path.write_text(json.dumps(_span("build_phase", 5.0, "a" * 32, "b" * 16)) + "\n")
+    assert request_breakdown(read_trace(str(path))) is None
+
+
+def test_top_profile_frames(synthetic_trace):
+    frames = top_profile_frames(read_trace(synthetic_trace))
+    assert frames[0]["function"] == "a.py:f"
+    assert frames[0]["self_ms"] == 40.0
+    assert frames[0]["stage"] == "inference"
+
+
+def test_analyze_and_render(synthetic_trace):
+    doc = analyze_trace(synthetic_trace)
+    text = render_analysis(doc)
+    assert "attribution coverage" in text
+    assert "critical path" in text
+    assert "inference" in text
+    json.dumps(doc)  # --as-json must always serialize
+
+
+def test_trace_cli(synthetic_trace, tmp_path):
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import trace as trace_cmd
+
+    runner = CliRunner()
+    # file target
+    result = runner.invoke(trace_cmd, [synthetic_trace])
+    assert result.exit_code == 0, result.output
+    assert "attribution coverage" in result.output
+    # directory target
+    result = runner.invoke(trace_cmd, [str(tmp_path)])
+    assert result.exit_code == 0, result.output
+    # --as-json round-trips
+    result = runner.invoke(trace_cmd, [synthetic_trace, "--as-json"])
+    assert result.exit_code == 0
+    doc = json.loads(result.output)
+    assert doc["request_breakdown"]["requests"] == 10
+    # missing target is a clean error, not a traceback
+    result = runner.invoke(trace_cmd, [str(tmp_path / "nope")])
+    assert result.exit_code != 0
+    assert "No such trace" in result.output

@@ -1,0 +1,171 @@
+"""W3C trace-context unit coverage: traceparent parse/format, the
+contextvar binding + log stamping, and the recorder's trace-identity
+extensions (explicit trace id, default parent, links, emit)."""
+
+import logging
+
+import pytest
+
+from gordo_tpu.telemetry import (
+    SpanRecorder,
+    bind_trace,
+    current_trace_id,
+    format_traceparent,
+    parse_traceparent,
+    new_span_id,
+    new_trace_id,
+)
+from gordo_tpu.telemetry.tracing import TraceIdFilter
+
+pytestmark = pytest.mark.observability
+
+TRACE = "0af7651916cd43dd8448eb211c80319c"
+SPAN = "b7ad6b7169203331"
+
+
+def test_parse_traceparent_roundtrip():
+    header = format_traceparent(TRACE, SPAN)
+    assert header == f"00-{TRACE}-{SPAN}-01"
+    ctx = parse_traceparent(header)
+    assert ctx.trace_id == TRACE and ctx.span_id == SPAN
+
+
+def test_parse_traceparent_rejects_malformed():
+    for bad in (
+        None,
+        "",
+        "garbage",
+        "00-short-span-01",
+        f"00-{'0' * 32}-{SPAN}-01",  # all-zero trace id is invalid
+        f"00-{TRACE}-{'0' * 16}-01",  # all-zero span id is invalid
+        f"ff-{TRACE}-{SPAN}-01",  # unknown version
+        f"00-{TRACE.upper()}-{SPAN}-XX",
+    ):
+        assert parse_traceparent(bad) is None, bad
+
+
+def test_parse_traceparent_tolerates_case_and_whitespace():
+    header = f"  00-{TRACE.upper()}-{SPAN.upper()}-01  "
+    ctx = parse_traceparent(header)
+    assert ctx is not None and ctx.trace_id == TRACE
+
+
+def test_id_shapes():
+    assert len(new_trace_id()) == 32
+    assert len(new_span_id()) == 16
+    assert new_trace_id() != new_trace_id()
+
+
+def test_bind_trace_scopes_the_contextvar():
+    assert current_trace_id() == ""
+    with bind_trace(TRACE):
+        assert current_trace_id() == TRACE
+        with bind_trace("b" * 32):
+            assert current_trace_id() == "b" * 32
+        assert current_trace_id() == TRACE
+    assert current_trace_id() == ""
+
+
+def test_trace_id_filter_stamps_records():
+    record = logging.LogRecord("t", logging.INFO, "f", 1, "msg", (), None)
+    filt = TraceIdFilter()
+    assert filt.filter(record)
+    assert record.trace_id == "-"
+    with bind_trace(TRACE):
+        record2 = logging.LogRecord("t", logging.INFO, "f", 1, "msg", (), None)
+        filt.filter(record2)
+        assert record2.trace_id == TRACE
+
+
+def test_log_record_factory_stamps_in_request_messages():
+    """install_trace_log_stamping works process-wide through the record
+    factory — a CHILD module logger's messages carry the bound trace id
+    (a plain logger filter would not inherit to children)."""
+    from gordo_tpu.telemetry.tracing import install_trace_log_stamping
+
+    install_trace_log_stamping()
+    child = logging.getLogger("gordo_tpu.some.deep.module")
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = Capture()
+    child.addHandler(handler)
+    try:
+        with bind_trace(TRACE):
+            child.warning("inside request %s", "x")
+        child.warning("outside request")
+    finally:
+        child.removeHandler(handler)
+    inside, outside = records
+    assert f"trace_id={TRACE}" in inside.getMessage()
+    assert inside.trace_id == TRACE
+    assert "trace_id=" not in outside.getMessage()
+    assert outside.trace_id == "-"
+
+
+# -- recorder trace-identity extensions --------------------------------------
+
+
+def test_recorder_adopts_explicit_trace_id():
+    rec = SpanRecorder(trace_id=TRACE)
+    with rec.span("stage"):
+        pass
+    (span,) = rec.finished()
+    assert span["context"]["trace_id"] == TRACE
+
+
+def test_default_parent_id_roots_spans_under_the_request_span():
+    rec = SpanRecorder(trace_id=TRACE)
+    rec.default_parent_id = SPAN
+    with rec.span("outer"):
+        with rec.span("inner"):
+            pass
+    rec.record("external", 0.01)
+    rec.event("mark")
+    inner, outer, external, mark = (
+        rec.finished("inner")[0],
+        rec.finished("outer")[0],
+        rec.finished("external")[0],
+        rec.finished("mark")[0],
+    )
+    # top-level spans parent onto the request span; nesting still wins
+    assert outer["parent_id"] == SPAN
+    assert inner["parent_id"] == outer["context"]["span_id"]
+    assert external["parent_id"] == SPAN
+    assert mark["parent_id"] == SPAN
+
+
+def test_span_links_carry_foreign_trace_context():
+    rec = SpanRecorder()
+    with rec.span("serve_batch") as handle:
+        handle.link(TRACE, SPAN, name="machine-1", queue_wait_ms=1.5)
+        handle.link("c" * 32, "d" * 16)
+    (span,) = rec.finished()
+    assert span["links"][0]["context"] == {
+        "trace_id": TRACE,
+        "span_id": SPAN,
+    }
+    assert span["links"][0]["attributes"]["name"] == "machine-1"
+    assert "attributes" not in span["links"][1]
+    # spans without links stay link-free (schema stability)
+    with rec.span("plain"):
+        pass
+    assert "links" not in rec.finished("plain")[0]
+
+
+def test_emit_records_prebuilt_spans(tmp_path):
+    import json
+
+    sink = tmp_path / "t.jsonl"
+    shared = SpanRecorder(sink_path=str(sink))
+    request = SpanRecorder(trace_id=TRACE)
+    with request.span("stage"):
+        pass
+    for span in request.finished():
+        shared.emit(span)
+    written = json.loads(sink.read_text().splitlines()[0])
+    # the emitted span keeps ITS trace id, not the shared recorder's
+    assert written["context"]["trace_id"] == TRACE
