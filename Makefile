@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve test-planner test-lifecycle test-lifecycle-faults docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-check lint image
+.PHONY: test test-fast test-faults test-observability test-serve test-planner test-lifecycle test-lifecycle-faults test-analysis docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-check lint lint-gordo image
 
 test:
 	python -m pytest tests/ -q
@@ -89,6 +89,21 @@ image:
 
 docs:
 	python docs/generate_api.py docs/api
+	python docs/generate_env_docs.py
+
+# The invariant gate (gordo_tpu/analysis/): layering arrows, JAX
+# hazards, env-knob registry, atomic writes, clock discipline, and
+# Prometheus cardinality over gordo_tpu/ itself — non-zero exit on any
+# finding that is neither suppressed in-file nor justified in
+# lint_baseline.json. CI's `lint` job runs exactly this.
+lint-gordo:
+	python -m gordo_tpu lint
+
+# The static-analysis test suite: per-rule fixture trees, suppression/
+# baseline semantics, and the tier-1 self-run asserting gordo_tpu/ is
+# clean against the committed baseline.
+test-analysis:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m analysis
 
 bench:
 	python bench.py

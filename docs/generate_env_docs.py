@@ -1,0 +1,91 @@
+"""
+Env-knob reference generator: renders the knob registry
+(``gordo_tpu.utils.env.KNOBS``) into the section of
+``docs/configuration.md`` between the ``<!-- env-knobs:begin -->`` /
+``<!-- env-knobs:end -->`` markers, one table per registry section.
+
+Usage:  python docs/generate_env_docs.py          (rewrite in place)
+        python docs/generate_env_docs.py --check  (exit 1 when stale)
+
+The emitted block is committed; tests/analysis/test_env_docs.py runs the
+``--check`` mode, so adding a knob to the registry without regenerating
+fails the suite — the table cannot drift from the code.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from gordo_tpu.utils.env import KNOBS, knob_sections  # noqa: E402
+
+CONFIG_MD = Path(__file__).resolve().parent / "configuration.md"
+BEGIN = "<!-- env-knobs:begin -->"
+END = "<!-- env-knobs:end -->"
+
+
+def _default_cell(knob) -> str:
+    if knob.default is None:
+        return "_(unset)_"
+    if knob.type == "bool":
+        return "`1`" if knob.default else "`0`"
+    return f"`{knob.default}`"
+
+
+def render_block() -> str:
+    lines = [
+        BEGIN,
+        "",
+        "_Generated from the knob registry in `gordo_tpu/utils/env.py` by "
+        "`python docs/generate_env_docs.py` — edit the registry, not this "
+        "block. Every knob is read through the typed accessors there "
+        "(malformed values warn once and fall back to the default), and "
+        "`gordo-tpu lint` fails on reads of undeclared knobs._",
+        "",
+    ]
+    for section in knob_sections():
+        knobs = [k for k in KNOBS.values() if k.section == section]
+        lines.append(f"**{section} knobs**:")
+        lines.append("")
+        lines.append("| Variable | Type | Default | Effect |")
+        lines.append("|---|---|---|---|")
+        for knob in knobs:
+            doc = " ".join(knob.doc.split())
+            lines.append(
+                f"| `{knob.name}` | {knob.type} | {_default_cell(knob)} | {doc} |"
+            )
+        lines.append("")
+    lines.append(END)
+    return "\n".join(lines)
+
+
+def spliced_document() -> str:
+    text = CONFIG_MD.read_text(encoding="utf-8")
+    if BEGIN not in text or END not in text:
+        raise SystemExit(
+            f"{CONFIG_MD} is missing the {BEGIN} / {END} markers"
+        )
+    head, rest = text.split(BEGIN, 1)
+    _, tail = rest.split(END, 1)
+    return head + render_block() + tail
+
+
+def main() -> int:
+    fresh = spliced_document()
+    if "--check" in sys.argv[1:]:
+        if fresh != CONFIG_MD.read_text(encoding="utf-8"):
+            print(
+                "docs/configuration.md env-knob block is stale — run "
+                "`python docs/generate_env_docs.py` (or `make docs`)",
+                file=sys.stderr,
+            )
+            return 1
+        print("env-knob block is up to date")
+        return 0
+    CONFIG_MD.write_text(fresh, encoding="utf-8")
+    print(f"regenerated env-knob block in {CONFIG_MD}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

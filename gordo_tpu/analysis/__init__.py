@@ -1,0 +1,42 @@
+"""
+Project-specific static analysis (``gordo-tpu lint``): an AST rule
+engine that enforces the codebase's load-bearing invariants in CI —
+layering arrows, JAX dispatch hazards, the env-knob registry contract,
+atomic artifact writes, monotonic-clock deadline math, and Prometheus
+label cardinality. See ``docs/static-analysis.md`` for the rule catalog,
+suppression (``# gt-lint: disable=<rule>``) and baseline semantics, and
+the how-to-add-a-rule guide.
+"""
+
+from .baseline import (
+    BASELINE_FILENAME,
+    BaselineEntry,
+    BaselineError,
+    default_baseline_path,
+    load_baseline,
+    split_by_baseline,
+    write_baseline,
+)
+from .contracts import Contracts, LayeringArrow, load_contracts
+from .core import Finding, LintResult, run_lint
+from .report import lint_document, render_report
+from .rules import default_rules
+
+__all__ = [
+    "BASELINE_FILENAME",
+    "BaselineEntry",
+    "BaselineError",
+    "Contracts",
+    "Finding",
+    "LayeringArrow",
+    "LintResult",
+    "default_baseline_path",
+    "default_rules",
+    "lint_document",
+    "load_baseline",
+    "load_contracts",
+    "render_report",
+    "run_lint",
+    "split_by_baseline",
+    "write_baseline",
+]

@@ -1,0 +1,152 @@
+"""
+Shared AST plumbing for the lint rules: parent links, dotted-name
+rendering, import resolution (absolute AND relative, module-level AND
+lazy in-function), and env-knob name resolution through module-level
+constants.
+"""
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+PARENT_ATTR = "_gt_parent"
+
+
+def annotate_parents(tree: ast.Module) -> ast.Module:
+    """Stamp every node with a ``_gt_parent`` link (idempotent)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            setattr(child, PARENT_ATTR, node)
+    return tree
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, PARENT_ATTR, None)
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    current = parent(node)
+    while current is not None:
+        yield current
+        current = parent(current)
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    """Nearest enclosing FunctionDef/AsyncFunctionDef/Lambda."""
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return anc
+    return None
+
+
+def enclosing_statement(node: ast.AST) -> ast.AST:
+    """The nearest ancestor (or the node itself) that is a statement."""
+    current: ast.AST = node
+    while not isinstance(current, ast.stmt):
+        up = parent(current)
+        if up is None:
+            return current
+        current = up
+    return current
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    current = node
+    while isinstance(current, ast.Attribute):
+        parts.append(current.attr)
+        current = current.value
+    if isinstance(current, ast.Name):
+        parts.append(current.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_relative(module: str, is_package: bool, level: int, target: str) -> str:
+    """Absolute module named by ``from <level dots><target> import ...``.
+
+    ``module`` is the importing module's dotted name, ``is_package``
+    whether it is a package ``__init__``.
+    """
+    if level == 0:
+        return target
+    base_parts = module.split(".")
+    # level 1 from a plain module strips the module segment; from a
+    # package __init__ it names the package itself
+    strip = level - 1 if is_package else level
+    if strip:
+        base_parts = base_parts[:-strip] if strip < len(base_parts) else []
+    base = ".".join(base_parts)
+    if target:
+        return f"{base}.{target}" if base else target
+    return base
+
+
+def iter_imports(
+    tree: ast.Module, module: str, is_package: bool
+) -> Iterator[Tuple[ast.stmt, str]]:
+    """Yield (import node, absolute imported-module candidate).
+
+    ``from X import y`` yields both ``X`` and ``X.y`` — ``y`` may be a
+    submodule, and a forbidden-prefix check must see it either way.
+    """
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield node, alias.name
+        elif isinstance(node, ast.ImportFrom):
+            base = resolve_relative(module, is_package, node.level, node.module or "")
+            yield node, base
+            for alias in node.names:
+                if alias.name != "*":
+                    yield node, f"{base}.{alias.name}" if base else alias.name
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Dotted name of a call's callee, e.g. ``os.environ.get``."""
+    return dotted_name(call.func)
+
+
+def first_arg(call: ast.Call) -> Optional[ast.expr]:
+    return call.args[0] if call.args else None
+
+
+def resolve_string(
+    node: Optional[ast.expr], local_constants: dict, global_constants: dict
+) -> Optional[str]:
+    """A string literal, or a Name/Attribute resolving to a module-level
+    string constant (file-local table first, then the cross-file table)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    name = dotted_name(node)
+    if name is None:
+        return None
+    if name in local_constants:
+        return local_constants[name]
+    if name in global_constants:
+        return global_constants[name]
+    # `telemetry.TRACE_DIR_ENV` where the constant is re-exported: fall
+    # back to the bare trailing name (ambiguous names are dropped from
+    # the table, so this can't mis-resolve to a conflicting value)
+    return global_constants.get(name.rsplit(".", 1)[-1])
+
+
+def module_string_constants(tree: ast.Module) -> dict:
+    """Module-level ``NAME = "literal"`` assignments of this file."""
+    table = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            value = node.value
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value = node.value
+            targets = [node.target]
+        else:
+            continue
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            for target in targets:
+                if isinstance(target, ast.Name):
+                    table[target.id] = value.value
+    return table

@@ -1,0 +1,128 @@
+"""
+The committed lint baseline: grandfathered findings that are understood,
+justified, and intentionally not fixed. Matching is by (rule, path,
+fingerprint) — fingerprints hash message + occurrence index, not line
+numbers, so unrelated edits above a baselined finding don't un-match it.
+
+Every entry MUST carry a non-empty ``justification``; loading a baseline
+with an unjustified entry is an error (the whole point is that the
+reasoning lives next to the exemption, not in a reviewer's head).
+"""
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding
+
+BASELINE_FILENAME = "lint_baseline.json"
+BASELINE_VERSION = 1
+
+
+@dataclass(frozen=True)
+class BaselineEntry:
+    rule: str
+    path: str
+    fingerprint: str
+    justification: str
+
+
+class BaselineError(ValueError):
+    """Malformed or unjustified baseline document."""
+
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    """Parse and validate a baseline file; missing file = empty baseline."""
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path, encoding="utf-8") as handle:
+            doc = json.load(handle)
+    except ValueError as exc:
+        raise BaselineError(f"unparseable baseline {path}: {exc}")
+    if not isinstance(doc, dict) or doc.get("version") != BASELINE_VERSION:
+        raise BaselineError(
+            f"baseline {path} must be a dict with version={BASELINE_VERSION}"
+        )
+    entries: List[BaselineEntry] = []
+    for i, raw in enumerate(doc.get("entries", ())):
+        entry = BaselineEntry(
+            rule=str(raw.get("rule", "")),
+            path=str(raw.get("path", "")),
+            fingerprint=str(raw.get("fingerprint", "")),
+            justification=str(raw.get("justification", "")).strip(),
+        )
+        if not (entry.rule and entry.path and entry.fingerprint):
+            raise BaselineError(
+                f"baseline entry #{i} is missing rule/path/fingerprint"
+            )
+        if not entry.justification:
+            raise BaselineError(
+                f"baseline entry #{i} ({entry.rule} @ {entry.path}) has no "
+                "justification — every grandfathered finding must say why"
+            )
+        entries.append(entry)
+    return entries
+
+
+def split_by_baseline(
+    findings: List[Finding], entries: List[BaselineEntry]
+) -> Tuple[List[Finding], List[Finding], List[BaselineEntry]]:
+    """(new findings, baselined findings, stale entries)."""
+    table: Dict[Tuple[str, str, str], BaselineEntry] = {
+        (e.rule, e.path, e.fingerprint): e for e in entries
+    }
+    new: List[Finding] = []
+    matched: List[Finding] = []
+    used = set()
+    for finding in findings:
+        key = (finding.rule, finding.path, finding.fingerprint)
+        if key in table:
+            matched.append(finding)
+            used.add(key)
+        else:
+            new.append(finding)
+    stale = [entry for key, entry in table.items() if key not in used]
+    return new, matched, stale
+
+
+def write_baseline(
+    path: str,
+    findings: List[Finding],
+    justification: str,
+    existing: Optional[List[BaselineEntry]] = None,
+) -> None:
+    """Write a baseline covering ``findings`` (the --update-baseline
+    path). Findings already present in ``existing`` KEEP their
+    hand-written justifications — only genuinely new entries get the
+    shared placeholder ``justification`` to hand-edit."""
+    kept: Dict[Tuple[str, str, str], str] = {
+        (e.rule, e.path, e.fingerprint): e.justification
+        for e in (existing or [])
+    }
+    doc = {
+        "version": BASELINE_VERSION,
+        "entries": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "fingerprint": f.fingerprint,
+                "line": f.line,  # informational; matching ignores it
+                "message": f.message,  # informational
+                "justification": kept.get(
+                    (f.rule, f.path, f.fingerprint), justification
+                ),
+            }
+            for f in findings
+        ],
+    }
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as handle:
+        json.dump(doc, handle, indent=1, sort_keys=True)
+        handle.write("\n")
+    os.replace(tmp, path)
+
+
+def default_baseline_path(root: Optional[str] = None) -> str:
+    return os.path.join(root or os.getcwd(), BASELINE_FILENAME)

@@ -1,0 +1,163 @@
+"""
+Typed view over ``contracts.toml`` — the declared invariants the lint
+rules enforce (layering arrows, jax-hazard scopes, the env-knob accessor
+contract, atomic-write scopes, clock and prometheus heuristics).
+"""
+
+import ast as _ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+try:  # Python >= 3.11
+    import tomllib
+except ImportError:  # pragma: no cover - exercised on 3.10 images
+    tomllib = None
+
+DEFAULT_CONTRACTS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "contracts.toml"
+)
+
+
+@dataclass(frozen=True)
+class LayeringArrow:
+    """``module`` (and its subtree) may not import from ``forbidden``."""
+
+    module: str
+    forbidden: Tuple[str, ...]
+    reason: str = ""
+
+
+@dataclass
+class Contracts:
+    """Every contract document, with file defaults where a key is absent."""
+
+    arrows: List[LayeringArrow] = field(default_factory=list)
+    jax_sync_scopes: Tuple[str, ...] = ()
+    jax_sync_allowed_functions: Tuple[str, ...] = ()
+    jax_stdlib_only: Tuple[str, ...] = ()
+    jax_heavy_modules: Tuple[str, ...] = ()
+    env_prefix: str = "GORDO_TPU_"
+    env_accessor_module: str = "gordo_tpu.utils.env"
+    env_accessors: Tuple[str, ...] = (
+        "env_int",
+        "env_float",
+        "env_bool",
+        "env_str",
+        "env_raw",
+    )
+    atomic_scopes: Tuple[str, ...] = ()
+    atomic_allowed_functions: Tuple[str, ...] = ()
+    clock_suspect_names: str = "deadline|timeout|expir|backoff|cutoff"
+    prometheus_scopes: Tuple[str, ...] = ()
+    prometheus_tainted_roots: Tuple[str, ...] = ("request",)
+
+
+def _parse_toml_subset(text: str) -> Dict:
+    """
+    Minimal TOML reader for ``contracts.toml`` when ``tomllib`` is
+    unavailable (Python 3.10 images; installs are off the table — the
+    same shim pattern as ``utils/json_compat.py``). Supports exactly what
+    the contracts file uses: ``[table]`` / ``[[array.of.tables]]``
+    headers, ``key = "string"``, and ``key = [..multi-line string
+    array..]``. Values are parsed with ``ast.literal_eval`` after
+    normalizing the array across continuation lines.
+    """
+    doc: Dict = {}
+    current: Dict = doc
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if not line or line.startswith("#"):
+            continue
+        array_header = re.fullmatch(r"\[\[([\w.\-]+)\]\]", line)
+        table_header = re.fullmatch(r"\[([\w.\-]+)\]", line)
+        if array_header:
+            parts = array_header.group(1).split(".")
+            node = doc
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            entries = node.setdefault(parts[-1], [])
+            current = {}
+            entries.append(current)
+            continue
+        if table_header:
+            parts = table_header.group(1).split(".")
+            node = doc
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            current = node.setdefault(parts[-1], {})
+            continue
+        match = re.match(r"([\w\-]+)\s*=\s*(.*)$", line)
+        if not match:
+            raise ValueError(f"contracts.toml subset parser: bad line {line!r}")
+        key, value = match.group(1), match.group(2)
+        # pull in continuation lines until the array literal balances
+        while value.count("[") > value.count("]"):
+            if i >= len(lines):
+                raise ValueError(f"unterminated array for key {key!r}")
+            extra = lines[i].split("#", 1)[0].strip() if "#" in lines[i] else lines[i].strip()
+            value += " " + extra
+            i += 1
+        value = value.strip()
+        if not value.startswith("["):
+            # strip trailing comments off scalar values
+            value = value.split("  #", 1)[0].strip()
+        current[key] = _ast.literal_eval(value.rstrip(","))
+    return doc
+
+
+def load_contracts(path: Optional[str] = None) -> Contracts:
+    """Parse a contracts file (default: the committed ``contracts.toml``)."""
+    if tomllib is not None:
+        with open(path or DEFAULT_CONTRACTS_PATH, "rb") as handle:
+            doc: Dict = tomllib.load(handle)
+    else:
+        with open(path or DEFAULT_CONTRACTS_PATH, encoding="utf-8") as handle:
+            doc = _parse_toml_subset(handle.read())
+    layering = doc.get("layering", {})
+    arrows = [
+        LayeringArrow(
+            module=str(entry["module"]),
+            forbidden=tuple(entry.get("forbidden", ())),
+            reason=str(entry.get("reason", "")),
+        )
+        for entry in layering.get("arrows", ())
+    ]
+    jax = doc.get("jax", {})
+    env = doc.get("env", {})
+    atomic = doc.get("atomic", {})
+    clock = doc.get("clock", {})
+    prometheus = doc.get("prometheus", {})
+    defaults = Contracts()
+    return Contracts(
+        arrows=arrows,
+        jax_sync_scopes=tuple(jax.get("sync_scopes", ())),
+        jax_sync_allowed_functions=tuple(jax.get("sync_allowed_functions", ())),
+        jax_stdlib_only=tuple(jax.get("stdlib_only", ())),
+        jax_heavy_modules=tuple(jax.get("heavy_modules", ())),
+        env_prefix=str(env.get("prefix", defaults.env_prefix)),
+        env_accessor_module=str(
+            env.get("accessor_module", defaults.env_accessor_module)
+        ),
+        env_accessors=tuple(env.get("accessors", defaults.env_accessors)),
+        atomic_scopes=tuple(atomic.get("scopes", ())),
+        atomic_allowed_functions=tuple(atomic.get("allowed_functions", ())),
+        clock_suspect_names=str(
+            clock.get("suspect_names", defaults.clock_suspect_names)
+        ),
+        prometheus_scopes=tuple(prometheus.get("scopes", ())),
+        prometheus_tainted_roots=tuple(
+            prometheus.get("tainted_roots", defaults.prometheus_tainted_roots)
+        ),
+    )
+
+
+def in_scope(module: str, scopes: Tuple[str, ...]) -> bool:
+    """True when ``module`` is one of ``scopes`` or inside one."""
+    return any(
+        module == scope or module.startswith(scope + ".") for scope in scopes
+    )

@@ -1,0 +1,314 @@
+"""
+The lint engine: source loading, suppression comments, rule running, and
+finding fingerprints.
+
+A *rule* is a callable object with ``name``/``description`` that yields
+:class:`Finding` objects for one parsed :class:`SourceFile`. The engine
+parses every file once, collects module-level knob-name string constants
+across the tree (rules resolve env-knob names through them), runs each
+rule, and drops findings suppressed by a ``# gt-lint: disable=<rule>``
+comment on the offending line (or a file-wide
+``# gt-lint: file-disable=<rule>``).
+
+Fingerprints are stable across unrelated edits: they hash (rule, path,
+message, occurrence-index) — NOT the line number — so a committed
+baseline entry keeps matching while code above the finding moves.
+"""
+
+import ast
+import hashlib
+import io
+import os
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+#: the suppression comment grammar: ``# gt-lint: disable=<rule>[,<rule>]``
+#: (same line or the standalone comment line directly above) and
+#: ``# gt-lint: file-disable=<rule>`` (whole file). Free text after
+#: `` -- `` is the human justification.
+SUPPRESS_MARKER = "gt-lint:"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    fingerprint: str = ""
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class SourceFile:
+    """One parsed module plus its suppression table."""
+
+    abspath: str
+    relpath: str
+    module: str  # dotted module name, e.g. ``gordo_tpu.utils.env``
+    text: str
+    tree: ast.Module
+    is_package: bool = False  # an ``__init__.py``
+    line_suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+    file_suppressions: Set[str] = field(default_factory=set)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressions:
+            return True
+        return rule in self.line_suppressions.get(line, ())
+
+
+@dataclass
+class LintContext:
+    """Cross-file state rules may consult."""
+
+    root: str
+    contracts: "object"
+    #: every module-level ``NAME = "<env prefix>..."`` constant in the tree:
+    #: both the bare name and its ``module.NAME`` spelling map to the value
+    env_constants: Dict[str, str] = field(default_factory=dict)
+    files: List[SourceFile] = field(default_factory=list)
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: int
+    parse_errors: List[str]
+
+
+def _parse_suppressions(
+    text: str,
+) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """Extract line- and file-level suppressions from comments."""
+    line_rules: Dict[int, Set[str]] = {}
+    file_rules: Set[str] = set()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return line_rules, file_rules
+    #: physical lines that hold only a comment — their suppression applies
+    #: to the next LOGICAL line (every physical line of it: rules anchor
+    #: findings on the flagged node's own line, which for a wrapped
+    #: statement is a continuation line)
+    code_lines: Set[int] = set()
+    comments: List[Tuple[int, str]] = []
+    logical_ranges: List[Tuple[int, int]] = []
+    logical_start: Optional[int] = None
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT:
+            comments.append((tok.start[0], tok.string))
+        elif tok.type == tokenize.NEWLINE:
+            if logical_start is not None:
+                logical_ranges.append((logical_start, tok.end[0]))
+                logical_start = None
+        elif tok.type not in (
+            tokenize.NL,
+            tokenize.INDENT,
+            tokenize.DEDENT,
+            tokenize.ENCODING,
+            tokenize.ENDMARKER,
+        ):
+            code_lines.add(tok.start[0])
+            if logical_start is None:
+                logical_start = tok.start[0]
+    if logical_start is not None:  # EOF without trailing NEWLINE
+        logical_ranges.append((logical_start, max(code_lines, default=logical_start)))
+    for lineno, comment in comments:
+        body = comment.lstrip("#").strip()
+        if not body.startswith(SUPPRESS_MARKER):
+            continue
+        directive = body[len(SUPPRESS_MARKER):].strip()
+        # strip the ` -- justification` tail
+        directive = directive.split("--", 1)[0].strip()
+        if directive.startswith("file-disable="):
+            file_rules.update(
+                r.strip() for r in directive[len("file-disable="):].split(",") if r.strip()
+            )
+            continue
+        if not directive.startswith("disable="):
+            continue
+        rules = {
+            r.strip() for r in directive[len("disable="):].split(",") if r.strip()
+        }
+        if lineno in code_lines:
+            targets = [lineno]
+        else:
+            # standalone comment: guards every physical line of the
+            # logical statement it sits INSIDE (a comment line within a
+            # wrapped call) or, failing that, of the next one — findings
+            # may anchor on any continuation line
+            containing = [r for r in logical_ranges if r[0] <= lineno <= r[1]]
+            following = [r for r in logical_ranges if r[0] > lineno]
+            if containing:
+                start, end = containing[0]
+                targets = list(range(start, end + 1))
+            elif following:
+                start, end = min(following)
+                targets = list(range(start, end + 1))
+            else:
+                targets = [lineno]
+        for target in targets:
+            line_rules.setdefault(target, set()).update(rules)
+    return line_rules, file_rules
+
+
+def module_name_for(root: str, abspath: str) -> str:
+    rel = os.path.relpath(abspath, root)
+    parts = rel.replace(os.sep, "/").split("/")
+    if parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][: -len(".py")]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p)
+
+
+def load_source_file(root: str, abspath: str) -> SourceFile:
+    with open(abspath, encoding="utf-8") as handle:
+        text = handle.read()
+    tree = ast.parse(text, filename=abspath)
+    from .astutil import annotate_parents
+
+    annotate_parents(tree)
+    line_rules, file_rules = _parse_suppressions(text)
+    return SourceFile(
+        abspath=abspath,
+        relpath=os.path.relpath(abspath, root).replace(os.sep, "/"),
+        module=module_name_for(root, abspath),
+        text=text,
+        tree=tree,
+        is_package=os.path.basename(abspath) == "__init__.py",
+        line_suppressions=line_rules,
+        file_suppressions=file_rules,
+    )
+
+
+def iter_python_files(root: str, paths: Optional[Sequence[str]] = None) -> Iterator[str]:
+    """Yield .py files under ``paths`` (default: ``<root>/gordo_tpu``)."""
+    targets = [os.path.join(root, p) for p in paths] if paths else [
+        os.path.join(root, "gordo_tpu")
+    ]
+    for target in targets:
+        if os.path.isfile(target):
+            yield target
+            continue
+        for dirpath, dirnames, filenames in os.walk(target):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def collect_env_constants(files: Iterable[SourceFile], prefix: str) -> Dict[str, str]:
+    """Module-level ``NAME = "<prefix>..."`` constants across the tree.
+
+    Both ``NAME`` and ``<module tail>.NAME`` spellings are recorded so a
+    rule can resolve ``os.getenv(TRACE_DIR_ENV)`` and
+    ``os.getenv(telemetry.TRACE_DIR_ENV)`` alike. A bare name claimed by
+    two modules with DIFFERENT values resolves to neither (ambiguous).
+    """
+    table: Dict[str, str] = {}
+    ambiguous: Set[str] = set()
+    for file in files:
+        for node in file.tree.body:
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if not (isinstance(value, ast.Constant) and isinstance(value.value, str)):
+                continue
+            if not value.value.startswith(prefix):
+                continue
+            for target in targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                name = target.id
+                # every dotted suffix of the module path too, so both
+                # ``telemetry.X`` and ``recorder.X`` resolve; any key —
+                # bare OR dotted — claimed with two different values is
+                # ambiguous and resolves to neither
+                parts = file.module.split(".")
+                keys = [name] + [
+                    ".".join(parts[i:] + [name]) for i in range(len(parts))
+                ]
+                for key in keys:
+                    if key in table and table[key] != value.value:
+                        ambiguous.add(key)
+                    table.setdefault(key, value.value)
+    for key in ambiguous:
+        table.pop(key, None)
+    return table
+
+
+def fingerprint_findings(findings: List[Finding]) -> List[Finding]:
+    """Assign occurrence-indexed stable fingerprints."""
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out: List[Finding] = []
+    for finding in findings:
+        key = (finding.rule, finding.path, finding.message)
+        index = seen.get(key, 0)
+        seen[key] = index + 1
+        digest = hashlib.sha1(
+            f"{finding.rule}|{finding.path}|{finding.message}|{index}".encode()
+        ).hexdigest()[:16]
+        out.append(
+            Finding(
+                rule=finding.rule,
+                path=finding.path,
+                line=finding.line,
+                col=finding.col,
+                message=finding.message,
+                fingerprint=digest,
+            )
+        )
+    return out
+
+
+def run_lint(
+    root: str,
+    rules: Sequence["object"],
+    paths: Optional[Sequence[str]] = None,
+    contracts: Optional["object"] = None,
+) -> LintResult:
+    """Parse, run every rule, apply suppressions, fingerprint."""
+    if contracts is None:
+        from .contracts import load_contracts
+
+        contracts = load_contracts()
+    files: List[SourceFile] = []
+    parse_errors: List[str] = []
+    for path in iter_python_files(root, paths):
+        try:
+            files.append(load_source_file(root, path))
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            parse_errors.append(f"{path}: {exc}")
+    ctx = LintContext(root=root, contracts=contracts, files=files)
+    ctx.env_constants = collect_env_constants(
+        files, getattr(contracts, "env_prefix", "GORDO_TPU_")
+    )
+    findings: List[Finding] = []
+    suppressed = 0
+    for file in files:
+        for rule in rules:
+            for finding in rule.check(file, ctx):
+                if file.suppressed(finding.rule, finding.line):
+                    suppressed += 1
+                else:
+                    findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule, f.message))
+    return LintResult(
+        findings=fingerprint_findings(findings),
+        suppressed=suppressed,
+        parse_errors=parse_errors,
+    )

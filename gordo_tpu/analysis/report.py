@@ -1,0 +1,100 @@
+"""
+Rendering for ``gordo-tpu lint``: the human table and the ``--as-json``
+document (the same shape the CI annotation step consumes).
+"""
+
+from typing import Dict, List
+
+from .baseline import BaselineEntry
+from .core import Finding, LintResult
+
+
+def lint_document(
+    result: LintResult,
+    new: List[Finding],
+    baselined: List[Finding],
+    stale: List[BaselineEntry],
+) -> Dict:
+    """The machine-readable lint outcome (``--as-json``)."""
+    return {
+        # mirrors the CLI gate exactly: parse errors fail the run too (a
+        # file the linter cannot read is not a clean file)
+        "ok": not new and not result.parse_errors,
+        "counts": {
+            "new": len(new),
+            "baselined": len(baselined),
+            "suppressed": result.suppressed,
+            "stale_baseline_entries": len(stale),
+            "parse_errors": len(result.parse_errors),
+        },
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "message": f.message,
+                "fingerprint": f.fingerprint,
+                "baselined": False,
+            }
+            for f in new
+        ]
+        + [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "message": f.message,
+                "fingerprint": f.fingerprint,
+                "baselined": True,
+            }
+            for f in baselined
+        ],
+        "stale_baseline_entries": [
+            {"rule": e.rule, "path": e.path, "fingerprint": e.fingerprint}
+            for e in stale
+        ],
+        "parse_errors": list(result.parse_errors),
+    }
+
+
+def render_report(
+    result: LintResult,
+    new: List[Finding],
+    baselined: List[Finding],
+    stale: List[BaselineEntry],
+) -> str:
+    """The human-facing table."""
+    lines: List[str] = []
+    if new:
+        lines.append(f"NEW findings ({len(new)}):")
+        for finding in new:
+            lines.append(f"  {finding.render()}")
+    if baselined:
+        lines.append(f"baselined ({len(baselined)} grandfathered):")
+        for finding in baselined:
+            lines.append(f"  {finding.render()}")
+    if stale:
+        lines.append(
+            f"stale baseline entries ({len(stale)}) — the finding is gone; "
+            "remove them:"
+        )
+        for entry in stale:
+            lines.append(f"  {entry.rule} @ {entry.path} [{entry.fingerprint}]")
+    if result.parse_errors:
+        lines.append(f"parse errors ({len(result.parse_errors)}):")
+        for error in result.parse_errors:
+            lines.append(f"  {error}")
+    # the verdict mirrors the CLI gate: new findings OR parse errors fail
+    problems = []
+    if new:
+        problems.append(f"{len(new)} new finding(s)")
+    if result.parse_errors:
+        problems.append(f"{len(result.parse_errors)} unparseable file(s)")
+    lines.append(
+        "lint: "
+        + (" + ".join(problems) if problems else "OK")
+        + f" ({len(baselined)} baselined, {result.suppressed} suppressed)"
+    )
+    return "\n".join(lines)

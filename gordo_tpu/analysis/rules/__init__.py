@@ -1,0 +1,42 @@
+"""
+The rule catalog. Each rule is a small object with ``name`` /
+``description`` and a ``check(file, ctx)`` generator; ``default_rules()``
+builds the shipped set (see ``docs/static-analysis.md`` for the catalog
+and the how-to-add-a-rule guide).
+"""
+
+from typing import Dict, List, Optional
+
+from .atomic_write import AtomicWriteRule
+from .clock import ClockDisciplineRule
+from .env_registry import EnvRegistryRule
+from .jax_hazards import JaxDeviceSyncRule, JaxStaticArgnumRule, StdlibOnlyRule
+from .layering import LayeringRule
+from .prometheus_cardinality import PrometheusCardinalityRule
+
+__all__ = [
+    "AtomicWriteRule",
+    "ClockDisciplineRule",
+    "EnvRegistryRule",
+    "JaxDeviceSyncRule",
+    "JaxStaticArgnumRule",
+    "StdlibOnlyRule",
+    "LayeringRule",
+    "PrometheusCardinalityRule",
+    "default_rules",
+]
+
+
+def default_rules(env_registry: Optional[Dict] = None) -> List:
+    """The shipped rule set; ``env_registry`` overrides the live knob
+    registry (fixture tests pass a controlled one)."""
+    return [
+        LayeringRule(),
+        JaxDeviceSyncRule(),
+        StdlibOnlyRule(),
+        JaxStaticArgnumRule(),
+        EnvRegistryRule(registry=env_registry),
+        AtomicWriteRule(),
+        ClockDisciplineRule(),
+        PrometheusCardinalityRule(),
+    ]

@@ -1,0 +1,114 @@
+"""
+``atomic-write`` — artifact writes in the builder/lifecycle/serializer
+paths must be crash-safe: either routed through
+``serializer.dump_atomic`` or staged to a temp path the same function
+``os.replace``/``os.rename``-s into place. A bare ``open(path, "w")``
+that dies mid-write leaves a torn file exactly where the fleet store,
+a ``--resume`` pass, or the lifecycle supervisor would load it.
+
+Append-mode opens are exempt (the build journal's event overlay is an
+append-only design), as are reads.
+"""
+
+import ast
+from typing import Iterator, Optional
+
+from ..astutil import call_name, enclosing_function
+from ..contracts import in_scope
+from ..core import Finding, LintContext, SourceFile
+
+#: dotted-callee tails that serialize to a target
+_DUMP_TAILS = ("dump", "save", "savez", "savez_compressed", "to_parquet", "to_csv")
+#: roots whose .dump writes a file (pickle.dump(obj, fh) etc.)
+_DUMP_ROOTS = ("json", "simplejson", "pickle", "np", "numpy", "joblib")
+
+_RENAMERS = ("os.replace", "os.rename", "replace", "rename")
+
+
+def _open_write_mode(call: ast.Call) -> Optional[str]:
+    """The mode string of an ``open()`` call when it writes, else None."""
+    if (call_name(call) or "").split(".")[-1] != "open":
+        return None
+    mode: Optional[ast.expr] = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return None  # default "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        if any(flag in mode.value for flag in ("w", "x", "+")):
+            return mode.value
+    return None
+
+
+def _function_renames(fn: Optional[ast.AST]) -> bool:
+    """Does the enclosing function atomically rename something into
+    place? (The write-to-staging-then-replace idiom.)"""
+    if fn is None:
+        return False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = call_name(node) or ""
+            if callee in _RENAMERS or callee.split(".")[-1] in ("replace", "rename"):
+                # str.replace() is not a file rename; require an `os.`
+                # root or a bare name imported from os
+                root = callee.split(".")[0]
+                if root in ("os", "replace", "rename"):
+                    return True
+    return False
+
+
+def _is_dump_call(call: ast.Call) -> Optional[str]:
+    callee = call_name(call)
+    if callee is None:
+        return None
+    parts = callee.split(".")
+    if parts[-1] not in _DUMP_TAILS:
+        return None
+    if parts[-1] in ("to_parquet", "to_csv"):
+        return callee
+    if len(parts) >= 2 and parts[-2] in _DUMP_ROOTS:
+        return callee
+    return None
+
+
+class AtomicWriteRule:
+    name = "atomic-write"
+    description = (
+        "artifact writes must go through dump_atomic or a "
+        "stage-then-os.replace in the same function"
+    )
+
+    def check(self, file: SourceFile, ctx: LintContext) -> Iterator[Finding]:
+        if not in_scope(file.module, ctx.contracts.atomic_scopes):
+            return
+        allowed = set(ctx.contracts.atomic_allowed_functions)
+        for node in ast.walk(file.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            mode = _open_write_mode(node)
+            dump_callee = None if mode else _is_dump_call(node)
+            if mode is None and dump_callee is None:
+                continue
+            fn = enclosing_function(node)
+            if fn is not None and getattr(fn, "name", None) in allowed:
+                continue
+            if _function_renames(fn):
+                continue
+            what = (
+                f"open(..., {mode!r})" if mode is not None else f"`{dump_callee}`"
+            )
+            yield Finding(
+                rule=self.name,
+                path=file.relpath,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    f"{what} writes in an artifact path without "
+                    "dump_atomic or a stage-then-os.replace — a crash "
+                    "mid-write leaves a torn file where a loader would "
+                    "pick it up"
+                ),
+            )

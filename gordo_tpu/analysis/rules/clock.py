@@ -1,0 +1,63 @@
+"""
+``clock-discipline`` — ``time.time()`` must not feed deadline, backoff,
+or queue-wait arithmetic; wall clocks jump (NTP steps, suspend/resume)
+and a stepped clock turns a 2-second batch deadline into an instant
+mass-shed or a never-expiring wait. ``time.monotonic()`` is the contract
+for interval math; wall time is for timestamps people read.
+
+The heuristic is statement-local: a ``time.time()`` call is flagged when
+the statement it sits in also mentions a name matching the configured
+suspect pattern (``deadline``/``timeout``/``expir``/``backoff``/...).
+Legitimate wall-clock uses that trip it (e.g. persisted cross-restart
+cutoffs) carry a suppression or a baseline entry with justification.
+"""
+
+import ast
+import re
+from typing import Iterator
+
+from ..astutil import call_name, enclosing_statement
+from ..core import Finding, LintContext, SourceFile
+
+
+def _statement_names(stmt: ast.AST) -> Iterator[str]:
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Name):
+            yield node.id
+        elif isinstance(node, ast.Attribute):
+            yield node.attr
+        elif isinstance(node, ast.arg):
+            yield node.arg
+
+
+class ClockDisciplineRule:
+    name = "clock-discipline"
+    description = (
+        "deadline/backoff/queue-wait arithmetic must use time.monotonic(),"
+        " not time.time()"
+    )
+
+    def check(self, file: SourceFile, ctx: LintContext) -> Iterator[Finding]:
+        suspect = re.compile(ctx.contracts.clock_suspect_names, re.IGNORECASE)
+        for node in ast.walk(file.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (call_name(node) or "") != "time.time":
+                continue
+            stmt = enclosing_statement(node)
+            suspects = sorted(
+                {name for name in _statement_names(stmt) if suspect.search(name)}
+            )
+            if not suspects:
+                continue
+            yield Finding(
+                rule=self.name,
+                path=file.relpath,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    "time.time() in deadline math (statement touches "
+                    f"{', '.join(suspects)}) — wall clocks step; use "
+                    "time.monotonic() for intervals"
+                ),
+            )

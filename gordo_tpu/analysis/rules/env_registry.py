@@ -1,0 +1,119 @@
+"""
+``env-registry`` — every ``GORDO_TPU_*`` environment read must go
+through the typed accessors in ``gordo_tpu/utils/env.py`` and name a
+knob declared (with a doc line) in its registry.
+
+Knob names resolve through string literals, module-level ``NAME_ENV =
+"<knob name>"`` constants in the same file, and such constants anywhere
+in the linted tree (``os.getenv(telemetry.TRACE_DIR_ENV)`` resolves).
+Writes (``os.environ["X"] = ...``) are exempt — the CLI forwards knobs
+to workers that way.
+"""
+
+import ast
+from typing import Dict, Iterator, Optional
+
+from ..astutil import (
+    call_name,
+    dotted_name,
+    first_arg,
+    module_string_constants,
+    resolve_string,
+)
+from ..core import Finding, LintContext, SourceFile
+
+#: dotted callee names that read the raw environment
+_RAW_READERS = ("os.environ.get", "os.getenv", "environ.get", "getenv")
+
+
+def _live_registry() -> Dict:
+    from gordo_tpu.utils.env import KNOBS
+
+    return KNOBS
+
+
+class EnvRegistryRule:
+    name = "env-registry"
+    description = (
+        "GORDO_TPU_* reads must use the typed utils.env accessors and "
+        "name a documented registry knob"
+    )
+
+    def __init__(self, registry: Optional[Dict] = None):
+        self._registry = registry
+
+    @property
+    def registry(self) -> Dict:
+        if self._registry is None:
+            self._registry = _live_registry()
+        return self._registry
+
+    def check(self, file: SourceFile, ctx: LintContext) -> Iterator[Finding]:
+        prefix = ctx.contracts.env_prefix
+        accessors = set(ctx.contracts.env_accessors)
+        local_constants = module_string_constants(file.tree)
+        in_accessor_module = file.module == ctx.contracts.env_accessor_module
+        for node in ast.walk(file.tree):
+            knob: Optional[str] = None
+            raw_read = False
+            if isinstance(node, ast.Call):
+                callee = call_name(node) or ""
+                if callee in _RAW_READERS:
+                    knob = resolve_string(
+                        first_arg(node), local_constants, ctx.env_constants
+                    )
+                    raw_read = True
+                elif callee.split(".")[-1] in accessors:
+                    knob = resolve_string(
+                        first_arg(node), local_constants, ctx.env_constants
+                    )
+            elif (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)
+                and (dotted_name(node.value) or "").endswith("environ")
+            ):
+                knob = resolve_string(
+                    node.slice, local_constants, ctx.env_constants
+                )
+                raw_read = True
+            if knob is None or not knob.startswith(prefix):
+                continue
+            line, col = node.lineno, node.col_offset
+            if raw_read and not in_accessor_module:
+                yield Finding(
+                    rule=self.name,
+                    path=file.relpath,
+                    line=line,
+                    col=col,
+                    message=(
+                        f"raw environ read of `{knob}` — route it through "
+                        f"a typed accessor in "
+                        f"{ctx.contracts.env_accessor_module} "
+                        "(malformed values must warn and fall back, not "
+                        "raise)"
+                    ),
+                )
+            declared = self.registry.get(knob)
+            if declared is None:
+                yield Finding(
+                    rule=self.name,
+                    path=file.relpath,
+                    line=line,
+                    col=col,
+                    message=(
+                        f"undeclared knob `{knob}` — add it to the "
+                        "registry in gordo_tpu/utils/env.py (name, type, "
+                        "default, doc) and regenerate docs/configuration.md"
+                    ),
+                )
+            elif not getattr(declared, "doc", ""):
+                yield Finding(
+                    rule=self.name,
+                    path=file.relpath,
+                    line=line,
+                    col=col,
+                    message=(
+                        f"knob `{knob}` is declared without a doc line — "
+                        "the generated reference table would be empty"
+                    ),
+                )

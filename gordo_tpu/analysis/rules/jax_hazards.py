@@ -1,0 +1,245 @@
+"""
+``jax-*`` — the three JAX dispatch/recompile hazards this codebase has
+been burned by (CHANGES.md PRs 3-5):
+
+- ``jax-device-sync``: ``block_until_ready``/``device_get`` outside a
+  ``program_span`` wrapper in the program-path packages. An unattributed
+  device sync either skews the telemetry compile/run split or blocks the
+  request thread where the engine expects async dispatch.
+- ``jax-stdlib-only``: array/device/server imports (even lazy) inside the
+  packages contracted to run stdlib-only in any process.
+- ``jax-static-argnum``: ``jax.jit`` static argnums/argnames pointing at
+  parameters whose defaults or annotations are unhashable — each call
+  would mint a fresh program-cache signature (or TypeError at dispatch).
+"""
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from ..astutil import ancestors, call_name, dotted_name, enclosing_function
+from ..contracts import in_scope
+from ..core import Finding, LintContext, SourceFile
+
+_SYNC_CALLS = ("block_until_ready", "device_get")
+
+#: AST nodes that are unhashable literals when used as a default
+_UNHASHABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+
+#: annotations that name unhashable containers
+_UNHASHABLE_ANNOTATIONS = {"list", "dict", "set", "List", "Dict", "Set", "bytearray"}
+
+
+def _in_program_span(node: ast.AST) -> bool:
+    """Is the node lexically under ``with ... program_span(...)``?"""
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    callee = call_name(expr) or ""
+                    if callee.split(".")[-1] in ("program_span", "record"):
+                        return True
+    return False
+
+
+class JaxDeviceSyncRule:
+    name = "jax-device-sync"
+    description = (
+        "device syncs in program-path packages must run inside a "
+        "program_span wrapper or a sanctioned helper"
+    )
+
+    def check(self, file: SourceFile, ctx: LintContext) -> Iterator[Finding]:
+        if not in_scope(file.module, ctx.contracts.jax_sync_scopes):
+            return
+        allowed = set(ctx.contracts.jax_sync_allowed_functions)
+        for node in ast.walk(file.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = call_name(node)
+            if callee is None or callee.split(".")[-1] not in _SYNC_CALLS:
+                continue
+            if _in_program_span(node):
+                continue
+            fn = enclosing_function(node)
+            if fn is not None and getattr(fn, "name", None) in allowed:
+                continue
+            yield Finding(
+                rule=self.name,
+                path=file.relpath,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    f"`{callee}` outside a program_span wrapper — the "
+                    "device sync is invisible to compile/run attribution"
+                ),
+            )
+
+
+class StdlibOnlyRule:
+    name = "jax-stdlib-only"
+    description = (
+        "contracted stdlib-only packages must not import device/array/"
+        "server modules, even lazily"
+    )
+
+    def check(self, file: SourceFile, ctx: LintContext) -> Iterator[Finding]:
+        if not in_scope(file.module, ctx.contracts.jax_stdlib_only):
+            return
+        heavy = set(ctx.contracts.jax_heavy_modules)
+        for node in ast.walk(file.tree):
+            names: List[str] = []
+            if isinstance(node, ast.Import):
+                names = [alias.name for alias in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                names = [node.module or ""]
+            else:
+                continue
+            for imported in names:
+                root = imported.split(".")[0]
+                if root in heavy:
+                    yield Finding(
+                        rule=self.name,
+                        path=file.relpath,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"stdlib-only package imports `{imported}` — "
+                            f"{file.module.split('.')[1] if '.' in file.module else file.module} "
+                            "is contracted to run in any process without "
+                            "device/array/server deps"
+                        ),
+                    )
+
+
+def _static_positions(call: ast.Call) -> "tuple":
+    """Declared static argnums/argnames on a jit call, best effort."""
+    nums: List[int] = []
+    names: List[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = _int_list(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _str_list(kw.value)
+    return nums, names
+
+
+def _int_list(node: ast.expr) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            el.value
+            for el in node.elts
+            if isinstance(el, ast.Constant) and isinstance(el.value, int)
+        ]
+    return []
+
+
+def _str_list(node: ast.expr) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            el.value
+            for el in node.elts
+            if isinstance(el, ast.Constant) and isinstance(el.value, str)
+        ]
+    return []
+
+
+def _annotation_unhashable(annotation: Optional[ast.expr]) -> bool:
+    if annotation is None:
+        return False
+    target = annotation
+    if isinstance(target, ast.Subscript):  # list[int], Dict[str, int], ...
+        target = target.value
+    name = dotted_name(target)
+    return bool(name) and name.split(".")[-1] in _UNHASHABLE_ANNOTATIONS
+
+
+def _check_params(
+    fn: ast.AST, nums: Sequence[int], names: Sequence[str]
+) -> Iterator[str]:
+    """Messages for unhashable static params of a function/lambda."""
+    args = fn.args
+    params = list(args.posonlyargs) + list(args.args)
+    defaults: Dict[str, ast.expr] = {}
+    if args.defaults:
+        for param, default in zip(params[len(params) - len(args.defaults):], args.defaults):
+            defaults[param.arg] = default
+    for kwarg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if default is not None:
+            defaults[kwarg.arg] = default
+    selected = []
+    for num in nums:
+        if 0 <= num < len(params):
+            selected.append(params[num])
+    by_name = {p.arg: p for p in params + list(args.kwonlyargs)}
+    for name in names:
+        if name in by_name:
+            selected.append(by_name[name])
+    for param in selected:
+        if isinstance(defaults.get(param.arg), _UNHASHABLE_LITERALS):
+            yield (
+                f"static argument `{param.arg}` defaults to an unhashable "
+                "literal — jit would TypeError (or mint a signature per "
+                "call if coerced)"
+            )
+        elif _annotation_unhashable(getattr(param, "annotation", None)):
+            yield (
+                f"static argument `{param.arg}` is annotated as an "
+                "unhashable container — every distinct value mints a new "
+                "program-cache signature"
+            )
+
+
+class JaxStaticArgnumRule:
+    name = "jax-static-argnum"
+    description = (
+        "jit static argnums/argnames must point at hashable parameters"
+    )
+
+    def check(self, file: SourceFile, ctx: LintContext) -> Iterator[Finding]:
+        #: module-level function defs, for resolving jax.jit(fn, ...)
+        functions: Dict[str, ast.AST] = {
+            node.name: node
+            for node in ast.walk(file.tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node in ast.walk(file.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = call_name(node) or ""
+            tail = callee.split(".")[-1]
+            target: Optional[ast.AST] = None
+            jit_call = node
+            if tail in ("jit", "pmap"):
+                if node.args:
+                    arg0 = node.args[0]
+                    if isinstance(arg0, ast.Lambda):
+                        target = arg0
+                    elif isinstance(arg0, ast.Name):
+                        target = functions.get(arg0.id)
+            elif tail == "partial" and node.args:
+                inner = dotted_name(node.args[0]) or ""
+                if inner.split(".")[-1] in ("jit", "pmap"):
+                    # decorator form: @partial(jax.jit, static_argnums=...)
+                    from ..astutil import parent
+
+                    up = parent(node)
+                    if isinstance(up, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        target = up
+            if target is None:
+                continue
+            nums, names = _static_positions(jit_call)
+            if not nums and not names:
+                continue
+            for message in _check_params(target, nums, names):
+                yield Finding(
+                    rule=self.name,
+                    path=file.relpath,
+                    line=jit_call.lineno,
+                    col=jit_call.col_offset,
+                    message=message,
+                )

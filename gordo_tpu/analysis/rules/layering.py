@@ -1,0 +1,52 @@
+"""
+``layering`` — import-dependency arrows from ``contracts.toml``.
+
+Each arrow declares that one package may not import from a set of
+forbidden dotted prefixes. Both module-level and lazy in-function
+imports count: a lazy import still creates the dependency, it just hides
+it from import-time cycle detection.
+"""
+
+from typing import Iterator
+
+from ..astutil import iter_imports
+from ..contracts import in_scope
+from ..core import Finding, LintContext, SourceFile
+
+
+class LayeringRule:
+    name = "layering"
+    description = (
+        "package imports must follow the dependency arrows declared in "
+        "contracts.toml"
+    )
+
+    def check(self, file: SourceFile, ctx: LintContext) -> Iterator[Finding]:
+        arrows = [
+            arrow
+            for arrow in ctx.contracts.arrows
+            if in_scope(file.module, (arrow.module,))
+        ]
+        if not arrows:
+            return
+        seen = set()
+        for node, imported in iter_imports(file.tree, file.module, file.is_package):
+            for arrow in arrows:
+                for forbidden in arrow.forbidden:
+                    if not in_scope(imported, (forbidden,)):
+                        continue
+                    key = (node.lineno, forbidden)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    why = f" ({arrow.reason})" if arrow.reason else ""
+                    yield Finding(
+                        rule=self.name,
+                        path=file.relpath,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"{arrow.module} must not import from "
+                            f"{forbidden} (imports {imported}){why}"
+                        ),
+                    )

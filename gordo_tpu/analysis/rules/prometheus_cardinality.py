@@ -1,0 +1,131 @@
+"""
+``prometheus-cardinality`` — metric label values must come from bounded
+sets. A label value interpolated from a request-derived string (raw
+path, query arg, regex capture that isn't collapsed back to a route
+shape) mints one timeseries per distinct input: scanners and typo'd
+URLs then grow the scrape set without bound — the exact failure the
+server's ``{unmatched}``-collapse guards against (PR 3).
+
+Flagged label-value shapes, per ``.labels(...)`` call in the scoped
+packages:
+
+- f-strings with interpolations, ``str.format`` calls, and string
+  concatenation with non-constants — unbounded by construction;
+- expressions reading ``request.*`` (the configured taint roots);
+- local names assigned from ``request.*`` or from a regex
+  ``.group(...)`` in the same function, unless the assignment also
+  passes through an obvious collapse (a string constant result).
+"""
+
+import ast
+from typing import Iterator, Optional, Set
+
+from ..astutil import call_name, dotted_name, enclosing_function
+from ..contracts import in_scope
+from ..core import Finding, LintContext, SourceFile
+
+
+def _iter_taint_nodes(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk an expression WITHOUT descending into call arguments: a
+    callee owns the boundedness of its return value (`self._labels(...)`
+    collapses paths to route shapes — its result is sanitized, not
+    tainted by the `request` it takes). The call node itself is still
+    yielded (``.group``/``.format`` taint directly), and the callee
+    expression is walked so ``request.args.get(...)`` still reads as a
+    direct request access."""
+    stack = [node]
+    while stack:
+        sub = stack.pop()
+        yield sub
+        if isinstance(sub, ast.Call):
+            stack.append(sub.func)
+            continue
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+def _is_tainted_expr(node: ast.AST, roots: Set[str], local_taint: Set[str]) -> Optional[str]:
+    """Why this expression is request-derived, or None."""
+    for sub in _iter_taint_nodes(node):
+        if isinstance(sub, ast.JoinedStr):
+            if any(isinstance(v, ast.FormattedValue) for v in sub.values):
+                return "f-string interpolation"
+        elif isinstance(sub, ast.Call):
+            callee = call_name(sub) or ""
+            tail = callee.split(".")[-1]
+            if tail == "format":
+                return "str.format interpolation"
+            if tail == "group":
+                return "regex capture"
+        elif isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Add):
+            sides = (sub.left, sub.right)
+            if any(
+                isinstance(s, ast.Constant) and isinstance(s.value, str)
+                for s in sides
+            ) and any(not isinstance(s, ast.Constant) for s in sides):
+                return "string concatenation"
+        elif isinstance(sub, (ast.Name, ast.Attribute)):
+            name = dotted_name(sub)
+            if name is None:
+                continue
+            root = name.split(".")[0]
+            if name in roots or root in roots:
+                return f"`{name}`"
+            if isinstance(sub, ast.Name) and sub.id in local_taint:
+                return f"`{sub.id}` (assigned from a request-derived value)"
+    return None
+
+
+def _local_tainted_names(fn: Optional[ast.AST], roots: Set[str]) -> Set[str]:
+    """Names assigned from request.* or regex captures in this function."""
+    tainted: Set[str] = set()
+    if fn is None:
+        return tainted
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        why = _is_tainted_expr(node.value, roots, set())
+        if why is None:
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                tainted.add(target.id)
+    return tainted
+
+
+class PrometheusCardinalityRule:
+    name = "prometheus-cardinality"
+    description = (
+        "metric label values must come from bounded sets, not "
+        "request-derived strings"
+    )
+
+    def check(self, file: SourceFile, ctx: LintContext) -> Iterator[Finding]:
+        if not in_scope(file.module, ctx.contracts.prometheus_scopes):
+            return
+        roots = set(ctx.contracts.prometheus_tainted_roots)
+        for node in ast.walk(file.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (
+                isinstance(node.func, ast.Attribute) and node.func.attr == "labels"
+            ):
+                continue
+            local_taint = _local_tainted_names(enclosing_function(node), roots)
+            values = list(node.args) + [
+                kw.value for kw in node.keywords if kw.arg is not None
+            ]
+            for value in values:
+                why = _is_tainted_expr(value, roots, local_taint)
+                if why is None:
+                    continue
+                yield Finding(
+                    rule=self.name,
+                    path=file.relpath,
+                    line=value.lineno,
+                    col=value.col_offset,
+                    message=(
+                        f"label value flows from {why} — unbounded label "
+                        "values mint a timeseries per distinct input; "
+                        "collapse to a route shape or a bounded enum first"
+                    ),
+                )

@@ -933,6 +933,117 @@ def bench_check(
         raise SystemExit(1)
 
 
+@click.command("lint")
+@click.argument("paths", nargs=-1)
+@click.option(
+    "--root",
+    "root",
+    default=None,
+    type=click.Path(exists=True, file_okay=False),
+    help="Repository root the paths (and the baseline) are relative to "
+    "(default: the current directory).",
+)
+@click.option(
+    "--baseline",
+    "baseline_path",
+    default=None,
+    type=click.Path(dir_okay=False),
+    help="Baseline file of grandfathered findings (default: "
+    "<root>/lint_baseline.json; every entry must carry a justification).",
+)
+@click.option(
+    "--update-baseline",
+    is_flag=True,
+    help="Rewrite the baseline to cover every current finding (each "
+    "entry gets a FIXME justification to hand-edit), then exit 0.",
+)
+@click.option(
+    "--report-only",
+    is_flag=True,
+    help="Always exit 0: print the findings, never gate (CI visibility "
+    "mode).",
+)
+@click.option(
+    "--as-json",
+    "as_json",
+    is_flag=True,
+    help="Print the raw lint document instead of the report",
+)
+def lint(
+    paths: Tuple[str, ...],
+    root: Optional[str],
+    baseline_path: Optional[str],
+    update_baseline: bool,
+    report_only: bool,
+    as_json: bool,
+):
+    """
+    The invariant gate: run the project's static-analysis rules
+    (gordo_tpu.analysis — layering arrows, JAX dispatch hazards, the
+    env-knob registry, atomic artifact writes, clock discipline,
+    Prometheus label cardinality) over PATHS (default: ``gordo_tpu/``)
+    and exit non-zero on any finding that is neither suppressed in-file
+    (``# gt-lint: disable=<rule>``) nor grandfathered in the committed
+    baseline. See ``docs/static-analysis.md`` for the rule catalog.
+
+    Example: ``gordo-tpu lint`` at the repo root — the same invocation
+    the CI ``lint`` job and ``make lint-gordo`` run.
+    """
+    from ..analysis import (
+        BaselineError,
+        default_baseline_path,
+        default_rules,
+        lint_document,
+        load_baseline,
+        render_report,
+        run_lint,
+        split_by_baseline,
+        write_baseline,
+    )
+
+    root = os.path.abspath(root or os.getcwd())
+    if baseline_path is None:
+        baseline_path = default_baseline_path(root)
+    result = run_lint(root, default_rules(), paths=list(paths) or None)
+    if update_baseline:
+        # still-matching entries keep their hand-written justifications;
+        # an unreadable existing baseline just means a fresh start
+        try:
+            existing = load_baseline(baseline_path)
+        except BaselineError:
+            existing = []
+        write_baseline(
+            baseline_path,
+            result.findings,
+            "FIXME: justify this grandfathered finding (lint refuses "
+            "unjustified baselines)",
+            existing=existing,
+        )
+        click.echo(
+            f"Baseline rewritten with {len(result.findings)} entr"
+            f"{'y' if len(result.findings) == 1 else 'ies'} -> "
+            f"{baseline_path}; edit the justifications before committing."
+        )
+        return
+    try:
+        entries = load_baseline(baseline_path)
+    except BaselineError as exc:
+        raise click.ClickException(str(exc))
+    new, baselined, stale = split_by_baseline(result.findings, entries)
+    if as_json:
+        click.echo(
+            json.dumps(
+                lint_document(result, new, baselined, stale),
+                indent=1,
+                sort_keys=True,
+            )
+        )
+    else:
+        click.echo(render_report(result, new, baselined, stale))
+    if (new or result.parse_errors) and not report_only:
+        raise SystemExit(1)
+
+
 @click.command("wait-for-models")
 @click.argument("models-dir", envvar="MODELS_DIR")
 @click.option(
@@ -1541,6 +1652,7 @@ gordo_tpu_cli.add_command(plan_fleet)
 gordo_tpu_cli.add_command(build_status)
 gordo_tpu_cli.add_command(trace)
 gordo_tpu_cli.add_command(bench_check)
+gordo_tpu_cli.add_command(lint)
 gordo_tpu_cli.add_command(run_server_cli)
 gordo_tpu_cli.add_command(wait_for_models)
 gordo_tpu_cli.add_command(score)

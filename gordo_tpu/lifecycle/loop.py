@@ -42,7 +42,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import telemetry
-from ..utils.env import env_float
+from ..utils.env import env_float, env_str
 from ..utils.faults import fault_point
 from .drift import DriftConfig, DriftMonitor, DriftVerdict
 from .gates import GateConfig, GateReport, evaluate_canary
@@ -139,7 +139,7 @@ class LifecycleSupervisor:
             )
         self.recorder: Any = telemetry.NULL_RECORDER
         if telemetry.enabled():
-            trace_dir = os.getenv(telemetry.TRACE_DIR_ENV) or os.path.join(
+            trace_dir = env_str(telemetry.TRACE_DIR_ENV, None) or os.path.join(
                 self.models_root, LIFECYCLE_DIR
             )
             try:

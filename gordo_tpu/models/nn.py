@@ -21,7 +21,6 @@ while matmuls/activations cast to the compute dtype per use and the
 OUTPUT, losses and thresholds are always float32.
 """
 
-import os
 from typing import Dict, Tuple
 
 import jax
@@ -42,10 +41,9 @@ def _lstm_unroll() -> int:
     roofline in docs/architecture.md), so fusing several timesteps into
     one scan iteration amortizes the per-step cost without changing the
     math."""
-    try:
-        return max(1, int(os.environ.get("GORDO_TPU_LSTM_UNROLL", 4)))
-    except ValueError:
-        return 4
+    from ..utils.env import env_int
+
+    return max(1, env_int("GORDO_TPU_LSTM_UNROLL", 4))
 
 
 def init_feedforward(rng: jax.Array, spec: FeedForwardSpec) -> Params:

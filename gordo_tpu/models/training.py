@@ -46,12 +46,9 @@ def segmented_config() -> Optional[int]:
     GORDO_TPU_LSTM_SEGMENTED: 0/unset = off, N = segments per update;
     see build_raw_segmented_fit_fn for the trade). Shared by the fleet
     trainer and the single-model estimator path."""
-    import os
+    from ..utils.env import env_int
 
-    try:
-        value = int(os.environ.get("GORDO_TPU_LSTM_SEGMENTED", "0"))
-    except ValueError:
-        return None
+    value = env_int("GORDO_TPU_LSTM_SEGMENTED", 0)
     return value if value > 0 else None
 
 

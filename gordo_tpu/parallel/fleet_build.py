@@ -62,7 +62,7 @@ from ..models.estimators import JaxBaseEstimator, JaxLSTMBaseEstimator
 from ..models.training import FitConfig, fit_config_from_kwargs, split_fit_kwargs
 from ..ops.windows import model_offset as calc_model_offset
 from ..ops.windows import window_targets
-from ..utils.env import env_float, env_int
+from ..utils.env import env_float, env_int, env_str
 from ..utils.faults import fault_point
 from ..utils.retry import retry_call
 from .fleet import (
@@ -197,9 +197,17 @@ class FleetBuilder:
             # packing (models/packing.py) for the whole build path —
             # including the `build-fleet` CLI — without new flags.
 
-            packing: Any = os.environ.get("GORDO_TPU_PACKING") or None
+            packing: Any = env_str("GORDO_TPU_PACKING", None)
             if packing and packing != "auto":
-                packing = int(packing)
+                try:
+                    packing = int(packing)
+                except ValueError:
+                    logger.warning(
+                        "Invalid GORDO_TPU_PACKING=%r (want an int or "
+                        "'auto'); packing disabled",
+                        packing,
+                    )
+                    packing = None
             trainer = FleetTrainer(packing=packing)
         # Bucket planning (gordo_tpu.planner): strategy / pre-computed
         # FleetPlan / calibrated cost table ride on the trainer — it is
@@ -372,7 +380,7 @@ class FleetBuilder:
         if telemetry.enabled():
             trace_path = None
             if output_dir is not None:
-                trace_dir = os.getenv(telemetry.TRACE_DIR_ENV) or output_dir
+                trace_dir = env_str(telemetry.TRACE_DIR_ENV, None) or output_dir
                 try:
                     os.makedirs(trace_dir, exist_ok=True)
                     trace_path = os.path.join(trace_dir, BUILD_TRACE_FILE)

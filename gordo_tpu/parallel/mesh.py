@@ -19,6 +19,8 @@ ICI/DCN without change.
 
 import logging
 import os
+
+from ..utils.env import env_str
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -50,7 +52,7 @@ def configure_compile_cache() -> Optional[str]:
     programs a heterogeneous fleet recompiles most often.
     """
     global _compile_cache_configured
-    cache_dir = os.getenv(COMPILE_CACHE_ENV)
+    cache_dir = env_str(COMPILE_CACHE_ENV, None)
     if not cache_dir:
         return None
     if _compile_cache_configured:

@@ -67,14 +67,9 @@ def ring_predict_enabled(n_rows: int) -> bool:
     materialization hurts (threshold rows), and there is more than one
     device to shard the time axis over.
     """
-    import os
+    from ..utils.env import env_int
 
-    try:
-        threshold = int(
-            os.environ.get(RING_PREDICT_ROWS_ENV, DEFAULT_RING_PREDICT_ROWS)
-        )
-    except ValueError:
-        threshold = DEFAULT_RING_PREDICT_ROWS
+    threshold = env_int(RING_PREDICT_ROWS_ENV, DEFAULT_RING_PREDICT_ROWS)
     if threshold <= 0:
         return False
     return n_rows >= threshold and len(jax.devices()) > 1

@@ -22,10 +22,9 @@ Two ladder families:
   then merges rungs back down where the trade is wrong.
 """
 
-import os
 from typing import List, Optional, Sequence, Tuple
 
-from ..utils.env import env_float
+from ..utils.env import env_float, env_str
 
 #: default row-count rungs: factor-4 geometric — 5 programs per member
 #: rung, worst-case 4x row padding, typical sensor payloads (tens to a
@@ -57,7 +56,7 @@ def parse_ladder(text: str) -> Tuple[int, ...]:
 def row_ladder() -> Tuple[int, ...]:
     """The configured row ladder (``GORDO_TPU_BATCH_ROW_LADDER``, falling
     back to :data:`DEFAULT_ROW_LADDER` on absent or malformed values)."""
-    raw = os.getenv(ROW_LADDER_ENV)
+    raw = env_str(ROW_LADDER_ENV, None)
     if raw:
         try:
             return parse_ladder(raw)

@@ -45,7 +45,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..models.spec import ModelSpec
-from ..utils.env import env_int
+from ..utils.env import env_int, env_str
 from .costmodel import CostModel
 from .ladder import round_up_ladder, sample_pad_ratio, series_pad_ratio
 
@@ -70,9 +70,7 @@ def default_strategy() -> str:
     """The build-wide strategy (``GORDO_TPU_PLAN_STRATEGY``; default
     ``naive`` — the historical grouping stays the default until a plan
     or an explicit flag opts a build in)."""
-    import os
-
-    raw = (os.getenv(STRATEGY_ENV) or NAIVE).strip().lower()
+    raw = (env_str(STRATEGY_ENV, NAIVE) or NAIVE).strip().lower()
     if raw not in STRATEGIES:
         logger.warning("Invalid %s=%r; using %r", STRATEGY_ENV, raw, NAIVE)
         return NAIVE

@@ -31,6 +31,7 @@ from typing import List, Optional, Tuple
 
 from ..machine.encoders import MachineJSONEncoder
 from ..utils import capture_args
+from ..utils.env import env_str
 from .base import BaseReporter, ReporterException
 
 logger = logging.getLogger(__name__)
@@ -234,7 +235,7 @@ class FileTrackingClient:
     """
 
     def __init__(self, root: Optional[str] = None):
-        self.root = root or os.environ.get(
+        self.root = root or env_str(
             "GORDO_TPU_MLFLOW_DIR", os.path.join(tempfile.gettempdir(), "gordo-mlruns")
         )
 

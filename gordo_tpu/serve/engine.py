@@ -33,7 +33,6 @@ fallback and the default).
 
 import atexit
 import logging
-import os
 import threading
 import time
 from concurrent.futures import CancelledError
@@ -44,7 +43,7 @@ import numpy as np
 
 from ..models.spec import FeedForwardSpec
 from ..telemetry.serving import SERVE_TRACE_FILE, serve_recorder
-from ..utils.env import env_float, env_int
+from ..utils.env import env_bool, env_float, env_int
 from . import ladder
 from .batcher import BatcherStopped, BatchItem, DeadlineExceeded, MicroBatcher
 
@@ -59,7 +58,7 @@ assert SERVE_TRACE_FILE  # imported for re-export
 
 def batching_enabled() -> bool:
     """Master switch: batching is opt-in (``GORDO_TPU_BATCHING=1``)."""
-    return os.getenv(BATCHING_ENV, "0").strip().lower() in ("1", "true", "on", "yes")
+    return env_bool(BATCHING_ENV, False)
 
 
 class ServeConfig:
@@ -110,7 +109,7 @@ class ServeConfig:
             deadline_ms=env_float("GORDO_TPU_BATCH_DEADLINE_MS", 2000.0),
             dispatchers=env_int("GORDO_TPU_BATCH_DISPATCHERS", 1),
             warmup_max_rows=env_int("GORDO_TPU_SERVE_WARMUP_ROWS", 512),
-            inline_flush=env_int("GORDO_TPU_BATCH_INLINE_FLUSH", 1) != 0,
+            inline_flush=env_bool("GORDO_TPU_BATCH_INLINE_FLUSH", True),
         )
 
 

@@ -35,6 +35,7 @@ import gordo_tpu
 
 from ..telemetry import SpanRecorder, tracing
 from ..telemetry import serving as serve_trace
+from ..utils.env import env_bool
 from ..telemetry.profiler import SamplingProfiler, should_profile
 from . import utils as server_utils
 from .utils import ServerError
@@ -610,12 +611,7 @@ def install_graceful_shutdown(app: GordoServerApp, server=None):
 def serve_warmup_enabled() -> bool:
     """Startup precompile of the served buckets' ladder programs: on by
     default whenever batching is on (``GORDO_TPU_SERVE_WARMUP=0`` skips)."""
-    return os.getenv("GORDO_TPU_SERVE_WARMUP", "1").strip().lower() not in (
-        "0",
-        "false",
-        "off",
-        "no",
-    )
+    return env_bool("GORDO_TPU_SERVE_WARMUP", True)
 
 
 def _start_serve_warmup(app: GordoServerApp, engine) -> Optional[object]:

@@ -32,7 +32,7 @@ import numpy as np
 from .. import serializer
 from ..models.estimators import JaxBaseEstimator
 from ..models.spec import FeedForwardSpec, LSTMSpec
-from ..utils.env import env_int
+from ..utils.env import env_bool, env_int
 
 logger = logging.getLogger(__name__)
 
@@ -187,6 +187,8 @@ class RevisionFleet:
                 self.params = params
 
         host = [
+            # gt-lint: disable=jax-device-sync -- one-time member-param
+            # stacking at revision load, outside any program span by design
             _P(jax.device_get(_find_estimator(models[n]).params_)) for n in names
         ]
         stacked = jax.device_put(stack_member_params(host))
@@ -382,7 +384,9 @@ class RevisionFleet:
 def use_pallas() -> bool:
     """Fused Pallas serving kernel: on by default on TPU backends, off
     elsewhere and under ``GORDO_TPU_DISABLE_PALLAS``."""
-    if os.environ.get("GORDO_TPU_DISABLE_PALLAS"):
+    # env_bool: a literal `GORDO_TPU_DISABLE_PALLAS=0` now reads as
+    # enabled-Pallas instead of silently disabling it (truthy-string bug)
+    if env_bool("GORDO_TPU_DISABLE_PALLAS", False):
         return False
     return jax.default_backend() == "tpu"
 

@@ -87,12 +87,9 @@ class BuildProgress:
         #: at every write so the doc carries the fine-grained breakdown
         self.phase_seconds = phase_seconds if phase_seconds is not None else {}
         if heartbeat_seconds is None:
-            try:
-                heartbeat_seconds = float(
-                    os.getenv(HEARTBEAT_ENV, "") or DEFAULT_HEARTBEAT_SECONDS
-                )
-            except ValueError:
-                heartbeat_seconds = DEFAULT_HEARTBEAT_SECONDS
+            from ..utils.env import env_float
+
+            heartbeat_seconds = env_float(HEARTBEAT_ENV, DEFAULT_HEARTBEAT_SECONDS)
         self.heartbeat_seconds = max(0.0, heartbeat_seconds)
         self._phase: Optional[str] = None
         self._phase_order: List[str] = []

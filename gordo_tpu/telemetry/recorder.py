@@ -61,12 +61,9 @@ DEFAULT_KEEP = 3
 def enabled() -> bool:
     """Telemetry master switch: on unless ``GORDO_TPU_TELEMETRY`` is a
     falsy string (``0``/``false``/``off``/``no``)."""
-    return os.getenv(TELEMETRY_ENV, "1").strip().lower() not in (
-        "0",
-        "false",
-        "off",
-        "no",
-    )
+    from ..utils.env import env_bool
+
+    return env_bool(TELEMETRY_ENV, True)
 
 
 def _iso(ts: float) -> str:

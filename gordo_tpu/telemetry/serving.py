@@ -65,7 +65,9 @@ _rate_cache: tuple = (None, DEFAULT_TRACE_SAMPLE_RATE)
 
 def trace_sample_rate() -> float:
     global _rate_cache
-    raw = os.getenv(TRACE_SAMPLE_RATE_ENV)
+    from ..utils.env import env_raw
+
+    raw = env_raw(TRACE_SAMPLE_RATE_ENV)
     cached_raw, cached_rate = _rate_cache
     if raw == cached_raw:
         return cached_rate
@@ -95,7 +97,9 @@ def serve_trace_path() -> Optional[str]:
     """Where the serving trace would land, or None when telemetry is off
     or no ``GORDO_TPU_TELEMETRY_DIR`` is configured (the serving path,
     unlike a build, has no natural output directory to default to)."""
-    trace_dir = os.getenv(TRACE_DIR_ENV)
+    from ..utils.env import env_str
+
+    trace_dir = env_str(TRACE_DIR_ENV, None)
     if not enabled() or not trace_dir:
         return None
     return os.path.join(trace_dir, SERVE_TRACE_FILE)

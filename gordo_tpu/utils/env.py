@@ -1,36 +1,425 @@
 """
-Environment-knob parsing: one warn-and-fall-back implementation for every
-``GORDO_TPU_*`` numeric knob instead of a per-call-site copy.
+Environment-knob parsing and the knob REGISTRY: one warn-and-fall-back
+implementation for every ``GORDO_TPU_*`` knob instead of a per-call-site
+copy, plus the single declared catalog of every knob the codebase reads.
+
+Every ``GORDO_TPU_*`` environment read in the package must go through
+one of the typed accessors here (``env_int``/``env_float``/``env_bool``/
+``env_str``/``env_raw``), and every knob name must be declared in
+:data:`KNOBS` — both invariants are enforced statically by the
+``env-registry`` rule of ``gordo-tpu lint`` (see
+``docs/static-analysis.md``), and the reference table in
+``docs/configuration.md`` is generated from this registry
+(``python docs/generate_env_docs.py``).
+
+Malformed values never raise: they log ONE warning per distinct
+(name, value) pair and fall back to the call-site default.
 
 >>> import os
 >>> os.environ["GORDO_TPU_DOCTEST_KNOB"] = "not-a-number"
 >>> env_int("GORDO_TPU_DOCTEST_KNOB", 7)
 7
+>>> os.environ["GORDO_TPU_DOCTEST_KNOB"] = "maybe"
+>>> env_bool("GORDO_TPU_DOCTEST_KNOB", False)
+False
 >>> del os.environ["GORDO_TPU_DOCTEST_KNOB"]
 """
 
 import logging
 import os
-from typing import Optional
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
+#: truthy / falsy spellings accepted by :func:`env_bool`
+_TRUE_STRINGS = frozenset(("1", "true", "on", "yes"))
+_FALSE_STRINGS = frozenset(("0", "false", "off", "no"))
+
+#: (name, raw) pairs already warned about — malformed knobs warn once,
+#: not once per read (hot paths re-read knobs per request/batch)
+_warned: set = set()
+
+
+def _warn_once(name: str, raw: str, default) -> None:
+    key = (name, raw)
+    if key not in _warned:
+        _warned.add(key)
+        logger.warning("Invalid %s=%r; using %r", name, raw, default)
+
 
 def env_int(name: str, default: int) -> int:
+    """``int(os.environ[name])`` with warn-once fallback to ``default``."""
     raw = os.environ.get(name)
     if raw:
         try:
             return int(raw)
         except ValueError:
-            logger.warning("Invalid %s=%r; using %r", name, raw, default)
+            _warn_once(name, raw, default)
     return default
 
 
 def env_float(name: str, default: Optional[float]) -> Optional[float]:
+    """``float(os.environ[name])`` with warn-once fallback to ``default``."""
     raw = os.environ.get(name)
     if raw:
         try:
             return float(raw)
         except ValueError:
-            logger.warning("Invalid %s=%r; using %r", name, raw, default)
+            _warn_once(name, raw, default)
     return default
+
+
+def env_bool(name: str, default: bool) -> bool:
+    """Boolean knob: ``1/true/on/yes`` → True, ``0/false/off/no`` →
+    False, unset or empty (a blanked-out manifest var) → ``default``;
+    anything else warns once and falls back."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    value = raw.strip().lower()
+    if not value:
+        return default
+    if value in _TRUE_STRINGS:
+        return True
+    if value in _FALSE_STRINGS:
+        return False
+    _warn_once(name, raw, default)
+    return default
+
+
+def env_str(name: str, default: Optional[str]) -> Optional[str]:
+    """String knob: the raw value, with unset/empty falling back to
+    ``default`` (paths, strategy names, comma-lists)."""
+    raw = os.environ.get(name)
+    return raw if raw else default
+
+
+def env_raw(name: str) -> Optional[str]:
+    """The unparsed value (or None) — for call sites that cache a parsed
+    knob keyed on the raw string and only re-parse when it changes."""
+    return os.environ.get(name)
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared ``GORDO_TPU_*`` environment knob.
+
+    ``type`` is the accessor family (``int``/``float``/``bool``/``str``),
+    ``default`` the call-site fallback, ``doc`` the one-line reference
+    description (the docs table row), and ``section`` the grouping header
+    in ``docs/configuration.md``.
+    """
+
+    name: str
+    type: str
+    default: object
+    doc: str
+    section: str = "General"
+
+
+def _knobs(*knobs: Knob) -> Dict[str, Knob]:
+    table: Dict[str, Knob] = {}
+    for knob in knobs:
+        if knob.name in table:
+            raise ValueError(f"duplicate knob declaration: {knob.name}")
+        table[knob.name] = knob
+    return table
+
+
+#: The registry: every ``GORDO_TPU_*`` knob the package reads, in docs
+#: order. Adding a read without declaring it here fails `gordo-tpu lint`
+#: (env-registry rule) and the docs drift test.
+KNOBS: Dict[str, Knob] = _knobs(
+    # -- Training / device performance ------------------------------------
+    Knob(
+        "GORDO_TPU_LSTM_UNROLL", "int", 4,
+        "Recurrence scan unroll factor for LSTM models.",
+        "Performance",
+    ),
+    Knob(
+        "GORDO_TPU_LSTM_SEGMENTED", "int", 0,
+        "Opt-in segmented (stateful-scan) LSTM training: segments per "
+        "update; must divide `batch_size`, requires `shuffle: false` "
+        "(see `docs/architecture.md`).",
+        "Performance",
+    ),
+    Knob(
+        "GORDO_TPU_CV_CHUNK_BYTES", "int", 1 << 30,
+        "Fleet CV super-bucket memory budget in bytes.",
+        "Performance",
+    ),
+    Knob(
+        "GORDO_TPU_PACKING", "str", None,
+        "Block-diagonal packing factor for fleet programs, or `auto`.",
+        "Performance",
+    ),
+    Knob(
+        "GORDO_TPU_COMPILE_CACHE", "str", None,
+        "Directory for JAX's persistent compilation cache — repeated "
+        "`build-fleet` runs and server restarts reload compiled programs "
+        "from disk instead of recompiling (applied at every mesh/backend "
+        "init; the min-compile-time threshold is zeroed so small fleet "
+        "programs are cached too).",
+        "Performance",
+    ),
+    Knob(
+        "GORDO_TPU_DISABLE_PALLAS", "bool", False,
+        "Force the plain-XLA fleet forward program even where the Pallas "
+        "kernel is available.",
+        "Performance",
+    ),
+    Knob(
+        "GORDO_TPU_RING_PREDICT_ROWS", "int", 65_536,
+        "Row threshold past which windowed models shard the prediction "
+        "time axis over the device mesh (`parallel/sequence.py`).",
+        "Performance",
+    ),
+    Knob(
+        "GORDO_TPU_PLATFORM", "str", None,
+        "Device platform override for the CLI (`gordo-tpu --platform`; "
+        "read by click, not `os.environ`).",
+        "Performance",
+    ),
+    # -- Bucket planner ----------------------------------------------------
+    Knob(
+        "GORDO_TPU_PLAN_STRATEGY", "str", "naive",
+        "Bucket-construction strategy: `naive` (historical exact-key "
+        "grouping, default) or `packed` (cost-model bin packing).",
+        "Planner",
+    ),
+    Knob(
+        "GORDO_TPU_PLAN_PAD_RATIO", "float", 1.25,
+        "Geometric growth ratio for the packed strategy's dense sample "
+        "axis.",
+        "Planner",
+    ),
+    Knob(
+        "GORDO_TPU_SERIES_PAD_RATIO", "float", 1.25,
+        "Geometric growth ratio for the windowed (LSTM) series axis — "
+        "applies to BOTH strategies; replaces the old pow2 time-axis "
+        "padding.",
+        "Planner",
+    ),
+    Knob(
+        "GORDO_TPU_PLAN_COMPILE_BUDGET", "int", 0,
+        "Hard cap on planned program count for `packed` (0 = stop rung "
+        "merging at the cost model's compile-vs-padding break-even).",
+        "Planner",
+    ),
+    Knob(
+        "GORDO_TPU_PLAN_HBM_CAP_BYTES", "int", 4 << 30,
+        "Per-bucket predicted resident-bytes cap for `packed` — buckets "
+        "split *before* they would OOM.",
+        "Planner",
+    ),
+    # -- Build robustness --------------------------------------------------
+    Knob(
+        "GORDO_TPU_DATA_RETRIES", "int", 2,
+        "Extra data-fetch attempts per machine; deterministic config "
+        "errors never retry.",
+        "Robustness",
+    ),
+    Knob(
+        "GORDO_TPU_DATA_BACKOFF", "float", 0.5,
+        "Base backoff seconds between fetch attempts, doubling per "
+        "attempt.",
+        "Robustness",
+    ),
+    Knob(
+        "GORDO_TPU_DATA_DEADLINE", "float", None,
+        "Optional per-machine fetch deadline in seconds — retries stop "
+        "once the next backoff would cross it.",
+        "Robustness",
+    ),
+    Knob(
+        "GORDO_TPU_FAULTS", "str", None,
+        "Deterministic fault injection for drills/tests, e.g. "
+        "`device_program:poison-*:times=inf` (sites: `data_fetch`, "
+        "`device_program`, `dump_artifact`, `drift_eval`, `canary_build`, "
+        "`promote_swap`, `rollback`, `process_kill_after_n_machines`).",
+        "Robustness",
+    ),
+    # -- Telemetry ---------------------------------------------------------
+    Knob(
+        "GORDO_TPU_TELEMETRY", "bool", True,
+        "Telemetry master switch: spans, traces, build-status heartbeat.",
+        "Telemetry",
+    ),
+    Knob(
+        "GORDO_TPU_TELEMETRY_DIR", "str", None,
+        "Span-sink directory (`build_trace.jsonl` / `serve_trace.jsonl`); "
+        "builds default to the build output dir, serving has no default.",
+        "Telemetry",
+    ),
+    Knob(
+        "GORDO_TPU_TELEMETRY_HEARTBEAT", "float", 0.5,
+        "`build_status.json` heartbeat throttle seconds (0 = write "
+        "exactly per completion; used by the fault drills).",
+        "Telemetry",
+    ),
+    Knob(
+        "GORDO_TPU_TELEMETRY_MAX_BYTES", "int", 256 * 1024 * 1024,
+        "Trace-sink rotation threshold per generation.",
+        "Telemetry",
+    ),
+    Knob(
+        "GORDO_TPU_TELEMETRY_KEEP", "int", 3,
+        "Rotated trace generations kept per sink (older are deleted).",
+        "Telemetry",
+    ),
+    Knob(
+        "GORDO_TPU_TRACE_SAMPLE_RATE", "float", 0.05,
+        "Head-sampling rate for exported request traces (ids/logs/RED "
+        "metrics see all traffic; an upstream sampled flag or "
+        "`?profile=1` always exports).",
+        "Telemetry",
+    ),
+    Knob(
+        "GORDO_TPU_PROFILE_SAMPLE_RATE", "float", 0.0,
+        "Fraction of requests host-profiled by the sampling profiler "
+        "(`?profile=1` forces one request).",
+        "Telemetry",
+    ),
+    Knob(
+        "GORDO_TPU_PROFILE_INTERVAL_MS", "float", 5.0,
+        "Sampling profiler frame-capture interval.",
+        "Telemetry",
+    ),
+    Knob(
+        "GORDO_TPU_PROFILE_DIR", "str", None,
+        "Directory for `jax.profiler` device traces "
+        "(`utils/profiling.py`; `?profile=device` on the server).",
+        "Telemetry",
+    ),
+    # -- Serving / micro-batching -----------------------------------------
+    Knob(
+        "GORDO_TPU_BATCHING", "bool", False,
+        "Cross-request micro-batching master switch (`gordo_tpu.serve`).",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_BATCH_MAX_SIZE", "int", 32,
+        "Member-axis batch capacity per fused program.",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_BATCH_MAX_DELAY_MS", "float", 5.0,
+        "Max time a request waits in the batch queue before a flush.",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_BATCH_QUEUE_DEPTH", "int", 512,
+        "Admission-control queue depth; overflow sheds with 429 + "
+        "Retry-After.",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_BATCH_DEADLINE_MS", "float", 2000.0,
+        "Per-request queue deadline; expiry sheds with 504.",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_BATCH_DISPATCHERS", "int", 1,
+        "Dispatcher threads per batching engine.",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_BATCH_ROW_LADDER", "str", "32,128,512,2048,8192",
+        "Row-axis padding ladder (comma list, ascending); requests "
+        "taller than the top rung fall back unbatched.",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_BATCH_INLINE_FLUSH", "bool", True,
+        "Let the request thread that fills a batch flush it inline "
+        "instead of waking a dispatcher.",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_SERVE_WARMUP", "bool", True,
+        "Precompile the batch-ladder programs in a background thread at "
+        "server boot (only when batching is on).",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_SERVE_WARMUP_ROWS", "int", 512,
+        "Tallest row rung warmed at boot.",
+        "Serving",
+    ),
+    # -- Lifecycle ---------------------------------------------------------
+    Knob(
+        "GORDO_TPU_DRIFT_SIGMA", "float", 2.0,
+        "Per-feature drift threshold in baseline standard deviations.",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_DRIFT_FEATURE_QUORUM", "float", 0.25,
+        "Fraction of features that must drift before a machine counts as "
+        "drifted.",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_DRIFT_RESIDUAL_RATIO", "float", 2.0,
+        "Serving-mse ratio over the calibrated baseline that marks "
+        "residual drift.",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_DRIFT_MIN_SAMPLES", "int", 64,
+        "Rows a drift window must accumulate before it is evaluated.",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_DRIFT_CALIBRATION", "int", 3,
+        "Scoring batches used to calibrate the residual baseline.",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_GATE_MAX_ERROR_RATE", "float", 0.0,
+        "Canary gate: max tolerated canary scoring error rate.",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_GATE_THRESHOLD_RATIO", "float", 4.0,
+        "Canary gate: max rebuilt-vs-base anomaly-threshold ratio.",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_GATE_RESIDUAL_RATIO", "float", 2.0,
+        "Canary gate: max canary-vs-base residual ratio.",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_CANARY_FRACTION", "float", 0.25,
+        "Fraction of requests routed to a published canary revision.",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_QUARANTINE_COOLDOWN", "float", 3600.0,
+        "Seconds a rolled-back machine stays quarantined before it may "
+        "canary again (wall-clock: quarantine spans process restarts).",
+        "Lifecycle",
+    ),
+    # -- Reporters ---------------------------------------------------------
+    Knob(
+        "GORDO_TPU_MLFLOW_DIR", "str", None,
+        "Local MLflow tracking root (default: `<tmpdir>/gordo-mlruns`).",
+        "Reporters",
+    ),
+    # -- Testing -----------------------------------------------------------
+    Knob(
+        "GORDO_TPU_DOCTEST_KNOB", "int", 7,
+        "Reserved for the `utils.env` doctests and the lint fixture "
+        "suite; never read by production code.",
+        "Testing",
+    ),
+)
+
+
+def knob_sections() -> Tuple[str, ...]:
+    """Section names in declaration order (the docs-table grouping)."""
+    seen: Dict[str, None] = {}
+    for knob in KNOBS.values():
+        seen.setdefault(knob.section)
+    return tuple(seen)

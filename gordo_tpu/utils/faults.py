@@ -187,7 +187,9 @@ def parse_rules(spec: str) -> List[FaultRule]:
 
 def _env_rules() -> List[FaultRule]:
     global _env_cache
-    raw = os.environ.get(ENV_VAR)
+    from .env import env_raw
+
+    raw = env_raw(ENV_VAR)
     if not raw:
         if _env_cache[0] is not None:
             _env_cache = (None, [])

@@ -15,10 +15,15 @@ attribution, the live build-status surface — is ``gordo_tpu.telemetry``
 (docs/observability.md); the two compose (a ``maybe_trace`` region can
 enclose spans and vice versa).
 """
+# gt-lint: file-disable=jax-stdlib-only -- this module IS the jax.profiler
+# wrapper; the import stays lazy so the utils package imports clean on
+# hosts without jax
 
 import contextlib
 import logging
 import os
+
+from .env import env_str
 
 logger = logging.getLogger(__name__)
 
@@ -29,7 +34,7 @@ PROFILE_DIR_ENV = "GORDO_TPU_PROFILE_DIR"
 def maybe_trace(label: str):
     """Trace the enclosed region to ``$GORDO_TPU_PROFILE_DIR/<label>``
     when profiling is enabled; no-op otherwise."""
-    trace_dir = os.getenv(PROFILE_DIR_ENV)
+    trace_dir = env_str(PROFILE_DIR_ENV, None)
     if not trace_dir:
         yield
         return
@@ -44,7 +49,7 @@ def maybe_trace(label: str):
 def annotate(label: str):
     """A ``jax.profiler.TraceAnnotation`` (shows up as a named region in the
     trace viewer) when profiling is on; a null context otherwise."""
-    if not os.getenv(PROFILE_DIR_ENV):
+    if not env_str(PROFILE_DIR_ENV, None):
         return contextlib.nullcontext()
     import jax
 

@@ -57,6 +57,7 @@ case "$component" in
     serve)    run -m "not slow" tests/serve ;;
     planner)  run -m "not slow" tests/planner ;;
     lifecycle) run -m "not slow" tests/lifecycle ;;
+    analysis) run -m "not slow" tests/analysis ;;
     utils)    run -m "not slow" tests/utils ;;
     workflow) run -m "not slow" tests/workflow ;;
     formatting) run tests/test_codestyle.py ;;
@@ -64,6 +65,7 @@ case "$component" in
     slow)     run -m "slow" tests/ ;;
     allelse)
         run -m "not slow" tests/ \
+            --ignore=tests/analysis \
             --ignore=tests/builder --ignore=tests/cli --ignore=tests/client \
             --ignore=tests/dataset --ignore=tests/lifecycle \
             --ignore=tests/machine --ignore=tests/models \

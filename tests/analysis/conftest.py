@@ -1,0 +1,46 @@
+"""Shared fixture-tree helpers for the static-analysis suite: each rule
+test writes a tiny `gordo_tpu/`-shaped tree into tmp_path and lints it
+with the committed contracts, so the tests exercise exactly what CI runs."""
+
+import os
+import textwrap
+
+import pytest
+
+
+@pytest.fixture
+def make_tree(tmp_path):
+    """Write ``{relpath: source}`` under ``tmp_path`` and return the root
+    (sources are dedented; relpaths use ``/``)."""
+
+    def _make(files):
+        for relpath, source in files.items():
+            path = tmp_path / relpath
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(textwrap.dedent(source))
+            # parent packages need __init__.py only for humans; the
+            # linter walks files, not imports
+        return str(tmp_path)
+
+    return _make
+
+
+@pytest.fixture
+def lint_tree(make_tree):
+    """Build a tree, lint it with the shipped rules (optionally a
+    controlled env registry), return the findings list."""
+    from gordo_tpu.analysis import default_rules, run_lint
+
+    def _lint(files, env_registry=None, rules=None):
+        root = make_tree(files)
+        result = run_lint(
+            root, rules if rules is not None else default_rules(env_registry)
+        )
+        return result
+
+    return _lint
+
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)

@@ -1,0 +1,171 @@
+"""Baseline semantics (justification required, fingerprint matching,
+stale detection) and the `gordo-tpu lint` CLI gate: exit codes, --as-json,
+--report-only, --update-baseline."""
+
+import json
+import os
+
+import pytest
+from click.testing import CliRunner
+
+from gordo_tpu.analysis import (
+    BaselineError,
+    default_rules,
+    load_baseline,
+    run_lint,
+    split_by_baseline,
+    write_baseline,
+)
+from gordo_tpu.cli.cli import lint as lint_cli
+
+pytestmark = pytest.mark.analysis
+
+VIOLATION = "from gordo_tpu.server import app\n"
+
+
+def test_baseline_requires_justification(tmp_path):
+    path = tmp_path / "lint_baseline.json"
+    path.write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "entries": [
+                    {
+                        "rule": "layering",
+                        "path": "x.py",
+                        "fingerprint": "abc",
+                        "justification": "   ",
+                    }
+                ],
+            }
+        )
+    )
+    with pytest.raises(BaselineError, match="justification"):
+        load_baseline(str(path))
+
+
+def test_baseline_version_and_shape_enforced(tmp_path):
+    path = tmp_path / "lint_baseline.json"
+    path.write_text(json.dumps({"version": 99, "entries": []}))
+    with pytest.raises(BaselineError, match="version"):
+        load_baseline(str(path))
+    path.write_text("{not json")
+    with pytest.raises(BaselineError, match="unparseable"):
+        load_baseline(str(path))
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    assert load_baseline(str(tmp_path / "nope.json")) == []
+
+
+def test_split_matches_by_fingerprint_and_reports_stale(make_tree, tmp_path):
+    root = make_tree({"gordo_tpu/telemetry/bad.py": VIOLATION})
+    findings = run_lint(root, default_rules()).findings
+    baseline_path = tmp_path / "lint_baseline.json"
+    write_baseline(str(baseline_path), findings, "known, tracked in #123")
+    entries = load_baseline(str(baseline_path))
+    new, baselined, stale = split_by_baseline(findings, entries)
+    assert not new and len(baselined) == 1 and not stale
+    # fix the violation: the entry goes stale
+    (tmp_path / "gordo_tpu/telemetry/bad.py").write_text("x = 1\n")
+    findings = run_lint(root, default_rules()).findings
+    new, baselined, stale = split_by_baseline(findings, entries)
+    assert not new and not baselined and len(stale) == 1
+
+
+def _run_cli(root, *args):
+    return CliRunner().invoke(lint_cli, ["--root", root, *args])
+
+
+def test_cli_exits_nonzero_on_new_finding(make_tree):
+    root = make_tree({"gordo_tpu/telemetry/bad.py": VIOLATION})
+    result = _run_cli(root)
+    assert result.exit_code == 1
+    assert "NEW findings" in result.output
+    assert "[layering]" in result.output
+
+
+def test_cli_report_only_always_exits_zero(make_tree):
+    root = make_tree({"gordo_tpu/telemetry/bad.py": VIOLATION})
+    result = _run_cli(root, "--report-only")
+    assert result.exit_code == 0
+    assert "NEW findings" in result.output
+
+
+def test_cli_as_json_document(make_tree):
+    root = make_tree({"gordo_tpu/telemetry/bad.py": VIOLATION})
+    result = _run_cli(root, "--as-json", "--report-only")
+    assert result.exit_code == 0
+    doc = json.loads(result.output)
+    assert doc["ok"] is False
+    assert doc["counts"]["new"] == 1
+    assert doc["findings"][0]["rule"] == "layering"
+
+
+def test_cli_update_baseline_then_clean(make_tree):
+    root = make_tree({"gordo_tpu/telemetry/bad.py": VIOLATION})
+    result = _run_cli(root, "--update-baseline")
+    assert result.exit_code == 0, result.output
+    baseline_path = os.path.join(root, "lint_baseline.json")
+    assert os.path.exists(baseline_path)
+    # the generated FIXME justification is non-empty, so the gate loads
+    # it and the finding is grandfathered
+    result = _run_cli(root)
+    assert result.exit_code == 0, result.output
+    assert "baselined" in result.output
+
+
+def test_update_baseline_preserves_existing_justifications(make_tree):
+    root = make_tree(
+        {
+            "gordo_tpu/telemetry/bad.py": VIOLATION,
+            "gordo_tpu/telemetry/bad2.py": "from gordo_tpu.serve import engine\n",
+        }
+    )
+    findings = run_lint(root, default_rules()).findings
+    assert len(findings) == 2
+    baseline_path = os.path.join(root, "lint_baseline.json")
+    # hand-write a justification for the FIRST finding only
+    write_baseline(baseline_path, findings[:1], "hand-written rationale #1")
+    # regenerate over both: the existing entry must keep its text
+    result = _run_cli(root, "--update-baseline")
+    assert result.exit_code == 0, result.output
+    entries = {e.fingerprint: e for e in load_baseline(baseline_path)}
+    assert len(entries) == 2
+    assert entries[findings[0].fingerprint].justification == (
+        "hand-written rationale #1"
+    )
+    assert "FIXME" in entries[findings[1].fingerprint].justification
+
+
+def test_parse_error_fails_gate_and_report_says_so(make_tree):
+    root = make_tree({"gordo_tpu/telemetry/broken.py": "def f(:\n"})
+    result = _run_cli(root)
+    assert result.exit_code == 1
+    assert "unparseable" in result.output
+    assert "lint: OK" not in result.output
+
+
+def test_cli_clean_tree_exits_zero(make_tree):
+    root = make_tree({"gordo_tpu/telemetry/ok.py": "x = 1\n"})
+    result = _run_cli(root)
+    assert result.exit_code == 0
+    assert "lint: OK" in result.output
+
+
+def test_cli_rejects_unjustified_baseline(make_tree, tmp_path):
+    root = make_tree({"gordo_tpu/telemetry/ok.py": "x = 1\n"})
+    bad = tmp_path / "bad_baseline.json"
+    bad.write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "entries": [
+                    {"rule": "layering", "path": "x.py", "fingerprint": "abc"}
+                ],
+            }
+        )
+    )
+    result = _run_cli(root, "--baseline", str(bad))
+    assert result.exit_code != 0
+    assert "justification" in result.output

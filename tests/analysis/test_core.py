@@ -1,0 +1,170 @@
+"""Engine semantics: suppression comments (same-line, standalone-line,
+file-wide), fingerprint stability across unrelated line drift, and
+parse-error surfacing."""
+
+import pytest
+
+from gordo_tpu.analysis import default_rules, run_lint
+
+pytestmark = pytest.mark.analysis
+
+#: a telemetry file importing the server — one guaranteed layering finding
+VIOLATION = "from gordo_tpu.server import app\n"
+
+
+def _findings(result, rule=None):
+    return [f for f in result.findings if rule is None or f.rule == rule]
+
+
+def test_plain_violation_is_found(lint_tree):
+    result = lint_tree({"gordo_tpu/telemetry/bad.py": VIOLATION})
+    found = _findings(result, "layering")
+    assert len(found) == 1
+    assert found[0].path == "gordo_tpu/telemetry/bad.py"
+    assert found[0].line == 1
+    assert "gordo_tpu.server" in found[0].message
+    assert found[0].fingerprint  # stamped
+
+
+def test_same_line_suppression(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/bad.py": (
+                "from gordo_tpu.server import app  "
+                "# gt-lint: disable=layering -- test escape\n"
+            )
+        }
+    )
+    assert not _findings(result, "layering")
+    assert result.suppressed == 1
+
+
+def test_standalone_comment_suppresses_next_line(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/bad.py": (
+                "# gt-lint: disable=layering -- the next line is blessed\n"
+                "from gordo_tpu.server import app\n"
+            )
+        }
+    )
+    assert not _findings(result, "layering")
+    assert result.suppressed == 1
+
+
+def test_standalone_comment_covers_multiline_statement(lint_tree):
+    # the finding anchors on the continuation line holding time.time(),
+    # not the statement's first line — the suppression must still hit
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/ok.py": (
+                "import time\n"
+                "def wait(timeout):\n"
+                "    # gt-lint: disable=clock-discipline -- drill\n"
+                "    deadline = (\n"
+                "        time.time() + timeout\n"
+                "    )\n"
+                "    return deadline\n"
+            )
+        }
+    )
+    assert not _findings(result, "clock-discipline")
+    assert result.suppressed == 1
+
+
+def test_env_constant_suffix_collision_resolves_to_neither(lint_tree):
+    # two modules both named env.py exporting FOO_ENV with DIFFERENT
+    # values: `env.FOO_ENV` is ambiguous and must not resolve first-wins
+    result = lint_tree(
+        {
+            "gordo_tpu/a/env.py": "FOO_ENV = 'GORDO_TPU_AAA'\n",
+            "gordo_tpu/b/env.py": "FOO_ENV = 'GORDO_TPU_BBB'\n",
+            "gordo_tpu/models/reader.py": (
+                "import os\n"
+                "from gordo_tpu.a import env\n"
+                "v = os.getenv(env.FOO_ENV)\n"
+            ),
+        }
+    )
+    # unresolvable → no env-registry finding, rather than a finding
+    # naming the wrong knob
+    assert not _findings(result, "env-registry")
+
+
+def test_file_disable_suppresses_everywhere(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/bad.py": (
+                "# gt-lint: file-disable=layering\n"
+                "from gordo_tpu.server import app\n"
+                "from gordo_tpu.serve import engine\n"
+            )
+        }
+    )
+    assert not _findings(result, "layering")
+    assert result.suppressed == 2
+
+
+def test_suppression_is_per_rule(lint_tree):
+    # suppressing an unrelated rule must not hide the layering finding
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/bad.py": (
+                "from gordo_tpu.server import app  "
+                "# gt-lint: disable=clock-discipline\n"
+            )
+        }
+    )
+    assert len(_findings(result, "layering")) == 1
+
+
+def test_fingerprint_stable_across_line_drift(make_tree, tmp_path):
+    root = make_tree({"gordo_tpu/telemetry/bad.py": VIOLATION})
+    first = run_lint(root, default_rules()).findings[0]
+    # unrelated code above moves the finding down two lines
+    (tmp_path / "gordo_tpu/telemetry/bad.py").write_text(
+        "import os\nimport sys\n" + VIOLATION + "assert os and sys\n"
+    )
+    second = run_lint(root, default_rules()).findings[0]
+    assert second.line == 3 != first.line
+    assert second.fingerprint == first.fingerprint
+
+
+def test_duplicate_findings_fingerprint_by_occurrence(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/bad.py": (
+                "def f():\n"
+                "    from gordo_tpu.server import app\n"
+                "def g():\n"
+                "    from gordo_tpu.server import app\n"
+            )
+        }
+    )
+    found = _findings(result, "layering")
+    assert len(found) == 2
+    assert found[0].fingerprint != found[1].fingerprint
+
+
+def test_parse_errors_are_reported_not_fatal(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/broken.py": "def f(:\n",
+            "gordo_tpu/telemetry/bad.py": VIOLATION,
+        }
+    )
+    assert len(result.parse_errors) == 1
+    assert "broken.py" in result.parse_errors[0]
+    assert len(_findings(result, "layering")) == 1
+
+
+def test_parse_errors_fail_the_document_like_the_gate(lint_tree):
+    # ok mirrors the CLI exit: an unparseable file is not a clean run,
+    # even with zero findings
+    from gordo_tpu.analysis import lint_document
+
+    result = lint_tree({"gordo_tpu/telemetry/broken.py": "def f(:\n"})
+    assert not result.findings
+    doc = lint_document(result, [], [], [])
+    assert doc["ok"] is False
+    assert doc["counts"]["parse_errors"] == 1

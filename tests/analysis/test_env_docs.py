@@ -1,0 +1,110 @@
+"""Env-knob registry gates: the generated reference table in
+docs/configuration.md must match the registry (drift test), every
+``GORDO_TPU_*`` token anywhere in the package source must be a declared
+knob, and the typed accessors keep their warn-once fallback contract."""
+
+import logging
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from gordo_tpu.utils import env as env_mod
+from gordo_tpu.utils.env import (
+    KNOBS,
+    env_bool,
+    env_float,
+    env_int,
+    env_str,
+    knob_sections,
+)
+
+from .conftest import REPO_ROOT
+
+pytestmark = pytest.mark.analysis
+
+
+def test_docs_table_is_not_stale():
+    result = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "docs", "generate_env_docs.py"),
+            "--check",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert result.returncode == 0, (
+        "docs/configuration.md drifted from the knob registry:\n"
+        + result.stderr
+    )
+
+
+def test_every_source_token_is_a_declared_knob():
+    """The grep-the-world drift net: any `GORDO_TPU_*` token in package
+    source — code, docstrings, comments — must be a registered knob.
+    This is what caught `GORDO_TPU_DOCTEST_KNOB` living only in a
+    doctest."""
+    token_re = re.compile(r"GORDO_TPU_[A-Z0-9_]+")
+    undeclared = {}
+    for dirpath, dirnames, filenames in os.walk(
+        os.path.join(REPO_ROOT, "gordo_tpu")
+    ):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as handle:
+                for token in token_re.findall(handle.read()):
+                    if token not in KNOBS and token != "GORDO_TPU_":
+                        undeclared.setdefault(token, path)
+    assert not undeclared, (
+        f"undeclared GORDO_TPU_* tokens in source: {undeclared} — declare "
+        "them in gordo_tpu/utils/env.py KNOBS (and regenerate docs) or "
+        "rename"
+    )
+
+
+def test_registry_hygiene():
+    assert len(KNOBS) >= 45
+    for knob in KNOBS.values():
+        assert knob.name.startswith("GORDO_TPU_")
+        assert knob.type in ("int", "float", "bool", "str"), knob.name
+        assert knob.doc.strip(), f"{knob.name} has no doc line"
+        assert knob.section in knob_sections()
+    # sections render in declaration order and are stable
+    assert knob_sections()[0] == "Performance"
+
+
+def test_accessors_parse_and_fall_back(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_DOCTEST_KNOB", "12")
+    assert env_int("GORDO_TPU_DOCTEST_KNOB", 7) == 12
+    monkeypatch.setenv("GORDO_TPU_DOCTEST_KNOB", "2.5")
+    assert env_float("GORDO_TPU_DOCTEST_KNOB", 0.0) == 2.5
+    monkeypatch.setenv("GORDO_TPU_DOCTEST_KNOB", "on")
+    assert env_bool("GORDO_TPU_DOCTEST_KNOB", False) is True
+    monkeypatch.setenv("GORDO_TPU_DOCTEST_KNOB", "no")
+    assert env_bool("GORDO_TPU_DOCTEST_KNOB", True) is False
+    monkeypatch.setenv("GORDO_TPU_DOCTEST_KNOB", "")
+    assert env_str("GORDO_TPU_DOCTEST_KNOB", "fallback") == "fallback"
+    # an EMPTY bool var (blanked-out manifest line) means unset, not
+    # False — default-on knobs like GORDO_TPU_TELEMETRY must stay on
+    assert env_bool("GORDO_TPU_DOCTEST_KNOB", True) is True
+    assert env_bool("GORDO_TPU_DOCTEST_KNOB", False) is False
+    monkeypatch.setenv("GORDO_TPU_DOCTEST_KNOB", "garbage")
+    assert env_int("GORDO_TPU_DOCTEST_KNOB", 7) == 7
+    assert env_bool("GORDO_TPU_DOCTEST_KNOB", True) is True
+
+
+def test_malformed_value_warns_once(monkeypatch, caplog):
+    monkeypatch.setenv("GORDO_TPU_DOCTEST_KNOB", "not-an-int-xyz")
+    env_mod._warned.discard(("GORDO_TPU_DOCTEST_KNOB", "not-an-int-xyz"))
+    with caplog.at_level(logging.WARNING, logger="gordo_tpu.utils.env"):
+        assert env_int("GORDO_TPU_DOCTEST_KNOB", 7) == 7
+        assert env_int("GORDO_TPU_DOCTEST_KNOB", 7) == 7
+    warnings = [r for r in caplog.records if "Invalid" in r.getMessage()]
+    assert len(warnings) == 1

@@ -1,0 +1,141 @@
+"""Rule fixtures: clock discipline in deadline math and Prometheus label
+cardinality."""
+
+import pytest
+
+pytestmark = pytest.mark.analysis
+
+
+def _rules(result, name):
+    return [f for f in result.findings if f.rule == name]
+
+
+# -- clock-discipline --------------------------------------------------------
+
+
+def test_wall_clock_deadline_is_flagged(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/bad.py": (
+                "import time\n"
+                "def wait(timeout):\n"
+                "    deadline = time.time() + timeout\n"
+                "    return deadline\n"
+            )
+        }
+    )
+    found = _rules(result, "clock-discipline")
+    assert len(found) == 1
+    assert "monotonic" in found[0].message
+
+
+def test_wall_clock_comparison_against_deadline_is_flagged(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/bad.py": (
+                "import time\n"
+                "def expired(self):\n"
+                "    return time.time() > self.deadline\n"
+            )
+        }
+    )
+    assert len(_rules(result, "clock-discipline")) == 1
+
+
+def test_wall_clock_timestamps_are_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/ok.py": (
+                "import time\n"
+                "def stamp(doc):\n"
+                "    doc['started_at'] = time.time()\n"
+                "    now = time.time()\n"
+                "    return now\n"
+            )
+        }
+    )
+    assert not _rules(result, "clock-discipline")
+
+
+def test_monotonic_deadline_is_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/ok.py": (
+                "import time\n"
+                "def wait(timeout):\n"
+                "    deadline = time.monotonic() + timeout\n"
+                "    return deadline\n"
+            )
+        }
+    )
+    assert not _rules(result, "clock-discipline")
+
+
+# -- prometheus-cardinality --------------------------------------------------
+
+
+def test_request_attribute_label_is_flagged(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/server/bad.py": (
+                "def observe(counter, request):\n"
+                "    counter.labels(path=request.path).inc()\n"
+            )
+        }
+    )
+    found = _rules(result, "prometheus-cardinality")
+    assert len(found) == 1
+    assert "request" in found[0].message
+
+
+def test_fstring_label_is_flagged(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/server/bad.py": (
+                "def observe(counter, name):\n"
+                "    counter.labels(model=f'model-{name}').inc()\n"
+            )
+        }
+    )
+    found = _rules(result, "prometheus-cardinality")
+    assert len(found) == 1
+    assert "f-string" in found[0].message
+
+
+def test_regex_capture_flows_into_label(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/server/bad.py": (
+                "def observe(counter, match):\n"
+                "    name = match.group('name')\n"
+                "    counter.labels(model=name).inc()\n"
+            )
+        }
+    )
+    assert len(_rules(result, "prometheus-cardinality")) == 1
+
+
+def test_constant_and_sanitized_labels_are_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/server/ok.py": (
+                "def observe(self, counter, request, response):\n"
+                "    labels = self._labels(request, response)\n"
+                "    counter.labels(**labels).inc()\n"
+                "    counter.labels(path='/static', reason='shed').inc()\n"
+            )
+        }
+    )
+    assert not _rules(result, "prometheus-cardinality")
+
+
+def test_labels_outside_server_packages_are_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/client/ok.py": (
+                "def observe(counter, request):\n"
+                "    counter.labels(path=request.path).inc()\n"
+            )
+        }
+    )
+    assert not _rules(result, "prometheus-cardinality")

@@ -1,0 +1,193 @@
+"""Rule fixtures: layering arrows (absolute, relative, lazy imports) and
+the three jax-hazard rules (device-sync-outside-span, stdlib-only
+packages, unhashable jit static args)."""
+
+import pytest
+
+pytestmark = pytest.mark.analysis
+
+
+def _rules(result, name):
+    return [f for f in result.findings if f.rule == name]
+
+
+# -- layering ----------------------------------------------------------------
+
+
+def test_layering_relative_and_lazy_imports(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/bad.py": (
+                "def lazy():\n"
+                "    from ..serializer import serializer\n"
+                "    return serializer\n"
+            )
+        }
+    )
+    found = _rules(result, "layering")
+    assert len(found) == 1
+    assert "gordo_tpu.serializer" in found[0].message
+
+
+def test_layering_planner_must_not_import_serve(lint_tree):
+    result = lint_tree(
+        {"gordo_tpu/planner/bad.py": "import gordo_tpu.serve.engine\n"}
+    )
+    assert len(_rules(result, "layering")) == 1
+
+
+def test_layering_allows_declared_directions(lint_tree):
+    # serve -> planner is the declared direction (ladder re-export)
+    result = lint_tree(
+        {"gordo_tpu/serve/ok.py": "from gordo_tpu.planner import ladder\n"}
+    )
+    assert not _rules(result, "layering")
+
+
+def test_layering_utils_is_bottom_of_stack(lint_tree):
+    result = lint_tree(
+        {"gordo_tpu/utils/bad.py": "from gordo_tpu.telemetry import recorder\n"}
+    )
+    assert len(_rules(result, "layering")) == 1
+
+
+# -- jax-device-sync ---------------------------------------------------------
+
+
+def test_device_sync_outside_span_is_flagged(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/parallel/bad.py": (
+                "import jax\n"
+                "def run(outputs):\n"
+                "    return jax.block_until_ready(outputs)\n"
+            )
+        }
+    )
+    found = _rules(result, "jax-device-sync")
+    assert len(found) == 1
+    assert "program_span" in found[0].message
+
+
+def test_device_sync_inside_span_is_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/parallel/ok.py": (
+                "import jax\n"
+                "from gordo_tpu import telemetry\n"
+                "def run(fit, args, spec):\n"
+                "    with telemetry.program_span('fit', spec):\n"
+                "        out = fit(*args)\n"
+                "        return jax.device_get(out)\n"
+            )
+        }
+    )
+    assert not _rules(result, "jax-device-sync")
+
+
+def test_device_sync_in_sanctioned_helper_is_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/parallel/ok.py": (
+                "import jax\n"
+                "def fetch_to_host(tree):\n"
+                "    return jax.device_get(tree)\n"
+            )
+        }
+    )
+    assert not _rules(result, "jax-device-sync")
+
+
+def test_device_sync_outside_scoped_packages_is_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/client/ok.py": (
+                "import jax\n"
+                "def f(x):\n"
+                "    return jax.device_get(x)\n"
+            )
+        }
+    )
+    assert not _rules(result, "jax-device-sync")
+
+
+# -- jax-stdlib-only ---------------------------------------------------------
+
+
+def test_stdlib_only_flags_lazy_numpy_in_telemetry(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/bad.py": (
+                "def f():\n"
+                "    import numpy as np\n"
+                "    return np.zeros(3)\n"
+            )
+        }
+    )
+    found = _rules(result, "jax-stdlib-only")
+    assert len(found) == 1
+    assert "numpy" in found[0].message
+
+
+def test_stdlib_only_allows_stdlib_and_package_relatives(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/ok.py": (
+                "import json, threading\n"
+                "from ..utils.env import env_int\n"
+                "assert json and threading and env_int\n"
+            )
+        }
+    )
+    assert not _rules(result, "jax-stdlib-only")
+
+
+# -- jax-static-argnum -------------------------------------------------------
+
+
+def test_static_argnum_unhashable_annotation(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/parallel/bad.py": (
+                "import jax\n"
+                "from functools import partial\n"
+                "@partial(jax.jit, static_argnums=(1,))\n"
+                "def f(x, shape: list):\n"
+                "    return x\n"
+            )
+        }
+    )
+    found = _rules(result, "jax-static-argnum")
+    assert len(found) == 1
+    assert "shape" in found[0].message
+
+
+def test_static_argname_unhashable_default(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/parallel/bad.py": (
+                "import jax\n"
+                "def g(x, opts={}):\n"
+                "    return x\n"
+                "g_jit = jax.jit(g, static_argnames=('opts',))\n"
+            )
+        }
+    )
+    found = _rules(result, "jax-static-argnum")
+    assert len(found) == 1
+    assert "opts" in found[0].message
+
+
+def test_static_argnum_hashable_is_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/parallel/ok.py": (
+                "import jax\n"
+                "from functools import partial\n"
+                "@partial(jax.jit, static_argnums=(1,), static_argnames=('interpret',))\n"
+                "def f(x, n: int, interpret: bool = False):\n"
+                "    return x\n"
+            )
+        }
+    )
+    assert not _rules(result, "jax-static-argnum")

@@ -224,3 +224,14 @@ def test_fleet_builder_packs_via_env(monkeypatch, tmp_path):
     assert len(results) == 4
     for model, machine in results:
         assert (tmp_path / machine.name / "model.pkl").exists()
+
+
+def test_fleet_builder_survives_malformed_packing_env(monkeypatch):
+    """A typo'd GORDO_TPU_PACKING warns and disables packing instead of
+    crashing the whole build at FleetBuilder construction (the
+    malformed-env contract every knob now carries)."""
+    from gordo_tpu.parallel import FleetBuilder
+
+    monkeypatch.setenv("GORDO_TPU_PACKING", "fast")
+    builder = FleetBuilder([])
+    assert builder.trainer.packing is None
