@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve test-planner test-lifecycle test-lifecycle-faults test-analysis docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-check lint lint-gordo image
+.PHONY: test test-fast test-faults test-observability test-serve test-planner test-lifecycle test-lifecycle-faults test-analysis test-fleet-health docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-check lint lint-gordo image
 
 test:
 	python -m pytest tests/ -q
@@ -62,6 +62,18 @@ bench-planner:
 # off vs on; writes BENCH_TELEMETRY.json for the bench trajectory.
 bench-telemetry:
 	JAX_PLATFORMS=cpu python benchmarks/bench_telemetry.py
+
+# The fleet console suite: per-member health ledger, device-utilization
+# telemetry, the joined fleet-status CLI/route surface — CPU-only and
+# not slow-marked, so the same tests also run inside the tier-1 budget.
+test-fleet-health:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m fleet_health
+
+# Fleet-health overhead microbench: the same build with all telemetry
+# (ledger + device sampler included) off vs on; writes
+# BENCH_FLEET_HEALTH.json (<=2% overhead is the gate).
+bench-fleet-health:
+	JAX_PLATFORMS=cpu python benchmarks/bench_fleet_health.py
 
 # Full-route serving benchmark + observability acceptance surface:
 # per-stage attribution from serve_trace.jsonl (coverage >= 90% of p50

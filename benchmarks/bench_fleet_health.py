@@ -1,0 +1,184 @@
+"""
+Fleet-health overhead microbench: the same small CPU fleet build with
+ALL telemetry off vs on (spans + heartbeat + the PR 9 health ledger and
+device-utilization sampler), so the fleet console's cost rides the bench
+trajectory with its own gate.
+
+The acceptance bar is the ISSUE's: ledger + device sampler within 2% of
+the telemetry-off floor. The comparison uses the same interleaved
+quiet-window method as BENCH_TELEMETRY (shared hosts show ±50% noise;
+per-mode minima are the only estimator whose noise is one-sided), with
+the mode medians reported alongside. A pure ledger micro-throughput
+number (records/sec through ``record_request``/``record_scores``) rides
+along so a regression in the ledger's lock/write path is visible even
+when build wall-clock noise hides it.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/bench_fleet_health.py
+(or ``make bench-fleet-health``; override the output path with
+``BENCH_FLEET_HEALTH_OUT``, the rep count with
+``BENCH_FLEET_HEALTH_REPS``).
+"""
+
+import datetime
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+#: same sizing rationale as bench_telemetry: big enough that a build is
+#: seconds, so the fixed per-build telemetry cost is an honest fraction
+N_MACHINES = 32
+N_EPOCHS = 10
+REPS = int(os.environ.get("BENCH_FLEET_HEALTH_REPS", "11"))
+
+DATASET = {
+    "type": "RandomDataset",
+    "train_start_date": "2020-01-01T00:00:00+00:00",
+    "train_end_date": "2020-01-05T00:00:00+00:00",
+    "tag_list": ["t1", "t2", "t3"],
+}
+
+MODEL = {
+    "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+        "base_estimator": {
+            "gordo_tpu.models.JaxAutoEncoder": {
+                "kind": "feedforward_hourglass",
+                "encoding_layers": 1,
+                "epochs": N_EPOCHS,
+            }
+        }
+    }
+}
+
+
+def make_machines():
+    from gordo_tpu.machine import Machine
+
+    return [
+        Machine.from_config(
+            {"name": f"bench-health-{i}", "model": MODEL, "dataset": dict(DATASET)},
+            project_name="bench-fleet-health",
+        )
+        for i in range(N_MACHINES)
+    ]
+
+
+def one_build(telemetry_on: bool) -> dict:
+    """One fleet build into a throwaway dir; returns wall seconds and
+    whether the health ledger snapshot landed."""
+    from gordo_tpu.parallel import FleetBuilder
+    from gordo_tpu.telemetry import FLEET_HEALTH_FILE
+    from gordo_tpu.telemetry.fleet_health import reset_ledgers
+
+    os.environ["GORDO_TPU_TELEMETRY"] = "1" if telemetry_on else "0"
+    reset_ledgers()  # each rep builds into a fresh dir
+    out = tempfile.mkdtemp(prefix="bench-fleet-health-")
+    try:
+        start = time.perf_counter()
+        builder = FleetBuilder(make_machines())
+        results = builder.build(output_dir=out)
+        elapsed = time.perf_counter() - start
+        assert len(results) == N_MACHINES, builder.build_errors
+        return {
+            "seconds": elapsed,
+            "ledger_written": os.path.exists(
+                os.path.join(out, FLEET_HEALTH_FILE)
+            ),
+        }
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+
+
+def ledger_micro_throughput() -> float:
+    """Pure ledger-path throughput: records/sec through the lock +
+    throttled-write path a serving process pays per request."""
+    from gordo_tpu.telemetry.fleet_health import FleetHealthLedger
+
+    out = tempfile.mkdtemp(prefix="bench-health-ledger-")
+    try:
+        ledger = FleetHealthLedger(directory=out, heartbeat_seconds=0.05)
+        n = 200_000
+        start = time.perf_counter()
+        for i in range(n):
+            ledger.record_request(f"m-{i % 64}", error=(i % 97 == 0))
+        elapsed = time.perf_counter() - start
+        ledger.flush()
+        return n / elapsed
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+
+
+def main() -> dict:
+    # Warmup: compile every program once so both measured modes run the
+    # same steady-state cache-hit path.
+    one_build(telemetry_on=False)
+    one_build(telemetry_on=True)
+
+    runs = {"telemetry_off": [], "telemetry_on": []}
+    ledger_written = False
+    pair_pcts = []
+    for rep in range(REPS):
+        if rep % 2 == 0:
+            off = one_build(telemetry_on=False)
+            on = one_build(telemetry_on=True)
+        else:
+            on = one_build(telemetry_on=True)
+            off = one_build(telemetry_on=False)
+        ledger_written = ledger_written or on["ledger_written"]
+        runs["telemetry_off"].append(off["seconds"])
+        runs["telemetry_on"].append(on["seconds"])
+        pair_pcts.append(
+            (on["seconds"] - off["seconds"]) / off["seconds"] * 100.0
+        )
+
+    timings = {
+        mode: {
+            "runs_sec": [round(v, 4) for v in values],
+            "best_sec": min(values),
+            "median_sec": statistics.median(values),
+        }
+        for mode, values in runs.items()
+    }
+    off_floor = timings["telemetry_off"]["best_sec"]
+    on_floor = timings["telemetry_on"]["best_sec"]
+    overhead_pct = (on_floor - off_floor) / off_floor * 100.0
+    doc = {
+        "bench": "fleet-health-overhead",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "machines": N_MACHINES,
+        "epochs": N_EPOCHS,
+        "reps": REPS,
+        "telemetry_off_sec": round(off_floor, 4),
+        "telemetry_on_sec": round(on_floor, 4),
+        "pair_overhead_pcts": [round(p, 2) for p in pair_pcts],
+        "median_pair_overhead_pct": round(statistics.median(pair_pcts), 2),
+        "overhead_pct": round(overhead_pct, 2),
+        "within_2pct": overhead_pct <= 2.0,
+        "ledger_written": ledger_written,
+        "ledger_records_per_sec": round(ledger_micro_throughput(), 1),
+        "runs": timings,
+    }
+    out_path = Path(
+        os.environ.get(
+            "BENCH_FLEET_HEALTH_OUT", REPO_ROOT / "BENCH_FLEET_HEALTH.json"
+        )
+    )
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(doc, indent=1, sort_keys=True))
+    print(f"\nwrote {out_path}")
+    return doc
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,7 @@ class Contracts:
     clock_suspect_names: str = "deadline|timeout|expir|backoff|cutoff"
     prometheus_scopes: Tuple[str, ...] = ()
     prometheus_tainted_roots: Tuple[str, ...] = ("request",)
+    prometheus_suspect_loop_vars: str = "member|machine|gordo_name"
 
 
 def _parse_toml_subset(text: str) -> Dict:
@@ -152,6 +153,11 @@ def load_contracts(path: Optional[str] = None) -> Contracts:
         prometheus_scopes=tuple(prometheus.get("scopes", ())),
         prometheus_tainted_roots=tuple(
             prometheus.get("tainted_roots", defaults.prometheus_tainted_roots)
+        ),
+        prometheus_suspect_loop_vars=str(
+            prometheus.get(
+                "suspect_loop_vars", defaults.prometheus_suspect_loop_vars
+            )
         ),
     )
 

@@ -14,10 +14,19 @@ packages:
 - expressions reading ``request.*`` (the configured taint roots);
 - local names assigned from ``request.*`` or from a regex
   ``.group(...)`` in the same function, unless the assignment also
-  passes through an obvious collapse (a string constant result).
+  passes through an obvious collapse (a string constant result);
+- **member identities** (PR 9): values whose dotted name matches the
+  configured ``suspect_loop_vars`` regex (``machine.name``, ``member``,
+  ``gordo_name``) or loop variables iterating a collection whose name
+  matches it (``for name, loss in member_losses.items(): ...``). A
+  per-fleet-member label value mints one timeseries per machine — the
+  ``gordo_fleet_member_final_loss`` failure class; per-member values
+  belong in the fleet health ledger (``telemetry/fleet_health.py``),
+  Prometheus gets bounded aggregates.
 """
 
 import ast
+import re
 from typing import Iterator, Optional, Set
 
 from ..astutil import call_name, dotted_name, enclosing_function
@@ -75,6 +84,62 @@ def _is_tainted_expr(node: ast.AST, roots: Set[str], local_taint: Set[str]) -> O
     return None
 
 
+def _suspect_loop_targets(
+    fn: Optional[ast.AST], suspect: "re.Pattern"
+) -> Set[str]:
+    """Names bound as for-loop (or comprehension) targets whose iterated
+    expression's dotted name matches the member-identity regex — e.g.
+    ``name`` in ``for name, loss in member_losses.items():``. Iterating
+    a bounded constant (``for stage in ("decode", "infer")``) never
+    qualifies: the taint is the member COLLECTION, not loops per se."""
+    targets: Set[str] = set()
+    if fn is None:
+        return targets
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iter_expr, target_nodes = node.iter, [node.target]
+        elif isinstance(node, ast.comprehension):
+            iter_expr, target_nodes = node.iter, [node.target]
+        else:
+            continue
+        # `members.items()` / `sorted(machines)` — look through the call
+        # to the collection expression it reads
+        probe = iter_expr
+        while isinstance(probe, ast.Call):
+            probe = (
+                probe.func
+                if not probe.args
+                else probe.args[0]
+            )
+        name = dotted_name(probe) or ""
+        if not suspect.search(name.lower()):
+            continue
+        for target in target_nodes:
+            for sub in ast.walk(target):
+                if isinstance(sub, ast.Name):
+                    targets.add(sub.id)
+    return targets
+
+
+def _member_suspect(
+    node: ast.AST, suspect: "re.Pattern", loop_targets: Set[str]
+) -> Optional[str]:
+    """Why this label value looks like a per-member identity, or None."""
+    for sub in _iter_taint_nodes(node):
+        if not isinstance(sub, (ast.Name, ast.Attribute)):
+            continue
+        name = dotted_name(sub)
+        if name is None:
+            continue
+        if suspect.search(name.lower()):
+            return f"member-identity name `{name}`"
+        if isinstance(sub, ast.Name) and sub.id in loop_targets:
+            return (
+                f"loop variable `{sub.id}` over a member collection"
+            )
+    return None
+
+
 def _local_tainted_names(fn: Optional[ast.AST], roots: Set[str]) -> Set[str]:
     """Names assigned from request.* or regex captures in this function."""
     tainted: Set[str] = set()
@@ -103,6 +168,7 @@ class PrometheusCardinalityRule:
         if not in_scope(file.module, ctx.contracts.prometheus_scopes):
             return
         roots = set(ctx.contracts.prometheus_tainted_roots)
+        suspect = re.compile(ctx.contracts.prometheus_suspect_loop_vars)
         for node in ast.walk(file.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -110,22 +176,39 @@ class PrometheusCardinalityRule:
                 isinstance(node.func, ast.Attribute) and node.func.attr == "labels"
             ):
                 continue
-            local_taint = _local_tainted_names(enclosing_function(node), roots)
+            fn = enclosing_function(node)
+            local_taint = _local_tainted_names(fn, roots)
+            loop_targets = _suspect_loop_targets(fn, suspect)
             values = list(node.args) + [
                 kw.value for kw in node.keywords if kw.arg is not None
             ]
             for value in values:
                 why = _is_tainted_expr(value, roots, local_taint)
-                if why is None:
+                if why is not None:
+                    yield Finding(
+                        rule=self.name,
+                        path=file.relpath,
+                        line=value.lineno,
+                        col=value.col_offset,
+                        message=(
+                            f"label value flows from {why} — unbounded label "
+                            "values mint a timeseries per distinct input; "
+                            "collapse to a route shape or a bounded enum first"
+                        ),
+                    )
                     continue
-                yield Finding(
-                    rule=self.name,
-                    path=file.relpath,
-                    line=value.lineno,
-                    col=value.col_offset,
-                    message=(
-                        f"label value flows from {why} — unbounded label "
-                        "values mint a timeseries per distinct input; "
-                        "collapse to a route shape or a bounded enum first"
-                    ),
-                )
+                why = _member_suspect(value, suspect, loop_targets)
+                if why is not None:
+                    yield Finding(
+                        rule=self.name,
+                        path=file.relpath,
+                        line=value.lineno,
+                        col=value.col_offset,
+                        message=(
+                            f"label value is a {why} — one timeseries per "
+                            "fleet member is unbounded cardinality (the "
+                            "gordo_fleet_member_final_loss failure class); "
+                            "route per-member values into the fleet health "
+                            "ledger and export bounded aggregates"
+                        ),
+                    )

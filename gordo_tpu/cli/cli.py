@@ -783,6 +783,60 @@ def build_status(output_dir: str, as_json: bool, watch: Optional[float]):
         click.echo("")
 
 
+@click.command("fleet-status")
+@click.argument("directory", envvar="OUTPUT_DIR")
+@click.option(
+    "--as-json",
+    "as_json",
+    is_flag=True,
+    help="Print the raw joined document instead of the table",
+)
+@click.option(
+    "--watch",
+    default=None,
+    type=float,
+    help="Re-render every N seconds (Ctrl-C to stop)",
+)
+def fleet_status(directory: str, as_json: bool, watch: Optional[float]):
+    """
+    The fleet console: ONE joined operator view over DIRECTORY (a build
+    output / served revision dir) — build progress
+    (``build_status.json``), plan accuracy incl. the measured
+    HBM/padding actuals (``fleet_plan.json`` + the health ledger),
+    per-member health counts with the unhealthiest machines
+    (``fleet_health.json``), lifecycle phase and quarantine records
+    (``.lifecycle/state.json``), device memory occupancy and
+    compile-cache hit rates.
+
+    The model server answers the same document at
+    ``/gordo/v0/<project>/fleet-health`` — point this CLI at the
+    artifact volume, or curl the route for a live serving process's
+    in-memory view (its device counters see the serving programs).
+    """
+    import time as time_mod
+
+    from ..telemetry import (
+        fleet_status_document,
+        render_fleet_status,
+        utilization_snapshot,
+    )
+
+    if not os.path.isdir(directory):
+        raise click.ClickException(f"No such directory: {directory}")
+    while True:
+        doc = fleet_status_document(
+            directory, device=utilization_snapshot()
+        )
+        if as_json:
+            click.echo(json.dumps(doc, indent=1, sort_keys=True, default=str))
+        else:
+            click.echo(render_fleet_status(doc))
+        if watch is None:
+            break
+        time_mod.sleep(max(0.1, watch))
+        click.echo("")
+
+
 @click.command("trace")
 @click.argument("target", envvar="OUTPUT_DIR")
 @click.option(
@@ -1650,6 +1704,7 @@ gordo_tpu_cli.add_command(build)
 gordo_tpu_cli.add_command(build_fleet)
 gordo_tpu_cli.add_command(plan_fleet)
 gordo_tpu_cli.add_command(build_status)
+gordo_tpu_cli.add_command(fleet_status)
 gordo_tpu_cli.add_command(trace)
 gordo_tpu_cli.add_command(bench_check)
 gordo_tpu_cli.add_command(lint)

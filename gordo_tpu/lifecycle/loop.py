@@ -158,6 +158,13 @@ class LifecycleSupervisor:
         self._project = (
             getattr(self.machines[0], "project_name", "") if self.machines else ""
         )
+        # Per-member health ledger (telemetry/fleet_health.py), keyed to
+        # the ANCHOR collection dir — the operator's stable handle, the
+        # same dir the server's fleet-health route reads — so drift
+        # verdicts, quarantines and promotions survive revision swaps.
+        self._ledger: Any = telemetry.ledger_for(
+            self.collection_dir, project=self._project
+        )
 
     # -- identity -----------------------------------------------------------
 
@@ -195,7 +202,29 @@ class LifecycleSupervisor:
             scores, errors = fleet.fleet_scores(frames)
         self.monitor.observe_scores(frames, scores)
         self._probe_frames = dict(frames)
+        self._feed_scores(frames, scores)
         return scores, errors
+
+    def _feed_scores(self, frames: Dict[str, Any], scores: Dict) -> None:
+        """Rolling per-machine residual means into the health ledger
+        (one snapshot write for the whole window)."""
+        try:
+            import numpy as np
+
+            for name, entry in scores.items():
+                frame = frames.get(name)
+                rows = len(frame) if frame is not None else 0
+                residuals = np.asarray(entry[1], dtype=float).ravel()
+                residuals = residuals[np.isfinite(residuals)]
+                self._ledger.record_scores(
+                    name,
+                    rows,
+                    float(residuals.mean()) if len(residuals) else None,
+                    write=False,
+                )
+            self._ledger.write()
+        except Exception as exc:  # noqa: BLE001 - the ledger is advisory
+            logger.debug("health ledger scores not recorded: %r", exc)
 
     def evaluate_drift(self) -> Dict[str, DriftVerdict]:
         """Every machine's drift verdict (windows reset)."""
@@ -215,6 +244,18 @@ class LifecycleSupervisor:
                         if isinstance(v, (int, float))
                     },
                 )
+        try:
+            for name, verdict in verdicts.items():
+                self._ledger.record_drift(
+                    name,
+                    verdict.drifted,
+                    verdict.reasons,
+                    verdict.stats,
+                    write=False,
+                )
+            self._ledger.flush()
+        except Exception as exc:  # noqa: BLE001 - the ledger is advisory
+            logger.debug("health ledger drift not recorded: %r", exc)
         return verdicts
 
     # -- the cycle ----------------------------------------------------------
@@ -311,6 +352,11 @@ class LifecycleSupervisor:
                 build_dir,
                 base_plan_path=os.path.join(self.serving_dir, PLAN_FILE),
                 resume=True,
+                # rebuilt members' provenance (fresh losses, cleared or
+                # re-tripped degrade flags) lands in the ANCHOR ledger
+                # the fleet-status surfaces read, not in a ledger keyed
+                # to this staging build dir
+                health_ledger=self._ledger,
             )
         failed = sorted(builder.build_errors)
         rebuilt = sorted(set(stale) - set(failed))
@@ -325,14 +371,15 @@ class LifecycleSupervisor:
                 revision,
                 self.serving_revision,
             )
+            reasons = [
+                f"{name}: rebuild failed ({exc!r})"
+                for name, exc in sorted(builder.build_errors.items())
+            ]
             self.state.quarantine(
                 {
                     "canary_revision": revision,
                     "machines": stale,
-                    "reasons": [
-                        f"{name}: rebuild failed ({exc!r})"
-                        for name, exc in sorted(builder.build_errors.items())
-                    ],
+                    "reasons": reasons,
                 }
             )
             self.state.transition(
@@ -340,6 +387,7 @@ class LifecycleSupervisor:
                 stale=[], rebuilt=[],
             )
             self._count_event("rollbacks")
+            self._ledger.record_quarantine(stale, revision, reasons)
             report.rolled_back = True
             return
         canary_path = publish_canary(
@@ -430,6 +478,7 @@ class LifecycleSupervisor:
                 self.collection_dir, canary_path, warm=self.config.warm_swaps
             )
         swap_seconds = time.monotonic() - start
+        rebuilt = list(self.state.doc.get("rebuilt") or self.state.stale)
         self.state.transition(
             "idle",
             event="promoted",
@@ -438,6 +487,7 @@ class LifecycleSupervisor:
             stale=[],
             rebuilt=[],
         )
+        self._ledger.record_promotion(revision, rebuilt)
         logger.info(
             "promoted canary %s into serving (swap %.3fs)",
             revision,
@@ -467,6 +517,7 @@ class LifecycleSupervisor:
     ) -> None:
         revision = self.state.canary_revision
         reasons = reasons or list(self.state.doc.get("reasons") or [])
+        quarantined = self.state.stale
         fault_point("rollback", revision or "")
         with self.recorder.span("rollback", canary_revision=revision):
             self.store.clear_canary(self.collection_dir)
@@ -503,6 +554,7 @@ class LifecycleSupervisor:
         report.rolled_back = True
         report.details["quarantined"] = revision
         self._count_event("rollbacks")
+        self._ledger.record_quarantine(quarantined, revision, reasons)
 
     def _quarantine_cooldown(self) -> set:
         """Machines whose canaries were quarantined within the cooldown

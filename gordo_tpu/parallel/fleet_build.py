@@ -190,6 +190,7 @@ class FleetBuilder:
         plan_strategy: Optional[str] = None,
         fleet_plan: Optional[Any] = None,
         cost_table: Optional[Any] = None,
+        health_ledger: Optional[Any] = None,
     ):
         self.machines = list(machines)
         if trainer is None:
@@ -276,6 +277,31 @@ class FleetBuilder:
         # cost model's error is observable (event + gauges at build end).
         self._current_phase = ""
         self._plan_actuals: Dict[str, float] = defaultdict(float)
+        # Per-member fleet health ledger (telemetry/fleet_health.py):
+        # build provenance — final losses, failures, degradations —
+        # lands per machine, so the fleet console can answer "which of
+        # my machines are degraded" without parsing the span trace.
+        # An explicit `health_ledger` overrides the default
+        # ledger-per-output-dir: lifecycle incremental rebuilds train
+        # into a .lifecycle/build-<rev> STAGING dir, but their
+        # provenance belongs in the anchor collection's ledger — the
+        # one the fleet-status surfaces actually read.
+        self._health_ledger_override = health_ledger
+        self._ledger: Any = telemetry.NULL_LEDGER
+        self._output_revision: Optional[str] = None
+        # Measured device-utilization actuals: member-axis occupancy of
+        # the executed final-fit programs and the max observed HBM peak
+        # (Device.memory_stats), joined against the FleetPlan's
+        # predictions in _export_plan_accuracy.
+        self._member_actuals: Dict[str, int] = defaultdict(int)
+        self._device_peak_bytes = 0
+        self._last_device_sample = 0.0
+
+    #: phases that end with a device-utilization sample (``cv_*`` phases
+    #: recur once per bucket chunk and are throttled by time instead)
+    _DEVICE_SAMPLED_PHASES = frozenset(
+        {"stage", "cv_train", "final_fit", "assemble", "dump"}
+    )
 
     @contextlib.contextmanager
     def _phase(self, name: str):
@@ -291,6 +317,32 @@ class FleetBuilder:
         finally:
             self._current_phase = previous_phase
             self.phase_seconds[name] += time.time() - start
+            self._sample_device(name)
+
+    def _sample_device(self, phase: str) -> None:
+        """Emit a ``device_utilization`` event (HBM in-use/peak +
+        compile-cache counters) at the end of device-heavy phases,
+        time-throttled so a thousand-chunk CV loop costs a handful of
+        samples, not a thousand. Tracks the build's max observed HBM
+        peak for the plan-accuracy join."""
+        if phase not in self._DEVICE_SAMPLED_PHASES:
+            return
+        now = time.time()
+        if now - self._last_device_sample < 1.0 and phase != "final_fit":
+            return
+        self._last_device_sample = now
+        try:
+            snapshot = telemetry.emit_device_utilization(
+                self.recorder, phase=phase
+            )
+        except Exception as exc:  # noqa: BLE001 - device telemetry is advisory
+            logger.debug("device utilization not sampled: %r", exc)
+            return
+        if snapshot and snapshot.get("available"):
+            self._device_peak_bytes = max(
+                self._device_peak_bytes,
+                int(snapshot.get("max_peak_bytes_in_use") or 0),
+            )
 
     def _fail(self, name: str, exc: BaseException):
         if self._journal is not None:
@@ -373,7 +425,22 @@ class FleetBuilder:
         self.resumed = []
         self._journal = None
         self._plan_actuals = defaultdict(float)
+        self._member_actuals = defaultdict(int)
+        self._device_peak_bytes = 0
         self._project = self.machines[0].project_name if self.machines else ""
+        self._output_revision = (
+            os.path.basename(os.path.normpath(output_dir))
+            if output_dir is not None
+            else None
+        )
+        if self._health_ledger_override is not None:
+            self._ledger = self._health_ledger_override
+        elif output_dir is not None:
+            self._ledger = telemetry.ledger_for(
+                output_dir, project=self._project
+            )
+        else:
+            self._ledger = telemetry.NULL_LEDGER
 
         recorder: Any = telemetry.NULL_RECORDER
         self.progress = None
@@ -428,6 +495,7 @@ class FleetBuilder:
             raise
         finally:
             recorder.close()
+            self._ledger.flush()
         if self.progress is not None:
             self.progress.finish("complete")
             self._update_progress_gauges()
@@ -633,6 +701,16 @@ class FleetBuilder:
             self._plan_actuals["seconds"] += seconds
             if attrs.get("compile"):
                 self._plan_actuals["compiles"] += 1
+            # Measured member-axis occupancy: `members` is the live
+            # bucket size, `stacked_members` the padded rung the program
+            # actually executed — the measured counterpart of the plan's
+            # predicted padding waste.
+            live = attrs.get("members")
+            padded = attrs.get("stacked_members")
+            if live is not None and padded:
+                self._member_actuals["live"] += int(live)
+                self._member_actuals["padded"] += int(padded)
+        self._feed_health_ledger(name, attrs)
         try:
             from ..server.prometheus import metrics as prom
 
@@ -653,6 +731,50 @@ class FleetBuilder:
                     prom.record_member_final_loss(self._project, float(loss))
         except Exception as exc:  # noqa: BLE001 - metrics are advisory
             logger.debug("Telemetry span not exported: %r", exc)
+
+    def _feed_health_ledger(self, name: str, attrs: Dict[str, Any]) -> None:
+        """Per-member build provenance into the fleet health ledger
+        (telemetry/fleet_health.py) as the build's own events happen.
+        Per-member VALUES live in the ledger; Prometheus only ever sees
+        the bounded loss histogram and the aggregate health counts (the
+        PR 8 cardinality contract)."""
+        machine = attrs.get("machine")
+        if not machine:
+            return
+        try:
+            if name == "member_trained":
+                loss = attrs.get("final_loss")
+                self._ledger.record_build(
+                    str(machine),
+                    final_loss=(
+                        float(loss)
+                        if loss is not None and np.isfinite(loss)
+                        else None
+                    ),
+                    retries=attrs.get("retries"),
+                )
+            elif name == "machine_built":
+                # an artifact landing supersedes a PREVIOUS build's
+                # failure evidence (a recovered machine must not read
+                # 'degraded' forever) — but a machine that degraded to
+                # the sequential builder in THIS build keeps the flag
+                # its artifact genuinely carries (None = leave as-is)
+                self._ledger.record_build(
+                    str(machine),
+                    revision=self._output_revision,
+                    failed=False,
+                    degraded=False if str(machine) not in self.degraded else None,
+                )
+            elif name == "machine_failed":
+                self._ledger.record_build(
+                    str(machine), failed=True, error=attrs.get("error")
+                )
+            elif name == "machine_degraded":
+                self._ledger.record_build(
+                    str(machine), degraded=True, error=attrs.get("error")
+                )
+        except Exception as exc:  # noqa: BLE001 - the ledger is advisory
+            logger.debug("Health ledger not fed: %r", exc)
 
     def _update_progress_gauges(self) -> None:
         """Push the live machine-progress counters to the Prometheus
@@ -994,15 +1116,32 @@ class FleetBuilder:
         totals = plan.totals
         actual_seconds = round(float(self._plan_actuals.get("seconds", 0.0)), 3)
         actual_compiles = int(self._plan_actuals.get("compiles", 0))
-        self.recorder.event(
-            "fleet_plan_accuracy",
+        # MEASURED utilization actuals beside the predicted numbers:
+        # member-axis occupancy of the executed final-fit programs and
+        # the max HBM peak Device.memory_stats() reported during the
+        # build (None on backends without the stats) — the feedback the
+        # ROADMAP's learned-performance-model work trains on.
+        padded = int(self._member_actuals.get("padded", 0))
+        measured_waste = (
+            round(1.0 - self._member_actuals["live"] / padded, 6)
+            if padded
+            else None
+        )
+        measured_hbm = self._device_peak_bytes or None
+        accuracy = dict(
             plan_hash=plan.plan_hash,
             strategy=plan.strategy,
             predicted_compiles=totals.get("compiles", 0),
             actual_compiles=actual_compiles,
             predicted_wall_s=totals.get("predicted_wall_s", 0.0),
             actual_fit_s=actual_seconds,
+            predicted_padding_waste=totals.get("padding_waste", 0.0),
+            measured_member_waste=measured_waste,
+            predicted_hbm_peak_bytes=totals.get("hbm_peak_bytes", 0),
+            measured_hbm_peak_bytes=measured_hbm,
         )
+        self.recorder.event("fleet_plan_accuracy", **accuracy)
+        self._ledger.record_plan_accuracy(accuracy)
         try:
             from ..server.prometheus.metrics import set_fleet_plan_actuals
 
@@ -1879,6 +2018,7 @@ def rebuild_stale(
     base_plan_path: Optional[str] = None,
     resume: bool = True,
     trainer: Optional[FleetTrainer] = None,
+    health_ledger: Optional[Any] = None,
 ) -> FleetBuilder:
     """
     Partial-fleet rebuild: train ONLY ``stale_names`` (the drift-tripped
@@ -1923,6 +2063,9 @@ def rebuild_stale(
         [m for m in machines if m.name in stale],
         trainer=trainer,
         fleet_plan=base_plan,
+        # provenance belongs in the CALLER's (anchor) ledger, not one
+        # keyed to this staging dir nothing ever reads
+        health_ledger=health_ledger,
     )
     builder.build(output_dir=output_dir, resume=resume)
     return builder

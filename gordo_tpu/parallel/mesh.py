@@ -71,6 +71,11 @@ def configure_compile_cache() -> Optional[str]:
         )
         return None
     _compile_cache_configured = True
+    # device telemetry inventories the configured cache (entries/bytes)
+    # for the fleet-status surface and the Prometheus device collector
+    from ..telemetry.device import note_compile_cache_dir
+
+    note_compile_cache_dir(cache_dir)
     logger.info("JAX persistent compilation cache at %s", cache_dir)
     return cache_dir
 

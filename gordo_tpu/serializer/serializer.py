@@ -21,6 +21,7 @@ import uuid
 from os import path
 from typing import Any, Optional
 
+from ..telemetry.fleet_health import FLEET_HEALTH_FILE
 from ..telemetry.progress import BUILD_STATUS_FILE, BUILD_TRACE_FILE
 from ..telemetry.serving import SERVE_TRACE_FILE
 from ..utils import json_compat as simplejson
@@ -105,7 +106,7 @@ def is_staging_dir(name: str) -> bool:
 def is_builder_dropping(name: str) -> bool:
     """True for any non-model entry the fleet builder may leave in an
     artifact directory: the build journal, its event overlay, the
-    telemetry heartbeat/trace files — including their size-rotated
+    telemetry heartbeat/trace/health-ledger files — including their size-rotated
     generations (``build_trace.jsonl.1`` ...) and the serving-side
     ``serve_trace.jsonl`` when ``GORDO_TPU_TELEMETRY_DIR`` points at
     the artifact volume — and atomic-write staging leftovers. Revision
@@ -117,6 +118,7 @@ def is_builder_dropping(name: str) -> bool:
         or name == BUILD_STATUS_FILE
         or name == BUILD_TRACE_FILE
         or name == SERVE_TRACE_FILE
+        or name == FLEET_HEALTH_FILE
         or name.startswith(BUILD_TRACE_FILE + ".")
         or name.startswith(SERVE_TRACE_FILE + ".")
         or is_staging_dir(name)

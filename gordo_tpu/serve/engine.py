@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..models.spec import FeedForwardSpec
+from ..telemetry.device import note_program_execution
 from ..telemetry.serving import SERVE_TRACE_FILE, serve_recorder
 from ..utils.env import env_bool, env_float, env_int
 from . import ladder
@@ -320,9 +321,14 @@ class ServeEngine:
             backend = "pallas" if use_pallas() else "xla"
             program = (spec, backend, padded_members, padded_rows)
             with self._lock:
+                new_program = program not in self._programs
                 self._programs.add(program)
                 self._counters["batches"] += 1
                 self._counters["coalesced"] += members
+            # serve-side compile-vs-cache-hit accounting (telemetry
+            # device console): a shape first seen here paid the XLA
+            # compile inside this batch's device call
+            note_program_execution(new_program, kind="serve")
 
             scatter_start = time.monotonic()
             with self._recorder.span("scatter"):
@@ -427,6 +433,7 @@ class ServeEngine:
                         padded_rows=padded_rows,
                     ):
                         np.asarray(fleet_forward_gather(spec, stacked, indices, X))
+                    note_program_execution(True, kind="serve")
                     compiled += 1
         self._count("warmup_programs", compiled)
         if self.metrics is not None:

@@ -253,6 +253,11 @@ URL_MAP = Map(
             endpoint="build-status",
             methods=["GET"],
         ),
+        Rule(
+            f"{PREFIX}/<gordo_project>/fleet-health",
+            endpoint="fleet-health",
+            methods=["GET"],
+        ),
         Rule(f"{PREFIX}/<gordo_project>/models", endpoint="models", methods=["GET"]),
         Rule(
             f"{PREFIX}/<gordo_project>/revisions",
@@ -280,6 +285,7 @@ HANDLERS = {
     "revisions": base.get_revision_list,
     "expected-models": base.get_expected_models,
     "build-status": base.get_build_status,
+    "fleet-health": base.get_fleet_health,
 }
 
 
@@ -391,6 +397,7 @@ class GordoServerApp:
         if ctx.profiler is not None:
             profile_report = ctx.profiler.stop()
             ctx.profiler = None
+        self._record_health(ctx, response)
         if ctx.sampled and ctx.endpoint not in self.UNTRACED_ENDPOINTS:
             serve_trace.export_request_trace(
                 ctx.timing,
@@ -413,6 +420,43 @@ class GordoServerApp:
                 profile=profile_report,
             )
         return response
+
+    #: endpoints whose outcomes feed the per-member health ledger —
+    #: scoring traffic only (metadata/listing requests say nothing about
+    #: a machine's serving health)
+    HEALTH_ENDPOINTS = ("prediction", "anomaly-prediction")
+
+    def _record_health(self, ctx: RequestContext, response: Response) -> None:
+        """Per-machine request/error counts into the fleet health ledger
+        (telemetry/fleet_health.py), keyed to the ANCHOR collection dir
+        (the env var, not the routed revision) so counts survive
+        lifecycle hot-swaps. 5xx marks the machine; 4xx is the client's
+        problem. Best-effort and throttled — the ledger must never cost
+        the request path more than a dict update.
+
+        Gated on a RESOLVED model: ``gordo_name`` is client-supplied URL
+        text, and recording it unconditionally would let a scanner mint
+        one ledger record (and one 'healthy' machine in the Prometheus
+        counts) per random path — the same request-derived-identity
+        cardinality class the ``{unmatched}`` label collapse guards
+        against. A name that never loaded a model is not a machine."""
+        if (
+            ctx.endpoint not in self.HEALTH_ENDPOINTS
+            or not ctx.gordo_name
+            or ctx.model is None
+        ):
+            return
+        try:
+            from ..telemetry import ledger_for
+
+            anchor = os.environ.get(self.config["MODEL_COLLECTION_DIR_ENV_VAR"])
+            if not anchor:
+                return
+            ledger_for(anchor, project=self.config.get("PROJECT") or "").record_request(
+                ctx.gordo_name, error=response.status_code >= 500
+            )
+        except Exception:  # noqa: BLE001 - health telemetry is advisory
+            logger.debug("health ledger request not recorded", exc_info=True)
 
     def dispatch(self, request: Request) -> Response:
         ctx = RequestContext(request, self.config)

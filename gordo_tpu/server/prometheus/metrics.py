@@ -32,7 +32,13 @@ _MODEL_PATH_RE = re.compile(r"^/gordo/v0/(?P<project>[^/]+)/(?P<name>[^/]+)(?:/|
 # Routes that would only add scrape noise.
 DEFAULT_IGNORE_PATHS = ("/healthcheck",)
 
-PROJECT_LEVEL_ROUTES = ("models", "revisions", "expected-models", "build-status")
+PROJECT_LEVEL_ROUTES = (
+    "models",
+    "revisions",
+    "expected-models",
+    "build-status",
+    "fleet-health",
+)
 
 #: request-stage latency buckets: stages span sub-millisecond metadata
 #: lookups to second-scale inference+serialize on fat payloads — the
@@ -71,6 +77,7 @@ def multiprocess_registry() -> Optional[CollectorRegistry]:
         # fan-in alone would silently drop them: they must ride every
         # registry that answers scrapes.
         register_program_cache_collector(registry)
+        register_fleet_console_collectors(registry)
         return registry
     return None
 
@@ -143,6 +150,10 @@ class GordoServerPrometheusMetrics:
         self.info.labels(
             version=gordo_tpu.__version__, project=project or ""
         ).set(1)
+        # the fleet console's scrape-time aggregates (health states,
+        # score histogram, device memory, compile-cache hit counters)
+        # ride every scrape registry, batching on or off
+        register_fleet_console_collectors(self.registry)
         # label-child caches: prometheus_client's .labels() rebuilds a
         # key tuple and takes the metric lock per call (~10us); on the
         # request hot path that is paid 2-7 times per request. Children
@@ -521,6 +532,125 @@ def register_program_cache_collector(registry: CollectorRegistry) -> None:
     registry.register(ProgramCacheCollector())
 
 
+class FleetHealthCollector:
+    """Scrape-time BOUNDED aggregates of the per-member health ledger
+    (``telemetry/fleet_health.py``): machines-by-state counts and the
+    fixed-bucket health-score histogram. Per-machine detail deliberately
+    never reaches a label — that is the ledger's job (the PR 8
+    prometheus-cardinality contract); the label sets here are constants:
+    four states, five score buckets."""
+
+    def collect(self):
+        from prometheus_client.core import (
+            GaugeHistogramMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        from ...telemetry.fleet_health import SCORE_BUCKETS, ledger_summaries
+
+        states = GaugeMetricFamily(
+            "gordo_fleet_health_machines",
+            "Fleet members by health state (quarantined > degraded > "
+            "drifting > healthy; per-machine detail lives in "
+            "fleet_health.json, not in labels)",
+            labels=["state"],
+        )
+        scores = GaugeHistogramMetricFamily(
+            "gordo_fleet_health_score",
+            "Distribution of per-member health scores in [0, 1] "
+            "(1.0 = healthy; see telemetry.fleet_health.health_score)",
+            labels=[],
+        )
+        totals = {"healthy": 0, "degraded": 0, "drifting": 0, "quarantined": 0}
+        bins = [0] * len(SCORE_BUCKETS)
+        machines = 0
+        score_sum = 0.0
+        for summary in ledger_summaries().values():
+            if not summary:
+                continue
+            machines += summary.get("machines", 0)
+            for state in totals:
+                totals[state] += int(summary.get(state, 0))
+            histogram = summary.get("score_histogram") or {}
+            counts = histogram.get("counts") or []
+            for i, count in enumerate(counts[: len(bins)]):
+                bins[i] += int(count)
+            score_sum += float(histogram.get("score_sum") or 0.0)
+        for state, count in totals.items():
+            states.add_metric([state], count)
+        cumulative = 0
+        buckets = []
+        for edge, count in zip(SCORE_BUCKETS, bins):
+            cumulative += count
+            buckets.append((str(edge), cumulative))
+        buckets.append(("+Inf", machines))
+        # gsum is the sum of SCORES (mean fleet health = sum / count in
+        # one PromQL division), never the machine count
+        scores.add_metric([], buckets=buckets, gsum_value=score_sum)
+        yield states
+        yield scores
+
+
+class DeviceUtilizationCollector:
+    """Scrape-time device telemetry (``telemetry/device.py``): measured
+    HBM occupancy per backend (summed over local devices) and the
+    process-wide compile-vs-cache-hit counters — the measured
+    counterpart of the planner's predicted HBM numbers. All label sets
+    are constants (three memory kinds, two sides, two results)."""
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        from ...telemetry import device as device_telemetry
+
+        memory_family = GaugeMetricFamily(
+            "gordo_device_memory_bytes",
+            "Device memory summed over local devices "
+            "(Device.memory_stats; absent when the backend reports none)",
+            labels=["kind"],
+        )
+        memory = device_telemetry.memory_snapshot()
+        if memory and memory.get("available"):
+            for kind in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                if kind in memory:
+                    memory_family.add_metric([kind], memory[kind])
+            yield memory_family
+        programs = CounterMetricFamily(
+            "gordo_compile_cache_events",
+            "jit-program executions by compile-cache outcome: "
+            "result=compile is a cache miss that paid XLA, result=hit a "
+            "steady-state run (side=build for fleet training programs, "
+            "side=serve for the fused serving programs)",
+            labels=["side", "result"],
+        )
+        for side, counters in sorted(
+            device_telemetry.program_cache_counters().items()
+        ):
+            programs.add_metric([side, "compile"], counters.get("compiles", 0))
+            programs.add_metric([side, "hit"], counters.get("cache_hits", 0))
+        yield programs
+
+
+#: registries already carrying the fleet-console collectors (same
+#: duplicate-registration guard as the program-cache WeakSet)
+_fleet_console_registries: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_fleet_console_collectors(registry: CollectorRegistry) -> None:
+    """Attach the fleet-health and device-utilization scrape collectors
+    to ``registry``, once — on every registry that answers scrapes,
+    like the program-cache collector (scrape-time collectors have no
+    mmap backing to ride the multiprocess fan-in)."""
+    if registry in _fleet_console_registries:
+        return
+    _fleet_console_registries.add(registry)
+    registry.register(FleetHealthCollector())
+    registry.register(DeviceUtilizationCollector())
+
+
 class ServeMetrics:
     """The micro-batching engine's metric set: queue depth, batch size /
     coalesce-ratio / padding-waste histograms, and the shed counter.
@@ -575,6 +705,7 @@ class ServeMetrics:
             registry=self.registry,
         )
         register_program_cache_collector(self.registry)
+        register_fleet_console_collectors(self.registry)
 
     def observe_batch(self, size: int, occupancy: float, padding_waste: float):
         self.batch_size.labels(project=self.project).observe(size)

@@ -172,6 +172,7 @@ def post_fleet_prediction(ctx, gordo_project: str):
             scores, score_errors = STORE.fleet(ctx.collection_dir).fleet_scores(
                 frames
             )
+        _record_fleet_health(ctx, frames, scores, score_errors)
         for name, exc in score_errors.items():
             # Filesystem/internal errors never echo raw text (it can carry
             # server paths; details live in the server log); client-data
@@ -286,6 +287,49 @@ def post_fleet_prediction(ctx, gordo_project: str):
     return ctx.json_response(context, status=200 if data else 400)
 
 
+def _record_fleet_health(ctx, frames, scores, score_errors) -> None:
+    """Per-machine serving health out of one fleet-scoring window:
+    request+row counts and the rolling residual mean for machines that
+    scored, an error mark for machines that failed server-side. One
+    throttled snapshot write for the whole batch (the ledger is keyed
+    to the anchor collection dir, like the single-model path)."""
+    try:
+        from ...telemetry import ledger_for
+
+        anchor = os.environ.get(ctx.config["MODEL_COLLECTION_DIR_ENV_VAR"])
+        if not anchor:
+            return
+        ledger = ledger_for(anchor)
+        if not ledger.enabled:
+            return
+        # every name here came through check_metadata_file (an artifact
+        # dir on disk) — score/error keys are bounded by the volume's
+        # machines, never by client-invented request text
+        for name, (reconstruction, mse) in scores.items():
+            residuals = np.asarray(mse, dtype=float).ravel()
+            residuals = residuals[np.isfinite(residuals)]
+            frame = frames.get(name)
+            ledger.record_scores(
+                name,
+                len(frame) if frame is not None else len(residuals),
+                float(residuals.mean()) if len(residuals) else None,
+                write=False,
+            )
+            ledger.record_request(name)
+        for name, exc in score_errors.items():
+            # client-side failures (ValueError/TypeError → 4xx, missing
+            # model → 404) are not the machine's health problem
+            ledger.record_request(
+                name,
+                error=not isinstance(
+                    exc, (ValueError, TypeError, FileNotFoundError)
+                ),
+            )
+        ledger.write()
+    except Exception:  # noqa: BLE001 - health telemetry is advisory
+        logger.debug("fleet health not recorded", exc_info=True)
+
+
 def _full_anomaly_entry(
     fleet, name, X, y, metadata, reconstruction, keep_smooth
 ):
@@ -363,6 +407,35 @@ def get_build_status(ctx, gordo_project: str):
         return ctx.json_response(
             {"error": "No build status for this revision."}, status=404
         )
+    return ctx.json_response(doc)
+
+
+def get_fleet_health(ctx, gordo_project: str):
+    """
+    The joined fleet-status document for the served collection: build
+    progress, plan accuracy (predicted vs measured HBM/padding), the
+    per-member health ledger, lifecycle/quarantine state, device memory
+    and compile-cache hit rates — exactly what the ``gordo-tpu
+    fleet-status`` CLI renders, as one JSON payload. Sections the
+    directory has no data for are null rather than errors: a plain
+    build dir still answers, so does a serve-only dir.
+    """
+    from ...telemetry import fleet_status_document, utilization_snapshot
+    from ..fleet_store import program_cache_stats
+
+    # the ANCHOR dir (the env var), not the routed revision: the ledger
+    # and lifecycle state are keyed to the operator's stable handle
+    anchor = os.environ.get(ctx.config["MODEL_COLLECTION_DIR_ENV_VAR"])
+    directory = anchor or ctx.collection_dir
+    try:
+        programs = program_cache_stats()
+    except Exception:  # noqa: BLE001 - cache stats are advisory
+        programs = None
+    doc = fleet_status_document(
+        directory,
+        device=utilization_snapshot(),
+        programs=programs,
+    )
     return ctx.json_response(doc)
 
 

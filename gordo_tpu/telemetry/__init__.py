@@ -7,6 +7,27 @@ path imports this package, so it must never pull in server or metrics
 dependencies.
 """
 
+from .device import (
+    DEVICE_TELEMETRY_ENV,
+    device_sampling_enabled,
+    emit_device_utilization,
+    memory_snapshot,
+    note_program_execution,
+    program_cache_counters,
+    utilization_snapshot,
+)
+from .fleet_health import (
+    FLEET_HEALTH_ENV,
+    FLEET_HEALTH_FILE,
+    NULL_LEDGER,
+    FleetHealthLedger,
+    fleet_status_document,
+    health_enabled,
+    ledger_for,
+    ledger_summaries,
+    load_health,
+    render_fleet_status,
+)
 from .progress import (
     HEARTBEAT_ENV,
     BuildProgress,
@@ -50,9 +71,14 @@ from .tracing import (
 
 __all__ = [
     "BuildProgress",
+    "DEVICE_TELEMETRY_ENV",
+    "FLEET_HEALTH_ENV",
+    "FLEET_HEALTH_FILE",
+    "FleetHealthLedger",
     "HEARTBEAT_ENV",
     "KEEP_ENV",
     "MAX_BYTES_ENV",
+    "NULL_LEDGER",
     "NULL_RECORDER",
     "NullRecorder",
     "SERVE_TRACE_FILE",
@@ -64,21 +90,33 @@ __all__ = [
     "activate",
     "bind_trace",
     "current_trace_id",
+    "device_sampling_enabled",
+    "emit_device_utilization",
     "enabled",
     "eta_seconds",
     "export_request_trace",
+    "fleet_status_document",
     "format_traceparent",
     "get_recorder",
+    "health_enabled",
     "install_trace_log_stamping",
+    "ledger_for",
+    "ledger_summaries",
+    "load_health",
     "load_status",
+    "memory_snapshot",
     "new_span_id",
     "new_trace_id",
+    "note_program_execution",
     "parse_traceparent",
+    "program_cache_counters",
     "program_span",
+    "render_fleet_status",
     "render_status",
     "reset_seen_programs",
     "reset_serve_recorder",
     "seen_program",
     "serve_recorder",
     "serve_trace_path",
+    "utilization_snapshot",
 ]

@@ -100,6 +100,25 @@ GATES: Dict[str, List[MetricSpec]] = {
             bound=0.0,
         ),
     ],
+    "fleet-health-overhead": [
+        MetricSpec(
+            "health ledger + device sampler overhead (%)",
+            "overhead_pct",
+            "max_bound",
+            bound=2.0,
+        ),
+        MetricSpec(
+            "fleet_health.json written by the instrumented build",
+            "ledger_written",
+            "truthy",
+        ),
+        MetricSpec(
+            "ledger record throughput (records/s)",
+            "ledger_records_per_sec",
+            "higher",
+            0.5,
+        ),
+    ],
 }
 
 #: where each bench kind's committed baseline lives (repo root)
@@ -109,6 +128,7 @@ BASELINE_FILES: Dict[str, str] = {
     "telemetry-overhead": "BENCH_TELEMETRY.json",
     "planner-strategies": "BENCH_PLAN.json",
     "lifecycle-hot-swap": "BENCH_LIFECYCLE.json",
+    "fleet-health-overhead": "BENCH_FLEET_HEALTH.json",
 }
 
 

@@ -1,0 +1,238 @@
+"""
+Device-utilization telemetry: measured HBM occupancy and compile-cache
+hit accounting.
+
+Everything the planner (PR 5) says about device memory is a *prediction*
+from spec geometry, and everything the compile-cache work (PR 5) does is
+invisible once it works — until now nothing measured either. This module
+closes both gaps:
+
+- :func:`memory_snapshot` reads ``Device.memory_stats()`` off every
+  local device (``bytes_in_use`` / ``peak_bytes_in_use`` / the backend's
+  limit) and aggregates them into one JSON-able dict. The fleet builder
+  emits it as a ``device_utilization`` event at phase boundaries (the
+  measured counterpart of the FleetPlan's predicted HBM), and the
+  Prometheus device collector reads it at scrape time. Backends without
+  the stats (older CPU jaxlib) degrade to ``{"available": False}`` —
+  callers never branch on platform.
+- :func:`note_program_execution` is the process-wide compile-vs-cache-hit
+  counter pair, fed by the two places that know: the build side's
+  :func:`~gordo_tpu.telemetry.recorder.program_span` (first call per
+  signature = compile, later = hit — the jit cache's own semantics) and
+  the serving engine's fused-program bookkeeping. The persistent
+  compile-cache directory (``GORDO_TPU_COMPILE_CACHE``), when
+  ``parallel/mesh.py`` configures one, is inventoried by
+  :func:`persistent_cache_info` (entries + bytes on disk).
+
+The counters and snapshots here are stdlib data; only the memory probe
+touches jax, lazily, so importing this module stays free on hosts
+without an accelerator stack.
+"""
+# gt-lint: file-disable=jax-stdlib-only -- this module IS the telemetry
+# package's Device.memory_stats() wrapper; the jax import stays lazy and
+# failure-isolated so the package still imports (and the counters still
+# work) on hosts without jax
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+#: master switch for the (slightly costly) device memory probe; the
+#: counters are a few ns and stay on with telemetry itself
+DEVICE_TELEMETRY_ENV = "GORDO_TPU_DEVICE_TELEMETRY"
+
+#: memory_stats() keys aggregated across local devices (keys a backend
+#: does not report simply contribute nothing)
+_MEMORY_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def device_sampling_enabled() -> bool:
+    """Memory sampling on? (telemetry master switch AND
+    ``GORDO_TPU_DEVICE_TELEMETRY``, both default-on)."""
+    from ..utils.env import env_bool
+    from .recorder import enabled
+
+    return enabled() and env_bool(DEVICE_TELEMETRY_ENV, True)
+
+
+# -- compile-cache hit/miss counters -----------------------------------------
+
+_counter_lock = threading.Lock()
+#: kind -> {"compiles": n, "cache_hits": n}; ``build`` is fed by
+#: program_span's first-call attribution, ``serve`` by the engine's
+#: fused-program set
+_program_counters: Dict[str, Dict[str, int]] = {}
+
+
+def note_program_execution(compiled: bool, kind: str = "build") -> None:
+    """Count one jit-program execution: ``compiled=True`` for a
+    cache-miss (trace+compile happened inside the call), False for a
+    steady-state cache-hit run."""
+    with _counter_lock:
+        counters = _program_counters.get(kind)
+        if counters is None:
+            counters = _program_counters[kind] = {
+                "compiles": 0,
+                "cache_hits": 0,
+            }
+        counters["compiles" if compiled else "cache_hits"] += 1
+
+
+def program_cache_counters() -> Dict[str, Dict[str, Any]]:
+    """Snapshot of the per-kind compile/cache-hit counters, each with a
+    derived ``hit_rate`` (None until anything executed)."""
+    with _counter_lock:
+        snapshot = {
+            kind: dict(counters) for kind, counters in _program_counters.items()
+        }
+    for counters in snapshot.values():
+        total = counters["compiles"] + counters["cache_hits"]
+        counters["hit_rate"] = (
+            round(counters["cache_hits"] / total, 4) if total else None
+        )
+    return snapshot
+
+
+def reset_program_counters() -> None:
+    """Zero the counters (tests only — production keeps them for the
+    life of the process, like the jit caches they describe)."""
+    with _counter_lock:
+        _program_counters.clear()
+
+
+# -- persistent compile cache -------------------------------------------------
+
+_cache_dir_lock = threading.Lock()
+_persistent_cache_dir: Optional[str] = None
+
+
+def note_compile_cache_dir(path: Optional[str]) -> None:
+    """Record the persistent compile-cache directory
+    ``parallel/mesh.configure_compile_cache`` actually configured (the
+    env knob alone does not mean the configure call succeeded)."""
+    global _persistent_cache_dir
+    with _cache_dir_lock:
+        _persistent_cache_dir = path
+
+
+def persistent_cache_info() -> Optional[Dict[str, Any]]:
+    """Inventory of the persistent compile cache (entry count + bytes),
+    or None when no cache directory is configured. Best-effort: a
+    vanished directory reports zero entries, never raises."""
+    with _cache_dir_lock:
+        cache_dir = _persistent_cache_dir
+    if cache_dir is None:
+        from ..utils.env import env_str
+
+        cache_dir = env_str("GORDO_TPU_COMPILE_CACHE", None)
+    if not cache_dir:
+        return None
+    entries = 0
+    total_bytes = 0
+    try:
+        with os.scandir(cache_dir) as it:
+            for entry in it:
+                try:
+                    if entry.is_file():
+                        entries += 1
+                        total_bytes += entry.stat().st_size
+                except OSError:
+                    continue
+    except OSError:
+        pass
+    return {"path": cache_dir, "entries": entries, "bytes": total_bytes}
+
+
+# -- device memory ------------------------------------------------------------
+
+
+def memory_snapshot() -> Optional[Dict[str, Any]]:
+    """
+    Aggregate ``Device.memory_stats()`` over the local devices:
+    ``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit`` summed
+    across devices, plus the per-device maxima (the number an HBM-cap
+    planner compares against) and how many devices actually reported.
+
+    Returns None when sampling is disabled or jax is unavailable;
+    ``{"available": False, ...}`` when the backend has no stats (the
+    distinction callers render differently: "off" vs "not measurable").
+    """
+    if not device_sampling_enabled():
+        return None
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 - no jax / broken backend: telemetry
+        # must degrade, never take the caller down
+        return None
+    doc: Dict[str, Any] = {
+        "devices": len(devices),
+        "measured_devices": 0,
+        "available": False,
+    }
+    totals = {key: 0 for key in _MEMORY_KEYS}
+    maxima = {key: 0 for key in _MEMORY_KEYS}
+    for device in devices:
+        try:
+            stats = device.memory_stats()
+        except Exception:  # noqa: BLE001 - per-device isolation
+            stats = None
+        if not stats:
+            continue
+        doc["measured_devices"] += 1
+        for key in _MEMORY_KEYS:
+            value = stats.get(key)
+            if value is None and key == "peak_bytes_in_use":
+                # some backends spell peak differently; fall back to
+                # in-use so the field is never silently absent
+                value = stats.get("bytes_in_use")
+            if value is None:
+                continue
+            value = int(value)
+            totals[key] += value
+            maxima[key] = max(maxima[key], value)
+    if doc["measured_devices"]:
+        doc["available"] = True
+        for key in _MEMORY_KEYS:
+            doc[key] = totals[key]
+            doc[f"max_{key}"] = maxima[key]
+        limit = totals.get("bytes_limit") or 0
+        if limit:
+            doc["utilization"] = round(totals["bytes_in_use"] / limit, 4)
+    return doc
+
+
+def utilization_snapshot() -> Dict[str, Any]:
+    """The full device-telemetry document: memory + compile-cache
+    counters + persistent-cache inventory (each section None/absent when
+    unavailable). This is what the ``device_utilization`` events and the
+    fleet-status surface carry."""
+    doc: Dict[str, Any] = {"compile_cache": program_cache_counters()}
+    memory = memory_snapshot()
+    if memory is not None:
+        doc["memory"] = memory
+    persistent = persistent_cache_info()
+    if persistent is not None:
+        doc["persistent_cache"] = persistent
+    return doc
+
+
+def emit_device_utilization(recorder: Any, **attributes: Any) -> Optional[dict]:
+    """Emit one ``device_utilization`` event onto ``recorder`` (memory +
+    cache counters flattened to event attributes) and return the
+    snapshot, or None when sampling is off/unavailable. The fleet
+    builder calls this at phase boundaries — a handful of samples per
+    build, not per program."""
+    memory = memory_snapshot()
+    if memory is None:
+        return None
+    counters = program_cache_counters().get("build") or {}
+    recorder.event(
+        "device_utilization",
+        **attributes,
+        **{f"memory_{k}": v for k, v in memory.items()},
+        compiles=counters.get("compiles", 0),
+        cache_hits=counters.get("cache_hits", 0),
+    )
+    return memory

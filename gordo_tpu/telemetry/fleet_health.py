@@ -1,0 +1,836 @@
+"""
+The per-member fleet health ledger and the joined fleet-status surface.
+
+The paper's operating premise is thousands of models watching machines
+for months — and after PRs 3/6/7 an operator can watch a *build* (the
+``build_status.json`` heartbeat), a *request* (serve traces + RED
+metrics) and a *lifecycle cycle* (state.json), but still cannot answer
+the fleet question: *which of my machines are degraded, drifting or
+quarantined right now, and is the device actually full?* This module is
+that answer:
+
+- :class:`FleetHealthLedger` — one rolling health record per machine:
+  serving counts (requests/errors/rows + a running residual mean),
+  the latest drift verdict (the PR 6 windows' feature-shift σ and
+  residual ratio), build provenance (revision, final loss,
+  degraded/bisected flags from ``BuildMetadata.robustness``), and
+  quarantine state. Fed by the serve path (``app._finalize`` + the
+  fleet route), the fleet builder's span listener, and the lifecycle
+  supervisor; persisted as atomic, heartbeat-throttled
+  ``fleet_health.json`` snapshots beside the artifacts.
+- Per-machine detail lives HERE, never in Prometheus labels (the PR 8
+  cardinality contract): the scrape side gets bounded aggregates only —
+  machines-by-state counts and a health-score histogram
+  (``server/prometheus/metrics.py`` reads :func:`ledger_summaries` at
+  scrape time).
+- :func:`fleet_status_document` — the one joined operator view:
+  ``build_status.json`` + ``fleet_plan.json`` (with the measured
+  padding/HBM actuals the builder records back into the ledger) +
+  lifecycle ``state.json``/``quarantine.json`` + the health ledger +
+  device utilization, rendered by ``gordo-tpu fleet-status`` and served
+  at ``/gordo/v0/<project>/fleet-health``.
+
+Stdlib-only, like the rest of the package: the device-memory section is
+*injected* by callers (``telemetry/device.py`` owns the jax probe).
+"""
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .recorder import _iso, enabled
+
+logger = logging.getLogger(__name__)
+
+#: the ledger snapshot written beside the artifacts (a builder dropping,
+#: like build_status.json — serializer.is_builder_dropping knows it)
+FLEET_HEALTH_FILE = "fleet_health.json"
+
+#: master switch for the ledger (rides the telemetry master switch too)
+FLEET_HEALTH_ENV = "GORDO_TPU_FLEET_HEALTH"
+#: seconds between serving-count snapshot writes (state transitions —
+#: drift verdicts, quarantines, build records — always force a write)
+HEALTH_HEARTBEAT_ENV = "GORDO_TPU_HEALTH_HEARTBEAT"
+DEFAULT_HEALTH_HEARTBEAT = 2.0
+#: rows after which the rolling serving window decays (halves), so a
+#: months-lived server's residual mean tracks the present, not January
+HEALTH_WINDOW_ENV = "GORDO_TPU_HEALTH_WINDOW"
+DEFAULT_HEALTH_WINDOW = 100_000
+
+#: upper edges of the bounded health-score histogram the Prometheus side
+#: exports — fixed, so the scrape cardinality is a constant
+SCORE_BUCKETS = (0.25, 0.5, 0.75, 0.9, 1.0)
+
+#: lifecycle state-file names, mirrored from ``gordo_tpu.lifecycle.state``
+#: (the layering contract forbids telemetry -> lifecycle imports; a test
+#: asserts the two spellings stay equal)
+_LIFECYCLE_DIR = ".lifecycle"
+_LIFECYCLE_STATE_FILE = "state.json"
+_LIFECYCLE_QUARANTINE_FILE = "quarantine.json"
+
+
+def health_enabled() -> bool:
+    """Ledger on? (telemetry master switch AND ``GORDO_TPU_FLEET_HEALTH``,
+    both default-on)."""
+    from ..utils.env import env_bool
+
+    return enabled() and env_bool(FLEET_HEALTH_ENV, True)
+
+
+# -- the health math ----------------------------------------------------------
+
+
+def _new_machine() -> Dict[str, Any]:
+    return {
+        "serving": {
+            "requests": 0,
+            "errors": 0,
+            "rows": 0,
+            "residual_mean": None,
+            "last_request_at": None,
+        },
+        "drift": {
+            "drifted": False,
+            "reasons": [],
+            "feature_shift_max": None,
+            "residual_ratio": None,
+            "window_rows": 0,
+            "evaluated_at": None,
+        },
+        "build": {
+            "revision": None,
+            "final_loss": None,
+            "degraded": False,
+            "failed": False,
+            "error": None,
+            "bisects": 0,
+            "retries": 0,
+            "built_at": None,
+        },
+        "quarantine": {
+            "active": False,
+            "revision": None,
+            "reasons": [],
+            "since": None,
+        },
+    }
+
+
+def health_score(machine: Dict[str, Any]) -> float:
+    """One machine's health in [0, 1]: 1.0 healthy, descending through
+    drift (−0.2), a degraded/failed build (−0.3), serving errors (up to
+    −0.3, proportional to the error rate) and quarantine (−0.5).
+    Deterministic in the record — the score is derived state, never
+    stored ground truth."""
+    score = 1.0
+    if machine["quarantine"]["active"]:
+        score -= 0.5
+    if machine["build"]["degraded"] or machine["build"]["failed"]:
+        score -= 0.3
+    if machine["drift"]["drifted"]:
+        score -= 0.2
+    serving = machine["serving"]
+    if serving["requests"]:
+        score -= min(0.3, 3.0 * serving["errors"] / serving["requests"])
+    return round(max(0.0, min(1.0, score)), 4)
+
+
+def machine_state(machine: Dict[str, Any]) -> str:
+    """The machine's headline state, by severity: ``quarantined`` >
+    ``degraded`` (failed/degraded build) > ``drifting`` > ``healthy``."""
+    if machine["quarantine"]["active"]:
+        return "quarantined"
+    if machine["build"]["degraded"] or machine["build"]["failed"]:
+        return "degraded"
+    if machine["drift"]["drifted"]:
+        return "drifting"
+    return "healthy"
+
+
+def summarize(machines: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Bounded aggregates over the per-machine records: state counts,
+    fleet-wide request/error totals, and the fixed-bucket health-score
+    histogram (per-bin counts; the Prometheus collector cumulates)."""
+    counts = {"healthy": 0, "degraded": 0, "drifting": 0, "quarantined": 0}
+    requests = errors = 0
+    score_sum = 0.0
+    bins = [0] * len(SCORE_BUCKETS)
+    for machine in machines.values():
+        counts[machine_state(machine)] += 1
+        requests += machine["serving"]["requests"]
+        errors += machine["serving"]["errors"]
+        score = health_score(machine)
+        score_sum += score
+        for i, edge in enumerate(SCORE_BUCKETS):
+            if score <= edge:
+                bins[i] += 1
+                break
+    return {
+        "machines": len(machines),
+        **counts,
+        "requests": requests,
+        "errors": errors,
+        "error_rate": round(errors / requests, 6) if requests else 0.0,
+        "score_histogram": {
+            "buckets": list(SCORE_BUCKETS),
+            "counts": bins,
+            # the histogram's sum: mean fleet health is one PromQL
+            # division (sum / count), so it must be the sum of SCORES,
+            # not the machine count
+            "score_sum": round(score_sum, 4),
+        },
+    }
+
+
+# -- the ledger ---------------------------------------------------------------
+
+
+class NullLedger:
+    """The do-nothing ledger (health telemetry off): every recording
+    method is a no-op, so feed sites stay unconditional."""
+
+    enabled = False
+    path = None
+
+    def record_request(self, *args, **kwargs):
+        pass
+
+    def record_scores(self, *args, **kwargs):
+        pass
+
+    def record_build(self, *args, **kwargs):
+        pass
+
+    def record_drift(self, *args, **kwargs):
+        pass
+
+    def record_quarantine(self, *args, **kwargs):
+        pass
+
+    def record_promotion(self, *args, **kwargs):
+        pass
+
+    def record_plan_accuracy(self, accuracy):
+        pass
+
+    def document(self):
+        return None
+
+    def summary(self):
+        return None
+
+    def write(self, force=False):
+        pass
+
+    def flush(self):
+        pass
+
+
+NULL_LEDGER = NullLedger()
+
+
+class FleetHealthLedger:
+    """The per-machine health ledger for one artifact directory.
+
+    Thread-safe (request threads, dispatcher threads and the builder's
+    dump pool all record concurrently); every snapshot write is an
+    atomic dotted-tmp + ``os.replace``, throttled like the
+    ``build_status.json`` heartbeat so serving traffic cannot turn the
+    ledger into an IO load."""
+
+    enabled = True
+
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        project: str = "",
+        heartbeat_seconds: Optional[float] = None,
+        window_rows: Optional[int] = None,
+    ):
+        self.directory = (
+            os.path.normpath(directory) if directory is not None else None
+        )
+        self.path = (
+            os.path.join(self.directory, FLEET_HEALTH_FILE)
+            if self.directory is not None
+            else None
+        )
+        self.project = project
+        from ..utils.env import env_float, env_int
+
+        self.heartbeat_seconds = max(
+            0.0,
+            heartbeat_seconds
+            if heartbeat_seconds is not None
+            else (
+                env_float(HEALTH_HEARTBEAT_ENV, DEFAULT_HEALTH_HEARTBEAT)
+                or DEFAULT_HEALTH_HEARTBEAT
+            ),
+        )
+        self.window_rows = max(
+            1,
+            window_rows
+            if window_rows is not None
+            else env_int(HEALTH_WINDOW_ENV, DEFAULT_HEALTH_WINDOW),
+        )
+        self._machines: Dict[str, Dict[str, Any]] = {}
+        #: running (sum, rows) behind each machine's residual mean —
+        #: kept out of the document (the document carries the mean)
+        self._residuals: Dict[str, List[float]] = {}
+        self._plan_accuracy: Optional[Dict[str, Any]] = None
+        self._listeners: List[Callable[[dict], None]] = []
+        self._lock = threading.Lock()
+        self._write_lock = threading.Lock()
+        self._last_write = 0.0
+
+    # -- recording ----------------------------------------------------------
+
+    def _machine(self, name: str) -> Dict[str, Any]:
+        machine = self._machines.get(name)
+        if machine is None:
+            machine = self._machines[name] = _new_machine()
+        return machine
+
+    def record_request(
+        self, machine: str, error: bool = False, count: int = 1
+    ) -> None:
+        """One served request (or ``count`` of them) for ``machine``;
+        ``error`` marks server-side failures (5xx) — client errors are
+        the client's problem, not the machine's health."""
+        with self._lock:
+            serving = self._machine(machine)["serving"]
+            serving["requests"] += count
+            if error:
+                serving["errors"] += count
+            serving["last_request_at"] = _iso(time.time())
+        self.write()
+
+    def record_scores(
+        self,
+        machine: str,
+        rows: int,
+        residual_mean: Optional[float] = None,
+        write: bool = True,
+    ) -> None:
+        """Fold one scored window into the machine's rolling serving
+        stats: ``rows`` scored, at mean reconstruction error
+        ``residual_mean`` (raw-target-space mse, as ``fleet_scores``
+        reports it). The window decays (halves) past ``window_rows`` so
+        the mean tracks the present. ``write=False`` lets a caller
+        batching many machines snapshot once at the end."""
+        if rows <= 0:
+            return
+        with self._lock:
+            serving = self._machine(machine)["serving"]
+            serving["rows"] += int(rows)
+            if residual_mean is not None and residual_mean == residual_mean:
+                total, seen = self._residuals.get(machine, (0.0, 0))
+                if seen >= self.window_rows:
+                    # decay BEFORE folding the new batch, so recent
+                    # windows outweigh history instead of averaging
+                    # into it forever
+                    total *= 0.5
+                    seen = int(seen * 0.5)
+                total += float(residual_mean) * rows
+                seen += rows
+                self._residuals[machine] = [total, seen]
+                serving["residual_mean"] = round(total / seen, 8)
+        if write:
+            self.write()
+
+    def record_build(self, machine: str, **fields: Any) -> None:
+        """Build provenance for one machine: any of ``revision``,
+        ``final_loss``, ``degraded``, ``failed``, ``error``, ``bisects``,
+        ``retries``. A successful (re)build clears the failed/degraded
+        flags unless the caller re-asserts them."""
+        with self._lock:
+            build = self._machine(machine)["build"]
+            for key, value in fields.items():
+                if key in build and value is not None:
+                    build[key] = value
+            if (
+                not build["failed"]
+                and not build["degraded"]
+                and not fields.get("error")
+            ):
+                # a clean (re)build supersedes the previous failure's
+                # evidence — a recovered machine must not read
+                # 'degraded' in the console forever
+                build["error"] = None
+            build["built_at"] = _iso(time.time())
+        # a thousand-machine fleet records a thousand of these — only
+        # the state-changing ones (failures/degradations) force the
+        # snapshot; healthy completions ride the heartbeat throttle
+        self.write(
+            force=bool(
+                fields.get("failed")
+                or fields.get("degraded")
+                or fields.get("error")
+            )
+        )
+
+    def record_drift(
+        self,
+        machine: str,
+        drifted: bool,
+        reasons: Any = (),
+        stats: Optional[Dict[str, Any]] = None,
+        write: bool = True,
+    ) -> None:
+        """The machine's latest drift verdict (the PR 6 windows).
+        ``write=False`` lets the lifecycle loop record a whole fleet's
+        verdicts under one forced snapshot (its own ``flush()``)."""
+        stats = stats or {}
+        with self._lock:
+            drift = self._machine(machine)["drift"]
+            drift["drifted"] = bool(drifted)
+            drift["reasons"] = [str(r) for r in (reasons or [])]
+            for key in ("feature_shift_max", "residual_ratio", "window_rows"):
+                if key in stats:
+                    drift[key] = stats[key]
+            drift["evaluated_at"] = _iso(time.time())
+        if write:
+            self.write(force=True)
+
+    def record_quarantine(
+        self,
+        machines: Any,
+        revision: Optional[str] = None,
+        reasons: Any = (),
+    ) -> None:
+        """Mark ``machines`` quarantined (their canary was rolled back)."""
+        now = _iso(time.time())
+        with self._lock:
+            for name in machines:
+                quarantine = self._machine(str(name))["quarantine"]
+                quarantine["active"] = True
+                quarantine["revision"] = revision
+                quarantine["reasons"] = [str(r) for r in (reasons or [])][:5]
+                quarantine["since"] = now
+        self.write(force=True)
+
+    def record_promotion(
+        self, revision: Optional[str], machines: Any = ()
+    ) -> None:
+        """A promoted revision: the rebuilt ``machines`` leave
+        quarantine and drift state (their windows restart against the
+        new artifacts), their build revision advances, and any
+        degraded/failed flags clear — a rebuild that passed the gates
+        and took traffic IS a successful build."""
+        with self._lock:
+            for name in machines:
+                machine = self._machine(str(name))
+                machine["quarantine"] = _new_machine()["quarantine"]
+                machine["drift"] = _new_machine()["drift"]
+                build = machine["build"]
+                build["degraded"] = False
+                build["failed"] = False
+                build["error"] = None
+                if revision is not None:
+                    build["revision"] = revision
+        self.write(force=True)
+
+    def record_plan_accuracy(self, accuracy: Dict[str, Any]) -> None:
+        """The build's predicted-vs-measured plan numbers (compiles,
+        wall seconds, padding waste, HBM) — the ledger carries them so
+        the joined fleet-status view can show plan accuracy without
+        re-reading the whole span trace."""
+        with self._lock:
+            self._plan_accuracy = dict(accuracy)
+        self.write(force=True)
+
+    def add_listener(self, listener: Callable[[dict], None]) -> None:
+        """Call ``listener(summary_dict)`` after every forced snapshot
+        write (advisory, exceptions swallowed)."""
+        with self._lock:
+            self._listeners.append(listener)
+
+    # -- the document -------------------------------------------------------
+
+    def machine(self, name: str) -> Optional[Dict[str, Any]]:
+        """One machine's record (deep-ish copy), with derived health."""
+        with self._lock:
+            machine = self._machines.get(name)
+            if machine is None:
+                return None
+            machine = json.loads(json.dumps(machine))
+        machine["health"] = {
+            "score": health_score(machine),
+            "state": machine_state(machine),
+        }
+        return machine
+
+    def document(self) -> Dict[str, Any]:
+        # one json.dumps pass under the lock (the cheapest consistent
+        # snapshot of the nested records), the loads + summarize +
+        # derived-health math OUTSIDE it — document() runs on whichever
+        # request thread loses the heartbeat race, and holding the
+        # shared lock through the full round-trip would stall every
+        # concurrent record_* call behind one serialization
+        with self._lock:
+            payload = json.dumps(self._machines, default=str)
+            plan_accuracy = (
+                dict(self._plan_accuracy) if self._plan_accuracy else None
+            )
+        machines = json.loads(payload)
+        for machine in machines.values():
+            machine["health"] = {
+                "score": health_score(machine),
+                "state": machine_state(machine),
+            }
+        doc: Dict[str, Any] = {
+            "version": 1,
+            "project": self.project,
+            "updated_at": _iso(time.time()),
+            "machines": machines,
+            "summary": summarize(machines),
+        }
+        if plan_accuracy is not None:
+            doc["plan_accuracy"] = plan_accuracy
+        return doc
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            machines = dict(self._machines)
+        return summarize(machines)
+
+    # -- persistence --------------------------------------------------------
+
+    def write(self, force: bool = False) -> None:
+        """Atomically replace the snapshot (best-effort, throttled).
+        Forced writes (state transitions) also notify listeners."""
+        if self.path is None:
+            return
+        now = time.time()
+        with self._write_lock:
+            with self._lock:
+                if not force and now - self._last_write < self.heartbeat_seconds:
+                    return
+                self._last_write = now
+                listeners = list(self._listeners)
+            doc = self.document()
+            tmp = os.path.join(
+                os.path.dirname(self.path),
+                f".{FLEET_HEALTH_FILE}.tmp-{os.getpid()}",
+            )
+            try:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                with open(tmp, "w") as f:
+                    json.dump(doc, f, default=str)
+                os.replace(tmp, self.path)
+            except OSError as exc:
+                logger.debug("fleet_health snapshot not written: %r", exc)
+                with contextlib.suppress(OSError):
+                    os.remove(tmp)
+        if force:
+            for listener in listeners:
+                try:
+                    listener(doc["summary"])
+                except Exception:  # noqa: BLE001 - listeners are advisory
+                    pass
+
+    def flush(self) -> None:
+        self.write(force=True)
+
+    def restore(self, doc: Dict[str, Any]) -> None:
+        """Adopt a previously persisted snapshot (a restarted server
+        resumes its counts instead of starting the fleet 'healthy')."""
+        if not isinstance(doc, dict) or not isinstance(
+            doc.get("machines"), dict
+        ):
+            return
+        template = _new_machine()
+        with self._lock:
+            for name, record in doc["machines"].items():
+                machine = self._machine(str(name))
+                for section in template:
+                    incoming = record.get(section)
+                    if isinstance(incoming, dict):
+                        for key in template[section]:
+                            if key in incoming:
+                                machine[section][key] = incoming[key]
+            if isinstance(doc.get("plan_accuracy"), dict):
+                self._plan_accuracy = dict(doc["plan_accuracy"])
+
+
+# -- the process-global registry ---------------------------------------------
+
+_registry_lock = threading.Lock()
+_ledgers: Dict[str, FleetHealthLedger] = {}
+
+
+def ledger_for(directory: str, project: str = "") -> Any:
+    """The (create-once) ledger for an artifact directory, or
+    :data:`NULL_LEDGER` when health telemetry is off. One ledger per
+    normalized path — the builder, the serve path and the lifecycle
+    supervisor all feed the same record set for the same directory."""
+    if not health_enabled():
+        return NULL_LEDGER
+    key = os.path.normpath(directory)
+    ledger = _ledgers.get(key)
+    if ledger is not None:
+        return ledger
+    with _registry_lock:
+        ledger = _ledgers.get(key)
+        if ledger is None:
+            ledger = FleetHealthLedger(directory=key, project=project)
+            persisted = load_health(key)
+            if persisted is not None:
+                ledger.restore(persisted)
+            _ledgers[key] = ledger
+    return ledger
+
+
+def ledger_summaries() -> Dict[str, Dict[str, Any]]:
+    """directory -> bounded summary for every live ledger (what the
+    Prometheus fleet-health collector reads at scrape time)."""
+    with _registry_lock:
+        ledgers = dict(_ledgers)
+    return {path: ledger.summary() for path, ledger in ledgers.items()}
+
+
+def reset_ledgers() -> None:
+    """Drop every live ledger (tests only)."""
+    with _registry_lock:
+        _ledgers.clear()
+
+
+def load_health(directory: str) -> Optional[Dict[str, Any]]:
+    """The persisted ``fleet_health.json`` from ``directory`` (or None)."""
+    doc = _load_json(os.path.join(directory, FLEET_HEALTH_FILE))
+    return doc if isinstance(doc, dict) else None
+
+
+# -- the joined fleet-status surface -----------------------------------------
+
+
+def _load_json(path: str) -> Optional[Any]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def fleet_status_document(
+    directory: str,
+    device: Optional[Dict[str, Any]] = None,
+    programs: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """
+    The one joined operator view over a build+serve directory:
+
+    - ``build`` — the live ``build_status.json`` heartbeat (PR 3);
+    - ``plan`` — ``fleet_plan.json`` strategy/totals plus the measured
+      plan-accuracy actuals recorded into the health ledger;
+    - ``lifecycle`` — the supervisor's ``state.json`` phase/identities,
+      quarantine record count, and most recent history events;
+    - ``health`` — the per-machine ledger (live when this process holds
+      one, else the persisted snapshot) and its bounded summary;
+    - ``device`` — injected device-utilization stats (memory +
+      compile-cache counters; ``telemetry.device.utilization_snapshot``)
+    - ``programs`` — injected serving program-cache stats.
+
+    Sections degrade to None independently: a build dir with no
+    lifecycle state still joins, a serve dir with no plan still joins.
+    """
+    from .progress import load_status
+
+    directory = os.path.normpath(directory)
+    root = os.path.dirname(directory)
+    doc: Dict[str, Any] = {
+        "version": 1,
+        "directory": directory,
+        "revision": os.path.basename(directory),
+        "generated_at": _iso(time.time()),
+    }
+    doc["build"] = load_status(directory)
+
+    plan = _load_json(os.path.join(directory, "fleet_plan.json"))
+    health_doc: Optional[Dict[str, Any]]
+    ledger = _ledgers.get(directory)
+    if ledger is not None:
+        health_doc = ledger.document()
+    else:
+        health_doc = load_health(directory)
+    if isinstance(plan, dict):
+        doc["plan"] = {
+            "strategy": plan.get("strategy"),
+            "totals": plan.get("totals"),
+            "accuracy": (health_doc or {}).get("plan_accuracy"),
+        }
+    else:
+        doc["plan"] = None
+
+    state = _load_json(
+        os.path.join(root, _LIFECYCLE_DIR, _LIFECYCLE_STATE_FILE)
+    )
+    quarantine = _load_json(
+        os.path.join(root, _LIFECYCLE_DIR, _LIFECYCLE_QUARANTINE_FILE)
+    )
+    if isinstance(state, dict):
+        doc["lifecycle"] = {
+            "phase": state.get("phase"),
+            "serving_revision": state.get("serving_revision"),
+            "canary_revision": state.get("canary_revision"),
+            "stale": state.get("stale") or [],
+            "quarantine_records": (
+                len(quarantine) if isinstance(quarantine, list) else 0
+            ),
+            "history": (state.get("history") or [])[-5:],
+        }
+    else:
+        doc["lifecycle"] = None
+
+    if health_doc is not None:
+        doc["health"] = {
+            "summary": health_doc.get("summary"),
+            "machines": health_doc.get("machines"),
+            "updated_at": health_doc.get("updated_at"),
+        }
+    else:
+        doc["health"] = None
+    doc["device"] = device
+    doc["programs"] = programs
+    return doc
+
+
+def render_fleet_status(doc: Dict[str, Any]) -> str:
+    """Human rendering of the joined document (the ``fleet-status``
+    CLI's table view)."""
+    lines: List[str] = [
+        f"Directory: {doc.get('directory', '-')}",
+        f"Revision:  {doc.get('revision', '-')}",
+    ]
+    build = doc.get("build")
+    if build:
+        machines = build.get("machines") or {}
+        lines.append(
+            f"Build:     {build.get('state', '?')}"
+            + (f" (phase: {build.get('phase')})" if build.get("phase") else "")
+            + f" — {machines.get('completed', 0)}/{machines.get('total', 0)}"
+            f" done, {machines.get('failed', 0)} failed"
+        )
+    else:
+        lines.append("Build:     (no build_status.json)")
+    plan = doc.get("plan")
+    if plan and plan.get("totals"):
+        totals = plan["totals"]
+        accuracy = plan.get("accuracy") or {}
+        lines.append(
+            f"Plan:      {plan.get('strategy', '?')} — "
+            f"{totals.get('buckets', 0)} bucket(s), "
+            f"{totals.get('compiles', 0)} predicted compile(s), "
+            f"waste {100.0 * float(totals.get('padding_waste') or 0.0):.1f}%"
+        )
+        if accuracy:
+            measured = accuracy.get("measured_member_waste")
+            hbm = accuracy.get("measured_hbm_peak_bytes")
+            lines.append(
+                "  actuals: "
+                f"{accuracy.get('actual_compiles', '?')} compile(s), "
+                f"fit {accuracy.get('actual_fit_s', '?')}s"
+                + (
+                    f", member waste {100.0 * float(measured):.1f}%"
+                    if measured is not None
+                    else ""
+                )
+                + (
+                    f", HBM peak {int(hbm) / (1 << 20):.1f} MiB"
+                    if hbm
+                    else ""
+                )
+            )
+    lifecycle = doc.get("lifecycle")
+    if lifecycle:
+        lines.append(
+            f"Lifecycle: {lifecycle.get('phase', '?')} — "
+            f"serving {lifecycle.get('serving_revision') or '-'}"
+            + (
+                f", canary {lifecycle['canary_revision']}"
+                if lifecycle.get("canary_revision")
+                else ""
+            )
+            + (
+                f", {lifecycle.get('quarantine_records')} quarantine record(s)"
+                if lifecycle.get("quarantine_records")
+                else ""
+            )
+        )
+    health = doc.get("health")
+    if health and health.get("summary"):
+        summary = health["summary"]
+        lines.append(
+            f"Health:    {summary.get('machines', 0)} machine(s) — "
+            f"{summary.get('healthy', 0)} healthy, "
+            f"{summary.get('drifting', 0)} drifting, "
+            f"{summary.get('degraded', 0)} degraded, "
+            f"{summary.get('quarantined', 0)} quarantined"
+            f" (error rate {100.0 * float(summary.get('error_rate') or 0.0):.2f}%)"
+        )
+        machines = health.get("machines") or {}
+        unhealthy = sorted(
+            (
+                (record["health"]["score"], name, record)
+                for name, record in machines.items()
+                if record.get("health", {}).get("state") != "healthy"
+            ),
+        )[:10]
+        for score, name, record in unhealthy:
+            state = record["health"]["state"]
+            reasons = (
+                record["quarantine"]["reasons"]
+                if state == "quarantined"
+                else record["drift"]["reasons"]
+            )
+            lines.append(
+                f"  {name}: {state} (score {score:.2f})"
+                + (f" — {reasons[0]}" if reasons else "")
+            )
+    else:
+        lines.append("Health:    (no fleet_health.json)")
+    device = doc.get("device")
+    if device:
+        memory = device.get("memory")
+        if memory and memory.get("available"):
+            lines.append(
+                f"Device:    {memory.get('measured_devices', 0)} device(s) — "
+                f"{memory.get('bytes_in_use', 0) / (1 << 20):.1f} MiB in use, "
+                f"peak {memory.get('peak_bytes_in_use', 0) / (1 << 20):.1f} MiB"
+                + (
+                    f" ({100.0 * memory['utilization']:.1f}% of limit)"
+                    if memory.get("utilization") is not None
+                    else ""
+                )
+            )
+        else:
+            lines.append("Device:    memory stats unavailable on this backend")
+        for kind, counters in sorted(
+            (device.get("compile_cache") or {}).items()
+        ):
+            rate = counters.get("hit_rate")
+            lines.append(
+                f"  {kind} programs: {counters.get('compiles', 0)} compile(s), "
+                f"{counters.get('cache_hits', 0)} cache hit(s)"
+                + (f" ({100.0 * rate:.1f}% hit rate)" if rate is not None else "")
+            )
+        persistent = device.get("persistent_cache")
+        if persistent:
+            lines.append(
+                f"  persistent cache: {persistent.get('entries', 0)} entr"
+                f"{'y' if persistent.get('entries', 0) == 1 else 'ies'}, "
+                f"{persistent.get('bytes', 0) / (1 << 20):.1f} MiB "
+                f"({persistent.get('path')})"
+            )
+    programs = doc.get("programs")
+    if programs:
+        lines.append(
+            f"Programs:  {programs.get('programs', 0)} cached jit entr"
+            f"{'y' if programs.get('programs', 0) == 1 else 'ies'}, "
+            f"{programs.get('signatures', 0)} compiled signature(s)"
+        )
+    return "\n".join(lines)

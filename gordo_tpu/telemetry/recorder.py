@@ -628,6 +628,12 @@ def program_span(program: str, key: Hashable, **attributes):
     config, and array shapes — exactly as the jit cache would.
     """
     compile_flag = not seen_program((program, key))
+    # feed the process-wide compile-vs-cache-hit accounting (device.py):
+    # unlike the span below this is unconditional — the fleet console's
+    # hit-rate numbers must not depend on a recorder being active
+    from .device import note_program_execution
+
+    note_program_execution(compile_flag, kind="build")
     return get_recorder().span(
         "device_program", program=program, compile=compile_flag, **attributes
     )

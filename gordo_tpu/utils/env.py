@@ -292,6 +292,34 @@ KNOBS: Dict[str, Knob] = _knobs(
         "(`utils/profiling.py`; `?profile=device` on the server).",
         "Telemetry",
     ),
+    Knob(
+        "GORDO_TPU_FLEET_HEALTH", "bool", True,
+        "Per-member fleet health ledger master switch "
+        "(`fleet_health.json` snapshots + the `fleet-status` surface; "
+        "also requires `GORDO_TPU_TELEMETRY`).",
+        "Telemetry",
+    ),
+    Knob(
+        "GORDO_TPU_HEALTH_HEARTBEAT", "float", 2.0,
+        "Seconds between throttled `fleet_health.json` snapshot writes "
+        "(state transitions — drift verdicts, quarantines — always "
+        "write).",
+        "Telemetry",
+    ),
+    Knob(
+        "GORDO_TPU_HEALTH_WINDOW", "int", 100_000,
+        "Rows after which a machine's rolling serving-residual window "
+        "decays (halves), so the ledger's residual mean tracks the "
+        "present.",
+        "Telemetry",
+    ),
+    Knob(
+        "GORDO_TPU_DEVICE_TELEMETRY", "bool", True,
+        "Device-utilization sampling (`Device.memory_stats()` around "
+        "fleet programs and at Prometheus scrape time); the "
+        "compile-cache hit counters stay on with telemetry itself.",
+        "Telemetry",
+    ),
     # -- Serving / micro-batching -----------------------------------------
     Knob(
         "GORDO_TPU_BATCHING", "bool", False,

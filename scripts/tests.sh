@@ -58,6 +58,10 @@ case "$component" in
     planner)  run -m "not slow" tests/planner ;;
     lifecycle) run -m "not slow" tests/lifecycle ;;
     analysis) run -m "not slow" tests/analysis ;;
+    # The fleet-console suite cuts across tests/telemetry, tests/server
+    # and tests/lifecycle — marker-selected so its own matrix job stays
+    # meaningful while the per-directory jobs still run every test.
+    fleet_health) run -m "fleet_health and not slow" tests/ ;;
     utils)    run -m "not slow" tests/utils ;;
     workflow) run -m "not slow" tests/workflow ;;
     formatting) run tests/test_codestyle.py ;;

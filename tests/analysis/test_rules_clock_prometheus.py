@@ -139,3 +139,65 @@ def test_labels_outside_server_packages_are_clean(lint_tree):
         }
     )
     assert not _rules(result, "prometheus-cardinality")
+
+
+# -- member-identity label values (PR 9: the per-member loss-gauge class) ----
+
+
+def test_loop_variable_over_member_collection_is_flagged(lint_tree):
+    # the exact shape that minted one gordo_fleet_member_final_loss
+    # timeseries per fleet member before the bounded histogram
+    result = lint_tree(
+        {
+            "gordo_tpu/parallel/bad.py": (
+                "def export(gauge, member_losses):\n"
+                "    for name, loss in member_losses.items():\n"
+                "        gauge.labels(name).set(loss)\n"
+            )
+        }
+    )
+    found = _rules(result, "prometheus-cardinality")
+    assert len(found) == 1
+    assert "loop variable" in found[0].message
+
+
+def test_machine_name_attribute_label_is_flagged(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/lifecycle/bad.py": (
+                "def export(gauge, machine):\n"
+                "    gauge.labels(machine.name).set(1)\n"
+            )
+        }
+    )
+    found = _rules(result, "prometheus-cardinality")
+    assert len(found) == 1
+    assert "member-identity" in found[0].message
+
+
+def test_bounded_stage_loop_is_clean(lint_tree):
+    # iterating a bounded per-request stage dict is NOT a member loop —
+    # the taint is the member collection's name, not loops per se
+    # (this is the live shape in server/prometheus/metrics.py observe())
+    result = lint_tree(
+        {
+            "gordo_tpu/server/ok.py": (
+                "def observe(histogram, stages):\n"
+                "    for stage, seconds in stages.items():\n"
+                "        histogram.labels(stage=stage).observe(seconds)\n"
+            )
+        }
+    )
+    assert not _rules(result, "prometheus-cardinality")
+
+
+def test_member_loop_comprehension_is_flagged(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/bad.py": (
+                "def export(gauge, machines):\n"
+                "    return [gauge.labels(m) for m in sorted(machines)]\n"
+            )
+        }
+    )
+    assert len(_rules(result, "prometheus-cardinality")) == 1

@@ -1,0 +1,113 @@
+"""
+The health ledger through a live lifecycle (PR 9): a full
+drift → canary → quarantine cycle and a drift → canary → promote cycle
+must each leave the per-member ledger telling the story — per-machine
+drift verdicts with their σ/ratio stats, residual means from the scored
+windows, quarantine evidence, and promotion clearing it.
+"""
+
+import os
+
+import pytest
+
+from gordo_tpu.lifecycle.gates import GateConfig
+from gordo_tpu.telemetry.fleet_health import (
+    fleet_status_document,
+    ledger_for,
+    load_health,
+    reset_ledgers,
+)
+
+from tests.lifecycle.conftest import (
+    BASE_REVISION,
+    NAMES,
+    frames_for,
+    make_supervisor,
+)
+
+pytestmark = [pytest.mark.lifecycle, pytest.mark.fleet_health]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledgers():
+    reset_ledgers()
+    yield
+    reset_ledgers()
+
+
+def test_drift_canary_quarantine_cycle_lands_in_ledger(
+    models_root, probe_windows
+):
+    healthy, drifted = probe_windows
+    anchor = os.path.join(models_root, BASE_REVISION)
+    supervisor = make_supervisor(
+        models_root, gates=GateConfig(residual_ratio=1e-6)
+    )
+    supervisor.run_cycle(frames_for(NAMES, healthy))  # calibration
+
+    ledger = ledger_for(anchor)
+    # the observed window already fed rolling serving stats per machine
+    for name in NAMES:
+        machine = ledger.machine(name)
+        assert machine["serving"]["rows"] > 0
+        assert machine["serving"]["residual_mean"] is not None
+
+    frames = frames_for(NAMES, healthy)
+    frames[NAMES[2]] = drifted
+    report = supervisor.run_cycle(frames)
+    assert report.rolled_back
+
+    # the drifted machine carries its verdict AND its quarantine record
+    machine = ledger.machine(NAMES[2])
+    assert machine["drift"]["drifted"] is True
+    assert any("feature-shift" in r for r in machine["drift"]["reasons"])
+    assert machine["drift"]["feature_shift_max"] is not None
+    assert machine["quarantine"]["active"] is True
+    assert machine["quarantine"]["revision"] == report.canary_revision
+    assert machine["health"]["state"] == "quarantined"
+    # the healthy machines did not
+    assert ledger.machine(NAMES[0])["health"]["state"] in ("healthy", "drifting")
+    assert ledger.machine(NAMES[0])["quarantine"]["active"] is False
+
+    # the snapshot on disk says the same (operators read the file)
+    doc = load_health(anchor)
+    assert doc["summary"]["quarantined"] == 1
+    assert doc["machines"][NAMES[2]]["quarantine"]["active"] is True
+
+    # ... and the joined fleet-status surface ties it to lifecycle state
+    status = fleet_status_document(anchor)
+    assert status["lifecycle"]["phase"] == "idle"
+    assert status["lifecycle"]["quarantine_records"] == 1
+    assert status["health"]["summary"]["quarantined"] == 1
+    supervisor.close()
+
+
+def test_promotion_clears_quarantine_and_advances_revision(
+    models_root, probe_windows
+):
+    healthy, drifted = probe_windows
+    anchor = os.path.join(models_root, BASE_REVISION)
+    supervisor = make_supervisor(models_root)
+    supervisor.run_cycle(frames_for(NAMES, healthy))
+
+    frames = frames_for(NAMES, healthy)
+    frames[NAMES[1]] = drifted
+    report = supervisor.run_cycle(frames)
+    assert report.promoted
+
+    ledger = ledger_for(anchor)
+    machine = ledger.machine(NAMES[1])
+    # promotion cleared the drift flag and stamped the new revision
+    assert machine["drift"]["drifted"] is False
+    assert machine["quarantine"]["active"] is False
+    assert machine["build"]["revision"] == report.canary_revision
+    assert machine["health"]["state"] == "healthy"
+    # the incremental rebuild ran in a .lifecycle staging dir, but its
+    # provenance landed HERE, in the anchor ledger the console reads
+    # (the base build fed a different dir; this value can only come
+    # from the rebuild's health_ledger override)
+    assert machine["build"]["final_loss"] is not None
+
+    doc = load_health(anchor)
+    assert doc["summary"]["quarantined"] == 0
+    supervisor.close()

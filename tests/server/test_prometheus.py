@@ -403,3 +403,98 @@ def test_label_child_cache_matches_uncached_observe(collection_dir, monkeypatch)
         )
         == 3
     )
+
+
+# -- fleet-console collectors (PR 9) ----------------------------------------
+
+
+def test_fleet_console_collectors_on_every_scrape_registry(
+    tmp_path, monkeypatch
+):
+    """The bounded fleet-health gauges and device counters are
+    scrape-time collectors (no mmap backing), so like the program-cache
+    gauge they must ride BOTH the in-process registry and the fresh
+    multiprocess fan-in registry — and registration must be idempotent."""
+    import pytest as _pytest
+
+    from gordo_tpu.server.prometheus.metrics import (
+        multiprocess_registry,
+        register_fleet_console_collectors,
+    )
+    from gordo_tpu.telemetry import device
+    from gordo_tpu.telemetry.fleet_health import ledger_for, reset_ledgers
+
+    _pytest.importorskip("prometheus_client.multiprocess")
+    reset_ledgers()
+    device.reset_program_counters()
+    try:
+        ledger = ledger_for(str(tmp_path / "collection"))
+        ledger.record_request("m-1", error=True)
+        ledger.record_quarantine(["m-2"], revision="9", reasons=["gate"])
+        device.note_program_execution(True, kind="serve")
+
+        in_process = CollectorRegistry()
+        register_fleet_console_collectors(in_process)
+        register_fleet_console_collectors(in_process)  # idempotent
+
+        monkeypatch.setenv(
+            "PROMETHEUS_MULTIPROC_DIR", str(tmp_path / "multiproc")
+        )
+        fan_in = multiprocess_registry()
+        assert fan_in is not None
+
+        for registry in (in_process, fan_in):
+            assert (
+                registry.get_sample_value(
+                    "gordo_fleet_health_machines", {"state": "quarantined"}
+                )
+                == 1
+            )
+            # m-1 has errors (its score drops) but no state flag — it
+            # stays counted healthy; only drift/degrade/quarantine move
+            # the state counters
+            assert (
+                registry.get_sample_value(
+                    "gordo_fleet_health_machines", {"state": "healthy"}
+                )
+                == 1
+            )
+            # the score histogram's +Inf bucket counts every machine
+            assert (
+                registry.get_sample_value(
+                    "gordo_fleet_health_score_bucket", {"le": "+Inf"}
+                )
+                == 2
+            )
+            # gsum is the sum of SCORES (mean health = gsum/gcount),
+            # never the machine count: m-1 at 0.7 (all-error requests)
+            # + m-2 at 0.5 (quarantined)
+            assert (
+                registry.get_sample_value(
+                    "gordo_fleet_health_score_gsum", {}
+                )
+                == _pytest.approx(1.2)
+            )
+            assert (
+                registry.get_sample_value(
+                    "gordo_compile_cache_events_total",
+                    {"side": "serve", "result": "compile"},
+                )
+                == 1
+            )
+        # label sets are CONSTANT-bounded: 4 states, no machine names
+        samples = [
+            sample
+            for metric in in_process.collect()
+            if metric.name == "gordo_fleet_health_machines"
+            for sample in metric.samples
+        ]
+        assert {s.labels["state"] for s in samples} == {
+            "healthy",
+            "degraded",
+            "drifting",
+            "quarantined",
+        }
+    finally:
+        reset_ledgers()
+        device.reset_program_counters()

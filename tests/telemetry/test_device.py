@@ -1,0 +1,112 @@
+"""
+Device-utilization telemetry (PR 9): the compile-cache hit counters,
+their ``program_span`` / serving wiring, the memory snapshot's degrade
+contract, and the ``device_utilization`` event schema.
+"""
+
+import pytest
+
+from gordo_tpu import telemetry
+from gordo_tpu.telemetry import device
+
+pytestmark = [pytest.mark.fleet_health, pytest.mark.observability]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_counters():
+    device.reset_program_counters()
+    telemetry.reset_seen_programs()
+    yield
+    device.reset_program_counters()
+    telemetry.reset_seen_programs()
+
+
+def test_program_counters_accumulate_per_kind():
+    device.note_program_execution(True)
+    device.note_program_execution(False)
+    device.note_program_execution(False)
+    device.note_program_execution(True, kind="serve")
+    counters = device.program_cache_counters()
+    assert counters["build"] == {
+        "compiles": 1,
+        "cache_hits": 2,
+        "hit_rate": round(2 / 3, 4),
+    }
+    assert counters["serve"]["compiles"] == 1
+    assert counters["serve"]["hit_rate"] == 0.0
+
+
+def test_program_span_feeds_the_counters():
+    """program_span's first-call-per-signature attribution IS the
+    compile-cache hit/miss signal — the same call that marks the span
+    must feed the console counters, recorder active or not."""
+    with telemetry.program_span("fleet_fit", ("spec", (4, 8))):
+        pass
+    with telemetry.program_span("fleet_fit", ("spec", (4, 8))):
+        pass
+    with telemetry.program_span("fleet_fit", ("spec", (8, 8))):
+        pass
+    counters = device.program_cache_counters()["build"]
+    assert counters["compiles"] == 2
+    assert counters["cache_hits"] == 1
+
+
+def test_memory_snapshot_never_raises(monkeypatch):
+    """On any backend the snapshot is a dict (or None when disabled) —
+    platforms without Device.memory_stats degrade to available=False,
+    they never break the caller."""
+    snapshot = device.memory_snapshot()
+    assert snapshot is None or isinstance(snapshot, dict)
+    if isinstance(snapshot, dict):
+        assert "available" in snapshot
+        if snapshot["available"]:
+            assert snapshot["bytes_in_use"] >= 0
+            assert snapshot["peak_bytes_in_use"] >= snapshot["bytes_in_use"] * 0
+    monkeypatch.setenv("GORDO_TPU_DEVICE_TELEMETRY", "0")
+    assert device.memory_snapshot() is None
+    monkeypatch.setenv("GORDO_TPU_DEVICE_TELEMETRY", "1")
+    monkeypatch.setenv("GORDO_TPU_TELEMETRY", "0")
+    assert device.memory_snapshot() is None
+
+
+def test_utilization_snapshot_sections():
+    device.note_program_execution(True)
+    doc = device.utilization_snapshot()
+    assert "compile_cache" in doc
+    assert doc["compile_cache"]["build"]["compiles"] == 1
+    # memory may be absent (no jax stats) but never truthy-and-empty
+    if "memory" in doc:
+        assert isinstance(doc["memory"], dict)
+
+
+def test_persistent_cache_info_counts_entries(tmp_path, monkeypatch):
+    cache_dir = tmp_path / "compile-cache"
+    cache_dir.mkdir()
+    (cache_dir / "entry-1").write_bytes(b"x" * 100)
+    (cache_dir / "entry-2").write_bytes(b"y" * 50)
+    device.note_compile_cache_dir(str(cache_dir))
+    try:
+        info = device.persistent_cache_info()
+        assert info == {"path": str(cache_dir), "entries": 2, "bytes": 150}
+    finally:
+        device.note_compile_cache_dir(None)
+    # unconfigured and no env knob -> None
+    monkeypatch.delenv("GORDO_TPU_COMPILE_CACHE", raising=False)
+    assert device.persistent_cache_info() is None
+
+
+def test_emit_device_utilization_event_schema():
+    """When memory stats exist the event carries flattened memory_*
+    attributes + the build counters; when they don't, nothing is
+    emitted (callers treat None as 'not measurable')."""
+    recorder = telemetry.SpanRecorder()
+    snapshot = device.emit_device_utilization(recorder, phase="final_fit")
+    events = recorder.finished("device_utilization")
+    if snapshot is None:
+        assert events == []
+        return
+    assert len(events) == 1
+    attrs = events[0]["attributes"]
+    assert attrs["phase"] == "final_fit"
+    assert "compiles" in attrs and "cache_hits" in attrs
+    assert attrs["memory_devices"] == snapshot["devices"]

@@ -1,0 +1,393 @@
+"""
+The per-member fleet health ledger (PR 9): record semantics, the golden
+``fleet_health.json`` schema, persistence round-trips, the master
+switch, and the joined fleet-status document.
+"""
+
+import json
+import os
+
+import pytest
+
+from gordo_tpu.telemetry import fleet_health
+from gordo_tpu.telemetry.fleet_health import (
+    FLEET_HEALTH_FILE,
+    NULL_LEDGER,
+    SCORE_BUCKETS,
+    FleetHealthLedger,
+    fleet_status_document,
+    health_score,
+    ledger_for,
+    ledger_summaries,
+    load_health,
+    machine_state,
+    render_fleet_status,
+    reset_ledgers,
+)
+
+pytestmark = [pytest.mark.fleet_health, pytest.mark.observability]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    reset_ledgers()
+    yield
+    reset_ledgers()
+
+
+def make_ledger(tmp_path, **kwargs) -> FleetHealthLedger:
+    kwargs.setdefault("heartbeat_seconds", 0.0)
+    return FleetHealthLedger(directory=str(tmp_path), **kwargs)
+
+
+# -- golden schema ------------------------------------------------------------
+
+#: the pinned per-machine record shape — the fleet-status surface, the
+#: Prometheus collector and external dashboards all parse this; drift
+#: here must be deliberate
+MACHINE_SECTIONS = {
+    "serving": {"requests", "errors", "rows", "residual_mean", "last_request_at"},
+    "drift": {
+        "drifted",
+        "reasons",
+        "feature_shift_max",
+        "residual_ratio",
+        "window_rows",
+        "evaluated_at",
+    },
+    "build": {
+        "revision",
+        "final_loss",
+        "degraded",
+        "failed",
+        "error",
+        "bisects",
+        "retries",
+        "built_at",
+    },
+    "quarantine": {"active", "revision", "reasons", "since"},
+    "health": {"score", "state"},
+}
+
+
+def test_snapshot_golden_schema(tmp_path):
+    ledger = make_ledger(tmp_path, project="p")
+    ledger.record_request("m-1", error=True)
+    ledger.record_scores("m-1", rows=10, residual_mean=0.25)
+    ledger.record_build("m-1", revision="7", final_loss=0.01, bisects=2)
+    ledger.record_drift(
+        "m-1", True, ["feature-shift t"], {"feature_shift_max": 3.0}
+    )
+    ledger.flush()
+
+    doc = load_health(str(tmp_path))
+    assert doc["version"] == 1
+    assert doc["project"] == "p"
+    assert set(doc) >= {"version", "project", "updated_at", "machines", "summary"}
+    record = doc["machines"]["m-1"]
+    assert set(record) == set(MACHINE_SECTIONS)
+    for section, keys in MACHINE_SECTIONS.items():
+        assert set(record[section]) == keys, section
+    summary = doc["summary"]
+    assert set(summary) == {
+        "machines",
+        "healthy",
+        "degraded",
+        "drifting",
+        "quarantined",
+        "requests",
+        "errors",
+        "error_rate",
+        "score_histogram",
+    }
+    assert summary["score_histogram"]["buckets"] == list(SCORE_BUCKETS)
+    assert sum(summary["score_histogram"]["counts"]) == summary["machines"]
+
+
+def test_lifecycle_file_names_stay_mirrored():
+    """fleet_health.py reads the lifecycle state files by path without
+    importing the lifecycle package (the layering contract); the
+    mirrored spellings must never drift apart."""
+    from gordo_tpu.lifecycle.state import (
+        LIFECYCLE_DIR,
+        QUARANTINE_FILE,
+        STATE_FILE,
+    )
+
+    assert fleet_health._LIFECYCLE_DIR == LIFECYCLE_DIR
+    assert fleet_health._LIFECYCLE_STATE_FILE == STATE_FILE
+    assert fleet_health._LIFECYCLE_QUARANTINE_FILE == QUARANTINE_FILE
+
+
+# -- record semantics ---------------------------------------------------------
+
+
+def test_states_by_severity(tmp_path):
+    ledger = make_ledger(tmp_path)
+    ledger.record_drift("m", True, ["drift"])
+    assert ledger.machine("m")["health"]["state"] == "drifting"
+    ledger.record_build("m", degraded=True)
+    assert ledger.machine("m")["health"]["state"] == "degraded"
+    ledger.record_quarantine(["m"], revision="9", reasons=["gate"])
+    assert ledger.machine("m")["health"]["state"] == "quarantined"
+    # promotion of a rebuilt member clears quarantine, drift AND the
+    # degraded/failed flags — a rebuild that passed the gates and took
+    # traffic IS a successful build; nothing may read 'degraded' forever
+    ledger.record_promotion("10", ["m"])
+    machine = ledger.machine("m")
+    assert machine["quarantine"]["active"] is False
+    assert machine["drift"]["drifted"] is False
+    assert machine["build"]["revision"] == "10"
+    assert machine["build"]["degraded"] is False
+    assert machine["health"]["state"] == "healthy"
+
+
+def test_clean_rebuild_clears_failure_evidence(tmp_path):
+    ledger = make_ledger(tmp_path)
+    ledger.record_build("m", failed=True, error="RuntimeError('boom')")
+    assert ledger.machine("m")["health"]["state"] == "degraded"
+    # the next clean build supersedes the evidence
+    ledger.record_build("m", revision="8", failed=False, degraded=False)
+    machine = ledger.machine("m")
+    assert machine["build"]["failed"] is False
+    assert machine["build"]["error"] is None
+    assert machine["health"]["state"] == "healthy"
+
+
+def test_health_score_is_monotone_in_badness():
+    healthy = fleet_health._new_machine()
+    drifted = fleet_health._new_machine()
+    drifted["drift"]["drifted"] = True
+    quarantined = json.loads(json.dumps(drifted))
+    quarantined["quarantine"]["active"] = True
+    assert health_score(healthy) == 1.0
+    assert health_score(drifted) < health_score(healthy)
+    assert health_score(quarantined) < health_score(drifted)
+    assert machine_state(healthy) == "healthy"
+
+
+def test_error_rate_degrades_score(tmp_path):
+    ledger = make_ledger(tmp_path)
+    for _ in range(9):
+        ledger.record_request("m")
+    ledger.record_request("m", error=True)
+    machine = ledger.machine("m")
+    assert machine["serving"]["requests"] == 10
+    assert machine["serving"]["errors"] == 1
+    assert 0.6 < machine["health"]["score"] < 1.0
+
+
+def test_residual_window_decays(tmp_path):
+    ledger = make_ledger(tmp_path, window_rows=100)
+    ledger.record_scores("m", rows=100, residual_mean=1.0)
+    ledger.record_scores("m", rows=100, residual_mean=3.0)
+    mean = ledger.machine("m")["serving"]["residual_mean"]
+    # with decay the later window dominates a plain average
+    assert mean > 2.0
+
+
+def test_restore_round_trip(tmp_path):
+    ledger = make_ledger(tmp_path)
+    ledger.record_request("m-1", error=True)
+    ledger.record_quarantine(["m-2"], revision="3", reasons=["r"])
+    ledger.record_plan_accuracy({"actual_compiles": 2})
+    ledger.flush()
+
+    fresh = make_ledger(tmp_path)
+    fresh.restore(load_health(str(tmp_path)))
+    assert fresh.machine("m-1")["serving"]["errors"] == 1
+    assert fresh.machine("m-2")["quarantine"]["active"] is True
+    assert fresh.document()["plan_accuracy"] == {"actual_compiles": 2}
+
+
+def test_ledger_for_reloads_persisted_snapshot(tmp_path, monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_TELEMETRY", "1")
+    ledger = ledger_for(str(tmp_path))
+    ledger.record_request("m-1")
+    ledger.flush()
+    reset_ledgers()
+    again = ledger_for(str(tmp_path))
+    assert again is not ledger
+    assert again.machine("m-1")["serving"]["requests"] == 1
+    # one ledger per normalized path
+    assert ledger_for(str(tmp_path) + os.sep) is again
+    assert str(tmp_path) in ledger_summaries()
+
+
+def test_master_switch_disables_everything(tmp_path, monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_FLEET_HEALTH", "0")
+    ledger = ledger_for(str(tmp_path))
+    assert ledger is NULL_LEDGER
+    ledger.record_request("m", error=True)
+    ledger.record_drift("m", True, write=False)
+    ledger.flush()
+    assert not os.path.exists(os.path.join(str(tmp_path), FLEET_HEALTH_FILE))
+    monkeypatch.setenv("GORDO_TPU_FLEET_HEALTH", "1")
+    monkeypatch.setenv("GORDO_TPU_TELEMETRY", "0")
+    assert ledger_for(str(tmp_path)) is NULL_LEDGER
+
+
+def test_atomic_write_leaves_no_tmp(tmp_path):
+    ledger = make_ledger(tmp_path)
+    ledger.record_request("m")
+    ledger.flush()
+    leftovers = [
+        name
+        for name in os.listdir(str(tmp_path))
+        if name != FLEET_HEALTH_FILE
+    ]
+    assert leftovers == []
+
+
+def test_snapshot_is_a_builder_dropping(tmp_path):
+    from gordo_tpu import serializer
+
+    assert serializer.is_builder_dropping(FLEET_HEALTH_FILE)
+    ledger = make_ledger(tmp_path)
+    ledger.record_request("m")
+    ledger.flush()
+    assert serializer.list_model_dirs(str(tmp_path)) == []
+
+
+# -- the joined surface -------------------------------------------------------
+
+
+def test_fleet_status_document_joins_all_sections(tmp_path):
+    revision_dir = tmp_path / "100"
+    revision_dir.mkdir()
+    with open(revision_dir / "build_status.json", "w") as f:
+        json.dump(
+            {
+                "version": 1,
+                "state": "complete",
+                "machines": {"total": 3, "completed": 3, "failed": 0},
+                "phases": {},
+            },
+            f,
+        )
+    with open(revision_dir / "fleet_plan.json", "w") as f:
+        json.dump(
+            {
+                "strategy": "packed",
+                "totals": {"buckets": 1, "compiles": 1, "padding_waste": 0.1},
+            },
+            f,
+        )
+    lifecycle_dir = tmp_path / ".lifecycle"
+    lifecycle_dir.mkdir()
+    with open(lifecycle_dir / "state.json", "w") as f:
+        json.dump(
+            {
+                "version": 1,
+                "phase": "idle",
+                "serving_revision": "101",
+                "canary_revision": None,
+                "stale": [],
+                "history": [{"event": "promoted"}],
+            },
+            f,
+        )
+    with open(lifecycle_dir / "quarantine.json", "w") as f:
+        json.dump([{"canary_revision": "102", "machines": ["m-2"]}], f)
+
+    ledger = FleetHealthLedger(
+        directory=str(revision_dir), heartbeat_seconds=0.0
+    )
+    ledger.record_request("m-1")
+    ledger.record_quarantine(["m-2"], revision="102", reasons=["gate fail"])
+    ledger.record_plan_accuracy(
+        {
+            "actual_compiles": 1,
+            "actual_fit_s": 1.5,
+            "measured_member_waste": 0.25,
+            "measured_hbm_peak_bytes": 1 << 20,
+        }
+    )
+    ledger.flush()
+
+    doc = fleet_status_document(
+        str(revision_dir),
+        device={
+            "memory": {
+                "available": True,
+                "measured_devices": 1,
+                "bytes_in_use": 1024,
+                "peak_bytes_in_use": 2048,
+            },
+            "compile_cache": {
+                "build": {"compiles": 2, "cache_hits": 6, "hit_rate": 0.75}
+            },
+        },
+        programs={"programs": 2, "signatures": 4},
+    )
+    assert doc["revision"] == "100"
+    assert doc["build"]["state"] == "complete"
+    assert doc["plan"]["strategy"] == "packed"
+    assert doc["plan"]["accuracy"]["measured_member_waste"] == 0.25
+    assert doc["lifecycle"]["serving_revision"] == "101"
+    assert doc["lifecycle"]["quarantine_records"] == 1
+    assert doc["health"]["summary"]["quarantined"] == 1
+    assert doc["programs"]["signatures"] == 4
+
+    rendered = render_fleet_status(doc)
+    assert "packed" in rendered
+    assert "quarantined" in rendered
+    assert "m-2" in rendered
+    assert "hit rate" in rendered
+    # the document round-trips through JSON (the route serves it)
+    json.dumps(doc)
+
+
+def test_fleet_status_document_degrades_per_section(tmp_path):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    doc = fleet_status_document(str(empty))
+    assert doc["build"] is None
+    assert doc["plan"] is None
+    assert doc["lifecycle"] is None
+    assert doc["health"] is None
+    rendered = render_fleet_status(doc)
+    assert "no build_status.json" in rendered
+    assert "no fleet_health.json" in rendered
+
+
+# -- the CLI ------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("as_json", [False, True])
+def test_fleet_status_cli(tmp_path, as_json):
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import fleet_status as fleet_status_cmd
+
+    revision_dir = tmp_path / "100"
+    revision_dir.mkdir()
+    ledger = FleetHealthLedger(
+        directory=str(revision_dir), heartbeat_seconds=0.0
+    )
+    ledger.record_request("m-1", error=True)
+    ledger.record_drift("m-1", True, ["feature-shift t (3.00σ)"])
+    ledger.flush()
+    reset_ledgers()  # the CLI reads the persisted snapshot, not memory
+
+    args = [str(revision_dir)] + (["--as-json"] if as_json else [])
+    result = CliRunner().invoke(fleet_status_cmd, args)
+    assert result.exit_code == 0, result.output
+    if as_json:
+        doc = json.loads(result.output)
+        assert doc["revision"] == "100"
+        assert doc["health"]["summary"]["drifting"] == 1
+        assert "compile_cache" in doc["device"]
+    else:
+        assert "drifting" in result.output
+        assert "m-1" in result.output
+
+
+def test_fleet_status_cli_missing_directory():
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import fleet_status as fleet_status_cmd
+
+    result = CliRunner().invoke(fleet_status_cmd, ["/no/such/dir"])
+    assert result.exit_code != 0
+    assert "No such directory" in result.output
