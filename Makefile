@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve test-planner test-lifecycle test-lifecycle-faults test-analysis test-fleet-health docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-check lint lint-gordo image
+.PHONY: test test-fast test-faults test-observability test-serve test-planner test-lifecycle test-lifecycle-faults test-analysis test-fleet-health test-slo docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-check lint lint-gordo image
 
 test:
 	python -m pytest tests/ -q
@@ -74,6 +74,18 @@ test-fleet-health:
 # BENCH_FLEET_HEALTH.json (<=2% overhead is the gate).
 bench-fleet-health:
 	JAX_PLATFORMS=cpu python benchmarks/bench_fleet_health.py
+
+# The fleet SLO suite: cross-worker rollup reducer, burn-rate alert
+# state machine, worker-sink merge, slo CLI/route/gauges — CPU-only and
+# not slow-marked, so the same tests also run inside the tier-1 budget.
+test-slo:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m slo
+
+# SLO-engine bench: aggregation throughput (spans/s), steady-state
+# evaluation overhead vs the telemetry-on floor (<=2% is the gate), and
+# the scripted burn drill; writes BENCH_SLO.json.
+bench-slo:
+	JAX_PLATFORMS=cpu python benchmarks/bench_slo.py
 
 # Full-route serving benchmark + observability acceptance surface:
 # per-stage attribution from serve_trace.jsonl (coverage >= 90% of p50

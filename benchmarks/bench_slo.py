@@ -1,0 +1,313 @@
+"""
+SLO-engine bench: aggregation throughput over a multi-worker span
+corpus, steady-state evaluation overhead against the telemetry-on
+serving floor, and a scripted burn-rate drill.
+
+Three numbers ride the bench trajectory (gated by ``bench-check``):
+
+- ``aggregate_spans_per_sec`` — cold reducer throughput: 3 worker
+  sinks' JSONL folded into windowed rollups (the corpus is synthesized,
+  so the number isolates parse+fold, not span generation);
+- ``overhead_pct`` — what periodic SLO evaluation costs a serving
+  process: the workload is a compute-bound request loop (a hash kernel
+  standing in for scoring, which dominates any real request) exporting
+  spans through the async sink at the production head-sampling rate
+  (1-in-20 requests — ``GORDO_TPU_TRACE_SAMPLE_RATE`` default 0.05;
+  the RED histograms, not the trace, carry full-population statistics),
+  run with and without a background evaluator thread re-evaluating
+  every second (60x denser than the production scrape cadence; each
+  evaluation is INCREMENTAL — only spans since the last tick are
+  parsed). Interleaved quiet-window floors, like BENCH_TELEMETRY /
+  BENCH_FLEET_HEALTH; the acceptance bar is <= 2%;
+- ``drill_ok`` — the burn-rate state machine walked end to end: an
+  injected 5xx burst arms (pending) then fires the fast alert, and
+  recovery traffic resolves it.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/bench_slo.py
+(or ``make bench-slo``; override the output with ``BENCH_SLO_OUT``,
+rep count with ``BENCH_SLO_REPS``, corpus size with
+``BENCH_SLO_SPANS``.)
+"""
+
+import datetime
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPS = int(os.environ.get("BENCH_SLO_REPS", "9"))
+CORPUS_SPANS = int(os.environ.get("BENCH_SLO_SPANS", "60000"))
+WORKERS = 3
+#: the serving-stand-in compute kernel: requests per workload rep and
+#: hash iterations per "request" (~5us each on this class of host —
+#: compute dominates, as scoring dominates a real request)
+WORKLOAD_REQUESTS = int(os.environ.get("BENCH_SLO_REQUESTS", "12000"))
+WORK_PER_REQUEST = 50
+#: background evaluator cadence during the loaded run — 60x denser
+#: than the default scrape refresh, so the measured cost is an upper
+#: bound on production
+EVALUATOR_PERIOD_S = 1.0
+#: deterministic head-sampling: one request in 20 exports its span
+#: (the production default export rate)
+EXPORT_EVERY = 20
+
+
+def iso(ts: float) -> str:
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc
+    ).isoformat()
+
+
+def request_line(i: int, ts: float, status: int = 200, pid: int = 0) -> str:
+    return json.dumps(
+        {
+            "name": "request",
+            "context": {
+                "trace_id": f"{pid:08x}{i:024x}",
+                "span_id": f"{i:016x}",
+            },
+            "parent_id": None,
+            "kind": "server",
+            "start_time": iso(ts - 0.1),
+            "end_time": iso(ts),
+            "duration_ms": 100.0,
+            "status": {"status_code": "OK"},
+            "attributes": {
+                "http.status_code": status,
+                "gordo_name": f"bench-m-{i % 32}",
+            },
+            "resource": {"service.name": "bench"},
+        }
+    )
+
+
+def synthesize_corpus(directory: str, total: int) -> None:
+    now = time.time()
+    per_worker = total // WORKERS
+    for worker in range(WORKERS):
+        path = os.path.join(directory, f"serve_trace-{9000 + worker}.jsonl")
+        with open(path, "w") as handle:
+            for i in range(per_worker):
+                ts = now - 3600 + (i * 3600.0 / per_worker)
+                status = 500 if i % 97 == 0 else 200
+                handle.write(
+                    request_line(i, ts, status=status, pid=9000 + worker)
+                    + "\n"
+                )
+
+
+def bench_aggregation() -> dict:
+    """Cold + incremental reducer throughput over the corpus."""
+    from gordo_tpu.telemetry.aggregate import RollupStore
+
+    d = tempfile.mkdtemp(prefix="bench-slo-agg-")
+    try:
+        synthesize_corpus(d, CORPUS_SPANS)
+        store = RollupStore(d)
+        start = time.perf_counter()
+        report = store.aggregate()
+        cold = time.perf_counter() - start
+        start = time.perf_counter()
+        second = store.aggregate()
+        warm = time.perf_counter() - start
+        return {
+            "corpus_spans": report["spans_read"],
+            "cold_seconds": round(cold, 4),
+            "spans_per_sec": round(report["spans_read"] / cold, 1),
+            "incremental_seconds": round(warm, 4),
+            "incremental_spans": second["spans_read"],
+            "rollups_written": len(report["windows_updated"]),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def one_workload(evaluator_on: bool) -> float:
+    """Wall seconds for the serving-stand-in request loop (hash kernel
+    + one exported span per request through the async sink), optionally
+    with the background SLO evaluator re-evaluating the same directory
+    once a second."""
+    import hashlib
+
+    from gordo_tpu.telemetry import slo
+    from gordo_tpu.telemetry.recorder import SpanRecorder
+
+    d = tempfile.mkdtemp(prefix="bench-slo-load-")
+    try:
+        recorder = SpanRecorder(
+            sink_path=os.path.join(d, "serve_trace.jsonl"),
+            async_sink=True,
+        )
+        stop = threading.Event()
+
+        def evaluator():
+            while not stop.is_set():
+                try:
+                    slo.evaluate(d)
+                except Exception:
+                    pass
+                stop.wait(EVALUATOR_PERIOD_S)
+
+        thread = None
+        if evaluator_on:
+            thread = threading.Thread(target=evaluator, daemon=True)
+            thread.start()
+        now = time.time()
+        span_template = {
+            "name": "request",
+            "parent_id": None,
+            "kind": "server",
+            "start_time": iso(now),
+            "end_time": iso(now),
+            "duration_ms": 100.0,
+            "status": {"status_code": "OK"},
+            "attributes": {"http.status_code": 200, "gordo_name": "m"},
+            "resource": {"service.name": "bench"},
+        }
+        payload = b"x" * 4096
+        digest = hashlib.sha256
+        start = time.perf_counter()
+        for i in range(WORKLOAD_REQUESTS):
+            for _ in range(WORK_PER_REQUEST):
+                digest(payload).digest()
+            if i % EXPORT_EVERY == 0:
+                recorder.emit(
+                    {
+                        **span_template,
+                        "context": {
+                            "trace_id": f"{i:032x}",
+                            "span_id": f"{i:016x}",
+                        },
+                    }
+                )
+        recorder.flush()
+        elapsed = time.perf_counter() - start
+        stop.set()
+        if thread is not None:
+            thread.join(timeout=5)
+        recorder.close()
+        return elapsed
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+        slo.reset_statuses()
+
+
+def run_drill() -> dict:
+    """The scripted burn drill: burst -> pending -> firing; recovery ->
+    resolved (deterministic timestamps, explicit `now`)."""
+    from gordo_tpu.telemetry import slo
+
+    d = tempfile.mkdtemp(prefix="bench-slo-drill-")
+    try:
+        with open(os.path.join(d, "slos.toml"), "w") as handle:
+            handle.write(
+                '[[slo]]\nname = "availability"\n'
+                'objective = "availability"\ntarget = 0.99\n'
+                'window = "30d"\n[burn]\nfast_threshold = 10.0\n'
+            )
+        now = time.time()
+        path = os.path.join(d, "serve_trace.jsonl")
+        with open(path, "w") as handle:
+            for i in range(2000):
+                handle.write(request_line(i, now - 2700 + i) + "\n")
+            for i in range(400):
+                handle.write(
+                    request_line(10_000 + i, now - 100 + i * 0.2, status=500)
+                    + "\n"
+                )
+        first = slo.evaluate(d, now=now)
+        second = slo.evaluate(d, now=now + 30)
+        with open(path, "a") as handle:
+            for i in range(20_000):
+                handle.write(
+                    request_line(50_000 + i, now + 30 + i * 0.001) + "\n"
+                )
+        third = slo.evaluate(d, now=now + 60)
+
+        def state(doc):
+            return {a["id"]: a["state"] for a in doc["alerts"]}[
+                "availability:fast"
+            ]
+
+        sequence = [state(first), state(second), state(third)]
+        return {
+            "drill_sequence": sequence,
+            "drill_ok": sequence == ["pending", "firing", "resolved"],
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+        from gordo_tpu.telemetry import slo as slo_module
+
+        slo_module.reset_statuses()
+
+
+def main() -> dict:
+    aggregation = bench_aggregation()
+
+    # warmup both modes, then interleave
+    one_workload(False)
+    one_workload(True)
+    runs = {"evaluator_off": [], "evaluator_on": []}
+    pair_pcts = []
+    for rep in range(REPS):
+        if rep % 2 == 0:
+            off = one_workload(False)
+            on = one_workload(True)
+        else:
+            on = one_workload(True)
+            off = one_workload(False)
+        runs["evaluator_off"].append(off)
+        runs["evaluator_on"].append(on)
+        pair_pcts.append((on - off) / off * 100.0)
+
+    off_floor = min(runs["evaluator_off"])
+    on_floor = min(runs["evaluator_on"])
+    overhead_pct = (on_floor - off_floor) / off_floor * 100.0
+
+    drill = run_drill()
+    doc = {
+        "bench": "slo-engine",
+        "timestamp": datetime.datetime.now(
+            datetime.timezone.utc
+        ).isoformat(),
+        "reps": REPS,
+        "workers": WORKERS,
+        "aggregation": aggregation,
+        "aggregate_spans_per_sec": aggregation["spans_per_sec"],
+        "workload_requests": WORKLOAD_REQUESTS,
+        "evaluator_period_s": EVALUATOR_PERIOD_S,
+        "evaluator_off_sec": round(off_floor, 4),
+        "evaluator_on_sec": round(on_floor, 4),
+        "pair_overhead_pcts": [round(p, 2) for p in pair_pcts],
+        "median_pair_overhead_pct": round(statistics.median(pair_pcts), 2),
+        "overhead_pct": round(overhead_pct, 2),
+        "within_2pct": overhead_pct <= 2.0,
+        **drill,
+        "runs": {
+            mode: [round(v, 4) for v in values]
+            for mode, values in runs.items()
+        },
+    }
+    out_path = Path(
+        os.environ.get("BENCH_SLO_OUT", REPO_ROOT / "BENCH_SLO.json")
+    )
+    with open(out_path, "w") as handle:
+        json.dump(doc, handle, indent=1, sort_keys=True)
+        handle.write("\n")
+    print(json.dumps(doc, indent=1, sort_keys=True))
+    print(f"\nwrote {out_path}")
+    return doc
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ import json
 import logging
 import os
 import sys
+import time
 import traceback
 from typing import Any, List, Optional, Tuple, cast
 
@@ -837,6 +838,35 @@ def fleet_status(directory: str, as_json: bool, watch: Optional[float]):
         click.echo("")
 
 
+def _parse_since(
+    since: Optional[str], last: Optional[str]
+) -> Optional[float]:
+    """``--since`` (ISO timestamp or epoch seconds) / ``--last``
+    (duration like ``90m``/``6h``/``7d``) -> an epoch cutoff."""
+    from ..telemetry.aggregate import parse_span_time
+    from ..telemetry.slo import parse_duration
+
+    if since and last:
+        raise click.ClickException("--since and --last are exclusive")
+    if last:
+        try:
+            return time.time() - parse_duration(last)
+        except ValueError as exc:
+            raise click.ClickException(str(exc))
+    if since:
+        try:
+            return float(since)
+        except ValueError:
+            pass
+        ts = parse_span_time(since)
+        if ts is None:
+            raise click.ClickException(
+                f"Unparseable --since {since!r} (ISO timestamp or epoch)"
+            )
+        return ts
+    return None
+
+
 @click.command("trace")
 @click.argument("target", envvar="OUTPUT_DIR")
 @click.option(
@@ -845,7 +875,20 @@ def fleet_status(directory: str, as_json: bool, watch: Optional[float]):
     is_flag=True,
     help="Print the raw analysis document instead of the report",
 )
-def trace(target: str, as_json: bool):
+@click.option(
+    "--since",
+    default=None,
+    help="Only analyze spans ending at/after this ISO timestamp (or "
+    "epoch seconds); rotated generations older than the cutoff are "
+    "skipped without being parsed.",
+)
+@click.option(
+    "--last",
+    default=None,
+    help="Only analyze the trailing window, e.g. `--last 1h`, `90m`, "
+    "`7d` (exclusive with --since).",
+)
+def trace(target: str, as_json: bool, since: Optional[str], last: Optional[str]):
     """
     Analyze a span trace: per-span latency percentiles, the request
     per-stage breakdown with attribution coverage and the median
@@ -854,31 +897,43 @@ def trace(target: str, as_json: bool):
 
     TARGET is a trace file (``serve_trace.jsonl`` / ``build_trace.jsonl``,
     rotated generations are read automatically) or a directory holding
-    one — a serving telemetry dir or a build output dir. With both
-    traces present in a directory, each is analyzed in turn.
+    one — a serving telemetry dir or a build output dir. Per-worker
+    sink variants (``serve_trace-<pid>.jsonl``) are read-merged into
+    one analysis per logical trace; with both serve and build traces
+    present, each is analyzed in turn.
     """
     from ..telemetry import SERVE_TRACE_FILE
     from ..telemetry.progress import BUILD_TRACE_FILE
-    from ..telemetry.trace_analysis import analyze_trace, render_analysis
+    from ..telemetry.trace_analysis import (
+        analyze_trace,
+        render_analysis,
+        trace_bases,
+    )
 
+    since_ts = _parse_since(since, last)
     if os.path.isdir(target):
-        paths = [
-            os.path.join(target, name)
-            for name in (SERVE_TRACE_FILE, BUILD_TRACE_FILE)
-            if os.path.exists(os.path.join(target, name))
+        # one analysis per LOGICAL trace: all worker variants of the
+        # serve trace merge, ditto the build trace
+        groups = [
+            bases
+            for bases in (
+                trace_bases(target, SERVE_TRACE_FILE),
+                trace_bases(target, BUILD_TRACE_FILE),
+            )
+            if bases
         ]
-        if not paths:
+        if not groups:
             raise click.ClickException(
                 f"No {SERVE_TRACE_FILE} or {BUILD_TRACE_FILE} in {target} "
                 "(is GORDO_TPU_TELEMETRY_DIR pointed elsewhere, or "
                 "telemetry disabled?)"
             )
     elif os.path.exists(target):
-        paths = [target]
+        groups = [[target]]
     else:
         raise click.ClickException(f"No such trace file or directory: {target}")
 
-    docs = [analyze_trace(path) for path in paths]
+    docs = [analyze_trace(group, since_ts=since_ts) for group in groups]
     if as_json:
         click.echo(
             json.dumps(docs[0] if len(docs) == 1 else docs, indent=1)
@@ -888,6 +943,114 @@ def trace(target: str, as_json: bool):
         if i:
             click.echo("")
         click.echo(render_analysis(doc))
+
+
+@click.group("slo")
+def slo_cli():
+    """Fleet SLO engine: cross-worker rollups, error budgets, and
+    multi-window burn-rate alerts (gordo_tpu.telemetry.slo;
+    docs/observability.md "SLOs & error budgets")."""
+
+
+def _slo_evaluate(directory: str, config_path: Optional[str]):
+    from ..telemetry import slo as slo_engine
+
+    if not os.path.isdir(directory):
+        raise click.ClickException(f"No such directory: {directory}")
+    try:
+        config = slo_engine.load_slo_config(directory, path=config_path)
+    except (OSError, ValueError) as exc:
+        raise click.ClickException(f"Bad SLO config: {exc}")
+    try:
+        return slo_engine.evaluate(directory, config=config)
+    except OSError as exc:
+        raise click.ClickException(f"SLO evaluation failed: {exc}")
+
+
+@slo_cli.command("status")
+@click.argument("directory", envvar="GORDO_TPU_TELEMETRY_DIR")
+@click.option(
+    "--config",
+    "config_path",
+    default=None,
+    type=click.Path(exists=True, dir_okay=False),
+    help="slos.toml to evaluate against (default: GORDO_TPU_SLO_CONFIG, "
+    "then DIRECTORY/slos.toml, then the packaged defaults).",
+)
+@click.option(
+    "--as-json",
+    "as_json",
+    is_flag=True,
+    help="Print the raw status document instead of the table",
+)
+@click.option(
+    "--watch",
+    default=None,
+    type=float,
+    help="Re-evaluate and re-render every N seconds (Ctrl-C to stop)",
+)
+def slo_status(
+    directory: str,
+    config_path: Optional[str],
+    as_json: bool,
+    watch: Optional[float],
+):
+    """
+    Evaluate and render the SLO status of DIRECTORY (a telemetry dir or
+    build output dir holding trace sinks): per-objective error-budget
+    remaining, multi-window burn rates, and every alert's state in the
+    pending -> firing -> resolved lifecycle.
+
+    Evaluation is incremental — new spans fold into the persisted
+    ``rollups/`` artifacts; re-running over an unchanged corpus reads
+    zero span bytes. The model server answers the same document at
+    ``/gordo/v0/<project>/slo``.
+    """
+    from ..telemetry import render_slo_status
+
+    while True:
+        doc = _slo_evaluate(directory, config_path)
+        if as_json:
+            click.echo(json.dumps(doc, indent=1, sort_keys=True, default=str))
+        else:
+            click.echo(render_slo_status(doc))
+        if watch is None:
+            break
+        time.sleep(max(0.1, watch))
+        click.echo("")
+
+
+@slo_cli.command("check")
+@click.argument("directory", envvar="GORDO_TPU_TELEMETRY_DIR")
+@click.option(
+    "--config",
+    "config_path",
+    default=None,
+    type=click.Path(exists=True, dir_okay=False),
+    help="slos.toml to evaluate against (default resolution as `status`).",
+)
+@click.option(
+    "--as-json",
+    "as_json",
+    is_flag=True,
+    help="Print the raw status document instead of the table",
+)
+def slo_check(directory: str, config_path: Optional[str], as_json: bool):
+    """
+    The SLO gate: evaluate DIRECTORY and exit non-zero while any
+    burn-rate alert is FIRING (pending and resolved alerts exit 0) —
+    mirroring ``bench-check``, so deploy pipelines and cron monitors
+    can gate on one command.
+    """
+    from ..telemetry import render_slo_status
+
+    doc = _slo_evaluate(directory, config_path)
+    if as_json:
+        click.echo(json.dumps(doc, indent=1, sort_keys=True, default=str))
+    else:
+        click.echo(render_slo_status(doc))
+    if doc.get("firing"):
+        raise SystemExit(1)
 
 
 @click.command("bench-check")
@@ -1706,6 +1869,7 @@ gordo_tpu_cli.add_command(plan_fleet)
 gordo_tpu_cli.add_command(build_status)
 gordo_tpu_cli.add_command(fleet_status)
 gordo_tpu_cli.add_command(trace)
+gordo_tpu_cli.add_command(slo_cli)
 gordo_tpu_cli.add_command(bench_check)
 gordo_tpu_cli.add_command(lint)
 gordo_tpu_cli.add_command(run_server_cli)

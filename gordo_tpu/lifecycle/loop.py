@@ -72,16 +72,26 @@ class LifecycleConfig:
     #: a broken rebuild path would canary-storm (rebuild, fail gates,
     #: roll back, repeat) every cycle
     quarantine_cooldown_s: float = 3600.0
+    #: hold auto-promotions while a page-severity SLO burn alert is
+    #: FIRING (telemetry/slo.py): swapping artifacts mid-incident
+    #: destroys the evidence an operator is debugging against, and a
+    #: canary gated on a probe window says nothing about the live burn.
+    #: The canary keeps serving its slice; `lifecycle promote --force`
+    #: and gate failures (rollbacks) are never held.
+    slo_gate: bool = True
     drift: DriftConfig = field(default_factory=DriftConfig)
     gates: GateConfig = field(default_factory=GateConfig)
 
     @classmethod
     def from_env(cls) -> "LifecycleConfig":
+        from ..utils.env import env_bool
+
         return cls(
             canary_fraction=env_float("GORDO_TPU_CANARY_FRACTION", 0.25),
             quarantine_cooldown_s=env_float(
                 "GORDO_TPU_QUARANTINE_COOLDOWN", 3600.0
             ),
+            slo_gate=env_bool("GORDO_TPU_GATE_SLO_BURN", True),
             drift=DriftConfig.from_env(),
             gates=GateConfig.from_env(),
         )
@@ -463,6 +473,24 @@ class LifecycleSupervisor:
         )
         if not gate.passed:
             self._rollback(report, gate.failures)
+            return
+        holding = self._slo_hold()
+        if holding:
+            # the alert state machine feeds the gate inputs: a passing
+            # canary does NOT auto-promote into a burning deployment —
+            # it keeps its slice and re-gates next cycle (resolved
+            # alerts release the hold; `promote --force` bypasses)
+            report.details["gate"] = (
+                "passed; auto-promotion held: SLO page alert firing "
+                f"({', '.join(holding)})"
+            )
+            report.details["slo_hold"] = holding
+            logger.warning(
+                "canary %s passed gates but auto-promotion is held: "
+                "firing SLO page alert(s) %s",
+                revision,
+                ", ".join(holding),
+            )
         elif self.config.auto_promote:
             self._promote(report)
         else:
@@ -570,6 +598,33 @@ class LifecycleSupervisor:
                 cooling.update(record.get("machines") or [])
         return cooling
 
+    def _slo_hold(self) -> List[str]:
+        """Firing page-severity SLO alert ids for this deployment's
+        telemetry dir (the persisted state machine — no aggregation
+        runs here), or [] when the SLO gate is off / never evaluated."""
+        if not self.config.slo_gate:
+            return []
+        try:
+            from ..telemetry import slo as slo_engine
+
+            directory = slo_engine.slo_directory(self.collection_dir)
+            if not directory:
+                return []
+            return [
+                alert["id"]
+                for alert in slo_engine.firing_alerts(
+                    directory,
+                    severity="page",
+                    # a dead evaluator's stale 'firing' must not hold
+                    # the self-healing loop forever
+                    max_age_s=slo_engine.STALE_ALERT_HOLD_S,
+                )
+            ]
+        except Exception as exc:  # noqa: BLE001 - a broken SLO state
+            # file must not wedge the lifecycle loop
+            logger.debug("slo hold check failed: %r", exc)
+            return []
+
     # -- manual controls (CLI) ----------------------------------------------
 
     def promote(self, force: bool = False) -> CycleReport:
@@ -589,6 +644,12 @@ class LifecycleSupervisor:
                 self._gate_and_settle(report)
             finally:
                 self.config.auto_promote = previous
+            if report.details.get("slo_hold"):
+                raise RuntimeError(
+                    "promotion held: SLO page alert(s) firing "
+                    f"({', '.join(report.details['slo_hold'])}); "
+                    "resolve the burn or use --force"
+                )
             if not (report.promoted or report.rolled_back):
                 raise RuntimeError(
                     "gates could not run (no probe data scored yet); "

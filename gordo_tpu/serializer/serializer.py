@@ -16,14 +16,17 @@ import hashlib
 import logging
 import os
 import pickle
+import re
 import shutil
 import uuid
 from os import path
 from typing import Any, Optional
 
+from ..telemetry.aggregate import ROLLUP_DIR, is_worker_variant
 from ..telemetry.fleet_health import FLEET_HEALTH_FILE
 from ..telemetry.progress import BUILD_STATUS_FILE, BUILD_TRACE_FILE
 from ..telemetry.serving import SERVE_TRACE_FILE
+from ..telemetry.slo import SLO_CONFIG_FILE, SLO_STATE_FILE
 from ..utils import json_compat as simplejson
 from ..utils.faults import fault_point
 
@@ -103,15 +106,24 @@ def is_staging_dir(name: str) -> bool:
     return name.startswith(".") and TMP_DIR_MARKER in name
 
 
+def _is_worker_sink(name: str, base: str) -> bool:
+    """Per-worker variants of one telemetry sink, rotated generations
+    included (``serve_trace-<pid>.jsonl[.N]``, ``fleet_health-<pid>
+    .json``); the suffix grammar itself lives in ONE place
+    (``telemetry.aggregate.is_worker_variant``)."""
+    return is_worker_variant(re.sub(r"\.\d+$", "", name), base)
+
+
 def is_builder_dropping(name: str) -> bool:
-    """True for any non-model entry the fleet builder may leave in an
+    """True for any non-model entry the fleet builder (or a serving /
+    SLO process pointed at the artifact volume) may leave in an
     artifact directory: the build journal, its event overlay, the
-    telemetry heartbeat/trace/health-ledger files — including their size-rotated
-    generations (``build_trace.jsonl.1`` ...) and the serving-side
-    ``serve_trace.jsonl`` when ``GORDO_TPU_TELEMETRY_DIR`` points at
-    the artifact volume — and atomic-write staging leftovers. Revision
-    cleanup treats a directory holding only these as empty; model
-    listings never surface them."""
+    telemetry heartbeat/trace/health-ledger files — including their
+    size-rotated generations (``build_trace.jsonl.1`` ...) and the
+    per-worker ``-<pid>`` sink variants — the SLO engine's ``rollups/``
+    directory, alert-state file and a deployment's ``slos.toml``, and
+    atomic-write staging leftovers. Revision cleanup treats a directory
+    holding only these as empty; model listings never surface them."""
     return (
         name == BUILD_JOURNAL_FILE
         or name == BUILD_JOURNAL_EVENTS_FILE
@@ -119,8 +131,13 @@ def is_builder_dropping(name: str) -> bool:
         or name == BUILD_TRACE_FILE
         or name == SERVE_TRACE_FILE
         or name == FLEET_HEALTH_FILE
+        or name == ROLLUP_DIR
+        or name == SLO_STATE_FILE
+        or name == SLO_CONFIG_FILE
         or name.startswith(BUILD_TRACE_FILE + ".")
         or name.startswith(SERVE_TRACE_FILE + ".")
+        or _is_worker_sink(name, SERVE_TRACE_FILE)
+        or _is_worker_sink(name, FLEET_HEALTH_FILE)
         or is_staging_dir(name)
     )
 

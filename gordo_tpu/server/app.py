@@ -258,6 +258,11 @@ URL_MAP = Map(
             endpoint="fleet-health",
             methods=["GET"],
         ),
+        Rule(
+            f"{PREFIX}/<gordo_project>/slo",
+            endpoint="slo",
+            methods=["GET"],
+        ),
         Rule(f"{PREFIX}/<gordo_project>/models", endpoint="models", methods=["GET"]),
         Rule(
             f"{PREFIX}/<gordo_project>/revisions",
@@ -286,6 +291,7 @@ HANDLERS = {
     "expected-models": base.get_expected_models,
     "build-status": base.get_build_status,
     "fleet-health": base.get_fleet_health,
+    "slo": base.get_slo_status,
 }
 
 
@@ -588,6 +594,16 @@ def build_app(
         except Exception:  # noqa: BLE001 - serving state restore is
             # advisory; a torn state file must not take the server down
             logger.exception("lifecycle serving-state restore failed")
+
+    # SLO exposition: mark the serving telemetry dir watched so /metrics
+    # scrapes keep gordo_slo_* fresh (throttled re-evaluation; see
+    # GORDO_TPU_SLO_SCRAPE_REFRESH). No-op with telemetry off.
+    try:
+        from ..telemetry import slo as slo_engine
+
+        slo_engine.watch(slo_engine.slo_directory(collection_dir))
+    except Exception:  # noqa: BLE001 - SLO exposition is advisory
+        logger.debug("slo watch registration failed", exc_info=True)
 
     # Micro-batching engine: process-global (gthread workers share it,
     # like STORE); created here so the server lifecycle owns warmup and

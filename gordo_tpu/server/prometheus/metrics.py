@@ -11,7 +11,7 @@ import logging
 import os
 import re
 import weakref
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 from prometheus_client import (
     REGISTRY,
@@ -38,6 +38,7 @@ PROJECT_LEVEL_ROUTES = (
     "expected-models",
     "build-status",
     "fleet-health",
+    "slo",
 )
 
 #: request-stage latency buckets: stages span sub-millisecond metadata
@@ -634,21 +635,86 @@ class DeviceUtilizationCollector:
         yield programs
 
 
+#: numeric encoding of the alert state machine for the gauge below —
+#: `resolved` maps to 0 (it is a closing annotation, not a page)
+_SLO_ALERT_STATE_VALUES = {
+    "inactive": 0,
+    "resolved": 0,
+    "pending": 1,
+    "firing": 2,
+}
+
+
+class SloCollector:
+    """Scrape-time SLO exposition (``telemetry/slo.py``): error-budget
+    remaining, multi-window burn rates, and the alert state machine.
+    Label cardinality is BOUNDED by the declared ``slos.toml`` — slo
+    names and the two burn windows — never by traffic or fleet size
+    (the PR 8 prometheus-cardinality contract). Watched directories
+    re-evaluate at most once per ``GORDO_TPU_SLO_SCRAPE_REFRESH``."""
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        from ...telemetry import slo as slo_engine
+
+        budget = GaugeMetricFamily(
+            "gordo_slo_error_budget_remaining_ratio",
+            "Fraction of the SLO window's error budget still unspent "
+            "(1.0 = clean, 0.0 = the objective is blown)",
+            labels=["slo"],
+        )
+        burn = GaugeMetricFamily(
+            "gordo_slo_burn_rate",
+            "Error-budget burn rate over the alert windows (1.0 = "
+            "spending exactly one budget per SLO window)",
+            labels=["slo", "window"],
+        )
+        state = GaugeMetricFamily(
+            "gordo_slo_alert_state",
+            "Worst burn-rate alert state per SLO "
+            "(0 = inactive/resolved, 1 = pending, 2 = firing)",
+            labels=["slo"],
+        )
+        for doc in slo_engine.scrape_statuses().values():
+            for slo in doc.get("slos") or []:
+                name = str(slo.get("name"))
+                budget.add_metric(
+                    [name],
+                    float((slo.get("budget") or {}).get("remaining_ratio", 1.0)),
+                )
+                for window, rate in (slo.get("burn_rates") or {}).items():
+                    burn.add_metric([name, str(window)], float(rate))
+            worst: Dict[str, int] = {}
+            for alert in doc.get("alerts") or []:
+                name = str(alert.get("slo"))
+                value = _SLO_ALERT_STATE_VALUES.get(
+                    str(alert.get("state")), 0
+                )
+                worst[name] = max(worst.get(name, 0), value)
+            for name, value in worst.items():
+                state.add_metric([name], value)
+        yield budget
+        yield burn
+        yield state
+
+
 #: registries already carrying the fleet-console collectors (same
 #: duplicate-registration guard as the program-cache WeakSet)
 _fleet_console_registries: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def register_fleet_console_collectors(registry: CollectorRegistry) -> None:
-    """Attach the fleet-health and device-utilization scrape collectors
-    to ``registry``, once — on every registry that answers scrapes,
-    like the program-cache collector (scrape-time collectors have no
-    mmap backing to ride the multiprocess fan-in)."""
+    """Attach the fleet-health, device-utilization and SLO scrape
+    collectors to ``registry``, once — on every registry that answers
+    scrapes, like the program-cache collector (scrape-time collectors
+    have no mmap backing to ride the multiprocess fan-in)."""
     if registry in _fleet_console_registries:
         return
     _fleet_console_registries.add(registry)
     registry.register(FleetHealthCollector())
     registry.register(DeviceUtilizationCollector())
+    registry.register(SloCollector())
 
 
 class ServeMetrics:

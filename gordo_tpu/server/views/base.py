@@ -439,6 +439,53 @@ def get_fleet_health(ctx, gordo_project: str):
     return ctx.json_response(doc)
 
 
+def get_slo_status(ctx, gordo_project: str):
+    """
+    The fleet SLO judgment for the served collection: per-objective
+    error-budget remaining, multi-window burn rates, and every alert's
+    pending/firing/resolved state — exactly what ``gordo-tpu slo
+    status --as-json`` prints, evaluated over the serving telemetry
+    dir's cross-worker rollups (``GORDO_TPU_TELEMETRY_DIR`` when
+    configured, else the anchor collection dir — a dir with no sinks
+    evaluates to empty traffic, inside SLO). 404 only when neither
+    resolves to a directory; config errors surface as 422 (a bad
+    slos.toml is the operator's to fix, not a server fault).
+    """
+    from ...telemetry import slo as slo_engine
+
+    # the ANCHOR dir (env var, falling back to the resolved collection
+    # dir like get_fleet_health) unless a telemetry dir is configured
+    anchor = os.environ.get(ctx.config["MODEL_COLLECTION_DIR_ENV_VAR"])
+    directory = slo_engine.slo_directory(anchor or ctx.collection_dir)
+    if not directory or not os.path.isdir(directory):
+        return ctx.json_response(
+            {
+                "error": "No telemetry directory to evaluate "
+                "(set GORDO_TPU_TELEMETRY_DIR)."
+            },
+            status=404,
+        )
+    try:
+        config = slo_engine.load_slo_config(directory)
+    except (OSError, ValueError) as exc:
+        return ctx.json_response(
+            {"error": f"Bad SLO config: {exc}"}, status=422
+        )
+    try:
+        # throttled: a dashboard polling this route re-serves the cached
+        # status until the scrape-refresh window lapses — a GET must not
+        # re-aggregate (disk writes) or step the alert state machine at
+        # whatever rate an external poller chooses
+        doc = slo_engine.evaluate_cached(directory, config=config)
+    except OSError as exc:
+        # a read-only artifact volume (a real serving deployment shape)
+        # cannot host rollups — answer a clean 503, not a traceback
+        return ctx.json_response(
+            {"error": f"SLO evaluation failed: {exc}"}, status=503
+        )
+    return ctx.json_response(doc)
+
+
 def get_metadata(ctx, gordo_project: str, gordo_name: str):
     """Model metadata; doubles as the per-model healthcheck route."""
     server_utils.require_metadata(ctx, gordo_name)

@@ -1,0 +1,857 @@
+"""
+The cross-worker telemetry reducer: every sink in a collection dir,
+folded into compact time-windowed rollups the SLO engine (and any
+pod-level aggregator) can evaluate without re-reading the span corpus.
+
+PRs 3/7/9 left the telemetry *sinks* per process: under gunicorn each
+worker appends its own ``serve_trace-<pid>.jsonl`` (PR 10's worker-sink
+split), builds append ``build_trace.jsonl``, and every sink rotates by
+size. Nothing merged them — answering "what was the error rate in the
+last hour" meant re-parsing a quarter-gigabyte of JSONL per question.
+This module is the merge:
+
+- :func:`discover_sinks` finds every trace sink in a directory — the
+  shared base names, the ``-<pid>`` worker variants, and all rotated
+  generations of each;
+- :class:`RollupStore` streams *new* spans out of them (per-file byte
+  offsets keyed by a content signature, so rotation — which renames a
+  file under the reader — resumes where the bytes moved to, not at the
+  path), dedupes by ``(trace_id, span_id)``, assigns each span to a
+  fixed time window, and folds it into ``rollups/<window>.json``
+  artifacts (request/error counts, fixed-bucket latency histograms,
+  per-stage and per-machine breakdowns), each written atomically;
+- re-aggregation is **incremental**: a second pass over an unchanged
+  corpus reads zero bytes. Rollups are plain mergeable JSON, so a
+  pod-level aggregator over N hosts is a directory walk plus
+  :func:`merge_rollups` — not a rewrite.
+
+Percentiles come from the fixed-bucket histograms (stdlib-only, like
+the whole package: no numpy inside the telemetry layer).
+"""
+
+import hashlib
+import json
+import logging
+import os
+import re
+import threading
+import time
+from datetime import datetime, timezone
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from .progress import BUILD_TRACE_FILE
+from .serving import SERVE_TRACE_FILE
+
+logger = logging.getLogger(__name__)
+
+#: where rollups (and the reducer's resume state) live, under the
+#: aggregated directory — a builder dropping, like the sinks themselves
+ROLLUP_DIR = "rollups"
+#: per-file read offsets + signatures (inside ROLLUP_DIR)
+ROLLUP_STATE_FILE = "rollup_state.json"
+
+#: rollup window size in seconds (every window boundary is aligned to
+#: it, so windows from different workers/hosts merge bucket-for-bucket)
+WINDOW_SECONDS_ENV = "GORDO_TPU_SLO_WINDOW_SECONDS"
+DEFAULT_WINDOW_SECONDS = 60
+#: rollup windows retained on disk (oldest pruned past this); the
+#: default covers a 30d SLO window at 60s granularity with headroom
+ROLLUP_KEEP_ENV = "GORDO_TPU_SLO_ROLLUP_KEEP"
+DEFAULT_ROLLUP_KEEP = 50_000
+#: seconds a dead worker's fully-consumed trace chain must sit
+#: unwritten before the reducer garbage-collects it (0 disables sink
+#: GC entirely — e.g. an aggregator in another pid namespace, where
+#: the liveness probe cannot see the writers)
+SINK_GC_AGE_ENV = "GORDO_TPU_SLO_SINK_GC_AGE"
+DEFAULT_SINK_GC_AGE = 24 * 3600.0
+
+#: fixed latency bucket upper edges (ms) — fixed so histograms merge
+#: across workers, windows and hosts by pure count addition; the +Inf
+#: overflow bucket is implicit as the last counts slot
+LATENCY_BUCKETS_MS: Tuple[float, ...] = (
+    1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 350.0, 500.0,
+    750.0, 1000.0, 1500.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0,
+)
+
+#: span names that are never request *stages* (see trace_analysis)
+_NON_STAGE_NAMES = frozenset(("request", "profile", "serve_batch"))
+
+
+def window_seconds() -> int:
+    from ..utils.env import env_int
+
+    return max(1, env_int(WINDOW_SECONDS_ENV, DEFAULT_WINDOW_SECONDS))
+
+
+def rollup_keep() -> int:
+    from ..utils.env import env_int
+
+    return max(1, env_int(ROLLUP_KEEP_ENV, DEFAULT_ROLLUP_KEEP))
+
+
+def parse_span_time(value: Any) -> Optional[float]:
+    """Epoch seconds from a recorded span timestamp (the recorder's
+    UTC isoformat); None when unparseable."""
+    if not isinstance(value, str) or not value:
+        return None
+    try:
+        stamp = datetime.fromisoformat(value)
+    except ValueError:
+        return None
+    if stamp.tzinfo is None:
+        stamp = stamp.replace(tzinfo=timezone.utc)
+    return stamp.timestamp()
+
+
+# -- the mergeable histogram --------------------------------------------------
+
+
+def new_histogram() -> Dict[str, Any]:
+    """An empty fixed-bucket latency histogram (counts has one overflow
+    slot past the last edge)."""
+    return {
+        "buckets_ms": list(LATENCY_BUCKETS_MS),
+        "counts": [0] * (len(LATENCY_BUCKETS_MS) + 1),
+        "count": 0,
+        "sum_ms": 0.0,
+    }
+
+
+def histogram_add(histogram: Dict[str, Any], value_ms: float) -> None:
+    edges = histogram["buckets_ms"]
+    slot = len(edges)
+    for i, edge in enumerate(edges):
+        if value_ms <= edge:
+            slot = i
+            break
+    histogram["counts"][slot] += 1
+    histogram["count"] += 1
+    histogram["sum_ms"] = round(histogram["sum_ms"] + value_ms, 3)
+
+
+def histogram_merge(into: Dict[str, Any], other: Dict[str, Any]) -> None:
+    """Fold ``other`` into ``into`` (same fixed edges by construction;
+    a rollup written under different edges merges by value re-binning
+    of its bucket midpoints — lossy but monotone)."""
+    if other.get("buckets_ms") == into["buckets_ms"]:
+        for i, count in enumerate(other.get("counts", ())):
+            if i < len(into["counts"]):
+                into["counts"][i] += int(count)
+    else:  # edge-set drift between versions: re-bin by midpoint
+        edges = other.get("buckets_ms") or []
+        lower = 0.0
+        for i, count in enumerate(other.get("counts", ())):
+            if not count:
+                continue
+            upper = edges[i] if i < len(edges) else lower * 2 or 1.0
+            midpoint = (lower + upper) / 2.0
+            for _ in range(int(count)):
+                histogram_add(into, midpoint)
+            into["count"] -= int(count)  # re-added below with the totals
+            into["sum_ms"] = round(into["sum_ms"] - midpoint * count, 3)
+            lower = upper
+    into["count"] += int(other.get("count", 0))
+    into["sum_ms"] = round(into["sum_ms"] + float(other.get("sum_ms", 0.0)), 3)
+
+
+def histogram_percentile(histogram: Dict[str, Any], q: float) -> float:
+    """Percentile estimate (ms) by linear interpolation inside the
+    containing bucket; the overflow bucket reports the top edge."""
+    total = histogram.get("count", 0)
+    if not total:
+        return 0.0
+    rank = q * total
+    edges = histogram["buckets_ms"]
+    cumulative = 0
+    lower = 0.0
+    for i, count in enumerate(histogram["counts"]):
+        if not count:
+            if i < len(edges):
+                lower = edges[i]
+            continue
+        if cumulative + count >= rank:
+            if i >= len(edges):
+                return round(lower, 3)
+            upper = edges[i]
+            inside = max(0.0, min(1.0, (rank - cumulative) / count))
+            return round(lower + (upper - lower) * inside, 3)
+        cumulative += count
+        if i < len(edges):
+            lower = edges[i]
+    return round(lower, 3)
+
+
+# -- sink discovery -----------------------------------------------------------
+
+
+_ROTATION_SUFFIX_RE = re.compile(r"\.(\d+)$")
+
+
+def is_worker_variant(name: str, base_name: str) -> bool:
+    """True when ``name`` is a per-worker variant of ``base_name``
+    (``serve_trace-<pid>.jsonl`` for ``serve_trace.jsonl``), rotation
+    suffix NOT included — THE one spelling of the worker-sink grammar
+    (``recorder.worker_sink_path`` writes it; this reads it; the
+    serializer's dropping predicate and the health-snapshot walk both
+    delegate here)."""
+    stem, ext = os.path.splitext(base_name)
+    return name.startswith(stem + "-") and name.endswith(ext)
+
+
+def sink_bases(directory: str, base_name: str) -> List[str]:
+    """Every base sink path in ``directory`` for one logical sink: the
+    shared spelling (``serve_trace.jsonl``) plus every per-worker
+    variant (``serve_trace-<pid>.jsonl``) — rotated generations ride
+    each base (``<base>.N``). A base whose live file is momentarily
+    absent (the writer's rotation renames it away and recreates it on
+    the next write) is still discovered through its generations."""
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return []
+    bases = set()
+    for entry in entries:
+        root = _ROTATION_SUFFIX_RE.sub("", entry)
+        if root == base_name or is_worker_variant(root, base_name):
+            bases.add(os.path.join(directory, root))
+    return sorted(bases)
+
+
+def generation_files(base_path: str) -> List[str]:
+    """All physical files of one sink, oldest first (``p.N`` ... ``p.1``,
+    then ``p``). Generations come from the directory listing, not a
+    ``while exists`` walk: mid-rotation the ``.1`` slot is briefly empty
+    while higher generations still hold bytes, and a probe walk would
+    go blind to all of them for the pass."""
+    directory, name = os.path.split(base_path)
+    try:
+        entries = os.listdir(directory or ".")
+    except OSError:
+        entries = []
+    generations = []
+    prefix = name + "."
+    for entry in entries:
+        if entry.startswith(prefix) and entry[len(prefix):].isdigit():
+            generations.append((int(entry[len(prefix):]), entry))
+    paths = [
+        os.path.join(directory, entry)
+        for _, entry in sorted(generations, reverse=True)
+    ]
+    if os.path.exists(base_path):
+        paths.append(base_path)
+    return paths
+
+
+def discover_sinks(directory: str) -> List[Tuple[str, str]]:
+    """``(kind, physical_path)`` for every trace file in ``directory``:
+    kind ``serve`` for request traces, ``build`` for build traces."""
+    found: List[Tuple[str, str]] = []
+    for kind, base_name in (
+        ("serve", SERVE_TRACE_FILE),
+        ("build", BUILD_TRACE_FILE),
+    ):
+        for base in sink_bases(directory, base_name):
+            for path in generation_files(base):
+                found.append((kind, path))
+    return found
+
+
+_WORKER_PID_RE = re.compile(r"-(\d+)$")
+
+
+def _worker_pid(name: str, base_name: str) -> Optional[int]:
+    """The pid baked into a worker-variant sink name, or None for the
+    shared spelling."""
+    if not is_worker_variant(name, base_name):
+        return None
+    stem, _ = os.path.splitext(name)
+    match = _WORKER_PID_RE.search(stem)
+    return int(match.group(1)) if match else None
+
+
+def _pid_alive(pid: int) -> bool:
+    """Best-effort liveness probe (signal 0). Unknown errors count as
+    alive — deleting a live worker's sink is the only unsafe answer."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True
+    return True
+
+
+def _signature_from_head(head: bytes) -> Optional[str]:
+    """The content identity from a sink's first bytes, or None when the
+    file has no COMPLETE first line yet. The basis is the first line
+    (newline inclusive), capped at 256 bytes: append-only files never
+    change it, so the signature is stable across the file's whole life.
+    Hashing a raw 256-byte prefix is NOT — a file whose only line is
+    shorter than 256 bytes would change identity when line two lands,
+    orphaning its saved offset and double-folding line one."""
+    if not head:
+        return "empty"
+    newline = head.find(b"\n")
+    if newline != -1:
+        head = head[: newline + 1]
+    elif len(head) < 256:
+        # a torn, still-growing first line: nothing complete to read,
+        # and any prefix hash would be unstable — identify it next pass
+        return None
+    return hashlib.sha1(head).hexdigest()[:20]
+
+
+def file_signature(path: str) -> Optional[str]:
+    """A content identity for resume offsets that survives rotation:
+    the hash of the file's first line (span lines carry random ids, so
+    it is unique per file — see :func:`_signature_from_head`). Rotation
+    renames the file but keeps its bytes, so the signature follows
+    them. None when the file is gone or holds no complete line yet;
+    empty files share the ``empty`` signature (offset 0 anyway)."""
+    try:
+        with open(path, "rb") as handle:
+            head = handle.read(256)
+    except OSError:
+        return None
+    return _signature_from_head(head)
+
+
+# -- the rollup reducer -------------------------------------------------------
+
+
+def _empty_rollup(start: int, seconds: int) -> Dict[str, Any]:
+    return {
+        "version": 1,
+        "window": {
+            "start": start,
+            "seconds": seconds,
+            "start_iso": datetime.fromtimestamp(
+                start, timezone.utc
+            ).isoformat(),
+        },
+        "requests": {
+            "count": 0,
+            "errors": 0,
+            "by_class": {"2xx": 0, "3xx": 0, "4xx": 0, "5xx": 0},
+        },
+        "latency_ms": new_histogram(),
+        "stages": {},
+        "machines": {},
+        "build": {"device_programs": 0, "compiles": 0, "phases": {}},
+        "spans": 0,
+    }
+
+
+def merge_rollups(into: Dict[str, Any], other: Dict[str, Any]) -> Dict[str, Any]:
+    """Fold rollup ``other`` into ``into`` (same window or a wider
+    aggregate — counts add, histograms merge). Returns ``into``."""
+    requests = into["requests"]
+    other_requests = other.get("requests") or {}
+    requests["count"] += int(other_requests.get("count", 0))
+    requests["errors"] += int(other_requests.get("errors", 0))
+    for klass, count in (other_requests.get("by_class") or {}).items():
+        requests["by_class"][klass] = (
+            requests["by_class"].get(klass, 0) + int(count)
+        )
+    if other.get("latency_ms"):
+        histogram_merge(into["latency_ms"], other["latency_ms"])
+    for stage, histogram in (other.get("stages") or {}).items():
+        mine = into["stages"].setdefault(stage, new_histogram())
+        histogram_merge(mine, histogram)
+    for machine, counts in (other.get("machines") or {}).items():
+        mine = into["machines"].setdefault(
+            machine, {"requests": 0, "errors": 0}
+        )
+        mine["requests"] += int(counts.get("requests", 0))
+        mine["errors"] += int(counts.get("errors", 0))
+    build = into["build"]
+    other_build = other.get("build") or {}
+    build["device_programs"] += int(other_build.get("device_programs", 0))
+    build["compiles"] += int(other_build.get("compiles", 0))
+    for phase, count in (other_build.get("phases") or {}).items():
+        build["phases"][phase] = build["phases"].get(phase, 0) + int(count)
+    into["spans"] = int(into.get("spans", 0)) + int(other.get("spans", 0))
+    return into
+
+
+def _fold_span(rollup: Dict[str, Any], kind: str, span: Dict[str, Any]) -> None:
+    """One span into one window rollup."""
+    rollup["spans"] += 1
+    name = span.get("name", "")
+    duration_ms = float(span.get("duration_ms", 0.0) or 0.0)
+    if kind == "build":
+        build = rollup["build"]
+        if name == "device_program":
+            build["device_programs"] += 1
+            if (span.get("attributes") or {}).get("compile"):
+                build["compiles"] += 1
+        elif name == "build_phase":
+            phase = str((span.get("attributes") or {}).get("phase", "?"))
+            build["phases"][phase] = build["phases"].get(phase, 0) + 1
+        return
+    if span.get("kind") == "event":
+        return
+    if name == "request":
+        attributes = span.get("attributes") or {}
+        requests = rollup["requests"]
+        requests["count"] += 1
+        try:
+            status = int(attributes.get("http.status_code", 0))
+        except (TypeError, ValueError):
+            status = 0
+        klass = f"{status // 100}xx" if 200 <= status < 600 else "2xx"
+        requests["by_class"][klass] = requests["by_class"].get(klass, 0) + 1
+        error = status >= 500
+        if error:
+            requests["errors"] += 1
+        histogram_add(rollup["latency_ms"], duration_ms)
+        machine = str(attributes.get("gordo_name") or "")
+        if machine:
+            record = rollup["machines"].setdefault(
+                machine, {"requests": 0, "errors": 0}
+            )
+            record["requests"] += 1
+            if error:
+                record["errors"] += 1
+    elif name not in _NON_STAGE_NAMES and span.get("parent_id"):
+        stage = rollup["stages"].setdefault(name, new_histogram())
+        histogram_add(stage, duration_ms)
+
+
+class RollupStore:
+    """Incremental reducer + rollup persistence for one directory.
+
+    Thread-safe per instance; distinct processes aggregating the same
+    directory are safe too (atomic artifact replaces; at worst two
+    concurrent reducers fold the same new spans — the per-file offsets
+    are re-read under the instance lock and rollup updates are
+    last-writer-wins per window, so the drill below pins single-reducer
+    exactness and multi-reducer convergence is advisory)."""
+
+    def __init__(self, directory: str, seconds: Optional[int] = None):
+        self.directory = os.path.normpath(directory)
+        self.rollup_dir = os.path.join(self.directory, ROLLUP_DIR)
+        self.state_path = os.path.join(self.rollup_dir, ROLLUP_STATE_FILE)
+        self.seconds = int(seconds) if seconds else window_seconds()
+        self._lock = threading.Lock()
+        #: bumped whenever a rollup file changes (fold or prune) — the
+        #: merge cache's invalidation token
+        self._version = 0
+        #: (since, until, version) -> merged doc; re-polling an
+        #: unchanged corpus (scrape refresh over an idle service) must
+        #: not re-read every rollup file. Busy dirs still pay one full
+        #: window walk per refresh once the corpus spans weeks — the
+        #: known scaling edge; coarser rollup tiers are the multi-host
+        #: roadmap item's follow-up.
+        self._merged_cache: Dict[Tuple[Any, Any, int], Dict[str, Any]] = {}
+
+    # -- paths / IO ---------------------------------------------------------
+
+    def window_start(self, ts: float) -> int:
+        return int(ts // self.seconds) * self.seconds
+
+    def rollup_path(self, start: int) -> str:
+        return os.path.join(self.rollup_dir, f"{int(start)}.json")
+
+    def _load_json(self, path: str) -> Optional[Any]:
+        try:
+            with open(path) as handle:
+                return json.load(handle)
+        except (OSError, ValueError):
+            return None
+
+    def _write_json(self, path: str, doc: Any) -> None:
+        # stage + os.replace in this function: the atomic-write contract
+        # for telemetry artifacts (a crash mid-dump must never leave a
+        # half-written rollup where the SLO engine would read it)
+        tmp = os.path.join(
+            os.path.dirname(path),
+            f".{os.path.basename(path)}.tmp-{os.getpid()}",
+        )
+        with open(tmp, "w") as handle:
+            json.dump(doc, handle, sort_keys=True)
+        os.replace(tmp, path)
+
+    # -- aggregation --------------------------------------------------------
+
+    def aggregate(self) -> Dict[str, Any]:
+        """Fold every unread span in the directory's sinks into the
+        window rollups. Returns a summary (spans read, windows touched,
+        files visited). Incremental: per-file offsets resume by content
+        signature, so an unchanged corpus costs a stat-walk."""
+        with self._lock:
+            return self._aggregate_locked()
+
+    def _aggregate_locked(self) -> Dict[str, Any]:
+        os.makedirs(self.rollup_dir, exist_ok=True)
+        state = self._load_json(self.state_path)
+        previous: Dict[str, Dict[str, Any]] = (
+            dict(state.get("files") or {}) if isinstance(state, dict) else {}
+        )
+        # rebuilt per pass. Signatures NOT visited this pass are carried
+        # forward for a few passes before they are dropped ("misses"
+        # counter): a writer's mid-rotation rename can hide a file for
+        # one walk, and forgetting its offset would re-read its bytes —
+        # the exact double-count this reducer exists to prevent. Files
+        # gone for good (the keep policy deleted them) age out, so the
+        # state cannot grow without bound either.
+        files: Dict[str, Dict[str, Any]] = {}
+        seen_ids: set = set()
+        windows: Dict[int, Dict[str, Any]] = {}
+        spans_read = 0
+        visited = 0
+        for kind, path in discover_sinks(self.directory):
+            # signature and read share ONE file descriptor: computing
+            # the signature by path and reopening would race the
+            # writer's rotation — the old signature's offset would bind
+            # to the freshly-created file and both chains would corrupt
+            result = self._read_file(
+                kind, path, previous, files, seen_ids, windows
+            )
+            if result is None:
+                continue
+            visited += 1
+            signature, offset = result
+            spans_read += offset["spans"]
+            files[signature] = {"offset": offset["offset"], "path": path}
+        for signature, entry in previous.items():
+            if signature in files:
+                continue
+            misses = int(entry.get("misses", 0)) + 1
+            if misses <= 8:
+                files[signature] = {**entry, "misses": misses}
+        # window rollups land BEFORE the offsets: a crash between the
+        # two atomic writes re-reads (and re-folds) the tail once — the
+        # deliberate at-least-once choice, because the alternative
+        # ordering silently DROPS spans, and an alerting pipeline must
+        # fail toward noticing errors, never toward missing them
+        updated = self._persist_windows(windows)
+        pruned = self._prune()
+        sinks_pruned = self._prune_dead_worker_sinks(files)
+        if updated or pruned:
+            self._version += 1
+            self._merged_cache.clear()
+        self._write_json(
+            self.state_path,
+            {
+                "version": 1,
+                "seconds": self.seconds,
+                "files": files,
+            },
+        )
+        return {
+            "spans_read": spans_read,
+            "files_visited": visited,
+            "windows_updated": sorted(updated),
+            "rollups_pruned": pruned,
+            "worker_sinks_pruned": sinks_pruned,
+        }
+
+    def _prune_dead_worker_sinks(
+        self, files: Dict[str, Dict[str, Any]]
+    ) -> int:
+        """Delete trace sinks of DEAD workers once fully consumed and
+        cold.
+
+        Worker recycling (gunicorn --max-requests) mints a fresh
+        ``serve_trace-<pid>.jsonl`` chain per worker lifetime; nothing
+        else ever deletes the old pids' chains, so a months-lived
+        deployment accumulates sinks (each with its own rotation KEEP
+        budget) without bound. A chain is removed only when (a) its pid
+        no longer exists, (b) every byte of every generation is already
+        folded into the rollups — the reducer is the sink's only
+        consumer with the offsets to prove that — and (c) nothing has
+        written it for ``GORDO_TPU_SLO_SINK_GC_AGE`` (the pid probe is
+        blind across pid namespaces/hosts, so a *quiet day* is required
+        evidence too; set the knob to 0 there to disable GC outright —
+        and the writers re-open a sink deleted under them anyway, see
+        ``SpanRecorder``'s unlink check). Health snapshots
+        (``fleet_health-<pid>.json``) are NOT touched: they are tiny,
+        and deleting one would erase that worker's counts from every
+        future merge."""
+        from ..utils.env import env_float
+
+        age_s = env_float(SINK_GC_AGE_ENV, DEFAULT_SINK_GC_AGE)
+        age_s = DEFAULT_SINK_GC_AGE if age_s is None else age_s
+        if age_s <= 0:
+            return 0
+        consumed_to: Dict[str, int] = {
+            entry["path"]: int(entry.get("offset", 0))
+            for entry in files.values()
+            if entry.get("path")
+        }
+        now = time.time()
+        removed = 0
+        for base_name in (SERVE_TRACE_FILE, BUILD_TRACE_FILE):
+            for base in sink_bases(self.directory, base_name):
+                pid = _worker_pid(os.path.basename(base), base_name)
+                if pid is None or pid == os.getpid() or _pid_alive(pid):
+                    continue
+                chain = generation_files(base)
+                removable = True
+                for path in chain:
+                    try:
+                        stat = os.stat(path)
+                    except OSError:
+                        continue
+                    if (
+                        stat.st_size > consumed_to.get(path, 0)
+                        or now - stat.st_mtime < age_s
+                    ):
+                        removable = False
+                        break
+                if not removable:
+                    continue
+                for path in chain:
+                    try:
+                        os.remove(path)
+                        removed += 1
+                    except OSError:
+                        pass
+        return removed
+
+    def _read_file(
+        self,
+        kind: str,
+        path: str,
+        previous: Dict[str, Dict[str, Any]],
+        files: Dict[str, Dict[str, Any]],
+        seen_ids: set,
+        windows: Dict[int, Dict[str, Any]],
+    ) -> Optional[Tuple[str, Dict[str, Any]]]:
+        """Open ``path`` once, identify it by content signature from the
+        SAME descriptor, resume at the signature's saved offset and fold
+        every complete new line. Returns ``(signature, {spans, offset})``
+        or None when the file vanished. The descriptor is the identity
+        anchor: once open, the writer renaming the path cannot swap a
+        different file's bytes under the saved offset."""
+        try:
+            handle = open(path, "rb")
+        except OSError:
+            return None
+        with handle:
+            head = handle.read(256)
+            if not head:
+                return ("empty", {"spans": 0, "offset": 0})
+            signature = _signature_from_head(head)
+            if signature is None:
+                # no complete first line yet — nothing foldable either
+                return None
+            entry = previous.get(signature) or files.get(signature) or {}
+            offset = int(entry.get("offset", 0))
+            spans = 0
+            try:
+                size = os.fstat(handle.fileno()).st_size
+                if size <= offset:
+                    # fully consumed (rotated generations are immutable,
+                    # the live file simply has nothing new)
+                    return (signature, {"spans": 0, "offset": offset})
+                handle.seek(offset)
+                # byte positions are tracked by hand: BufferedReader.tell()
+                # costs ~40us and a per-line tell() was 40% of the whole
+                # aggregation pass
+                position = offset
+                while True:
+                    line = handle.readline()
+                    if not line:
+                        break
+                    if not line.endswith(b"\n"):
+                        # a torn tail the writer is mid-appending: leave
+                        # the offset BEFORE it so the next pass rereads
+                        # the completed line exactly once
+                        return (signature, {"spans": spans, "offset": position})
+                    position += len(line)
+                    text = line.strip()
+                    if not text:
+                        continue
+                    try:
+                        span = json.loads(text.decode("utf-8", "replace"))
+                    except ValueError:
+                        continue
+                    if not isinstance(span, dict) or "name" not in span:
+                        continue
+                    context = span.get("context") or {}
+                    span_key = (
+                        context.get("trace_id", ""),
+                        context.get("span_id", ""),
+                    )
+                    if span_key != ("", ""):
+                        if span_key in seen_ids:
+                            continue  # duplicated across sinks/generations
+                        seen_ids.add(span_key)
+                    ts = parse_span_time(span.get("end_time"))
+                    if ts is None:
+                        continue
+                    start = self.window_start(ts)
+                    rollup = windows.get(start)
+                    if rollup is None:
+                        rollup = windows[start] = _empty_rollup(
+                            start, self.seconds
+                        )
+                    _fold_span(rollup, kind, span)
+                    spans += 1
+                return (signature, {"spans": spans, "offset": position})
+            except OSError:
+                return (signature, {"spans": spans, "offset": offset})
+
+    def _persist_windows(self, windows: Dict[int, Dict[str, Any]]) -> List[int]:
+        updated = []
+        for start, delta in windows.items():
+            path = self.rollup_path(start)
+            existing = self._load_json(path)
+            if isinstance(existing, dict) and existing.get("window"):
+                merged = merge_rollups(existing, delta)
+                # merge_rollups adds counts into `existing` in place but
+                # leaves its fixed window header intact
+                doc = merged
+            else:
+                doc = delta
+            self._write_json(path, doc)
+            updated.append(start)
+        return updated
+
+    def _prune(self) -> int:
+        keep = rollup_keep()
+        try:
+            entries = sorted(
+                entry
+                for entry in os.listdir(self.rollup_dir)
+                if entry.endswith(".json")
+                and entry[: -len(".json")].isdigit()
+            )
+        except OSError:
+            return 0
+        doomed = entries[:-keep] if len(entries) > keep else []
+        for entry in doomed:
+            try:
+                os.remove(os.path.join(self.rollup_dir, entry))
+            except OSError:
+                pass
+        return len(doomed)
+
+    # -- reading back -------------------------------------------------------
+
+    def windows(
+        self,
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Persisted rollups whose window overlaps [since, until],
+        oldest first."""
+        try:
+            entries = sorted(
+                entry
+                for entry in os.listdir(self.rollup_dir)
+                if entry.endswith(".json")
+                and entry[: -len(".json")].isdigit()
+            )
+        except OSError:
+            return
+        for entry in entries:
+            start = int(entry[: -len(".json")])
+            if since is not None and start + self.seconds <= since:
+                continue
+            if until is not None and start >= until:
+                continue
+            doc = self._load_json(os.path.join(self.rollup_dir, entry))
+            if isinstance(doc, dict) and doc.get("window"):
+                yield doc
+
+    def merged(
+        self,
+        since: Optional[float] = None,
+        until: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """One aggregate rollup over every window in [since, until]
+        (the SLO engine's unit of evaluation). Cached per (bounds,
+        corpus version): repeated evaluations over an unchanged corpus
+        cost a dict lookup, not a re-read of every rollup file. Bounds
+        quantize to the window grid — two calls in the same window see
+        the same window set."""
+        key = (
+            self.window_start(since) if since is not None else None,
+            self.window_start(until) if until is not None else None,
+            self._version,
+        )
+        cached = self._merged_cache.get(key)
+        if cached is not None:
+            return json.loads(json.dumps(cached))
+        merged = _empty_rollup(int(since or 0), self.seconds)
+        count = 0
+        for rollup in self.windows(since=since, until=until):
+            merge_rollups(merged, rollup)
+            count += 1
+        merged["window"]["merged_windows"] = count
+        if since is not None:
+            merged["window"]["since"] = int(since)
+        if until is not None:
+            merged["window"]["until"] = int(until)
+        if len(self._merged_cache) > 64:
+            self._merged_cache.clear()
+        self._merged_cache[key] = json.loads(json.dumps(merged))
+        return merged
+
+
+# -- the per-directory store registry -----------------------------------------
+
+_stores_lock = threading.Lock()
+_stores: Dict[Tuple[str, int], "RollupStore"] = {}
+
+
+def store_for(directory: str, seconds: Optional[int] = None) -> RollupStore:
+    """The (create-once) :class:`RollupStore` for a directory. A
+    store's instance lock is what serializes concurrent aggregation —
+    a scrape-thread evaluation racing a /slo route evaluation through
+    two fresh instances would each fold the same new spans into the
+    same window rollup (last-writer-wins would keep BOTH folds).
+    Callers that want serialization must share the instance; this is
+    the one place they get it."""
+    key = (os.path.normpath(directory), int(seconds) if seconds else window_seconds())
+    store = _stores.get(key)
+    if store is not None:
+        return store
+    with _stores_lock:
+        store = _stores.get(key)
+        if store is None:
+            store = _stores[key] = RollupStore(key[0], seconds=key[1])
+    return store
+
+
+def summarize_rollup(rollup: Dict[str, Any]) -> Dict[str, Any]:
+    """The headline numbers of one (merged) rollup: request/error
+    counts, latency percentiles, per-stage p50/p95, worst machines."""
+    requests = rollup.get("requests") or {}
+    count = int(requests.get("count", 0))
+    errors = int(requests.get("errors", 0))
+    latency = rollup.get("latency_ms") or new_histogram()
+    stages = {
+        name: {
+            "count": histogram.get("count", 0),
+            "p50_ms": histogram_percentile(histogram, 0.50),
+            "p95_ms": histogram_percentile(histogram, 0.95),
+        }
+        for name, histogram in sorted((rollup.get("stages") or {}).items())
+    }
+    machines = {
+        name: {
+            **counts,
+            "error_rate": round(
+                counts.get("errors", 0) / counts["requests"], 6
+            )
+            if counts.get("requests")
+            else 0.0,
+        }
+        for name, counts in sorted((rollup.get("machines") or {}).items())
+    }
+    return {
+        "requests": count,
+        "errors": errors,
+        "error_rate": round(errors / count, 6) if count else 0.0,
+        "latency_p50_ms": histogram_percentile(latency, 0.50),
+        "latency_p95_ms": histogram_percentile(latency, 0.95),
+        "latency_p99_ms": histogram_percentile(latency, 0.99),
+        "stages": stages,
+        "machines": machines,
+        "build": rollup.get("build"),
+        "spans": rollup.get("spans", 0),
+    }

@@ -119,6 +119,26 @@ GATES: Dict[str, List[MetricSpec]] = {
             0.5,
         ),
     ],
+    "slo-engine": [
+        MetricSpec(
+            "rollup aggregation throughput (spans/s)",
+            "aggregate_spans_per_sec",
+            "higher",
+            0.5,
+        ),
+        MetricSpec(
+            "steady-state SLO evaluation overhead vs telemetry-on "
+            "floor (%)",
+            "overhead_pct",
+            "max_bound",
+            bound=2.0,
+        ),
+        MetricSpec(
+            "burn drill: pending -> firing -> resolved",
+            "drill_ok",
+            "truthy",
+        ),
+    ],
 }
 
 #: where each bench kind's committed baseline lives (repo root)
@@ -129,6 +149,7 @@ BASELINE_FILES: Dict[str, str] = {
     "planner-strategies": "BENCH_PLAN.json",
     "lifecycle-hot-swap": "BENCH_LIFECYCLE.json",
     "fleet-health-overhead": "BENCH_FLEET_HEALTH.json",
+    "slo-engine": "BENCH_SLO.json",
 }
 
 

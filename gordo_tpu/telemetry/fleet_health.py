@@ -42,7 +42,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from .recorder import _iso, enabled
+from .recorder import _iso, enabled, worker_sink_path
 
 logger = logging.getLogger(__name__)
 
@@ -254,12 +254,21 @@ class FleetHealthLedger:
         self.directory = (
             os.path.normpath(directory) if directory is not None else None
         )
+        # under a multi-worker server every process snapshots its OWN
+        # `fleet_health-<pid>.json` — N workers atomically replacing one
+        # shared path were silently overwriting each other's counts;
+        # readers merge the variants (load_merged_health)
         self.path = (
-            os.path.join(self.directory, FLEET_HEALTH_FILE)
+            worker_sink_path(os.path.join(self.directory, FLEET_HEALTH_FILE))
             if self.directory is not None
             else None
         )
         self.project = project
+        #: the process that built this ledger — ledger_for() compares it
+        #: so a child forked AFTER construction (gunicorn --preload)
+        #: rebuilds with its own pid-suffixed snapshot path instead of
+        #: inheriting the parent's and clobbering it from N workers
+        self._pid = os.getpid()
         from ..utils.env import env_float, env_int
 
         self.heartbeat_seconds = max(
@@ -572,14 +581,23 @@ def ledger_for(directory: str, project: str = "") -> Any:
         return NULL_LEDGER
     key = os.path.normpath(directory)
     ledger = _ledgers.get(key)
-    if ledger is not None:
+    if ledger is not None and ledger._pid == os.getpid():
         return ledger
     with _registry_lock:
         ledger = _ledgers.get(key)
+        if ledger is not None and ledger._pid != os.getpid():
+            # inherited across a fork: the snapshot path froze the
+            # PARENT's pid, so every child writing through it would
+            # clobber one shared file — exactly the collision the
+            # worker-sink split exists to prevent. Rebuild per process.
+            ledger = None
         if ledger is None:
             ledger = FleetHealthLedger(directory=key, project=project)
-            persisted = load_health(key)
-            if persisted is not None:
+            # restore from the ledger's OWN snapshot path (pid-suffixed
+            # under worker sinks): adopting another worker's snapshot
+            # would double its counts once readers merge the variants
+            persisted = _load_json(ledger.path) if ledger.path else None
+            if isinstance(persisted, dict):
                 ledger.restore(persisted)
             _ledgers[key] = ledger
     return ledger
@@ -603,6 +621,158 @@ def load_health(directory: str) -> Optional[Dict[str, Any]]:
     """The persisted ``fleet_health.json`` from ``directory`` (or None)."""
     doc = _load_json(os.path.join(directory, FLEET_HEALTH_FILE))
     return doc if isinstance(doc, dict) else None
+
+
+def health_snapshot_paths(directory: str) -> List[str]:
+    """Every persisted health snapshot in ``directory``: the shared
+    ``fleet_health.json`` plus per-worker ``fleet_health-<pid>.json``
+    variants (one grammar: ``aggregate.is_worker_variant``), sorted for
+    determinism."""
+    from .aggregate import is_worker_variant
+
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return []
+    return [
+        os.path.join(directory, entry)
+        for entry in sorted(entries)
+        if entry == FLEET_HEALTH_FILE
+        or is_worker_variant(entry, FLEET_HEALTH_FILE)
+    ]
+
+
+def _newest(records: List[Dict[str, Any]], stamp_key: str) -> Dict[str, Any]:
+    """The record with the greatest ISO timestamp at ``stamp_key``
+    (records with no stamp lose to any stamped one; ties keep the
+    later-listed, i.e. the live document's)."""
+    best = records[0]
+    best_stamp = str(best.get(stamp_key) or "")
+    for record in records[1:]:
+        stamp = str(record.get(stamp_key) or "")
+        if stamp >= best_stamp:
+            best, best_stamp = record, stamp
+    return best
+
+
+#: per-section timestamp used to pick the authoritative worker for the
+#: non-additive machine sections (state, not counts)
+_SECTION_STAMPS = {
+    "drift": "evaluated_at",
+    "build": "built_at",
+    "quarantine": "since",
+}
+
+
+def merge_health_documents(
+    docs: List[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """
+    One fleet-health document out of N per-worker snapshots:
+
+    - **serving counts are summed** (requests/errors/rows — each worker
+      saw a disjoint slice of the traffic, so the fleet totals are the
+      sums; the RED regression test pins aggregated == Σ per-worker);
+    - the residual mean is the row-weighted mean of the workers' means;
+    - **state sections** (drift verdicts, build provenance, quarantine)
+      are not additive — the record with the newest section timestamp
+      wins (every worker that observed the transition wrote the same
+      facts, the newest is simply the most current);
+    - derived health (score/state) and the bounded summary are
+      recomputed over the merged records.
+    """
+    docs = [
+        doc
+        for doc in docs
+        if isinstance(doc, dict) and isinstance(doc.get("machines"), dict)
+    ]
+    if not docs:
+        return None
+    merged_machines: Dict[str, Dict[str, Any]] = {}
+    by_machine: Dict[str, List[Dict[str, Any]]] = {}
+    for doc in docs:
+        for name, record in doc["machines"].items():
+            if isinstance(record, dict):
+                by_machine.setdefault(str(name), []).append(record)
+    for name, records in by_machine.items():
+        machine = _new_machine()
+        serving = machine["serving"]
+        weighted_residual = 0.0
+        residual_rows = 0
+        for record in records:
+            incoming = record.get("serving") or {}
+            serving["requests"] += int(incoming.get("requests") or 0)
+            serving["errors"] += int(incoming.get("errors") or 0)
+            rows = int(incoming.get("rows") or 0)
+            serving["rows"] += rows
+            residual = incoming.get("residual_mean")
+            if residual is not None and rows > 0:
+                weighted_residual += float(residual) * rows
+                residual_rows += rows
+            stamp = incoming.get("last_request_at")
+            if stamp and str(stamp) > str(serving["last_request_at"] or ""):
+                serving["last_request_at"] = stamp
+        if residual_rows:
+            serving["residual_mean"] = round(
+                weighted_residual / residual_rows, 8
+            )
+        for section, stamp_key in _SECTION_STAMPS.items():
+            candidates = [
+                record[section]
+                for record in records
+                if isinstance(record.get(section), dict)
+            ]
+            if candidates:
+                chosen = _newest(candidates, stamp_key)
+                for key in machine[section]:
+                    if key in chosen:
+                        machine[section][key] = chosen[key]
+        machine["health"] = {
+            "score": health_score(machine),
+            "state": machine_state(machine),
+        }
+        merged_machines[name] = machine
+    newest_doc = _newest(docs, "updated_at")
+    merged: Dict[str, Any] = {
+        "version": 1,
+        "project": newest_doc.get("project", ""),
+        "updated_at": newest_doc.get("updated_at"),
+        "workers_merged": len(docs),
+        "machines": merged_machines,
+        "summary": summarize(merged_machines),
+    }
+    accuracy = [
+        doc["plan_accuracy"]
+        for doc in docs
+        if isinstance(doc.get("plan_accuracy"), dict)
+    ]
+    if accuracy:
+        merged["plan_accuracy"] = accuracy[-1]
+    return merged
+
+
+def load_merged_health(
+    directory: str,
+    live_documents: Optional[List[Dict[str, Any]]] = None,
+    exclude_paths: Optional[List[str]] = None,
+) -> Optional[Dict[str, Any]]:
+    """The merged health view over every snapshot in ``directory``,
+    optionally folding in live in-process documents — whose own snapshot
+    paths go in ``exclude_paths`` so a worker's counts never merge with
+    its own persisted copy (see :func:`fleet_status_document`)."""
+    docs = list(live_documents or [])
+    excluded = {os.path.normpath(p) for p in (exclude_paths or [])}
+    for path in health_snapshot_paths(directory):
+        if os.path.normpath(path) in excluded:
+            continue
+        doc = _load_json(path)
+        if isinstance(doc, dict):
+            docs.append(doc)
+    if len(docs) == 1:
+        only = docs[0]
+        if "machines" in only and "summary" in only:
+            return only
+    return merge_health_documents(docs)
 
 
 # -- the joined fleet-status surface -----------------------------------------
@@ -651,12 +821,16 @@ def fleet_status_document(
     doc["build"] = load_status(directory)
 
     plan = _load_json(os.path.join(directory, "fleet_plan.json"))
+    # the health view is a MERGE: this process's live ledger (its own
+    # snapshot path excluded — a worker must not double-count with its
+    # persisted copy) plus every other worker's fleet_health-<pid>.json
     health_doc: Optional[Dict[str, Any]]
     ledger = _ledgers.get(directory)
-    if ledger is not None:
-        health_doc = ledger.document()
-    else:
-        health_doc = load_health(directory)
+    live_docs = [ledger.document()] if ledger is not None else []
+    own_paths = [ledger.path] if ledger is not None and ledger.path else []
+    health_doc = load_merged_health(
+        directory, live_documents=live_docs, exclude_paths=own_paths
+    )
     if isinstance(plan, dict):
         doc["plan"] = {
             "strategy": plan.get("strategy"),
@@ -692,8 +866,19 @@ def fleet_status_document(
             "machines": health_doc.get("machines"),
             "updated_at": health_doc.get("updated_at"),
         }
+        if health_doc.get("workers_merged"):
+            doc["health"]["workers_merged"] = health_doc["workers_merged"]
     else:
         doc["health"] = None
+    # the SLO verdict joins the console: alert states from the engine's
+    # persisted state machine (slo.py), summarized — budgets/burn rates
+    # live in the full `gordo-tpu slo status` / /slo route document.
+    # The state lives where the SINKS live (the configured telemetry
+    # dir when set, else this directory) — resolved exactly as the /slo
+    # route resolves it, so the two surfaces can never disagree
+    from .slo import slo_directory, slo_section
+
+    doc["slo"] = slo_section(slo_directory(directory) or directory)
     doc["device"] = device
     doc["programs"] = programs
     return doc
@@ -793,6 +978,19 @@ def render_fleet_status(doc: Dict[str, Any]) -> str:
             )
     else:
         lines.append("Health:    (no fleet_health.json)")
+    slo = doc.get("slo")
+    if slo:
+        firing = slo.get("firing", 0)
+        pending = slo.get("pending", 0)
+        verdict = "inside SLO" if slo.get("ok", True) else "BURNING"
+        lines.append(
+            f"SLO:       {verdict} — {firing} firing, {pending} pending "
+            f"alert(s)"
+        )
+        for name, remaining in sorted((slo.get("budgets") or {}).items()):
+            lines.append(
+                f"  {name}: {100.0 * float(remaining):.1f}% budget remaining"
+            )
     device = doc.get("device")
     if device:
         memory = device.get("memory")

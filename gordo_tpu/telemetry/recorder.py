@@ -57,6 +57,36 @@ KEEP_ENV = "GORDO_TPU_TELEMETRY_KEEP"
 DEFAULT_MAX_BYTES = 256 * 1024 * 1024
 DEFAULT_KEEP = 3
 
+#: per-process sink split: when on, process-owned telemetry sinks
+#: (``serve_trace.jsonl``, ``fleet_health.json``) get a ``-<pid>``
+#: suffix so N gunicorn workers stop clobbering one shared path — the
+#: aggregator (telemetry/aggregate.py) and every reader merge all
+#: variants. Defaults to ON exactly when a multi-worker deployment is
+#: already configured (``PROMETHEUS_MULTIPROC_DIR``, the same signal
+#: prometheus_client keys worker fan-in on); single-process servers and
+#: tests keep the unsuffixed spelling.
+WORKER_SINKS_ENV = "GORDO_TPU_WORKER_SINKS"
+
+
+def worker_sinks_enabled() -> bool:
+    from ..utils.env import env_bool
+
+    multi_worker = bool(
+        os.environ.get("PROMETHEUS_MULTIPROC_DIR")
+        or os.environ.get("prometheus_multiproc_dir")
+    )
+    return env_bool(WORKER_SINKS_ENV, multi_worker)
+
+
+def worker_sink_path(path: str) -> str:
+    """``serve_trace.jsonl`` -> ``serve_trace-<pid>.jsonl`` when worker
+    sinks are on (the suffix sits before the extension so rotated
+    generations keep their ``.N`` tail grammar)."""
+    if not worker_sinks_enabled():
+        return path
+    stem, ext = os.path.splitext(path)
+    return f"{stem}-{os.getpid()}{ext}"
+
 
 def enabled() -> bool:
     """Telemetry master switch: on unless ``GORDO_TPU_TELEMETRY`` is a
@@ -398,6 +428,7 @@ class SpanRecorder:
                 self._spans.append(span)
             if self.sink_path is not None and not self.async_sink:
                 try:
+                    self._ensure_sink_linked()
                     if self._sink is None:
                         self._sink = open(self.sink_path, "a")
                     self._sink.write(json.dumps(span, default=str) + "\n")
@@ -415,6 +446,36 @@ class SpanRecorder:
                 listener(span)
             except Exception:  # noqa: BLE001 - listeners are advisory too
                 pass
+
+    def _ensure_sink_linked(self) -> None:
+        """Drop a sink handle whose file no longer sits at the sink
+        path (an aggregator in another pid namespace garbage-collected
+        a sink it wrongly judged dead, or another process rotated a
+        shared path): appending through the orphaned fd would make
+        every later span invisible to all readers forever. Detection is
+        a path-stat vs fd-stat inode comparison, NOT ``st_nlink == 0``
+        — overlayfs (containers) keeps reporting nlink 1 for an
+        unlinked-but-open file. One stat pair per write/batch; the
+        caller reopens by path right after, so the next span starts a
+        fresh, discoverable file."""
+        if self._sink is None:
+            return
+        try:
+            handle_stat = os.fstat(self._sink.fileno())
+            try:
+                path_stat = os.stat(self.sink_path)
+            except OSError:
+                orphaned = True  # the path is simply gone
+            else:
+                orphaned = (
+                    path_stat.st_ino != handle_stat.st_ino
+                    or path_stat.st_dev != handle_stat.st_dev
+                )
+            if orphaned:
+                self._sink.close()
+                self._sink = None
+        except OSError:
+            self._sink = None
 
     # -- async sink (serving) -----------------------------------------------
 
@@ -472,6 +533,7 @@ class SpanRecorder:
             if not batch or self.sink_path is None:
                 return
             try:
+                self._ensure_sink_linked()
                 if self._sink is None:
                     self._sink = open(self.sink_path, "a")
                 self._sink.write(

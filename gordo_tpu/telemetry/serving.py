@@ -36,6 +36,7 @@ from .recorder import (
     SpanRecorder,
     enabled,
     rand_hex,
+    worker_sink_path,
 )
 
 #: the serving-side JSONL trace beside ``build_trace.jsonl`` — batch
@@ -102,7 +103,12 @@ def serve_trace_path() -> Optional[str]:
     trace_dir = env_str(TRACE_DIR_ENV, None)
     if not enabled() or not trace_dir:
         return None
-    return os.path.join(trace_dir, SERVE_TRACE_FILE)
+    # under a multi-worker server each process appends to its own
+    # `serve_trace-<pid>.jsonl` — N workers sharing one append-mode file
+    # interleave safely but RACE on rotation (two workers renaming the
+    # same generation chain drop each other's spans); readers merge the
+    # variants (trace_analysis.serve_trace_bases / the aggregator)
+    return worker_sink_path(os.path.join(trace_dir, SERVE_TRACE_FILE))
 
 
 def serve_recorder() -> Any:

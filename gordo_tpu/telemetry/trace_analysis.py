@@ -35,24 +35,73 @@ from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
 _NON_STAGE_NAMES = ("request", "profile")
 
 
+def trace_bases(directory: str, base_name: str) -> List[str]:
+    """Every base sink path for one logical trace in ``directory``: the
+    shared spelling plus the per-worker ``<stem>-<pid>`` variants the
+    worker-sink split writes (rotated generations ride each base)."""
+    from .aggregate import sink_bases
+
+    return sink_bases(directory, base_name)
+
+
+def iter_trace_files(
+    path: str,
+    include_rotated: bool = True,
+    since_ts: Optional[float] = None,
+) -> List[str]:
+    """The physical files of one trace sink, oldest first — the rollup
+    reader's generation discovery (``aggregate.generation_files``, a
+    directory listing rather than a ``.1``-exists probe walk: mid-
+    rotation the ``.1`` slot is briefly empty while higher generations
+    still hold bytes, and a probe walk goes blind to the whole chain).
+    With ``since_ts``, rotated generations whose mtime predates it are
+    skipped wholesale — a generation's mtime is its LAST write, so
+    every span in it is older than the cutoff. This is what keeps
+    ``gordo-tpu trace --since`` from re-parsing a week-old 256MiB
+    corpus."""
+    from .aggregate import generation_files
+
+    if include_rotated:
+        paths = generation_files(path)
+    else:
+        paths = [path] if os.path.exists(path) else []
+    if since_ts is None:
+        return paths
+    kept = []
+    for trace_path in paths:
+        if trace_path != path:  # the live file always stays
+            try:
+                if os.path.getmtime(trace_path) < since_ts:
+                    continue
+            except OSError:
+                continue
+        kept.append(trace_path)
+    return kept
+
+
+def _span_end_ts(span: dict) -> Optional[float]:
+    from .aggregate import parse_span_time
+
+    return parse_span_time(span.get("end_time"))
+
+
 def read_trace(
-    path: str, include_rotated: bool = True
+    path: str,
+    include_rotated: bool = True,
+    since_ts: Optional[float] = None,
+    until_ts: Optional[float] = None,
 ) -> Iterator[dict]:
     """Yield span dicts from a JSONL trace file, oldest first across
     rotated generations (``p.N`` ... ``p.1``, then ``p``). Unparseable
-    lines (a crash mid-write leaves at most one) are skipped."""
-    paths: List[str] = []
-    if include_rotated:
-        generation = 1
-        rotated = []
-        while os.path.exists(f"{path}.{generation}"):
-            rotated.append(f"{path}.{generation}")
-            generation += 1
-        paths.extend(reversed(rotated))
-    if os.path.exists(path):
-        paths.append(path)
-    for trace_path in paths:
-        with open(trace_path) as handle:
+    lines (a crash mid-write leaves at most one) are skipped. With a
+    time window, spans ending outside [since_ts, until_ts] are dropped
+    and pre-cutoff generations are never opened at all."""
+    for trace_path in iter_trace_files(path, include_rotated, since_ts):
+        try:
+            handle = open(trace_path)
+        except OSError:
+            continue  # rotated away between discovery and open
+        with handle:
             for line in handle:
                 line = line.strip()
                 if not line:
@@ -61,8 +110,37 @@ def read_trace(
                     span = json.loads(line)
                 except ValueError:
                     continue
-                if isinstance(span, dict) and "name" in span:
-                    yield span
+                if not (isinstance(span, dict) and "name" in span):
+                    continue
+                if since_ts is not None or until_ts is not None:
+                    end_ts = _span_end_ts(span)
+                    if end_ts is None:
+                        continue
+                    if since_ts is not None and end_ts < since_ts:
+                        continue
+                    if until_ts is not None and end_ts > until_ts:
+                        continue
+                yield span
+
+
+def read_traces(
+    paths: List[str],
+    since_ts: Optional[float] = None,
+    until_ts: Optional[float] = None,
+) -> Iterator[dict]:
+    """Spans from several sink bases (N workers' traces), deduplicated
+    by ``(trace_id, span_id)`` — the merge contract shared with the
+    rollup reducer."""
+    seen: set = set()
+    for path in paths:
+        for span in read_trace(path, since_ts=since_ts, until_ts=until_ts):
+            context = span.get("context") or {}
+            key = (context.get("trace_id", ""), context.get("span_id", ""))
+            if key != ("", ""):
+                if key in seen:
+                    continue
+                seen.add(key)
+            yield span
 
 
 def percentile(values: List[float], q: float) -> float:
@@ -235,18 +313,29 @@ def top_profile_frames(
     ]
 
 
-def analyze_trace(path: str) -> Dict[str, Any]:
-    """The full analysis document for one trace file: span summaries,
-    the request breakdown, and the aggregated profile — the JSON shape
-    ``gordo-tpu trace --as-json`` prints and the tests golden-check."""
-    spans = list(read_trace(path))
-    return {
-        "trace": path,
+def analyze_trace(
+    path: Any,
+    since_ts: Optional[float] = None,
+    until_ts: Optional[float] = None,
+) -> Dict[str, Any]:
+    """The full analysis document for one trace (a file path, or a list
+    of sink bases to read-merge — the per-worker variants of one
+    logical trace): span summaries, the request breakdown, and the
+    aggregated profile — the JSON shape ``gordo-tpu trace --as-json``
+    prints and the tests golden-check. ``since_ts``/``until_ts``
+    restrict the analysis to a time window (``--since``/``--last``)."""
+    paths = [path] if isinstance(path, str) else list(path)
+    spans = list(read_traces(paths, since_ts=since_ts, until_ts=until_ts))
+    doc = {
+        "trace": paths[0] if len(paths) == 1 else paths,
         "spans_read": len(spans),
         "span_summary": summarize_spans(spans),
         "request_breakdown": request_breakdown(spans),
         "profile_frames": top_profile_frames(spans),
     }
+    if since_ts is not None or until_ts is not None:
+        doc["window"] = {"since_ts": since_ts, "until_ts": until_ts}
+    return doc
 
 
 # -- rendering ---------------------------------------------------------------
@@ -266,7 +355,16 @@ def _table(rows: List[List[str]], header: List[str]) -> str:
 
 def render_analysis(doc: Dict[str, Any]) -> str:
     """Human-readable rendering of :func:`analyze_trace`'s document."""
-    out: List[str] = [f"trace: {doc['trace']}  ({doc['spans_read']} spans)"]
+    trace = doc["trace"]
+    if isinstance(trace, list):
+        trace = ", ".join(trace)
+    out: List[str] = [f"trace: {trace}  ({doc['spans_read']} spans)"]
+    window = doc.get("window")
+    if window:
+        out.append(
+            f"window: since_ts={window.get('since_ts')} "
+            f"until_ts={window.get('until_ts')}"
+        )
 
     summary = doc.get("span_summary") or {}
     if summary:

@@ -320,6 +320,52 @@ KNOBS: Dict[str, Knob] = _knobs(
         "compile-cache hit counters stay on with telemetry itself.",
         "Telemetry",
     ),
+    Knob(
+        "GORDO_TPU_WORKER_SINKS", "bool", "auto",
+        "Per-process telemetry sinks: `serve_trace.jsonl` / "
+        "`fleet_health.json` get a `-<pid>` suffix so N gunicorn "
+        "workers stop overwriting one shared path (readers merge every "
+        "variant). Default: on exactly when `PROMETHEUS_MULTIPROC_DIR` "
+        "is configured — the existing multi-worker deployment signal.",
+        "Telemetry",
+    ),
+    # -- SLO engine --------------------------------------------------------
+    Knob(
+        "GORDO_TPU_SLO_CONFIG", "str", None,
+        "Path to a `slos.toml` declaring objectives and burn-rate "
+        "alert rules (default: `<telemetry dir>/slos.toml`, then the "
+        "packaged defaults).",
+        "SLO",
+    ),
+    Knob(
+        "GORDO_TPU_SLO_WINDOW_SECONDS", "int", 60,
+        "Rollup window size for the cross-worker telemetry reducer "
+        "(`rollups/<window>.json`); boundaries align to it, so rollups "
+        "from different workers/hosts merge bucket-for-bucket.",
+        "SLO",
+    ),
+    Knob(
+        "GORDO_TPU_SLO_ROLLUP_KEEP", "int", 50_000,
+        "Rollup windows retained on disk (oldest pruned past this); "
+        "the default covers a 30d SLO window at 60s granularity.",
+        "SLO",
+    ),
+    Knob(
+        "GORDO_TPU_SLO_SINK_GC_AGE", "float", 86400.0,
+        "Seconds a dead worker's fully-consumed trace-sink chain must "
+        "sit unwritten before the rollup reducer deletes it; 0 "
+        "disables sink GC (use that for aggregators running in "
+        "another pid namespace/host, where the liveness probe is "
+        "blind).",
+        "SLO",
+    ),
+    Knob(
+        "GORDO_TPU_SLO_SCRAPE_REFRESH", "float", 60.0,
+        "Minimum seconds between scrape-driven SLO re-evaluations of a "
+        "watched telemetry dir (`gordo_slo_*` gauges); 0 = scrapes "
+        "report the cached status only.",
+        "SLO",
+    ),
     # -- Serving / micro-batching -----------------------------------------
     Knob(
         "GORDO_TPU_BATCHING", "bool", False,
@@ -427,6 +473,13 @@ KNOBS: Dict[str, Knob] = _knobs(
         "GORDO_TPU_QUARANTINE_COOLDOWN", "float", 3600.0,
         "Seconds a rolled-back machine stays quarantined before it may "
         "canary again (wall-clock: quarantine spans process restarts).",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_GATE_SLO_BURN", "bool", True,
+        "Hold lifecycle auto-promotions while a page-severity SLO "
+        "burn-rate alert is firing (the canary keeps its traffic "
+        "slice; `lifecycle promote --force` bypasses).",
         "Lifecycle",
     ),
     # -- Reporters ---------------------------------------------------------

@@ -62,6 +62,9 @@ case "$component" in
     # and tests/lifecycle — marker-selected so its own matrix job stays
     # meaningful while the per-directory jobs still run every test.
     fleet_health) run -m "fleet_health and not slow" tests/ ;;
+    # The SLO suite cuts across tests/telemetry, tests/server and
+    # tests/lifecycle the same way — marker-selected.
+    slo)      run -m "slo and not slow" tests/ ;;
     utils)    run -m "not slow" tests/utils ;;
     workflow) run -m "not slow" tests/workflow ;;
     formatting) run tests/test_codestyle.py ;;

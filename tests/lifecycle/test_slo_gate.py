@@ -1,0 +1,188 @@
+"""SLO burn state feeds the lifecycle gate inputs: a passing canary is
+NOT auto-promoted while a page-severity burn-rate alert is firing —
+swapping artifacts mid-incident destroys the evidence — and the hold
+releases the moment the alert resolves."""
+
+import json
+import os
+
+import pytest
+
+from gordo_tpu.lifecycle.gates import GateReport
+
+from .conftest import make_supervisor
+
+pytestmark = [pytest.mark.lifecycle, pytest.mark.slo]
+
+
+def _write_alert_state(directory, state, severity="page"):
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, "slo_state.json"), "w") as handle:
+        json.dump(
+            {
+                "version": 1,
+                "alerts": {
+                    "availability:fast": {
+                        "slo": "availability",
+                        "rule": "fast",
+                        "severity": severity,
+                        "state": state,
+                    }
+                },
+            },
+            handle,
+        )
+
+
+@pytest.fixture
+def telemetry_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "telemetry")
+    os.makedirs(d)
+    monkeypatch.setenv("GORDO_TPU_TELEMETRY_DIR", d)
+    return d
+
+
+def test_slo_hold_reads_firing_page_alerts(models_root, telemetry_dir):
+    supervisor = make_supervisor(models_root)
+    assert supervisor._slo_hold() == []
+    _write_alert_state(telemetry_dir, "firing")
+    assert supervisor._slo_hold() == ["availability:fast"]
+    # ticket severity never holds a promotion
+    _write_alert_state(telemetry_dir, "firing", severity="ticket")
+    assert supervisor._slo_hold() == []
+    # resolved releases the hold
+    _write_alert_state(telemetry_dir, "resolved")
+    assert supervisor._slo_hold() == []
+
+
+def test_slo_gate_can_be_disabled(models_root, telemetry_dir):
+    supervisor = make_supervisor(models_root, slo_gate=False)
+    _write_alert_state(telemetry_dir, "firing")
+    assert supervisor._slo_hold() == []
+
+
+def test_passing_canary_held_while_page_fires(
+    models_root, telemetry_dir, monkeypatch, probe_windows
+):
+    """The full branch: gates pass, SLO page is firing -> the canary
+    keeps serving its slice (no promote, no rollback); the hold
+    releases when the alert resolves."""
+    from gordo_tpu.lifecycle.loop import CycleReport
+
+    supervisor = make_supervisor(models_root)
+    healthy, _ = probe_windows
+    supervisor.state.transition(
+        "canary_building", stale=["lc-0"], canary_revision="101"
+    )
+    supervisor.state.transition("canary_serving", rebuilt=["lc-0"])
+    supervisor._probe_frames = {"lc-0": healthy}
+
+    class StoreStub:
+        def canary_status(self):
+            return {"fraction": 0.5}
+
+        def fleet(self, path):
+            return object()
+
+    supervisor.store = StoreStub()
+    monkeypatch.setattr(
+        "gordo_tpu.lifecycle.loop.evaluate_canary",
+        lambda *args, **kwargs: GateReport(),
+    )
+    promoted = []
+    monkeypatch.setattr(
+        supervisor, "_promote", lambda report: promoted.append(report)
+    )
+
+    _write_alert_state(telemetry_dir, "firing")
+    report = CycleReport()
+    supervisor._gate_and_settle(report)
+    assert report.gate["passed"]
+    assert not promoted
+    assert not report.rolled_back
+    assert report.details["slo_hold"] == ["availability:fast"]
+    assert supervisor.state.phase == "canary_serving"
+
+    # the burn resolves -> the next cycle promotes
+    _write_alert_state(telemetry_dir, "resolved")
+    report = CycleReport()
+    supervisor._gate_and_settle(report)
+    assert promoted
+
+
+def test_failing_gates_still_roll_back_during_burn(
+    models_root, telemetry_dir, monkeypatch, probe_windows
+):
+    """A FAILING canary is never held alive by the SLO gate — rollback
+    (getting the bad artifacts out) always proceeds."""
+    from gordo_tpu.lifecycle.loop import CycleReport
+
+    supervisor = make_supervisor(models_root)
+    healthy, _ = probe_windows
+    supervisor.state.transition(
+        "canary_building", stale=["lc-0"], canary_revision="101"
+    )
+    supervisor.state.transition("canary_serving", rebuilt=["lc-0"])
+    supervisor._probe_frames = {"lc-0": healthy}
+
+    class StoreStub:
+        def canary_status(self):
+            return {"fraction": 0.5}
+
+        def fleet(self, path):
+            return object()
+
+    supervisor.store = StoreStub()
+    failing = GateReport()
+    failing.fail("lc-0: canary lost its anomaly threshold")
+    monkeypatch.setattr(
+        "gordo_tpu.lifecycle.loop.evaluate_canary",
+        lambda *args, **kwargs: failing,
+    )
+    rolled = []
+    monkeypatch.setattr(
+        supervisor,
+        "_rollback",
+        lambda report, reasons: rolled.append(reasons),
+    )
+    _write_alert_state(telemetry_dir, "firing")
+    report = CycleReport()
+    supervisor._gate_and_settle(report)
+    assert rolled
+
+
+def test_manual_promote_surfaces_hold(
+    models_root, telemetry_dir, monkeypatch, probe_windows
+):
+    from gordo_tpu.lifecycle.loop import CycleReport  # noqa: F401
+
+    supervisor = make_supervisor(models_root)
+    healthy, _ = probe_windows
+    supervisor.state.transition(
+        "canary_building", stale=["lc-0"], canary_revision="101"
+    )
+    supervisor.state.transition("canary_serving", rebuilt=["lc-0"])
+    supervisor._probe_frames = {"lc-0": healthy}
+
+    class StoreStub:
+        def canary_status(self):
+            return {"fraction": 0.5}
+
+        def fleet(self, path):
+            return object()
+
+    supervisor.store = StoreStub()
+    monkeypatch.setattr(
+        "gordo_tpu.lifecycle.loop.evaluate_canary",
+        lambda *args, **kwargs: GateReport(),
+    )
+    _write_alert_state(telemetry_dir, "firing")
+    with pytest.raises(RuntimeError, match="SLO page alert"):
+        supervisor.promote(force=False)
+    # --force bypasses the hold (and the gates)
+    promoted = []
+    monkeypatch.setattr(
+        supervisor, "_promote", lambda report: promoted.append(report)
+    )
+    supervisor.promote(force=True)
+    assert promoted

@@ -1,0 +1,207 @@
+"""The ``/gordo/v0/<project>/slo`` route and the scrape-time SLO
+gauges: the serving surface of the fleet SLO engine."""
+
+import datetime
+import json
+import os
+
+import pytest
+from prometheus_client import CollectorRegistry
+
+from gordo_tpu.telemetry import slo
+
+# Must match tests/server/conftest.py
+PROJECT = "test-project"
+
+pytestmark = [pytest.mark.slo, pytest.mark.observability]
+
+
+def url(rest: str) -> str:
+    return f"/gordo/v0/{PROJECT}/{rest}"
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    slo.reset_statuses()
+    yield
+    slo.reset_statuses()
+
+
+def iso(ts: float) -> str:
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc
+    ).isoformat()
+
+
+def write_serve_trace(directory, requests=50, errors=0):
+    import time
+
+    now = time.time()
+    spans = []
+    for i in range(requests):
+        status = 500 if i < errors else 200
+        spans.append(
+            {
+                "name": "request",
+                "context": {
+                    "trace_id": f"{i:032x}",
+                    "span_id": f"{i:016x}",
+                },
+                "parent_id": None,
+                "kind": "server",
+                "start_time": iso(now - 600 + i),
+                "end_time": iso(now - 600 + i),
+                "duration_ms": 90.0,
+                "status": {"status_code": "OK"},
+                "attributes": {
+                    "http.status_code": status,
+                    "gordo_name": "machine-1",
+                },
+                "resource": {},
+            }
+        )
+    with open(os.path.join(directory, "serve_trace.jsonl"), "w") as handle:
+        for span in spans:
+            handle.write(json.dumps(span) + "\n")
+
+
+def test_slo_route_answers_status_document(
+    client, collection_dir, tmp_path, monkeypatch
+):
+    telemetry_dir = tmp_path / "telemetry"
+    telemetry_dir.mkdir()
+    write_serve_trace(str(telemetry_dir))
+    monkeypatch.setenv("GORDO_TPU_TELEMETRY_DIR", str(telemetry_dir))
+
+    resp = client.get(url("slo"))
+    assert resp.status_code == 200
+    doc = resp.json
+    assert doc["ok"] is True
+    names = [entry["name"] for entry in doc["slos"]]
+    assert "availability" in names
+    assert doc["recent"]["requests"] == 50
+    # the evaluation persisted its machinery beside the sinks
+    assert (telemetry_dir / "rollups").is_dir()
+    assert (telemetry_dir / "slo_state.json").exists()
+
+
+def test_slo_route_404_without_telemetry_dir(client, monkeypatch):
+    # the anchor collection dir exists but holds no sinks and no
+    # telemetry dir is configured -> the route still evaluates over the
+    # anchor (empty traffic, clean budgets)
+    monkeypatch.delenv("GORDO_TPU_TELEMETRY_DIR", raising=False)
+    resp = client.get(url("slo"))
+    # anchor dir exists -> evaluates (requests=0, inside SLO)
+    assert resp.status_code == 200
+    assert resp.json["ok"] is True
+
+
+def test_slo_route_422_on_bad_config(client, tmp_path, monkeypatch):
+    telemetry_dir = tmp_path / "telemetry"
+    telemetry_dir.mkdir()
+    (telemetry_dir / "slos.toml").write_text(
+        '[[slo]]\nname = "x"\nobjective = "bogus"\ntarget = 0.5\n'
+    )
+    monkeypatch.setenv("GORDO_TPU_TELEMETRY_DIR", str(telemetry_dir))
+    resp = client.get(url("slo"))
+    assert resp.status_code == 422
+    assert "Bad SLO config" in resp.json["error"]
+
+
+def test_slo_gauges_bounded_and_on_every_registry(tmp_path, monkeypatch):
+    """gordo_slo_* ride every scrape registry (incl. the multiprocess
+    fan-in) with label cardinality bounded by the declared slos.toml."""
+    import pytest as _pytest
+
+    from gordo_tpu.server.prometheus.metrics import (
+        multiprocess_registry,
+        register_fleet_console_collectors,
+    )
+
+    _pytest.importorskip("prometheus_client.multiprocess")
+    d = tmp_path / "telemetry"
+    d.mkdir()
+    write_serve_trace(str(d), requests=40, errors=0)
+    slo.evaluate(str(d))
+
+    in_process = CollectorRegistry()
+    register_fleet_console_collectors(in_process)
+    register_fleet_console_collectors(in_process)  # idempotent
+
+    monkeypatch.setenv(
+        "PROMETHEUS_MULTIPROC_DIR", str(tmp_path / "multiproc")
+    )
+    fan_in = multiprocess_registry()
+    assert fan_in is not None
+
+    for registry in (in_process, fan_in):
+        assert (
+            registry.get_sample_value(
+                "gordo_slo_error_budget_remaining_ratio",
+                {"slo": "availability"},
+            )
+            == 1.0
+        )
+        assert (
+            registry.get_sample_value(
+                "gordo_slo_burn_rate", {"slo": "availability", "window": "1h"}
+            )
+            == 0.0
+        )
+        assert (
+            registry.get_sample_value(
+                "gordo_slo_alert_state", {"slo": "availability"}
+            )
+            == 0
+        )
+
+
+def test_slo_alert_state_gauge_tracks_firing(tmp_path):
+    from gordo_tpu.server.prometheus.metrics import (
+        register_fleet_console_collectors,
+    )
+
+    d = tmp_path / "telemetry"
+    d.mkdir()
+    (d / "slos.toml").write_text(
+        '[[slo]]\nname = "availability"\nobjective = "availability"\n'
+        'target = 0.99\nwindow = "30d"\n'
+        "[burn]\nfast_threshold = 5.0\n"
+    )
+    write_serve_trace(str(d), requests=40, errors=40)
+    slo.evaluate(str(d))  # pending
+    slo.evaluate(str(d))  # firing
+    registry = CollectorRegistry()
+    register_fleet_console_collectors(registry)
+    assert (
+        registry.get_sample_value(
+            "gordo_slo_alert_state", {"slo": "availability"}
+        )
+        == 2
+    )
+
+
+def test_scrape_refresh_respects_throttle(tmp_path, monkeypatch):
+    """Scrapes with a fresh cache never re-evaluate; 0 disables
+    scrape-driven evaluation entirely."""
+    d = tmp_path / "telemetry"
+    d.mkdir()
+    write_serve_trace(str(d), requests=10)
+    calls = []
+    original = slo.evaluate
+
+    def counting(directory, *args, **kwargs):
+        calls.append(directory)
+        return original(directory, *args, **kwargs)
+
+    monkeypatch.setattr(slo, "evaluate", counting)
+    slo.watch(str(d))
+    monkeypatch.setenv("GORDO_TPU_SLO_SCRAPE_REFRESH", "0")
+    assert slo.scrape_statuses() == {}  # cached-only mode, nothing cached
+    assert calls == []
+    monkeypatch.setenv("GORDO_TPU_SLO_SCRAPE_REFRESH", "3600")
+    statuses = slo.scrape_statuses()
+    assert len(calls) == 1  # stale cache -> one evaluation
+    assert os.path.normpath(str(d)) in statuses
+    slo.scrape_statuses()
+    assert len(calls) == 1  # fresh cache -> throttled

@@ -1,0 +1,529 @@
+"""The cross-worker telemetry reducer: windowed rollups, dedupe,
+incremental offsets, rotation-during-read safety, and per-worker merge
+exactness (the foundations under the SLO engine)."""
+
+import datetime
+import json
+import os
+import threading
+
+import pytest
+
+from gordo_tpu.telemetry.aggregate import (
+    LATENCY_BUCKETS_MS,
+    ROLLUP_DIR,
+    ROLLUP_STATE_FILE,
+    RollupStore,
+    discover_sinks,
+    file_signature,
+    histogram_add,
+    histogram_merge,
+    histogram_percentile,
+    merge_rollups,
+    new_histogram,
+    parse_span_time,
+    sink_bases,
+    summarize_rollup,
+)
+
+pytestmark = pytest.mark.slo
+
+NOW = 1_754_000_000.0  # a fixed, boring epoch
+
+
+def iso(ts: float) -> str:
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc
+    ).isoformat()
+
+
+def request_span(
+    i, ts, status=200, wall_ms=100.0, machine="m-1", trace_prefix=0
+):
+    return {
+        "name": "request",
+        "context": {
+            "trace_id": f"{trace_prefix:08x}{i:024x}",
+            "span_id": f"{i:016x}",
+        },
+        "parent_id": None,
+        "kind": "server",
+        "start_time": iso(ts - wall_ms / 1000.0),
+        "end_time": iso(ts),
+        "duration_ms": wall_ms,
+        "status": {"status_code": "OK"},
+        "attributes": {"http.status_code": status, "gordo_name": machine},
+        "resource": {"service.name": "test"},
+    }
+
+
+def stage_span(i, ts, name="inference", ms=40.0, trace_prefix=0):
+    return {
+        "name": name,
+        "context": {
+            "trace_id": f"{trace_prefix:08x}{i:024x}",
+            "span_id": f"a{i:015x}",
+        },
+        "parent_id": f"{i:016x}",
+        "kind": "internal",
+        "start_time": iso(ts - ms / 1000.0),
+        "end_time": iso(ts),
+        "duration_ms": ms,
+        "status": {"status_code": "OK"},
+        "attributes": {},
+        "resource": {"service.name": "test"},
+    }
+
+
+def write_spans(path, spans, mode="w"):
+    with open(path, mode) as handle:
+        for span in spans:
+            handle.write(json.dumps(span) + "\n")
+
+
+# -- histogram math -----------------------------------------------------------
+
+
+def test_histogram_add_and_percentile():
+    histogram = new_histogram()
+    for value in (10.0, 20.0, 30.0, 40.0, 1000.0):
+        histogram_add(histogram, value)
+    assert histogram["count"] == 5
+    assert histogram["sum_ms"] == pytest.approx(1100.0)
+    p50 = histogram_percentile(histogram, 0.50)
+    assert 10.0 < p50 <= 50.0
+    assert histogram_percentile(histogram, 1.0) >= 750.0
+    assert histogram_percentile(new_histogram(), 0.5) == 0.0
+
+
+def test_histogram_overflow_bucket_reports_top_edge():
+    histogram = new_histogram()
+    histogram_add(histogram, 10_000_000.0)  # way past the last edge
+    assert histogram["counts"][-1] == 1
+    assert histogram_percentile(histogram, 0.5) == LATENCY_BUCKETS_MS[-1]
+
+
+def test_histogram_merge_same_edges():
+    a, b = new_histogram(), new_histogram()
+    for value in (5.0, 50.0):
+        histogram_add(a, value)
+    for value in (500.0, 5000.0):
+        histogram_add(b, value)
+    histogram_merge(a, b)
+    assert a["count"] == 4
+    assert a["sum_ms"] == pytest.approx(5555.0)
+    assert sum(a["counts"]) == 4
+
+
+def test_parse_span_time():
+    assert parse_span_time(iso(NOW)) == pytest.approx(NOW)
+    assert parse_span_time("garbage") is None
+    assert parse_span_time(None) is None
+
+
+# -- discovery ----------------------------------------------------------------
+
+
+def test_sink_bases_and_discovery(tmp_path):
+    d = str(tmp_path)
+    write_spans(os.path.join(d, "serve_trace.jsonl"), [request_span(1, NOW)])
+    write_spans(
+        os.path.join(d, "serve_trace-123.jsonl"), [request_span(2, NOW)]
+    )
+    write_spans(
+        os.path.join(d, "serve_trace-123.jsonl.1"), [request_span(3, NOW)]
+    )
+    write_spans(os.path.join(d, "build_trace.jsonl"), [])
+    bases = sink_bases(d, "serve_trace.jsonl")
+    assert [os.path.basename(b) for b in bases] == [
+        "serve_trace-123.jsonl",
+        "serve_trace.jsonl",
+    ]
+    kinds = {}
+    for kind, path in discover_sinks(d):
+        kinds.setdefault(kind, []).append(os.path.basename(path))
+    # rotated generation read BEFORE its live file
+    assert kinds["serve"] == [
+        "serve_trace-123.jsonl.1",
+        "serve_trace-123.jsonl",
+        "serve_trace.jsonl",
+    ]
+    assert kinds["build"] == ["build_trace.jsonl"]
+
+
+def test_file_signature_follows_rotated_bytes(tmp_path):
+    path = tmp_path / "serve_trace.jsonl"
+    write_spans(str(path), [request_span(1, NOW)])
+    signature = file_signature(str(path))
+    os.replace(str(path), str(path) + ".1")
+    assert file_signature(str(path) + ".1") == signature
+    assert file_signature(str(path)) is None
+
+
+# -- the reducer --------------------------------------------------------------
+
+
+def test_rollup_windows_and_contents(tmp_path):
+    d = str(tmp_path)
+    spans = []
+    # two windows: 10 ok + 2 errors at NOW, 5 ok at NOW+120
+    for i in range(10):
+        spans.append(request_span(i, NOW + i * 0.1, wall_ms=100.0))
+        spans.append(stage_span(i, NOW + i * 0.1))
+    for i in range(10, 12):
+        spans.append(request_span(i, NOW + i * 0.1, status=503))
+    for i in range(20, 25):
+        spans.append(request_span(i, NOW + 120.0))
+    write_spans(os.path.join(d, "serve_trace.jsonl"), spans)
+
+    store = RollupStore(d, seconds=60)
+    report = store.aggregate()
+    assert report["spans_read"] == len(spans)
+    assert len(report["windows_updated"]) == 2
+
+    first = store._load_json(store.rollup_path(store.window_start(NOW)))
+    assert first["requests"]["count"] == 12
+    assert first["requests"]["errors"] == 2
+    assert first["requests"]["by_class"]["5xx"] == 2
+    assert first["machines"]["m-1"] == {"requests": 12, "errors": 2}
+    assert first["stages"]["inference"]["count"] == 10
+
+    merged = store.merged(since=NOW - 60, until=NOW + 300)
+    summary = summarize_rollup(merged)
+    assert summary["requests"] == 17
+    assert summary["errors"] == 2
+    assert summary["machines"]["m-1"]["error_rate"] == pytest.approx(
+        2 / 17, abs=1e-6
+    )
+    assert summary["stages"]["inference"]["p50_ms"] > 0
+
+
+def test_aggregate_is_incremental(tmp_path):
+    d = str(tmp_path)
+    path = os.path.join(d, "serve_trace.jsonl")
+    write_spans(path, [request_span(i, NOW) for i in range(5)])
+    store = RollupStore(d, seconds=60)
+    assert store.aggregate()["spans_read"] == 5
+    # unchanged corpus: zero spans re-read
+    assert store.aggregate()["spans_read"] == 0
+    # appending folds ONLY the delta, into the existing rollup
+    write_spans(path, [request_span(i, NOW) for i in range(5, 8)], mode="a")
+    assert store.aggregate()["spans_read"] == 3
+    merged = store.merged()
+    assert merged["requests"]["count"] == 8
+    # a fresh store instance resumes from the persisted state file
+    assert RollupStore(d, seconds=60).aggregate()["spans_read"] == 0
+
+
+def test_dedupe_by_trace_and_span_id(tmp_path):
+    d = str(tmp_path)
+    spans = [request_span(i, NOW) for i in range(4)]
+    # the same spans duplicated into a second worker sink (e.g. a copied
+    # generation): they must count once
+    write_spans(os.path.join(d, "serve_trace-1.jsonl"), spans)
+    write_spans(os.path.join(d, "serve_trace-2.jsonl"), spans)
+    store = RollupStore(d, seconds=60)
+    store.aggregate()
+    assert store.merged()["requests"]["count"] == 4
+
+
+def test_three_worker_sinks_sum_exactly(tmp_path):
+    """The satellite regression: aggregated RED counts == the sum of
+    per-worker counts (3 simulated workers, disjoint traffic)."""
+    d = str(tmp_path)
+    per_worker = {}
+    for worker, pid in enumerate((1001, 1002, 1003)):
+        spans = []
+        errors = 0
+        for i in range(30 + worker):
+            status = 500 if i % 7 == 0 else 200
+            errors += status == 500
+            spans.append(
+                request_span(
+                    i, NOW + i, status=status, trace_prefix=pid
+                )
+            )
+        per_worker[pid] = {"requests": len(spans), "errors": errors}
+        write_spans(os.path.join(d, f"serve_trace-{pid}.jsonl"), spans)
+    store = RollupStore(d, seconds=60)
+    store.aggregate()
+    summary = summarize_rollup(store.merged())
+    assert summary["requests"] == sum(
+        w["requests"] for w in per_worker.values()
+    )
+    assert summary["errors"] == sum(w["errors"] for w in per_worker.values())
+
+
+def test_torn_tail_line_reread_exactly_once(tmp_path):
+    d = str(tmp_path)
+    path = os.path.join(d, "serve_trace.jsonl")
+    write_spans(path, [request_span(0, NOW)])
+    with open(path, "a") as handle:
+        handle.write(json.dumps(request_span(1, NOW))[:40])  # torn write
+    store = RollupStore(d, seconds=60)
+    store.aggregate()
+    assert store.merged()["requests"]["count"] == 1
+    # the writer finishes the line; the completed span counts once
+    with open(path, "a") as handle:
+        handle.write(json.dumps(request_span(1, NOW))[40:] + "\n")
+    store.aggregate()
+    assert store.merged()["requests"]["count"] == 2
+
+
+def test_build_trace_folds_into_build_section(tmp_path):
+    d = str(tmp_path)
+    spans = []
+    for i in range(6):
+        spans.append(
+            {
+                "name": "device_program",
+                "context": {"trace_id": f"{i:032x}", "span_id": f"{i:016x}"},
+                "parent_id": None,
+                "kind": "internal",
+                "start_time": iso(NOW),
+                "end_time": iso(NOW + 1),
+                "duration_ms": 1000.0,
+                "status": {"status_code": "OK"},
+                "attributes": {"compile": i < 2},
+                "resource": {},
+            }
+        )
+    write_spans(os.path.join(d, "build_trace.jsonl"), spans)
+    store = RollupStore(d, seconds=60)
+    store.aggregate()
+    build = store.merged()["build"]
+    assert build["device_programs"] == 6
+    assert build["compiles"] == 2
+
+
+def test_rollup_pruning(tmp_path, monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_SLO_ROLLUP_KEEP", "3")
+    d = str(tmp_path)
+    spans = [
+        request_span(i, NOW + i * 60.0) for i in range(8)
+    ]  # 8 distinct windows
+    write_spans(os.path.join(d, "serve_trace.jsonl"), spans)
+    store = RollupStore(d, seconds=60)
+    report = store.aggregate()
+    assert report["rollups_pruned"] == 5
+    kept = [
+        entry
+        for entry in os.listdir(store.rollup_dir)
+        if entry != ROLLUP_STATE_FILE and not entry.startswith(".")
+    ]
+    assert len(kept) == 3
+
+
+def test_rollup_dir_and_state_are_droppings():
+    from gordo_tpu.serializer import is_builder_dropping
+
+    assert is_builder_dropping(ROLLUP_DIR)
+    assert is_builder_dropping("slo_state.json")
+    assert is_builder_dropping("slos.toml")
+    assert is_builder_dropping("serve_trace-1234.jsonl")
+    assert is_builder_dropping("serve_trace-1234.jsonl.2")
+    assert is_builder_dropping("fleet_health-1234.json")
+    assert not is_builder_dropping("my-model")
+
+
+def test_rotation_during_read_never_drops_or_double_counts(tmp_path):
+    """The pinned contract: a reader aggregating WHILE the writer
+    rotates the sink must converge on exactly-once folding — no span
+    dropped when its bytes moved to ``.1`` mid-read, none double-counted
+    when the reader sees the same bytes at two paths."""
+    from gordo_tpu.telemetry.recorder import SpanRecorder
+
+    d = str(tmp_path)
+    path = os.path.join(d, "serve_trace.jsonl")
+    total = 600
+    recorder = SpanRecorder(
+        sink_path=path, max_bytes=8 * 1024, keep=50
+    )  # rotates every ~20 spans
+    store = RollupStore(d, seconds=3600)
+    stop = threading.Event()
+    aggregation_errors = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                store.aggregate()
+            except Exception as exc:  # noqa: BLE001 - the assertion
+                aggregation_errors.append(exc)
+                return
+
+    thread = threading.Thread(target=reader)
+    thread.start()
+    try:
+        for i in range(total):
+            recorder.emit(request_span(i, NOW + i * 0.01))
+    finally:
+        stop.set()
+        thread.join(timeout=30)
+    recorder.close()
+    assert not aggregation_errors
+    # the settling pass: everything the concurrent passes missed
+    store.aggregate()
+    merged = store.merged()
+    assert merged["requests"]["count"] == total
+
+
+def test_dead_worker_sinks_pruned_once_consumed_and_cold(tmp_path):
+    """A dead worker's fully-folded, day-cold trace chain is
+    garbage-collected by the reducer; a live worker's (this process)
+    never is, a freshly-written chain never is (the age gate backs up
+    the namespace-blind pid probe), and health snapshots are never
+    touched."""
+    import time as time_mod
+
+    d = str(tmp_path)
+    dead_pid = 2**22 + 11  # beyond any real pid on this host
+    live_pid = os.getpid()
+    old = time_mod.time() - 2 * 86400
+    spans = [request_span(i, NOW, trace_prefix=1) for i in range(4)]
+    write_spans(os.path.join(d, f"serve_trace-{dead_pid}.jsonl"), spans)
+    write_spans(
+        os.path.join(d, f"serve_trace-{dead_pid}.jsonl.1"),
+        [request_span(10, NOW, trace_prefix=2)],
+    )
+    write_spans(
+        os.path.join(d, f"serve_trace-{live_pid}.jsonl"),
+        [request_span(20, NOW, trace_prefix=3)],
+    )
+    fresh_dead = os.path.join(d, f"serve_trace-{dead_pid + 1}.jsonl")
+    write_spans(fresh_dead, [request_span(30, NOW, trace_prefix=4)])
+    health = os.path.join(d, f"fleet_health-{dead_pid}.json")
+    with open(health, "w") as handle:
+        handle.write("{}")
+    for name in (
+        f"serve_trace-{dead_pid}.jsonl",
+        f"serve_trace-{dead_pid}.jsonl.1",
+    ):
+        os.utime(os.path.join(d, name), (old, old))
+    store = RollupStore(d, seconds=60)
+    report = store.aggregate()
+    assert report["worker_sinks_pruned"] == 2
+    assert not os.path.exists(
+        os.path.join(d, f"serve_trace-{dead_pid}.jsonl")
+    )
+    assert not os.path.exists(
+        os.path.join(d, f"serve_trace-{dead_pid}.jsonl.1")
+    )
+    assert os.path.exists(os.path.join(d, f"serve_trace-{live_pid}.jsonl"))
+    assert os.path.exists(fresh_dead)  # dead pid but written today
+    assert os.path.exists(health)
+    # the folded spans survive in the rollups
+    assert store.merged()["requests"]["count"] == 7
+
+
+def test_sink_gc_disabled_by_knob(tmp_path, monkeypatch):
+    import time as time_mod
+
+    monkeypatch.setenv("GORDO_TPU_SLO_SINK_GC_AGE", "0")
+    d = str(tmp_path)
+    dead = os.path.join(d, f"serve_trace-{2**22 + 13}.jsonl")
+    write_spans(dead, [request_span(0, NOW)])
+    old = time_mod.time() - 2 * 86400
+    os.utime(dead, (old, old))
+    report = RollupStore(d, seconds=60).aggregate()
+    assert report["worker_sinks_pruned"] == 0
+    assert os.path.exists(dead)
+
+
+def test_signature_stable_for_short_first_line(tmp_path):
+    """A sink whose only line is shorter than the 256-byte head read
+    must keep its identity when line two lands — a raw prefix hash
+    would orphan the saved offset and double-fold line one."""
+    path = tmp_path / "serve_trace.jsonl"
+    short = json.dumps(
+        {"name": "request", "context": {"trace_id": "t", "span_id": "s"}}
+    )
+    assert len(short) < 200
+    path.write_text(short + "\n")
+    first = file_signature(str(path))
+    with open(path, "a") as handle:
+        handle.write(json.dumps(request_span(1, NOW)) + "\n")
+    assert file_signature(str(path)) == first
+    # a torn (incomplete) first line has no identity yet
+    torn = tmp_path / "torn.jsonl"
+    torn.write_text(short)  # no newline
+    assert file_signature(str(torn)) is None
+
+
+def test_short_first_line_not_double_counted(tmp_path):
+    d = str(tmp_path)
+    path = os.path.join(d, "serve_trace.jsonl")
+    # a minimal-but-valid request span, well under 256 bytes
+    tiny = {
+        "name": "request",
+        "context": {"trace_id": "a" * 32, "span_id": "b" * 16},
+        "kind": "server",
+        "end_time": iso(NOW),
+        "duration_ms": 5.0,
+        "attributes": {"http.status_code": 200},
+    }
+    assert len(json.dumps(tiny)) < 256
+    with open(path, "w") as handle:
+        handle.write(json.dumps(tiny) + "\n")
+    store = RollupStore(d, seconds=60)
+    store.aggregate()
+    # the file grows past the old 256-byte hash basis
+    write_spans(path, [request_span(i, NOW) for i in range(3)], mode="a")
+    store.aggregate()
+    assert store.merged()["requests"]["count"] == 4
+
+
+def test_writer_reopens_unlinked_sink(tmp_path):
+    """A sink deleted under a live writer (a namespace-blind GC) must
+    not orphan the fd — the next write starts a fresh file."""
+    from gordo_tpu.telemetry.recorder import SpanRecorder
+
+    path = str(tmp_path / "serve_trace.jsonl")
+    recorder = SpanRecorder(sink_path=path)
+    recorder.emit(request_span(0, NOW))
+    assert os.path.exists(path)
+    os.remove(path)
+    recorder.emit(request_span(1, NOW))
+    recorder.close()
+    assert os.path.exists(path)
+    with open(path) as handle:
+        assert len(handle.readlines()) == 1  # the post-unlink span
+
+
+def test_ledger_registry_rebuilds_after_fork(tmp_path, monkeypatch):
+    """A ledger inherited across a fork (gunicorn --preload) froze the
+    PARENT's pid into its snapshot path; ledger_for must rebuild it in
+    the child instead of letting N workers clobber one file."""
+    from gordo_tpu.telemetry import fleet_health
+
+    monkeypatch.setenv("GORDO_TPU_WORKER_SINKS", "1")
+    fleet_health.reset_ledgers()
+    try:
+        parent = fleet_health.ledger_for(str(tmp_path))
+        assert parent.path.endswith(f"-{os.getpid()}.json")
+        # simulate the fork: the cached ledger claims another pid
+        parent._pid = os.getpid() + 1
+        child = fleet_health.ledger_for(str(tmp_path))
+        assert child is not parent
+        assert child._pid == os.getpid()
+        assert child.path.endswith(f"-{os.getpid()}.json")
+    finally:
+        fleet_health.reset_ledgers()
+
+
+def test_merge_rollups_is_count_additive():
+    a = {
+        "requests": {"count": 3, "errors": 1, "by_class": {"5xx": 1, "2xx": 2}},
+        "latency_ms": new_histogram(),
+        "stages": {},
+        "machines": {"m": {"requests": 3, "errors": 1}},
+        "build": {"device_programs": 0, "compiles": 0, "phases": {}},
+        "spans": 3,
+        "window": {"start": 0, "seconds": 60},
+    }
+    import copy
+
+    b = copy.deepcopy(a)
+    merged = merge_rollups(copy.deepcopy(a), b)
+    assert merged["requests"]["count"] == 6
+    assert merged["machines"]["m"]["requests"] == 6
+    assert merged["spans"] == 6

@@ -1,0 +1,339 @@
+"""The SLO engine: config parsing (tomllib and the 3.10 subset parser),
+burn-rate math, the pending→firing→resolved state machine, atomic
+persistence, and the status document."""
+
+import json
+import os
+
+import pytest
+
+from gordo_tpu.telemetry import slo
+from gordo_tpu.telemetry.aggregate import histogram_add, new_histogram
+
+from .test_aggregate import NOW, request_span, write_spans
+
+pytestmark = pytest.mark.slo
+
+
+# -- config -------------------------------------------------------------------
+
+
+def test_packaged_defaults_load():
+    config = slo.load_slo_config()
+    names = [spec.name for spec in config.slos]
+    assert "availability" in names
+    assert "full-route-p95" in names
+    rules = {rule.name: rule for rule in config.rules}
+    assert rules["fast"].threshold == pytest.approx(14.4)
+    assert rules["fast"].severity == "page"
+    assert rules["slow"].window_s == pytest.approx(6 * 3600)
+    assert rules["fast"].confirmation_s == pytest.approx(300.0)
+
+
+def test_subset_parser_matches_packaged_file():
+    with open(slo.DEFAULT_SLOS_PATH) as handle:
+        doc = slo._parse_toml_subset(handle.read())
+    assert [entry["name"] for entry in doc["slo"]] == [
+        "availability",
+        "full-route-p95",
+    ]
+    assert doc["burn"]["confirmation_divisor"] == 12
+    assert doc["slo"][1]["threshold_ms"] == 1000.0
+
+
+def test_config_resolution_order(tmp_path, monkeypatch):
+    local = tmp_path / "slos.toml"
+    local.write_text(
+        '[[slo]]\nname = "local"\nobjective = "availability"\n'
+        'target = 0.99\nwindow = "1d"\n'
+    )
+    assert slo.resolve_config_path(str(tmp_path)) == str(local)
+    config = slo.load_slo_config(str(tmp_path))
+    assert [spec.name for spec in config.slos] == ["local"]
+    override = tmp_path / "override.toml"
+    override.write_text(local.read_text())
+    monkeypatch.setenv(slo.SLO_CONFIG_ENV, str(override))
+    assert slo.resolve_config_path(str(tmp_path)) == str(override)
+    # no local file, no override -> the packaged defaults
+    monkeypatch.delenv(slo.SLO_CONFIG_ENV)
+    assert (
+        slo.resolve_config_path(str(tmp_path / "empty"))
+        == slo.DEFAULT_SLOS_PATH
+    )
+
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        '[[slo]]\nname = "x"\nobjective = "nope"\ntarget = 0.9\n',
+        '[[slo]]\nname = "x"\nobjective = "availability"\ntarget = 1.5\n',
+        '[[slo]]\nname = "x"\nobjective = "latency"\ntarget = 0.9\n',
+        '[[slo]]\nname = "x"\nobjective = "availability"\ntarget = 0.9\n'
+        '[[slo]]\nname = "x"\nobjective = "availability"\ntarget = 0.9\n',
+    ],
+)
+def test_malformed_config_raises(tmp_path, body):
+    path = tmp_path / "slos.toml"
+    path.write_text(body)
+    with pytest.raises(ValueError):
+        slo.load_slo_config(path=str(path))
+
+
+def test_parse_duration():
+    assert slo.parse_duration("30d") == pytest.approx(30 * 86400)
+    assert slo.parse_duration("90m") == pytest.approx(5400)
+    assert slo.parse_duration(45) == 45.0
+    with pytest.raises(ValueError):
+        slo.parse_duration("soon")
+
+
+# -- math ---------------------------------------------------------------------
+
+
+def test_histogram_fraction_over():
+    histogram = new_histogram()
+    for value in (100.0, 100.0, 100.0, 2000.0):
+        histogram_add(histogram, value)
+    over = slo.histogram_fraction_over(histogram, 1000.0)
+    assert over == pytest.approx(0.25, abs=0.05)
+    assert slo.histogram_fraction_over(new_histogram(), 1000.0) == 0.0
+    assert slo.histogram_fraction_over(histogram, 0.0) == 1.0
+
+
+def test_burn_rate():
+    spec = slo.SloSpec(
+        name="a", objective="availability", target=0.999,
+        window="30d", window_s=30 * 86400.0,
+    )
+    assert slo.burn_rate(spec, 0.001) == pytest.approx(1.0)
+    assert slo.burn_rate(spec, 0.0144) == pytest.approx(14.4)
+
+
+# -- the state machine --------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "previous,exceeded,expected",
+    [
+        (None, True, "pending"),
+        ("inactive", True, "pending"),
+        ("pending", True, "firing"),
+        ("firing", True, "firing"),
+        ("resolved", True, "pending"),
+        (None, False, "inactive"),
+        ("pending", False, "inactive"),
+        ("firing", False, "resolved"),
+        ("resolved", False, "inactive"),
+    ],
+)
+def test_advance_alert_state(previous, exceeded, expected):
+    assert slo.advance_alert_state(previous, exceeded) == expected
+
+
+# -- evaluation ---------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    slo.reset_statuses()
+    yield
+    slo.reset_statuses()
+
+
+def _healthy_then_burst(directory, burst_errors=60):
+    """2h of healthy traffic, then a 5xx burst just before NOW."""
+    spans = [
+        request_span(i, NOW - 7200 + i * 3.6, wall_ms=100.0)
+        for i in range(2000)
+    ]
+    spans += [
+        request_span(10_000 + i, NOW - 60 + i * 0.5, status=500)
+        for i in range(burst_errors)
+    ]
+    write_spans(os.path.join(directory, "serve_trace.jsonl"), spans)
+
+
+def test_evaluate_pending_then_firing_then_resolved(tmp_path):
+    d = str(tmp_path)
+    _healthy_then_burst(d)
+    doc = slo.evaluate(d, now=NOW)
+    states = {a["id"]: a["state"] for a in doc["alerts"]}
+    assert states["availability:fast"] == "pending"
+    assert doc["firing"] == 0 and doc["ok"]
+
+    doc = slo.evaluate(d, now=NOW + 30)
+    states = {a["id"]: a["state"] for a in doc["alerts"]}
+    assert states["availability:fast"] == "firing"
+    assert doc["firing"] >= 1 and not doc["ok"]
+    assert slo.firing_alerts(d, severity="page")
+
+    # recovery: the burst ages out of every alert window
+    doc = slo.evaluate(d, now=NOW + 8 * 3600)
+    states = {a["id"]: a["state"] for a in doc["alerts"]}
+    assert states["availability:fast"] == "resolved"
+    assert doc["ok"]
+    assert not slo.firing_alerts(d)
+
+    doc = slo.evaluate(d, now=NOW + 8 * 3600 + 60)
+    states = {a["id"]: a["state"] for a in doc["alerts"]}
+    assert states["availability:fast"] == "inactive"
+
+
+def test_confirmation_window_blocks_stale_burn(tmp_path):
+    """An old burst still inside the 1h window but outside the 5m
+    confirmation window must NOT trip the fast alert (the multi-window
+    point: stale incidents don't page)."""
+    d = str(tmp_path)
+    spans = [
+        request_span(i, NOW - 3000 + i * 0.5, status=500) for i in range(100)
+    ]
+    spans += [
+        request_span(1000 + i, NOW - 200 + i, wall_ms=50.0) for i in range(100)
+    ]
+    write_spans(os.path.join(d, "serve_trace.jsonl"), spans)
+    doc = slo.evaluate(d, now=NOW)
+    states = {a["id"]: a["state"] for a in doc["alerts"]}
+    assert states["availability:fast"] == "inactive"
+
+
+def test_state_persists_and_is_atomic(tmp_path):
+    d = str(tmp_path)
+    _healthy_then_burst(d)
+    slo.evaluate(d, now=NOW)
+    state_file = slo.state_path(d)
+    assert os.path.exists(state_file)
+    # no staging leftovers from the atomic replace
+    leftovers = [n for n in os.listdir(d) if ".tmp-" in n]
+    assert leftovers == []
+    persisted = slo.load_alert_states(d)
+    assert persisted["availability:fast"]["state"] == "pending"
+    # a fresh process (fresh registry) reads the same machine state and
+    # advances it — pending -> firing on the next evaluation
+    slo.reset_statuses()
+    doc = slo.evaluate(d, now=NOW + 30)
+    states = {a["id"]: a["state"] for a in doc["alerts"]}
+    assert states["availability:fast"] == "firing"
+
+
+def test_latency_slo_budget(tmp_path):
+    d = str(tmp_path)
+    spans = [
+        request_span(i, NOW - 1800 + i, wall_ms=5000.0) for i in range(100)
+    ]
+    write_spans(os.path.join(d, "serve_trace.jsonl"), spans)
+    doc = slo.evaluate(d, now=NOW)
+    latency = next(s for s in doc["slos"] if s["name"] == "full-route-p95")
+    assert latency["bad_fraction"] == pytest.approx(1.0)
+    assert latency["budget"]["remaining_ratio"] == pytest.approx(0.0)
+    assert latency["latency_p95_ms"] >= 1000.0
+
+
+def test_status_document_shape_and_registry(tmp_path):
+    d = str(tmp_path)
+    _healthy_then_burst(d, burst_errors=0)
+    doc = slo.evaluate(d, now=NOW)
+    assert doc["ok"] and doc["firing"] == 0
+    for entry in doc["slos"]:
+        assert set(entry["burn_rates"]) == {"1h", "6h"}
+        assert 0.0 <= entry["budget"]["remaining_ratio"] <= 1.0
+    assert doc["recent"]["requests"] > 0
+    # the registry feeds the fleet-status join and the scrape collector
+    section = slo.slo_section(d)
+    assert section["ok"] is True
+    assert section["budgets"]
+    rendered = slo.render_slo_status(doc)
+    assert "inside SLO" in rendered
+
+
+def test_slo_section_from_persisted_state_only(tmp_path):
+    d = str(tmp_path)
+    _healthy_then_burst(d)
+    slo.evaluate(d, now=NOW)
+    slo.evaluate(d, now=NOW + 30)  # -> firing
+    slo.reset_statuses()  # "another process": no cached status
+    section = slo.slo_section(d)
+    assert section is not None
+    assert section["firing"] >= 1
+    assert section["ok"] is False
+    assert section["budgets"] is None
+
+
+def test_undeclared_alerts_are_dropped(tmp_path):
+    d = str(tmp_path)
+    _healthy_then_burst(d)
+    slo.evaluate(d, now=NOW)
+    state_file = slo.state_path(d)
+    with open(state_file) as handle:
+        state = json.load(handle)
+    state["alerts"]["ghost:fast"] = {"state": "firing", "severity": "page"}
+    with open(state_file, "w") as handle:
+        json.dump(state, handle)
+    slo.evaluate(d, now=NOW + 30)
+    assert "ghost:fast" not in slo.load_alert_states(d)
+
+
+def test_subset_parser_bad_value_raises_valueerror(tmp_path):
+    """literal_eval's SyntaxError (a `0..99` typo) must surface as the
+    contract's ValueError, so the CLI/route answer cleanly."""
+    path = tmp_path / "slos.toml"
+    path.write_text(
+        '[[slo]]\nname = "x"\nobjective = "availability"\ntarget = 0..99\n'
+    )
+    with pytest.raises(ValueError, match="bad value"):
+        slo._parse_toml_subset(path.read_text())
+
+
+def test_evaluate_cached_throttles(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    _healthy_then_burst(d, burst_errors=0)
+    calls = []
+    original = slo.evaluate
+
+    def counting(directory, *args, **kwargs):
+        calls.append(directory)
+        return original(directory, *args, **kwargs)
+
+    monkeypatch.setattr(slo, "evaluate", counting)
+    first = slo.evaluate_cached(d, max_age_s=3600)
+    second = slo.evaluate_cached(d, max_age_s=3600)
+    assert len(calls) == 1  # the second call served the cache
+    assert second is first
+    slo.evaluate_cached(d, max_age_s=0)  # 0 = always evaluate
+    assert len(calls) == 2
+
+
+def test_firing_alerts_staleness_cutoff(tmp_path):
+    """A state document whose evaluator died hours ago must not hold
+    promotions forever; a missing stamp stays conservative (holds)."""
+    d = str(tmp_path)
+    _healthy_then_burst(d)
+    slo.evaluate(d, now=NOW)
+    slo.evaluate(d, now=NOW + 30)  # -> firing, stamped at NOW + 30
+    assert slo.firing_alerts(d, severity="page")
+    # fresh enough within the bound (relative to the stamp, wall clock
+    # is far past NOW, so any finite bound is exceeded)
+    assert not slo.firing_alerts(
+        d, severity="page", max_age_s=slo.STALE_ALERT_HOLD_S
+    )
+    # no stamp at all -> unknown age -> conservative hold
+    state_file = slo.state_path(d)
+    with open(state_file) as handle:
+        state = json.load(handle)
+    state.pop("updated_at", None)
+    with open(state_file, "w") as handle:
+        json.dump(state, handle)
+    assert slo.firing_alerts(d, severity="page", max_age_s=60)
+
+
+def test_fleet_status_document_joins_slo(tmp_path):
+    from gordo_tpu.telemetry import fleet_status_document
+
+    d = str(tmp_path)
+    _healthy_then_burst(d)
+    slo.evaluate(d, now=NOW)
+    doc = fleet_status_document(d)
+    assert doc["slo"] is not None
+    assert doc["slo"]["pending"] >= 1
+    from gordo_tpu.telemetry import render_fleet_status
+
+    assert "SLO:" in render_fleet_status(doc)

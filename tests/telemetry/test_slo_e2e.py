@@ -1,0 +1,197 @@
+"""The ISSUE's acceptance drill, end to end through the real CLI:
+3 worker sinks + an injected server-error burst → aggregated rollups
+match per-worker sums exactly, the fast-burn alert transitions
+pending→firing within one evaluation and resolves after recovery, and
+``gordo-tpu slo check`` exits non-zero only while firing."""
+
+import json
+import os
+import time
+
+import pytest
+from click.testing import CliRunner
+
+from gordo_tpu.cli.cli import gordo_tpu_cli
+from gordo_tpu.telemetry import slo
+from gordo_tpu.telemetry.aggregate import RollupStore, summarize_rollup
+
+from .test_aggregate import request_span, write_spans
+
+pytestmark = pytest.mark.slo
+
+#: a drill-friendly objective set: 1% budget, fast threshold 10x — the
+#: burst must push the 1h bad fraction over 10%, recovery volume pulls
+#: it back under without waiting for windows to age out
+DRILL_SLOS = """
+[[slo]]
+name = "availability"
+objective = "availability"
+target = 0.99
+window = "30d"
+
+[burn]
+fast_window = "1h"
+fast_threshold = 10.0
+fast_severity = "page"
+slow_window = "6h"
+slow_threshold = 6.0
+slow_severity = "ticket"
+confirmation_divisor = 12
+"""
+
+WORKER_PIDS = (3001, 3002, 3003)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    slo.reset_statuses()
+    yield
+    slo.reset_statuses()
+
+
+def _write_phase(directory, now, phase):
+    """Per-worker spans for one drill phase; returns per-worker counts."""
+    counts = {}
+    for worker, pid in enumerate(WORKER_PIDS):
+        spans = []
+        errors = 0
+        if phase == "healthy":
+            # ~45 min of clean traffic per worker
+            for i in range(700):
+                spans.append(
+                    request_span(
+                        i, now - 2700 + i * 3.5, wall_ms=80.0,
+                        trace_prefix=pid,
+                    )
+                )
+        elif phase == "burst":
+            # the injected server-error burst, just now
+            for i in range(120):
+                spans.append(
+                    request_span(
+                        5_000 + i, now - 120 + i, status=500,
+                        trace_prefix=pid,
+                    )
+                )
+                errors += 1
+        elif phase == "recovery":
+            # heavy clean traffic drowns the burst inside every window
+            for i in range(3000):
+                spans.append(
+                    request_span(
+                        10_000 + i, now - 240 + i * 0.08, wall_ms=80.0,
+                        trace_prefix=pid,
+                    )
+                )
+        counts[pid] = {"requests": len(spans), "errors": errors}
+        write_spans(
+            os.path.join(directory, f"serve_trace-{pid}.jsonl"),
+            spans,
+            mode="a",
+        )
+    return counts
+
+
+def _check(directory):
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo_tpu_cli, ["slo", "check", directory, "--as-json"]
+    )
+    doc = json.loads(result.output[result.output.index("{"):])
+    return result.exit_code, doc
+
+
+def test_slo_drill_end_to_end(tmp_path):
+    d = str(tmp_path)
+    (tmp_path / "slos.toml").write_text(DRILL_SLOS)
+    now = time.time()
+
+    healthy = _write_phase(d, now, "healthy")
+
+    # 1. clean traffic: inside SLO, check exits 0
+    code, doc = _check(d)
+    assert code == 0, doc
+    assert doc["ok"] and doc["firing"] == 0
+
+    # aggregated rollups match per-worker sums EXACTLY
+    store = RollupStore(d)
+    summary = summarize_rollup(store.merged())
+    assert summary["requests"] == sum(
+        w["requests"] for w in healthy.values()
+    )
+    assert summary["errors"] == 0
+
+    # 2. the burst: first evaluation arms the alert (pending, exit 0)
+    burst = _write_phase(d, now, "burst")
+    code, doc = _check(d)
+    states = {a["id"]: a["state"] for a in doc["alerts"]}
+    assert states["availability:fast"] == "pending"
+    assert code == 0
+
+    # per-worker sums still exact after the burst folds in
+    summary = summarize_rollup(RollupStore(d).merged())
+    expected_requests = sum(
+        w["requests"] for w in healthy.values()
+    ) + sum(w["requests"] for w in burst.values())
+    expected_errors = sum(w["errors"] for w in burst.values())
+    assert summary["requests"] == expected_requests
+    assert summary["errors"] == expected_errors
+
+    # 3. pending -> firing within ONE evaluation; check exits non-zero
+    code, doc = _check(d)
+    states = {a["id"]: a["state"] for a in doc["alerts"]}
+    assert states["availability:fast"] == "firing"
+    assert code == 1
+    assert not doc["ok"]
+
+    # the persisted state machine agrees (what lifecycle reads)
+    assert [a["id"] for a in slo.firing_alerts(d, severity="page")] == [
+        "availability:fast"
+    ]
+
+    # 4. recovery: clean volume pulls every window under threshold —
+    # firing -> resolved, and check exits 0 again
+    _write_phase(d, now, "recovery")
+    code, doc = _check(d)
+    states = {a["id"]: a["state"] for a in doc["alerts"]}
+    assert states["availability:fast"] == "resolved"
+    assert code == 0
+    assert doc["ok"]
+
+    # 5. and the cycle closes: resolved -> inactive
+    code, doc = _check(d)
+    states = {a["id"]: a["state"] for a in doc["alerts"]}
+    assert states["availability:fast"] == "inactive"
+    assert code == 0
+
+
+def test_slo_status_cli_renders(tmp_path):
+    d = str(tmp_path)
+    (tmp_path / "slos.toml").write_text(DRILL_SLOS)
+    now = time.time()
+    _write_phase(d, now, "healthy")
+    runner = CliRunner()
+    result = runner.invoke(gordo_tpu_cli, ["slo", "status", d])
+    assert result.exit_code == 0, result.output
+    assert "availability" in result.output
+    assert "budget remaining" in result.output
+    assert "inside SLO" in result.output
+
+
+def test_slo_cli_rejects_missing_directory():
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo_tpu_cli, ["slo", "check", "/nonexistent-drill-dir"]
+    )
+    assert result.exit_code != 0
+    assert "No such directory" in result.output
+
+
+def test_slo_cli_rejects_bad_config(tmp_path):
+    (tmp_path / "slos.toml").write_text(
+        '[[slo]]\nname = "x"\nobjective = "bogus"\ntarget = 0.9\n'
+    )
+    runner = CliRunner()
+    result = runner.invoke(gordo_tpu_cli, ["slo", "status", str(tmp_path)])
+    assert result.exit_code != 0
+    assert "Bad SLO config" in result.output

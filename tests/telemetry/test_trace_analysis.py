@@ -175,3 +175,49 @@ def test_trace_cli(synthetic_trace, tmp_path):
     result = runner.invoke(trace_cmd, [str(tmp_path / "nope")])
     assert result.exit_code != 0
     assert "No such trace" in result.output
+
+
+def test_trace_cli_since_and_last(synthetic_trace):
+    """--since/--last restrict the analysis window; the fixture's spans
+    all end at 2026-01-01T00:00:01Z, so a cutoff before that keeps them
+    and one after drops them."""
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import trace as trace_cmd
+
+    runner = CliRunner()
+    result = runner.invoke(
+        trace_cmd,
+        [synthetic_trace, "--since", "2025-12-31T00:00:00+00:00", "--as-json"],
+    )
+    assert result.exit_code == 0, result.output
+    doc = json.loads(result.output)
+    assert doc["request_breakdown"]["requests"] == 10
+    assert doc["window"]["since_ts"] is not None
+
+    result = runner.invoke(
+        trace_cmd,
+        [synthetic_trace, "--since", "2026-06-01T00:00:00+00:00", "--as-json"],
+    )
+    doc = json.loads(result.output)
+    assert doc["spans_read"] == 0
+
+    # --last measures back from NOW: the 2026-01-01 fixture spans are in
+    # the past, so a short trailing window is empty
+    result = runner.invoke(
+        trace_cmd, [synthetic_trace, "--last", "1h", "--as-json"]
+    )
+    doc = json.loads(result.output)
+    assert doc["spans_read"] == 0
+
+    # exclusive options and unparseable cutoffs are clean errors
+    result = runner.invoke(
+        trace_cmd, [synthetic_trace, "--since", "x", "--last", "1h"]
+    )
+    assert result.exit_code != 0
+    assert "exclusive" in result.output
+    result = runner.invoke(trace_cmd, [synthetic_trace, "--since", "whenever"])
+    assert result.exit_code != 0
+    assert "Unparseable" in result.output
+    result = runner.invoke(trace_cmd, [synthetic_trace, "--last", "soonish"])
+    assert result.exit_code != 0

@@ -1,0 +1,213 @@
+"""The multi-worker sink split: pid-suffixed sink paths under gunicorn,
+and the read-merge every surface does over them — the regression for
+N workers silently overwriting each other's ``fleet_health.json`` /
+racing each other's ``serve_trace.jsonl`` rotations."""
+
+import json
+import os
+
+import pytest
+
+from gordo_tpu.telemetry import fleet_health
+from gordo_tpu.telemetry.fleet_health import (
+    FleetHealthLedger,
+    load_merged_health,
+    merge_health_documents,
+)
+from gordo_tpu.telemetry.recorder import worker_sink_path, worker_sinks_enabled
+from gordo_tpu.telemetry.trace_analysis import analyze_trace, trace_bases
+
+from .test_aggregate import NOW, request_span, stage_span, write_spans
+
+pytestmark = [pytest.mark.slo, pytest.mark.fleet_health]
+
+
+@pytest.fixture(autouse=True)
+def _no_multiproc(monkeypatch):
+    monkeypatch.delenv("PROMETHEUS_MULTIPROC_DIR", raising=False)
+    monkeypatch.delenv("prometheus_multiproc_dir", raising=False)
+    monkeypatch.delenv("GORDO_TPU_WORKER_SINKS", raising=False)
+
+
+# -- the switch ---------------------------------------------------------------
+
+
+def test_worker_sinks_default_off_single_process():
+    assert not worker_sinks_enabled()
+    assert worker_sink_path("/x/serve_trace.jsonl") == "/x/serve_trace.jsonl"
+
+
+def test_worker_sinks_auto_on_under_multiproc(monkeypatch, tmp_path):
+    monkeypatch.setenv("PROMETHEUS_MULTIPROC_DIR", str(tmp_path))
+    assert worker_sinks_enabled()
+    suffixed = worker_sink_path("/x/serve_trace.jsonl")
+    assert suffixed == f"/x/serve_trace-{os.getpid()}.jsonl"
+    # explicit off overrides the auto-detection
+    monkeypatch.setenv("GORDO_TPU_WORKER_SINKS", "0")
+    assert not worker_sinks_enabled()
+
+
+def test_worker_sinks_explicit_on(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_WORKER_SINKS", "1")
+    assert worker_sink_path("/x/fleet_health.json") == (
+        f"/x/fleet_health-{os.getpid()}.json"
+    )
+
+
+def test_serve_trace_path_gets_suffix(monkeypatch, tmp_path):
+    from gordo_tpu.telemetry.serving import serve_trace_path
+
+    monkeypatch.setenv("GORDO_TPU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("GORDO_TPU_WORKER_SINKS", "1")
+    assert serve_trace_path() == os.path.join(
+        str(tmp_path), f"serve_trace-{os.getpid()}.jsonl"
+    )
+
+
+def test_ledger_path_gets_suffix(monkeypatch, tmp_path):
+    monkeypatch.setenv("GORDO_TPU_WORKER_SINKS", "1")
+    ledger = FleetHealthLedger(directory=str(tmp_path))
+    assert ledger.path == os.path.join(
+        str(tmp_path), f"fleet_health-{os.getpid()}.json"
+    )
+
+
+# -- the health merge ---------------------------------------------------------
+
+
+def _worker_ledger_doc(requests, errors, rows=0, residual=None):
+    ledger = FleetHealthLedger(directory=None)
+    for i in range(requests):
+        ledger.record_request("m-1", error=i < errors)
+    if rows:
+        ledger.record_scores("m-1", rows, residual, write=False)
+    return ledger.document()
+
+
+def test_merge_health_documents_sums_red_counts(tmp_path):
+    """The satellite regression: 3 simulated workers' snapshots —
+    aggregated RED counts == sum of per-worker counts."""
+    per_worker = [(100, 5), (200, 0), (50, 2)]
+    docs = []
+    for pid, (requests, errors) in zip((9001, 9002, 9003), per_worker):
+        doc = _worker_ledger_doc(requests, errors)
+        path = tmp_path / f"fleet_health-{pid}.json"
+        path.write_text(json.dumps(doc))
+        docs.append(doc)
+    merged = load_merged_health(str(tmp_path))
+    machine = merged["machines"]["m-1"]
+    assert machine["serving"]["requests"] == sum(r for r, _ in per_worker)
+    assert machine["serving"]["errors"] == sum(e for _, e in per_worker)
+    summary = merged["summary"]
+    assert summary["requests"] == sum(r for r, _ in per_worker)
+    assert summary["errors"] == sum(e for _, e in per_worker)
+    assert merged["workers_merged"] == 3
+
+
+def test_merge_weights_residual_mean_by_rows():
+    docs = [
+        _worker_ledger_doc(10, 0, rows=100, residual=1.0),
+        _worker_ledger_doc(10, 0, rows=300, residual=5.0),
+    ]
+    merged = merge_health_documents(docs)
+    residual = merged["machines"]["m-1"]["serving"]["residual_mean"]
+    assert residual == pytest.approx((1.0 * 100 + 5.0 * 300) / 400)
+
+
+def test_merge_newest_state_section_wins():
+    old = _worker_ledger_doc(1, 0)
+    new = _worker_ledger_doc(1, 0)
+    old["machines"]["m-1"]["drift"].update(
+        {"drifted": True, "evaluated_at": "2026-01-01T00:00:00+00:00"}
+    )
+    new["machines"]["m-1"]["drift"].update(
+        {"drifted": False, "evaluated_at": "2026-02-01T00:00:00+00:00"}
+    )
+    merged = merge_health_documents([old, new])
+    assert merged["machines"]["m-1"]["drift"]["drifted"] is False
+    # order independence: the newest stamp wins either way
+    merged = merge_health_documents([new, old])
+    assert merged["machines"]["m-1"]["drift"]["drifted"] is False
+
+
+def test_merge_recomputes_health_and_summary():
+    doc = _worker_ledger_doc(100, 50)  # heavy error rate
+    merged = merge_health_documents([doc])
+    machine = merged["machines"]["m-1"]
+    assert machine["health"]["score"] < 1.0
+    assert merged["summary"]["machines"] == 1
+
+
+def test_fleet_status_document_merges_worker_snapshots(tmp_path, monkeypatch):
+    """The joined console over a dir where 3 workers snapshotted."""
+    from gordo_tpu.telemetry import fleet_status_document
+
+    monkeypatch.setenv("GORDO_TPU_WORKER_SINKS", "1")
+    fleet_health.reset_ledgers()
+    try:
+        for pid, requests in zip((9001, 9002), (10, 20)):
+            doc = _worker_ledger_doc(requests, 0)
+            (tmp_path / f"fleet_health-{pid}.json").write_text(
+                json.dumps(doc)
+            )
+        # plus THIS process's live ledger, which has persisted its own
+        # pid-suffixed snapshot — the live doc must not double-count
+        # with its own file
+        ledger = fleet_health.ledger_for(str(tmp_path))
+        for _ in range(5):
+            ledger.record_request("m-1")
+        ledger.flush()
+        doc = fleet_status_document(str(tmp_path))
+        assert doc["health"]["machines"]["m-1"]["serving"]["requests"] == 35
+        assert doc["health"]["workers_merged"] == 3
+    finally:
+        fleet_health.reset_ledgers()
+
+
+# -- the trace merge ----------------------------------------------------------
+
+
+def test_trace_analysis_read_merges_worker_sinks(tmp_path):
+    d = str(tmp_path)
+    total = 0
+    for pid in (7001, 7002, 7003):
+        spans = []
+        for i in range(10):
+            spans.append(
+                request_span(i, NOW + i, wall_ms=100.0, trace_prefix=pid)
+            )
+            spans.append(stage_span(i, NOW + i, trace_prefix=pid))
+            total += 1
+        write_spans(os.path.join(d, f"serve_trace-{pid}.jsonl"), spans)
+    bases = trace_bases(d, "serve_trace.jsonl")
+    assert len(bases) == 3
+    doc = analyze_trace(bases)
+    assert doc["span_summary"]["request"]["count"] == total
+    assert doc["request_breakdown"]["requests"] == total
+
+
+def test_trace_since_skips_cold_generations(tmp_path, monkeypatch):
+    from gordo_tpu.telemetry import trace_analysis
+
+    d = str(tmp_path)
+    base = os.path.join(d, "serve_trace.jsonl")
+    old = [request_span(i, NOW - 7 * 86400) for i in range(5)]
+    new = [request_span(100 + i, NOW) for i in range(3)]
+    write_spans(base + ".1", old)
+    write_spans(base, old + new)
+    # age the rotated generation's mtime a week back
+    os.utime(base + ".1", (NOW - 7 * 86400, NOW - 7 * 86400))
+
+    opened = []
+    original_open = open
+
+    def counting_open(path, *args, **kwargs):
+        opened.append(path)
+        return original_open(path, *args, **kwargs)
+
+    monkeypatch.setattr("builtins.open", counting_open)
+    doc = trace_analysis.analyze_trace(base, since_ts=NOW - 3600)
+    # the week-old generation was never opened, and only the in-window
+    # spans were analyzed
+    assert not any(str(p).endswith(".1") for p in opened)
+    assert doc["span_summary"]["request"]["count"] == 3
