@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve test-planner test-lifecycle test-lifecycle-faults test-analysis test-fleet-health test-slo docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-check lint lint-gordo image
+.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-fleet-health test-slo docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-check lint lint-gordo image
 
 test:
 	python -m pytest tests/ -q
@@ -22,6 +22,13 @@ test-observability:
 # slow-marked, so the same tests also run inside the tier-1 budget.
 test-serve:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serve
+
+# The columnar wire-format suite: content negotiation, JSON/Arrow
+# codec parity (byte-identical JSON, numerically identical Arrow),
+# malformed-body/406 contracts, mixed-format concurrency — CPU-only and
+# not slow-marked, so the same tests also run inside the tier-1 budget.
+test-wire:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m wire
 
 # The build-planner suite: cost model + calibration, bucket packing,
 # FleetPlan determinism/replay, plan-aware resume — CPU-only and not

@@ -60,6 +60,10 @@ class Client:
         the GIL during IO).
     use_parquet
         Send/receive parquet instead of JSON payloads.
+    use_arrow
+        Send/receive columnar Arrow-IPC bodies (the server's wire fast
+        path — zero JSON parse on either side). Takes precedence over
+        ``use_parquet``; requires pyarrow on both ends.
     session
         A ``requests.Session``-compatible object (tests inject an
         in-process WSGI adapter here).
@@ -79,6 +83,7 @@ class Client:
         parallelism: int = 10,
         n_retries: int = 5,
         use_parquet: bool = False,
+        use_arrow: bool = False,
         session=None,
     ):
         self.project_name = project
@@ -91,6 +96,7 @@ class Client:
         self.parallelism = parallelism
         self.n_retries = n_retries
         self.use_parquet = use_parquet
+        self.use_arrow = use_arrow
         self.session = session if session is not None else requests.Session()
 
     # -- discovery -----------------------------------------------------------
@@ -418,7 +424,24 @@ class Client:
         last_exc: Optional[Exception] = None
         for attempt in range(max(1, self.n_retries)):
             try:
-                if self.use_parquet:
+                if self.use_arrow:
+                    # columnar wire: one IPC stream with role-tagged
+                    # X/y columns out, a record batch back
+                    from .utils import (
+                        ARROW_CONTENT_TYPE,
+                        dataframe_into_arrow_bytes,
+                    )
+
+                    resp = self.session.post(
+                        url,
+                        params=params,
+                        data=dataframe_into_arrow_bytes(X, y),
+                        headers={
+                            "Content-Type": ARROW_CONTENT_TYPE,
+                            "Accept": ARROW_CONTENT_TYPE,
+                        },
+                    )
+                elif self.use_parquet:
                     params = {**params, "format": "parquet"}
                     files = {"X": dataframe_into_parquet_bytes(X)}
                     if y is not None:
@@ -443,5 +466,9 @@ class Client:
         else:
             raise last_exc
         if isinstance(payload, bytes):
+            if self.use_arrow:
+                from .utils import dataframe_from_arrow_bytes
+
+                return dataframe_from_arrow_bytes(payload)
             return dataframe_from_parquet_bytes(payload)
         return dataframe_from_dict(payload["data"])

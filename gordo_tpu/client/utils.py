@@ -1,9 +1,46 @@
 """
 Client-side helper types (reference: gordo-client ``utils`` module —
 ``PredictionResult`` carrying one machine's joined predictions plus any
-per-batch error messages).
+per-batch error messages) and the columnar-wire decode helpers: thin
+client-facing wrappers over the server's shared codec
+(``gordo_tpu.server.wire`` — the one place the Arrow schema conventions
+live, so client and server can never drift).
 """
 
 from collections import namedtuple
+from typing import Optional, Tuple
+
+import pandas as pd
+
+from ..server.wire.arrow_codec import ARROW_CONTENT_TYPE  # noqa: F401
 
 PredictionResult = namedtuple("PredictionResult", "name predictions error_messages")
+
+
+def dataframe_into_arrow_bytes(
+    X: pd.DataFrame, y: Optional[pd.DataFrame] = None
+) -> bytes:
+    """``X`` (and optionally ``y``) as one role-tagged Arrow IPC stream —
+    the columnar request body the server's wire fast path decodes
+    zero-copy."""
+    from ..server.wire.arrow_codec import encode_request
+
+    return encode_request(X, y)
+
+
+def dataframe_from_arrow_bytes(buf: bytes) -> pd.DataFrame:
+    """An Arrow response body as the same MultiIndex-column frame
+    ``dataframe_from_dict(response["data"])`` yields for JSON clients
+    (envelope metadata — revision, time-seconds — is dropped; use
+    :func:`arrow_response_with_meta` to keep it)."""
+    frame, _ = arrow_response_with_meta(buf)
+    return frame
+
+
+def arrow_response_with_meta(buf: bytes) -> Tuple[pd.DataFrame, dict]:
+    """An Arrow response body as ``(frame, envelope)`` where
+    ``envelope`` carries the scalar response fields (``revision``,
+    ``time-seconds``)."""
+    from ..server.wire.arrow_codec import decode_response
+
+    return decode_response(buf)

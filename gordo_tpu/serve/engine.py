@@ -332,6 +332,15 @@ class ServeEngine:
 
             scatter_start = time.monotonic()
             with self._recorder.span("scatter"):
+                # recon is ONE host buffer for the whole batch (a single
+                # device→host transfer in np.asarray above); each request
+                # gets a zero-copy row VIEW of it, so scatter is pointer
+                # bookkeeping — the buffer lives as long as any view does.
+                # The per-item clock read is deliberate: batch_scatter
+                # must measure the loop's ACTUAL accumulated cost (a
+                # constant taken before the loop could never show a
+                # scatter regression, which is what the stage exists
+                # to surface).
                 for i, item in enumerate(live):
                     meta = {
                         "queue_wait": flush_start - item.enqueued_at,

@@ -93,6 +93,8 @@ class RequestContext:
         "model",
         "metadata",
         "info",
+        "resolution",
+        "deferred_stage",
         "X",
         "y",
     )
@@ -137,6 +139,16 @@ class RequestContext:
         self.model = None
         self.metadata: Optional[dict] = None
         self.info: Optional[dict] = None
+        self.resolution = None  # fleet ModelResolution (resolve_model)
+        # (name, start_time) of a stage that ends WITH the request —
+        # the wire fast path's serialize: after the encode there is
+        # only response construction (~30µs), but under thread load the
+        # GIL preemption a long encode earns lands exactly after the
+        # stage's closing clock read, so a conventional span would leak
+        # the parked tail into unattributed walltime (measured ~20ms
+        # p50 at 16 threads — the whole attribution-coverage gap).
+        # _finalize closes the interval at the request's own end clock.
+        self.deferred_stage: Optional[tuple] = None
         self.X = None
         self.y = None
 
@@ -167,6 +179,16 @@ class RequestContext:
         with self.stage("serialize"):
             body = simplejson.dumps(payload, default=str, ignore_nan=True)
         return Response(body, status=status, mimetype="application/json")
+
+    def raw_response(
+        self, body, mimetype: str, status: int = 200
+    ) -> Response:
+        """A pre-serialized response: the wire fast path encodes inside
+        the handler's own ``serialize`` stage (JSON bytes, Arrow IPC, or
+        a streamed chunk iterator) and hands the finished body here —
+        re-serializing through :meth:`json_response` would walk the
+        payload again."""
+        return Response(body, status=status, mimetype=mimetype)
 
     def file_response(
         self, data: bytes, download_name: Optional[str] = None
@@ -383,6 +405,12 @@ class GordoServerApp:
         )
 
         runtime_s = timeit.default_timer() - ctx.start_time
+        if ctx.deferred_stage is not None:
+            name, stage_start = ctx.deferred_stage
+            ctx.deferred_stage = None
+            ctx.timing.record(
+                name, max(0.0, timeit.default_timer() - stage_start)
+            )
         logger.debug("Total runtime for request: %ss", runtime_s)
         durations = ctx.timing.durations()
         entries = [

@@ -73,6 +73,99 @@ class ModelLoadError(Exception):
     """
 
 
+class ModelResolution:
+    """
+    Everything the serving routes derive from one model's artifacts, at
+    most once per revision: the loaded model, parsed metadata/info, tag
+    lists (both as :class:`SensorTag` and as plain names), the training
+    frequency offset, the detector's threshold arrays, and the wire
+    column-alignment plans. BENCH_ROUTE.json measured ``model_resolve``
+    at 50.9ms p50 (7.5% of the route) — almost all of it the per-request
+    zlib+pickle metadata round-trip and tag re-normalization this object
+    exists to not repeat: a request now pays dict probes.
+
+    Pinned to the :class:`RevisionFleet` snapshot, so the DELETE/hot-swap
+    invalidation contract is inherited wholesale (an invalidated revision
+    drops its fleet object, resolutions and all); callers still re-check
+    ``metadata.json`` existence per request, as with every other cache.
+    """
+
+    __slots__ = (
+        "name",
+        "model",
+        "metadata",
+        "info",
+        "tags",
+        "target_tags",
+        "tag_names",
+        "target_names",
+        "feature_thresholds",
+        "aggregate_threshold",
+        "_frequency",
+        "_plans",
+    )
+
+    def __init__(self, name: str, model: Any, metadata: dict, info: dict):
+        from types import SimpleNamespace
+
+        from .properties import get_frequency, get_tags, get_target_tags
+
+        self.name = name
+        self.model = model
+        self.metadata = metadata
+        self.info = info
+        carrier = SimpleNamespace(metadata=metadata)
+        self.tags = get_tags(carrier)
+        self.target_tags = get_target_tags(carrier)
+        self.tag_names = [t.name for t in self.tags]
+        self.target_names = [t.name for t in self.target_tags]
+        try:
+            self._frequency = ("ok", get_frequency(carrier))
+        except Exception as exc:  # noqa: BLE001 - re-raised per access
+            self._frequency = ("error", exc)
+        thresholds = getattr(model, "feature_thresholds_", None)
+        self.feature_thresholds = (
+            np.asarray(thresholds.values, dtype=float)
+            if thresholds is not None
+            else None
+        )
+        aggregate = getattr(model, "aggregate_threshold_", None)
+        self.aggregate_threshold = (
+            float(aggregate) if aggregate is not None else None
+        )
+        self._plans: Dict[Tuple, Tuple[str, ...]] = {}
+
+    @property
+    def frequency(self):
+        """The training resolution as a pandas offset. Errors are cached
+        too and re-raised per access — the route's error contract for a
+        bad ``dataset.resolution`` must not depend on cache state."""
+        kind, value = self._frequency
+        if kind == "error":
+            raise value
+        return value
+
+    def alignment(
+        self, names: Tuple[str, ...], expected: Tuple[str, ...]
+    ) -> Optional[Tuple[str, ...]]:
+        """The cached column-selection plan for a client column set
+        against ``expected`` tag order: the tuple of client column names
+        to stack, or None when no plan is cached yet. Bounded: plans are
+        keyed by client-supplied column tuples, so the dict is capped
+        against adversarial churn."""
+        return self._plans.get((names, expected))
+
+    def remember_alignment(
+        self,
+        names: Tuple[str, ...],
+        expected: Tuple[str, ...],
+        order: Tuple[str, ...],
+    ) -> None:
+        if len(self._plans) >= 1024:
+            self._plans.clear()
+        self._plans[(names, expected)] = order
+
+
 class RevisionFleet:
     """
     All models of one revision directory, loaded lazily but retained for
@@ -92,6 +185,8 @@ class RevisionFleet:
         # bucket lookup). Never mutate these dicts in place.
         self._models: Dict[str, Any] = {}
         self._specs: Dict[str, Any] = {}  # name -> spec (JAX models only)
+        #: name -> ModelResolution (COW, same discipline as _models)
+        self._resolutions: Dict[str, ModelResolution] = {}
         #: spec -> (names, stacked params, epoch stamped at build)
         self._stacked: Dict[Any, Tuple[List[str], Any, int]] = {}
         self._bucket_epoch = 0  # bumped on every membership change
@@ -125,6 +220,32 @@ class RevisionFleet:
                 self._stacked.pop(estimator.spec_, None)  # bucket grew; restack
                 self._bucket_epoch += 1
         return model
+
+    def resolution(self, name: str) -> ModelResolution:
+        """The cached :class:`ModelResolution` for ``name`` — model,
+        parsed metadata, tag lists, frequency, thresholds, alignment
+        plans — built at most once per revision (lock-free COW read on
+        the hot path, like :meth:`model`). Raises ``FileNotFoundError``
+        when the artifacts are gone (the routes' 404 contract)."""
+        cached = self._resolutions.get(name)  # lock-free: COW
+        if cached is not None:
+            return cached
+        model = self.model(name)
+        model_dir = os.path.join(self.collection_dir, name)
+        metadata = serializer.load_metadata(model_dir)
+        try:
+            info = serializer.load_info(model_dir)
+        except FileNotFoundError:
+            info = {}
+        resolution = ModelResolution(name, model, metadata, info)
+        with self._lock:
+            existing = self._resolutions.get(name)
+            if existing is not None:
+                return existing
+            resolutions = dict(self._resolutions)
+            resolutions[name] = resolution
+            self._resolutions = resolutions
+        return resolution
 
     def warm(self, names: Optional[List[str]] = None) -> List[str]:
         """Load every model in the revision dir (or ``names``); returns the

@@ -15,7 +15,12 @@ from ..dataset.sensor_tag import SensorTag, normalize_sensor_tags
 
 
 def get_frequency(ctx):
-    """The training resolution as a pandas offset (reference :45-49)."""
+    """The training resolution as a pandas offset (reference :45-49).
+    Served requests resolved through the fleet's resolution cache answer
+    from it (including a cached parse error, re-raised unchanged)."""
+    resolution = getattr(ctx, "resolution", None)
+    if resolution is not None:
+        return resolution.frequency
     return pd.tseries.frequencies.to_offset(ctx.metadata["dataset"]["resolution"])
 
 
@@ -28,13 +33,20 @@ def _dataset_asset(dataset: dict) -> Optional[str]:
 
 
 def get_tags(ctx) -> List[SensorTag]:
-    """The model's input tags."""
+    """The model's input tags (cached on the fleet resolution when the
+    request resolved through it)."""
+    resolution = getattr(ctx, "resolution", None)
+    if resolution is not None:
+        return resolution.tags
     dataset = ctx.metadata["dataset"]
     return normalize_sensor_tags(dataset["tag_list"], asset=_dataset_asset(dataset))
 
 
 def get_target_tags(ctx) -> List[SensorTag]:
     """The model's target tags; defaults to the input tags."""
+    resolution = getattr(ctx, "resolution", None)
+    if resolution is not None:
+        return resolution.target_tags
     dataset = ctx.metadata["dataset"]
     target_tag_list = dataset.get("target_tag_list")
     if target_tag_list:

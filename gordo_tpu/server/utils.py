@@ -31,8 +31,14 @@ from typing import List, Optional
 import dateutil.parser
 import numpy as np
 import pandas as pd
-import pyarrow as pa
-import pyarrow.parquet as pq
+
+try:  # pyarrow is an optional extra: JSON serving works without it,
+    # parquet/Arrow wire formats negotiate themselves away (406/415)
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+except ImportError:  # pragma: no cover - exercised via monkeypatch
+    pa = None
+    pq = None
 
 from .. import serializer
 
@@ -64,8 +70,18 @@ def validate_gordo_name(gordo_name: str):
 # -- parquet / JSON dataframe wire formats ---------------------------------
 
 
+def _require_parquet():
+    if pa is None:
+        raise ServerError(
+            "Parquet wire format unavailable (pyarrow not installed); "
+            "use JSON",
+            status=415,
+        )
+
+
 def dataframe_into_parquet_bytes(df: pd.DataFrame, compression: str = "snappy") -> bytes:
     """Serialize a DataFrame to parquet bytes (the binary wire format)."""
+    _require_parquet()
     table = pa.Table.from_pandas(df)
     buf = pa.BufferOutputStream()
     pq.write_table(table, buf, compression=compression)
@@ -74,6 +90,7 @@ def dataframe_into_parquet_bytes(df: pd.DataFrame, compression: str = "snappy") 
 
 def dataframe_from_parquet_bytes(buf: bytes) -> pd.DataFrame:
     """Inverse of :func:`dataframe_into_parquet_bytes`."""
+    _require_parquet()
     return pq.read_table(io.BytesIO(buf)).to_pandas()
 
 
@@ -232,19 +249,109 @@ def verify_dataframe(df: pd.DataFrame, expected_columns: List[str]) -> pd.DataFr
     return df[expected_columns]
 
 
+def frame_from_columns(
+    resolution,
+    columns,
+    index,
+    expected: List[str],
+) -> pd.DataFrame:
+    """A verified model-input frame out of decoded Arrow columns, with
+    ``verify_dataframe``'s alignment semantics (expected order selected,
+    extras dropped, full-width positional rename, otherwise 400) — but
+    the selection plan is computed once per (revision, column-set) and
+    cached on the fleet's resolution object, so a steady client's
+    requests pay a tuple-keyed dict probe, not set algebra."""
+    from .wire.arrow_codec import columns_to_frame
+
+    names = tuple(columns)
+    expected_t = tuple(expected)
+    order = resolution.alignment(names, expected_t) if resolution else None
+    if order is None:
+        if all(name in columns for name in expected_t):
+            order = expected_t
+        elif len(names) == len(expected_t):
+            # full-width positional rename, like verify_dataframe's
+            # unlabeled-array branch
+            order = names
+        else:
+            raise ServerError(
+                f"Unexpected features: was expecting {list(expected_t)} "
+                f"length of {len(expected_t)}, but got "
+                f"{list(names)} length of {len(names)}",
+                status=400,
+            )
+        if resolution is not None:
+            resolution.remember_alignment(names, expected_t, order)
+    frame = columns_to_frame(columns, index, list(order))
+    if tuple(order) != expected_t:
+        # positional branch: client names differ but width matches —
+        # adopt the model's tag names, like verify_dataframe
+        frame.columns = list(expected_t)
+    return frame
+
+
+def _extract_arrow(ctx) -> None:
+    """Arrow-IPC request body → ``ctx.X``/``ctx.y`` — the zero-copy
+    decode path: columns come off the received buffer as numpy views and
+    one ``column_stack`` builds the model-input frame (no JSON parse, no
+    per-cell dict walk)."""
+    from .properties import get_tags, get_target_tags
+    from .wire.arrow_codec import ArrowDecodeError, decode_frames
+
+    try:
+        x_columns, y_columns, index = decode_frames(ctx.request.get_data())
+    except ArrowDecodeError as exc:
+        raise ServerError(str(exc), status=400)
+    resolution = getattr(ctx, "resolution", None)
+    expected_x = [t.name for t in get_tags(ctx)]
+    try:
+        ctx.X = frame_from_columns(resolution, x_columns, index, expected_x)
+        if y_columns:
+            expected_y = [t.name for t in get_target_tags(ctx)]
+            ctx.y = frame_from_columns(
+                resolution, y_columns, index, expected_y
+            )
+        else:
+            ctx.y = None
+    except ServerError:
+        raise
+    except (ValueError, TypeError) as exc:
+        raise ServerError(f"Invalid Arrow body: {exc}", status=400)
+
+
 def extract_X_y(ctx) -> None:
     """
-    Pull ``X`` (and optionally ``y``) out of a POST request — either a JSON
-    body ``{"X": {...}, "y": {...}}`` or multipart parquet files — verify
-    them against the model's tags, and stash them on the context
-    (reference utils.py:256-331).
+    Pull ``X`` (and optionally ``y``) out of a POST request — a JSON
+    body ``{"X": {...}, "y": {...}}``, multipart parquet files, a raw
+    ``application/x-parquet`` body, or a columnar Arrow-IPC stream
+    (``Content-Type: application/vnd.apache.arrow.stream`` — see
+    ``docs/serving.md``) — verify them against the model's tags, and
+    stash them on the context (reference utils.py:256-331).
     """
     from .properties import get_tags, get_target_tags
+    from .wire import negotiate
 
     request = ctx.request
     start_time = timeit.default_timer()
     if request.method != "POST":
         raise ServerError(f"Cannot extract X and y from '{request.method}' request.")
+
+    body_format = negotiate.request_format(request)
+    if body_format == negotiate.ARROW:
+        _extract_arrow(ctx)
+        logger.debug(
+            "Arrow decode: X %s rows; parse time %.4fs",
+            len(ctx.X),
+            timeit.default_timer() - start_time,
+        )
+        return
+    if body_format == negotiate.PARQUET:
+        # raw-body parquet carries X only (y rides the multipart form or
+        # the Arrow stream's role-tagged columns)
+        X = dataframe_from_parquet_bytes(request.get_data())
+        X = verify_dataframe(X, [t.name for t in get_tags(ctx)])
+        ctx.X, ctx.y = X, None
+        return
 
     if request.is_json:
         body = request.get_json(silent=True) or {}
@@ -363,6 +470,27 @@ def delete_revision(directory: str, name: str):
         shutil.rmtree(directory, ignore_errors=True)
         if os.path.exists(directory):
             raise ServerError("Unable to delete this revision folder", status=500)
+
+
+def resolve_model(ctx, gordo_name: str):
+    """The scoring routes' model_resolve: load model + metadata + tag
+    lists onto the context through the fleet's per-revision
+    :class:`~.fleet_store.ModelResolution` cache — a request pays dict
+    probes plus one ``metadata.json`` existence re-check (the DELETE
+    staleness contract), not a zlib+pickle metadata round-trip. 404 on
+    miss, like :func:`require_model`."""
+    from .fleet_store import STORE
+
+    validate_gordo_name(gordo_name)
+    try:
+        check_metadata_file(ctx.collection_dir, gordo_name)
+        resolution = STORE.fleet(ctx.collection_dir).resolution(gordo_name)
+    except FileNotFoundError:
+        raise ServerError(f"No such model found: '{gordo_name}'", status=404)
+    ctx.resolution = resolution
+    ctx.model = resolution.model
+    ctx.metadata = resolution.metadata
+    ctx.info = resolution.info
 
 
 def require_model(ctx, gordo_name: str):

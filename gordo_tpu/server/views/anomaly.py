@@ -24,13 +24,28 @@ DELETED_FROM_RESPONSE_COLUMNS = (
 )
 
 
+def _unprocessable_response(ctx):
+    """The route's historical AttributeError → 422 mapping (non-detector
+    model OR ``require_thresholds`` unmet)."""
+    return ctx.json_response(
+        {
+            "message": "Model is not an AnomalyDetector, it is of type: "
+            f"{type(ctx.model)}"
+        },
+        status=422,
+    )
+
+
 def post_anomaly_prediction(ctx, gordo_project: str, gordo_name: str):
     from ...serve import BatchShedError
-    from .. import model_io
+    from .. import model_io, wire
+    from .base import encode_wire_response
 
     start_time = timeit.default_timer()
     with ctx.stage("model_resolve"):
-        server_utils.require_model(ctx, gordo_name)
+        server_utils.resolve_model(ctx, gordo_name)
+    # negotiate before decoding/scoring: unacceptable Accept → 406 early
+    response_format = wire.response_format(ctx.request)
     with ctx.stage("data_decode"):
         server_utils.extract_X_y(ctx)
 
@@ -40,6 +55,16 @@ def post_anomaly_prediction(ctx, gordo_project: str, gordo_name: str):
             status=400,
         )
 
+    keep_smooth = ctx.request.args.get("all_columns") is not None
+    # The columnar fast path: for the stock DiffBased detector family the
+    # reconstruction is the only model work — threshold/confidence math
+    # composes as numpy columns in response_assemble (same numbers, no
+    # MultiIndex frame). Custom detectors keep the legacy anomaly() path.
+    fast = wire.columnar_enabled() and wire.supports_columnar_anomaly(
+        ctx.model
+    )
+    anomaly_df = None
+    model_output = None
     try:
         with ctx.stage("inference"):
             # Micro-batching: when the detector accepts a precomputed
@@ -51,44 +76,76 @@ def post_anomaly_prediction(ctx, gordo_project: str, gordo_name: str):
                 model_output = model_io.batched_model_output(
                     ctx, gordo_name, ctx.X
                 )
+            if fast:
+                if model_output is None:
+                    # the same reconstruction anomaly() would compute
+                    model_output = (
+                        ctx.model.predict(ctx.X)
+                        if hasattr(ctx.model.base_estimator, "predict")
+                        else ctx.model.transform(ctx.X)
+                    )
+            else:
                 if model_output is not None:
                     kwargs["model_output"] = model_output
-            anomaly_df = ctx.model.anomaly(ctx.X, ctx.y, **kwargs)
+                anomaly_df = ctx.model.anomaly(ctx.X, ctx.y, **kwargs)
     except BatchShedError as exc:
         return model_io.shed_response(ctx, exc)
     except AttributeError:
-        return ctx.json_response(
-            {
-                "message": "Model is not an AnomalyDetector, it is of type: "
-                f"{type(ctx.model)}"
-            },
-            status=422,
-        )
+        return _unprocessable_response(ctx)
     except ValueError as err:
         # Client-data problem (e.g. fewer rows than a windowed model's
         # lookback) — same ValueError→400 contract as the base route.
         logger.error("Failed to compute anomalies: %s", err)
         return ctx.json_response({"error": f"ValueError: {err}"}, status=400)
 
-    # same response_assemble stage as the base route: column filtering +
-    # frame→wire-dict conversion is host-pipeline time the per-stage
-    # attribution must cover
-    with ctx.stage("response_assemble"):
-        if ctx.request.args.get("all_columns") is None:
-            columns_for_delete = [
-                column
-                for column in anomaly_df
-                if column[0] in DELETED_FROM_RESPONSE_COLUMNS
-            ]
-            anomaly_df = anomaly_df.drop(columns=columns_for_delete)
+    # same response_assemble stage as the base route: threshold math /
+    # column composition (fast path) or column filtering + frame walk
+    # (legacy) is host-pipeline time the per-stage attribution must cover
+    table = None
+    try:
+        with ctx.stage("response_assemble"):
+            if fast:
+                resolution = ctx.resolution
+                table = wire.anomaly_table(
+                    ctx.model,
+                    ctx.X,
+                    ctx.y,
+                    model_output,
+                    frequency=kwargs["frequency"],
+                    keep_smooth=keep_smooth,
+                    # the fleet resolution cache's pre-extracted
+                    # threshold arrays (same values, no per-request
+                    # Series→array extraction)
+                    thresholds=(
+                        resolution.feature_thresholds if resolution else None
+                    ),
+                    aggregate=(
+                        resolution.aggregate_threshold if resolution else None
+                    ),
+                )
+                if not table.unique_labels():
+                    table = None
+                    if model_io.accepts_model_output(ctx.model):
+                        kwargs["model_output"] = model_output
+                    anomaly_df = ctx.model.anomaly(ctx.X, ctx.y, **kwargs)
+            if table is None and not keep_smooth:
+                columns_for_delete = [
+                    column
+                    for column in anomaly_df
+                    if column[0] in DELETED_FROM_RESPONSE_COLUMNS
+                ]
+                anomaly_df = anomaly_df.drop(columns=columns_for_delete)
+    except AttributeError:
+        # require_thresholds unmet surfaces here on the fast path — the
+        # same 422 the legacy inference-stage anomaly() answered
+        return _unprocessable_response(ctx)
+    except ValueError as err:
+        logger.error("Failed to compute anomalies: %s", err)
+        return ctx.json_response({"error": f"ValueError: {err}"}, status=400)
 
-        if ctx.request.args.get("format") == "parquet":
-            payload = server_utils.dataframe_into_parquet_bytes(anomaly_df)
-        else:
-            payload = None
-            context: Dict[Any, Any] = dict()
-            context["data"] = server_utils.dataframe_to_dict(anomaly_df)
-    if payload is not None:
-        return ctx.file_response(payload)
-    context["time-seconds"] = f"{timeit.default_timer() - start_time:.4f}"
-    return ctx.json_response(context)
+    extra: Dict[Any, Any] = {}
+    if response_format != wire.PARQUET:
+        extra["time-seconds"] = f"{timeit.default_timer() - start_time:.4f}"
+    return encode_wire_response(
+        ctx, response_format, table=table, frame=anomaly_df, extra=extra
+    )

@@ -10,7 +10,7 @@ import logging
 import os
 import timeit
 import traceback
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 import pandas as pd
@@ -26,11 +26,94 @@ from ..properties import get_tags, get_target_tags
 logger = logging.getLogger(__name__)
 
 
+def encode_wire_response(
+    ctx,
+    response_format: str,
+    table=None,
+    frame=None,
+    extra: Optional[Dict[str, Any]] = None,
+    status: int = 200,
+):
+    """
+    The shared serialize stage of the scoring routes: one columnar
+    :class:`~..wire.WireTable` (the fast path) or one legacy MultiIndex
+    frame, encoded per the negotiated response format. ``extra`` carries
+    the scalar envelope fields in wire order (``time-seconds``);
+    ``revision`` is appended here for the fast encoders exactly where
+    ``json_response`` would have stamped it, so JSON bytes stay
+    byte-identical to the legacy serializer's.
+    """
+    from .. import wire
+
+    extra_items: Dict[str, Any] = dict(extra or {})
+    if ctx.revision is not None:
+        extra_items["revision"] = ctx.revision
+
+    # serialize is a DEFERRED stage: nothing meaningful runs between
+    # the encode and the request's end (response construction is ~30µs),
+    # so the interval closes at _finalize's end-of-request clock read —
+    # otherwise the GIL preemption a long encode earns under thread load
+    # parks the thread right after a conventional span's exit, leaking
+    # ~20ms p50 of scheduler wait into unattributed walltime. The
+    # sampling profiler still attributes encode frames to the stage via
+    # current_stage (left set until _finalize's record closes it).
+    serialize_start = timeit.default_timer()
+    ctx.current_stage = "serialize"
+
+    if response_format == wire.PARQUET:
+        payload = server_utils.dataframe_into_parquet_bytes(
+            frame if frame is not None else table.to_frame()
+        )
+        response = ctx.file_response(payload)
+        ctx.deferred_stage = ("serialize", serialize_start)
+        return response
+
+    if response_format == wire.ARROW:
+        if table is None:
+            # bridge legacy pandas assemblies (columnar switched off,
+            # custom detectors) into the Arrow encoder — only genuinely
+            # unrepresentable responses (duplicate labels) refuse
+            bridged = wire.WireTable.from_frame(frame)
+            if not bridged.unique_labels():
+                raise server_utils.ServerError(
+                    "Response columns are not representable as Arrow "
+                    "(duplicate labels); request JSON instead",
+                    status=400,
+                )
+            table = bridged
+        body = wire.encode_table(table, extra_items)
+        response = ctx.raw_response(body, wire.ARROW_CONTENT_TYPE, status)
+        ctx.deferred_stage = ("serialize", serialize_start)
+        return response
+
+    # JSON (the default wire format)
+    if table is None:
+        context: Dict[Any, Any] = {
+            "data": server_utils.dataframe_to_dict(frame)
+        }
+        context.update(extra or {})  # json_response appends revision
+        return ctx.json_response(context, status=status)
+    if wire.stream_enabled():
+        # streamed serialize: chunks encode during the WSGI write loop —
+        # off the request's instrumented path (docs/serving.md caveat)
+        return ctx.raw_response(
+            wire.iter_encode_response(table, extra_items),
+            wire.JSON_CONTENT_TYPE,
+            status,
+        )
+    body = wire.encode_response(table, extra_items)
+    response = ctx.raw_response(body, wire.JSON_CONTENT_TYPE, status)
+    ctx.deferred_stage = ("serialize", serialize_start)
+    return response
+
+
 def post_prediction(ctx, gordo_project: str, gordo_name: str):
     """
     Run the model on client-provided ``X`` and answer the
-    start/end/model-input/model-output response frame as JSON (or parquet
-    with ``?format=parquet``).
+    start/end/model-input/model-output response frame as JSON (the
+    default — byte-identical to the pre-columnar serializer), Arrow IPC
+    (``Accept: application/vnd.apache.arrow.stream``), or parquet
+    (``?format=parquet`` or content negotiation).
 
     With micro-batching on (``GORDO_TPU_BATCHING``), concurrent requests
     for same-architecture models coalesce into one fused fleet program
@@ -38,9 +121,13 @@ def post_prediction(ctx, gordo_project: str, gordo_name: str):
     everything unbatchable falls back to the model's own predict.
     """
     from ...serve import BatchShedError
+    from .. import wire
 
     with ctx.stage("model_resolve"):
-        server_utils.require_model(ctx, gordo_name)
+        server_utils.resolve_model(ctx, gordo_name)
+    # negotiate BEFORE decoding/scoring: an unacceptable Accept header
+    # answers 406 without paying for the model run
+    response_format = wire.response_format(ctx.request)
     with ctx.stage("data_decode"):
         server_utils.extract_X_y(ctx)
 
@@ -77,25 +164,34 @@ def post_prediction(ctx, gordo_project: str, gordo_name: str):
         timeit.default_timer() - process_request_start_time_s,
     )
     # response_assemble is its own stage (distinct from `serialize`, the
-    # JSON encode): frame construction + wire-dict conversion is a big
-    # slice of full-route walltime, and the per-stage attribution the
-    # trace/bench surfaces report must cover it to explain the route
+    # wire encode): response composition is a big slice of full-route
+    # walltime, and the per-stage attribution the trace/bench surfaces
+    # report must cover it to explain the route. The columnar fast path
+    # composes numpy columns; the legacy pandas frame remains the escape
+    # hatch (GORDO_TPU_WIRE_COLUMNAR=0) and the duplicate-label fallback.
+    table = None
+    frame = None
     with ctx.stage("response_assemble"):
-        data = model_utils.make_base_dataframe(
-            tags=get_tags(ctx),
-            model_input=X.values if isinstance(X, pd.DataFrame) else X,
-            model_output=output,
-            target_tag_list=get_target_tags(ctx),
-            index=X.index,
-        )
-        if ctx.request.args.get("format") == "parquet":
-            payload = server_utils.dataframe_into_parquet_bytes(data)
-        else:
-            payload = None
-            context["data"] = server_utils.dataframe_to_dict(data)
-    if payload is not None:
-        return ctx.file_response(payload)
-    return ctx.json_response(context)
+        if wire.columnar_enabled():
+            table = wire.prediction_table(
+                get_tags(ctx),
+                X if isinstance(X, pd.DataFrame) else pd.DataFrame(X),
+                output,
+                target_tags=get_target_tags(ctx),
+            )
+            if not table.unique_labels():
+                table = None
+        if table is None:
+            frame = model_utils.make_base_dataframe(
+                tags=get_tags(ctx),
+                model_input=X.values if isinstance(X, pd.DataFrame) else X,
+                model_output=output,
+                target_tag_list=get_target_tags(ctx),
+                index=X.index,
+            )
+    return encode_wire_response(
+        ctx, response_format, table=table, frame=frame
+    )
 
 
 def post_fleet_prediction(ctx, gordo_project: str):
@@ -118,53 +214,40 @@ def post_fleet_prediction(ctx, gordo_project: str):
     ``X`` per machine (autoencoder replay); a body ``"y"`` dict overrides
     per machine.
     """
-    from types import SimpleNamespace
-
     from ..fleet_store import STORE, ModelLoadError
+    from .. import wire
 
     request = ctx.request
-    body = request.get_json(silent=True) if request.is_json else None
-    if not body or not isinstance(body.get("X"), dict) or not body["X"]:
+    response_format = wire.response_format(request)
+    if response_format == wire.PARQUET:
         raise server_utils.ServerError(
-            'Fleet prediction needs a JSON body {"X": {<model-name>: frame}}'
+            "The fleet route serves JSON or Arrow, not parquet",
+            status=406,
         )
-    full = request.args.get("full") is not None or bool(body.get("full"))
-    keep_smooth = request.args.get("all_columns") is not None
-    y_payloads = body.get("y") if isinstance(body.get("y"), dict) else {}
+    fleet_for_meta = STORE.fleet(ctx.collection_dir)
 
     frames: Dict[str, pd.DataFrame] = {}
     y_frames: Dict[str, pd.DataFrame] = {}
     metadatas: Dict[str, Any] = {}
     errors: Dict[str, Dict[str, Any]] = {}
-    for name, payload in body["X"].items():
-        try:
-            server_utils.validate_gordo_name(name)
-            server_utils.check_metadata_file(ctx.collection_dir, name)
-            metadata = server_utils.load_metadata(ctx.collection_dir, name)
-            frame = server_utils.dataframe_from_dict(payload)
-            tags = get_tags(SimpleNamespace(metadata=metadata))
-            frames[name] = server_utils.verify_dataframe(
-                frame, [t.name for t in tags]
+
+    def resolve_machine(name: str):
+        """Per-machine resolution through the fleet cache, mapped to the
+        route's per-machine error entries (never the whole batch's)."""
+        server_utils.validate_gordo_name(name)
+        server_utils.check_metadata_file(ctx.collection_dir, name)
+        return fleet_for_meta.resolution(name)
+
+    body_format = wire.request_format(request)
+    with ctx.stage("data_decode"):
+        if body_format == wire.ARROW:
+            full, keep_smooth = _decode_fleet_arrow(
+                ctx, resolve_machine, frames, y_frames, metadatas, errors
             )
-            metadatas[name] = metadata
-            if name in y_payloads:
-                # verify/reorder y exactly like the single-model route
-                # (extract_X_y): an unverified y dict with shuffled or
-                # wrong columns would silently misalign the detector's
-                # scaler.transform(y) instead of answering 400
-                target_tags = get_target_tags(SimpleNamespace(metadata=metadata))
-                y_frames[name] = server_utils.verify_dataframe(
-                    server_utils.dataframe_from_dict(y_payloads[name]),
-                    [t.name for t in target_tags],
-                )
-        except FileNotFoundError:
-            errors[name] = {"error": f"No such model found: '{name}'", "status": 404}
-        except server_utils.ServerError as exc:
-            errors[name] = {"error": str(exc), "status": exc.status}
-        except (ValueError, TypeError, KeyError) as exc:
-            # malformed frame payloads (unparseable index etc.) are that
-            # machine's problem, never the whole batch's
-            errors[name] = {"error": f"Invalid frame payload: {exc}", "status": 400}
+        else:
+            full, keep_smooth = _decode_fleet_json(
+                ctx, resolve_machine, frames, y_frames, metadatas, errors
+            )
 
     data: Dict[str, Any] = {}
     if frames:
@@ -225,6 +308,7 @@ def post_fleet_prediction(ctx, gordo_project: str):
             return keys
 
         fleet = STORE.fleet(ctx.collection_dir) if full else None
+        as_arrow = response_format == wire.ARROW
         # per-machine wire assembly is the fleet route's host-pipeline
         # tail — staged like the single-model routes' response_assemble
         with ctx.stage("response_assemble"):
@@ -241,7 +325,7 @@ def post_fleet_prediction(ctx, gordo_project: str):
                     continue
                 if full:
                     try:
-                        entry, error = _full_anomaly_entry(
+                        table, frame, error = _full_anomaly_entry(
                             fleet,
                             name,
                             frames[name],
@@ -256,18 +340,48 @@ def post_fleet_prediction(ctx, gordo_project: str):
                         logger.exception(
                             "full anomaly assembly failed for %s", name
                         )
-                        entry, error = None, {
+                        table, frame, error = None, None, {
                             "error": "Anomaly assembly failed",
                             "status": 500,
                         }
                     if error is not None:
                         errors[name] = error
                         continue
-                    if entry is not None:
-                        data[name] = entry
+                    if frame is not None:
+                        # the legacy pandas assembly ran (custom
+                        # detector or columnar switched off) — both
+                        # encoders can still carry it, except
+                        # duplicate-label frames: JSON keeps pandas'
+                        # legacy duplicate semantics, Arrow can't
+                        # express them (per-machine error, never a
+                        # whole-batch 500)
+                        bridged = wire.WireTable.from_frame(frame)
+                        if bridged.unique_labels():
+                            table = bridged
+                        elif as_arrow:
+                            errors[name] = {
+                                "error": "Response columns are not "
+                                "representable as Arrow "
+                                "(duplicate labels)",
+                                "status": 400,
+                            }
+                            continue
+                        else:
+                            data[name] = server_utils.dataframe_to_dict(
+                                frame
+                            )
+                            continue
+                    if table is not None:
+                        data[name] = (
+                            table if as_arrow else table.to_wire_dict()
+                        )
                         continue
                     # not an anomaly detector: lean entry below
-                keys = index_keys(index[len(index) - len(recon):])
+                aligned_index = index[len(index) - len(recon):]
+                if as_arrow:
+                    data[name] = _lean_table(aligned_index, recon, mse)
+                    continue
+                keys = index_keys(aligned_index)
                 # direct dict assembly — same wire shape as
                 # dataframe_to_dict(DataFrame(reconstruction)) with
                 # stringified columns, without re-building frames per machine
@@ -281,10 +395,137 @@ def post_fleet_prediction(ctx, gordo_project: str):
                     ),
                 }
 
+    status = 200 if data else 400
+    if response_format == wire.ARROW:
+        # deferred serialize, like encode_wire_response: the interval
+        # closes at _finalize so the post-encode GIL park stays attributed
+        serialize_start = timeit.default_timer()
+        ctx.current_stage = "serialize"
+        entries = {
+            name: wire.encode_table(table) for name, table in data.items()
+        }
+        body = wire.pack_streams(
+            entries,
+            extra={"errors": errors, "revision": ctx.revision},
+        )
+        response = ctx.raw_response(body, wire.ARROW_CONTENT_TYPE, status)
+        ctx.deferred_stage = ("serialize", serialize_start)
+        return response
     context: Dict[str, Any] = {"data": data}
     if errors:
         context["errors"] = errors
-    return ctx.json_response(context, status=200 if data else 400)
+    return ctx.json_response(context, status=status)
+
+
+def _lean_table(index, recon: np.ndarray, mse) -> "Any":
+    """The lean fleet entry (``model-output`` + per-row mse) as a
+    columnar table — the Arrow twin of the JSON path's direct dict."""
+    from .. import wire
+
+    columns = [
+        wire.WireColumn("model-output", str(col), recon[:, col])
+        for col in range(recon.shape[1])
+    ]
+    columns.append(
+        wire.WireColumn("total-anomaly-unscaled", "", np.asarray(mse))
+    )
+    return wire.WireTable(pd.Index(index), columns)
+
+
+def _decode_fleet_json(
+    ctx, resolve_machine, frames, y_frames, metadatas, errors
+) -> Tuple[bool, bool]:
+    """The legacy JSON fleet body: ``{"X": {name: frame-dict}, "y":
+    {...}, "full": bool}`` — per-machine verification against the
+    resolution cache's tag lists, malformed machines isolated into
+    ``errors``."""
+    request = ctx.request
+    body = request.get_json(silent=True) if request.is_json else None
+    if not body or not isinstance(body.get("X"), dict) or not body["X"]:
+        raise server_utils.ServerError(
+            'Fleet prediction needs a JSON body {"X": {<model-name>: frame}}'
+        )
+    full = request.args.get("full") is not None or bool(body.get("full"))
+    keep_smooth = request.args.get("all_columns") is not None
+    y_payloads = body.get("y") if isinstance(body.get("y"), dict) else {}
+
+    for name, payload in body["X"].items():
+        try:
+            resolution = resolve_machine(name)
+            frame = server_utils.dataframe_from_dict(payload)
+            frames[name] = server_utils.verify_dataframe(
+                frame, resolution.tag_names
+            )
+            metadatas[name] = resolution.metadata
+            if name in y_payloads:
+                # verify/reorder y exactly like the single-model route
+                # (extract_X_y): an unverified y dict with shuffled or
+                # wrong columns would silently misalign the detector's
+                # scaler.transform(y) instead of answering 400
+                y_frames[name] = server_utils.verify_dataframe(
+                    server_utils.dataframe_from_dict(y_payloads[name]),
+                    resolution.target_names,
+                )
+        except FileNotFoundError:
+            errors[name] = {"error": f"No such model found: '{name}'", "status": 404}
+        except server_utils.ServerError as exc:
+            errors[name] = {"error": str(exc), "status": exc.status}
+        except (ValueError, TypeError, KeyError) as exc:
+            # malformed frame payloads (unparseable index etc.) are that
+            # machine's problem, never the whole batch's
+            errors[name] = {"error": f"Invalid frame payload: {exc}", "status": 400}
+        except Exception:  # noqa: BLE001 - a broken artifact is this
+            # machine's problem (the resolution loads the model)
+            logger.exception("fleet resolution failed for %s", name)
+            errors[name] = {"error": "Model could not be loaded", "status": 500}
+    return full, keep_smooth
+
+
+def _decode_fleet_arrow(
+    ctx, resolve_machine, frames, y_frames, metadatas, errors
+) -> Tuple[bool, bool]:
+    """The columnar fleet body: a container of per-machine Arrow IPC
+    streams (``wire.pack_streams``), each carrying role-tagged ``x``
+    (and optionally ``y``) columns; ``full`` rides the container's JSON
+    trailer or the query string."""
+    from .. import wire
+
+    request = ctx.request
+    try:
+        entries, extra = wire.unpack_streams(request.get_data())
+    except wire.ArrowDecodeError as exc:
+        raise server_utils.ServerError(str(exc), status=400)
+    if not entries:
+        raise server_utils.ServerError(
+            "Fleet prediction needs at least one machine entry"
+        )
+    full = request.args.get("full") is not None or bool(extra.get("full"))
+    keep_smooth = (
+        request.args.get("all_columns") is not None
+        or bool(extra.get("all_columns"))
+    )
+    for name, payload in entries.items():
+        try:
+            resolution = resolve_machine(name)
+            x_columns, y_columns, index = wire.decode_frames(payload)
+            frames[name] = server_utils.frame_from_columns(
+                resolution, x_columns, index, resolution.tag_names
+            )
+            metadatas[name] = resolution.metadata
+            if y_columns:
+                y_frames[name] = server_utils.frame_from_columns(
+                    resolution, y_columns, index, resolution.target_names
+                )
+        except FileNotFoundError:
+            errors[name] = {"error": f"No such model found: '{name}'", "status": 404}
+        except server_utils.ServerError as exc:
+            errors[name] = {"error": str(exc), "status": exc.status}
+        except (ValueError, TypeError, KeyError) as exc:
+            errors[name] = {"error": f"Invalid frame payload: {exc}", "status": 400}
+        except Exception:  # noqa: BLE001 - per-machine isolation
+            logger.exception("fleet resolution failed for %s", name)
+            errors[name] = {"error": "Model could not be loaded", "status": 500}
+    return full, keep_smooth
 
 
 def _record_fleet_health(ctx, frames, scores, score_errors) -> None:
@@ -335,37 +576,53 @@ def _full_anomaly_entry(
 ):
     """
     One machine's FULL anomaly response assembled from the fused-bucket
-    reconstruction: ``(entry, error)`` where ``entry`` is the wire dict
-    (None for non-detector models → caller falls back to the lean shape)
-    and ``error`` a per-machine error dict. The detector's threshold/
-    confidence math runs host-side exactly as in the single-model route;
-    only the predict was fused.
+    reconstruction: ``(table, frame, error)`` — a columnar
+    :class:`~..wire.WireTable` on the vectorized fast path, the legacy
+    pandas frame for custom detectors (or columnar switched off), both
+    None for non-detector models (→ caller falls back to the lean
+    shape), ``error`` a per-machine error dict. The detector's
+    threshold/confidence math runs host-side exactly as in the
+    single-model route; only the predict was fused.
     """
     from types import SimpleNamespace
 
     from ...models.anomaly.base import AnomalyDetectorBase
+    from .. import wire
     from ..properties import get_frequency
     from .anomaly import DELETED_FROM_RESPONSE_COLUMNS
 
     model = fleet.model(name)
     if not isinstance(model, AnomalyDetectorBase):
-        return None, None
+        return None, None, None
     try:
         frequency = get_frequency(SimpleNamespace(metadata=metadata))
     except (KeyError, TypeError, ValueError):
         frequency = None
-    kwargs = {"frequency": frequency}
-    if model_io.accepts_model_output(model):
-        kwargs["model_output"] = reconstruction
     try:
+        if wire.columnar_enabled() and wire.supports_columnar_anomaly(
+            model
+        ):
+            table = wire.anomaly_table(
+                model,
+                X,
+                y,
+                reconstruction,
+                frequency=frequency,
+                keep_smooth=keep_smooth,
+            )
+            if table.unique_labels():
+                return table, None, None
+        kwargs = {"frequency": frequency}
+        if model_io.accepts_model_output(model):
+            kwargs["model_output"] = reconstruction
         anomaly_df = model.anomaly(X, y, **kwargs)
     except AttributeError:
-        return None, {
+        return None, None, {
             "error": "Model has no thresholds (require_thresholds unmet)",
             "status": 422,
         }
     except ValueError as exc:
-        return None, {"error": f"ValueError: {exc}", "status": 400}
+        return None, None, {"error": f"ValueError: {exc}", "status": 400}
     if not keep_smooth:
         # same drop set as the single-model anomaly route, by construction
         anomaly_df = anomaly_df.drop(
@@ -375,7 +632,7 @@ def _full_anomaly_entry(
                 if column[0] in DELETED_FROM_RESPONSE_COLUMNS
             ]
         )
-    return server_utils.dataframe_to_dict(anomaly_df), None
+    return None, anomaly_df, None
 
 
 def delete_model_revision(ctx, gordo_project: str, gordo_name: str, revision: str):

@@ -1,0 +1,97 @@
+"""
+Columnar wire fast path for the serving stack (PR 12).
+
+``gordo_tpu.server.wire`` owns everything between "the model scored"
+and "bytes on the socket": content negotiation
+(:mod:`~gordo_tpu.server.wire.negotiate`), vectorized response assembly
+(:mod:`~gordo_tpu.server.wire.assemble` — numpy columns instead of the
+MultiIndex-frame round-trip that was ~70% of full-route p50), the
+dict-free JSON encoder (:mod:`~gordo_tpu.server.wire.json_codec`,
+byte-identical to the legacy serializer), and the import-guarded
+Arrow-IPC codec (:mod:`~gordo_tpu.server.wire.arrow_codec` — zero-copy
+request decode, record-batch responses, the fleet container).
+
+Layering contract (enforced by ``gordo-tpu lint``): this package never
+imports the server views or app — the views call DOWN into the codec.
+
+Knobs: ``GORDO_TPU_WIRE_COLUMNAR`` (master switch for the vectorized
+assembly; off = legacy pandas path, identical bytes),
+``GORDO_TPU_WIRE_ARROW`` (serve/accept Arrow bodies when pyarrow is
+importable), ``GORDO_TPU_WIRE_STREAM`` (stream JSON response bodies as
+WSGI chunks; off by default because streamed serialize time lands
+outside the request's exported stage spans).
+"""
+
+from ...utils.env import env_bool
+from .arrow_codec import (
+    ARROW_CONTENT_TYPE,
+    HAVE_ARROW,
+    ArrowDecodeError,
+    arrow_enabled,
+    decode_frames,
+    decode_response,
+    encode_request,
+    encode_table,
+    pack_streams,
+    unpack_streams,
+)
+from .assemble import (
+    anomaly_table,
+    prediction_table,
+    supports_columnar_anomaly,
+)
+from .columns import WireColumn, WireTable
+from .json_codec import encode_response, iter_encode_response
+from .negotiate import (
+    ARROW,
+    JSON,
+    JSON_CONTENT_TYPE,
+    PARQUET,
+    PARQUET_CONTENT_TYPE,
+    request_format,
+    response_format,
+)
+
+
+def columnar_enabled() -> bool:
+    """Master switch for the vectorized assembly fast path
+    (``GORDO_TPU_WIRE_COLUMNAR``, default on). The legacy pandas path
+    stays available as the escape hatch — and produces the same bytes."""
+    return env_bool("GORDO_TPU_WIRE_COLUMNAR", True)
+
+
+def stream_enabled() -> bool:
+    """Whether JSON responses stream as WSGI chunks
+    (``GORDO_TPU_WIRE_STREAM``, default off — see the module docstring
+    for the stage-attribution caveat)."""
+    return env_bool("GORDO_TPU_WIRE_STREAM", False)
+
+
+__all__ = [
+    "ARROW",
+    "ARROW_CONTENT_TYPE",
+    "ArrowDecodeError",
+    "HAVE_ARROW",
+    "JSON",
+    "JSON_CONTENT_TYPE",
+    "PARQUET",
+    "PARQUET_CONTENT_TYPE",
+    "WireColumn",
+    "WireTable",
+    "anomaly_table",
+    "arrow_enabled",
+    "columnar_enabled",
+    "decode_frames",
+    "decode_response",
+    "encode_request",
+    "encode_response",
+    "encode_table",
+    "iter_encode_response",
+    "pack_streams",
+    "prediction_table",
+    "request_format",
+    "response_format",
+    "stream_enabled",
+    "supports_columnar_anomaly",
+    "unpack_streams",
+]

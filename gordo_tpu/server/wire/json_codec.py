@@ -1,0 +1,158 @@
+"""
+The dict-free JSON wire encoder.
+
+The legacy serialize path materialized the full nested wire dict
+(``{group: {sub: {key: value}}}`` — one python dict per column, one
+entry per cell) and then walked it AGAIN inside ``json.dumps`` (plus a
+third time in the ``ignore_nan`` sanitize walk on stdlib json). This
+encoder writes the same bytes straight from the columnar table: the
+per-row key prefixes (``"2020-01-01 00:00:00+00:00": ``) are formatted
+ONCE per request and every column's cells become literals via one
+``tolist()`` + ``repr`` pass — python floats repr exactly as
+``json.dumps`` emits them, so the output is byte-for-byte identical to
+``json_compat.dumps(payload, default=str, ignore_nan=True)`` of the
+equivalent dict (pinned by ``tests/server/test_wire_parity.py``).
+
+``iter_encode_response`` is the streamed variant
+(``GORDO_TPU_WIRE_STREAM``): chunks come out one column group at a
+time, so a WSGI server that streams can overlap encode with socket
+writes instead of materializing multi-MB bodies.
+"""
+
+import json
+import math
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ...utils import json_compat
+from .columns import WireTable
+
+#: separators matching ``json.dumps``' defaults (the legacy serializer
+#: used them — byte parity requires the spaces)
+_ITEM_SEP = ", "
+_KEY_SEP = ": "
+
+
+def _key_literal(key: Any) -> str:
+    """A JSON OBJECT KEY for ``key``, with ``json.dumps``' non-string
+    key coercion rules (int → str, float → repr, bool → true/false)."""
+    if isinstance(key, str):
+        return json.dumps(key)
+    if key is True:
+        return '"true"'
+    if key is False:
+        return '"false"'
+    if isinstance(key, int):
+        return f'"{key:d}"'
+    if isinstance(key, float):
+        return f'"{float.__repr__(key)}"'
+    return json.dumps(str(key))
+
+
+def _value_literal(value: Any) -> str:
+    """One cell as a JSON literal, with the legacy path's ``default=str,
+    ignore_nan=True`` semantics."""
+    if value is None:
+        return "null"
+    if isinstance(value, str):
+        return json.dumps(value)
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, float):
+        return float.__repr__(value) if math.isfinite(value) else "null"
+    if isinstance(value, int):
+        return str(value)
+    return json_compat.dumps(value, default=str, ignore_nan=True)
+
+
+def _column_literals(values: Any) -> List[str]:
+    """Every cell of one column as JSON literals — one ``tolist()`` for
+    numeric arrays (C-speed unboxing), per-value fallback for object
+    columns (ISO strings / None)."""
+    if isinstance(values, np.ndarray):
+        kind = values.dtype.kind
+        if kind == "f":
+            literals = [float.__repr__(v) for v in values.tolist()]
+            if not np.isfinite(values).all():
+                finite = np.isfinite(values).tolist()
+                literals = [
+                    lit if ok else "null"
+                    for lit, ok in zip(literals, finite)
+                ]
+            return literals
+        if kind in "iu":
+            return [str(v) for v in values.tolist()]
+        if kind == "b":
+            return [
+                "true" if v else "false" for v in values.tolist()
+            ]
+        values = values.tolist()
+    return [_value_literal(v) for v in values]
+
+
+def encode_table(table: WireTable) -> Iterator[str]:
+    """The ``{group: {sub: {key: value}}}`` JSON object, one text chunk
+    per column group."""
+    key_prefixes = [
+        _key_literal(key) + _KEY_SEP for key in table.keys
+    ]
+    first = True
+    yield "{"
+    for group, bucket in table.groups():
+        sub_parts = []
+        for column in bucket:
+            literals = _column_literals(column.values)
+            body = _ITEM_SEP.join(
+                prefix + literal
+                for prefix, literal in zip(key_prefixes, literals)
+            )
+            # scalar groups nest under their own name — pandas collapsed
+            # ('start', '') to a Series named 'start', and that Series
+            # name became the legacy wire's sub key
+            sub_parts.append(
+                json.dumps(column.sub or column.group)
+                + _KEY_SEP
+                + "{"
+                + body
+                + "}"
+            )
+        chunk = (
+            ("" if first else _ITEM_SEP)
+            + json.dumps(group)
+            + _KEY_SEP
+            + "{"
+            + _ITEM_SEP.join(sub_parts)
+            + "}"
+        )
+        first = False
+        yield chunk
+    yield "}"
+
+
+def iter_encode_response(
+    table: WireTable, extra: Optional[Dict[str, Any]] = None
+) -> Iterator[bytes]:
+    """The full response body ``{"data": <table>, **extra}``, streamed
+    as UTF-8 chunks (one per column group). ``extra`` items serialize
+    through the same ``json_compat`` path the legacy serializer used."""
+    yield b'{"data"' + _KEY_SEP.encode()
+    for chunk in encode_table(table):
+        yield chunk.encode()
+    if extra:
+        for key, value in extra.items():
+            yield (
+                _ITEM_SEP
+                + json.dumps(key)
+                + _KEY_SEP
+                + json_compat.dumps(value, default=str, ignore_nan=True)
+            ).encode()
+    yield b"}"
+
+
+def encode_response(
+    table: WireTable, extra: Optional[Dict[str, Any]] = None
+) -> bytes:
+    """The full response body as one bytes payload (the default,
+    non-streamed serialize path)."""
+    return b"".join(iter_encode_response(table, extra))

@@ -1,0 +1,108 @@
+"""
+Content negotiation for the prediction/anomaly/fleet wire formats.
+
+Requests pick their body codec with ``Content-Type`` and their response
+codec with ``Accept``; JSON stays the default on both sides, so every
+existing client keeps working byte-for-byte. Rules (documented in
+``docs/serving.md``):
+
+- Response: explicit ``?format=parquet`` wins (legacy contract); then
+  the highest-quality acceptable type among Arrow / parquet / JSON,
+  with JSON winning ties and every wildcard (``*/*``,
+  ``application/*``) counting as JSON. An ``Accept`` header that admits
+  none of the three answers 406. A client that accepts Arrow *and*
+  JSON degrades gracefully to JSON when pyarrow is unavailable; one
+  that accepts ONLY Arrow gets the 406.
+- Request: ``Content-Type: application/vnd.apache.arrow.stream`` and
+  raw-body ``application/x-parquet`` are decoded columnar; JSON and
+  multipart parquet take the legacy decoders. An Arrow body on a
+  pyarrow-less server answers 415 (the capability is absent, not the
+  request malformed — malformed bodies answer 400).
+"""
+
+from typing import Tuple
+
+from .arrow_codec import ARROW_CONTENT_TYPE, arrow_enabled
+
+JSON_CONTENT_TYPE = "application/json"
+PARQUET_CONTENT_TYPE = "application/x-parquet"
+
+#: formats the serialize stage understands
+JSON, ARROW, PARQUET = "json", "arrow", "parquet"
+
+
+def _accept_qualities(request) -> Tuple[float, float, float]:
+    """(json_q, arrow_q, parquet_q) from the Accept header; wildcards
+    count toward JSON (the default representation)."""
+    json_q = arrow_q = parquet_q = 0.0
+    for value, quality in request.accept_mimetypes:
+        mime = value.lower()
+        if mime in (JSON_CONTENT_TYPE, "application/*", "*/*"):
+            json_q = max(json_q, quality)
+        elif mime == ARROW_CONTENT_TYPE:
+            arrow_q = max(arrow_q, quality)
+        elif mime == PARQUET_CONTENT_TYPE:
+            parquet_q = max(parquet_q, quality)
+    return json_q, arrow_q, parquet_q
+
+
+def response_format(request) -> str:
+    """The negotiated response codec (``json``/``arrow``/``parquet``).
+
+    Raises :class:`~..utils.ServerError` with status 406 when the
+    client's ``Accept`` admits none of the served representations.
+    """
+    from .. import utils as server_utils
+
+    if request.args.get("format") == "parquet":
+        return PARQUET
+    accept = request.headers.get("Accept")
+    if not accept:
+        return JSON
+    json_q, arrow_q, parquet_q = _accept_qualities(request)
+    if arrow_q > 0 and not arrow_enabled():
+        if json_q <= 0 and parquet_q <= 0:
+            raise server_utils.ServerError(
+                "Arrow responses unavailable (pyarrow not installed); "
+                "accept application/json instead",
+                status=406,
+            )
+        arrow_q = 0.0
+    if json_q <= 0 and arrow_q <= 0 and parquet_q <= 0:
+        raise server_utils.ServerError(
+            "Not acceptable: this route serves application/json, "
+            f"{ARROW_CONTENT_TYPE} or {PARQUET_CONTENT_TYPE}",
+            status=406,
+        )
+    # highest quality wins; JSON wins ties (default representation),
+    # Arrow beats parquet on their tie (it is the cheaper encode)
+    if arrow_q > json_q and arrow_q >= parquet_q:
+        return ARROW
+    if parquet_q > json_q:
+        return PARQUET
+    return JSON
+
+
+def request_format(request) -> str:
+    """The request-body codec this Content-Type selects: ``arrow`` /
+    ``parquet`` (raw body) / ``legacy`` (JSON body or multipart parquet
+    files — the pre-columnar decoders own those, including their error
+    contract).
+
+    Raises a 415 :class:`~..utils.ServerError` for an Arrow body when
+    the Arrow codec is unavailable.
+    """
+    from .. import utils as server_utils
+
+    mimetype = (request.mimetype or "").lower()
+    if mimetype == ARROW_CONTENT_TYPE:
+        if not arrow_enabled():
+            raise server_utils.ServerError(
+                "Arrow request bodies unsupported (pyarrow not "
+                "installed); send application/json",
+                status=415,
+            )
+        return ARROW
+    if mimetype == PARQUET_CONTENT_TYPE:
+        return PARQUET
+    return "legacy"

@@ -421,6 +421,29 @@ KNOBS: Dict[str, Knob] = _knobs(
         "Tallest row rung warmed at boot.",
         "Serving",
     ),
+    Knob(
+        "GORDO_TPU_WIRE_COLUMNAR", "bool", True,
+        "Columnar response fast path on the prediction/anomaly/fleet "
+        "routes: vectorized numpy assembly + dict-free wire encoders "
+        "(byte-identical JSON). Off = the legacy pandas assembly.",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_WIRE_ARROW", "bool", True,
+        "Serve and accept Arrow-IPC request/response bodies when "
+        "pyarrow is importable (`Accept`/`Content-Type: "
+        "application/vnd.apache.arrow.stream`). Off drills the "
+        "JSON-only fallback.",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_WIRE_STREAM", "bool", False,
+        "Stream JSON response bodies as WSGI chunks (encode overlaps "
+        "the socket write). Off by default: streamed serialize time "
+        "lands outside the request's exported stage spans (see "
+        "`docs/serving.md`).",
+        "Serving",
+    ),
     # -- Lifecycle ---------------------------------------------------------
     Knob(
         "GORDO_TPU_DRIFT_SIGMA", "float", 2.0,

@@ -65,6 +65,9 @@ case "$component" in
     # The SLO suite cuts across tests/telemetry, tests/server and
     # tests/lifecycle the same way — marker-selected.
     slo)      run -m "slo and not slow" tests/ ;;
+    # The columnar wire suite cuts across tests/server and
+    # tests/telemetry — marker-selected like fleet_health/slo.
+    wire)     run -m "wire and not slow" tests/ ;;
     utils)    run -m "not slow" tests/utils ;;
     workflow) run -m "not slow" tests/workflow ;;
     formatting) run tests/test_codestyle.py ;;

@@ -1,0 +1,220 @@
+"""
+Unit contracts of the wire codec itself: the dict-free JSON encoder's
+byte equivalence with the legacy serializer on adversarial values, the
+fleet container round trip, the vectorized anomaly assembly's numeric
+identity with ``DiffBasedAnomalyDetector.anomaly``, and the resolution
+cache's staleness behavior.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.server import wire
+from gordo_tpu.server.wire import json_codec
+from gordo_tpu.server.wire.columns import WireColumn, WireTable
+from gordo_tpu.utils import json_compat
+
+pytestmark = pytest.mark.wire
+
+
+def _reference_bytes(table: WireTable, extra: dict) -> bytes:
+    payload = {"data": table.to_wire_dict()}
+    payload.update(extra)
+    return json_compat.dumps(
+        payload, default=str, ignore_nan=True
+    ).encode()
+
+
+def test_json_encoder_matches_reference_on_tricky_values():
+    index = pd.date_range(
+        "2020-01-01", periods=4, freq="10min", tz="UTC"
+    )
+    table = WireTable(
+        index,
+        [
+            WireColumn("start", "", ["a", None, 'q"uote', "é"]),
+            WireColumn(
+                "vals",
+                "f",
+                np.array([1.5, float("nan"), float("inf"), -0.0]),
+            ),
+            WireColumn("vals", "i", np.array([1, -2, 3, 4], dtype=np.int64)),
+            WireColumn("vals", "b", np.array([True, False, True, False])),
+            WireColumn("total-x", "", np.array([0.1, 0.2, 0.3, 0.4])),
+        ],
+    )
+    extra = {"revision": "123", "note": "naïve"}
+    assert json_codec.encode_response(table, extra) == _reference_bytes(
+        table, extra
+    )
+
+
+def test_json_encoder_integer_index_keys():
+    table = WireTable(
+        pd.RangeIndex(3),
+        [WireColumn("vals", "x", np.array([0.25, 0.5, 1.0]))],
+    )
+    assert json_codec.encode_response(table, {}) == _reference_bytes(
+        table, {}
+    )
+
+
+def test_stream_chunks_concatenate_to_encode_response():
+    table = WireTable(
+        pd.RangeIndex(2),
+        [
+            WireColumn("a", "x", np.array([1.0, 2.0])),
+            WireColumn("b", "", np.array([3.0, 4.0])),
+        ],
+    )
+    chunks = list(json_codec.iter_encode_response(table, {"revision": "9"}))
+    assert len(chunks) > 2  # actually streamed, group by group
+    assert b"".join(chunks) == json_codec.encode_response(
+        table, {"revision": "9"}
+    )
+
+
+def test_fleet_container_round_trip():
+    entries = {"m-1": b"\x00\x01payload", "m-2": b""}
+    extra = {"errors": {"m-3": {"status": 404}}, "full": True}
+    packed = wire.pack_streams(entries, extra)
+    got_entries, got_extra = wire.unpack_streams(packed)
+    assert got_entries == entries
+    assert got_extra == extra
+
+
+@pytest.mark.parametrize(
+    "garbage",
+    [b"", b"GDTAF1", b"GDTAF1\xff\xff\xff\xff", b"nope", b"GDTAF1\x01\x00\x00\x00\x10\x00\x00\x00xx"],
+)
+def test_fleet_container_garbage_raises(garbage):
+    with pytest.raises(wire.ArrowDecodeError):
+        wire.unpack_streams(garbage)
+
+
+def test_arrow_request_round_trip_zero_copy_types():
+    index = pd.date_range("2020-01-01", periods=8, freq="h", tz="UTC")
+    X = pd.DataFrame(
+        {"t-1": np.linspace(0, 1, 8), "t-2": np.linspace(1, 2, 8)},
+        index=index,
+    )
+    y = X * 2.0
+    buf = wire.encode_request(X, y)
+    x_cols, y_cols, got_index = wire.decode_frames(buf)
+    assert set(x_cols) == {"t-1", "t-2"}
+    assert set(y_cols) == {"t-1", "t-2"}
+    np.testing.assert_array_equal(x_cols["t-1"], X["t-1"].to_numpy())
+    np.testing.assert_array_equal(y_cols["t-2"], y["t-2"].to_numpy())
+    assert isinstance(got_index, pd.DatetimeIndex)
+    assert list(got_index) == list(index)
+
+
+def test_anomaly_table_matches_detector_frame():
+    """The vectorized assembly IS the detector's anomaly() — same
+    columns, same float bits — on a hand-fitted detector."""
+    from sklearn.preprocessing import MinMaxScaler
+
+    from gordo_tpu.models.anomaly.diff import DiffBasedAnomalyDetector
+
+    rng = np.random.RandomState(0)
+    index = pd.date_range("2020-01-01", periods=32, freq="10min", tz="UTC")
+    X = pd.DataFrame(
+        rng.rand(32, 3), columns=["a", "b", "c"], index=index
+    )
+    y = X.copy()
+
+    class _Identity:
+        def predict(self, values):
+            return np.asarray(values, dtype=np.float32) * np.float32(0.9)
+
+    model = DiffBasedAnomalyDetector(
+        base_estimator=_Identity(), scaler=MinMaxScaler()
+    )
+    model.scaler.fit(y)
+    model.feature_thresholds_ = pd.Series(
+        [0.5, 0.4, 0.3], index=["a", "b", "c"]
+    )
+    model.aggregate_threshold_ = 0.123
+
+    recon = model.predict(X)
+    frequency = pd.tseries.frequencies.to_offset("10min")
+    legacy = model.anomaly(X, y, frequency=frequency, model_output=recon)
+    table = wire.anomaly_table(
+        model, X, y, recon, frequency=frequency, keep_smooth=False
+    )
+    fast = table.to_frame()
+    pd.testing.assert_frame_equal(fast, legacy, check_exact=True)
+
+
+def test_anomaly_table_require_thresholds_raises():
+    from sklearn.preprocessing import MinMaxScaler
+
+    from gordo_tpu.models.anomaly.diff import DiffBasedAnomalyDetector
+
+    index = pd.date_range("2020-01-01", periods=4, freq="h", tz="UTC")
+    X = pd.DataFrame(np.ones((4, 2)), columns=["a", "b"], index=index)
+
+    class _Identity:
+        def predict(self, values):
+            return np.asarray(values, dtype=np.float32)
+
+    model = DiffBasedAnomalyDetector(
+        base_estimator=_Identity(), scaler=MinMaxScaler()
+    )
+    model.scaler.fit(X)
+    with pytest.raises(AttributeError):
+        wire.anomaly_table(model, X, X, model.predict(X))
+
+
+def test_resolution_cache_probes_not_recomputation(collection_dir):
+    """resolution() parses metadata once per revision; repeated calls
+    answer the same object, and DELETE-style invalidation drops it."""
+    from gordo_tpu.server.fleet_store import STORE
+
+    STORE.clear()
+    fleet = STORE.fleet(collection_dir)
+    first = fleet.resolution("machine-1")
+    assert fleet.resolution("machine-1") is first
+    assert first.tag_names == ["tag-1", "tag-2", "tag-3", "tag-4"]
+    assert first.model is fleet.model("machine-1")
+    STORE.invalidate(collection_dir)
+    fresh = STORE.fleet(collection_dir).resolution("machine-1")
+    assert fresh is not first
+
+
+def test_alignment_plan_cached(collection_dir):
+    from gordo_tpu.server.fleet_store import STORE
+    from gordo_tpu.server.utils import frame_from_columns
+
+    STORE.clear()
+    resolution = STORE.fleet(collection_dir).resolution("machine-1")
+    expected = resolution.tag_names
+    shuffled = {
+        name: np.arange(3, dtype=float) + i
+        for i, name in enumerate(reversed(expected))
+    }
+    frame = frame_from_columns(resolution, shuffled, None, expected)
+    assert list(frame.columns) == expected
+    assert resolution.alignment(
+        tuple(shuffled), tuple(expected)
+    ) == tuple(expected)
+    # second pass hits the cached plan and yields the same frame
+    again = frame_from_columns(resolution, shuffled, None, expected)
+    pd.testing.assert_frame_equal(frame, again)
+
+
+def test_alignment_mismatch_is_400(collection_dir):
+    from gordo_tpu.server.fleet_store import STORE
+    from gordo_tpu.server.utils import ServerError, frame_from_columns
+
+    STORE.clear()
+    resolution = STORE.fleet(collection_dir).resolution("machine-1")
+    with pytest.raises(ServerError) as err:
+        frame_from_columns(
+            resolution,
+            {"bogus": np.arange(3, dtype=float)},
+            None,
+            resolution.tag_names,
+        )
+    assert err.value.status == 400

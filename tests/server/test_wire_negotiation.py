@@ -1,0 +1,207 @@
+"""
+Content-negotiation contracts for the columnar wire formats: 406 for
+unservable Accept headers, 415 for unsupported request bodies, 400 (as
+JSON) for malformed Arrow, graceful JSON-only degradation when pyarrow
+is unavailable, and the streaming-encode knob's byte parity.
+"""
+
+import json
+
+import pandas as pd
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu.server import build_app
+from gordo_tpu.server import wire
+from gordo_tpu.server.fleet_store import STORE
+
+from .conftest import temp_env_vars
+
+pytestmark = pytest.mark.wire
+
+URL = "/gordo/v0/test-project/machine-1/prediction"
+ANOMALY_URL = "/gordo/v0/test-project/machine-1/anomaly/prediction"
+
+
+@pytest.fixture
+def wire_client(collection_dir):
+    with temp_env_vars(MODEL_COLLECTION_DIR=collection_dir):
+        STORE.clear()
+        yield Client(build_app(config={}))
+
+
+def test_unknown_accept_is_406(wire_client, sensor_payload):
+    resp = wire_client.post(
+        URL, json=sensor_payload, headers={"Accept": "text/html"}
+    )
+    assert resp.status_code == 406
+    assert resp.content_type.startswith("application/json")
+    assert "application/json" in json.loads(resp.data)["message"]
+
+
+def test_wildcard_accept_stays_json(wire_client, sensor_payload):
+    resp = wire_client.post(
+        URL, json=sensor_payload, headers={"Accept": "*/*"}
+    )
+    assert resp.status_code == 200
+    assert resp.content_type.startswith("application/json")
+
+
+def test_browser_style_accept_stays_json(wire_client, sensor_payload):
+    resp = wire_client.post(
+        URL,
+        json=sensor_payload,
+        headers={"Accept": "text/html,application/xhtml+xml,*/*;q=0.8"},
+    )
+    assert resp.status_code == 200
+    assert resp.content_type.startswith("application/json")
+
+
+def test_arrow_accept_answers_arrow(wire_client, sensor_payload):
+    resp = wire_client.post(
+        URL,
+        json=sensor_payload,
+        headers={"Accept": wire.ARROW_CONTENT_TYPE},
+    )
+    assert resp.status_code == 200
+    assert resp.content_type == wire.ARROW_CONTENT_TYPE
+    frame, extra = wire.decode_response(resp.data)
+    assert ("model-output" in {g for g, _ in frame.columns})
+    assert extra["revision"] == resp.headers["revision"]
+
+
+def test_malformed_arrow_body_is_400_json(wire_client):
+    resp = wire_client.post(
+        URL,
+        data=b"not an ipc stream at all",
+        headers={"Content-Type": wire.ARROW_CONTENT_TYPE},
+    )
+    assert resp.status_code == 400
+    assert resp.content_type.startswith("application/json")
+    assert "Arrow" in json.loads(resp.data)["message"]
+
+
+def test_truncated_fleet_container_is_400(wire_client):
+    resp = wire_client.post(
+        "/gordo/v0/test-project/prediction/fleet",
+        data=b"GDTAF1\x02\x00\x00\x00trunc",
+        headers={"Content-Type": wire.ARROW_CONTENT_TYPE},
+    )
+    assert resp.status_code == 400
+
+
+def test_arrow_disabled_degrades_to_json(wire_client, sensor_payload):
+    """A client accepting Arrow AND json gets json when the Arrow codec
+    is off; one accepting ONLY Arrow gets 406; an Arrow BODY gets 415."""
+    with temp_env_vars(GORDO_TPU_WIRE_ARROW="0"):
+        both = wire_client.post(
+            URL,
+            json=sensor_payload,
+            headers={
+                "Accept": f"{wire.ARROW_CONTENT_TYPE}, application/json;q=0.5"
+            },
+        )
+        assert both.status_code == 200
+        assert both.content_type.startswith("application/json")
+
+        only = wire_client.post(
+            URL,
+            json=sensor_payload,
+            headers={"Accept": wire.ARROW_CONTENT_TYPE},
+        )
+        assert only.status_code == 406
+
+        body = wire_client.post(
+            URL,
+            data=b"\x00\x00",
+            headers={"Content-Type": wire.ARROW_CONTENT_TYPE},
+        )
+        assert body.status_code == 415
+
+
+def test_raw_parquet_body(wire_client, sensor_payload):
+    """A raw application/x-parquet body decodes as X (no multipart)."""
+    X = pd.DataFrame(
+        {t: list(c.values()) for t, c in sensor_payload["X"].items()},
+        index=pd.DatetimeIndex(
+            list(next(iter(sensor_payload["X"].values())))
+        ),
+    )
+    from gordo_tpu.server.utils import dataframe_into_parquet_bytes
+
+    resp = wire_client.post(
+        URL,
+        data=dataframe_into_parquet_bytes(X),
+        headers={"Content-Type": "application/x-parquet"},
+    )
+    assert resp.status_code == 200
+    assert json.loads(resp.data)["data"]["model-output"]
+
+
+def test_format_parquet_query_arg_wins(wire_client, sensor_payload):
+    """Legacy precedence: ?format=parquet beats any Accept header."""
+    resp = wire_client.post(
+        URL + "?format=parquet",
+        json=sensor_payload,
+        headers={"Accept": wire.ARROW_CONTENT_TYPE},
+    )
+    assert resp.status_code == 200
+    assert resp.content_type == "application/octet-stream"
+    from gordo_tpu.server.utils import dataframe_from_parquet_bytes
+
+    frame = dataframe_from_parquet_bytes(resp.data)
+    assert "model-output" in {c[0] for c in frame.columns}
+
+
+def test_negotiated_parquet_accept(wire_client, sensor_payload):
+    resp = wire_client.post(
+        URL,
+        json=sensor_payload,
+        headers={"Accept": "application/x-parquet"},
+    )
+    assert resp.status_code == 200
+    assert resp.content_type == "application/octet-stream"
+
+
+def test_parquet_response_identical_fast_and_legacy(
+    collection_dir, sensor_payload
+):
+    """The ?format=parquet wire keeps decoding to the same frame whether
+    the columnar path assembled it or the legacy pandas path did."""
+    from gordo_tpu.server.utils import dataframe_from_parquet_bytes
+
+    frames = {}
+    with temp_env_vars(MODEL_COLLECTION_DIR=collection_dir):
+        for switch in ("1", "0"):
+            with temp_env_vars(GORDO_TPU_WIRE_COLUMNAR=switch):
+                STORE.clear()
+                resp = Client(build_app(config={})).post(
+                    ANOMALY_URL + "?format=parquet", json=sensor_payload
+                )
+                assert resp.status_code == 200
+                frames[switch] = dataframe_from_parquet_bytes(resp.data)
+    pd.testing.assert_frame_equal(frames["1"], frames["0"])
+
+
+def test_stream_mode_bytes_identical(wire_client, sensor_payload):
+    """GORDO_TPU_WIRE_STREAM chunks concatenate to the exact unstreamed
+    body."""
+    plain = wire_client.post(ANOMALY_URL, json=sensor_payload)
+    assert plain.status_code == 200
+    with temp_env_vars(GORDO_TPU_WIRE_STREAM="1"):
+        streamed = wire_client.post(ANOMALY_URL, json=sensor_payload)
+    assert streamed.status_code == 200
+    import re
+
+    norm = lambda b: re.sub(  # noqa: E731
+        rb'"time-seconds": "[0-9.]+"', b'"T"', b
+    )
+    assert norm(streamed.data) == norm(plain.data)
+
+
+def test_fleet_parquet_accept_is_406(wire_client, sensor_payload):
+    resp = wire_client.post(
+        "/gordo/v0/test-project/prediction/fleet?format=parquet",
+        json={"X": {"machine-1": sensor_payload["X"]}},
+    )
+    assert resp.status_code == 406

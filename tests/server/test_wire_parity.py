@@ -1,0 +1,417 @@
+"""
+Columnar wire fast-path parity: the fast JSON encoder must produce the
+legacy serializer's bytes EXACTLY, and every (request format × response
+format) cell of the negotiation matrix must score identically — batched,
+unbatched, and across a concurrent hot-swap.
+"""
+
+import json
+import re
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu.server import build_app
+from gordo_tpu.server import wire
+from gordo_tpu.server.fleet_store import STORE
+
+from .conftest import temp_env_vars
+
+pytestmark = pytest.mark.wire
+
+TIME_RE = re.compile(rb'"time-seconds": "[0-9.]+"')
+
+
+def _norm(body: bytes) -> bytes:
+    return TIME_RE.sub(b'"time-seconds": "T"', body)
+
+
+def _client(collection_dir):
+    return Client(build_app(config={}))
+
+
+def _arrow_frames(sensor_payload):
+    X = pd.DataFrame(
+        {
+            tag: list(col.values())
+            for tag, col in sensor_payload["X"].items()
+        },
+        index=pd.DatetimeIndex(list(next(iter(sensor_payload["X"].values())))),
+    )
+    return X
+
+
+@pytest.mark.parametrize(
+    "path",
+    [
+        "/gordo/v0/test-project/machine-1/prediction",
+        "/gordo/v0/test-project/machine-1/anomaly/prediction",
+        "/gordo/v0/test-project/machine-2/prediction",
+    ],
+)
+def test_fast_json_bytes_identical_to_legacy(
+    collection_dir, sensor_payload, path
+):
+    """GORDO_TPU_WIRE_COLUMNAR on vs off: byte-for-byte identical JSON."""
+    payload = sensor_payload
+    if "machine-2" in path:
+        payload = {
+            "X": {t: sensor_payload["X"][t] for t in ("tag-1", "tag-2")}
+        }
+    bodies = {}
+    with temp_env_vars(MODEL_COLLECTION_DIR=collection_dir):
+        for switch in ("1", "0"):
+            with temp_env_vars(GORDO_TPU_WIRE_COLUMNAR=switch):
+                STORE.clear()
+                resp = _client(collection_dir).post(path, json=payload)
+                assert resp.status_code == 200
+                bodies[switch] = _norm(resp.data)
+    assert bodies["1"] == bodies["0"]
+
+
+def test_fleet_full_json_bytes_identical_to_legacy(
+    collection_dir, sensor_payload
+):
+    bodies = {}
+    with temp_env_vars(MODEL_COLLECTION_DIR=collection_dir):
+        for switch in ("1", "0"):
+            with temp_env_vars(GORDO_TPU_WIRE_COLUMNAR=switch):
+                STORE.clear()
+                resp = _client(collection_dir).post(
+                    "/gordo/v0/test-project/prediction/fleet?full",
+                    json={"X": {"machine-1": sensor_payload["X"]}},
+                )
+                assert resp.status_code == 200
+                bodies[switch] = _norm(resp.data)
+    assert bodies["1"] == bodies["0"]
+
+
+def _assert_columns_equal(got, want):
+    for key in want:
+        try:
+            a = np.asarray(got[key], dtype=float)
+            b = np.asarray(want[key], dtype=float)
+        except (TypeError, ValueError):
+            # object columns (start/end ISO strings, None)
+            a = np.asarray(got[key], dtype=object)
+            b = np.asarray(want[key], dtype=object)
+        np.testing.assert_array_equal(a, b, err_msg=str(key))
+
+
+def _decode_any(resp):
+    """One response (JSON or Arrow) as {group: {sub: np.array}}."""
+    if resp.content_type == wire.ARROW_CONTENT_TYPE:
+        frame, _ = wire.decode_response(resp.data)
+        return {
+            (group, sub): frame[(group, sub)].to_numpy()
+            for group, sub in frame.columns
+        }
+    data = json.loads(resp.data)["data"]
+    out = {}
+    for group, subs in data.items():
+        for sub, cells in subs.items():
+            # scalar groups nest under their own name on the wire
+            out[(group, "" if sub == group else sub)] = np.array(
+                [v for v in cells.values()], dtype=object
+            )
+    return out
+
+
+@pytest.mark.parametrize("request_format", ["json", "arrow"])
+@pytest.mark.parametrize("response_format", ["json", "arrow"])
+def test_format_matrix_identical_scores(
+    collection_dir, sensor_payload, request_format, response_format
+):
+    """Every request×response format combination answers numerically
+    identical anomaly columns."""
+    X = _arrow_frames(sensor_payload)
+    with temp_env_vars(MODEL_COLLECTION_DIR=collection_dir):
+        STORE.clear()
+        client = _client(collection_dir)
+        url = "/gordo/v0/test-project/machine-1/anomaly/prediction"
+        headers = {}
+        if response_format == "arrow":
+            headers["Accept"] = wire.ARROW_CONTENT_TYPE
+        if request_format == "arrow":
+            resp = client.post(
+                url,
+                data=wire.encode_request(X, X),
+                headers={
+                    **headers,
+                    "Content-Type": wire.ARROW_CONTENT_TYPE,
+                },
+            )
+        else:
+            resp = client.post(url, json=sensor_payload, headers=headers)
+        assert resp.status_code == 200, resp.data[:300]
+        got = _decode_any(resp)
+
+        # the reference cell: JSON in, JSON out
+        reference = client.post(url, json=sensor_payload)
+        assert reference.status_code == 200
+        want = _decode_any(reference)
+
+    assert set(got) == set(want)
+    _assert_columns_equal(got, want)
+
+
+def test_fleet_arrow_container_matches_json(collection_dir, sensor_payload):
+    """The fleet route's Arrow container carries the same verdicts as
+    its JSON twin — full mode, per-machine record batches."""
+    X = _arrow_frames(sensor_payload)
+    with temp_env_vars(MODEL_COLLECTION_DIR=collection_dir):
+        STORE.clear()
+        client = _client(collection_dir)
+        json_resp = client.post(
+            "/gordo/v0/test-project/prediction/fleet?full",
+            json={"X": {"machine-1": sensor_payload["X"]}},
+        )
+        assert json_resp.status_code == 200
+        body = wire.pack_streams(
+            {"machine-1": wire.encode_request(X, X)}, extra={"full": True}
+        )
+        arrow_resp = client.post(
+            "/gordo/v0/test-project/prediction/fleet",
+            data=body,
+            headers={
+                "Content-Type": wire.ARROW_CONTENT_TYPE,
+                "Accept": wire.ARROW_CONTENT_TYPE,
+            },
+        )
+        assert arrow_resp.status_code == 200
+        assert arrow_resp.content_type == wire.ARROW_CONTENT_TYPE
+
+    json_entry = json.loads(json_resp.data)["data"]["machine-1"]
+    entries, extra = wire.unpack_streams(arrow_resp.data)
+    assert extra.get("errors") == {}
+    frame, _ = wire.decode_response(entries["machine-1"])
+    j_total = np.array(
+        list(json_entry["total-anomaly-scaled"]["total-anomaly-scaled"].values()),
+        dtype=float,
+    )
+    a_total = frame[("total-anomaly-scaled", "")].to_numpy(dtype=float)
+    np.testing.assert_array_equal(a_total, j_total)
+
+
+def test_fleet_lean_arrow(collection_dir, sensor_payload):
+    """Lean (default) fleet mode over Arrow: model-output + per-row mse
+    per machine."""
+    X = _arrow_frames(sensor_payload)
+    with temp_env_vars(MODEL_COLLECTION_DIR=collection_dir):
+        STORE.clear()
+        resp = _client(collection_dir).post(
+            "/gordo/v0/test-project/prediction/fleet",
+            data=wire.pack_streams({"machine-1": wire.encode_request(X)}),
+            headers={
+                "Content-Type": wire.ARROW_CONTENT_TYPE,
+                "Accept": wire.ARROW_CONTENT_TYPE,
+            },
+        )
+        assert resp.status_code == 200
+    entries, extra = wire.unpack_streams(resp.data)
+    frame, _ = wire.decode_response(entries["machine-1"])
+    groups = {group for group, _ in frame.columns}
+    assert groups == {"model-output", "total-anomaly-unscaled"}
+    assert np.isfinite(
+        frame[("total-anomaly-unscaled", "")].to_numpy(dtype=float)
+    ).all()
+
+
+@pytest.mark.parametrize(
+    "path",
+    [
+        "/gordo/v0/test-project/machine-1/prediction",
+        "/gordo/v0/test-project/machine-1/anomaly/prediction",
+    ],
+)
+def test_arrow_served_from_legacy_frame_fallback(
+    collection_dir, sensor_payload, path
+):
+    """Review regression: with the columnar path off (the documented
+    escape hatch — and the same code path custom detectors take), an
+    Arrow-accepting client must still get a bridged Arrow response,
+    not a bogus duplicate-labels 400."""
+    with temp_env_vars(MODEL_COLLECTION_DIR=collection_dir):
+        STORE.clear()
+        client = _client(collection_dir)
+        fast = client.post(
+            path,
+            json=sensor_payload,
+            headers={"Accept": wire.ARROW_CONTENT_TYPE},
+        )
+        assert fast.status_code == 200
+        with temp_env_vars(GORDO_TPU_WIRE_COLUMNAR="0"):
+            bridged = client.post(
+                path,
+                json=sensor_payload,
+                headers={"Accept": wire.ARROW_CONTENT_TYPE},
+            )
+    assert bridged.status_code == 200, bridged.data[:300]
+    assert bridged.content_type == wire.ARROW_CONTENT_TYPE
+    fast_frame, _ = wire.decode_response(fast.data)
+    bridged_frame, _ = wire.decode_response(bridged.data)
+    assert list(fast_frame.columns) == list(bridged_frame.columns)
+    for column in fast_frame.columns:
+        np.testing.assert_array_equal(
+            fast_frame[column].to_numpy(),
+            bridged_frame[column].to_numpy(),
+            err_msg=str(column),
+        )
+
+
+def test_fleet_arrow_served_from_legacy_frame_fallback(
+    collection_dir, sensor_payload
+):
+    """Same bridge on the fleet full path (where the legacy frame rides
+    per-machine error isolation, never a whole-batch failure)."""
+    X = _arrow_frames(sensor_payload)
+    body = wire.pack_streams(
+        {"machine-1": wire.encode_request(X, X)}, extra={"full": True}
+    )
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=collection_dir, GORDO_TPU_WIRE_COLUMNAR="0"
+    ):
+        STORE.clear()
+        resp = _client(collection_dir).post(
+            "/gordo/v0/test-project/prediction/fleet",
+            data=body,
+            headers={
+                "Content-Type": wire.ARROW_CONTENT_TYPE,
+                "Accept": wire.ARROW_CONTENT_TYPE,
+            },
+        )
+    assert resp.status_code == 200, resp.data[:300]
+    entries, extra = wire.unpack_streams(resp.data)
+    assert extra["errors"] == {}
+    frame, _ = wire.decode_response(entries["machine-1"])
+    assert ("total-anomaly-scaled", "") in list(frame.columns)
+
+
+def test_duplicate_label_frames_are_not_arrow_representable():
+    """Review regression: WireTable.from_frame on a duplicate-label
+    frame must flag itself non-unique (the encoders' refusal guard)
+    instead of smuggling 2-D column blocks into the wire."""
+    frame = pd.DataFrame(
+        np.arange(6, dtype=float).reshape(2, 3),
+        columns=pd.MultiIndex.from_tuples(
+            [("g", "a"), ("g", "a"), ("g", "b")]
+        ),
+    )
+    assert not wire.WireTable.from_frame(frame).unique_labels()
+
+
+def test_matrix_parity_under_batching(collection_dir, sensor_payload):
+    """Batched (micro-batcher) vs unbatched, JSON vs Arrow: identical
+    scores for the same rows."""
+    from gordo_tpu import serve
+    from gordo_tpu.serve import ServeConfig, ServeEngine
+
+    X = _arrow_frames(sensor_payload)
+    url = "/gordo/v0/test-project/machine-1/anomaly/prediction"
+    with temp_env_vars(MODEL_COLLECTION_DIR=collection_dir):
+        STORE.clear()
+        client = _client(collection_dir)
+        unbatched = client.post(url, json=sensor_payload)
+        assert unbatched.status_code == 200
+
+        engine = ServeEngine(
+            ServeConfig(max_size=4, max_delay_ms=5.0, deadline_ms=30000.0)
+        )
+        serve.install_engine(engine)
+        try:
+            batched_json = client.post(url, json=sensor_payload)
+            batched_arrow = client.post(
+                url,
+                data=wire.encode_request(X, X),
+                headers={
+                    "Content-Type": wire.ARROW_CONTENT_TYPE,
+                    "Accept": wire.ARROW_CONTENT_TYPE,
+                },
+            )
+        finally:
+            serve.install_engine(None)
+            engine.shutdown(drain=True)
+    assert batched_json.status_code == 200
+    assert batched_arrow.status_code == 200
+    want = _decode_any(unbatched)
+    for resp in (batched_json, batched_arrow):
+        _assert_columns_equal(_decode_any(resp), want)
+
+
+def test_mixed_formats_concurrent_hot_swap(
+    model_collection_root, collection_dir, sensor_payload
+):
+    """The PR 6 snapshot contract extended to the codec path: concurrent
+    clients mixing JSON and Arrow against one app, while the store
+    hot-swaps revisions under them — every response 200 and internally
+    consistent, no torn decodes."""
+    from .conftest import OLD_REVISION
+
+    X = _arrow_frames(sensor_payload)
+    old_dir = str(model_collection_root / OLD_REVISION)
+    url = "/gordo/v0/test-project/machine-1/anomaly/prediction"
+    arrow_body = wire.encode_request(X, X)
+    failures = []
+    stop = threading.Event()
+
+    with temp_env_vars(MODEL_COLLECTION_DIR=collection_dir):
+        STORE.clear()
+        app = build_app(config={})
+
+        def worker(use_arrow: bool):
+            client = Client(app)
+            while not stop.is_set():
+                try:
+                    if use_arrow:
+                        resp = client.post(
+                            url,
+                            data=arrow_body,
+                            headers={
+                                "Content-Type": wire.ARROW_CONTENT_TYPE,
+                                "Accept": wire.ARROW_CONTENT_TYPE,
+                            },
+                        )
+                        assert resp.status_code == 200, resp.data[:200]
+                        frame, extra = wire.decode_response(resp.data)
+                        assert extra["revision"] in (
+                            resp.headers["revision"],
+                        )
+                        total = frame[
+                            ("total-anomaly-scaled", "")
+                        ].to_numpy(dtype=float)
+                    else:
+                        resp = client.post(url, json=sensor_payload)
+                        assert resp.status_code == 200, resp.data[:200]
+                        doc = json.loads(resp.data)
+                        assert doc["revision"] == resp.headers["revision"]
+                        total = np.array(
+                            list(
+                                doc["data"]["total-anomaly-scaled"][
+                                    "total-anomaly-scaled"
+                                ].values()
+                            ),
+                            dtype=float,
+                        )
+                    assert np.isfinite(total).all()
+                except Exception as exc:  # noqa: BLE001 - collected
+                    failures.append(repr(exc))
+                    return
+
+        threads = [
+            threading.Thread(target=worker, args=(i % 2 == 0,))
+            for i in range(4)
+        ]
+        for thread in threads:
+            thread.start()
+        try:
+            for _ in range(6):
+                STORE.swap(collection_dir, old_dir)
+                STORE.swap(collection_dir, collection_dir)  # rollback
+        finally:
+            stop.set()
+            for thread in threads:
+                thread.join(timeout=30)
+    assert not failures, failures

@@ -1,0 +1,126 @@
+"""
+Stage-attribution contract for the reshaped wire pipeline (PR 12): the
+columnar fast path kept the five canonical stage names —
+``model_resolve`` / ``data_decode`` / ``inference`` /
+``response_assemble`` / ``serialize`` — and the exported request traces
+must still explain ≥0.9 of request walltime on BOTH wire formats, or
+``gordo-tpu trace`` (and the bench gate built on it) goes blind to the
+very pipeline this PR rebuilt.
+"""
+
+import json
+import os
+
+import pandas as pd
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu import telemetry
+from gordo_tpu.server import build_app
+from gordo_tpu.server import wire
+from gordo_tpu.server.fleet_store import STORE
+from gordo_tpu.telemetry import serving as serve_trace
+from gordo_tpu.telemetry.trace_analysis import request_breakdown
+
+from .conftest import temp_env_vars
+
+pytestmark = [pytest.mark.wire, pytest.mark.observability]
+
+WIRE_STAGES = (
+    "model_resolve",
+    "data_decode",
+    "inference",
+    "response_assemble",
+    "serialize",
+)
+
+
+@pytest.fixture
+def traced(collection_dir, tmp_path):
+    trace_dir = str(tmp_path / "telemetry")
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=collection_dir,
+        GORDO_TPU_TELEMETRY="1",
+        GORDO_TPU_TELEMETRY_DIR=trace_dir,
+        GORDO_TPU_TRACE_SAMPLE_RATE="1.0",
+    ):
+        serve_trace.reset_serve_recorder()
+        STORE.clear()
+        yield Client(build_app(config={})), trace_dir
+    serve_trace.reset_serve_recorder()
+
+
+def _spans(trace_dir):
+    serve_trace.serve_recorder().flush()
+    path = os.path.join(trace_dir, telemetry.SERVE_TRACE_FILE)
+    with open(path) as handle:
+        return [json.loads(line) for line in handle]
+
+
+def _bench_sized_payloads():
+    """A bench-scale request (256 rows × 4 tags): the attribution
+    contract is about REAL serving traffic — on a 5-row request the
+    fixed per-request machinery (context/trace setup, routing,
+    negotiation) dominates walltime and coverage measures the wrong
+    thing."""
+    index = pd.date_range(
+        "2020-03-01", periods=256, freq="1min", tz="UTC"
+    )
+    X = pd.DataFrame(
+        {f"tag-{i}": [0.1 * i + 0.001 * j for j in range(256)] for i in range(1, 5)},
+        index=index,
+    )
+    json_x = {
+        tag: {ts.isoformat(): value for ts, value in column.items()}
+        for tag, column in X.to_dict().items()
+    }
+    return X, {"X": json_x, "y": json_x}
+
+
+@pytest.mark.parametrize("wire_format", ["json", "arrow"])
+def test_columnar_route_keeps_stage_attribution(traced, wire_format):
+    import threading
+
+    client, trace_dir = traced
+    url = "/gordo/v0/test-project/machine-1/anomaly/prediction"
+    X, json_payload = _bench_sized_payloads()
+    arrow_body = wire.encode_request(X, X)
+
+    def one_request():
+        if wire_format == "arrow":
+            resp = client.post(
+                url,
+                data=arrow_body,
+                headers={
+                    "Content-Type": wire.ARROW_CONTENT_TYPE,
+                    "Accept": wire.ARROW_CONTENT_TYPE,
+                },
+            )
+        else:
+            resp = client.post(url, json=json_payload)
+        assert resp.status_code == 200
+        # Server-Timing carries every wire stage, whatever the format
+        timing = resp.headers["Server-Timing"]
+        for stage in WIRE_STAGES:
+            assert stage in timing, (wire_format, stage, timing)
+
+    one_request()  # warm caches/compiles
+    # concurrent clients: the ≥0.9 contract describes SERVING traffic —
+    # under concurrency scheduler waits land inside whichever stage owns
+    # the work, while an idle single-threaded request is mostly fixed
+    # per-request machinery and would measure the wrong thing
+    threads = [
+        threading.Thread(target=lambda: [one_request() for _ in range(3)])
+        for _ in range(4)
+    ]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=120)
+
+    spans = _spans(trace_dir)
+    names = {s["name"] for s in spans}
+    for stage in WIRE_STAGES:
+        assert stage in names, f"{stage} not exported on {wire_format}"
+    breakdown = request_breakdown(spans)
+    assert breakdown["attribution_coverage"] >= 0.9, breakdown

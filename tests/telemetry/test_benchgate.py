@@ -24,8 +24,12 @@ BASELINE = {
         "throughput_rps": 20.0,
         "p50_ms": 700.0,
         "attribution_coverage": 0.95,
+        "stages": {"response_assemble": {"p50_ms": 1.0}},
     },
-    "scoring_overhead": {"overhead_pct": 1.0},
+    "scoring_overhead": {"overhead_us_per_request": 20.0},
+    # the columnar-wire acceptance set (PR 12)
+    "route_gap_p50_ratio": 2.0,
+    "route_batched_vs_unbatched": 0.95,
 }
 
 
@@ -70,7 +74,8 @@ def test_latency_regression_fails():
 
 def test_budget_bound_is_baseline_independent():
     report = compare(
-        BASELINE, _candidate(**{"scoring_overhead.overhead_pct": 5.0})
+        BASELINE,
+        _candidate(**{"scoring_overhead.overhead_us_per_request": 100.0}),
     )
     assert not report["ok"]
     failure = next(r for r in report["results"] if r["status"] == "regression")
@@ -87,9 +92,24 @@ def test_tolerance_scale_applies_to_budget_bounds_too():
     """--tolerance promises 'twice as lenient' for EVERY gate; a budget
     metric (the noisiest kind — wall-clock overhead deltas) must not
     veto the loosening."""
-    candidate = _candidate(**{"scoring_overhead.overhead_pct": 3.0})
-    assert not compare(BASELINE, candidate)["ok"]  # budget is 2.0
-    assert compare(BASELINE, candidate, tolerance_scale=2.0)["ok"]  # 4.0
+    candidate = _candidate(
+        **{"scoring_overhead.overhead_us_per_request": 90.0}
+    )
+    assert not compare(BASELINE, candidate)["ok"]  # budget is 60
+    assert compare(BASELINE, candidate, tolerance_scale=2.0)["ok"]  # 120
+
+
+def test_min_bound_floor_and_scaling():
+    """min_bound: an absolute floor (the route-level batching parity
+    gate); --tolerance DIVIDES the floor (more lenient = lower)."""
+    candidate = _candidate(**{"route_batched_vs_unbatched": 0.5})
+    report = compare(BASELINE, candidate)
+    assert not report["ok"]
+    failure = next(
+        r for r in report["results"] if r["status"] == "regression"
+    )
+    assert "floor" in failure["detail"]
+    assert compare(BASELINE, candidate, tolerance_scale=1.5)["ok"]  # 0.4
 
 
 def test_missing_candidate_metric_is_a_regression():
