@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-fleet-health test-slo docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-check lint lint-gordo image
+.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-concurrency test-fleet-health test-slo docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-check lint lint-gordo lockgraph-check image
 
 test:
 	python -m pytest tests/ -q
@@ -123,12 +123,35 @@ docs:
 	python docs/generate_env_docs.py
 
 # The invariant gate (gordo_tpu/analysis/): layering arrows, JAX
-# hazards, env-knob registry, atomic writes, clock discipline, and
-# Prometheus cardinality over gordo_tpu/ itself — non-zero exit on any
-# finding that is neither suppressed in-file nor justified in
-# lint_baseline.json. CI's `lint` job runs exactly this.
+# hazards, env-knob registry, atomic writes, clock discipline,
+# Prometheus cardinality, and the concurrency contracts (lock-guard
+# inference, COW-publish discipline, fork-safety, thread lifecycle)
+# over gordo_tpu/ itself — non-zero exit on any finding that is neither
+# suppressed in-file nor justified in lint_baseline.json. CI's `lint`
+# job runs exactly this (plus `--sarif` for the annotation artifact).
 lint-gordo:
 	python -m gordo_tpu lint
+
+# The runtime half of the concurrency gate: run the threaded suites
+# (serve, telemetry, lifecycle) with every lock instrumented
+# (GORDO_TPU_LOCK_TRACE), then fail on any acquisition-ordering cycle —
+# a cycle is two threads ordering the same locks differently, i.e. a
+# deadlock waiting for the right interleaving. CI's `lint` job runs
+# the same pair of steps.
+lockgraph-check:
+	rm -f lock_trace-*.jsonl
+	JAX_PLATFORMS=cpu GORDO_TPU_LOCK_TRACE=lock_trace.jsonl \
+		python -m pytest tests/serve tests/telemetry tests/lifecycle \
+		-q -m 'not slow' -p no:cacheprovider
+	python -m gordo_tpu lockgraph 'lock_trace-*.jsonl'
+
+# The concurrency-contract suite: rule fixtures (lock-guard/COW/fork/
+# thread-lifecycle), the lock-order harness unit tests, the COW
+# hot-swap stress drill, the ledger/recorder fork drills, and the
+# shutdown thread audit — CPU-only and not slow-marked, so the same
+# tests also run inside the tier-1 budget.
+test-concurrency:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m concurrency
 
 # The static-analysis test suite: per-rule fixture trees, suppression/
 # baseline semantics, and the tier-1 self-run asserting gordo_tpu/ is

@@ -2,10 +2,14 @@
 Project-specific static analysis (``gordo-tpu lint``): an AST rule
 engine that enforces the codebase's load-bearing invariants in CI —
 layering arrows, JAX dispatch hazards, the env-knob registry contract,
-atomic artifact writes, monotonic-clock deadline math, and Prometheus
-label cardinality. See ``docs/static-analysis.md`` for the rule catalog,
-suppression (``# gt-lint: disable=<rule>``) and baseline semantics, and
-the how-to-add-a-rule guide.
+atomic artifact writes, monotonic-clock deadline math, Prometheus label
+cardinality, and the concurrency contracts (lock-guard inference,
+copy-on-write publish discipline, fork-safety, thread lifecycle) — plus
+the opt-in runtime lock-order harness (``lockgraph``, the
+``GORDO_TPU_LOCK_TRACE`` knob and the ``gordo-tpu lockgraph`` deadlock
+gate). See ``docs/static-analysis.md`` for the rule catalog, suppression
+(``# gt-lint: disable=<rule>``) and baseline semantics, and the
+how-to-add-a-rule guide.
 """
 
 from .baseline import (
@@ -17,26 +21,39 @@ from .baseline import (
     split_by_baseline,
     write_baseline,
 )
-from .contracts import Contracts, LayeringArrow, load_contracts
+from .contracts import Contracts, CowContract, LayeringArrow, load_contracts
 from .core import Finding, LintResult, run_lint
+from .lockgraph import (
+    LOCK_TRACE_ENV,
+    analyze as analyze_lock_graph,
+    install_lock_trace,
+    lock_trace_sink,
+)
 from .report import lint_document, render_report
 from .rules import default_rules
+from .sarif import sarif_document
 
 __all__ = [
     "BASELINE_FILENAME",
     "BaselineEntry",
     "BaselineError",
     "Contracts",
+    "CowContract",
     "Finding",
+    "LOCK_TRACE_ENV",
     "LayeringArrow",
     "LintResult",
+    "analyze_lock_graph",
     "default_baseline_path",
     "default_rules",
+    "install_lock_trace",
     "lint_document",
     "load_baseline",
     "load_contracts",
+    "lock_trace_sink",
     "render_report",
     "run_lint",
+    "sarif_document",
     "split_by_baseline",
     "write_baseline",
 ]

@@ -29,6 +29,18 @@ class LayeringArrow:
     reason: str = ""
 
 
+@dataclass(frozen=True)
+class CowContract:
+    """Attributes declared copy-on-write: replaced whole under their
+    lock, read lock-free, never mutated in place. ``cls`` empty means
+    every scope in ``module``."""
+
+    module: str
+    attributes: Tuple[str, ...]
+    cls: str = ""
+    reason: str = ""
+
+
 @dataclass
 class Contracts:
     """Every contract document, with file defaults where a key is absent."""
@@ -53,6 +65,14 @@ class Contracts:
     prometheus_scopes: Tuple[str, ...] = ()
     prometheus_tainted_roots: Tuple[str, ...] = ("request",)
     prometheus_suspect_loop_vars: str = "member|machine|gordo_name"
+    concurrency_lock_scopes: Tuple[str, ...] = ()
+    concurrency_fork_scopes: Tuple[str, ...] = ()
+    concurrency_pid_sources: Tuple[str, ...] = ()
+    concurrency_postfork_registrars: Tuple[str, ...] = (
+        "register_postfork_reset",
+        "os.register_at_fork",
+    )
+    concurrency_cow: Tuple[CowContract, ...] = ()
 
 
 def _parse_toml_subset(text: str) -> Dict:
@@ -133,6 +153,16 @@ def load_contracts(path: Optional[str] = None) -> Contracts:
     atomic = doc.get("atomic", {})
     clock = doc.get("clock", {})
     prometheus = doc.get("prometheus", {})
+    concurrency = doc.get("concurrency", {})
+    cow = tuple(
+        CowContract(
+            module=str(entry.get("module", "")),
+            attributes=tuple(entry.get("attributes", ())),
+            cls=str(entry.get("class", "")),
+            reason=str(entry.get("reason", "")),
+        )
+        for entry in concurrency.get("cow", ())
+    )
     defaults = Contracts()
     return Contracts(
         arrows=arrows,
@@ -159,6 +189,15 @@ def load_contracts(path: Optional[str] = None) -> Contracts:
                 "suspect_loop_vars", defaults.prometheus_suspect_loop_vars
             )
         ),
+        concurrency_lock_scopes=tuple(concurrency.get("lock_scopes", ())),
+        concurrency_fork_scopes=tuple(concurrency.get("fork_scopes", ())),
+        concurrency_pid_sources=tuple(concurrency.get("pid_sources", ())),
+        concurrency_postfork_registrars=tuple(
+            concurrency.get(
+                "postfork_registrars", defaults.concurrency_postfork_registrars
+            )
+        ),
+        concurrency_cow=cow,
     )
 
 

@@ -1,0 +1,419 @@
+"""
+The runtime lock-order harness: instrumented locks + a deadlock analyzer.
+
+Static lock-guard inference (``rules/concurrency.py``) proves writes sit
+under the right lock; it cannot prove two locks are always taken in the
+same ORDER — the classic ABBA deadlock needs runtime evidence. This
+module supplies it, opt-in and zero-cost when off:
+
+- ``GORDO_TPU_LOCK_TRACE=<path>.jsonl`` (or ``=1`` for
+  ``./lock_trace.jsonl``) makes :func:`install_lock_trace` wrap
+  ``threading.Lock``/``threading.RLock`` so every lock created AFTER
+  installation is a :class:`TracedLock`. Lock identity is the
+  **creation site** (``file:line``), not the instance — a per-request
+  lock allocated a million times is still one node, which is what makes
+  the graph meaningful.
+- each thread keeps its held-lock stack; acquiring lock *B* while
+  holding *A* records the ordering edge ``A -> B`` (with wait time and
+  a held-while-blocking sample) into an in-process edge table, flushed
+  as JSON lines at interpreter exit (and on :func:`dump_edges`). The
+  tests' conftest auto-installs under the env knob, so
+  ``GORDO_TPU_LOCK_TRACE=1 pytest -m "serve or slo or lifecycle"``
+  leaves a sink the CI gate can analyze.
+- :func:`analyze` loads one or more edge sinks, builds the lock-order
+  graph, and reports every cycle (a potential deadlock: some thread
+  orders A before B, another B before A) plus the
+  max-held-while-blocking hotspots — the edges where a thread sat
+  longest waiting for a lock while holding another one (the convoy
+  telemetry the serving stack's lock budget cares about).
+  ``gordo-tpu lockgraph`` is the CLI; CI fails on any cycle.
+
+The wrapper honors the full lock protocol (``acquire``/``release``/
+context manager/``locked``) and delegates everything else, so
+``threading.Condition(traced_lock)`` works — the Condition binds the
+wrapper's ``acquire``/``release``, which is exactly how the
+micro-batcher's ``Condition(self._lock)`` alias stays one graph node.
+"""
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+LOCK_TRACE_ENV = "GORDO_TPU_LOCK_TRACE"
+
+#: default sink when the knob is a bare truthy flag rather than a path
+DEFAULT_SINK = "lock_trace.jsonl"
+
+#: edges whose acquirer never waited are still ordering evidence; the
+#: hotspot report ranks by wait, the cycle check ignores it
+_EDGE_FIELDS = ("src", "dst", "count", "max_wait_ms", "total_wait_ms")
+
+
+def lock_trace_sink() -> Optional[str]:
+    """The configured edge-sink path, or None when tracing is off.
+
+    ``GORDO_TPU_LOCK_TRACE`` is the knob: a path-looking value (has a
+    separator or a ``.jsonl`` tail) is the sink path; any other truthy
+    spelling means :data:`DEFAULT_SINK` in the current directory. The
+    sink is pid-suffixed, fork-safely: each process that actually
+    records writes its own file and the analyzer globs them back
+    together (the same worker-sink convention as ``serve_trace``)."""
+    from ..utils.env import env_str
+
+    raw = env_str(LOCK_TRACE_ENV, None)
+    if not raw:
+        return None
+    value = raw.strip()
+    if value.lower() in ("0", "false", "off", "no"):
+        return None
+    if os.sep in value or value.endswith(".jsonl"):
+        return value
+    return DEFAULT_SINK
+
+
+class _TraceState:
+    """Process-wide trace state: per-thread held stacks + the edge table."""
+
+    def __init__(self, base_path: str):
+        #: UNsuffixed: the pid lands in the filename at DUMP time, so a
+        #: worker forked after install still writes its own file — the
+        #: frozen-pid-path bug class the fork-safety rule bans (a child
+        #: inherits the parent's pre-fork edges and re-dumps them; the
+        #: analyzer's merge double-counts those, which only inflates
+        #: hotspot totals, never invents or hides a cycle)
+        self.base_path = base_path
+        self.local = threading.local()
+        self.table_lock = _REAL_LOCK()
+        #: (src site, dst site) -> [count, max_wait_s, total_wait_s]
+        self.edges: Dict[Tuple[str, str], List[float]] = {}
+
+    def held(self) -> List[str]:
+        stack = getattr(self.local, "stack", None)
+        if stack is None:
+            stack = self.local.stack = []
+        return stack
+
+    def note_edges(self, dst_site: str, wait_s: float, held: List[str]) -> None:
+        with self.table_lock:
+            for src_site in held:
+                if src_site == dst_site:
+                    continue  # re-entrant same-site acquisition orders nothing
+                entry = self.edges.get((src_site, dst_site))
+                if entry is None:
+                    entry = self.edges[(src_site, dst_site)] = [0, 0.0, 0.0]
+                entry[0] += 1
+                entry[1] = max(entry[1], wait_s)
+                entry[2] += wait_s
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self.table_lock:
+            items = sorted(self.edges.items())
+        return [
+            {
+                "src": src,
+                "dst": dst,
+                "count": int(count),
+                "max_wait_ms": round(max_wait * 1000.0, 3),
+                "total_wait_ms": round(total_wait * 1000.0, 3),
+            }
+            for (src, dst), (count, max_wait, total_wait) in items
+        ]
+
+
+_state: Optional[_TraceState] = None
+#: the REAL factories, captured before any patching (TracedLock's own
+#: internals must never recurse through the wrapper)
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_install_guard = threading.Lock()
+
+
+class TracedLock:
+    """A ``threading.Lock``/``RLock`` wrapper that records ordering
+    edges. Site identity comes from the allocation site so instances
+    coalesce; re-entrant RLock re-acquisitions neither push the stack
+    twice nor record self-edges."""
+
+    __slots__ = ("_inner", "_site", "_reentrant")
+
+    def __init__(self, inner, site: str, reentrant: bool):
+        self._inner = inner
+        self._site = site
+        self._reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        state = _state
+        if state is None:
+            return self._inner.acquire(blocking, timeout)
+        held = state.held()
+        start = time.monotonic()
+        acquired = self._inner.acquire(blocking, timeout)
+        if acquired:
+            if not (self._reentrant and self._site in held):
+                state.note_edges(
+                    self._site, time.monotonic() - start, held
+                )
+                held.append(self._site)
+            elif self._reentrant:
+                held.append(self._site)  # balanced with release's pop
+        return acquired
+
+    def release(self):
+        state = _state
+        if state is not None:
+            held = state.held()
+            if self._site in held:
+                # remove the most recent acquisition of this site (locks
+                # release LIFO in with-blocks; out-of-order release still
+                # drops the right site)
+                for index in range(len(held) - 1, -1, -1):
+                    if held[index] == self._site:
+                        del held[index]
+                        break
+        return self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<TracedLock {self._site} {self._inner!r}>"
+
+    def __getattr__(self, name):
+        # Condition probes _is_owned/_release_save/_acquire_restore on
+        # RLocks; delegate so wait() keeps working (its internal
+        # release/reacquire bypasses tracing, which is fine — a parked
+        # waiter acquires nothing else meanwhile)
+        return getattr(self._inner, name)
+
+
+def _allocation_site() -> str:
+    """``relpath:line`` of the frame that called the lock factory."""
+    import sys
+
+    frame = sys._getframe(2)
+    filename = frame.f_code.co_filename.replace("\\", "/")
+    parts = filename.rsplit("/", 3)
+    short = "/".join(parts[-2:]) if len(parts) > 1 else filename
+    return f"{short}:{frame.f_lineno}"
+
+
+class _Factory:
+    """A non-function callable: third-party code stores
+    ``threading.Lock`` as a CLASS attribute and calls
+    ``self.lock_class()`` (werkzeug's routing Map does) — a plain
+    function patched into ``threading`` would descriptor-bind there and
+    receive a spurious ``self``. Instances don't bind."""
+
+    __slots__ = ("_make", "_reentrant")
+
+    def __init__(self, make, reentrant: bool):
+        self._make = make
+        self._reentrant = reentrant
+
+    def __call__(self):
+        return TracedLock(self._make(), _allocation_site(), self._reentrant)
+
+
+_traced_lock_factory = _Factory(_REAL_LOCK, reentrant=False)
+_traced_rlock_factory = _Factory(_REAL_RLOCK, reentrant=True)
+
+
+def install_lock_trace(sink_path: Optional[str] = None) -> bool:
+    """Patch ``threading.Lock``/``RLock`` so locks created from now on
+    are traced; idempotent; returns whether tracing is (now) on. With
+    no ``sink_path``, the env knob decides — off means no-op."""
+    global _state
+    path = sink_path or lock_trace_sink()
+    if path is None:
+        return _state is not None
+    with _install_guard:
+        if _state is not None:
+            return True
+        _state = _TraceState(path)
+        threading.Lock = _traced_lock_factory
+        threading.RLock = _traced_rlock_factory
+        atexit.register(dump_edges)
+    return True
+
+
+def uninstall_lock_trace() -> None:
+    """Restore the real factories and drop the trace state (tests)."""
+    global _state
+    with _install_guard:
+        threading.Lock = _REAL_LOCK
+        threading.RLock = _REAL_RLOCK
+        _state = None
+
+
+def trace_active() -> bool:
+    return _state is not None
+
+
+def dump_edges(path: Optional[str] = None) -> Optional[str]:
+    """Write the aggregated edge table as JSON lines (one edge per
+    line; ``meta`` line first). Returns the path written, or None when
+    tracing is off. Registered atexit by :func:`install_lock_trace`, so
+    a traced test run leaves its sink behind without any teardown
+    plumbing."""
+    state = _state
+    if state is None:
+        return None
+    if path is None:
+        stem, ext = os.path.splitext(state.base_path)
+        path = f"{stem}-{os.getpid()}{ext or '.jsonl'}"
+    target = path
+    edges = state.snapshot()
+    directory = os.path.dirname(target)
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    tmp = f"{target}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as handle:
+        handle.write(
+            json.dumps(
+                {"meta": {"pid": os.getpid(), "edges": len(edges)}}
+            )
+            + "\n"
+        )
+        for edge in edges:
+            handle.write(json.dumps(edge, sort_keys=True) + "\n")
+    os.replace(tmp, target)
+    return target
+
+
+# -- analysis -----------------------------------------------------------------
+
+
+def load_edges(paths: Iterable[str]) -> List[Dict[str, Any]]:
+    """Read edge records from one or more sink files (meta lines and
+    unreadable lines are skipped; edges from different pids merge)."""
+    merged: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as handle:
+                lines = handle.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(record, dict) or "src" not in record:
+                continue
+            key = (str(record["src"]), str(record["dst"]))
+            entry = merged.get(key)
+            if entry is None:
+                merged[key] = {
+                    "src": key[0],
+                    "dst": key[1],
+                    "count": int(record.get("count", 1)),
+                    "max_wait_ms": float(record.get("max_wait_ms", 0.0)),
+                    "total_wait_ms": float(record.get("total_wait_ms", 0.0)),
+                }
+            else:
+                entry["count"] += int(record.get("count", 1))
+                entry["max_wait_ms"] = max(
+                    entry["max_wait_ms"], float(record.get("max_wait_ms", 0.0))
+                )
+                entry["total_wait_ms"] += float(record.get("total_wait_ms", 0.0))
+    return sorted(merged.values(), key=lambda e: (e["src"], e["dst"]))
+
+
+def find_cycles(edges: List[Dict[str, Any]]) -> List[List[str]]:
+    """Every elementary cycle in the lock-order graph (DFS over SCCs;
+    lock graphs are tiny — tens of nodes — so simple enumeration is
+    fine). A cycle means two threads order the same locks differently:
+    a potential deadlock."""
+    graph: Dict[str, List[str]] = {}
+    for edge in edges:
+        graph.setdefault(edge["src"], []).append(edge["dst"])
+        graph.setdefault(edge["dst"], [])
+    cycles: List[List[str]] = []
+    seen_signatures = set()
+
+    def dfs(start: str, node: str, path: List[str], visiting: set) -> None:
+        for nxt in graph.get(node, ()):
+            if nxt == start:
+                # self-loops (len(path) == 1) are re-entrancy artifacts,
+                # not ordering cycles
+                if len(path) > 1:
+                    # canonical rotation, NOT the node set: A->B->C->A
+                    # and A->C->B->A share nodes but are two distinct
+                    # ordering violations, both worth reporting
+                    pivot = path.index(min(path))
+                    signature = tuple(path[pivot:] + path[:pivot])
+                    if signature not in seen_signatures:
+                        seen_signatures.add(signature)
+                        cycles.append(path + [start])
+                continue
+            if nxt in visiting or nxt < start:
+                # only walk nodes ordered after start: each cycle is
+                # enumerated exactly once, from its smallest node
+                continue
+            visiting.add(nxt)
+            dfs(start, nxt, path + [nxt], visiting)
+            visiting.discard(nxt)
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def hotspots(edges: List[Dict[str, Any]], top: int = 10) -> List[Dict[str, Any]]:
+    """The held-while-blocking hotspots: edges ranked by the longest
+    single wait for ``dst`` while holding ``src`` — where lock convoys
+    (and the deadlock *cost*, should a cycle ever close) live."""
+    ranked = sorted(edges, key=lambda e: e["max_wait_ms"], reverse=True)
+    return ranked[:top]
+
+
+def analyze(paths: Iterable[str], top: int = 10) -> Dict[str, Any]:
+    """The full lock-order report over one or more edge sinks: the
+    merged graph, every ordering cycle, and the blocking hotspots.
+    ``ok`` is False exactly when a cycle exists — the CI gate."""
+    edges = load_edges(paths)
+    cycles = find_cycles(edges)
+    return {
+        "ok": not cycles,
+        "edges": len(edges),
+        "locks": len({e["src"] for e in edges} | {e["dst"] for e in edges}),
+        "cycles": [" -> ".join(cycle) for cycle in cycles],
+        "hotspots": hotspots(edges, top=top),
+    }
+
+
+def render_report(report: Dict[str, Any]) -> str:
+    lines = [
+        f"lock-order graph: {report['locks']} locks, "
+        f"{report['edges']} ordering edges"
+    ]
+    if report["cycles"]:
+        lines.append(f"CYCLES ({len(report['cycles'])}) — potential deadlocks:")
+        for cycle in report["cycles"]:
+            lines.append(f"  {cycle}")
+    else:
+        lines.append("no ordering cycles (deadlock-free orderings observed)")
+    if report["hotspots"]:
+        lines.append("held-while-blocking hotspots (worst single wait):")
+        for edge in report["hotspots"]:
+            lines.append(
+                f"  held {edge['src']} -> wanted {edge['dst']}: "
+                f"max {edge['max_wait_ms']:.3f}ms over {edge['count']} "
+                f"acquisitions ({edge['total_wait_ms']:.3f}ms total)"
+            )
+    lines.append("lockgraph: " + ("OK" if report["ok"] else "CYCLE DETECTED"))
+    return "\n".join(lines)

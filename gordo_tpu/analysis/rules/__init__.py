@@ -9,6 +9,12 @@ from typing import Dict, List, Optional
 
 from .atomic_write import AtomicWriteRule
 from .clock import ClockDisciplineRule
+from .concurrency import (
+    CowPublishRule,
+    ForkSafetyRule,
+    LockGuardRule,
+    ThreadLifecycleRule,
+)
 from .env_registry import EnvRegistryRule
 from .jax_hazards import JaxDeviceSyncRule, JaxStaticArgnumRule, StdlibOnlyRule
 from .layering import LayeringRule
@@ -17,12 +23,16 @@ from .prometheus_cardinality import PrometheusCardinalityRule
 __all__ = [
     "AtomicWriteRule",
     "ClockDisciplineRule",
+    "CowPublishRule",
     "EnvRegistryRule",
+    "ForkSafetyRule",
     "JaxDeviceSyncRule",
     "JaxStaticArgnumRule",
+    "LockGuardRule",
     "StdlibOnlyRule",
     "LayeringRule",
     "PrometheusCardinalityRule",
+    "ThreadLifecycleRule",
     "default_rules",
 ]
 
@@ -39,4 +49,8 @@ def default_rules(env_registry: Optional[Dict] = None) -> List:
         AtomicWriteRule(),
         ClockDisciplineRule(),
         PrometheusCardinalityRule(),
+        LockGuardRule(),
+        CowPublishRule(),
+        ForkSafetyRule(),
+        ThreadLifecycleRule(),
     ]

@@ -1,0 +1,809 @@
+"""
+The concurrency contract rules — the invariants that kept PRs 4–12's
+threaded serving stack correct, machine-checked (CHANGES.md records six
+of them being caught by hand: double-folded rollups, gunicorn-preload
+frozen pid paths, scrape-vs-/slo read-modify-write races,
+MRU-eviction-of-the-serving-fleet).
+
+Four rules share one per-file concurrency model (:func:`scope_models` —
+built once per SourceFile and cached on it):
+
+``lock-guard``
+    Per class (and per module, for module-level locks), infer which
+    ``threading.Lock``/``RLock`` guards which attributes: an attribute
+    ever *written* inside a ``with <lock>:`` block (outside
+    ``__init__``) is guarded by that lock. Any write of a guarded
+    attribute outside every guarding lock is a finding, as is
+    ``return self.<guarded>`` (publishing the live mutable object to
+    callers that hold no lock) — unless the attribute is declared
+    copy-on-write in contracts.toml, where lock-free reads of the
+    replaced-whole object are the design. Helper methods whose every
+    in-scope call site holds a lock (computed to fixpoint, so
+    ``submit -> _take_batch -> _ready_key`` chains resolve) count as
+    lock-held, and a ``Condition(self._lock)`` aliases its underlying
+    lock. Module semantics are honest Python: a bare ``NAME = ...``
+    inside a function only counts as a module write under a ``global``
+    declaration; ``REGISTRY[k] = ...`` counts when ``REGISTRY`` is
+    module-level.
+
+``cow-publish``
+    Attributes declared copy-on-write (``[[concurrency.cow]]``) may
+    only be *replaced* (whole-object assignment); any in-place mutation
+    — ``.append``/``.update``/``.setdefault``/``.pop``/``.clear``,
+    ``x[k] = v``, ``del x[k]``, ``+=`` — is a finding: a reader holding
+    the old reference would see the dict mutate under its feet, which
+    is exactly what the COW discipline exists to prevent. Attribute
+    receivers (``fleet._models.update(...)``) are flagged tree-wide;
+    bare-name receivers only inside the declaring module.
+
+``fork-safety``
+    A function that derives state from process identity (a declared
+    ``pid_source``: ``os.getpid``, ``worker_sink_path``, …) and stores
+    it in a module-level mutable registry builds the
+    gunicorn-``--preload`` frozen-pid bug class: every forked worker
+    inherits the parent's memoized value and clobbers one shared sink.
+    Such modules must register a post-fork reset hook
+    (``utils.postfork.register_postfork_reset`` /
+    ``os.register_at_fork``) at import time.
+
+``thread-lifecycle``
+    Every ``threading.Thread(...)`` must be ``daemon=True`` or joined
+    somewhere in its module (a non-daemon, never-joined thread turns
+    SIGTERM into a hang), and every ``while True:`` loop inside a
+    thread-target function must be able to stop: a ``return``/``break``
+    or a stop-event check in the body.
+"""
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..astutil import call_name, dotted_name, parent
+from ..contracts import in_scope
+from ..core import Finding, LintContext, SourceFile
+
+#: callee tails that construct a lock-like object
+_LOCK_FACTORIES = ("Lock", "RLock")
+_CONDITION_FACTORIES = ("Condition",)
+
+#: method-call tails that mutate a container in place (shared by the
+#: cow-publish rule and the lock-guard write inference)
+_MUTATORS = (
+    "append",
+    "extend",
+    "insert",
+    "remove",
+    "add",
+    "discard",
+    "update",
+    "setdefault",
+    "pop",
+    "popitem",
+    "clear",
+    "appendleft",
+    "popleft",
+    "move_to_end",
+)
+
+
+def _is_lock_factory(node: ast.expr) -> Optional[str]:
+    """``"lock"`` / ``"condition"`` when ``node`` constructs one."""
+    if not isinstance(node, ast.Call):
+        return None
+    tail = (call_name(node) or "").split(".")[-1]
+    if tail in _LOCK_FACTORIES:
+        return "lock"
+    if tail in _CONDITION_FACTORIES:
+        return "condition"
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``attr`` for a ``self.attr`` access, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _write_targets(node: ast.stmt) -> List[ast.expr]:
+    """The expressions a statement assigns into (plain/aug/ann/del)."""
+    if isinstance(node, ast.Assign):
+        return list(node.targets)
+    if isinstance(node, ast.AugAssign):
+        return [node.target]
+    if isinstance(node, ast.AnnAssign):
+        return [node.target]
+    if isinstance(node, ast.Delete):
+        return list(node.targets)
+    return []
+
+
+def _mutated_receiver(call: ast.Call) -> Optional[ast.expr]:
+    """The receiver expression of an in-place mutator call
+    (``<recv>.append(...)``, ``<recv>[k].update(...)``), else None."""
+    func = call.func
+    if not (isinstance(func, ast.Attribute) and func.attr in _MUTATORS):
+        return None
+    receiver = func.value
+    while isinstance(receiver, ast.Subscript):
+        receiver = receiver.value
+    return receiver
+
+
+class _FunctionModel:
+    """One function's concurrency-relevant facts."""
+
+    __slots__ = ("name", "node", "writes", "returns", "calls")
+
+    def __init__(self, name: str, node: ast.AST):
+        self.name = name
+        self.node = node
+        #: [(attr, lexically-held locks, ast node)] for scope-attr writes
+        self.writes: List[Tuple[str, Set[str], ast.AST]] = []
+        #: same shape, for ``return <scope attr>`` publications
+        self.returns: List[Tuple[str, Set[str], ast.AST]] = []
+        #: {(callee name, frozenset of lexically-held locks)}
+        self.calls: Set[Tuple[str, frozenset]] = set()
+
+
+class _ScopeModel:
+    """The inferred lock model of one class (or the module scope)."""
+
+    def __init__(self, label: str):
+        self.label = label
+        #: lock attribute -> canonical lock (Condition aliases collapse)
+        self.locks: Dict[str, str] = {}
+        #: attribute -> locks it is ever written under (outside __init__)
+        self.guards: Dict[str, Set[str]] = {}
+        self.functions: Dict[str, _FunctionModel] = {}
+        #: function -> the lock-sets it may run under, propagated from
+        #: its call sites to fixpoint (the `submit -> _take_batch ->
+        #: _ready_key` chain); a public function always includes the
+        #: empty context (external callers hold nothing)
+        self.contexts: Dict[str, Set[frozenset]] = {}
+
+    def canonical(self, lock_attr: str) -> str:
+        return self.locks.get(lock_attr, lock_attr)
+
+    def occurrence_contexts(self, fn_name: str) -> Set[frozenset]:
+        return self.contexts.get(fn_name) or {frozenset()}
+
+
+def _collect_locks(statements, attr_of, model: _ScopeModel, deep: bool) -> None:
+    """Record lock/Condition constructions assigned to scope attributes.
+
+    ``deep`` walks into function bodies (class ``__init__`` assigns
+    ``self._lock`` there); module scope stays shallow so function-local
+    ``lock = threading.Lock()`` temporaries don't pollute the model.
+    """
+    for top in statements:
+        nodes = ast.walk(top) if deep else [top]
+        for stmt in nodes:
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = stmt.value
+            if value is None:
+                continue
+            kind = _is_lock_factory(value)
+            if kind is None:
+                continue
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            for target in targets:
+                attr = attr_of(target)
+                if attr is None:
+                    continue
+                if kind == "lock":
+                    model.locks.setdefault(attr, attr)
+                else:
+                    # Condition(self._lock) shares its underlying lock:
+                    # `with self._work:` and `with self._lock:` must
+                    # count as the same guard
+                    inner = attr_of(value.args[0]) if value.args else None
+                    model.locks[attr] = (
+                        model.canonical(inner) if inner else attr
+                    )
+
+
+def _held_lexically(node: ast.AST, attr_of, model: _ScopeModel) -> Set[str]:
+    """Canonical locks held at ``node`` via enclosing ``with`` blocks."""
+    held: Set[str] = set()
+    current = parent(node)
+    while current is not None:
+        if isinstance(current, ast.With):
+            for item in current.items:
+                attr = attr_of(item.context_expr)
+                if attr is not None and attr in model.locks:
+                    held.add(model.canonical(attr))
+        current = parent(current)
+    return held
+
+
+def _function_statements(fn: ast.AST):
+    """Walk ``fn`` without descending into nested function defs (nested
+    defs are modeled as their own functions)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _build_scope_model(
+    label: str,
+    statements,
+    lock_attr_of,
+    function_nodes,
+    write_maps_for,
+    deep_locks: bool,
+) -> _ScopeModel:
+    """Infer one scope's lock model.
+
+    ``lock_attr_of`` resolves lock constructions and ``with`` targets to
+    scope-attribute names. ``write_maps_for(fn)`` returns
+    ``(bind_of, read_of)``: ``bind_of`` maps a plain rebind target to a
+    scope attribute (module scope requires a ``global`` declaration —
+    honest Python semantics), ``read_of`` maps reads/subscript bases.
+    """
+    model = _ScopeModel(label)
+    _collect_locks(statements, lock_attr_of, model, deep=deep_locks)
+    if not model.locks:
+        return model
+
+    #: in-scope callee resolution needs the function NAMES too — at
+    #: module scope a bare `helper()` call is a plain Name that the
+    #: write maps (rightly) don't treat as module state
+    fn_names = {fn.name for fn in function_nodes}
+
+    for fn in function_nodes:
+        bind_of, read_of = write_maps_for(fn)
+        fmodel = _FunctionModel(fn.name, fn)
+        model.functions.setdefault(fn.name, fmodel)
+        for node in _function_statements(fn):
+            if isinstance(node, ast.stmt):
+                for target in _write_targets(node):
+                    attr = bind_of(target)
+                    if attr is None and isinstance(target, ast.Subscript):
+                        attr = read_of(target.value)
+                    if attr is None or attr in model.locks:
+                        continue
+                    held = _held_lexically(node, lock_attr_of, model)
+                    fmodel.writes.append((attr, held, node))
+            if isinstance(node, ast.Call):
+                receiver = _mutated_receiver(node)
+                if receiver is not None:
+                    attr = read_of(receiver)
+                    if attr is not None and attr not in model.locks:
+                        held = _held_lexically(node, lock_attr_of, model)
+                        fmodel.writes.append((attr, held, node))
+                callee = read_of(node.func)
+                if callee is None and (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in fn_names
+                ):
+                    callee = node.func.id
+                if callee is not None:
+                    held = _held_lexically(node, lock_attr_of, model)
+                    fmodel.calls.add((callee, frozenset(held)))
+            if isinstance(node, ast.Return) and node.value is not None:
+                attr = read_of(node.value)
+                if attr is not None and attr not in model.locks:
+                    held = _held_lexically(node, lock_attr_of, model)
+                    fmodel.returns.append((attr, held, node))
+
+    # call-context fixpoint: the lock-sets each function may run under.
+    # Seeds: a PUBLIC function (no leading underscore) runs from outside
+    # with nothing held; a private helper runs only from its in-scope
+    # call sites (each contributing site-lexical locks ∪ the caller's
+    # own contexts). Thread targets and other never-called privates
+    # default to the empty context via occurrence_contexts().
+    called_in_scope = {
+        callee
+        for fmodel in model.functions.values()
+        for callee, _ in fmodel.calls
+        if callee in model.functions
+    }
+    for name in model.functions:
+        model.contexts[name] = set()
+        if not name.startswith("_") or name not in called_in_scope:
+            model.contexts[name].add(frozenset())
+    changed = True
+    while changed:
+        changed = False
+        for caller in model.functions.values():
+            caller_contexts = model.occurrence_contexts(caller.name)
+            for callee, held in caller.calls:
+                if callee not in model.functions:
+                    continue
+                target = model.contexts[callee]
+                for context in caller_contexts:
+                    merged = frozenset(held | context)
+                    # cap pathological growth; tiny in practice
+                    if merged not in target and len(target) < 16:
+                        target.add(merged)
+                        changed = True
+
+    # guard inference: an attribute is guarded by every lock any of its
+    # writes can hold — lexically or via a locked call context.
+    # Construction (`__init__`) is excluded: the object is unshared.
+    for fmodel in model.functions.values():
+        if fmodel.name in ("__init__", "__new__"):
+            continue
+        contexts = model.occurrence_contexts(fmodel.name)
+        for attr, held, _ in fmodel.writes:
+            for context in contexts:
+                effective = held | context
+                if effective:
+                    model.guards.setdefault(attr, set()).update(effective)
+    return model
+
+
+def scope_models(file: SourceFile):
+    """(label, :class:`_ScopeModel`) for the module scope and every
+    class in ``file`` — built once and cached on the SourceFile."""
+    cached = getattr(file, "_gt_concurrency_models", None)
+    if cached is not None:
+        return cached
+
+    models = []
+
+    # -- module scope -------------------------------------------------------
+    module_names: Set[str] = set()
+    for node in file.tree.body:
+        for target in _write_targets(node) if isinstance(node, ast.stmt) else []:
+            if isinstance(target, ast.Name):
+                module_names.add(target.id)
+
+    def module_lock_of(expr):
+        return expr.id if isinstance(expr, ast.Name) else None
+
+    def module_write_maps(fn):
+        declared_global: Set[str] = set()
+        for node in _function_statements(fn):
+            if isinstance(node, ast.Global):
+                declared_global.update(node.names)
+
+        def bind_of(expr):
+            if isinstance(expr, ast.Name) and expr.id in declared_global:
+                return expr.id
+            return None
+
+        def read_of(expr):
+            if isinstance(expr, ast.Name) and expr.id in module_names:
+                return expr.id
+            return None
+
+        return bind_of, read_of
+
+    module_functions = [
+        node
+        for node in ast.walk(file.tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and not any(
+            isinstance(anc, ast.ClassDef) for anc in _ancestors(node)
+        )
+    ]
+    models.append(
+        (
+            file.module.rsplit(".", 1)[-1],
+            _build_scope_model(
+                file.module,
+                file.tree.body,
+                module_lock_of,
+                module_functions,
+                module_write_maps,
+                deep_locks=False,
+            ),
+        )
+    )
+
+    # -- class scopes -------------------------------------------------------
+    def class_write_maps(_fn):
+        return _self_attr, _self_attr
+
+    for node in ast.walk(file.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        methods = [
+            child
+            for child in ast.walk(node)
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        models.append(
+            (
+                node.name,
+                _build_scope_model(
+                    node.name,
+                    node.body,
+                    _self_attr,
+                    methods,
+                    class_write_maps,
+                    deep_locks=True,
+                ),
+            )
+        )
+    file._gt_concurrency_models = models  # type: ignore[attr-defined]
+    return models
+
+
+def _ancestors(node: ast.AST):
+    current = parent(node)
+    while current is not None:
+        yield current
+        current = parent(current)
+
+
+def _cow_attributes_for(contracts, module: str) -> Dict[str, Set[str]]:
+    """scope label -> declared COW attributes for ``module`` (entries
+    with no class apply to every scope, keyed ``"*"``)."""
+    table: Dict[str, Set[str]] = {}
+    for entry in getattr(contracts, "concurrency_cow", ()):
+        if entry.module and not (
+            module == entry.module or module.startswith(entry.module + ".")
+        ):
+            continue
+        table.setdefault(entry.cls or "*", set()).update(entry.attributes)
+    return table
+
+
+def _lock_names(guards: Set[str]) -> str:
+    return "/".join(sorted(guards))
+
+
+class LockGuardRule:
+    name = "lock-guard"
+    description = (
+        "writes (and publishing returns) of lock-guarded attributes must "
+        "hold the inferred guarding lock"
+    )
+
+    def check(self, file: SourceFile, ctx: LintContext) -> Iterator[Finding]:
+        scopes = getattr(ctx.contracts, "concurrency_lock_scopes", ())
+        if scopes and not in_scope(file.module, scopes):
+            return
+        cow = _cow_attributes_for(ctx.contracts, file.module)
+        for label, model in scope_models(file):
+            if not model.locks:
+                continue
+            cow_attrs = cow.get(label, set()) | cow.get("*", set())
+            for fmodel in model.functions.values():
+                if fmodel.name in ("__init__", "__new__"):
+                    continue
+                contexts = model.occurrence_contexts(fmodel.name)
+                # a site is a violation when some call path reaches it
+                # with nothing held (lexical locks included)
+                def reachable_bare(lexical):
+                    return any(not (lexical | set(c)) for c in contexts)
+
+                for attr, lexical, node in fmodel.writes:
+                    guards = model.guards.get(attr)
+                    if not guards or not reachable_bare(lexical):
+                        continue
+                    yield Finding(
+                        rule=self.name,
+                        path=file.relpath,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"{label}.{attr} is written under "
+                            f"{_lock_names(guards)} elsewhere but written "
+                            f"here with no lock held — a concurrent locked "
+                            f"writer can interleave and lose this update"
+                        ),
+                    )
+                for attr, lexical, node in fmodel.returns:
+                    guards = model.guards.get(attr)
+                    if not guards or attr in cow_attrs:
+                        continue
+                    if not reachable_bare(lexical):
+                        continue
+                    yield Finding(
+                        rule=self.name,
+                        path=file.relpath,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"{label}.{attr} (guarded by "
+                            f"{_lock_names(guards)}) is returned without "
+                            f"its lock — callers receive the live mutable "
+                            f"object; return a copy, hold the lock, or "
+                            f"declare it copy-on-write in contracts.toml"
+                        ),
+                    )
+
+
+class CowPublishRule:
+    name = "cow-publish"
+    description = (
+        "copy-on-write attributes may only be replaced whole under their "
+        "lock, never mutated in place"
+    )
+
+    def check(self, file: SourceFile, ctx: LintContext) -> Iterator[Finding]:
+        entries = getattr(ctx.contracts, "concurrency_cow", ())
+        if not entries:
+            return
+        #: attribute-spelled receivers (`x._models`) are flagged
+        #: tree-wide; bare names only inside the declaring module (bare
+        #: names are too common for a global claim)
+        attr_names: Set[str] = set()
+        local_names: Set[str] = set()
+        for entry in entries:
+            attr_names.update(entry.attributes)
+            if not entry.module or in_scope(
+                file.module, (entry.module,)
+            ) or file.module == entry.module:
+                local_names.update(entry.attributes)
+
+        def cow_name(expr) -> Optional[str]:
+            if isinstance(expr, ast.Attribute) and expr.attr in attr_names:
+                return expr.attr
+            if isinstance(expr, ast.Name) and expr.id in local_names:
+                return expr.id
+            return None
+
+        for node in ast.walk(file.tree):
+            if isinstance(node, ast.Call):
+                receiver = _mutated_receiver(node)
+                if receiver is None:
+                    continue
+                name = cow_name(receiver)
+                if name is not None:
+                    mutator = node.func.attr  # type: ignore[union-attr]
+                    yield self._finding(file, node, name, f".{mutator}(...)")
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+                for target in _write_targets(node):
+                    if isinstance(target, ast.Subscript):
+                        name = cow_name(target.value)
+                        if name is not None:
+                            yield self._finding(
+                                file, node, name, "[...] assignment"
+                            )
+                    elif isinstance(node, ast.AugAssign):
+                        name = cow_name(target)
+                        if name is not None:
+                            yield self._finding(
+                                file, node, name, "augmented assignment"
+                            )
+
+    def _finding(self, file, node, attr, how) -> Finding:
+        return Finding(
+            rule=self.name,
+            path=file.relpath,
+            line=node.lineno,
+            col=node.col_offset,
+            message=(
+                f"in-place {how} on copy-on-write attribute `{attr}` — "
+                "COW attributes are read lock-free; mutate a copy and "
+                "replace the whole object under the lock"
+            ),
+        )
+
+
+class ForkSafetyRule:
+    name = "fork-safety"
+    description = (
+        "module-level registries memoizing pid-derived state need a "
+        "registered post-fork reset hook"
+    )
+
+    def check(self, file: SourceFile, ctx: LintContext) -> Iterator[Finding]:
+        contracts = ctx.contracts
+        scopes = getattr(contracts, "concurrency_fork_scopes", ())
+        if scopes and not in_scope(file.module, scopes):
+            return
+        pid_sources = set(getattr(contracts, "concurrency_pid_sources", ()))
+        registrars = set(
+            getattr(contracts, "concurrency_postfork_registrars", ())
+        )
+        if not pid_sources:
+            return
+        pid_tails = {source.split(".")[-1] for source in pid_sources}
+
+        # a "registry" is module-level memoized state: a mutable literal
+        # (`_ledgers = {}` — AnnAssign included) or a module name some
+        # function rebinds via `global` (`_recorder = SpanRecorder(...)`,
+        # the memoized-singleton spelling of the same bug class)
+        registries: Set[str] = set()
+        module_names: Set[str] = set()
+        for node in file.tree.body:
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            module_names.update(names)
+            mutable = isinstance(value, (ast.Dict, ast.List, ast.Set)) or (
+                isinstance(value, ast.Call)
+                and (call_name(value) or "").split(".")[-1]
+                in ("dict", "list", "set", "deque", "defaultdict", "OrderedDict")
+            )
+            if mutable:
+                registries.update(names)
+        for node in ast.walk(file.tree):
+            if isinstance(node, ast.Global):
+                registries.update(
+                    name for name in node.names if name in module_names
+                )
+        if not registries:
+            return
+
+        for node in ast.walk(file.tree):
+            if isinstance(node, ast.Call):
+                callee = call_name(node) or ""
+                if (
+                    callee in registrars
+                    or callee.split(".")[-1] in registrars
+                    or callee.endswith(".register_at_fork")
+                ):
+                    return  # the module resets itself after fork
+
+        for fn in ast.walk(file.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            declared_global: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    declared_global.update(node.names)
+            calls_pid = False
+            store_node: Optional[ast.AST] = None
+            stored: Optional[str] = None
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callee = call_name(node) or ""
+                    if callee in pid_sources or callee.split(".")[-1] in pid_tails:
+                        calls_pid = True
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    for target in _write_targets(node):
+                        name = None
+                        if isinstance(target, ast.Subscript) and isinstance(
+                            target.value, ast.Name
+                        ):
+                            name = target.value.id
+                        elif isinstance(target, ast.Name) and (
+                            target.id in declared_global
+                        ):
+                            # plain rebinds are module writes only under
+                            # a `global` declaration (locals that shadow
+                            # a registry name are just locals)
+                            name = target.id
+                        if name in registries:
+                            stored, store_node = name, node
+            if calls_pid and store_node is not None:
+                yield Finding(
+                    rule=self.name,
+                    path=file.relpath,
+                    line=store_node.lineno,
+                    col=store_node.col_offset,
+                    message=(
+                        f"`{fn.name}` derives state from a process-identity "
+                        f"source and memoizes it in module registry "
+                        f"`{stored}` with no post-fork reset hook — a "
+                        f"forked worker (gunicorn --preload) inherits the "
+                        f"parent's pid-frozen value; register a reset via "
+                        f"utils.postfork.register_postfork_reset"
+                    ),
+                )
+
+
+def _is_thread_join(call: ast.Call) -> bool:
+    """Thread.join's signature, not str/os.path join: no positional
+    args, or one numeric timeout (constant or name), or only a
+    ``timeout=`` keyword — ``os.path.join(a, b)`` and ``sep.join(parts)``
+    must not count as shutdown evidence."""
+    if any(kw.arg not in ("timeout",) for kw in call.keywords):
+        return False
+    if len(call.args) > 1:
+        return False
+    if call.args:
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant):
+            return isinstance(arg.value, (int, float)) and not isinstance(
+                arg.value, bool
+            )
+        # a bare name only counts when it reads like a duration —
+        # `thread.join(timeout)` yes, `sep.join(parts)` no
+        name = (dotted_name(arg) or "").rsplit(".", 1)[-1].lower()
+        return any(hint in name for hint in ("timeout", "deadline", "second", "wait"))
+    return True
+
+
+class ThreadLifecycleRule:
+    name = "thread-lifecycle"
+    description = (
+        "threads must be daemon=True or joined; thread worker loops must "
+        "be stoppable"
+    )
+
+    def check(self, file: SourceFile, ctx: LintContext) -> Iterator[Finding]:
+        thread_targets: Set[str] = set()
+        joins_anything = False
+        thread_calls: List[ast.Call] = []
+        for node in ast.walk(file.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = call_name(node) or ""
+            tail = callee.split(".")[-1]
+            if tail == "Thread" and (
+                callee in ("Thread", "threading.Thread")
+                or callee.endswith(".Thread")
+            ):
+                thread_calls.append(node)
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = dotted_name(kw.value)
+                        if target:
+                            thread_targets.add(target.rsplit(".", 1)[-1])
+            elif tail == "join" and "." in callee and _is_thread_join(node):
+                joins_anything = True
+
+        for node in thread_calls:
+            daemon = None
+            for kw in node.keywords:
+                if kw.arg == "daemon":
+                    if isinstance(kw.value, ast.Constant):
+                        daemon = bool(kw.value.value)
+                    else:
+                        daemon = True  # dynamic — benefit of the doubt
+            if daemon:
+                continue
+            # non-daemon threads demand a join somewhere in the module
+            # (precise reachability is the runtime lockgraph harness's
+            # job; the static contract is "shutdown CAN reach it")
+            if joins_anything:
+                continue
+            yield Finding(
+                rule=self.name,
+                path=file.relpath,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    "threading.Thread without daemon=True and no join() "
+                    "anywhere in this module — a forgotten non-daemon "
+                    "thread turns process shutdown into a hang"
+                ),
+            )
+
+        for fn in ast.walk(file.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name not in thread_targets:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.While):
+                    continue
+                test = node.test
+                if not (isinstance(test, ast.Constant) and test.value is True):
+                    continue
+                if self._loop_stoppable(node):
+                    continue
+                yield Finding(
+                    rule=self.name,
+                    path=file.relpath,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        f"`while True` worker loop in thread target "
+                        f"`{fn.name}` has no reachable stop: add a "
+                        f"break/return on a stop-event check so "
+                        f"drain/shutdown can end it"
+                    ),
+                )
+
+    @staticmethod
+    def _loop_stoppable(loop: ast.While) -> bool:
+        for node in ast.walk(loop):
+            if isinstance(node, (ast.Break, ast.Return)):
+                return True
+            if isinstance(node, ast.Call):
+                tail = (call_name(node) or "").split(".")[-1]
+                if tail in ("is_set", "wait"):
+                    return True
+        return False

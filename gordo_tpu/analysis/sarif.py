@@ -1,0 +1,148 @@
+"""
+SARIF 2.1.0 output for ``gordo-tpu lint`` (``--sarif <path>``).
+
+SARIF (Static Analysis Results Interchange Format) is the one artifact
+every code-scanning consumer already understands — GitHub code scanning
+renders it as inline PR annotations natively, so the CI lint job uploads
+this document instead of hand-rolling ``::error`` workflow commands from
+the ``--as-json`` shape.
+
+Mapping choices:
+
+- each rule becomes a ``tool.driver.rules`` entry (id, short
+  description, a ``helpUri`` into the committed rule catalog);
+- new findings are ``level: error`` results; baselined findings are
+  emitted too but carried as ``suppressions`` (kind ``external``, the
+  baseline justification as the suppression justification) so scanners
+  show them resolved rather than re-paging on every PR;
+- the engine's stable fingerprint (rule|path|message|occurrence — line
+  independent) lands in ``partialFingerprints`` as
+  ``gordoLint/v1``, which is exactly the stability contract SARIF asks
+  of that field;
+- parse errors become ``tool.driver.notifications``-shaped execution
+  notifications under ``invocations`` so a broken file fails loudly in
+  the same artifact.
+"""
+
+from typing import Dict, List, Optional, Sequence
+
+from .baseline import BaselineEntry
+from .core import Finding, LintResult
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+#: the committed rule catalog every rule's helpUri points into
+CATALOG_URI = "docs/static-analysis.md"
+
+
+def _rule_metadata(rules: Sequence[object]) -> List[Dict]:
+    entries = []
+    for rule in rules:
+        name = getattr(rule, "name", None)
+        if not name:
+            continue
+        entries.append(
+            {
+                "id": name,
+                "name": name,
+                "shortDescription": {
+                    "text": getattr(rule, "description", name)
+                },
+                "helpUri": f"{CATALOG_URI}#the-rule-catalog",
+                "defaultConfiguration": {"level": "error"},
+            }
+        )
+    return entries
+
+
+def _result(
+    finding: Finding,
+    baselined: bool,
+    justification: Optional[str] = None,
+) -> Dict:
+    result = {
+        "ruleId": finding.rule,
+        "level": "error",
+        "message": {"text": finding.message},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": finding.path,
+                        "uriBaseId": "%SRCROOT%",
+                    },
+                    "region": {
+                        "startLine": max(1, finding.line),
+                        "startColumn": max(1, finding.col + 1),
+                    },
+                }
+            }
+        ],
+        "partialFingerprints": {"gordoLint/v1": finding.fingerprint},
+    }
+    if baselined:
+        suppression = {"kind": "external", "status": "accepted"}
+        if justification:
+            suppression["justification"] = justification
+        result["suppressions"] = [suppression]
+    return result
+
+
+def sarif_document(
+    result: LintResult,
+    new: List[Finding],
+    baselined: List[Finding],
+    entries: Optional[List[BaselineEntry]] = None,
+    rules: Sequence[object] = (),
+    version: str = "",
+) -> Dict:
+    """The SARIF 2.1.0 run document for one lint invocation."""
+    justifications = {
+        (entry.rule, entry.path, entry.fingerprint): entry.justification
+        for entry in (entries or [])
+    }
+    results = [_result(finding, baselined=False) for finding in new]
+    results += [
+        _result(
+            finding,
+            baselined=True,
+            justification=justifications.get(
+                (finding.rule, finding.path, finding.fingerprint)
+            ),
+        )
+        for finding in baselined
+    ]
+    tool_notifications = [
+        {
+            "level": "error",
+            "message": {"text": f"unparseable file: {error}"},
+        }
+        for error in result.parse_errors
+    ]
+    driver = {
+        "name": "gordo-tpu-lint",
+        "informationUri": CATALOG_URI,
+        "rules": _rule_metadata(rules),
+    }
+    if version:
+        driver["version"] = version
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {"driver": driver},
+                "results": results,
+                "invocations": [
+                    {
+                        "executionSuccessful": not result.parse_errors,
+                        "toolExecutionNotifications": tool_notifications,
+                    }
+                ],
+                "columnKind": "utf16CodeUnits",
+            }
+        ],
+    }

@@ -1186,6 +1186,15 @@ def bench_check(
     is_flag=True,
     help="Print the raw lint document instead of the report",
 )
+@click.option(
+    "--sarif",
+    "sarif_path",
+    default=None,
+    type=click.Path(dir_okay=False),
+    help="Also write a SARIF 2.1.0 document to this path (rule "
+    "metadata, stable fingerprints, baseline entries as suppressions) "
+    "— the artifact the CI lint job uploads for PR annotations.",
+)
 def lint(
     paths: Tuple[str, ...],
     root: Optional[str],
@@ -1193,6 +1202,7 @@ def lint(
     update_baseline: bool,
     report_only: bool,
     as_json: bool,
+    sarif_path: Optional[str],
 ):
     """
     The invariant gate: run the project's static-analysis rules
@@ -1214,6 +1224,7 @@ def lint(
         load_baseline,
         render_report,
         run_lint,
+        sarif_document,
         split_by_baseline,
         write_baseline,
     )
@@ -1221,7 +1232,8 @@ def lint(
     root = os.path.abspath(root or os.getcwd())
     if baseline_path is None:
         baseline_path = default_baseline_path(root)
-    result = run_lint(root, default_rules(), paths=list(paths) or None)
+    rules = default_rules()
+    result = run_lint(root, rules, paths=list(paths) or None)
     if update_baseline:
         # still-matching entries keep their hand-written justifications;
         # an unreadable existing baseline just means a fresh start
@@ -1247,6 +1259,22 @@ def lint(
     except BaselineError as exc:
         raise click.ClickException(str(exc))
     new, baselined, stale = split_by_baseline(result.findings, entries)
+    if sarif_path:
+        import gordo_tpu
+
+        doc = sarif_document(
+            result,
+            new,
+            baselined,
+            entries=entries,
+            rules=rules,
+            version=gordo_tpu.__version__,
+        )
+        tmp = f"{sarif_path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as handle:
+            json.dump(doc, handle, indent=1, sort_keys=True)
+            handle.write("\n")
+        os.replace(tmp, sarif_path)
     if as_json:
         click.echo(
             json.dumps(
@@ -1258,6 +1286,63 @@ def lint(
     else:
         click.echo(render_report(result, new, baselined, stale))
     if (new or result.parse_errors) and not report_only:
+        raise SystemExit(1)
+
+
+@click.command("lockgraph")
+@click.argument("sinks", nargs=-1, required=True)
+@click.option(
+    "--top",
+    default=10,
+    type=int,
+    help="Held-while-blocking hotspot rows to report.",
+)
+@click.option(
+    "--report-only",
+    is_flag=True,
+    help="Always exit 0: print the report, never gate.",
+)
+@click.option(
+    "--as-json",
+    "as_json",
+    is_flag=True,
+    help="Print the raw analysis document instead of the report.",
+)
+def lockgraph(sinks: Tuple[str, ...], top: int, report_only: bool, as_json: bool):
+    """
+    Analyze lock-order trace sinks for deadlock potential: build the
+    acquisition-ordering graph recorded by ``GORDO_TPU_LOCK_TRACE``
+    (``gordo_tpu.analysis.lockgraph``), fail on any ordering cycle —
+    two threads taking the same locks in opposite orders — and report
+    the max-held-while-blocking hotspots.
+
+    SINKS are edge files (``lock_trace-<pid>.jsonl``) or glob patterns;
+    a traced multi-process run merges into one graph.
+
+    Example: ``GORDO_TPU_LOCK_TRACE=1 pytest -m "serve or slo" &&
+    gordo-tpu lockgraph 'lock_trace-*.jsonl'``
+    """
+    import glob as _glob
+
+    from ..analysis.lockgraph import analyze, render_report as render_lock_report
+
+    paths: list = []
+    for pattern in sinks:
+        matched = sorted(_glob.glob(pattern))
+        paths.extend(matched if matched else [pattern])
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing or not paths:
+        raise click.ClickException(
+            "no trace sinks found: "
+            + (", ".join(missing) or "(empty sink list)")
+            + " — run the suites with GORDO_TPU_LOCK_TRACE set first"
+        )
+    report = analyze(paths, top=top)
+    if as_json:
+        click.echo(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        click.echo(render_lock_report(report))
+    if not report["ok"] and not report_only:
         raise SystemExit(1)
 
 
@@ -1872,6 +1957,7 @@ gordo_tpu_cli.add_command(trace)
 gordo_tpu_cli.add_command(slo_cli)
 gordo_tpu_cli.add_command(bench_check)
 gordo_tpu_cli.add_command(lint)
+gordo_tpu_cli.add_command(lockgraph)
 gordo_tpu_cli.add_command(run_server_cli)
 gordo_tpu_cli.add_command(wait_for_models)
 gordo_tpu_cli.add_command(score)

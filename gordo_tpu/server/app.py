@@ -671,6 +671,12 @@ def drain_and_stop(app: GordoServerApp, server=None, engine=None) -> None:
     serve_trace.serve_recorder().flush()
     if server is not None:
         server.shutdown()
+    # close (not just flush) the shared trace recorder: close() joins
+    # its async writer thread, so SIGTERM leaves no gordo-owned thread
+    # alive — every remaining thread at this point is daemon by the
+    # thread-lifecycle lint contract (the regression test in
+    # tests/server/test_shutdown_threads.py pins both properties)
+    serve_trace.reset_serve_recorder()
 
 
 def install_graceful_shutdown(app: GordoServerApp, server=None):

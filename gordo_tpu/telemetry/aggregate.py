@@ -786,9 +786,17 @@ class RollupStore:
             merged["window"]["since"] = int(since)
         if until is not None:
             merged["window"]["until"] = int(until)
-        if len(self._merged_cache) > 64:
-            self._merged_cache.clear()
-        self._merged_cache[key] = json.loads(json.dumps(merged))
+        # deep-copy OUTSIDE the lock (a month's busy-dir rollup is large
+        # — serializing it under the lock would convoy concurrent folds)
+        copied = json.loads(json.dumps(merged))
+        with self._lock:
+            # the insert itself sits under the instance lock like the
+            # fold-side invalidation (version bump + clear): racing the
+            # clear could otherwise resurrect a pre-invalidation doc
+            # and pin the cache-size accounting stale
+            if len(self._merged_cache) > 64:
+                self._merged_cache.clear()
+            self._merged_cache[key] = copied
         return merged
 
 

@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..utils.postfork import register_postfork_reset
 from .recorder import _iso, enabled, worker_sink_path
 
 logger = logging.getLogger(__name__)
@@ -570,6 +571,25 @@ class FleetHealthLedger:
 
 _registry_lock = threading.Lock()
 _ledgers: Dict[str, FleetHealthLedger] = {}
+
+
+def _reset_after_fork() -> None:
+    """Drop inherited ledgers in a freshly forked worker: each froze
+    the PARENT's pid-suffixed snapshot path at construction, so N
+    children writing through them would clobber one shared file — the
+    gunicorn ``--preload`` collision the per-call ``_pid`` check in
+    :func:`ledger_for` also guards (the reset makes the fresh start
+    unconditional; the check stays as belt-and-braces). The child is
+    single-threaded here and the inherited lock may be frozen
+    mid-acquire, so rebind both without locking."""
+    global _registry_lock, _ledgers
+    _registry_lock = threading.Lock()
+    # gt-lint: disable=lock-guard -- post-fork child is single-threaded;
+    # taking the (possibly frozen-held) inherited lock could deadlock
+    _ledgers = {}
+
+
+register_postfork_reset(_reset_after_fork, name="telemetry.fleet_health.ledgers")
 
 
 def ledger_for(directory: str, project: str = "") -> Any:

@@ -30,6 +30,7 @@ import random
 import threading
 from typing import Any, Dict, Optional
 
+from ..utils.postfork import register_postfork_reset
 from .recorder import (
     NULL_RECORDER,
     TRACE_DIR_ENV,
@@ -57,6 +58,25 @@ DEFAULT_TRACE_SAMPLE_RATE = 0.05
 _lock = threading.Lock()
 _recorder: Optional[SpanRecorder] = None
 _atexit_registered = False
+
+
+def _reset_after_fork() -> None:
+    """Drop the inherited recorder in a freshly forked worker: its sink
+    path froze the PARENT's pid (``worker_sink_path``) and its writer
+    thread does not exist on this side of the fork — every span the
+    child enqueued would silently never reach disk. The child is
+    single-threaded here and the inherited lock may have been
+    snapshotted mid-acquire, so rebind without locking; dropping (not
+    closing) also avoids double-flushing the parent's file handle."""
+    global _recorder, _lock
+    _lock = threading.Lock()
+    # gt-lint: disable=lock-guard -- post-fork child is single-threaded;
+    # the inherited module lock may be frozen in an acquired state, so
+    # taking it here could deadlock the new worker at boot
+    _recorder = None
+
+
+register_postfork_reset(_reset_after_fork, name="telemetry.serving.recorder")
 
 
 #: (raw env string, parsed rate) — the parse is cached per distinct env

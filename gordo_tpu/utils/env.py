@@ -511,6 +511,18 @@ KNOBS: Dict[str, Knob] = _knobs(
         "Local MLflow tracking root (default: `<tmpdir>/gordo-mlruns`).",
         "Reporters",
     ),
+    # -- Static analysis ---------------------------------------------------
+    Knob(
+        "GORDO_TPU_LOCK_TRACE", "str", None,
+        "Opt-in lock-order tracing (`gordo_tpu.analysis.lockgraph`): a "
+        "`.jsonl` path (or `1` for `./lock_trace.jsonl`) wraps every "
+        "lock created after install in an instrumented wrapper that "
+        "records per-thread acquisition-ordering edges into a "
+        "pid-suffixed sink; `gordo-tpu lockgraph` analyzes the sinks "
+        "and fails on ordering cycles (potential deadlocks). Off by "
+        "default — zero overhead unless set.",
+        "Static analysis",
+    ),
     # -- Testing -----------------------------------------------------------
     Knob(
         "GORDO_TPU_DOCTEST_KNOB", "int", 7,

@@ -1,0 +1,76 @@
+"""
+The post-fork reset registry: one place for module-level state that must
+NOT survive ``os.fork()`` into a child process.
+
+The bug class this closes (the ``fork-safety`` lint rule enforces it):
+a module memoizes state derived from process identity — a pid-suffixed
+sink path (``worker_sink_path``), a ledger snapshotting to
+``fleet_health-<pid>.json``, a trace recorder whose writer thread only
+exists in the parent — and a gunicorn ``--preload`` master builds it
+once, then forks N workers that all inherit the parent's frozen value
+and clobber one shared file (or enqueue spans to a writer thread that
+does not exist on their side of the fork; threads never survive fork).
+
+Modules register a zero-arg reset callable at import time::
+
+    from ..utils.postfork import register_postfork_reset
+
+    register_postfork_reset(_reset_after_fork, name="telemetry.serving")
+
+The first registration installs one ``os.register_at_fork``
+``after_in_child`` hook that runs every registered reset, newest last.
+Resets run in the CHILD only, must not raise (failures are logged and
+swallowed — a broken reset must not kill a fresh worker), and should
+only drop references: closing inherited file handles would flush the
+parent's buffered bytes a second time.
+
+Stdlib-only (``utils`` sits below every other package) and a no-op on
+platforms without ``fork``.
+"""
+
+import logging
+import os
+import threading
+from typing import Callable, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_registry_lock = threading.Lock()
+_resets: List[Tuple[str, Callable[[], None]]] = []
+_hook_installed = False
+
+
+def register_postfork_reset(
+    reset: Callable[[], None], name: Optional[str] = None
+) -> None:
+    """Run ``reset()`` in every child this process forks, after the
+    fork. Registration is idempotent per callable (re-imports under
+    test reloaders must not stack duplicates)."""
+    global _hook_installed
+    with _registry_lock:
+        if any(existing is reset for _, existing in _resets):
+            return
+        _resets.append((name or getattr(reset, "__qualname__", "reset"), reset))
+        if not _hook_installed and hasattr(os, "register_at_fork"):
+            os.register_at_fork(after_in_child=run_postfork_resets)
+            _hook_installed = True
+
+
+def run_postfork_resets() -> None:
+    """Run every registered reset (the child-side fork hook; tests call
+    it directly to simulate a fork)."""
+    with _registry_lock:
+        resets = list(_resets)
+    for name, reset in resets:
+        try:
+            reset()
+        except Exception:  # noqa: BLE001 - a broken reset must not kill
+            # the freshly forked worker it exists to protect
+            logger.exception("post-fork reset %s failed", name)
+
+
+def registered_resets() -> List[str]:
+    """The registered reset names, registration order (introspection —
+    the thread-shutdown audit test asserts the serving stack's are in)."""
+    with _registry_lock:
+        return [name for name, _ in _resets]

@@ -68,6 +68,9 @@ case "$component" in
     # The columnar wire suite cuts across tests/server and
     # tests/telemetry — marker-selected like fleet_health/slo.
     wire)     run -m "wire and not slow" tests/ ;;
+    # The concurrency-contract suite cuts across tests/analysis,
+    # tests/server and tests/serve — marker-selected the same way.
+    concurrency) run -m "concurrency and not slow" tests/ ;;
     utils)    run -m "not slow" tests/utils ;;
     workflow) run -m "not slow" tests/workflow ;;
     formatting) run tests/test_codestyle.py ;;

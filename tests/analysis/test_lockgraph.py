@@ -1,0 +1,293 @@
+"""The runtime lock-order harness: traced-lock semantics (site
+identity, reentrancy, Condition aliasing), edge aggregation and the
+sink round-trip, cycle detection, hotspot ranking, and the
+`gordo-tpu lockgraph` CLI gate."""
+
+import json
+import threading
+
+import pytest
+from click.testing import CliRunner
+
+from gordo_tpu.analysis import lockgraph
+from gordo_tpu.cli.cli import lockgraph as lockgraph_cli
+
+pytestmark = [pytest.mark.analysis, pytest.mark.concurrency]
+
+
+@pytest.fixture
+def traced(tmp_path):
+    """Install tracing into a tmp sink; always uninstall (leaking the
+    patched factories would instrument every later test)."""
+    sink = str(tmp_path / "lock_trace.jsonl")
+    lockgraph.install_lock_trace(sink)
+    try:
+        yield sink
+    finally:
+        lockgraph.uninstall_lock_trace()
+
+
+def _edge(src, dst, count=1, max_wait_ms=0.0, total_wait_ms=0.0):
+    return {
+        "src": src,
+        "dst": dst,
+        "count": count,
+        "max_wait_ms": max_wait_ms,
+        "total_wait_ms": total_wait_ms,
+    }
+
+
+# -- traced locks --------------------------------------------------------------
+
+
+def test_nested_acquisition_records_ordering_edge(traced):
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    assert isinstance(lock_a, lockgraph.TracedLock)
+    with lock_a:
+        with lock_b:
+            pass
+    edges = lockgraph._state.snapshot()
+    assert len(edges) == 1
+    assert edges[0]["src"] != edges[0]["dst"]
+    assert edges[0]["count"] == 1
+    # same ordering again only bumps the count
+    with lock_a:
+        with lock_b:
+            pass
+    assert lockgraph._state.snapshot()[0]["count"] == 2
+
+
+def test_rlock_reentrancy_records_no_self_edge(traced):
+    rlock = threading.RLock()
+    with rlock:
+        with rlock:
+            pass
+    assert lockgraph._state.snapshot() == []
+    assert lockgraph._state.held() == []  # balanced
+
+
+def test_condition_shares_its_locks_site(traced):
+    lock = threading.Lock()
+    condition = threading.Condition(lock)
+    outer = threading.Lock()
+    with outer:
+        with condition:
+            pass
+        with lock:
+            pass
+    edges = lockgraph._state.snapshot()
+    # both nestings resolve to the SAME edge: Condition(lock) is lock
+    assert len(edges) == 1
+    assert edges[0]["count"] == 2
+
+
+def test_condition_wait_keeps_stack_balanced(traced):
+    condition = threading.Condition(threading.Lock())
+    with condition:
+        condition.wait(timeout=0.01)
+    assert lockgraph._state.held() == []
+
+
+def test_held_stack_is_per_thread(traced):
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    done = threading.Event()
+
+    def other():
+        # this thread holds nothing of ours: acquiring B here must not
+        # record an A -> B edge off the MAIN thread's held stack
+        with lock_b:
+            done.set()
+
+    with lock_a:
+        thread = threading.Thread(target=other, daemon=True)
+        thread.start()
+        assert done.wait(timeout=5.0)
+        thread.join(timeout=5.0)
+    # stdlib internals (Event/Thread create traced locks too) may add
+    # their own edges; the contract is that no A -> B ordering exists
+    pairs = {(e["src"], e["dst"]) for e in lockgraph._state.snapshot()}
+    assert (lock_a._site, lock_b._site) not in pairs
+    assert (lock_b._site, lock_a._site) not in pairs
+
+
+def test_dump_and_load_round_trip(traced):
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    with lock_a:
+        with lock_b:
+            pass
+    path = lockgraph.dump_edges()
+    assert path.endswith(".jsonl")
+    # the pid lands in the filename at DUMP time, so a forked worker
+    # writes its own sink instead of clobbering the parent's
+    import os
+
+    assert f"-{os.getpid()}" in os.path.basename(path)
+    lines = [json.loads(line) for line in open(path, encoding="utf-8")]
+    assert "meta" in lines[0]
+    edges = lockgraph.load_edges([path])
+    assert len(edges) == 1
+    # merging the same sink twice doubles counts (multi-pid merge shape)
+    merged = lockgraph.load_edges([path, path])
+    assert merged[0]["count"] == 2 * edges[0]["count"]
+
+
+def test_install_is_off_without_knob(monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_LOCK_TRACE", raising=False)
+    assert lockgraph.lock_trace_sink() is None
+    assert lockgraph.install_lock_trace() is False
+    assert threading.Lock is lockgraph._REAL_LOCK
+
+
+def test_sink_path_spellings(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_LOCK_TRACE", "1")
+    assert lockgraph.lock_trace_sink() == lockgraph.DEFAULT_SINK
+    monkeypatch.setenv("GORDO_TPU_LOCK_TRACE", "/tmp/x/edges.jsonl")
+    assert lockgraph.lock_trace_sink() == "/tmp/x/edges.jsonl"
+    monkeypatch.setenv("GORDO_TPU_LOCK_TRACE", "off")
+    assert lockgraph.lock_trace_sink() is None
+
+
+# -- analysis ------------------------------------------------------------------
+
+
+def test_cycle_detection_finds_abba():
+    edges = [_edge("A", "B"), _edge("B", "A")]
+    cycles = lockgraph.find_cycles(edges)
+    assert len(cycles) == 1
+    assert set(cycles[0]) == {"A", "B"}
+
+
+def test_cycle_detection_finds_longer_cycles_once():
+    edges = [_edge("A", "B"), _edge("B", "C"), _edge("C", "A")]
+    cycles = lockgraph.find_cycles(edges)
+    assert len(cycles) == 1
+    assert set(cycles[0]) == {"A", "B", "C"}
+
+
+def test_distinct_cycles_over_the_same_nodes_both_report():
+    # A->B->C->A and A->C->B->A share a node set but are two distinct
+    # ordering violations (different thread pairs) — report both
+    edges = [
+        _edge("A", "B"),
+        _edge("B", "C"),
+        _edge("C", "A"),
+        _edge("A", "C"),
+        _edge("C", "B"),
+        _edge("B", "A"),
+    ]
+    cycles = lockgraph.find_cycles(edges)
+    three_node = [c for c in cycles if len(set(c)) == 3]
+    assert len(three_node) == 2
+
+
+def test_acyclic_graph_has_no_cycles():
+    edges = [_edge("A", "B"), _edge("A", "C"), _edge("B", "C")]
+    assert lockgraph.find_cycles(edges) == []
+
+
+def test_self_loop_is_reentrancy_not_a_cycle():
+    assert lockgraph.find_cycles([_edge("A", "A")]) == []
+
+
+def test_hotspots_rank_by_worst_single_wait():
+    edges = [
+        _edge("A", "B", count=100, max_wait_ms=0.5, total_wait_ms=20.0),
+        _edge("A", "C", count=2, max_wait_ms=9.0, total_wait_ms=9.5),
+    ]
+    ranked = lockgraph.hotspots(edges, top=1)
+    assert ranked[0]["dst"] == "C"
+
+
+def test_analyze_report_shape(tmp_path):
+    sink = tmp_path / "edges.jsonl"
+    sink.write_text(
+        json.dumps(_edge("A", "B")) + "\n" + json.dumps(_edge("B", "A")) + "\n"
+    )
+    report = lockgraph.analyze([str(sink)])
+    assert report["ok"] is False
+    assert report["locks"] == 2
+    assert report["edges"] == 2
+    assert any("A" in cycle for cycle in report["cycles"])
+
+
+# -- the CLI gate --------------------------------------------------------------
+
+
+def test_lockgraph_cli_passes_on_acyclic_sink(tmp_path):
+    sink = tmp_path / "lock_trace-1.jsonl"
+    sink.write_text(json.dumps(_edge("A", "B")) + "\n")
+    result = CliRunner().invoke(lockgraph_cli, [str(sink)])
+    assert result.exit_code == 0, result.output
+    assert "OK" in result.output
+
+
+def test_lockgraph_cli_fails_on_cycle(tmp_path):
+    sink = tmp_path / "lock_trace-1.jsonl"
+    sink.write_text(
+        json.dumps(_edge("A", "B")) + "\n" + json.dumps(_edge("B", "A")) + "\n"
+    )
+    result = CliRunner().invoke(lockgraph_cli, [str(sink)])
+    assert result.exit_code == 1
+    assert "CYCLE" in result.output
+    # --report-only prints but never gates
+    result = CliRunner().invoke(lockgraph_cli, ["--report-only", str(sink)])
+    assert result.exit_code == 0
+
+
+def test_lockgraph_cli_globs_multi_pid_sinks(tmp_path):
+    (tmp_path / "lock_trace-1.jsonl").write_text(
+        json.dumps(_edge("A", "B")) + "\n"
+    )
+    (tmp_path / "lock_trace-2.jsonl").write_text(
+        json.dumps(_edge("B", "A")) + "\n"
+    )
+    result = CliRunner().invoke(
+        lockgraph_cli, ["--as-json", str(tmp_path / "lock_trace-*.jsonl")]
+    )
+    assert result.exit_code == 1
+    doc = json.loads(result.output)
+    assert doc["edges"] == 2 and not doc["ok"]
+
+
+def test_lockgraph_cli_errors_on_missing_sink(tmp_path):
+    result = CliRunner().invoke(
+        lockgraph_cli, [str(tmp_path / "nope.jsonl")]
+    )
+    assert result.exit_code != 0
+    assert "no trace sinks" in result.output
+
+
+# -- end-to-end: a real deadlock-shaped workload -------------------------------
+
+
+def test_traced_threads_expose_abba_deadlock_potential(traced, tmp_path):
+    # the orderings are recorded SEQUENTIALLY on purpose: that is the
+    # harness's whole value — it exposes the A->B vs B->A hazard from
+    # runs where the deadlock never actually fired
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def ab():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def ba():
+        with lock_b:
+            with lock_a:
+                pass
+
+    for target in (ab, ba):
+        thread = threading.Thread(target=target, daemon=True)
+        thread.start()
+        thread.join(timeout=10.0)
+    path = lockgraph.dump_edges()
+    report = lockgraph.analyze([path])
+    assert report["ok"] is False
+    assert any(
+        lock_a._site in cycle and lock_b._site in cycle
+        for cycle in report["cycles"]
+    )

@@ -1,0 +1,626 @@
+"""Rule fixtures for the concurrency contract family: lock-guard
+inference (guards, helper-chain fixpoint, Condition aliasing, COW
+exemption, module scope), cow-publish mutation discipline, fork-safety
+pid-memoization, and thread-lifecycle."""
+
+import pytest
+
+pytestmark = [pytest.mark.analysis, pytest.mark.concurrency]
+
+
+def _rules(result, name):
+    return [f for f in result.findings if f.rule == name]
+
+
+# -- lock-guard: class scope ---------------------------------------------------
+
+
+def test_unlocked_write_of_guarded_attribute_is_flagged(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/bad.py": """
+                import threading
+
+                class Store:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._items = {}
+
+                    def put(self, key, value):
+                        with self._lock:
+                            self._items = {**self._items, key: value}
+
+                    def reset(self):
+                        self._items = {}
+            """
+        }
+    )
+    found = _rules(result, "lock-guard")
+    assert len(found) == 1
+    assert "Store._items" in found[0].message
+    assert "_lock" in found[0].message
+
+
+def test_all_writes_locked_is_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/ok.py": """
+                import threading
+
+                class Store:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._items = {}
+
+                    def put(self, key, value):
+                        with self._lock:
+                            self._items = {**self._items, key: value}
+
+                    def reset(self):
+                        with self._lock:
+                            self._items = {}
+            """
+        }
+    )
+    assert not _rules(result, "lock-guard")
+
+
+def test_init_writes_are_construction_not_findings(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/ok.py": """
+                import threading
+
+                class Store:
+                    def __init__(self, seed):
+                        self._lock = threading.Lock()
+                        self._items = {}
+                        self._items = dict(seed)
+
+                    def put(self, key, value):
+                        with self._lock:
+                            self._items = {**self._items, key: value}
+            """
+        }
+    )
+    assert not _rules(result, "lock-guard")
+
+
+def test_helper_called_only_under_lock_is_lock_held(lint_tree):
+    # the submit -> _take_batch -> _ready_key chain: helpers whose every
+    # call site holds the lock count as locked, to fixpoint
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/ok.py": """
+                import threading
+
+                class Batcher:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._total = 0
+
+                    def submit(self, n):
+                        with self._lock:
+                            self._bump(n)
+
+                    def _bump(self, n):
+                        self._mark(n)
+
+                    def _mark(self, n):
+                        self._total += n
+            """
+        }
+    )
+    assert not _rules(result, "lock-guard")
+
+
+def test_helper_with_one_unlocked_call_site_is_not_assumed_locked(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/bad.py": """
+                import threading
+
+                class Batcher:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._total = 0
+
+                    def submit(self, n):
+                        with self._lock:
+                            self._bump(n)
+
+                    def poke(self, n):
+                        self._bump(n)
+
+                    def _bump(self, n):
+                        self._total += n
+            """
+        }
+    )
+    found = _rules(result, "lock-guard")
+    assert len(found) == 1
+    assert "Batcher._total" in found[0].message
+
+
+def test_condition_aliases_its_underlying_lock(lint_tree):
+    # the MicroBatcher idiom: Condition(self._lock) IS self._lock
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/ok.py": """
+                import threading
+
+                class Batcher:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._work = threading.Condition(self._lock)
+                        self._queues = {}
+
+                    def submit(self, key, item):
+                        with self._work:
+                            self._queues[key] = item
+
+                    def clear(self):
+                        with self._lock:
+                            self._queues = {}
+            """
+        }
+    )
+    assert not _rules(result, "lock-guard")
+
+
+def test_publishing_return_of_guarded_attribute_is_flagged(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/bad.py": """
+                import threading
+
+                class Store:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._items = {}
+
+                    def put(self, key, value):
+                        with self._lock:
+                            self._items[key] = value
+
+                    def items(self):
+                        return self._items
+            """
+        }
+    )
+    found = _rules(result, "lock-guard")
+    assert len(found) == 1
+    assert "returned without its lock" in found[0].message
+
+
+def test_declared_cow_attribute_returns_lock_free(lint_tree):
+    # the committed contracts declare RevisionFleet._models COW: writes
+    # must still hold the lock, but lock-free publishing reads are the
+    # pattern (loaded_specs / the per-request hot path)
+    result = lint_tree(
+        {
+            "gordo_tpu/server/fleet_store.py": """
+                import threading
+
+                class RevisionFleet:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._models = {}
+
+                    def load(self, name, model):
+                        with self._lock:
+                            self._models = {**self._models, name: model}
+
+                    def loaded(self):
+                        return self._models
+            """
+        }
+    )
+    assert not _rules(result, "lock-guard")
+
+
+def test_suppression_silences_lock_guard(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/ok.py": """
+                import threading
+
+                class Store:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._tick = 0
+
+                    def bump(self):
+                        with self._lock:
+                            self._tick += 1
+
+                    def fast_bump(self):
+                        # gt-lint: disable=lock-guard -- approximate by design
+                        self._tick += 1
+            """
+        }
+    )
+    assert not _rules(result, "lock-guard")
+    assert result.suppressed >= 1
+
+
+# -- lock-guard: module scope --------------------------------------------------
+
+
+def test_module_registry_written_without_module_lock_is_flagged(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/bad.py": """
+                import threading
+
+                _lock = threading.Lock()
+                _cache = {}
+
+                def put(key, value):
+                    with _lock:
+                        _cache[key] = value
+
+                def sneak(key, value):
+                    _cache[key] = value
+            """
+        }
+    )
+    found = _rules(result, "lock-guard")
+    assert len(found) == 1
+    assert "_cache" in found[0].message
+
+
+def test_function_local_shadow_is_not_a_module_write(lint_tree):
+    # honest Python scoping: without `global`, `store = ...` binds a
+    # local, even when a module name matches — the double-checked
+    # `store = _stores.get(key)` read pattern must not be flagged
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/ok.py": """
+                import threading
+
+                _lock = threading.Lock()
+                _stores = {}
+
+                def store_for(key):
+                    store = _stores.get(key)
+                    if store is not None:
+                        return store
+                    with _lock:
+                        store = _stores.get(key)
+                        if store is None:
+                            store = _stores[key] = object()
+                    return store
+            """
+        }
+    )
+    assert not _rules(result, "lock-guard")
+
+
+def test_module_helper_called_only_under_lock_is_lock_held(lint_tree):
+    # the call-context fixpoint works at module scope too: a helper
+    # whose only call site holds the module lock is not a finding
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/ok.py": """
+                import threading
+
+                _lock = threading.Lock()
+                _cache = {}
+
+                def put(key, value):
+                    with _lock:
+                        _store(key, value)
+
+                def _store(key, value):
+                    _cache[key] = value
+            """
+        }
+    )
+    assert not _rules(result, "lock-guard")
+
+
+def test_global_rebind_under_lock_infers_guard(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/bad.py": """
+                import threading
+
+                _lock = threading.Lock()
+                _recorder = None
+
+                def set_recorder(value):
+                    global _recorder
+                    with _lock:
+                        _recorder = value
+
+                def drop_recorder():
+                    global _recorder
+                    _recorder = None
+            """
+        }
+    )
+    found = _rules(result, "lock-guard")
+    assert len(found) == 1
+    assert "_recorder" in found[0].message
+
+
+# -- cow-publish ---------------------------------------------------------------
+
+
+def test_in_place_mutation_of_cow_attribute_is_flagged_tree_wide(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/lifecycle/bad.py": """
+                def poke(fleet, name, model):
+                    fleet._models[name] = model
+
+                def merge(fleet, extra):
+                    fleet._models.update(extra)
+            """
+        }
+    )
+    found = _rules(result, "cow-publish")
+    assert len(found) == 2
+    assert all("_models" in f.message for f in found)
+
+
+def test_whole_object_replacement_is_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/server/fleet_store.py": """
+                import threading
+
+                class RevisionFleet:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._models = {}
+
+                    def load(self, name, model):
+                        staged = dict(self._models)
+                        staged[name] = model
+                        with self._lock:
+                            self._models = staged
+            """
+        }
+    )
+    assert not _rules(result, "cow-publish")
+
+
+def test_bare_name_cow_mutation_flagged_only_in_declaring_module(lint_tree):
+    # `_recorder` is declared COW for gordo_tpu.telemetry.serving; a
+    # same-named local list in an unrelated module is not a claim
+    result = lint_tree(
+        {
+            "gordo_tpu/builder/ok.py": """
+                def collect(rows):
+                    _recorder = []
+                    _recorder.append(rows)
+                    return _recorder
+            """
+        }
+    )
+    assert not _rules(result, "cow-publish")
+
+
+# -- fork-safety ---------------------------------------------------------------
+
+_FORK_BAD = """
+    import os
+    import threading
+
+    _lock = threading.Lock()
+    _sinks = {}
+
+    def sink_for(directory):
+        key = f"{directory}-{os.getpid()}"
+        with _lock:
+            if key not in _sinks:
+                _sinks[key] = open(key, "a")
+            return _sinks[key]
+"""
+
+
+def test_pid_memoization_without_reset_hook_is_flagged(lint_tree):
+    result = lint_tree({"gordo_tpu/telemetry/bad.py": _FORK_BAD})
+    found = _rules(result, "fork-safety")
+    assert len(found) == 1
+    assert "_sinks" in found[0].message
+    assert "post-fork" in found[0].message
+
+
+def test_registered_reset_hook_satisfies_fork_safety(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/ok.py": _FORK_BAD
+            + """
+
+    from gordo_tpu.utils.postfork import register_postfork_reset
+
+    def _reset():
+        global _sinks
+        _sinks = {}
+
+    register_postfork_reset(_reset)
+"""
+        }
+    )
+    assert not _rules(result, "fork-safety")
+
+
+def test_os_register_at_fork_also_satisfies_fork_safety(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/ok.py": _FORK_BAD
+            + """
+
+    os.register_at_fork(after_in_child=_sinks.clear)
+"""
+        }
+    )
+    assert not _rules(result, "fork-safety")
+
+
+def test_registry_without_pid_derivation_is_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/telemetry/ok.py": """
+                import threading
+
+                _lock = threading.Lock()
+                _stores = {}
+
+                def store_for(key):
+                    with _lock:
+                        if key not in _stores:
+                            _stores[key] = object()
+                        return _stores[key]
+            """
+        }
+    )
+    assert not _rules(result, "fork-safety")
+
+
+def test_fork_safety_scoped_to_forking_packages(lint_tree):
+    # the planner never runs inside forked gunicorn workers
+    result = lint_tree({"gordo_tpu/planner/ok.py": _FORK_BAD})
+    assert not _rules(result, "fork-safety")
+
+
+# -- thread-lifecycle ----------------------------------------------------------
+
+
+def test_non_daemon_unjoined_thread_is_flagged(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/bad.py": """
+                import threading
+
+                def start():
+                    thread = threading.Thread(target=print)
+                    thread.start()
+                    return thread
+            """
+        }
+    )
+    found = _rules(result, "thread-lifecycle")
+    assert len(found) == 1
+    assert "daemon" in found[0].message
+
+
+def test_string_and_path_joins_are_not_shutdown_evidence(lint_tree):
+    # os.path.join / sep.join must not read as Thread.join — nearly
+    # every module joins paths, which would disable the rule wholesale
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/bad.py": """
+                import os
+                import threading
+
+                def start(parts):
+                    label = "-".join(parts)
+                    path = os.path.join("a", "b", label)
+                    thread = threading.Thread(target=print)
+                    thread.start()
+                    return path
+            """
+        }
+    )
+    found = _rules(result, "thread-lifecycle")
+    assert len(found) == 1
+    assert "daemon" in found[0].message
+
+
+def test_daemon_thread_is_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/ok.py": """
+                import threading
+
+                def start():
+                    thread = threading.Thread(target=print, daemon=True)
+                    thread.start()
+                    return thread
+            """
+        }
+    )
+    assert not _rules(result, "thread-lifecycle")
+
+
+def test_joined_thread_is_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/ok.py": """
+                import threading
+
+                class Worker:
+                    def start(self):
+                        self._thread = threading.Thread(target=print)
+                        self._thread.start()
+
+                    def stop(self):
+                        self._thread.join(timeout=5.0)
+            """
+        }
+    )
+    assert not _rules(result, "thread-lifecycle")
+
+
+def test_unstoppable_worker_loop_is_flagged(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/bad.py": """
+                import threading
+                import time
+
+                def _loop():
+                    while True:
+                        time.sleep(1.0)
+
+                def start():
+                    threading.Thread(target=_loop, daemon=True).start()
+            """
+        }
+    )
+    found = _rules(result, "thread-lifecycle")
+    assert len(found) == 1
+    assert "while True" in found[0].message
+
+
+def test_stop_event_checked_loop_is_clean(lint_tree):
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/ok.py": """
+                import threading
+
+                _stop = threading.Event()
+
+                def _loop():
+                    while True:
+                        if _stop.wait(timeout=0.05):
+                            return
+
+                def start():
+                    threading.Thread(target=_loop, daemon=True).start()
+            """
+        }
+    )
+    assert not _rules(result, "thread-lifecycle")
+
+
+def test_non_thread_while_true_is_ignored(lint_tree):
+    # CLI polling loops and file readers are not thread worker loops
+    result = lint_tree(
+        {
+            "gordo_tpu/cli/ok.py": """
+                import time
+
+                def wait_for(path, exists):
+                    while True:
+                        if exists(path):
+                            break
+                        time.sleep(1.0)
+            """
+        }
+    )
+    assert not _rules(result, "thread-lifecycle")

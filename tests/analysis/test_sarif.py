@@ -1,0 +1,114 @@
+"""SARIF 2.1.0 output: document shape, rule metadata, baseline
+suppressions, stable fingerprints, parse-error notifications, and the
+`gordo-tpu lint --sarif` CLI path."""
+
+import json
+
+import pytest
+from click.testing import CliRunner
+
+from gordo_tpu.analysis import (
+    default_rules,
+    run_lint,
+    sarif_document,
+    split_by_baseline,
+)
+from gordo_tpu.analysis.baseline import BaselineEntry
+from gordo_tpu.cli.cli import lint as lint_cli
+
+pytestmark = pytest.mark.analysis
+
+VIOLATION = "from gordo_tpu.server import app\n"
+
+
+@pytest.fixture
+def lint_outcome(make_tree):
+    root = make_tree({"gordo_tpu/telemetry/bad.py": VIOLATION})
+    rules = default_rules()
+    result = run_lint(root, rules)
+    assert result.findings  # layering violation fixture must fire
+    return root, rules, result
+
+
+def test_sarif_document_shape(lint_outcome):
+    _, rules, result = lint_outcome
+    doc = sarif_document(result, result.findings, [], rules=rules, version="9.9.9")
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "gordo-tpu-lint"
+    assert driver["version"] == "9.9.9"
+    rule_ids = {rule["id"] for rule in driver["rules"]}
+    # the full catalog rides along, concurrency family included
+    assert {
+        "layering",
+        "lock-guard",
+        "cow-publish",
+        "fork-safety",
+        "thread-lifecycle",
+    } <= rule_ids
+    for rule in driver["rules"]:
+        assert rule["shortDescription"]["text"]
+
+
+def test_sarif_results_carry_location_and_fingerprint(lint_outcome):
+    _, rules, result = lint_outcome
+    doc = sarif_document(result, result.findings, [], rules=rules)
+    results = doc["runs"][0]["results"]
+    assert results
+    for entry in results:
+        location = entry["locations"][0]["physicalLocation"]
+        assert location["artifactLocation"]["uri"].endswith("bad.py")
+        assert location["region"]["startLine"] >= 1
+        assert location["region"]["startColumn"] >= 1  # SARIF is 1-based
+        assert entry["partialFingerprints"]["gordoLint/v1"]
+        assert "suppressions" not in entry
+
+
+def test_sarif_baselined_findings_become_suppressions(lint_outcome):
+    _, rules, result = lint_outcome
+    finding = result.findings[0]
+    entries = [
+        BaselineEntry(
+            rule=finding.rule,
+            path=finding.path,
+            fingerprint=finding.fingerprint,
+            justification="a deliberate fixture exemption with a reason",
+        )
+    ]
+    new, baselined, _ = split_by_baseline(result.findings, entries)
+    doc = sarif_document(result, new, baselined, entries=entries, rules=rules)
+    suppressed = [
+        r for r in doc["runs"][0]["results"] if "suppressions" in r
+    ]
+    assert len(suppressed) == len(baselined) >= 1
+    suppression = suppressed[0]["suppressions"][0]
+    assert suppression["kind"] == "external"
+    assert suppression["status"] == "accepted"
+    assert "deliberate fixture exemption" in suppression["justification"]
+
+
+def test_sarif_parse_errors_become_notifications(make_tree):
+    root = make_tree({"gordo_tpu/telemetry/broken.py": "def broken(:\n"})
+    result = run_lint(root, default_rules())
+    assert result.parse_errors
+    doc = sarif_document(result, [], [], rules=())
+    invocation = doc["runs"][0]["invocations"][0]
+    assert invocation["executionSuccessful"] is False
+    notes = invocation["toolExecutionNotifications"]
+    assert notes and "unparseable" in notes[0]["message"]["text"]
+
+
+def test_lint_cli_writes_sarif_artifact(make_tree, tmp_path):
+    root = make_tree({"gordo_tpu/telemetry/bad.py": VIOLATION})
+    sarif_path = tmp_path / "out" / "lint.sarif"
+    sarif_path.parent.mkdir()
+    result = CliRunner().invoke(
+        lint_cli,
+        ["--root", root, "--sarif", str(sarif_path), "--report-only"],
+    )
+    assert result.exit_code == 0, result.output
+    doc = json.loads(sarif_path.read_text())
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"]

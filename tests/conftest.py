@@ -17,6 +17,17 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Opt-in lock-order tracing (GORDO_TPU_LOCK_TRACE): install BEFORE any
+# gordo_tpu module creates its module/instance locks, so the traced run
+# covers the serving stack's whole lock population. Edges aggregate
+# in-process and dump atexit into a pid-suffixed JSONL sink;
+# `gordo-tpu lockgraph 'lock_trace-*.jsonl'` is the deadlock gate CI
+# runs over the serve/telemetry/lifecycle suites.
+if os.environ.get("GORDO_TPU_LOCK_TRACE"):
+    from gordo_tpu.analysis.lockgraph import install_lock_trace
+
+    install_lock_trace()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

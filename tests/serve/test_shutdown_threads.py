@@ -1,0 +1,95 @@
+"""Thread-shutdown audit (the thread-lifecycle contract, runtime side):
+after a SIGTERM-style drain, no gordo-owned thread may survive as
+non-daemon — the batcher dispatchers join, the trace writer joins
+through the recorder close, and whatever is still alive (a warmup
+mid-compile) is daemon, so process exit can never hang."""
+
+import threading
+
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu.server import build_app
+from gordo_tpu.server.app import drain_and_stop
+from gordo_tpu.telemetry import serving as serve_trace
+
+from tests.serve.conftest import (
+    BATCH_NAMES,
+    PROJECT,
+    installed_engine,
+    temp_env_vars,
+    tiny_config,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.concurrency]
+
+
+class _FakeServer:
+    def __init__(self):
+        self.shutdowns = 0
+
+    def shutdown(self):
+        self.shutdowns += 1
+
+
+def _alive_non_daemon():
+    return [
+        thread
+        for thread in threading.enumerate()
+        if thread.is_alive()
+        and not thread.daemon
+        and thread is not threading.main_thread()
+    ]
+
+
+def test_drain_and_stop_leaves_zero_non_daemon_threads(
+    serve_collection_dir, batch_payload, tmp_path
+):
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=serve_collection_dir,
+        GORDO_TPU_TELEMETRY_DIR=str(tmp_path),
+        GORDO_TPU_TRACE_SAMPLE_RATE="1",
+        GORDO_TPU_SERVE_WARMUP="0",
+    ):
+        serve_trace.reset_serve_recorder()
+        try:
+            app = build_app(config={"EXPECTED_MODELS": BATCH_NAMES})
+            with installed_engine(tiny_config()) as engine:
+                # traffic spawns the async trace writer + dispatcher work
+                response = Client(app).post(
+                    f"/gordo/v0/{PROJECT}/{BATCH_NAMES[0]}/prediction",
+                    json=batch_payload,
+                )
+                assert response.status_code == 200
+                writer = serve_trace.serve_recorder()._writer
+                assert writer is not None and writer.is_alive()
+
+                server = _FakeServer()
+                drain_and_stop(app, server=server, engine=engine)
+
+                assert server.shutdowns == 1
+                # the writer thread was JOINED, not abandoned
+                assert not writer.is_alive()
+                # every gordo-owned thread still alive must be daemon
+                # (a warmup mid-XLA-compile may linger; it cannot block
+                # exit), and nothing non-daemon survives at all
+                leftovers = [
+                    t
+                    for t in threading.enumerate()
+                    if t.name.startswith("gordo-") and t.is_alive()
+                ]
+                assert all(t.daemon for t in leftovers), leftovers
+                assert _alive_non_daemon() == []
+        finally:
+            serve_trace.reset_serve_recorder()
+
+
+def test_serving_stack_registers_postfork_resets():
+    """The fork-safety contract's runtime half: the pid-derived
+    registries (serving trace recorder, fleet-health ledgers) must be
+    wired into the post-fork reset registry at import time."""
+    from gordo_tpu.utils.postfork import registered_resets
+
+    names = registered_resets()
+    assert "telemetry.serving.recorder" in names
+    assert "telemetry.fleet_health.ledgers" in names

@@ -1,0 +1,206 @@
+"""Concurrency stress drills for the contracts the new lint rules
+encode: the fleet store's copy-on-write read path under concurrent
+hot-swap/DELETE churn (no torn reads, no dict-mutated-during-iteration),
+and `ledger_for` across a real fork (fresh pid, fresh snapshot path —
+the gunicorn --preload bug class)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from gordo_tpu.server.fleet_store import FleetModelStore, RevisionFleet
+
+from tests.server.conftest import OLD_REVISION, REVISION, temp_env_vars
+
+pytestmark = pytest.mark.concurrency
+
+STRESS_SECONDS = 3.0
+
+
+def _run_hammer(workers, duration_s=STRESS_SECONDS):
+    """Run worker callables in a tight loop for ``duration_s``;
+    returns the list of raised exceptions (want: empty)."""
+    deadline = time.monotonic() + duration_s
+    failures = []
+
+    def loop(fn):
+        while time.monotonic() < deadline:
+            try:
+                fn()
+            except Exception as exc:  # noqa: BLE001 - collected for assert
+                failures.append(exc)
+                return
+
+    threads = [
+        threading.Thread(target=loop, args=(fn,), daemon=True)
+        for fn in workers
+    ]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=duration_s + 60.0)
+    assert not any(thread.is_alive() for thread in threads), "hammer deadlock"
+    return failures
+
+
+def test_cow_reads_survive_hot_swap_and_delete_churn(model_collection_root):
+    """Readers dereference the COW dicts lock-free while hot-swaps and
+    DELETE-revision invalidations churn the store: iteration over a
+    snapshot must never see a mutation (`dict changed size during
+    iteration` is exactly the torn read COW exists to prevent), and a
+    resolved model must always be internally consistent."""
+    current = str(model_collection_root / REVISION)
+    old = str(model_collection_root / OLD_REVISION)
+    store = FleetModelStore(max_revisions=2)
+
+    def read_models():
+        fleet = store.fleet(store.route(current))
+        model = fleet.model("machine-1")
+        assert model is not None
+        # iterate the COW snapshots: in-place mutation anywhere would
+        # raise RuntimeError mid-iteration
+        specs = fleet.loaded_specs()
+        for name, spec in specs.items():
+            assert name and spec is not None
+        resolution = fleet.resolution("machine-1")
+        assert resolution.model is not None
+        assert list(resolution.tag_names)
+
+    def swap_churn():
+        store.swap(current, old, warm=False)
+        store.swap(current, current, warm=False)  # rollback to disk truth
+
+    def delete_churn():
+        store.invalidate(old)
+        time.sleep(0.001)
+
+    def route_reads():
+        routed = store.route(current)
+        assert routed in (current, old)
+
+    failures = _run_hammer(
+        [read_models, read_models, read_models, swap_churn, delete_churn, route_reads]
+    )
+    assert not failures, failures
+
+
+def test_revision_fleet_warm_races_bucket_reads(collection_dir):
+    """Concurrent warm() (whole-dict COW replacement per load) against
+    loaded_specs() iteration and spec_bucket() lookups: single
+    residency and consistent snapshots throughout."""
+    fleet = RevisionFleet(collection_dir)
+    ids = set()
+
+    def warm():
+        loaded = fleet.warm()
+        assert loaded  # artifacts exist
+
+    def snapshot_reads():
+        specs = fleet.loaded_specs()
+        for name in list(specs):
+            model = fleet.model(name)
+            ids.add((name, id(model)))
+
+    failures = _run_hammer([warm, warm, snapshot_reads, snapshot_reads], 1.5)
+    assert not failures, failures
+    # single residency: one object identity per machine, ever
+    names = {name for name, _ in ids}
+    assert len(ids) == len(names)
+
+
+@pytest.mark.skipif(
+    not hasattr(os, "fork"), reason="fork drill requires POSIX fork"
+)
+@pytest.mark.filterwarnings("ignore::RuntimeWarning")  # fork-with-threads
+def test_ledger_for_across_fork_gets_fresh_pid_sink(tmp_path):
+    """The frozen-pid-path bug class, end to end: a child forked after
+    the parent built its ledger must get a FRESH ledger bound to its
+    own pid-suffixed snapshot path (via the registered post-fork reset
+    + the `_pid` check), never the parent's — N workers clobbering one
+    shared fleet_health.json was the PR 10 collision."""
+    from gordo_tpu.telemetry import fleet_health
+
+    with temp_env_vars(
+        GORDO_TPU_TELEMETRY="1",
+        GORDO_TPU_FLEET_HEALTH="1",
+        GORDO_TPU_WORKER_SINKS="1",
+    ):
+        fleet_health.reset_ledgers()
+        try:
+            parent = fleet_health.ledger_for(str(tmp_path))
+            parent.record_request("machine-1")
+            parent_path = parent.path
+            assert str(os.getpid()) in os.path.basename(parent_path)
+
+            pid = os.fork()
+            if pid == 0:
+                # child: verdict via exit code only — no pytest
+                # machinery may run on this side of the fork
+                code = 3
+                try:
+                    child = fleet_health.ledger_for(str(tmp_path))
+                    fresh = (
+                        child is not parent
+                        and child._pid == os.getpid()
+                        and child.path != parent_path
+                        and str(os.getpid())
+                        in os.path.basename(child.path)
+                    )
+                    code = 0 if fresh else 1
+                except BaseException:
+                    code = 2
+                os._exit(code)
+
+            _, status = os.waitpid(pid, 0)
+            assert os.WIFEXITED(status) and os.WEXITSTATUS(status) == 0, (
+                f"fork child exit status {status}"
+            )
+            # the parent's ledger is untouched by the child's existence
+            assert fleet_health.ledger_for(str(tmp_path)) is parent
+        finally:
+            fleet_health.reset_ledgers()
+
+
+@pytest.mark.skipif(
+    not hasattr(os, "fork"), reason="fork drill requires POSIX fork"
+)
+@pytest.mark.filterwarnings("ignore::RuntimeWarning")  # fork-with-threads
+def test_serve_recorder_reset_runs_in_forked_child(tmp_path):
+    """The other registered reset: a forked child must not inherit the
+    parent's recorder (its writer thread does not exist post-fork —
+    spans would queue forever into a sink nobody drains)."""
+    from gordo_tpu.telemetry import serving as serve_trace
+
+    with temp_env_vars(
+        GORDO_TPU_TELEMETRY="1",
+        GORDO_TPU_TELEMETRY_DIR=str(tmp_path),
+        GORDO_TPU_WORKER_SINKS="1",
+    ):
+        serve_trace.reset_serve_recorder()
+        try:
+            parent_recorder = serve_trace.serve_recorder()
+            assert parent_recorder is not serve_trace.NULL_RECORDER
+
+            pid = os.fork()
+            if pid == 0:
+                code = 3
+                try:
+                    fresh = serve_trace._recorder is None
+                    rebuilt = serve_trace.serve_recorder()
+                    code = (
+                        0
+                        if fresh and rebuilt is not parent_recorder
+                        else 1
+                    )
+                except BaseException:
+                    code = 2
+                os._exit(code)
+
+            _, status = os.waitpid(pid, 0)
+            assert os.WIFEXITED(status) and os.WEXITSTATUS(status) == 0, (
+                f"fork child exit status {status}"
+            )
+        finally:
+            serve_trace.reset_serve_recorder()
