@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-concurrency test-fleet-health test-slo docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-check lint lint-gordo lockgraph-check image
+.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-concurrency test-fleet-health test-slo test-precision docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-precision bench-check lint lint-gordo lockgraph-check image
 
 test:
 	python -m pytest tests/ -q
@@ -87,6 +87,18 @@ bench-fleet-health:
 # not slow-marked, so the same tests also run inside the tier-1 budget.
 test-slo:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m slo
+
+# The mixed-precision serving-ladder suite: precision vocabulary /
+# casting / quantization units, engine e2e (f32 byte-parity, bf16
+# verdict parity, degrade drill, mixed-precision hot swap), the
+# precision-parity gate drills, and the cost-model precision features.
+test-precision:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m precision
+
+# Precision-ladder bench: per-precision fused scoring throughput +
+# verdict-agreement rate; writes BENCH_PRECISION.json.
+bench-precision:
+	JAX_PLATFORMS=cpu python benchmarks/bench_precision.py
 
 # SLO-engine bench: aggregation throughput (spans/s), steady-state
 # evaluation overhead vs the telemetry-on floor (<=2% is the gate), and

@@ -1,0 +1,236 @@
+"""
+Mixed-precision serving-ladder benchmark: per-precision fused scoring
+throughput and verdict-agreement rate.
+
+Measures the two numbers the precision ladder stands on:
+
+- **scoring throughput per precision** (f32 / bf16 / int8): the fused
+  ``fleet_forward_gather`` program — the exact program a served batch
+  runs — driven back-to-back at one fixed ladder shape per precision,
+  reps INTERLEAVED across precisions with quiet-window floors (the
+  bench_serve/bench_telemetry estimator: on shared hosts only one-sided
+  noise survives a floor). On CPU-only hosts there are no bf16/int8
+  compute units, so parity (ratio ≈ 1) is the CEILING — the committed
+  ratio floors exist to catch the reduced paths REGRESSING (an
+  accidental f64 upcast, a dequant blowup), per the ``min_bound``
+  pattern PR 12 established; the speedup itself asserts on device.
+- **verdict agreement** per reduced precision: the precision-parity
+  gate's own evaluation (``serve.precision.evaluate_parity``) over the
+  built fleet — the rate the serving gate requires before a revision
+  may serve reduced.
+
+The cost model's precision features ride along: predicted step time and
+resident weight bytes per precision next to the measured values.
+
+Writes ``BENCH_PRECISION.json`` at the repo root (the committed bench
+convention), gated by ``gordo-tpu bench-check``. Run:
+``JAX_PLATFORMS=cpu python benchmarks/bench_precision.py`` (or
+``make bench-precision``).
+"""
+
+import datetime
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+import warnings
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+warnings.filterwarnings("ignore", category=UserWarning)
+
+N_MODELS = 8
+N_TAGS = 12
+ROWS = 256  # the row rung every batch runs at
+MEMBERS = 8  # fused batch member count (== N_MODELS: full bucket)
+#: fused program launches per rep (one rep ≈ one quiet window); CI runs
+#: reduced reps via the BENCH_PRECISION_* overrides like every bench
+CALLS_PER_REP = int(os.environ.get("BENCH_PRECISION_CALLS", "30"))
+REPS = int(os.environ.get("BENCH_PRECISION_REPS", "7"))
+PRECISIONS = ("f32", "bf16", "int8")
+
+REVISION = "1700000000000"
+
+MACHINE_YAML = """  - name: bench-{i}
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-02T00:00:00+00:00"
+      tag_list: [{tags}]
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.JaxAutoEncoder:
+            kind: feedforward_model
+            encoding_dim: [256, 128]
+            encoding_func: [tanh, tanh]
+            decoding_dim: [128, 256]
+            decoding_func: [tanh, tanh]
+            epochs: 1
+"""
+
+
+def build_collection(root: str) -> str:
+    from gordo_tpu import serializer
+    from gordo_tpu.builder import local_build
+
+    tags = ", ".join(f"tag-{j}" for j in range(1, N_TAGS + 1))
+    config = "machines:\n" + "".join(
+        MACHINE_YAML.format(i=i, tags=tags) for i in range(N_MODELS)
+    )
+    collection_dir = os.path.join(root, REVISION)
+    for model, machine in local_build(config, project_name="bench-precision"):
+        serializer.dump(
+            model,
+            os.path.join(collection_dir, machine.name),
+            metadata=machine.to_dict(),
+        )
+    return collection_dir
+
+
+def main() -> dict:
+    import numpy as np
+
+    from gordo_tpu.planner.costmodel import CostModel
+    from gordo_tpu.serve import precision as P
+    from gordo_tpu.server.fleet_store import (
+        STORE,
+        fleet_forward_gather,
+        program_cache_stats,
+    )
+
+    root = tempfile.mkdtemp(prefix="bench-precision-")
+    try:
+        collection_dir = build_collection(root)
+        fleet = STORE.fleet(collection_dir)
+        fleet.warm()
+        spec = next(iter(fleet.loaded_specs().values()))
+
+        # one bucket + one payload per precision, prepared once (exactly
+        # the engine contract: cast at fleet load, payload at the
+        # precision's payload dtype)
+        indices = np.arange(MEMBERS, dtype=np.int32)
+        X32 = np.random.RandomState(0).rand(MEMBERS, ROWS, N_TAGS).astype(
+            np.float32
+        )
+        buckets, payloads = {}, {}
+        for prec in PRECISIONS:
+            _, buckets[prec] = fleet.spec_bucket(spec, prec)
+            payloads[prec] = X32.astype(P.payload_dtype(prec))
+
+        def run_once(prec: str):
+            np.asarray(
+                fleet_forward_gather(
+                    spec, buckets[prec], indices, payloads[prec], precision=prec
+                )
+            )
+
+        # warm every program out of the timed region
+        for prec in PRECISIONS:
+            run_once(prec)
+
+        def rep(prec: str) -> float:
+            begin = time.perf_counter()
+            for _ in range(CALLS_PER_REP):
+                run_once(prec)
+            wall = time.perf_counter() - begin
+            return MEMBERS * ROWS * CALLS_PER_REP / wall
+
+        # interleave precisions inside every rep (rotating order) so a
+        # host noise window hits all three, not one
+        runs = {prec: [] for prec in PRECISIONS}
+        for r in range(REPS):
+            order = PRECISIONS[r % len(PRECISIONS):] + PRECISIONS[: r % len(PRECISIONS)]
+            for prec in order:
+                runs[prec].append(rep(prec))
+
+        cost = CostModel()
+        throughput = {}
+        for prec in PRECISIONS:
+            floor = max(runs[prec])
+            throughput[prec] = {
+                "rows_per_sec": round(floor, 1),
+                "median_rows_per_sec": round(statistics.median(runs[prec]), 1),
+                "rows_per_sec_runs": [round(v, 1) for v in runs[prec]],
+                "measured_step_ms": round(
+                    MEMBERS * ROWS / floor * 1000.0, 4
+                ),
+                "predicted_step_ms": round(
+                    cost.predict_serve_step_s(spec, MEMBERS, ROWS, prec)
+                    * 1000.0,
+                    4,
+                ),
+                "weight_bytes": cost.serve_weight_bytes(spec, MEMBERS, prec),
+                "predicted_hbm_bytes": cost.predict_serve_hbm_bytes(
+                    spec, MEMBERS, ROWS, prec
+                ),
+            }
+
+        # the gate's own verdict-agreement evaluation per reduced
+        # precision (fresh fleet state: evaluate, don't cache-read)
+        agreement = {}
+        gates_passed = True
+        for prec in ("bf16", "int8"):
+            report = P.evaluate_parity(fleet, spec, prec)
+            agreement[prec] = {
+                "agreement_min": report["agreement_min"],
+                "passed": bool(report["passed"]),
+                "probe_rows": report["probe_rows"],
+                "members": len(report["members"]),
+            }
+            gates_passed = gates_passed and bool(report["passed"])
+        agreement["min"] = min(
+            agreement[p]["agreement_min"] for p in ("bf16", "int8")
+        )
+
+        programs = program_cache_stats()
+        STORE.clear()
+
+        f32_floor = throughput["f32"]["rows_per_sec"]
+        doc = {
+            "bench": "precision-ladder",
+            "timestamp": datetime.datetime.now(
+                datetime.timezone.utc
+            ).isoformat(),
+            "models": N_MODELS,
+            "tags": N_TAGS,
+            "members": MEMBERS,
+            "rows": ROWS,
+            "calls_per_rep": CALLS_PER_REP,
+            "reps": REPS,
+            "backend": os.environ.get("JAX_PLATFORMS", "cpu"),
+            "throughput": throughput,
+            "ratios": {
+                "bf16_vs_f32": round(
+                    throughput["bf16"]["rows_per_sec"] / f32_floor, 4
+                ),
+                "int8_vs_f32": round(
+                    throughput["int8"]["rows_per_sec"] / f32_floor, 4
+                ),
+            },
+            "verdict_agreement": agreement,
+            "parity_gates_passed": gates_passed,
+            "programs_by_precision": programs.get("by_precision"),
+        }
+        out_path = Path(
+            os.environ.get("BENCH_PRECISION_OUT")
+            or REPO_ROOT / "BENCH_PRECISION.json"
+        )
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        print(f"\nwrote {out_path}")
+        return doc
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

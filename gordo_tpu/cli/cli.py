@@ -359,6 +359,16 @@ def get_all_score_strings(machine) -> List[str]:
     help="Precompile each served bucket's ladder programs at startup "
     "[GORDO_TPU_SERVE_WARMUP, default on when batching is on].",
 )
+@click.option(
+    "--serve-precision",
+    type=click.Choice(["f32", "bf16", "int8"]),
+    default=None,
+    help="Default serving precision for the fused batch programs "
+    "[GORDO_TPU_SERVE_PRECISION, default f32]. A spec's own "
+    "`precision:` field overrides per model; reduced precision serves "
+    "only behind a passed precision-parity gate and degrades to f32 "
+    "on failure (see docs/serving.md, 'Serving precision').",
+)
 def run_server_cli(
     host,
     port,
@@ -376,6 +386,7 @@ def run_server_cli(
     batch_deadline_ms,
     batch_row_ladder,
     serve_warmup,
+    serve_precision,
 ):
     """Run the model server."""
     # Batching knobs travel as env vars — that is how they reach the
@@ -388,6 +399,7 @@ def run_server_cli(
         ("GORDO_TPU_BATCH_DEADLINE_MS", batch_deadline_ms),
         ("GORDO_TPU_BATCH_ROW_LADDER", batch_row_ladder),
         ("GORDO_TPU_SERVE_WARMUP", None if serve_warmup is None else int(serve_warmup)),
+        ("GORDO_TPU_SERVE_PRECISION", serve_precision),
     ):
         if value is not None:
             os.environ[env_name] = str(value)

@@ -21,7 +21,17 @@ base and the canary fleets:
   the base model's on the same rows. The base is the STALE model, so a
   healthy rebuild usually scores far below it — a canary that scores
   materially WORSE than a model already flagged as drifted is broken,
-  whatever its training loss claimed.
+  whatever its training loss claimed;
+- **precision-parity gate** — the threshold-parity idea promoted onto
+  the serving precision ladder (PR 14): when the active serving
+  precision is reduced (``GORDO_TPU_SERVE_PRECISION``/per-spec
+  ``precision:``), the canary's bf16/int8 anomaly VERDICTS must agree
+  with its own f32 verdicts within
+  ``GORDO_TPU_GATE_PRECISION_AGREEMENT`` on a deterministic probe
+  window (the shared math in ``gordo_tpu.serve.precision``). A canary
+  whose rebuilt weights quantize badly must not be promoted into a
+  reduced-precision fleet — and at serve time the same check gates each
+  revision's buckets, degrading to f32 instead of erroring.
 
 Gate failures are collected (not short-circuited) so the quarantine
 record explains every reason at once.
@@ -45,6 +55,9 @@ class GateConfig:
     max_error_rate: float = 0.0
     threshold_ratio: float = 4.0
     residual_ratio: float = 2.0
+    #: minimum reduced-vs-f32 verdict agreement (the precision-parity
+    #: gate; only evaluated when the active serving precision is not f32)
+    precision_agreement: float = 0.98
 
     @classmethod
     def from_env(cls) -> "GateConfig":
@@ -52,6 +65,9 @@ class GateConfig:
             max_error_rate=env_float("GORDO_TPU_GATE_MAX_ERROR_RATE", 0.0),
             threshold_ratio=env_float("GORDO_TPU_GATE_THRESHOLD_RATIO", 4.0),
             residual_ratio=env_float("GORDO_TPU_GATE_RESIDUAL_RATIO", 2.0),
+            precision_agreement=env_float(
+                "GORDO_TPU_GATE_PRECISION_AGREEMENT", 0.98
+            ),
         )
 
 
@@ -175,4 +191,99 @@ def evaluate_canary(
         # informational: the stale base failing to score the probe does
         # not block the canary (it is what the rebuild is fixing)
         report.checks["base_errors"] = sorted(base_errors)
+
+    # -- precision-parity gate ----------------------------------------------
+    # only engaged when the fleet would actually serve reduced: a canary
+    # promoted into a bf16/int8 deployment must prove its quantized
+    # verdicts first (serve-time gating then re-checks per revision and
+    # degrades rather than erroring — this promotion-time check exists
+    # so a badly-quantizing rebuild never even takes its canary slice
+    # into the reduced ladder)
+    _apply_precision_parity(canary_fleet, report, config)
+    return report
+
+
+def _apply_precision_parity(
+    canary_fleet: Any, report: GateReport, config: GateConfig
+) -> None:
+    try:
+        from ..serve.precision import ParityConfig, resolve_precision
+    except Exception:  # noqa: BLE001 - serve package unavailable: the
+        # classic gates still stand
+        return
+    from ..models.spec import FeedForwardSpec
+
+    specs = {
+        spec
+        for spec in canary_fleet.loaded_specs().values()
+        if isinstance(spec, FeedForwardSpec)
+    }
+    active = sorted(
+        {
+            (resolve_precision(spec), spec)
+            for spec in specs
+            if resolve_precision(spec) != "f32"
+        },
+        key=lambda pair: (pair[0], repr(pair[1])),
+    )
+    if not active:
+        return
+    parity_config = ParityConfig.from_env()
+    parity_config.agreement = config.precision_agreement
+    results: Dict[str, Any] = {}
+    for precision, spec in active:
+        gate = evaluate_precision_parity(
+            canary_fleet, spec, precision, parity_config
+        )
+        key = f"{precision}:{type(spec).__name__}[{spec.n_features}]"
+        results[key] = gate.checks.get("parity")
+        if not gate.passed:
+            report.failures.extend(gate.failures)
+            report.passed = False
+    report.checks["precision_parity"] = results
+
+
+def evaluate_precision_parity(
+    fleet: Any,
+    spec: Any,
+    precision: str,
+    config: Optional["Any"] = None,
+) -> GateReport:
+    """
+    The precision-parity gate for one fleet's spec bucket, as a
+    :class:`GateReport`: scores a deterministic probe window through the
+    f32 AND the reduced-precision fused programs
+    (``gordo_tpu.serve.precision.evaluate_parity`` — the same math the
+    serve engine's governor runs) and fails when any member's anomaly
+    verdicts diverge past tolerance. Crashing evaluation is a FAILED
+    gate, never an exception — the caller's rollback/degrade machinery
+    handles both identically.
+    """
+    from ..serve.precision import ParityConfig, evaluate_parity
+
+    if config is None:
+        config = ParityConfig.from_env()
+    report = GateReport()
+    try:
+        parity = evaluate_parity(fleet, spec, precision, config)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as exc:  # noqa: BLE001 - see docstring
+        report.fail(f"precision parity evaluation crashed: {exc!r}")
+        report.checks["parity"] = {"precision": precision, "error": repr(exc)}
+        return report
+    report.checks["parity"] = {
+        "precision": parity.get("precision"),
+        "agreement_min": parity.get("agreement_min"),
+        "agreement_threshold": parity.get("agreement_threshold"),
+        "members": {
+            name: member.get("agreement")
+            for name, member in (parity.get("members") or {}).items()
+        },
+    }
+    if not parity.get("passed"):
+        report.fail(
+            parity.get("detail")
+            or f"{precision} verdicts diverge from f32 past tolerance"
+        )
     return report

@@ -31,6 +31,7 @@ def feedforward_model(
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     compile_kwargs: Optional[Dict[str, Any]] = None,
     compute_dtype: str = "float32",
+    precision: str = "",
     **kwargs,
 ) -> FeedForwardSpec:
     """
@@ -38,6 +39,9 @@ def feedforward_model(
     an L1 activity penalty on every encoder layer except the first.
     ``compute_dtype="bfloat16"`` runs params + activations in bf16 (losses
     and outputs stay float32 — models/nn.py dtype contract).
+    ``precision`` declares the SERVING precision ("f32"/"bf16"/"int8";
+    "" inherits ``GORDO_TPU_SERVE_PRECISION``) — training ignores it,
+    the serve engine's precision ladder reads it per spec.
     """
     n_features_out = n_features_out or n_features
     check_dim_func_len("encoding", encoding_dim, encoding_func)
@@ -60,6 +64,7 @@ def feedforward_model(
         optimizer=OptimizerSpec.from_config(optimizer, optimizer_kwargs),
         loss=compile_kwargs.get("loss", "mse"),
         compute_dtype=compute_dtype,
+        precision=precision,
     )
 
 

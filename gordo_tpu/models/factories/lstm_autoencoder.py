@@ -30,11 +30,14 @@ def lstm_model(
     optimizer_kwargs: Optional[Dict[str, Any]] = None,
     compile_kwargs: Optional[Dict[str, Any]] = None,
     compute_dtype: str = "float32",
+    precision: str = "",
     **kwargs,
 ) -> LSTMSpec:
     """Fully-specified stacked-LSTM network over a lookback window.
     ``compute_dtype="bfloat16"`` runs the recurrence in bf16 (losses and
-    outputs stay float32 — models/nn.py dtype contract)."""
+    outputs stay float32 — models/nn.py dtype contract). ``precision``
+    declares the serving precision (carried on the spec; LSTMs serve
+    unbatched today)."""
     n_features_out = n_features_out or n_features
     check_dim_func_len("encoding", encoding_dim, encoding_func)
     check_dim_func_len("decoding", decoding_dim, decoding_func)
@@ -49,6 +52,7 @@ def lstm_model(
         optimizer=OptimizerSpec.from_config(optimizer, optimizer_kwargs),
         loss=compile_kwargs.get("loss", "mse"),
         compute_dtype=compute_dtype,
+        precision=precision,
     )
 
 

@@ -127,6 +127,12 @@ class FeedForwardSpec(ModelSpec):
     optimizer: OptimizerSpec = field(default_factory=OptimizerSpec)
     loss: str = "mse"
     compute_dtype: str = "float32"
+    #: serving precision from the config surface ("" inherits the
+    #: GORDO_TPU_SERVE_PRECISION knob): "f32", "bf16" or "int8" — read
+    #: only by the serve engine's precision ladder, never by training.
+    #: A plain class-level default keeps pre-precision pickled specs
+    #: loading (attribute access falls back to the class default).
+    precision: str = ""
 
     def __post_init__(self):
         if len(self.dims) != len(self.activations):
@@ -156,6 +162,9 @@ class LSTMSpec(ModelSpec):
     optimizer: OptimizerSpec = field(default_factory=OptimizerSpec)
     loss: str = "mse"
     compute_dtype: str = "float32"
+    #: serving precision from the config surface (see FeedForwardSpec;
+    #: LSTMs serve unbatched today, so this is carried, not yet used)
+    precision: str = ""
 
     def __post_init__(self):
         if len(self.dims) != len(self.activations):

@@ -1128,9 +1128,22 @@ class FleetBuilder:
             else None
         )
         measured_hbm = self._device_peak_bytes or None
+        # the precision feature rides the accuracy record: which compute
+        # precisions the planned programs ran at (the cost model's new
+        # axis — predicted-vs-actual is only comparable per precision)
+        try:
+            from ..planner.costmodel import compute_precision
+
+            plan_precisions = sorted(
+                {compute_precision(bucket.spec) for bucket in plan.buckets}
+            )
+        except Exception:  # noqa: BLE001 - a replayed plan may carry
+            # serialized bucket entries; the feature is advisory
+            plan_precisions = None
         accuracy = dict(
             plan_hash=plan.plan_hash,
             strategy=plan.strategy,
+            precisions=plan_precisions,
             predicted_compiles=totals.get("compiles", 0),
             actual_compiles=actual_compiles,
             predicted_wall_s=totals.get("predicted_wall_s", 0.0),

@@ -46,6 +46,48 @@ _OPTIMIZER_COPIES = 4
 #: backward pass ≈ 2x the forward FLOPs (grad wrt inputs + weights)
 _TRAIN_FLOP_FACTOR = 3.0
 
+#: resident WEIGHT bytes per element at each serving precision (the
+#: serve engine's precision ladder): int8 weight-only quantization
+#: additionally keeps a per-channel f32 scale, accounted separately in
+#: :meth:`CostModel.serve_weight_bytes`
+PRECISION_WEIGHT_BYTES: Dict[str, int] = {"f32": 4, "bf16": 2, "int8": 1}
+
+#: activation/compute bytes per element: int8 serving runs its
+#: activations in bf16 (weight-only quantization), so its compute width
+#: is bf16's
+PRECISION_COMPUTE_BYTES: Dict[str, int] = {"f32": 4, "bf16": 2, "int8": 2}
+
+#: THE canonical precision-alias table. It lives HERE (not in
+#: gordo_tpu.serve.precision, which re-imports it) because the layering
+#: contract forbids planner→serve imports even lazily — the cost model
+#: is the lowest layer that speaks precision, so it owns the vocabulary
+#: and the serve package reads it from below.
+PRECISION_ALIASES: Dict[str, str] = {
+    "f32": "f32", "fp32": "f32", "float32": "f32",
+    "bf16": "bf16", "bfloat16": "bf16",
+    "int8": "int8", "i8": "int8", "w8": "int8",
+}
+
+#: analytic default per-precision step-time factors (shared by the
+#: CostTable field default and the legacy-table load path)
+DEFAULT_PRECISION_FACTORS: Dict[str, float] = {"bf16": 0.6, "int8": 0.55}
+
+
+def normalize_precision(precision: Optional[str]) -> str:
+    """Canonical precision key (``float32``→``f32``, ``bfloat16``→
+    ``bf16``); unknown/empty values cost as f32 — the conservative
+    (widest) estimate."""
+    if not precision:
+        return "f32"
+    return PRECISION_ALIASES.get(str(precision).strip().lower(), "f32")
+
+
+def compute_precision(spec: ModelSpec) -> str:
+    """The precision feature of a spec's TRAINING programs, derived from
+    its ``compute_dtype`` (bf16 compute halves activation traffic even
+    though master params stay f32 — models/nn.py dtype contract)."""
+    return normalize_precision(getattr(spec, "compute_dtype", "float32"))
+
 
 def spec_param_count(spec: ModelSpec) -> int:
     """Trainable parameter count from the spec geometry alone."""
@@ -113,9 +155,23 @@ class CostTable:
     dispatch_s: float = 0.01
     run_factors: Dict[str, float] = field(default_factory=dict)
     compile_factors: Dict[str, float] = field(default_factory=dict)
+    #: per-precision multiplicative correction on predicted step time —
+    #: the precision FEATURE of the cost model. Defaults assume the
+    #: HBM-bound tiny-model regime (bf16 halves re-read bytes but not
+    #: to 0.5x — dispatch and host shares don't scale; int8's dequant
+    #: claws some back). Unlisted precisions (and f32) cost 1.0;
+    #: recalibrate per backend like every other factor.
+    precision_factors: Dict[str, float] = field(
+        default_factory=lambda: dict(DEFAULT_PRECISION_FACTORS)
+    )
     #: calibration provenance: sample counts per program
     samples: Dict[str, int] = field(default_factory=dict)
     version: int = COST_TABLE_VERSION
+
+    def precision_factor(self, precision: Optional[str]) -> float:
+        return float(
+            self.precision_factors.get(normalize_precision(precision), 1.0)
+        )
 
     def to_dict(self) -> dict:
         return {
@@ -126,6 +182,7 @@ class CostTable:
             "dispatch_s": self.dispatch_s,
             "run_factors": dict(sorted(self.run_factors.items())),
             "compile_factors": dict(sorted(self.compile_factors.items())),
+            "precision_factors": dict(sorted(self.precision_factors.items())),
             "samples": dict(sorted(self.samples.items())),
         }
 
@@ -150,6 +207,14 @@ class CostTable:
             compile_factors={
                 str(k): float(v)
                 for k, v in (doc.get("compile_factors") or {}).items()
+            },
+            # pre-precision tables (PR ≤13) carry no factor map: they
+            # load with the analytic defaults rather than being rejected
+            precision_factors={
+                str(k): float(v)
+                for k, v in (
+                    doc.get("precision_factors") or DEFAULT_PRECISION_FACTORS
+                ).items()
             },
             samples={
                 str(k): int(v) for k, v in (doc.get("samples") or {}).items()
@@ -235,10 +300,22 @@ class CostModel:
         )
 
     def predict_run_s(
-        self, program: str, spec: ModelSpec, m_total: int, n_total: int, epochs: int
+        self,
+        program: str,
+        spec: ModelSpec,
+        m_total: int,
+        n_total: int,
+        epochs: int,
+        precision: Optional[str] = None,
     ) -> float:
+        """``precision`` is the program's compute precision (defaults to
+        the spec's own ``compute_dtype``) — a feature of predicted step
+        cost, corrected by the table's per-precision factor."""
+        if precision is None:
+            precision = compute_precision(spec)
         flops = self.train_flops(spec, m_total, n_total, epochs)
         factor = self.table.run_factors.get(program, 1.0)
+        factor *= self.table.precision_factor(precision)
         return factor * (flops / self.table.throughput) + self.table.dispatch_s
 
     def predict_compile_s(self, program: str, spec: ModelSpec) -> float:
@@ -256,11 +333,20 @@ class CostModel:
         batch_size: int,
         y_aliased: bool = True,
         series_rows: Optional[int] = None,
+        precision: Optional[str] = None,
     ) -> int:
         """Resident device bytes of one bucket's training program:
         staged data + per-member params × optimizer copies + one batch
         of activations. ``series_rows`` switches to the windowed layout
-        (series resident instead of materialized windows)."""
+        (series resident instead of materialized windows).
+
+        ``precision`` (default: the spec's ``compute_dtype``) scales the
+        ACTIVATION bytes — bf16 compute halves them, which changes how
+        many members fit under the packer's HBM cap. Master params and
+        staged f32 data keep full width during training (the models/nn
+        mixed-precision contract: params never store reduced)."""
+        if precision is None:
+            precision = compute_precision(spec)
         f_in = getattr(spec, "n_features", 1)
         f_out = getattr(spec, "n_features_out", f_in)
         if series_rows is not None:
@@ -278,7 +364,57 @@ class CostModel:
         activations = m_total * batch_size * width * (
             len(getattr(spec, "dims", ())) + 2
         ) * lookback
-        return 4 * int(data + params + activations)  # float32
+        compute_bytes = PRECISION_COMPUTE_BYTES.get(
+            normalize_precision(precision), 4
+        )
+        return int(4 * (data + params) + compute_bytes * activations)
+
+    # -- serve-side estimates (the engine's precision ladder) ---------------
+
+    def serve_weight_bytes(
+        self, spec: ModelSpec, members: int, precision: str = "f32"
+    ) -> int:
+        """Resident weight bytes of one revision bucket at a serving
+        precision: bf16 halves them, int8 quarters them (plus the
+        per-channel f32 scales — one scale per output unit per member).
+        This is the number the precision ladder exists to shrink: the
+        HBM traffic every fused batch re-reads."""
+        precision = normalize_precision(precision)
+        weight_bytes = PRECISION_WEIGHT_BYTES.get(precision, 4)
+        params = spec_param_count(spec) * members
+        scales = 0
+        if precision == "int8":
+            dims = tuple(getattr(spec, "dims", ())) + (
+                getattr(spec, "n_features_out", 1),
+            )
+            scales = 4 * members * sum(dims)  # f32 scale per out channel
+        return int(weight_bytes * params + scales)
+
+    def predict_serve_hbm_bytes(
+        self, spec: ModelSpec, members: int, rows: int, precision: str = "f32"
+    ) -> int:
+        """Resident bytes of one fused serving batch: the precision's
+        weight bucket + the staged payload at the compute width + the
+        f32 output."""
+        precision = normalize_precision(precision)
+        f_in = getattr(spec, "n_features", 1)
+        f_out = getattr(spec, "n_features_out", f_in)
+        compute_bytes = PRECISION_COMPUTE_BYTES.get(precision, 4)
+        payload = compute_bytes * members * rows * f_in
+        output = 4 * members * rows * f_out  # always float32 out
+        return self.serve_weight_bytes(spec, members, precision) + payload + output
+
+    def predict_serve_step_s(
+        self, spec: ModelSpec, members: int, rows: int, precision: str = "f32"
+    ) -> float:
+        """Predicted wall seconds of one fused serving batch (forward
+        only — no train factor), with precision as a feature: the
+        engine stamps this next to the measured device time on every
+        batch span (predicted-vs-actual on the new axis)."""
+        flops = spec_flops_per_sample(spec) * float(members) * float(rows)
+        factor = self.table.run_factors.get("fleet_forward", 1.0)
+        factor *= self.table.precision_factor(precision)
+        return factor * (flops / self.table.throughput) + self.table.dispatch_s
 
 
 def calibrate(

@@ -32,6 +32,17 @@ from .engine import (
     reset_engine,
 )
 from .ladder import member_ladder, pad_to, parse_ladder, row_ladder
+from .precision import (
+    PRECISIONS,
+    ParityConfig,
+    PrecisionGovernor,
+    evaluate_parity,
+    payload_dtype,
+    recon_agreement,
+    resolve_precision,
+    serve_precision,
+    verdict_agreement,
+)
 
 __all__ = [
     "BatchItem",
@@ -39,16 +50,25 @@ __all__ = [
     "BatcherStopped",
     "DeadlineExceeded",
     "MicroBatcher",
+    "PRECISIONS",
+    "ParityConfig",
+    "PrecisionGovernor",
     "QueueFullError",
     "ServeConfig",
     "ServeEngine",
     "batching_enabled",
     "ensure_engine",
+    "evaluate_parity",
     "get_engine",
     "install_engine",
     "member_ladder",
     "pad_to",
     "parse_ladder",
+    "payload_dtype",
+    "recon_agreement",
     "reset_engine",
+    "resolve_precision",
     "row_ladder",
+    "serve_precision",
+    "verdict_agreement",
 ]

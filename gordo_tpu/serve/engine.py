@@ -44,8 +44,8 @@ import numpy as np
 from ..models.spec import FeedForwardSpec
 from ..telemetry.device import note_program_execution
 from ..telemetry.serving import SERVE_TRACE_FILE, serve_recorder
-from ..utils.env import env_bool, env_float, env_int
-from . import ladder
+from ..utils.env import env_bool, env_float, env_int, env_str
+from . import ladder, precision
 from .batcher import BatcherStopped, BatchItem, DeadlineExceeded, MicroBatcher
 
 logger = logging.getLogger(__name__)
@@ -75,6 +75,7 @@ class ServeConfig:
         "row_ladder",
         "warmup_max_rows",
         "inline_flush",
+        "precision",
     )
 
     def __init__(
@@ -88,6 +89,7 @@ class ServeConfig:
         row_ladder: Optional[Tuple[int, ...]] = None,
         warmup_max_rows: int = 512,
         inline_flush: bool = True,
+        serve_precision: str = "",
     ):
         self.max_size = max(1, int(max_size))
         self.max_delay_s = max(0.0, float(max_delay_ms) / 1000.0)
@@ -100,6 +102,14 @@ class ServeConfig:
         )
         self.warmup_max_rows = int(warmup_max_rows)
         self.inline_flush = bool(inline_flush)
+        #: the engine-default serving precision ("" inherits the
+        #: GORDO_TPU_SERVE_PRECISION knob at resolve time); a spec's own
+        #: precision: field still wins per request
+        self.precision = (
+            precision.normalize(serve_precision)
+            if serve_precision
+            else precision.serve_precision()
+        )
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -111,6 +121,7 @@ class ServeConfig:
             dispatchers=env_int("GORDO_TPU_BATCH_DISPATCHERS", 1),
             warmup_max_rows=env_int("GORDO_TPU_SERVE_WARMUP_ROWS", 512),
             inline_flush=env_bool("GORDO_TPU_BATCH_INLINE_FLUSH", True),
+            serve_precision=env_str(precision.PRECISION_ENV, "") or "",
         )
 
 
@@ -123,6 +134,9 @@ class ServeEngine:
         #: late-bound so build_app can attach it after creation
         self.metrics = metrics
         self.member_ladder = ladder.member_ladder(self.config.max_size)
+        #: the precision-parity arbiter: gate-then-serve, degrade to f32
+        #: on failure (serve/precision.py)
+        self.governor = precision.PrecisionGovernor()
         self._lock = threading.Lock()
         self._programs: set = set()
         self._counters: Dict[str, int] = {
@@ -133,7 +147,14 @@ class ServeEngine:
             "shed_queue_full": 0,
             "shed_deadline": 0,
             "warmup_programs": 0,
+            "precision_degraded": 0,  # requests gated down to f32
         }
+        #: requests coalesced per effective serving precision
+        self._precision_counters: Dict[str, int] = {}
+        #: (spec, members, rows, precision) -> predicted device ms (the
+        #: cost model's serve-step estimate, cached per ladder shape for
+        #: the predicted-vs-actual batch-span attributes)
+        self._step_predictions: Dict[Tuple, float] = {}
         self._batcher = MicroBatcher(
             self._run_batch,
             max_size=self.config.max_size,
@@ -209,13 +230,31 @@ class ServeEngine:
                 self._count("fallback")
                 return None
 
+        # the effective serving precision: the spec's declared (or the
+        # engine-default) precision, gated down to f32 when the parity
+        # gate failed (or has not passed yet) — the governor's steady
+        # state is one COW dict probe per request
+        desired = precision.resolve_precision(spec, self.config.precision)
+        prec = desired
+        if desired != precision.F32:
+            prec = self.governor.effective_precision(
+                fleet, spec, desired, recorder=self._recorder
+            )
+            if prec != desired:
+                self._count("precision_degraded")
+
         # row padding happens HERE, on the (otherwise waiting) request
         # thread — the dispatcher then stacks same-rung payloads in one
-        # numpy call (see the module docstring for why that matters)
+        # numpy call (see the module docstring for why that matters).
+        # The payload dtype is derived from the effective precision
+        # (serve/precision.payload_dtype — THE one payload-dtype
+        # authority), so the stack path cannot silently upcast a
+        # reduced-precision program's inputs.
+        dtype = precision.payload_dtype(prec)
         if rows == padded_rows:
-            payload = np.ascontiguousarray(transformed, dtype=np.float32)
+            payload = np.ascontiguousarray(transformed, dtype=dtype)
         else:
-            payload = np.zeros((padded_rows,) + transformed.shape[1:], np.float32)
+            payload = np.zeros((padded_rows,) + transformed.shape[1:], dtype)
             payload[:rows] = transformed
 
         deadline = time.monotonic() + self.config.deadline_s
@@ -234,7 +273,10 @@ class ServeEngine:
             trace = (timing.trace_id, getattr(timing, "default_parent_id", None))
         item = BatchItem(name, payload, rows=rows, deadline=deadline, trace=trace)
         try:
-            future = self._batcher.submit((fleet, spec, padded_rows), item)
+            # precision is part of the batch key: an f32 and a bf16
+            # request for the same spec/rung must never share a fused
+            # program (mixed base/canary traffic during a hot-swap)
+            future = self._batcher.submit((fleet, spec, padded_rows, prec), item)
         except BatcherStopped:
             self._count("fallback")
             return None
@@ -259,9 +301,9 @@ class ServeEngine:
     # -- batch execution (dispatcher thread) --------------------------------
 
     def _run_batch(self, key, items: List[BatchItem]) -> None:
-        from ..server.fleet_store import fleet_forward_gather, use_pallas
+        from ..server.fleet_store import fleet_forward_gather, serving_backend
 
-        fleet, spec, padded_rows = key
+        fleet, spec, padded_rows, prec = key
         flush_start = time.monotonic()
         queue_waits = [flush_start - item.enqueued_at for item in items]
         with self._recorder.span(
@@ -269,10 +311,11 @@ class ServeEngine:
             spec=type(spec).__name__,
             n_features=spec.n_features,
             size=len(items),
+            precision=prec,
         ) as batch_span:
             with self._recorder.span("stack"):
                 stack_start = time.monotonic()
-                bucket_names, stacked = fleet.spec_bucket(spec)
+                bucket_names, stacked = fleet.spec_bucket(spec, prec)
                 bucket_rows = {n: i for i, n in enumerate(bucket_names)}
                 live: List[BatchItem] = []
                 for item in items:
@@ -295,40 +338,50 @@ class ServeEngine:
                 # payloads arrive pre-padded to this key's row rung: the
                 # whole batch stacks in ONE numpy call (per-item python
                 # work here gets GIL-starved under request load)
+                # payloads arrive at the effective precision's payload
+                # dtype (request-thread padding above); the stack
+                # inherits it — no silent upcast on the dispatcher
                 X = np.stack([item.payload for item in live])
                 if padded_members > members:
                     padded = np.zeros(
                         (padded_members, padded_rows, spec.n_features),
-                        np.float32,
+                        precision.payload_dtype(prec),
                     )
                     padded[:members] = X
                     X = padded
                 stack_s = time.monotonic() - stack_start
 
             with self._recorder.span(
-                "device", padded_members=padded_members, padded_rows=padded_rows
+                "device",
+                padded_members=padded_members,
+                padded_rows=padded_rows,
+                precision=prec,
             ):
                 device_start = time.monotonic()
                 # member gather happens INSIDE the program — one device
                 # dispatch per batch, not one per parameter leaf
                 recon = np.asarray(
                     fleet_forward_gather(
-                        spec, stacked, np.asarray(indices, np.int32), X
+                        spec, stacked, np.asarray(indices, np.int32), X,
+                        precision=prec,
                     )
                 )
                 device_s = time.monotonic() - device_start
 
-            backend = "pallas" if use_pallas() else "xla"
-            program = (spec, backend, padded_members, padded_rows)
+            backend = serving_backend(prec)
+            program = (spec, backend, padded_members, padded_rows, prec)
             with self._lock:
                 new_program = program not in self._programs
                 self._programs.add(program)
                 self._counters["batches"] += 1
                 self._counters["coalesced"] += members
+                self._precision_counters[prec] = (
+                    self._precision_counters.get(prec, 0) + members
+                )
             # serve-side compile-vs-cache-hit accounting (telemetry
             # device console): a shape first seen here paid the XLA
             # compile inside this batch's device call
-            note_program_execution(new_program, kind="serve")
+            note_program_execution(new_program, kind="serve", precision=prec)
 
             scatter_start = time.monotonic()
             with self._recorder.span("scatter"):
@@ -361,6 +414,15 @@ class ServeEngine:
                 padded_rows=padded_rows,
                 padding_waste=round(waste, 4),
                 queue_wait_max_ms=round(max(queue_waits) * 1000.0, 3),
+                precision=prec,
+                # predicted-vs-actual on the precision axis: the cost
+                # model's precision-aware serve-step estimate next to the
+                # measured device time (the serving counterpart of the
+                # build plane's fleet_plan_accuracy)
+                predicted_device_ms=self._predicted_step_ms(
+                    spec, padded_members, padded_rows, prec
+                ),
+                device_ms=round(device_s * 1000.0, 3),
             )
             # link back to every request span this batch coalesced, with
             # the per-request queue wait — the causal edge that makes a
@@ -387,6 +449,32 @@ class ServeEngine:
             except Exception:  # noqa: BLE001 - metrics are advisory
                 pass
 
+    def _predicted_step_ms(
+        self, spec, members: int, rows: int, prec: str
+    ) -> float:
+        """The cost model's predicted device milliseconds for one fused
+        batch at this ladder shape and precision, cached per shape (the
+        planner's estimator is pure arithmetic, but the batch path runs
+        at request rates). -1.0 when the estimator is unavailable."""
+        key = (spec, members, rows, prec)
+        cached = self._step_predictions.get(key)
+        if cached is None:
+            try:
+                from ..planner.costmodel import CostModel
+
+                cached = round(
+                    CostModel().predict_serve_step_s(spec, members, rows, prec)
+                    * 1000.0,
+                    4,
+                )
+            except Exception:  # noqa: BLE001 - prediction is telemetry,
+                # never the batch path's problem
+                cached = -1.0
+            if len(self._step_predictions) > 4096:
+                self._step_predictions.clear()
+            self._step_predictions[key] = cached
+        return cached
+
     # -- warmup -------------------------------------------------------------
 
     def warmup_collection(
@@ -394,7 +482,10 @@ class ServeEngine:
     ) -> Dict[str, Any]:
         """Load the revision's models and precompile its fused programs
         at every ladder shape a request could hit (rows capped at
-        ``warmup_max_rows`` — taller rungs compile on first use)."""
+        ``warmup_max_rows`` — taller rungs compile on first use), at
+        each spec's ACTIVE serving precision: the precision-parity gate
+        runs here, off the request path, so the first real reduced-
+        precision request finds both a verdict and a warm program."""
         from ..server.fleet_store import STORE
 
         fleet = STORE.fleet(collection_dir)
@@ -402,10 +493,9 @@ class ServeEngine:
         return self.warmup_fleet(fleet)
 
     def warmup_fleet(self, fleet) -> Dict[str, Any]:
-        from ..server.fleet_store import fleet_forward_gather, use_pallas
+        from ..server.fleet_store import fleet_forward_gather, serving_backend
 
         start = time.monotonic()
-        backend = "pallas" if use_pallas() else "xla"
         warm_rows = [
             rung
             for rung in self.config.row_ladder
@@ -418,15 +508,28 @@ class ServeEngine:
         }
         compiled = 0
         for spec in sorted(specs, key=repr):
+            # the gate decides which precision this spec's ladder warms:
+            # a passed gate warms the reduced programs, a failed one
+            # warms the f32 programs the degraded traffic will hit
+            desired = precision.resolve_precision(spec, self.config.precision)
+            prec = (
+                self.governor.effective_precision(
+                    fleet, spec, desired, recorder=self._recorder
+                )
+                if desired != precision.F32
+                else precision.F32
+            )
+            backend = serving_backend(prec)
             try:
-                bucket_names, stacked = fleet.spec_bucket(spec)
+                bucket_names, stacked = fleet.spec_bucket(spec, prec)
             except KeyError:
                 continue
             n_bucket = len(bucket_names)
+            dtype = precision.payload_dtype(prec)
             for padded_members in self.member_ladder:
                 indices = np.arange(padded_members, dtype=np.int32) % n_bucket
                 for padded_rows in warm_rows:
-                    program = (spec, backend, padded_members, padded_rows)
+                    program = (spec, backend, padded_members, padded_rows, prec)
                     with self._lock:
                         new = program not in self._programs
                         if new:
@@ -434,15 +537,20 @@ class ServeEngine:
                     if not new:
                         continue
                     X = np.zeros(
-                        (padded_members, padded_rows, spec.n_features), np.float32
+                        (padded_members, padded_rows, spec.n_features), dtype
                     )
                     with self._recorder.span(
                         "warmup_program",
                         padded_members=padded_members,
                         padded_rows=padded_rows,
+                        precision=prec,
                     ):
-                        np.asarray(fleet_forward_gather(spec, stacked, indices, X))
-                    note_program_execution(True, kind="serve")
+                        np.asarray(
+                            fleet_forward_gather(
+                                spec, stacked, indices, X, precision=prec
+                            )
+                        )
+                    note_program_execution(True, kind="serve", precision=prec)
                     compiled += 1
         self._count("warmup_programs", compiled)
         if self.metrics is not None:
@@ -465,13 +573,17 @@ class ServeEngine:
         with self._lock:
             stats = dict(self._counters)
             stats["programs"] = len(self._programs)
+            stats["precision"] = {
+                "config": self.config.precision,
+                "coalesced": dict(self._precision_counters),
+            }
         stats["pending"] = self._batcher.pending()
         return stats
 
     def program_shapes(self) -> List[Tuple]:
         with self._lock:
             return sorted(
-                (repr(s), b, m, r) for (s, b, m, r) in self._programs
+                (repr(s), b, m, r, p) for (s, b, m, r, p) in self._programs
             )
 
     def shutdown(self, drain: bool = True) -> None:

@@ -1,0 +1,539 @@
+"""
+The serving precision ladder: per-revision bf16 / int8 inference programs
+behind a precision-parity gate.
+
+Every inference program used to run f32. The TPU serving literature
+(PAPERS.md: the Gemma-on-TPU serving case study) puts the real serving
+throughput in reduced precision — bf16 halves the weight bytes each
+fused batch re-reads from HBM, int8 weight-only quantization quarters
+them — so the serve engine's shape ladder gains a **precision axis**:
+
+- the precision *vocabulary* (:data:`PRECISIONS`, :func:`normalize`)
+  and its resolution order — a per-spec ``precision:`` field from the
+  config surface wins, else the ``GORDO_TPU_SERVE_PRECISION`` knob,
+  else ``f32`` (the default, byte-identical to the pre-precision
+  serving path);
+- *casting* (:func:`cast_bucket_params`): the revision's resident f32
+  bucket is cast (bf16) or per-channel weight-quantized (int8) ONCE at
+  fleet load and cached on the :class:`RevisionFleet` COW maps — never
+  per request;
+- the *parity gate* (:func:`evaluate_parity`, :class:`PrecisionGovernor`):
+  a reduced-precision bucket only serves after its anomaly verdicts
+  agree with f32 within tolerance on a deterministic probe window; a
+  failed gate **degrades that bucket to f32** (logged + counted, never
+  an error). The verdict-agreement math (:func:`recon_agreement` /
+  :func:`verdict_agreement`) is shared with the lifecycle canary gate
+  (``lifecycle/gates.py``) and the f32-vs-bf16 model parity tests.
+
+Dtype contract (mirrors models/nn.py): weights and activations run at
+the serving precision, the program OUTPUT is always float32 — the
+DiffBased threshold/confidence math downstream never sees a reduced
+dtype.
+"""
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..planner.costmodel import PRECISION_ALIASES as _ALIASES
+from ..utils.env import env_bool, env_float, env_int, env_str
+
+logger = logging.getLogger(__name__)
+
+PRECISION_ENV = "GORDO_TPU_SERVE_PRECISION"
+GATE_ENV = "GORDO_TPU_PRECISION_GATE"
+
+#: the serving precision ladder, widest first; ``f32`` is the default
+#: and the degrade target. ``int8`` is per-channel weight-only
+#: quantization (activations run bf16) and is EXPERIMENTAL. The alias
+#: vocabulary is owned by ``planner.costmodel`` (the lowest layer that
+#: speaks precision — planner may not import serve) so the engine and
+#: the cost model can never disagree about a precision's name.
+PRECISIONS: Tuple[str, ...] = ("f32", "bf16", "int8")
+
+F32 = "f32"
+
+#: (raw value) spellings already warned about — malformed knob values
+#: warn once, not once per request
+_warned: set = set()
+
+
+def normalize(value: Optional[str], default: str = F32) -> str:
+    """The canonical precision name for ``value`` (``float32`` → ``f32``,
+    ``bfloat16`` → ``bf16``, ...); unknown spellings warn once and fall
+    back to ``default`` — a typo'd knob must degrade to f32, never take
+    the serving path down."""
+    if not value:
+        return default
+    name = _ALIASES.get(str(value).strip().lower())
+    if name is None:
+        if value not in _warned:
+            _warned.add(value)
+            logger.warning(
+                "Unknown serving precision %r; using %r (known: %s)",
+                value,
+                default,
+                "/".join(PRECISIONS),
+            )
+        return default
+    return name
+
+
+def serve_precision() -> str:
+    """The process-default serving precision
+    (``GORDO_TPU_SERVE_PRECISION``, default ``f32``)."""
+    return normalize(env_str(PRECISION_ENV, F32))
+
+
+def resolve_precision(spec: Any, default: Optional[str] = None) -> str:
+    """The precision ``spec`` serves at: the spec's own ``precision:``
+    field (the config surface — set via the model factory kwarg) wins;
+    an unset field inherits ``default`` (the engine's configured knob,
+    else the env)."""
+    if default is None:
+        default = serve_precision()
+    declared = getattr(spec, "precision", "")
+    return normalize(declared, normalize(default)) if declared else normalize(default)
+
+
+def gate_enabled() -> bool:
+    """The parity gate master switch (``GORDO_TPU_PRECISION_GATE``,
+    default ON — reduced precision must EARN traffic)."""
+    return env_bool(GATE_ENV, True)
+
+
+# -- payload dtypes -----------------------------------------------------------
+
+_payload_dtypes: Dict[str, Any] = {}
+
+
+def payload_dtype(precision: str = F32):
+    """
+    The numpy dtype request payloads are staged in for one precision —
+    THE one place the serve engine derives its stack/padding dtypes
+    from, so the batch path cannot silently upcast a reduced-precision
+    program's inputs: ``f32`` → float32; ``bf16`` and ``int8``
+    (activations run bf16 under weight-only quantization) → ml_dtypes'
+    bfloat16, halving the host-side stack and the host→device transfer.
+    Falls back to float32 when the bfloat16 numpy dtype is unavailable
+    (the device program casts its inputs either way).
+    """
+    precision = normalize(precision)
+    cached = _payload_dtypes.get(precision)
+    if cached is not None:
+        return cached
+    dtype = np.float32
+    if precision in ("bf16", "int8"):
+        try:
+            import ml_dtypes
+
+            dtype = np.dtype(ml_dtypes.bfloat16)
+        except Exception:  # noqa: BLE001 - optional fast path only
+            dtype = np.float32
+    _payload_dtypes[precision] = dtype
+    return dtype
+
+
+# -- bucket casting / quantization -------------------------------------------
+
+
+def cast_bucket_params(stacked: Any, precision: str):
+    """
+    One revision bucket's stacked f32 params at ``precision``: bf16 is a
+    whole-tree cast; int8 replaces every weight matrix with a per-member,
+    per-output-channel symmetric quantization (``W ≈ Wq * scale``, Wq
+    int8, scale f32 ``[..., 1, d_out]``) while biases stay f32. Runs
+    once per (revision, spec, precision) at fleet load — the result is
+    cached on the RevisionFleet, never rebuilt per request.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    # strict: this is an internal API handed already-normalized names;
+    # silently serving f32 for a typo here would mask an engine bug
+    requested = precision
+    precision = _ALIASES.get(str(precision).strip().lower())
+    if precision is None:
+        raise ValueError(f"unknown serving precision {requested!r}")
+    if precision == F32:
+        return stacked
+    if precision == "bf16":
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16), stacked
+        )
+    if precision == "int8":
+        quantized = {}
+        for layer, leaves in stacked.items():
+            W = jnp.asarray(leaves["W"], jnp.float32)
+            # symmetric per-channel scales over the input axis; the
+            # tiny clamp keeps a dead (all-zero) channel from minting
+            # NaNs out of 0/0
+            scale = jnp.max(jnp.abs(W), axis=-2, keepdims=True) / 127.0
+            scale = jnp.maximum(scale, 1e-12)
+            quantized[layer] = {
+                "W": jnp.clip(jnp.round(W / scale), -127, 127).astype(jnp.int8),
+                "scale": scale,
+                "b": jnp.asarray(leaves["b"], jnp.float32),
+            }
+        return quantized
+    raise ValueError(f"unknown serving precision {precision!r}")
+
+
+def forward_feedforward_quantized(spec: Any, params: Dict, x):
+    """
+    The int8 weight-quantized serving forward for ONE member (the fused
+    program vmaps it over the gathered bucket): weights dequantize in
+    registers (``Wq * scale`` in bf16), activations run bf16, output is
+    float32 — the same output contract as every other serving program.
+    Inference-only: no activity penalty (mirrors the Pallas kernel).
+    """
+    import jax.numpy as jnp
+
+    from ..ops.activations import resolve_activation
+
+    compute = jnp.bfloat16
+    h = x.astype(compute)
+    for i in range(len(spec.dims)):
+        layer = params[f"dense_{i}"]
+        W = layer["W"].astype(compute) * layer["scale"].astype(compute)
+        h = resolve_activation(spec.activations[i])(
+            h @ W + layer["b"].astype(compute)
+        )
+    out_layer = params["out"]
+    W = out_layer["W"].astype(compute) * out_layer["scale"].astype(compute)
+    out = h @ W + out_layer["b"].astype(compute)
+    return resolve_activation(spec.out_activation)(out).astype(jnp.float32)
+
+
+# -- parity math (shared with lifecycle gates and the model parity tests) ----
+
+
+@dataclass
+class ParityConfig:
+    """Precision-parity gate knobs, env-overridable (``from_env``)."""
+
+    #: minimum per-member verdict/row agreement fraction
+    agreement: float = 0.98
+    #: relative tolerance for the reconstruction-closeness fallback
+    #: (members without a fitted detector threshold); the absolute floor
+    #: is 1% of reconstruction space — near-zero rows otherwise read
+    #: bf16's last-place noise as divergence
+    rtol: float = 0.05
+    atol: float = 0.01
+    #: probe window height (rows scored per member)
+    probe_rows: int = 128
+
+    @classmethod
+    def from_env(cls) -> "ParityConfig":
+        return cls(
+            agreement=env_float("GORDO_TPU_GATE_PRECISION_AGREEMENT", 0.98),
+            rtol=env_float("GORDO_TPU_GATE_PRECISION_RTOL", 0.05),
+            probe_rows=max(8, env_int("GORDO_TPU_GATE_PRECISION_PROBE_ROWS", 128)),
+        )
+
+
+def recon_agreement(
+    recon_a: np.ndarray,
+    recon_b: np.ndarray,
+    rtol: float = 0.05,
+    atol: float = 1e-3,
+) -> Dict[str, Any]:
+    """
+    Row-wise closeness of two reconstructions of the SAME input: the
+    fraction of rows whose max absolute difference stays within
+    ``atol + rtol * row magnitude``. This is the tolerance-based
+    f32-vs-bf16 parity check (it replaced the seed-luck convergence
+    assert the bf16 suite used to carry) and the gate's fallback for
+    members without a fitted anomaly threshold.
+    """
+    a = np.asarray(recon_a, np.float64)
+    b = np.asarray(recon_b, np.float64)
+    if a.shape != b.shape:
+        return {"mode": "recon", "agreement": 0.0, "rows": 0,
+                "detail": f"shape mismatch {a.shape} vs {b.shape}"}
+    if a.ndim == 1:
+        a, b = a[:, None], b[:, None]
+    # leading axes (e.g. a stacked [members, rows, features] batch)
+    # flatten into one row axis: a "row" is one feature vector
+    a = a.reshape(-1, a.shape[-1])
+    b = b.reshape(-1, b.shape[-1])
+    diff = np.abs(a - b).max(axis=-1)
+    budget = atol + rtol * np.abs(a).max(axis=-1)
+    rows = int(diff.shape[0])
+    agree = int(np.count_nonzero(diff <= budget))
+    return {
+        "mode": "recon",
+        "agreement": round(agree / rows, 6) if rows else 1.0,
+        "rows": rows,
+        "max_diff": round(float(diff.max()), 6) if rows else 0.0,
+    }
+
+
+def verdict_agreement(
+    recon_a: np.ndarray,
+    recon_b: np.ndarray,
+    y: np.ndarray,
+    scaler: Any = None,
+    threshold: Optional[float] = None,
+    rtol: float = 0.05,
+    atol: float = 1e-3,
+) -> Dict[str, Any]:
+    """
+    Anomaly-VERDICT agreement between two reconstructions: each is
+    turned into the DiffBased detector's per-row scaled mse (f32 math —
+    thresholds and anomaly arithmetic never run reduced) and compared
+    against ``threshold``; agreement is the fraction of rows whose
+    anomalous/normal verdict matches. Falls back to
+    :func:`recon_agreement` when there is no scaler/threshold to take a
+    verdict from.
+    """
+    if scaler is None or not threshold or threshold <= 0:
+        return recon_agreement(recon_a, recon_b, rtol=rtol, atol=atol)
+    try:
+        scaled_y = np.asarray(scaler.transform(y), np.float64)
+        scaled_a = np.asarray(scaler.transform(recon_a), np.float64)
+        scaled_b = np.asarray(scaler.transform(recon_b), np.float64)
+    except Exception:  # noqa: BLE001 - an unfit/odd scaler: fall back to
+        # the thresholdless closeness check rather than failing the gate
+        # on gate machinery
+        return recon_agreement(recon_a, recon_b, rtol=rtol, atol=atol)
+    mse_a = np.mean(np.square(scaled_a - scaled_y), axis=1)
+    mse_b = np.mean(np.square(scaled_b - scaled_y), axis=1)
+    verdict_a = mse_a > threshold
+    verdict_b = mse_b > threshold
+    rows = int(len(mse_a))
+    agree = int(np.count_nonzero(verdict_a == verdict_b))
+    return {
+        "mode": "verdict",
+        "agreement": round(agree / rows, 6) if rows else 1.0,
+        "rows": rows,
+        "flagged_f32": int(np.count_nonzero(verdict_a)),
+        "flagged_reduced": int(np.count_nonzero(verdict_b)),
+    }
+
+
+def _probe_rows(model: Any, n_features: int, rows: int, seed: int) -> np.ndarray:
+    """A deterministic probe window in model-input space: uniform inside
+    the detector scaler's learned data range when one is fit (in-
+    distribution rows make the verdict comparison meaningful), else
+    standard normal. Seeded per member — the gate's answer for a given
+    revision never depends on evaluation order."""
+    rng = np.random.default_rng(seed)
+    scaler = getattr(model, "scaler", None)
+    lo = getattr(scaler, "data_min_", None)
+    hi = getattr(scaler, "data_max_", None)
+    if lo is not None and hi is not None and len(lo) == n_features:
+        lo = np.asarray(lo, np.float64)
+        hi = np.asarray(hi, np.float64)
+        span = np.where(hi > lo, hi - lo, 1.0)
+        return (lo + rng.random((rows, n_features)) * span).astype(np.float32)
+    return rng.standard_normal((rows, n_features)).astype(np.float32)
+
+
+def evaluate_parity(
+    fleet: Any,
+    spec: Any,
+    precision: str,
+    config: Optional[ParityConfig] = None,
+) -> Dict[str, Any]:
+    """
+    The precision-parity gate for one revision's spec bucket: score a
+    deterministic probe window through the f32 bucket AND the
+    ``precision`` bucket (both fused programs — the exact code path a
+    served batch takes) and require every member's anomaly verdicts to
+    agree within tolerance. Returns a JSON-able report
+    (``{"passed": bool, "precision", "agreement_min", "members", ...}``)
+    that the caller caches on the fleet; the fused reduced-precision
+    program compiled here is the same one warmup would mint, so gating
+    doubles as precompilation.
+    """
+    from ..server.fleet_store import _host_transform, fleet_forward_gather
+
+    config = config or ParityConfig.from_env()
+    precision = normalize(precision)
+    report: Dict[str, Any] = {
+        "precision": precision,
+        "spec": type(spec).__name__,
+        "n_features": getattr(spec, "n_features", None),
+        "passed": True,
+        "members": {},
+    }
+    if precision == F32:
+        report["detail"] = "f32 is the reference; nothing to gate"
+        return report
+
+    # ONE consistent membership snapshot: the f32 and the cast bucket
+    # are two separate reads, and a concurrent model load between them
+    # would pair recon_f32[i] with a DIFFERENT member's recon_lp[i]
+    # (verdicts collapse, the gate records a spurious fail). Retake both
+    # until the membership (and the fleet's bucket epoch, when it has
+    # one) agrees across the pair.
+    for _ in range(4):
+        epoch = getattr(fleet, "_bucket_epoch", None)
+        names, stacked = fleet.spec_bucket(spec)
+        cast_names, cast = fleet.spec_bucket(spec, precision)
+        if cast_names == names and getattr(fleet, "_bucket_epoch", None) == epoch:
+            break
+    else:
+        raise RuntimeError(
+            "bucket membership kept changing during parity evaluation"
+        )
+    report["bucket_epoch"] = epoch
+    rows = int(config.probe_rows)
+    payloads = []
+    models = []
+    probes = []
+    for i, name in enumerate(names):
+        model = fleet.model(name)
+        probe = _probe_rows(model, spec.n_features, rows, seed=i + 1)
+        transformed = _host_transform(model, probe)
+        if transformed.shape != (rows, spec.n_features):
+            # a row/width-changing host pipeline: probe in transformed
+            # space directly so every member still stacks to one shape
+            transformed = np.asarray(
+                np.random.default_rng(i + 1).standard_normal(
+                    (rows, spec.n_features)
+                ),
+                np.float32,
+            )
+            probe = transformed
+        payloads.append(transformed)
+        probes.append(probe)
+        models.append(model)
+
+    indices = np.arange(len(names), dtype=np.int32)
+    X32 = np.stack(payloads).astype(np.float32)
+    Xlp = X32.astype(payload_dtype(precision))
+    recon_f32 = np.asarray(fleet_forward_gather(spec, stacked, indices, X32))
+    recon_lp = np.asarray(
+        fleet_forward_gather(spec, cast, indices, Xlp, precision=precision)
+    )
+
+    agreements = []
+    for i, name in enumerate(names):
+        model = models[i]
+        y = probes[i]
+        threshold = getattr(model, "aggregate_threshold_", None)
+        scaler = getattr(model, "scaler", None)
+        a, b = recon_f32[i], recon_lp[i]
+        if y.shape[-1] != a.shape[-1]:
+            member = recon_agreement(a, b, rtol=config.rtol, atol=config.atol)
+        else:
+            member = verdict_agreement(
+                a, b, y, scaler=scaler,
+                threshold=float(threshold) if threshold else None,
+                rtol=config.rtol, atol=config.atol,
+            )
+        if not np.all(np.isfinite(b)):
+            member["agreement"] = 0.0
+            member["detail"] = "non-finite reduced-precision output"
+        report["members"][name] = member
+        agreements.append(member["agreement"])
+
+    report["agreement_min"] = min(agreements) if agreements else 1.0
+    report["agreement_threshold"] = config.agreement
+    report["probe_rows"] = rows
+    if report["agreement_min"] < config.agreement:
+        report["passed"] = False
+        worst = min(report["members"], key=lambda n: report["members"][n]["agreement"])
+        report["detail"] = (
+            f"{precision} verdicts diverge from f32: member {worst} agrees "
+            f"on {report['members'][worst]['agreement']:.2%} of the probe "
+            f"window (gate {config.agreement:.2%})"
+        )
+    return report
+
+
+# -- the governor: gate-then-serve, degrade on failure ------------------------
+
+
+class PrecisionGovernor:
+    """
+    The serve engine's precision arbiter: the first time a (revision
+    fleet, spec, precision) combination is requested it runs
+    :func:`evaluate_parity`, caches the verdict on the fleet's COW
+    state map, and from then on answers with one dict probe. A FAILED
+    gate degrades that bucket to f32 — requests keep flowing, nothing
+    5xxes — and the degrade is visible in the engine counters, the
+    batch spans and the gate report on the fleet.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()  # guards the per-key lock registry
+        #: (id(fleet), spec, precision) -> evaluation lock: gating one
+        #: bucket (probe compiles + scoring, seconds on first touch)
+        #: must not convoy every OTHER fleet/spec's first request behind
+        #: one process-wide lock
+        self._evaluating: Dict[Tuple, threading.Lock] = {}
+
+    def effective_precision(
+        self, fleet: Any, spec: Any, desired: str, recorder: Any = None
+    ) -> str:
+        desired = normalize(desired)
+        if desired == F32:
+            return F32
+        if not gate_enabled():
+            return desired
+        state = fleet.precision_state(spec, desired)
+        if state is None:
+            key = (id(fleet), spec, desired)
+            with self._lock:
+                key_lock = self._evaluating.setdefault(key, threading.Lock())
+            with key_lock:  # one evaluation per bucket, however many threads
+                state = fleet.precision_state(spec, desired)
+                if state is None:
+                    state = self._evaluate(fleet, spec, desired, recorder)
+            with self._lock:
+                self._evaluating.pop(key, None)
+        return desired if state.get("passed") else F32
+
+    def _evaluate(self, fleet, spec, precision: str, recorder) -> Dict[str, Any]:
+        try:
+            report = evaluate_parity(fleet, spec, precision)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as exc:  # noqa: BLE001 - an unevaluable gate is a
+            # failed gate (degrade to f32), never a crashed request
+            report = {
+                "precision": normalize(precision),
+                "passed": False,
+                "detail": f"parity evaluation crashed: {exc!r}",
+            }
+        # stamp the verdict with the membership epoch it was EVALUATED
+        # at (a member loading mid-evaluation bumps the epoch and the
+        # verdict reads as absent → next request re-gates)
+        fleet.set_precision_state(
+            spec, precision, report, epoch=report.get("bucket_epoch")
+        )
+        if report.get("passed"):
+            logger.info(
+                "precision gate PASSED: %s serving at %s "
+                "(verdict agreement >= %.2f%% on %s members)",
+                fleet.collection_dir,
+                report["precision"],
+                100.0 * report.get("agreement_min", 1.0),
+                len(report.get("members", {})),
+            )
+        else:
+            logger.warning(
+                "precision gate FAILED for %s at %s — degrading to f32: %s",
+                fleet.collection_dir,
+                report["precision"],
+                report.get("detail", "verdict divergence"),
+            )
+        if recorder is not None:
+            try:
+                recorder.event(
+                    "precision_gate",
+                    collection_dir=fleet.collection_dir,
+                    precision=report["precision"],
+                    passed=bool(report.get("passed")),
+                    agreement_min=report.get("agreement_min"),
+                    detail=report.get("detail", ""),
+                )
+            except Exception:  # noqa: BLE001 - telemetry is advisory
+                pass
+        return report

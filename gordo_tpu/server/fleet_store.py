@@ -189,6 +189,18 @@ class RevisionFleet:
         self._resolutions: Dict[str, ModelResolution] = {}
         #: spec -> (names, stacked params, epoch stamped at build)
         self._stacked: Dict[Any, Tuple[List[str], Any, int]] = {}
+        #: (spec, precision) -> (names, cast/quantized params, epoch):
+        #: reduced-precision copies of the f32 buckets, cast ONCE at
+        #: fleet load (serve.precision.cast_bucket_params) — the serve
+        #: engine's precision ladder reads these per batch, never
+        #: re-casts per request. Mutated only under the lock, like
+        #: _stacked.
+        self._cast_buckets: Dict[Tuple[Any, str], Tuple[List[str], Any, int]] = {}
+        #: (spec, precision) -> precision-parity gate report (COW, same
+        #: discipline as _models): the serve engine's governor caches
+        #: pass/fail verdicts here, so gate state lives and dies with
+        #: the revision fleet — a hot-swap or DELETE re-gates naturally.
+        self._precision_states: Dict[Tuple[Any, str], Dict[str, Any]] = {}
         self._bucket_epoch = 0  # bumped on every membership change
 
     # -- single-model serving ------------------------------------------------
@@ -218,6 +230,10 @@ class RevisionFleet:
                 specs[name] = estimator.spec_
                 self._specs = specs
                 self._stacked.pop(estimator.spec_, None)  # bucket grew; restack
+                for key in [
+                    k for k in self._cast_buckets if k[0] == estimator.spec_
+                ]:
+                    self._cast_buckets.pop(key, None)  # recast with the bucket
                 self._bucket_epoch += 1
         return model
 
@@ -270,7 +286,7 @@ class RevisionFleet:
 
     # -- fused fleet scoring -------------------------------------------------
 
-    def spec_bucket(self, spec) -> Tuple[List[str], Any]:
+    def spec_bucket(self, spec, precision: str = "f32") -> Tuple[List[str], Any]:
         """
         The (names, stacked device params) bucket for one spec (feedforward
         or LSTM), built from every loaded model of that spec. Restacked
@@ -278,9 +294,16 @@ class RevisionFleet:
         stacking work (host round-trip of every member's params) runs
         OUTSIDE the store lock so concurrent single-model serving never
         stalls behind it.
+
+        ``precision`` other than ``f32`` answers the bucket's cast
+        (bf16) or weight-quantized (int8) copy, derived from the f32
+        master bucket once per (spec, precision) and cached for the
+        revision's lifetime (:meth:`_cast_bucket`).
         """
         from ..parallel.fleet import stack_member_params
 
+        if precision and precision != "f32":
+            return self._cast_bucket(spec, precision)
         with self._lock:
             cached = self._stacked.get(spec)
             epoch = self._bucket_epoch
@@ -323,6 +346,77 @@ class RevisionFleet:
 
     #: retained name from before LSTM buckets existed (r3 API)
     feedforward_bucket = spec_bucket
+
+    def _cast_bucket(self, spec, precision: str) -> Tuple[List[str], Any]:
+        """The reduced-precision copy of one spec bucket: cast/quantized
+        from the f32 master ONCE per (spec, precision) per membership
+        epoch. The cast work (a whole-tree device op) runs outside the
+        lock, mirroring :meth:`spec_bucket`'s stacking discipline."""
+        from ..serve.precision import cast_bucket_params
+
+        with self._lock:
+            cached = self._cast_buckets.get((spec, precision))
+            epoch = self._bucket_epoch
+            if cached is not None and cached[2] == epoch:
+                return cached[0], cached[1]
+        names, stacked = self.spec_bucket(spec)
+        cast = cast_bucket_params(stacked, precision)
+        with self._lock:
+            # a membership change since our snapshot means the next call
+            # recasts against the fresh f32 bucket (same rule as
+            # spec_bucket's concurrent-stacker contract)
+            if self._bucket_epoch == epoch:
+                self._cast_buckets[(spec, precision)] = (names, cast, epoch)
+        return names, cast
+
+    # -- precision-parity gate state -----------------------------------------
+
+    def precision_state(self, spec, precision: str) -> Optional[Dict[str, Any]]:
+        """The cached precision-parity gate report for (spec,
+        ``precision``), or None when ungated — INCLUDING when the
+        bucket's membership changed since the verdict was taken (states
+        are epoch-stamped like the cast buckets: a PASS gated on the
+        old membership must not let a later-loaded member serve reduced
+        unverified, and a racy FAIL must not stick forever). Lock-free
+        COW read — this sits on the per-request serving path (the
+        engine's governor probes it per batched request)."""
+        entry = self._precision_states.get((spec, precision))
+        if entry is None:
+            return None
+        report, epoch = entry
+        return report if epoch == self._bucket_epoch else None
+
+    def set_precision_state(
+        self,
+        spec,
+        precision: str,
+        report: Dict[str, Any],
+        epoch: Optional[int] = None,
+    ):
+        """Record a gate verdict (COW replace under the lock, like every
+        other serving map), stamped with the membership epoch the
+        verdict was EVALUATED at (``epoch``; default: current) — a
+        verdict taken against an older membership must read as absent,
+        not as a fresh PASS/FAIL. The state is revision-fleet-scoped by
+        construction: a hot-swapped or invalidated revision drops its
+        fleet object, verdicts and all, and the replacement re-gates."""
+        with self._lock:
+            states = dict(self._precision_states)
+            states[(spec, precision)] = (
+                report,
+                self._bucket_epoch if epoch is None else epoch,
+            )
+            self._precision_states = states
+
+    def precision_reports(self) -> List[Dict[str, Any]]:
+        """Every LIVE cached gate report (current-epoch verdicts only —
+        for the engine stats / fleet-status surface)."""
+        epoch = self._bucket_epoch
+        return [
+            report
+            for report, stamped in self._precision_states.values()
+            if stamped == epoch
+        ]
 
     def loaded_specs(self) -> Dict[str, Any]:
         """The name -> spec map of the loaded JAX models. The returned
@@ -512,22 +606,37 @@ def use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def serving_backend(precision: str = "f32") -> str:
+    """The fused-program backend for one serving precision: the Pallas
+    kernel serves the f32 path on TPU; reduced-precision programs run
+    the XLA vmapped forward everywhere (bf16 hits the MXU natively
+    through XLA; a reduced-precision Pallas kernel is a follow-up —
+    dtype tiling differs, see the Pallas guide's tiling table)."""
+    if precision and precision != "f32":
+        return "xla"
+    return "pallas" if use_pallas() else "xla"
+
+
 def fleet_forward(spec: FeedForwardSpec, stacked_params, X: np.ndarray):
     """
     The fused fleet forward ``X[M, B, F] -> [M, B, F_out]``: Pallas kernel
     on TPU (whole layer stack per grid step, activations in VMEM —
     ops/pallas_dense.py), XLA vmap elsewhere. Both paths share ONE cached
-    program table keyed by (spec, backend) so serving requests hit a
-    compiled program and cache growth is observable in one place
+    program table keyed by (spec, backend, precision) so serving requests
+    hit a compiled program and cache growth is observable in one place
     (``program_cache_stats`` / the ``gordo_server_program_cache_size``
     Prometheus gauge).
     """
-    backend = "pallas" if use_pallas() else "xla"
-    return _fleet_forward_program(spec, backend, gather=False)(stacked_params, X)
+    backend = serving_backend()
+    return _fleet_forward_program(spec, backend, False, "f32")(stacked_params, X)
 
 
 def fleet_forward_gather(
-    spec: FeedForwardSpec, stacked_params, indices: np.ndarray, X: np.ndarray
+    spec: FeedForwardSpec,
+    stacked_params,
+    indices: np.ndarray,
+    X: np.ndarray,
+    precision: str = "f32",
 ):
     """
     The fused gather+forward the micro-batcher runs:
@@ -539,10 +648,16 @@ def fleet_forward_gather(
     micro-batch rates dominates the fused forward itself. The jit
     signature includes the bucket's member count, which is fixed per
     revision, so the executable count per spec stays bounded by the serve
-    shape ladder.
+    shape ladder (now ``× |precisions in use|``).
+
+    ``precision`` selects the reduced-precision program variant; the
+    caller passes the MATCHING bucket (``spec_bucket(spec, precision)``)
+    — bf16 weights for the bf16 program, the quantized pytree for int8.
+    Output is float32 at every precision (the dtype contract).
     """
-    backend = "pallas" if use_pallas() else "xla"
-    return _fleet_forward_program(spec, backend, gather=True)(
+    precision = precision or "f32"
+    backend = serving_backend(precision)
+    return _fleet_forward_program(spec, backend, True, precision)(
         stacked_params, indices, X
     )
 
@@ -553,27 +668,48 @@ def fleet_forward_gather(
 _program_cache_keys: set = set()
 
 
-def _fleet_forward_program(spec: FeedForwardSpec, backend: str, gather: bool):
-    _program_cache_keys.add((spec, backend, gather))
-    return _build_fleet_forward_program(spec, backend, gather)
+def _fleet_forward_program(
+    spec: FeedForwardSpec, backend: str, gather: bool, precision: str = "f32"
+):
+    _program_cache_keys.add((spec, backend, gather, precision))
+    return _build_fleet_forward_program(spec, backend, gather, precision)
 
 
 @lru_cache(maxsize=None)
 def _build_fleet_forward_program(
-    spec: FeedForwardSpec, backend: str, gather: bool = False
+    spec: FeedForwardSpec,
+    backend: str,
+    gather: bool = False,
+    precision: str = "f32",
 ):
-    """The jitted fused-forward entry for one (spec, backend[, gather]).
-    The lru entry holds the jit wrapper; XLA compiles one executable per
-    input shape INSIDE it (counted by ``program_cache_stats``)."""
+    """The jitted fused-forward entry for one (spec, backend[, gather,
+    precision]). The lru entry holds the jit wrapper; XLA compiles one
+    executable per input shape INSIDE it (counted by
+    ``program_cache_stats``)."""
     if backend == "pallas":
         from ..ops.pallas_dense import fleet_feedforward_pallas
 
         fused = lambda params, X: fleet_feedforward_pallas(spec, params, X)  # noqa: E731
+    elif precision == "int8":
+        from ..serve.precision import forward_feedforward_quantized
+
+        fused = jax.vmap(
+            lambda p, x: forward_feedforward_quantized(spec, p, x)
+        )
     else:
         from ..models.nn import forward_fn_for
 
         forward = forward_fn_for(spec)
-        fused = jax.vmap(lambda p, x: forward(spec, p, x)[0])
+        if precision == "bf16":
+            # the serving spec computes in bf16 whatever the training
+            # compute_dtype was; the forward's own contract keeps the
+            # OUTPUT float32
+            from dataclasses import replace
+
+            run_spec = replace(spec, compute_dtype="bfloat16")
+        else:
+            run_spec = spec
+        fused = jax.vmap(lambda p, x: forward(run_spec, p, x)[0])
     if gather:
 
         def run(params, indices, X):
@@ -586,21 +722,24 @@ def _build_fleet_forward_program(
 
 def program_cache_stats() -> Dict[str, int]:
     """Serving program-cache sizes: ``programs`` is the number of cached
-    (spec, backend) jit entries, ``signatures`` the number of XLA
-    executables compiled inside them (distinct argument shapes) — the
-    number that must stay bounded by the serve shape ladder. A
+    (spec, backend, precision) jit entries, ``signatures`` the number of
+    XLA executables compiled inside them (distinct argument shapes) —
+    the number that must stay bounded by the serve shape ladder. A
     ``signatures`` of -1 means this jax version hides the jit cache."""
     signatures = 0
-    for (spec, backend, gather) in list(_program_cache_keys):
-        program = _build_fleet_forward_program(spec, backend, gather)
+    by_precision: Dict[str, int] = {}
+    for (spec, backend, gather, precision) in list(_program_cache_keys):
+        by_precision[precision] = by_precision.get(precision, 0) + 1
+        program = _build_fleet_forward_program(spec, backend, gather, precision)
         try:
-            signatures += program._cache_size()
+            if signatures >= 0:
+                signatures += program._cache_size()
         except AttributeError:  # jit cache introspection is version-bound
             signatures = -1
-            break
     return {
         "programs": _build_fleet_forward_program.cache_info().currsize,
         "signatures": signatures,
+        "by_precision": by_precision,
     }
 
 

@@ -512,6 +512,12 @@ class ProgramCacheCollector:
         )
         family.add_metric(["programs"], stats["programs"])
         family.add_metric(["signatures"], stats["signatures"])
+        # the precision axis (PR 14): programs per serving precision —
+        # bounded by the declared precision ladder (f32/bf16/int8)
+        for precision, count in sorted(
+            (stats.get("by_precision") or {}).items()
+        ):
+            family.add_metric([f"programs_{precision}"], count)
         yield family
 
 

@@ -688,10 +688,27 @@ def get_fleet_health(ctx, gordo_project: str):
         programs = program_cache_stats()
     except Exception:  # noqa: BLE001 - cache stats are advisory
         programs = None
+    # the serve-engine section: batch counters plus the precision ladder
+    # (per-precision coalesce counts, degrade counter, and the served
+    # revision's cached precision-parity gate reports)
+    serving = None
+    try:
+        from ... import serve
+        from ..fleet_store import STORE
+
+        engine = serve.get_engine()
+        if engine is not None:
+            serving = engine.stats()
+            serving["gates"] = STORE.fleet(
+                STORE.route(directory)
+            ).precision_reports()
+    except Exception:  # noqa: BLE001 - engine stats are advisory
+        pass
     doc = fleet_status_document(
         directory,
         device=utilization_snapshot(),
         programs=programs,
+        serving=serving,
     )
     return ctx.json_response(doc)
 

@@ -153,6 +153,43 @@ GATES: Dict[str, List[MetricSpec]] = {
             0.5,
         ),
     ],
+    "precision-ladder": [
+        MetricSpec(
+            "f32 fused scoring throughput (floor rows/s)",
+            "throughput.f32.rows_per_sec",
+            "higher",
+            0.5,
+        ),
+        # CPU hosts have no bf16/int8 compute units, so parity with f32
+        # is the CEILING there (measured ~0.5x under XLA's emulation) —
+        # these floors exist to catch the reduced paths REGRESSING
+        # (an accidental f64 upcast, a dequant blowup), exactly the
+        # route_batched_vs_unbatched min_bound pattern; the speedup
+        # itself asserts on device hardware.
+        MetricSpec(
+            "bf16 vs f32 fused scoring throughput (ratio)",
+            "ratios.bf16_vs_f32",
+            "min_bound",
+            bound=0.3,
+        ),
+        MetricSpec(
+            "int8 vs f32 fused scoring throughput (ratio)",
+            "ratios.int8_vs_f32",
+            "min_bound",
+            bound=0.25,
+        ),
+        MetricSpec(
+            "reduced-vs-f32 verdict agreement (min across precisions)",
+            "verdict_agreement.min",
+            "min_bound",
+            bound=0.95,
+        ),
+        MetricSpec(
+            "precision-parity gates passed",
+            "parity_gates_passed",
+            "truthy",
+        ),
+    ],
     "slo-engine": [
         MetricSpec(
             "rollup aggregation throughput (spans/s)",
@@ -184,6 +221,7 @@ BASELINE_FILES: Dict[str, str] = {
     "lifecycle-hot-swap": "BENCH_LIFECYCLE.json",
     "fleet-health-overhead": "BENCH_FLEET_HEALTH.json",
     "slo-engine": "BENCH_SLO.json",
+    "precision-ladder": "BENCH_PRECISION.json",
 }
 
 

@@ -64,10 +64,15 @@ _counter_lock = threading.Lock()
 _program_counters: Dict[str, Dict[str, int]] = {}
 
 
-def note_program_execution(compiled: bool, kind: str = "build") -> None:
+def note_program_execution(
+    compiled: bool, kind: str = "build", precision: Optional[str] = None
+) -> None:
     """Count one jit-program execution: ``compiled=True`` for a
     cache-miss (trace+compile happened inside the call), False for a
-    steady-state cache-hit run."""
+    steady-state cache-hit run. ``precision`` (the serve engine's
+    precision ladder: ``f32``/``bf16``/``int8``) additionally buckets
+    the count per serving precision, so the compile-cache console can
+    answer "did the bf16 ladder actually warm" per axis."""
     with _counter_lock:
         counters = _program_counters.get(kind)
         if counters is None:
@@ -76,15 +81,29 @@ def note_program_execution(compiled: bool, kind: str = "build") -> None:
                 "cache_hits": 0,
             }
         counters["compiles" if compiled else "cache_hits"] += 1
+        if precision:
+            by_precision = counters.setdefault("by_precision", {})
+            sub = by_precision.setdefault(
+                precision, {"compiles": 0, "cache_hits": 0}
+            )
+            sub["compiles" if compiled else "cache_hits"] += 1
 
 
 def program_cache_counters() -> Dict[str, Dict[str, Any]]:
     """Snapshot of the per-kind compile/cache-hit counters, each with a
-    derived ``hit_rate`` (None until anything executed)."""
+    derived ``hit_rate`` (None until anything executed); the serve
+    kind's per-precision sub-counters ride along under
+    ``by_precision``."""
     with _counter_lock:
-        snapshot = {
-            kind: dict(counters) for kind, counters in _program_counters.items()
-        }
+        snapshot = {}
+        for kind, counters in _program_counters.items():
+            copied = dict(counters)
+            if "by_precision" in copied:
+                copied["by_precision"] = {
+                    prec: dict(sub)
+                    for prec, sub in copied["by_precision"].items()
+                }
+            snapshot[kind] = copied
     for counters in snapshot.values():
         total = counters["compiles"] + counters["cache_hits"]
         counters["hit_rate"] = (

@@ -810,6 +810,7 @@ def fleet_status_document(
     directory: str,
     device: Optional[Dict[str, Any]] = None,
     programs: Optional[Dict[str, Any]] = None,
+    serving: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """
     The one joined operator view over a build+serve directory:
@@ -824,6 +825,9 @@ def fleet_status_document(
     - ``device`` — injected device-utilization stats (memory +
       compile-cache counters; ``telemetry.device.utilization_snapshot``)
     - ``programs`` — injected serving program-cache stats.
+    - ``serving`` — injected serve-engine stats (batch/shed counters and
+      the precision ladder: per-precision coalesce counts, degrade
+      counter, cached precision-parity gate reports).
 
     Sections degrade to None independently: a build dir with no
     lifecycle state still joins, a serve dir with no plan still joins.
@@ -901,6 +905,7 @@ def fleet_status_document(
     doc["slo"] = slo_section(slo_directory(directory) or directory)
     doc["device"] = device
     doc["programs"] = programs
+    doc["serving"] = serving
     return doc
 
 
@@ -1051,4 +1056,46 @@ def render_fleet_status(doc: Dict[str, Any]) -> str:
             f"{'y' if programs.get('programs', 0) == 1 else 'ies'}, "
             f"{programs.get('signatures', 0)} compiled signature(s)"
         )
+        by_precision = programs.get("by_precision")
+        if by_precision:
+            lines.append(
+                "  by precision: "
+                + ", ".join(
+                    f"{prec}={count}"
+                    for prec, count in sorted(by_precision.items())
+                )
+            )
+    serving = doc.get("serving")
+    if serving:
+        precision = serving.get("precision") or {}
+        coalesced = precision.get("coalesced") or {}
+        gates = [
+            g for g in serving.get("gates", []) if isinstance(g, dict)
+        ]
+        lines.append(
+            f"Serving:   precision={precision.get('config', 'f32')}"
+            + (
+                " — coalesced "
+                + ", ".join(
+                    f"{p}={n}" for p, n in sorted(coalesced.items())
+                )
+                if coalesced
+                else ""
+            )
+            + (
+                f", {serving.get('precision_degraded', 0)} degraded req(s)"
+                if serving.get("precision_degraded")
+                else ""
+            )
+        )
+        for gate in gates:
+            lines.append(
+                f"  gate {gate.get('precision')}: "
+                f"{'PASS' if gate.get('passed') else 'FAIL — degraded to f32'}"
+                + (
+                    f" (agreement {gate.get('agreement_min'):.4f})"
+                    if gate.get("agreement_min") is not None
+                    else ""
+                )
+            )
     return "\n".join(lines)

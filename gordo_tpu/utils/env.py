@@ -422,6 +422,23 @@ KNOBS: Dict[str, Knob] = _knobs(
         "Serving",
     ),
     Knob(
+        "GORDO_TPU_SERVE_PRECISION", "str", "f32",
+        "Default serving precision for the fused batch programs: `f32` "
+        "(default, byte-identical to pre-precision serving), `bf16`, or "
+        "`int8` (experimental per-channel weight quantization; "
+        "activations run bf16). A spec's own `precision:` field "
+        "overrides per model; reduced precision only serves behind a "
+        "passed precision-parity gate and degrades to f32 on failure.",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_PRECISION_GATE", "bool", True,
+        "Gate reduced-precision serving on f32 verdict parity "
+        "(`gordo_tpu.serve.precision`); off serves the requested "
+        "precision ungated (benches/tests).",
+        "Serving",
+    ),
+    Knob(
         "GORDO_TPU_WIRE_COLUMNAR", "bool", True,
         "Columnar response fast path on the prediction/anomaly/fleet "
         "routes: vectorized numpy assembly + dict-free wire encoders "
@@ -485,6 +502,25 @@ KNOBS: Dict[str, Knob] = _knobs(
     Knob(
         "GORDO_TPU_GATE_RESIDUAL_RATIO", "float", 2.0,
         "Canary gate: max canary-vs-base residual ratio.",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_GATE_PRECISION_AGREEMENT", "float", 0.98,
+        "Precision-parity gate: minimum reduced-vs-f32 anomaly-verdict "
+        "agreement fraction on the probe window (serve-time bucket "
+        "gating AND the canary promotion gate).",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_GATE_PRECISION_RTOL", "float", 0.05,
+        "Precision-parity gate: relative row tolerance for the "
+        "reconstruction-closeness fallback (members without a fitted "
+        "anomaly threshold).",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_GATE_PRECISION_PROBE_ROWS", "int", 128,
+        "Precision-parity gate: probe window height scored per member.",
         "Lifecycle",
     ),
     Knob(

@@ -71,6 +71,10 @@ case "$component" in
     # The concurrency-contract suite cuts across tests/analysis,
     # tests/server and tests/serve — marker-selected the same way.
     concurrency) run -m "concurrency and not slow" tests/ ;;
+    # The mixed-precision suite cuts across tests/serve, tests/models,
+    # tests/lifecycle, tests/planner and tests/telemetry —
+    # marker-selected like fleet_health/slo/wire/concurrency.
+    precision) run -m "precision and not slow" tests/ ;;
     utils)    run -m "not slow" tests/utils ;;
     workflow) run -m "not slow" tests/workflow ;;
     formatting) run tests/test_codestyle.py ;;

@@ -4,6 +4,14 @@ activations run in bf16 while outputs, losses and thresholds stay
 float32 (the dtype contract in models/nn.py). In the measured HBM-bound
 tiny-model regime bf16 halves the bytes each training step re-reads —
 the bench's fleet stage reports the realized speedup.
+
+Correctness here is PARITY, not convergence: a bf16 model must answer
+(tolerably) what the same-seed f32 model answers. The old assert —
+"bf16 converges past 0.8 EV" — tracked the init seed, not the dtype
+(CHANGES.md: it flipped between seeds with f32 scoring identically),
+so it could fail on a healthy bf16 path and pass on a broken one. The
+tolerance-based check (``gordo_tpu.serve.precision.recon_agreement``)
+is the same math the serving precision-parity gate runs.
 """
 
 import jax.numpy as jnp
@@ -14,6 +22,9 @@ from gordo_tpu.models.estimators import JaxAutoEncoder, JaxLSTMAutoEncoder
 from gordo_tpu.models.factories import feedforward_hourglass, lstm_model
 from gordo_tpu.models.training import FitConfig
 from gordo_tpu.parallel import FleetMember, FleetTrainer
+from gordo_tpu.serve.precision import recon_agreement
+
+pytestmark = pytest.mark.precision
 
 
 @pytest.fixture(scope="module")
@@ -36,15 +47,10 @@ def test_factory_plumbs_compute_dtype():
 
 
 def test_bf16_estimator_trains_and_predicts_float32(sine_data):
-    # seed=1, not the default 42: convergence at a 60-epoch budget tracks
-    # the init seed IDENTICALLY in f32 and bf16 (measured seed 42 → 0.48
-    # for both dtypes; seed 1 → 0.975 for both), so the old failure was
-    # seed luck, not a bf16 defect — this test asserts bf16 converges
-    # like f32 does, and must run from an init where f32 converges.
     model = JaxAutoEncoder(
         kind="feedforward_hourglass",
         compute_dtype="bfloat16",
-        epochs=60,
+        epochs=30,
         batch_size=64,
         seed=1,
     )
@@ -57,15 +63,29 @@ def test_bf16_estimator_trains_and_predicts_float32(sine_data):
     out = model.predict(sine_data)
     # sklearn-facing output is full-precision numpy
     assert np.asarray(out).dtype == np.float32
-    assert model.score(sine_data, sine_data) > 0.8, "bf16 AE failed to converge"
+    assert np.all(np.isfinite(out))
 
 
-def test_bf16_close_to_f32_training(sine_data):
+def test_bf16_tracks_f32_training_within_tolerance(sine_data):
+    """The parity contract: same seed, same budget — the bf16 model's
+    reconstructions agree with the f32 model's row for row within the
+    precision-parity gate's tolerance (the shared ``recon_agreement``
+    helper, NOT an absolute convergence bar that tracks seed luck)."""
     kwargs = dict(kind="feedforward_hourglass", epochs=30, batch_size=64, seed=1)
     f32 = JaxAutoEncoder(**kwargs).fit(sine_data, sine_data)
     bf16 = JaxAutoEncoder(compute_dtype="bfloat16", **kwargs).fit(
         sine_data, sine_data
     )
+    report = recon_agreement(
+        f32.predict(sine_data), bf16.predict(sine_data), rtol=0.1, atol=0.05
+    )
+    # training amplifies rounding differences over 30 epochs of updates,
+    # so the tolerance is looser than the serving gate's (which compares
+    # the SAME weights across dtypes); the overwhelming majority of rows
+    # must still agree
+    assert report["agreement"] >= 0.95, report
+    # and the two models' answers stay in the same EV neighborhood —
+    # relative parity, never an absolute convergence assert
     ev_f32 = f32.score(sine_data, sine_data)
     ev_bf16 = bf16.score(sine_data, sine_data)
     assert ev_bf16 > ev_f32 - 0.1, (ev_f32, ev_bf16)

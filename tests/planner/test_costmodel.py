@@ -159,3 +159,95 @@ def test_calibrate_skips_unusable_lines(tmp_path):
     trace.write_text("\n".join(lines) + "\n")
     table = calibrate(str(trace))
     assert table.samples == {"fleet_fit": 1}
+
+
+# -- the precision axis (PR 14) ----------------------------------------------
+
+
+@pytest.mark.precision
+def test_precision_factor_scales_predicted_run():
+    model = CostModel()
+    f32 = model.predict_run_s("fleet_fit", FF, 4, 1024, 10, precision="f32")
+    bf16 = model.predict_run_s("fleet_fit", FF, 4, 1024, 10, precision="bf16")
+    # the per-precision factor multiplies the FLOP share, not dispatch
+    dispatch = model.table.dispatch_s
+    assert bf16 < f32
+    assert (bf16 - dispatch) == pytest.approx(0.6 * (f32 - dispatch))
+    # precision defaults to the spec's compute_dtype
+    bf16_spec = FeedForwardSpec(
+        n_features=3,
+        n_features_out=3,
+        dims=(6, 3),
+        activations=("tanh", "tanh"),
+        compute_dtype="bfloat16",
+    )
+    assert model.predict_run_s("fleet_fit", bf16_spec, 4, 1024, 10) == bf16
+
+
+@pytest.mark.precision
+def test_serve_weight_bytes_halve_and_quarter():
+    model = CostModel()
+    f32 = model.serve_weight_bytes(FF, 8, "f32")
+    bf16 = model.serve_weight_bytes(FF, 8, "bf16")
+    int8 = model.serve_weight_bytes(FF, 8, "int8")
+    assert f32 == 4 * spec_param_count(FF) * 8
+    assert bf16 == f32 // 2
+    # int8 quarters the matrices but pays f32 per-channel scales
+    scales = 4 * 8 * sum(FF.dims + (FF.n_features_out,))
+    assert int8 == spec_param_count(FF) * 8 + scales
+    assert int8 < bf16
+
+
+@pytest.mark.precision
+def test_serve_hbm_and_step_predictions_carry_precision():
+    model = CostModel()
+    hbm_f32 = model.predict_serve_hbm_bytes(FF, 8, 128, "f32")
+    hbm_bf16 = model.predict_serve_hbm_bytes(FF, 8, 128, "bf16")
+    assert hbm_bf16 < hbm_f32
+    step_f32 = model.predict_serve_step_s(FF, 8, 128, "f32")
+    step_bf16 = model.predict_serve_step_s(FF, 8, 128, "bf16")
+    assert 0 < step_bf16 < step_f32
+
+
+@pytest.mark.precision
+def test_hbm_precision_changes_bin_packing_caps():
+    """bf16 compute halves the activation bytes, so a cap that forces an
+    f32 bucket to split can hold the bf16-compute twin whole — the
+    packer's HBM item weights genuinely move with the precision axis."""
+    model = CostModel()
+    wide = FeedForwardSpec(
+        n_features=64,
+        n_features_out=64,
+        dims=(512, 512),
+        activations=("tanh", "tanh"),
+    )
+    wide_bf16 = FeedForwardSpec(
+        n_features=64,
+        n_features_out=64,
+        dims=(512, 512),
+        activations=("tanh", "tanh"),
+        compute_dtype="bfloat16",
+    )
+    f32_bytes = model.predict_hbm_bytes(wide, 4, 4096, 4096)
+    bf16_bytes = model.predict_hbm_bytes(wide_bf16, 4, 4096, 4096)
+    assert bf16_bytes < f32_bytes
+    # a cap between the two: the f32 bucket overflows, the bf16 fits
+    cap = (f32_bytes + bf16_bytes) // 2
+    assert f32_bytes > cap >= bf16_bytes
+
+
+@pytest.mark.precision
+def test_cost_table_round_trips_precision_factors(tmp_path):
+    table = CostTable(precision_factors={"bf16": 0.5, "int8": 0.4})
+    path = str(tmp_path / "cost_table.json")
+    table.save(path)
+    loaded = CostTable.load(path)
+    assert loaded.precision_factors == {"bf16": 0.5, "int8": 0.4}
+    assert loaded.precision_factor("bf16") == 0.5
+    assert loaded.precision_factor("f32") == 1.0
+    assert loaded.precision_factor("bfloat16") == 0.5  # alias-normalized
+    # a pre-precision table (no key) loads with the analytic defaults
+    doc = table.to_dict()
+    del doc["precision_factors"]
+    legacy = CostTable.from_dict(doc)
+    assert legacy.precision_factor("bf16") == 0.6

@@ -1,0 +1,345 @@
+"""
+Precision ladder end-to-end over the WSGI routes: the f32 default is
+byte-identical to the pre-precision engine, bf16 serves behind a passed
+parity gate with verdict-identical anomaly answers under concurrent
+clients, a failed gate degrades to f32 with zero 5xx (route-level
+drill), and mixed f32-base / bf16-canary traffic survives a hot swap.
+"""
+
+import json
+
+import numpy as np
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu import serializer, serve
+from gordo_tpu.builder import local_build
+from gordo_tpu.serve import precision as P
+from gordo_tpu.server import build_app
+from gordo_tpu.server.fleet_store import STORE
+
+from tests.serve.conftest import (
+    BATCH_NAMES,
+    PROJECT,
+    installed_engine,
+    run_threads,
+    temp_env_vars,
+    tiny_config,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.precision]
+
+#: the bf16 canary fleet: the SAME machine names as the serve collection
+#: (a canary serves under the base's names) whose specs declare their
+#: serving precision on the config surface (`precision: bf16`)
+BF16_CONFIG = """
+machines:
+  - name: batch-a
+    dataset: &ds
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-05T00:00:00+00:00"
+      tag_list: [tag-1, tag-2, tag-3, tag-4]
+    model: &detector
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.JaxAutoEncoder:
+            kind: feedforward_model
+            encoding_dim: [8, 4]
+            encoding_func: [tanh, tanh]
+            decoding_dim: [4, 8]
+            decoding_func: [tanh, tanh]
+            precision: bf16
+            epochs: 1
+  - name: batch-b
+    dataset: *ds
+    model: *detector
+  - name: batch-c
+    dataset: *ds
+    model: *detector
+"""
+
+
+@pytest.fixture(scope="module")
+def bf16_collection_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("bf16-canary")
+    for model, machine in local_build(BF16_CONFIG, project_name=PROJECT):
+        serializer.dump(
+            model,
+            str(root / "1700000000001" / machine.name),
+            metadata=machine.to_dict(),
+        )
+    return str(root / "1700000000001")
+
+
+@pytest.fixture(autouse=True)
+def fresh_fleet(serve_collection_dir):
+    """Precision gate verdicts live and die with the RevisionFleet —
+    give every test a fresh fleet so one test's gate state (or
+    corrupted cast) never leaks into the next."""
+    STORE.invalidate(serve_collection_dir)
+    yield
+    STORE.invalidate(serve_collection_dir)
+
+
+def _leaf_columns(frame_dict, prefix=()):
+    """(path, {ts: value}) leaves of a dataframe_to_dict payload —
+    MultiIndex anomaly frames nest one dict level deeper than flat
+    prediction frames."""
+    for key, value in frame_dict.items():
+        if (
+            isinstance(value, dict)
+            and value
+            and all(isinstance(v, dict) for v in value.values())
+        ):
+            yield from _leaf_columns(value, prefix + (key,))
+        else:
+            yield prefix + (key,), value
+
+
+def _column_array(frame_dict):
+    """A dataframe_to_dict payload as a dense [rows, cols] array in
+    sorted column/timestamp order."""
+    cols = sorted(_leaf_columns(frame_dict), key=lambda kv: kv[0])
+    rows = sorted(cols[0][1])
+    return np.asarray(
+        [[series[r] for _, series in cols] for r in rows], np.float64
+    )
+
+
+def test_default_f32_is_byte_identical(serve_collection_dir, batch_payload):
+    """With the knob unset (and with it explicitly f32) the batched
+    response bytes are identical — the precision axis is invisible until
+    asked for."""
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=serve_collection_dir, GORDO_TPU_SERVE_WARMUP="0"
+    ):
+        app = build_app(config={"EXPECTED_MODELS": BATCH_NAMES})
+        url = f"/gordo/v0/{PROJECT}/batch-a/prediction"
+        with installed_engine() as engine:
+            assert engine.config.precision == "f32"
+            default_bytes = Client(app).post(url, json=batch_payload).data
+            stats = engine.stats()
+            assert stats["precision"]["coalesced"] == {"f32": 1}
+            assert stats["precision_degraded"] == 0
+            assert all(p == "f32" for *_, p in engine.program_shapes())
+        # nothing was gated: f32 is the reference, not a candidate
+        assert STORE.fleet(serve_collection_dir).precision_reports() == []
+        with temp_env_vars(GORDO_TPU_SERVE_PRECISION="f32"):
+            with installed_engine():
+                explicit_bytes = Client(app).post(url, json=batch_payload).data
+    assert default_bytes == explicit_bytes
+
+
+def test_bf16_verdict_parity_under_concurrent_clients(
+    serve_collection_dir, batch_payload
+):
+    """bf16 serving behind a passed gate: concurrent batched anomaly
+    requests all answer 200 and their anomaly VERDICTS (confidence >= 1)
+    match the unbatched f32 reference row for row."""
+    payload = dict(batch_payload, y=batch_payload["X"])
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=serve_collection_dir, GORDO_TPU_SERVE_WARMUP="0"
+    ):
+        app = build_app(config={"EXPECTED_MODELS": BATCH_NAMES})
+        reference = {}
+        for name in BATCH_NAMES:
+            resp = Client(app).post(
+                f"/gordo/v0/{PROJECT}/{name}/anomaly/prediction", json=payload
+            )
+            assert resp.status_code == 200
+            reference[name] = json.loads(resp.data)["data"]
+
+        with temp_env_vars(GORDO_TPU_SERVE_PRECISION="bf16"):
+            with installed_engine(tiny_config(max_delay_ms=250.0)) as engine:
+                # warmup runs the parity gate off the request path and
+                # precompiles the ACTIVE (bf16) ladder
+                engine.warmup_collection(serve_collection_dir)
+                fleet = STORE.fleet(serve_collection_dir)
+                spec = fleet.loaded_specs()["batch-a"]
+                state = fleet.precision_state(spec, "bf16")
+                assert state is not None and state["passed"], state
+                assert state["agreement_min"] >= 0.98
+
+                results = {}
+
+                def hit(i):
+                    name = BATCH_NAMES[i % len(BATCH_NAMES)]
+                    resp = Client(app).post(
+                        f"/gordo/v0/{PROJECT}/{name}/anomaly/prediction",
+                        json=payload,
+                    )
+                    assert resp.status_code == 200, resp.data
+                    results[i] = (name, json.loads(resp.data)["data"])
+
+                errors = run_threads(9, hit)
+                assert not errors
+                stats = engine.stats()
+                assert stats["precision"]["coalesced"].get("bf16") == 9
+                assert stats["precision_degraded"] == 0
+
+    assert len(results) == 9
+    for name, frame in results.values():
+        # the reconstruction is close (bf16-magnitude error) ...
+        got = _column_array(frame["model-output"])
+        want = _column_array(reference[name]["model-output"])
+        report = P.recon_agreement(want, got, rtol=0.02, atol=1e-2)
+        assert report["agreement"] == 1.0, report
+        # ... and the anomaly verdicts are identical: threshold math is
+        # f32 on the output side at every precision
+        got_conf = _column_array(
+            {"c": frame["total-anomaly-confidence"]}
+        )
+        want_conf = _column_array(
+            {"c": reference[name]["total-anomaly-confidence"]}
+        )
+        assert np.array_equal(got_conf >= 1.0, want_conf >= 1.0)
+
+
+def test_parity_failure_degrades_to_f32_with_zero_5xx(
+    serve_collection_dir, batch_payload, monkeypatch
+):
+    """The route-level degrade drill: a corrupted quantization fails the
+    gate, every request still answers 200, and the answers are exactly
+    the f32 answers (the degraded path IS the f32 path)."""
+
+    def corrupt_cast(stacked, precision):
+        import jax
+
+        return jax.tree_util.tree_map(lambda a: a * 0.0, stacked)
+
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=serve_collection_dir, GORDO_TPU_SERVE_WARMUP="0"
+    ):
+        app = build_app(config={"EXPECTED_MODELS": BATCH_NAMES})
+        url = f"/gordo/v0/{PROJECT}/batch-a/prediction"
+        with installed_engine() as engine:
+            f32_bytes = Client(app).post(url, json=batch_payload).data
+
+        monkeypatch.setattr(
+            "gordo_tpu.serve.precision.cast_bucket_params", corrupt_cast
+        )
+        STORE.invalidate(serve_collection_dir)
+        with temp_env_vars(GORDO_TPU_SERVE_PRECISION="bf16"):
+            with installed_engine(tiny_config(max_delay_ms=120.0)) as engine:
+                statuses = {}
+
+                def hit(i):
+                    resp = Client(app).post(url, json=batch_payload)
+                    statuses[i] = (resp.status_code, resp.data)
+
+                errors = run_threads(6, hit)
+                assert not errors
+                assert all(s == 200 for s, _ in statuses.values())
+                # every response is the f32 response, byte for byte
+                assert all(b == f32_bytes for _, b in statuses.values())
+                stats = engine.stats()
+                assert stats["precision_degraded"] == 6
+                assert stats["precision"]["coalesced"] == {"f32": 6}
+                assert all(p == "f32" for *_, p in engine.program_shapes())
+        fleet = STORE.fleet(serve_collection_dir)
+        reports = fleet.precision_reports()
+        assert len(reports) == 1 and not reports[0]["passed"]
+
+
+def test_gate_disabled_serves_requested_precision(serve_collection_dir):
+    """GORDO_TPU_PRECISION_GATE=0: the requested precision serves
+    ungated (benches and tests drive this; production keeps the gate)."""
+    fleet = STORE.fleet(serve_collection_dir)
+    fleet.warm(BATCH_NAMES)
+    model = STORE.get_model(serve_collection_dir, "batch-a")
+    with temp_env_vars(
+        GORDO_TPU_SERVE_PRECISION="bf16", GORDO_TPU_PRECISION_GATE="0"
+    ):
+        with installed_engine(tiny_config()) as engine:
+            recon = engine.batched_predict(
+                serve_collection_dir,
+                "batch-a",
+                model,
+                np.zeros((6, 4), np.float32),
+            )
+            assert recon is not None and recon.dtype == np.float32
+            assert engine.stats()["precision"]["coalesced"] == {"bf16": 1}
+    assert STORE.fleet(serve_collection_dir).precision_reports() == []
+
+
+def test_hot_swap_mixed_precision_traffic(
+    serve_collection_dir, bf16_collection_dir, batch_payload
+):
+    """The hot-swap drill: base f32 and a bf16-declared canary serve
+    mixed traffic (the canary's per-spec `precision: bf16` wins over the
+    unset env default), then the canary promotes — zero non-200s
+    throughout, and both precisions actually coalesced batches."""
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=serve_collection_dir, GORDO_TPU_SERVE_WARMUP="0"
+    ):
+        app = build_app(config={"EXPECTED_MODELS": BATCH_NAMES})
+        url = f"/gordo/v0/{PROJECT}/batch-a/prediction"
+        try:
+            with installed_engine(tiny_config(max_delay_ms=60.0)) as engine:
+                # every other request routes to the bf16 canary
+                STORE.set_canary(serve_collection_dir, bf16_collection_dir, 0.5)
+                statuses = {}
+
+                def hit(i):
+                    resp = Client(app).post(url, json=batch_payload)
+                    statuses[i] = resp.status_code
+
+                errors = run_threads(12, hit)
+                assert not errors
+                assert all(s == 200 for s in statuses.values()), statuses
+                coalesced = engine.stats()["precision"]["coalesced"]
+                assert coalesced.get("f32", 0) > 0, coalesced
+                assert coalesced.get("bf16", 0) > 0, coalesced
+                # the canary fleet carries a PASSED bf16 gate verdict
+                canary_fleet = STORE.fleet(bf16_collection_dir)
+                canary_spec = canary_fleet.loaded_specs()["batch-a"]
+                state = canary_fleet.precision_state(canary_spec, "bf16")
+                assert state is not None and state["passed"]
+                # the base fleet was never gated (it serves f32)
+                assert (
+                    STORE.fleet(serve_collection_dir).precision_reports() == []
+                )
+
+                # promote: all traffic now serves the bf16 revision
+                STORE.swap(serve_collection_dir, bf16_collection_dir)
+                before = coalesced.get("bf16", 0)
+                errors = run_threads(4, hit)
+                assert not errors
+                assert all(s == 200 for s in statuses.values())
+                after = engine.stats()["precision"]["coalesced"]["bf16"]
+                assert after >= before + 4
+        finally:
+            STORE.clear()
+
+
+def test_fleet_status_surfaces_the_precision_ladder(
+    serve_collection_dir, batch_payload
+):
+    """The operator surface: /fleet-health's `serving` section carries
+    the engine's precision config, per-precision coalesce counts and the
+    cached gate reports; the `programs` section buckets by precision."""
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=serve_collection_dir,
+        GORDO_TPU_SERVE_WARMUP="0",
+        GORDO_TPU_SERVE_PRECISION="bf16",
+    ):
+        app = build_app(config={"EXPECTED_MODELS": BATCH_NAMES})
+        with installed_engine(tiny_config()):
+            resp = Client(app).post(
+                f"/gordo/v0/{PROJECT}/batch-a/prediction", json=batch_payload
+            )
+            assert resp.status_code == 200
+            doc = Client(app).get(f"/gordo/v0/{PROJECT}/fleet-health").json
+    serving = doc["serving"]
+    assert serving["precision"]["config"] == "bf16"
+    assert serving["precision"]["coalesced"].get("bf16") == 1
+    (gate,) = serving["gates"]
+    assert gate["precision"] == "bf16" and gate["passed"]
+    assert doc["programs"]["by_precision"].get("bf16", 0) >= 1
+    # the rendered table view carries the same story without crashing
+    from gordo_tpu.telemetry import fleet_health
+
+    rendered = fleet_health.render_fleet_status(doc)
+    assert "precision=bf16" in rendered
+    assert "gate bf16: PASS" in rendered

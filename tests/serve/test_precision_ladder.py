@@ -1,0 +1,251 @@
+"""
+Precision-ladder units: the precision vocabulary and its resolution
+order, payload dtypes, bucket casting/quantization, the shared parity
+math, and the program-cache bound now that programs are keyed by
+``|members| × |rows| × |precisions|``.
+"""
+
+import numpy as np
+import pytest
+
+from gordo_tpu import serve
+from gordo_tpu.models.factories import feedforward_hourglass
+from gordo_tpu.serve import precision as P
+from gordo_tpu.server.fleet_store import STORE
+
+from tests.serve.conftest import (
+    BATCH_NAMES,
+    installed_engine,
+    run_threads,
+    temp_env_vars,
+    tiny_config,
+    warm_store,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.precision]
+
+
+# -- vocabulary ---------------------------------------------------------------
+
+
+def test_normalize_aliases_and_fallback():
+    assert P.normalize("f32") == "f32"
+    assert P.normalize("float32") == "f32"
+    assert P.normalize("bfloat16") == "bf16"
+    assert P.normalize("BF16") == "bf16"
+    assert P.normalize("int8") == "int8"
+    assert P.normalize("i8") == "int8"
+    # unset inherits the default; garbage degrades to it (warn-once)
+    assert P.normalize(None) == "f32"
+    assert P.normalize("") == "f32"
+    assert P.normalize("float8000") == "f32"
+    assert P.normalize("garbage", default="bf16") == "bf16"
+
+
+def test_resolution_order_spec_field_wins_over_env():
+    plain = feedforward_hourglass(4)
+    declared = feedforward_hourglass(4, precision="int8")
+    with temp_env_vars(GORDO_TPU_SERVE_PRECISION="bf16"):
+        assert P.serve_precision() == "bf16"
+        assert P.resolve_precision(plain) == "bf16"
+        assert P.resolve_precision(declared) == "int8"
+    # default default: f32
+    with temp_env_vars(GORDO_TPU_SERVE_PRECISION=""):
+        assert P.resolve_precision(plain) == "f32"
+        assert P.resolve_precision(declared) == "int8"
+    # an explicit engine-config default beats the env too
+    assert P.resolve_precision(plain, "bf16") == "bf16"
+
+
+def test_spec_precision_field_rides_the_config_surface():
+    """The factory kwarg lands on the spec (how a machine config's
+    ``precision: bf16`` declares its serving precision), defaults
+    unchanged, and two specs differing only in precision are distinct
+    (they must never share a fused-program cache entry)."""
+    spec = feedforward_hourglass(6)
+    assert spec.precision == ""
+    bf16 = feedforward_hourglass(6, precision="bf16")
+    assert bf16.precision == "bf16"
+    assert spec != bf16
+    assert hash(spec) != hash(bf16)
+    assert bf16.to_dict()["precision"] == "bf16"
+
+
+def test_payload_dtype_mapping():
+    assert P.payload_dtype("f32") == np.float32
+    bf16 = P.payload_dtype("bf16")
+    # jax ships ml_dtypes, so the reduced payload dtype is bfloat16
+    # (2 bytes on the wire to the device) both for bf16 and for int8
+    # weight-only serving (activations run bf16)
+    assert np.dtype(bf16).itemsize == 2
+    assert P.payload_dtype("int8") == bf16
+
+
+# -- casting / quantization ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def stacked_params():
+    import jax
+
+    from gordo_tpu.models.nn import init_feedforward
+    from gordo_tpu.parallel.fleet import stack_member_params
+
+    spec = feedforward_hourglass(6)
+
+    class _P:
+        def __init__(self, params):
+            self.params = params
+
+    members = [
+        _P(init_feedforward(jax.random.PRNGKey(i), spec)) for i in range(3)
+    ]
+    return spec, stack_member_params(members)
+
+
+def test_cast_bucket_bf16(stacked_params):
+    import jax.numpy as jnp
+
+    _, stacked = stacked_params
+    cast = P.cast_bucket_params(stacked, "bf16")
+    for layer in cast.values():
+        assert layer["W"].dtype == jnp.bfloat16
+        assert layer["b"].dtype == jnp.bfloat16
+    # f32 passes through untouched (identity, not a copy)
+    assert P.cast_bucket_params(stacked, "f32") is stacked
+
+
+def test_quantize_bucket_int8_per_channel(stacked_params):
+    import jax.numpy as jnp
+
+    _, stacked = stacked_params
+    q = P.cast_bucket_params(stacked, "int8")
+    for name, layer in q.items():
+        W32 = np.asarray(stacked[name]["W"], np.float32)
+        assert layer["W"].dtype == jnp.int8
+        # one scale per member per output channel
+        assert layer["scale"].shape == (W32.shape[0], 1, W32.shape[-1])
+        assert np.asarray(layer["W"]).min() >= -127
+        assert np.asarray(layer["W"]).max() <= 127
+        # dequantization error bounded by half a quantization step
+        dequant = np.asarray(layer["W"], np.float32) * np.asarray(
+            layer["scale"], np.float32
+        )
+        step = np.asarray(layer["scale"], np.float32)
+        assert np.all(np.abs(dequant - W32) <= 0.51 * step)
+
+
+def test_unknown_precision_raises(stacked_params):
+    _, stacked = stacked_params
+    with pytest.raises(ValueError):
+        P.cast_bucket_params(stacked, "fp4")
+
+
+# -- parity math --------------------------------------------------------------
+
+
+def test_recon_agreement_identical_and_corrupted():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((64, 4)).astype(np.float32)
+    assert P.recon_agreement(a, a)["agreement"] == 1.0
+    # a bf16-magnitude perturbation stays inside tolerance
+    near = a * (1.0 + 0.004)
+    assert P.recon_agreement(a, near, rtol=0.05)["agreement"] == 1.0
+    # zeroed weights (the degrade drill's corruption) do not
+    corrupt = np.zeros_like(a)
+    report = P.recon_agreement(a, corrupt, rtol=0.05)
+    assert report["agreement"] < 0.5
+    # stacked [members, rows, features] batches count feature-vector rows
+    stacked = np.stack([a, a])
+    assert P.recon_agreement(stacked, stacked)["rows"] == 128
+    # shape mismatch is disagreement, not a crash
+    assert P.recon_agreement(a, a[:10])["agreement"] == 0.0
+
+
+def test_verdict_agreement_threshold_math():
+    from sklearn.preprocessing import MinMaxScaler
+
+    rng = np.random.default_rng(1)
+    y = rng.random((100, 4)).astype(np.float32)
+    scaler = MinMaxScaler().fit(y)
+    # recon_a reconstructs half the rows well and half badly → verdicts
+    # split around a mid threshold
+    recon_a = y.copy()
+    recon_a[50:] += 1.0
+    report = P.verdict_agreement(recon_a, recon_a.copy(), y, scaler, 0.5)
+    assert report["mode"] == "verdict"
+    assert report["agreement"] == 1.0
+    assert report["flagged_f32"] == report["flagged_reduced"] == 50
+    # flipping the reduced copy's verdicts tanks agreement
+    flipped = y.copy()
+    flipped[:50] += 1.0
+    report = P.verdict_agreement(recon_a, flipped, y, scaler, 0.5)
+    assert report["agreement"] == 0.0
+    # no threshold → falls back to the closeness mode
+    report = P.verdict_agreement(recon_a, recon_a, y, None, None)
+    assert report["mode"] == "recon"
+
+
+# -- program-cache bound with the precision axis ------------------------------
+
+
+def test_program_cache_bound_covers_precisions(serve_collection_dir):
+    """Mixed f32/bf16 traffic mints at most |member ladder| × |row
+    ladder| × |precisions| programs, and the shapes report carries the
+    precision axis."""
+    warm_store(serve_collection_dir, BATCH_NAMES)
+    model = STORE.get_model(serve_collection_dir, "batch-a")
+    config = tiny_config(max_size=8, row_ladder=(8, 32), max_delay_ms=20.0)
+    bound = len(serve.member_ladder(8)) * 2 * 2  # two precisions in play
+    # gate off: this test bounds the cache, the gate has its own tests
+    with temp_env_vars(GORDO_TPU_PRECISION_GATE="0"):
+        with installed_engine(config) as engine:
+
+            def hit(i):
+                rows = 1 + (i * 7) % 30
+                X = np.random.RandomState(i).rand(rows, 4).astype(np.float32)
+                engine.config.precision = "bf16" if i % 2 else "f32"
+                recon = engine.batched_predict(
+                    serve_collection_dir, "batch-a", model, X
+                )
+                assert recon is not None and recon.shape == (rows, 4)
+
+            # sequential on purpose: the per-request precision flips
+            # through the shared engine config, which is only
+            # deterministic single-threaded
+            for i in range(12):
+                hit(i)
+            stats = engine.stats()
+            assert 0 < stats["programs"] <= bound
+            precisions = {p for (_, _, _, _, p) in engine.program_shapes()}
+            assert precisions == {"f32", "bf16"}
+            coalesced = stats["precision"]["coalesced"]
+            assert coalesced.get("f32", 0) > 0
+            assert coalesced.get("bf16", 0) > 0
+
+
+def test_gate_verdict_invalidated_by_bucket_membership_growth(
+    serve_collection_dir,
+):
+    """Review fix: a PASS verdict gated on the old membership must not
+    let a later-loaded member of the same spec serve reduced unverified
+    — verdicts are epoch-stamped like the cast buckets and read as
+    absent (→ re-gate) once the bucket grows."""
+    from gordo_tpu.server.fleet_store import RevisionFleet
+
+    fleet = RevisionFleet(serve_collection_dir)
+    fleet.warm(["batch-a", "batch-b"])  # two of the three spec members
+    spec = fleet.loaded_specs()["batch-a"]
+    governor = P.PrecisionGovernor()
+    assert governor.effective_precision(fleet, spec, "bf16") == "bf16"
+    state = fleet.precision_state(spec, "bf16")
+    assert state is not None and set(state["members"]) == {"batch-a", "batch-b"}
+    assert len(fleet.precision_reports()) == 1
+
+    fleet.model("batch-c")  # the bucket grows: epoch bumps
+    assert fleet.precision_state(spec, "bf16") is None
+    assert fleet.precision_reports() == []
+    # the next request re-gates over the FULL membership
+    assert governor.effective_precision(fleet, spec, "bf16") == "bf16"
+    state = fleet.precision_state(spec, "bf16")
+    assert set(state["members"]) == {"batch-a", "batch-b", "batch-c"}

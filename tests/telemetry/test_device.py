@@ -110,3 +110,28 @@ def test_emit_device_utilization_event_schema():
     assert attrs["phase"] == "final_fit"
     assert "compiles" in attrs and "cache_hits" in attrs
     assert attrs["memory_devices"] == snapshot["devices"]
+
+
+@pytest.mark.precision
+def test_program_counters_bucket_by_precision():
+    """The serve kind's counters gain a per-precision breakdown (the
+    precision ladder's compile accounting); kinds fed without a
+    precision stay exactly as before."""
+    device.note_program_execution(True, kind="serve", precision="f32")
+    device.note_program_execution(False, kind="serve", precision="f32")
+    device.note_program_execution(True, kind="serve", precision="bf16")
+    device.note_program_execution(True, kind="build")
+    counters = device.program_cache_counters()
+    serve = counters["serve"]
+    assert serve["compiles"] == 2 and serve["cache_hits"] == 1
+    assert serve["by_precision"]["f32"] == {"compiles": 1, "cache_hits": 1}
+    assert serve["by_precision"]["bf16"] == {"compiles": 1, "cache_hits": 0}
+    assert "by_precision" not in counters["build"]
+    # the snapshot is a COPY: mutating it never corrupts the live counts
+    serve["by_precision"]["f32"]["compiles"] = 999
+    assert (
+        device.program_cache_counters()["serve"]["by_precision"]["f32"][
+            "compiles"
+        ]
+        == 1
+    )
