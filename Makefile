@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-concurrency test-fleet-health test-slo test-precision docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-precision bench-check lint lint-gordo lockgraph-check image
+.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-concurrency test-fleet-health test-slo test-precision test-chaos docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-precision bench-chaos bench-check lint lint-gordo lockgraph-check image
 
 test:
 	python -m pytest tests/ -q
@@ -99,6 +99,21 @@ test-precision:
 # verdict-agreement rate; writes BENCH_PRECISION.json.
 bench-precision:
 	JAX_PLATFORMS=cpu python benchmarks/bench_precision.py
+
+# The serving fault-containment suite: circuit-breaker state machine,
+# batch bisection under injected device faults, NaN-poison detection,
+# OOM rung demotion, the route-level chaos drills, and the
+# breaker->lifecycle rebuild feed — CPU-only and not slow-marked, so
+# the same tests also run inside the tier-1 budget.
+test-chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos
+
+# Route-level chaos drill: >=8 concurrent clients + device faults
+# against one coalesced member + a hot-swap mid-drill; asserts zero
+# innocent-rider 5xx, breaker trip/recovery, ledger narration; writes
+# BENCH_CHAOS.json (gated by `gordo-tpu bench-check`).
+bench-chaos:
+	JAX_PLATFORMS=cpu python benchmarks/bench_chaos.py
 
 # SLO-engine bench: aggregation throughput (spans/s), steady-state
 # evaluation overhead vs the telemetry-on floor (<=2% is the gate), and

@@ -1,0 +1,293 @@
+"""
+Route-level chaos drill: serving-plane fault containment under load.
+
+The drill measures the PR 15 acceptance criterion end to end: with
+device faults injected against ONE member of a coalesced fleet while
+``>= 8`` concurrent route-level clients hammer the full WSGI prediction
+route — and a lifecycle hot-swap landing mid-drill — innocent riders
+must see ZERO 5xx, the poison member's circuit breaker must trip into
+quarantine (503 + Retry-After) and then recover through its half-open
+probe once the faults stop, the fleet-health ledger must narrate the
+whole episode, and the innocent riders' steady-state throughput under
+faults must stay within tolerance of the no-fault floor (bisection
+contains the poison; it does not drag the plane down).
+
+Phases:
+
+1. **clean** — no faults: the innocent-rider throughput floor.
+2. **faulted** — ``serve_device_program`` fires for every program the
+   poison member rides (a non-OOM ``InjectedDeviceError``: the
+   poison-member shape, not the OOM shape); a warm hot-swap to a
+   hardlink-published alternate revision lands mid-phase.
+3. **recovery** — faults stop; the drill polls the poison member until
+   its half-open probe scores and the breaker closes.
+
+Writes ``BENCH_CHAOS.json`` at the repo root (the committed bench
+convention), gated by ``gordo-tpu bench-check``. Run:
+``JAX_PLATFORMS=cpu python benchmarks/bench_chaos.py`` (or
+``make bench-chaos``). Reduced-reps knobs for CI:
+``BENCH_CHAOS_OUT``, ``BENCH_CHAOS_SECONDS``, ``BENCH_CHAOS_CLIENTS``.
+"""
+
+import datetime
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import warnings
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+warnings.filterwarnings("ignore", category=UserWarning)
+
+N_MODELS = 6
+N_TAGS = 8
+N_CLIENTS = int(os.environ.get("BENCH_CHAOS_CLIENTS", "8"))
+PHASE_SECONDS = float(os.environ.get("BENCH_CHAOS_SECONDS", "4.0"))
+
+PROJECT = "bench-chaos"
+BASE_REVISION = "100"
+ALT_REVISION = "101"
+POISON = "chaos-0"
+
+
+def build_collection(root: str):
+    from gordo_tpu.machine import Machine
+    from gordo_tpu.parallel import FleetBuilder
+
+    tags = [f"tag-{i}" for i in range(1, N_TAGS + 1)]
+    dataset = {
+        "type": "RandomDataset",
+        "train_start_date": "2020-01-01T00:00:00+00:00",
+        "train_end_date": "2020-01-04T00:00:00+00:00",
+        "tag_list": tags,
+    }
+    model = {
+        "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "gordo_tpu.models.JaxAutoEncoder": {
+                    "kind": "feedforward_hourglass",
+                    "encoding_layers": 1,
+                    "epochs": 1,
+                }
+            }
+        }
+    }
+    machines = [
+        Machine.from_config(
+            {"name": f"chaos-{i}", "model": model, "dataset": dict(dataset)},
+            project_name=PROJECT,
+        )
+        for i in range(N_MODELS)
+    ]
+    base_dir = os.path.join(root, BASE_REVISION)
+    FleetBuilder(machines, plan_strategy="packed").build(output_dir=base_dir)
+    return base_dir, tags
+
+
+def payload_for(tags):
+    index = [f"2020-03-01T00:{m:02d}:00+00:00" for m in range(0, 60, 10)]
+    return {
+        "X": {
+            tag: {ts: 0.01 * i + 0.1 * j for j, ts in enumerate(index)}
+            for i, tag in enumerate(tags)
+        }
+    }
+
+
+class Phase:
+    """One hammering window: per-name status counts + wall seconds."""
+
+    def __init__(self):
+        self.statuses = {}
+        self.lock = threading.Lock()
+        self.wall = 0.0
+
+    def record(self, name, code):
+        with self.lock:
+            self.statuses.setdefault(name, {})
+            self.statuses[name][code] = self.statuses[name].get(code, 0) + 1
+
+    def innocent_counts(self):
+        total = bad = 0
+        for name, codes in self.statuses.items():
+            if name == POISON:
+                continue
+            for code, n in codes.items():
+                total += n
+                if code >= 500:
+                    bad += n
+        return total, bad
+
+    def innocent_rps(self):
+        total, _ = self.innocent_counts()
+        return total / self.wall if self.wall else 0.0
+
+
+def hammer(app, payload, phase, seconds, swap_at=None, swap=None):
+    from werkzeug.test import Client
+
+    names = [f"chaos-{i}" for i in range(N_MODELS)]
+    stop = threading.Event()
+
+    def client_loop(i):
+        client = Client(app)
+        name = names[i % N_MODELS]
+        while not stop.is_set():
+            resp = client.post(
+                f"/gordo/v0/{PROJECT}/{name}/prediction", json=payload
+            )
+            phase.record(name, resp.status_code)
+
+    threads = [
+        threading.Thread(target=client_loop, args=(i,), daemon=True)
+        for i in range(N_CLIENTS)
+    ]
+    start = time.monotonic()
+    for thread in threads:
+        thread.start()
+    if swap_at is not None:
+        time.sleep(swap_at)
+        swap()
+        time.sleep(max(0.0, seconds - swap_at))
+    else:
+        time.sleep(seconds)
+    stop.set()
+    for thread in threads:
+        thread.join(timeout=30)
+    phase.wall = time.monotonic() - start
+    return phase
+
+
+def main() -> dict:
+    from werkzeug.test import Client
+
+    from gordo_tpu import serve, telemetry
+    from gordo_tpu.lifecycle import publish_canary
+    from gordo_tpu.serve import ServeConfig, ServeEngine
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server.fleet_store import STORE
+    from gordo_tpu.utils.faults import FaultRule, InjectedDeviceError, inject
+
+    tmp = tempfile.mkdtemp(prefix="bench-chaos-")
+    base_dir, tags = build_collection(tmp)
+    alt_dir = publish_canary(tmp, BASE_REVISION, base_dir, [], ALT_REVISION)
+
+    os.environ["MODEL_COLLECTION_DIR"] = base_dir
+    os.environ["GORDO_TPU_SERVE_WARMUP"] = "0"
+    os.environ["GORDO_TPU_BREAKER_THRESHOLD"] = "3"
+    os.environ["GORDO_TPU_BREAKER_COOLDOWN_S"] = "0.6"
+    os.environ["GORDO_TPU_BREAKER_BACKOFF"] = "2.0"
+    app = build_app(config={"EXPECTED_MODELS": []})
+    engine = ServeEngine(
+        ServeConfig(max_size=16, max_delay_ms=5.0, row_ladder=(8, 32))
+    )
+    serve.install_engine(engine)
+
+    payload = payload_for(tags)
+    STORE.fleet(base_dir).warm()
+    STORE.fleet(alt_dir).warm()
+
+    # phase 1: the no-fault floor
+    clean = hammer(app, payload, Phase(), PHASE_SECONDS)
+
+    # phase 2: poison one member's device programs; hot-swap mid-phase
+    rule = FaultRule(
+        "serve_device_program",
+        match=f"*:*:{POISON}",
+        times=None,
+        exc=InjectedDeviceError,
+    )
+    with inject(rule):
+        faulted = hammer(
+            app,
+            payload,
+            Phase(),
+            PHASE_SECONDS,
+            swap_at=PHASE_SECONDS / 2.0,
+            swap=lambda: STORE.swap(base_dir, alt_dir, warm=True),
+        )
+    stats_after_faults = engine.stats()
+
+    # phase 3: faults stopped — poll the poison member through its
+    # half-open probe until it serves again. Recovery is judged by
+    # behavior (consecutive 200s): the pre-swap fleet's breaker stays
+    # open with no traffic to probe it, which is correct — breaker
+    # state is per revision fleet and dies with it.
+    client = Client(app)
+    recovered = False
+    streak = 0
+    recovery_deadline = time.monotonic() + 30.0
+    while time.monotonic() < recovery_deadline:
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/{POISON}/prediction", json=payload
+        )
+        streak = streak + 1 if resp.status_code == 200 else 0
+        if streak >= 3:
+            recovered = True
+            break
+        time.sleep(0.2)
+
+    # ledger narration: the anchor ledger carries the breaker episode
+    ledger_doc = telemetry.ledger_for(base_dir).document() or {}
+    poison_record = (ledger_doc.get("machines") or {}).get(POISON) or {}
+    breaker_record = poison_record.get("breaker") or {}
+    ledger_narrated = bool(breaker_record.get("trips", 0) >= 1)
+
+    stats = engine.stats()
+    serve.install_engine(None)
+    engine.shutdown(drain=True)
+
+    innocent_total, innocent_5xx = faulted.innocent_counts()
+    clean_total, clean_5xx = clean.innocent_counts()
+    poison_codes = faulted.statuses.get(POISON, {})
+    ratio = (
+        faulted.innocent_rps() / clean.innocent_rps()
+        if clean.innocent_rps()
+        else 0.0
+    )
+    return {
+        "bench": "serve-chaos",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "models": N_MODELS,
+        "clients": N_CLIENTS,
+        "phase_seconds": PHASE_SECONDS,
+        "clean_innocent_rps": round(clean.innocent_rps(), 2),
+        "faulted_innocent_rps": round(faulted.innocent_rps(), 2),
+        "throughput_ratio_faulted_vs_clean": round(ratio, 4),
+        "innocent_requests_clean": clean_total,
+        "innocent_5xx_clean": clean_5xx,
+        "innocent_requests_faulted": innocent_total,
+        "innocent_rider_5xx": innocent_5xx,
+        "swap_dropped": innocent_5xx,  # the swap landed mid-faulted-phase
+        "poison_statuses": {str(k): v for k, v in sorted(poison_codes.items())},
+        "breaker_tripped": bool(stats_after_faults["breaker_trips"] >= 1),
+        "breaker_recovered": recovered,
+        "ledger_narrated": ledger_narrated,
+        "engine": {
+            "device_errors": stats["device_errors"],
+            "batch_bisects": stats["batch_bisects"],
+            "members_isolated": stats["members_isolated"],
+            "breaker_trips": stats["breaker_trips"],
+            "breaker_rejects": stats["breaker_rejects"],
+            "coalesced": stats["coalesced"],
+            "batches": stats["batches"],
+        },
+    }
+
+
+if __name__ == "__main__":
+    outcome = main()
+    out_path = os.environ.get(
+        "BENCH_CHAOS_OUT", str(REPO_ROOT / "BENCH_CHAOS.json")
+    )
+    with open(out_path, "w") as f:
+        json.dump(outcome, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(outcome, indent=1, sort_keys=True))
+    print(f"\nwrote {out_path}")

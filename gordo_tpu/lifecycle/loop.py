@@ -79,6 +79,11 @@ class LifecycleConfig:
     #: The canary keeps serving its slice; `lifecycle promote --force`
     #: and gate failures (rollbacks) are never held.
     slo_gate: bool = True
+    #: treat members whose SERVING circuit breaker tripped (the ledger's
+    #: `breaker` section, fed by the serve engine) as rebuild candidates
+    #: alongside drifted ones: a member whose device programs keep
+    #: failing is stale in the way that matters most — it cannot serve
+    breaker_rebuild: bool = True
     drift: DriftConfig = field(default_factory=DriftConfig)
     gates: GateConfig = field(default_factory=GateConfig)
 
@@ -92,6 +97,9 @@ class LifecycleConfig:
                 "GORDO_TPU_QUARANTINE_COOLDOWN", 3600.0
             ),
             slo_gate=env_bool("GORDO_TPU_GATE_SLO_BURN", True),
+            breaker_rebuild=env_bool(
+                "GORDO_TPU_LIFECYCLE_BREAKER_REBUILD", True
+            ),
             drift=DriftConfig.from_env(),
             gates=GateConfig.from_env(),
         )
@@ -304,9 +312,23 @@ class LifecycleSupervisor:
             for name, verdict in verdicts.items()
             if verdict.drifted
         }
+        # serving-plane casualties: members whose circuit breaker the
+        # serve engine tripped (repeated isolated device failures) are
+        # rebuild candidates too — read from the merged health ledger,
+        # the one arrow between serve and lifecycle
+        tripped = self._breaker_candidates()
+        if tripped:
+            report.details["breaker_tripped"] = tripped
+            logger.warning(
+                "serving breaker tripped for %d machine(s) (%s); "
+                "nominating for rebuild",
+                len(tripped),
+                ", ".join(tripped[:5]),
+            )
+        candidates = set(report.drifted) | set(tripped)
         buildable = {m.name for m in self.machines}
-        stale = sorted(set(report.drifted) & buildable)
-        unbuildable = sorted(set(report.drifted) - buildable)
+        stale = sorted(candidates & buildable)
+        unbuildable = sorted(candidates - buildable)
         if unbuildable:
             logger.warning(
                 "drifted machines with no machine config (cannot rebuild): %s",
@@ -583,6 +605,22 @@ class LifecycleSupervisor:
         report.details["quarantined"] = revision
         self._count_event("rollbacks")
         self._ledger.record_quarantine(quarantined, revision, reasons)
+
+    def _breaker_candidates(self) -> List[str]:
+        """Machines whose serving circuit breaker is tripped, from the
+        merged health snapshots under the anchor dir (stale records
+        expire — a dead server's forgotten `open` must not drive canary
+        storms; the quarantine cooldown applies on top, like drift)."""
+        if not self.config.breaker_rebuild:
+            return []
+        try:
+            from ..telemetry import breaker_tripped_machines
+
+            return sorted(breaker_tripped_machines(self.collection_dir))
+        except Exception as exc:  # noqa: BLE001 - the feed is advisory;
+            # a malformed snapshot must not stop drift-driven cycles
+            logger.debug("breaker candidates not read: %r", exc)
+            return []
 
     def _quarantine_cooldown(self) -> set:
         """Machines whose canaries were quarantined within the cooldown

@@ -21,6 +21,13 @@ from .batcher import (
     DeadlineExceeded,
     MicroBatcher,
     QueueFullError,
+    clone_exception,
+)
+from .breaker import (
+    BreakerBoard,
+    BreakerConfig,
+    MemberQuarantined,
+    ServeDeviceError,
 )
 from .engine import (
     ServeConfig,
@@ -48,14 +55,19 @@ __all__ = [
     "BatchItem",
     "BatchShedError",
     "BatcherStopped",
+    "BreakerBoard",
+    "BreakerConfig",
     "DeadlineExceeded",
+    "MemberQuarantined",
     "MicroBatcher",
     "PRECISIONS",
     "ParityConfig",
     "PrecisionGovernor",
     "QueueFullError",
     "ServeConfig",
+    "ServeDeviceError",
     "ServeEngine",
+    "clone_exception",
     "batching_enabled",
     "ensure_engine",
     "evaluate_parity",

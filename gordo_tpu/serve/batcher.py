@@ -58,6 +58,26 @@ class BatcherStopped(BatchShedError):
     """Submit after shutdown began — callers fall back to unbatched."""
 
 
+def clone_exception(exc: BaseException) -> BaseException:
+    """A fresh exception instance carrying ``exc``'s type and message.
+
+    Futures fan one batch failure out to N waiting request threads; each
+    must get its OWN instance (``raise`` mutates the instance's
+    ``__traceback__``, so one object re-raised from N handler threads is
+    a data race). The original rides along as ``__cause__`` for the
+    first-class server log; exception types whose constructor rejects a
+    bare message degrade to ``RuntimeError``.
+    """
+    try:
+        clone = type(exc)(*exc.args)
+        if not isinstance(clone, type(exc)):  # an odd __new__ contract
+            raise TypeError
+    except Exception:  # noqa: BLE001 - ctor signature we can't satisfy
+        clone = RuntimeError(f"batch runner failed: {exc!r}")
+    clone.__cause__ = exc
+    return clone
+
+
 class BatchItem:
     """One enqueued request: the payload the runner scores, the future
     the waiting request thread holds, and the admission bookkeeping.
@@ -290,9 +310,15 @@ class MicroBatcher:
         except BaseException as exc:  # noqa: BLE001 - a runner crash must
             # resolve every waiter (a hung client is worse than an error)
             logger.exception("batch runner failed for key %r", key)
+            self._shed("runner_error")
             for item in live:
                 try:
-                    item.future.set_exception(exc)
+                    # each rider gets its OWN exception instance: one
+                    # shared exception object (and its traceback) handed
+                    # to N request-handler threads is mutated concurrently
+                    # by every `raise` that re-renders it — a latent race
+                    # and a cross-request information leak
+                    item.future.set_exception(clone_exception(exc))
                 except Exception:  # noqa: BLE001 - runner resolved some
                     pass
 

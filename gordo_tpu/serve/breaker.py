@@ -1,0 +1,401 @@
+"""
+Per-member circuit breakers for the serving plane.
+
+The engine's batch bisection (``engine.py``) isolates a device failure
+down to one member — but without memory, every batch that member rides
+pays the whole bisection ladder again, forever. The breaker is that
+memory: a per-``(revision fleet, spec, member)`` state machine that
+counts consecutive isolated failures, TRIPS the member into a serving
+quarantine once they cross a threshold, and probes it back to health on
+an exponential-backoff schedule.
+
+State machine (the classic three states):
+
+- **closed** (the steady state): requests flow; an isolated failure
+  increments the consecutive-failure count, a success resets it.
+  ``GORDO_TPU_BREAKER_THRESHOLD`` consecutive failures trip the breaker.
+- **open**: requests for the member are rejected *before* they ride a
+  batch (:class:`MemberQuarantined` → the route's 503 + ``Retry-After``)
+  for ``cooldown`` seconds. The cooldown starts at
+  ``GORDO_TPU_BREAKER_COOLDOWN_S`` and multiplies by
+  ``GORDO_TPU_BREAKER_BACKOFF`` on every re-trip, capped at
+  ``GORDO_TPU_BREAKER_MAX_COOLDOWN_S``.
+- **half-open**: after the cooldown, exactly ONE request is admitted as
+  a probe (concurrent requests keep getting 503 with a short
+  ``Retry-After``); the probe's success closes the breaker, its failure
+  re-opens with the grown cooldown. A probe whose request is shed
+  (deadline, cancelled waiter) expires after ``probe_ttl_s`` so a lost
+  probe can never wedge the breaker half-open forever.
+
+Keys include the :class:`RevisionFleet` *object*, so breaker state lives
+and dies with the served revision exactly like the precision-gate
+verdicts: a hot-swap or DELETE drops the fleet, and the replacement
+revision starts with a clean slate (a rebuilt member has earned a fresh
+chance). Dead fleets are purged via ``weakref.finalize`` — the board
+never pins a revision in memory.
+
+Layering: this module is pure stdlib state machinery. It must NOT
+import ``gordo_tpu.lifecycle`` — tripped members reach the lifecycle
+supervisor through the fleet-health ledger (the telemetry arrow), which
+the :class:`~gordo_tpu.serve.engine.ServeEngine` feeds on every
+transition.
+"""
+
+import collections
+import logging
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.env import env_float, env_int
+from .batcher import BatchShedError
+
+logger = logging.getLogger(__name__)
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class MemberQuarantined(BatchShedError):
+    """The member's circuit breaker is open: the request is rejected
+    before riding a batch. The route maps this to **503** with a
+    ``Retry-After`` derived from the breaker's remaining cooldown
+    (mirroring the 429 ``Retry-After`` contract)."""
+
+    def __init__(self, member: str, retry_after_s: float):
+        super().__init__(
+            f"model {member!r} is quarantined by its serving circuit "
+            f"breaker; retry in {retry_after_s:.0f}s"
+        )
+        self.member = member
+        self.retry_after_s = retry_after_s
+
+
+class ServeDeviceError(BatchShedError):
+    """A device program failed for THIS request/member after the
+    engine's bisection isolated it — the innocent riders of the same
+    batch already got their results. The route maps this to **500**
+    (server-side; the generic text never echoes device internals)."""
+
+    def __init__(self, member: str, cause: Optional[BaseException] = None):
+        super().__init__(
+            f"device scoring failed for model {member!r} in isolation"
+        )
+        self.member = member
+        # chained for the server log only; routes answer generic text
+        self.__cause__ = cause
+
+
+class BreakerConfig:
+    """Breaker knobs, resolved once per board from the environment."""
+
+    __slots__ = (
+        "threshold",
+        "cooldown_s",
+        "backoff",
+        "max_cooldown_s",
+        "probe_ttl_s",
+    )
+
+    def __init__(
+        self,
+        threshold: int = 3,
+        cooldown_s: float = 30.0,
+        backoff: float = 2.0,
+        max_cooldown_s: float = 600.0,
+        probe_ttl_s: Optional[float] = None,
+    ):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = max(0.001, float(cooldown_s))
+        self.backoff = max(1.0, float(backoff))
+        self.max_cooldown_s = max(self.cooldown_s, float(max_cooldown_s))
+        #: how long a half-open probe may stay unresolved before another
+        #: request is allowed to probe (a shed/cancelled probe must not
+        #: wedge the breaker half-open forever)
+        self.probe_ttl_s = (
+            float(probe_ttl_s)
+            if probe_ttl_s is not None
+            else max(5.0, self.cooldown_s)
+        )
+
+    @classmethod
+    def from_env(cls) -> "BreakerConfig":
+        return cls(
+            threshold=env_int("GORDO_TPU_BREAKER_THRESHOLD", 3),
+            cooldown_s=env_float("GORDO_TPU_BREAKER_COOLDOWN_S", 30.0),
+            backoff=env_float("GORDO_TPU_BREAKER_BACKOFF", 2.0),
+            max_cooldown_s=env_float("GORDO_TPU_BREAKER_MAX_COOLDOWN_S", 600.0),
+        )
+
+
+class _MemberBreaker:
+    """One member's breaker record (mutated only under the board lock)."""
+
+    __slots__ = (
+        "name",
+        "state",
+        "failures",
+        "trips",
+        "opened_at",
+        "cooldown_s",
+        "probe_at",
+        "last_error",
+    )
+
+    def __init__(self, name: str):
+        self.name = name
+        self.state = CLOSED
+        self.failures = 0  # consecutive isolated failures
+        self.trips = 0
+        self.opened_at = 0.0  # monotonic
+        self.cooldown_s = 0.0
+        self.probe_at: Optional[float] = None
+        self.last_error = ""
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "member": self.name,
+            "state": self.state,
+            "failures": self.failures,
+            "trips": self.trips,
+            "cooldown_s": round(self.cooldown_s, 3),
+            "last_error": self.last_error,
+        }
+
+
+class BreakerBoard:
+    """The engine's breaker registry, keyed by (fleet, spec, member).
+
+    ``on_transition(member, old_state, new_state, snapshot)`` fires
+    (outside the lock) on every state change — the engine wires it to
+    the fleet-health ledger, the span recorder and Prometheus. The
+    board also carries the engine's **precision degrade set**: buckets
+    whose reduced-precision programs started faulting mid-traffic are
+    pinned to f32 here (it shares the breaker's fleet-lifetime scoping
+    and GC), independent of whether the parity gate is enabled.
+    """
+
+    def __init__(
+        self,
+        config: Optional[BreakerConfig] = None,
+        on_transition: Optional[Callable[[str, str, str, dict], None]] = None,
+    ):
+        self.config = config or BreakerConfig.from_env()
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._members: Dict[Tuple[int, Any, str], _MemberBreaker] = {}
+        #: (fleet id, spec, precision) buckets degraded to f32 after
+        #: device errors (engine._member_failure); consulted per request
+        #: with one set probe
+        self._degraded: set = set()
+        #: fleet id -> finalizer: purges a dead fleet's keys so an id
+        #: reuse can never resurrect another revision's breaker state
+        self._fleets: Dict[int, Any] = {}
+        #: fleet ids whose finalizer fired, awaiting a locked drain. The
+        #: weakref callback runs inside the GC — which can trigger on any
+        #: allocation, including one made WHILE this board's lock is
+        #: held — so the callback itself must never take the lock
+        #: (deadlock) or mutate the maps (concurrent-iteration): it only
+        #: appends to this deque, and every locked mutator drains it.
+        self._dead: "collections.deque" = collections.deque()
+
+    # -- keying / GC ---------------------------------------------------------
+
+    def _track_fleet(self, fleet: Any) -> int:
+        fid = id(fleet)
+        if fid not in self._fleets:  # caller holds the lock
+            self._fleets[fid] = weakref.finalize(fleet, self._dead.append, fid)
+        return fid
+
+    def _drain_dead_locked(self) -> None:
+        """Purge dead fleets' state (caller holds the lock); an id freed
+        here can be reused by a NEW fleet without ever resurrecting the
+        old revision's breaker verdicts."""
+        while True:
+            try:
+                fid = self._dead.popleft()
+            except IndexError:
+                return
+            self._fleets.pop(fid, None)
+            for key in [k for k in self._members if k[0] == fid]:
+                del self._members[key]
+            self._degraded = {k for k in self._degraded if k[0] != fid}
+
+    # -- request path --------------------------------------------------------
+
+    def quarantined(self, fleet: Any, spec: Any, member: str) -> Optional[float]:
+        """None when the request may proceed (closed, or admitted as the
+        half-open probe); otherwise the ``Retry-After`` seconds the 503
+        should carry. The steady-state (no breaker for this member) cost
+        is one lock-free dict probe."""
+        if self._dead:
+            with self._lock:
+                self._drain_dead_locked()
+        key = (id(fleet), spec, member)
+        breaker = self._members.get(key)  # lock-free: hot path
+        if breaker is None or breaker.state == CLOSED:
+            return None
+        now = time.monotonic()
+        transition = None
+        with self._lock:
+            self._drain_dead_locked()
+            breaker = self._members.get(key)
+            if breaker is None or breaker.state == CLOSED:
+                return None
+            if breaker.state == OPEN:
+                remaining = breaker.opened_at + breaker.cooldown_s - now
+                if remaining > 0:
+                    return max(1.0, remaining)
+                # cooldown lapsed: this request becomes the probe
+                breaker.state = HALF_OPEN
+                breaker.probe_at = now
+                transition = (OPEN, HALF_OPEN, breaker.snapshot())
+            elif breaker.state == HALF_OPEN:
+                probe_at = breaker.probe_at
+                if probe_at is not None and now - probe_at < self.config.probe_ttl_s:
+                    # a probe is in flight; everyone else waits it out
+                    return max(1.0, self.config.probe_ttl_s - (now - probe_at))
+                breaker.probe_at = now  # the previous probe was lost
+        if transition is not None:
+            self._fire(member, *transition)
+        return None
+
+    def record_success(self, fleet: Any, spec: Any, member: str) -> None:
+        """A member scored cleanly: reset the consecutive-failure count,
+        and close a half-open breaker (the probe came back healthy).
+        No-op — one dict probe — for untracked members."""
+        key = (id(fleet), spec, member)
+        if self._members.get(key) is None:  # lock-free: hot path
+            return
+        transition = None
+        with self._lock:
+            self._drain_dead_locked()
+            breaker = self._members.get(key)
+            if breaker is None:
+                return
+            breaker.failures = 0
+            if breaker.state == HALF_OPEN:
+                old = breaker.state
+                breaker.state = CLOSED
+                breaker.probe_at = None
+                transition = (old, CLOSED, breaker.snapshot())
+        if transition is not None:
+            logger.info(
+                "serving breaker CLOSED for member %s (half-open probe "
+                "succeeded after %d trip(s))",
+                member,
+                transition[2]["trips"],
+            )
+            self._fire(member, *transition)
+
+    def record_failure(
+        self, fleet: Any, spec: Any, member: str, exc: BaseException
+    ) -> bool:
+        """One isolated device failure for ``member``; returns True when
+        this failure TRIPPED the breaker (closed→open or a failed
+        half-open probe re-opening)."""
+        now = time.monotonic()
+        transition = None
+        with self._lock:
+            self._drain_dead_locked()
+            key = (self._track_fleet(fleet), spec, member)
+            breaker = self._members.get(key)
+            if breaker is None:
+                breaker = self._members[key] = _MemberBreaker(member)
+            breaker.failures += 1
+            breaker.last_error = repr(exc)[:200]
+            tripped = False
+            if breaker.state == HALF_OPEN:
+                tripped = True  # the probe failed: straight back to open
+            elif (
+                breaker.state == CLOSED
+                and breaker.failures >= self.config.threshold
+            ):
+                tripped = True
+            if tripped:
+                old = breaker.state
+                breaker.state = OPEN
+                breaker.trips += 1
+                breaker.opened_at = now
+                breaker.probe_at = None
+                breaker.cooldown_s = min(
+                    self.config.max_cooldown_s,
+                    self.config.cooldown_s
+                    * (self.config.backoff ** (breaker.trips - 1)),
+                )
+                transition = (old, OPEN, breaker.snapshot())
+        if transition is not None:
+            logger.warning(
+                "serving breaker OPEN for member %s (trip %d, cooldown "
+                "%.1fs): %s",
+                member,
+                transition[2]["trips"],
+                transition[2]["cooldown_s"],
+                transition[2]["last_error"],
+            )
+            self._fire(member, *transition)
+        return transition is not None
+
+    # -- precision degrade set ----------------------------------------------
+
+    def degrade_bucket(self, fleet: Any, spec: Any, precision: str) -> bool:
+        """Pin one (fleet, spec, precision) bucket to f32 after its
+        reduced-precision program faulted; True when newly degraded.
+        Unlike the parity gate's verdict map this works with the gate
+        disabled — device errors degrade unconditionally."""
+        with self._lock:
+            self._drain_dead_locked()
+            key = (self._track_fleet(fleet), spec, precision)
+            if key in self._degraded:
+                return False
+            self._degraded.add(key)
+        return True
+
+    def degraded(self, fleet: Any, spec: Any, precision: str) -> bool:
+        if self._dead:
+            # a dead fleet's id can be REUSED by a new RevisionFleet:
+            # drain before the lock-free probe so stale degrade keys can
+            # never pin a fresh revision's bucket to f32
+            with self._lock:
+                self._drain_dead_locked()
+        return (id(fleet), spec, precision) in self._degraded  # lock-free
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self, detail_cap: int = 50) -> Dict[str, Any]:
+        """Bounded state summary for the engine stats / fleet-status
+        ``serving`` section: counts by state, total trips, and per-member
+        detail for the (bounded) set of currently-unhealthy members."""
+        with self._lock:
+            self._drain_dead_locked()
+            breakers = list(self._members.values())
+            degraded = len(self._degraded)
+        counts = {CLOSED: 0, OPEN: 0, HALF_OPEN: 0}
+        trips = 0
+        detail: List[Dict[str, Any]] = []
+        for breaker in breakers:
+            counts[breaker.state] += 1
+            trips += breaker.trips
+            if breaker.state != CLOSED and len(detail) < detail_cap:
+                detail.append(breaker.snapshot())
+        return {
+            "tracked": len(breakers),
+            "open": counts[OPEN],
+            "half_open": counts[HALF_OPEN],
+            "trips": trips,
+            "degraded_buckets": degraded,
+            "members": detail,
+        }
+
+    # -- hooks ---------------------------------------------------------------
+
+    def _fire(self, member: str, old: str, new: str, info: dict) -> None:
+        if self._on_transition is None:
+            return
+        try:
+            self._on_transition(member, old, new, info)
+        except Exception:  # noqa: BLE001 - transition feeds (ledger,
+            # metrics, spans) are advisory, never the request's problem
+            logger.debug("breaker transition hook failed", exc_info=True)

@@ -33,6 +33,7 @@ fallback and the default).
 
 import atexit
 import logging
+import os
 import threading
 import time
 from concurrent.futures import CancelledError
@@ -45,8 +46,10 @@ from ..models.spec import FeedForwardSpec
 from ..telemetry.device import note_program_execution
 from ..telemetry.serving import SERVE_TRACE_FILE, serve_recorder
 from ..utils.env import env_bool, env_float, env_int, env_str
+from ..utils.faults import FaultInjected, fault_point
 from . import ladder, precision
 from .batcher import BatcherStopped, BatchItem, DeadlineExceeded, MicroBatcher
+from .breaker import BreakerBoard, MemberQuarantined, ServeDeviceError
 
 logger = logging.getLogger(__name__)
 
@@ -76,6 +79,7 @@ class ServeConfig:
         "warmup_max_rows",
         "inline_flush",
         "precision",
+        "finite_check",
     )
 
     def __init__(
@@ -90,6 +94,7 @@ class ServeConfig:
         warmup_max_rows: int = 512,
         inline_flush: bool = True,
         serve_precision: str = "",
+        finite_check: bool = True,
     ):
         self.max_size = max(1, int(max_size))
         self.max_delay_s = max(0.0, float(max_delay_ms) / 1000.0)
@@ -102,6 +107,10 @@ class ServeConfig:
         )
         self.warmup_max_rows = int(warmup_max_rows)
         self.inline_flush = bool(inline_flush)
+        #: scan every fused batch's output for non-finite rows (NaN/inf):
+        #: a member producing them from FINITE input is poisoned and
+        #: fails alone instead of silently corrupting anomaly verdicts
+        self.finite_check = bool(finite_check)
         #: the engine-default serving precision ("" inherits the
         #: GORDO_TPU_SERVE_PRECISION knob at resolve time); a spec's own
         #: precision: field still wins per request
@@ -122,6 +131,7 @@ class ServeConfig:
             warmup_max_rows=env_int("GORDO_TPU_SERVE_WARMUP_ROWS", 512),
             inline_flush=env_bool("GORDO_TPU_BATCH_INLINE_FLUSH", True),
             serve_precision=env_str(precision.PRECISION_ENV, "") or "",
+            finite_check=env_bool("GORDO_TPU_SERVE_FINITE_CHECK", True),
         )
 
 
@@ -133,12 +143,26 @@ class ServeEngine:
         #: duck-typed metric sink (server.prometheus.metrics.ServeMetrics);
         #: late-bound so build_app can attach it after creation
         self.metrics = metrics
+        #: the anchor collection dir the breaker feed ledgers against —
+        #: late-bound by build_app (which resolves the app's configurable
+        #: MODEL_COLLECTION_DIR_ENV_VAR); unset, the transition hook
+        #: falls back to the default env var name
+        self.ledger_anchor: Optional[str] = None
         self.member_ladder = ladder.member_ladder(self.config.max_size)
         #: the precision-parity arbiter: gate-then-serve, degrade to f32
         #: on failure (serve/precision.py)
         self.governor = precision.PrecisionGovernor()
+        #: per-(fleet, spec, member) circuit breakers + the device-error
+        #: precision degrade set (serve/breaker.py); transitions feed
+        #: the health ledger, the span recorder and Prometheus
+        self.breakers = BreakerBoard(on_transition=self._on_breaker_transition)
         self._lock = threading.Lock()
         self._programs: set = set()
+        #: (spec, precision) -> demoted member/row caps after a
+        #: RESOURCE_EXHAUSTED: an OOMing ladder rung is dropped for the
+        #: engine's lifetime instead of being retried on every batch
+        self._member_caps: Dict[Tuple, int] = {}
+        self._row_caps: Dict[Tuple, int] = {}
         self._counters: Dict[str, int] = {
             "requests": 0,  # batched_predict calls that enqueued
             "fallback": 0,  # ineligible calls answered None
@@ -148,6 +172,16 @@ class ServeEngine:
             "shed_deadline": 0,
             "warmup_programs": 0,
             "precision_degraded": 0,  # requests gated down to f32
+            # -- failure containment (this set distinguishes device
+            # errors from the deadline/queue_full admission sheds) --
+            "device_errors": 0,  # fused programs that raised device errors
+            "batch_bisects": 0,  # halvings while isolating a failure
+            "members_isolated": 0,  # failures pinned to a single member
+            "nonfinite_outputs": 0,  # poisoned (NaN/inf) member outputs
+            "breaker_rejects": 0,  # requests answered 503 by a breaker
+            "breaker_trips": 0,  # closed/half-open -> open transitions
+            "rung_demotions": 0,  # ladder rungs dropped after OOM
+            "oom_fallbacks": 0,  # single-member OOMs sent unbatched
         }
         #: requests coalesced per effective serving precision
         self._precision_counters: Dict[str, int] = {}
@@ -211,6 +245,13 @@ class ServeEngine:
         if spec is None or _find_estimator(model) is None:
             self._count("fallback")
             return None
+        # circuit breaker FIRST — before paying the host transform: a
+        # quarantined member answers 503 + Retry-After instead of riding
+        # batches (its cooldown is serving state, not admission load)
+        retry_after = self.breakers.quarantined(fleet, spec, name)
+        if retry_after is not None:
+            self._count("breaker_rejects")
+            raise MemberQuarantined(name, retry_after)
         # row count is decided before the (potentially expensive) host
         # transform: a fallback request must not pay the pipeline twice
         rows = int(len(X))
@@ -218,6 +259,31 @@ class ServeEngine:
         if rows == 0 or padded_rows is None:
             # taller than the ladder's top rung: an unbounded shape —
             # serve it unbatched rather than minting a program
+            self._count("fallback")
+            return None
+
+        # the effective serving precision: the spec's declared (or the
+        # engine-default) precision, degraded to f32 when the bucket's
+        # reduced program faulted mid-traffic (the breaker board's
+        # degrade set — one set probe) or the parity gate failed / has
+        # not passed yet (the governor — one COW dict probe)
+        desired = precision.resolve_precision(spec, self.config.precision)
+        prec = desired
+        if desired != precision.F32:
+            if self.breakers.degraded(fleet, spec, desired):
+                prec = precision.F32
+            else:
+                prec = self.governor.effective_precision(
+                    fleet, spec, desired, recorder=self._recorder
+                )
+            if prec != desired:
+                self._count("precision_degraded")
+
+        # an OOM-demoted row rung: requests that would pad to a rung the
+        # device already RESOURCE_EXHAUSTED on serve unbatched instead
+        # of re-OOMing the same shape forever
+        row_cap = self._row_caps.get((spec, prec))  # lock-free dict probe
+        if row_cap is not None and padded_rows > row_cap:
             self._count("fallback")
             return None
         transformed = _host_transform(model, X)
@@ -229,19 +295,9 @@ class ServeEngine:
             if rows == 0 or padded_rows is None:
                 self._count("fallback")
                 return None
-
-        # the effective serving precision: the spec's declared (or the
-        # engine-default) precision, gated down to f32 when the parity
-        # gate failed (or has not passed yet) — the governor's steady
-        # state is one COW dict probe per request
-        desired = precision.resolve_precision(spec, self.config.precision)
-        prec = desired
-        if desired != precision.F32:
-            prec = self.governor.effective_precision(
-                fleet, spec, desired, recorder=self._recorder
-            )
-            if prec != desired:
-                self._count("precision_degraded")
+            if row_cap is not None and padded_rows > row_cap:
+                self._count("fallback")
+                return None
 
         # row padding happens HERE, on the (otherwise waiting) request
         # thread — the dispatcher then stacks same-rung payloads in one
@@ -296,13 +352,20 @@ class ServeEngine:
         if timing is not None:
             for stage, seconds in meta.items():
                 timing.record(stage, seconds)
+        # recon is None when the member's SMALLEST fused program
+        # RESOURCE_EXHAUSTED (the rung was demoted): the caller falls
+        # back to the model's own unbatched predict
         return recon
 
     # -- batch execution (dispatcher thread) --------------------------------
 
-    def _run_batch(self, key, items: List[BatchItem]) -> None:
-        from ..server.fleet_store import fleet_forward_gather, serving_backend
+    def _fault_key(self, spec, prec: str, name: str) -> str:
+        """The chaos-harness key for one coalesced member:
+        ``<spec>:<precision>:<member>`` — rules glob any axis
+        (``*:bf16:*``, ``*:*:poison-*``)."""
+        return f"{type(spec).__name__}:{prec}:{name}"
 
+    def _run_batch(self, key, items: List[BatchItem]) -> None:
         fleet, spec, padded_rows, prec = key
         flush_start = time.monotonic()
         queue_waits = [flush_start - item.enqueued_at for item in items]
@@ -333,24 +396,21 @@ class ServeEngine:
                     return
                 members = len(live)
                 padded_members = ladder.pad_to(members, self.member_ladder)
-                indices = [bucket_rows[item.name] for item in live]
-                indices += [indices[0]] * (padded_members - members)
-                # payloads arrive pre-padded to this key's row rung: the
-                # whole batch stacks in ONE numpy call (per-item python
-                # work here gets GIL-starved under request load)
-                # payloads arrive at the effective precision's payload
-                # dtype (request-thread padding above); the stack
-                # inherits it — no silent upcast on the dispatcher
-                X = np.stack([item.payload for item in live])
-                if padded_members > members:
-                    padded = np.zeros(
-                        (padded_members, padded_rows, spec.n_features),
-                        precision.payload_dtype(prec),
-                    )
-                    padded[:members] = X
-                    X = padded
                 stack_s = time.monotonic() - stack_start
 
+            # results / failures / fallbacks for THIS batch: the scoring
+            # ladder below (fused program → bisection → per-member f32
+            # retry → breaker) fills them; only failures that survived
+            # isolation land in `failures`, each with its own exception
+            results: List[Tuple[BatchItem, np.ndarray]] = []
+            failures: List[Tuple[BatchItem, BaseException]] = []
+            fallbacks: List[BatchItem] = []
+            # bisection can run several programs per drained batch, each
+            # with its own payload stack — the ladder accumulates that
+            # host-side stacking time here so batch_stack keeps measuring
+            # stacking (a stack regression must not read as a phantom
+            # device slowdown)
+            timings = {"stack": 0.0}
             with self._recorder.span(
                 "device",
                 padded_members=padded_members,
@@ -358,43 +418,33 @@ class ServeEngine:
                 precision=prec,
             ):
                 device_start = time.monotonic()
-                # member gather happens INSIDE the program — one device
-                # dispatch per batch, not one per parameter leaf
-                recon = np.asarray(
-                    fleet_forward_gather(
-                        spec, stacked, np.asarray(indices, np.int32), X,
-                        precision=prec,
-                    )
+                self._score_live(
+                    fleet, spec, prec, padded_rows, live, stacked,
+                    bucket_rows, results, failures, fallbacks, timings,
                 )
-                device_s = time.monotonic() - device_start
+                device_s = (
+                    time.monotonic() - device_start - timings["stack"]
+                )
+            stack_s += timings["stack"]
 
-            backend = serving_backend(prec)
-            program = (spec, backend, padded_members, padded_rows, prec)
             with self._lock:
-                new_program = program not in self._programs
-                self._programs.add(program)
                 self._counters["batches"] += 1
                 self._counters["coalesced"] += members
                 self._precision_counters[prec] = (
                     self._precision_counters.get(prec, 0) + members
                 )
-            # serve-side compile-vs-cache-hit accounting (telemetry
-            # device console): a shape first seen here paid the XLA
-            # compile inside this batch's device call
-            note_program_execution(new_program, kind="serve", precision=prec)
 
             scatter_start = time.monotonic()
             with self._recorder.span("scatter"):
-                # recon is ONE host buffer for the whole batch (a single
-                # device→host transfer in np.asarray above); each request
-                # gets a zero-copy row VIEW of it, so scatter is pointer
-                # bookkeeping — the buffer lives as long as any view does.
-                # The per-item clock read is deliberate: batch_scatter
-                # must measure the loop's ACTUAL accumulated cost (a
-                # constant taken before the loop could never show a
-                # scatter regression, which is what the stage exists
-                # to surface).
-                for i, item in enumerate(live):
+                # each result's rows are a zero-copy VIEW of its fused
+                # program's single host buffer, so scatter is pointer
+                # bookkeeping — the buffer lives as long as any view
+                # does. The per-item clock read is deliberate:
+                # batch_scatter must measure the loop's ACTUAL
+                # accumulated cost (a constant taken before the loop
+                # could never show a scatter regression, which is what
+                # the stage exists to surface).
+                for item, rows in results:
                     meta = {
                         "queue_wait": flush_start - item.enqueued_at,
                         "batch_stack": stack_s,
@@ -402,8 +452,33 @@ class ServeEngine:
                         "batch_scatter": time.monotonic() - scatter_start,
                     }
                     try:
-                        item.future.set_result((recon[i, : item.rows], meta))
+                        fault_point(
+                            "serve_scatter",
+                            self._fault_key(spec, prec, item.name),
+                        )
+                        item.future.set_result((rows[: item.rows], meta))
+                    except FaultInjected as exc:
+                        # one rider's scatter failure is that rider's
+                        # problem — the loop keeps resolving the rest
+                        try:
+                            item.future.set_exception(
+                                ServeDeviceError(item.name, exc)
+                            )
+                        except Exception:  # noqa: BLE001 - waiter gave up
+                            pass
                     except Exception:  # noqa: BLE001 - waiter gave up (504'd)
+                        pass
+                for item in fallbacks:
+                    # the member's smallest fused program OOM'd: hand the
+                    # request back for the unbatched path (None contract)
+                    try:
+                        item.future.set_result((None, {}))
+                    except Exception:  # noqa: BLE001 - waiter gave up
+                        pass
+                for item, exc in failures:
+                    try:
+                        item.future.set_exception(exc)
+                    except Exception:  # noqa: BLE001 - waiter gave up
                         pass
 
             useful = sum(item.rows for item in live)
@@ -423,6 +498,7 @@ class ServeEngine:
                     spec, padded_members, padded_rows, prec
                 ),
                 device_ms=round(device_s * 1000.0, 3),
+                isolated_failures=len(failures),
             )
             # link back to every request span this batch coalesced, with
             # the per-request queue wait — the causal edge that makes a
@@ -446,6 +522,378 @@ class ServeEngine:
                     padding_waste=waste,
                 )
                 self.metrics.set_program_cache()
+            except Exception:  # noqa: BLE001 - metrics are advisory
+                pass
+
+    # -- failure containment (the scoring ladder) ---------------------------
+
+    def _score_live(
+        self,
+        fleet,
+        spec,
+        prec: str,
+        padded_rows: int,
+        live: List[BatchItem],
+        stacked,
+        bucket_rows: Dict[str, int],
+        results: List,
+        failures: List,
+        fallbacks: List,
+        timings: Optional[Dict[str, float]] = None,
+    ) -> None:
+        """
+        Score ``live`` with degradation, mirroring the build side's
+        ``FleetTrainer._run_bucket_degraded`` ladder: a device error
+        (``XlaRuntimeError`` / ``RESOURCE_EXHAUSTED``) from the fused
+        program BISECTS the batch and retries each half — an over-packed
+        shape resolves by splitting (and its rung is demoted), a
+        poisonous member is isolated down to a one-member program whose
+        failure is ITS OWN (``_member_failure``: precision degrade, then
+        the circuit breaker) instead of 500ing every coalesced rider.
+        Host-side exceptions propagate: they are deterministic, would
+        fail every half identically, and the batcher's backstop resolves
+        every waiter with a per-rider exception clone.
+        """
+        from ..parallel.fleet import is_device_error
+
+        # an OOM-demoted member rung: chunk oversized batches up front
+        # (not a bisect — the ladder already learned this shape's cap)
+        cap = self._member_caps.get((spec, prec))
+        if cap is not None and len(live) > cap:
+            for start in range(0, len(live), cap):
+                self._score_live(
+                    fleet, spec, prec, padded_rows, live[start:start + cap],
+                    stacked, bucket_rows, results, failures, fallbacks,
+                    timings,
+                )
+            return
+        try:
+            recon = self._fused_live(
+                spec, prec, padded_rows, live, stacked, bucket_rows, timings
+            )
+        except Exception as exc:
+            if not is_device_error(exc):
+                raise
+            self._count("device_errors")
+            self._note_resource_exhausted(
+                spec, prec, len(live), padded_rows, exc
+            )
+            if len(live) > 1:
+                self._count("batch_bisects")
+                self._recorder.event(
+                    "serve_bisect",
+                    members=len(live),
+                    precision=prec,
+                    error=repr(exc)[:200],
+                )
+                logger.warning(
+                    "fused serving program failed for %d coalesced "
+                    "member(s) (%s); bisecting",
+                    len(live),
+                    exc,
+                )
+                mid = len(live) // 2
+                self._score_live(
+                    fleet, spec, prec, padded_rows, live[:mid], stacked,
+                    bucket_rows, results, failures, fallbacks, timings,
+                )
+                self._score_live(
+                    fleet, spec, prec, padded_rows, live[mid:], stacked,
+                    bucket_rows, results, failures, fallbacks, timings,
+                )
+            else:
+                self._member_failure(
+                    fleet, spec, prec, padded_rows, live[0], exc,
+                    results, failures, fallbacks, timings,
+                )
+            return
+        for i, item in enumerate(live):
+            rows = recon[i]
+            try:
+                fault_point(
+                    "serve_member_poison",
+                    self._fault_key(spec, prec, item.name),
+                )
+            except FaultInjected:
+                rows = np.full_like(np.asarray(rows, np.float32), np.nan)
+            if self.config.finite_check and not bool(
+                np.isfinite(np.asarray(rows[: item.rows], np.float32)).all()
+            ):
+                payload = np.asarray(item.payload[: item.rows], np.float32)
+                if bool(np.isfinite(payload).all()):
+                    # finite input, non-finite output: the MEMBER is
+                    # poisoned (a NaN'd parameter never crashes the
+                    # program — it silently corrupts verdicts), and it
+                    # fails alone like a crashing one
+                    self._count("nonfinite_outputs")
+                    self._member_failure(
+                        fleet, spec, prec, padded_rows, item,
+                        FloatingPointError(
+                            f"non-finite output from member {item.name} "
+                            f"({prec}) for finite input"
+                        ),
+                        results, failures, fallbacks, timings,
+                    )
+                    continue
+                # non-finite INPUT rows are the client's data; the
+                # model's own predict would answer NaN exactly the same
+            results.append((item, rows))
+            self.breakers.record_success(fleet, spec, item.name)
+
+    def _fused_live(
+        self, spec, prec: str, padded_rows: int, live: List[BatchItem],
+        stacked, bucket_rows: Dict[str, int],
+        timings: Optional[Dict[str, float]] = None,
+    ) -> np.ndarray:
+        """ONE fused gather program over ``live`` (no degradation —
+        `_score_live` owns the ladder); returns the [n_live, padded_rows,
+        F] host buffer. Also the serve-side program/compile accounting,
+        since bisection means one drained batch can run several shapes."""
+        from ..server.fleet_store import fleet_forward_gather, serving_backend
+
+        for item in live:
+            fault_point(
+                "serve_device_program", self._fault_key(spec, prec, item.name)
+            )
+        stack_start = time.monotonic()
+        members = len(live)
+        padded_members = ladder.pad_to(members, self.member_ladder)
+        indices = [bucket_rows[item.name] for item in live]
+        indices += [indices[0]] * (padded_members - members)
+        # payloads arrive pre-padded to this key's row rung at the
+        # effective precision's payload dtype (request-thread padding):
+        # the whole batch stacks in ONE numpy call, and the stack
+        # inherits the dtype — no per-item python work, no silent
+        # upcast, on the dispatcher thread
+        X = np.stack([item.payload for item in live])
+        if padded_members > members:
+            padded = np.zeros(
+                (padded_members, padded_rows, spec.n_features),
+                precision.payload_dtype(prec),
+            )
+            padded[:members] = X
+            X = padded
+        if timings is not None:
+            # stacking is host work: it accrues to the batch_stack
+            # stage, not to the device interval wrapping this call
+            timings["stack"] += time.monotonic() - stack_start
+        # member gather happens INSIDE the program — one device dispatch
+        # per (sub-)batch, not one per parameter leaf
+        recon = np.asarray(
+            fleet_forward_gather(
+                spec, stacked, np.asarray(indices, np.int32), X,
+                precision=prec,
+            )
+        )
+        program = (
+            spec, serving_backend(prec), padded_members, padded_rows, prec,
+        )
+        with self._lock:
+            new_program = program not in self._programs
+            self._programs.add(program)
+        # serve-side compile-vs-cache-hit accounting (telemetry device
+        # console): a shape first seen here paid the XLA compile inside
+        # this batch's device call
+        note_program_execution(new_program, kind="serve", precision=prec)
+        return recon
+
+    def _member_failure(
+        self,
+        fleet,
+        spec,
+        prec: str,
+        padded_rows: int,
+        item: BatchItem,
+        exc: BaseException,
+        results: List,
+        failures: List,
+        fallbacks: List,
+        timings: Optional[Dict[str, float]] = None,
+    ) -> None:
+        """
+        One member failed in ISOLATION (a one-member program, or a
+        non-finite output). The remaining ladder, in order:
+
+        1. a pure-OOM failure (``RESOURCE_EXHAUSTED``) hands the request
+           back for the UNBATCHED path (its rung was demoted by
+           ``_note_resource_exhausted``; the member is not to blame for
+           an over-tall shape — and an OOM on a reduced-precision
+           program must NOT fail the bucket's parity verdict, nor would
+           a double-width f32 retry help);
+        2. a reduced-precision bucket DEGRADES to f32 and the member
+           retries through the f32 scoring ladder (PR 14's
+           ``precision_degraded`` path — a faulting bf16/int8 program
+           must not trip the breaker while f32 still serves);
+        3. anything else is this member's own failure: the breaker
+           records it (tripping into quarantine past the threshold) and
+           the rider — only this rider — gets a :class:`ServeDeviceError`.
+        """
+        if "RESOURCE_EXHAUSTED" in str(exc):
+            # an isolated OOM is a SHAPE problem, not member poison:
+            # the rung demotion already keeps future requests off it
+            self._count("oom_fallbacks")
+            fallbacks.append(item)
+            return
+        if prec != precision.F32:
+            self._degrade_bucket(fleet, spec, prec, exc)
+            self._count("precision_degraded")
+            try:
+                names32, stacked32 = fleet.spec_bucket(spec)
+            except Exception:  # noqa: BLE001 - no f32 bucket to retry on
+                names32, stacked32 = [], None
+            if item.name in names32:
+                rows32 = {n: i for i, n in enumerate(names32)}
+                item.payload = np.ascontiguousarray(item.payload, np.float32)
+                self._score_live(
+                    fleet, spec, precision.F32, padded_rows, [item],
+                    stacked32, rows32, results, failures, fallbacks,
+                    timings,
+                )
+                return
+        self._count("members_isolated")
+        logger.error(
+            "serving device program failed for member %s in isolation: %r",
+            item.name,
+            exc,
+        )
+        self._recorder.event(
+            "serve_member_isolated",
+            member=item.name,
+            precision=prec,
+            error=repr(exc)[:200],
+        )
+        self.breakers.record_failure(fleet, spec, item.name, exc)
+        failures.append((item, ServeDeviceError(item.name, exc)))
+
+    def _degrade_bucket(self, fleet, spec, prec: str, exc: BaseException) -> None:
+        """Pin a faulting reduced-precision bucket to f32: the breaker
+        board's degrade set covers the gate-disabled path, and a FAILED
+        gate verdict is recorded on the fleet so the governor, the
+        fleet-status gate reports and a later hot-swap all agree."""
+        if not self.breakers.degrade_bucket(fleet, spec, prec):
+            return  # already degraded: don't spam verdicts/logs
+        logger.warning(
+            "degrading (%s, %s) bucket to f32 after a device error: %r",
+            type(spec).__name__,
+            prec,
+            exc,
+        )
+        self._recorder.event(
+            "precision_degraded",
+            collection_dir=getattr(fleet, "collection_dir", ""),
+            precision=prec,
+            error=repr(exc)[:200],
+        )
+        try:
+            fleet.set_precision_state(
+                spec,
+                prec,
+                {
+                    "precision": prec,
+                    "spec": type(spec).__name__,
+                    "passed": False,
+                    "detail": f"device errors while serving {prec}: "
+                    f"{exc!r}"[:300],
+                },
+            )
+        except Exception:  # noqa: BLE001 - verdict bookkeeping is advisory
+            pass
+
+    def _note_resource_exhausted(
+        self, spec, prec: str, members: int, padded_rows: int, exc: BaseException
+    ) -> None:
+        """OOM containment: a ``RESOURCE_EXHAUSTED`` demotes the ladder
+        rung it happened on — the member axis while the batch is still
+        splittable, the row axis once a single member OOM'd — so the
+        engine stops retrying a shape the device already refused
+        (mirroring the planner's bisected-OOM rung drop)."""
+        if "RESOURCE_EXHAUSTED" not in str(exc):
+            return
+        demoted = None
+        with self._lock:
+            if members > 1:
+                padded = ladder.pad_to(members, self.member_ladder) or members
+                cap = max(1, padded // 2)
+                current = self._member_caps.get((spec, prec))
+                if current is None or cap < current:
+                    self._member_caps[(spec, prec)] = cap
+                    demoted = ("members", cap)
+            else:
+                lower = [r for r in self.config.row_ladder if r < padded_rows]
+                cap = max(lower) if lower else 0
+                current = self._row_caps.get((spec, prec))
+                if current is None or cap < current:
+                    self._row_caps[(spec, prec)] = cap
+                    demoted = ("rows", cap)
+        if demoted is None:
+            return
+        self._count("rung_demotions")
+        axis, cap = demoted
+        logger.warning(
+            "RESOURCE_EXHAUSTED at (%s members, %s rows, %s): capping the "
+            "%s ladder for %s at %d",
+            members,
+            padded_rows,
+            prec,
+            axis,
+            type(spec).__name__,
+            cap,
+        )
+        self._recorder.event(
+            "serve_rung_demoted",
+            spec=type(spec).__name__,
+            precision=prec,
+            axis=axis,
+            cap=cap,
+            error=repr(exc)[:200],
+        )
+
+    def _on_breaker_transition(
+        self, member: str, old: str, new: str, info: dict
+    ) -> None:
+        """Breaker state changes fan out to every observability surface:
+        engine counters, the span recorder (trace narration), the
+        fleet-health ledger (which the lifecycle supervisor reads to
+        nominate tripped members for rebuild), and Prometheus."""
+        if new == "open":
+            self._count("breaker_trips")
+        self._recorder.event(
+            "serve_breaker",
+            member=member,
+            old_state=old,
+            new_state=new,
+            trips=info.get("trips"),
+            cooldown_s=info.get("cooldown_s"),
+            error=info.get("last_error", ""),
+        )
+        try:
+            from ..telemetry import ledger_for
+
+            # the ANCHOR collection dir — the operator's stable handle,
+            # the same key the server's request feed and the lifecycle
+            # supervisor use. build_app wires it through the app's
+            # configurable MODEL_COLLECTION_DIR_ENV_VAR; the env read is
+            # the engine-without-an-app fallback (the default var name —
+            # a deployment contract, not a GORDO_TPU_* knob)
+            anchor = self.ledger_anchor or os.environ.get(
+                "MODEL_COLLECTION_DIR"
+            )
+            if anchor:
+                ledger_for(anchor).record_breaker(
+                    member,
+                    new,
+                    trips=info.get("trips"),
+                    cooldown_s=info.get("cooldown_s"),
+                    reason=info.get("last_error") or None,
+                )
+        except Exception:  # noqa: BLE001 - the ledger is advisory
+            logger.debug("breaker ledger feed failed", exc_info=True)
+        if self.metrics is not None:
+            try:
+                self.metrics.observe_breaker(new)
+                self.metrics.set_breaker_open(
+                    self.breakers.snapshot(detail_cap=0)["open"]
+                )
             except Exception:  # noqa: BLE001 - metrics are advisory
                 pass
 
@@ -577,7 +1025,19 @@ class ServeEngine:
                 "config": self.config.precision,
                 "coalesced": dict(self._precision_counters),
             }
+            demotions = {
+                "members": {
+                    f"{type(s).__name__}:{p}": cap
+                    for (s, p), cap in self._member_caps.items()
+                },
+                "rows": {
+                    f"{type(s).__name__}:{p}": cap
+                    for (s, p), cap in self._row_caps.items()
+                },
+            }
         stats["pending"] = self._batcher.pending()
+        stats["breaker"] = self.breakers.snapshot()
+        stats["demoted_rungs"] = demotions
         return stats
 
     def program_shapes(self) -> List[Tuple]:
@@ -604,6 +1064,11 @@ class ServeEngine:
             self._count("shed_queue_full", n)
         elif reason == "deadline":
             self._count("shed_deadline", n)
+        elif reason == "runner_error":
+            # the batcher's backstop fired: a non-device runner crash
+            # resolved every rider (distinct from device_errors, which
+            # the containment ladder caught and isolated)
+            self._count("shed_runner_error", n)
         if self.metrics is not None:
             try:
                 self.metrics.observe_shed(reason, n)

@@ -486,8 +486,15 @@ class GordoServerApp:
             anchor = os.environ.get(self.config["MODEL_COLLECTION_DIR_ENV_VAR"])
             if not anchor:
                 return
+            # 503 is backpressure (a breaker-quarantined member shedding
+            # its own traffic), not NEW failure evidence — the trip that
+            # caused it was already recorded by the breaker feed; letting
+            # every rejected retry mark an error would ratchet the
+            # machine's health down for the whole quarantine
             ledger_for(anchor, project=self.config.get("PROJECT") or "").record_request(
-                ctx.gordo_name, error=response.status_code >= 500
+                ctx.gordo_name,
+                error=response.status_code >= 500
+                and response.status_code != 503,
             )
         except Exception:  # noqa: BLE001 - health telemetry is advisory
             logger.debug("health ledger request not recorded", exc_info=True)
@@ -648,6 +655,12 @@ def build_app(
                 project=app.config.get("PROJECT"),
                 registry=app.prometheus_metrics.registry,
             )
+        # the ANCHOR dir the breaker feed should ledger against — wired
+        # through the app's configurable env-var name, the same
+        # indirection every other health feed resolves through (the
+        # engine's own fallback reads the default MODEL_COLLECTION_DIR)
+        if collection_dir:
+            engine.ledger_anchor = collection_dir
         _start_serve_warmup(app, engine)
     return app
 

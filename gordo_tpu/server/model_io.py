@@ -46,8 +46,11 @@ def batched_model_output(ctx, gordo_name: str, X) -> Optional[np.ndarray]:
     """
     The micro-batched reconstruction for one single-model request, or
     None when batching is off or this request is not batchable (caller
-    falls back to the model's own predict). The engine's admission
-    errors (:class:`gordo_tpu.serve.QueueFullError` → 429,
+    falls back to the model's own predict — including a demoted-rung
+    OOM fallback). The engine's admission and containment errors
+    (:class:`gordo_tpu.serve.QueueFullError` → 429,
+    :class:`gordo_tpu.serve.MemberQuarantined` → 503,
+    :class:`gordo_tpu.serve.ServeDeviceError` → 500,
     :class:`gordo_tpu.serve.DeadlineExceeded` → 504) propagate to the
     route, which maps them via :func:`shed_response`.
     """
@@ -62,10 +65,21 @@ def batched_model_output(ctx, gordo_name: str, X) -> Optional[np.ndarray]:
 
 
 def shed_response(ctx, exc):
-    """The backpressure response for an admission-control rejection:
-    429 + ``Retry-After`` for a full queue, 504 for a missed deadline —
-    overload degrades into flow control instead of OOMing the host."""
-    from ..serve import QueueFullError
+    """The flow-control / fault-containment response for a serving-plane
+    rejection (the full table lives in docs/serving.md "Error
+    contract"):
+
+    - 429 + ``Retry-After`` — the batch queue is full (overload degrades
+      into backpressure instead of OOMing the host);
+    - 503 + ``Retry-After`` — THIS member's circuit breaker is open (its
+      device programs kept failing); the ``Retry-After`` is the
+      breaker's remaining half-open backoff, mirroring the 429 contract;
+    - 500 — the device program failed for this request/member after the
+      engine's bisection isolated it (innocent coalesced riders already
+      answered 200);
+    - 504 — the request missed its batching deadline.
+    """
+    from ..serve import MemberQuarantined, QueueFullError, ServeDeviceError
 
     if isinstance(exc, QueueFullError):
         response = ctx.json_response(
@@ -76,6 +90,24 @@ def shed_response(ctx, exc):
             max(1, int(round(exc.retry_after_s)))
         )
         return response
+    if isinstance(exc, MemberQuarantined):
+        response = ctx.json_response(
+            {
+                "error": "Model is quarantined after repeated device "
+                "failures; retry later."
+            },
+            status=503,
+        )
+        response.headers["Retry-After"] = str(
+            max(1, int(round(exc.retry_after_s)))
+        )
+        return response
+    if isinstance(exc, ServeDeviceError):
+        # server-side: the text never echoes device internals (the cause
+        # is chained into the server log by the engine)
+        return ctx.json_response(
+            {"error": "Device scoring failed for this model."}, status=500
+        )
     return ctx.json_response(
         {"error": "Request timed out waiting for its batch."}, status=504
     )

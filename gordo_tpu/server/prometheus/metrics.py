@@ -772,9 +772,30 @@ class ServeMetrics:
             "gordo_server_batch_shed_total",
             "Requests shed by serving admission control, by reason "
             "(queue_full -> 429, deadline -> 504, cancelled = waiter "
-            "gave up before its batch ran)",
+            "gave up before its batch ran, runner_error = the batcher's "
+            "backstop resolved a crashed batch)",
             labelnames=labels + ["reason"],
             registry=self.registry,
+        )
+        # the serving circuit breakers (gordo_tpu.serve.breaker): the
+        # `state` label is the breaker vocabulary (open / half_open /
+        # closed) — bounded by construction
+        self.breaker_transitions = Counter(
+            "gordo_server_breaker_transitions_total",
+            "Per-member serving circuit-breaker state transitions, by "
+            "the state ENTERED (open = tripped into quarantine, "
+            "half_open = probing, closed = recovered)",
+            labelnames=labels + ["state"],
+            registry=self.registry,
+        )
+        self.breaker_open = Gauge(
+            "gordo_server_breaker_open_members",
+            "Members currently quarantined by an open serving circuit "
+            "breaker (answering 503 + Retry-After instead of riding "
+            "batches)",
+            labelnames=labels,
+            registry=self.registry,
+            multiprocess_mode="max",
         )
         register_program_cache_collector(self.registry)
         register_fleet_console_collectors(self.registry)
@@ -786,6 +807,14 @@ class ServeMetrics:
 
     def observe_shed(self, reason: str, n: int = 1):
         self.shed.labels(project=self.project, reason=reason).inc(n)
+
+    def observe_breaker(self, state: str):
+        self.breaker_transitions.labels(
+            project=self.project, state=state
+        ).inc()
+
+    def set_breaker_open(self, count: int):
+        self.breaker_open.labels(project=self.project).set(count)
 
     def set_queue_depth(self, depth: int):
         self.queue_depth.labels(project=self.project).set(depth)

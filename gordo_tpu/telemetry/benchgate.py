@@ -190,6 +190,46 @@ GATES: Dict[str, List[MetricSpec]] = {
             "truthy",
         ),
     ],
+    "serve-chaos": [
+        # the containment contract, verbatim: one poisoned member out of
+        # a coalesced fleet must never turn into innocent-rider 5xx
+        MetricSpec(
+            "innocent-rider 5xx during the device-fault drill",
+            "innocent_rider_5xx",
+            "max_bound",
+            bound=0.0,
+        ),
+        MetricSpec(
+            "poison member's breaker tripped into quarantine",
+            "breaker_tripped",
+            "truthy",
+        ),
+        MetricSpec(
+            "breaker recovered via its half-open probe",
+            "breaker_recovered",
+            "truthy",
+        ),
+        MetricSpec(
+            "health ledger narrated the trip and recovery",
+            "ledger_narrated",
+            "truthy",
+        ),
+        MetricSpec(
+            "hot-swap mid-drill dropped requests",
+            "swap_dropped",
+            "max_bound",
+            bound=0.0,
+        ),
+        # steady-state throughput under faults vs the no-fault floor:
+        # bisection + breaker quarantine must CONTAIN the poison, not
+        # drag the whole serving plane down with it
+        MetricSpec(
+            "faulted vs clean innocent-rider throughput (ratio)",
+            "throughput_ratio_faulted_vs_clean",
+            "min_bound",
+            bound=0.4,
+        ),
+    ],
     "slo-engine": [
         MetricSpec(
             "rollup aggregation throughput (spans/s)",
@@ -222,6 +262,7 @@ BASELINE_FILES: Dict[str, str] = {
     "fleet-health-overhead": "BENCH_FLEET_HEALTH.json",
     "slo-engine": "BENCH_SLO.json",
     "precision-ladder": "BENCH_PRECISION.json",
+    "serve-chaos": "BENCH_CHAOS.json",
 }
 
 

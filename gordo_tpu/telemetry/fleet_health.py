@@ -35,6 +35,7 @@ Stdlib-only, like the rest of the package: the device-memory section is
 """
 
 import contextlib
+import datetime
 import json
 import logging
 import os
@@ -118,7 +119,53 @@ def _new_machine() -> Dict[str, Any]:
             "reasons": [],
             "since": None,
         },
+        # the SERVING circuit breaker (gordo_tpu.serve.breaker): device
+        # programs for this member kept failing and the engine tripped
+        # it into quarantine (503 + Retry-After) — distinct from the
+        # lifecycle `quarantine` section (a rolled-back canary)
+        "breaker": {
+            "state": "closed",
+            "trips": 0,
+            "cooldown_s": None,
+            "reason": None,
+            "updated_at": None,
+        },
     }
+
+
+#: seconds after which a persisted breaker record stops influencing the
+#: displayed machine health: the record is written by the SERVING
+#: process on transitions only, so a dead server (or a revision swapped
+#: out from under its ledger) can never retire its own "open" — without
+#: an age cutoff a machine would display quarantined forever while
+#: serving 200s. Live breakers re-stamp on every transition (an actual
+#: quarantine refreshes itself through its half-open probes).
+BREAKER_STATE_MAX_AGE_S = 3600.0
+
+
+def _live_breaker_state(
+    machine: Dict[str, Any], max_age_s: float = BREAKER_STATE_MAX_AGE_S
+) -> Optional[str]:
+    """The machine's breaker state IF it is tripped and fresh enough to
+    trust, else None. Stamps are wall-clock ISO strings (they must
+    compare across processes and restarts, where monotonic can't
+    reach); ``.get`` everywhere so pre-breaker snapshots read closed."""
+    breaker = machine.get("breaker") or {}
+    state = breaker.get("state")
+    if state not in ("open", "half_open"):
+        return None
+    stamp = breaker.get("updated_at")
+    if max_age_s and stamp:
+        try:
+            age = (
+                datetime.datetime.now(datetime.timezone.utc)
+                - datetime.datetime.fromisoformat(str(stamp))
+            ).total_seconds()
+        except ValueError:
+            return state  # unparseable stamp: trust the state
+        if age > max_age_s:
+            return None
+    return state
 
 
 def health_score(machine: Dict[str, Any]) -> float:
@@ -130,6 +177,11 @@ def health_score(machine: Dict[str, Any]) -> float:
     score = 1.0
     if machine["quarantine"]["active"]:
         score -= 0.5
+    breaker_state = _live_breaker_state(machine)
+    if breaker_state == "open":
+        score -= 0.4
+    elif breaker_state == "half_open":
+        score -= 0.2
     if machine["build"]["degraded"] or machine["build"]["failed"]:
         score -= 0.3
     if machine["drift"]["drifted"]:
@@ -142,8 +194,13 @@ def health_score(machine: Dict[str, Any]) -> float:
 
 def machine_state(machine: Dict[str, Any]) -> str:
     """The machine's headline state, by severity: ``quarantined`` >
-    ``degraded`` (failed/degraded build) > ``drifting`` > ``healthy``."""
+    ``degraded`` (failed/degraded build) > ``drifting`` > ``healthy``.
+    A member whose serving circuit breaker is open (or probing
+    half-open) IS quarantined — the serving-plane twin of a rolled-back
+    canary."""
     if machine["quarantine"]["active"]:
+        return "quarantined"
+    if _live_breaker_state(machine) is not None:
         return "quarantined"
     if machine["build"]["degraded"] or machine["build"]["failed"]:
         return "degraded"
@@ -210,6 +267,9 @@ class NullLedger:
         pass
 
     def record_quarantine(self, *args, **kwargs):
+        pass
+
+    def record_breaker(self, *args, **kwargs):
         pass
 
     def record_promotion(self, *args, **kwargs):
@@ -423,19 +483,50 @@ class FleetHealthLedger:
                 quarantine["since"] = now
         self.write(force=True)
 
+    def record_breaker(
+        self,
+        machine: str,
+        state: str,
+        trips: Optional[int] = None,
+        cooldown_s: Optional[float] = None,
+        reason: Optional[str] = None,
+    ) -> None:
+        """The member's serving circuit-breaker state (fed by the serve
+        engine on every transition). An ``open`` record is what nominates
+        the member to the lifecycle supervisor as a rebuild candidate
+        (:func:`breaker_tripped_machines`); ``closed`` retires it."""
+        now = _iso(time.time())
+        with self._lock:
+            record = self._machine(machine).setdefault(
+                "breaker", _new_machine()["breaker"]
+            )
+            record["state"] = str(state)
+            if trips is not None:
+                record["trips"] = int(trips)
+            record["cooldown_s"] = cooldown_s
+            record["reason"] = str(reason)[:200] if reason else None
+            record["updated_at"] = now
+        # every breaker transition is a state change: force the snapshot
+        self.write(force=True)
+
     def record_promotion(
         self, revision: Optional[str], machines: Any = ()
     ) -> None:
         """A promoted revision: the rebuilt ``machines`` leave
-        quarantine and drift state (their windows restart against the
-        new artifacts), their build revision advances, and any
-        degraded/failed flags clear — a rebuild that passed the gates
-        and took traffic IS a successful build."""
+        quarantine, drift AND breaker state (their windows restart
+        against the new artifacts), their build revision advances, and
+        any degraded/failed flags clear — a rebuild that passed the
+        gates and took traffic IS a successful build."""
         with self._lock:
             for name in machines:
                 machine = self._machine(str(name))
                 machine["quarantine"] = _new_machine()["quarantine"]
                 machine["drift"] = _new_machine()["drift"]
+                # a tripped serving breaker drove (or rode along with)
+                # this rebuild: the fresh artifacts start closed — the
+                # engine's in-process breaker reset the same way when
+                # the hot-swap minted a new RevisionFleet
+                machine["breaker"] = _new_machine()["breaker"]
                 build = machine["build"]
                 build["degraded"] = False
                 build["failed"] = False
@@ -681,6 +772,7 @@ _SECTION_STAMPS = {
     "drift": "evaluated_at",
     "build": "built_at",
     "quarantine": "since",
+    "breaker": "updated_at",
 }
 
 
@@ -793,6 +885,34 @@ def load_merged_health(
         if "machines" in only and "summary" in only:
             return only
     return merge_health_documents(docs)
+
+
+def breaker_tripped_machines(
+    directory: str, max_age_s: float = 3600.0
+) -> Dict[str, Dict[str, Any]]:
+    """
+    Machines whose SERVING circuit breaker is currently tripped (open or
+    probing half-open), from the merged health snapshots under
+    ``directory`` — the feed the lifecycle supervisor reads to nominate
+    tripped members as rebuild candidates (the serve layer never imports
+    lifecycle; the ledger is the arrow between them).
+
+    ``max_age_s`` ignores stale trip records (the shared
+    :func:`_live_breaker_state` cutoff): a dead server (or a revision
+    swapped out from under its ledger) can never resolve its own
+    record, and a forgotten ``open`` stamp must not drive rebuild
+    canaries forever (the same reasoning as the SLO engine's
+    ``firing_alerts(max_age_s=...)``).
+    """
+    doc = load_merged_health(directory)
+    if not isinstance(doc, dict):
+        return {}
+    tripped: Dict[str, Dict[str, Any]] = {}
+    for name, record in (doc.get("machines") or {}).items():
+        if _live_breaker_state(record or {}, max_age_s=max_age_s) is None:
+            continue
+        tripped[str(name)] = dict((record or {}).get("breaker") or {})
+    return tripped
 
 
 # -- the joined fleet-status surface -----------------------------------------
@@ -1098,4 +1218,22 @@ def render_fleet_status(doc: Dict[str, Any]) -> str:
                     else ""
                 )
             )
+        breaker = serving.get("breaker") or {}
+        if breaker.get("open") or breaker.get("half_open") or breaker.get(
+            "trips"
+        ):
+            lines.append(
+                f"  breakers: {breaker.get('open', 0)} open, "
+                f"{breaker.get('half_open', 0)} half-open "
+                f"({breaker.get('trips', 0)} trip(s) total)"
+            )
+            for member in breaker.get("members", [])[:5]:
+                lines.append(
+                    f"    {member.get('member')}: {member.get('state')}"
+                    + (
+                        f", cooldown {member.get('cooldown_s')}s"
+                        if member.get("cooldown_s")
+                        else ""
+                    )
+                )
     return "\n".join(lines)

@@ -237,7 +237,10 @@ KNOBS: Dict[str, Knob] = _knobs(
         "Deterministic fault injection for drills/tests, e.g. "
         "`device_program:poison-*:times=inf` (sites: `data_fetch`, "
         "`device_program`, `dump_artifact`, `drift_eval`, `canary_build`, "
-        "`promote_swap`, `rollback`, `process_kill_after_n_machines`).",
+        "`promote_swap`, `rollback`, `process_kill_after_n_machines`, "
+        "and the serving sites `serve_device_program`, "
+        "`serve_member_poison`, `serve_scatter` keyed "
+        "`<spec>:<precision>:<member>`).",
         "Robustness",
     ),
     # -- Telemetry ---------------------------------------------------------
@@ -461,6 +464,37 @@ KNOBS: Dict[str, Knob] = _knobs(
         "`docs/serving.md`).",
         "Serving",
     ),
+    Knob(
+        "GORDO_TPU_SERVE_FINITE_CHECK", "bool", True,
+        "Scan every fused batch's output for non-finite (NaN/inf) rows: "
+        "a member producing them from FINITE input is poisoned and "
+        "fails alone (feeding its circuit breaker) instead of silently "
+        "corrupting anomaly verdicts.",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_BREAKER_THRESHOLD", "int", 3,
+        "Consecutive isolated device failures that trip a member's "
+        "serving circuit breaker into quarantine (503 + Retry-After).",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_BREAKER_COOLDOWN_S", "float", 30.0,
+        "Initial quarantine cooldown before a tripped member's breaker "
+        "half-opens and admits one probe request.",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_BREAKER_BACKOFF", "float", 2.0,
+        "Cooldown multiplier applied on every re-trip (a failed "
+        "half-open probe re-opens with a longer cooldown).",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_BREAKER_MAX_COOLDOWN_S", "float", 600.0,
+        "Cap on the exponential breaker cooldown.",
+        "Serving",
+    ),
     # -- Lifecycle ---------------------------------------------------------
     Knob(
         "GORDO_TPU_DRIFT_SIGMA", "float", 2.0,
@@ -539,6 +573,13 @@ KNOBS: Dict[str, Knob] = _knobs(
         "Hold lifecycle auto-promotions while a page-severity SLO "
         "burn-rate alert is firing (the canary keeps its traffic "
         "slice; `lifecycle promote --force` bypasses).",
+        "Lifecycle",
+    ),
+    Knob(
+        "GORDO_TPU_LIFECYCLE_BREAKER_REBUILD", "bool", True,
+        "Nominate members whose serving circuit breaker tripped (the "
+        "health ledger's `breaker` section) as rebuild candidates "
+        "alongside drifted ones.",
         "Lifecycle",
     ),
     # -- Reporters ---------------------------------------------------------

@@ -40,6 +40,22 @@ Sites instrumented today:
 - ``rollback`` — before a failed canary's rollback actions run (key:
   canary revision); a crash here must leave the rollback resumable so
   a restart still converges on the last-good revision.
+- ``serve_device_program`` — before a fused SERVING batch program runs,
+  once per coalesced member (key: ``<spec>:<precision>:<member>``, e.g.
+  ``FeedForwardSpec:f32:machine-3`` — glob any axis: ``*:bf16:*`` faults
+  every bf16 program, ``*:*:poison-*`` one member at every precision);
+  exercises the serve engine's batch bisection, precision degradation
+  and the per-member circuit breaker. The default exception's message
+  carries ``RESOURCE_EXHAUSTED`` (OOM-shaped — drives rung demotion);
+  use ``exc=InjectedDeviceError`` for a poison-member (non-OOM) fault.
+- ``serve_member_poison`` — after a fused serving program succeeds, once
+  per coalesced member (same key form); the engine converts a firing
+  into NaN output rows for that member, exercising non-finite-output
+  detection (a NaN-poisoned member must fail alone, not crash or
+  corrupt its batch).
+- ``serve_scatter`` — inside the engine's scatter loop, once per
+  resolved member (same key form); a scatter failure for one rider must
+  never leak into the other riders' futures.
 
 Rules fire deterministically: each rule counts the calls matching its
 (site, key-glob) and fires on calls ``after < i <= after + times``.
@@ -56,6 +72,13 @@ Env form (``;``-separated rules, fields ``site[:key-glob][:opt...]``)::
 
     GORDO_TPU_FAULTS="device_program:poison-*:times=inf"
     GORDO_TPU_FAULTS="process_kill_after_n_machines:*:after=500:kill"
+    GORDO_TPU_FAULTS="serve_device_program:*poison-1:exc=InjectedDeviceError"
+
+The env glob itself cannot contain ``:`` (it is the field separator);
+for the serving sites' composite ``<spec>:<precision>:<member>`` keys
+use a colon-free glob — ``*`` matches across ``:`` in fnmatch, so
+``*poison-1`` targets one member at every spec/precision (tests and the
+bench target single axes with :class:`FaultRule` via :func:`inject`).
 
 ``kill`` makes the rule ``os._exit(137)`` instead of raising — a true
 mid-build death for end-to-end resume drills; tests prefer the default
@@ -83,6 +106,9 @@ SITES = (
     "canary_build",
     "promote_swap",
     "rollback",
+    "serve_device_program",
+    "serve_member_poison",
+    "serve_scatter",
 )
 
 
@@ -128,7 +154,7 @@ class FaultRule:
             if isinstance(exc, BaseException):
                 return exc
             return exc(f"injected fault at {self.site}:{key}")
-        if self.site == "device_program":
+        if self.site in ("device_program", "serve_device_program"):
             return InjectedDeviceError(
                 f"RESOURCE_EXHAUSTED: injected device fault ({key})"
             )

@@ -75,6 +75,10 @@ case "$component" in
     # tests/lifecycle, tests/planner and tests/telemetry —
     # marker-selected like fleet_health/slo/wire/concurrency.
     precision) run -m "precision and not slow" tests/ ;;
+    # The serving fault-containment suite cuts across tests/serve,
+    # tests/server, tests/telemetry and tests/lifecycle —
+    # marker-selected the same way.
+    chaos)    run -m "chaos and not slow" tests/ ;;
     utils)    run -m "not slow" tests/utils ;;
     workflow) run -m "not slow" tests/workflow ;;
     formatting) run tests/test_codestyle.py ;;

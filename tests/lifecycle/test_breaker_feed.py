@@ -1,0 +1,103 @@
+"""
+The serve → lifecycle arrow for tripped circuit breakers: the engine
+records breaker state in the fleet-health ledger (telemetry), and the
+supervisor's detect phase reads it back to nominate tripped members as
+rebuild candidates — without serve ever importing lifecycle.
+"""
+
+import datetime
+
+import pytest
+
+from gordo_tpu import telemetry
+from gordo_tpu.telemetry.fleet_health import (
+    breaker_tripped_machines,
+    reset_ledgers,
+)
+
+from tests.lifecycle.conftest import BASE_REVISION, make_supervisor
+
+pytestmark = [pytest.mark.lifecycle, pytest.mark.chaos]
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledgers():
+    reset_ledgers()
+    yield
+    reset_ledgers()
+
+
+def record_trip(directory, machine, state="open", updated_at=None):
+    ledger = telemetry.ledger_for(directory)
+    ledger.record_breaker(
+        machine, state, trips=1, cooldown_s=30.0, reason="XlaRuntimeError"
+    )
+    if updated_at is not None:
+        # backdate the stamp (stale-record drills)
+        with ledger._lock:
+            ledger._machines[machine]["breaker"]["updated_at"] = updated_at
+        ledger.flush()
+
+
+def test_tripped_machines_read_back_from_snapshots(models_root):
+    import os
+
+    anchor = os.path.join(models_root, BASE_REVISION)
+    record_trip(anchor, "lc-1")
+    reset_ledgers()  # force the file path, like a separate process
+    tripped = breaker_tripped_machines(anchor)
+    assert list(tripped) == ["lc-1"]
+    assert tripped["lc-1"]["state"] == "open"
+
+
+def test_stale_trip_records_expire(models_root):
+    import os
+
+    anchor = os.path.join(models_root, BASE_REVISION)
+    old = (
+        datetime.datetime.now(datetime.timezone.utc)
+        - datetime.timedelta(hours=3)
+    ).isoformat()
+    record_trip(anchor, "lc-1", updated_at=old)
+    assert breaker_tripped_machines(anchor) == {}
+    assert breaker_tripped_machines(anchor, max_age_s=0) != {}
+
+
+def test_detect_nominates_tripped_member_for_rebuild(models_root):
+    supervisor = make_supervisor(models_root)
+    try:
+        record_trip(supervisor.collection_dir, "lc-2")
+        report = supervisor.run_cycle()
+        assert report.details.get("breaker_tripped") == ["lc-2"]
+        assert "lc-2" in report.stale
+        # one cycle can ride detect all the way into a serving canary —
+        # anything past idle means the trip drove a rebuild
+        assert supervisor.state.phase != "idle"
+    finally:
+        supervisor.close()
+
+
+def test_breaker_rebuild_knob_disables_the_feed(models_root):
+    supervisor = make_supervisor(models_root, breaker_rebuild=False)
+    try:
+        record_trip(supervisor.collection_dir, "lc-2")
+        report = supervisor.run_cycle()
+        assert "breaker_tripped" not in report.details
+        assert report.stale == []
+        assert supervisor.state.phase == "idle"
+    finally:
+        supervisor.close()
+
+
+def test_promotion_clears_breaker_state(models_root):
+    import os
+
+    anchor = os.path.join(models_root, BASE_REVISION)
+    record_trip(anchor, "lc-0")
+    ledger = telemetry.ledger_for(anchor)
+    assert breaker_tripped_machines(anchor)
+    ledger.record_promotion("101", ["lc-0"])
+    assert breaker_tripped_machines(anchor) == {}
+    doc = ledger.document()
+    assert doc["machines"]["lc-0"]["breaker"]["state"] == "closed"
+    assert doc["machines"]["lc-0"]["health"]["state"] == "healthy"

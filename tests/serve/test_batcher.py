@@ -213,3 +213,46 @@ def test_oversize_queue_splits_into_max_size_batches():
         assert sorted(len(names) for _, names in runner.batches) == [2, 2, 2]
     finally:
         batcher.shutdown()
+
+
+def test_runner_crash_gives_each_rider_its_own_exception_instance():
+    """The shared-exception fan-out fix: one exception object handed to
+    N request-handler threads is re-raised (and its traceback mutated)
+    concurrently — every rider must get a distinct clone instead."""
+
+    class WeirdError(Exception):
+        pass
+
+    def crash(key, items):
+        raise WeirdError("program exploded")
+
+    batcher = make(crash, max_size=4, max_delay_s=0.01)
+    try:
+        futures = [
+            batcher.submit("k", BatchItem(f"r{i}", None)) for i in range(3)
+        ]
+        raised = []
+        for future in futures:
+            with pytest.raises(WeirdError) as excinfo:
+                future.result(timeout=5)
+            raised.append(excinfo.value)
+        assert len({id(exc) for exc in raised}) == 3  # three instances
+        assert {str(exc) for exc in raised} == {"program exploded"}
+        # the original crash rides along as the cause for the log
+        assert all(type(exc.__cause__) is WeirdError for exc in raised)
+    finally:
+        batcher.shutdown()
+
+
+def test_runner_crash_clone_degrades_for_odd_constructors():
+    from gordo_tpu.serve.batcher import clone_exception
+
+    class Odd(Exception):
+        def __init__(self, a, b):  # can't rebuild from args=() spellings
+            super().__init__(f"{a}/{b}")
+            self.args = ()
+
+    original = Odd("x", "y")
+    clone = clone_exception(original)
+    assert isinstance(clone, RuntimeError)
+    assert clone.__cause__ is original

@@ -1,0 +1,235 @@
+"""
+Unit tests for the per-member serving circuit breaker
+(gordo_tpu/serve/breaker.py): the closed → open → half-open state
+machine, exponential backoff, the single-probe contract, transition
+hooks, and fleet-lifetime scoping. Pure stdlib — no JAX in the loop.
+"""
+
+import gc
+import threading
+
+import pytest
+
+from gordo_tpu.serve.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerBoard,
+    BreakerConfig,
+    MemberQuarantined,
+    ServeDeviceError,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.chaos]
+
+
+class FakeFleet:
+    """Stands in for a RevisionFleet: the board only needs identity."""
+
+
+SPEC = ("spec", 4)
+
+
+def make_board(**overrides):
+    defaults = dict(
+        threshold=3, cooldown_s=0.05, backoff=2.0, max_cooldown_s=0.4,
+        probe_ttl_s=0.2,
+    )
+    defaults.update(overrides)
+    return BreakerBoard(config=BreakerConfig(**defaults))
+
+
+def test_closed_until_threshold_consecutive_failures():
+    board = make_board()
+    fleet = FakeFleet()
+    exc = RuntimeError("boom")
+    assert board.quarantined(fleet, SPEC, "m-1") is None
+    assert not board.record_failure(fleet, SPEC, "m-1", exc)
+    assert not board.record_failure(fleet, SPEC, "m-1", exc)
+    assert board.quarantined(fleet, SPEC, "m-1") is None  # still closed
+    assert board.record_failure(fleet, SPEC, "m-1", exc)  # third trips
+    retry = board.quarantined(fleet, SPEC, "m-1")
+    assert retry is not None and retry > 0
+
+
+def test_success_resets_consecutive_count():
+    board = make_board()
+    fleet = FakeFleet()
+    exc = RuntimeError("boom")
+    board.record_failure(fleet, SPEC, "m-1", exc)
+    board.record_failure(fleet, SPEC, "m-1", exc)
+    board.record_success(fleet, SPEC, "m-1")
+    # the streak restarted: two more failures do NOT trip
+    board.record_failure(fleet, SPEC, "m-1", exc)
+    assert not board.record_failure(fleet, SPEC, "m-1", exc)
+    assert board.quarantined(fleet, SPEC, "m-1") is None
+
+
+def test_members_are_independent():
+    board = make_board(threshold=1)
+    fleet = FakeFleet()
+    board.record_failure(fleet, SPEC, "poison", RuntimeError("x"))
+    assert board.quarantined(fleet, SPEC, "poison") is not None
+    assert board.quarantined(fleet, SPEC, "innocent") is None
+
+
+def test_half_open_admits_exactly_one_probe(monkeypatch):
+    board = make_board(threshold=1, cooldown_s=0.01, probe_ttl_s=30.0)
+    fleet = FakeFleet()
+    board.record_failure(fleet, SPEC, "m-1", RuntimeError("x"))
+    deadline = threading.Event()
+    deadline.wait(0.03)  # let the cooldown lapse
+    assert board.quarantined(fleet, SPEC, "m-1") is None  # the probe
+    # a second concurrent request is NOT a probe: short retry-after
+    retry = board.quarantined(fleet, SPEC, "m-1")
+    assert retry is not None and retry > 0
+
+
+def test_probe_success_closes_and_probe_failure_reopens_with_backoff():
+    board = make_board(threshold=1, cooldown_s=0.01, backoff=3.0)
+    fleet = FakeFleet()
+    board.record_failure(fleet, SPEC, "m-1", RuntimeError("x"))
+    snap = board.snapshot()
+    assert snap["open"] == 1 and snap["trips"] == 1
+    first_cooldown = snap["members"][0]["cooldown_s"]
+    threading.Event().wait(0.03)
+    assert board.quarantined(fleet, SPEC, "m-1") is None  # half-open probe
+    assert board.snapshot()["half_open"] == 1
+    # probe fails: straight back to open, cooldown grows by backoff
+    board.record_failure(fleet, SPEC, "m-1", RuntimeError("still bad"))
+    snap = board.snapshot()
+    assert snap["open"] == 1 and snap["trips"] == 2
+    assert snap["members"][0]["cooldown_s"] > first_cooldown
+    threading.Event().wait(snap["members"][0]["cooldown_s"] + 0.02)
+    assert board.quarantined(fleet, SPEC, "m-1") is None  # probe again
+    board.record_success(fleet, SPEC, "m-1")  # probe came back healthy
+    snap = board.snapshot()
+    assert snap["open"] == 0 and snap["half_open"] == 0
+    assert board.quarantined(fleet, SPEC, "m-1") is None
+
+
+def test_cooldown_capped_at_max():
+    board = make_board(
+        threshold=1, cooldown_s=0.05, backoff=10.0, max_cooldown_s=0.2,
+        probe_ttl_s=30.0,
+    )
+    fleet = FakeFleet()
+    for _ in range(4):  # trip, probe-fail, probe-fail, probe-fail
+        board.record_failure(fleet, SPEC, "m-1", RuntimeError("x"))
+        threading.Event().wait(0.21)
+        board.quarantined(fleet, SPEC, "m-1")  # take the probe slot
+    detail = board.snapshot()["members"][0]
+    assert detail["cooldown_s"] <= 0.2
+
+
+def test_lost_probe_expires_and_another_request_probes():
+    board = make_board(threshold=1, cooldown_s=0.01, probe_ttl_s=0.02)
+    fleet = FakeFleet()
+    board.record_failure(fleet, SPEC, "m-1", RuntimeError("x"))
+    threading.Event().wait(0.03)
+    assert board.quarantined(fleet, SPEC, "m-1") is None  # probe admitted...
+    # ...but its request was shed and never reported back
+    threading.Event().wait(0.03)
+    assert board.quarantined(fleet, SPEC, "m-1") is None  # fresh probe
+
+
+def test_transition_hook_fires_outside_lock():
+    events = []
+
+    def hook(member, old, new, info):
+        events.append((member, old, new, info["trips"]))
+
+    board = BreakerBoard(
+        config=BreakerConfig(threshold=1, cooldown_s=0.01),
+        on_transition=hook,
+    )
+    fleet = FakeFleet()
+    board.record_failure(fleet, SPEC, "m-1", RuntimeError("x"))
+    threading.Event().wait(0.02)
+    board.quarantined(fleet, SPEC, "m-1")
+    board.record_success(fleet, SPEC, "m-1")
+    assert [(m, o, n) for m, o, n, _ in events] == [
+        ("m-1", CLOSED, OPEN),
+        ("m-1", OPEN, HALF_OPEN),
+        ("m-1", HALF_OPEN, CLOSED),
+    ]
+
+
+def test_success_on_untracked_member_is_noop():
+    board = make_board()
+    board.record_success(FakeFleet(), SPEC, "never-failed")
+    assert board.snapshot()["tracked"] == 0
+
+
+def test_degrade_set_is_per_fleet_and_idempotent():
+    board = make_board()
+    fleet = FakeFleet()
+    assert not board.degraded(fleet, SPEC, "bf16")
+    assert board.degrade_bucket(fleet, SPEC, "bf16")
+    assert not board.degrade_bucket(fleet, SPEC, "bf16")  # already
+    assert board.degraded(fleet, SPEC, "bf16")
+    assert not board.degraded(FakeFleet(), SPEC, "bf16")
+
+
+def test_dead_fleet_state_is_purged():
+    board = make_board(threshold=1)
+    fleet = FakeFleet()
+    board.record_failure(fleet, SPEC, "m-1", RuntimeError("x"))
+    board.degrade_bucket(fleet, SPEC, "bf16")
+    assert board.snapshot()["tracked"] == 1
+    del fleet
+    gc.collect()
+    snap = board.snapshot()
+    # a hot-swap/DELETE drops the fleet object: breaker state and the
+    # degrade set die with the revision — a rebuilt member starts clean
+    assert snap["tracked"] == 0
+    assert snap["degraded_buckets"] == 0
+
+
+def test_exception_types_carry_retry_after_and_member():
+    exc = MemberQuarantined("m-9", 12.3)
+    assert exc.retry_after_s == 12.3
+    assert exc.member == "m-9"
+    cause = RuntimeError("device text that must not echo")
+    wrapped = ServeDeviceError("m-9", cause)
+    assert wrapped.member == "m-9"
+    assert wrapped.__cause__ is cause
+    assert "device text" not in str(wrapped)
+
+
+def test_fleet_finalizer_never_takes_the_board_lock():
+    """The weakref finalizer runs inside the GC, which can trigger on an
+    allocation made WHILE the board lock is held — a finalizer that
+    locked would deadlock the serving plane. It must only enqueue."""
+    board = make_board(threshold=1)
+    fleet = FakeFleet()
+    board.record_failure(fleet, SPEC, "m-1", RuntimeError("x"))
+    with board._lock:  # simulate GC striking inside a locked section
+        del fleet
+        gc.collect()  # finalizer fires here; must not block on the lock
+    snap = board.snapshot()  # first locked call drains the purge queue
+    assert snap["tracked"] == 0
+
+
+def test_reused_fleet_id_never_resurrects_old_state():
+    """After a fleet dies, its id() can be handed to a NEW fleet; the
+    deferred purge must run before any probe could alias the old
+    revision's open breaker or degrade pin onto the new one."""
+    board = make_board(threshold=1)
+    fleet = FakeFleet()
+    board.record_failure(fleet, SPEC, "m-1", RuntimeError("x"))
+    board.degrade_bucket(fleet, SPEC, "bf16")
+    fid = id(fleet)
+    del fleet
+    gc.collect()
+
+    class Pinned(FakeFleet):
+        pass
+
+    # we can't force an id collision deterministically, but the drain
+    # contract is what prevents it: both probes must drain first
+    fresh = Pinned()
+    assert board.quarantined(fresh, SPEC, "m-1") is None
+    assert not board.degraded(fresh, SPEC, "bf16")
+    assert board.snapshot()["tracked"] == 0
+    assert fid is not None  # silence the linter; identity was the point

@@ -1,0 +1,407 @@
+"""
+Engine-level fault containment: injected device faults against the
+fused serving programs must bisect down to the poisonous member (the
+serving twin of PR 2's `_run_bucket_degraded` ladder), innocents must
+keep scoring, non-finite poison must be caught, OOM must demote its
+ladder rung, and repeated isolated failures must trip the member's
+circuit breaker.
+"""
+
+import numpy as np
+import pytest
+
+from gordo_tpu.serve import MemberQuarantined, ServeDeviceError
+from gordo_tpu.server.fleet_store import STORE
+from gordo_tpu.utils.faults import FaultRule, InjectedDeviceError, inject
+
+from tests.serve.conftest import (
+    BATCH_NAMES,
+    installed_engine,
+    run_threads,
+    temp_env_vars,
+    tiny_config,
+    warm_store,
+)
+
+pytestmark = [pytest.mark.serve, pytest.mark.chaos]
+
+ROWS = 6
+FEATURES = 4
+
+
+def payload_rows(seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.random((ROWS, FEATURES)).astype(np.float32)
+
+
+def concurrent_predict(engine, collection_dir, names, X=None):
+    """Score `names` concurrently through the engine (one thread per
+    name, coalescing window >> spawn jitter); returns name -> result
+    array or the raised exception."""
+    fleet = warm_store(collection_dir)
+    outcomes = {}
+
+    def hit(i):
+        name = names[i]
+        try:
+            outcomes[name] = engine.batched_predict(
+                collection_dir, name, fleet.model(name),
+                payload_rows() if X is None else X,
+            )
+        except Exception as exc:  # noqa: BLE001 - the assertion target
+            outcomes[name] = exc
+
+    errors = run_threads(len(names), hit)
+    assert not errors
+    return outcomes
+
+
+def test_transient_device_fault_bisects_and_everyone_scores(
+    serve_collection_dir,
+):
+    """One injected device error against a coalesced batch: bisection
+    retries the halves and every rider still gets its reconstruction."""
+    with installed_engine(tiny_config(max_delay_ms=250.0)) as engine:
+        reference = concurrent_predict(
+            engine, serve_collection_dir, BATCH_NAMES
+        )
+        rule = FaultRule(
+            "serve_device_program",
+            match="*:f32:batch-a",
+            times=1,
+            exc=InjectedDeviceError,
+        )
+        with inject(rule):
+            outcomes = concurrent_predict(
+                engine, serve_collection_dir, BATCH_NAMES
+            )
+        assert rule.fired == 1
+        for name in BATCH_NAMES:
+            assert isinstance(outcomes[name], np.ndarray), outcomes[name]
+            np.testing.assert_allclose(
+                outcomes[name], reference[name], rtol=1e-5, atol=1e-6
+            )
+        stats = engine.stats()
+        assert stats["device_errors"] >= 1
+        assert stats["batch_bisects"] >= 1
+        assert stats["members_isolated"] == 0
+        assert stats["breaker"]["tracked"] == 0 or (
+            stats["breaker"]["open"] == 0
+        )
+
+
+def test_poison_member_fails_alone_and_breaker_trips(serve_collection_dir):
+    """A persistently-poisonous member: innocents answer normally on
+    every batch, only the poison rider errors, and past the threshold
+    the breaker quarantines it (503 material) instead of re-bisecting
+    every batch it touches."""
+    with temp_env_vars(
+        GORDO_TPU_BREAKER_THRESHOLD="2",
+        GORDO_TPU_BREAKER_COOLDOWN_S="30",
+    ):
+        with installed_engine(tiny_config(max_delay_ms=250.0)) as engine:
+            rule = FaultRule(
+                "serve_device_program",
+                match="*:f32:batch-a",
+                times=None,
+                exc=InjectedDeviceError,
+            )
+            with inject(rule):
+                first = concurrent_predict(
+                    engine, serve_collection_dir, BATCH_NAMES
+                )
+                assert isinstance(first["batch-a"], ServeDeviceError)
+                assert isinstance(first["batch-b"], np.ndarray)
+                assert isinstance(first["batch-c"], np.ndarray)
+                second = concurrent_predict(
+                    engine, serve_collection_dir, BATCH_NAMES
+                )
+                # second isolated failure crossed the threshold: tripped
+                assert isinstance(second["batch-a"], ServeDeviceError)
+                third = concurrent_predict(
+                    engine, serve_collection_dir, BATCH_NAMES
+                )
+                # quarantined: rejected BEFORE riding a batch, with a
+                # Retry-After; innocents still score
+                assert isinstance(third["batch-a"], MemberQuarantined)
+                assert third["batch-a"].retry_after_s > 0
+                assert isinstance(third["batch-b"], np.ndarray)
+            stats = engine.stats()
+            assert stats["members_isolated"] >= 2
+            assert stats["breaker_trips"] == 1
+            assert stats["breaker_rejects"] >= 1
+            snap = stats["breaker"]
+            assert snap["open"] == 1
+            assert snap["members"][0]["member"] == "batch-a"
+
+
+def test_breaker_recovers_via_half_open_probe(serve_collection_dir):
+    """Faults stop; after the cooldown the next request probes the
+    member through a real fused program and recovery closes the
+    breaker."""
+    import threading
+
+    with temp_env_vars(
+        GORDO_TPU_BREAKER_THRESHOLD="1",
+        GORDO_TPU_BREAKER_COOLDOWN_S="0.2",
+    ):
+        with installed_engine(tiny_config(max_delay_ms=30.0)) as engine:
+            fleet = warm_store(serve_collection_dir)
+            model = fleet.model("batch-a")
+            rule = FaultRule(
+                "serve_device_program",
+                match="*:f32:batch-a",
+                times=1,
+                exc=InjectedDeviceError,
+            )
+            with inject(rule):
+                with pytest.raises(ServeDeviceError):
+                    engine.batched_predict(
+                        serve_collection_dir, "batch-a", model, payload_rows()
+                    )
+            with pytest.raises(MemberQuarantined):
+                engine.batched_predict(
+                    serve_collection_dir, "batch-a", model, payload_rows()
+                )
+            threading.Event().wait(0.3)
+            # the probe request: admitted, scores cleanly, closes the
+            # breaker — and everything after flows freely
+            recon = engine.batched_predict(
+                serve_collection_dir, "batch-a", model, payload_rows()
+            )
+            assert isinstance(recon, np.ndarray)
+            assert engine.stats()["breaker"]["open"] == 0
+            recon = engine.batched_predict(
+                serve_collection_dir, "batch-a", model, payload_rows()
+            )
+            assert isinstance(recon, np.ndarray)
+
+
+def test_nonfinite_output_is_member_poison(serve_collection_dir):
+    """A member answering NaN rows for FINITE input fails alone (and
+    feeds its breaker) — NaN poison must not ride the wire as a silent
+    verdict corruption, and must not touch innocent riders."""
+    with temp_env_vars(GORDO_TPU_BREAKER_THRESHOLD="10"):
+        with installed_engine(tiny_config(max_delay_ms=250.0)) as engine:
+            rule = FaultRule(
+                "serve_member_poison", match="*:f32:batch-b", times=None
+            )
+            with inject(rule):
+                outcomes = concurrent_predict(
+                    engine, serve_collection_dir, BATCH_NAMES
+                )
+            assert isinstance(outcomes["batch-b"], ServeDeviceError)
+            assert isinstance(outcomes["batch-a"], np.ndarray)
+            assert np.isfinite(outcomes["batch-a"]).all()
+            assert isinstance(outcomes["batch-c"], np.ndarray)
+            stats = engine.stats()
+            assert stats["nonfinite_outputs"] >= 1
+            assert stats["members_isolated"] >= 1
+
+
+def test_nonfinite_input_stays_the_clients_problem(serve_collection_dir):
+    """NaN rows IN mean NaN rows OUT — exactly what the unbatched path
+    answers; the member is not blamed and the breaker stays untouched."""
+    with installed_engine(tiny_config(max_delay_ms=30.0)) as engine:
+        fleet = warm_store(serve_collection_dir)
+        X = payload_rows()
+        X[2, 1] = np.nan
+        recon = engine.batched_predict(
+            serve_collection_dir, "batch-a", fleet.model("batch-a"), X
+        )
+        assert isinstance(recon, np.ndarray)
+        stats = engine.stats()
+        assert stats["nonfinite_outputs"] == 0
+        assert stats["breaker"]["tracked"] == 0
+
+
+def test_single_member_oom_demotes_rung_and_falls_back(serve_collection_dir):
+    """RESOURCE_EXHAUSTED with nothing left to bisect is a SHAPE
+    problem: the request hands back to the unbatched path (no error, no
+    breaker penalty) and the rung is demoted so the engine never
+    retries it."""
+    with installed_engine(tiny_config(max_delay_ms=30.0)) as engine:
+        fleet = warm_store(serve_collection_dir)
+        model = fleet.model("batch-a")
+        # default serve_device_program exception message carries
+        # RESOURCE_EXHAUSTED — the OOM-shaped fault
+        rule = FaultRule(
+            "serve_device_program", match="*:f32:batch-a", times=1
+        )
+        with inject(rule):
+            recon = engine.batched_predict(
+                serve_collection_dir, "batch-a", model, payload_rows()
+            )
+        assert recon is None  # unbatched fallback, not a 500
+        stats = engine.stats()
+        assert stats["oom_fallbacks"] == 1
+        assert stats["rung_demotions"] == 1
+        assert stats["breaker"]["tracked"] == 0  # OOM never blames the member
+        # the demoted rung is remembered: the same request shape now
+        # falls back WITHOUT riding a batch (no fused program retry)
+        assert (
+            engine.batched_predict(
+                serve_collection_dir, "batch-a", model, payload_rows()
+            )
+            is None
+        )
+
+
+def test_coalesced_oom_demotes_member_axis(serve_collection_dir):
+    """A multi-member RESOURCE_EXHAUSTED halves the member-axis cap for
+    that program key while bisection rescues the in-flight batch."""
+    with installed_engine(tiny_config(max_delay_ms=250.0)) as engine:
+        rule = FaultRule("serve_device_program", match="*:f32:*", times=1)
+        with inject(rule):
+            outcomes = concurrent_predict(
+                engine, serve_collection_dir, BATCH_NAMES
+            )
+        for name in BATCH_NAMES:
+            assert isinstance(outcomes[name], np.ndarray)
+        stats = engine.stats()
+        assert stats["rung_demotions"] >= 1
+        assert list(stats["demoted_rungs"]["members"].values()) == [2]
+
+
+def test_scatter_fault_is_isolated_to_its_rider(serve_collection_dir):
+    with installed_engine(tiny_config(max_delay_ms=250.0)) as engine:
+        rule = FaultRule("serve_scatter", match="*:f32:batch-c", times=1)
+        with inject(rule):
+            outcomes = concurrent_predict(
+                engine, serve_collection_dir, BATCH_NAMES
+            )
+        assert isinstance(outcomes["batch-c"], ServeDeviceError)
+        assert isinstance(outcomes["batch-a"], np.ndarray)
+        assert isinstance(outcomes["batch-b"], np.ndarray)
+
+
+def test_reduced_precision_faults_degrade_to_f32_before_breaker(
+    serve_collection_dir,
+):
+    """The precision-degradation ladder (the PR 14 path under device
+    errors): a bf16 program that starts faulting degrades that bucket to
+    f32 — requests keep answering, the breaker is NOT charged — and only
+    when f32 fails too does the member trip."""
+    with temp_env_vars(
+        GORDO_TPU_SERVE_PRECISION="bf16",
+        GORDO_TPU_PRECISION_GATE="0",
+        GORDO_TPU_BREAKER_THRESHOLD="2",
+        GORDO_TPU_BREAKER_COOLDOWN_S="30",
+    ):
+        with installed_engine(
+            tiny_config(serve_precision="bf16")
+        ) as engine:
+            fleet = warm_store(serve_collection_dir)
+            model = fleet.model("batch-a")
+            bf16_rule = FaultRule(
+                "serve_device_program",
+                match="*:bf16:*",
+                times=None,
+                exc=InjectedDeviceError,
+            )
+            with inject(bf16_rule):
+                recon = engine.batched_predict(
+                    serve_collection_dir, "batch-a", model, payload_rows()
+                )
+                # served — at f32, after the bucket degraded
+                assert isinstance(recon, np.ndarray)
+                stats = engine.stats()
+                assert stats["precision_degraded"] >= 1
+                assert stats["breaker"]["tracked"] == 0
+                assert stats["breaker"]["degraded_buckets"] == 1
+                # the degrade is sticky: the next request goes straight
+                # to f32 (the bf16 rule never fires again)
+                fired = bf16_rule.fired
+                recon = engine.batched_predict(
+                    serve_collection_dir, "batch-a", model, payload_rows()
+                )
+                assert isinstance(recon, np.ndarray)
+                assert bf16_rule.fired == fired
+            # the fleet's gate verdict narrates the degrade too
+            reports = fleet.precision_reports()
+            assert any(
+                r.get("precision") == "bf16" and r.get("passed") is False
+                for r in reports
+            )
+            # phase two: f32 faults as well -> the breaker takes over
+            f32_rule = FaultRule(
+                "serve_device_program",
+                match="*:f32:batch-a",
+                times=None,
+                exc=InjectedDeviceError,
+            )
+            with inject(f32_rule):
+                with pytest.raises(ServeDeviceError):
+                    engine.batched_predict(
+                        serve_collection_dir, "batch-a", model, payload_rows()
+                    )
+                with pytest.raises(ServeDeviceError):
+                    engine.batched_predict(
+                        serve_collection_dir, "batch-a", model, payload_rows()
+                    )
+                with pytest.raises(MemberQuarantined):
+                    engine.batched_predict(
+                        serve_collection_dir, "batch-a", model, payload_rows()
+                    )
+            assert engine.stats()["breaker"]["open"] == 1
+
+
+def test_reduced_precision_oom_falls_back_without_degrading_the_bucket(
+    serve_collection_dir,
+):
+    """An isolated RESOURCE_EXHAUSTED on a bf16 program is a SHAPE
+    problem: unbatched fallback, rung demoted — but the bucket's parity
+    verdict must NOT fail (OOM says nothing about bf16 correctness, and
+    a double-width f32 retry would only OOM harder)."""
+    with temp_env_vars(
+        GORDO_TPU_SERVE_PRECISION="bf16", GORDO_TPU_PRECISION_GATE="0"
+    ):
+        with installed_engine(
+            tiny_config(serve_precision="bf16")
+        ) as engine:
+            fleet = warm_store(serve_collection_dir)
+            model = fleet.model("batch-a")
+            # the session fleet may carry verdicts from earlier tests:
+            # what matters is that THIS drill adds none
+            reports_before = fleet.precision_reports()
+            # default exception message carries RESOURCE_EXHAUSTED
+            rule = FaultRule(
+                "serve_device_program", match="*:bf16:batch-a", times=1
+            )
+            with inject(rule):
+                recon = engine.batched_predict(
+                    serve_collection_dir, "batch-a", model, payload_rows()
+                )
+            assert recon is None  # unbatched fallback, not an error
+            stats = engine.stats()
+            assert stats["oom_fallbacks"] == 1
+            assert stats["rung_demotions"] == 1
+            assert stats["breaker"]["degraded_buckets"] == 0
+            assert stats["breaker"]["tracked"] == 0
+            # no NEW failed verdict: OOM says nothing about parity
+            assert fleet.precision_reports() == reports_before
+
+
+def test_breaker_ledger_feed_uses_the_wired_anchor(
+    serve_collection_dir, tmp_path
+):
+    """build_app wires engine.ledger_anchor through the app's
+    configurable collection-dir env var; the transition feed must honor
+    it instead of hardcoding MODEL_COLLECTION_DIR."""
+    from gordo_tpu import telemetry
+    from gordo_tpu.telemetry.fleet_health import reset_ledgers
+
+    reset_ledgers()
+    try:
+        with temp_env_vars(GORDO_TPU_BREAKER_THRESHOLD="1"):
+            with installed_engine(tiny_config()) as engine:
+                engine.ledger_anchor = str(tmp_path)
+                fleet = warm_store(serve_collection_dir)
+                spec = fleet.loaded_specs()["batch-a"]
+                engine.breakers.record_failure(
+                    fleet, spec, "batch-a", RuntimeError("boom")
+                )
+                doc = telemetry.ledger_for(str(tmp_path)).document()
+                assert doc["machines"]["batch-a"]["breaker"]["state"] == "open"
+    finally:
+        reset_ledgers()

@@ -498,3 +498,48 @@ def test_fleet_console_collectors_on_every_scrape_registry(
     finally:
         reset_ledgers()
         device.reset_program_counters()
+
+
+def test_serve_metrics_breaker_counters_and_gauge():
+    """The serving circuit-breaker metric set (PR 15): transitions by
+    entered state (bounded vocabulary) and the open-member gauge."""
+    import pytest
+
+    pytest.importorskip("prometheus_client")
+    from gordo_tpu.server.prometheus.metrics import ServeMetrics
+
+    registry = CollectorRegistry()
+    metrics = ServeMetrics(project="p", registry=registry)
+    metrics.observe_breaker("open")
+    metrics.observe_breaker("half_open")
+    metrics.observe_breaker("closed")
+    metrics.observe_breaker("open")
+    metrics.set_breaker_open(1)
+    metrics.observe_shed("runner_error")
+    assert (
+        registry.get_sample_value(
+            "gordo_server_breaker_transitions_total",
+            {"project": "p", "state": "open"},
+        )
+        == 2
+    )
+    assert (
+        registry.get_sample_value(
+            "gordo_server_breaker_transitions_total",
+            {"project": "p", "state": "closed"},
+        )
+        == 1
+    )
+    assert (
+        registry.get_sample_value(
+            "gordo_server_breaker_open_members", {"project": "p"}
+        )
+        == 1
+    )
+    assert (
+        registry.get_sample_value(
+            "gordo_server_batch_shed_total",
+            {"project": "p", "reason": "runner_error"},
+        )
+        == 1
+    )

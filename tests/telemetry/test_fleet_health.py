@@ -66,6 +66,7 @@ MACHINE_SECTIONS = {
         "built_at",
     },
     "quarantine": {"active", "revision", "reasons", "since"},
+    "breaker": {"state", "trips", "cooldown_s", "reason", "updated_at"},
     "health": {"score", "state"},
 }
 
@@ -391,3 +392,120 @@ def test_fleet_status_cli_missing_directory():
     result = CliRunner().invoke(fleet_status_cmd, ["/no/such/dir"])
     assert result.exit_code != 0
     assert "No such directory" in result.output
+
+
+# -- the serving circuit-breaker section (PR 15) ------------------------------
+
+
+@pytest.mark.chaos
+def test_record_breaker_trip_and_recovery(tmp_path):
+    ledger = make_ledger(tmp_path)
+    ledger.record_breaker(
+        "m-1", "open", trips=2, cooldown_s=60.0, reason="XlaRuntimeError(...)"
+    )
+    doc = ledger.document()
+    record = doc["machines"]["m-1"]
+    assert record["breaker"]["state"] == "open"
+    assert record["breaker"]["trips"] == 2
+    assert record["breaker"]["cooldown_s"] == 60.0
+    assert record["breaker"]["updated_at"]
+    # an open breaker IS a serving quarantine in the headline state,
+    # and it costs health score
+    assert record["health"]["state"] == "quarantined"
+    assert record["health"]["score"] < 1.0
+    assert doc["summary"]["quarantined"] == 1
+    ledger.record_breaker("m-1", "closed", trips=2)
+    record = ledger.document()["machines"]["m-1"]
+    assert record["breaker"]["state"] == "closed"
+    assert record["health"]["state"] == "healthy"
+
+
+@pytest.mark.chaos
+def test_breaker_state_transitions_force_snapshot_writes(tmp_path):
+    ledger = make_ledger(tmp_path, heartbeat_seconds=3600.0)
+    ledger.record_breaker("m-1", "open", trips=1)
+    doc = load_health(str(tmp_path))
+    assert doc["machines"]["m-1"]["breaker"]["state"] == "open"
+
+
+@pytest.mark.chaos
+def test_breaker_section_merges_newest_stamp_wins(tmp_path):
+    from gordo_tpu.telemetry.fleet_health import merge_health_documents
+
+    older = make_ledger(tmp_path / "a")
+    older.record_breaker("m-1", "open", trips=1)
+    doc_a = older.document()
+    newer = make_ledger(tmp_path / "b")
+    newer.record_breaker("m-1", "closed", trips=1)
+    doc_b = newer.document()
+    # force the ordering regardless of wall-clock resolution
+    doc_a["machines"]["m-1"]["breaker"]["updated_at"] = "2026-01-01T00:00:00+00:00"
+    doc_b["machines"]["m-1"]["breaker"]["updated_at"] = "2026-01-02T00:00:00+00:00"
+    merged = merge_health_documents([doc_a, doc_b])
+    assert merged["machines"]["m-1"]["breaker"]["state"] == "closed"
+    merged = merge_health_documents([doc_b, doc_a])
+    assert merged["machines"]["m-1"]["breaker"]["state"] == "closed"
+
+
+@pytest.mark.chaos
+def test_pre_breaker_snapshots_restore_cleanly(tmp_path):
+    """Snapshots persisted before the breaker section existed load
+    without it and read as healthy/closed."""
+    ledger = make_ledger(tmp_path)
+    ledger.record_request("m-1")
+    doc = ledger.document()
+    for record in doc["machines"].values():
+        record.pop("breaker", None)
+    fresh = make_ledger(tmp_path / "fresh")
+    fresh.restore(doc)
+    restored = fresh.document()["machines"]["m-1"]
+    assert restored["breaker"]["state"] == "closed"
+    assert restored["health"]["state"] == "healthy"
+
+
+@pytest.mark.chaos
+def test_render_fleet_status_shows_breaker_state(tmp_path):
+    from gordo_tpu.telemetry.fleet_health import render_fleet_status
+
+    ledger = make_ledger(tmp_path)
+    ledger.record_request("m-1")
+    doc = fleet_status_document(
+        str(tmp_path),
+        serving={
+            "precision": {"config": "f32", "coalesced": {}},
+            "gates": [],
+            "breaker": {
+                "open": 1,
+                "half_open": 0,
+                "trips": 2,
+                "members": [
+                    {"member": "m-1", "state": "open", "cooldown_s": 60.0}
+                ],
+            },
+        },
+    )
+    rendered = render_fleet_status(doc)
+    assert "breakers: 1 open" in rendered
+    assert "m-1: open, cooldown 60.0s" in rendered
+
+
+@pytest.mark.chaos
+def test_stale_breaker_record_stops_reading_quarantined(tmp_path):
+    """A dead server's forgotten 'open' record must not display a
+    serving machine as quarantined forever: past the staleness cutoff
+    the headline state and score read the breaker as retired."""
+    import datetime
+
+    ledger = make_ledger(tmp_path)
+    ledger.record_breaker("m-1", "open", trips=1, cooldown_s=30.0)
+    fresh = ledger.machine("m-1")
+    assert fresh["health"]["state"] == "quarantined"
+    old = (
+        datetime.datetime.now(datetime.timezone.utc)
+        - datetime.timedelta(hours=3)
+    ).isoformat()
+    with ledger._lock:
+        ledger._machines["m-1"]["breaker"]["updated_at"] = old
+    stale = ledger.machine("m-1")
+    assert stale["health"]["state"] == "healthy"
+    assert stale["health"]["score"] == 1.0
