@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-concurrency test-fleet-health test-slo test-precision test-chaos docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-precision bench-chaos bench-check lint lint-gordo lockgraph-check image
+.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-concurrency test-fleet-health test-slo test-precision test-chaos test-scale docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-precision bench-chaos bench-scale bench-check lint lint-gordo lockgraph-check image
 
 test:
 	python -m pytest tests/ -q
@@ -114,6 +114,22 @@ test-chaos:
 # BENCH_CHAOS.json (gated by `gordo-tpu bench-check`).
 bench-chaos:
 	JAX_PLATFORMS=cpu python benchmarks/bench_chaos.py
+
+# The fleet-scale observability suite: sharded ledger layout/migration/
+# dirty-flush contracts, rollup-manifest counting-open reads, bounded
+# fleet-status selection/paging, the 5k-member breaker-summary guard —
+# CPU-only and not slow-marked, so the same tests also run inside the
+# tier-1 budget.
+test-scale:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m scale
+
+# Fleet-scale observability harness: the synthetic-fleet generator
+# (benchmarks/fleetgen.py) drives build-plan, sharded health ledger,
+# rollup manifest, bounded fleet-status, breaker board and prometheus
+# scrape at N in {100, 1k, 10k}; writes BENCH_SCALE.json (gated by
+# `gordo-tpu bench-check`).
+bench-scale:
+	JAX_PLATFORMS=cpu python benchmarks/bench_scale.py
 
 # SLO-engine bench: aggregation throughput (spans/s), steady-state
 # evaluation overhead vs the telemetry-on floor (<=2% is the gate), and

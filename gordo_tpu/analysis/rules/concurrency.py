@@ -316,7 +316,14 @@ def _build_scope_model(
     while changed:
         changed = False
         for caller in model.functions.values():
-            caller_contexts = model.occurrence_contexts(caller.name)
+            # propagate only contexts established so far — the
+            # empty-context DEFAULT is a check-time fallback, not a real
+            # context. Using occurrence_contexts() here would let a
+            # not-yet-seeded private caller inject a spurious unlocked
+            # context into its callees on the first sweep, and the
+            # monotone accumulation would never retract it (false
+            # lock-guard positives on two-level locked call chains).
+            caller_contexts = model.contexts.get(caller.name) or ()
             for callee, held in caller.calls:
                 if callee not in model.functions:
                     continue

@@ -810,7 +810,37 @@ def build_status(output_dir: str, as_json: bool, watch: Optional[float]):
     type=float,
     help="Re-render every N seconds (Ctrl-C to stop)",
 )
-def fleet_status(directory: str, as_json: bool, watch: Optional[float]):
+@click.option(
+    "--machines",
+    "machines",
+    default=None,
+    help="Per-machine record selection: `all`, `none`, a state "
+    "(`healthy`/`degraded`/`drifting`/`quarantined`/`unhealthy`) or a "
+    "comma-separated name list. Default: inline while the fleet is "
+    "small, summary + top-K offenders past "
+    "GORDO_TPU_FLEET_STATUS_MAX_MACHINES.",
+)
+@click.option(
+    "--limit",
+    default=None,
+    type=int,
+    help="Page size for --machines selections (capped at "
+    "GORDO_TPU_FLEET_STATUS_MAX_MACHINES)",
+)
+@click.option(
+    "--offset",
+    default=0,
+    type=int,
+    help="Page offset for --machines selections",
+)
+def fleet_status(
+    directory: str,
+    as_json: bool,
+    watch: Optional[float],
+    machines: Optional[str],
+    limit: Optional[int],
+    offset: int,
+):
     """
     The fleet console: ONE joined operator view over DIRECTORY (a build
     output / served revision dir) — build progress
@@ -838,7 +868,11 @@ def fleet_status(directory: str, as_json: bool, watch: Optional[float]):
         raise click.ClickException(f"No such directory: {directory}")
     while True:
         doc = fleet_status_document(
-            directory, device=utilization_snapshot()
+            directory,
+            device=utilization_snapshot(),
+            machines=machines,
+            limit=limit,
+            offset=offset,
         )
         if as_json:
             click.echo(json.dumps(doc, indent=1, sort_keys=True, default=str))
@@ -915,6 +949,7 @@ def trace(target: str, as_json: bool, since: Optional[str], last: Optional[str])
     present, each is analyzed in turn.
     """
     from ..telemetry import SERVE_TRACE_FILE
+    from ..telemetry.aggregate import sink_window_index
     from ..telemetry.progress import BUILD_TRACE_FILE
     from ..telemetry.trace_analysis import (
         analyze_trace,
@@ -923,6 +958,7 @@ def trace(target: str, as_json: bool, since: Optional[str], last: Optional[str])
     )
 
     since_ts = _parse_since(since, last)
+    window_index: dict = {}
     if os.path.isdir(target):
         # one analysis per LOGICAL trace: all worker variants of the
         # serve trace merge, ditto the build trace
@@ -934,6 +970,11 @@ def trace(target: str, as_json: bool, since: Optional[str], last: Optional[str])
             )
             if bases
         ]
+        if since_ts is not None:
+            # the rollup manifest records each rotated generation's span
+            # window — skip-by-window beats the mtime heuristic (a
+            # late-touched old generation still gets skipped)
+            window_index = sink_window_index(target)
         if not groups:
             raise click.ClickException(
                 f"No {SERVE_TRACE_FILE} or {BUILD_TRACE_FILE} in {target} "
@@ -945,7 +986,10 @@ def trace(target: str, as_json: bool, since: Optional[str], last: Optional[str])
     else:
         raise click.ClickException(f"No such trace file or directory: {target}")
 
-    docs = [analyze_trace(group, since_ts=since_ts) for group in groups]
+    docs = [
+        analyze_trace(group, since_ts=since_ts, window_index=window_index)
+        for group in groups
+    ]
     if as_json:
         click.echo(
             json.dumps(docs[0] if len(docs) == 1 else docs, indent=1)

@@ -23,7 +23,7 @@ from os import path
 from typing import Any, Optional
 
 from ..telemetry.aggregate import ROLLUP_DIR, is_worker_variant
-from ..telemetry.fleet_health import FLEET_HEALTH_FILE
+from ..telemetry.fleet_health import FLEET_HEALTH_FILE, FLEET_HEALTH_SHARD_DIR
 from ..telemetry.progress import BUILD_STATUS_FILE, BUILD_TRACE_FILE
 from ..telemetry.serving import SERVE_TRACE_FILE
 from ..telemetry.slo import SLO_CONFIG_FILE, SLO_STATE_FILE
@@ -131,6 +131,7 @@ def is_builder_dropping(name: str) -> bool:
         or name == BUILD_TRACE_FILE
         or name == SERVE_TRACE_FILE
         or name == FLEET_HEALTH_FILE
+        or name == FLEET_HEALTH_SHARD_DIR
         or name == ROLLUP_DIR
         or name == SLO_STATE_FILE
         or name == SLO_CONFIG_FILE
@@ -138,6 +139,9 @@ def is_builder_dropping(name: str) -> bool:
         or name.startswith(SERVE_TRACE_FILE + ".")
         or _is_worker_sink(name, SERVE_TRACE_FILE)
         or _is_worker_sink(name, FLEET_HEALTH_FILE)
+        # the sharded health-ledger layout (`fleet_health.d/`,
+        # per-worker `fleet_health-<pid>.d/`) is a dropping DIRECTORY
+        or _is_worker_sink(name, FLEET_HEALTH_SHARD_DIR)
         or is_staging_dir(name)
     )
 

@@ -46,7 +46,7 @@ import logging
 import threading
 import time
 import weakref
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..utils.env import env_float, env_int
 from .batcher import BatchShedError
@@ -186,6 +186,14 @@ class BreakerBoard:
         self._on_transition = on_transition
         self._lock = threading.Lock()
         self._members: Dict[Tuple[int, Any, str], _MemberBreaker] = {}
+        #: the non-CLOSED subset of ``_members``, maintained by every
+        #: transition: board summaries iterate THIS map (plus the live
+        #: trip counter below), never the full member map, so their cost
+        #: tracks how many members are unhealthy — not fleet size
+        self._unhealthy: Dict[Tuple[int, Any, str], _MemberBreaker] = {}
+        #: total trips across live members (decremented when a dead
+        #: fleet's members are purged, mirroring the old full-map sum)
+        self._live_trips = 0
         #: (fleet id, spec, precision) buckets degraded to f32 after
         #: device errors (engine._member_failure); consulted per request
         #: with one set probe
@@ -220,7 +228,8 @@ class BreakerBoard:
                 return
             self._fleets.pop(fid, None)
             for key in [k for k in self._members if k[0] == fid]:
-                del self._members[key]
+                self._live_trips -= self._members.pop(key).trips
+                self._unhealthy.pop(key, None)
             self._degraded = {k for k in self._degraded if k[0] != fid}
 
     # -- request path --------------------------------------------------------
@@ -280,6 +289,7 @@ class BreakerBoard:
                 old = breaker.state
                 breaker.state = CLOSED
                 breaker.probe_at = None
+                self._unhealthy.pop(key, None)
                 transition = (old, CLOSED, breaker.snapshot())
         if transition is not None:
             logger.info(
@@ -318,6 +328,8 @@ class BreakerBoard:
                 old = breaker.state
                 breaker.state = OPEN
                 breaker.trips += 1
+                self._live_trips += 1
+                self._unhealthy[key] = breaker
                 breaker.opened_at = now
                 breaker.probe_at = None
                 breaker.cooldown_s = min(
@@ -364,30 +376,36 @@ class BreakerBoard:
 
     # -- introspection -------------------------------------------------------
 
-    def snapshot(self, detail_cap: int = 50) -> Dict[str, Any]:
-        """Bounded state summary for the engine stats / fleet-status
-        ``serving`` section: counts by state, total trips, and per-member
-        detail for the (bounded) set of currently-unhealthy members."""
+    def summary(self, top_k: int = 10) -> Dict[str, Any]:
+        """Bounded board summary for the engine stats / fleet-status
+        ``serving`` section: counts by state, total trips, and the
+        top-``top_k`` unhealthy members by trip count. Cost is
+        O(unhealthy members) — the full member map is only ever
+        ``len()``-counted, never iterated, so a 10k-member fleet with
+        three tripped breakers pays for three."""
         with self._lock:
             self._drain_dead_locked()
-            breakers = list(self._members.values())
+            tracked = len(self._members)
+            unhealthy = list(self._unhealthy.values())
+            trips = self._live_trips
             degraded = len(self._degraded)
-        counts = {CLOSED: 0, OPEN: 0, HALF_OPEN: 0}
-        trips = 0
-        detail: List[Dict[str, Any]] = []
-        for breaker in breakers:
+        counts = {OPEN: 0, HALF_OPEN: 0}
+        for breaker in unhealthy:
             counts[breaker.state] += 1
-            trips += breaker.trips
-            if breaker.state != CLOSED and len(detail) < detail_cap:
-                detail.append(breaker.snapshot())
+        ranked = sorted(unhealthy, key=lambda b: (-b.trips, b.name))
         return {
-            "tracked": len(breakers),
+            "tracked": tracked,
             "open": counts[OPEN],
             "half_open": counts[HALF_OPEN],
             "trips": trips,
             "degraded_buckets": degraded,
-            "members": detail,
+            "members": [b.snapshot() for b in ranked[: max(0, top_k)]],
         }
+
+    def snapshot(self, detail_cap: int = 50) -> Dict[str, Any]:
+        """Compatibility spelling of :meth:`summary` (same keys; member
+        detail capped at ``detail_cap``)."""
+        return self.summary(top_k=detail_cap)
 
     # -- hooks ---------------------------------------------------------------
 

@@ -1036,7 +1036,7 @@ class ServeEngine:
                 },
             }
         stats["pending"] = self._batcher.pending()
-        stats["breaker"] = self.breakers.snapshot()
+        stats["breaker"] = self.breakers.summary()
         stats["demoted_rungs"] = demotions
         return stats
 

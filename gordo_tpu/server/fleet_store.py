@@ -424,6 +424,46 @@ class RevisionFleet:
         this sits on the per-request serving path)."""
         return self._specs
 
+    def resident_bytes(self) -> Dict[str, int]:
+        """Estimated bytes this fleet keeps resident: per-member params,
+        the fused f32 bucket stacks, and the reduced-precision cast
+        copies. An *estimate* (``size * itemsize`` over array leaves;
+        non-array leaves and host-side pipeline objects are not
+        counted) — the fleet-status / Prometheus capacity signal, not an
+        allocator audit. Lock-free: reads the COW maps; ``_stacked`` /
+        ``_cast_buckets`` values are replaced whole, so a concurrent
+        restack at worst skews one bucket."""
+
+        def _tree_bytes(tree: Any) -> int:
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                try:
+                    total += int(leaf.size) * int(leaf.dtype.itemsize)
+                except (AttributeError, TypeError):
+                    continue  # non-array leaf (scalars, None, strings)
+            return total
+
+        model_bytes = 0
+        models = self._models  # COW snapshot
+        for model in models.values():
+            estimator = _find_estimator(model)
+            if estimator is not None and getattr(estimator, "params_", None) is not None:
+                model_bytes += _tree_bytes(estimator.params_)
+        stacked_bytes = sum(
+            _tree_bytes(params) for (_, params, _) in list(self._stacked.values())
+        )
+        cast_bytes = sum(
+            _tree_bytes(params)
+            for (_, params, _) in list(self._cast_buckets.values())
+        )
+        return {
+            "models": len(models),
+            "model_bytes": model_bytes,
+            "stacked_bytes": stacked_bytes,
+            "cast_bytes": cast_bytes,
+            "total_bytes": model_bytes + stacked_bytes + cast_bytes,
+        }
+
     def fleet_scores(
         self, inputs: Dict[str, Any]
     ) -> Tuple[Dict[str, Tuple[np.ndarray, np.ndarray]], Dict[str, Exception]]:
@@ -886,6 +926,18 @@ class FleetModelStore:
             "source": canary[0],
             "canary": canary[1],
             "fraction": 1.0 / canary[2],
+        }
+
+    def revision_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-resident-revision byte estimates, keyed by the revision
+        dir's basename (``RevisionFleet.resident_bytes``; the key set is
+        bounded by ``N_CACHED_REVISIONS``). The fleet-status ``serving``
+        section and the ``gordo_store_revision_bytes`` gauge read this."""
+        with self._lock:
+            revisions = list(self._revisions.items())
+        return {
+            os.path.basename(key) or key: fleet.resident_bytes()
+            for key, fleet in revisions
         }
 
     def _rerank_mru_locked(self) -> None:

@@ -521,6 +521,33 @@ class ProgramCacheCollector:
         yield family
 
 
+class StoreResidencyCollector:
+    """Scrape-time reader of the serving store's resident-revision byte
+    estimates (``FleetModelStore.revision_stats``). The ``revision``
+    label is BOUNDED by ``N_CACHED_REVISIONS`` (default 2) — revision
+    basenames, never member names, so cardinality stays at revision
+    count (the PR 8 prometheus-cardinality contract); the ``kind`` axis
+    is a three-value constant."""
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        from ..fleet_store import STORE
+
+        family = GaugeMetricFamily(
+            "gordo_store_revision_bytes",
+            "Estimated resident bytes per cached serving revision "
+            "(kind=model per-member params, kind=stacked fused f32 "
+            "buckets, kind=cast reduced-precision copies)",
+            labels=["revision", "kind"],
+        )
+        for revision, stats in sorted(STORE.revision_stats().items()):
+            family.add_metric([revision, "model"], stats["model_bytes"])
+            family.add_metric([revision, "stacked"], stats["stacked_bytes"])
+            family.add_metric([revision, "cast"], stats["cast_bytes"])
+        yield family
+
+
 #: registries already carrying a ProgramCacheCollector — re-registering
 #: would raise on the duplicated metric name
 _program_cache_registries: "weakref.WeakSet" = weakref.WeakSet()
@@ -537,6 +564,7 @@ def register_program_cache_collector(registry: CollectorRegistry) -> None:
         return
     _program_cache_registries.add(registry)
     registry.register(ProgramCacheCollector())
+    registry.register(StoreResidencyCollector())
 
 
 class FleetHealthCollector:

@@ -676,6 +676,13 @@ def get_fleet_health(ctx, gordo_project: str):
     fleet-status`` CLI renders, as one JSON payload. Sections the
     directory has no data for are null rather than errors: a plain
     build dir still answers, so does a serve-only dir.
+
+    The health section is bounded at fleet scale (summary + top
+    offenders; per-machine records elide past
+    ``GORDO_TPU_FLEET_STATUS_MAX_MACHINES``): ``?machines=`` selects
+    records back in — ``all``, ``none``, a health state
+    (``unhealthy``, ``quarantined``, ...) or a comma-separated name
+    list — and ``?limit=``/``?offset=`` page through the selection.
     """
     from ...telemetry import fleet_status_document, utilization_snapshot
     from ..fleet_store import program_cache_stats
@@ -684,6 +691,16 @@ def get_fleet_health(ctx, gordo_project: str):
     # and lifecycle state are keyed to the operator's stable handle
     anchor = os.environ.get(ctx.config["MODEL_COLLECTION_DIR_ENV_VAR"])
     directory = anchor or ctx.collection_dir
+    args = ctx.request.args
+    machines = args.get("machines")
+    try:
+        limit = int(args["limit"]) if "limit" in args else None
+    except (TypeError, ValueError):
+        limit = None
+    try:
+        offset = int(args.get("offset") or 0)
+    except (TypeError, ValueError):
+        offset = 0
     try:
         programs = program_cache_stats()
     except Exception:  # noqa: BLE001 - cache stats are advisory
@@ -702,6 +719,9 @@ def get_fleet_health(ctx, gordo_project: str):
             serving["gates"] = STORE.fleet(
                 STORE.route(directory)
             ).precision_reports()
+            # per-revision resident-byte estimates (the capacity signal
+            # gordo_store_revision_bytes also exports)
+            serving["store"] = STORE.revision_stats()
     except Exception:  # noqa: BLE001 - engine stats are advisory
         pass
     doc = fleet_status_document(
@@ -709,6 +729,9 @@ def get_fleet_health(ctx, gordo_project: str):
         device=utilization_snapshot(),
         programs=programs,
         serving=serving,
+        machines=machines,
+        limit=limit,
+        offset=offset,
     )
     return ctx.json_response(doc)
 

@@ -49,6 +49,12 @@ logger = logging.getLogger(__name__)
 ROLLUP_DIR = "rollups"
 #: per-file read offsets + signatures (inside ROLLUP_DIR)
 ROLLUP_STATE_FILE = "rollup_state.json"
+#: the rollup index (inside ROLLUP_DIR): window -> file map with
+#: per-window summaries, plus per-sink span-time windows — so
+#: merged-window reads and ``--since``/``--last`` queries open only the
+#: files they need instead of walking a busy directory
+ROLLUP_MANIFEST_FILE = "manifest.json"
+ROLLUP_MANIFEST_ENV = "GORDO_TPU_ROLLUP_MANIFEST"
 
 #: rollup window size in seconds (every window boundary is aligned to
 #: it, so windows from different workers/hosts merge bucket-for-bucket)
@@ -87,6 +93,36 @@ def rollup_keep() -> int:
     from ..utils.env import env_int
 
     return max(1, env_int(ROLLUP_KEEP_ENV, DEFAULT_ROLLUP_KEEP))
+
+
+def manifest_enabled() -> bool:
+    from ..utils.env import env_bool
+
+    return env_bool(ROLLUP_MANIFEST_ENV, True)
+
+
+def sink_window_index(directory: str) -> Dict[str, Dict[str, Any]]:
+    """Per-sink span-time windows from the rollup manifest: sink file
+    basename -> ``{"min_ts", "max_ts", "complete"}`` (epoch seconds of
+    the spans the reducer consumed; ``complete`` means it reached EOF,
+    so the window covers the whole file). ``{}`` when no manifest —
+    callers fall back to mtime heuristics. This is what lets
+    ``gordo-tpu trace --since`` skip whole rotated generations by
+    recorded span window instead of trusting filesystem mtimes."""
+    path = os.path.join(directory, ROLLUP_DIR, ROLLUP_MANIFEST_FILE)
+    try:
+        with open(path) as handle:
+            doc = json.load(handle)
+    except (OSError, ValueError):
+        return {}
+    sinks = doc.get("sinks") if isinstance(doc, dict) else None
+    if not isinstance(sinks, dict):
+        return {}
+    return {
+        str(name): entry
+        for name, entry in sinks.items()
+        if isinstance(entry, dict)
+    }
 
 
 def parse_span_time(value: Any) -> Optional[float]:
@@ -432,7 +468,13 @@ class RollupStore:
         self.directory = os.path.normpath(directory)
         self.rollup_dir = os.path.join(self.directory, ROLLUP_DIR)
         self.state_path = os.path.join(self.rollup_dir, ROLLUP_STATE_FILE)
+        self.manifest_path = os.path.join(
+            self.rollup_dir, ROLLUP_MANIFEST_FILE
+        )
         self.seconds = int(seconds) if seconds else window_seconds()
+        #: the manifest this store last wrote (authoritative in the
+        #: aggregating process; reader-only processes re-load from disk)
+        self._manifest: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
         #: bumped whenever a rollup file changes (fold or prune) — the
         #: merge cache's invalidation token
@@ -513,7 +555,15 @@ class RollupStore:
             visited += 1
             signature, offset = result
             spans_read += offset["spans"]
-            files[signature] = {"offset": offset["offset"], "path": path}
+            files[signature] = {
+                "offset": offset["offset"],
+                "path": path,
+                "complete": bool(offset.get("eof")),
+            }
+            if offset.get("min_ts") is not None:
+                files[signature]["min_ts"] = offset["min_ts"]
+            if offset.get("max_ts") is not None:
+                files[signature]["max_ts"] = offset["max_ts"]
         for signature, entry in previous.items():
             if signature in files:
                 continue
@@ -525,12 +575,15 @@ class RollupStore:
         # deliberate at-least-once choice, because the alternative
         # ordering silently DROPS spans, and an alerting pipeline must
         # fail toward noticing errors, never toward missing them
-        updated = self._persist_windows(windows)
+        persisted = self._persist_windows(windows)
+        updated = sorted(persisted)
         pruned = self._prune()
         sinks_pruned = self._prune_dead_worker_sinks(files)
         if updated or pruned:
             self._version += 1
             self._merged_cache.clear()
+        if manifest_enabled():
+            self._update_manifest(persisted, pruned, files)
         self._write_json(
             self.state_path,
             {
@@ -542,8 +595,8 @@ class RollupStore:
         return {
             "spans_read": spans_read,
             "files_visited": visited,
-            "windows_updated": sorted(updated),
-            "rollups_pruned": pruned,
+            "windows_updated": updated,
+            "rollups_pruned": len(pruned),
             "worker_sinks_pruned": sinks_pruned,
         }
 
@@ -632,20 +685,38 @@ class RollupStore:
         with handle:
             head = handle.read(256)
             if not head:
-                return ("empty", {"spans": 0, "offset": 0})
+                return ("empty", {"spans": 0, "offset": 0, "eof": True})
             signature = _signature_from_head(head)
             if signature is None:
                 # no complete first line yet — nothing foldable either
                 return None
             entry = previous.get(signature) or files.get(signature) or {}
             offset = int(entry.get("offset", 0))
+            # span-time window accumulated across passes (the manifest's
+            # per-sink index): an incremental read only sees new spans,
+            # so fold this pass's range into the carried one
+            min_ts = entry.get("min_ts")
+            max_ts = entry.get("max_ts")
             spans = 0
+
+            def result(position: int, eof: bool) -> Tuple[str, Dict[str, Any]]:
+                return (
+                    signature,
+                    {
+                        "spans": spans,
+                        "offset": position,
+                        "eof": eof,
+                        "min_ts": min_ts,
+                        "max_ts": max_ts,
+                    },
+                )
+
             try:
                 size = os.fstat(handle.fileno()).st_size
                 if size <= offset:
                     # fully consumed (rotated generations are immutable,
                     # the live file simply has nothing new)
-                    return (signature, {"spans": 0, "offset": offset})
+                    return result(offset, True)
                 handle.seek(offset)
                 # byte positions are tracked by hand: BufferedReader.tell()
                 # costs ~40us and a per-line tell() was 40% of the whole
@@ -659,7 +730,7 @@ class RollupStore:
                         # a torn tail the writer is mid-appending: leave
                         # the offset BEFORE it so the next pass rereads
                         # the completed line exactly once
-                        return (signature, {"spans": spans, "offset": position})
+                        return result(position, False)
                     position += len(line)
                     text = line.strip()
                     if not text:
@@ -670,6 +741,15 @@ class RollupStore:
                         continue
                     if not isinstance(span, dict) or "name" not in span:
                         continue
+                    ts = parse_span_time(span.get("end_time"))
+                    if ts is not None:
+                        # the sink's span window counts every span seen,
+                        # duplicates included — a generation holding only
+                        # dupes still gets an honest window
+                        if min_ts is None or ts < min_ts:
+                            min_ts = ts
+                        if max_ts is None or ts > max_ts:
+                            max_ts = ts
                     context = span.get("context") or {}
                     span_key = (
                         context.get("trace_id", ""),
@@ -679,7 +759,6 @@ class RollupStore:
                         if span_key in seen_ids:
                             continue  # duplicated across sinks/generations
                         seen_ids.add(span_key)
-                    ts = parse_span_time(span.get("end_time"))
                     if ts is None:
                         continue
                     start = self.window_start(ts)
@@ -690,12 +769,14 @@ class RollupStore:
                         )
                     _fold_span(rollup, kind, span)
                     spans += 1
-                return (signature, {"spans": spans, "offset": position})
+                return result(position, True)
             except OSError:
-                return (signature, {"spans": spans, "offset": offset})
+                return result(offset, False)
 
-    def _persist_windows(self, windows: Dict[int, Dict[str, Any]]) -> List[int]:
-        updated = []
+    def _persist_windows(
+        self, windows: Dict[int, Dict[str, Any]]
+    ) -> Dict[int, Dict[str, Any]]:
+        persisted: Dict[int, Dict[str, Any]] = {}
         for start, delta in windows.items():
             path = self.rollup_path(start)
             existing = self._load_json(path)
@@ -707,10 +788,10 @@ class RollupStore:
             else:
                 doc = delta
             self._write_json(path, doc)
-            updated.append(start)
-        return updated
+            persisted[start] = doc
+        return persisted
 
-    def _prune(self) -> int:
+    def _prune(self) -> List[int]:
         keep = rollup_keep()
         try:
             entries = sorted(
@@ -720,16 +801,106 @@ class RollupStore:
                 and entry[: -len(".json")].isdigit()
             )
         except OSError:
-            return 0
+            return []
         doomed = entries[:-keep] if len(entries) > keep else []
+        removed = []
         for entry in doomed:
             try:
                 os.remove(os.path.join(self.rollup_dir, entry))
             except OSError:
-                pass
-        return len(doomed)
+                continue
+            removed.append(int(entry[: -len(".json")]))
+        return removed
+
+    def _update_manifest(
+        self,
+        persisted: Dict[int, Dict[str, Any]],
+        pruned: List[int],
+        files: Dict[str, Dict[str, Any]],
+    ) -> None:
+        """Fold this pass's window updates into ``manifest.json``: the
+        window -> file map (with per-window request summaries) readers
+        select from, plus the per-sink span-time index the trace CLI
+        uses to skip whole rotated generations. Rebuilt from a directory
+        listing when absent (the one walk that makes all later reads
+        walk-free)."""
+        manifest = self._manifest
+        if manifest is None:
+            manifest = self._load_json(self.manifest_path)
+        window_map: Dict[str, Dict[str, Any]] = {}
+        if (
+            isinstance(manifest, dict)
+            and isinstance(manifest.get("windows"), dict)
+            and int(manifest.get("seconds") or 0) == self.seconds
+        ):
+            window_map = dict(manifest["windows"])
+        else:
+            try:
+                for entry in os.listdir(self.rollup_dir):
+                    if (
+                        entry.endswith(".json")
+                        and entry[: -len(".json")].isdigit()
+                    ):
+                        window_map[entry[: -len(".json")]] = {"file": entry}
+            except OSError:
+                window_map = {}
+        for start, doc in persisted.items():
+            requests = doc.get("requests") or {}
+            window_map[str(int(start))] = {
+                "file": f"{int(start)}.json",
+                "requests": int(requests.get("count") or 0),
+                "errors": int(requests.get("errors") or 0),
+            }
+        for start in pruned:
+            window_map.pop(str(int(start)), None)
+        sinks: Dict[str, Dict[str, Any]] = {}
+        for entry in files.values():
+            path = entry.get("path")
+            if not path or entry.get("max_ts") is None:
+                continue
+            sinks[os.path.basename(path)] = {
+                "min_ts": entry.get("min_ts"),
+                "max_ts": entry.get("max_ts"),
+                "complete": bool(entry.get("complete")),
+            }
+        doc = {
+            "version": 1,
+            "seconds": self.seconds,
+            "updated_at": time.time(),
+            "windows": window_map,
+            "sinks": sinks,
+        }
+        try:
+            self._write_json(self.manifest_path, doc)
+        except OSError as exc:
+            logger.debug("rollup manifest not written: %r", exc)
+            return
+        self._manifest = doc
 
     # -- reading back -------------------------------------------------------
+
+    def _manifest_windows(self) -> Optional[List[int]]:
+        """Window starts from the manifest (sorted), or None when the
+        manifest is disabled/absent/incompatible — readers then fall
+        back to the directory walk. The in-memory copy is used only by
+        the aggregating process (it is authoritative there); everyone
+        else re-loads the file, which is one open instead of a listdir
+        over tens of thousands of entries."""
+        if not manifest_enabled():
+            return None
+        doc = self._manifest
+        if doc is None:
+            doc = self._load_json(self.manifest_path)
+        if (
+            not isinstance(doc, dict)
+            or not isinstance(doc.get("windows"), dict)
+            or int(doc.get("seconds") or 0) != self.seconds
+        ):
+            return None
+        try:
+            return sorted(int(start) for start in doc["windows"])
+        except (TypeError, ValueError):
+            return None
 
     def windows(
         self,
@@ -737,23 +908,26 @@ class RollupStore:
         until: Optional[float] = None,
     ) -> Iterator[Dict[str, Any]]:
         """Persisted rollups whose window overlaps [since, until],
-        oldest first."""
-        try:
-            entries = sorted(
-                entry
-                for entry in os.listdir(self.rollup_dir)
-                if entry.endswith(".json")
-                and entry[: -len(".json")].isdigit()
-            )
-        except OSError:
-            return
-        for entry in entries:
-            start = int(entry[: -len(".json")])
+        oldest first. With a manifest, only the overlapping files are
+        ever opened (the scale contract a counting-open test pins);
+        without one, the directory walk selects by name."""
+        starts = self._manifest_windows()
+        if starts is None:
+            try:
+                starts = sorted(
+                    int(entry[: -len(".json")])
+                    for entry in os.listdir(self.rollup_dir)
+                    if entry.endswith(".json")
+                    and entry[: -len(".json")].isdigit()
+                )
+            except OSError:
+                return
+        for start in starts:
             if since is not None and start + self.seconds <= since:
                 continue
             if until is not None and start >= until:
                 continue
-            doc = self._load_json(os.path.join(self.rollup_dir, entry))
+            doc = self._load_json(self.rollup_path(start))
             if isinstance(doc, dict) and doc.get("window"):
                 yield doc
 

@@ -230,6 +230,56 @@ GATES: Dict[str, List[MetricSpec]] = {
             bound=0.4,
         ),
     ],
+    "fleet-scale": [
+        # the bounded fleet-status contract: the summary-first document
+        # must stay both cheap in absolute terms and a small fraction
+        # of the naive full render at the largest measured N
+        MetricSpec(
+            "fleet-status summary build+render budget (ms)",
+            "gates.fleet_status_summary_ms",
+            "max_bound",
+            bound=250.0,
+        ),
+        MetricSpec(
+            "fleet-status summary vs naive full render (ratio)",
+            "gates.fleet_status_summary_vs_full_ratio",
+            "max_bound",
+            bound=0.5,
+        ),
+        # one machine's flush must rewrite ~one shard's share of the
+        # corpus regardless of N (the ratio is shard-normalized, so the
+        # budget holds at CI's reduced sizes too): a value near the
+        # shard count would mean the flush went monolithic again
+        MetricSpec(
+            "ledger dirty-flush bytes vs one-shard share (ratio)",
+            "gates.ledger_dirty_flush_shard_ratio",
+            "max_bound",
+            bound=2.0,
+        ),
+        MetricSpec(
+            "merged-window read opened only manifest-selected files",
+            "gates.rollup_reads_bounded",
+            "truthy",
+        ),
+        MetricSpec(
+            "rollup aggregation throughput at scale (spans/s)",
+            "gates.rollup_spans_per_sec",
+            "higher",
+            0.5,
+        ),
+        MetricSpec(
+            "ledger populate throughput at scale (records/s)",
+            "gates.ledger_records_per_sec",
+            "higher",
+            0.5,
+        ),
+        MetricSpec(
+            "breaker-board bounded summary budget (ms)",
+            "gates.breaker_summary_ms",
+            "max_bound",
+            bound=5.0,
+        ),
+    ],
     "slo-engine": [
         MetricSpec(
             "rollup aggregation throughput (spans/s)",
@@ -261,6 +311,7 @@ BASELINE_FILES: Dict[str, str] = {
     "lifecycle-hot-swap": "BENCH_LIFECYCLE.json",
     "fleet-health-overhead": "BENCH_FLEET_HEALTH.json",
     "slo-engine": "BENCH_SLO.json",
+    "fleet-scale": "BENCH_SCALE.json",
     "precision-ladder": "BENCH_PRECISION.json",
     "serve-chaos": "BENCH_CHAOS.json",
 }

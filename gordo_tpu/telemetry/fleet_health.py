@@ -36,12 +36,14 @@ Stdlib-only, like the rest of the package: the device-memory section is
 
 import contextlib
 import datetime
+import heapq
 import json
 import logging
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+import zlib
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
 
 from ..utils.postfork import register_postfork_reset
 from .recorder import _iso, enabled, worker_sink_path
@@ -51,6 +53,39 @@ logger = logging.getLogger(__name__)
 #: the ledger snapshot written beside the artifacts (a builder dropping,
 #: like build_status.json — serializer.is_builder_dropping knows it)
 FLEET_HEALTH_FILE = "fleet_health.json"
+
+#: the sharded snapshot layout beside it: past the monolithic-comfort
+#: threshold the ledger splits its persistence into bounded per-shard
+#: files under ``fleet_health.d/`` (``fleet_health-<pid>.d/`` per worker
+#: — same worker-variant grammar as the sinks), so one noisy machine's
+#: flush rewrites ONE shard, not 10k records. ``summary.json`` inside
+#: the dir is the bounded read path: folded fleet summary + top-K
+#: offenders, rewritten on every flush.
+FLEET_HEALTH_SHARD_DIR = "fleet_health.d"
+FLEET_HEALTH_SUMMARY_FILE = "summary.json"
+
+#: shard count override: 0 (default) sizes adaptively — one shard while
+#: the fleet fits a monolithic snapshot, then the next power of two of
+#: ``machines / _SHARD_TARGET_MACHINES`` — any positive value pins it
+HEALTH_SHARDS_ENV = "GORDO_TPU_HEALTH_SHARDS"
+#: adaptive target: shards sized so a dirty-shard flush rewrites about
+#: this many records regardless of fleet size (10k members -> 32 shards)
+_SHARD_TARGET_MACHINES = 512
+_MAX_SHARDS = 64
+#: cached per-shard summaries go stale as breaker age-out cutoffs pass;
+#: refresh untouched shards after this many seconds
+_SUMMARY_MAX_AGE_S = 60.0
+#: offenders kept per shard summary (consumers slice their own top-K)
+_OFFENDER_CAP = 32
+
+#: fleet-status bounding: past this many machines the joined document
+#: stops inlining per-machine records by default (summary + top-K
+#: offenders instead) — also the hard cap on one ``?machines=`` page
+FLEET_STATUS_MAX_MACHINES_ENV = "GORDO_TPU_FLEET_STATUS_MAX_MACHINES"
+DEFAULT_FLEET_STATUS_MAX_MACHINES = 500
+#: offender rows carried by the bounded fleet-status health section
+FLEET_STATUS_TOP_K_ENV = "GORDO_TPU_FLEET_STATUS_TOP_K"
+DEFAULT_FLEET_STATUS_TOP_K = 10
 
 #: master switch for the ledger (rides the telemetry master switch too)
 FLEET_HEALTH_ENV = "GORDO_TPU_FLEET_HEALTH"
@@ -214,13 +249,15 @@ def summarize(machines: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
     fleet-wide request/error totals, and the fixed-bucket health-score
     histogram (per-bin counts; the Prometheus collector cumulates)."""
     counts = {"healthy": 0, "degraded": 0, "drifting": 0, "quarantined": 0}
-    requests = errors = 0
+    requests = errors = breaker_tripped = 0
     score_sum = 0.0
     bins = [0] * len(SCORE_BUCKETS)
     for machine in machines.values():
         counts[machine_state(machine)] += 1
         requests += machine["serving"]["requests"]
         errors += machine["serving"]["errors"]
+        if _live_breaker_state(machine) is not None:
+            breaker_tripped += 1
         score = health_score(machine)
         score_sum += score
         for i, edge in enumerate(SCORE_BUCKETS):
@@ -233,6 +270,10 @@ def summarize(machines: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
         "requests": requests,
         "errors": errors,
         "error_rate": round(errors / requests, 6) if requests else 0.0,
+        # serving-breaker trips, counted here so bounded readers (the
+        # lifecycle supervisor's rebuild feed) can skip the full
+        # machine parse when nothing is tripped fleet-wide
+        "breaker_tripped": breaker_tripped,
         "score_histogram": {
             "buckets": list(SCORE_BUCKETS),
             "counts": bins,
@@ -242,6 +283,94 @@ def summarize(machines: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
             "score_sum": round(score_sum, 4),
         },
     }
+
+
+def _fold_summaries(summaries: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold per-shard :func:`summarize` outputs into one fleet summary.
+    Exact, not approximate: every field is a sum (shards partition the
+    machines), so the fold equals ``summarize`` over the union."""
+    folded = summarize({})
+    bins = folded["score_histogram"]["counts"]
+    score_sum = 0.0
+    for summary in summaries:
+        if not isinstance(summary, dict):
+            continue
+        for key in (
+            "machines",
+            "healthy",
+            "degraded",
+            "drifting",
+            "quarantined",
+            "requests",
+            "errors",
+            "breaker_tripped",
+        ):
+            folded[key] += int(summary.get(key) or 0)
+        histogram = summary.get("score_histogram") or {}
+        score_sum += float(histogram.get("score_sum") or 0.0)
+        for i, count in enumerate(histogram.get("counts") or ()):
+            if i < len(bins):
+                bins[i] += int(count)
+    folded["error_rate"] = (
+        round(folded["errors"] / folded["requests"], 6)
+        if folded["requests"]
+        else 0.0
+    )
+    folded["score_histogram"]["score_sum"] = round(score_sum, 4)
+    return folded
+
+
+def _offender_reason(machine: Dict[str, Any], state: str) -> Optional[str]:
+    """The one-line why behind an unhealthy machine (what the renderer
+    prints after the score)."""
+    if state == "quarantined":
+        reasons = machine.get("quarantine", {}).get("reasons") or []
+        if reasons:
+            return str(reasons[0])
+        breaker = machine.get("breaker") or {}
+        if breaker.get("reason"):
+            return str(breaker["reason"])
+        return None
+    if state == "degraded":
+        error = machine.get("build", {}).get("error")
+        return str(error) if error else None
+    reasons = machine.get("drift", {}).get("reasons") or []
+    return str(reasons[0]) if reasons else None
+
+
+def _offenders(
+    machines: Dict[str, Dict[str, Any]], cap: int
+) -> List[Dict[str, Any]]:
+    """The ``cap`` unhealthiest machines as bounded rows (name, score,
+    state, first reason) — what the fleet-status surfaces show instead
+    of 10k inline records."""
+    entries = []
+    for name, machine in machines.items():
+        state = machine_state(machine)
+        if state == "healthy":
+            continue
+        entries.append(
+            {
+                "machine": name,
+                "score": health_score(machine),
+                "state": state,
+                "reason": _offender_reason(machine, state),
+            }
+        )
+    return heapq.nsmallest(
+        cap, entries, key=lambda e: (e["score"], e["machine"])
+    )
+
+
+def _merge_offenders(
+    pools: Iterable[List[Dict[str, Any]]], top_k: int
+) -> List[Dict[str, Any]]:
+    merged: List[Dict[str, Any]] = []
+    for pool in pools:
+        merged.extend(e for e in pool if isinstance(e, dict))
+    return heapq.nsmallest(
+        top_k, merged, key=lambda e: (e.get("score", 0.0), str(e.get("machine")))
+    )
 
 
 # -- the ledger ---------------------------------------------------------------
@@ -281,8 +410,17 @@ class NullLedger:
     def document(self):
         return None
 
+    def bounded_document(self, top_k=10):
+        return None
+
     def summary(self):
         return None
+
+    def offenders(self, top_k=10):
+        return []
+
+    def machine_count(self):
+        return 0
 
     def write(self, force=False):
         pass
@@ -292,6 +430,32 @@ class NullLedger:
 
 
 NULL_LEDGER = NullLedger()
+
+
+def _shard_dir_for(path: str) -> str:
+    """``fleet_health.json`` -> ``fleet_health.d`` (pid suffix kept:
+    ``fleet_health-123.json`` -> ``fleet_health-123.d``)."""
+    stem, _ = os.path.splitext(path)
+    return stem + ".d"
+
+
+def _shard_file_name(shard: int, count: int) -> str:
+    # the layout generation rides the name: a reshard (count change)
+    # produces a disjoint file set, so stale-generation files are
+    # recognizable and removable
+    return f"shard-{shard:03d}of{count:03d}.json"
+
+
+def _shard_files(shard_dir: str) -> List[str]:
+    try:
+        entries = sorted(os.listdir(shard_dir))
+    except OSError:
+        return []
+    return [
+        os.path.join(shard_dir, entry)
+        for entry in entries
+        if entry.startswith("shard-") and entry.endswith(".json")
+    ]
 
 
 class FleetHealthLedger:
@@ -323,6 +487,12 @@ class FleetHealthLedger:
             worker_sink_path(os.path.join(self.directory, FLEET_HEALTH_FILE))
             if self.directory is not None
             else None
+        )
+        # the sharded layout lives beside the monolithic spelling:
+        # fleet_health.json -> fleet_health.d/ (worker variants keep
+        # their pid suffix: fleet_health-<pid>.json -> fleet_health-<pid>.d/)
+        self.shard_dir = (
+            _shard_dir_for(self.path) if self.path is not None else None
         )
         self.project = project
         #: the process that built this ledger — ledger_for() compares it
@@ -356,14 +526,79 @@ class FleetHealthLedger:
         self._lock = threading.Lock()
         self._write_lock = threading.Lock()
         self._last_write = 0.0
+        # -- shard bookkeeping (all mutated under self._lock) --
+        #: pinned shard count from the env (0 = adaptive)
+        self._forced_shards = max(0, env_int(HEALTH_SHARDS_ENV, 0))
+        self._shard_count = self._forced_shards or 1
+        #: shard -> member names, maintained incrementally so flushing
+        #: one dirty shard never walks the full fleet
+        self._shard_members: Dict[int, set] = {}
+        #: shards with unpersisted record changes
+        self._dirty: set = set()
+        #: shard layout changed (reshard): next flush rewrites the dir
+        self._layout_changed = False
+        #: per-shard cached {"summary", "offenders"} + refresh stamps —
+        #: the scrape/fleet-status summary is a fold over these, O(S),
+        #: recomputed only for shards touched since the last refresh
+        self._summary_cache: Dict[int, Dict[str, Any]] = {}
+        self._summary_stamp: Dict[int, float] = {}
+        self._summary_dirty: set = set()
 
     # -- recording ----------------------------------------------------------
 
+    def _shard_of(self, name: str) -> int:
+        # crc32, NOT hash(): shard assignment must be stable across
+        # processes and restarts (Python string hashing is randomized)
+        return zlib.crc32(name.encode("utf-8")) % self._shard_count
+
+    def _reshard_locked(self) -> None:
+        """Grow the shard count to the adaptive target and rebuild the
+        membership map (O(N), but only on power-of-two growth — the
+        per-record path never walks the fleet)."""
+        needed = (len(self._machines) + _SHARD_TARGET_MACHINES - 1) // (
+            _SHARD_TARGET_MACHINES
+        )
+        count = 1 << max(0, needed - 1).bit_length()
+        count = min(_MAX_SHARDS, max(1, count))
+        if count <= self._shard_count:
+            return
+        self._shard_count = count
+        self._shard_members = {}
+        for name in self._machines:
+            self._shard_members.setdefault(self._shard_of(name), set()).add(
+                name
+            )
+        self._dirty.update(range(count))
+        self._summary_cache.clear()
+        self._summary_stamp.clear()
+        self._summary_dirty.update(range(count))
+        self._layout_changed = True
+
     def _machine(self, name: str) -> Dict[str, Any]:
+        """The (create-once) record for ``name`` — called under
+        ``self._lock`` by every mutator, so it is also where the
+        machine's shard is marked dirty."""
         machine = self._machines.get(name)
         if machine is None:
             machine = self._machines[name] = _new_machine()
+            if (
+                not self._forced_shards
+                and self._shard_count < _MAX_SHARDS
+                and len(self._machines)
+                > self._shard_count * _SHARD_TARGET_MACHINES
+            ):
+                self._reshard_locked()
+            shard = self._shard_of(name)
+            self._shard_members.setdefault(shard, set()).add(name)
+        else:
+            shard = self._shard_of(name)
+        self._dirty.add(shard)
+        self._summary_dirty.add(shard)
         return machine
+
+    def machine_count(self) -> int:
+        with self._lock:
+            return len(self._machines)
 
     def record_request(
         self, machine: str, error: bool = False, count: int = 1
@@ -594,16 +829,108 @@ class FleetHealthLedger:
             doc["plan_accuracy"] = plan_accuracy
         return doc
 
+    def _refresh_summaries_locked(self) -> None:
+        """Recompute the per-shard summary cache for shards touched
+        since the last refresh (or stale past the breaker age-out
+        window). Caller holds ``self._lock``."""
+        now = time.time()
+        for shard in range(self._shard_count):
+            if (
+                shard not in self._summary_dirty
+                and shard in self._summary_cache
+                and now - self._summary_stamp.get(shard, 0.0)
+                <= _SUMMARY_MAX_AGE_S
+            ):
+                continue
+            names = self._shard_members.get(shard) or ()
+            machines = {
+                name: self._machines[name]
+                for name in names
+                if name in self._machines
+            }
+            self._summary_cache[shard] = {
+                "summary": summarize(machines),
+                "offenders": _offenders(machines, _OFFENDER_CAP),
+            }
+            self._summary_stamp[shard] = now
+        self._summary_dirty.clear()
+
     def summary(self) -> Dict[str, Any]:
         with self._lock:
+            if self._shard_count > 1:
+                # fold of per-shard cached summaries: O(shards + dirty)
+                # — this is what keeps the Prometheus scrape flat as
+                # the fleet grows
+                self._refresh_summaries_locked()
+                return _fold_summaries(
+                    entry["summary"] for entry in self._summary_cache.values()
+                )
             machines = dict(self._machines)
         return summarize(machines)
+
+    def offenders(self, top_k: int = 10) -> List[Dict[str, Any]]:
+        """The ``top_k`` unhealthiest machines as bounded rows."""
+        with self._lock:
+            if self._shard_count > 1:
+                self._refresh_summaries_locked()
+                pools = [
+                    entry["offenders"]
+                    for entry in self._summary_cache.values()
+                ]
+                return _merge_offenders(pools, top_k)
+            machines = dict(self._machines)
+        return _offenders(machines, top_k)
+
+    def bounded_document(self, top_k: int = 10) -> Dict[str, Any]:
+        """The summary-first view of this ledger: fleet summary, top-K
+        offenders and the machine count — never the per-machine map.
+        O(shards + dirty) however large the fleet is; what the bounded
+        fleet-status path reads instead of :meth:`document`."""
+        with self._lock:
+            total = len(self._machines)
+            plan_accuracy = (
+                dict(self._plan_accuracy) if self._plan_accuracy else None
+            )
+            if self._shard_count > 1:
+                self._refresh_summaries_locked()
+                summary = _fold_summaries(
+                    entry["summary"] for entry in self._summary_cache.values()
+                )
+                offenders = _merge_offenders(
+                    [
+                        entry["offenders"]
+                        for entry in self._summary_cache.values()
+                    ],
+                    top_k,
+                )
+                machines = None
+            else:
+                machines = dict(self._machines)
+        if machines is not None:
+            summary = summarize(machines)
+            offenders = _offenders(machines, top_k)
+        doc: Dict[str, Any] = {
+            "version": 1,
+            "project": self.project,
+            "updated_at": _iso(time.time()),
+            "machines_total": total,
+            "summary": summary,
+            "offenders": offenders,
+        }
+        if plan_accuracy is not None:
+            doc["plan_accuracy"] = plan_accuracy
+        return doc
 
     # -- persistence --------------------------------------------------------
 
     def write(self, force: bool = False) -> None:
         """Atomically replace the snapshot (best-effort, throttled).
-        Forced writes (state transitions) also notify listeners."""
+        Forced writes (state transitions) also notify listeners.
+
+        Monolithic layout (one shard): the whole document replaces
+        ``fleet_health.json`` exactly as it always has. Sharded layout:
+        only the shards dirtied since the last flush are rewritten —
+        one noisy machine costs one bounded shard file, not the fleet."""
         if self.path is None:
             return
         now = time.time()
@@ -613,26 +940,162 @@ class FleetHealthLedger:
                     return
                 self._last_write = now
                 listeners = list(self._listeners)
-            doc = self.document()
-            tmp = os.path.join(
-                os.path.dirname(self.path),
-                f".{FLEET_HEALTH_FILE}.tmp-{os.getpid()}",
-            )
-            try:
-                os.makedirs(os.path.dirname(self.path), exist_ok=True)
-                with open(tmp, "w") as f:
-                    json.dump(doc, f, default=str)
-                os.replace(tmp, self.path)
-            except OSError as exc:
-                logger.debug("fleet_health snapshot not written: %r", exc)
-                with contextlib.suppress(OSError):
-                    os.remove(tmp)
-        if force:
+                sharded = self._shard_count > 1
+            if sharded:
+                summary = self._write_shards()
+            else:
+                doc = self.document()
+                summary = doc["summary"]
+                tmp = os.path.join(
+                    os.path.dirname(self.path),
+                    f".{FLEET_HEALTH_FILE}.tmp-{os.getpid()}",
+                )
+                try:
+                    os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                    with open(tmp, "w") as f:
+                        json.dump(doc, f, default=str)
+                    os.replace(tmp, self.path)
+                except OSError as exc:
+                    logger.debug("fleet_health snapshot not written: %r", exc)
+                    with contextlib.suppress(OSError):
+                        os.remove(tmp)
+                with self._lock:
+                    self._dirty.clear()
+                self._cleanup_shard_layout()
+        if force and summary is not None:
             for listener in listeners:
                 try:
-                    listener(doc["summary"])
+                    listener(summary)
                 except Exception:  # noqa: BLE001 - listeners are advisory
                     pass
+
+    def _atomic_write(self, path: str, doc: Dict[str, Any]) -> None:
+        tmp = os.path.join(
+            os.path.dirname(path),
+            f".{os.path.basename(path)}.tmp-{os.getpid()}",
+        )
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, path)
+        except OSError:
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+            raise
+
+    def _write_shards(self) -> Optional[Dict[str, Any]]:
+        """Flush the dirty shards (serialize under the lock, write
+        outside it) plus the bounded ``summary.json``; returns the
+        folded fleet summary. Caller holds ``self._write_lock``."""
+        if self.shard_dir is None:
+            return None
+        with self._lock:
+            count = self._shard_count
+            dirty = sorted(self._dirty)
+            self._dirty.clear()
+            layout_changed = self._layout_changed
+            self._layout_changed = False
+            payloads = {}
+            for shard in dirty:
+                names = self._shard_members.get(shard) or ()
+                payloads[shard] = json.dumps(
+                    {
+                        name: self._machines[name]
+                        for name in sorted(names)
+                        if name in self._machines
+                    },
+                    default=str,
+                )
+            plan_accuracy = (
+                dict(self._plan_accuracy) if self._plan_accuracy else None
+            )
+            total = len(self._machines)
+            self._refresh_summaries_locked()
+            shard_summaries = {
+                shard: entry["summary"]
+                for shard, entry in self._summary_cache.items()
+            }
+            offender_pools = [
+                entry["offenders"] for entry in self._summary_cache.values()
+            ]
+        summary = _fold_summaries(shard_summaries.values())
+        offenders = _merge_offenders(offender_pools, _OFFENDER_CAP)
+        stamp = _iso(time.time())
+        current_names = {_shard_file_name(k, count) for k in range(count)}
+        try:
+            os.makedirs(self.shard_dir, exist_ok=True)
+            if layout_changed:
+                # a reshard re-homes every machine: drop files from the
+                # previous layout so merge-on-read never sees a machine
+                # in two generations of shards
+                for entry in os.listdir(self.shard_dir):
+                    if (
+                        entry.startswith("shard-")
+                        and entry.endswith(".json")
+                        and entry not in current_names
+                    ):
+                        with contextlib.suppress(OSError):
+                            os.remove(os.path.join(self.shard_dir, entry))
+            for shard in dirty:
+                machines = json.loads(payloads[shard])
+                for machine in machines.values():
+                    machine["health"] = {
+                        "score": health_score(machine),
+                        "state": machine_state(machine),
+                    }
+                shard_doc = {
+                    "version": 1,
+                    "kind": "fleet-health-shard",
+                    "project": self.project,
+                    "updated_at": stamp,
+                    "shard": shard,
+                    "shards": count,
+                    "machines": machines,
+                    "summary": shard_summaries.get(shard),
+                }
+                self._atomic_write(
+                    os.path.join(
+                        self.shard_dir, _shard_file_name(shard, count)
+                    ),
+                    shard_doc,
+                )
+            summary_doc: Dict[str, Any] = {
+                "version": 1,
+                "kind": "fleet-health-summary",
+                "project": self.project,
+                "updated_at": stamp,
+                "shards": count,
+                "machines_total": total,
+                "summary": summary,
+                "offenders": offenders,
+            }
+            if plan_accuracy is not None:
+                summary_doc["plan_accuracy"] = plan_accuracy
+            self._atomic_write(
+                os.path.join(self.shard_dir, FLEET_HEALTH_SUMMARY_FILE),
+                summary_doc,
+            )
+            # the shard layout is now authoritative: retire this
+            # worker's monolithic spelling so merge-on-read can never
+            # double-count the two layouts (the migration contract —
+            # the legacy file is read once at restore, then gone)
+            if self.path and os.path.exists(self.path):
+                with contextlib.suppress(OSError):
+                    os.remove(self.path)
+        except OSError as exc:
+            logger.debug("fleet_health shard flush failed: %r", exc)
+        return summary
+
+    def _cleanup_shard_layout(self) -> None:
+        """Monolithic mode: remove a stale shard directory left by a
+        previous (larger) incarnation, so readers never merge both."""
+        if self.shard_dir is None or not os.path.isdir(self.shard_dir):
+            return
+        with contextlib.suppress(OSError):
+            for entry in os.listdir(self.shard_dir):
+                with contextlib.suppress(OSError):
+                    os.remove(os.path.join(self.shard_dir, entry))
+            os.rmdir(self.shard_dir)
 
     def flush(self) -> None:
         self.write(force=True)
@@ -656,6 +1119,35 @@ class FleetHealthLedger:
                                 machine[section][key] = incoming[key]
             if isinstance(doc.get("plan_accuracy"), dict):
                 self._plan_accuracy = dict(doc["plan_accuracy"])
+
+    def _load_own_snapshot(self) -> Optional[Dict[str, Any]]:
+        """This worker's persisted state, whichever layout it left:
+        the shard directory when it has files (newest flush wins per
+        machine), else the legacy monolithic document — read ONCE here;
+        the first sharded flush retires it."""
+        if self.shard_dir:
+            shard_docs = []
+            for path in _shard_files(self.shard_dir):
+                doc = _load_json(path)
+                if isinstance(doc, dict) and isinstance(
+                    doc.get("machines"), dict
+                ):
+                    shard_docs.append(doc)
+            if shard_docs:
+                shard_docs.sort(key=lambda d: str(d.get("updated_at") or ""))
+                machines: Dict[str, Any] = {}
+                for doc in shard_docs:
+                    machines.update(doc["machines"])
+                merged: Dict[str, Any] = {"machines": machines}
+                summary_doc = _load_json(
+                    os.path.join(self.shard_dir, FLEET_HEALTH_SUMMARY_FILE)
+                )
+                if isinstance(summary_doc, dict) and isinstance(
+                    summary_doc.get("plan_accuracy"), dict
+                ):
+                    merged["plan_accuracy"] = summary_doc["plan_accuracy"]
+                return merged
+        return _load_json(self.path) if self.path else None
 
 
 # -- the process-global registry ---------------------------------------------
@@ -704,10 +1196,11 @@ def ledger_for(directory: str, project: str = "") -> Any:
             ledger = None
         if ledger is None:
             ledger = FleetHealthLedger(directory=key, project=project)
-            # restore from the ledger's OWN snapshot path (pid-suffixed
-            # under worker sinks): adopting another worker's snapshot
-            # would double its counts once readers merge the variants
-            persisted = _load_json(ledger.path) if ledger.path else None
+            # restore from the ledger's OWN snapshot (pid-suffixed
+            # under worker sinks; shard dir when the last incarnation
+            # was sharded): adopting another worker's snapshot would
+            # double its counts once readers merge the variants
+            persisted = ledger._load_own_snapshot()
             if isinstance(persisted, dict):
                 ledger.restore(persisted)
             _ledgers[key] = ledger
@@ -728,17 +1221,58 @@ def reset_ledgers() -> None:
         _ledgers.clear()
 
 
+def _load_shard_unit(shard_dir: str) -> Optional[Dict[str, Any]]:
+    """One worker's shard directory folded back into a single health
+    document (machines union, newest flush wins; plan accuracy from
+    ``summary.json``)."""
+    shard_docs = []
+    for path in _shard_files(shard_dir):
+        doc = _load_json(path)
+        if isinstance(doc, dict) and isinstance(doc.get("machines"), dict):
+            shard_docs.append(doc)
+    if not shard_docs:
+        return None
+    shard_docs.sort(key=lambda d: str(d.get("updated_at") or ""))
+    machines: Dict[str, Any] = {}
+    for doc in shard_docs:
+        machines.update(doc["machines"])
+    newest = shard_docs[-1]
+    merged: Dict[str, Any] = {
+        "version": 1,
+        "project": newest.get("project", ""),
+        "updated_at": newest.get("updated_at"),
+        "machines": machines,
+        "summary": summarize(machines),
+    }
+    summary_doc = _load_json(
+        os.path.join(shard_dir, FLEET_HEALTH_SUMMARY_FILE)
+    )
+    if isinstance(summary_doc, dict) and isinstance(
+        summary_doc.get("plan_accuracy"), dict
+    ):
+        merged["plan_accuracy"] = summary_doc["plan_accuracy"]
+    return merged
+
+
 def load_health(directory: str) -> Optional[Dict[str, Any]]:
-    """The persisted ``fleet_health.json`` from ``directory`` (or None)."""
+    """The persisted shared-spelling health snapshot from ``directory``
+    (the ``fleet_health.d/`` shard layout when present, else the
+    monolithic ``fleet_health.json``), or None."""
+    shard_dir = os.path.join(directory, FLEET_HEALTH_SHARD_DIR)
+    if os.path.isdir(shard_dir):
+        doc = _load_shard_unit(shard_dir)
+        if doc is not None:
+            return doc
     doc = _load_json(os.path.join(directory, FLEET_HEALTH_FILE))
     return doc if isinstance(doc, dict) else None
 
 
 def health_snapshot_paths(directory: str) -> List[str]:
-    """Every persisted health snapshot in ``directory``: the shared
-    ``fleet_health.json`` plus per-worker ``fleet_health-<pid>.json``
-    variants (one grammar: ``aggregate.is_worker_variant``), sorted for
-    determinism."""
+    """Every persisted monolithic health snapshot in ``directory``: the
+    shared ``fleet_health.json`` plus per-worker
+    ``fleet_health-<pid>.json`` variants (one grammar:
+    ``aggregate.is_worker_variant``), sorted for determinism. Sharded
+    workers don't appear here — see :func:`health_snapshot_units`."""
     from .aggregate import is_worker_variant
 
     try:
@@ -751,6 +1285,89 @@ def health_snapshot_paths(directory: str) -> List[str]:
         if entry == FLEET_HEALTH_FILE
         or is_worker_variant(entry, FLEET_HEALTH_FILE)
     ]
+
+
+def health_snapshot_units(directory: str) -> List[Dict[str, Any]]:
+    """Every persisted health snapshot in ``directory``, one unit per
+    WORKER: ``{"stem", "kind": "file"|"shards", "paths", "dir"}``. A
+    worker that left both layouts (a crash between the shard flush and
+    the legacy unlink) counts once — the shard directory wins, so the
+    merge can never double its records."""
+    from .aggregate import is_worker_variant
+
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return []
+    files: Dict[str, str] = {}
+    shard_dirs: Dict[str, str] = {}
+    for entry in sorted(entries):
+        path = os.path.join(directory, entry)
+        if entry == FLEET_HEALTH_FILE or is_worker_variant(
+            entry, FLEET_HEALTH_FILE
+        ):
+            files[os.path.splitext(entry)[0]] = path
+        elif (
+            entry == FLEET_HEALTH_SHARD_DIR
+            or is_worker_variant(entry, FLEET_HEALTH_SHARD_DIR)
+        ) and os.path.isdir(path):
+            shard_dirs[os.path.splitext(entry)[0]] = path
+    units: List[Dict[str, Any]] = []
+    for stem in sorted(set(files) | set(shard_dirs)):
+        shard_dir = shard_dirs.get(stem)
+        if shard_dir is not None:
+            paths = _shard_files(shard_dir)
+            if paths:
+                units.append(
+                    {
+                        "stem": stem,
+                        "kind": "shards",
+                        "paths": paths,
+                        "dir": shard_dir,
+                    }
+                )
+                continue
+        if stem in files:
+            units.append(
+                {
+                    "stem": stem,
+                    "kind": "file",
+                    "paths": [files[stem]],
+                    "dir": None,
+                }
+            )
+    return units
+
+
+def _load_unit_document(unit: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    if unit["kind"] == "shards":
+        return _load_shard_unit(unit["dir"])
+    doc = _load_json(unit["paths"][0])
+    return doc if isinstance(doc, dict) else None
+
+
+def _unit_summary(unit: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """A worker unit's bounded summary WITHOUT parsing its machines:
+    ``summary.json`` for sharded units (constant-size however large the
+    worker's fleet), the persisted document's own summary for monolithic
+    units (whose size is bounded by the monolithic threshold anyway).
+    Returns ``{"summary", "offenders"?, "machines_total"?, ...}``."""
+    if unit["kind"] == "shards":
+        doc = _load_json(
+            os.path.join(unit["dir"], FLEET_HEALTH_SUMMARY_FILE)
+        )
+        if isinstance(doc, dict) and isinstance(doc.get("summary"), dict):
+            return doc
+        return None
+    doc = _load_json(unit["paths"][0])
+    if isinstance(doc, dict) and isinstance(doc.get("summary"), dict):
+        return {
+            "summary": doc["summary"],
+            "machines_total": len(doc.get("machines") or {}),
+            "updated_at": doc.get("updated_at"),
+            "plan_accuracy": doc.get("plan_accuracy"),
+        }
+    return None
 
 
 def _newest(records: List[Dict[str, Any]], stamp_key: str) -> Dict[str, Any]:
@@ -873,11 +1490,16 @@ def load_merged_health(
     paths go in ``exclude_paths`` so a worker's counts never merge with
     its own persisted copy (see :func:`fleet_status_document`)."""
     docs = list(live_documents or [])
-    excluded = {os.path.normpath(p) for p in (exclude_paths or [])}
-    for path in health_snapshot_paths(directory):
-        if os.path.normpath(path) in excluded:
+    # exclusion is per WORKER (stem), not per file: a live ledger must
+    # skip its own persisted copy whichever layout it last wrote
+    excluded = {
+        os.path.splitext(os.path.basename(p))[0]
+        for p in (exclude_paths or [])
+    }
+    for unit in health_snapshot_units(directory):
+        if unit["stem"] in excluded:
             continue
-        doc = _load_json(path)
+        doc = _load_unit_document(unit)
         if isinstance(doc, dict):
             docs.append(doc)
     if len(docs) == 1:
@@ -903,7 +1525,34 @@ def breaker_tripped_machines(
     record, and a forgotten ``open`` stamp must not drive rebuild
     canaries forever (the same reasoning as the SLO engine's
     ``firing_alerts(max_age_s=...)``).
+
+    Bounded fast path: every worker's persisted summary carries a
+    ``breaker_tripped`` count (a trip forces a flush, so the counts are
+    current); when they all read zero the full machine parse — O(N)
+    per lifecycle cycle at 10k members — is skipped entirely.
     """
+    # (only when the caller's cutoff is at most the summaries' own —
+    # a laxer cutoff, including 0 = "no cutoff", could admit records
+    # the summaries already aged out)
+    units = (
+        health_snapshot_units(directory)
+        if 0 < max_age_s <= BREAKER_STATE_MAX_AGE_S
+        else []
+    )
+    if units:
+        tripped_hint = 0
+        for unit in units:
+            summary_doc = _unit_summary(unit)
+            summary = (summary_doc or {}).get("summary")
+            count = (summary or {}).get("breaker_tripped")
+            if count is None:
+                # pre-upgrade snapshot without the count: can't prove
+                # anything cheaply, fall through to the full read
+                tripped_hint = -1
+                break
+            tripped_hint += int(count)
+        if tripped_hint == 0:
+            return {}
     doc = load_merged_health(directory)
     if not isinstance(doc, dict):
         return {}
@@ -926,11 +1575,97 @@ def _load_json(path: str) -> Optional[Any]:
         return None
 
 
+def _machine_selection(
+    machines: Union[None, str, Iterable[str]],
+) -> Tuple[Optional[str], Optional[List[str]]]:
+    """Normalize the ``machines=`` selector: ``(kind, names)`` where
+    kind is None (adaptive default), ``"none"``, ``"all"``, a state
+    filter (``healthy``/``degraded``/``drifting``/``quarantined``/
+    ``unhealthy``) or ``"names"``."""
+    if machines is None:
+        return None, None
+    if isinstance(machines, str):
+        token = machines.strip()
+        low = token.lower()
+        if low in ("", "none", "summary"):
+            return "none", None
+        if low == "all":
+            return "all", None
+        if low in ("healthy", "degraded", "drifting", "quarantined", "unhealthy"):
+            return low, None
+        return "names", [t.strip() for t in token.split(",") if t.strip()]
+    return "names", [str(name) for name in machines]
+
+
+def _select_machines(
+    machines: Dict[str, Dict[str, Any]],
+    kind: Optional[str],
+    names: Optional[List[str]],
+    offset: int,
+    limit: int,
+) -> Tuple[Dict[str, Dict[str, Any]], bool]:
+    """Apply a normalized selector + page window to the merged machine
+    map; returns (selected, truncated)."""
+    if kind == "names":
+        wanted = [n for n in (names or []) if n in machines]
+        page = wanted[offset : offset + limit]
+        return (
+            {name: machines[name] for name in page},
+            len(wanted) > offset + len(page),
+        )
+    if kind == "unhealthy":
+        pool = [
+            name
+            for name in sorted(machines)
+            if (machines[name].get("health") or {}).get("state") != "healthy"
+        ]
+    elif kind in ("healthy", "degraded", "drifting", "quarantined"):
+        pool = [
+            name
+            for name in sorted(machines)
+            if (machines[name].get("health") or {}).get("state") == kind
+        ]
+    else:  # "all"
+        pool = sorted(machines)
+    page = pool[offset : offset + limit]
+    return (
+        {name: machines[name] for name in page},
+        len(pool) > offset + len(page),
+    )
+
+
+def _doc_offenders(
+    machines: Dict[str, Dict[str, Any]], top_k: int
+) -> List[Dict[str, Any]]:
+    """Top-K offender rows from a merged document's machine map (whose
+    records already carry derived ``health``)."""
+    entries = []
+    for name, record in machines.items():
+        health = record.get("health") or {}
+        state = health.get("state")
+        if state in (None, "healthy"):
+            continue
+        entries.append(
+            {
+                "machine": name,
+                "score": health.get("score", 0.0),
+                "state": state,
+                "reason": _offender_reason(record, state),
+            }
+        )
+    return heapq.nsmallest(
+        top_k, entries, key=lambda e: (e["score"], e["machine"])
+    )
+
+
 def fleet_status_document(
     directory: str,
     device: Optional[Dict[str, Any]] = None,
     programs: Optional[Dict[str, Any]] = None,
     serving: Optional[Dict[str, Any]] = None,
+    machines: Union[None, str, Iterable[str]] = None,
+    limit: Optional[int] = None,
+    offset: int = 0,
 ) -> Dict[str, Any]:
     """
     The one joined operator view over a build+serve directory:
@@ -951,6 +1686,14 @@ def fleet_status_document(
 
     Sections degrade to None independently: a build dir with no
     lifecycle state still joins, a serve dir with no plan still joins.
+
+    The health section is BOUNDED at scale: per-machine records are
+    inlined only while the fleet fits ``GORDO_TPU_FLEET_STATUS_MAX_MACHINES``
+    (default 500); past that the section carries the summary, the
+    machine count and the top-K offenders. ``machines=`` selects
+    explicitly — ``"all"`` / a state name / ``"unhealthy"`` / a
+    comma-separated name list / ``"none"`` — with ``limit``/``offset``
+    paging (capped at the same knob).
     """
     from .progress import load_status
 
@@ -965,21 +1708,78 @@ def fleet_status_document(
     doc["build"] = load_status(directory)
 
     plan = _load_json(os.path.join(directory, "fleet_plan.json"))
+
+    from ..utils.env import env_int
+
+    kind, names = _machine_selection(machines)
+    max_inline = max(
+        1,
+        env_int(
+            FLEET_STATUS_MAX_MACHINES_ENV, DEFAULT_FLEET_STATUS_MAX_MACHINES
+        ),
+    )
+    top_k = max(
+        1, env_int(FLEET_STATUS_TOP_K_ENV, DEFAULT_FLEET_STATUS_TOP_K)
+    )
+    page_limit = (
+        max_inline if limit is None else max(0, min(int(limit), max_inline))
+    )
+    page_offset = max(0, int(offset or 0))
+
     # the health view is a MERGE: this process's live ledger (its own
-    # snapshot path excluded — a worker must not double-count with its
-    # persisted copy) plus every other worker's fleet_health-<pid>.json
-    health_doc: Optional[Dict[str, Any]]
+    # snapshot excluded by worker stem — a worker must not double-count
+    # with its persisted copy) plus every other worker's snapshots.
+    # Bounded-first: when no per-machine records are wanted (or the
+    # fleet outgrew the inline threshold) and a single source can
+    # answer, the summary path never materializes the machine map —
+    # O(shards), not O(fleet).
     ledger = _ledgers.get(directory)
-    live_docs = [ledger.document()] if ledger is not None else []
-    own_paths = [ledger.path] if ledger is not None and ledger.path else []
-    health_doc = load_merged_health(
-        directory, live_documents=live_docs, exclude_paths=own_paths
+    own_stems = set()
+    if ledger is not None and ledger.path:
+        own_stems.add(os.path.splitext(os.path.basename(ledger.path))[0])
+    units = [
+        unit
+        for unit in health_snapshot_units(directory)
+        if unit["stem"] not in own_stems
+    ]
+    single_live = ledger is not None and not units
+
+    bounded_doc: Optional[Dict[str, Any]] = None
+    health_doc: Optional[Dict[str, Any]] = None
+    if single_live and (
+        kind == "none"
+        or (kind is None and ledger.machine_count() > max_inline)
+    ):
+        bounded_doc = ledger.bounded_document(top_k)
+    elif (
+        kind in (None, "none")
+        and ledger is None
+        and len(units) == 1
+        and units[0]["kind"] == "shards"
+    ):
+        candidate = _unit_summary(units[0])
+        if candidate is not None and (
+            kind == "none"
+            or int(candidate.get("machines_total") or 0) > max_inline
+        ):
+            bounded_doc = candidate
+    if bounded_doc is None:
+        live_docs = [ledger.document()] if ledger is not None else []
+        own_paths = (
+            [ledger.path] if ledger is not None and ledger.path else []
+        )
+        health_doc = load_merged_health(
+            directory, live_documents=live_docs, exclude_paths=own_paths
+        )
+
+    accuracy_source = (
+        bounded_doc if bounded_doc is not None else (health_doc or {})
     )
     if isinstance(plan, dict):
         doc["plan"] = {
             "strategy": plan.get("strategy"),
             "totals": plan.get("totals"),
-            "accuracy": (health_doc or {}).get("plan_accuracy"),
+            "accuracy": accuracy_source.get("plan_accuracy"),
         }
     else:
         doc["plan"] = None
@@ -1004,14 +1804,48 @@ def fleet_status_document(
     else:
         doc["lifecycle"] = None
 
-    if health_doc is not None:
+    if bounded_doc is not None:
+        total = int(bounded_doc.get("machines_total") or 0)
         doc["health"] = {
-            "summary": health_doc.get("summary"),
-            "machines": health_doc.get("machines"),
-            "updated_at": health_doc.get("updated_at"),
+            "summary": bounded_doc.get("summary"),
+            "machines": None,
+            "machines_total": total,
+            "machines_truncated": total > 0,
+            "top_offenders": (bounded_doc.get("offenders") or [])[:top_k],
+            "updated_at": bounded_doc.get("updated_at"),
         }
+    elif health_doc is not None:
+        machines_all = health_doc.get("machines") or {}
+        total = len(machines_all)
+        section: Dict[str, Any] = {
+            "summary": health_doc.get("summary"),
+            "updated_at": health_doc.get("updated_at"),
+            "machines_total": total,
+            "top_offenders": _doc_offenders(machines_all, top_k),
+        }
+        if kind is None:
+            # adaptive default: small fleets inline every record (the
+            # document everyone always got); big ones get the bounded
+            # summary + offenders and explicit selection on request
+            if total <= max_inline:
+                section["machines"] = machines_all
+                section["machines_truncated"] = False
+            else:
+                section["machines"] = None
+                section["machines_truncated"] = True
+        elif kind == "none":
+            section["machines"] = None
+            section["machines_truncated"] = total > 0
+        else:
+            selected, truncated = _select_machines(
+                machines_all, kind, names, page_offset, page_limit
+            )
+            section["machines"] = selected
+            section["machines_offset"] = page_offset
+            section["machines_truncated"] = truncated
         if health_doc.get("workers_merged"):
-            doc["health"]["workers_merged"] = health_doc["workers_merged"]
+            section["workers_merged"] = health_doc["workers_merged"]
+        doc["health"] = section
     else:
         doc["health"] = None
     # the SLO verdict joins the console: alert states from the engine's
@@ -1102,24 +1936,45 @@ def render_fleet_status(doc: Dict[str, Any]) -> str:
             f"{summary.get('quarantined', 0)} quarantined"
             f" (error rate {100.0 * float(summary.get('error_rate') or 0.0):.2f}%)"
         )
-        machines = health.get("machines") or {}
-        unhealthy = sorted(
-            (
-                (record["health"]["score"], name, record)
+        total = health.get("machines_total")
+        shown = health.get("machines")
+        if health.get("machines_truncated") and total:
+            lines.append(
+                f"  (per-machine records elided at {total} members — "
+                "select with --machines/?machines=)"
+            )
+        elif isinstance(shown, dict) and total and len(shown) < total:
+            lines.append(
+                f"  (showing {len(shown)} of {total} machine record(s))"
+            )
+        offenders = health.get("top_offenders")
+        if offenders is None:
+            # pre-upgrade documents: derive from the inline records
+            machines = shown or {}
+            offenders = [
+                {
+                    "machine": name,
+                    "score": record["health"]["score"],
+                    "state": record["health"]["state"],
+                    "reason": _offender_reason(
+                        record, record["health"]["state"]
+                    ),
+                }
                 for name, record in machines.items()
                 if record.get("health", {}).get("state") != "healthy"
-            ),
-        )[:10]
-        for score, name, record in unhealthy:
-            state = record["health"]["state"]
-            reasons = (
-                record["quarantine"]["reasons"]
-                if state == "quarantined"
-                else record["drift"]["reasons"]
+            ]
+            offenders = heapq.nsmallest(
+                10, offenders, key=lambda e: (e["score"], e["machine"])
             )
+        for entry in offenders:
             lines.append(
-                f"  {name}: {state} (score {score:.2f})"
-                + (f" — {reasons[0]}" if reasons else "")
+                f"  {entry.get('machine')}: {entry.get('state')} "
+                f"(score {float(entry.get('score') or 0.0):.2f})"
+                + (
+                    f" — {entry['reason']}"
+                    if entry.get("reason")
+                    else ""
+                )
             )
     else:
         lines.append("Health:    (no fleet_health.json)")

@@ -48,17 +48,20 @@ def iter_trace_files(
     path: str,
     include_rotated: bool = True,
     since_ts: Optional[float] = None,
+    window_index: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> List[str]:
     """The physical files of one trace sink, oldest first — the rollup
     reader's generation discovery (``aggregate.generation_files``, a
     directory listing rather than a ``.1``-exists probe walk: mid-
     rotation the ``.1`` slot is briefly empty while higher generations
     still hold bytes, and a probe walk goes blind to the whole chain).
-    With ``since_ts``, rotated generations whose mtime predates it are
-    skipped wholesale — a generation's mtime is its LAST write, so
-    every span in it is older than the cutoff. This is what keeps
-    ``gordo-tpu trace --since`` from re-parsing a week-old 256MiB
-    corpus."""
+    With ``since_ts``, rotated generations are skipped wholesale when
+    provably pre-cutoff: by the rollup manifest's span-time window
+    (``window_index``, keyed by basename — ``aggregate.sink_window_
+    index``; authoritative when the generation was read ``complete``),
+    else by mtime — a generation's mtime is its LAST write, so every
+    span in it is older than the cutoff. This is what keeps ``gordo-tpu
+    trace --since`` from re-parsing a week-old 256MiB corpus."""
     from .aggregate import generation_files
 
     if include_rotated:
@@ -70,6 +73,13 @@ def iter_trace_files(
     kept = []
     for trace_path in paths:
         if trace_path != path:  # the live file always stays
+            entry = (window_index or {}).get(os.path.basename(trace_path))
+            if entry and entry.get("complete"):
+                max_ts = entry.get("max_ts")
+                if max_ts is not None and float(max_ts) < since_ts:
+                    continue
+                kept.append(trace_path)
+                continue
             try:
                 if os.path.getmtime(trace_path) < since_ts:
                     continue
@@ -90,13 +100,16 @@ def read_trace(
     include_rotated: bool = True,
     since_ts: Optional[float] = None,
     until_ts: Optional[float] = None,
+    window_index: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> Iterator[dict]:
     """Yield span dicts from a JSONL trace file, oldest first across
     rotated generations (``p.N`` ... ``p.1``, then ``p``). Unparseable
     lines (a crash mid-write leaves at most one) are skipped. With a
     time window, spans ending outside [since_ts, until_ts] are dropped
     and pre-cutoff generations are never opened at all."""
-    for trace_path in iter_trace_files(path, include_rotated, since_ts):
+    for trace_path in iter_trace_files(
+        path, include_rotated, since_ts, window_index=window_index
+    ):
         try:
             handle = open(trace_path)
         except OSError:
@@ -127,13 +140,19 @@ def read_traces(
     paths: List[str],
     since_ts: Optional[float] = None,
     until_ts: Optional[float] = None,
+    window_index: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> Iterator[dict]:
     """Spans from several sink bases (N workers' traces), deduplicated
     by ``(trace_id, span_id)`` — the merge contract shared with the
     rollup reducer."""
     seen: set = set()
     for path in paths:
-        for span in read_trace(path, since_ts=since_ts, until_ts=until_ts):
+        for span in read_trace(
+            path,
+            since_ts=since_ts,
+            until_ts=until_ts,
+            window_index=window_index,
+        ):
             context = span.get("context") or {}
             key = (context.get("trace_id", ""), context.get("span_id", ""))
             if key != ("", ""):
@@ -317,15 +336,25 @@ def analyze_trace(
     path: Any,
     since_ts: Optional[float] = None,
     until_ts: Optional[float] = None,
+    window_index: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> Dict[str, Any]:
     """The full analysis document for one trace (a file path, or a list
     of sink bases to read-merge — the per-worker variants of one
     logical trace): span summaries, the request breakdown, and the
     aggregated profile — the JSON shape ``gordo-tpu trace --as-json``
     prints and the tests golden-check. ``since_ts``/``until_ts``
-    restrict the analysis to a time window (``--since``/``--last``)."""
+    restrict the analysis to a time window (``--since``/``--last``);
+    ``window_index`` (``aggregate.sink_window_index``) lets rotated
+    generations be skipped by recorded span window, not just mtime."""
     paths = [path] if isinstance(path, str) else list(path)
-    spans = list(read_traces(paths, since_ts=since_ts, until_ts=until_ts))
+    spans = list(
+        read_traces(
+            paths,
+            since_ts=since_ts,
+            until_ts=until_ts,
+            window_index=window_index,
+        )
+    )
     doc = {
         "trace": paths[0] if len(paths) == 1 else paths,
         "spans_read": len(spans),

@@ -317,6 +317,28 @@ KNOBS: Dict[str, Knob] = _knobs(
         "Telemetry",
     ),
     Knob(
+        "GORDO_TPU_HEALTH_SHARDS", "int", 0,
+        "Fleet-health snapshot shard count (`fleet_health.d/`): 0 "
+        "(default) sizes adaptively — monolithic `fleet_health.json` "
+        "for small fleets, then ~512 machines per shard up to 64 "
+        "shards — so a dirty-shard flush rewrites one bounded file, "
+        "not the whole fleet. Any positive value pins the count.",
+        "Telemetry",
+    ),
+    Knob(
+        "GORDO_TPU_FLEET_STATUS_MAX_MACHINES", "int", 500,
+        "Per-machine records inlined in the fleet-status document only "
+        "while the fleet is at most this large (past it: summary + "
+        "top-K offenders); also the hard cap on one `?machines=` page.",
+        "Telemetry",
+    ),
+    Knob(
+        "GORDO_TPU_FLEET_STATUS_TOP_K", "int", 10,
+        "Offender rows (unhealthiest machines) carried by the bounded "
+        "fleet-status health section.",
+        "Telemetry",
+    ),
+    Knob(
         "GORDO_TPU_DEVICE_TELEMETRY", "bool", True,
         "Device-utilization sampling (`Device.memory_stats()` around "
         "fleet programs and at Prometheus scrape time); the "
@@ -345,6 +367,14 @@ KNOBS: Dict[str, Knob] = _knobs(
         "Rollup window size for the cross-worker telemetry reducer "
         "(`rollups/<window>.json`); boundaries align to it, so rollups "
         "from different workers/hosts merge bucket-for-bucket.",
+        "SLO",
+    ),
+    Knob(
+        "GORDO_TPU_ROLLUP_MANIFEST", "bool", True,
+        "Maintain `rollups/manifest.json` (window -> file map + "
+        "per-sink span windows) so merged-window reads and "
+        "`--since`/`--last` queries open only the rollup files they "
+        "need instead of walking the directory.",
         "SLO",
     ),
     Knob(

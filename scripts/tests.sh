@@ -79,6 +79,10 @@ case "$component" in
     # tests/server, tests/telemetry and tests/lifecycle —
     # marker-selected the same way.
     chaos)    run -m "chaos and not slow" tests/ ;;
+    # The fleet-scale observability suite (sharded ledger, rollup
+    # manifest, bounded fleet-status, breaker summaries) lives in
+    # tests/telemetry + tests/server — marker-selected the same way.
+    scale)    run -m "scale and not slow" tests/ ;;
     utils)    run -m "not slow" tests/utils ;;
     workflow) run -m "not slow" tests/workflow ;;
     formatting) run tests/test_codestyle.py ;;

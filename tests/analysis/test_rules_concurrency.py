@@ -114,6 +114,37 @@ def test_helper_called_only_under_lock_is_lock_held(lint_tree):
     assert not _rules(result, "lock-guard")
 
 
+def test_locked_helper_chain_defined_before_its_callers_is_clean(lint_tree):
+    # the _reshard_locked -> _machine -> record_* shape: the deepest
+    # helper is DEFINED before the function that seeds its lock context.
+    # The fixpoint must not let a not-yet-seeded private caller inject a
+    # spurious unlocked context on the first sweep (the empty-context
+    # default is a check-time fallback, never a propagated context).
+    result = lint_tree(
+        {
+            "gordo_tpu/serve/ok.py": """
+                import threading
+
+                class Ledger:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._count = 0
+
+                    def _grow(self):
+                        self._count += 1
+
+                    def _ensure(self):
+                        self._grow()
+
+                    def add(self):
+                        with self._lock:
+                            self._ensure()
+            """
+        }
+    )
+    assert not _rules(result, "lock-guard")
+
+
 def test_helper_with_one_unlocked_call_site_is_not_assumed_locked(lint_tree):
     result = lint_tree(
         {

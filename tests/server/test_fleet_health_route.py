@@ -67,6 +67,40 @@ def test_fleet_health_route_serves_joined_document(client, collection_dir):
     assert doc["lifecycle"] is None
 
 
+@pytest.mark.scale
+def test_fleet_health_route_machine_selection_params(client, collection_dir):
+    """The bounded-surface query grammar (PR 16): ``?machines=`` selects
+    records explicitly, ``?limit=``/``?offset=`` page the selection."""
+    ledger = ledger_for(collection_dir)
+    names = [f"route-m-{i:02d}" for i in range(12)]
+    for name in names:
+        ledger.record_request(name)
+
+    doc = client.get(url("fleet-health?machines=none")).json
+    assert doc["health"]["machines"] is None
+    assert doc["health"]["machines_total"] == 12
+    assert doc["health"]["machines_truncated"] is True
+    assert doc["health"]["summary"]["machines"] == 12
+
+    doc = client.get(url("fleet-health?machines=all&limit=5")).json
+    assert sorted(doc["health"]["machines"]) == names[:5]
+    assert doc["health"]["machines_offset"] == 0
+    assert doc["health"]["machines_truncated"] is True
+
+    doc = client.get(url("fleet-health?machines=all&limit=5&offset=10")).json
+    assert sorted(doc["health"]["machines"]) == names[10:]
+    assert doc["health"]["machines_truncated"] is False
+
+    doc = client.get(
+        url("fleet-health?machines=route-m-03,route-m-07,no-such")
+    ).json
+    assert sorted(doc["health"]["machines"]) == ["route-m-03", "route-m-07"]
+
+    # malformed paging never errors — it falls back to defaults
+    doc = client.get(url("fleet-health?machines=all&limit=zap&offset=zap")).json
+    assert len(doc["health"]["machines"]) == 12
+
+
 def test_fleet_health_route_without_any_data_still_answers(client):
     resp = client.get(url("fleet-health"))
     assert resp.status_code == 200

@@ -3,6 +3,7 @@
 import gc
 import weakref
 
+import pytest
 from prometheus_client import CollectorRegistry
 from werkzeug.test import Client
 
@@ -498,6 +499,50 @@ def test_fleet_console_collectors_on_every_scrape_registry(
     finally:
         reset_ledgers()
         device.reset_program_counters()
+
+
+@pytest.mark.scale
+def test_store_revision_bytes_gauge(client, collection_dir, sensor_payload):
+    """``gordo_store_revision_bytes`` (PR 16): per-revision resident-byte
+    estimates from the serving store, revision basenames only in the
+    label (bounded by N_CACHED_REVISIONS — the PR 8 cardinality
+    contract) with the constant three-value ``kind`` axis."""
+    import json as _json
+
+    from gordo_tpu.server.fleet_store import STORE
+    from gordo_tpu.server.prometheus.metrics import (
+        register_program_cache_collector,
+    )
+
+    # score through the route so the served revision is resident
+    resp = client.post(
+        "/gordo/v0/test-project/machine-1/prediction",
+        data=_json.dumps(sensor_payload),
+        content_type="application/json",
+    )
+    assert resp.status_code == 200
+
+    stats = STORE.revision_stats()
+    assert stats, "served revision should be resident in the store"
+
+    registry = CollectorRegistry()
+    register_program_cache_collector(registry)
+    for revision, expected in stats.items():
+        value = registry.get_sample_value(
+            "gordo_store_revision_bytes",
+            {"revision": revision, "kind": "model"},
+        )
+        assert value == expected["model_bytes"]
+        assert value > 0  # real loaded params, not a stub
+    samples = [
+        sample
+        for metric in registry.collect()
+        if metric.name == "gordo_store_revision_bytes"
+        for sample in metric.samples
+    ]
+    assert {s.labels["kind"] for s in samples} == {"model", "stacked", "cast"}
+    # revision labels are basenames (bounded), never member names
+    assert {s.labels["revision"] for s in samples} == set(stats)
 
 
 def test_serve_metrics_breaker_counters_and_gauge():

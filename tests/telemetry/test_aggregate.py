@@ -12,6 +12,7 @@ import pytest
 from gordo_tpu.telemetry.aggregate import (
     LATENCY_BUCKETS_MS,
     ROLLUP_DIR,
+    ROLLUP_MANIFEST_FILE,
     ROLLUP_STATE_FILE,
     RollupStore,
     discover_sinks,
@@ -309,9 +310,16 @@ def test_rollup_pruning(tmp_path, monkeypatch):
     kept = [
         entry
         for entry in os.listdir(store.rollup_dir)
-        if entry != ROLLUP_STATE_FILE and not entry.startswith(".")
+        if entry != ROLLUP_STATE_FILE
+        and entry != ROLLUP_MANIFEST_FILE
+        and not entry.startswith(".")
     ]
     assert len(kept) == 3
+    # the manifest tracks exactly the surviving windows
+    manifest = store._load_json(store.manifest_path)
+    assert sorted(manifest["windows"]) == sorted(
+        entry[: -len(".json")] for entry in kept
+    )
 
 
 def test_rollup_dir_and_state_are_droppings():

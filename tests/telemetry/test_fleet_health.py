@@ -99,6 +99,7 @@ def test_snapshot_golden_schema(tmp_path):
         "requests",
         "errors",
         "error_rate",
+        "breaker_tripped",
         "score_histogram",
     }
     assert summary["score_histogram"]["buckets"] == list(SCORE_BUCKETS)
