@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-concurrency test-fleet-health test-slo test-precision test-chaos test-scale docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-precision bench-chaos bench-scale bench-check lint lint-gordo lockgraph-check image
+.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-concurrency test-fleet-health test-slo test-precision test-chaos test-scale test-stream docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-precision bench-chaos bench-scale bench-stream bench-check lint lint-gordo lockgraph-check image
 
 test:
 	python -m pytest tests/ -q
@@ -114,6 +114,21 @@ test-chaos:
 # BENCH_CHAOS.json (gated by `gordo-tpu bench-check`).
 bench-chaos:
 	JAX_PLATFORMS=cpu python benchmarks/bench_chaos.py
+
+# The streaming scoring-plane suite: row/event rings, SSE session
+# replay + cursor resume, watermark scoring with breaker quarantine,
+# backpressure shedding, hot-swap pinning, drain terminals, the three
+# stream_* fault-site drills — CPU-only and not slow-marked, so the
+# same tests also run inside the tier-1 budget.
+test-stream:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m stream
+
+# Streaming soak harness: N long-lived sessions under sustained Arrow
+# ingest with >=5 mid-stream hot-swaps, a poisoned member (quarantine +
+# half-open recovery), and a drain audit; writes BENCH_STREAM.json
+# (gated by `gordo-tpu bench-check`).
+bench-stream:
+	JAX_PLATFORMS=cpu python benchmarks/bench_stream.py
 
 # The fleet-scale observability suite: sharded ledger layout/migration/
 # dirty-flush contracts, rollup-manifest counting-open reads, bounded

@@ -1,0 +1,423 @@
+"""
+Streaming-plane soak: the always-on scoring plane under sustained load.
+
+The harness measures the PR 17 acceptance criteria end to end against a
+REAL built fleet (no fakes): N long-lived stream sessions each ingest
+Arrow record batches for every fleet member on a keep-alive loop while a
+dedicated SSE consumer per stream holds one unbounded ``/events``
+response open for the whole run. Four phases share the same live
+sessions — nothing is torn down between them, because the contract under
+test is precisely that the plane survives what happens mid-stream:
+
+1. **soak** — sustained ingest+score throughput (rows/s) with ``>= 5``
+   lifecycle hot-swaps landing mid-stream. The committed gate: rows/s
+   must beat the request/response ceiling (BENCH_ROUTE's JSON
+   throughput), because one standing connection amortizes decode and
+   dispatch across many windows.
+2. **poison** — ``stream_score`` faults fire for ONE member; its breaker
+   must quarantine it (``quarantined`` frame, rows kept buffered) while
+   every innocent stream-mate keeps scoring without a dropped window.
+3. **recovery** — faults stop; the half-open probe must score the
+   quarantine-era backlog and emit ``recovered`` on the live stream.
+4. **drain** — ``drain_and_stop``: every open SSE subscription must end
+   with a terminal ``drain`` frame, never a dead socket.
+
+Two audits run across ALL phases, from what the consumers actually
+received: per machine, anomaly+error ``[first_seq, last_seq]`` spans
+must tile ``1..N`` with no hole (dropped window) and no overlap
+(double-score) across every hot-swap; and the plane's own row accounting
+must balance (``rows_in == scored + failed + pending + shed``).
+
+Writes ``BENCH_STREAM.json`` at the repo root (the committed bench
+convention), gated by ``gordo-tpu bench-check``. Run:
+``JAX_PLATFORMS=cpu python benchmarks/bench_stream.py`` (or
+``make bench-stream``). Reduced-duration knobs for CI:
+``BENCH_STREAM_OUT``, ``BENCH_STREAM_SECONDS``, ``BENCH_STREAM_CLIENTS``.
+"""
+
+import datetime
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import warnings
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+warnings.filterwarnings("ignore", category=UserWarning)
+
+N_MODELS = 6
+N_TAGS = 8
+N_STREAMS = int(os.environ.get("BENCH_STREAM_CLIENTS", "2"))
+SOAK_SECONDS = float(os.environ.get("BENCH_STREAM_SECONDS", "4.0"))
+POISON_SECONDS = max(1.0, SOAK_SECONDS / 2.0)
+N_SWAPS = 6  # the gate floor is 5
+WINDOW = 32
+ROWS_PER_POST = WINDOW  # one exact window per member per ingest
+
+PROJECT = "bench-stream"
+BASE_REVISION = "100"
+ALT_REVISION = "101"
+POISON = "stream-0"
+
+
+def build_collection(root: str):
+    from gordo_tpu.machine import Machine
+    from gordo_tpu.parallel import FleetBuilder
+
+    tags = [f"tag-{i}" for i in range(1, N_TAGS + 1)]
+    dataset = {
+        "type": "RandomDataset",
+        "train_start_date": "2020-01-01T00:00:00+00:00",
+        "train_end_date": "2020-01-04T00:00:00+00:00",
+        "tag_list": tags,
+    }
+    model = {
+        "gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "gordo_tpu.models.JaxAutoEncoder": {
+                    "kind": "feedforward_hourglass",
+                    "encoding_layers": 1,
+                    "epochs": 1,
+                }
+            }
+        }
+    }
+    machines = [
+        Machine.from_config(
+            {"name": f"stream-{i}", "model": model, "dataset": dict(dataset)},
+            project_name=PROJECT,
+        )
+        for i in range(N_MODELS)
+    ]
+    base_dir = os.path.join(root, BASE_REVISION)
+    FleetBuilder(machines, plan_strategy="packed").build(output_dir=base_dir)
+    return base_dir, tags
+
+
+def arrow_body(tags):
+    """One reusable ingest body: ROWS_PER_POST rows for every member,
+    packed in the fleet route's Arrow-IPC container."""
+    from gordo_tpu.server import wire
+    from gordo_tpu.server.utils import dataframe_from_dict
+
+    index = [
+        f"2020-03-01T{h:02d}:{m:02d}:00+00:00"
+        for h in range(ROWS_PER_POST // 60 + 1)
+        for m in range(60)
+    ][:ROWS_PER_POST]
+    payload = {
+        tag: {ts: 0.01 * i + 0.1 * j for j, ts in enumerate(index)}
+        for i, tag in enumerate(tags)
+    }
+    X = dataframe_from_dict(payload)
+    encoded = wire.encode_request(X)
+    body = wire.pack_streams(
+        {f"stream-{i}": encoded for i in range(N_MODELS)}
+    )
+    return body, wire.ARROW_CONTENT_TYPE
+
+
+def parse_sse(text: str):
+    """SSE wire text -> list of (event, data) frames (heartbeat comments
+    and un-id'd control frames included; data parsed as JSON)."""
+    frames = []
+    for block in text.split("\n\n"):
+        if not block.strip():
+            continue
+        if block.startswith(":"):
+            frames.append(("heartbeat", None))
+            continue
+        event, data = "", None
+        for line in block.splitlines():
+            if line.startswith("event:"):
+                event = line.split(":", 1)[1].strip()
+            elif line.startswith("data:"):
+                data = json.loads(line.split(":", 1)[1].strip())
+        frames.append((event, data))
+    return frames
+
+
+class Consumer:
+    """One unbounded SSE subscription held open for the whole run."""
+
+    def __init__(self, app, stream_id):
+        self.stream_id = stream_id
+        self.chunks = []
+        self.done = False
+
+        def run():
+            from werkzeug.test import Client
+
+            resp = Client(app).get(
+                f"/gordo/v0/{PROJECT}/stream/{stream_id}/events",
+                buffered=False,
+            )
+            for part in resp.response:
+                text = part if isinstance(part, str) else part.decode()
+                self.chunks.append(text)
+            self.done = True
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+
+    def frames(self):
+        return parse_sse("".join(self.chunks))
+
+
+class Ingestor:
+    """One keep-alive ingest loop feeding every member of one stream."""
+
+    def __init__(self, app, stream_id, body, content_type):
+        self.stream_id = stream_id
+        self.stop = threading.Event()
+        self.posts = 0
+        self.non_200 = 0
+
+        def run():
+            from werkzeug.test import Client
+
+            client = Client(app)
+            url = f"/gordo/v0/{PROJECT}/stream/{stream_id}/ingest"
+            while not self.stop.is_set():
+                resp = client.post(url, data=body, content_type=content_type)
+                self.posts += 1
+                if resp.status_code != 200:
+                    self.non_200 += 1
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+
+
+def rows_scored_total(plane):
+    total = 0
+    for session in plane.stats()["sessions"].values():
+        for record in session["machines"].values():
+            total += record["rows_scored"]
+    return total
+
+
+def audit_spans(frames):
+    """Consumed spans (anomaly + error) per machine must tile their row
+    space: each next span's first_seq abuts the previous last_seq + 1.
+    Returns (gaps, spans_checked)."""
+    per_machine = {}
+    for event, data in frames:
+        if event in ("anomaly", "error") and data:
+            per_machine.setdefault(data["machine"], []).append(
+                (data["first_seq"], data["last_seq"])
+            )
+    gaps = checked = 0
+    for spans in per_machine.values():
+        spans.sort()
+        expected = 1
+        for first, last in spans:
+            checked += 1
+            if first != expected:
+                gaps += 1
+            expected = last + 1
+    return gaps, checked
+
+
+def accounting_gaps(plane):
+    gaps = 0
+    for session in plane.stats()["sessions"].values():
+        for record in session["machines"].values():
+            balance = (
+                record["rows_scored"]
+                + record["rows_failed"]
+                + record["rows_pending"]
+                + record["rows_shed"]
+            )
+            if balance != record["rows_in"]:
+                gaps += 1
+    return gaps
+
+
+def main() -> dict:
+    from gordo_tpu import serve, stream as stream_mod
+    from gordo_tpu.lifecycle import publish_canary
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server.app import drain_and_stop
+    from gordo_tpu.server.fleet_store import STORE
+    from gordo_tpu.utils.faults import FaultRule, inject
+
+    tmp = tempfile.mkdtemp(prefix="bench-stream-")
+    base_dir, tags = build_collection(tmp)
+    alt_dir = publish_canary(tmp, BASE_REVISION, base_dir, [], ALT_REVISION)
+
+    os.environ["MODEL_COLLECTION_DIR"] = base_dir
+    os.environ["GORDO_TPU_SERVE_WARMUP"] = "0"
+    os.environ["GORDO_TPU_BREAKER_THRESHOLD"] = "1"
+    os.environ["GORDO_TPU_BREAKER_COOLDOWN_S"] = "0.6"
+    os.environ["GORDO_TPU_BREAKER_BACKOFF"] = "1.0"
+    os.environ["GORDO_TPU_STREAM_WINDOW_ROWS"] = str(WINDOW)
+    os.environ["GORDO_TPU_STREAM_HEARTBEAT_S"] = "0.5"
+
+    # the scorer goes straight at fleet_scores: the stream board is the
+    # standalone one (no batching engine in the loop)
+    serve.install_engine(None)
+    serve.reset_stream_breakers()
+    stream_mod.reset_plane()
+    app = build_app(config={"EXPECTED_MODELS": []})
+    STORE.fleet(base_dir).warm()
+    STORE.fleet(alt_dir).warm()
+
+    body, content_type = arrow_body(tags)
+    stream_ids = [f"soak-{i}" for i in range(N_STREAMS)]
+    consumers = [Consumer(app, sid) for sid in stream_ids]
+    ingestors = [Ingestor(app, sid, body, content_type) for sid in stream_ids]
+
+    # let the first flush pay its fused-program compile before the clock
+    deadline = time.monotonic() + 30.0
+    plane = None
+    while time.monotonic() < deadline:
+        plane = stream_mod.get_plane()
+        if plane is not None and rows_scored_total(plane) > 0:
+            break
+        time.sleep(0.05)
+    assert plane is not None, "stream plane never materialized"
+
+    # phase 1: soak, with N_SWAPS promotions landing mid-stream
+    scored_before = rows_scored_total(plane)
+    soak_start = time.monotonic()
+    swaps = 0
+    for i in range(N_SWAPS):
+        time.sleep(SOAK_SECONDS / N_SWAPS)
+        STORE.swap(base_dir, alt_dir if i % 2 == 0 else base_dir, warm=True)
+        swaps += 1
+    soak_wall = time.monotonic() - soak_start
+    soak_rows = rows_scored_total(plane) - scored_before
+    rows_per_sec = soak_rows / soak_wall if soak_wall else 0.0
+
+    # phase 2: poison one member's scoring; the breaker must quarantine
+    # it while its stream-mates keep scoring
+    innocent_before = {
+        key: {
+            name: record["rows_scored"]
+            for name, record in session["machines"].items()
+            if name != POISON
+        }
+        for key, session in plane.stats()["sessions"].items()
+    }
+    rule = FaultRule("stream_score", match=f"*:{POISON}", times=None)
+    with inject(rule):
+        time.sleep(POISON_SECONDS)
+        stats_poisoned = plane.stats()
+    quarantined = any(
+        (session["machines"].get(POISON) or {}).get("quarantined")
+        for session in stats_poisoned["sessions"].values()
+    )
+    innocent_stalled = 0
+    for key, session in stats_poisoned["sessions"].items():
+        for name, before in innocent_before[key].items():
+            if session["machines"][name]["rows_scored"] <= before:
+                innocent_stalled += 1
+
+    # phase 3: faults stopped — the half-open probe must recover the
+    # member and score its buffered backlog on the live stream
+    recovered = False
+    recovery_deadline = time.monotonic() + 30.0
+    while time.monotonic() < recovery_deadline:
+        if any(
+            "event: recovered" in chunk and f'"{POISON}"' in chunk
+            for consumer in consumers
+            for chunk in list(consumer.chunks)
+        ):
+            recovered = True
+            break
+        time.sleep(0.1)
+
+    # phase 4: planned shutdown — stop the feeders, then drain: every
+    # open subscription must end with a terminal frame
+    for ingestor in ingestors:
+        ingestor.stop.set()
+    for ingestor in ingestors:
+        ingestor.thread.join(timeout=30)
+    final_accounting = accounting_gaps(plane)
+    drain_and_stop(app)
+    for consumer in consumers:
+        consumer.thread.join(timeout=30)
+    clean_terminals = all(
+        consumer.done
+        and consumer.frames()
+        and consumer.frames()[-1][0] in ("drain", "end")
+        for consumer in consumers
+    )
+
+    # the cross-phase audits, from what the consumers actually received
+    # — per consumer: the two streams' identically-named members have
+    # independent seq spaces, so spans must never be pooled across them
+    seq_gaps = spans_checked = innocent_gaps = 0
+    for consumer in consumers:
+        frames = consumer.frames()
+        gaps, checked = audit_spans(frames)
+        seq_gaps += gaps
+        spans_checked += checked
+        gaps, _ = audit_spans(
+            [
+                (event, data)
+                for event, data in frames
+                if not (data and data.get("machine") == POISON)
+            ]
+        )
+        innocent_gaps += gaps
+    innocent_shed = sum(
+        record["rows_shed"]
+        for session in plane.stats()["sessions"].values()
+        for name, record in session["machines"].items()
+        if name != POISON
+    )
+    posts = sum(ingestor.posts for ingestor in ingestors)
+    non_200 = sum(ingestor.non_200 for ingestor in ingestors)
+
+    serve.reset_stream_breakers()
+    stream_mod.reset_plane()
+
+    return {
+        "bench": "stream-soak",
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "models": N_MODELS,
+        "streams": N_STREAMS,
+        "window_rows": WINDOW,
+        "rows_per_post": ROWS_PER_POST * N_MODELS,
+        "soak_seconds": SOAK_SECONDS,
+        "ingest_posts": posts,
+        "ingest_non_200": non_200,
+        "soak": {
+            "rows_per_sec": round(rows_per_sec, 1),
+            "rows_scored": soak_rows,
+            "accounting_gaps": final_accounting,
+        },
+        "swap": {
+            "swaps": swaps,
+            "seq_gaps": seq_gaps,
+            "spans_checked": spans_checked,
+        },
+        "poison": {
+            "quarantined": quarantined,
+            "innocent_drops": innocent_stalled + innocent_shed + innocent_gaps,
+            "recovered": recovered,
+        },
+        "drain": {
+            "clean_terminals": clean_terminals,
+            "subscribers": len(consumers),
+        },
+    }
+
+
+if __name__ == "__main__":
+    outcome = main()
+    out_path = os.environ.get(
+        "BENCH_STREAM_OUT", str(REPO_ROOT / "BENCH_STREAM.json")
+    )
+    with open(out_path, "w") as f:
+        json.dump(outcome, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(outcome, indent=1, sort_keys=True))
+    print(f"\nwrote {out_path}")

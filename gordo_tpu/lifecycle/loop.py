@@ -206,6 +206,15 @@ class LifecycleSupervisor:
     def close(self) -> None:
         self.recorder.close()
 
+    def attach_stream(self, plane: Any) -> None:
+        """Wire the streaming scoring plane's windows into this
+        supervisor's drift statistics. Duck-typed on purpose:
+        ``gordo_tpu.stream`` must not import lifecycle (layering), so
+        the supervisor reaches down and hands its monitor over — every
+        streamed window then feeds the same drift verdicts as
+        request/response observation."""
+        plane.attach_drift(self.monitor)
+
     # -- observation --------------------------------------------------------
 
     def observe(self, frames: Dict[str, Any]) -> Tuple[Dict, Dict]:

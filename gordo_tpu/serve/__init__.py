@@ -37,6 +37,8 @@ from .engine import (
     get_engine,
     install_engine,
     reset_engine,
+    reset_stream_breakers,
+    stream_breaker_board,
 )
 from .ladder import member_ladder, pad_to, parse_ladder, row_ladder
 from .precision import (
@@ -79,8 +81,10 @@ __all__ = [
     "payload_dtype",
     "recon_agreement",
     "reset_engine",
+    "reset_stream_breakers",
     "resolve_precision",
     "row_ladder",
     "serve_precision",
+    "stream_breaker_board",
     "verdict_agreement",
 ]

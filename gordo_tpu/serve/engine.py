@@ -38,7 +38,7 @@ import threading
 import time
 from concurrent.futures import CancelledError
 from concurrent.futures import TimeoutError as FutureTimeoutError
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -1138,3 +1138,39 @@ def _shutdown_at_exit() -> None:
             engine.shutdown(drain=True)
         except Exception:  # noqa: BLE001 - interpreter is going down anyway
             pass
+
+
+# -- streaming-plane breaker sharing ----------------------------------------
+
+_stream_breakers: Optional[BreakerBoard] = None
+
+
+def stream_breaker_board(
+    on_transition: Optional[Callable[..., None]] = None,
+) -> BreakerBoard:
+    """The per-member breaker board the STREAMING plane quarantines
+    through. With the micro-batching engine installed this is the
+    engine's OWN board, so the HTTP and stream planes share one
+    quarantine truth — a member tripped by request traffic is
+    immediately quarantined on every stream, and a stream-probed
+    recovery reopens the request path too. Without an engine (batching
+    is off by default) a process-global standalone board is created on
+    first use: streaming fault containment must not depend on the
+    batching switch. ``on_transition`` is only adopted when this call
+    creates the standalone board (the engine's board keeps the engine's
+    own observability fan-out)."""
+    engine = get_engine()
+    if engine is not None:
+        return engine.breakers
+    global _stream_breakers
+    with _engine_lock:
+        if _stream_breakers is None:
+            _stream_breakers = BreakerBoard(on_transition=on_transition)
+        return _stream_breakers
+
+
+def reset_stream_breakers() -> None:
+    """Drop the standalone stream breaker board (tests, reload)."""
+    global _stream_breakers
+    with _engine_lock:
+        _stream_breakers = None

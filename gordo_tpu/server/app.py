@@ -40,6 +40,7 @@ from ..telemetry.profiler import SamplingProfiler, should_profile
 from . import utils as server_utils
 from .utils import ServerError
 from .views import anomaly, base
+from .views import stream as stream_views
 
 logger = logging.getLogger(__name__)
 
@@ -271,6 +272,26 @@ URL_MAP = Map(
             methods=["POST"],
         ),
         Rule(
+            f"{PREFIX}/<gordo_project>/stream/<stream_id>/ingest",
+            endpoint="stream-ingest",
+            methods=["POST"],
+        ),
+        Rule(
+            f"{PREFIX}/<gordo_project>/stream/<stream_id>/events",
+            endpoint="stream-events",
+            methods=["GET"],
+        ),
+        Rule(
+            f"{PREFIX}/<gordo_project>/stream/status",
+            endpoint="stream-status",
+            methods=["GET"],
+        ),
+        Rule(
+            f"{PREFIX}/<gordo_project>/stream/<stream_id>",
+            endpoint="stream-close",
+            methods=["DELETE"],
+        ),
+        Rule(
             f"{PREFIX}/<gordo_project>/build-status",
             endpoint="build-status",
             methods=["GET"],
@@ -314,6 +335,10 @@ HANDLERS = {
     "build-status": base.get_build_status,
     "fleet-health": base.get_fleet_health,
     "slo": base.get_slo_status,
+    "stream-ingest": stream_views.post_stream_ingest,
+    "stream-events": stream_views.get_stream_events,
+    "stream-status": stream_views.get_stream_status,
+    "stream-close": stream_views.delete_stream,
 }
 
 
@@ -675,6 +700,19 @@ def drain_and_stop(app: GordoServerApp, server=None, engine=None) -> None:
     from .. import serve
 
     app.begin_drain()
+    # standing streams FIRST: every live SSE subscriber gets its
+    # terminal `drain` frame and flushes its outbox tail while the
+    # batcher below is still resolving in-flight futures — a long-lived
+    # stream socket closes cleanly instead of dying mid-frame
+    try:
+        from ..stream import get_plane
+
+        plane = get_plane()
+        if plane is not None:
+            plane.drain()
+    except Exception:  # noqa: BLE001 - stream drain is best-effort; the
+        # engine drain and server stop below must still run
+        logger.exception("stream plane drain failed")
     engine = engine if engine is not None else serve.get_engine()
     if engine is not None:
         logger.info("draining micro-batcher before shutdown")

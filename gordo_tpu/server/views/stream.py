@@ -1,0 +1,274 @@
+"""
+Streaming-plane routes under ``/gordo/v0/<project>/stream/...``.
+
+No reference analog: the reference serves request/response only. These
+routes are the thin HTTP skin over :mod:`gordo_tpu.stream` — a stream is
+a server-side session (``stream_id``), fed by repeated ingest POSTs on a
+keep-alive connection and consumed as one long-lived SSE response:
+
+- ``POST  .../stream/<stream_id>/ingest`` — an Arrow-IPC container
+  (``wire.pack_streams``, the fleet route's request body) or the JSON
+  twin ``{"X": {<machine>: frame-dict}}``; rows land in the session's
+  rings, the watermark flush scores, and the JSON ack reports
+  accepted/shed/scored/quarantined per machine plus the consumer
+  ``cursor``. Backpressure is visible, never fatal: ``backpressure:
+  true`` + ``retry_after_s`` when rows were shed oldest-first.
+- ``GET   .../stream/<stream_id>/events`` — ``text/event-stream``.
+  Resume with ``?cursor=<seq>`` or the standard ``Last-Event-ID``
+  header; ``?max_events=`` and ``?idle_timeout_s=`` bound the response
+  (tests, polling consumers). The first frames are ``open`` and any
+  active ``quarantined`` notices — a reconnect learns about an ongoing
+  quarantine immediately, not from a silent gap.
+- ``GET   .../stream/status`` — every live session's counters.
+- ``DELETE .../stream/<stream_id>`` — close with a terminal ``end``
+  frame.
+
+Ladder: 503 streaming disabled / server draining · 429 session cap
+(``Retry-After``) · 410 closed stream ingest · 400 malformed body — all
+JSON, mirroring the request/response error ladder in
+``docs/serving.md``.
+"""
+
+import logging
+import os
+import re
+from typing import Any, Dict
+
+from .. import utils as server_utils
+
+logger = logging.getLogger(__name__)
+
+_STREAM_ID = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,127}$")
+
+
+def _validate_stream_id(stream_id: str) -> None:
+    if not _STREAM_ID.match(stream_id):
+        raise server_utils.ServerError(
+            "Invalid stream id: letters, digits, '.', '_', '-' "
+            "(max 128 chars).",
+            status=400,
+        )
+
+
+def _anchor_dir(ctx) -> str:
+    """The ANCHOR collection dir (the env var's value, not the routed
+    revision): sessions outlive hot-swaps, so the session pins the
+    operator's stable handle and the scorer re-routes per flush."""
+    return os.environ[ctx.config["MODEL_COLLECTION_DIR_ENV_VAR"]]
+
+
+def _require_plane(ctx):
+    from ... import stream as stream_plane
+
+    plane = stream_plane.ensure_plane()
+    if plane is None:
+        raise server_utils.ServerError(
+            "Streaming is disabled (GORDO_TPU_STREAM_ENABLED=0)",
+            status=503,
+        )
+    plane.ledger_anchor = _anchor_dir(ctx)
+    return plane
+
+
+def _open_session(ctx, plane, gordo_project: str, stream_id: str):
+    """``(session, None)`` on admission, ``(None, 429 response)`` when
+    the plane is saturated or draining."""
+    from ...stream import PlaneSaturated
+
+    try:
+        return (
+            plane.session(gordo_project, stream_id, _anchor_dir(ctx)),
+            None,
+        )
+    except PlaneSaturated as exc:
+        response = ctx.json_response(
+            {
+                "error": str(exc),
+                "retry_after_s": exc.retry_after_s,
+            },
+            status=429,
+        )
+        response.headers["Retry-After"] = str(
+            max(1, int(round(exc.retry_after_s)))
+        )
+        return None, response
+
+
+def _decode_stream_body(ctx, frames, errors) -> None:
+    """Per-machine decode straight off the fleet route's body formats —
+    same per-machine isolation: a malformed entry errors alone in the
+    ack. Streaming is autoencoder replay; ``y`` entries are ignored."""
+    from .. import wire
+    from ..fleet_store import STORE
+
+    request = ctx.request
+    fleet = STORE.fleet(ctx.collection_dir)
+
+    def resolve(name: str):
+        server_utils.validate_gordo_name(name)
+        server_utils.check_metadata_file(ctx.collection_dir, name)
+        return fleet.resolution(name)
+
+    if wire.request_format(request) == wire.ARROW:
+        try:
+            entries, _extra = wire.unpack_streams(request.get_data())
+        except wire.ArrowDecodeError as exc:
+            raise server_utils.ServerError(str(exc), status=400)
+        if not entries:
+            raise server_utils.ServerError(
+                "Stream ingest needs at least one machine entry"
+            )
+        for name, payload in entries.items():
+            try:
+                resolution = resolve(name)
+                x_columns, _y, index = wire.decode_frames(payload)
+                frames[name] = server_utils.frame_from_columns(
+                    resolution, x_columns, index, resolution.tag_names
+                )
+            except FileNotFoundError:
+                errors[name] = {
+                    "error": f"No such model found: '{name}'",
+                    "status": 404,
+                }
+            except server_utils.ServerError as exc:
+                errors[name] = {"error": str(exc), "status": exc.status}
+            except (ValueError, TypeError, KeyError) as exc:
+                errors[name] = {
+                    "error": f"Invalid frame payload: {exc}",
+                    "status": 400,
+                }
+            except Exception:  # noqa: BLE001 - per-machine isolation
+                logger.exception("stream resolution failed for %s", name)
+                errors[name] = {
+                    "error": "Model could not be loaded",
+                    "status": 500,
+                }
+        return
+
+    body = request.get_json(silent=True) if request.is_json else None
+    if not body or not isinstance(body.get("X"), dict) or not body["X"]:
+        raise server_utils.ServerError(
+            'Stream ingest needs an Arrow container or a JSON body '
+            '{"X": {<model-name>: frame}}'
+        )
+    for name, payload in body["X"].items():
+        try:
+            resolution = resolve(name)
+            frame = server_utils.dataframe_from_dict(payload)
+            frames[name] = server_utils.verify_dataframe(
+                frame, resolution.tag_names
+            )
+        except FileNotFoundError:
+            errors[name] = {
+                "error": f"No such model found: '{name}'",
+                "status": 404,
+            }
+        except server_utils.ServerError as exc:
+            errors[name] = {"error": str(exc), "status": exc.status}
+        except (ValueError, TypeError, KeyError) as exc:
+            errors[name] = {
+                "error": f"Invalid frame payload: {exc}",
+                "status": 400,
+            }
+        except Exception:  # noqa: BLE001 - per-machine isolation
+            logger.exception("stream resolution failed for %s", name)
+            errors[name] = {
+                "error": "Model could not be loaded",
+                "status": 500,
+            }
+
+
+def post_stream_ingest(ctx, gordo_project: str, stream_id: str):
+    """Land one record batch on a stream session and run the watermark
+    flush; answers the JSON ingest ack."""
+    _validate_stream_id(stream_id)
+    plane = _require_plane(ctx)
+    session, rejected = _open_session(ctx, plane, gordo_project, stream_id)
+    if rejected is not None:
+        return rejected
+    if session.closed:
+        return ctx.json_response(
+            {"error": f"Stream '{stream_id}' is closed"}, status=410
+        )
+
+    frames: Dict[str, Any] = {}
+    errors: Dict[str, Dict[str, Any]] = {}
+    with ctx.stage("data_decode"):
+        _decode_stream_body(ctx, frames, errors)
+    with ctx.stage("inference"):
+        ack = plane.ingest(session, frames, errors)
+    status = 200 if (ack["accepted"] or not ack["errors"]) else 400
+    return ctx.json_response(ack, status=status)
+
+
+def get_stream_events(ctx, gordo_project: str, stream_id: str):
+    """The long-lived SSE feed for one stream (resume via ``?cursor=``
+    or ``Last-Event-ID``)."""
+    _validate_stream_id(stream_id)
+    plane = _require_plane(ctx)
+    session, rejected = _open_session(ctx, plane, gordo_project, stream_id)
+    if rejected is not None:
+        return rejected
+    request = ctx.request
+
+    def _int_arg(name: str, header: str = "") -> int:
+        raw = request.args.get(name) or (
+            request.headers.get(header) if header else None
+        )
+        try:
+            return max(0, int(raw)) if raw else 0
+        except (TypeError, ValueError):
+            raise server_utils.ServerError(
+                f"'{name}' must be an integer", status=400
+            )
+
+    cursor = _int_arg("cursor", "Last-Event-ID")
+    max_events = _int_arg("max_events") or None
+    idle_raw = request.args.get("idle_timeout_s")
+    try:
+        idle_timeout_s = float(idle_raw) if idle_raw else None
+    except ValueError:
+        raise server_utils.ServerError(
+            "'idle_timeout_s' must be a number", status=400
+        )
+
+    from ...stream import SSE_CONTENT_TYPE
+
+    body = plane.subscribe(
+        session,
+        cursor=cursor,
+        max_events=max_events,
+        idle_timeout_s=idle_timeout_s,
+    )
+    response = ctx.raw_response(body, SSE_CONTENT_TYPE)
+    # SSE hygiene: never cached, never buffered by nginx-style proxies
+    response.headers["Cache-Control"] = "no-cache"
+    response.headers["X-Accel-Buffering"] = "no"
+    return response
+
+
+def get_stream_status(ctx, gordo_project: str):
+    """Every live session's counters (the plane's observability face)."""
+    from ... import stream as stream_plane
+
+    plane = stream_plane.get_plane()
+    if plane is None:
+        return ctx.json_response(
+            {"enabled": stream_plane.stream_enabled(), "sessions": {}}
+        )
+    return ctx.json_response(plane.stats())
+
+
+def delete_stream(ctx, gordo_project: str, stream_id: str):
+    """Close a stream with a terminal ``end`` frame."""
+    _validate_stream_id(stream_id)
+    from ... import stream as stream_plane
+
+    plane = stream_plane.get_plane()
+    closed = bool(
+        plane and plane.close_session(gordo_project, stream_id)
+    )
+    return ctx.json_response(
+        {"stream": stream_id, "closed": closed},
+        status=200 if closed else 404,
+    )

@@ -1,0 +1,66 @@
+"""
+The always-on streaming scoring plane.
+
+Request/response serving answers one frame per HTTP exchange; the
+production reality for a sensor fleet is a continuous feed. This package
+is the standing pipeline: Arrow-IPC record batches stream in over
+long-lived connections (``server/views/stream.py``), rows land in
+per-machine bounded ring buffers, the watermark cuts windows that score
+through the SAME fused many-model gather programs the request path uses,
+and anomalies flow out as server-sent events with replayable cursors.
+
+The robustness contract is the point (see ``docs/serving.md`` —
+"Streaming plane"):
+
+- disconnects resume from a cursor (``ring.EventRing`` replay);
+- backpressure sheds oldest-first with counters, never unbounded memory;
+- a poisoned member is quarantined by PR 15's per-member circuit
+  breakers while its stream-mates keep scoring (``scorer.WindowScorer``);
+- hot-swaps never gap or double-score a window (per-flush pinned fleet);
+- ``drain_and_stop`` closes every stream with a clean terminal frame.
+
+Master switch: ``GORDO_TPU_STREAM_ENABLED`` (default on). The full knob
+catalog lives in the Streaming section of ``docs/configuration.md``.
+"""
+
+from .events import (
+    SSE_CONTENT_TYPE,
+    TERMINAL_KINDS,
+    StreamEvent,
+    encode_sse,
+    heartbeat_frame,
+)
+from .plane import (
+    PlaneSaturated,
+    StreamConfig,
+    StreamPlane,
+    ensure_plane,
+    get_plane,
+    install_plane,
+    reset_plane,
+    stream_enabled,
+)
+from .ring import EventRing, RowRing
+from .scorer import WindowScorer
+from .session import MachineChannel, StreamSession
+
+__all__ = [
+    "EventRing",
+    "MachineChannel",
+    "PlaneSaturated",
+    "RowRing",
+    "SSE_CONTENT_TYPE",
+    "StreamConfig",
+    "StreamEvent",
+    "StreamPlane",
+    "StreamSession",
+    "TERMINAL_KINDS",
+    "WindowScorer",
+    "encode_sse",
+    "ensure_plane",
+    "get_plane",
+    "heartbeat_frame",
+    "install_plane",
+    "reset_plane",
+    "stream_enabled",
+]

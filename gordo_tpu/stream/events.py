@@ -1,0 +1,99 @@
+"""
+The streaming plane's event vocabulary and SSE encoding.
+
+Everything a stream consumer ever sees is a server-sent event with an
+``id:`` (the session's outbox sequence number — reconnect cursors are
+these ids), an ``event:`` kind, and a one-line JSON ``data:`` payload.
+The kinds form the stream twin of the request/response error ladder in
+``docs/serving.md`` (PR 15):
+
+========== ============================================================
+kind       meaning
+========== ============================================================
+open       first frame of every subscription: cursor position, replayed
+           event count, and the session's live counters
+anomaly    a scored watermark window: machine, ``first_seq``/
+           ``last_seq`` row span, rows/windows, residual stats, and the
+           revision that scored it (hot-swap visibility)
+shed       backpressure: oldest-first drops happened — ``scope`` is
+           ``ring`` (ingest rows), ``outbox`` (emitted events a slow or
+           reconnecting consumer missed), or ``emit`` (events dropped at
+           the emit fault site); carries the drop count
+quarantined a member's circuit breaker is open: its windows are NOT
+           scored; ``retry_after_s`` says when the next probe may run.
+           Innocent machines on the same stream keep scoring.
+recovered  a previously quarantined member scored cleanly again
+           (half-open probe success closed its breaker)
+error      a machine's window failed to score (contained: that window
+           only, that machine only)
+drain      terminal: the server is shutting down gracefully
+           (``drain_and_stop``); the stream is complete, reconnect later
+end        terminal: the session was closed explicitly (client DELETE)
+========== ============================================================
+
+``drain``/``end`` are **terminal**: they are the last frame a
+subscription yields before the server closes the response cleanly — a
+consumer that sees EOF *without* one knows the connection died and
+should reconnect with its cursor.
+
+>>> evt = StreamEvent("anomaly", {"machine": "m-1", "rows": 4})
+>>> print(encode_sse(3, evt), end="")
+id: 3
+event: anomaly
+data: {"machine": "m-1", "rows": 4}
+<BLANKLINE>
+"""
+
+import json
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "StreamEvent",
+    "TERMINAL_KINDS",
+    "encode_sse",
+    "heartbeat_frame",
+    "SSE_CONTENT_TYPE",
+]
+
+SSE_CONTENT_TYPE = "text/event-stream"
+
+#: kinds after which a subscription ends (clean close follows)
+TERMINAL_KINDS = ("drain", "end")
+
+
+class StreamEvent:
+    """One emitted frame: a ``kind`` from the table above plus its JSON
+    payload. Sequence numbers are assigned by the session outbox at
+    append time, not here — the same event object is never reused."""
+
+    __slots__ = ("kind", "data")
+
+    def __init__(self, kind: str, data: Optional[Dict[str, Any]] = None):
+        self.kind = kind
+        self.data = data or {}
+
+    @property
+    def terminal(self) -> bool:
+        return self.kind in TERMINAL_KINDS
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"StreamEvent({self.kind!r}, {self.data!r})"
+
+
+def encode_sse(seq: Optional[int], event: StreamEvent) -> str:
+    """One wire frame: ``id``/``event``/``data`` lines + blank-line
+    terminator. ``data`` is a single line by construction (compact JSON
+    with no embedded newlines), so no multi-line ``data:`` splitting is
+    needed. ``seq=None`` omits the ``id:`` line — used for
+    subscription-local frames (the ``open`` prelude, replayed
+    quarantine notices) that must not advance the consumer's
+    ``Last-Event-ID`` cursor."""
+    payload = json.dumps(event.data, separators=(", ", ": "), default=str)
+    head = f"id: {seq}\n" if seq is not None else ""
+    return f"{head}event: {event.kind}\ndata: {payload}\n\n"
+
+
+def heartbeat_frame() -> str:
+    """An SSE comment frame: keeps idle connections alive through
+    proxies without advancing the consumer's cursor."""
+    return ": keep-alive\n\n"

@@ -1,0 +1,411 @@
+"""
+The streaming plane coordinator: sessions, ingest, subscribe, drain.
+
+One process-global :class:`StreamPlane` (``ensure_plane`` — installed by
+``build_app`` alongside the micro-batching engine, shared by every
+worker thread like ``STORE``) owns the session registry and the
+:class:`~.scorer.WindowScorer`. The HTTP layer (``server/views/stream.py``)
+stays thin: it decodes bodies and hands frames here; everything
+long-lived — rings, outboxes, breaker gates, TTL expiry, drain — is the
+plane's.
+
+Admission and lifetime are bounded like everything else on this plane:
+at most ``GORDO_TPU_STREAM_MAX_SESSIONS`` live sessions (overflow is
+refused with a retry hint — the session-level 429), and a session idle
+past ``GORDO_TPU_STREAM_SESSION_TTL_S`` is expired on the next registry
+access (no reaper thread: the plane creates NO threads at all, which
+keeps the thread-lifecycle contract trivially true).
+
+``drain()`` is the graceful-shutdown hook ``drain_and_stop`` calls
+before the engine drains: every live session gets its terminal ``drain``
+frame and every SSE subscriber wakes, finishes its outbox tail, and
+closes cleanly — a standing stream socket never just dies mid-frame on
+a planned shutdown.
+"""
+
+import logging
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from ..utils.env import env_bool, env_float, env_int
+from ..utils.faults import FaultInjected, fault_point
+from .events import StreamEvent
+from .scorer import WindowScorer
+from .session import StreamSession
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "PlaneSaturated",
+    "StreamConfig",
+    "StreamPlane",
+    "ensure_plane",
+    "get_plane",
+    "reset_plane",
+    "stream_enabled",
+]
+
+STREAM_ENV = "GORDO_TPU_STREAM_ENABLED"
+
+
+def stream_enabled() -> bool:
+    """Streaming-plane master switch (default on — the plane costs
+    nothing until a stream route is hit)."""
+    return env_bool(STREAM_ENV, True)
+
+
+class PlaneSaturated(Exception):
+    """Session admission refused (``GORDO_TPU_STREAM_MAX_SESSIONS``):
+    the stream twin of the batcher's ``QueueFullError`` → 429 +
+    Retry-After."""
+
+    def __init__(self, limit: int, retry_after_s: float):
+        super().__init__(f"stream session limit reached ({limit})")
+        self.limit = limit
+        self.retry_after_s = retry_after_s
+
+
+class StreamConfig:
+    """Plane knobs, resolved once from the environment at creation."""
+
+    __slots__ = (
+        "ring_rows",
+        "window_rows",
+        "outbox_events",
+        "session_ttl_s",
+        "heartbeat_s",
+        "max_sessions",
+        "shed_retry_s",
+    )
+
+    def __init__(
+        self,
+        ring_rows: int = 8192,
+        window_rows: int = 64,
+        outbox_events: int = 1024,
+        session_ttl_s: float = 3600.0,
+        heartbeat_s: float = 15.0,
+        max_sessions: int = 64,
+        shed_retry_s: float = 1.0,
+    ):
+        self.ring_rows = max(1, int(ring_rows))
+        self.window_rows = max(1, int(window_rows))
+        self.outbox_events = max(1, int(outbox_events))
+        self.session_ttl_s = max(1.0, float(session_ttl_s))
+        self.heartbeat_s = max(0.05, float(heartbeat_s))
+        self.max_sessions = max(1, int(max_sessions))
+        self.shed_retry_s = max(0.0, float(shed_retry_s))
+
+    @classmethod
+    def from_env(cls) -> "StreamConfig":
+        return cls(
+            ring_rows=env_int("GORDO_TPU_STREAM_RING_ROWS", 8192),
+            window_rows=env_int("GORDO_TPU_STREAM_WINDOW_ROWS", 64),
+            outbox_events=env_int("GORDO_TPU_STREAM_OUTBOX_EVENTS", 1024),
+            session_ttl_s=env_float(
+                "GORDO_TPU_STREAM_SESSION_TTL_S", 3600.0
+            ),
+            heartbeat_s=env_float("GORDO_TPU_STREAM_HEARTBEAT_S", 15.0),
+            max_sessions=env_int("GORDO_TPU_STREAM_MAX_SESSIONS", 64),
+            shed_retry_s=env_float("GORDO_TPU_STREAM_SHED_RETRY_S", 1.0),
+        )
+
+
+class StreamPlane:
+    """Session registry + scorer + drain for one server process."""
+
+    def __init__(self, config: Optional[StreamConfig] = None):
+        self.config = config or StreamConfig.from_env()
+        self._lock = threading.Lock()
+        self._sessions: Dict[Tuple[str, str], StreamSession] = {}
+        self.scorer = WindowScorer(self.config.window_rows)
+        self._drained = False
+        self.counters: Dict[str, int] = {
+            "sessions_opened": 0,
+            "sessions_expired": 0,
+            "sessions_rejected": 0,
+            "ingest_batches": 0,
+            "ingest_errors": 0,
+        }
+
+    # -- wiring --------------------------------------------------------------
+
+    @property
+    def ledger_anchor(self) -> Optional[str]:
+        return self.scorer.ledger_anchor
+
+    @ledger_anchor.setter
+    def ledger_anchor(self, anchor: Optional[str]) -> None:
+        self.scorer.ledger_anchor = anchor
+
+    def attach_drift(self, monitor: Any) -> None:
+        """Wire a lifecycle ``DriftMonitor`` (duck-typed —
+        ``observe_scores(frames, scores)``) into the scoring flush, so
+        drift statistics accumulate from streaming traffic. Called by
+        ``LifecycleSupervisor.attach_stream``; this package never
+        imports ``gordo_tpu.lifecycle``."""
+        self.scorer.drift_monitor = monitor
+
+    # -- session registry ----------------------------------------------------
+
+    def _prune_locked(self, now: float) -> None:
+        # closed sessions linger as tombstones until the TTL: a late
+        # ingest gets an honest 410 (not a silently re-opened stream
+        # whose row seqs restart at 1) and a late reconnect still finds
+        # the terminal frame in the outbox. They stop counting against
+        # the admission cap the moment they close.
+        ttl = self.config.session_ttl_s
+        for key, session in list(self._sessions.items()):
+            if now - session.last_used <= ttl:
+                continue
+            if not session.closed:
+                session.close("end", reason="session expired (idle)")
+                self.counters["sessions_expired"] += 1
+            if session.subscribers == 0:
+                del self._sessions[key]
+
+    def session(
+        self,
+        project: str,
+        stream_id: str,
+        collection_dir: str,
+        create: bool = True,
+    ) -> Optional[StreamSession]:
+        """Look up (or admit) one stream session. Raises
+        :class:`PlaneSaturated` when admission would exceed the session
+        cap; returns None for a miss with ``create=False``."""
+        key = (project, stream_id)
+        now = time.monotonic()
+        with self._lock:
+            self._prune_locked(now)
+            session = self._sessions.get(key)
+            if session is not None or not create:
+                return session
+            if self._drained:
+                raise PlaneSaturated(0, self.config.shed_retry_s)
+            live = sum(
+                1 for s in self._sessions.values() if not s.closed
+            )
+            if live >= self.config.max_sessions:
+                self.counters["sessions_rejected"] += 1
+                raise PlaneSaturated(
+                    self.config.max_sessions, self.config.shed_retry_s
+                )
+            session = StreamSession(
+                project,
+                stream_id,
+                collection_dir,
+                ring_rows=self.config.ring_rows,
+                outbox_events=self.config.outbox_events,
+            )
+            self._sessions[key] = session
+            self.counters["sessions_opened"] += 1
+            return session
+
+    def close_session(
+        self, project: str, stream_id: str, reason: str = "closed by client"
+    ) -> bool:
+        with self._lock:
+            session = self._sessions.get((project, stream_id))
+        if session is None:
+            return False
+        session.close("end", reason=reason)
+        return True
+
+    # -- ingest --------------------------------------------------------------
+
+    def ingest(
+        self,
+        session: StreamSession,
+        frames: Dict[str, Any],
+        errors: Optional[Dict[str, Dict[str, Any]]] = None,
+    ) -> Dict[str, Any]:
+        """Land decoded per-machine frames, run the watermark flush, and
+        return the ingest ack: accepted/shed row counts, per-machine
+        errors (decode errors passed in by the view + ``stream_ingest``
+        fault-site hits), the flush summary, and the consumer cursor."""
+        errors = dict(errors or {})
+        accepted: Dict[str, int] = {}
+        shed: Dict[str, int] = {}
+        for name, frame in frames.items():
+            try:
+                fault_point(
+                    "stream_ingest", f"{session.stream_id}:{name}"
+                )
+            except FaultInjected as exc:
+                # one poisoned entry errors alone; the rest of the
+                # machines' rows still land (fleet-route isolation)
+                errors[name] = {"error": str(exc), "status": 500}
+                continue
+            first_seq, shed_rows = session.append_rows(name, frame)
+            accepted[name] = int(len(frame))
+            if shed_rows:
+                shed[name] = shed_rows
+        flush = self.scorer.flush(session)
+        with self._lock:
+            self.counters["ingest_batches"] += 1
+            self.counters["ingest_errors"] += len(errors)
+        backpressure = bool(shed)
+        ack: Dict[str, Any] = {
+            "stream": session.stream_id,
+            "accepted": accepted,
+            "shed": shed,
+            "errors": errors,
+            "cursor": session.latest_seq(),
+            "scored": flush["scored"],
+            "score_errors": flush["errors"],
+            "quarantined": flush["quarantined"],
+            "backpressure": backpressure,
+        }
+        if backpressure:
+            ack["retry_after_s"] = self.config.shed_retry_s
+        return ack
+
+    # -- subscribe -----------------------------------------------------------
+
+    def _quarantine_prelude(
+        self, session: StreamSession
+    ) -> List[StreamEvent]:
+        """The immediate quarantine notices a (re)connecting consumer
+        gets ahead of the replay: one ``quarantined`` frame per member
+        whose breaker is currently open/half-open — a reconnect must
+        learn about an ongoing quarantine NOW, not from a silent gap.
+        Read from the board's snapshot (no probe admission is consumed
+        by subscribing)."""
+        from .. import serve
+
+        machines = session.machine_names()
+        if not machines:
+            return []
+        try:
+            board = serve.stream_breaker_board(
+                self.scorer._on_breaker_transition
+            )
+            unhealthy = board.summary(top_k=len(machines))["members"]
+        except Exception:  # noqa: BLE001 - the prelude is advisory
+            logger.debug("quarantine prelude failed", exc_info=True)
+            return []
+        notices = []
+        for member in unhealthy:
+            name = member.get("member")
+            if name in machines and member.get("state") != "closed":
+                notices.append(
+                    StreamEvent(
+                        "quarantined",
+                        {
+                            "machine": name,
+                            "retry_after_s": member.get("cooldown_s"),
+                            "trips": member.get("trips"),
+                        },
+                    )
+                )
+        return notices
+
+    def subscribe(
+        self,
+        session: StreamSession,
+        cursor: int = 0,
+        max_events: Optional[int] = None,
+        idle_timeout_s: Optional[float] = None,
+    ) -> Iterator[str]:
+        """SSE frame iterator for one consumer: ``open`` + quarantine
+        prelude + replay-from-cursor + live tail (see
+        :meth:`.session.StreamSession.subscribe`)."""
+        return session.subscribe(
+            cursor=cursor,
+            heartbeat_s=self.config.heartbeat_s,
+            max_events=max_events,
+            idle_timeout_s=idle_timeout_s,
+            prelude=self._quarantine_prelude(session),
+        )
+
+    # -- shutdown ------------------------------------------------------------
+
+    def drain(self) -> int:
+        """Terminal ``drain`` frame into every live session and refuse
+        new ones; returns how many sessions were closed. Idempotent —
+        called from ``drain_and_stop`` BEFORE the engine drain so
+        subscribers flush their tails while the batcher is still
+        resolving in-flight futures."""
+        with self._lock:
+            self._drained = True
+            sessions = list(self._sessions.values())
+        closed = 0
+        for session in sessions:
+            if not session.closed:
+                session.close("drain", reason="server draining")
+                closed += 1
+        if closed:
+            logger.info("stream plane drained %d live session(s)", closed)
+        return closed
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            sessions = dict(self._sessions)
+            counters = dict(self.counters)
+            drained = self._drained
+        return {
+            "enabled": stream_enabled(),
+            "draining": drained,
+            "sessions": {
+                f"{project}/{stream_id}": session.stats()
+                for (project, stream_id), session in sorted(sessions.items())
+            },
+            "counters": counters,
+            "config": {
+                "ring_rows": self.config.ring_rows,
+                "window_rows": self.config.window_rows,
+                "outbox_events": self.config.outbox_events,
+                "max_sessions": self.config.max_sessions,
+            },
+        }
+
+
+# -- process-global plane ----------------------------------------------------
+
+_plane: Optional[StreamPlane] = None
+_plane_lock = threading.Lock()
+
+
+def get_plane() -> Optional[StreamPlane]:
+    """The installed plane, or None (no stream route hit yet)."""
+    return _plane
+
+
+def ensure_plane() -> Optional[StreamPlane]:
+    """Create-and-install the process plane when streaming is enabled
+    (idempotent); None when ``GORDO_TPU_STREAM_ENABLED`` is off."""
+    global _plane
+    if not stream_enabled():
+        return None
+    with _plane_lock:
+        if _plane is None:
+            _plane = StreamPlane()
+            logger.info(
+                "stream plane on: ring_rows=%d window_rows=%d "
+                "outbox_events=%d max_sessions=%d",
+                _plane.config.ring_rows,
+                _plane.config.window_rows,
+                _plane.config.outbox_events,
+                _plane.config.max_sessions,
+            )
+        return _plane
+
+
+def install_plane(plane: Optional[StreamPlane]) -> None:
+    """Install a specific plane (tests; pass None to uninstall)."""
+    global _plane
+    with _plane_lock:
+        _plane = plane
+
+
+def reset_plane() -> None:
+    """Drain and uninstall the process plane (tests, reload)."""
+    global _plane
+    with _plane_lock:
+        plane, _plane = _plane, None
+    if plane is not None:
+        plane.drain()

@@ -1,0 +1,188 @@
+"""
+Bounded, sequence-numbered ring buffers for the streaming plane.
+
+Two rings back every stream session (``session.py``):
+
+- :class:`RowRing` — the per-machine *ingest* side: decoded sensor rows
+  land here with monotonically increasing row sequence numbers and wait
+  for the watermark to cut a scoring window. Overflow sheds
+  **oldest-first** (the freshest telemetry is the valuable telemetry for
+  anomaly detection) and counts every shed row — memory is bounded by
+  construction, never by the client's politeness.
+- :class:`EventRing` — the per-session *emit* side: every SSE event is
+  appended under the next event sequence number and retained until the
+  ring evicts it. A reconnecting consumer replays ``since(cursor)``; if
+  the ring already evicted past its cursor the reader learns exactly how
+  many events it missed (the ``shed`` control frame) instead of getting
+  a silent gap.
+
+Neither ring owns a lock: the owning :class:`~.session.StreamSession`
+serializes access under its own lock (one lock per session keeps the
+lock-ordering graph trivial).
+
+>>> ring = EventRing(capacity=2)
+>>> ring.append("a"), ring.append("b"), ring.append("c")
+(1, 2, 3)
+>>> events, missed = ring.since(0)   # "a" was evicted: 1 missed
+>>> [seq for seq, _ in events], missed
+([2, 3], 1)
+>>> ring.since(3)
+([], 0)
+"""
+
+from collections import deque
+from typing import Any, Deque, List, Optional, Tuple
+
+__all__ = ["RowRing", "EventRing"]
+
+
+class RowRing:
+    """Bounded buffer of row chunks with per-row sequence numbers.
+
+    Rows are appended as chunks (anything with ``len`` and positional
+    slicing via ``.iloc`` or ``[...]`` — pandas frames in production,
+    plain lists in tests) and taken oldest-first in exact arrival order.
+    Row sequence numbers are 1-based and monotonic for the life of the
+    ring; they never reset, so a scored window's ``(first_seq,
+    last_seq)`` span is a durable, gap-checkable coordinate.
+    """
+
+    __slots__ = ("capacity", "_chunks", "_pending", "_next_seq", "shed_rows")
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        #: deque of (first_seq, chunk) in arrival order
+        self._chunks: Deque[Tuple[int, Any]] = deque()
+        self._pending = 0
+        self._next_seq = 1
+        self.shed_rows = 0
+
+    @property
+    def pending_rows(self) -> int:
+        return self._pending
+
+    @property
+    def next_seq(self) -> int:
+        """Sequence number the next appended row will receive."""
+        return self._next_seq
+
+    @staticmethod
+    def _slice(chunk: Any, start: int, stop: Optional[int] = None) -> Any:
+        iloc = getattr(chunk, "iloc", None)
+        if iloc is not None:
+            return iloc[start:stop]
+        return chunk[start:stop]
+
+    def append(self, chunk: Any) -> Tuple[int, int]:
+        """Land ``chunk`` rows; returns ``(first_seq, rows_shed)``.
+
+        Shedding is oldest-first: when the ring would exceed capacity the
+        oldest buffered rows are dropped (counted in :attr:`shed_rows`)
+        until the new chunk fits. A chunk taller than the whole ring
+        keeps only its newest ``capacity`` rows — the bound is absolute.
+        """
+        rows = int(len(chunk))
+        first_seq = self._next_seq
+        if rows == 0:
+            return first_seq, 0
+        shed = 0
+        if rows >= self.capacity:
+            # the chunk alone overflows the ring: every buffered row and
+            # the chunk's own oldest overflow go
+            shed += self._pending
+            self._chunks.clear()
+            self._pending = 0
+            overflow = rows - self.capacity
+            if overflow:
+                shed += overflow
+                chunk = self._slice(chunk, overflow)
+            self._next_seq += rows
+            self._chunks.append((self._next_seq - self.capacity, chunk))
+            self._pending = self.capacity
+            self.shed_rows += shed
+            return first_seq, shed
+        self._next_seq += rows
+        self._chunks.append((first_seq, chunk))
+        self._pending += rows
+        while self._pending > self.capacity:
+            over = self._pending - self.capacity
+            oldest_seq, oldest = self._chunks[0]
+            if len(oldest) <= over:
+                self._chunks.popleft()
+                self._pending -= len(oldest)
+                shed += len(oldest)
+            else:
+                self._chunks[0] = (
+                    oldest_seq + over,
+                    self._slice(oldest, over),
+                )
+                self._pending -= over
+                shed += over
+        self.shed_rows += shed
+        return first_seq, shed
+
+    def take(self, rows: int) -> Optional[Tuple[List[Any], int, int]]:
+        """Pop the oldest ``rows`` buffered rows, or None if fewer are
+        pending. Returns ``(chunks, first_seq, last_seq)`` — the chunk
+        list concatenates (in order) to exactly ``rows`` rows."""
+        rows = int(rows)
+        if rows <= 0 or self._pending < rows:
+            return None
+        first_seq = self._chunks[0][0]
+        out: List[Any] = []
+        needed = rows
+        while needed > 0:
+            chunk_seq, chunk = self._chunks.popleft()
+            if len(chunk) <= needed:
+                out.append(chunk)
+                needed -= len(chunk)
+                self._pending -= len(chunk)
+            else:
+                out.append(self._slice(chunk, 0, needed))
+                self._chunks.appendleft(
+                    (chunk_seq + needed, self._slice(chunk, needed))
+                )
+                self._pending -= needed
+                needed = 0
+        return out, first_seq, first_seq + rows - 1
+
+
+class EventRing:
+    """Bounded event log with 1-based monotonic sequence numbers and
+    cursor replay — the SSE outbox's memory."""
+
+    __slots__ = ("capacity", "_events", "_latest", "dropped")
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        #: deque of (seq, event) — seq is contiguous within the deque
+        self._events: Deque[Tuple[int, Any]] = deque()
+        self._latest = 0
+        self.dropped = 0
+
+    @property
+    def latest_seq(self) -> int:
+        return self._latest
+
+    @property
+    def oldest_seq(self) -> int:
+        """Sequence of the oldest retained event (0 when empty)."""
+        return self._events[0][0] if self._events else 0
+
+    def append(self, event: Any) -> int:
+        self._latest += 1
+        self._events.append((self._latest, event))
+        while len(self._events) > self.capacity:
+            self._events.popleft()
+            self.dropped += 1
+        return self._latest
+
+    def since(self, cursor: int) -> Tuple[List[Tuple[int, Any]], int]:
+        """Events with ``seq > cursor`` still retained, plus how many
+        matching events were already evicted (the reader's gap)."""
+        cursor = max(0, int(cursor))
+        if cursor >= self._latest:
+            return [], 0
+        oldest = self.oldest_seq
+        missed = max(0, oldest - cursor - 1) if self._events else self._latest - cursor
+        return [entry for entry in self._events if entry[0] > cursor], missed

@@ -1,0 +1,331 @@
+"""
+Watermark-triggered window scoring for the streaming plane.
+
+Every ingest that pushes a machine past the watermark
+(``GORDO_TPU_STREAM_WINDOW_ROWS`` buffered rows) flushes through here:
+the pending full windows are cut from the rings and scored as ONE fused
+many-model call (``RevisionFleet.fleet_scores`` — the same per-spec
+gather programs the fleet route and the micro-batching engine run), and
+each machine's result becomes an ``anomaly`` event carrying its exact
+``(first_seq, last_seq)`` row span and the revision that scored it.
+
+Robustness properties, in the order they bite:
+
+- **zero-gap hot-swap** — the serving revision is resolved ONCE per
+  flush (``STORE.route`` + ``STORE.fleet``) and every window in the
+  flush scores against that pinned :class:`RevisionFleet` object. A
+  ``LifecycleSupervisor`` promotion lands between flushes, never inside
+  one: row spans stay contiguous across the swap (the soak bench audits
+  exactly this) and no window is dropped or double-scored.
+- **poison containment** — the per-member circuit breakers are PR 15's
+  (:func:`gordo_tpu.serve.stream_breaker_board`: the engine's own board
+  when batching is on, a standalone one otherwise). A quarantined
+  member's windows are not cut at all — its rows keep buffering (and
+  shedding oldest-first under pressure) while the stream emits one
+  ``quarantined`` frame with ``retry_after_s``; the *other* machines in
+  the same flush keep scoring. When the cooldown lapses the next flush
+  admits one window as the half-open probe; success closes the breaker
+  and emits ``recovered``.
+- **per-window error isolation** — a scoring failure (including the
+  ``stream_score`` fault site) costs exactly that machine's cut span:
+  an ``error`` frame, a breaker failure mark for server-side causes,
+  and honest ``rows_failed`` accounting. Client-data failures
+  (ValueError/TypeError) never count against the member's breaker.
+
+Observability: one ``stream_score`` span per flush on the shared serving
+recorder, a batch-wise fleet-health ledger feed (rows + rolling residual
+mean + request marks — the stream twin of the fleet route's feed), and
+an optional drift monitor fed ``observe_scores`` so lifecycle drift
+detection runs off streaming traffic, not just sampled HTTP requests.
+"""
+
+import logging
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.faults import fault_point
+from .events import StreamEvent
+from .session import StreamSession
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["WindowScorer"]
+
+#: breaker spec key for members whose real spec bucket could not be
+#: resolved (model failed to load, exotic provider): quarantine still
+#: works, just without cross-plane key sharing for that member
+FALLBACK_SPEC = "stream"
+
+
+class WindowScorer:
+    """Cut-and-score the watermark windows of one session flush."""
+
+    def __init__(
+        self,
+        window_rows: int,
+        ledger_anchor: Optional[str] = None,
+        drift_monitor: Optional[Any] = None,
+    ):
+        self.window_rows = max(1, int(window_rows))
+        #: the ANCHOR collection dir the ledger/breaker feeds key on
+        #: (falls back to the session's own anchor per flush)
+        self.ledger_anchor = ledger_anchor
+        #: duck-typed ``DriftMonitor`` (``observe_scores(frames,
+        #: scores)``) — injected by the lifecycle supervisor via
+        #: ``StreamPlane.attach_drift`` so this package never imports
+        #: ``gordo_tpu.lifecycle``
+        self.drift_monitor = drift_monitor
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _board(self):
+        from .. import serve
+
+        return serve.stream_breaker_board(self._on_breaker_transition)
+
+    def _on_breaker_transition(
+        self, member: str, old: str, new: str, info: dict
+    ) -> None:
+        """Standalone-board transitions mirror the engine's ledger feed:
+        tripped stream members must reach ``fleet-status`` and the
+        lifecycle supervisor's rebuild nomination the same way tripped
+        request-plane members do."""
+        try:
+            from ..telemetry import ledger_for
+
+            anchor = self.ledger_anchor or os.environ.get(
+                "MODEL_COLLECTION_DIR"
+            )
+            if anchor:
+                ledger_for(anchor).record_breaker(
+                    member,
+                    new,
+                    trips=info.get("trips"),
+                    cooldown_s=info.get("cooldown_s"),
+                    reason=info.get("last_error") or None,
+                )
+        except Exception:  # noqa: BLE001 - the ledger is advisory
+            logger.debug("stream breaker ledger feed failed", exc_info=True)
+
+    @staticmethod
+    def _spec_for(fleet: Any, name: str) -> Any:
+        try:
+            fleet.model(name)  # ensure loaded + bucketed
+            spec = fleet.loaded_specs().get(name)
+        except Exception:  # noqa: BLE001 - an unloadable member still
+            # deserves a working breaker key
+            spec = None
+        return spec if spec is not None else FALLBACK_SPEC
+
+    @staticmethod
+    def _concat(chunks: List[Any]) -> Any:
+        if len(chunks) == 1:
+            return chunks[0]
+        import pandas as pd
+
+        return pd.concat(chunks)
+
+    # -- the flush -----------------------------------------------------------
+
+    def flush(self, session: StreamSession) -> Dict[str, Any]:
+        """Score every full pending window in ``session``; returns the
+        flush summary the ingest ack carries: scored/failed/quarantined
+        machine maps plus total rows scored."""
+        from ..server.fleet_store import STORE
+        from ..telemetry import serving as serve_trace
+
+        summary: Dict[str, Any] = {
+            "scored": {},
+            "errors": {},
+            "quarantined": {},
+            "rows": 0,
+        }
+        # pin ONCE per flush: every window below scores against this
+        # revision object, however many promotions land meanwhile
+        routed = STORE.route(session.collection_dir)
+        fleet = STORE.fleet(routed)
+        revision = os.path.basename(os.path.normpath(routed))
+        board = self._board()
+
+        # breaker gate BEFORE cutting: a quarantined member's rows stay
+        # in its ring (bounded by oldest-first shed), they are not cut
+        # into a window that could never score
+        quarantined: Dict[str, float] = {}
+        specs: Dict[str, Any] = {}
+        for name in session.pending_machines(self.window_rows):
+            spec = self._spec_for(fleet, name)
+            specs[name] = spec
+            retry_after = board.quarantined(fleet, spec, name)
+            if retry_after is not None:
+                quarantined[name] = retry_after
+                chan = session.channel(name)
+                if not chan.quarantine_notified:
+                    chan.quarantine_notified = True
+                    session.emit(
+                        StreamEvent(
+                            "quarantined",
+                            {
+                                "machine": name,
+                                "retry_after_s": round(retry_after, 3),
+                            },
+                        )
+                    )
+        summary["quarantined"] = {
+            name: round(retry, 3) for name, retry in quarantined.items()
+        }
+
+        cut = session.cut_windows(self.window_rows, skip=tuple(quarantined))
+        if not cut:
+            return summary
+
+        inputs: Dict[str, Any] = {}
+        spans: Dict[str, Tuple[int, int, int]] = {}
+        injected: Dict[str, BaseException] = {}
+        for name, (chunks, first_seq, last_seq, windows) in cut.items():
+            spans[name] = (first_seq, last_seq, windows)
+            try:
+                fault_point(
+                    "stream_score", f"{session.stream_id}:{name}"
+                )
+                inputs[name] = self._concat(chunks)
+            except Exception as exc:  # noqa: BLE001 - injected poison or
+                # a broken concat is THIS member's failure, nobody else's
+                injected[name] = exc
+
+        recorder = serve_trace.serve_recorder()
+        total_rows = sum(int(len(x)) for x in inputs.values())
+        with recorder.span(
+            "stream_score",
+            stream=session.stream_id,
+            machines=len(inputs),
+            rows=total_rows,
+            revision=revision,
+        ):
+            scores, errors = (
+                fleet.fleet_scores(inputs) if inputs else ({}, {})
+            )
+        errors.update(injected)
+
+        for name, (reconstruction, mse) in scores.items():
+            first_seq, last_seq, windows = spans[name]
+            rows = int(len(inputs[name]))
+            residuals = np.asarray(mse, dtype=float).ravel()
+            finite = residuals[np.isfinite(residuals)]
+            chan = session.channel(name)
+            chan.rows_scored += rows
+            chan.windows_scored += windows
+            board.record_success(fleet, specs.get(name, FALLBACK_SPEC), name)
+            if chan.quarantine_notified:
+                chan.quarantine_notified = False
+                session.emit(StreamEvent("recovered", {"machine": name}))
+            session.emit(
+                StreamEvent(
+                    "anomaly",
+                    {
+                        "machine": name,
+                        "first_seq": first_seq,
+                        "last_seq": last_seq,
+                        "rows": rows,
+                        "windows": windows,
+                        "mse_mean": (
+                            float(finite.mean()) if len(finite) else None
+                        ),
+                        "mse_max": (
+                            float(finite.max()) if len(finite) else None
+                        ),
+                        "revision": revision,
+                    },
+                )
+            )
+            summary["scored"][name] = rows
+            summary["rows"] += rows
+
+        for name, exc in errors.items():
+            first_seq, last_seq, _windows = spans[name]
+            rows = last_seq - first_seq + 1
+            chan = session.channel(name)
+            chan.score_errors += 1
+            chan.rows_failed += rows
+            # client-data failures are not the member's health problem —
+            # same classification as the fleet route's ledger feed
+            server_side = not isinstance(
+                exc, (ValueError, TypeError, FileNotFoundError)
+            )
+            if server_side:
+                board.record_failure(
+                    fleet, specs.get(name, FALLBACK_SPEC), name, exc
+                )
+            session.emit(
+                StreamEvent(
+                    "error",
+                    {
+                        "machine": name,
+                        "first_seq": first_seq,
+                        "last_seq": last_seq,
+                        "error": type(exc).__name__,
+                    },
+                )
+            )
+            summary["errors"][name] = type(exc).__name__
+
+        self._feed_ledger(session, inputs, scores, errors)
+        self._feed_drift(inputs, scores)
+        return summary
+
+    # -- feeds ---------------------------------------------------------------
+
+    def _feed_ledger(
+        self,
+        session: StreamSession,
+        frames: Dict[str, Any],
+        scores: Dict[str, Tuple[Any, Any]],
+        errors: Dict[str, BaseException],
+    ) -> None:
+        """Batch-wise fleet-health feed: one throttled snapshot write per
+        flush, so a stream-only deployment still populates per-machine
+        health exactly like HTTP scoring traffic would."""
+        try:
+            from ..telemetry import ledger_for
+
+            anchor = self.ledger_anchor or session.collection_dir
+            if not anchor:
+                return
+            ledger = ledger_for(anchor)
+            if not ledger.enabled:
+                return
+            for name, (reconstruction, mse) in scores.items():
+                residuals = np.asarray(mse, dtype=float).ravel()
+                residuals = residuals[np.isfinite(residuals)]
+                frame = frames.get(name)
+                ledger.record_scores(
+                    name,
+                    len(frame) if frame is not None else len(residuals),
+                    float(residuals.mean()) if len(residuals) else None,
+                    write=False,
+                )
+                ledger.record_request(name)
+            for name, exc in errors.items():
+                ledger.record_request(
+                    name,
+                    error=not isinstance(
+                        exc, (ValueError, TypeError, FileNotFoundError)
+                    ),
+                )
+            ledger.write()
+        except Exception:  # noqa: BLE001 - health telemetry is advisory
+            logger.debug("stream health not recorded", exc_info=True)
+
+    def _feed_drift(
+        self,
+        frames: Dict[str, Any],
+        scores: Dict[str, Tuple[Any, Any]],
+    ) -> None:
+        monitor = self.drift_monitor
+        if monitor is None or not frames:
+            return
+        try:
+            monitor.observe_scores(frames, scores)
+        except Exception:  # noqa: BLE001 - drift statistics are advisory
+            logger.debug("stream drift feed failed", exc_info=True)

@@ -1,0 +1,364 @@
+"""
+Stream sessions: the durable server-side half of one logical stream.
+
+A session outlives any single HTTP exchange — that is the whole point.
+Ingest POSTs land rows in the session's per-machine :class:`~.ring.RowRing`
+buffers; scored windows and control frames append to its
+:class:`~.ring.EventRing` outbox; any number of SSE subscriptions
+(including a reconnect after a dropped socket) read the outbox from a
+cursor. All mutable state is guarded by ONE lock per session
+(``_wake`` — a Condition wrapping it — doubles as the subscriber
+wakeup), so the plane's lock graph stays a star: plane registry lock →
+session lock, never the reverse.
+
+Robustness contract carried here:
+
+- **resume**: ``subscribe(cursor=N)`` replays retained events with
+  ``seq > N``; if the outbox already evicted past the cursor the first
+  frames say exactly how many events were missed (``shed`` with scope
+  ``outbox``) — a reconnect is never a silent gap.
+- **backpressure**: both rings are bounded; ingest overflow sheds
+  oldest-first with a ``shed`` (scope ``ring``) control frame, outbox
+  overflow surfaces as the reader's ``shed`` (scope ``outbox``) frame.
+- **drain/close**: :meth:`close` appends a terminal ``drain``/``end``
+  frame and wakes every subscriber; a subscription always ends with a
+  terminal frame on a graceful shutdown (EOF without one means the
+  connection itself died → reconnect with the cursor).
+"""
+
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ..utils.faults import FaultInjected, fault_point
+from .events import StreamEvent, encode_sse, heartbeat_frame
+from .ring import EventRing, RowRing
+
+__all__ = ["MachineChannel", "StreamSession"]
+
+
+class MachineChannel:
+    """One machine's ingest state inside a session: its row ring plus
+    the per-machine counters the status route and the soak bench audit
+    (``ingested == scored + pending + shed`` is the zero-gap
+    invariant)."""
+
+    __slots__ = (
+        "name",
+        "ring",
+        "rows_in",
+        "rows_scored",
+        "rows_failed",
+        "windows_scored",
+        "score_errors",
+        "quarantine_notified",
+    )
+
+    def __init__(self, name: str, ring_rows: int):
+        self.name = name
+        self.ring = RowRing(ring_rows)
+        self.rows_in = 0
+        self.rows_scored = 0
+        self.rows_failed = 0
+        self.windows_scored = 0
+        self.score_errors = 0
+        #: True between the ``quarantined`` frame and the member's
+        #: ``recovered`` frame — dedupes per-window quarantine noise and
+        #: tells a fresh subscription to replay the notice immediately
+        self.quarantine_notified = False
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "rows_in": self.rows_in,
+            "rows_scored": self.rows_scored,
+            "rows_failed": self.rows_failed,
+            "rows_pending": self.ring.pending_rows,
+            "rows_shed": self.ring.shed_rows,
+            "windows_scored": self.windows_scored,
+            "score_errors": self.score_errors,
+            "quarantined": self.quarantine_notified,
+        }
+
+
+class StreamSession:
+    """One stream id's rings, outbox, and subscriber bookkeeping."""
+
+    def __init__(
+        self,
+        project: str,
+        stream_id: str,
+        collection_dir: str,
+        ring_rows: int,
+        outbox_events: int,
+    ):
+        self.project = project
+        self.stream_id = stream_id
+        #: the ANCHOR collection dir (the env var's value at session
+        #: creation) — routing to the served revision happens per scoring
+        #: flush, so a hot-swap mid-stream picks up the new revision at
+        #: the next window, never mid-window
+        self.collection_dir = collection_dir
+        self.ring_rows = ring_rows
+        self._wake = threading.Condition()
+        self.channels: Dict[str, MachineChannel] = {}
+        self.outbox = EventRing(outbox_events)
+        self.closed = False
+        self.last_used = time.monotonic()
+        self._subscribers = 0
+        self.emit_dropped = 0
+        #: emit-site drops not yet surfaced as a ``shed`` frame
+        self._emit_shed_pending = 0
+
+    # -- ingest side ---------------------------------------------------------
+
+    def touch(self) -> None:
+        with self._wake:
+            self.last_used = time.monotonic()
+
+    def idle_for(self, now: float) -> float:
+        with self._wake:
+            return now - self.last_used
+
+    def channel(self, name: str) -> MachineChannel:
+        with self._wake:
+            chan = self.channels.get(name)
+            if chan is None:
+                chan = self.channels[name] = MachineChannel(
+                    name, self.ring_rows
+                )
+            return chan
+
+    def append_rows(self, name: str, frame: Any) -> Tuple[int, int]:
+        """Land decoded rows for ``name``; returns ``(first_seq, shed)``
+        and emits the backpressure control frame when rows were shed."""
+        with self._wake:
+            chan = self.channels.get(name)
+            if chan is None:
+                chan = self.channels[name] = MachineChannel(
+                    name, self.ring_rows
+                )
+            first_seq, shed = chan.ring.append(frame)
+            chan.rows_in += int(len(frame))
+            self.last_used = time.monotonic()
+        if shed:
+            self.emit(
+                StreamEvent(
+                    "shed",
+                    {
+                        "scope": "ring",
+                        "machine": name,
+                        "dropped": shed,
+                        "rows_shed_total": chan.ring.shed_rows,
+                    },
+                )
+            )
+        return first_seq, shed
+
+    def latest_seq(self) -> int:
+        """The consumer cursor that would catch everything emitted so
+        far (the ingest ack's ``cursor`` field)."""
+        with self._wake:
+            return self.outbox.latest_seq
+
+    def machine_names(self) -> List[str]:
+        with self._wake:
+            return sorted(self.channels)
+
+    def pending_machines(self, window_rows: int) -> List[str]:
+        """Machines with at least one full watermark window buffered —
+        the flush's breaker-gate worklist (sorted for determinism)."""
+        with self._wake:
+            return sorted(
+                name
+                for name, chan in self.channels.items()
+                if chan.ring.pending_rows >= window_rows
+            )
+
+    def cut_windows(
+        self, window_rows: int, skip: Sequence[str] = ()
+    ) -> Dict[str, Tuple[List[Any], int, int, int]]:
+        """Pop every full watermark window: ``{machine: (chunks,
+        first_seq, last_seq, windows)}``. Multiple pending windows for a
+        machine come out as ONE contiguous span (scored in one fused
+        call, counted as ``windows``). Machines in ``skip`` (quarantined
+        members) keep their rows buffered — their ring keeps absorbing
+        (and, under pressure, shedding oldest-first) until the breaker's
+        half-open probe lets scoring resume."""
+        out: Dict[str, Tuple[List[Any], int, int, int]] = {}
+        with self._wake:
+            for name, chan in self.channels.items():
+                if name in skip:
+                    continue
+                windows = chan.ring.pending_rows // window_rows
+                if windows <= 0:
+                    continue
+                taken = chan.ring.take(windows * window_rows)
+                if taken is None:  # pragma: no cover - guarded by the //
+                    continue
+                chunks, first_seq, last_seq = taken
+                out[name] = (chunks, first_seq, last_seq, windows)
+        return out
+
+    # -- emit side -----------------------------------------------------------
+
+    def emit(
+        self, event: StreamEvent, fault_key: Optional[str] = None
+    ) -> Optional[int]:
+        """Append one event to the outbox and wake subscribers; the
+        ``stream_emit`` fault site can drop it (counted, surfaced as a
+        deferred ``shed`` scope-``emit`` frame) — an emit failure never
+        propagates into ingest or scoring."""
+        try:
+            fault_point(
+                "stream_emit",
+                fault_key
+                if fault_key is not None
+                else f"{self.stream_id}:{event.kind}",
+            )
+        except FaultInjected:
+            with self._wake:
+                self.emit_dropped += 1
+                self._emit_shed_pending += 1
+            return None
+        return self._append(event)
+
+    def _append(self, event: StreamEvent) -> int:
+        """The unfaulted append: terminal frames and shed notices use it
+        directly so a drill targeting ``stream_emit`` can never suppress
+        its own loss report or a clean close."""
+        with self._wake:
+            if self._emit_shed_pending and event.kind != "shed":
+                pending = self._emit_shed_pending
+                self._emit_shed_pending = 0
+                self.outbox.append(
+                    StreamEvent(
+                        "shed",
+                        {"scope": "emit", "dropped": pending},
+                    )
+                )
+            seq = self.outbox.append(event)
+            self.last_used = time.monotonic()
+            self._wake.notify_all()
+            return seq
+
+    def close(self, kind: str = "end", reason: str = "") -> None:
+        """Terminal frame + closed flag + subscriber wakeup. Idempotent:
+        the first close wins, later calls are no-ops (a drain racing a
+        client DELETE must not emit two terminals)."""
+        with self._wake:
+            if self.closed:
+                return
+            self.closed = True
+        self._append(StreamEvent(kind, {"reason": reason} if reason else {}))
+        with self._wake:
+            self._wake.notify_all()
+
+    # -- subscribe side ------------------------------------------------------
+
+    @property
+    def subscribers(self) -> int:
+        with self._wake:
+            return self._subscribers
+
+    def subscribe(
+        self,
+        cursor: int = 0,
+        heartbeat_s: float = 15.0,
+        max_events: Optional[int] = None,
+        idle_timeout_s: Optional[float] = None,
+        prelude: Sequence[StreamEvent] = (),
+    ) -> Iterator[str]:
+        """Yield SSE frames from ``cursor`` until a terminal frame (or
+        the optional ``max_events``/``idle_timeout_s`` bounds, which
+        exist so tests and the bench can run against a finite response).
+
+        The first frame is always ``open`` (un-id'd), then the caller's
+        ``prelude`` frames (e.g. the immediate quarantine notices for a
+        reconnecting consumer), then the replay/live tail. Waits happen
+        on the session condition with a ``heartbeat_s`` bound, so an
+        idle stream stays alive through proxies and a ``close`` wakes
+        every subscriber immediately.
+        """
+        with self._wake:
+            self._subscribers += 1
+            self.last_used = time.monotonic()
+            latest = self.outbox.latest_seq
+            closed = self.closed
+        emitted = 0
+        try:
+            yield encode_sse(
+                None,
+                StreamEvent(
+                    "open",
+                    {
+                        "stream": self.stream_id,
+                        "cursor": cursor,
+                        "latest_seq": latest,
+                        "closed": closed,
+                    },
+                ),
+            )
+            for event in prelude:
+                yield encode_sse(None, event)
+            idle_since = time.monotonic()
+            while True:
+                with self._wake:
+                    batch, missed = self.outbox.since(cursor)
+                    if not batch and not self.closed:
+                        self._wake.wait(timeout=heartbeat_s)
+                        batch, missed = self.outbox.since(cursor)
+                    session_closed = self.closed
+                if missed:
+                    # the consumer was slower than the outbox ring (or
+                    # reconnected with an evicted cursor): say so, then
+                    # continue from the oldest retained event
+                    yield encode_sse(
+                        None,
+                        StreamEvent(
+                            "shed",
+                            {"scope": "outbox", "dropped": missed},
+                        ),
+                    )
+                if not batch:
+                    if session_closed:
+                        # closed and fully drained (terminal already
+                        # consumed by this subscriber via an earlier
+                        # batch, or it was evicted): end cleanly
+                        return
+                    if (
+                        idle_timeout_s is not None
+                        and time.monotonic() - idle_since >= idle_timeout_s
+                    ):
+                        return
+                    yield heartbeat_frame()
+                    continue
+                for seq, event in batch:
+                    cursor = seq
+                    yield encode_sse(seq, event)
+                    emitted += 1
+                    if event.terminal:
+                        return
+                    if max_events is not None and emitted >= max_events:
+                        return
+                idle_since = time.monotonic()
+        finally:
+            with self._wake:
+                self._subscribers -= 1
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._wake:
+            machines = {
+                name: chan.stats() for name, chan in self.channels.items()
+            }
+            return {
+                "stream": self.stream_id,
+                "project": self.project,
+                "closed": self.closed,
+                "subscribers": self._subscribers,
+                "latest_seq": self.outbox.latest_seq,
+                "events_dropped_outbox": self.outbox.dropped,
+                "events_dropped_emit": self.emit_dropped,
+                "machines": machines,
+            }

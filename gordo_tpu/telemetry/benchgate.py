@@ -280,6 +280,64 @@ GATES: Dict[str, List[MetricSpec]] = {
             bound=5.0,
         ),
     ],
+    "stream-soak": [
+        # the always-on plane must beat the request/response ceiling:
+        # one ingest connection amortizes decode + dispatch across many
+        # windows, where the JSON route pays it per exchange
+        MetricSpec(
+            "sustained streaming scoring throughput (rows/s)",
+            "soak.rows_per_sec",
+            "higher",
+            0.5,
+        ),
+        # the zero-gap invariant, audited per machine across the whole
+        # soak: rows_in == rows_scored + rows_failed + pending + shed
+        MetricSpec(
+            "per-machine row-accounting gaps across the soak",
+            "soak.accounting_gaps",
+            "max_bound",
+            bound=0.0,
+        ),
+        # hot-swap mid-stream: anomaly frames' [first_seq, last_seq]
+        # spans must stay contiguous per machine across every promotion
+        # — a hole is a dropped window, an overlap a double-score
+        MetricSpec(
+            "hot-swaps completed mid-stream",
+            "swap.swaps",
+            "min_bound",
+            bound=5.0,
+        ),
+        MetricSpec(
+            "windows dropped or double-scored across hot-swaps",
+            "swap.seq_gaps",
+            "max_bound",
+            bound=0.0,
+        ),
+        # poison containment: breakers quarantine the poisoned member;
+        # its stream-mates keep scoring without a single dropped window
+        MetricSpec(
+            "poisoned member quarantined by its breaker",
+            "poison.quarantined",
+            "truthy",
+        ),
+        MetricSpec(
+            "innocent machines' dropped windows under member poison",
+            "poison.innocent_drops",
+            "max_bound",
+            bound=0.0,
+        ),
+        MetricSpec(
+            "quarantined member recovered via half-open probe",
+            "poison.recovered",
+            "truthy",
+        ),
+        # drain: every open SSE subscription ended with a terminal frame
+        MetricSpec(
+            "drain closed every stream with a terminal frame",
+            "drain.clean_terminals",
+            "truthy",
+        ),
+    ],
     "slo-engine": [
         MetricSpec(
             "rollup aggregation throughput (spans/s)",
@@ -314,6 +372,7 @@ BASELINE_FILES: Dict[str, str] = {
     "fleet-scale": "BENCH_SCALE.json",
     "precision-ladder": "BENCH_PRECISION.json",
     "serve-chaos": "BENCH_CHAOS.json",
+    "stream-soak": "BENCH_STREAM.json",
 }
 
 

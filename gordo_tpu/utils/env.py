@@ -525,6 +525,58 @@ KNOBS: Dict[str, Knob] = _knobs(
         "Cap on the exponential breaker cooldown.",
         "Serving",
     ),
+    # -- Streaming ---------------------------------------------------------
+    Knob(
+        "GORDO_TPU_STREAM_ENABLED", "bool", True,
+        "Master switch for the always-on streaming scoring plane "
+        "(`/stream/...` routes). Disabled, stream routes answer 503.",
+        "Streaming",
+    ),
+    Knob(
+        "GORDO_TPU_STREAM_RING_ROWS", "int", 8192,
+        "Per-machine row-ring capacity on a stream session. Ingest "
+        "beyond it sheds oldest-first (counted, surfaced as a `shed` "
+        "control frame) — bounded memory, never a stall.",
+        "Streaming",
+    ),
+    Knob(
+        "GORDO_TPU_STREAM_WINDOW_ROWS", "int", 64,
+        "Watermark window height: a machine scores once it has this "
+        "many buffered rows, through the same fused gather programs as "
+        "the request path.",
+        "Streaming",
+    ),
+    Knob(
+        "GORDO_TPU_STREAM_OUTBOX_EVENTS", "int", 1024,
+        "Per-session outbox ring capacity (scored anomalies + control "
+        "frames). A consumer slower than the ring gets a `shed` "
+        "scope-`outbox` frame with the evicted count on catch-up.",
+        "Streaming",
+    ),
+    Knob(
+        "GORDO_TPU_STREAM_SESSION_TTL_S", "float", 3600.0,
+        "Idle seconds before a stream session (no ingest, no "
+        "subscriber activity) is expired with a terminal `end` frame.",
+        "Streaming",
+    ),
+    Knob(
+        "GORDO_TPU_STREAM_HEARTBEAT_S", "float", 15.0,
+        "SSE keep-alive comment interval on an idle event feed (keeps "
+        "proxies from reaping the long-lived response).",
+        "Streaming",
+    ),
+    Knob(
+        "GORDO_TPU_STREAM_MAX_SESSIONS", "int", 64,
+        "Live stream sessions the plane admits before answering 429 + "
+        "Retry-After (admission control for the standing plane).",
+        "Streaming",
+    ),
+    Knob(
+        "GORDO_TPU_STREAM_SHED_RETRY_S", "float", 1.0,
+        "Retry-After hint (seconds) in backpressure ingest acks and "
+        "429 saturation responses.",
+        "Streaming",
+    ),
     # -- Lifecycle ---------------------------------------------------------
     Knob(
         "GORDO_TPU_DRIFT_SIGMA", "float", 2.0,

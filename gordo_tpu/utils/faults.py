@@ -56,6 +56,20 @@ Sites instrumented today:
 - ``serve_scatter`` — inside the engine's scatter loop, once per
   resolved member (same key form); a scatter failure for one rider must
   never leak into the other riders' futures.
+- ``stream_ingest`` — before a machine's decoded rows land in its
+  streaming ring buffer (key: ``<stream-id>:<member>``); one poisoned
+  machine entry must error alone in the ingest ack while the other
+  machines' rows keep landing (stream containment mirrors the fleet
+  route's per-machine isolation).
+- ``stream_score`` — before a machine's watermark window is handed to
+  the fused scorer (key: ``<stream-id>:<member>``); repeated firings
+  drive the member's serving circuit breaker open mid-stream, so the
+  drill can watch the ``quarantined`` control event, the innocent
+  members' uninterrupted scoring, and half-open recovery on the live
+  stream.
+- ``stream_emit`` — before an event is appended to a session's outbox
+  ring (key: ``<stream-id>:<event-kind>``); an emit failure is counted
+  and dropped without ever stalling ingest or scoring.
 
 Rules fire deterministically: each rule counts the calls matching its
 (site, key-glob) and fires on calls ``after < i <= after + times``.
@@ -109,6 +123,9 @@ SITES = (
     "serve_device_program",
     "serve_member_poison",
     "serve_scatter",
+    "stream_ingest",
+    "stream_score",
+    "stream_emit",
 )
 
 

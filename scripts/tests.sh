@@ -79,6 +79,9 @@ case "$component" in
     # tests/server, tests/telemetry and tests/lifecycle —
     # marker-selected the same way.
     chaos)    run -m "chaos and not slow" tests/ ;;
+    # The streaming scoring-plane suite cuts across tests/stream and
+    # tests/server — marker-selected the same way.
+    stream)   run -m "stream and not slow" tests/ ;;
     # The fleet-scale observability suite (sharded ledger, rollup
     # manifest, bounded fleet-status, breaker summaries) lives in
     # tests/telemetry + tests/server — marker-selected the same way.

@@ -1,0 +1,428 @@
+"""
+The streaming plane over HTTP (PR 17): ingest acks, SSE replay/resume
+with cursors and ``Last-Event-ID``, per-machine decode isolation on both
+body formats, quarantine notices on reconnect (+ half-open recovery on
+the live stream), hot-swap span contiguity, the 429/410/400/503 ladder,
+stream-only health-ledger population, and the ``drain_and_stop`` audit
+with concurrent long-lived subscribers.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu import serve
+from gordo_tpu.server import build_app
+from gordo_tpu.server.app import drain_and_stop
+from gordo_tpu.server.fleet_store import STORE
+from gordo_tpu.server.utils import dataframe_from_dict
+from gordo_tpu.stream import (
+    StreamConfig,
+    StreamPlane,
+    install_plane,
+    reset_plane,
+)
+from gordo_tpu.telemetry.fleet_health import (
+    FLEET_HEALTH_FILE,
+    ledger_for,
+    reset_ledgers,
+)
+from gordo_tpu.utils.faults import FaultRule, inject
+
+from .conftest import OLD_REVISION, PROJECT, temp_env_vars
+
+pytestmark = [pytest.mark.stream, pytest.mark.serve]
+
+WINDOW = 5  # the sensor_payload fixture is 5 rows tall: one exact window
+
+
+def url(rest: str) -> str:
+    return f"/gordo/v0/{PROJECT}/stream/{rest}"
+
+
+def parse_sse(raw: bytes):
+    """SSE wire bytes -> list of (id, event, data) frames (heartbeat
+    comments come back as ("", "heartbeat", None))."""
+    out = []
+    for block in raw.decode().split("\n\n"):
+        if not block.strip():
+            continue
+        if block.startswith(":"):
+            out.append(("", "heartbeat", None))
+            continue
+        fields = dict(line.split(": ", 1) for line in block.split("\n"))
+        out.append(
+            (
+                fields.get("id", ""),
+                fields["event"],
+                json.loads(fields["data"]),
+            )
+        )
+    return out
+
+
+@pytest.fixture
+def stream_client(collection_dir):
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=collection_dir,
+        GORDO_TPU_BREAKER_THRESHOLD="1",
+        GORDO_TPU_BREAKER_COOLDOWN_S="0.2",
+        GORDO_TPU_BREAKER_BACKOFF="1.0",
+    ):
+        reset_ledgers()
+        engine = serve.get_engine()
+        serve.install_engine(None)
+        serve.reset_stream_breakers()
+        plane = StreamPlane(
+            StreamConfig(
+                ring_rows=64,
+                window_rows=WINDOW,
+                outbox_events=64,
+                session_ttl_s=60.0,
+                heartbeat_s=0.2,
+                max_sessions=4,
+                shed_retry_s=0.5,
+            )
+        )
+        install_plane(plane)
+        app = build_app(
+            config={"EXPECTED_MODELS": ["machine-1", "machine-2"]}
+        )
+        yield Client(app), app, plane
+        reset_plane()
+        serve.reset_stream_breakers()
+        serve.install_engine(engine)
+        reset_ledgers()
+        path = os.path.join(collection_dir, FLEET_HEALTH_FILE)
+        if os.path.exists(path):
+            os.remove(path)
+
+
+@pytest.fixture
+def json_body(sensor_payload):
+    return {"X": {"machine-1": sensor_payload["X"]}}
+
+
+# -- ingest + events ---------------------------------------------------------
+
+
+def test_json_ingest_scores_a_window_and_emits_anomaly(
+    stream_client, json_body
+):
+    client, _app, _plane = stream_client
+    resp = client.post(url("s1/ingest"), json=json_body)
+    assert resp.status_code == 200, resp.data
+    ack = resp.json
+    assert ack["accepted"] == {"machine-1": WINDOW}
+    assert ack["scored"] == {"machine-1": WINDOW}
+    assert ack["errors"] == {}
+    assert ack["backpressure"] is False
+    assert ack["cursor"] >= 1
+
+    resp = client.get(url("s1/events?max_events=5&idle_timeout_s=0.3"))
+    assert resp.status_code == 200
+    assert resp.headers["Content-Type"].startswith("text/event-stream")
+    assert resp.headers["Cache-Control"] == "no-cache"
+    frames = parse_sse(resp.data)
+    assert frames[0][1] == "open"
+    anomalies = [d for _, kind, d in frames if kind == "anomaly"]
+    assert len(anomalies) == 1
+    anomaly = anomalies[0]
+    assert anomaly["machine"] == "machine-1"
+    assert (anomaly["first_seq"], anomaly["last_seq"]) == (1, WINDOW)
+    assert anomaly["mse_mean"] is not None
+    assert anomaly["revision"]
+
+
+def test_arrow_ingest_rides_the_fleet_wire_container(
+    stream_client, sensor_payload
+):
+    client, _app, _plane = stream_client
+    from gordo_tpu.server import wire
+
+    X = dataframe_from_dict(sensor_payload["X"])
+    body = wire.pack_streams({"machine-1": wire.encode_request(X)})
+    resp = client.post(
+        url("s-arrow/ingest"),
+        data=body,
+        content_type=wire.ARROW_CONTENT_TYPE,
+    )
+    assert resp.status_code == 200, resp.data
+    ack = resp.json
+    assert ack["accepted"] == {"machine-1": WINDOW}
+    assert ack["scored"] == {"machine-1": WINDOW}
+
+
+def test_ingest_isolates_unknown_machine_per_entry(
+    stream_client, json_body, sensor_payload
+):
+    client, _app, _plane = stream_client
+    body = {
+        "X": {
+            **json_body["X"],
+            "no-such-machine": sensor_payload["X"],
+        }
+    }
+    resp = client.post(url("s1/ingest"), json=body)
+    assert resp.status_code == 200  # the good machine still landed
+    ack = resp.json
+    assert ack["accepted"] == {"machine-1": WINDOW}
+    assert ack["errors"]["no-such-machine"]["status"] == 404
+
+
+def test_reconnect_with_cursor_resumes_without_gap(
+    stream_client, json_body
+):
+    client, _app, _plane = stream_client
+    client.post(url("s1/ingest"), json=json_body)
+    client.post(url("s1/ingest"), json=json_body)
+
+    first = parse_sse(
+        client.get(url("s1/events?max_events=1&idle_timeout_s=0.3")).data
+    )
+    anomaly_ids = [int(i) for i, kind, _ in first if kind == "anomaly"]
+    assert len(anomaly_ids) == 1
+
+    # reconnect presenting the standard Last-Event-ID header: the
+    # second window's anomaly arrives, the first is NOT replayed
+    resp = client.get(
+        url("s1/events?max_events=5&idle_timeout_s=0.3"),
+        headers={"Last-Event-ID": str(anomaly_ids[0])},
+    )
+    tail = parse_sse(resp.data)
+    anomalies = [d for _, kind, d in tail if kind == "anomaly"]
+    assert len(anomalies) == 1
+    assert anomalies[0]["first_seq"] == WINDOW + 1
+    assert anomalies[0]["last_seq"] == 2 * WINDOW
+
+
+def test_backpressure_ack_and_shed_frame_on_ring_overflow(
+    stream_client, json_body
+):
+    client, _app, plane = stream_client
+    # shrink the ring under the watermark so nothing ever scores and
+    # the second ingest must shed oldest-first
+    plane.config.ring_rows = 6
+    plane.scorer.window_rows = 100
+    client.post(url("bp/ingest"), json=json_body)
+    resp = client.post(url("bp/ingest"), json=json_body)
+    assert resp.status_code == 200
+    ack = resp.json
+    assert ack["backpressure"] is True
+    assert ack["shed"] == {"machine-1": 4}  # 10 rows into a 6-row ring
+    assert ack["retry_after_s"] == 0.5
+    frames = parse_sse(
+        client.get(url("bp/events?max_events=3&idle_timeout_s=0.3")).data
+    )
+    sheds = [d for _, kind, d in frames if kind == "shed"]
+    assert sheds and sheds[0]["scope"] == "ring"
+    assert sheds[0]["dropped"] == 4
+
+
+# -- quarantine / reconnect / recovery ---------------------------------------
+
+
+def test_reconnect_learns_quarantine_immediately_then_recovers(
+    stream_client, json_body
+):
+    """Satellites 3a+3b: a consumer reconnecting to a stream whose
+    member is quarantined gets the ``quarantined`` notice (with its
+    Retry-After hint) in the prelude, before any replay; once the
+    cooldown lapses, scoring resumes on the LIVE stream and emits
+    ``recovered``."""
+    client, _app, _plane = stream_client
+    with inject(
+        FaultRule("stream_score", match="sq:machine-1", times=None)
+    ):
+        # ingest 1 cuts a window that fails server-side -> trips (threshold 1)
+        client.post(url("sq/ingest"), json=json_body)
+        # ingest 2: gated before cutting -> quarantined in the ack
+        ack = client.post(url("sq/ingest"), json=json_body).json
+        assert "machine-1" in ack["quarantined"]
+
+        # a FRESH subscription (the reconnect): quarantine notice is in
+        # the prelude — un-id'd, ahead of the replayed event tail
+        frames = parse_sse(
+            client.get(url("sq/events?max_events=1&idle_timeout_s=0.3")).data
+        )
+        kinds = [kind for _, kind, _ in frames]
+        assert kinds[0] == "open"
+        assert kinds[1] == "quarantined"
+        notice_id, _, notice = frames[1]
+        assert notice_id == ""  # prelude frames never advance the cursor
+        assert notice["machine"] == "machine-1"
+        assert notice["retry_after_s"] is not None
+
+    # fault gone; past the 0.2s cooldown the next flush is the probe
+    time.sleep(0.3)
+    ack = client.post(url("sq/ingest"), json=json_body).json
+    assert ack["quarantined"] == {}
+    # the whole quarantine-era backlog scores: rows 6..15 in one span
+    assert ack["scored"] == {"machine-1": 2 * WINDOW}
+    frames = parse_sse(
+        client.get(url("sq/events?max_events=10&idle_timeout_s=0.3")).data
+    )
+    kinds = [kind for _, kind, _ in frames]
+    assert "recovered" in kinds
+    # and a fresh reconnect carries NO stale quarantine prelude
+    frames = parse_sse(
+        client.get(url("sq/events?max_events=1&idle_timeout_s=0.3")).data
+    )
+    assert frames[1][1] != "quarantined"
+
+
+# -- hot-swap ----------------------------------------------------------------
+
+
+def test_hot_swap_mid_stream_keeps_spans_contiguous(
+    stream_client, json_body, model_collection_root, collection_dir
+):
+    client, _app, _plane = stream_client
+    old_dir = str(model_collection_root / OLD_REVISION)
+    try:
+        client.post(url("swap/ingest"), json=json_body)
+        STORE.swap(collection_dir, old_dir, warm=False)
+        client.post(url("swap/ingest"), json=json_body)
+        frames = parse_sse(
+            client.get(url("swap/events?max_events=9&idle_timeout_s=0.3")).data
+        )
+        anomalies = [d for _, kind, d in frames if kind == "anomaly"]
+        assert len(anomalies) == 2
+        # the promotion landed between windows: revision changed, spans abut
+        assert [a["revision"] for a in anomalies] == [
+            os.path.basename(collection_dir),
+            OLD_REVISION,
+        ]
+        assert anomalies[0]["last_seq"] + 1 == anomalies[1]["first_seq"]
+    finally:
+        STORE.swap(collection_dir, collection_dir, warm=False)
+
+
+# -- the error ladder --------------------------------------------------------
+
+
+def test_stream_error_ladder(stream_client, json_body):
+    client, _app, plane = stream_client
+    # 400: malformed stream id
+    assert (
+        client.post(url("no spaces/ingest"), json=json_body).status_code
+        == 400
+    )
+    # 400: bodyless ingest
+    assert client.post(url("s1/ingest"), json={}).status_code == 400
+    # 404: closing a stream that never existed
+    assert client.delete(url("nope")).status_code == 404
+    # 410: ingest into a closed stream
+    client.post(url("s1/ingest"), json=json_body)
+    assert client.delete(url("s1")).status_code == 200
+    assert client.post(url("s1/ingest"), json=json_body).status_code == 410
+    # 429 + Retry-After: the session cap (max_sessions=4; the closed
+    # s1 is a tombstone and no longer counts against admission)
+    for i in range(5):
+        resp = client.post(url(f"cap-{i}/ingest"), json=json_body)
+        if resp.status_code == 429:
+            break
+    assert resp.status_code == 429
+    assert int(resp.headers["Retry-After"]) >= 1
+    assert "retry_after_s" in resp.json
+
+
+def test_stream_disabled_answers_503(collection_dir, json_body):
+    with temp_env_vars(
+        MODEL_COLLECTION_DIR=collection_dir,
+        GORDO_TPU_STREAM_ENABLED="0",
+    ):
+        install_plane(None)
+        app = build_app(config={"EXPECTED_MODELS": []})
+        client = Client(app)
+        resp = client.post(url("s1/ingest"), json=json_body)
+        assert resp.status_code == 503
+        status = client.get(url("status"))
+        assert status.status_code == 200
+        assert status.json["enabled"] is False
+        assert status.json["sessions"] == {}
+
+
+def test_stream_status_surfaces_session_counters(stream_client, json_body):
+    client, _app, _plane = stream_client
+    client.post(url("s1/ingest"), json=json_body)
+    doc = client.get(url("status")).json
+    assert doc["enabled"] is True
+    session = doc["sessions"][f"{PROJECT}/s1"]
+    machine = session["machines"]["machine-1"]
+    assert machine["rows_in"] == WINDOW
+    assert machine["rows_scored"] == WINDOW
+    assert doc["counters"]["ingest_batches"] == 1
+
+
+# -- stream-only health ledger (satellite 2) ---------------------------------
+
+
+def test_stream_only_deployment_populates_fleet_health(
+    stream_client, json_body, collection_dir
+):
+    client, _app, _plane = stream_client
+    client.post(url("s1/ingest"), json=json_body)
+    record = (
+        (ledger_for(collection_dir).document() or {}).get("machines") or {}
+    ).get("machine-1") or {}
+    assert record, "stream scoring must narrate machine health"
+    assert record["serving"]["rows"] >= WINDOW
+    assert record["serving"]["requests"] >= 1
+    # and the fleet-health route serves it — no HTTP scoring ever ran
+    doc = client.get(f"/gordo/v0/{PROJECT}/fleet-health").json
+    assert doc["health"]["machines"]["machine-1"]["serving"]["rows"] >= WINDOW
+
+
+# -- drain_and_stop audit (satellite 1) --------------------------------------
+
+
+def test_drain_and_stop_terminates_concurrent_subscribers(
+    stream_client, json_body
+):
+    """Long-lived SSE connections across drain: every concurrent
+    subscriber's response ends with the terminal ``drain`` frame (no
+    dead sockets, no missing terminals), and the plane refuses new
+    sessions afterwards."""
+    client, app, plane = stream_client
+    client.post(url("s1/ingest"), json=json_body)
+    results = [None, None]
+
+    def subscribe(i):
+        # no max_events / idle_timeout: this response only ends when a
+        # terminal frame arrives — the long-lived production shape
+        resp = Client(app).get(url("s1/events"), buffered=False)
+        results[i] = parse_sse(b"".join(
+            part if isinstance(part, bytes) else part.encode()
+            for part in resp.response
+        ))
+
+    threads = [
+        threading.Thread(target=subscribe, args=(i,), daemon=True)
+        for i in range(2)
+    ]
+    for thread in threads:
+        thread.start()
+    deadline = time.monotonic() + 5.0
+    while plane.session(PROJECT, "s1", "", create=False).subscribers < 2:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+
+    drain_and_stop(app, server=None, engine=None)
+
+    for thread in threads:
+        thread.join(timeout=10)
+    assert not any(thread.is_alive() for thread in threads)
+    for frames in results:
+        kinds = [kind for _, kind, _ in frames]
+        assert kinds[-1] == "drain", kinds
+        assert frames[-1][2]["reason"] == "server draining"
+    # drained plane refuses admission; draining is visible in status
+    resp = client.post(url("s2/ingest"), json=json_body)
+    assert resp.status_code == 429
+    # and a second drain is a no-op (SIGTERM races are real)
+    assert plane.drain() == 0

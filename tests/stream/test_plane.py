@@ -1,0 +1,168 @@
+"""
+StreamPlane coordination: session admission/cap/TTL, the ingest ack
+(backpressure fields, per-machine ``stream_ingest`` fault isolation),
+drain semantics, and the process-global install/reset lifecycle.
+"""
+
+import pandas as pd
+import pytest
+
+from gordo_tpu import serve
+from gordo_tpu.server.fleet_store import STORE
+from gordo_tpu.stream import (
+    PlaneSaturated,
+    StreamConfig,
+    StreamPlane,
+    ensure_plane,
+    get_plane,
+    install_plane,
+    reset_plane,
+)
+from gordo_tpu.utils.faults import FaultRule, inject
+
+from .test_scorer import FakeFleet
+from .test_session import parse_frames
+
+pytestmark = pytest.mark.stream
+
+
+@pytest.fixture(autouse=True)
+def fake_store(monkeypatch, tmp_path):
+    fleets = {}
+    monkeypatch.setattr(STORE, "route", lambda directory: directory)
+    monkeypatch.setattr(
+        STORE,
+        "fleet",
+        lambda directory: fleets.setdefault(directory, FakeFleet(directory)),
+    )
+    engine = serve.get_engine()
+    serve.install_engine(None)
+    serve.reset_stream_breakers()
+    yield fleets
+    serve.reset_stream_breakers()
+    serve.install_engine(engine)
+
+
+def make_plane(**overrides):
+    defaults = dict(
+        ring_rows=16,
+        window_rows=4,
+        outbox_events=32,
+        session_ttl_s=60.0,
+        heartbeat_s=0.05,
+        max_sessions=2,
+        shed_retry_s=0.5,
+    )
+    defaults.update(overrides)
+    return StreamPlane(StreamConfig(**defaults))
+
+
+def frame(rows):
+    return pd.DataFrame({"tag-1": [float(i) for i in range(rows)]})
+
+
+def test_session_cap_rejects_with_retry_hint():
+    plane = make_plane(max_sessions=2)
+    plane.session("p", "s1", "/dir")
+    plane.session("p", "s2", "/dir")
+    plane.session("p", "s1", "/dir")  # existing: not a new admission
+    with pytest.raises(PlaneSaturated) as info:
+        plane.session("p", "s3", "/dir")
+    assert info.value.retry_after_s == 0.5
+    assert plane.stats()["counters"]["sessions_rejected"] == 1
+
+
+def test_idle_session_expires_with_terminal_end_frame():
+    plane = make_plane(session_ttl_s=1.0)
+    session = plane.session("p", "s1", "/dir")
+    session.last_used -= 5.0  # age it past the TTL by hand
+    assert plane.session("p", "s2", "/dir") is not None  # triggers prune
+    assert session.closed
+    frames = parse_frames(list(session.subscribe(heartbeat_s=0.01)))
+    assert frames[-1][1] == "end"
+    assert "expired" in frames[-1][2]["reason"]
+    assert plane.stats()["counters"]["sessions_expired"] == 1
+    assert plane.session("p", "s1", "/dir", create=False) is None
+
+
+def test_ingest_ack_reports_scored_rows_and_cursor():
+    plane = make_plane()
+    session = plane.session("p", "s1", "/dir")
+    ack = plane.ingest(session, {"m-1": frame(4), "m-2": frame(2)})
+    assert ack["accepted"] == {"m-1": 4, "m-2": 2}
+    assert ack["scored"] == {"m-1": 4}  # m-2 below the watermark
+    assert ack["errors"] == {}
+    assert ack["backpressure"] is False
+    assert "retry_after_s" not in ack
+    assert ack["cursor"] == session.latest_seq() >= 1
+
+
+def test_ingest_backpressure_ack_when_ring_sheds():
+    plane = make_plane(ring_rows=4, window_rows=100)  # never scores
+    session = plane.session("p", "s1", "/dir")
+    plane.ingest(session, {"m-1": frame(3)})
+    ack = plane.ingest(session, {"m-1": frame(3)})
+    assert ack["backpressure"] is True
+    assert ack["shed"] == {"m-1": 2}
+    assert ack["retry_after_s"] == 0.5
+    assert ack["accepted"] == {"m-1": 3}  # accepted then shed oldest-first
+
+
+def test_stream_ingest_fault_isolates_one_machine():
+    plane = make_plane()
+    session = plane.session("p", "s1", "/dir")
+    with inject(FaultRule("stream_ingest", match="s1:bad", times=None)):
+        ack = plane.ingest(
+            session, {"bad": frame(4), "good": frame(4)}
+        )
+    assert ack["errors"]["bad"]["status"] == 500
+    assert "bad" not in ack["accepted"]
+    assert ack["accepted"] == {"good": 4}  # the innocent's rows landed
+    assert ack["scored"] == {"good": 4}
+    assert session.stats()["machines"].get("bad") is None  # nothing buffered
+
+
+def test_drain_closes_live_sessions_and_refuses_new_ones():
+    plane = make_plane()
+    s1 = plane.session("p", "s1", "/dir")
+    s2 = plane.session("p", "s2", "/dir")
+    s2.close("end")  # already closed: drain must not double-terminal it
+    assert plane.drain() == 1
+    assert s1.closed
+    frames = parse_frames(list(s1.subscribe(heartbeat_s=0.01)))
+    assert frames[-1][1] == "drain"
+    assert frames[-1][2]["reason"] == "server draining"
+    assert plane.drain() == 0  # idempotent
+    with pytest.raises(PlaneSaturated):
+        plane.session("p", "s3", "/dir")
+    assert plane.stats()["draining"] is True
+
+
+def test_install_ensure_reset_lifecycle(monkeypatch):
+    reset_plane()
+    assert get_plane() is None
+    monkeypatch.setenv("GORDO_TPU_STREAM_ENABLED", "0")
+    assert ensure_plane() is None  # disabled: no plane materializes
+    monkeypatch.setenv("GORDO_TPU_STREAM_ENABLED", "1")
+    plane = ensure_plane()
+    assert plane is not None
+    assert ensure_plane() is plane  # idempotent
+    assert get_plane() is plane
+    reset_plane()
+    assert get_plane() is None
+
+
+def test_attach_drift_feeds_streamed_windows():
+    class Monitor:
+        def __init__(self):
+            self.seen = []
+
+        def observe_scores(self, frames, scores):
+            self.seen.append((sorted(frames), sorted(scores)))
+
+    plane = make_plane()
+    monitor = Monitor()
+    plane.attach_drift(monitor)
+    session = plane.session("p", "s1", "/dir")
+    plane.ingest(session, {"m-1": frame(4)})
+    assert monitor.seen == [(["m-1"], ["m-1"])]

@@ -1,0 +1,126 @@
+"""
+Streaming ring buffers: bounded row rings with 1-based monotonic row
+sequence numbers and oldest-first shedding, and the bounded event outbox
+with cursor replay + honest eviction accounting. Pure stdlib units (the
+"frames" are plain lists) — the seq arithmetic here is what the zero-gap
+soak audit leans on.
+"""
+
+import pytest
+
+from gordo_tpu.stream.ring import EventRing, RowRing
+
+pytestmark = pytest.mark.stream
+
+
+# -- RowRing -----------------------------------------------------------------
+
+
+def test_row_ring_append_assigns_contiguous_seqs():
+    ring = RowRing(100)
+    first, shed = ring.append([1, 2, 3])
+    assert (first, shed) == (1, 0)
+    first, shed = ring.append([4, 5])
+    assert (first, shed) == (4, 0)
+    assert ring.pending_rows == 5
+    assert ring.next_seq == 6
+    assert ring.shed_rows == 0
+
+
+def test_row_ring_take_returns_exact_span():
+    ring = RowRing(100)
+    ring.append([1, 2, 3])
+    ring.append([4, 5, 6])
+    chunks, first, last = ring.take(4)
+    assert first == 1 and last == 4
+    assert [row for chunk in chunks for row in chunk] == [1, 2, 3, 4]
+    assert ring.pending_rows == 2
+    # the remainder keeps its original seqs
+    chunks, first, last = ring.take(2)
+    assert first == 5 and last == 6
+    assert [row for chunk in chunks for row in chunk] == [5, 6]
+
+
+def test_row_ring_take_insufficient_rows_is_none():
+    ring = RowRing(100)
+    ring.append([1, 2])
+    assert ring.take(3) is None
+    assert ring.pending_rows == 2  # nothing consumed on refusal
+
+
+def test_row_ring_sheds_oldest_first_and_counts():
+    ring = RowRing(4)
+    ring.append([1, 2, 3])
+    first, shed = ring.append([4, 5, 6])
+    assert first == 4
+    assert shed == 2  # rows 1-2 evicted to fit 6 pending into 4
+    assert ring.pending_rows == 4
+    assert ring.shed_rows == 2
+    # what remains is the NEWEST 4 rows, seqs intact
+    chunks, first, last = ring.take(4)
+    assert (first, last) == (3, 6)
+    assert [row for chunk in chunks for row in chunk] == [3, 4, 5, 6]
+
+
+def test_row_ring_oversized_chunk_keeps_newest_capacity_rows():
+    ring = RowRing(3)
+    first, shed = ring.append([1, 2, 3, 4, 5])
+    assert first == 1
+    assert shed == 2
+    chunks, first, last = ring.take(3)
+    # seqs 1-2 were shed from inside the oversized chunk itself
+    assert (first, last) == (3, 5)
+    assert [row for chunk in chunks for row in chunk] == [3, 4, 5]
+
+
+def test_row_ring_seq_continuity_across_shed_and_take():
+    """The zero-gap invariant's bookkeeping: every row seq is consumed
+    exactly once, either by take() or by the shed counter."""
+    ring = RowRing(5)
+    total_in = 0
+    taken = []
+    for batch in ([1] * 4, [2] * 4, [3] * 4):
+        ring.append(list(batch))
+        total_in += len(batch)
+        got = ring.take(3)
+        if got is not None:
+            _, first, last = got
+            taken.append((first, last))
+    consumed = sum(last - first + 1 for first, last in taken)
+    assert consumed + ring.pending_rows + ring.shed_rows == total_in
+    # spans never overlap and never run backwards
+    for (_, prev_last), (nxt_first, _) in zip(taken, taken[1:]):
+        assert nxt_first > prev_last
+
+
+# -- EventRing ---------------------------------------------------------------
+
+
+def test_event_ring_since_replays_from_cursor():
+    ring = EventRing(10)
+    assert ring.append("a") == 1
+    assert ring.append("b") == 2
+    assert ring.append("c") == 3
+    batch, missed = ring.since(0)
+    assert [seq for seq, _ in batch] == [1, 2, 3]
+    assert missed == 0
+    batch, missed = ring.since(2)
+    assert [(seq, ev) for seq, ev in batch] == [(3, "c")]
+    assert missed == 0
+    assert ring.since(3) == ([], 0)
+
+
+def test_event_ring_eviction_reports_missed_events():
+    ring = EventRing(2)
+    for event in "abcd":
+        ring.append(event)
+    assert ring.latest_seq == 4
+    assert ring.oldest_seq == 3
+    assert ring.dropped == 2
+    batch, missed = ring.since(0)
+    assert [ev for _, ev in batch] == ["c", "d"]
+    assert missed == 2  # "a" and "b" are gone and the reader is told
+    # a cursor inside the retained window misses nothing
+    batch, missed = ring.since(3)
+    assert [ev for _, ev in batch] == ["d"]
+    assert missed == 0

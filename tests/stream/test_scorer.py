@@ -1,0 +1,297 @@
+"""
+Watermark scoring drills against a fake fleet: quarantine gating (rows
+stay buffered, innocents keep scoring), half-open recovery on the live
+stream, the ``stream_score`` fault site, hot-swap revision pinning with
+contiguous row spans, and breaker classification of client-data errors.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu import serve
+from gordo_tpu.server.fleet_store import STORE
+from gordo_tpu.stream.scorer import WindowScorer
+from gordo_tpu.stream.session import StreamSession
+from gordo_tpu.utils.faults import FaultRule, inject
+
+from .test_session import parse_frames
+
+pytestmark = [pytest.mark.stream, pytest.mark.chaos]
+
+WINDOW = 4
+
+
+class FakeFleet:
+    """fleet_scores twin: every machine echoes rows of mse 0.5, except
+    names in ``poison`` which land in the errors dict."""
+
+    def __init__(self, directory):
+        self.directory = directory
+        self.poison = {}
+
+    def model(self, name):
+        return object()
+
+    def loaded_specs(self):
+        return {}
+
+    def fleet_scores(self, inputs):
+        scores, errors = {}, {}
+        for name, X in inputs.items():
+            exc = self.poison.get(name)
+            if exc is not None:
+                errors[name] = exc
+            else:
+                rows = len(X)
+                scores[name] = (np.zeros((rows, 2)), np.full(rows, 0.5))
+        return scores, errors
+
+
+@pytest.fixture
+def fake_store(monkeypatch, tmp_path):
+    """Route the module STORE at a fake fleet; returns the fleet and a
+    swap(dir) hook that re-pins routing at a new revision dir."""
+    state = {"routed": str(tmp_path / "rev-a")}
+    fleets = {}
+
+    def route(directory):
+        return state["routed"]
+
+    def fleet(directory):
+        return fleets.setdefault(directory, FakeFleet(directory))
+
+    monkeypatch.setattr(STORE, "route", route)
+    monkeypatch.setattr(STORE, "fleet", fleet)
+
+    def swap(directory):
+        state["routed"] = str(directory)
+
+    return fleets, swap, state
+
+
+@pytest.fixture(autouse=True)
+def fresh_breakers(monkeypatch):
+    """Standalone stream breaker board, threshold 1, short cooldown —
+    and no engine, so the board is truly the stream's own."""
+    monkeypatch.setenv("GORDO_TPU_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("GORDO_TPU_BREAKER_COOLDOWN_S", "0.15")
+    monkeypatch.setenv("GORDO_TPU_BREAKER_BACKOFF", "1.0")
+    engine = serve.get_engine()
+    serve.install_engine(None)
+    serve.reset_stream_breakers()
+    yield
+    serve.reset_stream_breakers()
+    serve.install_engine(engine)
+
+
+def make_session(tmp_path):
+    return StreamSession(
+        "proj", "sid", str(tmp_path / "rev-a"), ring_rows=64,
+        outbox_events=64,
+    )
+
+
+def frame(rows):
+    return pd.DataFrame({"tag-1": np.arange(rows, dtype=float)})
+
+
+def events_of(session, kind=None):
+    frames = parse_frames(
+        list(session.subscribe(heartbeat_s=0.01, idle_timeout_s=0.02))
+    )
+    if kind is None:
+        return frames
+    return [data for _, k, data in frames if k == kind]
+
+
+def test_flush_scores_full_windows_only(fake_store, tmp_path):
+    scorer = WindowScorer(WINDOW)
+    session = make_session(tmp_path)
+    session.append_rows("m-1", frame(WINDOW + 1))  # one window + 1 spare
+    session.append_rows("m-2", frame(WINDOW - 1))  # below the watermark
+    summary = scorer.flush(session)
+    assert summary["scored"] == {"m-1": WINDOW}
+    assert summary["rows"] == WINDOW
+    anomalies = events_of(session, "anomaly")
+    assert anomalies == [
+        {
+            "machine": "m-1",
+            "first_seq": 1,
+            "last_seq": WINDOW,
+            "rows": WINDOW,
+            "windows": 1,
+            "mse_mean": 0.5,
+            "mse_max": 0.5,
+            "revision": "rev-a",
+        }
+    ]
+    stats = session.stats()["machines"]
+    assert stats["m-1"]["rows_pending"] == 1
+    assert stats["m-2"]["rows_pending"] == WINDOW - 1
+    assert stats["m-2"]["rows_scored"] == 0
+
+
+def test_poison_is_quarantined_while_innocents_keep_scoring(
+    fake_store, tmp_path
+):
+    fleets, _swap, state = fake_store
+    scorer = WindowScorer(WINDOW)
+    session = make_session(tmp_path)
+    fleet = fleets.setdefault(state["routed"], FakeFleet(state["routed"]))
+    fleet.poison["bad"] = RuntimeError("device poisoned")
+
+    # flush 1: the poison member fails server-side -> breaker trips
+    session.append_rows("bad", frame(WINDOW))
+    session.append_rows("good", frame(WINDOW))
+    summary = scorer.flush(session)
+    assert summary["scored"] == {"good": WINDOW}
+    assert summary["errors"] == {"bad": "RuntimeError"}
+
+    # flush 2: the tripped member is gated BEFORE cutting — its rows
+    # stay buffered; the innocent scores the same flush
+    session.append_rows("bad", frame(WINDOW))
+    session.append_rows("good", frame(WINDOW))
+    summary = scorer.flush(session)
+    assert "bad" in summary["quarantined"]
+    assert summary["quarantined"]["bad"] > 0  # the Retry-After hint
+    assert summary["scored"] == {"good": WINDOW}
+    stats = session.stats()["machines"]
+    assert stats["bad"]["rows_pending"] == WINDOW  # buffered, not dropped
+    assert stats["bad"]["quarantined"] is True
+    assert stats["good"]["rows_scored"] == 2 * WINDOW  # zero innocent drops
+
+    frames = events_of(session)
+    kinds = [k for _, k, _ in frames]
+    assert kinds.count("quarantined") == 1  # deduped, not per-flush noise
+    quarantine = [d for _, k, d in frames if k == "quarantined"][0]
+    assert quarantine["machine"] == "bad"
+    assert quarantine["retry_after_s"] > 0
+
+
+def test_half_open_probe_recovers_on_the_live_stream(fake_store, tmp_path):
+    import time
+
+    fleets, _swap, state = fake_store
+    scorer = WindowScorer(WINDOW)
+    session = make_session(tmp_path)
+    fleet = fleets.setdefault(state["routed"], FakeFleet(state["routed"]))
+    fleet.poison["bad"] = RuntimeError("device poisoned")
+
+    session.append_rows("bad", frame(WINDOW))
+    scorer.flush(session)  # window 1 cut, fails server-side: trips
+    session.append_rows("bad", frame(WINDOW))
+    assert "bad" in scorer.flush(session)["quarantined"]
+    session.append_rows("bad", frame(WINDOW))  # buffers while quarantined
+
+    fleet.poison.clear()  # the fault clears
+    time.sleep(0.2)  # past the 0.15s cooldown -> half-open
+    summary = scorer.flush(session)
+    # the half-open probe scores the ENTIRE quarantine-era backlog as
+    # one contiguous span — buffered windows were never dropped
+    assert summary["scored"] == {"bad": 2 * WINDOW}
+    frames = events_of(session)
+    kinds = [k for _, k, _ in frames]
+    assert "recovered" in kinds
+    anomalies = [d for _, k, d in frames if k == "anomaly"]
+    # rows 1..WINDOW were cut by flush 1 and failed; the backlog span
+    # picks up exactly where the failed window ended
+    assert anomalies[-1]["first_seq"] == WINDOW + 1
+    assert anomalies[-1]["last_seq"] == 3 * WINDOW
+    assert anomalies[-1]["windows"] == 2
+    stats = session.stats()["machines"]["bad"]
+    assert stats["quarantined"] is False
+    assert stats["rows_scored"] == 2 * WINDOW
+    assert stats["rows_failed"] == WINDOW
+    # zero-gap ledger across the whole episode
+    assert (
+        stats["rows_scored"]
+        + stats["rows_failed"]
+        + stats["rows_pending"]
+        + stats["rows_shed"]
+        == stats["rows_in"]
+    )
+
+
+def test_stream_score_fault_site_is_per_member(fake_store, tmp_path):
+    scorer = WindowScorer(WINDOW)
+    session = make_session(tmp_path)
+    session.append_rows("bad", frame(WINDOW))
+    session.append_rows("good", frame(WINDOW))
+    with inject(FaultRule("stream_score", match="sid:bad", times=None)):
+        summary = scorer.flush(session)
+    assert summary["scored"] == {"good": WINDOW}
+    assert summary["errors"] == {"bad": "FaultInjected"}
+    errors = events_of(session, "error")
+    assert errors == [
+        {"machine": "bad", "first_seq": 1, "last_seq": WINDOW,
+         "error": "FaultInjected"}
+    ]
+    stats = session.stats()["machines"]["bad"]
+    assert stats["rows_failed"] == WINDOW
+    assert stats["score_errors"] == 1
+
+
+def test_hot_swap_pins_revision_per_flush_with_contiguous_spans(
+    fake_store, tmp_path
+):
+    _fleets, swap, _state = fake_store
+    scorer = WindowScorer(WINDOW)
+    session = make_session(tmp_path)
+
+    session.append_rows("m-1", frame(WINDOW))
+    scorer.flush(session)
+    swap(tmp_path / "rev-b")  # the promotion lands between flushes
+    session.append_rows("m-1", frame(WINDOW))
+    scorer.flush(session)
+
+    anomalies = events_of(session, "anomaly")
+    assert [a["revision"] for a in anomalies] == ["rev-a", "rev-b"]
+    # zero-gap across the swap: spans abut exactly
+    assert anomalies[0]["last_seq"] + 1 == anomalies[1]["first_seq"]
+    assert [a["first_seq"] for a in anomalies] == [1, WINDOW + 1]
+
+
+def test_client_data_errors_do_not_trip_the_breaker(fake_store, tmp_path):
+    fleets, _swap, state = fake_store
+    scorer = WindowScorer(WINDOW)
+    session = make_session(tmp_path)
+    fleet = fleets.setdefault(state["routed"], FakeFleet(state["routed"]))
+    fleet.poison["m-1"] = ValueError("wrong columns")
+
+    session.append_rows("m-1", frame(WINDOW))
+    summary = scorer.flush(session)
+    assert summary["errors"] == {"m-1": "ValueError"}
+    # threshold is 1: a server-side error would have quarantined it
+    fleet.poison.clear()
+    session.append_rows("m-1", frame(WINDOW))
+    summary = scorer.flush(session)
+    assert summary["quarantined"] == {}
+    assert summary["scored"] == {"m-1": WINDOW}
+
+
+def test_stream_only_scoring_populates_the_health_ledger(
+    fake_store, tmp_path, monkeypatch
+):
+    """Satellite: a stream-only deployment (no HTTP scoring traffic at
+    all) still narrates per-machine health through the anchor ledger."""
+    from gordo_tpu.telemetry.fleet_health import ledger_for, reset_ledgers
+
+    reset_ledgers()
+    anchor = tmp_path / "anchor"
+    anchor.mkdir()
+    scorer = WindowScorer(WINDOW, ledger_anchor=str(anchor))
+    session = make_session(tmp_path)
+    try:
+        session.append_rows("m-1", frame(WINDOW))
+        scorer.flush(session)
+        doc = ledger_for(str(anchor)).document() or {}
+        record = (doc.get("machines") or {}).get("m-1") or {}
+        assert record, doc
+        serving = record.get("serving") or {}
+        assert serving.get("rows", 0) >= WINDOW
+        assert serving.get("residual_mean") == pytest.approx(0.5)
+        assert serving.get("requests", 0) >= 1
+        assert serving.get("errors", 0) == 0
+    finally:
+        reset_ledgers()

@@ -1,0 +1,228 @@
+"""
+Stream sessions: SSE subscription replay/cursor semantics, terminal
+frames, backpressure surfacing, the ``stream_emit`` fault drill, and the
+per-machine zero-gap accounting. No scoring here — frames are plain
+lists and events are appended by hand.
+"""
+
+import json
+import threading
+
+import pytest
+
+from gordo_tpu.stream.events import StreamEvent, heartbeat_frame
+from gordo_tpu.stream.session import StreamSession
+from gordo_tpu.utils.faults import FaultRule, inject
+
+pytestmark = pytest.mark.stream
+
+
+def make_session(ring_rows=100, outbox_events=100) -> StreamSession:
+    return StreamSession(
+        "proj", "s1", "/tmp/anchor", ring_rows=ring_rows,
+        outbox_events=outbox_events,
+    )
+
+
+def parse_frames(frames):
+    """Decode SSE wire frames into (id, event, data) tuples; heartbeats
+    come back as ("", "heartbeat", None)."""
+    out = []
+    for frame in frames:
+        assert frame.endswith("\n\n"), frame
+        if frame.startswith(":"):
+            out.append(("", "heartbeat", None))
+            continue
+        fields = dict(
+            line.split(": ", 1) for line in frame.strip().split("\n")
+        )
+        out.append(
+            (
+                fields.get("id", ""),
+                fields["event"],
+                json.loads(fields["data"]),
+            )
+        )
+    return out
+
+
+def collect(session, **kwargs):
+    return parse_frames(list(session.subscribe(**kwargs)))
+
+
+# -- subscribe replay / cursor ----------------------------------------------
+
+
+def test_subscribe_opens_then_replays_then_terminates():
+    session = make_session()
+    session.emit(StreamEvent("anomaly", {"machine": "m-1"}))
+    session.emit(StreamEvent("anomaly", {"machine": "m-2"}))
+    session.close("end", reason="done")
+    frames = collect(session)
+    ids, kinds, datas = zip(*frames)
+    assert kinds == ("open", "anomaly", "anomaly", "end")
+    # the open frame is subscription-local: no id (it must never
+    # advance a reconnecting consumer's Last-Event-ID)
+    assert ids[0] == ""
+    assert [i for i in ids[1:]] == ["1", "2", "3"]
+    assert datas[0]["stream"] == "s1"
+    assert datas[-1]["reason"] == "done"
+
+
+def test_subscribe_from_cursor_skips_consumed_events():
+    session = make_session()
+    for i in range(4):
+        session.emit(StreamEvent("anomaly", {"n": i}))
+    session.close()
+    frames = collect(session, cursor=2)
+    kinds = [kind for _, kind, _ in frames]
+    assert kinds == ["open", "anomaly", "anomaly", "end"]
+    assert [data["n"] for _, kind, data in frames if kind == "anomaly"] == [
+        2,
+        3,
+    ]
+
+
+def test_reconnect_resumes_without_gap_or_duplicate():
+    """The disconnect drill: consume a prefix, 'drop the connection',
+    reconnect with the last seen id — the tail continues exactly."""
+    session = make_session()
+    for i in range(6):
+        session.emit(StreamEvent("anomaly", {"n": i}))
+    first_half = collect(session, max_events=3)
+    last_id = int([i for i, _, _ in first_half if i][-1])
+    session.close()
+    second_half = collect(session, cursor=last_id)
+    seen = [
+        data["n"]
+        for _, kind, data in first_half + second_half
+        if kind == "anomaly"
+    ]
+    assert seen == [0, 1, 2, 3, 4, 5]
+
+
+def test_slow_consumer_outbox_eviction_is_reported():
+    session = make_session(outbox_events=3)
+    for i in range(8):
+        session.emit(StreamEvent("anomaly", {"n": i}))
+    session.close()  # terminal occupies one outbox slot too
+    frames = collect(session)
+    kinds = [kind for _, kind, _ in frames]
+    assert kinds[0] == "open"
+    assert kinds[1] == "shed"
+    shed = frames[1][2]
+    assert shed["scope"] == "outbox"
+    assert shed["dropped"] == 6  # 9 events, 3 retained
+    assert session.stats()["events_dropped_outbox"] == 6
+
+
+def test_idle_subscription_heartbeats_then_times_out():
+    session = make_session()
+    frames = list(
+        session.subscribe(heartbeat_s=0.01, idle_timeout_s=0.05)
+    )
+    assert frames[0].startswith("event: open")
+    assert heartbeat_frame() in frames[1:]
+
+
+def test_max_events_bounds_the_response():
+    session = make_session()
+    for i in range(5):
+        session.emit(StreamEvent("anomaly", {"n": i}))
+    frames = collect(session, max_events=2)
+    assert [kind for _, kind, _ in frames] == ["open", "anomaly", "anomaly"]
+
+
+# -- close / drain -----------------------------------------------------------
+
+
+def test_close_is_idempotent_one_terminal_frame():
+    session = make_session()
+    session.close("drain", reason="server draining")
+    session.close("end", reason="too late")
+    frames = collect(session)
+    kinds = [kind for _, kind, _ in frames]
+    assert kinds == ["open", "drain"]
+    assert frames[1][2]["reason"] == "server draining"
+
+
+def test_close_wakes_blocked_subscriber_with_terminal_frame():
+    session = make_session()
+    got = []
+
+    def consume():
+        got.extend(parse_frames(list(session.subscribe(heartbeat_s=5.0))))
+
+    thread = threading.Thread(target=consume, daemon=True)
+    thread.start()
+    # subscriber is parked in the condition wait; drain must wake it
+    session.close("drain", reason="server draining")
+    thread.join(timeout=10)
+    assert not thread.is_alive()
+    assert [kind for _, kind, _ in got] == ["open", "drain"]
+
+
+# -- backpressure ------------------------------------------------------------
+
+
+def test_ring_overflow_emits_shed_control_frame():
+    session = make_session(ring_rows=4)
+    session.append_rows("m-1", [1, 2, 3])
+    first_seq, shed = session.append_rows("m-1", [4, 5, 6])
+    assert shed == 2
+    session.close()
+    frames = collect(session)
+    shed_frames = [data for _, kind, data in frames if kind == "shed"]
+    assert shed_frames == [
+        {
+            "scope": "ring",
+            "machine": "m-1",
+            "dropped": 2,
+            "rows_shed_total": 2,
+        }
+    ]
+    stats = session.stats()["machines"]["m-1"]
+    assert stats["rows_in"] == 6
+    assert stats["rows_shed"] == 2
+    assert stats["rows_pending"] == 4
+    # the zero-gap ledger: every ingested row is accounted for
+    assert (
+        stats["rows_scored"]
+        + stats["rows_failed"]
+        + stats["rows_pending"]
+        + stats["rows_shed"]
+        == stats["rows_in"]
+    )
+
+
+# -- the stream_emit fault drill ---------------------------------------------
+
+
+def test_emit_fault_drops_are_counted_and_surfaced():
+    session = make_session()
+    rule = FaultRule("stream_emit", match="s1:anomaly", times=2)
+    with inject(rule):
+        session.emit(StreamEvent("anomaly", {"n": 0}))  # dropped
+        session.emit(StreamEvent("anomaly", {"n": 1}))  # dropped
+        session.emit(StreamEvent("anomaly", {"n": 2}))  # lands
+    session.close()
+    frames = collect(session)
+    kinds = [kind for _, kind, _ in frames]
+    # the deferred loss report precedes the first event that landed
+    assert kinds == ["open", "shed", "anomaly", "end"]
+    assert frames[1][2] == {"scope": "emit", "dropped": 2}
+    assert frames[2][2]["n"] == 2
+    assert session.stats()["events_dropped_emit"] == 2
+
+
+def test_emit_fault_cannot_suppress_terminal_frame():
+    """A drill matching EVERY emit on the stream must still let the
+    terminal through: close() uses the unfaulted append."""
+    session = make_session()
+    with inject(FaultRule("stream_emit", match="s1:*", times=None)):
+        session.emit(StreamEvent("anomaly", {"n": 0}))
+        session.close("drain", reason="server draining")
+    frames = collect(session)
+    kinds = [kind for _, kind, _ in frames]
+    assert kinds == ["open", "shed", "drain"]
+    assert frames[1][2] == {"scope": "emit", "dropped": 1}
