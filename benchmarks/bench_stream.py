@@ -28,16 +28,38 @@ must tile ``1..N`` with no hole (dropped window) and no overlap
 (double-score) across every hot-swap; and the plane's own row accounting
 must balance (``rows_in == scored + failed + pending + shed``).
 
+Three observability phases (PR 18) run after the drain, against the
+same built fleet:
+
+5. **telemetry overhead** — span telemetry on vs off across repeated
+   ingest→flush cycles, interleaved quiet-floor method (the
+   BENCH_TELEMETRY / BENCH_SLO convention): the floors' delta is the
+   streaming-plane telemetry tax, gated at ``<= 2%``. The soak itself
+   also reports its row-weighted ingest→scored lag p95 (the freshness
+   SLO's native distribution), gated by an absolute budget.
+6. **freshness SLO drill** — an injected ``stream_score`` stall (fault
+   → breaker quarantine → cooldown → half-open probe scoring the aged
+   backlog) must drive the streaming freshness SLO pending → firing —
+   the page-severity predicate that holds lifecycle auto-promotion —
+   and recovery traffic must resolve it, all read back from the span
+   trace by the burn-rate engine.
+7. **scrape boundedness** — one session holding rows pending for 10k
+   fleetgen members, then one ``StreamPlaneCollector`` pass: the
+   sample count must stay a small constant and NO member name may
+   reach a label.
+
 Writes ``BENCH_STREAM.json`` at the repo root (the committed bench
 convention), gated by ``gordo-tpu bench-check``. Run:
 ``JAX_PLATFORMS=cpu python benchmarks/bench_stream.py`` (or
 ``make bench-stream``). Reduced-duration knobs for CI:
-``BENCH_STREAM_OUT``, ``BENCH_STREAM_SECONDS``, ``BENCH_STREAM_CLIENTS``.
+``BENCH_STREAM_OUT``, ``BENCH_STREAM_SECONDS``, ``BENCH_STREAM_CLIENTS``,
+``BENCH_STREAM_OVERHEAD_REPS``, ``BENCH_STREAM_PROM_MEMBERS``.
 """
 
 import datetime
 import json
 import os
+import shutil
 import sys
 import tempfile
 import threading
@@ -59,6 +81,20 @@ POISON_SECONDS = max(1.0, SOAK_SECONDS / 2.0)
 N_SWAPS = 6  # the gate floor is 5
 WINDOW = 32
 ROWS_PER_POST = WINDOW  # one exact window per member per ingest
+
+#: interleaved on/off reps for the telemetry-overhead floor, and
+#: ingest→flush cycles per rep (one cycle = one fused flush of every
+#: member's full window)
+OVERHEAD_REPS = int(os.environ.get("BENCH_STREAM_OVERHEAD_REPS", "11"))
+OVERHEAD_CYCLES = int(os.environ.get("BENCH_STREAM_OVERHEAD_CYCLES", "96"))
+#: fleetgen members held pending for the scrape-boundedness pass, and
+#: the fixed sample budget the collector must stay under at any N
+PROM_MEMBERS = int(os.environ.get("BENCH_STREAM_PROM_MEMBERS", "10000"))
+PROM_SAMPLE_BUDGET = 100
+#: the injected stall: longer than the breaker cooldown (0.6s below)
+#: so the half-open probe scores rows aged far past the drill's 100ms
+#: freshness threshold
+STALL_SECONDS = 0.9
 
 PROJECT = "bench-stream"
 BASE_REVISION = "100"
@@ -100,10 +136,8 @@ def build_collection(root: str):
     return base_dir, tags
 
 
-def arrow_body(tags):
-    """One reusable ingest body: ROWS_PER_POST rows for every member,
-    packed in the fleet route's Arrow-IPC container."""
-    from gordo_tpu.server import wire
+def window_frame(tags):
+    """One exact watermark window: ROWS_PER_POST rows of every tag."""
     from gordo_tpu.server.utils import dataframe_from_dict
 
     index = [
@@ -115,8 +149,15 @@ def arrow_body(tags):
         tag: {ts: 0.01 * i + 0.1 * j for j, ts in enumerate(index)}
         for i, tag in enumerate(tags)
     }
-    X = dataframe_from_dict(payload)
-    encoded = wire.encode_request(X)
+    return dataframe_from_dict(payload)
+
+
+def arrow_body(tags):
+    """One reusable ingest body: ROWS_PER_POST rows for every member,
+    packed in the fleet route's Arrow-IPC container."""
+    from gordo_tpu.server import wire
+
+    encoded = wire.encode_request(window_frame(tags))
     body = wire.pack_streams(
         {f"stream-{i}": encoded for i in range(N_MODELS)}
     )
@@ -239,12 +280,229 @@ def accounting_gaps(plane):
     return gaps
 
 
+def _drill_plane():
+    """A private plane sized for the observability phases: exact
+    watermark windows, a ring deep enough to hold a quarantine-era
+    backlog without shedding, heartbeats out of the way."""
+    from gordo_tpu.stream import StreamConfig, StreamPlane
+
+    return StreamPlane(
+        StreamConfig(
+            ring_rows=WINDOW * 8,
+            window_rows=WINDOW,
+            outbox_events=4096,
+            session_ttl_s=600.0,
+            heartbeat_s=600.0,
+            max_sessions=4,
+            shed_retry_s=0.5,
+        )
+    )
+
+
+def telemetry_overhead(base_dir, tags) -> dict:
+    """Span-telemetry cost on the streaming hot path, interleaved
+    quiet-floor method (the BENCH_TELEMETRY / BENCH_SLO convention):
+    one rep is OVERHEAD_CYCLES ingest→flush cycles against the real
+    fleet through a private plane, the serve recorder rebuilt from the
+    environment between modes; the per-mode floors (min over reps)
+    shed shared-host noise, and their delta is the telemetry tax."""
+    from gordo_tpu import telemetry
+    from gordo_tpu.telemetry import serving
+
+    frames = {f"stream-{i}": window_frame(tags) for i in range(N_MODELS)}
+    trace_root = tempfile.mkdtemp(prefix="bench-stream-tel-")
+
+    def one_rep(telemetry_on: bool) -> float:
+        if telemetry_on:
+            os.environ[telemetry.TELEMETRY_ENV] = "1"
+            os.environ[telemetry.TRACE_DIR_ENV] = tempfile.mkdtemp(
+                dir=trace_root
+            )
+        else:
+            os.environ.pop(telemetry.TELEMETRY_ENV, None)
+        serving.reset_serve_recorder()
+        plane = _drill_plane()
+        session = plane.session(PROJECT, "overhead", base_dir)
+        start = time.perf_counter()
+        for _ in range(OVERHEAD_CYCLES):
+            plane.ingest(session, frames)
+        serving.serve_recorder().flush()
+        elapsed = time.perf_counter() - start
+        plane.drain()
+        return elapsed
+
+    try:
+        one_rep(False)  # warm both modes before the measured reps
+        one_rep(True)
+        runs = {"off": [], "on": []}
+        for rep in range(OVERHEAD_REPS):
+            if rep % 2 == 0:
+                runs["off"].append(one_rep(False))
+                runs["on"].append(one_rep(True))
+            else:
+                runs["on"].append(one_rep(True))
+                runs["off"].append(one_rep(False))
+    finally:
+        os.environ.pop(telemetry.TELEMETRY_ENV, None)
+        os.environ.pop(telemetry.TRACE_DIR_ENV, None)
+        serving.reset_serve_recorder()
+        shutil.rmtree(trace_root, ignore_errors=True)
+    off_floor, on_floor = min(runs["off"]), min(runs["on"])
+    return {
+        "reps": OVERHEAD_REPS,
+        "cycles_per_rep": OVERHEAD_CYCLES,
+        "rows_per_cycle": ROWS_PER_POST * N_MODELS,
+        "off_floor_s": round(off_floor, 4),
+        "on_floor_s": round(on_floor, 4),
+        "overhead_pct": round(
+            (on_floor - off_floor) / off_floor * 100.0, 2
+        ),
+        "runs": {
+            mode: [round(v, 4) for v in values]
+            for mode, values in runs.items()
+        },
+    }
+
+
+def freshness_slo_drill(base_dir, tags) -> dict:
+    """The PR 18 acceptance drill, end to end through the REAL plane:
+    an injected ``stream_score`` stall (fault → breaker trip → rows
+    quarantined past the cooldown → half-open probe scoring the aged
+    backlog) produces rows whose ingest→scored lag blows the drill's
+    100ms freshness threshold; the burn-rate engine reads them back
+    from the span trace and must walk the freshness alert pending →
+    firing — the page-severity predicate the lifecycle supervisor's
+    promotion gate consults — then resolve it on recovery traffic."""
+    from gordo_tpu import serve, telemetry
+    from gordo_tpu.telemetry import serving, slo
+    from gordo_tpu.utils.faults import FaultRule, inject
+
+    d = tempfile.mkdtemp(prefix="bench-stream-slo-")
+    os.environ[telemetry.TELEMETRY_ENV] = "1"
+    os.environ[telemetry.TRACE_DIR_ENV] = d
+    serving.reset_serve_recorder()
+    serve.reset_stream_breakers()
+    slo.reset_statuses()
+    try:
+        with open(os.path.join(d, "slos.toml"), "w") as handle:
+            handle.write(
+                '[[slo]]\nname = "stream-freshness"\n'
+                'objective = "stream_freshness"\ntarget = 0.95\n'
+                'threshold_ms = 100.0\nwindow = "30d"\n'
+                "[burn]\nfast_threshold = 5.0\n"
+            )
+        frames = {POISON: window_frame(tags)}
+        plane = _drill_plane()
+        session = plane.session(PROJECT, "drill", base_dir)
+        rule = FaultRule("stream_score", match=f"*:{POISON}", times=None)
+        with inject(rule):
+            plane.ingest(session, frames)  # flush fails, breaker trips
+            plane.ingest(session, frames)  # quarantined: rows sit pending
+        time.sleep(STALL_SECONDS)  # the stall ages the buffered backlog
+        plane.ingest(session, frames)  # half-open probe scores stale rows
+        serving.serve_recorder().flush()
+        now = time.time()
+        first = slo.evaluate(d, now=now)
+        second = slo.evaluate(d, now=now + 30)
+        firing = [
+            alert["id"]
+            for alert in slo.firing_alerts(d, severity="page")
+        ]
+        # recovery: fresh windows flush within the threshold and dilute
+        # the burn below both alert windows' thresholds
+        for _ in range(48):
+            plane.ingest(session, frames)
+        serving.serve_recorder().flush()
+        third = slo.evaluate(d, now=now + 60)
+        released = not slo.firing_alerts(d, severity="page")
+        plane.drain()
+    finally:
+        os.environ.pop(telemetry.TELEMETRY_ENV, None)
+        os.environ.pop(telemetry.TRACE_DIR_ENV, None)
+        serving.reset_serve_recorder()
+        slo.reset_statuses()
+        serve.reset_stream_breakers()
+        shutil.rmtree(d, ignore_errors=True)
+
+    def alert_state(doc):
+        states = {a["id"]: a["state"] for a in doc["alerts"]}
+        return states.get("stream-freshness:fast", "absent")
+
+    sequence = [alert_state(first), alert_state(second), alert_state(third)]
+    return {
+        "sequence": sequence,
+        # the gate requires the full walk AND the promotion-hold
+        # predicate going quiet again once the alert resolves
+        "drill_ok": (
+            sequence == ["pending", "firing", "resolved"] and released
+        ),
+        "held_promotion": "stream-freshness:fast" in firing,
+        "released": released,
+    }
+
+
+def prometheus_bounded(base_dir) -> dict:
+    """Scrape-surface boundedness at fleet scale: one plane session
+    holds PROM_MEMBERS fleetgen members' rows pending (the watermark
+    never trips), then one ``StreamPlaneCollector`` pass runs — the
+    sample count must stay under the fixed budget with NO member name
+    in any label value."""
+    import pandas as pd
+
+    import fleetgen
+    from gordo_tpu.server.prometheus.metrics import StreamPlaneCollector
+    from gordo_tpu.stream import StreamConfig, StreamPlane
+    from gordo_tpu.stream import plane as plane_mod
+
+    names = fleetgen.machine_names(PROM_MEMBERS, prefix="stream-m")
+    row = pd.DataFrame({"tag-1": [0.0]})
+    plane = StreamPlane(
+        StreamConfig(
+            ring_rows=4,
+            window_rows=10_000_000,
+            outbox_events=64,
+            session_ttl_s=600.0,
+            heartbeat_s=600.0,
+            max_sessions=2,
+            shed_retry_s=0.5,
+        )
+    )
+    session = plane.session(PROJECT, "prom", base_dir)
+    plane.ingest(session, {name: row for name in names})
+    previous = plane_mod.get_plane()
+    plane_mod.install_plane(plane)
+    try:
+        samples = families = leaked = 0
+        for family in StreamPlaneCollector().collect():
+            families += 1
+            for sample in family.samples:
+                samples += 1
+                if any(
+                    "stream-m-" in value
+                    for value in sample.labels.values()
+                ):
+                    leaked += 1
+    finally:
+        plane_mod.install_plane(previous)
+        plane.drain()
+    return {
+        "members": PROM_MEMBERS,
+        "families": families,
+        "samples": samples,
+        "sample_budget": PROM_SAMPLE_BUDGET,
+        "member_labels_leaked": leaked,
+        "bounded": samples <= PROM_SAMPLE_BUDGET and leaked == 0,
+    }
+
+
 def main() -> dict:
     from gordo_tpu import serve, stream as stream_mod
     from gordo_tpu.lifecycle import publish_canary
     from gordo_tpu.server import build_app
     from gordo_tpu.server.app import drain_and_stop
     from gordo_tpu.server.fleet_store import STORE
+    from gordo_tpu.stream import reset_stream_telemetry, stream_telemetry
+    from gordo_tpu.telemetry.aggregate import histogram_percentile
     from gordo_tpu.utils.faults import FaultRule, inject
 
     tmp = tempfile.mkdtemp(prefix="bench-stream-")
@@ -264,6 +522,7 @@ def main() -> dict:
     serve.install_engine(None)
     serve.reset_stream_breakers()
     stream_mod.reset_plane()
+    reset_stream_telemetry()
     app = build_app(config={"EXPECTED_MODELS": []})
     STORE.fleet(base_dir).warm()
     STORE.fleet(alt_dir).warm()
@@ -294,6 +553,11 @@ def main() -> dict:
     soak_wall = time.monotonic() - soak_start
     soak_rows = rows_scored_total(plane) - scored_before
     rows_per_sec = soak_rows / soak_wall if soak_wall else 0.0
+    # the soak's row-weighted ingest→scored lag distribution, captured
+    # BEFORE the poison phase inflates it with quarantine-era backlog
+    soak_lag_hist = stream_telemetry().snapshot()["lag_ms"]
+    soak_lag_p50 = histogram_percentile(soak_lag_hist, 0.50)
+    soak_lag_p95 = histogram_percentile(soak_lag_hist, 0.95)
 
     # phase 2: poison one member's scoring; the breaker must quarantine
     # it while its stream-mates keep scoring
@@ -376,6 +640,13 @@ def main() -> dict:
     posts = sum(ingestor.posts for ingestor in ingestors)
     non_200 = sum(ingestor.non_200 for ingestor in ingestors)
 
+    # the observability phases run after the drain, against the same
+    # built fleet (still warm in STORE) but private planes
+    serve.reset_stream_breakers()
+    overhead = telemetry_overhead(base_dir, tags)
+    prometheus = prometheus_bounded(base_dir)
+    slo_drill = freshness_slo_drill(base_dir, tags)
+
     serve.reset_stream_breakers()
     stream_mod.reset_plane()
 
@@ -393,7 +664,12 @@ def main() -> dict:
             "rows_per_sec": round(rows_per_sec, 1),
             "rows_scored": soak_rows,
             "accounting_gaps": final_accounting,
+            "lag_p50_ms": round(soak_lag_p50, 3),
+            "lag_p95_ms": round(soak_lag_p95, 3),
         },
+        "telemetry": overhead,
+        "prometheus": prometheus,
+        "slo_drill": slo_drill,
         "swap": {
             "swaps": swaps,
             "seq_gaps": seq_gaps,

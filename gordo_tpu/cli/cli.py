@@ -858,6 +858,7 @@ def fleet_status(
     """
     import time as time_mod
 
+    from ..stream import stream_plane_section
     from ..telemetry import (
         fleet_status_document,
         render_fleet_status,
@@ -870,6 +871,9 @@ def fleet_status(
         doc = fleet_status_document(
             directory,
             device=utilization_snapshot(),
+            # None in a CLI process with no installed plane — the
+            # section is injected, never imported by telemetry
+            stream=stream_plane_section(),
             machines=machines,
             limit=limit,
             offset=offset,

@@ -733,22 +733,165 @@ class SloCollector:
         yield state
 
 
+class StreamPlaneCollector:
+    """Scrape-time exposition of the streaming scoring plane
+    (``gordo_tpu.stream``): session/subscriber/pending gauges, the
+    row-accounting totals, and the flush-duration + ingest→scored
+    score-lag fixed-bucket histograms from the process-global stream
+    telemetry accumulator.
+
+    Cardinality is BOUNDED by construction (the PR 8/9 contract): the
+    only label sets are small constants — session states, row accounting
+    scopes, event-drop scopes. Per-machine and per-stream detail NEVER
+    reaches a label, however large the fleet grows; it lives on the
+    ``/stream/status`` route and in the span trace instead."""
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeHistogramMetricFamily,
+            GaugeMetricFamily,
+        )
+
+        from ... import stream as stream_plane
+
+        sessions = GaugeMetricFamily(
+            "gordo_stream_sessions",
+            "Stream sessions by state (tombstoned = closed but retained "
+            "for late cursors until the TTL)",
+            labels=["state"],
+        )
+        subscribers = GaugeMetricFamily(
+            "gordo_stream_subscribers",
+            "Open SSE subscriptions across all stream sessions",
+            labels=[],
+        )
+        pending = GaugeMetricFamily(
+            "gordo_stream_pending_rows",
+            "Rows buffered in the ingest rings awaiting the watermark, "
+            "summed over sessions and machines",
+            labels=[],
+        )
+        quarantined = GaugeMetricFamily(
+            "gordo_stream_quarantined_machines",
+            "Stream machines currently held by an open circuit breaker "
+            "(their rows buffer instead of scoring)",
+            labels=[],
+        )
+        rows = CounterMetricFamily(
+            "gordo_stream_rows",
+            "Streaming-plane row accounting by outcome (in/scored/"
+            "failed/shed); in == scored + failed + pending + shed is "
+            "the plane's zero-gap invariant",
+            labels=["outcome"],
+        )
+        events_dropped = CounterMetricFamily(
+            "gordo_stream_events_dropped",
+            "Emitted events dropped by scope (outbox = slow-consumer "
+            "ring eviction, emit = the emit fault site)",
+            labels=["scope"],
+        )
+        flushes = CounterMetricFamily(
+            "gordo_stream_flushes",
+            "Watermark scoring flushes run by this process",
+            labels=[],
+        )
+        flush_hist = GaugeHistogramMetricFamily(
+            "gordo_stream_flush_duration_ms",
+            "Wall milliseconds per watermark flush (cut + fused scoring "
+            "+ event fan-out), fixed buckets",
+            labels=[],
+        )
+        lag_hist = GaugeHistogramMetricFamily(
+            "gordo_stream_score_lag_ms",
+            "Ingest→scored lag in milliseconds, row-weighted (each "
+            "flush contributes its scored rows at the span's oldest-row "
+            "lag) — the freshness SLO's native distribution",
+            labels=[],
+        )
+
+        plane = stream_plane.get_plane()
+        active = tombstoned = subs = pending_rows = quarantine_count = 0
+        dropped = {"outbox": 0, "emit": 0}
+        if plane is not None:
+            stats = plane.stats()
+            for session in (stats.get("sessions") or {}).values():
+                if session.get("closed"):
+                    tombstoned += 1
+                else:
+                    active += 1
+                subs += int(session.get("subscribers") or 0)
+                dropped["outbox"] += int(
+                    session.get("events_dropped_outbox") or 0
+                )
+                dropped["emit"] += int(
+                    session.get("events_dropped_emit") or 0
+                )
+                for machine in (session.get("machines") or {}).values():
+                    pending_rows += int(machine.get("rows_pending") or 0)
+                    if machine.get("quarantined"):
+                        quarantine_count += 1
+        sessions.add_metric(["active"], active)
+        sessions.add_metric(["tombstoned"], tombstoned)
+        subscribers.add_metric([], subs)
+        pending.add_metric([], pending_rows)
+        quarantined.add_metric([], quarantine_count)
+        for scope, count in dropped.items():
+            events_dropped.add_metric([scope], count)
+
+        telemetry = stream_plane.stream_telemetry().snapshot()
+        rows.add_metric(["in"], telemetry["rows_in"])
+        rows.add_metric(["scored"], telemetry["rows_scored"])
+        rows.add_metric(["failed"], telemetry["rows_failed"])
+        rows.add_metric(["shed"], telemetry["rows_shed"])
+        flushes.add_metric([], telemetry["flushes"])
+        for family, histogram in (
+            (flush_hist, telemetry["flush_ms"]),
+            (lag_hist, telemetry["lag_ms"]),
+        ):
+            cumulative = 0
+            buckets = []
+            counts = histogram.get("counts") or []
+            for edge, count in zip(
+                histogram.get("buckets_ms") or [], counts
+            ):
+                cumulative += int(count)
+                buckets.append((str(edge), cumulative))
+            buckets.append(("+Inf", int(histogram.get("count") or 0)))
+            family.add_metric(
+                [],
+                buckets=buckets,
+                gsum_value=float(histogram.get("sum_ms") or 0.0),
+            )
+
+        yield sessions
+        yield subscribers
+        yield pending
+        yield quarantined
+        yield rows
+        yield events_dropped
+        yield flushes
+        yield flush_hist
+        yield lag_hist
+
+
 #: registries already carrying the fleet-console collectors (same
 #: duplicate-registration guard as the program-cache WeakSet)
 _fleet_console_registries: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def register_fleet_console_collectors(registry: CollectorRegistry) -> None:
-    """Attach the fleet-health, device-utilization and SLO scrape
-    collectors to ``registry``, once — on every registry that answers
-    scrapes, like the program-cache collector (scrape-time collectors
-    have no mmap backing to ride the multiprocess fan-in)."""
+    """Attach the fleet-health, device-utilization, SLO and stream-plane
+    scrape collectors to ``registry``, once — on every registry that
+    answers scrapes, like the program-cache collector (scrape-time
+    collectors have no mmap backing to ride the multiprocess fan-in)."""
     if registry in _fleet_console_registries:
         return
     _fleet_console_registries.add(registry)
     registry.register(FleetHealthCollector())
     registry.register(DeviceUtilizationCollector())
     registry.register(SloCollector())
+    registry.register(StreamPlaneCollector())
 
 
 class ServeMetrics:

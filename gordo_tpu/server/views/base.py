@@ -724,11 +724,21 @@ def get_fleet_health(ctx, gordo_project: str):
             serving["store"] = STORE.revision_stats()
     except Exception:  # noqa: BLE001 - engine stats are advisory
         pass
+    # the streaming plane joins the console like device/programs — an
+    # injected live-process section (telemetry never imports the plane)
+    stream = None
+    try:
+        from ...stream import stream_plane_section
+
+        stream = stream_plane_section()
+    except Exception:  # noqa: BLE001 - plane stats are advisory
+        pass
     doc = fleet_status_document(
         directory,
         device=utilization_snapshot(),
         programs=programs,
         serving=serving,
+        stream=stream,
         machines=machines,
         limit=limit,
         offset=offset,

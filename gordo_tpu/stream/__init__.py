@@ -39,10 +39,16 @@ from .plane import (
     install_plane,
     reset_plane,
     stream_enabled,
+    stream_plane_section,
 )
 from .ring import EventRing, RowRing
 from .scorer import WindowScorer
 from .session import MachineChannel, StreamSession
+from .telemetry import (
+    StreamTelemetry,
+    reset_stream_telemetry,
+    stream_telemetry,
+)
 
 __all__ = [
     "EventRing",
@@ -54,6 +60,7 @@ __all__ = [
     "StreamEvent",
     "StreamPlane",
     "StreamSession",
+    "StreamTelemetry",
     "TERMINAL_KINDS",
     "WindowScorer",
     "encode_sse",
@@ -62,5 +69,8 @@ __all__ = [
     "heartbeat_frame",
     "install_plane",
     "reset_plane",
+    "reset_stream_telemetry",
     "stream_enabled",
+    "stream_plane_section",
+    "stream_telemetry",
 ]

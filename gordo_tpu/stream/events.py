@@ -36,6 +36,14 @@ subscription yields before the server closes the response cleanly — a
 consumer that sees EOF *without* one knows the connection died and
 should reconnect with its cursor.
 
+Idle subscriptions additionally receive **heartbeat comment frames**
+(``: keep-alive {"cursor": N, "pending": R}``) between events: not part
+of the event vocabulary (SSE ``:`` comments are invisible to spec
+parsers and never carry an ``id:``), but the payload lets an idle
+consumer watch its cursor and the rings' pending-row depth — rising
+``pending`` is backpressure building toward a ``shed`` — without
+polling the status route.
+
 >>> evt = StreamEvent("anomaly", {"machine": "m-1", "rows": 4})
 >>> print(encode_sse(3, evt), end="")
 id: 3
@@ -93,7 +101,23 @@ def encode_sse(seq: Optional[int], event: StreamEvent) -> str:
     return f"{head}event: {event.kind}\ndata: {payload}\n\n"
 
 
-def heartbeat_frame() -> str:
+def heartbeat_frame(
+    cursor: Optional[int] = None, pending_rows: Optional[int] = None
+) -> str:
     """An SSE comment frame: keeps idle connections alive through
-    proxies without advancing the consumer's cursor."""
-    return ": keep-alive\n\n"
+    proxies without advancing the consumer's cursor.
+
+    When the session knows them, the comment carries the subscriber's
+    ``cursor`` and the rings' total ``pending`` row depth — an idle
+    consumer observes backpressure building (pending climbing toward
+    the ring bound means shedding is next) without polling the status
+    route. Still a comment frame: parsers that ignore ``:`` lines per
+    the SSE spec are unaffected, and ``Last-Event-ID`` never advances.
+    """
+    if cursor is None and pending_rows is None:
+        return ": keep-alive\n\n"
+    payload = json.dumps(
+        {"cursor": cursor, "pending": pending_rows},
+        separators=(", ", ": "),
+    )
+    return f": keep-alive {payload}\n\n"

@@ -33,6 +33,7 @@ from ..utils.faults import FaultInjected, fault_point
 from .events import StreamEvent
 from .scorer import WindowScorer
 from .session import StreamSession
+from .telemetry import stream_telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -44,6 +45,7 @@ __all__ = [
     "get_plane",
     "reset_plane",
     "stream_enabled",
+    "stream_plane_section",
 ]
 
 STREAM_ENV = "GORDO_TPU_STREAM_ENABLED"
@@ -225,23 +227,44 @@ class StreamPlane:
         return the ingest ack: accepted/shed row counts, per-machine
         errors (decode errors passed in by the view + ``stream_ingest``
         fault-site hits), the flush summary, and the consumer cursor."""
+        from ..telemetry import serving as serve_trace
+
         errors = dict(errors or {})
         accepted: Dict[str, int] = {}
         shed: Dict[str, int] = {}
-        for name, frame in frames.items():
-            try:
-                fault_point(
-                    "stream_ingest", f"{session.stream_id}:{name}"
+        recorder = serve_trace.serve_recorder()
+        with recorder.span(
+            "stream_ingest",
+            stream=session.stream_id,
+            machines=len(frames),
+        ) as ingest_span:
+            for name, frame in frames.items():
+                try:
+                    fault_point(
+                        "stream_ingest", f"{session.stream_id}:{name}"
+                    )
+                except FaultInjected as exc:
+                    # one poisoned entry errors alone; the rest of the
+                    # machines' rows still land (fleet-route isolation)
+                    errors[name] = {"error": str(exc), "status": 500}
+                    continue
+                first_seq, shed_rows = session.append_rows(name, frame)
+                accepted[name] = int(len(frame))
+                if shed_rows:
+                    shed[name] = shed_rows
+            rows_accepted = sum(accepted.values())
+            ingest_span.set(
+                rows=rows_accepted,
+                shed=sum(shed.values()),
+                errors=len(errors),
+            )
+            # remember this span's identity so the flush that drains
+            # these rows can link back to it (ingest → flush → emit)
+            if ingest_span.span_id:
+                session.note_ingest_span(
+                    ingest_span.trace_id, ingest_span.span_id
                 )
-            except FaultInjected as exc:
-                # one poisoned entry errors alone; the rest of the
-                # machines' rows still land (fleet-route isolation)
-                errors[name] = {"error": str(exc), "status": 500}
-                continue
-            first_seq, shed_rows = session.append_rows(name, frame)
-            accepted[name] = int(len(frame))
-            if shed_rows:
-                shed[name] = shed_rows
+        stream_telemetry().observe_ingest(rows_accepted)
         flush = self.scorer.flush(session)
         with self._lock:
             self.counters["ingest_batches"] += 1
@@ -355,6 +378,7 @@ class StreamPlane:
                 for (project, stream_id), session in sorted(sessions.items())
             },
             "counters": counters,
+            "telemetry": stream_telemetry().snapshot(),
             "config": {
                 "ring_rows": self.config.ring_rows,
                 "window_rows": self.config.window_rows,
@@ -409,3 +433,76 @@ def reset_plane() -> None:
         plane, _plane = _plane, None
     if plane is not None:
         plane.drain()
+
+
+def stream_plane_section() -> Optional[Dict[str, Any]]:
+    """The streaming-plane section of the fleet-status console: session
+    counts, the summed zero-gap row accounting, freshness (score lag /
+    watermark delay) and the process-global flush/lag percentiles —
+    everything from this process's installed :class:`StreamPlane`.
+    None when no stream route has been hit here (a CLI process reading
+    somebody else's directory degrades exactly like the other injected
+    sections). Lives HERE rather than in ``telemetry/fleet_health.py``
+    because the layering arrows point down — callers inject it into
+    ``fleet_status_document(stream=...)`` like device/programs/serving."""
+    plane = get_plane()
+    if plane is None:
+        return None
+    stats = plane.stats()
+    sessions = stats.get("sessions") or {}
+    active = [s for s in sessions.values() if not s.get("closed")]
+    accounting = {
+        key: 0
+        for key in (
+            "rows_in",
+            "rows_scored",
+            "rows_failed",
+            "rows_pending",
+            "rows_shed",
+            "gap",
+        )
+    }
+    quarantined = 0
+    score_lags: List[float] = []
+    delays: List[float] = []
+    for session in sessions.values():
+        for key in accounting:
+            accounting[key] += int(
+                (session.get("accounting") or {}).get(key, 0)
+            )
+        lag = session.get("lag") or {}
+        if lag.get("score_lag_max_ms") is not None:
+            score_lags.append(float(lag["score_lag_max_ms"]))
+        if lag.get("watermark_delay_max_ms") is not None:
+            delays.append(float(lag["watermark_delay_max_ms"]))
+        quarantined += sum(
+            1
+            for machine in (session.get("machines") or {}).values()
+            if machine.get("quarantined")
+        )
+    telemetry = stats.get("telemetry") or {}
+    from ..telemetry.aggregate import histogram_percentile
+
+    return {
+        "enabled": stats.get("enabled"),
+        "draining": stats.get("draining"),
+        "sessions_active": len(active),
+        "sessions_closed": len(sessions) - len(active),
+        "subscribers": sum(
+            int(s.get("subscribers", 0)) for s in sessions.values()
+        ),
+        "quarantined_machines": quarantined,
+        "accounting": accounting,
+        "lag": {
+            "score_lag_max_ms": max(score_lags) if score_lags else None,
+            "watermark_delay_max_ms": max(delays) if delays else None,
+            "lag_p95_ms": histogram_percentile(
+                telemetry.get("lag_ms") or {}, 0.95
+            ),
+            "flush_p95_ms": histogram_percentile(
+                telemetry.get("flush_ms") or {}, 0.95
+            ),
+        },
+        "flushes": int(telemetry.get("flushes", 0)),
+        "counters": stats.get("counters"),
+    }

@@ -30,6 +30,7 @@ lock-ordering graph trivial).
 ([], 0)
 """
 
+import time
 from collections import deque
 from typing import Any, Deque, List, Optional, Tuple
 
@@ -45,14 +46,20 @@ class RowRing:
     Row sequence numbers are 1-based and monotonic for the life of the
     ring; they never reset, so a scored window's ``(first_seq,
     last_seq)`` span is a durable, gap-checkable coordinate.
+
+    Every chunk also carries the wall-clock instant it landed
+    (``ingest_ts``), preserved across partial sheds and partial takes,
+    so the scorer can compute ingest→scored lag per flush and the
+    status surfaces can report watermark delay (``now - oldest_ts``)
+    without a side table.
     """
 
     __slots__ = ("capacity", "_chunks", "_pending", "_next_seq", "shed_rows")
 
     def __init__(self, capacity: int):
         self.capacity = max(1, int(capacity))
-        #: deque of (first_seq, chunk) in arrival order
-        self._chunks: Deque[Tuple[int, Any]] = deque()
+        #: deque of (first_seq, ingest_ts, chunk) in arrival order
+        self._chunks: Deque[Tuple[int, float, Any]] = deque()
         self._pending = 0
         self._next_seq = 1
         self.shed_rows = 0
@@ -66,6 +73,12 @@ class RowRing:
         """Sequence number the next appended row will receive."""
         return self._next_seq
 
+    @property
+    def oldest_ts(self) -> Optional[float]:
+        """Ingest wall-clock of the oldest buffered row (None when
+        empty) — the watermark-delay anchor."""
+        return self._chunks[0][1] if self._chunks else None
+
     @staticmethod
     def _slice(chunk: Any, start: int, stop: Optional[int] = None) -> Any:
         iloc = getattr(chunk, "iloc", None)
@@ -73,16 +86,24 @@ class RowRing:
             return iloc[start:stop]
         return chunk[start:stop]
 
-    def append(self, chunk: Any) -> Tuple[int, int]:
+    def append(
+        self, chunk: Any, ingest_ts: Optional[float] = None
+    ) -> Tuple[int, int]:
         """Land ``chunk`` rows; returns ``(first_seq, rows_shed)``.
 
         Shedding is oldest-first: when the ring would exceed capacity the
         oldest buffered rows are dropped (counted in :attr:`shed_rows`)
         until the new chunk fits. A chunk taller than the whole ring
         keeps only its newest ``capacity`` rows — the bound is absolute.
+
+        ``ingest_ts`` (default: now) is retained with the chunk; a
+        partially-shed chunk keeps its original stamp — the surviving
+        rows arrived when the chunk arrived.
         """
         rows = int(len(chunk))
         first_seq = self._next_seq
+        if ingest_ts is None:
+            ingest_ts = time.time()
         if rows == 0:
             return first_seq, 0
         shed = 0
@@ -97,16 +118,18 @@ class RowRing:
                 shed += overflow
                 chunk = self._slice(chunk, overflow)
             self._next_seq += rows
-            self._chunks.append((self._next_seq - self.capacity, chunk))
+            self._chunks.append(
+                (self._next_seq - self.capacity, ingest_ts, chunk)
+            )
             self._pending = self.capacity
             self.shed_rows += shed
             return first_seq, shed
         self._next_seq += rows
-        self._chunks.append((first_seq, chunk))
+        self._chunks.append((first_seq, ingest_ts, chunk))
         self._pending += rows
         while self._pending > self.capacity:
             over = self._pending - self.capacity
-            oldest_seq, oldest = self._chunks[0]
+            oldest_seq, oldest_ts, oldest = self._chunks[0]
             if len(oldest) <= over:
                 self._chunks.popleft()
                 self._pending -= len(oldest)
@@ -114,6 +137,7 @@ class RowRing:
             else:
                 self._chunks[0] = (
                     oldest_seq + over,
+                    oldest_ts,
                     self._slice(oldest, over),
                 )
                 self._pending -= over
@@ -121,18 +145,23 @@ class RowRing:
         self.shed_rows += shed
         return first_seq, shed
 
-    def take(self, rows: int) -> Optional[Tuple[List[Any], int, int]]:
+    def take(
+        self, rows: int
+    ) -> Optional[Tuple[List[Any], int, int, float]]:
         """Pop the oldest ``rows`` buffered rows, or None if fewer are
-        pending. Returns ``(chunks, first_seq, last_seq)`` — the chunk
-        list concatenates (in order) to exactly ``rows`` rows."""
+        pending. Returns ``(chunks, first_seq, last_seq, oldest_ts)`` —
+        the chunk list concatenates (in order) to exactly ``rows`` rows
+        and ``oldest_ts`` is the ingest wall-clock of the oldest row
+        taken (``now - oldest_ts`` is this take's ingest→scored lag)."""
         rows = int(rows)
         if rows <= 0 or self._pending < rows:
             return None
         first_seq = self._chunks[0][0]
+        oldest_ts = self._chunks[0][1]
         out: List[Any] = []
         needed = rows
         while needed > 0:
-            chunk_seq, chunk = self._chunks.popleft()
+            chunk_seq, chunk_ts, chunk = self._chunks.popleft()
             if len(chunk) <= needed:
                 out.append(chunk)
                 needed -= len(chunk)
@@ -140,11 +169,11 @@ class RowRing:
             else:
                 out.append(self._slice(chunk, 0, needed))
                 self._chunks.appendleft(
-                    (chunk_seq + needed, self._slice(chunk, needed))
+                    (chunk_seq + needed, chunk_ts, self._slice(chunk, needed))
                 )
                 self._pending -= needed
                 needed = 0
-        return out, first_seq, first_seq + rows - 1
+        return out, first_seq, first_seq + rows - 1, oldest_ts
 
 
 class EventRing:

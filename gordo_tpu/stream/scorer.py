@@ -32,15 +32,24 @@ Robustness properties, in the order they bite:
   and honest ``rows_failed`` accounting. Client-data failures
   (ValueError/TypeError) never count against the member's breaker.
 
-Observability: one ``stream_score`` span per flush on the shared serving
-recorder, a batch-wise fleet-health ledger feed (rows + rolling residual
-mean + request marks — the stream twin of the fleet route's feed), and
-an optional drift monitor fed ``observe_scores`` so lifecycle drift
-detection runs off streaming traffic, not just sampled HTTP requests.
+Observability: one enriched ``stream_score`` span per flush on the
+shared serving recorder — rows/windows/shed, per-machine ingest→scored
+lag (p50/max plus a rows-weighted fixed-bucket histogram the rollups
+merge), ``predicted_device_ms`` vs ``device_ms`` (the engine's
+plan-accuracy axis, extended to flushes), and OTel links back to the
+``stream_ingest`` spans the flush drained — followed by a
+``stream_emit`` span timing the event fan-out, the process-global
+stream telemetry accumulator (``telemetry.py`` → the Prometheus
+``StreamPlaneCollector``), a batch-wise fleet-health ledger feed (rows
++ rolling residual mean + request marks — the stream twin of the fleet
+route's feed), and an optional drift monitor fed ``observe_scores`` so
+lifecycle drift detection runs off streaming traffic, not just sampled
+HTTP requests.
 """
 
 import logging
 import os
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -48,6 +57,7 @@ import numpy as np
 from ..utils.faults import fault_point
 from .events import StreamEvent
 from .session import StreamSession
+from .telemetry import lag_bucket_counts, stream_telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -77,6 +87,10 @@ class WindowScorer:
         #: ``StreamPlane.attach_drift`` so this package never imports
         #: ``gordo_tpu.lifecycle``
         self.drift_monitor = drift_monitor
+        #: cost-model device-ms predictions cached per (spec, members,
+        #: rows) — the engine's ``_predicted_step_ms`` pattern; flushes
+        #: run at watermark rates, the estimator is pure arithmetic
+        self._step_predictions: Dict[Any, float] = {}
 
     # -- plumbing ------------------------------------------------------------
 
@@ -127,6 +141,56 @@ class WindowScorer:
 
         return pd.concat(chunks)
 
+    def _predicted_step_ms(self, spec: Any, members: int, rows: int) -> float:
+        """Cost-model device milliseconds for one fused spec group at
+        this flush's shape (f32 — the stream path's width), cached per
+        shape like the serve engine's batch predictions. -1.0 when the
+        estimator is unavailable (the sentinel the plan-accuracy
+        consumers already skip)."""
+        key = (spec, members, rows)
+        cached = self._step_predictions.get(key)
+        if cached is None:
+            try:
+                from ..planner.costmodel import CostModel
+
+                cached = round(
+                    CostModel().predict_serve_step_s(
+                        spec, members, rows, "f32"
+                    )
+                    * 1000.0,
+                    4,
+                )
+            except Exception:  # noqa: BLE001 - prediction is telemetry,
+                # never the flush's problem
+                cached = -1.0
+            if len(self._step_predictions) > 4096:
+                self._step_predictions.clear()
+            self._step_predictions[key] = cached
+        return cached
+
+    def _predicted_flush_ms(
+        self, specs: Dict[str, Any], inputs: Dict[str, Any]
+    ) -> float:
+        """Predicted device-ms for the whole flush: the per-spec fused
+        groups ``fleet_scores`` will actually run, summed. Members whose
+        spec bucket could not be resolved (the breaker-fallback string)
+        are unpredictable — a flush made only of those reports -1.0."""
+        groups: Dict[Any, List[int]] = {}
+        for name, frame in inputs.items():
+            spec = specs.get(name)
+            if spec is None or isinstance(spec, str):
+                continue
+            groups.setdefault(spec, []).append(int(len(frame)))
+        total = 0.0
+        for spec, row_counts in groups.items():
+            predicted = self._predicted_step_ms(
+                spec, len(row_counts), max(row_counts)
+            )
+            if predicted < 0.0:
+                return -1.0
+            total += predicted
+        return round(total, 4) if groups else -1.0
+
     # -- the flush -----------------------------------------------------------
 
     def flush(self, session: StreamSession) -> Dict[str, Any]:
@@ -176,6 +240,7 @@ class WindowScorer:
             name: round(retry, 3) for name, retry in quarantined.items()
         }
 
+        flush_started = time.time()
         cut = session.cut_windows(self.window_rows, skip=tuple(quarantined))
         if not cut:
             return summary
@@ -183,8 +248,18 @@ class WindowScorer:
         inputs: Dict[str, Any] = {}
         spans: Dict[str, Tuple[int, int, int]] = {}
         injected: Dict[str, BaseException] = {}
-        for name, (chunks, first_seq, last_seq, windows) in cut.items():
+        lags_ms: Dict[str, float] = {}
+        total_windows = 0
+        for name, (chunks, first_seq, last_seq, windows, oldest_ts) in (
+            cut.items()
+        ):
             spans[name] = (first_seq, last_seq, windows)
+            total_windows += windows
+            # ingest→scored lag of this machine's span, anchored on its
+            # OLDEST row: the freshness number a consumer experiences
+            lags_ms[name] = round(
+                max(0.0, flush_started - oldest_ts) * 1000.0, 3
+            )
             try:
                 fault_point(
                     "stream_score", f"{session.stream_id}:{name}"
@@ -196,18 +271,68 @@ class WindowScorer:
 
         recorder = serve_trace.serve_recorder()
         total_rows = sum(int(len(x)) for x in inputs.values())
+        shed_rows = session.shed_delta()
+        lag_values = sorted(lags_ms.values())
+        lag_p50 = (
+            lag_values[len(lag_values) // 2] if lag_values else 0.0
+        )
+        lag_max = lag_values[-1] if lag_values else 0.0
+        # rows-weighted lag distribution over every machine drained this
+        # flush, binned into the shared fixed edges — the compact shape
+        # rollups merge to answer "what fraction of rows scored fresh"
+        cut_names = list(spans)
+        cut_weights = [spans[n][1] - spans[n][0] + 1 for n in cut_names]
+        lag_hist = lag_bucket_counts(
+            [lags_ms.get(n, 0.0) for n in cut_names],
+            weights=cut_weights,
+        )
+        lag_sum_ms = round(
+            sum(
+                lags_ms.get(n, 0.0) * weight
+                for n, weight in zip(cut_names, cut_weights)
+            ),
+            3,
+        )
         with recorder.span(
             "stream_score",
             stream=session.stream_id,
             machines=len(inputs),
             rows=total_rows,
+            windows=total_windows,
+            shed=shed_rows,
             revision=revision,
-        ):
+            lag_p50_ms=lag_p50,
+            lag_max_ms=lag_max,
+            lag_hist=lag_hist,
+            lag_sum_ms=lag_sum_ms,
+            predicted_device_ms=self._predicted_flush_ms(specs, inputs),
+        ) as score_span:
+            # the OTel links tie this flush back to the ingest exchanges
+            # it drained (the serve engine's batch-link pattern): a
+            # trace reader can walk ingest → flush → emit
+            for trace_id, ingest_span_id in session.drain_ingest_spans():
+                score_span.link(trace_id, ingest_span_id)
+            device_started = time.monotonic()
             scores, errors = (
                 fleet.fleet_scores(inputs) if inputs else ({}, {})
             )
+            device_s = time.monotonic() - device_started
+            # the scored/failed row split is stamped on the span itself
+            # so rollups reconstruct the plane's zero-gap accounting
+            # from traces alone (rows == rows_scored + rows_failed)
+            score_span.set(
+                device_ms=round(device_s * 1000.0, 3),
+                rows_scored=sum(int(len(inputs[n])) for n in scores),
+                rows_failed=sum(
+                    spans[n][1] - spans[n][0] + 1
+                    for n in set(errors) | set(injected)
+                ),
+            )
         errors.update(injected)
 
+        emit_started = time.monotonic()
+        events_emitted = 0
+        scored_ts = time.time()
         for name, (reconstruction, mse) in scores.items():
             first_seq, last_seq, windows = spans[name]
             rows = int(len(inputs[name]))
@@ -216,10 +341,13 @@ class WindowScorer:
             chan = session.channel(name)
             chan.rows_scored += rows
             chan.windows_scored += windows
+            chan.last_score_lag_ms = lags_ms.get(name)
+            chan.last_scored_ts = scored_ts
             board.record_success(fleet, specs.get(name, FALLBACK_SPEC), name)
             if chan.quarantine_notified:
                 chan.quarantine_notified = False
                 session.emit(StreamEvent("recovered", {"machine": name}))
+                events_emitted += 1
             session.emit(
                 StreamEvent(
                     "anomaly",
@@ -239,15 +367,18 @@ class WindowScorer:
                     },
                 )
             )
+            events_emitted += 1
             summary["scored"][name] = rows
             summary["rows"] += rows
 
+        failed_rows = 0
         for name, exc in errors.items():
             first_seq, last_seq, _windows = spans[name]
             rows = last_seq - first_seq + 1
             chan = session.channel(name)
             chan.score_errors += 1
             chan.rows_failed += rows
+            failed_rows += rows
             # client-data failures are not the member's health problem —
             # same classification as the fleet route's ledger feed
             server_side = not isinstance(
@@ -268,7 +399,32 @@ class WindowScorer:
                     },
                 )
             )
+            events_emitted += 1
             summary["errors"][name] = type(exc).__name__
+
+        # the emit phase as an externally-timed span: with the ingest
+        # links above, `gordo-tpu trace` can lay out the stream critical
+        # path (ingest → flush/device → emit) per session
+        recorder.record(
+            "stream_emit",
+            max(0.0, time.monotonic() - emit_started),
+            stream=session.stream_id,
+            events=events_emitted,
+            machines=len(scores) + len(errors),
+        )
+
+        flush_s = max(0.0, time.time() - flush_started)
+        scored_names = list(scores)
+        stream_telemetry().observe_flush(
+            flush_s,
+            rows_scored=summary["rows"],
+            rows_failed=failed_rows,
+            rows_shed=shed_rows,
+            lags_ms=[lags_ms.get(n, 0.0) for n in scored_names],
+            lag_weights=[summary["scored"][n] for n in scored_names],
+        )
+        summary["lag_p50_ms"] = lag_p50
+        summary["lag_max_ms"] = lag_max
 
         self._feed_ledger(session, inputs, scores, errors)
         self._feed_drift(inputs, scores)

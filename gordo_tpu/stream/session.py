@@ -52,6 +52,8 @@ class MachineChannel:
         "windows_scored",
         "score_errors",
         "quarantine_notified",
+        "last_score_lag_ms",
+        "last_scored_ts",
     )
 
     def __init__(self, name: str, ring_rows: int):
@@ -66,8 +68,14 @@ class MachineChannel:
         #: ``recovered`` frame — dedupes per-window quarantine noise and
         #: tells a fresh subscription to replay the notice immediately
         self.quarantine_notified = False
+        #: ingest→scored wall-clock lag of this machine's most recent
+        #: flush (None until the first window scores) and when it scored
+        #: — the status route's per-machine freshness view
+        self.last_score_lag_ms: Optional[float] = None
+        self.last_scored_ts: Optional[float] = None
 
     def stats(self) -> Dict[str, Any]:
+        oldest_ts = self.ring.oldest_ts
         return {
             "rows_in": self.rows_in,
             "rows_scored": self.rows_scored,
@@ -77,6 +85,12 @@ class MachineChannel:
             "windows_scored": self.windows_scored,
             "score_errors": self.score_errors,
             "quarantined": self.quarantine_notified,
+            "last_score_lag_ms": self.last_score_lag_ms,
+            "watermark_delay_ms": (
+                None
+                if oldest_ts is None
+                else round(max(0.0, time.time() - oldest_ts) * 1000.0, 3)
+            ),
         }
 
 
@@ -108,6 +122,14 @@ class StreamSession:
         self.emit_dropped = 0
         #: emit-site drops not yet surfaced as a ``shed`` frame
         self._emit_shed_pending = 0
+        #: (trace_id, span_id) of recent ``stream_ingest`` spans not yet
+        #: claimed by a flush — the scorer links its ``stream_score``
+        #: span back to the ingests it drained (the batch-link pattern).
+        #: Bounded: a stalled scorer must not grow this without limit.
+        self._ingest_spans: List[Tuple[str, str]] = []
+        #: rows_shed total already reported via :meth:`shed_delta` —
+        #: keeps per-flush span/rollup shed attrs additive
+        self._shed_reported = 0
 
     # -- ingest side ---------------------------------------------------------
 
@@ -154,6 +176,33 @@ class StreamSession:
             )
         return first_seq, shed
 
+    def shed_delta(self) -> int:
+        """Ring-shed rows since the last call — the per-flush ``shed``
+        attribute on ``stream_score`` spans (deltas, not cumulative
+        totals, so rollups can sum spans without double counting)."""
+        with self._wake:
+            total = sum(
+                chan.ring.shed_rows for chan in self.channels.values()
+            )
+            delta = total - self._shed_reported
+            self._shed_reported = total
+            return max(0, delta)
+
+    def note_ingest_span(self, trace_id: str, span_id: str) -> None:
+        """Remember an ingest span's context for the next flush's OTel
+        links (oldest dropped past a small bound)."""
+        with self._wake:
+            self._ingest_spans.append((trace_id, span_id))
+            if len(self._ingest_spans) > 64:
+                del self._ingest_spans[:-64]
+
+    def drain_ingest_spans(self) -> List[Tuple[str, str]]:
+        """Claim (and clear) the ingest-span contexts accumulated since
+        the last flush."""
+        with self._wake:
+            spans, self._ingest_spans = self._ingest_spans, []
+            return spans
+
     def latest_seq(self) -> int:
         """The consumer cursor that would catch everything emitted so
         far (the ingest ack's ``cursor`` field)."""
@@ -176,15 +225,17 @@ class StreamSession:
 
     def cut_windows(
         self, window_rows: int, skip: Sequence[str] = ()
-    ) -> Dict[str, Tuple[List[Any], int, int, int]]:
+    ) -> Dict[str, Tuple[List[Any], int, int, int, float]]:
         """Pop every full watermark window: ``{machine: (chunks,
-        first_seq, last_seq, windows)}``. Multiple pending windows for a
-        machine come out as ONE contiguous span (scored in one fused
-        call, counted as ``windows``). Machines in ``skip`` (quarantined
+        first_seq, last_seq, windows, oldest_ts)}``. Multiple pending
+        windows for a machine come out as ONE contiguous span (scored in
+        one fused call, counted as ``windows``); ``oldest_ts`` is the
+        ingest wall-clock of the span's oldest row — the flush's
+        ingest→scored lag anchor. Machines in ``skip`` (quarantined
         members) keep their rows buffered — their ring keeps absorbing
         (and, under pressure, shedding oldest-first) until the breaker's
         half-open probe lets scoring resume."""
-        out: Dict[str, Tuple[List[Any], int, int, int]] = {}
+        out: Dict[str, Tuple[List[Any], int, int, int, float]] = {}
         with self._wake:
             for name, chan in self.channels.items():
                 if name in skip:
@@ -195,8 +246,8 @@ class StreamSession:
                 taken = chan.ring.take(windows * window_rows)
                 if taken is None:  # pragma: no cover - guarded by the //
                     continue
-                chunks, first_seq, last_seq = taken
-                out[name] = (chunks, first_seq, last_seq, windows)
+                chunks, first_seq, last_seq, oldest_ts = taken
+                out[name] = (chunks, first_seq, last_seq, windows, oldest_ts)
         return out
 
     # -- emit side -----------------------------------------------------------
@@ -308,6 +359,10 @@ class StreamSession:
                         self._wake.wait(timeout=heartbeat_s)
                         batch, missed = self.outbox.since(cursor)
                     session_closed = self.closed
+                    pending_rows = sum(
+                        chan.ring.pending_rows
+                        for chan in self.channels.values()
+                    )
                 if missed:
                     # the consumer was slower than the outbox ring (or
                     # reconnected with an evicted cursor): say so, then
@@ -330,7 +385,12 @@ class StreamSession:
                         and time.monotonic() - idle_since >= idle_timeout_s
                     ):
                         return
-                    yield heartbeat_frame()
+                    # heartbeats carry the consumer's cursor and the
+                    # rings' pending-row depth: an idle consumer watches
+                    # backpressure build without polling the status route
+                    yield heartbeat_frame(
+                        cursor=cursor, pending_rows=pending_rows
+                    )
                     continue
                 for seq, event in batch:
                     cursor = seq
@@ -352,7 +412,47 @@ class StreamSession:
             machines = {
                 name: chan.stats() for name, chan in self.channels.items()
             }
+            lags = sorted(
+                stats["last_score_lag_ms"]
+                for stats in machines.values()
+                if stats["last_score_lag_ms"] is not None
+            )
+            delays = [
+                stats["watermark_delay_ms"]
+                for stats in machines.values()
+                if stats["watermark_delay_ms"] is not None
+            ]
+            lag_summary = {
+                "score_lag_p50_ms": (
+                    lags[len(lags) // 2] if lags else None
+                ),
+                "score_lag_max_ms": lags[-1] if lags else None,
+                "watermark_delay_max_ms": (
+                    max(delays) if delays else None
+                ),
+            }
+            accounting = {
+                key: sum(stats[key] for stats in machines.values())
+                for key in (
+                    "rows_in",
+                    "rows_scored",
+                    "rows_failed",
+                    "rows_pending",
+                    "rows_shed",
+                )
+            }
+            # the zero-gap invariant, checked live: every ingested row
+            # is scored, failed, pending, or honestly shed — nonzero
+            # here is a bug, not load
+            accounting["gap"] = accounting["rows_in"] - (
+                accounting["rows_scored"]
+                + accounting["rows_failed"]
+                + accounting["rows_pending"]
+                + accounting["rows_shed"]
+            )
             return {
+                "lag": lag_summary,
+                "accounting": accounting,
                 "stream": self.stream_id,
                 "project": self.project,
                 "closed": self.closed,

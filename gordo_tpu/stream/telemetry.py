@@ -1,0 +1,163 @@
+"""
+Process-global streaming-plane telemetry accumulator.
+
+The streaming plane's hot paths (ingest POSTs, watermark flushes) are
+lock-striped per session; Prometheus scrapes and the status routes are
+not on those paths. This module is the meeting point: ingest and the
+scorer fold their observations into ONE process-global accumulator
+under a dedicated lock (never held while scoring), and the scrape-time
+``StreamPlaneCollector`` (``server/prometheus/metrics.py``) plus
+``/stream/status`` read a consistent snapshot.
+
+Cardinality is bounded by construction (the PR 8/9 exposition
+contract): totals and two fixed-bucket histograms — flush duration and
+ingest→scored lag — with NO per-machine or per-stream labels. The
+per-machine detail lives on the status route and in the span trace,
+where cardinality is the reader's choice, not the scrape's.
+
+The histograms share ``telemetry.aggregate``'s fixed latency edges so
+a scrape-side bucket and a rollup-side bucket always mean the same
+thing.
+"""
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+__all__ = [
+    "StreamTelemetry",
+    "stream_telemetry",
+    "reset_stream_telemetry",
+    "lag_bucket_counts",
+]
+
+
+def _lag_edges() -> List[float]:
+    from ..telemetry.aggregate import LATENCY_BUCKETS_MS
+
+    return list(LATENCY_BUCKETS_MS)
+
+
+def lag_bucket_counts(
+    lags_ms: Sequence[float], weights: Optional[Sequence[int]] = None
+) -> List[int]:
+    """Bucket ``lags_ms`` observations (optionally row-weighted) into
+    the shared fixed edges; the trailing slot is the overflow bucket.
+    This is the compact per-flush shape ``stream_score`` spans carry so
+    rollups keep a true rows-under-threshold distribution without
+    hauling per-machine lists around."""
+    edges = _lag_edges()
+    counts = [0] * (len(edges) + 1)
+    for i, value in enumerate(lags_ms):
+        weight = int(weights[i]) if weights is not None else 1
+        slot = len(edges)
+        for j, edge in enumerate(edges):
+            if value <= edge:
+                slot = j
+                break
+        counts[slot] += weight
+    return counts
+
+
+class _Histogram:
+    """Fixed-bucket histogram (count/sum + overflow slot), guarded by
+    the owning accumulator's lock."""
+
+    __slots__ = ("edges", "counts", "count", "sum_value")
+
+    def __init__(self, edges: Sequence[float]):
+        self.edges = list(edges)
+        self.counts = [0] * (len(self.edges) + 1)
+        self.count = 0
+        self.sum_value = 0.0
+
+    def add(self, value: float, weight: int = 1) -> None:
+        slot = len(self.edges)
+        for i, edge in enumerate(self.edges):
+            if value <= edge:
+                slot = i
+                break
+        self.counts[slot] += weight
+        self.count += weight
+        self.sum_value += value * weight
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "buckets_ms": list(self.edges),
+            "counts": list(self.counts),
+            "count": self.count,
+            "sum_ms": round(self.sum_value, 3),
+        }
+
+
+class StreamTelemetry:
+    """Counters + histograms for one process's streaming plane."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows_in = 0
+        self.rows_scored = 0
+        self.rows_failed = 0
+        self.rows_shed = 0
+        self.flushes = 0
+        self.ingest_batches = 0
+        self._flush_ms = _Histogram(_lag_edges())
+        self._lag_ms = _Histogram(_lag_edges())
+
+    def observe_ingest(self, rows: int, batches: int = 1) -> None:
+        with self._lock:
+            self.rows_in += int(rows)
+            self.ingest_batches += int(batches)
+
+    def observe_flush(
+        self,
+        duration_s: float,
+        rows_scored: int,
+        rows_failed: int,
+        rows_shed: int,
+        lags_ms: Sequence[float] = (),
+        lag_weights: Optional[Sequence[int]] = None,
+    ) -> None:
+        """One watermark flush: wall duration, the accounting deltas,
+        and the per-machine ingest→scored lags (row-weighted when
+        weights are given, so the lag histogram answers "what fraction
+        of ROWS scored fresh", not "what fraction of machines")."""
+        with self._lock:
+            self.flushes += 1
+            self.rows_scored += int(rows_scored)
+            self.rows_failed += int(rows_failed)
+            self.rows_shed += int(rows_shed)
+            self._flush_ms.add(duration_s * 1000.0)
+            for i, lag in enumerate(lags_ms):
+                weight = (
+                    int(lag_weights[i]) if lag_weights is not None else 1
+                )
+                self._lag_ms.add(float(lag), weight)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "rows_in": self.rows_in,
+                "rows_scored": self.rows_scored,
+                "rows_failed": self.rows_failed,
+                "rows_shed": self.rows_shed,
+                "flushes": self.flushes,
+                "ingest_batches": self.ingest_batches,
+                "flush_ms": self._flush_ms.snapshot(),
+                "lag_ms": self._lag_ms.snapshot(),
+            }
+
+
+_telemetry = StreamTelemetry()
+_telemetry_lock = threading.Lock()
+
+
+def stream_telemetry() -> StreamTelemetry:
+    return _telemetry
+
+
+def reset_stream_telemetry() -> StreamTelemetry:
+    """Fresh accumulator (tests, post-fork, bench phases)."""
+    global _telemetry
+    with _telemetry_lock:
+        _telemetry = StreamTelemetry()
+        return _telemetry

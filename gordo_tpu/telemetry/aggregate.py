@@ -80,7 +80,16 @@ LATENCY_BUCKETS_MS: Tuple[float, ...] = (
 )
 
 #: span names that are never request *stages* (see trace_analysis)
-_NON_STAGE_NAMES = frozenset(("request", "profile", "serve_batch"))
+_NON_STAGE_NAMES = frozenset(
+    (
+        "request",
+        "profile",
+        "serve_batch",
+        "stream_ingest",
+        "stream_score",
+        "stream_emit",
+    )
+)
 
 
 def window_seconds() -> int:
@@ -374,7 +383,26 @@ def _empty_rollup(start: int, seconds: int) -> Dict[str, Any]:
         "stages": {},
         "machines": {},
         "build": {"device_programs": 0, "compiles": 0, "phases": {}},
+        "stream": _empty_stream_section(),
         "spans": 0,
+    }
+
+
+def _empty_stream_section() -> Dict[str, Any]:
+    """The streaming-plane rollup section: row accounting, flush count,
+    flush-duration and rows-weighted ingest→scored lag histograms —
+    folded from ``stream_score`` spans, merged like everything else, and
+    read by the stream SLOs (freshness = lag_ms fraction under
+    threshold, integrity = non-shed/non-failed row fraction)."""
+    return {
+        "rows_in": 0,
+        "rows_scored": 0,
+        "rows_failed": 0,
+        "rows_shed": 0,
+        "flushes": 0,
+        "windows": 0,
+        "flush_ms": new_histogram(),
+        "lag_ms": new_histogram(),
     }
 
 
@@ -406,6 +434,22 @@ def merge_rollups(into: Dict[str, Any], other: Dict[str, Any]) -> Dict[str, Any]
     build["compiles"] += int(other_build.get("compiles", 0))
     for phase, count in (other_build.get("phases") or {}).items():
         build["phases"][phase] = build["phases"].get(phase, 0) + int(count)
+    stream = into.setdefault("stream", _empty_stream_section())
+    other_stream = other.get("stream")
+    if other_stream:  # pre-upgrade rollups have no stream section
+        for key in (
+            "rows_in",
+            "rows_scored",
+            "rows_failed",
+            "rows_shed",
+            "flushes",
+            "windows",
+        ):
+            stream[key] += int(other_stream.get(key, 0))
+        if other_stream.get("flush_ms"):
+            histogram_merge(stream["flush_ms"], other_stream["flush_ms"])
+        if other_stream.get("lag_ms"):
+            histogram_merge(stream["lag_ms"], other_stream["lag_ms"])
     into["spans"] = int(into.get("spans", 0)) + int(other.get("spans", 0))
     return into
 
@@ -426,6 +470,9 @@ def _fold_span(rollup: Dict[str, Any], kind: str, span: Dict[str, Any]) -> None:
             build["phases"][phase] = build["phases"].get(phase, 0) + 1
         return
     if span.get("kind") == "event":
+        return
+    if name in ("stream_ingest", "stream_score"):
+        _fold_stream_span(rollup, name, span, duration_ms)
         return
     if name == "request":
         attributes = span.get("attributes") or {}
@@ -452,6 +499,47 @@ def _fold_span(rollup: Dict[str, Any], kind: str, span: Dict[str, Any]) -> None:
     elif name not in _NON_STAGE_NAMES and span.get("parent_id"):
         stage = rollup["stages"].setdefault(name, new_histogram())
         histogram_add(stage, duration_ms)
+
+
+def _fold_stream_span(
+    rollup: Dict[str, Any],
+    name: str,
+    span: Dict[str, Any],
+    duration_ms: float,
+) -> None:
+    """Fold one streaming-plane span into the rollup's ``stream``
+    section. ``stream_ingest`` contributes row arrivals; ``stream_score``
+    (one per flush) contributes the scored/failed/shed split, the flush
+    duration, and its pre-binned rows-weighted lag histogram — the
+    per-span ``lag_hist`` shares :data:`LATENCY_BUCKETS_MS`, so the
+    fold is an elementwise add, no re-binning."""
+    stream = rollup.setdefault("stream", _empty_stream_section())
+    attributes = span.get("attributes") or {}
+    if name == "stream_ingest":
+        stream["rows_in"] += int(attributes.get("rows", 0) or 0)
+        return
+    stream["flushes"] += 1
+    stream["windows"] += int(attributes.get("windows", 0) or 0)
+    scored = attributes.get("rows_scored")
+    if scored is None:  # early-exit flushes never stamp the split
+        scored = attributes.get("rows", 0)
+    stream["rows_scored"] += int(scored or 0)
+    stream["rows_failed"] += int(attributes.get("rows_failed", 0) or 0)
+    stream["rows_shed"] += int(attributes.get("shed", 0) or 0)
+    histogram_add(stream["flush_ms"], duration_ms)
+    lag = stream["lag_ms"]
+    counts = attributes.get("lag_hist")
+    if (
+        isinstance(counts, (list, tuple))
+        and len(counts) == len(lag["counts"])
+    ):
+        folded = 0
+        for i, count in enumerate(counts):
+            count = int(count or 0)
+            lag["counts"][i] += count
+            folded += count
+        lag["count"] += folded
+        lag["sum_ms"] += float(attributes.get("lag_sum_ms", 0.0) or 0.0)
 
 
 class RollupStore:
@@ -1025,6 +1113,24 @@ def summarize_rollup(rollup: Dict[str, Any]) -> Dict[str, Any]:
         }
         for name, counts in sorted((rollup.get("machines") or {}).items())
     }
+    stream = rollup.get("stream") or _empty_stream_section()
+    stream_lag = stream.get("lag_ms") or new_histogram()
+    stream_summary = {
+        "rows_in": int(stream.get("rows_in", 0)),
+        "rows_scored": int(stream.get("rows_scored", 0)),
+        "rows_failed": int(stream.get("rows_failed", 0)),
+        "rows_shed": int(stream.get("rows_shed", 0)),
+        "flushes": int(stream.get("flushes", 0)),
+        "windows": int(stream.get("windows", 0)),
+        "flush_p50_ms": histogram_percentile(
+            stream.get("flush_ms") or new_histogram(), 0.50
+        ),
+        "flush_p95_ms": histogram_percentile(
+            stream.get("flush_ms") or new_histogram(), 0.95
+        ),
+        "lag_p50_ms": histogram_percentile(stream_lag, 0.50),
+        "lag_p95_ms": histogram_percentile(stream_lag, 0.95),
+    }
     return {
         "requests": count,
         "errors": errors,
@@ -1035,5 +1141,6 @@ def summarize_rollup(rollup: Dict[str, Any]) -> Dict[str, Any]:
         "stages": stages,
         "machines": machines,
         "build": rollup.get("build"),
+        "stream": stream_summary,
         "spans": rollup.get("spans", 0),
     }

@@ -337,6 +337,51 @@ GATES: Dict[str, List[MetricSpec]] = {
             "drain.clean_terminals",
             "truthy",
         ),
+        # -- the streaming-observability acceptance set (PR 18) ---------
+        # span telemetry on the flush path, interleaved quiet floors:
+        # the always-on plane must not pay a visible tax for its own
+        # observability
+        MetricSpec(
+            "stream telemetry soak overhead (%)",
+            "telemetry.overhead_pct",
+            "max_bound",
+            bound=2.0,
+        ),
+        # freshness under sustained load: the soak's row-weighted
+        # ingest-to-scored lag p95, an absolute budget well under the
+        # packaged 5s freshness SLO threshold
+        MetricSpec(
+            "soak ingest-to-scored lag p95 budget (ms)",
+            "soak.lag_p95_ms",
+            "max_bound",
+            bound=2000.0,
+        ),
+        # the freshness SLO drill: an injected stream_score stall must
+        # walk the alert pending -> firing (the page-severity predicate
+        # that holds lifecycle auto-promotion) and resolve on recovery
+        MetricSpec(
+            "freshness drill: stall -> pending -> firing -> resolved",
+            "slo_drill.drill_ok",
+            "truthy",
+        ),
+        MetricSpec(
+            "freshness firing held the canary promotion gate",
+            "slo_drill.held_promotion",
+            "truthy",
+        ),
+        # the scrape surface must stay a small constant at 10k members:
+        # per-machine detail belongs to /stream/status and the trace
+        MetricSpec(
+            "stream scrape surface bounded at 10k members",
+            "prometheus.bounded",
+            "truthy",
+        ),
+        MetricSpec(
+            "stream scrape samples at 10k members",
+            "prometheus.samples",
+            "max_bound",
+            bound=100.0,
+        ),
     ],
     "slo-engine": [
         MetricSpec(

@@ -1663,6 +1663,7 @@ def fleet_status_document(
     device: Optional[Dict[str, Any]] = None,
     programs: Optional[Dict[str, Any]] = None,
     serving: Optional[Dict[str, Any]] = None,
+    stream: Optional[Dict[str, Any]] = None,
     machines: Union[None, str, Iterable[str]] = None,
     limit: Optional[int] = None,
     offset: int = 0,
@@ -1683,6 +1684,11 @@ def fleet_status_document(
     - ``serving`` — injected serve-engine stats (batch/shed counters and
       the precision ladder: per-precision coalesce counts, degrade
       counter, cached precision-parity gate reports).
+    - ``stream`` — injected streaming-plane stats
+      (``gordo_tpu.stream.plane.stream_plane_section``, like the other
+      injected sections — telemetry never imports the plane):
+      session/subscriber counts, the summed zero-gap row accounting,
+      score-lag and watermark-delay freshness, flush/lag percentiles.
 
     Sections degrade to None independently: a build dir with no
     lifecycle state still joins, a serve dir with no plan still joins.
@@ -1860,6 +1866,9 @@ def fleet_status_document(
     doc["device"] = device
     doc["programs"] = programs
     doc["serving"] = serving
+    # the streaming plane joins the console like device/programs — an
+    # injected live-process section, None wherever no plane is installed
+    doc["stream"] = stream
     return doc
 
 
@@ -2091,4 +2100,36 @@ def render_fleet_status(doc: Dict[str, Any]) -> str:
                         else ""
                     )
                 )
+    stream = doc.get("stream")
+    if stream:
+        accounting = stream.get("accounting") or {}
+        lag = stream.get("lag") or {}
+        lag_p95 = lag.get("lag_p95_ms")
+        lines.append(
+            f"Stream:    {stream.get('sessions_active', 0)} active "
+            f"session(s), {stream.get('subscribers', 0)} subscriber(s)"
+            + (" — DRAINING" if stream.get("draining") else "")
+        )
+        lines.append(
+            f"  rows: {accounting.get('rows_in', 0)} in, "
+            f"{accounting.get('rows_scored', 0)} scored, "
+            f"{accounting.get('rows_failed', 0)} failed, "
+            f"{accounting.get('rows_pending', 0)} pending, "
+            f"{accounting.get('rows_shed', 0)} shed "
+            f"(gap {accounting.get('gap', 0)})"
+        )
+        lines.append(
+            f"  freshness: lag p95 "
+            + (f"{lag_p95:g}ms" if lag_p95 is not None else "-")
+            + (
+                f", watermark delay {lag['watermark_delay_max_ms']:g}ms"
+                if lag.get("watermark_delay_max_ms") is not None
+                else ""
+            )
+            + (
+                f", {stream['quarantined_machines']} quarantined machine(s)"
+                if stream.get("quarantined_machines")
+                else ""
+            )
+        )
     return "\n".join(lines)

@@ -131,11 +131,21 @@ class SpanHandle:
     OTel-shaped links to spans in OTHER traces (the serving engine links
     each fused batch span to the request spans it coalesced)."""
 
-    __slots__ = ("attributes", "links")
+    __slots__ = ("attributes", "links", "trace_id", "span_id")
 
-    def __init__(self, attributes: Dict[str, Any]):
+    def __init__(
+        self,
+        attributes: Dict[str, Any],
+        trace_id: str = "",
+        span_id: str = "",
+    ):
         self.attributes = attributes
         self.links: List[dict] = []
+        #: this span's own identity (empty on the null recorder) — lets
+        #: a producer hand its context to a LATER span in another trace
+        #: that wants to link back (the stream ingest→flush links)
+        self.trace_id = trace_id
+        self.span_id = span_id
 
     def set(self, **attributes) -> "SpanHandle":
         self.attributes.update(attributes)
@@ -283,8 +293,8 @@ class SpanRecorder:
     def span(self, name: str, **attributes):
         """Record the enclosed block as one span; exceptions mark the
         span ``ERROR`` (with the exception repr) and propagate."""
-        handle = SpanHandle(dict(attributes))
         span_id = rand_hex(16)
+        handle = SpanHandle(dict(attributes), self.trace_id, span_id)
         stack = self._stack()
         parent_id = stack[-1] if stack else self.default_parent_id
         stack.append(span_id)

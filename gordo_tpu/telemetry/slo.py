@@ -9,8 +9,9 @@ error budget" — not raw spans. This module renders that judgment:
 - **objectives are declared, not coded**: a ``slos.toml`` (shipped like
   ``analysis/contracts.toml``, overridable per deployment via
   ``GORDO_TPU_SLO_CONFIG`` or a file beside the telemetry sinks) names
-  each SLO's objective (``availability`` / ``latency``), target and
-  window;
+  each SLO's objective (``availability`` / ``latency`` over the request
+  plane; ``stream_freshness`` / ``stream_integrity`` over the streaming
+  plane's rollup row accounting), target and window;
 - **evaluation runs over rollups** (telemetry/aggregate.py), never the
   raw span corpus: one incremental aggregation pass, then window merges
   — asking "last 6h burn rate" costs a few hundred small JSON reads,
@@ -98,7 +99,8 @@ class SloSpec:
     """One declared objective."""
 
     name: str
-    objective: str  # "availability" | "latency"
+    #: "availability" | "latency" | "stream_freshness" | "stream_integrity"
+    objective: str
     target: float
     window: str  # the declared spelling ("30d")
     window_s: float
@@ -219,10 +221,16 @@ def load_slo_config(
     for entry in doc.get("slo") or []:
         name = str(entry.get("name") or "").strip()
         objective = str(entry.get("objective") or "").strip()
-        if not name or objective not in ("availability", "latency"):
+        if not name or objective not in (
+            "availability",
+            "latency",
+            "stream_freshness",
+            "stream_integrity",
+        ):
             raise ValueError(
                 f"slos.toml: every [[slo]] needs a name and an objective "
-                f"of availability|latency (got {entry!r})"
+                f"of availability|latency|stream_freshness|stream_integrity "
+                f"(got {entry!r})"
             )
         target = float(entry.get("target", 0.0))
         if not 0.0 < target < 1.0:
@@ -230,9 +238,12 @@ def load_slo_config(
                 f"slos.toml: {name}: target must be in (0, 1), got {target}"
             )
         threshold_ms = entry.get("threshold_ms")
-        if objective == "latency" and threshold_ms is None:
+        if objective in ("latency", "stream_freshness") and (
+            threshold_ms is None
+        ):
             raise ValueError(
-                f"slos.toml: {name}: latency objectives need threshold_ms"
+                f"slos.toml: {name}: {objective} objectives need "
+                f"threshold_ms"
             )
         window = str(entry.get("window", "30d"))
         slos.append(
@@ -308,7 +319,31 @@ def histogram_fraction_over(
 def bad_fraction(spec: SloSpec, rollup: Dict[str, Any]) -> Tuple[float, int]:
     """(bad event fraction, total events) for ``spec`` over one merged
     rollup. Sampled traces keep ratios unbiased — counts are estimates,
-    fractions are the contract (docs/observability.md)."""
+    fractions are the contract (docs/observability.md).
+
+    Stream objectives read the rollup's ``stream`` section instead of
+    the request plane: *freshness* is the rows-weighted fraction of the
+    ingest→scored lag histogram above ``threshold_ms``; *integrity* is
+    the shed+failed row fraction of everything ingested. Zero stream
+    traffic is (0.0, 0) — silence never burns budget."""
+    if spec.objective in ("stream_freshness", "stream_integrity"):
+        stream = rollup.get("stream") or {}
+        if spec.objective == "stream_freshness":
+            lag = stream.get("lag_ms") or {}
+            total = int(lag.get("count", 0))
+            if not total:
+                return 0.0, 0
+            return (
+                histogram_fraction_over(lag, float(spec.threshold_ms)),
+                total,
+            )
+        rows_in = int(stream.get("rows_in", 0))
+        if not rows_in:
+            return 0.0, 0
+        bad = int(stream.get("rows_shed", 0)) + int(
+            stream.get("rows_failed", 0)
+        )
+        return min(1.0, bad / rows_in), rows_in
     requests = rollup.get("requests") or {}
     total = int(requests.get("count", 0))
     if not total:
@@ -574,6 +609,11 @@ def _evaluate_locked(
             entry["latency_p95_ms"] = histogram_percentile(
                 window_rollup.get("latency_ms") or {}, 0.95
             )
+        elif spec.objective == "stream_freshness":
+            entry["lag_p95_ms"] = histogram_percentile(
+                (window_rollup.get("stream") or {}).get("lag_ms") or {},
+                0.95,
+            )
         slos_doc.append(entry)
 
     # alerts for SLOs no longer declared are dropped, not zombie-fired
@@ -785,11 +825,16 @@ def render_slo_status(doc: Dict[str, Any]) -> str:
             if slo.get("threshold_ms") is not None
             else ""
         )
+        unit = (
+            "row(s)"
+            if str(slo.get("objective", "")).startswith("stream")
+            else "request(s)"
+        )
         lines.append(
             f"  {slo['name']}: {slo['objective']}{threshold} "
             f"target {slo['target']:.4%} over {slo['window']} — "
             f"budget remaining {budget.get('remaining_ratio', 0) * 100:.1f}%"
-            f" ({slo.get('requests', 0)} request(s), burn {burn or '-'})"
+            f" ({slo.get('requests', 0)} {unit}, burn {burn or '-'})"
         )
     alerts = doc.get("alerts") or []
     active = [a for a in alerts if a.get("state") != "inactive"]

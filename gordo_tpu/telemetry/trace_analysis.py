@@ -31,8 +31,16 @@ from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
 
 #: serving stage spans whose parent is the request span; anything else
 #: under a request (events, nested helper spans) is excluded from the
-#: stage breakdown so shares stay a partition of walltime
-_NON_STAGE_NAMES = ("request", "profile")
+#: stage breakdown so shares stay a partition of walltime. The
+#: streaming-plane spans are root spans with their own breakdown
+#: (:func:`stream_breakdown`), never request stages.
+_NON_STAGE_NAMES = (
+    "request",
+    "profile",
+    "stream_ingest",
+    "stream_score",
+    "stream_emit",
+)
 
 
 def trace_bases(directory: str, base_name: str) -> List[str]:
@@ -304,6 +312,134 @@ def request_breakdown(spans: Iterable[dict]) -> Optional[Dict[str, Any]]:
     }
 
 
+def stream_breakdown(spans: Iterable[dict]) -> Optional[Dict[str, Any]]:
+    """
+    The streaming plane's per-session critical path: for every stream id
+    seen in the trace, the ``stream_ingest`` → ``stream_score`` →
+    ``stream_emit`` stage distributions, the freshness numbers the score
+    spans carry (ingest→scored lag p50/max), device time vs the cost
+    model's prediction, and the row/shed accounting summed from span
+    attributes. ``linked_ingests`` counts the OTel links score spans
+    carry back to the ingests they drained — the fraction of flushes a
+    trace reader can walk end-to-end. None when the trace holds no
+    streaming spans.
+    """
+    stage_names = ("stream_ingest", "stream_score", "stream_emit")
+    by_stream: Dict[str, Dict[str, Any]] = {}
+    for span in spans:
+        name = span.get("name")
+        if name not in stage_names:
+            continue
+        attributes = span.get("attributes") or {}
+        stream_id = str(attributes.get("stream") or "-")
+        entry = by_stream.setdefault(
+            stream_id,
+            {
+                "durations": {stage: [] for stage in stage_names},
+                "device_ms": [],
+                "predicted_device_ms": [],
+                "lag_p50_ms": [],
+                "lag_max_ms": 0.0,
+                "rows_in": 0,
+                "rows_scored": 0,
+                "rows_failed": 0,
+                "rows_shed": 0,
+                "windows": 0,
+                "events": 0,
+                "linked_ingests": 0,
+            },
+        )
+        entry["durations"][name].append(
+            float(span.get("duration_ms", 0.0))
+        )
+        if name == "stream_ingest":
+            entry["rows_in"] += int(attributes.get("rows", 0) or 0)
+        elif name == "stream_score":
+            scored = attributes.get("rows_scored")
+            if scored is None:
+                scored = attributes.get("rows", 0)
+            entry["rows_scored"] += int(scored or 0)
+            entry["rows_failed"] += int(
+                attributes.get("rows_failed", 0) or 0
+            )
+            entry["rows_shed"] += int(attributes.get("shed", 0) or 0)
+            entry["windows"] += int(attributes.get("windows", 0) or 0)
+            entry["linked_ingests"] += len(span.get("links") or [])
+            device = attributes.get("device_ms")
+            if device is not None:
+                entry["device_ms"].append(float(device))
+            predicted = attributes.get("predicted_device_ms")
+            if predicted is not None and float(predicted) >= 0.0:
+                entry["predicted_device_ms"].append(float(predicted))
+            lag_p50 = attributes.get("lag_p50_ms")
+            if lag_p50 is not None:
+                entry["lag_p50_ms"].append(float(lag_p50))
+            lag_max = attributes.get("lag_max_ms")
+            if lag_max is not None:
+                entry["lag_max_ms"] = max(
+                    entry["lag_max_ms"], float(lag_max)
+                )
+        else:
+            entry["events"] += int(attributes.get("events", 0) or 0)
+    if not by_stream:
+        return None
+
+    streams: Dict[str, Dict[str, Any]] = {}
+    for stream_id, entry in sorted(by_stream.items()):
+        stages = {
+            stage: _distribution(durations)
+            for stage, durations in entry["durations"].items()
+            if durations
+        }
+        lag_p50s = sorted(entry["lag_p50_ms"])
+        device = sorted(entry["device_ms"])
+        predicted = sorted(entry["predicted_device_ms"])
+        # the session's median critical path, in pipeline order: what
+        # one row pays from ingest acceptance to the emitted event
+        critical_path = [
+            {
+                "stage": stage,
+                "p50_ms": stages[stage]["p50_ms"],
+            }
+            for stage in stage_names
+            if stage in stages
+        ]
+        streams[stream_id] = {
+            "stages": stages,
+            "flushes": stages.get("stream_score", {}).get("count", 0),
+            "rows_in": entry["rows_in"],
+            "rows_scored": entry["rows_scored"],
+            "rows_failed": entry["rows_failed"],
+            "rows_shed": entry["rows_shed"],
+            "windows": entry["windows"],
+            "events": entry["events"],
+            "linked_ingests": entry["linked_ingests"],
+            "lag_p50_ms": round(percentile(lag_p50s, 0.50), 3),
+            "lag_max_ms": round(entry["lag_max_ms"], 3),
+            "device_p50_ms": round(percentile(device, 0.50), 3),
+            "predicted_device_p50_ms": (
+                round(percentile(predicted, 0.50), 3)
+                if predicted
+                else None
+            ),
+            "critical_path": critical_path,
+        }
+    return {
+        "streams": streams,
+        "totals": {
+            "rows_in": sum(s["rows_in"] for s in streams.values()),
+            "rows_scored": sum(
+                s["rows_scored"] for s in streams.values()
+            ),
+            "rows_failed": sum(
+                s["rows_failed"] for s in streams.values()
+            ),
+            "rows_shed": sum(s["rows_shed"] for s in streams.values()),
+            "flushes": sum(s["flushes"] for s in streams.values()),
+        },
+    }
+
+
 def top_profile_frames(
     spans: Iterable[dict], max_frames: int = 25
 ) -> List[Dict[str, Any]]:
@@ -340,8 +476,9 @@ def analyze_trace(
 ) -> Dict[str, Any]:
     """The full analysis document for one trace (a file path, or a list
     of sink bases to read-merge — the per-worker variants of one
-    logical trace): span summaries, the request breakdown, and the
-    aggregated profile — the JSON shape ``gordo-tpu trace --as-json``
+    logical trace): span summaries, the request breakdown, the stream-
+    session breakdown, and the aggregated profile — the JSON shape
+    ``gordo-tpu trace --as-json``
     prints and the tests golden-check. ``since_ts``/``until_ts``
     restrict the analysis to a time window (``--since``/``--last``);
     ``window_index`` (``aggregate.sink_window_index``) lets rotated
@@ -360,6 +497,7 @@ def analyze_trace(
         "spans_read": len(spans),
         "span_summary": summarize_spans(spans),
         "request_breakdown": request_breakdown(spans),
+        "stream_breakdown": stream_breakdown(spans),
         "profile_frames": top_profile_frames(spans),
     }
     if since_ts is not None or until_ts is not None:
@@ -448,6 +586,60 @@ def render_analysis(doc: Dict[str, Any]) -> str:
                 for step in breakdown["critical_path"]
             )
             out.append(f"critical path (median request): {path_text}")
+
+    stream = doc.get("stream_breakdown")
+    if stream:
+        totals = stream.get("totals") or {}
+        out.append(
+            f"\nStream sessions: {len(stream.get('streams') or {})}  "
+            f"flushes={totals.get('flushes', 0)} "
+            f"rows in={totals.get('rows_in', 0)} "
+            f"scored={totals.get('rows_scored', 0)} "
+            f"failed={totals.get('rows_failed', 0)} "
+            f"shed={totals.get('rows_shed', 0)}"
+        )
+        out.append(
+            _table(
+                [
+                    [
+                        stream_id,
+                        entry["flushes"],
+                        entry["rows_scored"],
+                        entry["lag_p50_ms"],
+                        entry["lag_max_ms"],
+                        entry["device_p50_ms"],
+                        (
+                            entry["predicted_device_p50_ms"]
+                            if entry["predicted_device_p50_ms"] is not None
+                            else "-"
+                        ),
+                        entry["linked_ingests"],
+                    ]
+                    for stream_id, entry in (
+                        stream.get("streams") or {}
+                    ).items()
+                ],
+                [
+                    "stream",
+                    "flushes",
+                    "rows",
+                    "lag p50",
+                    "lag max",
+                    "device p50",
+                    "pred p50",
+                    "links",
+                ],
+            )
+        )
+        for stream_id, entry in (stream.get("streams") or {}).items():
+            if entry.get("critical_path"):
+                path_text = "  >  ".join(
+                    f"{step['stage']} {step['p50_ms']}ms"
+                    for step in entry["critical_path"]
+                )
+                out.append(
+                    f"critical path ({stream_id}, median): {path_text}"
+                )
 
     frames = doc.get("profile_frames") or []
     if frames:

@@ -79,8 +79,10 @@ case "$component" in
     # tests/server, tests/telemetry and tests/lifecycle —
     # marker-selected the same way.
     chaos)    run -m "chaos and not slow" tests/ ;;
-    # The streaming scoring-plane suite cuts across tests/stream and
-    # tests/server — marker-selected the same way.
+    # The streaming scoring-plane suite cuts across tests/stream,
+    # tests/server and tests/telemetry (the PR 18 observability layer:
+    # stream spans in rollups, freshness/integrity SLOs, the bounded
+    # scrape collector) — marker-selected the same way.
     stream)   run -m "stream and not slow" tests/ ;;
     # The fleet-scale observability suite (sharded ledger, rollup
     # manifest, bounded fleet-status, breaker summaries) lives in

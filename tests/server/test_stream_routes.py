@@ -357,6 +357,19 @@ def test_stream_status_surfaces_session_counters(stream_client, json_body):
     assert machine["rows_in"] == WINDOW
     assert machine["rows_scored"] == WINDOW
     assert doc["counters"]["ingest_batches"] == 1
+    # the observability surfaces: per-machine freshness, the summed
+    # zero-gap accounting, and the process-global telemetry rollup
+    assert machine["last_score_lag_ms"] is not None
+    assert machine["last_score_lag_ms"] >= 0.0
+    accounting = session["accounting"]
+    assert accounting["rows_in"] == WINDOW
+    assert accounting["gap"] == 0
+    assert session["lag"]["score_lag_max_ms"] >= 0.0
+    telemetry = doc["telemetry"]
+    assert telemetry["rows_in"] >= WINDOW
+    assert telemetry["rows_scored"] >= WINDOW
+    assert telemetry["flushes"] >= 1
+    assert telemetry["lag_ms"]["count"] >= WINDOW  # rows-weighted
 
 
 # -- stream-only health ledger (satellite 2) ---------------------------------

@@ -31,12 +31,12 @@ def test_row_ring_take_returns_exact_span():
     ring = RowRing(100)
     ring.append([1, 2, 3])
     ring.append([4, 5, 6])
-    chunks, first, last = ring.take(4)
+    chunks, first, last, _ = ring.take(4)
     assert first == 1 and last == 4
     assert [row for chunk in chunks for row in chunk] == [1, 2, 3, 4]
     assert ring.pending_rows == 2
     # the remainder keeps its original seqs
-    chunks, first, last = ring.take(2)
+    chunks, first, last, _ = ring.take(2)
     assert first == 5 and last == 6
     assert [row for chunk in chunks for row in chunk] == [5, 6]
 
@@ -57,7 +57,7 @@ def test_row_ring_sheds_oldest_first_and_counts():
     assert ring.pending_rows == 4
     assert ring.shed_rows == 2
     # what remains is the NEWEST 4 rows, seqs intact
-    chunks, first, last = ring.take(4)
+    chunks, first, last, _ = ring.take(4)
     assert (first, last) == (3, 6)
     assert [row for chunk in chunks for row in chunk] == [3, 4, 5, 6]
 
@@ -67,7 +67,7 @@ def test_row_ring_oversized_chunk_keeps_newest_capacity_rows():
     first, shed = ring.append([1, 2, 3, 4, 5])
     assert first == 1
     assert shed == 2
-    chunks, first, last = ring.take(3)
+    chunks, first, last, _ = ring.take(3)
     # seqs 1-2 were shed from inside the oversized chunk itself
     assert (first, last) == (3, 5)
     assert [row for chunk in chunks for row in chunk] == [3, 4, 5]
@@ -84,7 +84,7 @@ def test_row_ring_seq_continuity_across_shed_and_take():
         total_in += len(batch)
         got = ring.take(3)
         if got is not None:
-            _, first, last = got
+            _, first, last, _ = got
             taken.append((first, last))
     consumed = sum(last - first + 1 for first, last in taken)
     assert consumed + ring.pending_rows + ring.shed_rows == total_in

@@ -122,7 +122,12 @@ def test_idle_subscription_heartbeats_then_times_out():
         session.subscribe(heartbeat_s=0.01, idle_timeout_s=0.05)
     )
     assert frames[0].startswith("event: open")
-    assert heartbeat_frame() in frames[1:]
+    # heartbeats carry the cursor + pending-row payload (still SSE
+    # comment frames — no id:, Last-Event-ID never advances)
+    assert heartbeat_frame(cursor=0, pending_rows=0) in frames[1:]
+    for frame in frames[1:]:
+        assert frame.startswith(": keep-alive")
+        assert "id:" not in frame
 
 
 def test_max_events_bounds_the_response():

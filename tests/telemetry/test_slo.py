@@ -36,9 +36,13 @@ def test_subset_parser_matches_packaged_file():
     assert [entry["name"] for entry in doc["slo"]] == [
         "availability",
         "full-route-p95",
+        "stream-freshness",
+        "stream-integrity",
     ]
     assert doc["burn"]["confirmation_divisor"] == 12
     assert doc["slo"][1]["threshold_ms"] == 1000.0
+    assert doc["slo"][2]["objective"] == "stream_freshness"
+    assert doc["slo"][2]["threshold_ms"] == 5000.0
 
 
 def test_config_resolution_order(tmp_path, monkeypatch):

@@ -1,0 +1,521 @@
+"""
+Streaming-plane observability: stream span → rollup folding, the
+freshness/integrity SLO objectives (including the pending→firing→
+resolved drill over an injected lag stall), the bounded Prometheus
+collector, the fleet-status stream section, and the trace analyzer's
+stream-session breakdown.
+"""
+
+import json
+import os
+
+import pytest
+
+from gordo_tpu.telemetry import slo
+from gordo_tpu.telemetry.aggregate import (
+    LATENCY_BUCKETS_MS,
+    RollupStore,
+    merge_rollups,
+    new_histogram,
+    summarize_rollup,
+)
+
+from .test_aggregate import NOW, iso, write_spans
+
+pytestmark = [pytest.mark.stream, pytest.mark.observability]
+
+
+def lag_hist_for(lag_ms: float, rows: int):
+    """A compact span lag_hist: all ``rows`` at one lag value."""
+    counts = [0] * (len(LATENCY_BUCKETS_MS) + 1)
+    slot = len(LATENCY_BUCKETS_MS)
+    for i, edge in enumerate(LATENCY_BUCKETS_MS):
+        if lag_ms <= edge:
+            slot = i
+            break
+    counts[slot] = rows
+    return counts
+
+
+def stream_ingest_span(i, ts, rows=32, stream="s1"):
+    return {
+        "name": "stream_ingest",
+        "context": {
+            "trace_id": f"{i:032x}",
+            "span_id": f"{i:016x}",
+        },
+        "parent_id": None,
+        "kind": "internal",
+        "start_time": iso(ts - 0.002),
+        "end_time": iso(ts),
+        "duration_ms": 2.0,
+        "status": {"status_code": "OK"},
+        "attributes": {
+            "stream": stream,
+            "machines": 1,
+            "rows": rows,
+            "shed": 0,
+            "errors": 0,
+        },
+        "resource": {"service.name": "test"},
+    }
+
+
+def stream_score_span(
+    i,
+    ts,
+    rows=32,
+    rows_failed=0,
+    shed=0,
+    lag_ms=50.0,
+    flush_ms=20.0,
+    stream="s1",
+):
+    scored = rows - rows_failed
+    return {
+        "name": "stream_score",
+        "context": {
+            "trace_id": f"{i + 500:032x}",
+            "span_id": f"{i + 500:016x}",
+        },
+        "parent_id": None,
+        "kind": "internal",
+        "start_time": iso(ts - flush_ms / 1000.0),
+        "end_time": iso(ts),
+        "duration_ms": flush_ms,
+        "status": {"status_code": "OK"},
+        "attributes": {
+            "stream": stream,
+            "machines": 1,
+            "rows": rows,
+            "rows_scored": scored,
+            "rows_failed": rows_failed,
+            "windows": max(1, rows // 32),
+            "shed": shed,
+            "revision": "rev-a",
+            "lag_p50_ms": lag_ms,
+            "lag_max_ms": lag_ms,
+            "lag_hist": lag_hist_for(lag_ms, rows),
+            "lag_sum_ms": lag_ms * rows,
+            "predicted_device_ms": 1.5,
+            "device_ms": 2.0,
+        },
+        "resource": {"service.name": "test"},
+    }
+
+
+# -- rollup folding -----------------------------------------------------------
+
+
+def test_stream_spans_fold_into_rollup_stream_section(tmp_path):
+    d = str(tmp_path)
+    write_spans(
+        os.path.join(d, "serve_trace.jsonl"),
+        [
+            stream_ingest_span(1, NOW, rows=64),
+            stream_score_span(
+                1, NOW + 1, rows=32, lag_ms=50.0, flush_ms=20.0
+            ),
+            stream_score_span(
+                2, NOW + 2, rows=32, rows_failed=8, shed=4, lag_ms=200.0
+            ),
+        ],
+    )
+    store = RollupStore(d)
+    store.aggregate()
+    rollup = store.merged(since=NOW - 3600, until=NOW + 3600)
+    stream = rollup["stream"]
+    assert stream["rows_in"] == 64
+    assert stream["rows_scored"] == 32 + 24
+    assert stream["rows_failed"] == 8
+    assert stream["rows_shed"] == 4
+    assert stream["flushes"] == 2
+    assert stream["windows"] == 2
+    assert stream["flush_ms"]["count"] == 2
+    # the lag histogram is rows-weighted: 64 rows across the two spans
+    assert stream["lag_ms"]["count"] == 64
+    assert stream["lag_ms"]["sum_ms"] == pytest.approx(
+        50.0 * 32 + 200.0 * 32
+    )
+    # stream spans are not request stages
+    assert "stream_score" not in rollup["stages"]
+    assert "stream_ingest" not in rollup["stages"]
+
+    summary = summarize_rollup(rollup)
+    assert summary["stream"]["rows_in"] == 64
+    assert summary["stream"]["flushes"] == 2
+    assert summary["stream"]["lag_p95_ms"] > 0.0
+
+
+def test_stream_section_merges_and_tolerates_pre_upgrade_rollups():
+    from gordo_tpu.telemetry.aggregate import _empty_rollup
+
+    a = _empty_rollup(NOW, 300)
+    a["stream"]["rows_in"] = 10
+    a["stream"]["flushes"] = 1
+    legacy = _empty_rollup(NOW, 300)
+    del legacy["stream"]  # a rollup written before this section existed
+    merged = merge_rollups(a, legacy)
+    assert merged["stream"]["rows_in"] == 10
+    b = _empty_rollup(NOW, 300)
+    b["stream"]["rows_in"] = 5
+    b["stream"]["rows_shed"] = 2
+    merge_rollups(a, b)
+    assert a["stream"]["rows_in"] == 15
+    assert a["stream"]["rows_shed"] == 2
+
+
+# -- the SLO objectives -------------------------------------------------------
+
+
+def freshness_spec(threshold_ms=100.0, target=0.95):
+    return slo.SloSpec(
+        name="stream-freshness",
+        objective="stream_freshness",
+        target=target,
+        window="30d",
+        window_s=30 * 86400.0,
+        threshold_ms=threshold_ms,
+    )
+
+
+def integrity_spec(target=0.999):
+    return slo.SloSpec(
+        name="stream-integrity",
+        objective="stream_integrity",
+        target=target,
+        window="30d",
+        window_s=30 * 86400.0,
+    )
+
+
+def test_stream_objectives_require_threshold_and_parse(tmp_path):
+    path = tmp_path / "slos.toml"
+    path.write_text(
+        '[[slo]]\nname = "f"\nobjective = "stream_freshness"\n'
+        'target = 0.95\nthreshold_ms = 250.0\nwindow = "7d"\n'
+        '[[slo]]\nname = "i"\nobjective = "stream_integrity"\n'
+        'target = 0.99\nwindow = "7d"\n'
+    )
+    config = slo.load_slo_config(path=str(path))
+    assert [s.objective for s in config.slos] == [
+        "stream_freshness",
+        "stream_integrity",
+    ]
+    path.write_text(
+        '[[slo]]\nname = "f"\nobjective = "stream_freshness"\n'
+        'target = 0.95\nwindow = "7d"\n'
+    )
+    with pytest.raises(ValueError, match="threshold_ms"):
+        slo.load_slo_config(path=str(path))
+
+
+def test_stream_bad_fractions_read_the_stream_section():
+    rollup = {
+        "stream": {
+            "rows_in": 100,
+            "rows_scored": 90,
+            "rows_failed": 6,
+            "rows_shed": 4,
+            "flushes": 3,
+            "windows": 3,
+            "flush_ms": new_histogram(),
+            "lag_ms": {
+                "buckets_ms": list(LATENCY_BUCKETS_MS),
+                "counts": [0] * (len(LATENCY_BUCKETS_MS) + 1),
+                "count": 0,
+                "sum_ms": 0.0,
+            },
+        }
+    }
+    lag = rollup["stream"]["lag_ms"]
+    for lag_ms, rows in ((50.0, 75), (10_000.0, 25)):
+        counts = lag_hist_for(lag_ms, rows)
+        lag["counts"] = [a + b for a, b in zip(lag["counts"], counts)]
+        lag["count"] += rows
+        lag["sum_ms"] += lag_ms * rows
+    fraction, total = slo.bad_fraction(freshness_spec(100.0), rollup)
+    assert total == 100
+    assert fraction == pytest.approx(0.25, abs=0.02)
+    fraction, total = slo.bad_fraction(integrity_spec(), rollup)
+    assert total == 100
+    assert fraction == pytest.approx(0.10)
+    # zero stream traffic never burns budget
+    assert slo.bad_fraction(freshness_spec(), {}) == (0.0, 0)
+    assert slo.bad_fraction(integrity_spec(), {}) == (0.0, 0)
+
+
+def test_freshness_stall_drives_pending_to_firing_then_resolves(tmp_path):
+    """The acceptance drill in miniature: a lag stall (every row scored
+    10s late against a 100ms objective) pushes the freshness alert
+    pending → firing — which `firing_alerts(severity='page')` surfaces,
+    the exact gate the lifecycle supervisor's auto-promotion consults —
+    and the alert resolves once the stall leaves the burn windows."""
+    d = str(tmp_path)
+    config_path = tmp_path / "slos.toml"
+    config_path.write_text(
+        '[[slo]]\nname = "stream-freshness"\n'
+        'objective = "stream_freshness"\n'
+        'target = 0.95\nthreshold_ms = 100.0\nwindow = "30d"\n'
+    )
+    config = slo.load_slo_config(path=str(config_path))
+    write_spans(
+        os.path.join(d, "serve_trace.jsonl"),
+        [
+            stream_ingest_span(i, NOW - 30 + i, rows=32)
+            for i in range(4)
+        ]
+        + [
+            stream_score_span(
+                i, NOW - 28 + i, rows=32, lag_ms=10_000.0
+            )
+            for i in range(4)
+        ],
+    )
+    doc = slo.evaluate(d, config=config, now=NOW)
+    entry = doc["slos"][0]
+    assert entry["objective"] == "stream_freshness"
+    assert entry["bad_fraction"] == pytest.approx(1.0)
+    assert entry["lag_p95_ms"] >= 5000.0
+    states = {a["id"]: a["state"] for a in doc["alerts"]}
+    assert states["stream-freshness:fast"] == "pending"
+    assert not doc["ok"] or doc["firing"] == 0  # pending, not yet firing
+
+    doc = slo.evaluate(d, config=config, now=NOW + 60)
+    states = {a["id"]: a["state"] for a in doc["alerts"]}
+    assert states["stream-freshness:fast"] == "firing"
+    assert doc["ok"] is False
+    firing = slo.firing_alerts(d, severity="page")
+    assert [a["id"] for a in firing] == ["stream-freshness:fast"]
+
+    # the stall ages out of every burn window -> the page resolves and
+    # the promotion gate opens again
+    later = NOW + 40 * 86400.0
+    doc = slo.evaluate(d, config=config, now=later)
+    states = {a["id"]: a["state"] for a in doc["alerts"]}
+    assert states["stream-freshness:fast"] == "resolved"
+    assert doc["ok"] is True
+    assert slo.firing_alerts(d, severity="page") == []
+
+
+# -- Prometheus exposition ----------------------------------------------------
+
+
+def test_stream_plane_collector_is_bounded_and_accurate(monkeypatch):
+    pytest.importorskip("prometheus_client")
+    import pandas as pd
+    from prometheus_client.core import CollectorRegistry
+
+    from gordo_tpu import stream as stream_pkg
+    from gordo_tpu.server.prometheus.metrics import StreamPlaneCollector
+    from gordo_tpu.stream import (
+        StreamConfig,
+        StreamPlane,
+        install_plane,
+        reset_stream_telemetry,
+    )
+
+    reset_stream_telemetry()
+    plane = StreamPlane(
+        StreamConfig(
+            ring_rows=4096,
+            window_rows=1_000_000,  # nothing flushes: rows stay pending
+            outbox_events=8,
+            session_ttl_s=60.0,
+            heartbeat_s=0.05,
+            max_sessions=4,
+            shed_retry_s=0.5,
+        )
+    )
+    install_plane(plane)
+    try:
+        session = plane.session("p", "s1", "/tmp/anchor")
+        n_machines = 1000  # fleet-scale: must not appear in any label
+        for m in range(n_machines):
+            session.append_rows(
+                f"m-{m}", pd.DataFrame({"t": [1.0, 2.0]})
+            )
+        session.channel("m-0").quarantine_notified = True
+        stream_pkg.stream_telemetry().observe_ingest(2 * n_machines)
+        stream_pkg.stream_telemetry().observe_flush(
+            0.02,
+            rows_scored=100,
+            rows_failed=5,
+            rows_shed=3,
+            lags_ms=[40.0],
+            lag_weights=[100],
+        )
+
+        registry = CollectorRegistry()
+        registry.register(StreamPlaneCollector())
+        families = {
+            family.name: family for family in registry.collect()
+        }
+        assert families["gordo_stream_pending_rows"].samples[0].value == (
+            2 * n_machines
+        )
+        assert (
+            families["gordo_stream_quarantined_machines"]
+            .samples[0]
+            .value
+            == 1
+        )
+        by_label = {
+            sample.labels.get("state"): sample.value
+            for sample in families["gordo_stream_sessions"].samples
+        }
+        assert by_label == {"active": 1, "tombstoned": 0}
+        rows = {
+            sample.labels["outcome"]: sample.value
+            for sample in families["gordo_stream_rows"].samples
+        }
+        assert rows["in"] == 2 * n_machines
+        assert rows["scored"] == 100
+        assert rows["failed"] == 5
+        assert rows["shed"] == 3
+        # BOUNDED: total series count is a fixed constant — label values
+        # are small enums, never machine or stream names
+        all_samples = [
+            sample
+            for family in families.values()
+            for sample in family.samples
+        ]
+        assert len(all_samples) < 100
+        for sample in all_samples:
+            for value in sample.labels.values():
+                assert not value.startswith("m-")
+        lag_buckets = [
+            sample
+            for sample in families[
+                "gordo_stream_score_lag_ms"
+            ].samples
+            if sample.name.endswith("_bucket")
+        ]
+        assert lag_buckets[-1].labels["le"] == "+Inf"
+        assert lag_buckets[-1].value == 100
+    finally:
+        install_plane(None)
+        reset_stream_telemetry()
+
+
+def test_stream_collector_rides_fleet_console_registration():
+    pytest.importorskip("prometheus_client")
+    from prometheus_client.core import CollectorRegistry
+
+    from gordo_tpu.server.prometheus.metrics import (
+        register_fleet_console_collectors,
+    )
+
+    registry = CollectorRegistry()
+    register_fleet_console_collectors(registry)
+    names = {family.name for family in registry.collect()}
+    assert "gordo_stream_rows" in names
+    assert "gordo_stream_score_lag_ms" in names
+    # idempotent per registry (the WeakSet guard)
+    register_fleet_console_collectors(registry)
+
+
+# -- fleet-status + trace surfaces --------------------------------------------
+
+
+def test_fleet_status_document_carries_stream_section(tmp_path):
+    import pandas as pd
+
+    from gordo_tpu.stream import (
+        StreamConfig,
+        StreamPlane,
+        install_plane,
+        reset_stream_telemetry,
+        stream_plane_section,
+    )
+    from gordo_tpu.telemetry.fleet_health import (
+        fleet_status_document,
+        render_fleet_status,
+    )
+
+    reset_stream_telemetry()
+    plane = StreamPlane(
+        StreamConfig(
+            ring_rows=64,
+            window_rows=1_000_000,
+            outbox_events=8,
+            session_ttl_s=60.0,
+            heartbeat_s=0.05,
+            max_sessions=4,
+            shed_retry_s=0.5,
+        )
+    )
+    install_plane(plane)
+    try:
+        session = plane.session("p", "s1", str(tmp_path))
+        session.append_rows("m-1", pd.DataFrame({"t": [1.0, 2.0, 3.0]}))
+        # callers inject the section (telemetry never imports the plane)
+        doc = fleet_status_document(
+            str(tmp_path), stream=stream_plane_section()
+        )
+        stream = doc["stream"]
+        assert stream["sessions_active"] == 1
+        assert stream["accounting"]["rows_in"] == 3
+        assert stream["accounting"]["rows_pending"] == 3
+        assert stream["accounting"]["gap"] == 0
+        rendered = render_fleet_status(doc)
+        assert "Stream:" in rendered
+        assert "3 in" in rendered
+    finally:
+        install_plane(None)
+        reset_stream_telemetry()
+    # no plane installed -> the section degrades to None (CLI process)
+    assert stream_plane_section() is None
+    assert (
+        fleet_status_document(str(tmp_path), stream=stream_plane_section())[
+            "stream"
+        ]
+        is None
+    )
+
+
+def test_trace_analyzer_stream_breakdown(tmp_path):
+    from gordo_tpu.telemetry.trace_analysis import (
+        analyze_trace,
+        render_analysis,
+    )
+
+    path = os.path.join(str(tmp_path), "serve_trace.jsonl")
+    ingest = stream_ingest_span(1, NOW, rows=64)
+    score = stream_score_span(1, NOW + 1, rows=64, lag_ms=80.0)
+    score["links"] = [
+        {
+            "context": {
+                "trace_id": ingest["context"]["trace_id"],
+                "span_id": ingest["context"]["span_id"],
+            },
+            "attributes": {},
+        }
+    ]
+    emit = dict(
+        stream_ingest_span(3, NOW + 1, rows=0),
+        name="stream_emit",
+        attributes={"stream": "s1", "events": 2, "machines": 2},
+    )
+    write_spans(path, [ingest, score, emit])
+    doc = analyze_trace(path)
+    breakdown = doc["stream_breakdown"]
+    entry = breakdown["streams"]["s1"]
+    assert entry["rows_in"] == 64
+    assert entry["rows_scored"] == 64
+    assert entry["flushes"] == 1
+    assert entry["linked_ingests"] == 1
+    assert entry["lag_p50_ms"] == pytest.approx(80.0)
+    assert entry["device_p50_ms"] == pytest.approx(2.0)
+    assert entry["predicted_device_p50_ms"] == pytest.approx(1.5)
+    assert [step["stage"] for step in entry["critical_path"]] == [
+        "stream_ingest",
+        "stream_score",
+        "stream_emit",
+    ]
+    assert breakdown["totals"]["rows_in"] == 64
+    rendered = render_analysis(doc)
+    assert "Stream sessions: 1" in rendered
+    assert "critical path (s1, median)" in rendered
+    # stream spans never pollute the request stage partition
+    assert doc["request_breakdown"] is None

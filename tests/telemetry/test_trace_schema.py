@@ -153,6 +153,124 @@ def test_exported_request_trace_schema(tmp_path, monkeypatch):
         serving.reset_serve_recorder()
 
 
+@pytest.mark.stream
+def test_stream_span_schema(tmp_path, monkeypatch):
+    """The streaming plane's span vocabulary, golden-checked end to end:
+    one ``stream_ingest`` per ingest POST, one enriched ``stream_score``
+    per watermark flush (row accounting split, freshness lag numbers,
+    the compact rows-weighted ``lag_hist``, predicted vs measured device
+    time, and OTel links back to the drained ingests), and one
+    ``stream_emit`` per event fan-out."""
+    import numpy as np
+    import pandas as pd
+
+    from gordo_tpu import serve, telemetry
+    from gordo_tpu.server.fleet_store import STORE
+    from gordo_tpu.stream import (
+        StreamConfig,
+        StreamPlane,
+        reset_stream_telemetry,
+    )
+    from gordo_tpu.telemetry import serving
+
+    class EchoFleet:
+        def model(self, name):
+            return object()
+
+        def loaded_specs(self):
+            return {}
+
+        def fleet_scores(self, inputs):
+            return (
+                {
+                    name: (
+                        np.zeros((len(X), 2)),
+                        np.full(len(X), 0.5),
+                    )
+                    for name, X in inputs.items()
+                },
+                {},
+            )
+
+    monkeypatch.setenv(telemetry.TELEMETRY_ENV, "1")
+    monkeypatch.setenv(telemetry.TRACE_DIR_ENV, str(tmp_path))
+    fleet = EchoFleet()
+    monkeypatch.setattr(STORE, "route", lambda directory: directory)
+    monkeypatch.setattr(STORE, "fleet", lambda directory: fleet)
+    engine = serve.get_engine()
+    serve.install_engine(None)
+    serve.reset_stream_breakers()
+    serving.reset_serve_recorder()
+    reset_stream_telemetry()
+    try:
+        plane = StreamPlane(
+            StreamConfig(
+                ring_rows=16,
+                window_rows=4,
+                outbox_events=32,
+                session_ttl_s=60.0,
+                heartbeat_s=0.05,
+                max_sessions=2,
+                shed_retry_s=0.5,
+            )
+        )
+        session = plane.session("p", "s1", str(tmp_path / "rev-a"))
+        plane.ingest(
+            session,
+            {
+                "m-1": pd.DataFrame({"t": [float(i) for i in range(4)]}),
+                "m-2": pd.DataFrame({"t": [float(i) for i in range(4)]}),
+            },
+        )
+        serving.serve_recorder().flush()
+        lines = [
+            json.loads(l)
+            for l in open(serving.serve_trace_path()).read().splitlines()
+        ]
+        by_name = {s["name"]: s for s in lines}
+        assert {"stream_ingest", "stream_score", "stream_emit"} <= set(
+            by_name
+        )
+        for span in lines:
+            assert_span_schema(span)
+        ingest = by_name["stream_ingest"]
+        assert ingest["attributes"]["stream"] == "s1"
+        assert ingest["attributes"]["machines"] == 2
+        assert ingest["attributes"]["rows"] == 8
+        assert ingest["attributes"]["shed"] == 0
+        assert ingest["attributes"]["errors"] == 0
+        score = by_name["stream_score"]
+        attrs = score["attributes"]
+        assert attrs["stream"] == "s1"
+        assert attrs["rows"] == 8
+        assert attrs["rows_scored"] == 8
+        assert attrs["rows_failed"] == 0
+        assert attrs["windows"] == 2
+        assert attrs["shed"] == 0
+        assert attrs["revision"] == "rev-a"
+        assert attrs["lag_p50_ms"] >= 0.0
+        assert attrs["lag_max_ms"] >= attrs["lag_p50_ms"]
+        assert attrs["lag_sum_ms"] >= 0.0
+        assert isinstance(attrs["lag_hist"], list)
+        assert sum(attrs["lag_hist"]) == 8  # rows-weighted
+        assert attrs["device_ms"] >= 0.0
+        assert "predicted_device_ms" in attrs
+        # the flush links back to the ingest exchange it drained
+        linked = [
+            link["context"]["span_id"] for link in score.get("links") or []
+        ]
+        assert ingest["context"]["span_id"] in linked
+        emit = by_name["stream_emit"]
+        assert emit["attributes"]["stream"] == "s1"
+        assert emit["attributes"]["events"] == 2
+        assert emit["attributes"]["machines"] == 2
+    finally:
+        serving.reset_serve_recorder()
+        serve.reset_stream_breakers()
+        serve.install_engine(engine)
+        reset_stream_telemetry()
+
+
 def test_bench_gate_paths_match_committed_bench_docs():
     """Every gate spec path must resolve inside the committed baseline
     document it gates — a bench schema rename that would silently turn
