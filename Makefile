@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-concurrency test-fleet-health test-slo test-precision test-chaos test-scale test-stream docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-precision bench-chaos bench-scale bench-stream bench-check lint lint-gordo lockgraph-check image
+.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-concurrency test-fleet-health test-slo test-precision test-chaos test-scale test-stream test-ingest docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-precision bench-chaos bench-scale bench-stream bench-ingest bench-check lint lint-gordo lockgraph-check image
 
 test:
 	python -m pytest tests/ -q
@@ -129,6 +129,20 @@ test-stream:
 # (gated by `gordo-tpu bench-check`).
 bench-stream:
 	JAX_PLATFORMS=cpu python benchmarks/bench_stream.py
+
+# The device-resident ingest suite: compiled preprocessing plans,
+# raw-column dlpack transfer with host fallback, compiled-vs-host
+# parity across wire formats / batching modes / routes, ladder-snapped
+# stream cuts — CPU-only and not slow-marked, so the same tests also
+# run inside the tier-1 budget.
+test-ingest:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m ingest
+
+# Device-ingest microbench: host preprocessing pipeline vs the compiled
+# plan + raw-column transfer on the same payloads; writes
+# BENCH_INGEST.json (gated by `gordo-tpu bench-check`).
+bench-ingest:
+	JAX_PLATFORMS=cpu python benchmarks/bench_ingest.py
 
 # The fleet-scale observability suite: sharded ledger layout/migration/
 # dirty-flush contracts, rollup-manifest counting-open reads, bounded

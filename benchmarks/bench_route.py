@@ -342,6 +342,12 @@ def main() -> dict:
         route_arrow["trace_walltime_p50_ms"] = arrow_breakdown.get(
             "walltime_p50_ms", 0.0
         )
+        # which transfer path the columnar phase actually exercised
+        # (dlpack per-column vs host staging, with fallback reasons) —
+        # context for the ingest_p50_ms budget below
+        from gordo_tpu.ingest import ingest_stats
+
+        route_arrow["ingest_transfer"] = ingest_stats()
 
         # ---- batched vs unbatched full-route, at saturating load --------
         # micro-batching coalesces by ARRIVAL: at the 16-thread route
@@ -682,6 +688,18 @@ def main() -> dict:
             # throughput context for the same gap
             "route_gap_throughput_ratio": round(
                 median_on / route_arrow["median_throughput_rps"], 3
+            ),
+            # the two stages the ingest subsystem (PR 19) owns, summed at
+            # p50 on the columnar phase: data_decode (wire -> host parse)
+            # + device_ingest (host -> device staging, the cost
+            # data_decode used to hide). Gated as an absolute per-request
+            # budget in bench-check.
+            "ingest_p50_ms": round(
+                sum(
+                    route_arrow["stages"].get(stage, {}).get("p50_ms", 0.0)
+                    for stage in ("data_decode", "device_ingest")
+                ),
+                3,
             ),
             "attribution_target_met": route["attribution_coverage"] >= 0.9,
             "scoring_overhead": {

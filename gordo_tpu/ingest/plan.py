@@ -1,0 +1,256 @@
+"""
+Compiled preprocessing plans: the host pipeline's scaler math as device
+arrays.
+
+The serving artifacts wrap their estimator in (optionally) an sklearn
+``Pipeline`` whose leading steps are fitted scalers. The host serving
+path replays those steps per request (``fleet_store._host_transform``):
+an object-graph walk plus one float64 numpy pass per transformer per
+member — pure host work sitting between the wire decode and the fused
+device program. Every stock scaler is an *affine* map, and a chain of
+affine maps composes into ONE ``X * scale + offset``; this module
+extracts that composition per member and stacks it across a spec bucket
+into device-resident ``[members, features]`` arrays, so the whole
+preprocessing pipeline runs as a fused prologue INSIDE the gather
+program (``fleet_store.fleet_forward_gather``).
+
+Anything that is not provably affine — a custom transformer, a
+row-count-changing step, ``MinMaxScaler(clip=True)`` — answers ``None``
+and the caller keeps the host path (the fallback ladder in
+``docs/serving.md``); supported scalers are matched by EXACT type so a
+subclass with an overridden ``transform`` can never be silently
+mis-compiled.
+
+Numerics: the compiled prologue computes in float32 on device while the
+host pipeline runs float64 then casts — results agree to float32
+round-off (the parity tests pin this at tolerance), except for the
+**identity** plan (no transformer steps — the common bare-estimator
+artifact), which skips the multiply-add entirely and is bit-identical
+to the host path by construction.
+"""
+
+import logging
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class MemberPlan:
+    """One member's composed affine pipeline: ``f(X) = X * scale + offset``
+    (both ``[n_features]`` float32). ``identity`` marks the no-op plan
+    (no transformer steps), which callers must apply by NOT applying it —
+    skipping the multiply-add keeps the compiled path bit-identical to
+    the host path for bare-estimator artifacts.
+
+    >>> plan = MemberPlan(np.ones(2, np.float32), np.zeros(2, np.float32), True)
+    >>> plan.identity
+    True
+    """
+
+    __slots__ = ("scale", "offset", "identity")
+
+    def __init__(self, scale: np.ndarray, offset: np.ndarray, identity: bool):
+        self.scale = scale
+        self.offset = offset
+        self.identity = identity
+
+
+def _affine_of(transformer: Any) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """``(scale, offset)`` such that ``transform(X) == X * scale + offset``,
+    or None when this transformer is not provably affine. Exact-type
+    dispatch only — a subclass may override ``transform``."""
+    try:
+        from sklearn.preprocessing import (
+            MaxAbsScaler,
+            MinMaxScaler,
+            RobustScaler,
+            StandardScaler,
+        )
+    except ImportError:  # pragma: no cover - sklearn is a hard dep today
+        return None
+
+    kind = type(transformer)
+    try:
+        if kind is MinMaxScaler:
+            if getattr(transformer, "clip", False):
+                return None  # clip is not affine
+            return (
+                np.asarray(transformer.scale_, dtype=np.float64),
+                np.asarray(transformer.min_, dtype=np.float64),
+            )
+        if kind is StandardScaler:
+            scale = (
+                np.asarray(transformer.scale_, dtype=np.float64)
+                if getattr(transformer, "with_std", True)
+                and transformer.scale_ is not None
+                else None
+            )
+            mean = (
+                np.asarray(transformer.mean_, dtype=np.float64)
+                if getattr(transformer, "with_mean", True)
+                and transformer.mean_ is not None
+                else None
+            )
+            s = 1.0 / scale if scale is not None else np.asarray(1.0)
+            o = -(mean * s) if mean is not None else np.asarray(0.0)
+            return np.asarray(s), np.asarray(o)
+        if kind is MaxAbsScaler:
+            return (
+                1.0 / np.asarray(transformer.scale_, dtype=np.float64),
+                np.asarray(0.0),
+            )
+        if kind is RobustScaler:
+            scale = (
+                np.asarray(transformer.scale_, dtype=np.float64)
+                if getattr(transformer, "with_scaling", True)
+                and transformer.scale_ is not None
+                else None
+            )
+            center = (
+                np.asarray(transformer.center_, dtype=np.float64)
+                if getattr(transformer, "with_centering", True)
+                and transformer.center_ is not None
+                else None
+            )
+            s = 1.0 / scale if scale is not None else np.asarray(1.0)
+            o = -(center * s) if center is not None else np.asarray(0.0)
+            return np.asarray(s), np.asarray(o)
+    except AttributeError:
+        return None  # unfitted scaler: nothing to compile
+    return None
+
+
+def _pipeline_steps(model: Any) -> List[Any]:
+    """The transformer steps ahead of the estimator, through the same
+    unwrapping ``fleet_store._host_transform`` does (detector →
+    ``base_estimator`` → ``Pipeline.steps[:-1]``)."""
+    obj = model
+    base = getattr(obj, "base_estimator", None)
+    if base is not None:
+        obj = base
+    steps = getattr(obj, "steps", None)
+    if steps:
+        return [transformer for _, transformer in steps[:-1]]
+    return []
+
+
+def extract_member_plan(model: Any, n_features: int) -> Optional[MemberPlan]:
+    """The composed affine plan for one served model, or None when any
+    pipeline step is not provably affine (the host-fallback cue).
+
+    Composition order matches the pipeline's sequential transform: with
+    accumulated ``X*s1+o1`` followed by step ``(s2, o2)``, the result is
+    ``X*(s1*s2) + (o1*s2 + o2)``.
+    """
+    transformers = _pipeline_steps(model)
+    if not transformers:
+        return MemberPlan(
+            np.ones(n_features, np.float32),
+            np.zeros(n_features, np.float32),
+            identity=True,
+        )
+    scale = np.ones(n_features, np.float64)
+    offset = np.zeros(n_features, np.float64)
+    for transformer in transformers:
+        affine = _affine_of(transformer)
+        if affine is None:
+            return None
+        s, o = affine
+        try:
+            s = np.broadcast_to(s, (n_features,))
+            o = np.broadcast_to(o, (n_features,))
+        except ValueError:
+            # a width-changing step (feature selection) is not a plan
+            return None
+        scale = scale * s
+        offset = offset * s + o
+    return MemberPlan(
+        np.asarray(scale, np.float32), np.asarray(offset, np.float32),
+        identity=False,
+    )
+
+
+class FleetIngestPlan:
+    """A spec bucket's stacked preprocessing plan, device-resident.
+
+    ``scale``/``offset`` are ``[members, features]`` float32 device
+    arrays aligned row-for-row with the bucket's stacked parameters
+    (same sorted-name order), so the fused gather program indexes them
+    with the SAME ``indices`` it gathers member params with;
+    ``host_scale``/``host_offset`` keep the numpy originals for callers
+    that apply the plan host-side (the fleet route's vectorized staging)
+    without a device→host sync. For the all-identity bucket all four are
+    None (``identity`` True, zero resident bytes): callers run the
+    existing un-prologued program, keeping the compiled path
+    bit-identical to the host path for bare-estimator fleets.
+    """
+
+    __slots__ = (
+        "names",
+        "scale",
+        "offset",
+        "host_scale",
+        "host_offset",
+        "identity",
+        "nbytes",
+    )
+
+    def __init__(
+        self,
+        names: Sequence[str],
+        scale: Optional[Any],
+        offset: Optional[Any],
+        identity: bool,
+        host_scale: Optional[np.ndarray] = None,
+        host_offset: Optional[np.ndarray] = None,
+    ):
+        self.names = list(names)
+        self.scale = scale
+        self.offset = offset
+        self.host_scale = host_scale
+        self.host_offset = host_offset
+        self.identity = identity
+        self.nbytes = (
+            0
+            if identity
+            else int(scale.size + offset.size) * 4  # float32 leaves
+        )
+
+
+def build_fleet_plan(
+    members: Sequence[Tuple[str, Any]], n_features: int
+) -> Optional[FleetIngestPlan]:
+    """The stacked :class:`FleetIngestPlan` for one spec bucket
+    (``members`` in bucket order), or None when ANY member's pipeline is
+    not compilable — plans are all-or-nothing per bucket, so a fused
+    batch never mixes compiled and host-transformed riders."""
+    import jax
+
+    plans: List[MemberPlan] = []
+    for name, model in members:
+        plan = extract_member_plan(model, n_features)
+        if plan is None:
+            logger.debug(
+                "ingest plan: %s has a non-affine pipeline; bucket keeps "
+                "the host transform path",
+                name,
+            )
+            return None
+        plans.append(plan)
+    if not plans:
+        return None
+    names = [name for name, _ in members]
+    if all(plan.identity for plan in plans):
+        return FleetIngestPlan(names, None, None, identity=True)
+    host_scale = np.stack([plan.scale for plan in plans])
+    host_offset = np.stack([plan.offset for plan in plans])
+    return FleetIngestPlan(
+        names,
+        jax.device_put(host_scale),
+        jax.device_put(host_offset),
+        identity=False,
+        host_scale=host_scale,
+        host_offset=host_offset,
+    )

@@ -1,0 +1,193 @@
+"""
+Wire-column → device transfer without the host staging copy.
+
+The legacy decode path materializes a request as ``np.column_stack`` of
+the Arrow wire columns — a full host copy of the payload — and only then
+hands the matrix to the device program, which copies it AGAIN across the
+transfer boundary. :class:`RawColumns` instead carries the decoded wire
+columns as-is (zero-copy views straight out of the Arrow buffers) and
+:func:`to_device` moves them per-column over the dlpack protocol, so the
+first full-matrix materialization happens device-side inside the fused
+program's ``stack``. On backends whose dlpack import aliases host
+memory (TPU DMA path) that removes the staging copy entirely; the CPU
+backend copies on import, so the win there is skipping ``column_stack``
+— either way no intermediate host matrix is built.
+
+The fallback ladder is deliberately boring: ANY dlpack failure
+(non-contiguous column, unsupported dtype, backend refusal) drops the
+whole request to the host path — ``host_matrix()`` + ``jnp.asarray`` —
+which is the exact legacy staging behaviour, so parity is structural.
+Outcomes are counted module-wide (:func:`ingest_stats`) so benches and
+``/fleet-health`` can see which rung actually served traffic.
+"""
+
+import threading
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+_stats_lock = threading.Lock()
+_STATS = {
+    "dlpack_transfers": 0,
+    "host_transfers": 0,
+    "dlpack_columns": 0,
+    "fallback_reasons": {},
+}
+
+
+def _note_transfer(dlpack: bool, columns: int = 0, reason: str = "") -> None:
+    with _stats_lock:
+        if dlpack:
+            _STATS["dlpack_transfers"] += 1
+            _STATS["dlpack_columns"] += columns
+        else:
+            _STATS["host_transfers"] += 1
+            if reason:
+                reasons = _STATS["fallback_reasons"]
+                reasons[reason] = reasons.get(reason, 0) + 1
+
+
+def ingest_stats() -> dict:
+    """Process-wide transfer counters: how many requests went over
+    dlpack vs the host staging path, and why the host path was taken."""
+    with _stats_lock:
+        return {
+            "dlpack_transfers": _STATS["dlpack_transfers"],
+            "host_transfers": _STATS["host_transfers"],
+            "dlpack_columns": _STATS["dlpack_columns"],
+            "fallback_reasons": dict(_STATS["fallback_reasons"]),
+        }
+
+
+def reset_ingest_stats() -> None:
+    with _stats_lock:
+        _STATS["dlpack_transfers"] = 0
+        _STATS["host_transfers"] = 0
+        _STATS["dlpack_columns"] = 0
+        _STATS["fallback_reasons"] = {}
+
+
+class RawColumns:
+    """A request payload still in wire form: per-feature columns in
+    model-tag order, not yet stacked into a matrix.
+
+    Built from decoded Arrow columns (zero-copy buffer views) or, for
+    JSON/fallback requests, from an existing matrix (``matrix`` mode —
+    already staged, nothing to save, but it lets every caller speak one
+    payload type). ``host_matrix()`` is the escape hatch back to the
+    legacy staged ``float32`` matrix and is lazy: the raw-column fast
+    path never pays for it.
+
+    >>> raw = RawColumns.from_columns(
+    ...     [np.array([1.0, 2.0]), np.array([3.0, 4.0])])
+    >>> raw.rows, raw.width
+    (2, 2)
+    >>> raw.host_matrix().shape
+    (2, 2)
+    """
+
+    __slots__ = ("columns", "matrix", "rows", "width", "_host")
+
+    def __init__(
+        self,
+        columns: Optional[Sequence[np.ndarray]],
+        matrix: Optional[np.ndarray],
+        rows: int,
+        width: int,
+    ):
+        self.columns = tuple(columns) if columns is not None else None
+        self.matrix = matrix
+        self.rows = int(rows)
+        self.width = int(width)
+        self._host: Optional[np.ndarray] = None
+
+    @classmethod
+    def from_columns(cls, columns: Sequence[np.ndarray]) -> "RawColumns":
+        cols = [np.asarray(col) for col in columns]
+        rows = len(cols[0]) if cols else 0
+        return cls(cols, None, rows, len(cols))
+
+    @classmethod
+    def from_matrix(cls, matrix: Any) -> "RawColumns":
+        mat = np.asarray(matrix)
+        return cls(None, mat, mat.shape[0], mat.shape[1] if mat.ndim > 1 else 1)
+
+    def host_matrix(self) -> np.ndarray:
+        """The legacy staged matrix (``float32``, C-order), built at most
+        once."""
+        if self._host is None:
+            if self.matrix is not None:
+                self._host = np.ascontiguousarray(self.matrix, np.float32)
+            else:
+                self._host = np.column_stack(
+                    [np.asarray(col, np.float32) for col in self.columns]
+                )
+        return self._host
+
+    @property
+    def nbytes(self) -> int:
+        if self.columns is not None:
+            return int(sum(col.nbytes for col in self.columns))
+        return int(self.matrix.nbytes)
+
+
+def _dlpack_column(col: np.ndarray) -> Any:
+    """One wire column onto the device via dlpack, as float32. Raises on
+    anything the protocol can't take (caller falls back)."""
+    import jax
+    import jax.numpy as jnp
+
+    arr = np.asarray(col)
+    if arr.dtype != np.float32:
+        # dlpack moves bytes, not values: cast (a copy) first. Arrow f64
+        # wires land here; the compiled path computes f32 regardless.
+        arr = np.ascontiguousarray(arr, np.float32)
+    elif not arr.flags["C_CONTIGUOUS"]:
+        raise ValueError("non-contiguous wire column")
+    out = jax.dlpack.from_dlpack(arr)
+    if out.dtype != jnp.float32:  # pragma: no cover - cast path above
+        out = out.astype(jnp.float32)
+    return out
+
+
+def to_device(
+    raw: RawColumns,
+    padded_rows: Optional[int] = None,
+    dlpack: bool = True,
+) -> Any:
+    """``raw`` as a ``[rows, width]`` (or ``[padded_rows, width]``)
+    float32 device array.
+
+    Fast rung: each wire column crosses via dlpack and the matrix is
+    first assembled device-side (``jnp.stack(axis=1)``); row padding, if
+    any, happens on device too. Fallback rung (``dlpack=False``, a
+    padding-incompatible shape, or any dlpack refusal): the legacy host
+    staging — ``host_matrix()`` zero-padded on host, one ``jnp.asarray``
+    transfer. Both rungs return the same values; only the copy count
+    differs.
+    """
+    import jax.numpy as jnp
+
+    rows = raw.rows
+    target = padded_rows if padded_rows is not None else rows
+    if dlpack and raw.columns is not None and raw.width > 0 and rows > 0:
+        try:
+            device_cols: List[Any] = [
+                _dlpack_column(col) for col in raw.columns
+            ]
+            X = jnp.stack(device_cols, axis=1)
+            if target != rows:
+                X = jnp.zeros((target, raw.width), jnp.float32).at[:rows].set(X)
+            _note_transfer(True, columns=raw.width)
+            return X
+        except Exception as exc:  # noqa: BLE001 - any refusal = host rung
+            _note_transfer(False, reason=type(exc).__name__)
+    else:
+        reason = "disabled" if not dlpack else "no_columns"
+        _note_transfer(False, reason=reason)
+    host = raw.host_matrix()
+    if target != rows:
+        padded = np.zeros((target, raw.width), np.float32)
+        padded[:rows] = host
+        host = padded
+    return jnp.asarray(host)

@@ -90,6 +90,45 @@ def pad_to(n: int, ladder: Sequence[int]) -> Optional[int]:
     return None
 
 
+def snap_rows(
+    pending_rows: int,
+    window_rows: int,
+    ladder: Optional[Sequence[int]] = None,
+) -> int:
+    """The row count a multi-window stream cut should take from
+    ``pending_rows`` buffered rows: the largest whole-window span that
+    lands exactly on a serve row-ladder rung (``(rung // window_rows) *
+    window_rows`` — the rung's whole-window capacity), so a big backlog
+    flush runs the SAME compiled shape the request plane batches into
+    instead of minting a worst-case 4x-padded one. Below the smallest
+    rung-aligned size the whole backlog is taken (freshness beats
+    alignment for small flushes); the un-taken remainder is whole
+    windows that ride the next watermark flush.
+
+    >>> snap_rows(224, 32)
+    128
+    >>> snap_rows(96, 32)
+    32
+    >>> snap_rows(10, 5)
+    10
+    >>> snap_rows(3, 5)
+    0
+    """
+    window_rows = int(window_rows)
+    if window_rows <= 0:
+        return 0
+    whole = (int(pending_rows) // window_rows) * window_rows
+    if whole <= 0:
+        return 0
+    rungs = ladder if ladder is not None else row_ladder()
+    best = 0
+    for rung in rungs:
+        aligned = (int(rung) // window_rows) * window_rows
+        if 0 < aligned <= whole and aligned > best:
+            best = aligned
+    return best or whole
+
+
 # -- geometric rounding (build-side open-ended axes) -------------------------
 
 

@@ -42,6 +42,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..ingest import RawColumns
+from ..ingest import compiled_enabled as ingest_compiled
+from ..ingest import dlpack_enabled, ingest_stats, to_device
 from ..models.spec import FeedForwardSpec
 from ..telemetry.device import note_program_execution
 from ..telemetry.serving import SERVE_TRACE_FILE, serve_recorder
@@ -182,6 +185,10 @@ class ServeEngine:
             "breaker_trips": 0,  # closed/half-open -> open transitions
             "rung_demotions": 0,  # ladder rungs dropped after OOM
             "oom_fallbacks": 0,  # single-member OOMs sent unbatched
+            # -- device-resident ingest (gordo_tpu.ingest) --
+            "ingest_requests": 0,  # requests enqueued as raw wire columns
+            "ingest_batches": 0,  # fused batches staged through to_device
+            "ingest_replans": 0,  # plan vanished mid-batch: host rebuild
         }
         #: requests coalesced per effective serving precision
         self._precision_counters: Dict[str, int] = {}
@@ -228,11 +235,20 @@ class ServeEngine:
         model: Any,
         X,
         timing: Any = None,
+        raw: Optional[RawColumns] = None,
     ) -> Optional[np.ndarray]:
         """
         Score one request through the micro-batcher: returns the
         reconstruction rows, or None when the request is not batchable
         (the caller runs the model's own predict instead).
+
+        ``raw`` carries the request's decoded wire columns
+        (:class:`gordo_tpu.ingest.RawColumns`) when the view still has
+        them; with a compiled preprocessing plan resident for the spec
+        (``RevisionFleet.ingest_plan``, f32 serving) the request then
+        batches RAW — no host transform, no request-thread pad — and the
+        dispatcher stages the columns straight to device, where the
+        fused program's prologue does the preprocessing.
 
         Raises :class:`QueueFullError` (→ 429) when admission control
         rejects the request and :class:`DeadlineExceeded` (→ 504) when
@@ -286,32 +302,58 @@ class ServeEngine:
         if row_cap is not None and padded_rows > row_cap:
             self._count("fallback")
             return None
-        transformed = _host_transform(model, X)
-        if int(transformed.shape[0]) != rows:
-            # a row-count-changing transformer: re-derive from the
-            # shape the fused program will actually see
-            rows = int(transformed.shape[0])
-            padded_rows = ladder.pad_to(rows, self.config.row_ladder)
-            if rows == 0 or padded_rows is None:
-                self._count("fallback")
-                return None
-            if row_cap is not None and padded_rows > row_cap:
-                self._count("fallback")
-                return None
 
-        # row padding happens HERE, on the (otherwise waiting) request
-        # thread — the dispatcher then stacks same-rung payloads in one
-        # numpy call (see the module docstring for why that matters).
-        # The payload dtype is derived from the effective precision
-        # (serve/precision.payload_dtype — THE one payload-dtype
-        # authority), so the stack path cannot silently upcast a
-        # reduced-precision program's inputs.
-        dtype = precision.payload_dtype(prec)
-        if rows == padded_rows:
-            payload = np.ascontiguousarray(transformed, dtype=dtype)
+        # compiled-ingest eligibility: a resident preprocessing plan at
+        # f32 serving means the host pipeline is already inside the
+        # fused program (or provably a no-op) — the request thread then
+        # enqueues the RAW columns and does no math at all. Reduced
+        # precisions keep the legacy pre-cast payload path: their
+        # payload dtype is part of the program contract.
+        plan = None
+        if prec == precision.F32 and ingest_compiled():
+            try:
+                plan = fleet.ingest_plan(spec)
+            except Exception:  # noqa: BLE001 - planning never gates serving
+                plan = None
+        if plan is not None:
+            if raw is None:
+                raw = RawColumns.from_matrix(np.asarray(X, np.float32))
+            if raw.rows != rows:
+                rows = raw.rows
+                padded_rows = ladder.pad_to(rows, self.config.row_ladder)
+                if rows == 0 or padded_rows is None or (
+                    row_cap is not None and padded_rows > row_cap
+                ):
+                    self._count("fallback")
+                    return None
+            payload: Any = raw
         else:
-            payload = np.zeros((padded_rows,) + transformed.shape[1:], dtype)
-            payload[:rows] = transformed
+            transformed = _host_transform(model, X)
+            if int(transformed.shape[0]) != rows:
+                # a row-count-changing transformer: re-derive from the
+                # shape the fused program will actually see
+                rows = int(transformed.shape[0])
+                padded_rows = ladder.pad_to(rows, self.config.row_ladder)
+                if rows == 0 or padded_rows is None:
+                    self._count("fallback")
+                    return None
+                if row_cap is not None and padded_rows > row_cap:
+                    self._count("fallback")
+                    return None
+
+            # row padding happens HERE, on the (otherwise waiting) request
+            # thread — the dispatcher then stacks same-rung payloads in one
+            # numpy call (see the module docstring for why that matters).
+            # The payload dtype is derived from the effective precision
+            # (serve/precision.payload_dtype — THE one payload-dtype
+            # authority), so the stack path cannot silently upcast a
+            # reduced-precision program's inputs.
+            dtype = precision.payload_dtype(prec)
+            if rows == padded_rows:
+                payload = np.ascontiguousarray(transformed, dtype=dtype)
+            else:
+                payload = np.zeros((padded_rows,) + transformed.shape[1:], dtype)
+                payload[:rows] = transformed
 
         deadline = time.monotonic() + self.config.deadline_s
         # carry the request's trace identity into the queue ONLY when
@@ -331,12 +373,18 @@ class ServeEngine:
         try:
             # precision is part of the batch key: an f32 and a bf16
             # request for the same spec/rung must never share a fused
-            # program (mixed base/canary traffic during a hot-swap)
-            future = self._batcher.submit((fleet, spec, padded_rows, prec), item)
+            # program (mixed base/canary traffic during a hot-swap).
+            # So is the raw-vs-staged payload mode — a raw-column item
+            # and a pre-transformed one must never stack together.
+            future = self._batcher.submit(
+                (fleet, spec, padded_rows, prec, plan is not None), item
+            )
         except BatcherStopped:
             self._count("fallback")
             return None
         self._count("requests")
+        if plan is not None:
+            self._count("ingest_requests")
         try:
             recon, meta = future.result(timeout=self.config.deadline_s)
         except FutureTimeoutError:
@@ -366,7 +414,7 @@ class ServeEngine:
         return f"{type(spec).__name__}:{prec}:{name}"
 
     def _run_batch(self, key, items: List[BatchItem]) -> None:
-        fleet, spec, padded_rows, prec = key
+        fleet, spec, padded_rows, prec, raw_mode = key
         flush_start = time.monotonic()
         queue_waits = [flush_start - item.enqueued_at for item in items]
         with self._recorder.span(
@@ -396,6 +444,21 @@ class ServeEngine:
                     return
                 members = len(live)
                 padded_members = ladder.pad_to(members, self.member_ladder)
+                plan = None
+                if raw_mode:
+                    plan = fleet.ingest_plan(spec)
+                    if plan is None or plan.names != bucket_names:
+                        # the plan (or its membership) changed between
+                        # submit and flush — a hot-loaded member with a
+                        # non-affine pipeline, say. Rebuild the legacy
+                        # staged payloads host-side; correctness never
+                        # depends on the plan surviving the queue.
+                        self._count("ingest_replans", members)
+                        plan = None
+                        for item in live:
+                            item.payload = self._materialize_host(
+                                fleet, item, padded_rows, prec
+                            )
                 stack_s = time.monotonic() - stack_start
 
             # results / failures / fallbacks for THIS batch: the scoring
@@ -410,7 +473,7 @@ class ServeEngine:
             # host-side stacking time here so batch_stack keeps measuring
             # stacking (a stack regression must not read as a phantom
             # device slowdown)
-            timings = {"stack": 0.0}
+            timings = {"stack": 0.0, "device_ingest": 0.0}
             with self._recorder.span(
                 "device",
                 padded_members=padded_members,
@@ -421,11 +484,18 @@ class ServeEngine:
                 self._score_live(
                     fleet, spec, prec, padded_rows, live, stacked,
                     bucket_rows, results, failures, fallbacks, timings,
+                    plan=plan,
                 )
                 device_s = (
-                    time.monotonic() - device_start - timings["stack"]
+                    time.monotonic()
+                    - device_start
+                    - timings["stack"]
+                    - timings["device_ingest"]
                 )
             stack_s += timings["stack"]
+            ingest_s = timings["device_ingest"]
+            if plan is not None:
+                self._count("ingest_batches")
 
             with self._lock:
                 self._counters["batches"] += 1
@@ -451,6 +521,11 @@ class ServeEngine:
                         "batch_device": device_s,
                         "batch_scatter": time.monotonic() - scatter_start,
                     }
+                    if ingest_s > 0.0:
+                        # raw-column batches split out the wire→device
+                        # staging so the compiled path's win is
+                        # attributed (the device_ingest stage)
+                        meta["device_ingest"] = ingest_s
                     try:
                         fault_point(
                             "serve_scatter",
@@ -498,6 +573,7 @@ class ServeEngine:
                     spec, padded_members, padded_rows, prec
                 ),
                 device_ms=round(device_s * 1000.0, 3),
+                ingest_ms=round(ingest_s * 1000.0, 3),
                 isolated_failures=len(failures),
             )
             # link back to every request span this batch coalesced, with
@@ -540,6 +616,7 @@ class ServeEngine:
         failures: List,
         fallbacks: List,
         timings: Optional[Dict[str, float]] = None,
+        plan=None,
     ) -> None:
         """
         Score ``live`` with degradation, mirroring the build side's
@@ -564,12 +641,13 @@ class ServeEngine:
                 self._score_live(
                     fleet, spec, prec, padded_rows, live[start:start + cap],
                     stacked, bucket_rows, results, failures, fallbacks,
-                    timings,
+                    timings, plan=plan,
                 )
             return
         try:
             recon = self._fused_live(
-                spec, prec, padded_rows, live, stacked, bucket_rows, timings
+                spec, prec, padded_rows, live, stacked, bucket_rows, timings,
+                plan=plan,
             )
         except Exception as exc:
             if not is_device_error(exc):
@@ -596,10 +674,12 @@ class ServeEngine:
                 self._score_live(
                     fleet, spec, prec, padded_rows, live[:mid], stacked,
                     bucket_rows, results, failures, fallbacks, timings,
+                    plan=plan,
                 )
                 self._score_live(
                     fleet, spec, prec, padded_rows, live[mid:], stacked,
                     bucket_rows, results, failures, fallbacks, timings,
+                    plan=plan,
                 )
             else:
                 self._member_failure(
@@ -619,7 +699,12 @@ class ServeEngine:
             if self.config.finite_check and not bool(
                 np.isfinite(np.asarray(rows[: item.rows], np.float32)).all()
             ):
-                payload = np.asarray(item.payload[: item.rows], np.float32)
+                source = (
+                    item.payload.host_matrix()
+                    if isinstance(item.payload, RawColumns)
+                    else item.payload
+                )
+                payload = np.asarray(source[: item.rows], np.float32)
                 if bool(np.isfinite(payload).all()):
                     # finite input, non-finite output: the MEMBER is
                     # poisoned (a NaN'd parameter never crashes the
@@ -644,11 +729,21 @@ class ServeEngine:
         self, spec, prec: str, padded_rows: int, live: List[BatchItem],
         stacked, bucket_rows: Dict[str, int],
         timings: Optional[Dict[str, float]] = None,
+        plan=None,
     ) -> np.ndarray:
         """ONE fused gather program over ``live`` (no degradation —
         `_score_live` owns the ladder); returns the [n_live, padded_rows,
         F] host buffer. Also the serve-side program/compile accounting,
-        since bisection means one drained batch can run several shapes."""
+        since bisection means one drained batch can run several shapes.
+
+        With ``plan`` set the items carry raw wire columns: staging goes
+        per-item through ``ingest.to_device`` (dlpack when the columns
+        allow) and the batch is first assembled DEVICE-side — no host
+        ``column_stack``, no host pad. Identity plans then run the
+        classic program on the staged float32 batch (bit-for-bit the
+        legacy math); non-identity plans run the ingest program variant
+        whose prologue applies the compiled preprocessing.
+        """
         from ..server.fleet_store import fleet_forward_gather, serving_backend
 
         for item in live:
@@ -660,33 +755,62 @@ class ServeEngine:
         padded_members = ladder.pad_to(members, self.member_ladder)
         indices = [bucket_rows[item.name] for item in live]
         indices += [indices[0]] * (padded_members - members)
-        # payloads arrive pre-padded to this key's row rung at the
-        # effective precision's payload dtype (request-thread padding):
-        # the whole batch stacks in ONE numpy call, and the stack
-        # inherits the dtype — no per-item python work, no silent
-        # upcast, on the dispatcher thread
-        X = np.stack([item.payload for item in live])
-        if padded_members > members:
-            padded = np.zeros(
-                (padded_members, padded_rows, spec.n_features),
-                precision.payload_dtype(prec),
-            )
-            padded[:members] = X
-            X = padded
-        if timings is not None:
-            # stacking is host work: it accrues to the batch_stack
-            # stage, not to the device interval wrapping this call
-            timings["stack"] += time.monotonic() - stack_start
+        if plan is not None:
+            import jax.numpy as jnp
+
+            use_dlpack = dlpack_enabled()
+            device_rows = [
+                to_device(item.payload, padded_rows, dlpack=use_dlpack)
+                for item in live
+            ]
+            if padded_members > members:
+                pad_row = jnp.zeros((padded_rows, spec.n_features), jnp.float32)
+                device_rows += [pad_row] * (padded_members - members)
+            X: Any = jnp.stack(device_rows)
+            if timings is not None:
+                # wire→device staging is the device_ingest stage, split
+                # from both batch_stack (no host stacking happened) and
+                # batch_device (the fused program proper)
+                timings["device_ingest"] = (
+                    timings.get("device_ingest", 0.0)
+                    + time.monotonic()
+                    - stack_start
+                )
+        else:
+            # payloads arrive pre-padded to this key's row rung at the
+            # effective precision's payload dtype (request-thread padding):
+            # the whole batch stacks in ONE numpy call, and the stack
+            # inherits the dtype — no per-item python work, no silent
+            # upcast, on the dispatcher thread
+            X = np.stack([item.payload for item in live])
+            if padded_members > members:
+                padded = np.zeros(
+                    (padded_members, padded_rows, spec.n_features),
+                    precision.payload_dtype(prec),
+                )
+                padded[:members] = X
+                X = padded
+            if timings is not None:
+                # stacking is host work: it accrues to the batch_stack
+                # stage, not to the device interval wrapping this call
+                timings["stack"] += time.monotonic() - stack_start
         # member gather happens INSIDE the program — one device dispatch
         # per (sub-)batch, not one per parameter leaf
         recon = np.asarray(
             fleet_forward_gather(
                 spec, stacked, np.asarray(indices, np.int32), X,
                 precision=prec,
+                ingest=None
+                if plan is None or plan.identity
+                else (plan.scale, plan.offset),
             )
+        )
+        variant = (
+            "ingest" if plan is not None and not plan.identity else "payload"
         )
         program = (
             spec, serving_backend(prec), padded_members, padded_rows, prec,
+            variant,
         )
         with self._lock:
             new_program = program not in self._programs
@@ -696,6 +820,26 @@ class ServeEngine:
         # this batch's device call
         note_program_execution(new_program, kind="serve", precision=prec)
         return recon
+
+    def _materialize_host(
+        self, fleet, item: BatchItem, padded_rows: int, prec: str
+    ) -> np.ndarray:
+        """A raw-column item's legacy staged payload (host transform +
+        row pad at the precision's payload dtype) — the escape hatch for
+        a batch whose compiled plan disappeared between submit and
+        flush."""
+        from ..server.fleet_store import _host_transform
+
+        model = fleet.model(item.name)
+        transformed = _host_transform(
+            model, item.payload.host_matrix()[: item.rows]
+        )
+        dtype = precision.payload_dtype(prec)
+        if int(transformed.shape[0]) == padded_rows:
+            return np.ascontiguousarray(transformed, dtype=dtype)
+        payload = np.zeros((padded_rows,) + transformed.shape[1:], dtype)
+        payload[: transformed.shape[0]] = transformed
+        return payload
 
     def _member_failure(
         self,
@@ -974,32 +1118,52 @@ class ServeEngine:
                 continue
             n_bucket = len(bucket_names)
             dtype = precision.payload_dtype(prec)
+            # with a compiled non-identity plan resident, f32 traffic
+            # runs the INGEST program variant — warm that one too, so
+            # the first raw-column batch finds its prologue compiled
+            plan = None
+            if prec == precision.F32 and ingest_compiled():
+                try:
+                    plan = fleet.ingest_plan(spec)
+                except Exception:  # noqa: BLE001 - warmup is best-effort
+                    plan = None
+            variants = [("payload", None)]
+            if plan is not None and not plan.identity:
+                variants.append(("ingest", (plan.scale, plan.offset)))
             for padded_members in self.member_ladder:
                 indices = np.arange(padded_members, dtype=np.int32) % n_bucket
                 for padded_rows in warm_rows:
-                    program = (spec, backend, padded_members, padded_rows, prec)
-                    with self._lock:
-                        new = program not in self._programs
-                        if new:
-                            self._programs.add(program)
-                    if not new:
-                        continue
-                    X = np.zeros(
-                        (padded_members, padded_rows, spec.n_features), dtype
-                    )
-                    with self._recorder.span(
-                        "warmup_program",
-                        padded_members=padded_members,
-                        padded_rows=padded_rows,
-                        precision=prec,
-                    ):
-                        np.asarray(
-                            fleet_forward_gather(
-                                spec, stacked, indices, X, precision=prec
-                            )
+                    for variant, ingest_arrays in variants:
+                        program = (
+                            spec, backend, padded_members, padded_rows, prec,
+                            variant,
                         )
-                    note_program_execution(True, kind="serve", precision=prec)
-                    compiled += 1
+                        with self._lock:
+                            new = program not in self._programs
+                            if new:
+                                self._programs.add(program)
+                        if not new:
+                            continue
+                        X = np.zeros(
+                            (padded_members, padded_rows, spec.n_features),
+                            np.float32 if variant == "ingest" else dtype,
+                        )
+                        with self._recorder.span(
+                            "warmup_program",
+                            padded_members=padded_members,
+                            padded_rows=padded_rows,
+                            precision=prec,
+                        ):
+                            np.asarray(
+                                fleet_forward_gather(
+                                    spec, stacked, indices, X, precision=prec,
+                                    ingest=ingest_arrays,
+                                )
+                            )
+                        note_program_execution(
+                            True, kind="serve", precision=prec
+                        )
+                        compiled += 1
         self._count("warmup_programs", compiled)
         if self.metrics is not None:
             try:
@@ -1038,12 +1202,18 @@ class ServeEngine:
         stats["pending"] = self._batcher.pending()
         stats["breaker"] = self.breakers.summary()
         stats["demoted_rungs"] = demotions
+        stats["ingest"] = {
+            "compiled": ingest_compiled(),
+            "dlpack": dlpack_enabled(),
+            **ingest_stats(),
+        }
         return stats
 
     def program_shapes(self) -> List[Tuple]:
         with self._lock:
             return sorted(
-                (repr(s), b, m, r, p) for (s, b, m, r, p) in self._programs
+                (repr(s), b, m, r, p, v)
+                for (s, b, m, r, p, v) in self._programs
             )
 
     def shutdown(self, drain: bool = True) -> None:

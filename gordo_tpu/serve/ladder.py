@@ -20,6 +20,7 @@ from ..planner.ladder import (  # noqa: F401
     pad_to,
     parse_ladder,
     row_ladder,
+    snap_rows,
 )
 
 __all__ = [
@@ -29,4 +30,5 @@ __all__ = [
     "pad_to",
     "parse_ladder",
     "row_ladder",
+    "snap_rows",
 ]

@@ -98,6 +98,7 @@ class RequestContext:
         "deferred_stage",
         "X",
         "y",
+        "ingest",
     )
 
     def __init__(self, request: Request, config: Dict[str, Any]):
@@ -152,6 +153,11 @@ class RequestContext:
         self.deferred_stage: Optional[tuple] = None
         self.X = None
         self.y = None
+        # Raw wire columns (ingest.RawColumns) stashed by the Arrow
+        # decode when they align with the model's tag order — the
+        # device-resident ingest path scores them without the host
+        # column_stack staging copy.
+        self.ingest = None
 
     @contextlib.contextmanager
     def stage(self, name: str):

@@ -53,6 +53,11 @@ def batched_model_output(ctx, gordo_name: str, X) -> Optional[np.ndarray]:
     :class:`gordo_tpu.serve.ServeDeviceError` → 500,
     :class:`gordo_tpu.serve.DeadlineExceeded` → 504) propagate to the
     route, which maps them via :func:`shed_response`.
+
+    The request's decoded wire columns (``ctx.ingest``, stashed by the
+    Arrow decode when they align with the model's tag order) ride along
+    so the engine can batch RAW — preprocessing compiled into the fused
+    program instead of run per request on this thread.
     """
     from ..serve import get_engine
 
@@ -60,8 +65,118 @@ def batched_model_output(ctx, gordo_name: str, X) -> Optional[np.ndarray]:
     if engine is None:
         return None
     return engine.batched_predict(
-        ctx.collection_dir, gordo_name, ctx.model, X, timing=ctx.timing
+        ctx.collection_dir,
+        gordo_name,
+        ctx.model,
+        X,
+        timing=ctx.timing,
+        raw=getattr(ctx, "ingest", None),
     )
+
+
+class CompiledInput:
+    """A request staged for the compiled UNBATCHED path: the device-
+    resident input batch plus everything :func:`compiled_output` needs
+    to run the fused gather program for one member."""
+
+    __slots__ = ("spec", "stacked", "index", "plan", "X_dev", "rows")
+
+    def __init__(self, spec, stacked, index: int, plan, X_dev, rows: int):
+        self.spec = spec
+        self.stacked = stacked
+        self.index = index
+        self.plan = plan
+        self.X_dev = X_dev
+        self.rows = rows
+
+
+def stage_compiled_input(ctx, gordo_name: str, X) -> Optional[CompiledInput]:
+    """
+    Stage one request's input onto the device for the compiled
+    (engine-less) scoring path, or None → the caller keeps the host
+    ``model.predict`` path. Meant to run inside the view's
+    ``device_ingest`` stage: everything here is wire→device staging —
+    the raw columns (``ctx.ingest`` when the Arrow decode stashed them,
+    else the already-decoded matrix) cross via
+    :func:`gordo_tpu.ingest.to_device`, row-padded on a geometric
+    sample-ladder rung so the executable count stays bounded at ≤25%
+    padded compute.
+
+    Eligibility mirrors the micro-batcher: a feedforward spec with a
+    resident compiled preprocessing plan (``RevisionFleet.ingest_plan``
+    — identity plans included, where the compiled path is bit-identical
+    to the host path). Anything else — non-affine pipelines, LSTM
+    specs, width mismatches — answers None and costs one cached probe.
+    """
+    from ..ingest import RawColumns, compiled_enabled, dlpack_enabled, to_device
+    from ..models.spec import FeedForwardSpec
+    from ..planner import ladder
+    from .fleet_store import STORE, _find_estimator
+
+    if not compiled_enabled():
+        return None
+    estimator = _find_estimator(ctx.model)
+    if estimator is None or not isinstance(
+        getattr(estimator, "spec_", None), FeedForwardSpec
+    ):
+        return None
+    spec = estimator.spec_
+    fleet = STORE.fleet(ctx.collection_dir)
+    try:
+        plan = fleet.ingest_plan(spec)
+    except Exception:  # noqa: BLE001 - planning never gates serving
+        plan = None
+    if plan is None:
+        return None
+    try:
+        bucket_names, stacked = fleet.spec_bucket(spec)
+    except KeyError:
+        return None
+    try:
+        index = bucket_names.index(gordo_name)
+    except ValueError:
+        return None
+    raw = getattr(ctx, "ingest", None)
+    rows = int(len(X))
+    if raw is None or raw.rows != rows:
+        raw = RawColumns.from_matrix(np.asarray(X, np.float32))
+    if raw.rows == 0 or raw.width != spec.n_features:
+        return None
+    # quantize rows on the packed-sample geometric ladder (ratio 1.25,
+    # whole multiples of 32), NOT the serve row ladder: the batcher's
+    # coarse rungs exist for arrival coalescing and waste up to 4x
+    # compute on a single request (256 rows -> the 512 rung doubles the
+    # fused program's work), while the legacy host path this replaces
+    # compiles per EXACT row count per member — geometric rungs bound
+    # the executable count (~22 rungs to 8k rows, shared by the whole
+    # bucket) and cap padded compute at 25%
+    padded_rows = ladder.round_up_ladder(
+        rows, ladder.sample_pad_ratio(), multiple=32
+    )
+    X_dev = to_device(raw, padded_rows=padded_rows, dlpack=dlpack_enabled())
+    return CompiledInput(spec, stacked, index, plan, X_dev, rows)
+
+
+def compiled_output(staged: CompiledInput) -> np.ndarray:
+    """Run the fused gather program for one staged request (the view's
+    ``inference`` stage): identity plans run the classic program on the
+    staged float32 rows — bit-identical to the host predict — and
+    non-identity plans run the ingest variant whose prologue applies
+    the compiled preprocessing. Returns the member's reconstruction
+    rows (padding sliced off)."""
+    from .fleet_store import fleet_forward_gather
+
+    plan = staged.plan
+    recon = np.asarray(
+        fleet_forward_gather(
+            staged.spec,
+            staged.stacked,
+            np.asarray([staged.index], np.int32),
+            staged.X_dev[None],
+            ingest=None if plan.identity else (plan.scale, plan.offset),
+        )
+    )
+    return recon[0, : staged.rows]
 
 
 def shed_response(ctx, exc):

@@ -130,8 +130,11 @@ class GordoServerPrometheusMetrics:
         )
         # stage labels are bounded: endpoint is the route map's endpoint
         # name, stage the handler-instrumented pipeline stage set
-        # (model_resolve/data_decode/inference/response_assemble/
-        # serialize + the micro-batcher's queue_wait/batch_* intervals)
+        # (model_resolve/data_decode/device_ingest/inference/
+        # response_assemble/serialize + the micro-batcher's
+        # queue_wait/batch_* intervals); data_decode is wire→host parse
+        # only — the wire→device staging it used to hide is the
+        # device_ingest stage
         self.stage_duration = Histogram(
             "gordo_server_stage_duration_seconds",
             "Per-request pipeline-stage wall-time (one observation per "

@@ -317,6 +317,28 @@ def _extract_arrow(ctx) -> None:
         raise
     except (ValueError, TypeError) as exc:
         raise ServerError(f"Invalid Arrow body: {exc}", status=400)
+    _stash_raw_columns(ctx, x_columns, index)
+
+
+def _stash_raw_columns(ctx, x_columns, index) -> None:
+    """Keep the decoded X column views beside the assembled frame
+    (``ctx.ingest``) so the device-resident ingest path can dlpack them
+    straight to the device, skipping the ``column_stack`` staging copy.
+    Only when the stash would match the frame row-for-row: a
+    non-monotonic index means ``columns_to_frame`` re-sorted rows, and a
+    positional rename means ``ctx.X.columns`` no longer key into the
+    wire columns — both fall back to the frame path (skipping the stash
+    is always correct, never wrong)."""
+    from ..ingest import RawColumns
+
+    if index is not None and not index.is_monotonic_increasing:
+        return
+    try:
+        columns = [np.asarray(x_columns[name]) for name in ctx.X.columns]
+    except KeyError:
+        return
+    if columns and all(c.ndim == 1 for c in columns):
+        ctx.ingest = RawColumns.from_columns(columns)
 
 
 def extract_X_y(ctx) -> None:

@@ -37,7 +37,7 @@ def _unprocessable_response(ctx):
 
 
 def post_anomaly_prediction(ctx, gordo_project: str, gordo_name: str):
-    from ...serve import BatchShedError
+    from ...serve import BatchShedError, get_engine
     from .. import model_io, wire
     from .base import encode_wire_response
 
@@ -65,6 +65,16 @@ def post_anomaly_prediction(ctx, gordo_project: str, gordo_name: str):
     )
     anomaly_df = None
     model_output = None
+    # device_ingest: stage the request onto the device for the compiled
+    # (engine-less) path — sequential with inference, like the base
+    # route, so the stage split attributes wire→device staging apart
+    # from the device program itself.
+    staged = None
+    if get_engine() is None and (
+        fast or model_io.accepts_model_output(ctx.model)
+    ):
+        with ctx.stage("device_ingest"):
+            staged = model_io.stage_compiled_input(ctx, gordo_name, ctx.X)
     try:
         with ctx.stage("inference"):
             # Micro-batching: when the detector accepts a precomputed
@@ -76,6 +86,14 @@ def post_anomaly_prediction(ctx, gordo_project: str, gordo_name: str):
                 model_output = model_io.batched_model_output(
                     ctx, gordo_name, ctx.X
                 )
+            if model_output is None and staged is not None:
+                try:
+                    model_output = model_io.compiled_output(staged)
+                except Exception:  # noqa: BLE001 - compiled path is an
+                    # optimization; device refusal → host fallback
+                    logger.exception(
+                        "compiled ingest scoring failed; host fallback"
+                    )
             if fast:
                 if model_output is None:
                     # the same reconstruction anomaly() would compute

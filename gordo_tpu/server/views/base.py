@@ -120,7 +120,7 @@ def post_prediction(ctx, gordo_project: str, gordo_name: str):
     (``gordo_tpu.serve``); admission control maps to 429/504 and
     everything unbatchable falls back to the model's own predict.
     """
-    from ...serve import BatchShedError
+    from ...serve import BatchShedError, get_engine
     from .. import wire
 
     with ctx.stage("model_resolve"):
@@ -135,9 +135,31 @@ def post_prediction(ctx, gordo_project: str, gordo_name: str):
     X = ctx.X
     process_request_start_time_s = timeit.default_timer()
 
+    # device_ingest is its own stage, SEQUENTIAL with (never nested in)
+    # inference: the wire→device staging the compiled path does is the
+    # cost data_decode used to hide, and the stage attribution must show
+    # the two separately (docs/observability.md "Stage reference"). With
+    # the micro-batcher on, the engine stages instead and reports its
+    # own device_ingest interval.
+    staged = None
+    if get_engine() is None:
+        with ctx.stage("device_ingest"):
+            staged = model_io.stage_compiled_input(ctx, gordo_name, X)
+
     try:
         with ctx.stage("inference"):
-            output = model_io.batched_model_output(ctx, gordo_name, X)
+            output = None
+            if staged is not None:
+                try:
+                    output = model_io.compiled_output(staged)
+                except Exception:  # noqa: BLE001 - the compiled path is
+                    # an optimization: a device refusal keeps the host
+                    # predict path, never fails the request
+                    logger.exception(
+                        "compiled ingest scoring failed; host fallback"
+                    )
+            if output is None:
+                output = model_io.batched_model_output(ctx, gordo_name, X)
             if output is None:
                 output = model_io.get_model_output(model=ctx.model, X=X)
     except BatchShedError as exc:

@@ -54,6 +54,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..planner import ladder
 from ..utils.faults import fault_point
 from .events import StreamEvent
 from .session import StreamSession
@@ -241,7 +242,16 @@ class WindowScorer:
         }
 
         flush_started = time.time()
-        cut = session.cut_windows(self.window_rows, skip=tuple(quarantined))
+        # multi-window spans snap onto the serve row ladder
+        # (planner.ladder.snap_rows): a backlog flush runs the SAME
+        # compiled shape the request plane batches into instead of
+        # minting a worst-case-padded one; the remainder windows stay
+        # buffered and ride the next watermark flush
+        cut = session.cut_windows(
+            self.window_rows,
+            skip=tuple(quarantined),
+            snap=lambda pending: ladder.snap_rows(pending, self.window_rows),
+        )
         if not cut:
             return summary
 

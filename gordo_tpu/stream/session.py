@@ -224,9 +224,12 @@ class StreamSession:
             )
 
     def cut_windows(
-        self, window_rows: int, skip: Sequence[str] = ()
+        self,
+        window_rows: int,
+        skip: Sequence[str] = (),
+        snap: Optional[Any] = None,
     ) -> Dict[str, Tuple[List[Any], int, int, int, float]]:
-        """Pop every full watermark window: ``{machine: (chunks,
+        """Pop pending full watermark windows: ``{machine: (chunks,
         first_seq, last_seq, windows, oldest_ts)}``. Multiple pending
         windows for a machine come out as ONE contiguous span (scored in
         one fused call, counted as ``windows``); ``oldest_ts`` is the
@@ -234,13 +237,28 @@ class StreamSession:
         ingest→scored lag anchor. Machines in ``skip`` (quarantined
         members) keep their rows buffered — their ring keeps absorbing
         (and, under pressure, shedding oldest-first) until the breaker's
-        half-open probe lets scoring resume."""
+        half-open probe lets scoring resume.
+
+        ``snap`` (``pending_rows -> rows_to_cut``, a whole-window
+        multiple — :func:`gordo_tpu.planner.ladder.snap_rows`) quantizes
+        big multi-window spans onto the serve row ladder so backlog
+        flushes reuse the request plane's compiled shapes; the un-taken
+        remainder stays buffered (still counted pending — the zero-gap
+        invariant is untouched) and rides the next watermark flush."""
         out: Dict[str, Tuple[List[Any], int, int, int, float]] = {}
         with self._wake:
             for name, chan in self.channels.items():
                 if name in skip:
                     continue
-                windows = chan.ring.pending_rows // window_rows
+                pending = chan.ring.pending_rows
+                if snap is not None:
+                    take_rows = int(snap(pending))
+                    # defensive: a snap that is not a whole-window
+                    # multiple would break the span accounting
+                    take_rows -= take_rows % window_rows
+                else:
+                    take_rows = (pending // window_rows) * window_rows
+                windows = take_rows // window_rows
                 if windows <= 0:
                     continue
                 taken = chan.ring.take(windows * window_rows)

@@ -77,12 +77,26 @@ GATES: Dict[str, List[MetricSpec]] = {
             "max_bound",
             bound=50.0,
         ),
+        # tightened 3.0 -> 1.5 by the device-resident ingest subsystem
+        # (PR 19): with decode, staging and preprocessing all columnar/
+        # on-device, the route may cost at most 1.5x the scoring-only
+        # floor at matched concurrency
         MetricSpec(
             "columnar (Arrow) route p50 vs scoring-only floor at "
             "matched concurrency (ratio)",
             "route_gap_p50_ratio",
             "max_bound",
-            bound=3.0,
+            bound=1.5,
+        ),
+        # wire parse + device staging together must stay a small
+        # absolute cost per request (the stages the ingest subsystem
+        # owns: data_decode narrowed to wire->host parse, device_ingest
+        # the wire->device staging it used to hide)
+        MetricSpec(
+            "data_decode + device_ingest p50 budget (ms)",
+            "ingest_p50_ms",
+            "max_bound",
+            bound=10.0,
         ),
         # route-level batching must stay at least at parity with
         # batching-off (noise margin included) — the wash PR 7 measured
@@ -383,6 +397,43 @@ GATES: Dict[str, List[MetricSpec]] = {
             bound=100.0,
         ),
     ],
+    "device-ingest": [
+        # compiled-vs-host numeric parity on the same payloads is the
+        # subsystem's contract — a fast wrong answer fails the run
+        MetricSpec(
+            "compiled plan output matches the host pipeline",
+            "parity_ok",
+            "truthy",
+        ),
+        MetricSpec(
+            "broken-dlpack fallback still answers correct bytes",
+            "fallback_ok",
+            "truthy",
+        ),
+        # the rung dlpack_enabled() picks for this backend vs forced
+        # host staging: on CPU both are the host rung, so parity is the
+        # ceiling and the floor catches the picked rung REGRESSING (the
+        # precision-ladder min_bound pattern); the dlpack zero-copy win
+        # itself asserts on device hardware
+        MetricSpec(
+            "serving transfer rung vs host staging throughput (ratio)",
+            "transfer.speedup",
+            "min_bound",
+            bound=0.4,
+        ),
+        MetricSpec(
+            "compiled-plan vs host-pipeline scoring throughput (ratio)",
+            "compiled.speedup",
+            "min_bound",
+            bound=0.5,
+        ),
+        MetricSpec(
+            "end-to-end staging p50 budget (ms)",
+            "compiled.staged_p50_ms",
+            "max_bound",
+            bound=10.0,
+        ),
+    ],
     "slo-engine": [
         MetricSpec(
             "rollup aggregation throughput (spans/s)",
@@ -418,6 +469,7 @@ BASELINE_FILES: Dict[str, str] = {
     "precision-ladder": "BENCH_PRECISION.json",
     "serve-chaos": "BENCH_CHAOS.json",
     "stream-soak": "BENCH_STREAM.json",
+    "device-ingest": "BENCH_INGEST.json",
 }
 
 

@@ -495,6 +495,26 @@ KNOBS: Dict[str, Knob] = _knobs(
         "Serving",
     ),
     Knob(
+        "GORDO_TPU_INGEST_COMPILED", "bool", True,
+        "Compiled preprocessing plans (`gordo_tpu.ingest`): per-member "
+        "scaler affines are extracted into stacked device arrays cached "
+        "on the revision fleet, and scale/transform runs inside the "
+        "fused gather program. Off = every route materializes "
+        "transformed inputs host-side (the legacy path).",
+        "Serving",
+    ),
+    Knob(
+        "GORDO_TPU_INGEST_DLPACK", "bool", True,
+        "Per-column dlpack device transfer for raw wire columns "
+        "(`gordo_tpu.ingest.to_device`) — skips the intermediate host "
+        "`column_stack`. Only engages on accelerator backends: on CPU "
+        "both rungs stage through host memory, so host staging is the "
+        "fast rung regardless of this knob. Any per-request dlpack "
+        "failure (and off) falls back to host staging, counted by "
+        "reason in `ingest_stats()['fallback_reasons']`.",
+        "Serving",
+    ),
+    Knob(
         "GORDO_TPU_SERVE_FINITE_CHECK", "bool", True,
         "Scan every fused batch's output for non-finite (NaN/inf) rows: "
         "a member producing them from FINITE input is poisoned and "

@@ -88,6 +88,10 @@ case "$component" in
     # manifest, bounded fleet-status, breaker summaries) lives in
     # tests/telemetry + tests/server — marker-selected the same way.
     scale)    run -m "scale and not slow" tests/ ;;
+    # The device-resident ingest suite cuts across tests/ingest,
+    # tests/server and tests/serve (compiled plans, raw-column
+    # transfer, parity, stream snap) — marker-selected the same way.
+    ingest)   run -m "ingest and not slow" tests/ ;;
     utils)    run -m "not slow" tests/utils ;;
     workflow) run -m "not slow" tests/workflow ;;
     formatting) run tests/test_codestyle.py ;;

@@ -1,0 +1,105 @@
+"""
+Ingest-suite fixtures: a model collection whose machines exercise every
+compiled-preprocessing shape — two same-architecture detectors whose
+base estimators are sklearn Pipelines with REAL fitted scalers (a
+MinMaxScaler and a StandardScaler, one spec bucket, non-identity plans)
+plus one bare hourglass machine (the identity plan, where the compiled
+path must stay bit-identical to the host path).
+"""
+
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu import serializer
+from gordo_tpu.builder import local_build
+from gordo_tpu.server import build_app
+from gordo_tpu.server.fleet_store import STORE
+
+from tests.server.conftest import temp_env_vars  # noqa: F401 (re-export)
+
+PROJECT = "ingest-project"
+REVISION = "1710000000000"
+
+SCALED_NAMES = ["scaled-mm", "scaled-std"]
+
+#: the two scaled machines share ONE feedforward architecture (so their
+#: member plans stack into one FleetIngestPlan); the scalers differ so
+#: the stacked scale/offset rows must differ per member
+INGEST_CONFIG = """
+machines:
+  - name: scaled-mm
+    dataset: &ds
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-05T00:00:00+00:00"
+      tag_list: [ing-1, ing-2, ing-3, ing-4]
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          sklearn.pipeline.Pipeline:
+            steps:
+              - sklearn.preprocessing.MinMaxScaler
+              - gordo_tpu.models.JaxAutoEncoder:
+                  kind: feedforward_model
+                  encoding_dim: [8, 4]
+                  encoding_func: [tanh, tanh]
+                  decoding_dim: [4, 8]
+                  decoding_func: [tanh, tanh]
+                  epochs: 1
+  - name: scaled-std
+    dataset: *ds
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          sklearn.pipeline.Pipeline:
+            steps:
+              - sklearn.preprocessing.StandardScaler
+              - gordo_tpu.models.JaxAutoEncoder:
+                  kind: feedforward_model
+                  encoding_dim: [8, 4]
+                  encoding_func: [tanh, tanh]
+                  decoding_dim: [4, 8]
+                  decoding_func: [tanh, tanh]
+                  epochs: 1
+  - name: plain-id
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-05T00:00:00+00:00"
+      tag_list: [ing-1, ing-2]
+    model:
+      gordo_tpu.models.JaxAutoEncoder:
+        kind: feedforward_hourglass
+        compression_factor: 0.5
+        encoding_layers: 1
+        epochs: 1
+"""
+
+
+@pytest.fixture(scope="session")
+def ingest_collection(tmp_path_factory):
+    root = tmp_path_factory.mktemp("ingest-collection") / REVISION
+    for model, machine in local_build(INGEST_CONFIG, project_name=PROJECT):
+        serializer.dump(
+            model, str(root / machine.name), metadata=machine.to_dict()
+        )
+    return str(root)
+
+
+@pytest.fixture
+def ingest_client(ingest_collection):
+    with temp_env_vars(MODEL_COLLECTION_DIR=ingest_collection):
+        STORE.clear()
+        yield Client(build_app(config={}))
+    STORE.clear()
+
+
+@pytest.fixture(scope="session")
+def scaled_payload():
+    """A 5-row JSON X/y payload matching the scaled machines' tags."""
+    index = [f"2020-03-01T00:{m:02d}:00+00:00" for m in range(0, 50, 10)]
+    values = {
+        f"ing-{i}": {ts: 0.2 * i + 0.03 * j for j, ts in enumerate(index)}
+        for i in range(1, 5)
+    }
+    return {"X": values, "y": values}

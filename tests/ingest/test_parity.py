@@ -1,0 +1,330 @@
+"""
+Compiled-vs-host parity: the device-resident ingest path must answer the
+SAME scores the host sklearn walk answers — across wire formats
+(JSON × Arrow), batching modes (micro-batched × unbatched), routes
+(prediction / anomaly / fleet / windowed), transfer rungs (dlpack × host
+staging), and a mid-batch hot-swap that invalidates the compiled plan.
+Identity plans (bare estimators) must stay BIT-identical; non-identity
+plans compute float32 on device against the host's float64-then-cast, so
+they pin tolerance parity plus verdict agreement.
+"""
+
+import json
+import re
+
+import numpy as np
+import pandas as pd
+import pytest
+from werkzeug.test import Client
+
+from gordo_tpu.ingest import INGEST_COMPILED_ENV, INGEST_DLPACK_ENV
+from gordo_tpu.server import build_app, wire
+from gordo_tpu.server.fleet_store import STORE
+
+from tests.server.conftest import temp_env_vars
+
+from .conftest import PROJECT
+
+pytestmark = pytest.mark.ingest
+
+TIME_RE = re.compile(rb'"time-seconds": "[0-9.]+"')
+
+
+def _norm(body: bytes) -> bytes:
+    """Blank the per-request wall-clock field before byte comparison."""
+    return TIME_RE.sub(b'"time-seconds": "T"', body)
+
+
+def _leaves(node, path=()):
+    """Flatten a nested response dict to {path: leaf-list} at the level
+    where values stop being dicts (routes differ in nesting depth)."""
+    out = {}
+    for key, value in node.items():
+        if isinstance(value, dict):
+            if value and not any(isinstance(v, dict) for v in value.values()):
+                out[path + (key,)] = list(value.values())
+            else:
+                out.update(_leaves(value, path + (key,)))
+        else:
+            out[path + (key,)] = value
+    return out
+
+
+def _frame(payload):
+    X = pd.DataFrame(
+        {tag: list(col.values()) for tag, col in payload["X"].items()},
+        index=pd.DatetimeIndex(
+            list(next(iter(payload["X"].values())))
+        ),
+    )
+    return X
+
+
+def _json_arrays(resp):
+    """Every numeric leaf of a JSON scoring response as {path: array}."""
+    data = json.loads(resp.data)["data"]
+    out = {}
+    for group, subs in data.items():
+        for sub, cells in subs.items():
+            values = list(cells.values())
+            try:
+                out[(group, sub)] = np.asarray(values, dtype=float)
+            except (TypeError, ValueError):
+                out[(group, sub)] = np.asarray(values, dtype=object)
+    return out
+
+
+def _assert_close(got, want, rtol=2e-3, atol=1e-4):
+    assert set(got) == set(want)
+    for key in want:
+        if want[key].dtype == object:
+            np.testing.assert_array_equal(got[key], want[key], err_msg=str(key))
+        else:
+            np.testing.assert_allclose(
+                got[key], want[key], rtol=rtol, atol=atol, err_msg=str(key)
+            )
+
+
+def _post(collection_dir, path, payload=None, data=None, headers=None):
+    client = Client(build_app(config={}))
+    if data is not None:
+        resp = client.post(path, data=data, headers=headers)
+    else:
+        resp = client.post(path, json=payload)
+    assert resp.status_code == 200, resp.data[:300]
+    return resp
+
+
+def _compiled_vs_host(collection_dir, path, payload):
+    """The same request with the compiled plan on and off."""
+    responses = {}
+    with temp_env_vars(MODEL_COLLECTION_DIR=collection_dir):
+        for switch in ("1", "0"):
+            with temp_env_vars(**{INGEST_COMPILED_ENV: switch}):
+                STORE.clear()
+                responses[switch] = _post(collection_dir, path, payload)
+    return responses
+
+
+@pytest.mark.parametrize("route", ["prediction", "anomaly/prediction"])
+@pytest.mark.parametrize("name", ["scaled-mm", "scaled-std"])
+def test_compiled_scaler_matches_host_json(
+    ingest_collection, scaled_payload, route, name
+):
+    responses = _compiled_vs_host(
+        ingest_collection, f"/gordo/v0/{PROJECT}/{name}/{route}", scaled_payload
+    )
+    _assert_close(
+        _json_arrays(responses["1"]), _json_arrays(responses["0"])
+    )
+
+
+def test_identity_plan_is_bit_identical(ingest_collection):
+    """Bare-estimator machines run the classic program on the compiled
+    path: identical BYTES with the plan on and off."""
+    index = [f"2020-03-01T00:{m:02d}:00+00:00" for m in range(0, 50, 10)]
+    payload = {
+        "X": {
+            f"ing-{i}": {ts: 0.3 * i + 0.05 * j for j, ts in enumerate(index)}
+            for i in (1, 2)
+        }
+    }
+    responses = _compiled_vs_host(
+        ingest_collection, f"/gordo/v0/{PROJECT}/plain-id/prediction", payload
+    )
+    assert _norm(responses["1"].data) == _norm(responses["0"].data)
+
+
+def test_arrow_wire_matches_json_with_compiled_ingest(
+    ingest_collection, scaled_payload
+):
+    """Arrow requests ride the raw-column stash + dlpack rung; JSON
+    requests stage from the decoded matrix — same verdicts."""
+    X = _frame(scaled_payload)
+    path = f"/gordo/v0/{PROJECT}/scaled-mm/anomaly/prediction"
+    with temp_env_vars(MODEL_COLLECTION_DIR=ingest_collection):
+        STORE.clear()
+        json_resp = _post(ingest_collection, path, scaled_payload)
+        arrow_resp = _post(
+            ingest_collection,
+            path,
+            data=wire.encode_request(X, X),
+            headers={"Content-Type": wire.ARROW_CONTENT_TYPE},
+        )
+    _assert_close(
+        _json_arrays(arrow_resp),
+        _json_arrays(json_resp),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_dlpack_rung_matches_host_staging_exactly(
+    ingest_collection, scaled_payload
+):
+    """The two transfer rungs move the same float32 values — identical
+    bytes, not just tolerance parity."""
+    X = _frame(scaled_payload)
+    path = f"/gordo/v0/{PROJECT}/scaled-mm/prediction"
+    bodies = {}
+    with temp_env_vars(MODEL_COLLECTION_DIR=ingest_collection):
+        for switch in ("1", "0"):
+            with temp_env_vars(**{INGEST_DLPACK_ENV: switch}):
+                STORE.clear()
+                bodies[switch] = _post(
+                    ingest_collection,
+                    path,
+                    data=wire.encode_request(X),
+                    headers={"Content-Type": wire.ARROW_CONTENT_TYPE},
+                ).data
+    assert _norm(bodies["1"]) == _norm(bodies["0"])
+
+
+def test_fleet_route_compiled_matches_host(ingest_collection, scaled_payload):
+    """The fleet route applies the plan host-side from the cached
+    host_scale/host_offset copies — same verdicts as the sklearn walk."""
+    payload = {
+        "X": {
+            "scaled-mm": scaled_payload["X"],
+            "scaled-std": scaled_payload["X"],
+        }
+    }
+    responses = _compiled_vs_host(
+        ingest_collection, f"/gordo/v0/{PROJECT}/prediction/fleet", payload
+    )
+    on = json.loads(responses["1"].data)
+    off = json.loads(responses["0"].data)
+    assert on.get("errors", {}) == off.get("errors", {}) == {}
+    got, want = _leaves(on["data"]), _leaves(off["data"])
+    assert set(got) == set(want)
+    for path, cells in want.items():
+        try:
+            want_arr = np.asarray(cells, dtype=float)
+        except (TypeError, ValueError):
+            np.testing.assert_array_equal(got[path], cells, err_msg=str(path))
+            continue
+        np.testing.assert_allclose(
+            np.asarray(got[path], dtype=float),
+            want_arr,
+            rtol=2e-3,
+            atol=1e-4,
+            err_msg=str(path),
+        )
+
+
+def test_batched_compiled_matches_unbatched_host(
+    ingest_collection, scaled_payload
+):
+    """Micro-batched raw-column scoring (the fused preprocess prologue)
+    vs the unbatched host path: same scores for the same rows."""
+    from tests.serve.conftest import installed_engine
+
+    path = f"/gordo/v0/{PROJECT}/scaled-mm/anomaly/prediction"
+    with temp_env_vars(MODEL_COLLECTION_DIR=ingest_collection):
+        with temp_env_vars(**{INGEST_COMPILED_ENV: "0"}):
+            STORE.clear()
+            host = _post(ingest_collection, path, scaled_payload)
+        STORE.clear()
+        with installed_engine() as engine:
+            batched = _post(ingest_collection, path, scaled_payload)
+            stats = engine.stats()
+    assert stats["ingest_requests"] >= 1
+    assert stats["ingest_batches"] >= 1
+    _assert_close(_json_arrays(batched), _json_arrays(host))
+
+
+def test_mid_batch_hot_swap_replans_to_host_path(
+    ingest_collection, scaled_payload, monkeypatch
+):
+    """A plan whose member list no longer matches the bucket at flush
+    time (a hot-load landed between admission and flush) must be
+    discarded: the batch re-materializes legacy payloads, counts a
+    replan, and still answers the right scores."""
+    from gordo_tpu.ingest.plan import FleetIngestPlan
+    from gordo_tpu.server.fleet_store import RevisionFleet
+
+    from tests.serve.conftest import installed_engine
+
+    with temp_env_vars(MODEL_COLLECTION_DIR=ingest_collection):
+        STORE.clear()
+        fleet = STORE.fleet(ingest_collection)
+        model = fleet.model("scaled-mm")
+        X = _frame(scaled_payload)
+        want = np.asarray(model.predict(X))
+        spec = fleet.loaded_specs()["scaled-mm"]
+        real = fleet.ingest_plan(spec)
+        assert real is not None and not real.identity
+
+        calls = {"n": 0}
+        original = RevisionFleet.ingest_plan
+
+        def shifty(self, s):
+            calls["n"] += 1
+            if calls["n"] <= 1:
+                return original(self, s)  # admission sees the real plan
+            return FleetIngestPlan(  # flush sees a stale member list
+                ["ghost"],
+                real.scale,
+                real.offset,
+                identity=False,
+                host_scale=real.host_scale,
+                host_offset=real.host_offset,
+            )
+
+        monkeypatch.setattr(RevisionFleet, "ingest_plan", shifty)
+        with installed_engine() as engine:
+            got = engine.batched_predict(
+                ingest_collection, "scaled-mm", model, X
+            )
+            stats = engine.stats()
+    assert stats["ingest_replans"] >= 1
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+
+LSTM_CONFIG = """
+machines:
+  - name: lstm-ingest
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-05T00:00:00+00:00"
+      tag_list: [lt-1, lt-2]
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.JaxLSTMAutoEncoder:
+            kind: lstm_model
+            lookback_window: 4
+            epochs: 1
+"""
+
+
+@pytest.fixture(scope="module")
+def lstm_collection(tmp_path_factory):
+    from gordo_tpu import serializer
+    from gordo_tpu.builder import local_build
+
+    root = tmp_path_factory.mktemp("ingest-lstm") / "1710000000001"
+    for model, machine in local_build(LSTM_CONFIG, project_name=PROJECT):
+        serializer.dump(
+            model, str(root / machine.name), metadata=machine.to_dict()
+        )
+    return str(root)
+
+
+def test_windowed_route_keeps_host_path_bit_identical(lstm_collection):
+    """Windowed (LSTM) specs have no compiled plan: the route must take
+    the host path with the ingest subsystem on — identical bytes."""
+    n_rows = 12
+    index = [f"2020-03-01T{h:02d}:00:00+00:00" for h in range(n_rows)]
+    values = {
+        f"lt-{i}": {ts: 0.1 * i + 0.01 * j for j, ts in enumerate(index)}
+        for i in (1, 2)
+    }
+    payload = {"X": values, "y": values}
+    responses = _compiled_vs_host(
+        lstm_collection,
+        f"/gordo/v0/{PROJECT}/lstm-ingest/anomaly/prediction",
+        payload,
+    )
+    assert _norm(responses["1"].data) == _norm(responses["0"].data)

@@ -1,0 +1,145 @@
+"""
+Compiled-plan extraction units: every supported scaler's composed
+affine must reproduce sklearn's own ``transform`` numbers, and every
+unsupported shape must answer None (the host-fallback cue) — never a
+silently wrong compilation.
+"""
+
+import numpy as np
+import pytest
+from sklearn.decomposition import PCA
+from sklearn.pipeline import Pipeline
+from sklearn.preprocessing import (
+    MaxAbsScaler,
+    MinMaxScaler,
+    RobustScaler,
+    StandardScaler,
+)
+
+from gordo_tpu.ingest import build_fleet_plan, extract_member_plan
+from gordo_tpu.ingest.plan import _affine_of
+
+pytestmark = pytest.mark.ingest
+
+N_FEATURES = 4
+
+
+def _fit_data(seed=7, rows=200):
+    rng = np.random.default_rng(seed)
+    return rng.normal(loc=3.0, scale=2.5, size=(rows, N_FEATURES))
+
+
+class _FakeModel:
+    """A detector-shaped object graph: ``base_estimator`` is an sklearn
+    Pipeline whose last step stands in for the estimator."""
+
+    def __init__(self, transformers):
+        steps = [(f"step_{i}", t) for i, t in enumerate(transformers)]
+        steps.append(("estimator", object()))
+        self.base_estimator = Pipeline.__new__(Pipeline)
+        self.base_estimator.steps = steps
+
+
+@pytest.mark.parametrize(
+    "scaler",
+    [
+        MinMaxScaler(),
+        MinMaxScaler(feature_range=(-1, 1)),
+        StandardScaler(),
+        StandardScaler(with_mean=False),
+        StandardScaler(with_std=False),
+        MaxAbsScaler(),
+        RobustScaler(),
+        RobustScaler(with_centering=False),
+        RobustScaler(with_scaling=False),
+    ],
+)
+def test_affine_matches_sklearn_transform(scaler):
+    X = _fit_data()
+    scaler.fit(X)
+    scale, offset = _affine_of(scaler)
+    np.testing.assert_allclose(
+        X * scale + offset, scaler.transform(X), rtol=1e-10, atol=1e-12
+    )
+
+
+def test_chained_scalers_compose_in_pipeline_order():
+    X = _fit_data(seed=11)
+    first = MinMaxScaler().fit(X)
+    second = StandardScaler().fit(first.transform(X))
+    plan = extract_member_plan(_FakeModel([first, second]), N_FEATURES)
+    assert plan is not None and not plan.identity
+    want = second.transform(first.transform(X))
+    got = X.astype(np.float32) * plan.scale + plan.offset
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_identity_plan_for_bare_estimator():
+    class Bare:
+        pass
+
+    plan = extract_member_plan(Bare(), N_FEATURES)
+    assert plan is not None and plan.identity
+
+
+@pytest.mark.parametrize(
+    "transformer",
+    [
+        MinMaxScaler(clip=True),
+        MinMaxScaler(),  # unfitted: nothing to compile
+        PCA(n_components=2),  # width-changing / not affine
+    ],
+)
+def test_uncompilable_steps_answer_none(transformer):
+    if getattr(transformer, "clip", False):
+        transformer.fit(_fit_data())
+    plan = extract_member_plan(_FakeModel([transformer]), N_FEATURES)
+    assert plan is None
+
+
+def test_scaler_subclass_is_never_compiled():
+    class Sneaky(MinMaxScaler):
+        def transform(self, X):
+            return super().transform(X) ** 2
+
+    sneaky = Sneaky().fit(_fit_data())
+    assert _affine_of(sneaky) is None
+    assert extract_member_plan(_FakeModel([sneaky]), N_FEATURES) is None
+
+
+def test_fleet_plan_stacks_members_in_order():
+    X = _fit_data(seed=3)
+    mm = MinMaxScaler().fit(X)
+    std = StandardScaler().fit(X)
+    plan = build_fleet_plan(
+        [("a", _FakeModel([mm])), ("b", _FakeModel([std]))], N_FEATURES
+    )
+    assert plan is not None and not plan.identity
+    assert plan.names == ["a", "b"]
+    assert np.asarray(plan.scale).shape == (2, N_FEATURES)
+    np.testing.assert_allclose(
+        np.asarray(plan.scale)[0], np.asarray(mm.scale_, np.float32)
+    )
+    assert plan.nbytes == 2 * N_FEATURES * 4 * 2
+    # host copies mirror the device arrays (the fleet route's staging)
+    np.testing.assert_array_equal(plan.host_scale, np.asarray(plan.scale))
+
+
+def test_fleet_plan_is_all_or_nothing():
+    class Bare:
+        pass
+
+    mm = MinMaxScaler().fit(_fit_data())
+    clipped = MinMaxScaler(clip=True).fit(_fit_data())
+    assert (
+        build_fleet_plan(
+            [("ok", _FakeModel([mm])), ("bad", _FakeModel([clipped]))],
+            N_FEATURES,
+        )
+        is None
+    )
+    # all-identity bucket: identity plan, zero resident bytes
+    plan = build_fleet_plan([("a", Bare()), ("b", Bare())], N_FEATURES)
+    assert plan is not None and plan.identity
+    assert plan.scale is None and plan.nbytes == 0
+    assert build_fleet_plan([], N_FEATURES) is None

@@ -1,0 +1,130 @@
+"""
+Ladder-snapped stream cuts: a big multi-window backlog flush must take
+the largest whole-window span that lands exactly on a serve row-ladder
+rung (re-using the request plane's compiled shapes instead of minting a
+worst-case padded one), leave the remainder buffered for the next
+watermark flush, and never bend the zero-gap invariant.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu import serve
+from gordo_tpu.planner.ladder import snap_rows
+from gordo_tpu.server.fleet_store import STORE
+from gordo_tpu.stream.scorer import WindowScorer
+from gordo_tpu.stream.session import StreamSession
+
+from tests.stream.test_scorer import FakeFleet, events_of
+
+pytestmark = [pytest.mark.ingest, pytest.mark.stream]
+
+WINDOW = 32
+
+
+@pytest.fixture(autouse=True)
+def routed_fake_fleet(monkeypatch, tmp_path):
+    """Fake-fleet routing plus a standalone breaker board (no engine)."""
+    routed = str(tmp_path / "rev-a")
+    fleet = FakeFleet(routed)
+    monkeypatch.setattr(STORE, "route", lambda directory: routed)
+    monkeypatch.setattr(STORE, "fleet", lambda directory: fleet)
+    engine = serve.get_engine()
+    serve.install_engine(None)
+    serve.reset_stream_breakers()
+    yield fleet
+    serve.reset_stream_breakers()
+    serve.install_engine(engine)
+
+
+def make_session(tmp_path, ring_rows=512):
+    return StreamSession(
+        "proj", "sid", str(tmp_path / "rev-a"), ring_rows=ring_rows,
+        outbox_events=64,
+    )
+
+
+def frame(rows):
+    return pd.DataFrame({"tag-1": np.arange(rows, dtype=float)})
+
+
+def test_snap_rows_picks_the_largest_aligned_rung():
+    # default ladder (32, 128, 512, ...): 224 pending -> the 128 rung
+    assert snap_rows(224, WINDOW) == 128
+    # a rung is only eligible via its WHOLE-window capacity: with
+    # window 48, rung 128 holds 2 windows = 96 rows
+    assert snap_rows(200, 48, ladder=(32, 128)) == 96
+    # below the smallest aligned size, freshness wins: take everything
+    assert snap_rows(60, 24, ladder=(128, 512)) == 48
+    # no whole window buffered -> nothing to cut
+    assert snap_rows(WINDOW - 1, WINDOW) == 0
+    assert snap_rows(100, 0) == 0
+
+
+def test_cut_windows_snap_keeps_remainder_buffered(tmp_path):
+    session = make_session(tmp_path)
+    session.append_rows("m-1", frame(224))  # 7 whole windows of 32
+    cuts = session.cut_windows(
+        WINDOW, snap=lambda pending: snap_rows(pending, WINDOW)
+    )
+    chunks, first_seq, last_seq, windows, _oldest = cuts["m-1"]
+    assert (first_seq, last_seq, windows) == (1, 128, 4)
+    assert sum(len(c) for c in chunks) == 128
+    stats = session.stats()["machines"]["m-1"]
+    assert stats["rows_pending"] == 96  # remainder stays buffered
+
+
+def test_cut_windows_defensively_floors_a_ragged_snap(tmp_path):
+    session = make_session(tmp_path)
+    session.append_rows("m-1", frame(3 * WINDOW))
+    cuts = session.cut_windows(WINDOW, snap=lambda pending: WINDOW + 7)
+    assert cuts["m-1"][3] == 1  # floored to one whole window
+    assert session.stats()["machines"]["m-1"]["rows_pending"] == 2 * WINDOW
+
+
+def test_backlog_flush_snaps_then_drains_with_contiguous_spans(tmp_path):
+    """The scorer's flush wires the snap in: a 224-row backlog scores
+    128 rows (the rung), the 96-row remainder rides later flushes, and
+    the spans abut exactly — zero-gap accounting intact throughout."""
+    scorer = WindowScorer(WINDOW)
+    session = make_session(tmp_path)
+    session.append_rows("m-1", frame(224))
+
+    summary = scorer.flush(session)
+    assert summary["scored"] == {"m-1": 128}
+    stats = session.stats()["machines"]["m-1"]
+    assert stats["rows_pending"] == 96
+
+    # the remainder drains one 32-rung at a time on later watermarks
+    assert scorer.flush(session)["scored"] == {"m-1": 32}
+    assert scorer.flush(session)["scored"] == {"m-1": 32}
+    assert scorer.flush(session)["scored"] == {"m-1": 32}
+    assert scorer.flush(session)["scored"] == {}
+
+    anomalies = events_of(session, "anomaly")
+    assert [a["windows"] for a in anomalies] == [4, 1, 1, 1]
+    assert [a["first_seq"] for a in anomalies] == [1, 129, 161, 193]
+    for earlier, later in zip(anomalies, anomalies[1:]):
+        assert earlier["last_seq"] + 1 == later["first_seq"]
+    stats = session.stats()["machines"]["m-1"]
+    assert stats["rows_scored"] == 224
+    assert stats["rows_pending"] == 0
+    assert (
+        stats["rows_scored"]
+        + stats["rows_failed"]
+        + stats["rows_pending"]
+        + stats["rows_shed"]
+        == stats["rows_in"]
+    )
+
+
+def test_small_flushes_are_untouched_by_snapping(tmp_path):
+    """Below the smallest aligned rung the whole backlog still scores
+    on the first flush — snapping must never delay a small payload."""
+    scorer = WindowScorer(5)
+    session = make_session(tmp_path)
+    session.append_rows("m-1", frame(12))  # 2 whole windows + 2 spare
+    summary = scorer.flush(session)
+    assert summary["scored"] == {"m-1": 10}
+    assert session.stats()["machines"]["m-1"]["rows_pending"] == 2

@@ -135,7 +135,7 @@ def test_program_count_bounded_by_ladder(serve_collection_dir):
         stats = engine.stats()
         assert stats["requests"] == 12
         assert 0 < stats["programs"] <= bound
-        for _, _, members, rows, precision in engine.program_shapes():
+        for _, _, members, rows, precision, _ in engine.program_shapes():
             assert members in serve.member_ladder(8)
             assert rows in (8, 32)
             assert precision == "f32"  # the default ladder is pure f32

@@ -122,7 +122,7 @@ def test_default_f32_is_byte_identical(serve_collection_dir, batch_payload):
             stats = engine.stats()
             assert stats["precision"]["coalesced"] == {"f32": 1}
             assert stats["precision_degraded"] == 0
-            assert all(p == "f32" for *_, p in engine.program_shapes())
+            assert all(p == "f32" for (_, _, _, _, p, _) in engine.program_shapes())
         # nothing was gated: f32 is the reference, not a candidate
         assert STORE.fleet(serve_collection_dir).precision_reports() == []
         with temp_env_vars(GORDO_TPU_SERVE_PRECISION="f32"):
@@ -236,7 +236,7 @@ def test_parity_failure_degrades_to_f32_with_zero_5xx(
                 stats = engine.stats()
                 assert stats["precision_degraded"] == 6
                 assert stats["precision"]["coalesced"] == {"f32": 6}
-                assert all(p == "f32" for *_, p in engine.program_shapes())
+                assert all(p == "f32" for (_, _, _, _, p, _) in engine.program_shapes())
         fleet = STORE.fleet(serve_collection_dir)
         reports = fleet.precision_reports()
         assert len(reports) == 1 and not reports[0]["passed"]

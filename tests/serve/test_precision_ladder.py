@@ -217,7 +217,7 @@ def test_program_cache_bound_covers_precisions(serve_collection_dir):
                 hit(i)
             stats = engine.stats()
             assert 0 < stats["programs"] <= bound
-            precisions = {p for (_, _, _, _, p) in engine.program_shapes()}
+            precisions = {p for (_, _, _, _, p, _) in engine.program_shapes()}
             assert precisions == {"f32", "bf16"}
             coalesced = stats["precision"]["coalesced"]
             assert coalesced.get("f32", 0) > 0

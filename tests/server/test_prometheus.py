@@ -281,6 +281,7 @@ def test_stage_duration_histograms_per_request(
     for stage in (
         "model_resolve",
         "data_decode",
+        "device_ingest",
         "inference",
         "response_assemble",
         "serialize",
@@ -317,6 +318,7 @@ def test_stage_duration_histograms_per_request(
         for stage in (
             "model_resolve",
             "data_decode",
+            "device_ingest",
             "inference",
             "response_assemble",
             "serialize",

@@ -115,6 +115,7 @@ def test_prediction_exports_stage_spans_under_the_request(
     for stage in (
         "model_resolve",
         "data_decode",
+        "device_ingest",
         "inference",
         "response_assemble",
         "serialize",

@@ -1,8 +1,8 @@
 """
 Stage-attribution contract for the reshaped wire pipeline (PR 12): the
-columnar fast path kept the five canonical stage names —
-``model_resolve`` / ``data_decode`` / ``inference`` /
-``response_assemble`` / ``serialize`` — and the exported request traces
+columnar fast path kept the canonical stage names —
+``model_resolve`` / ``data_decode`` / ``device_ingest`` /
+``inference`` / ``response_assemble`` / ``serialize`` — and the exported request traces
 must still explain ≥0.9 of request walltime on BOTH wire formats, or
 ``gordo-tpu trace`` (and the bench gate built on it) goes blind to the
 very pipeline this PR rebuilt.
@@ -29,6 +29,7 @@ pytestmark = [pytest.mark.wire, pytest.mark.observability]
 WIRE_STAGES = (
     "model_resolve",
     "data_decode",
+    "device_ingest",
     "inference",
     "response_assemble",
     "serialize",

@@ -27,8 +27,11 @@ BASELINE = {
         "stages": {"response_assemble": {"p50_ms": 1.0}},
     },
     "scoring_overhead": {"overhead_us_per_request": 20.0},
-    # the columnar-wire acceptance set (PR 12)
-    "route_gap_p50_ratio": 2.0,
+    # the columnar-wire acceptance set (PR 12), tightened by the
+    # device-resident ingest subsystem (PR 19: gap budget 3.0 -> 1.5,
+    # plus the decode+staging absolute budget)
+    "route_gap_p50_ratio": 1.2,
+    "ingest_p50_ms": 2.0,
     "route_batched_vs_unbatched": 0.95,
 }
 
