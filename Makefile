@@ -1,6 +1,6 @@
 # Convenience targets (CI runs scripts/tests.sh per matrix component)
 
-.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-concurrency test-fleet-health test-slo test-precision test-chaos test-scale test-stream test-ingest docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-precision bench-chaos bench-scale bench-stream bench-ingest bench-check lint lint-gordo lockgraph-check image
+.PHONY: test test-fast test-faults test-observability test-serve test-wire test-planner test-lifecycle test-lifecycle-faults test-analysis test-concurrency test-fleet-health test-slo test-precision test-chaos test-scale test-stream test-ingest test-perfmodel docs bench bench-telemetry bench-serve bench-planner bench-lifecycle bench-route bench-fleet-health bench-slo bench-precision bench-chaos bench-scale bench-stream bench-ingest bench-perfmodel bench-check lint lint-gordo lockgraph-check image
 
 test:
 	python -m pytest tests/ -q
@@ -143,6 +143,23 @@ test-ingest:
 # BENCH_INGEST.json (gated by `gordo-tpu bench-check`).
 bench-ingest:
 	JAX_PLATFORMS=cpu python benchmarks/bench_ingest.py
+
+# The learned performance-model suite: trace harvesting, closed-form
+# ridge fit + deterministic holdout, accuracy-gated promotion,
+# cold-start/corrupt-table fallback, knob-off plan byte-parity, and the
+# model-informed serving consumers — CPU-only and not slow-marked, so
+# the same tests also run inside the tier-1 budget.
+test-perfmodel:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m perfmodel
+
+# Learned-cost-model bench: measure a real fleet_forward shape grid,
+# fit + promote through the accuracy gate, score predicted-vs-actual on
+# the deterministic holdout (learned must beat analytic), and replay a
+# ragged request stream through the static vs model-informed row
+# ladder; writes BENCH_PERFMODEL.json (gated by `gordo-tpu
+# bench-check`).
+bench-perfmodel:
+	JAX_PLATFORMS=cpu python benchmarks/bench_perfmodel.py
 
 # The fleet-scale observability suite: sharded ledger layout/migration/
 # dirty-flush contracts, rollup-manifest counting-open reads, bounded

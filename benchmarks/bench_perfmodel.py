@@ -1,0 +1,355 @@
+"""
+Learned performance-model benchmark: trace corpus → fit → accuracy and
+serving-consumer parity, end to end.
+
+Three stages, each exercising the real subsystem (no synthetic
+numbers — every ``device_ms`` in the corpus is a measured fused-program
+wall time from THIS host):
+
+1. **corpus**: the exact fused ``fleet_forward_gather`` program a served
+   batch runs, driven across a (members × rows × precision) shape grid.
+   Every timed call is written as a ``serve_batch`` span (with the
+   ``flops_per_sample`` stamp the engine records since PR 20) and every
+   first-call-at-a-shape as a ``compile`` ``device_program`` span — a
+   ``serve_trace.jsonl`` the harvester reads exactly the way
+   ``gordo-tpu perfmodel fit`` reads production telemetry dirs.
+2. **fit + accuracy**: :func:`gordo_tpu.perfmodel.fit_and_promote` on
+   that corpus (accuracy-gated promotion included), then learned vs
+   analytic MAE on the SAME deterministic holdout the promotion gate
+   used. The gated ratio (learned/analytic, log space) must stay ≤ 1.0:
+   the learned model only exists because it out-predicts the pinned
+   analytic fallback.
+3. **ladder**: the serving decision the model steers — row-rung choice
+   for ragged request sizes — replayed with real fused calls under the
+   static ladder policy (pad to next rung) and the learned policy
+   (cheapest predicted rung that fits, via ``predict_serve_step_s`` on
+   the promoted table). On CPU hosts parity is the ceiling; the
+   ``min_bound`` floor catches the learned path LOSING throughput
+   (mispredicted rungs, estimator overhead on the hot path), per the
+   bench_precision pattern.
+
+Writes ``BENCH_PERFMODEL.json`` at the repo root (the committed bench
+convention), gated by ``gordo-tpu bench-check``. Run:
+``JAX_PLATFORMS=cpu python benchmarks/bench_perfmodel.py`` (or
+``make bench-perfmodel``).
+"""
+
+import datetime
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+import warnings
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+warnings.filterwarnings("ignore", category=UserWarning)
+
+N_MODELS = 8
+N_TAGS = 12
+MEMBER_GRID = (2, 4, 8)
+ROW_GRID = (32, 128, 512)
+PRECISIONS = ("f32", "bf16")
+#: timed calls per grid shape (each is one corpus span); CI runs reduced
+#: reps via the BENCH_PERFMODEL_* overrides like every bench
+CALLS_PER_SHAPE = int(os.environ.get("BENCH_PERFMODEL_CALLS", "5"))
+#: ragged requests per ladder-policy rep
+LADDER_REQUESTS = int(os.environ.get("BENCH_PERFMODEL_REQUESTS", "40"))
+REPS = int(os.environ.get("BENCH_PERFMODEL_REPS", "5"))
+#: bench corpora are small by construction (one compile row per distinct
+#: program shape), so the sample floor drops below the production
+#: default — passed explicitly, the same override an operator would use
+MIN_SAMPLES = 8
+
+REVISION = "1700000000000"
+
+MACHINE_YAML = """  - name: bench-{i}
+    dataset:
+      type: RandomDataset
+      train_start_date: "2020-01-01T00:00:00+00:00"
+      train_end_date: "2020-01-02T00:00:00+00:00"
+      tag_list: [{tags}]
+    model:
+      gordo_tpu.models.anomaly.diff.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.JaxAutoEncoder:
+            kind: feedforward_model
+            encoding_dim: [256, 128]
+            encoding_func: [tanh, tanh]
+            decoding_dim: [128, 256]
+            decoding_func: [tanh, tanh]
+            epochs: 1
+"""
+
+
+def build_collection(root: str) -> str:
+    from gordo_tpu import serializer
+    from gordo_tpu.builder import local_build
+
+    tags = ", ".join(f"tag-{j}" for j in range(1, N_TAGS + 1))
+    config = "machines:\n" + "".join(
+        MACHINE_YAML.format(i=i, tags=tags) for i in range(N_MODELS)
+    )
+    collection_dir = os.path.join(root, REVISION)
+    for model, machine in local_build(config, project_name="bench-perfmodel"):
+        serializer.dump(
+            model,
+            os.path.join(collection_dir, machine.name),
+            metadata=machine.to_dict(),
+        )
+    return collection_dir
+
+
+def _span(name: str, index: int, attributes: dict) -> dict:
+    return {
+        "name": name,
+        "context": {
+            "trace_id": "bench-perfmodel",
+            "span_id": f"{name}-{index:06d}",
+        },
+        "attributes": attributes,
+    }
+
+
+def main() -> dict:
+    import numpy as np
+
+    from gordo_tpu.perfmodel import (
+        analytic_prediction,
+        evaluate_rows,
+        fit_and_promote,
+        harvest_corpus,
+        holdout_split,
+    )
+    from gordo_tpu.planner.costmodel import (
+        CostModel,
+        load_table_safe,
+        spec_flops_per_sample,
+    )
+    from gordo_tpu.planner.ladder import DEFAULT_ROW_LADDER, pad_to
+    from gordo_tpu.serve import precision as P
+    from gordo_tpu.server.fleet_store import STORE, fleet_forward_gather
+    from gordo_tpu.telemetry import SERVE_TRACE_FILE
+
+    root = tempfile.mkdtemp(prefix="bench-perfmodel-")
+    corpus_dir = os.path.join(root, "telemetry")
+    os.makedirs(corpus_dir)
+    table_path = os.path.join(root, "cost_table.json")
+    try:
+        collection_dir = build_collection(root)
+        fleet = STORE.fleet(collection_dir)
+        fleet.warm()
+        spec = next(iter(fleet.loaded_specs().values()))
+        flops = spec_flops_per_sample(spec)
+        rng = np.random.RandomState(0)
+
+        # -- stage 1: measured corpus ----------------------------------
+        spans = []
+        payloads = {}
+
+        def run_once(members: int, rows: int, prec: str) -> float:
+            key = (members, rows, prec)
+            if key not in payloads:
+                x = rng.rand(members, rows, N_TAGS).astype(np.float32)
+                payloads[key] = x.astype(P.payload_dtype(prec))
+            _, bucket = fleet.spec_bucket(spec, prec)
+            indices = np.arange(members, dtype=np.int32)
+            begin = time.perf_counter()
+            np.asarray(
+                fleet_forward_gather(
+                    spec, bucket, indices, payloads[key], precision=prec
+                )
+            )
+            return (time.perf_counter() - begin) * 1000.0
+
+        for prec in PRECISIONS:
+            for members in MEMBER_GRID:
+                for rows in ROW_GRID:
+                    first_ms = run_once(members, rows, prec)  # compiles
+                    steady = [
+                        run_once(members, rows, prec)
+                        for _ in range(CALLS_PER_SHAPE)
+                    ]
+                    compile_ms = max(
+                        first_ms - statistics.median(steady), 0.1
+                    )
+                    spans.append(
+                        _span(
+                            "device_program",
+                            len(spans),
+                            {
+                                "program": "fleet_forward",
+                                "compile": True,
+                                "flops_per_sample": flops,
+                                "stacked_members": members,
+                                "stacked_samples": rows,
+                                "precision": prec,
+                                "device_ms": round(compile_ms, 4),
+                            },
+                        )
+                    )
+                    for ms in steady:
+                        spans.append(
+                            _span(
+                                "serve_batch",
+                                len(spans),
+                                {
+                                    "flops_per_sample": flops,
+                                    "padded_members": members,
+                                    "padded_rows": rows,
+                                    "precision": prec,
+                                    "device_ms": round(ms, 4),
+                                },
+                            )
+                        )
+        with open(os.path.join(corpus_dir, SERVE_TRACE_FILE), "w") as f:
+            for span in spans:
+                f.write(json.dumps(span, sort_keys=True) + "\n")
+
+        # -- stage 2: fit + holdout accuracy ---------------------------
+        report = fit_and_promote(
+            corpus_dir, table_path=table_path, min_samples=MIN_SAMPLES
+        )
+        table = load_table_safe(table_path)
+        rows_harvested, corpus_stats = harvest_corpus(corpus_dir)
+        accuracy = {}
+        for target in ("device_ms", "compile_ms"):
+            population = [r for r in rows_harvested if r.target == target]
+            _, holdout = holdout_split(population)
+            learned_mae, learned_n = evaluate_rows(
+                holdout,
+                lambda r: table.learned_predict(
+                    r.target, r.program, r.features
+                ),
+            )
+            analytic_mae, _ = evaluate_rows(
+                holdout,
+                lambda r: analytic_prediction(
+                    table, r.target, r.program, r.features
+                ),
+            )
+            accuracy[target] = {
+                "holdout_n": learned_n,
+                "learned_mae_log": round(learned_mae, 4),
+                "analytic_mae_log": round(analytic_mae, 4),
+                "mae_ratio": round(learned_mae / analytic_mae, 4)
+                if analytic_mae > 0.0
+                else 0.0,
+            }
+
+        # -- stage 3: static vs learned ladder policy ------------------
+        members = MEMBER_GRID[-1]
+        request_rows = [
+            int(r)
+            for r in np.random.RandomState(1).randint(
+                8, ROW_GRID[-1] + 1, size=LADDER_REQUESTS
+            )
+        ]
+        admissible = [r for r in DEFAULT_ROW_LADDER if r <= ROW_GRID[-1]]
+        learned_cost = CostModel(table, use_learned=True)
+
+        def static_rung(rows: int) -> int:
+            return pad_to(rows, admissible) or admissible[-1]
+
+        def learned_rung(rows: int) -> int:
+            fits = [r for r in admissible if r >= rows] or [admissible[-1]]
+            return min(
+                fits,
+                key=lambda r: (
+                    learned_cost.predict_serve_step_s(spec, members, r, "f32"),
+                    r,
+                ),
+            )
+
+        policies = {"static": static_rung, "learned": learned_rung}
+        for rung in admissible:  # warm every rung out of the timed region
+            run_once(members, rung, "f32")
+
+        runs = {name: [] for name in policies}
+        latencies = {name: [] for name in policies}
+        for rep in range(REPS):
+            order = (
+                ("static", "learned") if rep % 2 == 0 else ("learned", "static")
+            )
+            for name in order:
+                choose = policies[name]
+                begin = time.perf_counter()
+                for rows in request_rows:
+                    t0 = time.perf_counter()
+                    run_once(members, choose(rows), "f32")
+                    latencies[name].append(
+                        (time.perf_counter() - t0) * 1000.0
+                    )
+                wall = time.perf_counter() - begin
+                runs[name].append(members * sum(request_rows) / wall)
+
+        ladder = {}
+        for name in policies:
+            lat = sorted(latencies[name])
+            ladder[name] = {
+                "rows_per_sec": round(max(runs[name]), 1),
+                "median_rows_per_sec": round(statistics.median(runs[name]), 1),
+                "p99_ms": round(lat[int(0.99 * (len(lat) - 1))], 4),
+            }
+        ladder["choices_differ"] = sum(
+            1 for r in request_rows if static_rung(r) != learned_rung(r)
+        )
+        ladder["learned_vs_static_throughput"] = round(
+            ladder["learned"]["rows_per_sec"]
+            / ladder["static"]["rows_per_sec"],
+            4,
+        )
+        ladder["learned_vs_static_p99_ratio"] = round(
+            ladder["learned"]["p99_ms"] / ladder["static"]["p99_ms"], 4
+        )
+
+        STORE.clear()
+        doc = {
+            "bench": "learned-perfmodel",
+            "timestamp": datetime.datetime.now(
+                datetime.timezone.utc
+            ).isoformat(),
+            "models": N_MODELS,
+            "tags": N_TAGS,
+            "member_grid": list(MEMBER_GRID),
+            "row_grid": list(ROW_GRID),
+            "precisions": list(PRECISIONS),
+            "calls_per_shape": CALLS_PER_SHAPE,
+            "ladder_requests": LADDER_REQUESTS,
+            "reps": REPS,
+            "backend": os.environ.get("JAX_PLATFORMS", "cpu"),
+            "corpus": {
+                "spans": corpus_stats["spans"],
+                "rows": corpus_stats["rows"],
+                "rows_by_model": corpus_stats["rows_by_model"],
+            },
+            "fit": {
+                "promoted": bool(report["promoted"]),
+                "reason": report.get("reason"),
+                "models": report["models"],
+            },
+            "accuracy": accuracy,
+            "ladder": ladder,
+        }
+        out_path = Path(
+            os.environ.get("BENCH_PERFMODEL_OUT")
+            or REPO_ROOT / "BENCH_PERFMODEL.json"
+        )
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        print(f"\nwrote {out_path}")
+        return doc
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

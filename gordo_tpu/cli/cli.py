@@ -2006,6 +2006,195 @@ def lifecycle_rollback(collection_dir: str, reason: str):
     _echo_cycle(report)
 
 
+@click.group("perfmodel")
+def perfmodel_cli():
+    """The learned performance model: fit device-cost regressors from
+    telemetry traces, inspect the promoted table, and evaluate learned
+    vs analytic accuracy on a corpus."""
+
+
+@perfmodel_cli.command("fit")
+@click.argument("corpus-dir", type=click.Path(exists=True, file_okay=False))
+@click.option(
+    "--table",
+    "table_path",
+    default=None,
+    type=click.Path(dir_okay=False, writable=True),
+    help="The cost_table.json to promote into (default: "
+    "GORDO_TPU_PERFMODEL_TABLE, else cost_table.json beside the corpus).",
+)
+@click.option(
+    "--min-samples",
+    default=None,
+    type=int,
+    help="Smallest (target, program) population to fit (default: "
+    "GORDO_TPU_PERFMODEL_MIN_SAMPLES).",
+)
+@click.option(
+    "--force",
+    is_flag=True,
+    help="Install the fit even when it loses the holdout accuracy gate "
+    "(the sample floor still applies).",
+)
+@click.option("--as-json", "as_json", is_flag=True, help="Raw report JSON")
+def perfmodel_fit(
+    corpus_dir: str,
+    table_path: Optional[str],
+    min_samples: Optional[int],
+    force: bool,
+    as_json: bool,
+):
+    """Harvest CORPUS_DIR's traces (build + serve, rotated generations
+    and worker variants merged), fit the per-program regressors, and
+    promote them into the cost table IF each beats the analytic model
+    and the incumbent on its holdout."""
+    from ..perfmodel import fit_and_promote
+
+    report = fit_and_promote(
+        corpus_dir,
+        table_path=table_path,
+        min_samples=min_samples,
+        force=force,
+    )
+    if as_json:
+        click.echo(json.dumps(report, indent=1, sort_keys=True))
+        return
+    corpus = report.get("corpus") or {}
+    click.echo(
+        f"corpus: {corpus.get('rows', 0)} training row(s) from "
+        f"{corpus.get('spans', 0)} span(s) in {corpus_dir}"
+    )
+    for entry in report.get("models") or []:
+        inc = entry.get("incumbent_mae_log")
+        click.echo(
+            f"  {entry['target']}/{entry['program']}: n={entry['n']} "
+            f"holdout={entry['holdout_mae_log']:.4f} "
+            f"analytic={entry.get('analytic_mae_log')} "
+            f"incumbent={inc if inc is not None else '-'} "
+            f"-> {entry['reason']}"
+        )
+    click.echo(
+        f"{'PROMOTED' if report.get('promoted') else 'not promoted'}: "
+        f"{report.get('reason')}"
+        + (f" ({report.get('table')})" if report.get("promoted") else "")
+    )
+    if not report.get("promoted") and not (report.get("models") or []):
+        # an empty/thin corpus is normal at cold start — say so plainly
+        click.echo("the analytic model remains the active fallback")
+
+
+@perfmodel_cli.command("status")
+@click.option(
+    "--table",
+    "table_path",
+    default=None,
+    type=click.Path(dir_okay=False),
+    help="The cost table to inspect (default: GORDO_TPU_PERFMODEL_TABLE).",
+)
+@click.option("--as-json", "as_json", is_flag=True, help="Raw status JSON")
+def perfmodel_status(table_path: Optional[str], as_json: bool):
+    """What the cost table currently carries: calibration factors,
+    fitted learned models and their holdout accuracy, corpus identity."""
+    from ..perfmodel import default_table_path, section_status
+
+    path = table_path or default_table_path()
+    doc = section_status(path)
+    if as_json:
+        click.echo(json.dumps(doc, indent=1, sort_keys=True))
+        return
+    click.echo(f"table: {path or '(none; analytic defaults)'}")
+    click.echo(
+        f"calibrated: {doc['calibrated']}  learned: {doc['learned']}"
+    )
+    corpus = doc.get("corpus")
+    if corpus:
+        click.echo(
+            f"corpus: {corpus.get('rows')} row(s), "
+            f"fingerprint {corpus.get('fingerprint')}"
+        )
+    for entry in doc["models"]:
+        click.echo(
+            f"  {entry['target']}/{entry['program']}: n={entry['n']} "
+            f"holdout_mae_log={entry['holdout_mae_log']}"
+        )
+    if not doc["models"]:
+        click.echo("no learned models; predictions are analytic")
+
+
+@perfmodel_cli.command("eval")
+@click.argument("corpus-dir", type=click.Path(exists=True, file_okay=False))
+@click.option(
+    "--table",
+    "table_path",
+    default=None,
+    type=click.Path(exists=True, dir_okay=False),
+    help="Evaluate THIS table's learned models (default: "
+    "GORDO_TPU_PERFMODEL_TABLE, else cost_table.json beside the corpus).",
+)
+@click.option("--as-json", "as_json", is_flag=True, help="Raw report JSON")
+def perfmodel_eval(
+    corpus_dir: str, table_path: Optional[str], as_json: bool
+):
+    """Score a table's learned models against CORPUS_DIR's measured
+    spans — learned vs analytic mean absolute log error per (target,
+    program), without fitting or writing anything."""
+    from ..perfmodel import default_table_path, harvest_corpus
+    from ..perfmodel.model import analytic_prediction, evaluate_rows
+    from ..planner.costmodel import load_table_safe
+
+    path = table_path or default_table_path(corpus_dir)
+    table = load_table_safe(path)
+    rows, stats = harvest_corpus(corpus_dir)
+    populations: dict = {}
+    for row in rows:
+        populations.setdefault((row.target, row.program), []).append(row)
+    report = {
+        "table": path,
+        "corpus": stats,
+        "models": [],
+    }
+    for (target, program), population in sorted(populations.items()):
+        learned_mae, learned_n = evaluate_rows(
+            population,
+            lambda r: table.learned_predict(target, program, r.features),
+        )
+        analytic_mae, analytic_n = evaluate_rows(
+            population,
+            lambda r: analytic_prediction(table, target, program, r.features),
+        )
+        report["models"].append(
+            {
+                "target": target,
+                "program": program,
+                "rows": len(population),
+                "learned_mae_log": round(learned_mae, 6)
+                if learned_n
+                else None,
+                "learned_scored": learned_n,
+                "analytic_mae_log": round(analytic_mae, 6)
+                if analytic_n
+                else None,
+            }
+        )
+    if as_json:
+        click.echo(json.dumps(report, indent=1, sort_keys=True))
+        return
+    click.echo(
+        f"corpus: {len(rows)} row(s); table: "
+        f"{path or '(analytic defaults)'}"
+    )
+    for entry in report["models"]:
+        learned = entry["learned_mae_log"]
+        click.echo(
+            f"  {entry['target']}/{entry['program']}: rows={entry['rows']} "
+            f"learned={learned if learned is not None else '-'} "
+            f"(scored {entry['learned_scored']}) "
+            f"analytic={entry['analytic_mae_log']}"
+        )
+    if not report["models"]:
+        click.echo("no training rows in the corpus")
+
+
 gordo_tpu_cli.add_command(workflow_cli)
 gordo_tpu_cli.add_command(client_cli)
 gordo_tpu_cli.add_command(build)
@@ -2024,6 +2213,7 @@ gordo_tpu_cli.add_command(score)
 gordo_tpu_cli.add_command(ensure_single_workflow)
 gordo_tpu_cli.add_command(cleanup_revisions)
 gordo_tpu_cli.add_command(lifecycle_cli)
+gordo_tpu_cli.add_command(perfmodel_cli)
 
 
 if __name__ == "__main__":

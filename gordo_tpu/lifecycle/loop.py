@@ -308,9 +308,42 @@ class LifecycleSupervisor:
             # drift accumulators survive restarts (windows in progress
             # when the process dies are evidence, not noise)
             self.state.update(drift=self.monitor.snapshot())
+            self._maybe_recalibrate(report)
         report.phase = self.state.phase
         self._export_status(report)
         return report
+
+    def _maybe_recalibrate(self, report: CycleReport) -> None:
+        """Online perfmodel recalibration, once per cycle: refit the
+        learned cost regressors from the telemetry corpus and promote
+        only if the holdout gate passes (``perfmodel.service``). Gated
+        on ``GORDO_TPU_PERFMODEL_RECAL`` (default off) and advisory by
+        contract — any failure is a debug log, never a broken cycle."""
+        from ..utils.env import env_bool
+
+        if not env_bool("GORDO_TPU_PERFMODEL_RECAL", False):
+            return
+        try:
+            from ..perfmodel.service import maybe_recalibrate
+
+            corpus = env_str(telemetry.TRACE_DIR_ENV, None) or self.collection_dir
+            result = maybe_recalibrate(corpus)
+            if result is None:
+                return
+            report.details["perfmodel"] = {
+                "promoted": bool(result.get("promoted")),
+                "reason": result.get("reason"),
+                "models": len(result.get("models") or []),
+            }
+            self.recorder.event(
+                "perfmodel_recalibrated",
+                corpus=corpus,
+                promoted=bool(result.get("promoted")),
+                reason=str(result.get("reason", ""))[:200],
+                models=len(result.get("models") or []),
+            )
+        except Exception as exc:  # noqa: BLE001 - recalibration is advisory
+            logger.debug("perfmodel recalibration skipped: %r", exc)
 
     # -- phase steps --------------------------------------------------------
 

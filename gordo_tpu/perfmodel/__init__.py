@@ -1,0 +1,76 @@
+"""
+The learned performance model: trace-trained predictors that drive the
+planner, the serving ladders, warmup ordering and precision selection.
+
+Per "A Learned Performance Model for Tensor Processing Units"
+(PAPERS.md), per-program device cost is predictable from static
+features; this package closes the loop the analytic cost model
+(:mod:`gordo_tpu.planner.costmodel`) opened: it **harvests** training
+rows from the telemetry the system already records (``device_program``
+spans in ``build_trace.jsonl``, ``serve_batch`` spans in
+``serve_trace*.jsonl``), **fits** small closed-form ridge regressors in
+log space per (target, program kind), and **promotes** the fit into the
+versioned ``cost_table.json`` only when its holdout error beats the
+incumbent's — the analytic model stays pinned as the cold-start
+fallback, so an empty corpus changes nothing.
+
+Layering: the EVALUATION side (the ``learned`` section schema, the
+feature vocabulary, the knob-gated predictions) lives in
+``planner/costmodel.py`` because the layering contract forbids
+planner→perfmodel imports; this package owns the FIT side and may
+import telemetry and planner primitives — never ``server``/``serve``/
+``cli`` (declared in ``analysis/contracts.toml``).
+
+Consumers (each behind its own ``GORDO_TPU_PERFMODEL*`` knob, defaults
+preserving current behavior):
+
+- ``planner/packing.py`` bucket and rung decisions (automatic: the
+  packer costs through :class:`~gordo_tpu.planner.costmodel.CostModel`);
+- ``serve/engine.py`` batch-span predictions, per-spec predicted-HBM
+  batch caps, predicted-hot warmup ordering, and predicted-HBM-aware
+  OOM rung demotion;
+- ``serve/precision.py`` model-informed precision rung choice;
+- ``stream/scorer.py`` flush predictions;
+- ``lifecycle/loop.py`` online recalibration via
+  :func:`~gordo_tpu.perfmodel.service.maybe_recalibrate`.
+
+CLI: ``gordo-tpu perfmodel fit|status|eval``.
+"""
+
+from .features import (
+    TrainingRow,
+    corpus_fingerprint,
+    harvest_corpus,
+    harvest_trace,
+    rows_from_spans,
+)
+from .model import (
+    analytic_prediction,
+    evaluate_rows,
+    fit_ridge,
+    fit_section,
+    holdout_split,
+)
+from .service import (
+    default_table_path,
+    fit_and_promote,
+    maybe_recalibrate,
+    section_status,
+)
+
+__all__ = [
+    "TrainingRow",
+    "analytic_prediction",
+    "corpus_fingerprint",
+    "default_table_path",
+    "evaluate_rows",
+    "fit_and_promote",
+    "fit_ridge",
+    "fit_section",
+    "harvest_corpus",
+    "harvest_trace",
+    "holdout_split",
+    "maybe_recalibrate",
+    "rows_from_spans",
+    "section_status",
+]

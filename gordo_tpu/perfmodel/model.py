@@ -1,0 +1,249 @@
+"""
+The regressor: closed-form ridge in log space, pure Python.
+
+Each ``(target, program)`` population gets its own log-linear model
+``log(y) = intercept + coef · features`` — per the learned-TPU-cost-
+model recipe (PAPERS.md), program cost is near-multiplicative in shape,
+so a linear fit in log space captures it with 7 coefficients and no
+iterative training. Ridge (tiny L2 on the non-intercept terms) keeps
+the normal equations solvable when a corpus only exercised one rung of
+an axis (a column of identical values is singular without it).
+
+Honesty machinery:
+
+- :func:`holdout_split` carves a deterministic ~25% holdout BEFORE
+  fitting; every quality number this package reports is holdout error,
+  never training error.
+- :func:`fit_section` refuses populations below the
+  ``GORDO_TPU_PERFMODEL_MIN_SAMPLES`` floor — a regressor fit on six
+  spans would promote noise.
+- :func:`analytic_prediction` replays the analytic model on the same
+  feature vector, so the promotion gate compares like against like.
+  HBM has no feature-only analytic counterpart (the formula needs the
+  spec geometry, which the log-FLOPs feature cannot recover), so its
+  baseline is the train-median predictor — "beat predicting the
+  median" is the weakest gate that still rejects a garbage fit.
+"""
+
+import math
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..planner.costmodel import (
+    _TRAIN_FLOP_FACTOR,
+    LEARNED_FEATURES,
+    LEARNED_VERSION,
+    CostTable,
+)
+from ..utils.env import env_int
+from .features import TrainingRow
+
+#: floor under measured values before taking logs (ms or bytes)
+_EPS = 1e-9
+
+#: default L2 strength on the non-intercept coefficients
+_DEFAULT_L2 = 1e-3
+
+MIN_SAMPLES_ENV = "GORDO_TPU_PERFMODEL_MIN_SAMPLES"
+
+
+def fit_ridge(
+    xs: Sequence[Sequence[float]],
+    ys: Sequence[float],
+    l2: float = _DEFAULT_L2,
+) -> List[float]:
+    """Closed-form ridge: coefficients ``[intercept, w_1..w_d]``
+    minimizing ``Σ (intercept + w·x - y)^2 + l2·|w|^2`` (the intercept
+    is not penalized). Normal equations solved by Gaussian elimination
+    with partial pivoting — no numpy, the planner layer is importable
+    everywhere."""
+    if not xs:
+        raise ValueError("cannot fit on an empty sample set")
+    d = len(xs[0]) + 1  # intercept column first
+    a = [[0.0] * d for _ in range(d)]
+    b = [0.0] * d
+    for x, y in zip(xs, ys):
+        row = (1.0, *x)
+        for i in range(d):
+            b[i] += row[i] * y
+            for j in range(d):
+                a[i][j] += row[i] * row[j]
+    for i in range(1, d):  # ridge on everything but the intercept
+        a[i][i] += float(l2)
+    # Gaussian elimination, partial pivoting
+    for col in range(d):
+        pivot = max(range(col, d), key=lambda r: abs(a[r][col]))
+        if abs(a[pivot][col]) < 1e-12:
+            raise ValueError("singular design matrix (raise l2)")
+        if pivot != col:
+            a[col], a[pivot] = a[pivot], a[col]
+            b[col], b[pivot] = b[pivot], b[col]
+        inv = 1.0 / a[col][col]
+        for r in range(col + 1, d):
+            f = a[r][col] * inv
+            if f == 0.0:
+                continue
+            for c in range(col, d):
+                a[r][c] -= f * a[col][c]
+            b[r] -= f * b[col]
+    coef = [0.0] * d
+    for i in range(d - 1, -1, -1):
+        acc = b[i] - sum(a[i][j] * coef[j] for j in range(i + 1, d))
+        coef[i] = acc / a[i][i]
+    return coef
+
+
+def holdout_split(
+    rows: Sequence[TrainingRow],
+) -> Tuple[List[TrainingRow], List[TrainingRow]]:
+    """Deterministic ~25% holdout: rows sort by value, every 4th goes to
+    the holdout — striding a sorted population stratifies the split
+    across the shape range instead of gambling on arrival order (worker
+    sink merge order is not stable)."""
+    ordered = sorted(rows)
+    train: List[TrainingRow] = []
+    holdout: List[TrainingRow] = []
+    for index, row in enumerate(ordered):
+        (holdout if index % 4 == 3 else train).append(row)
+    if not holdout and len(train) > 1:  # tiny populations still hold one out
+        holdout.append(train.pop())
+    return train, holdout
+
+
+def evaluate_rows(
+    rows: Sequence[TrainingRow],
+    predict: Callable[[TrainingRow], Optional[float]],
+) -> Tuple[float, int]:
+    """``(mae_log, n_scored)``: mean absolute error in log space over
+    the rows ``predict`` answered (None answers are excluded from both
+    numerator and count). Log-space MAE is unit-free — 0.1 ≈ ±10%
+    multiplicative error whether the target is ms or bytes. An empty
+    scored set is ``(inf, 0)``."""
+    total, n = 0.0, 0
+    for row in rows:
+        pred = predict(row)
+        if pred is None or pred <= 0.0:
+            continue
+        total += abs(math.log(pred + _EPS) - math.log(max(row.y, 0.0) + _EPS))
+        n += 1
+    return (total / n, n) if n else (math.inf, 0)
+
+
+def coef_predict(coef: Sequence[float], features: Sequence[float]) -> float:
+    """``exp(intercept + coef·x)`` — the same arithmetic
+    ``CostTable.learned_predict`` runs, minus the domain gate (holdout
+    evaluation must score every row, not just the in-domain ones)."""
+    z = float(coef[0]) + sum(
+        float(c) * float(x) for c, x in zip(coef[1:], features)
+    )
+    return math.exp(z)
+
+
+def _shape_from_features(
+    features: Sequence[float],
+) -> Tuple[float, float, float, float, str]:
+    """Invert :func:`~gordo_tpu.planner.costmodel.learned_feature_vector`:
+    ``(flops_per_sample, members, rows, epochs, precision)``."""
+    flops = math.exp(features[0]) - 1.0
+    members = math.exp(features[1])
+    rows = math.exp(features[2])
+    epochs = math.exp(features[3])
+    precision = (
+        "bf16" if features[4] >= 0.5 else "int8" if features[5] >= 0.5 else "f32"
+    )
+    return flops, members, rows, epochs, precision
+
+
+def analytic_prediction(
+    table: CostTable, target: str, program: str, features: Sequence[float]
+) -> Optional[float]:
+    """What the ANALYTIC model (this ``table``'s factors, no learned
+    section) predicts for the same feature vector, in the target's unit.
+    None for ``hbm_bytes`` — its analytic formula needs the spec
+    geometry, which log-FLOPs cannot recover."""
+    flops, members, rows, epochs, precision = _shape_from_features(features)
+    if target == "device_ms":
+        if program == "fleet_forward":
+            total_flops = flops * members * rows
+            factor = table.run_factors.get(program, 1.0)
+        else:
+            total_flops = (
+                _TRAIN_FLOP_FACTOR * flops * members * rows * max(epochs, 1.0)
+            )
+            factor = table.run_factors.get(program, 1.0)
+        factor *= table.precision_factor(precision)
+        return (
+            factor * (total_flops / table.throughput) + table.dispatch_s
+        ) * 1000.0
+    if target == "compile_ms":
+        factor = table.compile_factors.get(program, 1.0)
+        return (
+            factor * (table.compile_floor_s + table.compile_per_flop * flops)
+        ) * 1000.0
+    return None
+
+
+def min_samples_floor(override: Optional[int] = None) -> int:
+    """The smallest population :func:`fit_section` will fit."""
+    if override is not None:
+        return max(int(override), 2)
+    return max(env_int(MIN_SAMPLES_ENV, 32), 2)
+
+
+def fit_section(
+    rows: Sequence[TrainingRow],
+    min_samples: Optional[int] = None,
+    l2: float = _DEFAULT_L2,
+) -> Optional[dict]:
+    """Fit every ``(target, program)`` population in ``rows`` that
+    clears the sample floor, and assemble the ``learned`` section dict
+    ``CostTable.from_dict`` validates (:data:`LEARNED_VERSION` schema).
+    None when NO population qualifies — the caller keeps the incumbent
+    table untouched (cold start stays analytic)."""
+    floor = min_samples_floor(min_samples)
+    populations: Dict[Tuple[str, str], List[TrainingRow]] = {}
+    for row in rows:
+        populations.setdefault((row.target, row.program), []).append(row)
+    targets: Dict[str, Dict[str, dict]] = {}
+    skipped: Dict[str, int] = {}
+    for (target, program), population in sorted(populations.items()):
+        if len(population) < floor:
+            skipped[f"{target}/{program}"] = len(population)
+            continue
+        train, holdout = holdout_split(population)
+        try:
+            coef = fit_ridge(
+                [r.features for r in train],
+                [math.log(max(r.y, 0.0) + _EPS) for r in train],
+                l2=l2,
+            )
+        except ValueError:
+            skipped[f"{target}/{program}"] = len(population)
+            continue
+        width = len(LEARNED_FEATURES)
+        lo = [
+            min(r.features[i] for r in train) for i in range(width)
+        ]
+        hi = [
+            max(r.features[i] for r in train) for i in range(width)
+        ]
+        mae, scored = evaluate_rows(
+            holdout, lambda r: coef_predict(coef, r.features)
+        )
+        if not math.isfinite(mae):
+            skipped[f"{target}/{program}"] = len(population)
+            continue
+        targets.setdefault(target, {})[program] = {
+            "coef": [round(c, 10) for c in coef],
+            "lo": [round(v, 6) for v in lo],
+            "hi": [round(v, 6) for v in hi],
+            "n": len(population),
+            "holdout_mae_log": round(mae, 6),
+        }
+    if not targets:
+        return None
+    return {
+        "version": LEARNED_VERSION,
+        "features": list(LEARNED_FEATURES),
+        "targets": targets,
+        "skipped": dict(sorted(skipped.items())),
+    }

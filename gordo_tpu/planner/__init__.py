@@ -33,11 +33,19 @@ it without cycles.
 
 from .costmodel import (
     COST_TABLE_FILE,
+    LEARNED_FEATURES,
+    LEARNED_TARGETS,
+    LEARNED_VERSION,
+    PERFMODEL_ENV,
     CostModel,
     CostTable,
     calibrate,
+    learned_feature_vector,
+    load_table_safe,
+    perfmodel_enabled,
     spec_flops_per_sample,
     spec_param_count,
+    validate_learned_section,
 )
 from .ladder import (
     DEFAULT_ROW_LADDER,
@@ -73,7 +81,11 @@ __all__ = [
     "CostTable",
     "DEFAULT_ROW_LADDER",
     "FleetPlan",
+    "LEARNED_FEATURES",
+    "LEARNED_TARGETS",
+    "LEARNED_VERSION",
     "NAIVE",
+    "PERFMODEL_ENV",
     "PACKED",
     "PLAN_FILE",
     "PlanError",
@@ -84,11 +96,15 @@ __all__ = [
     "config_fingerprint",
     "default_strategy",
     "geometric_rungs",
+    "learned_feature_vector",
+    "load_table_safe",
     "member_ladder",
     "pad_to",
     "parse_ladder",
+    "perfmodel_enabled",
     "plan_train_buckets",
     "render_plan",
+    "validate_learned_section",
     "round_up_ladder",
     "row_ladder",
     "sample_pad_ratio",

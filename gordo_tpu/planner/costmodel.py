@@ -27,9 +27,10 @@ import logging
 import math
 import os
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..models.spec import FeedForwardSpec, LSTMSpec, ModelSpec
+from ..utils.env import env_bool
 
 logger = logging.getLogger(__name__)
 
@@ -39,6 +40,42 @@ COST_TABLE_FILE = "cost_table.json"
 #: cost_table.json schema version — bump on shape changes so stale
 #: tables are rejected instead of silently misread
 COST_TABLE_VERSION = 1
+
+#: master switch for the LEARNED performance model (PR 20): when on, a
+#: cost table carrying a fitted ``learned`` section answers predictions
+#: from its log-linear regressors (in-domain) instead of the analytic
+#: formula. Off (the default) the learned section is inert — plans and
+#: ladder choices are byte-identical to the analytic model's.
+PERFMODEL_ENV = "GORDO_TPU_PERFMODEL"
+
+#: ``learned`` section schema version inside cost_table.json — the
+#: section versions independently of the table (an old table with no
+#: section stays loadable; a future section shape downgrades to the
+#: analytic fallback with a warning instead of rejecting the table)
+LEARNED_VERSION = 1
+
+#: the shared feature vocabulary: the FIT side (gordo_tpu.perfmodel)
+#: and the EVAL side (this module) must agree on the vector, and the
+#: layering contract forbids planner->perfmodel imports — so the
+#: vocabulary lives here, at the bottom, and perfmodel reads it from
+#: below exactly like serve reads PRECISION_ALIASES
+LEARNED_FEATURES: Tuple[str, ...] = (
+    "log_flops_per_sample",
+    "log_members",
+    "log_rows",
+    "log_epochs",
+    "bf16",
+    "int8",
+)
+
+#: prediction targets a learned section may carry, with their units
+LEARNED_TARGETS: Tuple[str, ...] = ("device_ms", "compile_ms", "hbm_bytes")
+
+#: extrapolation slack in log space around the training corpus's
+#: per-feature [lo, hi] box: ~5x beyond the largest trained shape still
+#: answers learned, further falls back analytic (a regressor fit on
+#: 8-member buckets has no business costing a 4096-member one)
+LEARNED_DOMAIN_SLACK = 1.6
 
 #: Adam keeps params + grads + two moment vectors resident per member
 _OPTIMIZER_COPIES = 4
@@ -71,6 +108,89 @@ PRECISION_ALIASES: Dict[str, str] = {
 #: analytic default per-precision step-time factors (shared by the
 #: CostTable field default and the legacy-table load path)
 DEFAULT_PRECISION_FACTORS: Dict[str, float] = {"bf16": 0.6, "int8": 0.55}
+
+
+def perfmodel_enabled() -> bool:
+    """The ``GORDO_TPU_PERFMODEL`` master switch (default off)."""
+    return env_bool(PERFMODEL_ENV, False)
+
+
+def learned_feature_vector(
+    flops_per_sample: float,
+    members: int,
+    rows: int,
+    epochs: int = 1,
+    precision: Optional[str] = None,
+) -> List[float]:
+    """The :data:`LEARNED_FEATURES` vector for one program shape — the
+    log-linear regressor's input, shared verbatim by the fit side
+    (``gordo_tpu.perfmodel``) and this module's evaluation.
+
+    >>> [round(v, 3) for v in learned_feature_vector(100.0, 8, 512)]
+    [4.615, 2.079, 6.238, 0.0, 0.0, 0.0]
+    """
+    prec = normalize_precision(precision)
+    return [
+        math.log(max(float(flops_per_sample), 0.0) + 1.0),
+        math.log(max(int(members), 1)),
+        math.log(max(int(rows), 1)),
+        math.log(max(int(epochs), 1)),
+        1.0 if prec == "bf16" else 0.0,
+        1.0 if prec == "int8" else 0.0,
+    ]
+
+
+def validate_learned_section(doc: object) -> Optional[dict]:
+    """A usable ``learned`` section dict, or None (with ONE warning) for
+    anything malformed — a truncated/mis-versioned/hand-edited section
+    must downgrade to the analytic fallback, never traceback in the
+    planner, the serve engine, or the lifecycle supervisor."""
+    if doc is None:
+        return None
+    try:
+        if not isinstance(doc, dict):
+            raise ValueError(f"learned section is {type(doc).__name__}, not dict")
+        version = int(doc.get("version", 0))
+        if version != LEARNED_VERSION:
+            raise ValueError(
+                f"learned section version {version} != supported "
+                f"{LEARNED_VERSION}"
+            )
+        features = tuple(str(f) for f in (doc.get("features") or ()))
+        if features != LEARNED_FEATURES:
+            raise ValueError(
+                f"learned feature vocabulary {features!r} != "
+                f"{LEARNED_FEATURES!r}"
+            )
+        width = len(LEARNED_FEATURES)
+        targets = doc.get("targets")
+        if not isinstance(targets, dict):
+            raise ValueError("learned section carries no targets map")
+        for target, programs in targets.items():
+            if target not in LEARNED_TARGETS:
+                raise ValueError(f"unknown learned target {target!r}")
+            if not isinstance(programs, dict):
+                raise ValueError(f"target {target!r} is not a program map")
+            for program, entry in programs.items():
+                coef = [float(c) for c in entry["coef"]]
+                lo = [float(v) for v in entry["lo"]]
+                hi = [float(v) for v in entry["hi"]]
+                if len(coef) != width + 1 or len(lo) != width or len(hi) != width:
+                    raise ValueError(
+                        f"model {target}/{program} has wrong arity"
+                    )
+                if not all(math.isfinite(c) for c in coef):
+                    raise ValueError(
+                        f"model {target}/{program} has non-finite coefficients"
+                    )
+        return doc
+    except (TypeError, ValueError, KeyError) as exc:
+        logger.warning(
+            "Ignoring unusable learned section in cost table (%s); "
+            "falling back to the analytic model",
+            exc,
+        )
+        return None
 
 
 def normalize_precision(precision: Optional[str]) -> str:
@@ -166,6 +286,11 @@ class CostTable:
     )
     #: calibration provenance: sample counts per program
     samples: Dict[str, int] = field(default_factory=dict)
+    #: the fitted learned-regressor section (PR 20), or None for a
+    #: purely analytic/median-factor table — see
+    #: :func:`validate_learned_section` for the schema. Inert unless
+    #: ``GORDO_TPU_PERFMODEL`` is on.
+    learned: Optional[dict] = None
     version: int = COST_TABLE_VERSION
 
     def precision_factor(self, precision: Optional[str]) -> float:
@@ -173,8 +298,49 @@ class CostTable:
             self.precision_factors.get(normalize_precision(precision), 1.0)
         )
 
+    # -- learned-section evaluation -----------------------------------------
+
+    def learned_entry(self, target: str, program: str) -> Optional[dict]:
+        """The fitted model for ``(target, program)``, or None."""
+        if not self.learned:
+            return None
+        return (self.learned.get("targets") or {}).get(target, {}).get(
+            program
+        )
+
+    def learned_predict(
+        self, target: str, program: str, features: Sequence[float]
+    ) -> Optional[float]:
+        """Evaluate the fitted log-linear model for ``(target,
+        program)`` on a :func:`learned_feature_vector`: ``exp(intercept
+        + coef·x)`` in the target's unit (ms or bytes). None when no
+        model is fitted, the shape is out of the training domain, or the
+        evaluation misbehaves — every None falls back analytic."""
+        entry = self.learned_entry(target, program)
+        if entry is None:
+            return None
+        try:
+            lo, hi = entry["lo"], entry["hi"]
+            for x, lo_i, hi_i in zip(features, lo, hi):
+                if not (
+                    lo_i - LEARNED_DOMAIN_SLACK
+                    <= x
+                    <= hi_i + LEARNED_DOMAIN_SLACK
+                ):
+                    return None
+            coef = entry["coef"]
+            z = float(coef[0]) + sum(
+                float(c) * float(x) for c, x in zip(coef[1:], features)
+            )
+            value = math.exp(z)
+        except (TypeError, ValueError, KeyError, IndexError, OverflowError):
+            return None
+        if not math.isfinite(value) or value < 0.0:
+            return None
+        return value
+
     def to_dict(self) -> dict:
-        return {
+        doc = {
             "version": self.version,
             "throughput": self.throughput,
             "compile_per_flop": self.compile_per_flop,
@@ -185,6 +351,9 @@ class CostTable:
             "precision_factors": dict(sorted(self.precision_factors.items())),
             "samples": dict(sorted(self.samples.items())),
         }
+        if self.learned is not None:
+            doc["learned"] = self.learned
+        return doc
 
     @classmethod
     def from_dict(cls, doc: dict) -> "CostTable":
@@ -219,6 +388,9 @@ class CostTable:
             samples={
                 str(k): int(v) for k, v in (doc.get("samples") or {}).items()
             },
+            # a bad learned section degrades (warn + analytic), it never
+            # rejects the table: the median factors are still good
+            learned=validate_learned_section(doc.get("learned")),
             version=version,
         )
 
@@ -238,6 +410,31 @@ class CostTable:
     def calibrated(self) -> bool:
         return bool(self.run_factors or self.compile_factors)
 
+    @property
+    def has_learned(self) -> bool:
+        return bool(
+            self.learned and (self.learned.get("targets") or {})
+        )
+
+
+def load_table_safe(path: Optional[str]) -> CostTable:
+    """A :class:`CostTable` from ``path`` that NEVER raises: a missing,
+    truncated, torn or mis-versioned ``cost_table.json`` warns once and
+    answers the analytic defaults — the contract the serve engine, the
+    stream scorer and the lifecycle supervisor load through (a corrupt
+    table must degrade predictions, not take down serving)."""
+    if not path:
+        return CostTable()
+    try:
+        return CostTable.load(path)
+    except (OSError, ValueError, TypeError, KeyError) as exc:
+        logger.warning(
+            "Unusable cost table %s (%s); using the analytic defaults",
+            path,
+            exc,
+        )
+        return CostTable()
+
 
 class CostModel:
     """Bucket-shape cost estimates against a :class:`CostTable`.
@@ -252,9 +449,39 @@ class CostModel:
         self,
         table: Optional[CostTable] = None,
         mesh_shape: Tuple[int, int] = (1, 1),
+        use_learned: Optional[bool] = None,
     ):
         self.table = table or CostTable()
         self.mesh_shape = (int(mesh_shape[0]), int(mesh_shape[1] or 1))
+        #: learned-section participation, resolved ONCE at construction
+        #: (``GORDO_TPU_PERFMODEL`` unless the caller pins it) so one
+        #: model instance answers consistently for its whole lifetime —
+        #: a plan costed half-analytic, half-learned would rank buckets
+        #: against each other with two different rulers
+        self.use_learned = (
+            perfmodel_enabled() if use_learned is None else bool(use_learned)
+        )
+
+    def _learned(
+        self,
+        target: str,
+        program: str,
+        spec: ModelSpec,
+        members: int,
+        rows: int,
+        epochs: int = 1,
+        precision: Optional[str] = None,
+    ) -> Optional[float]:
+        """One knob-gated learned lookup; None means 'answer analytic'."""
+        if not self.use_learned:
+            return None
+        return self.table.learned_predict(
+            target,
+            program,
+            learned_feature_vector(
+                spec_flops_per_sample(spec), members, rows, epochs, precision
+            ),
+        )
 
     # -- shape replication --------------------------------------------------
 
@@ -313,12 +540,23 @@ class CostModel:
         cost, corrected by the table's per-precision factor."""
         if precision is None:
             precision = compute_precision(spec)
+        learned = self._learned(
+            "device_ms", program, spec, m_total, n_total, epochs, precision
+        )
+        if learned is not None:
+            return learned / 1000.0
         flops = self.train_flops(spec, m_total, n_total, epochs)
         factor = self.table.run_factors.get(program, 1.0)
         factor *= self.table.precision_factor(precision)
         return factor * (flops / self.table.throughput) + self.table.dispatch_s
 
     def predict_compile_s(self, program: str, spec: ModelSpec) -> float:
+        # compile cost scales with program complexity, not data volume:
+        # the learned model is keyed on the same static features with
+        # the shape axes pinned to 1 (the fit side mirrors this)
+        learned = self._learned("compile_ms", program, spec, 1, 1)
+        if learned is not None:
+            return learned / 1000.0
         factor = self.table.compile_factors.get(program, 1.0)
         return factor * (
             self.table.compile_floor_s
@@ -347,6 +585,17 @@ class CostModel:
         mixed-precision contract: params never store reduced)."""
         if precision is None:
             precision = compute_precision(spec)
+        learned = self._learned(
+            "hbm_bytes",
+            "fleet_windowed_fit" if series_rows is not None else "fleet_fit",
+            spec,
+            m_total,
+            n_total,
+            1,
+            precision,
+        )
+        if learned is not None:
+            return int(learned)
         f_in = getattr(spec, "n_features", 1)
         f_out = getattr(spec, "n_features_out", f_in)
         if series_rows is not None:
@@ -397,6 +646,11 @@ class CostModel:
         weight bucket + the staged payload at the compute width + the
         f32 output."""
         precision = normalize_precision(precision)
+        learned = self._learned(
+            "hbm_bytes", "fleet_forward", spec, members, rows, 1, precision
+        )
+        if learned is not None:
+            return int(learned)
         f_in = getattr(spec, "n_features", 1)
         f_out = getattr(spec, "n_features_out", f_in)
         compute_bytes = PRECISION_COMPUTE_BYTES.get(precision, 4)
@@ -411,6 +665,11 @@ class CostModel:
         only — no train factor), with precision as a feature: the
         engine stamps this next to the measured device time on every
         batch span (predicted-vs-actual on the new axis)."""
+        learned = self._learned(
+            "device_ms", "fleet_forward", spec, members, rows, 1, precision
+        )
+        if learned is not None:
+            return learned / 1000.0
         flops = spec_flops_per_sample(spec) * float(members) * float(rows)
         factor = self.table.run_factors.get("fleet_forward", 1.0)
         factor *= self.table.precision_factor(precision)
@@ -458,7 +717,16 @@ def calibrate(
             m = int(attrs.get("stacked_members") or attrs.get("members") or 0)
             n = int(attrs.get("stacked_samples") or 0)
             epochs = int(attrs.get("epochs") or 1)
-            seconds = float(span.get("duration_ms") or 0.0) / 1000.0
+            # prefer the device-measured time when the span carries one;
+            # a span whose device_ms is present but zero/negative is a
+            # broken sample and is SKIPPED — folding its wall-clock
+            # duration into the median would let dispatch/queue noise
+            # masquerade as device time
+            device_ms = attrs.get("device_ms")
+            if device_ms is not None:
+                seconds = float(device_ms) / 1000.0
+            else:
+                seconds = float(span.get("duration_ms") or 0.0) / 1000.0
             flops_per_sample = float(flops_per_sample)
         except (TypeError, ValueError):
             continue

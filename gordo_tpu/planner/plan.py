@@ -26,6 +26,7 @@ import json
 import os
 from typing import Any, Dict, List, Sequence, Tuple
 
+from .costmodel import perfmodel_enabled
 from .packing import PlannedBucket, member_is_windowed, member_samples
 
 PLAN_VERSION = 1
@@ -250,6 +251,20 @@ def build_plan_doc(
         "cost_table": {
             "version": getattr(cost_table, "version", None),
             "calibrated": bool(getattr(cost_table, "calibrated", False)),
+            # per-program calibration sample counts: thin calibration
+            # (3 spans backing a factor) is visible in `plan --as-json`
+            # instead of hiding behind a confident-looking number
+            "samples": {
+                str(k): int(v)
+                for k, v in sorted(
+                    (getattr(cost_table, "samples", None) or {}).items()
+                )
+            },
+            # True only when the learned performance model actually
+            # participated in costing (section fitted AND knob on) —
+            # the plan records which ruler ranked its buckets
+            "learned": bool(getattr(cost_table, "has_learned", False))
+            and perfmodel_enabled(),
         },
         "buckets": bucket_docs,
         "totals": totals,

@@ -58,6 +58,15 @@ logger = logging.getLogger(__name__)
 
 BATCHING_ENV = "GORDO_TPU_BATCHING"
 
+#: learned-performance-model consumer knobs (PR 20): each defaults OFF,
+#: and each degrades to the exact pre-perfmodel behavior on any model
+#: failure — predictions steer, they never gate
+PERFMODEL_TABLE_ENV = "GORDO_TPU_PERFMODEL_TABLE"
+PERFMODEL_WARMUP_ENV = "GORDO_TPU_PERFMODEL_WARMUP"
+PERFMODEL_CAP_ENV = "GORDO_TPU_PERFMODEL_BATCH_CAP_BYTES"
+PERFMODEL_BREAKER_ENV = "GORDO_TPU_PERFMODEL_BREAKER"
+PERFMODEL_BREAKER_SAFETY_ENV = "GORDO_TPU_PERFMODEL_BREAKER_SAFETY"
+
 # SERVE_TRACE_FILE is re-exported for callers that predate the shared
 # serving recorder; telemetry/serving.py owns the name and sink now.
 assert SERVE_TRACE_FILE  # imported for re-export
@@ -196,6 +205,14 @@ class ServeEngine:
         #: cost model's serve-step estimate, cached per ladder shape for
         #: the predicted-vs-actual batch-span attributes)
         self._step_predictions: Dict[Tuple, float] = {}
+        #: the engine's CostModel (analytic, or carrying the learned
+        #: table GORDO_TPU_PERFMODEL_TABLE names) — built lazily ONCE so
+        #: every consumer (span predictions, batch caps, OOM demotion,
+        #: warmup ordering) measures with the same ruler
+        self._cost_model_cache: Optional[Any] = None
+        #: (spec, precision) -> predicted-HBM row cap under
+        #: GORDO_TPU_PERFMODEL_BATCH_CAP_BYTES (None = uncapped)
+        self._model_row_caps: Dict[Tuple, Optional[int]] = {}
         self._batcher = MicroBatcher(
             self._run_batch,
             max_size=self.config.max_size,
@@ -284,6 +301,16 @@ class ServeEngine:
         # degrade set — one set probe) or the parity gate failed / has
         # not passed yet (the governor — one COW dict probe)
         desired = precision.resolve_precision(spec, self.config.precision)
+        if desired == precision.F32 and not getattr(spec, "precision", ""):
+            # nothing pinned a precision: the learned model may nominate
+            # a measured-faster rung (GORDO_TPU_PERFMODEL_PRECISION,
+            # default off) — still gated and degradable below, exactly
+            # like a configured one
+            preferred = precision.model_preferred(
+                spec, self.member_ladder[-1], padded_rows, self._cost_model()
+            )
+            if preferred:
+                desired = preferred
         prec = desired
         if desired != precision.F32:
             if self.breakers.degraded(fleet, spec, desired):
@@ -299,6 +326,12 @@ class ServeEngine:
         # device already RESOURCE_EXHAUSTED on serve unbatched instead
         # of re-OOMing the same shape forever
         row_cap = self._row_caps.get((spec, prec))  # lock-free dict probe
+        # the perfmodel byte budget is a second, PREDICTIVE cap on the
+        # same axis: the reactive (post-OOM) and predicted caps merge as
+        # min — whichever learned the lower ceiling wins
+        model_cap = self._model_row_cap(spec, prec)
+        if model_cap is not None and (row_cap is None or model_cap < row_cap):
+            row_cap = model_cap
         if row_cap is not None and padded_rows > row_cap:
             self._count("fallback")
             return None
@@ -558,8 +591,18 @@ class ServeEngine:
 
             useful = sum(item.rows for item in live)
             waste = 1.0 - useful / float(padded_members * padded_rows)
+            try:
+                # the spec's static FLOPs feature rides every batch span
+                # so serve traces are self-contained perfmodel training
+                # rows (features + measured device_ms in one record)
+                from ..planner.costmodel import spec_flops_per_sample
+
+                flops_per_sample = spec_flops_per_sample(spec)
+            except Exception:  # noqa: BLE001 - telemetry enrichment only
+                flops_per_sample = None
             batch_span.set(
                 coalesced=members,
+                flops_per_sample=flops_per_sample,
                 padded_members=padded_members,
                 padded_rows=padded_rows,
                 padding_waste=round(waste, 4),
@@ -954,17 +997,26 @@ class ServeEngine:
         if "RESOURCE_EXHAUSTED" not in str(exc):
             return
         demoted = None
+        model_informed = False
+        padded = ladder.pad_to(members, self.member_ladder) or members
+        if members > 1:
+            cap = self._hbm_aware_cap(spec, prec, padded, padded_rows, "members")
+            model_informed = cap is not None
+            if cap is None:
+                cap = max(1, padded // 2)
+        else:
+            cap = self._hbm_aware_cap(spec, prec, padded, padded_rows, "rows")
+            model_informed = cap is not None
+            if cap is None:
+                lower = [r for r in self.config.row_ladder if r < padded_rows]
+                cap = max(lower) if lower else 0
         with self._lock:
             if members > 1:
-                padded = ladder.pad_to(members, self.member_ladder) or members
-                cap = max(1, padded // 2)
                 current = self._member_caps.get((spec, prec))
                 if current is None or cap < current:
                     self._member_caps[(spec, prec)] = cap
                     demoted = ("members", cap)
             else:
-                lower = [r for r in self.config.row_ladder if r < padded_rows]
-                cap = max(lower) if lower else 0
                 current = self._row_caps.get((spec, prec))
                 if current is None or cap < current:
                     self._row_caps[(spec, prec)] = cap
@@ -989,6 +1041,7 @@ class ServeEngine:
             precision=prec,
             axis=axis,
             cap=cap,
+            model_informed=model_informed,
             error=repr(exc)[:200],
         )
 
@@ -1041,6 +1094,22 @@ class ServeEngine:
             except Exception:  # noqa: BLE001 - metrics are advisory
                 pass
 
+    def _cost_model(self):
+        """The engine's cost model, built ONCE per engine: the analytic
+        defaults, or the (possibly learned) table that
+        ``GORDO_TPU_PERFMODEL_TABLE`` names — a corrupt/missing table
+        degrades to the analytic defaults inside ``load_table_safe``, so
+        this never raises past construction."""
+        model = self._cost_model_cache
+        if model is None:
+            from ..planner.costmodel import CostModel, load_table_safe
+
+            model = CostModel(
+                load_table_safe(env_str(PERFMODEL_TABLE_ENV, None))
+            )
+            self._cost_model_cache = model
+        return model
+
     def _predicted_step_ms(
         self, spec, members: int, rows: int, prec: str
     ) -> float:
@@ -1052,10 +1121,10 @@ class ServeEngine:
         cached = self._step_predictions.get(key)
         if cached is None:
             try:
-                from ..planner.costmodel import CostModel
-
                 cached = round(
-                    CostModel().predict_serve_step_s(spec, members, rows, prec)
+                    self._cost_model().predict_serve_step_s(
+                        spec, members, rows, prec
+                    )
                     * 1000.0,
                     4,
                 )
@@ -1066,6 +1135,98 @@ class ServeEngine:
                 self._step_predictions.clear()
             self._step_predictions[key] = cached
         return cached
+
+    def _model_row_cap(self, spec, prec: str) -> Optional[int]:
+        """The predicted-HBM row cap for one (spec, precision) under
+        ``GORDO_TPU_PERFMODEL_BATCH_CAP_BYTES``: the tallest row-ladder
+        rung whose WORST-CASE fused batch (full member ladder) stays
+        under the byte budget. None (uncapped) when the knob is off or
+        the estimate is unavailable; 0 sends every batch unbatched."""
+        cap_bytes = env_int(PERFMODEL_CAP_ENV, 0)
+        if cap_bytes <= 0:
+            return None
+        key = (spec, prec)
+        if key in self._model_row_caps:
+            return self._model_row_caps[key]
+        cap: Optional[int] = None
+        try:
+            model = self._cost_model()
+            top_members = self.member_ladder[-1]
+            fitting = [
+                rung
+                for rung in self.config.row_ladder
+                if model.predict_serve_hbm_bytes(
+                    spec, top_members, rung, prec
+                )
+                <= cap_bytes
+            ]
+            cap = max(fitting) if fitting else 0
+            if cap != self.config.row_ladder[-1]:
+                logger.info(
+                    "perfmodel batch cap: (%s, %s) rows capped at %d "
+                    "(predicted HBM budget %d bytes)",
+                    type(spec).__name__,
+                    prec,
+                    cap,
+                    cap_bytes,
+                )
+        except Exception:  # noqa: BLE001 - an unpredictable shape stays
+            # uncapped rather than unbatched
+            cap = None
+        with self._lock:
+            if len(self._model_row_caps) > 4096:
+                self._model_row_caps.clear()
+            self._model_row_caps[key] = cap
+        return cap
+
+    def _hbm_aware_cap(
+        self, spec, prec: str, padded_members: int, padded_rows: int, axis: str
+    ) -> Optional[int]:
+        """OOM demotion informed by predicted HBM
+        (``GORDO_TPU_PERFMODEL_BREAKER``): the largest lower rung on
+        ``axis`` whose predicted bytes fit under ``safety ×`` the failed
+        shape's prediction — possibly dropping SEVERAL rungs at once
+        where the fixed heuristic single-steps toward a shape the model
+        already says cannot fit. None defers to the fixed heuristic."""
+        if not env_bool(PERFMODEL_BREAKER_ENV, False):
+            return None
+        try:
+            model = self._cost_model()
+            safety = env_float(PERFMODEL_BREAKER_SAFETY_ENV, 0.8) or 0.8
+            failed = model.predict_serve_hbm_bytes(
+                spec, padded_members, padded_rows, prec
+            )
+            if failed <= 0:
+                return None
+            budget = failed * float(safety)
+            if axis == "members":
+                candidates = [
+                    v for v in self.member_ladder if v < padded_members
+                ]
+                fitting = [
+                    v
+                    for v in candidates
+                    if model.predict_serve_hbm_bytes(
+                        spec, v, padded_rows, prec
+                    )
+                    <= budget
+                ]
+            else:
+                candidates = [
+                    r for r in self.config.row_ladder if r < padded_rows
+                ]
+                fitting = [
+                    r
+                    for r in candidates
+                    if model.predict_serve_hbm_bytes(
+                        spec, padded_members, r, prec
+                    )
+                    <= budget
+                ]
+            return max(fitting) if fitting else None
+        except Exception:  # noqa: BLE001 - the fixed heuristic is the
+            # fallback, never a crashed demotion
+            return None
 
     # -- warmup -------------------------------------------------------------
 
@@ -1099,11 +1260,47 @@ class ServeEngine:
             if isinstance(spec, FeedForwardSpec)
         }
         compiled = 0
-        for spec in sorted(specs, key=repr):
+        # warmup order: alphabetical by default; predicted-hot first
+        # under GORDO_TPU_PERFMODEL_WARMUP — the specs (and shapes) that
+        # will cost the most device time compile first, so an early
+        # request is likelier to find ITS program warm when warmup is
+        # racing live traffic. repr stays the tie-break: equal
+        # predictions keep the deterministic compile order the
+        # compile-count tests pin.
+        spec_order = sorted(specs, key=repr)
+        hot_first = env_bool(PERFMODEL_WARMUP_ENV, False)
+        if hot_first:
+            try:
+                model = self._cost_model()
+                top_rows = max(warm_rows)
+                top_members = self.member_ladder[-1]
+                spec_order = sorted(
+                    specs,
+                    key=lambda s: (
+                        -model.predict_serve_step_s(
+                            s, top_members, top_rows, precision.F32
+                        ),
+                        repr(s),
+                    ),
+                )
+            except Exception:  # noqa: BLE001 - ordering is advisory
+                spec_order = sorted(specs, key=repr)
+        for spec in spec_order:
             # the gate decides which precision this spec's ladder warms:
             # a passed gate warms the reduced programs, a failed one
             # warms the f32 programs the degraded traffic will hit
             desired = precision.resolve_precision(spec, self.config.precision)
+            if desired == precision.F32 and not getattr(spec, "precision", ""):
+                # mirror the request path's learned nomination so warmup
+                # compiles the programs live traffic will actually hit
+                preferred = precision.model_preferred(
+                    spec,
+                    self.member_ladder[-1],
+                    max(warm_rows),
+                    self._cost_model(),
+                )
+                if preferred:
+                    desired = preferred
             prec = (
                 self.governor.effective_precision(
                     fleet, spec, desired, recorder=self._recorder
@@ -1130,9 +1327,19 @@ class ServeEngine:
             variants = [("payload", None)]
             if plan is not None and not plan.identity:
                 variants.append(("ingest", (plan.scale, plan.offset)))
-            for padded_members in self.member_ladder:
+            # within a spec, hot-first walks the ladders top-down (the
+            # tallest shapes carry the highest predicted device cost)
+            member_order = (
+                list(reversed(self.member_ladder))
+                if hot_first
+                else self.member_ladder
+            )
+            rows_order = (
+                list(reversed(warm_rows)) if hot_first else warm_rows
+            )
+            for padded_members in member_order:
                 indices = np.arange(padded_members, dtype=np.int32) % n_bucket
-                for padded_rows in warm_rows:
+                for padded_rows in rows_order:
                     for variant, ingest_arrays in variants:
                         program = (
                             spec, backend, padded_members, padded_rows, prec,

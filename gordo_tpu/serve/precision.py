@@ -45,6 +45,7 @@ logger = logging.getLogger(__name__)
 
 PRECISION_ENV = "GORDO_TPU_SERVE_PRECISION"
 GATE_ENV = "GORDO_TPU_PRECISION_GATE"
+PERFMODEL_PRECISION_ENV = "GORDO_TPU_PERFMODEL_PRECISION"
 
 #: the serving precision ladder, widest first; ``f32`` is the default
 #: and the degrade target. ``int8`` is per-channel weight-only
@@ -103,6 +104,50 @@ def gate_enabled() -> bool:
     """The parity gate master switch (``GORDO_TPU_PRECISION_GATE``,
     default ON — reduced precision must EARN traffic)."""
     return env_bool(GATE_ENV, True)
+
+
+def model_preferred(
+    spec: Any, members: int, rows: int, cost_model: Any
+) -> Optional[str]:
+    """The precision rung the LEARNED performance model predicts fastest
+    for this spec at a representative fused shape, or None to keep the
+    configured resolution. Deliberately narrow:
+
+    - gated on ``GORDO_TPU_PERFMODEL_PRECISION`` (default off);
+    - answers only from MEASURED evidence — every candidate rung must
+      have an in-domain learned ``fleet_forward`` prediction. The
+      analytic per-precision factors are priors that ALWAYS say reduced
+      is faster; steering on them would flip the f32 default for every
+      deployment the moment the knob turns on, learned table or not;
+    - advisory only: the winner still rides the parity gate and the
+      breaker degrade set downstream, exactly like a configured
+      precision.
+    """
+    if not env_bool(PERFMODEL_PRECISION_ENV, False):
+        return None
+    try:
+        from ..planner.costmodel import (
+            learned_feature_vector,
+            spec_flops_per_sample,
+        )
+
+        flops = spec_flops_per_sample(spec)
+        best: Optional[Tuple[float, str]] = None
+        for candidate in PRECISIONS:
+            predicted = cost_model.table.learned_predict(
+                "device_ms",
+                "fleet_forward",
+                learned_feature_vector(flops, members, rows, 1, candidate),
+            )
+            if predicted is None:
+                return None  # partial evidence: keep the configured rung
+            if best is None or predicted < best[0]:
+                best = (predicted, candidate)
+        if best is None or best[1] == F32:
+            return None
+        return best[1]
+    except Exception:  # noqa: BLE001 - advisory path, never a gate
+        return None
 
 
 # -- payload dtypes -----------------------------------------------------------

@@ -152,12 +152,19 @@ class WindowScorer:
         cached = self._step_predictions.get(key)
         if cached is None:
             try:
-                from ..planner.costmodel import CostModel
+                from ..planner.costmodel import CostModel, load_table_safe
+                from ..utils.env import env_str
 
+                # the perfmodel table (when GORDO_TPU_PERFMODEL_TABLE
+                # names one) upgrades flush predictions to the learned
+                # regressors; load_table_safe degrades any bad table to
+                # the analytic defaults without raising
                 cached = round(
-                    CostModel().predict_serve_step_s(
-                        spec, members, rows, "f32"
-                    )
+                    CostModel(
+                        load_table_safe(
+                            env_str("GORDO_TPU_PERFMODEL_TABLE", None)
+                        )
+                    ).predict_serve_step_s(spec, members, rows, "f32")
                     * 1000.0,
                     4,
                 )

@@ -454,6 +454,44 @@ GATES: Dict[str, List[MetricSpec]] = {
             "truthy",
         ),
     ],
+    "learned-perfmodel": [
+        # the learned regressor earns its place by beating the analytic
+        # model on a held-out slice of the same trace corpus — the same
+        # accuracy gate fit_and_promote enforces, re-checked end to end
+        # from raw traces. Ratio = learned MAE / analytic MAE in log
+        # space; 1.0 is parity, the promotion gate's own floor.
+        MetricSpec(
+            "learned vs analytic holdout MAE, device time (ratio)",
+            "accuracy.device_ms.mae_ratio",
+            "max_bound",
+            bound=1.0,
+        ),
+        MetricSpec(
+            "learned vs analytic holdout MAE, compile time (ratio)",
+            "accuracy.compile_ms.mae_ratio",
+            "max_bound",
+            bound=1.0,
+        ),
+        MetricSpec("model promoted from bench corpus", "fit.promoted", "truthy"),
+        # learned-informed serving (model-ordered warmup + learned step
+        # predictions) vs the static ladder at equal offered load. On
+        # CPU hosts there is no hardware for the model to exploit, so
+        # parity is the ceiling — the floor catches the learned path
+        # *losing* throughput (mispredicted ladders, estimator overhead
+        # on the hot path).
+        MetricSpec(
+            "learned-informed vs static ladder throughput (ratio)",
+            "ladder.learned_vs_static_throughput",
+            "min_bound",
+            bound=0.85,
+        ),
+        MetricSpec(
+            "learned-informed vs static ladder p99 latency (ratio)",
+            "ladder.learned_vs_static_p99_ratio",
+            "max_bound",
+            bound=1.5,
+        ),
+    ],
 }
 
 #: where each bench kind's committed baseline lives (repo root)
@@ -470,6 +508,7 @@ BASELINE_FILES: Dict[str, str] = {
     "serve-chaos": "BENCH_CHAOS.json",
     "stream-soak": "BENCH_STREAM.json",
     "device-ingest": "BENCH_INGEST.json",
+    "learned-perfmodel": "BENCH_PERFMODEL.json",
 }
 
 

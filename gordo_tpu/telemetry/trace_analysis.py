@@ -440,6 +440,47 @@ def stream_breakdown(spans: Iterable[dict]) -> Optional[Dict[str, Any]]:
     }
 
 
+def prediction_accuracy(
+    spans: Iterable[dict],
+) -> Optional[Dict[str, Dict[str, Any]]]:
+    """Predicted-vs-actual device time per program population: every
+    span carrying both a measured ``device_ms`` and the cost model's
+    ``predicted_device_ms`` stamp (serve batches, stream flushes) is one
+    scored pair. ``error_p50``/``error_p95`` are relative-error
+    percentiles (|predicted − actual| / actual); ``bias`` is the median
+    predicted/actual ratio — above 1.0 the model over-predicts, below it
+    under-predicts. The ``-1.0`` predicted sentinel (estimator
+    unavailable) is excluded, so accuracy never averages in the spans
+    that had no prediction at all."""
+    by_key: Dict[str, Dict[str, list]] = {}
+    for span in spans:
+        attributes = span.get("attributes") or {}
+        try:
+            device = float(attributes.get("device_ms"))
+            predicted = float(attributes.get("predicted_device_ms"))
+        except (TypeError, ValueError):
+            continue
+        if device <= 0.0 or predicted < 0.0:
+            continue
+        key = str(attributes.get("program") or span["name"])
+        entry = by_key.setdefault(key, {"ratios": [], "errors": []})
+        entry["ratios"].append(predicted / device)
+        entry["errors"].append(abs(predicted - device) / device)
+    if not by_key:
+        return None
+    out: Dict[str, Dict[str, Any]] = {}
+    for key, entry in sorted(by_key.items()):
+        errors = sorted(entry["errors"])
+        ratios = sorted(entry["ratios"])
+        out[key] = {
+            "count": len(errors),
+            "error_p50": round(percentile(errors, 0.50), 4),
+            "error_p95": round(percentile(errors, 0.95), 4),
+            "bias": round(percentile(ratios, 0.50), 4),
+        }
+    return out
+
+
 def top_profile_frames(
     spans: Iterable[dict], max_frames: int = 25
 ) -> List[Dict[str, Any]]:
@@ -498,6 +539,7 @@ def analyze_trace(
         "span_summary": summarize_spans(spans),
         "request_breakdown": request_breakdown(spans),
         "stream_breakdown": stream_breakdown(spans),
+        "prediction_accuracy": prediction_accuracy(spans),
         "profile_frames": top_profile_frames(spans),
     }
     if since_ts is not None or until_ts is not None:
@@ -640,6 +682,25 @@ def render_analysis(doc: Dict[str, Any]) -> str:
                 out.append(
                     f"critical path ({stream_id}, median): {path_text}"
                 )
+
+    accuracy = doc.get("prediction_accuracy")
+    if accuracy:
+        out.append("\nPrediction accuracy (cost model vs measured device ms):")
+        out.append(
+            _table(
+                [
+                    [
+                        program,
+                        entry["count"],
+                        f"{entry['error_p50'] * 100:.1f}%",
+                        f"{entry['error_p95'] * 100:.1f}%",
+                        entry["bias"],
+                    ]
+                    for program, entry in accuracy.items()
+                ],
+                ["program", "pairs", "err p50", "err p95", "bias"],
+            )
+        )
 
     frames = doc.get("profile_frames") or []
     if frames:

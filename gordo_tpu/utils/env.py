@@ -684,6 +684,80 @@ KNOBS: Dict[str, Knob] = _knobs(
         "alongside drifted ones.",
         "Lifecycle",
     ),
+    # -- Learned performance model -----------------------------------------
+    Knob(
+        "GORDO_TPU_PERFMODEL", "bool", False,
+        "Master switch for the learned performance model: cost tables "
+        "carrying a fitted `learned` section answer in-domain "
+        "predictions (device ms / compile ms / HBM bytes) from the "
+        "trace-trained log-linear regressors instead of the analytic "
+        "formula. Off: the section is inert — plans and ladder choices "
+        "are byte-identical to the analytic model's.",
+        "Performance model",
+    ),
+    Knob(
+        "GORDO_TPU_PERFMODEL_TABLE", "str", None,
+        "Path to the `cost_table.json` the SERVING plane's estimators "
+        "(batch-span predictions, stream flush predictions, the "
+        "model-informed consumers below) load; unreadable or "
+        "mis-versioned tables warn once and degrade to the analytic "
+        "defaults. Unset: the analytic defaults.",
+        "Performance model",
+    ),
+    Knob(
+        "GORDO_TPU_PERFMODEL_WARMUP", "bool", False,
+        "Order serve warmup by predicted cost, hottest first (specs by "
+        "predicted step time at the top warm shape, then per-spec "
+        "shapes descending) so the most expensive compiles happen "
+        "earliest in the warmup budget.",
+        "Performance model",
+    ),
+    Knob(
+        "GORDO_TPU_PERFMODEL_BATCH_CAP_BYTES", "int", 0,
+        "Per-spec predicted-HBM batch cap in bytes: row rungs whose "
+        "predicted fused-batch footprint (at the full member ladder) "
+        "exceeds the budget are never batched into — requests taller "
+        "than the allowed rungs serve unbatched. 0 = off.",
+        "Performance model",
+    ),
+    Knob(
+        "GORDO_TPU_PERFMODEL_BREAKER", "bool", False,
+        "Predicted-HBM-aware OOM demotion: a RESOURCE_EXHAUSTED batch "
+        "demotes to the largest ladder rung whose predicted footprint "
+        "is safely below the failed shape's, instead of the fixed "
+        "halve-members / drop-one-row-rung heuristic.",
+        "Performance model",
+    ),
+    Knob(
+        "GORDO_TPU_PERFMODEL_BREAKER_SAFETY", "float", 0.8,
+        "Safety factor for predicted-HBM-aware demotion: the demoted "
+        "rung's predicted bytes must be <= this fraction of the failed "
+        "shape's predicted bytes.",
+        "Performance model",
+    ),
+    Knob(
+        "GORDO_TPU_PERFMODEL_PRECISION", "bool", False,
+        "Model-informed precision rung choice: when neither the spec "
+        "nor `GORDO_TPU_SERVE_PRECISION` pins a serving precision, pick "
+        "the rung with the lowest predicted step time for the bucket's "
+        "shape (the parity gate still decides whether reduced may "
+        "actually serve).",
+        "Performance model",
+    ),
+    Knob(
+        "GORDO_TPU_PERFMODEL_RECAL", "bool", False,
+        "Online recalibration: each lifecycle cycle refits the learned "
+        "sections from the telemetry corpus and promotes the new table "
+        "only if its holdout error beats the incumbent's "
+        "(`gordo_tpu.perfmodel.service.maybe_recalibrate`).",
+        "Performance model",
+    ),
+    Knob(
+        "GORDO_TPU_PERFMODEL_MIN_SAMPLES", "int", 32,
+        "Minimum training rows per (target, program) before a learned "
+        "model is fitted for it; thinner populations stay analytic.",
+        "Performance model",
+    ),
     # -- Reporters ---------------------------------------------------------
     Knob(
         "GORDO_TPU_MLFLOW_DIR", "str", None,

@@ -88,6 +88,10 @@ case "$component" in
     # manifest, bounded fleet-status, breaker summaries) lives in
     # tests/telemetry + tests/server — marker-selected the same way.
     scale)    run -m "scale and not slow" tests/ ;;
+    # The learned performance-model suite cuts across tests/perfmodel,
+    # tests/ingest (ladder-snapped stream cuts) and the planner/serve
+    # consumer contracts — marker-selected the same way.
+    perfmodel) run -m "perfmodel and not slow" tests/ ;;
     # The device-resident ingest suite cuts across tests/ingest,
     # tests/server and tests/serve (compiled plans, raw-column
     # transfer, parity, stream snap) — marker-selected the same way.

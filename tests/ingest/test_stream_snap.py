@@ -119,6 +119,34 @@ def test_backlog_flush_snaps_then_drains_with_contiguous_spans(tmp_path):
     )
 
 
+def test_backlog_drain_mints_only_ladder_rung_shapes(
+    tmp_path, routed_fake_fleet
+):
+    """Compile-count pin: draining ragged backlogs of many different
+    sizes submits ONLY ladder-aligned cut sizes, so the compiled-shape
+    population a drain can mint is bounded by the ladder's rung count —
+    never by how ragged the backlogs were."""
+    sizes = []
+    orig = routed_fake_fleet.fleet_scores
+
+    def recording(inputs):
+        sizes.extend(len(X) for X in inputs.values())
+        return orig(inputs)
+
+    routed_fake_fleet.fleet_scores = recording
+    scorer = WindowScorer(WINDOW)
+    session = make_session(tmp_path, ring_rows=2048)
+    for backlog in (224, 197, 510, 333, 75):
+        session.append_rows("m-1", frame(backlog))
+        while scorer.flush(session)["scored"]:
+            pass
+    assert sizes
+    # whole-window capacities of the default (32, 128, 512, ...) ladder
+    aligned = {(rung // WINDOW) * WINDOW for rung in (32, 128, 512)}
+    assert set(sizes) <= aligned
+    assert len(set(sizes)) <= len(aligned)
+
+
 def test_small_flushes_are_untouched_by_snapping(tmp_path):
     """Below the smallest aligned rung the whole backlog still scores
     on the first flush — snapping must never delay a small payload."""

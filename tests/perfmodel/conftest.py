@@ -1,0 +1,123 @@
+"""
+Perfmodel-suite fixtures: synthetic trace corpora drawn from a KNOWN
+multiplicative cost law (so fits have a ground truth to recover), span
+builders matching the telemetry plane's JSONL schema, and a fitted
+cost-table fixture the consumer tests load through the real
+``fit_and_promote`` path.
+"""
+
+import json
+import math
+import os
+
+import pytest
+
+from gordo_tpu.models.spec import FeedForwardSpec
+from gordo_tpu.telemetry import SERVE_TRACE_FILE
+
+SPEC = FeedForwardSpec(
+    n_features=3, n_features_out=3, dims=(6, 3), activations=("tanh", "tanh")
+)
+
+FLOPS = 100.0
+
+#: the ground-truth law the synthetic corpora follow:
+#: device_ms = 0.05 * members^0.9 * rows^0.8 (× 0.7 at bf16) — exactly
+#: log-linear in the learned feature vocabulary, so a correct fit drives
+#: holdout error to ~0 while the analytic defaults stay far off
+def true_device_ms(members, rows, precision="f32"):
+    scale = 0.7 if precision == "bf16" else 1.0
+    return 0.05 * (members ** 0.9) * (rows ** 0.8) * scale
+
+
+def true_compile_ms(flops=FLOPS):
+    return 40.0 + 0.2 * flops
+
+
+def serve_span(index, members, rows, precision="f32", device_ms=None, **extra):
+    attrs = {
+        "flops_per_sample": FLOPS,
+        "padded_members": members,
+        "padded_rows": rows,
+        "precision": precision,
+        "device_ms": (
+            device_ms
+            if device_ms is not None
+            else true_device_ms(members, rows, precision)
+        ),
+    }
+    attrs.update(extra)
+    return {
+        "name": "serve_batch",
+        "context": {"trace_id": "t", "span_id": f"s-{index}"},
+        "attributes": attrs,
+    }
+
+
+def compile_span(index, members, rows, precision="f32", device_ms=None):
+    return {
+        "name": "device_program",
+        "context": {"trace_id": "t", "span_id": f"c-{index}"},
+        "attributes": {
+            "program": "fleet_forward",
+            "compile": True,
+            "flops_per_sample": FLOPS,
+            "stacked_members": members,
+            "stacked_samples": rows,
+            "precision": precision,
+            "device_ms": (
+                device_ms if device_ms is not None else true_compile_ms()
+            ),
+        },
+    }
+
+
+def grid_spans(jitter=0.0):
+    """A (members × rows × precision) grid of serve spans plus one
+    compile span per shape — 72 device rows, 36 compile rows."""
+    spans = []
+    shapes = [
+        (m, r, p)
+        for p in ("f32", "bf16")
+        for m in (1, 2, 4, 8, 12, 16)
+        for r in (16, 32, 128)
+    ]
+    for i, (m, r, p) in enumerate(shapes):
+        spans.append(compile_span(len(spans), m, r, p))
+        for k in range(2):
+            wobble = 1.0 + jitter * math.sin(i + k)
+            spans.append(
+                serve_span(
+                    len(spans), m, r, p,
+                    device_ms=true_device_ms(m, r, p) * wobble,
+                )
+            )
+    return spans
+
+
+def write_corpus(directory, spans):
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, SERVE_TRACE_FILE)
+    with open(path, "w") as f:
+        for span in spans:
+            f.write(json.dumps(span) + "\n")
+    return path
+
+
+@pytest.fixture
+def corpus_dir(tmp_path):
+    directory = str(tmp_path / "telemetry")
+    write_corpus(directory, grid_spans(jitter=0.02))
+    return directory
+
+
+@pytest.fixture
+def fitted_table_path(corpus_dir, tmp_path):
+    """A cost table with a promoted learned section, produced by the
+    real harvest→fit→gate→save path."""
+    from gordo_tpu.perfmodel import fit_and_promote
+
+    path = str(tmp_path / "cost_table.json")
+    report = fit_and_promote(corpus_dir, table_path=path, min_samples=8)
+    assert report["promoted"], report
+    return path

@@ -1,0 +1,282 @@
+"""
+Consumer drills: the serving-plane surfaces that READ the learned
+model — the engine's predicted-HBM batch cap and OOM demotion, the
+precision nomination, the trace report's predicted-vs-actual section,
+and the ``gordo-tpu perfmodel`` CLI — each gated by its own knob and
+degrading to the exact pre-perfmodel behavior when the model cannot
+answer.
+"""
+
+import json
+
+import pytest
+from click.testing import CliRunner
+
+from gordo_tpu.cli.cli import gordo_tpu_cli
+from gordo_tpu.planner.costmodel import CostModel, CostTable
+from gordo_tpu.serve import ServeConfig, ServeEngine
+from gordo_tpu.serve import precision as P
+from gordo_tpu.telemetry.trace_analysis import (
+    analyze_trace,
+    prediction_accuracy,
+    render_analysis,
+)
+
+from tests.perfmodel.conftest import SPEC, write_corpus, grid_spans
+
+pytestmark = [pytest.mark.perfmodel, pytest.mark.serve]
+
+
+@pytest.fixture
+def engine():
+    engine = ServeEngine(
+        ServeConfig(
+            max_size=8,
+            max_delay_ms=60.0,
+            queue_depth=64,
+            deadline_ms=10000.0,
+            dispatchers=1,
+            row_ladder=(8, 32),
+            warmup_max_rows=32,
+        )
+    )
+    try:
+        yield engine
+    finally:
+        engine.shutdown(drain=True)
+
+
+# -- predicted-HBM batch cap -------------------------------------------------
+
+
+def test_model_row_cap_defaults_off(engine, monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_PERFMODEL_BATCH_CAP_BYTES", raising=False)
+    assert engine._model_row_cap(SPEC, "f32") is None
+
+
+def test_model_row_cap_picks_the_tallest_fitting_rung(engine, monkeypatch):
+    model = engine._cost_model()
+    top = engine.member_ladder[-1]
+    low = model.predict_serve_hbm_bytes(SPEC, top, 8, "f32")
+    high = model.predict_serve_hbm_bytes(SPEC, top, 32, "f32")
+    assert low < high
+    # budget between the two rungs: only the 8-row rung fits
+    monkeypatch.setenv(
+        "GORDO_TPU_PERFMODEL_BATCH_CAP_BYTES", str((low + high) // 2)
+    )
+    assert engine._model_row_cap(SPEC, "f32") == 8
+    # budget above both: the top rung (== uncapped behavior)
+    engine._model_row_caps.clear()
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_BATCH_CAP_BYTES", str(high * 2))
+    assert engine._model_row_cap(SPEC, "f32") == 32
+    # budget below both: 0 — every batch serves unbatched
+    engine._model_row_caps.clear()
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_BATCH_CAP_BYTES", str(low // 2))
+    assert engine._model_row_cap(SPEC, "f32") == 0
+
+
+# -- predicted-HBM OOM demotion ----------------------------------------------
+
+
+def test_hbm_aware_cap_defaults_off(engine, monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_PERFMODEL_BREAKER", raising=False)
+    assert engine._hbm_aware_cap(SPEC, "f32", 8, 32, "members") is None
+
+
+def test_hbm_aware_cap_drops_to_a_predicted_safe_rung(engine, monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_BREAKER", "1")
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_BREAKER_SAFETY", "0.8")
+    cap = engine._hbm_aware_cap(SPEC, "f32", 8, 32, "members")
+    model = engine._cost_model()
+    failed = model.predict_serve_hbm_bytes(SPEC, 8, 32, "f32")
+    assert cap is not None and cap < 8
+    assert model.predict_serve_hbm_bytes(SPEC, cap, 32, "f32") <= 0.8 * failed
+    # a tight safety margin may skip SEVERAL rungs at once
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_BREAKER_SAFETY", "0.3")
+    tight = engine._hbm_aware_cap(SPEC, "f32", 8, 32, "members")
+    assert tight is not None and tight <= cap
+    # rows axis: the demoted rung comes off the configured row ladder
+    row_cap = engine._hbm_aware_cap(SPEC, "f32", 1, 32, "rows")
+    assert row_cap in (None, 8)  # 8 is the only lower rung
+
+
+def test_oom_demotion_records_whether_the_model_informed_it(
+    engine, monkeypatch
+):
+    exc = RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+    monkeypatch.delenv("GORDO_TPU_PERFMODEL_BREAKER", raising=False)
+    engine._note_resource_exhausted(SPEC, "f32", 8, 32, exc)
+    fixed = engine._member_caps.get((SPEC, "f32"))
+    assert fixed == 4  # the fixed heuristic: padded // 2
+    engine._member_caps.clear()
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_BREAKER", "1")
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_BREAKER_SAFETY", "0.3")
+    engine._note_resource_exhausted(SPEC, "f32", 8, 32, exc)
+    informed = engine._member_caps.get((SPEC, "f32"))
+    assert informed is not None and informed < fixed  # skipped rungs
+
+
+# -- precision nomination ----------------------------------------------------
+
+
+def reduced_favoring_table():
+    """A learned section with measured evidence that bf16 is fastest."""
+    entry = {
+        "coef": [0.1, 0.0, 1.0, 1.0, 0.0, -0.5, 0.2],
+        "lo": [0.0] * 6,
+        "hi": [30.0] * 6,
+        "n": 64,
+        "holdout_mae_log": 0.05,
+    }
+    return CostTable(
+        learned={
+            "version": 1,
+            "features": [
+                "log_flops_per_sample",
+                "log_members",
+                "log_rows",
+                "log_epochs",
+                "bf16",
+                "int8",
+            ],
+            "targets": {"device_ms": {"fleet_forward": entry}},
+        }
+    )
+
+
+def test_model_preferred_defaults_off(monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_PERFMODEL_PRECISION", raising=False)
+    model = CostModel(reduced_favoring_table(), use_learned=True)
+    assert P.model_preferred(SPEC, 8, 32, model) is None
+
+
+def test_model_preferred_nominates_the_measured_fastest(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_PRECISION", "1")
+    model = CostModel(reduced_favoring_table(), use_learned=True)
+    assert P.model_preferred(SPEC, 8, 32, model) == "bf16"
+
+
+def test_model_preferred_requires_evidence_for_every_rung(monkeypatch):
+    """Partial evidence keeps the configured rung: an analytic-only
+    table (whose per-precision priors ALWAYS favor reduced) must not
+    flip the f32 default."""
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_PRECISION", "1")
+    assert P.model_preferred(SPEC, 8, 32, CostModel(CostTable())) is None
+    # an f32-favoring section nominates nothing either
+    table = reduced_favoring_table()
+    entry = table.learned["targets"]["device_ms"]["fleet_forward"]
+    entry["coef"] = [0.1, 0.0, 1.0, 1.0, 0.0, 0.5, 0.7]  # f32 wins
+    assert P.model_preferred(SPEC, 8, 32, CostModel(table)) is None
+
+
+# -- trace predicted-vs-actual section ---------------------------------------
+
+
+def accuracy_spans():
+    return [
+        {
+            "name": "serve_batch",
+            "attributes": {
+                "program": "fleet_forward",
+                "device_ms": 10.0,
+                "predicted_device_ms": 12.0,
+            },
+        },
+        {
+            "name": "serve_batch",
+            "attributes": {
+                "program": "fleet_forward",
+                "device_ms": 20.0,
+                "predicted_device_ms": 18.0,
+            },
+        },
+        {  # the -1.0 estimator-unavailable sentinel is excluded
+            "name": "serve_batch",
+            "attributes": {"device_ms": 5.0, "predicted_device_ms": -1.0},
+        },
+        {  # measured-zero spans never divide by zero
+            "name": "serve_batch",
+            "attributes": {"device_ms": 0.0, "predicted_device_ms": 3.0},
+        },
+    ]
+
+
+def test_prediction_accuracy_scores_only_honest_pairs():
+    doc = prediction_accuracy(accuracy_spans())
+    assert set(doc) == {"fleet_forward"}
+    entry = doc["fleet_forward"]
+    assert entry["count"] == 2
+    assert entry["error_p50"] == pytest.approx(0.1)
+    assert entry["error_p95"] == pytest.approx(0.2)
+    assert entry["bias"] == pytest.approx(0.9)
+    assert prediction_accuracy([]) is None
+
+
+def test_trace_report_carries_the_accuracy_table(tmp_path):
+    path = tmp_path / "serve_trace.jsonl"
+    with open(path, "w") as f:
+        for span in accuracy_spans():
+            f.write(json.dumps(span) + "\n")
+    doc = analyze_trace(str(path))
+    assert doc["prediction_accuracy"]["fleet_forward"]["count"] == 2
+    text = render_analysis(doc)
+    assert "Prediction accuracy" in text
+    assert "fleet_forward" in text
+
+
+# -- the perfmodel CLI -------------------------------------------------------
+
+
+def test_perfmodel_cli_fit_status_eval(tmp_path):
+    corpus = str(tmp_path / "telemetry")
+    write_corpus(corpus, grid_spans(jitter=0.02))
+    table = str(tmp_path / "cost_table.json")
+    runner = CliRunner()
+
+    result = runner.invoke(
+        gordo_tpu_cli,
+        [
+            "perfmodel", "fit", corpus,
+            "--table", table, "--min-samples", "8", "--as-json",
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    doc = json.loads(result.output)
+    assert doc["promoted"] is True
+
+    result = runner.invoke(
+        gordo_tpu_cli, ["perfmodel", "status", "--table", table, "--as-json"]
+    )
+    assert result.exit_code == 0, result.output
+    status = json.loads(result.output)
+    assert status["learned"] is True
+    assert {m["target"] for m in status["models"]} >= {
+        "device_ms", "compile_ms",
+    }
+
+    result = runner.invoke(
+        gordo_tpu_cli,
+        ["perfmodel", "eval", corpus, "--table", table, "--as-json"],
+    )
+    assert result.exit_code == 0, result.output
+    evaluation = json.loads(result.output)
+    forward = next(
+        m
+        for m in evaluation["models"]
+        if (m["target"], m["program"]) == ("device_ms", "fleet_forward")
+    )
+    assert forward["learned_mae_log"] < forward["analytic_mae_log"]
+
+
+def test_perfmodel_cli_fit_on_an_empty_corpus_is_calm(tmp_path):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo_tpu_cli,
+        ["perfmodel", "fit", str(empty), "--as-json"],
+    )
+    assert result.exit_code == 0, result.output
+    doc = json.loads(result.output)
+    assert doc["promoted"] is False
+    assert "empty corpus" in doc["reason"]

@@ -1,0 +1,112 @@
+"""
+Harvesting drills: spans → training rows. Rows are extracted only when
+the span carries the full static feature set AND a positive measured
+target — anything else is skipped, never guessed — and corpus discovery
+reuses the telemetry plane's own trace readers (rotated generations,
+per-worker sinks, span dedup).
+"""
+
+import math
+
+import pytest
+
+from gordo_tpu.perfmodel import (
+    corpus_fingerprint,
+    harvest_corpus,
+    rows_from_spans,
+)
+from gordo_tpu.planner.costmodel import learned_feature_vector
+
+from tests.perfmodel.conftest import (
+    FLOPS,
+    compile_span,
+    grid_spans,
+    serve_span,
+    write_corpus,
+)
+
+pytestmark = pytest.mark.perfmodel
+
+
+def test_serve_batch_spans_become_forward_device_rows():
+    rows = rows_from_spans([serve_span(0, members=4, rows=32, device_ms=7.5)])
+    assert len(rows) == 1
+    row = rows[0]
+    assert (row.target, row.program, row.y) == ("device_ms", "fleet_forward", 7.5)
+    assert row.features == tuple(
+        learned_feature_vector(FLOPS, 4, 32, 1, "f32")
+    )
+
+
+def test_compile_spans_pin_shape_axes_to_one():
+    """Compile cost tracks program complexity, not data volume: the
+    member/row/epoch features pin to log(1)=0 exactly like
+    ``CostModel.predict_compile_s`` evaluates them."""
+    rows = rows_from_spans([compile_span(0, members=8, rows=512)])
+    assert len(rows) == 1
+    row = rows[0]
+    assert (row.target, row.program) == ("compile_ms", "fleet_forward")
+    assert row.features[1:4] == (0.0, 0.0, 0.0)
+
+
+def test_rows_without_static_features_or_targets_are_skipped():
+    missing_flops = serve_span(0, members=4, rows=32)
+    del missing_flops["attributes"]["flops_per_sample"]
+    zero = serve_span(1, members=4, rows=32, device_ms=0.0)
+    negative = serve_span(2, members=4, rows=32, device_ms=-1.0)
+    unknown = {"name": "other_span", "attributes": {"device_ms": 5.0}}
+    assert rows_from_spans([missing_flops, zero, negative, unknown, None]) == []
+
+
+def test_hbm_attribute_adds_a_peak_memory_row():
+    span = serve_span(0, members=4, rows=32, device_ms=7.5, hbm_bytes=1 << 20)
+    rows = rows_from_spans([span])
+    assert {r.target for r in rows} == {"device_ms", "hbm_bytes"}
+    hbm = next(r for r in rows if r.target == "hbm_bytes")
+    assert hbm.y == float(1 << 20)
+    assert hbm.program == "fleet_forward"
+
+
+def test_device_program_run_spans_use_their_program_attribute():
+    span = compile_span(0, members=4, rows=64)
+    span["attributes"].pop("compile")
+    span["attributes"]["program"] = "fleet_fit"
+    span["attributes"]["epochs"] = 3
+    rows = rows_from_spans([span])
+    assert len(rows) == 1
+    assert rows[0].program == "fleet_fit"
+    assert rows[0].features[3] == pytest.approx(math.log(3))
+
+
+def test_harvest_corpus_empty_and_absent_directories(tmp_path):
+    rows, stats = harvest_corpus(str(tmp_path / "nowhere"))
+    assert rows == [] and stats["spans"] == 0
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    rows, stats = harvest_corpus(str(empty))
+    assert rows == [] and stats["rows"] == 0
+
+
+def test_harvest_corpus_counts_populations(tmp_path):
+    directory = str(tmp_path / "telemetry")
+    write_corpus(directory, grid_spans())
+    rows, stats = harvest_corpus(directory)
+    assert stats["rows"] == len(rows) > 0
+    assert stats["rows_by_model"]["device_ms/fleet_forward"] == 72
+    assert stats["rows_by_model"]["compile_ms/fleet_forward"] == 36
+
+
+def test_harvest_skips_torn_trailing_line(tmp_path):
+    directory = str(tmp_path / "telemetry")
+    path = write_corpus(directory, [serve_span(0, members=2, rows=16)])
+    with open(path, "a") as f:
+        f.write('{"name": "serve_batch", "attributes": {"padded')  # torn
+    rows, _ = harvest_corpus(directory)
+    assert len(rows) == 1
+
+
+def test_fingerprint_is_order_independent_and_content_sensitive():
+    a = rows_from_spans(grid_spans())
+    b = list(reversed(a))
+    assert corpus_fingerprint(a) == corpus_fingerprint(b)
+    assert corpus_fingerprint(a) != corpus_fingerprint(a[:-1])

@@ -1,0 +1,149 @@
+"""
+Regressor drills: the closed-form ridge recovers a known log-linear
+law, the holdout split is deterministic and stratified, and the sample
+floor refuses to fit noise-sized populations.
+"""
+
+import math
+
+import pytest
+
+from gordo_tpu.perfmodel import (
+    analytic_prediction,
+    evaluate_rows,
+    fit_ridge,
+    fit_section,
+    holdout_split,
+)
+from gordo_tpu.perfmodel.features import TrainingRow, rows_from_spans
+from gordo_tpu.perfmodel.model import coef_predict, min_samples_floor
+from gordo_tpu.planner.costmodel import CostTable, learned_feature_vector
+
+from tests.perfmodel.conftest import grid_spans, true_device_ms
+
+pytestmark = pytest.mark.perfmodel
+
+
+def device_rows():
+    return [
+        r
+        for r in rows_from_spans(grid_spans())
+        if r.target == "device_ms"
+    ]
+
+
+def test_fit_ridge_recovers_an_exact_log_linear_law():
+    """y = 0.05 * members^0.9 * rows^0.8 is exactly linear in the log
+    features; the closed-form fit must recover the exponents."""
+    rows = device_rows()
+    coef = fit_ridge(
+        [r.features for r in rows], [math.log(r.y) for r in rows]
+    )
+    assert coef[2] == pytest.approx(0.9, abs=0.02)  # log_members
+    assert coef[3] == pytest.approx(0.8, abs=0.02)  # log_rows
+    assert coef[5] == pytest.approx(math.log(0.7), abs=0.05)  # bf16 scale
+    for row in rows:
+        assert coef_predict(coef, row.features) == pytest.approx(
+            row.y, rel=0.05
+        )
+
+
+def test_fit_ridge_rejects_empty_input():
+    with pytest.raises(ValueError):
+        fit_ridge([], [])
+
+
+def test_holdout_split_is_deterministic_and_stratified():
+    rows = device_rows()
+    train_a, holdout_a = holdout_split(rows)
+    train_b, holdout_b = holdout_split(list(reversed(rows)))
+    assert train_a == train_b and holdout_a == holdout_b
+    assert len(holdout_a) == pytest.approx(len(rows) / 4, abs=1)
+    assert sorted(train_a + holdout_a) == sorted(rows)
+
+
+def test_tiny_populations_still_hold_one_out():
+    rows = [
+        TrainingRow("device_ms", "p", (float(i), 0, 0, 0, 0, 0), float(i + 1))
+        for i in range(3)
+    ]
+    train, holdout = holdout_split(rows)
+    assert len(holdout) == 1 and len(train) == 2
+
+
+def test_evaluate_rows_excludes_unanswered_predictions():
+    rows = device_rows()[:8]
+    mae, n = evaluate_rows(rows, lambda r: r.y)  # perfect oracle
+    assert (mae, n) == (pytest.approx(0.0), 8)
+    mae, n = evaluate_rows(rows, lambda r: None)
+    assert n == 0 and mae == math.inf
+    # half answered: only the answered half is scored
+    mae, n = evaluate_rows(
+        rows, lambda r: r.y if r.features[1] > 0.0 else None
+    )
+    assert 0 < n < 8
+
+
+def test_analytic_prediction_replays_the_formula_per_target():
+    table = CostTable()
+    features = learned_feature_vector(100.0, 8, 128, 1, "f32")
+    device = analytic_prediction(table, "device_ms", "fleet_forward", features)
+    # (flops*members*rows / throughput + dispatch) * 1000
+    expected = (100.0 * 8 * 128 / table.throughput + table.dispatch_s) * 1000.0
+    assert device == pytest.approx(expected, rel=1e-6)
+    compiled = analytic_prediction(table, "compile_ms", "fleet_forward", features)
+    assert compiled == pytest.approx(
+        (table.compile_floor_s + table.compile_per_flop * 100.0) * 1000.0,
+        rel=1e-6,
+    )
+    # HBM has no feature-only analytic counterpart
+    assert analytic_prediction(table, "hbm_bytes", "fleet_forward", features) is None
+
+
+def test_min_samples_floor_env_and_override(monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_PERFMODEL_MIN_SAMPLES", raising=False)
+    assert min_samples_floor() == 32
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_MIN_SAMPLES", "10")
+    assert min_samples_floor() == 10
+    assert min_samples_floor(override=4) == 4
+    assert min_samples_floor(override=0) == 2  # never below 2
+
+
+def test_fit_section_skips_small_populations_and_reports_them():
+    rows = device_rows()
+    rows.append(
+        TrainingRow("device_ms", "fleet_fit", rows[0].features, 5.0)
+    )
+    section = fit_section(rows, min_samples=8)
+    assert "fleet_forward" in section["targets"]["device_ms"]
+    assert "fleet_fit" not in section["targets"]["device_ms"]
+    assert section["skipped"] == {"device_ms/fleet_fit": 1}
+    entry = section["targets"]["device_ms"]["fleet_forward"]
+    assert entry["n"] == len(rows) - 1
+    assert entry["holdout_mae_log"] < 0.05  # the law is exactly learnable
+    assert len(entry["coef"]) == 7
+    assert len(entry["lo"]) == len(entry["hi"]) == 6
+
+
+def test_fit_section_returns_none_when_nothing_qualifies():
+    assert fit_section(device_rows()[:4], min_samples=100) is None
+    assert fit_section([], min_samples=2) is None
+
+
+def test_fit_section_round_trips_through_table_validation():
+    from gordo_tpu.planner.costmodel import validate_learned_section
+
+    section = fit_section(device_rows(), min_samples=8)
+    assert validate_learned_section(section) is section
+    table = CostTable(learned=section)
+    row = device_rows()[0]
+    predicted = table.learned_predict("device_ms", "fleet_forward", row.features)
+    assert predicted == pytest.approx(row.y, rel=0.1)
+
+
+def test_learned_prediction_refuses_out_of_domain_shapes():
+    section = fit_section(device_rows(), min_samples=8)
+    table = CostTable(learned=section)
+    # 4096 members is far outside the trained 1..16 box + slack
+    far = learned_feature_vector(100.0, 4096, 32, 1, "f32")
+    assert table.learned_predict("device_ms", "fleet_forward", far) is None

@@ -1,0 +1,123 @@
+"""
+The knob-off regression contract: with ``GORDO_TPU_PERFMODEL`` unset, a
+cost table carrying a fitted learned section must produce BYTE-IDENTICAL
+FleetPlan JSON to the same table without the section — the learned model
+may only change behavior when asked to. With the knob on, the plan doc
+records that the learned ruler participated.
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from gordo_tpu.planner.costmodel import CostModel, CostTable
+from gordo_tpu.planner.packing import PACKED, plan_train_buckets
+from gordo_tpu.planner.plan import build_plan_doc, config_fingerprint
+
+from tests.perfmodel.conftest import SPEC
+from tests.perfmodel.test_table_safety import valid_section
+
+pytestmark = [pytest.mark.perfmodel, pytest.mark.planner]
+
+CONFIG = SimpleNamespace(
+    epochs=2,
+    batch_size=16,
+    validation_split=0.1,
+    shuffle=False,
+    early_stopping=None,
+)
+
+
+def training_section():
+    """A learned section whose models answer the TRAINING programs the
+    planner costs (wide domain box, deliberately wild coefficients — if
+    the knob-off path consulted them, packing would visibly change)."""
+    entry = {
+        "coef": [5.0, 0.1, 1.5, 1.2, 1.0, 0.0, 0.0],
+        "lo": [0.0] * 6,
+        "hi": [30.0] * 6,
+        "n": 64,
+        "holdout_mae_log": 0.05,
+    }
+    section = valid_section()
+    section["targets"] = {
+        "device_ms": {"fleet_fit": dict(entry), "fleet_forward": dict(entry)},
+        "compile_ms": {"fleet_fit": dict(entry)},
+        "hbm_bytes": {"fleet_fit": dict(entry)},
+    }
+    return section
+
+
+def make_plan(table):
+    members = [
+        SimpleNamespace(name=name, spec=SPEC, n=n)
+        for name, n in (("a", 50), ("b", 120), ("c", 700))
+    ]
+    cost_model = CostModel(table)
+    buckets = plan_train_buckets(
+        members, CONFIG, strategy=PACKED, cost_model=cost_model
+    )
+    return build_plan_doc(
+        [(CONFIG, buckets)],
+        PACKED,
+        cost_model.mesh_shape,
+        cost_model.table,
+        config_fingerprint(["k1", "k2", "k3"]),
+    )
+
+
+def test_knob_off_plans_are_byte_identical(monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_PERFMODEL", raising=False)
+    plain = make_plan(CostTable())
+    with_section = make_plan(CostTable(learned=training_section()))
+    assert with_section.to_json() == plain.to_json()
+    assert with_section.plan_hash == plain.plan_hash
+    assert with_section.doc["cost_table"]["learned"] is False
+
+
+def test_knob_off_explicit_zero_is_the_same_contract(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL", "0")
+    plain = make_plan(CostTable())
+    with_section = make_plan(CostTable(learned=training_section()))
+    assert with_section.to_json() == plain.to_json()
+
+
+def test_knob_on_plan_records_the_learned_ruler(monkeypatch):
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL", "1")
+    learned = make_plan(CostTable(learned=training_section()))
+    assert learned.doc["cost_table"]["learned"] is True
+    # a learned-section-free table stays honest about its ruler
+    assert make_plan(CostTable()).doc["cost_table"]["learned"] is False
+
+
+def test_knob_on_predictions_actually_diverge(monkeypatch):
+    """The knob must route predictions through the regressors — a knob
+    that only flips a doc flag would pass the parity tests vacuously."""
+    monkeypatch.delenv("GORDO_TPU_PERFMODEL", raising=False)
+    table = CostTable(learned=training_section())
+    off = CostModel(table, use_learned=False)
+    on = CostModel(table, use_learned=True)
+    assert on.predict_serve_step_s(SPEC, 8, 128, "f32") != off.predict_serve_step_s(
+        SPEC, 8, 128, "f32"
+    )
+    assert on.predict_run_s("fleet_fit", SPEC, 8, 128, 2) != off.predict_run_s(
+        "fleet_fit", SPEC, 8, 128, 2
+    )
+
+
+def test_cold_start_plan_matches_the_analytic_defaults(tmp_path, monkeypatch):
+    """Satellite 3, the cold-start half: an empty corpus promotes no
+    table, and planning through ``load_table_safe`` of the absent path
+    is byte-identical to the analytic defaults — knob on or off."""
+    from gordo_tpu.perfmodel import fit_and_promote
+    from gordo_tpu.planner.costmodel import load_table_safe
+
+    empty = tmp_path / "empty-corpus"
+    empty.mkdir()
+    table_path = str(tmp_path / "cost_table.json")
+    report = fit_and_promote(str(empty), table_path=table_path)
+    assert report["promoted"] is False
+    for knob in ("0", "1"):
+        monkeypatch.setenv("GORDO_TPU_PERFMODEL", knob)
+        cold = make_plan(load_table_safe(table_path))
+        assert cold.to_json() == make_plan(CostTable()).to_json()

@@ -1,0 +1,242 @@
+"""
+Promotion-lifecycle drills: fit_and_promote end to end (gate, write,
+fingerprint skip, carry-forward), the per-model accuracy gate's
+verdicts, and the supervisor-facing recalibration hook's safety
+contract (env-gated, never raises).
+"""
+
+import json
+import os
+
+import pytest
+
+from gordo_tpu.perfmodel import (
+    default_table_path,
+    fit_and_promote,
+    harvest_corpus,
+    maybe_recalibrate,
+    section_status,
+)
+from gordo_tpu.perfmodel.service import _gate_entry
+from gordo_tpu.planner.costmodel import COST_TABLE_FILE, CostTable, load_table_safe
+
+from tests.perfmodel.conftest import compile_span, write_corpus
+
+pytestmark = pytest.mark.perfmodel
+
+
+def test_fit_and_promote_installs_a_gated_section(corpus_dir, tmp_path):
+    path = str(tmp_path / "cost_table.json")
+    report = fit_and_promote(corpus_dir, table_path=path, min_samples=8)
+    assert report["promoted"] is True
+    assert report["reason"] == "promoted"
+    assert all(m["accepted"] for m in report["models"])
+    for model in report["models"]:
+        # the gate's whole point: every promoted model beat analytic
+        assert model["holdout_mae_log"] <= model["analytic_mae_log"]
+    table = load_table_safe(path)
+    assert table.has_learned
+    assert table.learned["corpus"]["fingerprint"] == report["fingerprint"]
+    # analytic factors survive promotion untouched
+    assert table.throughput == CostTable().throughput
+
+
+def test_unchanged_corpus_skips_the_refit(corpus_dir, tmp_path):
+    path = str(tmp_path / "cost_table.json")
+    fit_and_promote(corpus_dir, table_path=path, min_samples=8)
+    before = open(path).read()
+    again = fit_and_promote(corpus_dir, table_path=path, min_samples=8)
+    assert again["promoted"] is False
+    assert again["reason"] == "corpus unchanged since incumbent fit"
+    assert open(path).read() == before
+    # force overrides the fingerprint skip (but not the gate)
+    forced = fit_and_promote(
+        corpus_dir, table_path=path, min_samples=8, force=True
+    )
+    assert forced["promoted"] is True
+
+
+def test_empty_corpus_promotes_nothing_and_writes_nothing(tmp_path):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    path = str(tmp_path / "cost_table.json")
+    report = fit_and_promote(str(empty), table_path=path)
+    assert report["promoted"] is False
+    assert "empty corpus" in report["reason"]
+    assert not os.path.exists(path)
+
+
+def test_below_floor_corpus_keeps_the_incumbent_table(tmp_path):
+    directory = str(tmp_path / "telemetry")
+    write_corpus(directory, [compile_span(i, 2, 16) for i in range(4)])
+    path = str(tmp_path / "cost_table.json")
+    report = fit_and_promote(directory, table_path=path, min_samples=32)
+    assert report["promoted"] is False
+    assert "sample floor" in report["reason"]
+    assert not os.path.exists(path)
+
+
+def test_gate_rejects_a_candidate_that_loses_to_analytic(corpus_dir):
+    rows, _ = harvest_corpus(corpus_dir)
+    population = [r for r in rows if r.target == "device_ms"]
+    bad_entry = {
+        "coef": [50.0, 0, 0, 0, 0, 0, 0],  # predicts e^50 ms everywhere
+        "lo": [0.0] * 6,
+        "hi": [50.0] * 6,
+        "n": len(population),
+        "holdout_mae_log": 45.0,
+    }
+    verdict = _gate_entry(
+        "device_ms", "fleet_forward", bad_entry, population, CostTable()
+    )
+    assert verdict["accepted"] is False
+    assert verdict["reason"] == "loses to analytic"
+
+
+def test_gate_rejects_a_candidate_that_loses_to_the_incumbent(
+    corpus_dir, fitted_table_path
+):
+    rows, _ = harvest_corpus(corpus_dir)
+    population = [r for r in rows if r.target == "device_ms"]
+    incumbent = load_table_safe(fitted_table_path)
+    # an "ok but worse than the promoted fit" candidate: beats the (far
+    # off) analytic defaults, loses to the incumbent regressor
+    mediocre = {
+        "coef": incumbent.learned_entry("device_ms", "fleet_forward")["coef"],
+        "lo": [0.0] * 6,
+        "hi": [50.0] * 6,
+        "n": len(population),
+        "holdout_mae_log": 1.0,
+    }
+    verdict = _gate_entry(
+        "device_ms", "fleet_forward", mediocre, population, incumbent
+    )
+    assert verdict["accepted"] is False
+    assert verdict["reason"] == "loses to incumbent"
+    assert verdict["incumbent_mae_log"] is not None
+
+
+def test_hbm_gate_uses_the_median_baseline(tmp_path):
+    """hbm_bytes has no feature-only analytic counterpart: its gate
+    baseline is the train-median predictor."""
+    from tests.perfmodel.conftest import serve_span
+
+    directory = str(tmp_path / "telemetry")
+    spans = [
+        serve_span(i, m, r, device_ms=1.0, hbm_bytes=1024.0 * m * r)
+        for i, (m, r) in enumerate(
+            (m, r) for m in (1, 2, 4, 8, 12, 16) for r in (16, 32, 64, 128)
+        )
+    ]
+    write_corpus(directory, spans)
+    path = str(tmp_path / "cost_table.json")
+    report = fit_and_promote(directory, table_path=path, min_samples=8)
+    hbm = [m for m in report["models"] if m["target"] == "hbm_bytes"]
+    assert len(hbm) == 1
+    assert hbm[0]["accepted"] is True
+    assert hbm[0]["analytic_mae_log"] is not None  # the median baseline
+    table = load_table_safe(path)
+    predicted = table.learned_predict(
+        "hbm_bytes",
+        "fleet_forward",
+        [r for r in harvest_corpus(directory)[0] if r.target == "hbm_bytes"][0]
+        .features,
+    )
+    assert predicted == pytest.approx(1024.0 * 1 * 16, rel=0.2)
+
+
+def test_serve_only_refit_carries_forward_other_models(
+    corpus_dir, fitted_table_path, tmp_path
+):
+    """A later corpus that only exercises compile spans must not evict
+    the incumbent device_ms regressor from the table."""
+    incumbent = load_table_safe(fitted_table_path)
+    assert incumbent.learned_entry("device_ms", "fleet_forward")
+    compile_only = str(tmp_path / "compile-only")
+    write_corpus(
+        compile_only,
+        [
+            compile_span(i, 1, 1, device_ms=60.0 + 0.01 * i)
+            for i in range(24)
+        ],
+    )
+    report = fit_and_promote(
+        compile_only, table_path=fitted_table_path, min_samples=8
+    )
+    assert report["promoted"] is True
+    table = load_table_safe(fitted_table_path)
+    assert table.learned_entry("compile_ms", "fleet_forward") is not None
+    assert table.learned_entry("device_ms", "fleet_forward") is not None
+
+
+def test_default_table_path_resolution(monkeypatch, tmp_path):
+    monkeypatch.delenv("GORDO_TPU_PERFMODEL_TABLE", raising=False)
+    assert default_table_path() is None
+    assert default_table_path(str(tmp_path)) == str(
+        tmp_path / COST_TABLE_FILE
+    )
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_TABLE", "/pinned/table.json")
+    assert default_table_path(str(tmp_path)) == "/pinned/table.json"
+
+
+def test_section_status_reports_the_models(fitted_table_path):
+    doc = section_status(fitted_table_path)
+    assert doc["exists"] and doc["learned"]
+    assert {m["target"] for m in doc["models"]} >= {"device_ms", "compile_ms"}
+    assert "fingerprint" in doc["corpus"]
+    absent = section_status("/nowhere/cost_table.json")
+    assert absent["exists"] is False and absent["learned"] is False
+
+
+def test_maybe_recalibrate_is_env_gated_and_never_raises(
+    monkeypatch, corpus_dir, tmp_path
+):
+    monkeypatch.delenv("GORDO_TPU_PERFMODEL_RECAL", raising=False)
+    assert maybe_recalibrate(corpus_dir) is None  # knob off: no-op
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_RECAL", "1")
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_MIN_SAMPLES", "8")
+    path = str(tmp_path / "cost_table.json")
+    result = maybe_recalibrate(corpus_dir, table_path=path)
+    assert result is not None and result["promoted"] is True
+    # a blown-up fit is a warning + None, never an exception
+    import gordo_tpu.perfmodel.service as service
+
+    monkeypatch.setattr(
+        service,
+        "fit_and_promote",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    assert service.maybe_recalibrate(corpus_dir, table_path=path) is None
+
+
+def test_supervisor_hook_records_the_recalibration(
+    monkeypatch, corpus_dir, tmp_path
+):
+    """The lifecycle hook surface: env-gated, reads the telemetry-dir
+    knob, stamps the cycle report and emits one recorder event."""
+    from gordo_tpu.lifecycle.loop import CycleReport, LifecycleSupervisor
+
+    events = []
+
+    class FakeRecorder:
+        def event(self, name, **attrs):
+            events.append((name, attrs))
+
+    sup = LifecycleSupervisor.__new__(LifecycleSupervisor)
+    sup.collection_dir = corpus_dir
+    sup.recorder = FakeRecorder()
+    report = CycleReport()
+    monkeypatch.delenv("GORDO_TPU_PERFMODEL_RECAL", raising=False)
+    monkeypatch.delenv("GORDO_TPU_TELEMETRY_DIR", raising=False)
+    sup._maybe_recalibrate(report)
+    assert "perfmodel" not in report.details and not events
+
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_RECAL", "1")
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL_MIN_SAMPLES", "8")
+    monkeypatch.setenv(
+        "GORDO_TPU_PERFMODEL_TABLE", str(tmp_path / "cost_table.json")
+    )
+    sup._maybe_recalibrate(report)
+    assert report.details["perfmodel"]["promoted"] is True
+    assert events and events[0][0] == "perfmodel_recalibrated"
+    assert events[0][1]["promoted"] is True

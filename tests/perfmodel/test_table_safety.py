@@ -1,0 +1,156 @@
+"""
+Cold-start and corrupt-table drills (the satellite-3 contract): a
+missing, truncated, mis-versioned or hand-mangled ``cost_table.json``
+must warn and degrade to the analytic defaults — never traceback — and
+a malformed ``learned`` section must degrade alone, keeping the table's
+calibrated factors.
+"""
+
+import json
+import logging
+
+import pytest
+
+from gordo_tpu.planner.costmodel import (
+    CostModel,
+    CostTable,
+    load_table_safe,
+    validate_learned_section,
+)
+
+from tests.perfmodel.conftest import SPEC
+
+pytestmark = pytest.mark.perfmodel
+
+
+def valid_section():
+    return {
+        "version": 1,
+        "features": [
+            "log_flops_per_sample",
+            "log_members",
+            "log_rows",
+            "log_epochs",
+            "bf16",
+            "int8",
+        ],
+        "targets": {
+            "device_ms": {
+                "fleet_forward": {
+                    "coef": [0.1, 0.0, 1.0, 1.0, 0.0, 0.0, 0.0],
+                    "lo": [0.0] * 6,
+                    "hi": [20.0] * 6,
+                    "n": 64,
+                    "holdout_mae_log": 0.05,
+                }
+            }
+        },
+    }
+
+
+def test_load_table_safe_never_raises(tmp_path, caplog):
+    assert load_table_safe(None).calibrated is False
+    with caplog.at_level(logging.WARNING):
+        missing = load_table_safe(str(tmp_path / "nowhere.json"))
+    assert missing.to_dict() == CostTable().to_dict()
+    assert "Unusable cost table" in caplog.text
+
+    truncated = tmp_path / "truncated.json"
+    truncated.write_text('{"version": 1, "run_factors": {"fleet')
+    assert load_table_safe(str(truncated)).to_dict() == CostTable().to_dict()
+
+    wrong_version = tmp_path / "versioned.json"
+    wrong_version.write_text(json.dumps({"version": 99}))
+    assert (
+        load_table_safe(str(wrong_version)).to_dict() == CostTable().to_dict()
+    )
+
+
+@pytest.mark.parametrize(
+    "mangle",
+    [
+        lambda s: 7,  # not a dict
+        lambda s: {**s, "version": 2},  # future section version
+        lambda s: {**s, "features": ["log_flops_per_sample"]},  # vocab drift
+        lambda s: {**s, "targets": "oops"},
+        lambda s: {**s, "targets": {"warp_speed": s["targets"]["device_ms"]}},
+        lambda s: {
+            **s,
+            "targets": {
+                "device_ms": {
+                    "fleet_forward": {
+                        **s["targets"]["device_ms"]["fleet_forward"],
+                        "coef": [1.0, 2.0],  # wrong arity
+                    }
+                }
+            },
+        },
+        lambda s: {
+            **s,
+            "targets": {
+                "device_ms": {
+                    "fleet_forward": {
+                        **s["targets"]["device_ms"]["fleet_forward"],
+                        "coef": [float("nan")] + [0.0] * 6,
+                    }
+                }
+            },
+        },
+    ],
+)
+def test_malformed_learned_sections_degrade_with_a_warning(mangle, caplog):
+    with caplog.at_level(logging.WARNING):
+        assert validate_learned_section(mangle(valid_section())) is None
+    assert "learned section" in caplog.text
+
+
+def test_a_bad_learned_section_degrades_without_rejecting_the_table(
+    tmp_path, caplog
+):
+    """The calibrated factors are still good: only the learned section
+    is dropped."""
+    doc = CostTable(run_factors={"fleet_fit": 3.0}).to_dict()
+    doc["learned"] = {**valid_section(), "version": 42}
+    path = tmp_path / "cost_table.json"
+    path.write_text(json.dumps(doc))
+    with caplog.at_level(logging.WARNING):
+        table = load_table_safe(str(path))
+    assert table.run_factors == {"fleet_fit": 3.0}  # factors survive
+    assert table.learned is None and not table.has_learned
+    assert "falling back to the analytic model" in caplog.text
+
+
+def test_valid_section_round_trips_through_save_and_load(tmp_path):
+    table = CostTable(learned=valid_section())
+    path = str(tmp_path / "cost_table.json")
+    table.save(path)
+    loaded = load_table_safe(path)
+    assert loaded.has_learned
+    assert loaded.to_dict() == table.to_dict()
+
+
+def test_knob_off_model_ignores_a_learned_section(monkeypatch):
+    """One consistent ruler: with GORDO_TPU_PERFMODEL unset the learned
+    section is inert — predictions are byte-for-byte the analytic
+    model's even when the table carries fitted regressors."""
+    monkeypatch.delenv("GORDO_TPU_PERFMODEL", raising=False)
+    learned = CostModel(CostTable(learned=valid_section()))
+    plain = CostModel(CostTable())
+    for members, rows in ((1, 16), (8, 128), (16, 512)):
+        assert learned.predict_serve_step_s(
+            SPEC, members, rows, "f32"
+        ) == plain.predict_serve_step_s(SPEC, members, rows, "f32")
+    # the same table with the knob pinned on diverges in-domain
+    pinned = CostModel(CostTable(learned=valid_section()), use_learned=True)
+    assert pinned.predict_serve_step_s(SPEC, 8, 128, "f32") != plain.predict_serve_step_s(
+        SPEC, 8, 128, "f32"
+    )
+
+
+def test_use_learned_resolves_once_at_construction(monkeypatch):
+    monkeypatch.delenv("GORDO_TPU_PERFMODEL", raising=False)
+    model = CostModel(CostTable(learned=valid_section()))
+    assert model.use_learned is False
+    monkeypatch.setenv("GORDO_TPU_PERFMODEL", "1")
+    assert model.use_learned is False  # pinned for the instance lifetime
+    assert CostModel(CostTable(learned=valid_section())).use_learned is True
